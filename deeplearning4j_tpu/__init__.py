@@ -1,0 +1,34 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning suite with Deeplearning4j's capabilities.
+
+A brand-new framework built on JAX/XLA/pjit/Pallas that reproduces the capability
+surface of the Deeplearning4j suite (reference: buluceli/deeplearning4j, surveyed in
+SURVEY.md) with a TPU-first architecture:
+
+- ``ndarray``   — NDArray tensor facade over ``jax.Array`` (nd4j-api equivalent,
+                  ref: nd4j/nd4j-backends/nd4j-api-parent/nd4j-api INDArray/Nd4j).
+- ``ops``       — single op-spec registry generating the eager + graph op surfaces
+                  (ref: org.nd4j.linalg.api.ops.* ~2k op classes + codegen-tools).
+- ``autodiff``  — declarative graph engine with whole-graph XLA compilation
+                  (ref: org.nd4j.autodiff.samediff.SameDiff; here the graph traces
+                  to a single jaxpr instead of an op-by-op interpreter).
+- ``nn``        — config-DSL layer framework (ref: deeplearning4j-nn
+                  MultiLayerConfiguration / MultiLayerNetwork / ComputationGraph).
+- ``train``     — updaters / losses / activations / schedules
+                  (ref: org.nd4j.linalg.learning|lossfunctions|activations).
+- ``data``      — ETL: record readers, transforms, dataset iterators
+                  (ref: datavec/ + org.nd4j.linalg.dataset).
+- ``eval``      — Evaluation / ROC / RegressionEvaluation (ref: org.nd4j.evaluation).
+- ``parallel``  — device-mesh distributed training: DP/TP/SP over ICI/DCN collectives
+                  (ref: ParallelWrapper / Spark masters / Aeron parameter server —
+                  superseded by sharded pjit, see SURVEY.md §2.9/§2.10).
+- ``models``    — model zoo (ref: deeplearning4j-zoo) + BERT flagship.
+- ``importers`` — Keras h5 / TF GraphDef / ONNX import (ref: samediff-import,
+                  deeplearning4j-modelimport).
+- ``callbacks`` — training listeners, checkpointing, early stopping
+                  (ref: org.deeplearning4j.optimize.listeners, earlystopping).
+- ``utils``     — model serialization and misc utilities (ref: o.d.util.ModelSerializer).
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.ndarray import NDArray, nd  # noqa: F401

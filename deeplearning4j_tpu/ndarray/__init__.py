@@ -1,0 +1,7 @@
+"""Tensor core: NDArray facade + factory + dtypes + RNG (nd4j-api equivalent)."""
+from deeplearning4j_tpu.ndarray.array import NDArray
+from deeplearning4j_tpu.ndarray.factory import nd
+from deeplearning4j_tpu.ndarray import dtypes
+from deeplearning4j_tpu.ndarray.random import Random, getRandom
+
+__all__ = ["NDArray", "nd", "dtypes", "Random", "getRandom"]

@@ -1,0 +1,447 @@
+"""NDArray — the tensor facade (ref: org.nd4j.linalg.api.ndarray.INDArray/BaseNDArray).
+
+A thin, zero-copy wrapper over ``jax.Array`` that preserves the reference's op
+*names and semantics* at the API boundary while keeping the compute path purely
+functional (the TPU-idiomatic form — XLA owns layout/fusion; there is no c/f
+order or stride machinery to manage, see SURVEY.md §7.3 item 4).
+
+In-place ``i``-variants (``addi``, ``muli`` …) rebind the wrapper to the new
+functional value — observationally in-place for the common "handle held in one
+place" pattern the reference's training loops use, without fighting XLA's
+immutable buffers. True aliasing of *views* is intentionally not reproduced;
+``dup()`` remains a semantic copy.
+
+NDArray is registered as a jax pytree node so it can flow through jit/grad/vmap
+transparently.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray import dtypes as _dt
+
+
+def _unwrap(x):
+    return x._jax if isinstance(x, NDArray) else x
+
+
+def _wrap(x):
+    return NDArray(x) if isinstance(x, (jax.Array, np.ndarray)) else x
+
+
+class NDArray:
+    """N-dimensional array over a jax.Array value."""
+
+    __slots__ = ("_jax",)
+
+    def __init__(self, value):
+        if isinstance(value, NDArray):
+            value = value._jax
+        if not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._jax = value
+
+    # ------------------------------------------------------------------ basics
+    @property
+    def jax(self) -> jax.Array:
+        """The underlying jax.Array (escape hatch)."""
+        return self._jax
+
+    @property
+    def shape(self):
+        return tuple(self._jax.shape)
+
+    @property
+    def dtype(self):
+        return self._jax.dtype
+
+    def dataType(self) -> str:
+        return _dt.name_of(self._jax.dtype)
+
+    def rank(self) -> int:
+        return self._jax.ndim
+
+    @property
+    def ndim(self) -> int:
+        return self._jax.ndim
+
+    def length(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def size(self) -> int:
+        return self.length()
+
+    def isScalar(self) -> bool:
+        return self._jax.ndim == 0
+
+    def isVector(self) -> bool:
+        return self._jax.ndim == 1
+
+    def isMatrix(self) -> bool:
+        return self._jax.ndim == 2
+
+    def rows(self) -> int:
+        return self.shape[0]
+
+    def columns(self) -> int:
+        return self.shape[1]
+
+    def dup(self) -> "NDArray":
+        """Semantic copy (ref: INDArray.dup)."""
+        return NDArray(jnp.array(self._jax))
+
+    def castTo(self, dtype) -> "NDArray":
+        return NDArray(self._jax.astype(_dt.resolve(dtype)))
+
+    astype = castTo
+
+    def toNumpy(self) -> np.ndarray:
+        return np.asarray(self._jax)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._jax)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self):
+        return self._jax.item()
+
+    def getDouble(self, *indices) -> float:
+        return float(self._jax[tuple(indices)] if indices else self._jax)
+
+    def getInt(self, *indices) -> int:
+        return int(self._jax[tuple(indices)] if indices else self._jax)
+
+    # --------------------------------------------------------- binary arithmetic
+    def _binary(self, other, fn) -> "NDArray":
+        return NDArray(fn(self._jax, _unwrap(other)))
+
+    def _ibinary(self, other, fn) -> "NDArray":
+        self._jax = fn(self._jax, _unwrap(other))
+        return self
+
+    def add(self, other):
+        return self._binary(other, jnp.add)
+
+    def sub(self, other):
+        return self._binary(other, jnp.subtract)
+
+    def mul(self, other):
+        return self._binary(other, jnp.multiply)
+
+    def div(self, other):
+        return self._binary(other, jnp.divide)
+
+    def rsub(self, other):
+        return self._binary(other, lambda a, b: b - a)
+
+    def rdiv(self, other):
+        return self._binary(other, lambda a, b: b / a)
+
+    def fmod(self, other):
+        return self._binary(other, jnp.fmod)
+
+    def pow(self, other):
+        return self._binary(other, jnp.power)
+
+    def addi(self, other):
+        return self._ibinary(other, jnp.add)
+
+    def subi(self, other):
+        return self._ibinary(other, jnp.subtract)
+
+    def muli(self, other):
+        return self._ibinary(other, jnp.multiply)
+
+    def divi(self, other):
+        return self._ibinary(other, jnp.divide)
+
+    def rsubi(self, other):
+        return self._ibinary(other, lambda a, b: b - a)
+
+    def rdivi(self, other):
+        return self._ibinary(other, lambda a, b: b / a)
+
+    def neg(self):
+        return NDArray(-self._jax)
+
+    def negi(self):
+        self._jax = -self._jax
+        return self
+
+    def assign(self, other):
+        """Overwrite contents (ref: INDArray.assign) — rebinds to a broadcast copy."""
+        self._jax = jnp.broadcast_to(_unwrap(other), self.shape).astype(self.dtype)
+        return self
+
+    # dunders
+    __add__ = add
+    __radd__ = add
+    __sub__ = sub
+    __rsub__ = rsub
+    __mul__ = mul
+    __rmul__ = mul
+    __truediv__ = div
+    __rtruediv__ = rdiv
+    __pow__ = pow
+    __neg__ = neg
+    __mod__ = fmod
+
+    def __matmul__(self, other):
+        return self.mmul(other)
+
+    # ----------------------------------------------------------------- linalg
+    def mmul(self, other) -> "NDArray":
+        return NDArray(jnp.matmul(self._jax, _unwrap(other)))
+
+    def transpose(self, *axes) -> "NDArray":
+        if not axes:
+            return NDArray(jnp.transpose(self._jax))
+        return NDArray(jnp.transpose(self._jax, axes))
+
+    def permute(self, *axes) -> "NDArray":
+        return NDArray(jnp.transpose(self._jax, axes))
+
+    def transposei(self):
+        self._jax = jnp.transpose(self._jax)
+        return self
+
+    # ------------------------------------------------------------------ shape
+    def reshape(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.reshape(self._jax, shape))
+
+    def ravel(self) -> "NDArray":
+        return NDArray(jnp.ravel(self._jax))
+
+    flatten = ravel
+
+    def broadcast(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.broadcast_to(self._jax, shape))
+
+    def repeat(self, repeats, axis=None) -> "NDArray":
+        return NDArray(jnp.repeat(self._jax, repeats, axis=axis))
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return NDArray(jnp.squeeze(self._jax, axis=axis))
+
+    def expandDims(self, axis) -> "NDArray":
+        return NDArray(jnp.expand_dims(self._jax, axis))
+
+    def swapAxes(self, a, b) -> "NDArray":
+        return NDArray(jnp.swapaxes(self._jax, a, b))
+
+    # ------------------------------------------------------------- reductions
+    def _reduce(self, fn, dims, keepdims=False):
+        axis = None if not dims else (dims if len(dims) > 1 else dims[0])
+        return NDArray(fn(self._jax, axis=axis, keepdims=keepdims))
+
+    def sum(self, *dims, keepdims=False):
+        return self._reduce(jnp.sum, dims, keepdims)
+
+    def mean(self, *dims, keepdims=False):
+        return self._reduce(jnp.mean, dims, keepdims)
+
+    def max(self, *dims, keepdims=False):
+        return self._reduce(jnp.max, dims, keepdims)
+
+    def min(self, *dims, keepdims=False):
+        return self._reduce(jnp.min, dims, keepdims)
+
+    def prod(self, *dims, keepdims=False):
+        return self._reduce(jnp.prod, dims, keepdims)
+
+    def std(self, *dims, keepdims=False, biasCorrected=True):
+        axis = None if not dims else (dims if len(dims) > 1 else dims[0])
+        return NDArray(
+            jnp.std(self._jax, axis=axis, keepdims=keepdims, ddof=1 if biasCorrected else 0)
+        )
+
+    def var(self, *dims, keepdims=False, biasCorrected=True):
+        axis = None if not dims else (dims if len(dims) > 1 else dims[0])
+        return NDArray(
+            jnp.var(self._jax, axis=axis, keepdims=keepdims, ddof=1 if biasCorrected else 0)
+        )
+
+    def norm1(self, *dims, keepdims=False):
+        return self._reduce(lambda a, axis, keepdims: jnp.sum(jnp.abs(a), axis=axis, keepdims=keepdims), dims, keepdims)
+
+    def norm2(self, *dims, keepdims=False):
+        return self._reduce(
+            lambda a, axis, keepdims: jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=keepdims)),
+            dims,
+            keepdims,
+        )
+
+    def normmax(self, *dims, keepdims=False):
+        return self._reduce(lambda a, axis, keepdims: jnp.max(jnp.abs(a), axis=axis, keepdims=keepdims), dims, keepdims)
+
+    def argMax(self, *dims):
+        axis = dims[0] if dims else None
+        return NDArray(jnp.argmax(self._jax, axis=axis))
+
+    def argMin(self, *dims):
+        axis = dims[0] if dims else None
+        return NDArray(jnp.argmin(self._jax, axis=axis))
+
+    def cumsum(self, axis=None):
+        return NDArray(jnp.cumsum(self._jax, axis=axis))
+
+    def cumprod(self, axis=None):
+        return NDArray(jnp.cumprod(self._jax, axis=axis))
+
+    def sumNumber(self) -> float:
+        return float(jnp.sum(self._jax))
+
+    def meanNumber(self) -> float:
+        return float(jnp.mean(self._jax))
+
+    def maxNumber(self) -> float:
+        return float(jnp.max(self._jax))
+
+    def minNumber(self) -> float:
+        return float(jnp.min(self._jax))
+
+    def norm2Number(self) -> float:
+        return float(jnp.sqrt(jnp.sum(self._jax * self._jax)))
+
+    def entropy(self, *dims):
+        axis = None if not dims else (dims if len(dims) > 1 else dims[0])
+        p = self._jax
+        return NDArray(-jnp.sum(p * jnp.log(p), axis=axis))
+
+    # ------------------------------------------------------------ comparisons
+    def gt(self, other):
+        return self._binary(other, jnp.greater)
+
+    def lt(self, other):
+        return self._binary(other, jnp.less)
+
+    def gte(self, other):
+        return self._binary(other, jnp.greater_equal)
+
+    def lte(self, other):
+        return self._binary(other, jnp.less_equal)
+
+    def eq(self, other):
+        return self._binary(other, jnp.equal)
+
+    def neq(self, other):
+        return self._binary(other, jnp.not_equal)
+
+    __gt__ = gt
+    __lt__ = lt
+    __ge__ = gte
+    __le__ = lte
+
+    def __eq__(self, other):  # INDArray.eq semantics: elementwise
+        if isinstance(other, (NDArray, jax.Array, np.ndarray, int, float, bool)):
+            return self.eq(other)
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (NDArray, jax.Array, np.ndarray, int, float, bool)):
+            return self.neq(other)
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    def equalsWithEps(self, other, eps=1e-5) -> bool:
+        o = _unwrap(other)
+        if tuple(jnp.shape(o)) != self.shape:
+            return False
+        return bool(jnp.all(jnp.abs(self._jax - o) <= eps))
+
+    def equals(self, other) -> bool:
+        return self.equalsWithEps(other, 1e-5)
+
+    # --------------------------------------------------------------- indexing
+    def __getitem__(self, idx):
+        return NDArray(self._jax[idx])
+
+    def __setitem__(self, idx, value):
+        self._jax = self._jax.at[idx].set(_unwrap(value))
+
+    def get(self, *indices):
+        """Row/point access (simplified NDArrayIndex: ints and slices)."""
+        return NDArray(self._jax[tuple(indices)])
+
+    def getRow(self, i):
+        return NDArray(self._jax[i])
+
+    def getColumn(self, i):
+        return NDArray(self._jax[:, i])
+
+    def getRows(self, *rows):
+        return NDArray(self._jax[jnp.asarray(rows)])
+
+    def getColumns(self, *cols):
+        return NDArray(self._jax[:, jnp.asarray(cols)])
+
+    def putScalar(self, indices, value):
+        if not isinstance(indices, (tuple, list)):
+            indices = (indices,)
+        self._jax = self._jax.at[tuple(indices)].set(value)
+        return self
+
+    def put(self, indices, value):
+        if not isinstance(indices, (tuple, list)):
+            indices = (indices,)
+        self._jax = self._jax.at[tuple(indices)].set(_unwrap(value))
+        return self
+
+    def putRow(self, i, row):
+        self._jax = self._jax.at[i].set(_unwrap(row))
+        return self
+
+    def putColumn(self, i, col):
+        self._jax = self._jax.at[:, i].set(_unwrap(col))
+        return self
+
+    def slice(self, i, axis=0):
+        return NDArray(jnp.take(self._jax, i, axis=axis))
+
+    def tensorAlongDimension(self, index, *dims):
+        """TAD access (ref: BaseNDArray.tensorAlongDimension) — returns the
+        index-th sub-tensor spanning ``dims``."""
+        dims = sorted(d % self.ndim for d in dims)
+        other = [d for d in range(self.ndim) if d not in dims]
+        perm = other + dims
+        moved = jnp.transpose(self._jax, perm)
+        lead = int(np.prod([self.shape[d] for d in other])) if other else 1
+        tad_shape = tuple(self.shape[d] for d in dims)
+        return NDArray(jnp.reshape(moved, (lead,) + tad_shape)[index])
+
+    # ------------------------------------------------------------------ misc
+    def __len__(self):
+        return self.shape[0] if self.shape else 0
+
+    def __iter__(self):
+        return (NDArray(self._jax[i]) for i in range(len(self)))
+
+    def __repr__(self):
+        return f"NDArray(shape={self.shape}, dtype={_dt.name_of(self.dtype)})\n{self._jax}"
+
+    def shapeInfoToString(self) -> str:
+        return f"rank={self.ndim}, shape={list(self.shape)}, dtype={self.dataType()}"
+
+
+def _flatten_ndarray(x: NDArray):
+    return (x._jax,), None
+
+
+def _unflatten_ndarray(_, children):
+    obj = object.__new__(NDArray)
+    obj._jax = children[0]
+    return obj
+
+
+jax.tree_util.register_pytree_node(NDArray, _flatten_ndarray, _unflatten_ndarray)

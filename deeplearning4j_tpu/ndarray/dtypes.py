@@ -1,0 +1,77 @@
+"""Data type system (ref: org.nd4j.linalg.api.buffer.DataType).
+
+Maps the reference's DataType enum onto jnp dtypes. On TPU the natural compute
+types are bfloat16/float32; float64 is supported (XLA emulates on TPU, native on
+CPU) and is used by the gradient-check tier exactly as the reference forces
+global fp64 for gradient checks (SURVEY.md §4.3).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# dl4j DataType name -> numpy/jnp dtype
+_DTYPES = {
+    "DOUBLE": jnp.float64,
+    "FLOAT": jnp.float32,
+    "HALF": jnp.float16,
+    "BFLOAT16": jnp.bfloat16,
+    "LONG": jnp.int64,
+    "INT": jnp.int32,
+    "SHORT": jnp.int16,
+    "BYTE": jnp.int8,
+    "UBYTE": jnp.uint8,
+    "UINT16": jnp.uint16,
+    "UINT32": jnp.uint32,
+    "UINT64": jnp.uint64,
+    "BOOL": jnp.bool_,
+}
+
+_CANONICAL = {np.dtype(v).name: k for k, v in _DTYPES.items()}
+
+FLOATING = {"DOUBLE", "FLOAT", "HALF", "BFLOAT16"}
+INTEGRAL = {"LONG", "INT", "SHORT", "BYTE", "UBYTE", "UINT16", "UINT32", "UINT64"}
+
+
+def resolve(dtype) -> jnp.dtype:
+    """Accept a dl4j-style name ('FLOAT'), a numpy/jnp dtype, or a python type."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.upper()
+        if key in _DTYPES:
+            return jnp.dtype(_DTYPES[key])
+        return jnp.dtype(dtype)  # allow 'float32' etc.
+    return jnp.dtype(dtype)
+
+
+def name_of(dtype) -> str:
+    """The dl4j DataType name for a jnp/numpy dtype ('FLOAT', 'INT', ...)."""
+    return _CANONICAL.get(np.dtype(dtype).name, np.dtype(dtype).name.upper())
+
+
+def is_floating(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.floating) or np.dtype(dtype) == np.dtype(
+        jnp.bfloat16
+    )
+
+
+def is_integral(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.integer)
+
+
+class _Defaults:
+    """Global default dtypes (ref: Nd4j.setDefaultDataTypes)."""
+
+    def __init__(self):
+        self.floating = jnp.dtype(jnp.float32)
+        self.integral = jnp.dtype(jnp.int32)
+
+    def set(self, floating=None, integral=None):
+        if floating is not None:
+            self.floating = resolve(floating)
+        if integral is not None:
+            self.integral = resolve(integral)
+
+
+defaults = _Defaults()

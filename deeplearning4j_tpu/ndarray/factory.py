@@ -1,0 +1,210 @@
+"""The static factory — nd4j's ``Nd4j`` class equivalent (ref:
+org.nd4j.linalg.factory.Nd4j).
+
+Array creation, global dtype control, linalg entry points. Backend discovery is
+moot: there is exactly one backend (XLA via jax), selected by JAX_PLATFORMS.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray import dtypes as _dt
+from deeplearning4j_tpu.ndarray import random as _random
+from deeplearning4j_tpu.ndarray.array import NDArray, _unwrap
+
+
+def _dtype(dtype):
+    return _dt.resolve(dtype) if dtype is not None else _dt.defaults.floating
+
+
+def _shape(args):
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        return tuple(args[0])
+    return tuple(args)
+
+
+class nd:
+    """Namespace of static factory/exec methods (Nd4j analog)."""
+
+    DataType = _dt
+
+    # ------------------------------------------------------------- creation
+    @staticmethod
+    def create(data=None, shape=None, dtype=None) -> NDArray:
+        if data is None:
+            return nd.zeros(*shape, dtype=dtype)
+        arr = jnp.asarray(_unwrap(data), dtype=_dt.resolve(dtype) if dtype else None)
+        if shape is not None:
+            arr = jnp.reshape(arr, tuple(shape))
+        return NDArray(arr)
+
+    @staticmethod
+    def zeros(*shape, dtype=None) -> NDArray:
+        return NDArray(jnp.zeros(_shape(shape), dtype=_dtype(dtype)))
+
+    @staticmethod
+    def ones(*shape, dtype=None) -> NDArray:
+        return NDArray(jnp.ones(_shape(shape), dtype=_dtype(dtype)))
+
+    @staticmethod
+    def zerosLike(a) -> NDArray:
+        return NDArray(jnp.zeros_like(_unwrap(a)))
+
+    @staticmethod
+    def onesLike(a) -> NDArray:
+        return NDArray(jnp.ones_like(_unwrap(a)))
+
+    @staticmethod
+    def valueArrayOf(shape, value, dtype=None) -> NDArray:
+        return NDArray(jnp.full(tuple(shape), value, dtype=_dtype(dtype)))
+
+    @staticmethod
+    def scalar(value, dtype=None) -> NDArray:
+        return NDArray(jnp.asarray(value, dtype=_dt.resolve(dtype) if dtype else None))
+
+    @staticmethod
+    def eye(n, dtype=None) -> NDArray:
+        return NDArray(jnp.eye(n, dtype=_dtype(dtype)))
+
+    @staticmethod
+    def arange(*args, dtype=None) -> NDArray:
+        return NDArray(jnp.arange(*args, dtype=_dt.resolve(dtype) if dtype else None))
+
+    @staticmethod
+    def linspace(start, stop, num, dtype=None) -> NDArray:
+        return NDArray(jnp.linspace(start, stop, num, dtype=_dtype(dtype)))
+
+    # ---------------------------------------------------------------- random
+    @staticmethod
+    def getRandom() -> _random.Random:
+        return _random.getRandom()
+
+    @staticmethod
+    def rand(*shape, dtype=None) -> NDArray:
+        return NDArray(_random.getRandom().uniform(_shape(shape), dtype=_dtype(dtype)))
+
+    @staticmethod
+    def randn(*shape, dtype=None) -> NDArray:
+        return NDArray(_random.getRandom().normal(_shape(shape), dtype=_dtype(dtype)))
+
+    @staticmethod
+    def randomBernoulli(p, *shape) -> NDArray:
+        return NDArray(
+            _random.getRandom().bernoulli(_shape(shape), p).astype(_dt.defaults.floating)
+        )
+
+    # ------------------------------------------------------------ stack/split
+    @staticmethod
+    def concat(axis, *arrays) -> NDArray:
+        return NDArray(jnp.concatenate([_unwrap(a) for a in arrays], axis=axis))
+
+    @staticmethod
+    def stack(axis, *arrays) -> NDArray:
+        return NDArray(jnp.stack([_unwrap(a) for a in arrays], axis=axis))
+
+    @staticmethod
+    def vstack(*arrays) -> NDArray:
+        return NDArray(jnp.vstack([_unwrap(a) for a in arrays]))
+
+    @staticmethod
+    def hstack(*arrays) -> NDArray:
+        return NDArray(jnp.hstack([_unwrap(a) for a in arrays]))
+
+    @staticmethod
+    def split(a, n, axis=0):
+        return [NDArray(x) for x in jnp.split(_unwrap(a), n, axis=axis)]
+
+    @staticmethod
+    def tile(a, *reps) -> NDArray:
+        return NDArray(jnp.tile(_unwrap(a), _shape(reps)))
+
+    @staticmethod
+    def where(cond, x=None, y=None):
+        if x is None:
+            return [NDArray(i) for i in jnp.where(_unwrap(cond))]
+        return NDArray(jnp.where(_unwrap(cond), _unwrap(x), _unwrap(y)))
+
+    # ----------------------------------------------------------------- linalg
+    @staticmethod
+    def gemm(a, b, transposeA=False, transposeB=False, alpha=1.0, beta=0.0, c=None) -> NDArray:
+        A = _unwrap(a).T if transposeA else _unwrap(a)
+        B = _unwrap(b).T if transposeB else _unwrap(b)
+        out = alpha * jnp.matmul(A, B)
+        if c is not None and beta != 0.0:
+            out = out + beta * _unwrap(c)
+        return NDArray(out)
+
+    @staticmethod
+    def matmul(a, b) -> NDArray:
+        return NDArray(jnp.matmul(_unwrap(a), _unwrap(b)))
+
+    @staticmethod
+    def dot(a, b) -> NDArray:
+        return NDArray(jnp.dot(_unwrap(a), _unwrap(b)))
+
+    @staticmethod
+    def tensorMmul(a, b, axes) -> NDArray:
+        return NDArray(jnp.tensordot(_unwrap(a), _unwrap(b), axes=axes))
+
+    @staticmethod
+    def kron(a, b) -> NDArray:
+        return NDArray(jnp.kron(_unwrap(a), _unwrap(b)))
+
+    @staticmethod
+    def diag(a) -> NDArray:
+        return NDArray(jnp.diag(_unwrap(a)))
+
+    # -------------------------------------------------------------- gather etc
+    @staticmethod
+    def gather(a, indices, axis=0) -> NDArray:
+        return NDArray(jnp.take(_unwrap(a), _unwrap(indices), axis=axis))
+
+    @staticmethod
+    def scatterUpdate(a, indices, updates) -> NDArray:
+        return NDArray(_unwrap(a).at[_unwrap(indices)].set(_unwrap(updates)))
+
+    @staticmethod
+    def scatterAdd(a, indices, updates) -> NDArray:
+        return NDArray(_unwrap(a).at[_unwrap(indices)].add(_unwrap(updates)))
+
+    @staticmethod
+    def oneHot(indices, depth, dtype=None) -> NDArray:
+        return NDArray(jax.nn.one_hot(_unwrap(indices), depth, dtype=_dtype(dtype)))
+
+    @staticmethod
+    def sort(a, axis=-1, descending=False) -> NDArray:
+        out = jnp.sort(_unwrap(a), axis=axis)
+        return NDArray(jnp.flip(out, axis=axis) if descending else out)
+
+    @staticmethod
+    def argsort(a, axis=-1) -> NDArray:
+        return NDArray(jnp.argsort(_unwrap(a), axis=axis))
+
+    @staticmethod
+    def topK(a, k, axis=-1):
+        vals, idx = jax.lax.top_k(jnp.moveaxis(_unwrap(a), axis, -1), k)
+        return NDArray(jnp.moveaxis(vals, -1, axis)), NDArray(jnp.moveaxis(idx, -1, axis))
+
+    # --------------------------------------------------------------- defaults
+    @staticmethod
+    def setDefaultDataTypes(floating=None, integral=None):
+        _dt.defaults.set(floating, integral)
+
+    @staticmethod
+    def defaultFloatingPointType():
+        return _dt.defaults.floating
+
+    # -------------------------------------------------------------- env info
+    @staticmethod
+    def getBackend() -> str:
+        return jax.default_backend()
+
+    @staticmethod
+    def getAffinityManager():
+        """Device listing (ref: Nd4j.getAffinityManager) — on TPU, placement
+        is owned by jax.sharding; this only reports devices."""
+        return jax.devices()

@@ -1,0 +1,65 @@
+"""RNG (ref: org.nd4j.linalg.api.rng + libnd4j RandomLauncher/RandomGenerator).
+
+The reference uses a Philox-style counter RNG with a settable global seed
+(``Nd4j.getRandom().setSeed(...)``). The TPU-native equivalent is JAX's
+threefry counter PRNG; this module keeps the reference's *stateful seed API* as
+a thin shell over explicit key-splitting, so ``setSeed(12345)`` reproduces
+deterministic streams just like the reference's test fixtures (SURVEY.md §4.3).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class Random:
+    """Stateful key holder; each draw splits a fresh subkey."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._key = jax.random.key(seed)
+        self._seed = seed
+
+    def setSeed(self, seed: int):
+        with self._lock:
+            self._key = jax.random.key(seed)
+            self._seed = seed
+
+    def getSeed(self) -> int:
+        return self._seed
+
+    def nextKey(self) -> jax.Array:
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def split(self, n: int):
+        with self._lock:
+            keys = jax.random.split(self._key, n + 1)
+            self._key = keys[0]
+            return keys[1:]
+
+    # sampling helpers (shapes as tuples)
+    def uniform(self, shape, minval=0.0, maxval=1.0, dtype=jnp.float32):
+        return jax.random.uniform(self.nextKey(), shape, dtype=dtype, minval=minval, maxval=maxval)
+
+    def normal(self, shape, mean=0.0, std=1.0, dtype=jnp.float32):
+        return jax.random.normal(self.nextKey(), shape, dtype=dtype) * std + mean
+
+    def bernoulli(self, shape, p=0.5):
+        return jax.random.bernoulli(self.nextKey(), p, shape)
+
+    def randint(self, shape, minval, maxval, dtype=jnp.int32):
+        return jax.random.randint(self.nextKey(), shape, minval, maxval, dtype=dtype)
+
+    def permutation(self, n_or_array):
+        return jax.random.permutation(self.nextKey(), n_or_array)
+
+
+_global = Random(0)
+
+
+def getRandom() -> Random:
+    return _global

@@ -1,0 +1,27 @@
+"""Op surface: registry + eager namespaces (ref: org.nd4j.linalg.factory.ops.ND*
+generated classes; the graph surface in autodiff/ reads the same registry)."""
+from deeplearning4j_tpu.ops.registry import (  # noqa: F401
+    REGISTRY,
+    EagerNamespace,
+    OpSpec,
+    coverage_report,
+    get,
+    mark_validated,
+    op,
+)
+
+# importing definitions populates the registry
+from deeplearning4j_tpu.ops import math_defs as _math_defs  # noqa: F401
+from deeplearning4j_tpu.ops import nn_defs as _nn_defs  # noqa: F401
+
+math = EagerNamespace("math")
+reduce = EagerNamespace("reduce")
+shape = EagerNamespace("shape")
+bitwise = EagerNamespace("bitwise")
+linalg = EagerNamespace("linalg")
+nn = EagerNamespace("nn")
+cnn = EagerNamespace("cnn")
+rnn = EagerNamespace("rnn")
+loss = EagerNamespace("loss")
+image = EagerNamespace("image")
+random = EagerNamespace("random")

@@ -1,0 +1,287 @@
+"""Math / reduce / shape / bitwise / linalg / random op definitions.
+
+Covers the reference's legacy transform/pairwise/reduce/broadcast op families
+(libnd4j include/loops + org.nd4j.linalg.api.ops.impl.{transforms,reduce,shape,
+broadcast,random}) as registry entries over jnp — XLA emits the kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+# ----------------------------------------------------------------- transforms
+
+
+def _simple(name, fn, ns="math"):
+    op(name, ns)(fn)
+
+
+for _name, _fn in {
+    "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor, "round": jnp.round,
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log1p": jnp.log1p,
+    "log2": jnp.log2, "log10": jnp.log10, "sqrt": jnp.sqrt, "square": jnp.square,
+    "cube": lambda x: x * x * x, "reciprocal": jnp.reciprocal, "neg": jnp.negative,
+    "sign": jnp.sign, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "erf": jax.scipy.special.erf, "erfc": jax.scipy.special.erfc,
+    "rsqrt": lax.rsqrt, "isnan": jnp.isnan, "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite,
+}.items():
+    _simple(_name, _fn)
+
+op("pow", "math")(jnp.power)
+op("atan2", "math")(jnp.arctan2)
+op("add", "math")(jnp.add)
+op("sub", "math")(jnp.subtract)
+op("mul", "math")(jnp.multiply)
+op("div", "math")(jnp.divide)
+op("floorDiv", "math")(jnp.floor_divide)
+op("floorMod", "math")(jnp.mod)
+op("fmod", "math")(jnp.fmod)
+op("max", "math")(jnp.maximum)
+op("min", "math")(jnp.minimum)
+op("clipByValue", "math")(lambda x, lo, hi: jnp.clip(x, lo, hi))
+
+
+@op("clipByNorm", "math")
+def clip_by_norm(x, clip_norm, axis=None):
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=axis is not None))
+    return jnp.where(n > clip_norm, x * (clip_norm / jnp.maximum(n, 1e-12)), x)
+
+
+@op("step", "math")
+def step(x, cutoff=0.0):
+    return (x > cutoff).astype(x.dtype)
+
+
+op("logicalAnd", "math")(jnp.logical_and)
+op("logicalOr", "math")(jnp.logical_or)
+op("logicalNot", "math")(jnp.logical_not)
+op("logicalXor", "math")(jnp.logical_xor)
+
+# bitwise namespace (ref: SDBitwise)
+op("and_", "bitwise")(jnp.bitwise_and)
+op("or_", "bitwise")(jnp.bitwise_or)
+op("xor", "bitwise")(jnp.bitwise_xor)
+op("leftShift", "bitwise")(jnp.left_shift)
+op("rightShift", "bitwise")(jnp.right_shift)
+op("bitsHammingDistance", "bitwise")(
+    lambda a, b: jnp.sum(jax.lax.population_count(jnp.bitwise_xor(a, b)))
+)
+
+# ------------------------------------------------------------------- reduce
+
+
+def _axis(dims):
+    if dims is None or dims == () or dims == []:
+        return None
+    if isinstance(dims, (tuple, list)):
+        return tuple(dims)
+    return dims
+
+
+def _reduce_ns(name, fn):
+    @op(name, "reduce")
+    def _r(x, dims=None, keepdims=False, _fn=fn):
+        return _fn(x, axis=_axis(dims), keepdims=keepdims)
+
+
+for _name, _fn in {
+    "sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min,
+    "prod": jnp.prod, "any": jnp.any, "all": jnp.all,
+    "countNonZero": lambda x, axis=None, keepdims=False: jnp.sum(
+        (x != 0).astype(jnp.int32), axis=axis, keepdims=keepdims),
+    "countZero": lambda x, axis=None, keepdims=False: jnp.sum(
+        (x == 0).astype(jnp.int32), axis=axis, keepdims=keepdims),
+    "norm1": lambda x, axis=None, keepdims=False: jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims),
+    "norm2": lambda x, axis=None, keepdims=False: jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims)),
+    "normMax": lambda x, axis=None, keepdims=False: jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims),
+    "squaredNorm": lambda x, axis=None, keepdims=False: jnp.sum(x * x, axis=axis, keepdims=keepdims),
+    "logSumExp": lambda x, axis=None, keepdims=False: jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims),
+}.items():
+    _reduce_ns(_name, _fn)
+
+
+@op("std", "reduce")
+def std(x, dims=None, keepdims=False, biasCorrected=True):
+    return jnp.std(x, axis=_axis(dims), keepdims=keepdims, ddof=1 if biasCorrected else 0)
+
+
+@op("variance", "reduce")
+def variance(x, dims=None, keepdims=False, biasCorrected=True):
+    return jnp.var(x, axis=_axis(dims), keepdims=keepdims, ddof=1 if biasCorrected else 0)
+
+
+@op("argmax", "reduce")
+def argmax(x, dims=None, keepdims=False):
+    return jnp.argmax(x, axis=dims if dims is not None else None, keepdims=keepdims)
+
+
+@op("argmin", "reduce")
+def argmin(x, dims=None, keepdims=False):
+    return jnp.argmin(x, axis=dims if dims is not None else None, keepdims=keepdims)
+
+
+@op("iamax", "reduce")
+def iamax(x, dims=None):
+    return jnp.argmax(jnp.abs(x), axis=dims)
+
+
+@op("cosineSimilarity", "reduce")
+def cosine_similarity(a, b, dims=None):
+    num = jnp.sum(a * b, axis=_axis(dims))
+    den = jnp.sqrt(jnp.sum(a * a, axis=_axis(dims))) * jnp.sqrt(jnp.sum(b * b, axis=_axis(dims)))
+    return num / jnp.maximum(den, 1e-12)
+
+
+@op("euclideanDistance", "reduce")
+def euclidean_distance(a, b, dims=None):
+    d = a - b
+    return jnp.sqrt(jnp.sum(d * d, axis=_axis(dims)))
+
+
+@op("manhattanDistance", "reduce")
+def manhattan_distance(a, b, dims=None):
+    return jnp.sum(jnp.abs(a - b), axis=_axis(dims))
+
+
+@op("hammingDistance", "reduce")
+def hamming_distance(a, b, dims=None):
+    return jnp.sum((a != b).astype(jnp.float32), axis=_axis(dims))
+
+
+@op("shannonEntropy", "reduce")
+def shannon_entropy(x, dims=None):
+    return -jnp.sum(x * jnp.log2(jnp.maximum(x, 1e-30)), axis=_axis(dims))
+
+
+@op("matchCondition", "reduce")
+def match_condition(x, predicate, dims=None):
+    """Count of elements matching a python predicate built from jnp comparisons."""
+    return jnp.sum(predicate(x).astype(jnp.int64), axis=_axis(dims))
+
+
+# -------------------------------------------------------------------- shape
+
+op("reshape", "shape")(lambda x, shape: jnp.reshape(x, tuple(shape)))
+op("transpose", "shape")(lambda x, axes=None: jnp.transpose(x, axes))
+op("permute", "shape")(lambda x, axes: jnp.transpose(x, axes))
+op("expandDims", "shape")(jnp.expand_dims)
+op("squeeze", "shape")(lambda x, axis=None: jnp.squeeze(x, axis=axis))
+op("flatten", "shape")(jnp.ravel)
+op("concat", "shape")(lambda arrays, axis=0: jnp.concatenate(arrays, axis=axis))
+op("stack", "shape")(lambda arrays, axis=0: jnp.stack(arrays, axis=axis))
+op("unstack", "shape")(lambda x, axis=0: [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)])
+op("tile", "shape")(lambda x, reps: jnp.tile(x, tuple(reps)))
+op("repeat", "shape")(lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=axis))
+op("reverse", "shape")(lambda x, dims: jnp.flip(x, axis=tuple(dims) if isinstance(dims, (list, tuple)) else dims))
+op("shapeOf", "shape")(lambda x: jnp.asarray(x.shape, dtype=jnp.int64))
+op("sizeAt", "shape")(lambda x, dim: x.shape[dim])
+op("rank", "shape")(lambda x: x.ndim)
+op("broadcastTo", "shape")(lambda x, shape: jnp.broadcast_to(x, tuple(shape)))
+op("gather", "shape")(lambda x, indices, axis=0: jnp.take(x, indices, axis=axis))
+op("gatherNd", "shape")(lambda x, indices: x[tuple(jnp.moveaxis(indices, -1, 0))])
+op("scatterUpdate", "shape")(lambda x, indices, updates: x.at[indices].set(updates))
+op("scatterAdd", "shape")(lambda x, indices, updates: x.at[indices].add(updates))
+op("scatterSub", "shape")(lambda x, indices, updates: x.at[indices].add(-updates))
+op("scatterMax", "shape")(lambda x, indices, updates: x.at[indices].max(updates))
+op("scatterMin", "shape")(lambda x, indices, updates: x.at[indices].min(updates))
+op("slice", "shape")(lambda x, begin, size: lax.dynamic_slice(x, tuple(begin), tuple(size)))
+op("stridedSlice", "shape")(lambda x, slices: x[tuple(slices)])
+op("where", "shape")(lambda cond, x, y: jnp.where(cond, x, y))
+op("cumsum", "shape")(lambda x, axis=None: jnp.cumsum(x, axis=axis))
+op("cumprod", "shape")(lambda x, axis=None: jnp.cumprod(x, axis=axis))
+op("pad", "shape")(lambda x, paddings, mode="constant", value=0.0: jnp.pad(
+    x, paddings, mode=mode, constant_values=value) if mode == "constant" else jnp.pad(x, paddings, mode=mode))
+op("diag", "shape")(jnp.diag)
+op("diagPart", "shape")(jnp.diagonal)
+op("oneHot", "shape")(lambda indices, depth, axis=-1, on=1.0, off=0.0: jax.nn.one_hot(
+    indices, depth, axis=axis) * (on - off) + off)
+op("castTo", "shape")(lambda x, dtype: x.astype(dtype))
+
+
+@op("dynamicPartition", "shape")
+def dynamic_partition(x, partitions, num_partitions):
+    """Static-shape-friendly variant: returns masked copies (XLA needs static
+    shapes; the reference returns ragged lists — callers use segment ops here)."""
+    return [jnp.where((partitions == i)[(...,) + (None,) * (x.ndim - partitions.ndim)], x, 0)
+            for i in range(num_partitions)]
+
+
+@op("segmentSum", "shape")
+def segment_sum(data, segment_ids, num_segments):
+    return jax.ops.segment_sum(data, segment_ids, num_segments)
+
+
+@op("segmentMean", "shape")
+def segment_mean(data, segment_ids, num_segments):
+    s = jax.ops.segment_sum(data, segment_ids, num_segments)
+    c = jax.ops.segment_sum(jnp.ones_like(data), segment_ids, num_segments)
+    return s / jnp.maximum(c, 1)
+
+
+@op("sequenceMask", "shape")
+def sequence_mask(lengths, maxlen, dtype=jnp.float32):
+    return (jnp.arange(maxlen)[None, :] < lengths[:, None]).astype(dtype)
+
+
+# ------------------------------------------------------------------- linalg
+
+op("matmul", "linalg")(jnp.matmul)
+op("mmul", "linalg")(jnp.matmul)
+
+
+@op("gemm", "linalg")
+def gemm(a, b, alpha=1.0, beta=0.0, transposeA=False, transposeB=False, c=None):
+    A = a.T if transposeA else a
+    B = b.T if transposeB else b
+    out = alpha * jnp.matmul(A, B)
+    if c is not None:
+        out = out + beta * c
+    return out
+
+
+op("tensorMmul", "linalg")(lambda a, b, axes: jnp.tensordot(a, b, axes=axes))
+op("cholesky", "linalg")(jnp.linalg.cholesky)
+op("svd", "linalg")(jnp.linalg.svd)
+op("qr", "linalg")(jnp.linalg.qr)
+op("inverse", "linalg")(jnp.linalg.inv)
+op("det", "linalg")(jnp.linalg.det)
+op("solve", "linalg")(jnp.linalg.solve)
+op("lstsq", "linalg")(lambda a, b: jnp.linalg.lstsq(a, b)[0])
+op("eig", "linalg")(jnp.linalg.eigh)
+op("trace", "linalg")(jnp.trace)
+op("matrixDiag", "linalg")(jnp.diag)
+op("matrixBandPart", "linalg")(
+    lambda x, lower, upper: jnp.where(
+        (jnp.arange(x.shape[-2])[:, None] - jnp.arange(x.shape[-1])[None, :] <= (lower if lower >= 0 else x.shape[-2]))
+        & (jnp.arange(x.shape[-1])[None, :] - jnp.arange(x.shape[-2])[:, None] <= (upper if upper >= 0 else x.shape[-1])),
+        x, 0))
+
+# ------------------------------------------------------------------- random
+# Key-explicit (functional) random ops; the eager surface threads the global
+# Random's key automatically via ops/__init__ wrappers where key=None.
+
+op("uniform", "random")(
+    lambda key, shape, minval=0.0, maxval=1.0, dtype=jnp.float32: jax.random.uniform(
+        key, tuple(shape), dtype=dtype, minval=minval, maxval=maxval))
+op("normal", "random")(
+    lambda key, shape, mean=0.0, std=1.0, dtype=jnp.float32: jax.random.normal(
+        key, tuple(shape), dtype=dtype) * std + mean)
+op("bernoulli", "random")(
+    lambda key, shape, p=0.5, dtype=jnp.float32: jax.random.bernoulli(key, p, tuple(shape)).astype(dtype))
+op("exponential", "random")(
+    lambda key, shape, lam=1.0, dtype=jnp.float32: jax.random.exponential(key, tuple(shape), dtype=dtype) / lam)
+op("gamma", "random")(
+    lambda key, shape, alpha, dtype=jnp.float32: jax.random.gamma(key, alpha, tuple(shape), dtype=dtype))
+op("shuffle", "random")(lambda key, x, axis=0: jax.random.permutation(key, x, axis=axis))
+op("dropout", "random")(
+    lambda key, x, rate: jnp.where(jax.random.bernoulli(key, 1.0 - rate, x.shape), x / (1.0 - rate), 0.0))
+op("truncatedNormal", "random")(
+    lambda key, shape, mean=0.0, std=1.0, dtype=jnp.float32: jax.random.truncated_normal(
+        key, -2.0, 2.0, tuple(shape), dtype=dtype) * std + mean)

@@ -1,0 +1,104 @@
+"""Op-spec registry — the single source of truth for the op surface.
+
+The reference maintains ~2,000 Java op wrapper classes plus a codegen tool
+(contrib/codegen-tools) that emits typed namespaces (SDMath, SDNN, ...). The
+TPU rebuild collapses that to ONE table: each op is registered once with its
+jnp-level implementation, and both surfaces are generated from it:
+
+- the **eager** namespaces (``ops.math.tanh(x)`` on NDArray) — analog of
+  org.nd4j.linalg.factory.ops.NDMath etc.;
+- the **graph** namespaces (``sd.math.tanh(var)`` building graph nodes) — analog
+  of org.nd4j.autodiff.samediff.ops.SDMath etc. (see autodiff/).
+
+Gradients come from jax.grad over the impl (every impl is differentiable jnp
+code), so there is no per-op ``doDiff`` to write — the reference's largest
+maintenance surface (SURVEY.md §2.2 "op classes") disappears by construction.
+
+The registry doubles as the **coverage ledger** (ref:
+org.nd4j.autodiff.validation.OpValidation): tests mark ops validated and
+``coverage_report()`` lists unvalidated ops.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from deeplearning4j_tpu.ndarray.array import NDArray, _unwrap
+
+
+@dataclass
+class OpSpec:
+    name: str
+    namespace: str
+    fn: Callable  # jnp-level implementation (jax arrays in/out)
+    doc: str = ""
+    validated: bool = False  # flipped by the op-validation test harness
+
+
+REGISTRY: Dict[str, OpSpec] = {}
+
+
+def op(name: str, namespace: str, doc: str = ""):
+    """Register a jnp-level function as a framework op."""
+
+    def deco(fn):
+        key = f"{namespace}.{name}"
+        REGISTRY[key] = OpSpec(name=name, namespace=namespace, fn=fn, doc=doc or fn.__doc__ or "")
+        return fn
+
+    return deco
+
+
+def get(name: str, namespace: Optional[str] = None) -> OpSpec:
+    if namespace is not None:
+        return REGISTRY[f"{namespace}.{name}"]
+    matches = [s for k, s in REGISTRY.items() if s.name == name]
+    if not matches:
+        raise KeyError(f"unknown op: {name}")
+    return matches[0]
+
+
+def mark_validated(name: str, namespace: Optional[str] = None):
+    get(name, namespace).validated = True
+
+
+def coverage_report():
+    """(validated, unvalidated) op key lists — the op-parity ledger."""
+    done = sorted(k for k, s in REGISTRY.items() if s.validated)
+    todo = sorted(k for k, s in REGISTRY.items() if not s.validated)
+    return done, todo
+
+
+class EagerNamespace:
+    """Eager op surface over NDArray, generated from the registry
+    (ref: org.nd4j.linalg.factory.ops.ND* generated classes)."""
+
+    def __init__(self, namespace: str):
+        self._namespace = namespace
+
+    def __getattr__(self, name: str):
+        spec = REGISTRY.get(f"{self._namespace}.{name}")
+        if spec is None:
+            raise AttributeError(f"no op {self._namespace}.{name}")
+
+        def wrap_out(out):
+            if isinstance(out, (tuple, list)):
+                return type(out)(wrap_out(o) for o in out)
+            if isinstance(out, (int, float, bool)):
+                return out
+            return NDArray(out)
+
+        @functools.wraps(spec.fn)
+        def call(*args, **kwargs):
+            args = [_unwrap(a) for a in args]
+            kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+            return wrap_out(spec.fn(*args, **kwargs))
+
+        # cache on the instance so repeated lookups are cheap
+        setattr(self, name, call)
+        return call
+
+    def __dir__(self):
+        prefix = self._namespace + "."
+        return [k[len(prefix):] for k in REGISTRY if k.startswith(prefix)]

@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-device virtual CPU mesh so distributed
+(DP/TP/SP) logic is exercised on CI machines without TPU hardware — the same
+philosophy as the reference's Spark local[N] / DummyTransport fabric
+(SURVEY.md §4.2). Must run before jax is imported anywhere."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pins jax_platforms=axon before conftest runs; the
+# config update (not just the env var) is required to actually land on CPU.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # gradient-check tier runs fp64 (SURVEY §4.3)
+
+assert jax.default_backend() == "cpu"
+assert len(jax.devices()) == 8, "virtual 8-device CPU mesh required for parallel tests"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    """Deterministic global RNG per test (ref: Nd4j.getRandom().setSeed)."""
+    from deeplearning4j_tpu.ndarray import getRandom
+
+    getRandom().setSeed(12345)
+    yield
+
+
+@pytest.fixture
+def rtol():
+    return 1e-5

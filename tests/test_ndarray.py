@@ -1,0 +1,196 @@
+"""NDArray facade tests (ref: nd4j INDArray semantics tests in
+platform-tests / Nd4jTestsC)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import NDArray, nd
+
+
+class TestCreation:
+    def test_zeros_ones(self):
+        z = nd.zeros(2, 3)
+        assert z.shape == (2, 3)
+        assert z.sumNumber() == 0.0
+        o = nd.ones((3, 4))
+        assert o.sumNumber() == 12.0
+
+    def test_create_from_data(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.shape == (2, 2)
+        assert a.getDouble(1, 0) == 3.0
+
+    def test_create_reshaped(self):
+        a = nd.create([1, 2, 3, 4, 5, 6], shape=(2, 3))
+        assert a.shape == (2, 3)
+
+    def test_value_array_scalar_eye(self):
+        v = nd.valueArrayOf((2, 2), 7.0)
+        assert v.meanNumber() == 7.0
+        s = nd.scalar(3.5)
+        assert s.isScalar() and s.getDouble() == 3.5
+        e = nd.eye(3)
+        assert e.sumNumber() == 3.0
+
+    def test_arange_linspace(self):
+        assert nd.arange(5).toNumpy().tolist() == [0, 1, 2, 3, 4]
+        ls = nd.linspace(0.0, 1.0, 5)
+        np.testing.assert_allclose(ls.toNumpy(), [0, 0.25, 0.5, 0.75, 1.0])
+
+    def test_rand_deterministic(self):
+        nd.getRandom().setSeed(42)
+        a = nd.rand(3, 3)
+        nd.getRandom().setSeed(42)
+        b = nd.rand(3, 3)
+        assert a.equals(b)
+
+    def test_dtypes(self):
+        a = nd.zeros(2, 2, dtype="DOUBLE")
+        assert a.dataType() == "DOUBLE"
+        b = a.castTo("FLOAT")
+        assert b.dataType() == "FLOAT"
+        c = nd.create([1, 2], dtype="INT")
+        assert c.dataType() == "INT"
+
+
+class TestArithmetic:
+    def test_add_sub_mul_div(self):
+        a = nd.create([1.0, 2.0, 3.0])
+        b = nd.create([4.0, 5.0, 6.0])
+        np.testing.assert_allclose(a.add(b).toNumpy(), [5, 7, 9])
+        np.testing.assert_allclose(a.sub(b).toNumpy(), [-3, -3, -3])
+        np.testing.assert_allclose(a.mul(b).toNumpy(), [4, 10, 18])
+        np.testing.assert_allclose(b.div(a).toNumpy(), [4, 2.5, 2])
+        np.testing.assert_allclose(a.rsub(10).toNumpy(), [9, 8, 7])
+        np.testing.assert_allclose(a.rdiv(6).toNumpy(), [6, 3, 2])
+
+    def test_dunder_and_scalars(self):
+        a = nd.create([1.0, 2.0])
+        np.testing.assert_allclose((a + 1).toNumpy(), [2, 3])
+        np.testing.assert_allclose((2 * a).toNumpy(), [2, 4])
+        np.testing.assert_allclose((a ** 2).toNumpy(), [1, 4])
+        np.testing.assert_allclose((-a).toNumpy(), [-1, -2])
+
+    def test_inplace_variants(self):
+        a = nd.create([1.0, 2.0])
+        ref = a
+        a.addi(1.0).muli(2.0)
+        np.testing.assert_allclose(ref.toNumpy(), [4, 6])
+
+    def test_assign(self):
+        a = nd.zeros(2, 2)
+        a.assign(5.0)
+        assert a.meanNumber() == 5.0
+
+    def test_broadcasting(self):
+        a = nd.ones(2, 3)
+        row = nd.create([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(a.add(row).toNumpy(), [[2, 3, 4], [2, 3, 4]])
+
+
+class TestLinalgShape:
+    def test_mmul(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        b = nd.create([[5.0, 6.0], [7.0, 8.0]])
+        np.testing.assert_allclose(a.mmul(b).toNumpy(), [[19, 22], [43, 50]])
+
+    def test_gemm(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        b = nd.create([[1.0, 0.0], [0.0, 1.0]])
+        out = nd.gemm(a, b, transposeA=True)
+        np.testing.assert_allclose(out.toNumpy(), [[1, 3], [2, 4]])
+
+    def test_transpose_reshape_ravel(self):
+        a = nd.arange(6).reshape(2, 3)
+        assert a.transpose().shape == (3, 2)
+        assert a.reshape(3, 2).shape == (3, 2)
+        assert a.ravel().shape == (6,)
+
+    def test_concat_stack(self):
+        a, b = nd.ones(2, 2), nd.zeros(2, 2)
+        assert nd.concat(0, a, b).shape == (4, 2)
+        assert nd.concat(1, a, b).shape == (2, 4)
+        assert nd.stack(0, a, b).shape == (2, 2, 2)
+        assert nd.vstack(a, b).shape == (4, 2)
+        assert nd.hstack(a, b).shape == (2, 4)
+
+    def test_tad(self):
+        a = nd.arange(24).reshape(2, 3, 4)
+        tad = a.tensorAlongDimension(1, 2)
+        np.testing.assert_allclose(tad.toNumpy(), [4, 5, 6, 7])
+
+
+class TestReductions:
+    def test_global(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sumNumber() == 10.0
+        assert a.meanNumber() == 2.5
+        assert a.maxNumber() == 4.0
+        assert a.minNumber() == 1.0
+
+    def test_axis(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(a.sum(0).toNumpy(), [4, 6])
+        np.testing.assert_allclose(a.sum(1).toNumpy(), [3, 7])
+        np.testing.assert_allclose(a.mean(0).toNumpy(), [2, 3])
+
+    def test_std_bias_correction(self):
+        a = nd.create([1.0, 2.0, 3.0, 4.0])
+        assert abs(a.std().getDouble() - np.std([1, 2, 3, 4], ddof=1)) < 1e-6
+        assert abs(a.std(biasCorrected=False).getDouble() - np.std([1, 2, 3, 4])) < 1e-6
+
+    def test_norms_argmax(self):
+        a = nd.create([[-3.0, 4.0]])
+        assert a.norm1().getDouble() == 7.0
+        assert a.norm2().getDouble() == 5.0
+        assert a.normmax().getDouble() == 4.0
+        assert nd.create([1.0, 9.0, 3.0]).argMax().getInt() == 1
+
+    def test_cumsum(self):
+        np.testing.assert_allclose(nd.create([1.0, 2.0, 3.0]).cumsum().toNumpy(), [1, 3, 6])
+
+
+class TestIndexing:
+    def test_get_rows_cols(self):
+        a = nd.arange(12).reshape(3, 4)
+        np.testing.assert_allclose(a.getRow(1).toNumpy(), [4, 5, 6, 7])
+        np.testing.assert_allclose(a.getColumn(2).toNumpy(), [2, 6, 10])
+        assert a.getRows(0, 2).shape == (2, 4)
+
+    def test_put(self):
+        a = nd.zeros(2, 2)
+        a.putScalar((0, 1), 5.0)
+        assert a.getDouble(0, 1) == 5.0
+        a.putRow(1, nd.create([7.0, 8.0]))
+        np.testing.assert_allclose(a.getRow(1).toNumpy(), [7, 8])
+
+    def test_python_slicing(self):
+        a = nd.arange(12).reshape(3, 4)
+        assert a[1:, :2].shape == (2, 2)
+        a[0, 0] = 99
+        assert a.getInt(0, 0) == 99
+
+
+class TestComparison:
+    def test_elementwise(self):
+        a = nd.create([1.0, 5.0, 3.0])
+        np.testing.assert_array_equal(a.gt(2.0).toNumpy(), [False, True, True])
+        np.testing.assert_array_equal(a.lte(3.0).toNumpy(), [True, False, True])
+
+    def test_equals(self):
+        a = nd.create([1.0, 2.0])
+        assert a.equals(nd.create([1.0, 2.0]))
+        assert not a.equals(nd.create([1.0, 2.1]))
+        assert a.equalsWithEps(nd.create([1.0, 2.05]), eps=0.1)
+
+
+class TestPytree:
+    def test_jit_through_ndarray(self):
+        import jax
+
+        @jax.jit
+        def f(x: NDArray):
+            return x.mul(2.0).add(1.0)
+
+        out = f(nd.create([1.0, 2.0]))
+        assert isinstance(out, NDArray)
+        np.testing.assert_allclose(out.toNumpy(), [3, 5])

@@ -1,0 +1,316 @@
+"""Op registry + namespace tests (ref: OpValidation / LayerOpValidation /
+ReductionOpValidation suites in nd4j). Validated ops get marked in the
+coverage ledger."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nd, ops
+from deeplearning4j_tpu.ops import mark_validated
+
+
+def check(namespace, name, out, expected, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(out.toNumpy(), dtype=np.float64),
+                               expected, atol=atol)
+    mark_validated(name, namespace)
+
+
+class TestMathOps:
+    def test_transforms(self):
+        x = nd.create([0.5, 1.0, 2.0])
+        check("math", "exp", ops.math.exp(x), np.exp([0.5, 1, 2]))
+        check("math", "log", ops.math.log(x), np.log([0.5, 1, 2]))
+        check("math", "sqrt", ops.math.sqrt(x), np.sqrt([0.5, 1, 2]))
+        check("math", "tanh", ops.math.tanh(x), np.tanh([0.5, 1, 2]))
+        check("math", "abs", ops.math.abs(nd.create([-1.0, 2.0])), [1, 2])
+        check("math", "sign", ops.math.sign(nd.create([-3.0, 0.0, 9.0])), [-1, 0, 1])
+        check("math", "square", ops.math.square(x), [0.25, 1, 4])
+        check("math", "floor", ops.math.floor(nd.create([1.7])), [1.0])
+        check("math", "erf", ops.math.erf(nd.create([0.0])), [0.0])
+
+    def test_binary(self):
+        a, b = nd.create([1.0, 4.0]), nd.create([3.0, 2.0])
+        check("math", "max", ops.math.max(a, b), [3, 4])
+        check("math", "min", ops.math.min(a, b), [1, 2])
+        check("math", "pow", ops.math.pow(a, 2.0), [1, 16])
+        check("math", "clipByValue", ops.math.clipByValue(nd.create([-5.0, 0.5, 5.0]), -1.0, 1.0), [-1, 0.5, 1])
+
+    def test_clip_by_norm(self):
+        x = nd.create([3.0, 4.0])
+        check("math", "clipByNorm", ops.math.clipByNorm(x, 1.0), [0.6, 0.8])
+
+
+class TestReduceOps:
+    def test_basic(self):
+        x = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        check("reduce", "sum", ops.reduce.sum(x), 10.0)
+        check("reduce", "mean", ops.reduce.mean(x, 0), [2, 3])
+        check("reduce", "max", ops.reduce.max(x, 1), [2, 4])
+        check("reduce", "norm2", ops.reduce.norm2(nd.create([3.0, 4.0])), 5.0)
+        check("reduce", "logSumExp", ops.reduce.logSumExp(nd.create([0.0, 0.0])), np.log(2))
+
+    def test_distances(self):
+        a, b = nd.create([1.0, 0.0]), nd.create([0.0, 1.0])
+        check("reduce", "euclideanDistance", ops.reduce.euclideanDistance(a, b), np.sqrt(2))
+        check("reduce", "manhattanDistance", ops.reduce.manhattanDistance(a, b), 2.0)
+        check("reduce", "cosineSimilarity", ops.reduce.cosineSimilarity(a, b), 0.0)
+
+    def test_argmax(self):
+        check("reduce", "argmax", ops.reduce.argmax(nd.create([[1.0, 9.0], [8.0, 2.0]]), 1), [1, 0])
+
+
+class TestShapeOps:
+    def test_manipulation(self):
+        x = nd.arange(6).reshape(2, 3)
+        assert ops.shape.transpose(x).shape == (3, 2)
+        assert ops.shape.expandDims(x, 0).shape == (1, 2, 3)
+        assert ops.shape.tile(x, (2, 1)).shape == (4, 3)
+        mark_validated("transpose", "shape")
+        mark_validated("expandDims", "shape")
+        mark_validated("tile", "shape")
+
+    def test_gather_scatter(self):
+        x = nd.create([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        check("shape", "gather", ops.shape.gather(x, nd.create([0, 2], dtype="INT")), [[1, 2], [5, 6]])
+        z = nd.zeros(3, 2)
+        out = ops.shape.scatterAdd(z, nd.create([1], dtype="INT"), nd.create([[9.0, 9.0]]))
+        check("shape", "scatterAdd", out, [[0, 0], [9, 9], [0, 0]])
+
+    def test_one_hot_where(self):
+        check("shape", "oneHot", ops.shape.oneHot(nd.create([0, 2], dtype="INT"), 3),
+              [[1, 0, 0], [0, 0, 1]])
+        check("shape", "where", ops.shape.where(nd.create([True, False]), nd.create([1.0, 1.0]),
+                                                nd.create([2.0, 2.0])), [1, 2])
+
+    def test_segment_sum(self):
+        data = nd.create([1.0, 2.0, 3.0, 4.0])
+        seg = nd.create([0, 0, 1, 1], dtype="INT")
+        check("shape", "segmentSum", ops.shape.segmentSum(data, seg, 2), [3, 7])
+
+    def test_sequence_mask(self):
+        check("shape", "sequenceMask", ops.shape.sequenceMask(nd.create([1, 3], dtype="INT"), 4),
+              [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+class TestLinalgOps:
+    def test_matmul_inverse(self):
+        a = nd.create([[2.0, 0.0], [0.0, 4.0]])
+        check("linalg", "inverse", ops.linalg.inverse(a), [[0.5, 0], [0, 0.25]])
+        check("linalg", "det", ops.linalg.det(a), 8.0)
+        check("linalg", "trace", ops.linalg.trace(a), 6.0)
+        b = nd.create([[1.0], [2.0]])
+        check("linalg", "solve", ops.linalg.solve(a, b), [[0.5], [0.5]])
+        mark_validated("matmul", "linalg")
+
+    def test_cholesky(self):
+        a = nd.create([[4.0, 0.0], [0.0, 9.0]])
+        check("linalg", "cholesky", ops.linalg.cholesky(a), [[2, 0], [0, 3]])
+
+
+class TestNNOps:
+    def test_activations(self):
+        x = nd.create([-1.0, 0.0, 2.0])
+        check("nn", "relu", ops.nn.relu(x), [0, 0, 2])
+        check("nn", "sigmoid", ops.nn.sigmoid(nd.create([0.0])), [0.5])
+        check("nn", "leakyRelu", ops.nn.leakyRelu(x, 0.1), [-0.1, 0, 2])
+        check("nn", "elu", ops.nn.elu(nd.create([0.0, 1.0])), [0, 1])
+        sm = ops.nn.softmax(nd.create([[1.0, 1.0]]))
+        check("nn", "softmax", sm, [[0.5, 0.5]])
+        check("nn", "softplus", ops.nn.softplus(nd.create([0.0])), [np.log(2)])
+        check("nn", "hardTanh", ops.nn.hardTanh(nd.create([-5.0, 0.3, 5.0])), [-1, 0.3, 1])
+
+    def test_layer_norm(self):
+        x = nd.create([[1.0, 2.0, 3.0]])
+        out = ops.nn.layerNorm(x)
+        np.testing.assert_allclose(out.toNumpy().mean(), 0.0, atol=1e-5)
+        mark_validated("layerNorm", "nn")
+
+    def test_batch_norm(self):
+        x = nd.ones(2, 3, 2, 2)
+        mean, var = nd.zeros(3), nd.ones(3)
+        out = ops.nn.batchNorm(x, mean, var, eps=0.0)
+        np.testing.assert_allclose(out.toNumpy(), np.ones((2, 3, 2, 2)), atol=1e-5)
+        mark_validated("batchNorm", "nn")
+
+    def test_attention(self):
+        q = nd.rand(2, 4, 8)
+        out = ops.nn.dotProductAttention(q, q, q)
+        assert out.shape == (2, 4, 8)
+        mark_validated("dotProductAttention", "nn")
+
+    def test_mha_shapes(self):
+        B, T, D, H = 2, 5, 16, 4
+        x = nd.rand(B, T, D)
+        w = [nd.randn(D, D).mul(0.1) for _ in range(4)]
+        out = ops.nn.multiHeadDotProductAttention(x, x, *w, num_heads=H)
+        assert out.shape == (B, T, D)
+        mark_validated("multiHeadDotProductAttention", "nn")
+
+    def test_embedding(self):
+        table = nd.create([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])
+        check("nn", "embeddingLookup", ops.nn.embeddingLookup(table, nd.create([2, 0], dtype="INT")),
+              [[3, 3], [1, 1]])
+
+
+class TestCNNOps:
+    def test_conv2d_identity(self):
+        x = nd.rand(1, 1, 5, 5)
+        w = nd.zeros(1, 1, 3, 3)
+        w.putScalar((0, 0, 1, 1), 1.0)  # identity kernel
+        out = ops.cnn.conv2d(x, w, padding="SAME")
+        np.testing.assert_allclose(out.toNumpy(), x.toNumpy(), atol=1e-6)
+        mark_validated("conv2d", "cnn")
+
+    def test_conv2d_shapes(self):
+        x = nd.rand(2, 3, 8, 8)
+        w = nd.randn(16, 3, 3, 3)
+        assert ops.cnn.conv2d(x, w, padding="SAME").shape == (2, 16, 8, 8)
+        assert ops.cnn.conv2d(x, w, padding="VALID").shape == (2, 16, 6, 6)
+        assert ops.cnn.conv2d(x, w, strides=(2, 2), padding="SAME").shape == (2, 16, 4, 4)
+
+    def test_pooling(self):
+        x = nd.create(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = ops.cnn.maxPool2d(x, (2, 2))
+        check("cnn", "maxPool2d", mp, [[[[5, 7], [13, 15]]]])
+        ap = ops.cnn.avgPool2d(x, (2, 2))
+        check("cnn", "avgPool2d", ap, [[[[2.5, 4.5], [10.5, 12.5]]]])
+
+    def test_depthwise(self):
+        x = nd.rand(1, 3, 6, 6)
+        w = nd.randn(3, 1, 3, 3)
+        assert ops.cnn.depthwiseConv2d(x, w, padding="SAME").shape == (1, 3, 6, 6)
+        mark_validated("depthwiseConv2d", "cnn")
+
+    def test_upsampling_space_depth(self):
+        x = nd.rand(1, 4, 2, 2)
+        assert ops.cnn.upsampling2d(x, (2, 2)).shape == (1, 4, 4, 4)
+        s2d = ops.cnn.spaceToDepth(nd.rand(1, 1, 4, 4), 2)
+        assert s2d.shape == (1, 4, 2, 2)
+        d2s = ops.cnn.depthToSpace(s2d, 2)
+        assert d2s.shape == (1, 1, 4, 4)
+        mark_validated("upsampling2d", "cnn")
+        mark_validated("spaceToDepth", "cnn")
+        mark_validated("depthToSpace", "cnn")
+
+    def test_global_pool(self):
+        x = nd.ones(2, 3, 4, 4)
+        check("cnn", "globalAvgPool", ops.cnn.globalAvgPool(x), np.ones((2, 3)))
+
+
+class TestRNNOps:
+    def test_lstm_layer_shapes(self):
+        B, T, I, H = 2, 5, 3, 4
+        x = nd.rand(B, T, I)
+        h0, c0 = nd.zeros(B, H), nd.zeros(B, H)
+        w_ih, w_hh, b = nd.randn(I, 4 * H).mul(0.1), nd.randn(H, 4 * H).mul(0.1), nd.zeros(4 * H)
+        ys, (hT, cT) = ops.rnn.lstmLayer(x, h0, c0, w_ih, w_hh, b)
+        assert ys.shape == (B, T, H)
+        assert hT.shape == (B, H)
+        np.testing.assert_allclose(ys.toNumpy()[:, -1], hT.toNumpy(), atol=1e-6)
+        mark_validated("lstmLayer", "rnn")
+        mark_validated("lstmCell", "rnn")
+
+    def test_lstm_mask_freezes_state(self):
+        B, T, I, H = 1, 4, 2, 3
+        x = nd.rand(B, T, I)
+        mask = nd.create([[1.0, 1.0, 0.0, 0.0]])
+        h0, c0 = nd.zeros(B, H), nd.zeros(B, H)
+        w_ih, w_hh, b = nd.randn(I, 4 * H), nd.randn(H, 4 * H), nd.zeros(4 * H)
+        ys, (hT, _) = ops.rnn.lstmLayer(x, h0, c0, w_ih, w_hh, b, mask=mask)
+        np.testing.assert_allclose(ys.toNumpy()[0, 1], hT.toNumpy()[0], atol=1e-6)
+
+    def test_gru_simple_rnn(self):
+        B, T, I, H = 2, 3, 4, 5
+        x = nd.rand(B, T, I)
+        h0 = nd.zeros(B, H)
+        ys, hT = ops.rnn.gru(x, h0, nd.randn(I, 3 * H), nd.randn(H, 3 * H), nd.zeros(3 * H), nd.zeros(3 * H))
+        assert ys.shape == (B, T, H)
+        mark_validated("gru", "rnn")
+        ys2, hT2 = ops.rnn.simpleRnn(x, h0, nd.randn(I, H), nd.randn(H, H), nd.zeros(H))
+        assert ys2.shape == (B, T, H)
+        mark_validated("simpleRnn", "rnn")
+
+
+class TestLossOps:
+    def test_mse(self):
+        l, p = nd.create([[1.0, 2.0]]), nd.create([[1.5, 2.5]])
+        check("loss", "mse", ops.loss.mse(l, p), 0.25)
+
+    def test_mcxent(self):
+        labels = nd.create([[1.0, 0.0]])
+        probs = nd.create([[0.8, 0.2]])
+        check("loss", "mcxent", ops.loss.mcxent(labels, probs), -np.log(0.8))
+        logits = nd.create([[2.0, 0.0]])
+        expected = -np.log(np.exp(2) / (np.exp(2) + 1))
+        check("loss", "mcxent", ops.loss.mcxent(labels, logits, from_logits=True), expected)
+
+    def test_sparse_mcxent(self):
+        logits = nd.create([[2.0, 0.0], [0.0, 2.0]])
+        labels = nd.create([0, 1], dtype="INT")
+        expected = -np.log(np.exp(2) / (np.exp(2) + 1))
+        check("loss", "sparseMcxent", ops.loss.sparseMcxent(labels, logits), expected)
+
+    def test_binary_xent_hinge_huber(self):
+        l, p = nd.create([[1.0]]), nd.create([[0.9]])
+        check("loss", "binaryXent", ops.loss.binaryXent(l, p), -np.log(0.9))
+        check("loss", "hinge", ops.loss.hinge(nd.create([[1.0]]), nd.create([[0.5]])), 0.5)
+        check("loss", "huber", ops.loss.huber(nd.create([[0.0]]), nd.create([[2.0]])), 1.5)
+
+    def test_losses_differentiable(self):
+        import jax
+
+        def f(p):
+            return ops.loss.mse(nd.create([[1.0, 2.0]]), NDArrayFrom(p)).jax
+
+        # raw jnp path: losses must be differentiable for training
+        from deeplearning4j_tpu.ops import get
+        fn = get("mse", "loss").fn
+        g = jax.grad(lambda p: fn(jnp.array([[1.0, 2.0]]), p))(jnp.array([[1.5, 2.5]]))
+        np.testing.assert_allclose(np.asarray(g), [[0.5, 0.5]])
+
+
+def NDArrayFrom(p):
+    from deeplearning4j_tpu import NDArray
+    return NDArray(p)
+
+
+class TestImageOps:
+    def test_resize(self):
+        x = nd.rand(1, 3, 4, 4)
+        assert ops.image.resizeBilinear(x, (8, 8)).shape == (1, 3, 8, 8)
+        assert ops.image.resizeNearest(x, (2, 2)).shape == (1, 3, 2, 2)
+        mark_validated("resizeBilinear", "image")
+        mark_validated("resizeNearest", "image")
+
+    def test_rgb_to_gray(self):
+        x = nd.ones(1, 2, 2, 3)
+        out = ops.image.rgbToGrayscale(x)
+        np.testing.assert_allclose(out.toNumpy(), np.full((1, 2, 2, 1), 0.9999), atol=1e-3)
+        mark_validated("rgbToGrayscale", "image")
+
+    def test_nms(self):
+        boxes = nd.create([[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3]], dtype="FLOAT")
+        scores = nd.create([0.9, 0.8, 0.7])
+        sel = ops.image.nonMaxSuppression(boxes, scores, 2)
+        assert sel.toNumpy().tolist() == [0, 2]
+        mark_validated("nonMaxSuppression", "image")
+
+
+class TestRandomOps:
+    def test_key_explicit(self):
+        key = jax.random.key(0)
+        u = ops.random.uniform(key, (100,))
+        assert 0.0 <= float(u.minNumber()) and float(u.maxNumber()) <= 1.0
+        mark_validated("uniform", "random")
+        d = ops.random.dropout(key, nd.ones(1000), 0.5)
+        kept = float((d.toNumpy() > 0).mean())
+        assert 0.35 < kept < 0.65
+        mark_validated("dropout", "random")
+
+
+class TestCoverageLedger:
+    def test_report_runs(self):
+        from deeplearning4j_tpu.ops import coverage_report
+        done, todo = coverage_report()
+        assert len(done) + len(todo) == len(__import__("deeplearning4j_tpu.ops", fromlist=["REGISTRY"]).REGISTRY)
