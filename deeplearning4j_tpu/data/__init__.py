@@ -1,0 +1,9 @@
+"""ETL / datasets (ref: datavec/ + org.nd4j.linalg.dataset + deeplearning4j-core
+datasets). Record readers & transform pipeline live in records.py / transform.py."""
+from deeplearning4j_tpu.data.dataset import (  # noqa: F401
+    ArrayDataSetIterator, DataSet, DataSetIterator, ListDataSetIterator, MultiDataSet,
+)
+from deeplearning4j_tpu.data.fetchers import (  # noqa: F401
+    Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator, MnistDataSetIterator,
+    TinyImageNetDataSetIterator,
+)

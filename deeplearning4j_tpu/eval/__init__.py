@@ -1,0 +1,2 @@
+"""Evaluation suite (ref: org.nd4j.evaluation)."""
+from deeplearning4j_tpu.eval.evaluation import ROC, Evaluation, RegressionEvaluation, ROCMultiClass  # noqa: F401

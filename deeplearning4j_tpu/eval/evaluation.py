@@ -1,0 +1,235 @@
+"""Classification / regression / ROC evaluation (ref: org.nd4j.evaluation —
+Evaluation, RegressionEvaluation, ROC, ROCMultiClass, EvaluationCalibration).
+
+Streaming accumulators: ``eval(labels, predictions)`` per batch, metrics on
+demand — same usage contract as the reference. Accumulation happens on host
+in numpy (tiny data); the heavy forward pass stays jitted on device.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _np(x):
+    from deeplearning4j_tpu.ndarray.array import NDArray
+    if isinstance(x, NDArray):
+        return x.toNumpy()
+    return np.asarray(x)
+
+
+class Evaluation:
+    """Multi-class classification metrics (ref: org.nd4j.evaluation.classification.Evaluation):
+    accuracy, precision/recall/F1 (macro + per-class), confusion matrix."""
+
+    def __init__(self, num_classes: Optional[int] = None, labels: Optional[list] = None):
+        self.num_classes = num_classes
+        self.label_names = labels
+        self.confusion: Optional[np.ndarray] = None
+
+    def eval(self, labels, predictions, mask=None):
+        y = _np(labels)
+        p = _np(predictions)
+        if y.ndim == 3:  # (B,T,C) time series: flatten time
+            y = y.reshape(-1, y.shape[-1])
+            p = p.reshape(-1, p.shape[-1])
+            if mask is not None:
+                m = _np(mask).reshape(-1).astype(bool)
+                y, p = y[m], p[m]
+        true = y.argmax(-1) if y.ndim > 1 else y.astype(int)
+        pred = p.argmax(-1) if p.ndim > 1 else p.astype(int)
+        n = self.num_classes or int(max(true.max(initial=0), pred.max(initial=0))) + 1
+        if self.confusion is None:
+            self.num_classes = n
+            self.confusion = np.zeros((n, n), dtype=np.int64)
+        elif n > self.confusion.shape[0]:
+            grown = np.zeros((n, n), dtype=np.int64)
+            grown[:self.confusion.shape[0], :self.confusion.shape[1]] = self.confusion
+            self.confusion = grown
+            self.num_classes = n
+        np.add.at(self.confusion, (true, pred), 1)
+
+    # ---- metrics
+    def accuracy(self) -> float:
+        c = self.confusion
+        return float(np.trace(c) / max(c.sum(), 1))
+
+    def _tp_fp_fn(self, cls):
+        c = self.confusion
+        tp = c[cls, cls]
+        fp = c[:, cls].sum() - tp
+        fn = c[cls, :].sum() - tp
+        return tp, fp, fn
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            tp, fp, _ = self._tp_fp_fn(cls)
+            return float(tp / max(tp + fp, 1))
+        return float(np.mean([self.precision(i) for i in range(self.num_classes)]))
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            tp, _, fn = self._tp_fp_fn(cls)
+            return float(tp / max(tp + fn, 1))
+        return float(np.mean([self.recall(i) for i in range(self.num_classes)]))
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            p, r = self.precision(cls), self.recall(cls)
+            return 2 * p * r / max(p + r, 1e-12)
+        return float(np.mean([self.f1(i) for i in range(self.num_classes)]))
+
+    def falsePositiveRate(self, cls: int) -> float:
+        c = self.confusion
+        tp, fp, fn = self._tp_fp_fn(cls)
+        tn = c.sum() - tp - fp - fn
+        return float(fp / max(fp + tn, 1))
+
+    def matthewsCorrelation(self, cls: int) -> float:
+        c = self.confusion
+        tp, fp, fn = self._tp_fp_fn(cls)
+        tn = c.sum() - tp - fp - fn
+        denom = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        return float((tp * tn - fp * fn) / max(denom, 1e-12))
+
+    def confusionMatrix(self) -> np.ndarray:
+        return self.confusion
+
+    def stats(self) -> str:
+        lines = [
+            f"# of classes: {self.num_classes}",
+            f"Accuracy:  {self.accuracy():.4f}",
+            f"Precision: {self.precision():.4f}",
+            f"Recall:    {self.recall():.4f}",
+            f"F1 Score:  {self.f1():.4f}",
+            "Confusion matrix:",
+            str(self.confusion),
+        ]
+        return "\n".join(lines)
+
+
+class RegressionEvaluation:
+    """(ref: org.nd4j.evaluation.regression.RegressionEvaluation): MSE, MAE,
+    RMSE, R^2, pearson correlation — per-column streaming."""
+
+    def __init__(self, n_columns: Optional[int] = None):
+        self.n = 0
+        self.sum_err2 = None
+        self.sum_abs = None
+        self.sum_y = None
+        self.sum_y2 = None
+        self.sum_p = None
+        self.sum_p2 = None
+        self.sum_yp = None
+
+    def eval(self, labels, predictions, mask=None):
+        y = _np(labels).astype(np.float64)
+        p = _np(predictions).astype(np.float64)
+        y = y.reshape(-1, y.shape[-1])
+        p = p.reshape(-1, p.shape[-1])
+        if self.sum_err2 is None:
+            cols = y.shape[-1]
+            self.sum_err2 = np.zeros(cols)
+            self.sum_abs = np.zeros(cols)
+            self.sum_y = np.zeros(cols)
+            self.sum_y2 = np.zeros(cols)
+            self.sum_p = np.zeros(cols)
+            self.sum_p2 = np.zeros(cols)
+            self.sum_yp = np.zeros(cols)
+        e = p - y
+        self.n += y.shape[0]
+        self.sum_err2 += (e ** 2).sum(0)
+        self.sum_abs += np.abs(e).sum(0)
+        self.sum_y += y.sum(0)
+        self.sum_y2 += (y ** 2).sum(0)
+        self.sum_p += p.sum(0)
+        self.sum_p2 += (p ** 2).sum(0)
+        self.sum_yp += (y * p).sum(0)
+
+    def meanSquaredError(self, col=None):
+        mse = self.sum_err2 / self.n
+        return float(mse.mean() if col is None else mse[col])
+
+    def meanAbsoluteError(self, col=None):
+        mae = self.sum_abs / self.n
+        return float(mae.mean() if col is None else mae[col])
+
+    def rootMeanSquaredError(self, col=None):
+        return float(np.sqrt(self.meanSquaredError(col)))
+
+    def rSquared(self, col=None):
+        ss_res = self.sum_err2
+        ss_tot = self.sum_y2 - self.sum_y ** 2 / self.n
+        r2 = 1.0 - ss_res / np.maximum(ss_tot, 1e-12)
+        return float(r2.mean() if col is None else r2[col])
+
+    def pearsonCorrelation(self, col=None):
+        cov = self.sum_yp - self.sum_y * self.sum_p / self.n
+        vy = self.sum_y2 - self.sum_y ** 2 / self.n
+        vp = self.sum_p2 - self.sum_p ** 2 / self.n
+        r = cov / np.maximum(np.sqrt(vy * vp), 1e-12)
+        return float(r.mean() if col is None else r[col])
+
+    def stats(self) -> str:
+        return (f"MSE: {self.meanSquaredError():.6f}  MAE: {self.meanAbsoluteError():.6f}  "
+                f"RMSE: {self.rootMeanSquaredError():.6f}  R^2: {self.rSquared():.4f}")
+
+
+class ROC:
+    """Binary ROC/AUC with exact computation (ref: org.nd4j.evaluation.classification.ROC
+    with thresholdSteps=0 'exact' mode)."""
+
+    def __init__(self):
+        self.scores = []
+        self.labels = []
+
+    def eval(self, labels, predictions, mask=None):
+        y = _np(labels)
+        p = _np(predictions)
+        if y.ndim > 1 and y.shape[-1] == 2:  # one-hot binary: positive = col 1
+            y = y[..., 1]
+            p = p[..., 1]
+        self.labels.append(y.reshape(-1))
+        self.scores.append(p.reshape(-1))
+
+    def calculateAUC(self) -> float:
+        y = np.concatenate(self.labels)
+        s = np.concatenate(self.scores)
+        order = np.argsort(-s, kind="stable")
+        y = y[order]
+        tp = np.cumsum(y)
+        fp = np.cumsum(1 - y)
+        n_pos, n_neg = max(tp[-1], 1e-12), max(fp[-1], 1e-12)
+        tpr = np.concatenate([[0.0], tp / n_pos])
+        fpr = np.concatenate([[0.0], fp / n_neg])
+        return float(np.trapezoid(tpr, fpr))
+
+    def calculateAUCPR(self) -> float:
+        y = np.concatenate(self.labels)
+        s = np.concatenate(self.scores)
+        order = np.argsort(-s, kind="stable")
+        y = y[order]
+        tp = np.cumsum(y)
+        precision = tp / np.arange(1, len(y) + 1)
+        recall = tp / max(tp[-1], 1e-12)
+        return float(np.trapezoid(precision, recall))
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (ref: org.nd4j.evaluation.classification.ROCMultiClass)."""
+
+    def __init__(self):
+        self.per_class: dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        y = _np(labels)
+        p = _np(predictions)
+        for c in range(y.shape[-1]):
+            self.per_class.setdefault(c, ROC()).eval(y[..., c], p[..., c])
+
+    def calculateAUC(self, cls: int) -> float:
+        return self.per_class[cls].calculateAUC()
+
+    def calculateAverageAUC(self) -> float:
+        return float(np.mean([r.calculateAUC() for r in self.per_class.values()]))

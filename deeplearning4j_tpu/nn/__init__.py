@@ -1,0 +1,5 @@
+"""NN framework (ref: deeplearning4j/deeplearning4j-nn)."""
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration, NeuralNetConfiguration  # noqa: F401
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
+from deeplearning4j_tpu.nn.conf import layers  # noqa: F401
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401

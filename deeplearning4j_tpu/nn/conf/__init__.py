@@ -1,0 +1,3 @@
+"""Configuration DSL (ref: org.deeplearning4j.nn.conf)."""
+from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration, NeuralNetConfiguration  # noqa: F401
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401

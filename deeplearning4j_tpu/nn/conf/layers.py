@@ -1,0 +1,934 @@
+"""Layer configuration classes (ref: org.deeplearning4j.nn.conf.layers.* — the
+~80-class config DSL) fused with their runtime implementations (ref:
+org.deeplearning4j.nn.layers.* mirror tree).
+
+The reference splits config (conf.layers.DenseLayer) from runtime
+(nn.layers.feedforward.dense.DenseLayer); on TPU the runtime half collapses to
+a pure ``apply(params, x) -> y`` traced under jit, so each config class here
+carries its own init/apply — one class per reference pair:
+
+- ``init_params(key, dtype)``   — parameter pytree (ref: nn.params.*ParamInitializer)
+- ``init_state()``              — non-trainable state (BN running stats)
+- ``apply(params, x, ...)``     — forward; gradients come from jax.grad, so the
+  reference's per-layer ``backpropGradient`` has no analog (deleted by design)
+- ``output_type(input)``        — shape inference (ref: InputType.getOutputType)
+- ``set_n_in(input)``           — nIn auto-fill (ref: overrideNinUponBuild)
+
+JSON round-trip via to_dict/from_dict (ref: Jackson serde of layer confs).
+
+Layout conventions: CNN = NCHW + OIHW kernels (reference default); RNN
+sequences = (batch, time, features) a.k.a. NWC — TPU-native default, with NCW
+(the reference's [b, size, t]) accepted via ``rnnDataFormat``.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf import weights as _winit
+from deeplearning4j_tpu.ops import nn_defs as _nnops
+from deeplearning4j_tpu.train import activations as _act
+from deeplearning4j_tpu.train import losses as _losses
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v, v)
+
+
+def _conv_out(size, k, s, p, mode):
+    if mode == "Same":
+        return -(-size // s)  # ceil
+    return (size + 2 * p - k) // s + 1
+
+
+@dataclass
+class Layer:
+    """Base layer config. Fields with None inherit the builder's globals
+    (ref: NeuralNetConfiguration.Builder global defaults)."""
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weightInit: Optional[str] = None
+    biasInit: Optional[float] = None
+    dropOut: Optional[float] = None  # RETAIN probability (dl4j semantics)
+
+    # ---- build-time plumbing
+    def inherit(self, globals_: dict):
+        for k, v in globals_.items():
+            if hasattr(self, k) and getattr(self, k) is None:
+                setattr(self, k, v)
+
+    def set_n_in(self, input_type: InputType):
+        pass
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    # ---- params
+    def init_params(self, key, dtype=jnp.float32) -> dict:
+        return {}
+
+    def init_state(self) -> dict:
+        return {}
+
+    def regularizable(self) -> Tuple[str, ...]:
+        return ("W",)
+
+    def n_params(self) -> int:
+        import numpy as np
+        key = jax.random.key(0)
+        p = self.init_params(key)
+        return int(sum(np.prod(v.shape) for v in jax.tree_util.tree_leaves(p)))
+
+    # ---- runtime
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        raise NotImplementedError
+
+    def _activate(self, z):
+        return _act.get(self.activation or "IDENTITY")(z)
+
+    # ---- serde
+    def to_dict(self) -> dict:
+        out = {"@type": type(self).__name__}
+        for k, v in self.__dict__.items():
+            if isinstance(v, Layer):
+                out[k] = v.to_dict()
+            elif isinstance(v, tuple):
+                out[k] = list(v)
+            else:
+                out[k] = v
+        return out
+
+    @staticmethod
+    def from_dict(d: dict) -> "Layer":
+        d = dict(d)
+        cls = LAYER_TYPES[d.pop("@type")]
+        for k, v in list(d.items()):
+            if isinstance(v, dict) and "@type" in v:
+                d[k] = Layer.from_dict(v)
+            elif isinstance(v, list) and k in ("kernelSize", "stride", "padding", "dilation",
+                                               "size", "cropping", "blocks", "poolingDimensions"):
+                d[k] = tuple(v)
+        return cls(**d)
+
+
+@dataclass
+class FeedForwardLayer(Layer):
+    nIn: int = 0
+    nOut: int = 0
+
+    def set_n_in(self, input_type: InputType):
+        if not self.nIn:
+            self.nIn = input_type.flat_size() if input_type.kind != "rnn" else input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            return InputType.recurrent(self.nOut, input_type.timeSeriesLength)
+        return InputType.feedForward(self.nOut)
+
+
+@dataclass
+class DenseLayer(FeedForwardLayer):
+    """(ref: conf.layers.DenseLayer / nn.layers.feedforward.dense.DenseLayer)"""
+    hasBias: bool = True
+
+    def init_params(self, key, dtype=jnp.float32):
+        kW, _ = jax.random.split(key)
+        p = {"W": _winit.init(self.weightInit or "XAVIER", kW, (self.nIn, self.nOut),
+                              self.nIn, self.nOut, dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        z = jnp.matmul(x, params["W"])
+        if self.hasBias:
+            z = z + params["b"]
+        return self._activate(z), state
+
+
+@dataclass
+class EmbeddingLayer(FeedForwardLayer):
+    """Index -> dense row (ref: conf.layers.EmbeddingLayer). Input: (B,) or
+    (B,1) integer indices."""
+    hasBias: bool = False
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"W": _winit.init(self.weightInit or "XAVIER", key, (self.nIn, self.nOut),
+                                 self.nIn, self.nOut, dtype)}
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        return self._activate(jnp.take(params["W"], idx, axis=0)), state
+
+
+@dataclass
+class EmbeddingSequenceLayer(FeedForwardLayer):
+    """Sequence of indices -> sequence of rows (ref: EmbeddingSequenceLayer).
+    Input (B, T) ints -> (B, T, nOut)."""
+    inputLength: int = -1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, input_type.timeSeriesLength)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"W": _winit.init(self.weightInit or "XAVIER", key, (self.nIn, self.nOut),
+                                 self.nIn, self.nOut, dtype)}
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        return self._activate(jnp.take(params["W"], idx, axis=0)), state
+
+
+# --------------------------------------------------------------------- CNN
+
+
+@dataclass
+class ConvolutionLayer(FeedForwardLayer):
+    """2D conv, NCHW/OIHW (ref: conf.layers.ConvolutionLayer ->
+    libnd4j conv2d; here lax.conv_general_dilated -> MXU)."""
+    kernelSize: Tuple[int, int] = (5, 5)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolutionMode: str = "Truncate"  # Truncate | Same (ref: ConvolutionMode)
+    hasBias: bool = True
+
+    def set_n_in(self, input_type: InputType):
+        if not self.nIn:
+            self.nIn = input_type.channels
+
+    def output_type(self, input_type: InputType) -> InputType:
+        k, s, p = _pair(self.kernelSize), _pair(self.stride), _pair(self.padding)
+        h = _conv_out(input_type.height, k[0], s[0], p[0], self.convolutionMode)
+        w = _conv_out(input_type.width, k[1], s[1], p[1], self.convolutionMode)
+        return InputType.convolutional(h, w, self.nOut)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k = _pair(self.kernelSize)
+        fan_in = self.nIn * k[0] * k[1]
+        fan_out = self.nOut * k[0] * k[1]
+        p = {"W": _winit.init(self.weightInit or "XAVIER", key,
+                              (self.nOut, self.nIn, k[0], k[1]), fan_in, fan_out, dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def _padding_arg(self):
+        if self.convolutionMode == "Same":
+            return "SAME"
+        p = _pair(self.padding)
+        return [(p[0], p[0]), (p[1], p[1])]
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        z = _nnops.conv2d(x, params["W"], params.get("b"), strides=_pair(self.stride),
+                          padding=self._padding_arg(), dilation=_pair(self.dilation))
+        return self._activate(z), state
+
+
+@dataclass
+class Convolution1DLayer(FeedForwardLayer):
+    """1D conv over (B, T, C) sequences (ref: Convolution1DLayer)."""
+    kernelSize: int = 3
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    convolutionMode: str = "Same"
+    hasBias: bool = True
+
+    def set_n_in(self, input_type: InputType):
+        if not self.nIn:
+            self.nIn = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timeSeriesLength
+        if t > 0:
+            t = _conv_out(t, self.kernelSize, self.stride, self.padding, self.convolutionMode)
+        return InputType.recurrent(self.nOut, t)
+
+    def init_params(self, key, dtype=jnp.float32):
+        fan_in = self.nIn * self.kernelSize
+        p = {"W": _winit.init(self.weightInit or "XAVIER", key,
+                              (self.nOut, self.nIn, self.kernelSize),
+                              fan_in, self.nOut * self.kernelSize, dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        xc = jnp.swapaxes(x, 1, 2)  # (B,T,C) -> (B,C,T)
+        pad = "SAME" if self.convolutionMode == "Same" else [(self.padding, self.padding)]
+        z = _nnops.conv1d(xc, params["W"], params.get("b"), stride=self.stride,
+                          padding=pad, dilation=self.dilation)
+        return self._activate(jnp.swapaxes(z, 1, 2)), state
+
+
+@dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed conv (ref: conf.layers.Deconvolution2D)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        k, s, p = _pair(self.kernelSize), _pair(self.stride), _pair(self.padding)
+        if self.convolutionMode == "Same":
+            h, w = input_type.height * s[0], input_type.width * s[1]
+        else:
+            h = s[0] * (input_type.height - 1) + k[0] - 2 * p[0]
+            w = s[1] * (input_type.width - 1) + k[1] - 2 * p[1]
+        return InputType.convolutional(h, w, self.nOut)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k = _pair(self.kernelSize)
+        fan_in = self.nIn * k[0] * k[1]
+        p = {"W": _winit.init(self.weightInit or "XAVIER", key,
+                              (self.nIn, self.nOut, k[0], k[1]), fan_in,
+                              self.nOut * k[0] * k[1], dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        dn = lax.conv_dimension_numbers(x.shape, params["W"].shape, ("NCHW", "IOHW", "NCHW"))
+        pad = self._padding_arg()
+        if isinstance(pad, list):
+            pad = [(p0, p1) for (p0, p1) in pad]
+        z = lax.conv_transpose(x, params["W"], strides=_pair(self.stride), padding=pad,
+                               dimension_numbers=dn)
+        if self.hasBias:
+            z = z + params["b"].reshape(1, -1, 1, 1)
+        return self._activate(z), state
+
+
+@dataclass
+class DepthwiseConvolution2D(ConvolutionLayer):
+    """(ref: conf.layers.DepthwiseConvolution2D); nOut = nIn * depthMultiplier."""
+    depthMultiplier: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        self.nOut = self.nIn * self.depthMultiplier
+        return super().output_type(input_type)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k = _pair(self.kernelSize)
+        ch = self.nIn * self.depthMultiplier
+        p = {"W": _winit.init(self.weightInit or "XAVIER", key, (ch, 1, k[0], k[1]),
+                              k[0] * k[1], k[0] * k[1] * self.depthMultiplier, dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((ch,), self.biasInit or 0.0, dtype)
+        return p
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        z = _nnops.depthwise_conv2d(x, params["W"], params.get("b"), strides=_pair(self.stride),
+                                    padding=self._padding_arg(), dilation=_pair(self.dilation))
+        return self._activate(z), state
+
+
+@dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    """(ref: conf.layers.SeparableConvolution2D)."""
+    depthMultiplier: int = 1
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        k = _pair(self.kernelSize)
+        ch = self.nIn * self.depthMultiplier
+        p = {
+            "dW": _winit.init(self.weightInit or "XAVIER", k1, (ch, 1, k[0], k[1]),
+                              k[0] * k[1], k[0] * k[1], dtype),
+            "pW": _winit.init(self.weightInit or "XAVIER", k2, (self.nOut, ch, 1, 1),
+                              ch, self.nOut, dtype),
+        }
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def regularizable(self):
+        return ("dW", "pW")
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        z = _nnops.separable_conv2d(x, params["dW"], params["pW"], params.get("b"),
+                                    strides=_pair(self.stride), padding=self._padding_arg())
+        return self._activate(z), state
+
+
+@dataclass
+class SubsamplingLayer(Layer):
+    """Pooling (ref: conf.layers.SubsamplingLayer; PoolingType MAX/AVG/SUM/PNORM)."""
+    poolingType: str = "MAX"
+    kernelSize: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolutionMode: str = "Truncate"
+    pnorm: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        k, s, p = _pair(self.kernelSize), _pair(self.stride), _pair(self.padding)
+        h = _conv_out(input_type.height, k[0], s[0], p[0], self.convolutionMode)
+        w = _conv_out(input_type.width, k[1], s[1], p[1], self.convolutionMode)
+        return InputType.convolutional(h, w, input_type.channels)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        if self.convolutionMode == "Same":
+            pad = "SAME"
+        else:
+            p = _pair(self.padding)
+            pad = [(p[0], p[0]), (p[1], p[1])]
+        k, s = _pair(self.kernelSize), _pair(self.stride)
+        if self.poolingType == "MAX":
+            return _nnops._pool(x, "max", k, s, pad), state
+        if self.poolingType == "AVG":
+            return _nnops._pool(x, "avg", k, s, pad), state
+        if self.poolingType == "SUM":
+            return _nnops._pool(x, "sum", k, s, pad), state
+        if self.poolingType == "PNORM":
+            z = _nnops._pool(jnp.abs(x) ** self.pnorm, "sum", k, s, pad)
+            return z ** (1.0 / self.pnorm), state
+        raise ValueError(self.poolingType)
+
+
+@dataclass
+class Subsampling1DLayer(Layer):
+    """1D pooling over (B,T,C) (ref: Subsampling1DLayer)."""
+    poolingType: str = "MAX"
+    kernelSize: int = 2
+    stride: int = 2
+    padding: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timeSeriesLength
+        if t > 0:
+            t = (t + 2 * self.padding - self.kernelSize) // self.stride + 1
+        return InputType.recurrent(input_type.size, t)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        xc = jnp.swapaxes(x, 1, 2)
+        pad = [(self.padding, self.padding)]
+        kind = "max" if self.poolingType == "MAX" else "avg"
+        z = _nnops._pool(xc, kind, (self.kernelSize,), (self.stride,), pad, "NCW")
+        return jnp.swapaxes(z, 1, 2), state
+
+
+@dataclass
+class BatchNormalization(FeedForwardLayer):
+    """(ref: conf.layers.BatchNormalization; decay 0.9 hmm — dl4j 'decay' is
+    the running-average momentum; eps 1e-5). Works on FF (B,F) and CNN NCHW
+    (per-channel). Running stats live in layer state, updated in training."""
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+    lockGammaBeta: bool = False
+
+    def set_n_in(self, input_type: InputType):
+        if not self.nIn:
+            self.nIn = input_type.channels if input_type.kind == "cnn" else input_type.flat_size()
+        self.nOut = self.nIn
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init_params(self, key, dtype=jnp.float32):
+        if self.lockGammaBeta:
+            return {}
+        return {"gamma": jnp.full((self.nIn,), self.gamma_init, dtype),
+                "beta": jnp.full((self.nIn,), self.beta_init, dtype)}
+
+    def init_state(self):
+        return {"mean": jnp.zeros((self.nIn,)), "var": jnp.ones((self.nIn,))}
+
+    def regularizable(self):
+        return ()
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        axes = (0,) if x.ndim == 2 else (0, 2, 3)
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {"mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                         "var": self.decay * state["var"] + (1 - self.decay) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        y = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + self.eps)
+        if params:
+            y = y * params["gamma"].reshape(shape) + params["beta"].reshape(shape)
+        return self._activate(y), new_state
+
+
+@dataclass
+class LocalResponseNormalization(Layer):
+    """(ref: conf.layers.LocalResponseNormalization; dl4j defaults k=2,n=5,
+    alpha=1e-4,beta=0.75)."""
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return _nnops.local_response_normalization(
+            x, depth_radius=self.n // 2, bias=self.k, alpha=self.alpha, beta=self.beta), state
+
+
+@dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout (ref: conf.layers.DropoutLayer). ``dropOut`` is the
+    RETAIN probability, dl4j semantics; inverted-dropout scaling."""
+
+    def __post_init__(self):
+        if self.dropOut is None:
+            self.dropOut = 0.5
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        if not training or self.dropOut >= 1.0 or rng is None:
+            return x, state
+        keep = self.dropOut
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0), state
+
+
+@dataclass
+class ActivationLayer(Layer):
+    """(ref: conf.layers.ActivationLayer)."""
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return self._activate(x), state
+
+
+@dataclass
+class Upsampling2D(Layer):
+    size: Tuple[int, int] = (2, 2)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        s = _pair(self.size)
+        return InputType.convolutional(input_type.height * s[0], input_type.width * s[1],
+                                       input_type.channels)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return _nnops.upsampling2d(x, _pair(self.size)), state
+
+
+@dataclass
+class ZeroPaddingLayer(Layer):
+    padding: Tuple[int, int, int, int] = (0, 0, 0, 0)  # top,bottom,left,right
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self.padding
+        return InputType.convolutional(input_type.height + t + b, input_type.width + l + r,
+                                       input_type.channels)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        t, b, l, r = self.padding
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r))), state
+
+
+@dataclass
+class Cropping2D(Layer):
+    cropping: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self.cropping
+        return InputType.convolutional(input_type.height - t - b, input_type.width - l - r,
+                                       input_type.channels)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        t, b, l, r = self.cropping
+        return x[:, :, t:x.shape[2] - b, l:x.shape[3] - r], state
+
+
+@dataclass
+class GlobalPoolingLayer(Layer):
+    """(ref: conf.layers.GlobalPoolingLayer) — pools RNN over time or CNN over
+    space. Supports masked mean/max for variable-length sequences."""
+    poolingType: str = "AVG"
+    pnorm: int = 2
+    collapseDimensions: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            return InputType.feedForward(input_type.size)
+        if input_type.kind == "cnn":
+            return InputType.feedForward(input_type.channels)
+        return input_type
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None):
+        if x.ndim == 3:  # (B,T,F) over time
+            axes = (1,)
+            if mask is not None:
+                m = mask[:, :, None]
+                if self.poolingType == "AVG":
+                    return jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0), state
+                if self.poolingType == "MAX":
+                    return jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1), state
+        else:  # NCHW over spatial
+            axes = tuple(range(2, x.ndim))
+        if self.poolingType == "AVG":
+            return jnp.mean(x, axis=axes), state
+        if self.poolingType == "MAX":
+            return jnp.max(x, axis=axes), state
+        if self.poolingType == "SUM":
+            return jnp.sum(x, axis=axes), state
+        if self.poolingType == "PNORM":
+            return jnp.sum(jnp.abs(x) ** self.pnorm, axis=axes) ** (1.0 / self.pnorm), state
+        raise ValueError(self.poolingType)
+
+
+# --------------------------------------------------------------------- RNN
+
+
+@dataclass
+class BaseRecurrentLayer(FeedForwardLayer):
+    rnnDataFormat: str = "NWC"  # (B,T,F); "NCW" = reference layout [b,size,t]
+
+    def set_n_in(self, input_type: InputType):
+        if not self.nIn:
+            self.nIn = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, input_type.timeSeriesLength)
+
+    def _to_nwc(self, x):
+        return jnp.swapaxes(x, 1, 2) if self.rnnDataFormat == "NCW" else x
+
+    def _from_nwc(self, x):
+        return jnp.swapaxes(x, 1, 2) if self.rnnDataFormat == "NCW" else x
+
+
+@dataclass
+class LSTM(BaseRecurrentLayer):
+    """(ref: conf.layers.LSTM -> fused libnd4j lstmLayer; here one lax.scan).
+    Default activation tanh; gate activation sigmoid (fixed, as reference)."""
+    forgetGateBiasInit: float = 1.0
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        H = self.nOut
+        p = {
+            "W": _winit.init(self.weightInit or "XAVIER", k1, (self.nIn, 4 * H),
+                             self.nIn, 4 * H, dtype),
+            "RW": _winit.init(self.weightInit or "XAVIER", k2, (H, 4 * H), H, 4 * H, dtype),
+        }
+        b = jnp.zeros((4 * H,), dtype)
+        b = b.at[H:2 * H].set(self.forgetGateBiasInit)  # [i,f,g,o] gate order
+        p["b"] = b
+        return p
+
+    def regularizable(self):
+        return ("W", "RW")
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None,
+              initial_state=None):
+        x = self._to_nwc(x)
+        B, H = x.shape[0], self.nOut
+        if initial_state is None:
+            h0 = jnp.zeros((B, H), x.dtype)
+            c0 = jnp.zeros((B, H), x.dtype)
+        else:
+            h0, c0 = initial_state
+        ys, (hT, cT) = _nnops.lstm_layer(x, h0, c0, params["W"], params["RW"], params["b"],
+                                         mask=mask)
+        return self._from_nwc(ys), state
+
+
+@dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (ref: conf.layers.GravesLSTM)."""
+
+    def init_params(self, key, dtype=jnp.float32):
+        p = super().init_params(key, dtype)
+        H = self.nOut
+        p["pI"] = jnp.zeros((H,), dtype)
+        p["pF"] = jnp.zeros((H,), dtype)
+        p["pO"] = jnp.zeros((H,), dtype)
+        return p
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None,
+              initial_state=None):
+        x = self._to_nwc(x)
+        B, H = x.shape[0], self.nOut
+        if initial_state is None:
+            h0, c0 = jnp.zeros((B, H), x.dtype), jnp.zeros((B, H), x.dtype)
+        else:
+            h0, c0 = initial_state
+        W, RW, b = params["W"], params["RW"], params["b"]
+        pI, pF, pO = params["pI"], params["pF"], params["pO"]
+
+        xs = jnp.swapaxes(x, 0, 1)  # (T,B,F)
+        ms = jnp.swapaxes(mask, 0, 1) if mask is not None else None
+
+        def step(carry, inp):
+            h, c = carry
+            xt, mt = inp if ms is not None else (inp, None)
+            z = jnp.matmul(xt, W) + jnp.matmul(h, RW) + b
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i = jax.nn.sigmoid(i + pI * c)
+            f = jax.nn.sigmoid(f + pF * c)
+            g = jnp.tanh(g)
+            c2 = f * c + i * g
+            o = jax.nn.sigmoid(o + pO * c2)
+            h2 = o * jnp.tanh(c2)
+            if mt is not None:
+                m = mt[:, None]
+                h2 = jnp.where(m > 0, h2, h)
+                c2 = jnp.where(m > 0, c2, c)
+            return (h2, c2), h2
+
+        (_, _), ys = lax.scan(step, (h0, c0), (xs, ms) if ms is not None else xs)
+        return self._from_nwc(jnp.swapaxes(ys, 0, 1)), state
+
+
+@dataclass
+class SimpleRnn(BaseRecurrentLayer):
+    """(ref: conf.layers.SimpleRnn)."""
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        p = {"W": _winit.init(self.weightInit or "XAVIER", k1, (self.nIn, self.nOut),
+                              self.nIn, self.nOut, dtype),
+             "RW": _winit.init(self.weightInit or "XAVIER", k2, (self.nOut, self.nOut),
+                               self.nOut, self.nOut, dtype),
+             "b": jnp.full((self.nOut,), self.biasInit or 0.0, dtype)}
+        return p
+
+    def regularizable(self):
+        return ("W", "RW")
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None,
+              initial_state=None):
+        x = self._to_nwc(x)
+        B = x.shape[0]
+        h0 = initial_state if initial_state is not None else jnp.zeros((B, self.nOut), x.dtype)
+        act = _act.get(self.activation or "TANH")
+        ys, _ = _nnops.simple_rnn(x, h0, params["W"], params["RW"], params["b"], activation=act)
+        return self._from_nwc(ys), state
+
+
+@dataclass
+class GRU(BaseRecurrentLayer):
+    """(ref: libnd4j gru op; dl4j exposes via samediff) ."""
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        H = self.nOut
+        return {"W": _winit.init(self.weightInit or "XAVIER", k1, (self.nIn, 3 * H),
+                                 self.nIn, 3 * H, dtype),
+                "RW": _winit.init(self.weightInit or "XAVIER", k2, (H, 3 * H), H, 3 * H, dtype),
+                "bi": jnp.zeros((3 * H,), dtype), "bh": jnp.zeros((3 * H,), dtype)}
+
+    def regularizable(self):
+        return ("W", "RW")
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None,
+              initial_state=None):
+        x = self._to_nwc(x)
+        B = x.shape[0]
+        h0 = initial_state if initial_state is not None else jnp.zeros((B, self.nOut), x.dtype)
+        ys, _ = _nnops.gru_layer(x, h0, params["W"], params["RW"], params["bi"], params["bh"])
+        return self._from_nwc(ys), state
+
+
+@dataclass
+class Bidirectional(Layer):
+    """Wrapper running a recurrent layer in both directions (ref:
+    conf.layers.recurrent.Bidirectional; Mode CONCAT/ADD/MUL/AVERAGE)."""
+    fwd: Optional[Layer] = None
+    mode: str = "CONCAT"
+
+    def __post_init__(self):
+        if self.fwd is not None and not isinstance(self.fwd, Layer):
+            self.fwd = Layer.from_dict(self.fwd)
+
+    def inherit(self, globals_: dict):
+        super().inherit(globals_)
+        self.fwd.inherit(globals_)
+
+    def set_n_in(self, input_type: InputType):
+        self.fwd.set_n_in(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        out = self.fwd.output_type(input_type)
+        if self.mode == "CONCAT":
+            return InputType.recurrent(out.size * 2, out.timeSeriesLength)
+        return out
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        return {"fwd": self.fwd.init_params(k1, dtype), "bwd": self.fwd.init_params(k2, dtype)}
+
+    def regularizable(self):
+        return ()
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None):
+        yf, _ = self.fwd.apply(params["fwd"], x, training=training, rng=rng, state=None,
+                               mask=mask)
+        xr = jnp.flip(x, axis=1)
+        mr = jnp.flip(mask, axis=1) if mask is not None else None
+        yb, _ = self.fwd.apply(params["bwd"], xr, training=training, rng=rng, state=None,
+                               mask=mr)
+        yb = jnp.flip(yb, axis=1)
+        if self.mode == "CONCAT":
+            return jnp.concatenate([yf, yb], axis=-1), state
+        if self.mode == "ADD":
+            return yf + yb, state
+        if self.mode == "MUL":
+            return yf * yb, state
+        if self.mode == "AVERAGE":
+            return 0.5 * (yf + yb), state
+        raise ValueError(self.mode)
+
+
+@dataclass
+class LastTimeStep(Layer):
+    """Wrapper extracting the last (masked) timestep (ref:
+    conf.layers.recurrent.LastTimeStep)."""
+    underlying: Optional[Layer] = None
+
+    def __post_init__(self):
+        if self.underlying is not None and not isinstance(self.underlying, Layer):
+            self.underlying = Layer.from_dict(self.underlying)
+
+    def inherit(self, globals_: dict):
+        super().inherit(globals_)
+        if self.underlying:
+            self.underlying.inherit(globals_)
+
+    def set_n_in(self, input_type: InputType):
+        if self.underlying:
+            self.underlying.set_n_in(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        out = self.underlying.output_type(input_type) if self.underlying else input_type
+        return InputType.feedForward(out.size)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return self.underlying.init_params(key, dtype) if self.underlying else {}
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None):
+        if self.underlying:
+            x, state = self.underlying.apply(params, x, training=training, rng=rng,
+                                             state=state, mask=mask)
+        if mask is not None:
+            idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+            return x[jnp.arange(x.shape[0]), idx], state
+        return x[:, -1], state
+
+
+# ------------------------------------------------------------- output layers
+
+
+@dataclass
+class BaseOutputLayer(FeedForwardLayer):
+    lossFunction: str = "MCXENT"
+    hasBias: bool = True
+
+    def __post_init__(self):
+        if self.activation is None and self.lossFunction in ("MCXENT", "NEGATIVELOGLIKELIHOOD"):
+            self.activation = "SOFTMAX"
+
+    def init_params(self, key, dtype=jnp.float32):
+        kW, _ = jax.random.split(key)
+        p = {"W": _winit.init(self.weightInit or "XAVIER", kW, (self.nIn, self.nOut),
+                              self.nIn, self.nOut, dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        z = jnp.matmul(x, params["W"])
+        if self.hasBias:
+            z = z + params["b"]
+        return self._activate(z), state
+
+    def compute_loss(self, labels, output, mask=None):
+        return _losses.get(self.lossFunction)(labels, output, mask)
+
+
+@dataclass
+class OutputLayer(BaseOutputLayer):
+    """(ref: conf.layers.OutputLayer)."""
+
+
+@dataclass
+class RnnOutputLayer(BaseOutputLayer):
+    """Per-timestep output (ref: conf.layers.RnnOutputLayer). Input (B,T,F)."""
+
+    def set_n_in(self, input_type: InputType):
+        if not self.nIn:
+            self.nIn = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, input_type.timeSeriesLength)
+
+
+@dataclass
+class LossLayer(Layer):
+    """Loss without params (ref: conf.layers.LossLayer)."""
+    lossFunction: str = "MCXENT"
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return self._activate(x), state
+
+    def compute_loss(self, labels, output, mask=None):
+        return _losses.get(self.lossFunction)(labels, output, mask)
+
+
+# ------------------------------------------------------------- attention
+
+
+@dataclass
+class SelfAttentionLayer(BaseRecurrentLayer):
+    """Dot-product self-attention over sequences (ref:
+    conf.layers.SelfAttentionLayer, SameDiff-backed in the reference).
+    projectInput=True uses learned Q/K/V/O projections."""
+    nHeads: int = 1
+    headSize: int = 0
+    projectInput: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        size = self.nOut if self.projectInput else input_type.size
+        return InputType.recurrent(size, input_type.timeSeriesLength)
+
+    def init_params(self, key, dtype=jnp.float32):
+        if not self.projectInput:
+            return {}
+        D = self.nIn
+        O = self.nOut
+        ks = jax.random.split(key, 4)
+        wi = self.weightInit or "XAVIER"
+        return {"Wq": _winit.init(wi, ks[0], (D, O), D, O, dtype),
+                "Wk": _winit.init(wi, ks[1], (D, O), D, O, dtype),
+                "Wv": _winit.init(wi, ks[2], (D, O), D, O, dtype),
+                "Wo": _winit.init(wi, ks[3], (O, O), O, O, dtype)}
+
+    def regularizable(self):
+        return ("Wq", "Wk", "Wv", "Wo")
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None):
+        if self.projectInput:
+            out = _nnops.multi_head_attention(x, x, params["Wq"], params["Wk"], params["Wv"],
+                                              params["Wo"], self.nHeads, mask=mask)
+        else:
+            m = mask[:, None, :] if mask is not None else None
+            out = _nnops.dot_product_attention(x, x, x, mask=m)
+        return out, state
+
+
+LAYER_TYPES = {c.__name__: c for c in [
+    DenseLayer, EmbeddingLayer, EmbeddingSequenceLayer, ConvolutionLayer, Convolution1DLayer,
+    Deconvolution2D, DepthwiseConvolution2D, SeparableConvolution2D, SubsamplingLayer,
+    Subsampling1DLayer, BatchNormalization, LocalResponseNormalization, DropoutLayer,
+    ActivationLayer, Upsampling2D, ZeroPaddingLayer, Cropping2D, GlobalPoolingLayer,
+    LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional, LastTimeStep,
+    OutputLayer, RnnOutputLayer, LossLayer, SelfAttentionLayer,
+]}

@@ -1,0 +1,51 @@
+"""Weight initialization (ref: org.deeplearning4j.nn.weights.WeightInit enum +
+WeightInitUtil; dl4j's XAVIER is gaussian sqrt(2/(fanIn+fanOut))).
+
+All initializers are pure functions of an explicit PRNG key (threefry),
+deterministic per seed — matching the reference's seeded-init reproducibility
+contract (ref: NeuralNetConfiguration.Builder.seed)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def init(name: str, key, shape, fan_in: int, fan_out: int, dtype=jnp.float32):
+    """Initialize a weight tensor per the dl4j WeightInit scheme ``name``."""
+    name = str(name).upper()
+    if name == "ZERO":
+        return jnp.zeros(shape, dtype)
+    if name == "ONES":
+        return jnp.ones(shape, dtype)
+    if name == "XAVIER":  # dl4j: gaussian, std = sqrt(2/(fanIn+fanOut))
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return jax.random.normal(key, shape, dtype) * std
+    if name == "XAVIER_UNIFORM":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "XAVIER_FAN_IN":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if name in ("RELU", "HE_NORMAL"):  # He: std = sqrt(2/fanIn)
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / fan_in)
+    if name in ("RELU_UNIFORM", "HE_UNIFORM"):
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "LECUN_NORMAL":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if name == "LECUN_UNIFORM":
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "UNIFORM":  # dl4j legacy: U(-a, a), a = 1/sqrt(fanIn)
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "NORMAL":  # dl4j: N(0, 1/sqrt(fanIn))
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if name == "SIGMOID_UNIFORM":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "IDENTITY":
+        assert len(shape) == 2 and shape[0] == shape[1]
+        return jnp.eye(shape[0], dtype=dtype)
+    raise ValueError(f"unknown WeightInit: {name}")

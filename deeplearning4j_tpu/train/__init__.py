@@ -1,0 +1,11 @@
+"""Training math: updaters, losses, activations, schedules, regularization
+(ref: org.nd4j.linalg.{learning,lossfunctions,activations,schedule})."""
+from deeplearning4j_tpu.train import activations, losses, regularization, schedules, updaters  # noqa: F401
+from deeplearning4j_tpu.train.updaters import (  # noqa: F401
+    Adam, AdamW, AdaDelta, AdaGrad, AdaMax, AMSGrad, Nadam, Nesterovs, NoOp, RmsProp, Sgd, Updater,
+)
+from deeplearning4j_tpu.train.schedules import (  # noqa: F401
+    ExponentialSchedule, FixedSchedule, InverseSchedule, MapSchedule, PolySchedule, Schedule,
+    SigmoidSchedule, StepSchedule, WarmupLinearDecaySchedule,
+)
+from deeplearning4j_tpu.train.regularization import L1, L2, WeightDecay  # noqa: F401

@@ -1,0 +1,52 @@
+"""Activation functions (ref: org.nd4j.linalg.activations.Activation enum +
+impl.Activation* classes, ~25 total).
+
+Each activation resolves to a pure jnp function from the op registry; layers
+call them inside the jitted step so XLA fuses them into the surrounding
+matmul/conv (the reference pays a separate native-op dispatch per activation).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+# dl4j Activation enum name -> jnp fn
+_ACTIVATIONS: dict[str, Callable] = {
+    "IDENTITY": lambda x: x,
+    "RELU": jax.nn.relu,
+    "RELU6": jax.nn.relu6,
+    "LEAKYRELU": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "ELU": jax.nn.elu,
+    "SELU": jax.nn.selu,
+    "GELU": lambda x: jax.nn.gelu(x, approximate=True),
+    "SIGMOID": jax.nn.sigmoid,
+    "HARDSIGMOID": jax.nn.hard_sigmoid,
+    "TANH": jnp.tanh,
+    "HARDTANH": lambda x: jnp.clip(x, -1.0, 1.0),
+    "RATIONALTANH": lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0),
+    "RECTIFIEDTANH": lambda x: jnp.maximum(0.0, jnp.tanh(x)),
+    "SOFTMAX": lambda x: jax.nn.softmax(x, axis=-1),
+    "LOGSOFTMAX": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "SOFTPLUS": jax.nn.softplus,
+    "SOFTSIGN": jax.nn.soft_sign,
+    "SWISH": jax.nn.silu,
+    "MISH": jax.nn.mish,
+    "CUBE": lambda x: x * x * x,
+    "THRESHOLDEDRELU": lambda x: jnp.where(x > 1.0, x, 0.0),
+}
+
+
+def get(name) -> Callable:
+    """Resolve an activation by dl4j name (case-insensitive) or pass through a callable."""
+    if callable(name):
+        return name
+    fn = _ACTIVATIONS.get(str(name).upper())
+    if fn is None:
+        raise ValueError(f"unknown activation: {name}. Known: {sorted(_ACTIVATIONS)}")
+    return fn
+
+
+def names():
+    return sorted(_ACTIVATIONS)

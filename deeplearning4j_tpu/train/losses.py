@@ -1,0 +1,132 @@
+"""Loss functions (ref: org.nd4j.linalg.lossfunctions.LossFunctions.LossFunction
+enum + impl.Loss* classes).
+
+Each loss resolves to a pure jnp ``(labels, preds, mask) -> scalar`` used
+inside the jitted training step; gradients come from jax.grad (the reference
+hand-writes computeGradient per loss).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import registry as _reg
+
+
+def _masked(per_example, mask):
+    if mask is None:
+        return jnp.mean(per_example)
+    m = mask
+    while m.ndim < per_example.ndim:
+        m = m[..., None]
+    m = jnp.broadcast_to(m, per_example.shape)
+    return jnp.sum(per_example * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+# Explicit per-example forms so masking composes correctly.
+def _mcxent(labels, preds, mask=None):
+    logp = jnp.log(jnp.clip(preds, 1e-10, 1.0))
+    return _masked(-jnp.sum(labels * logp, axis=-1), mask)
+
+
+def _mcxent_logits(labels, logits, mask=None):
+    import jax
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return _masked(-jnp.sum(labels * logp, axis=-1), mask)
+
+
+def _mse(labels, preds, mask=None):
+    return _masked(jnp.mean((preds - labels) ** 2, axis=-1), mask)
+
+
+def _mae(labels, preds, mask=None):
+    return _masked(jnp.mean(jnp.abs(preds - labels), axis=-1), mask)
+
+
+def _binary_xent(labels, preds, mask=None):
+    p = jnp.clip(preds, 1e-7, 1.0 - 1e-7)
+    per = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
+    return _masked(jnp.mean(per, axis=-1), mask)
+
+
+def _hinge(labels, preds, mask=None):
+    return _masked(jnp.mean(jnp.maximum(0.0, 1.0 - labels * preds), axis=-1), mask)
+
+
+def _squared_hinge(labels, preds, mask=None):
+    return _masked(jnp.mean(jnp.maximum(0.0, 1.0 - labels * preds) ** 2, axis=-1), mask)
+
+
+def _kld(labels, preds, mask=None):
+    p = jnp.clip(labels, 1e-10, 1.0)
+    q = jnp.clip(preds, 1e-10, 1.0)
+    return _masked(jnp.sum(p * jnp.log(p / q), axis=-1), mask)
+
+
+def _poisson(labels, preds, mask=None):
+    return _masked(jnp.mean(preds - labels * jnp.log(jnp.maximum(preds, 1e-8)), axis=-1), mask)
+
+
+def _cosine(labels, preds, mask=None):
+    num = jnp.sum(labels * preds, axis=-1)
+    den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(preds, axis=-1)
+    return _masked(-num / jnp.maximum(den, 1e-12), mask)
+
+
+def _l1(labels, preds, mask=None):
+    return _masked(jnp.sum(jnp.abs(preds - labels), axis=-1), mask)
+
+
+def _l2(labels, preds, mask=None):
+    return _masked(jnp.sum((preds - labels) ** 2, axis=-1), mask)
+
+
+def _mape(labels, preds, mask=None):
+    return _masked(jnp.mean(jnp.abs((labels - preds) / jnp.maximum(jnp.abs(labels), 1e-8)),
+                            axis=-1) * 100.0, mask)
+
+
+def _msle(labels, preds, mask=None):
+    return _masked(jnp.mean((jnp.log1p(jnp.maximum(preds, 0)) - jnp.log1p(jnp.maximum(labels, 0))) ** 2,
+                            axis=-1), mask)
+
+
+def _nll(labels, preds, mask=None):  # dl4j NEGATIVELOGLIKELIHOOD == MCXENT on softmax outputs
+    return _mcxent(labels, preds, mask)
+
+
+_LOSSES: dict[str, Callable] = {
+    "MCXENT": _mcxent,
+    "NEGATIVELOGLIKELIHOOD": _nll,
+    "MSE": _mse,
+    "SQUARED_LOSS": _mse,
+    "MEAN_ABSOLUTE_ERROR": _mae,
+    "L1": _l1,
+    "L2": _l2,
+    "XENT": _binary_xent,
+    "HINGE": _hinge,
+    "SQUARED_HINGE": _squared_hinge,
+    "KL_DIVERGENCE": _kld,
+    "RECONSTRUCTION_CROSSENTROPY": _binary_xent,
+    "POISSON": _poisson,
+    "COSINE_PROXIMITY": _cosine,
+    "MEAN_ABSOLUTE_PERCENTAGE_ERROR": _mape,
+    "MEAN_SQUARED_LOGARITHMIC_ERROR": _msle,
+    "SPARSE_MCXENT": lambda labels, logits, mask=None: _reg.get("sparseMcxent", "loss").fn(labels, logits),
+}
+
+
+def get(name) -> Callable:
+    """Resolve by dl4j LossFunction enum name or pass through a callable
+    (labels, preds, mask=None) -> scalar."""
+    if callable(name):
+        return name
+    fn = _LOSSES.get(str(name).upper())
+    if fn is None:
+        raise ValueError(f"unknown loss: {name}. Known: {sorted(_LOSSES)}")
+    return fn
+
+
+def names():
+    return sorted(_LOSSES)

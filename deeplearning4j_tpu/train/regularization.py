@@ -1,0 +1,58 @@
+"""Regularization (ref: org.nd4j.linalg.learning.regularization.* — L1, L2,
+WeightDecay applied to gradients per-layer).
+
+Applied inside the jitted loss: loss += sum over weight params of the
+per-layer penalty. The reference excludes biases by default (param key 'b');
+same here — only keys listed in each layer's ``regularizable()`` participate.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+
+@dataclass
+class Regularization:
+    def penalty(self, w):
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {"@type": type(self).__name__}
+        d.update(self.__dict__)
+        return d
+
+
+@dataclass
+class L1(Regularization):
+    l1: float = 0.0
+
+    def penalty(self, w):
+        return self.l1 * jnp.sum(jnp.abs(w))
+
+
+@dataclass
+class L2(Regularization):
+    l2: float = 0.0
+
+    def penalty(self, w):
+        return self.l2 * jnp.sum(w * w)
+
+
+@dataclass
+class WeightDecay(Regularization):
+    """Decoupled weight decay (applied as grad += coeff * w in the reference;
+    under jax.grad the 0.5*coeff*||w||^2 penalty is the exact equivalent)."""
+    coeff: float = 0.0
+
+    def penalty(self, w):
+        return 0.5 * self.coeff * jnp.sum(w * w)
+
+
+_ALL = {c.__name__: c for c in [L1, L2, WeightDecay]}
+
+
+def from_dict(d: dict) -> Regularization:
+    d = dict(d)
+    cls = _ALL[d.pop("@type")]
+    return cls(**d)

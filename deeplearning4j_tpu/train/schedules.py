@@ -1,0 +1,135 @@
+"""Learning-rate schedules (ref: org.nd4j.linalg.schedule.* — ISchedule impls).
+
+Each schedule is a dataclass serializable to JSON and convertible to a pure
+``step -> lr`` function usable inside the jitted train step (optax-compatible).
+ScheduleType ITERATION/EPOCH parity: the ``t`` passed in is the iteration
+counter; epoch-typed schedules divide by iterations_per_epoch at fit time.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclass
+class Schedule:
+    scheduleType: str = "ITERATION"  # or EPOCH
+
+    def value_at(self, t):
+        raise NotImplementedError
+
+    def to_fn(self, iterations_per_epoch: int = 1):
+        div = iterations_per_epoch if self.scheduleType == "EPOCH" else 1
+
+        def fn(step):
+            return self.value_at(step // div if div > 1 else step)
+
+        return fn
+
+    def to_dict(self):
+        d = {"@type": type(self).__name__}
+        d.update(self.__dict__)
+        return d
+
+
+@dataclass
+class FixedSchedule(Schedule):
+    value: float = 0.001
+
+    def value_at(self, t):
+        return self.value
+
+
+@dataclass
+class StepSchedule(Schedule):
+    initialValue: float = 0.1
+    decayRate: float = 0.5
+    step: float = 10
+
+    def value_at(self, t):
+        return self.initialValue * self.decayRate ** jnp.floor(t / self.step)
+
+
+@dataclass
+class ExponentialSchedule(Schedule):
+    initialValue: float = 0.1
+    gamma: float = 0.99
+
+    def value_at(self, t):
+        return self.initialValue * self.gamma ** t
+
+
+@dataclass
+class InverseSchedule(Schedule):
+    initialValue: float = 0.1
+    gamma: float = 0.99
+    power: float = 1.0
+
+    def value_at(self, t):
+        return self.initialValue / (1.0 + self.gamma * t) ** self.power
+
+
+@dataclass
+class PolySchedule(Schedule):
+    initialValue: float = 0.1
+    power: float = 2.0
+    maxIter: int = 1000
+
+    def value_at(self, t):
+        return self.initialValue * (1.0 - jnp.minimum(t, self.maxIter) / self.maxIter) ** self.power
+
+
+@dataclass
+class SigmoidSchedule(Schedule):
+    initialValue: float = 0.1
+    gamma: float = 0.99
+    stepSize: int = 10
+
+    def value_at(self, t):
+        return self.initialValue / (1.0 + jnp.exp(-self.gamma * (t - self.stepSize)))
+
+
+@dataclass
+class MapSchedule(Schedule):
+    values: dict = field(default_factory=dict)  # {iteration: lr}; holds until next key
+
+    def value_at(self, t):
+        keys = sorted(int(k) for k in self.values)
+        out = self.values[str(keys[0])] if isinstance(next(iter(self.values)), str) else self.values[keys[0]]
+
+        def val(k):
+            return self.values.get(k, self.values.get(str(k)))
+
+        result = val(keys[0])
+        for k in keys:
+            result = jnp.where(t >= k, val(k), result)
+        return result
+
+
+@dataclass
+class WarmupLinearDecaySchedule(Schedule):
+    """TPU-native addition: linear warmup then linear decay (the BERT fine-tune
+    schedule; no reference equivalent — the reference predates it)."""
+    peakValue: float = 1e-4
+    warmupSteps: int = 100
+    totalSteps: int = 1000
+    endValue: float = 0.0
+
+    def value_at(self, t):
+        warm = self.peakValue * t / jnp.maximum(self.warmupSteps, 1)
+        frac = (t - self.warmupSteps) / jnp.maximum(self.totalSteps - self.warmupSteps, 1)
+        decay = self.peakValue + (self.endValue - self.peakValue) * jnp.clip(frac, 0.0, 1.0)
+        return jnp.where(t < self.warmupSteps, warm, decay)
+
+
+_ALL = {c.__name__: c for c in [
+    FixedSchedule, StepSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
+    SigmoidSchedule, MapSchedule, WarmupLinearDecaySchedule]}
+
+
+def from_dict(d: dict) -> Schedule:
+    d = dict(d)
+    cls = _ALL[d.pop("@type")]
+    return cls(**d)

@@ -1,0 +1,166 @@
+"""Updaters / optimizers (ref: org.nd4j.linalg.learning.config.* dataclasses +
+org.nd4j.linalg.learning.*Updater fused-update implementations).
+
+Each updater is a JSON-serializable dataclass that lowers to an
+``optax.GradientTransformation``. The reference applies updates via fused
+native ops over UpdaterBlocks of the flat param vector; here the whole update
+is part of the single jitted train step, so XLA fuses across ALL params —
+strictly stronger than per-block fusion.
+
+Learning rates accept a float or a Schedule (train/schedules.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Union
+
+import optax
+
+from deeplearning4j_tpu.train import schedules as _sched
+
+LrType = Union[float, _sched.Schedule]
+
+
+def _lr(lr: LrType, iterations_per_epoch=1):
+    if isinstance(lr, _sched.Schedule):
+        return lr.to_fn(iterations_per_epoch)
+    return lr
+
+
+@dataclass
+class Updater:
+    def to_optax(self, iterations_per_epoch: int = 1) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = {"@type": type(self).__name__}
+        for k, v in self.__dict__.items():
+            d[k] = v.to_dict() if isinstance(v, _sched.Schedule) else v
+        return d
+
+    @property
+    def learningRate(self):
+        return getattr(self, "lr", None)
+
+
+@dataclass
+class Sgd(Updater):
+    lr: LrType = 1e-3
+
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.sgd(_lr(self.lr, iterations_per_epoch))
+
+
+@dataclass
+class Nesterovs(Updater):
+    lr: LrType = 0.1
+    momentum: float = 0.9
+
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.sgd(_lr(self.lr, iterations_per_epoch), momentum=self.momentum, nesterov=True)
+
+
+@dataclass
+class Adam(Updater):
+    lr: LrType = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.adam(_lr(self.lr, iterations_per_epoch), b1=self.beta1, b2=self.beta2,
+                          eps=self.epsilon)
+
+
+@dataclass
+class AdamW(Adam):
+    """TPU-native addition (the reference models weight decay via
+    regularization instead); the BERT fine-tune default."""
+    weightDecay: float = 0.01
+
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.adamw(_lr(self.lr, iterations_per_epoch), b1=self.beta1, b2=self.beta2,
+                           eps=self.epsilon, weight_decay=self.weightDecay)
+
+
+@dataclass
+class AdaMax(Updater):
+    lr: LrType = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.adamax(_lr(self.lr, iterations_per_epoch), b1=self.beta1, b2=self.beta2,
+                            eps=self.epsilon)
+
+
+@dataclass
+class Nadam(Updater):
+    lr: LrType = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.nadam(_lr(self.lr, iterations_per_epoch), b1=self.beta1, b2=self.beta2,
+                           eps=self.epsilon)
+
+
+@dataclass
+class AMSGrad(Updater):
+    lr: LrType = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.amsgrad(_lr(self.lr, iterations_per_epoch), b1=self.beta1, b2=self.beta2,
+                             eps=self.epsilon)
+
+
+@dataclass
+class AdaGrad(Updater):
+    lr: LrType = 0.1
+    epsilon: float = 1e-6
+
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.adagrad(_lr(self.lr, iterations_per_epoch), eps=self.epsilon)
+
+
+@dataclass
+class AdaDelta(Updater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.adadelta(learning_rate=1.0, rho=self.rho, eps=self.epsilon)
+
+
+@dataclass
+class RmsProp(Updater):
+    lr: LrType = 0.1
+    rmsDecay: float = 0.95
+    epsilon: float = 1e-8
+
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.rmsprop(_lr(self.lr, iterations_per_epoch), decay=self.rmsDecay,
+                             eps=self.epsilon)
+
+
+@dataclass
+class NoOp(Updater):
+    def to_optax(self, iterations_per_epoch=1):
+        return optax.set_to_zero()
+
+
+_ALL = {c.__name__: c for c in [
+    Sgd, Nesterovs, Adam, AdamW, AdaMax, Nadam, AMSGrad, AdaGrad, AdaDelta, RmsProp, NoOp]}
+
+
+def from_dict(d: dict) -> Updater:
+    d = dict(d)
+    cls = _ALL[d.pop("@type")]
+    if isinstance(d.get("lr"), dict):
+        d["lr"] = _sched.from_dict(d["lr"])
+    return cls(**d)

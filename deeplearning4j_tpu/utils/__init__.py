@@ -1,0 +1,2 @@
+"""Utilities (ref: org.deeplearning4j.util + nd4j-common)."""
+from deeplearning4j_tpu.utils import gradientcheck  # noqa: F401

@@ -1,0 +1,97 @@
+"""Gradient checking (ref: org.deeplearning4j.gradientcheck.GradientCheckUtil —
+"THE correctness backbone for every layer", SURVEY.md §4.1).
+
+Central-difference numerical gradients vs the analytic jax.grad gradients of
+the network's loss, per-parameter, in fp64 (run on CPU XLA — the gradient
+check tier forces x64 exactly as the reference forces global DOUBLE).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_gradients(net, x, y, epsilon: float = 1e-6, max_rel_error: float = 1e-3,
+                    min_abs_error: float = 1e-8, subset: Optional[int] = 128,
+                    seed: int = 12345, print_failures: bool = True) -> bool:
+    """Gradient-check a MultiLayerNetwork on a batch. Checks up to ``subset``
+    randomly-chosen parameters per layer (the reference checks all; subset
+    keeps CI fast — pass None to check everything)."""
+    x = jnp.asarray(x, dtype=jnp.float64)
+    y = jnp.asarray(y, dtype=jnp.float64)
+    params64 = jax.tree_util.tree_map(lambda p: p.astype(jnp.float64), net._params)
+    state = net._state
+
+    def loss_fn(params):
+        loss, _ = net._loss_for(params, state, x, y, None, None, None)
+        return loss
+
+    analytic = jax.grad(loss_fn)(params64)
+    flat_p, unravel = jax.flatten_util.ravel_pytree(params64)
+    flat_g, _ = jax.flatten_util.ravel_pytree(analytic)
+    n = flat_p.shape[0]
+    rng = np.random.default_rng(seed)
+    idxs = np.arange(n) if subset is None or subset >= n else rng.choice(n, subset, replace=False)
+
+    flat_np = np.asarray(flat_p)
+    failures = []
+    for i in idxs:
+        plus = flat_np.copy()
+        plus[i] += epsilon
+        minus = flat_np.copy()
+        minus[i] -= epsilon
+        f_plus = float(loss_fn(unravel(jnp.asarray(plus))))
+        f_minus = float(loss_fn(unravel(jnp.asarray(minus))))
+        numeric = (f_plus - f_minus) / (2 * epsilon)
+        a = float(flat_g[i])
+        abs_err = abs(a - numeric)
+        denom = max(abs(a), abs(numeric))
+        rel_err = abs_err / denom if denom > 0 else 0.0
+        if rel_err > max_rel_error and abs_err > min_abs_error:
+            failures.append((int(i), a, numeric, rel_err))
+
+    if failures and print_failures:
+        for i, a, numv, rel in failures[:20]:
+            print(f"  param[{i}]: analytic={a:.8g} numeric={numv:.8g} relErr={rel:.3g}")
+        print(f"GradientCheck FAILED: {len(failures)}/{len(idxs)} params exceed tolerance")
+    return not failures
+
+
+def check_function_gradients(fn, *args, epsilon: float = 1e-6, max_rel_error: float = 1e-3,
+                             min_abs_error: float = 1e-8, argnum: int = 0,
+                             subset: Optional[int] = 64, seed: int = 0,
+                             print_failures: bool = True) -> bool:
+    """Gradient-check an arbitrary scalar-valued jnp function in fp64 (the
+    OpValidation analog for single ops)."""
+    args = [jnp.asarray(a, dtype=jnp.float64) if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+            else jnp.asarray(a) for a in args]
+    target = args[argnum]
+    analytic = jax.grad(lambda t: fn(*args[:argnum], t, *args[argnum + 1:]))(target)
+    flat_t = np.asarray(target).ravel()
+    flat_g = np.asarray(analytic).ravel()
+    n = flat_t.size
+    rng = np.random.default_rng(seed)
+    idxs = np.arange(n) if subset is None or subset >= n else rng.choice(n, subset, replace=False)
+    failures = []
+    for i in idxs:
+        plus = flat_t.copy()
+        plus[i] += epsilon
+        minus = flat_t.copy()
+        minus[i] -= epsilon
+        shape = np.asarray(target).shape
+        fp = float(fn(*args[:argnum], jnp.asarray(plus.reshape(shape)), *args[argnum + 1:]))
+        fm = float(fn(*args[:argnum], jnp.asarray(minus.reshape(shape)), *args[argnum + 1:]))
+        numeric = (fp - fm) / (2 * epsilon)
+        a = float(flat_g[i])
+        abs_err = abs(a - numeric)
+        denom = max(abs(a), abs(numeric))
+        rel_err = abs_err / denom if denom > 0 else 0.0
+        if rel_err > max_rel_error and abs_err > min_abs_error:
+            failures.append((int(i), a, numeric, rel_err))
+    if failures and print_failures:
+        for i, a, numv, rel in failures[:20]:
+            print(f"  x[{i}]: analytic={a:.8g} numeric={numv:.8g} relErr={rel:.3g}")
+    return not failures

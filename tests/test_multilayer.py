@@ -1,0 +1,256 @@
+"""MultiLayerNetwork runtime tests: forward shapes, training convergence,
+gradient checks, flat-param surface, evaluation — the reference's
+MultiLayerTest + GradientCheckTests analog. The LeNet-MNIST case is BASELINE
+config #1's e2e slice."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, IrisDataSetIterator, ListDataSetIterator, MnistDataSetIterator
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization, Bidirectional, ConvolutionLayer, DenseLayer, DropoutLayer,
+    EmbeddingSequenceLayer, GlobalPoolingLayer, GravesLSTM, LastTimeStep, LSTM, OutputLayer,
+    RnnOutputLayer, SimpleRnn, SubsamplingLayer,
+)
+from deeplearning4j_tpu.train import Adam, Sgd
+from deeplearning4j_tpu.utils.gradientcheck import check_gradients
+
+from tests.test_nn_conf import lenet_conf
+
+
+class TestForward:
+    def test_lenet_shapes(self):
+        net = MultiLayerNetwork(lenet_conf()).init()
+        out = net.output(np.random.rand(4, 784).astype(np.float32))
+        assert out.shape == (4, 10)
+        np.testing.assert_allclose(out.toNumpy().sum(-1), np.ones(4), atol=1e-5)
+
+    def test_feed_forward_activations(self):
+        net = MultiLayerNetwork(lenet_conf()).init()
+        acts = net.feedForward(np.random.rand(2, 784).astype(np.float32))
+        assert len(acts) == 7  # input + 6 layers
+        assert acts[1].shape == (2, 20, 24, 24)
+        assert acts[2].shape == (2, 20, 12, 12)
+        assert acts[-1].shape == (2, 10)
+
+    def test_deterministic_init(self):
+        n1 = MultiLayerNetwork(lenet_conf()).init()
+        n2 = MultiLayerNetwork(lenet_conf()).init()
+        assert n1.params().equals(n2.params())
+
+    def test_rnn_pipeline_shapes(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).list()
+                .layer(EmbeddingSequenceLayer(nIn=50, nOut=8))
+                .layer(Bidirectional(fwd=LSTM(nOut=16)))
+                .layer(GlobalPoolingLayer(poolingType="MAX"))
+                .layer(OutputLayer(nOut=4, lossFunction="MCXENT"))
+                .setInputType(InputType.recurrent(50, 7))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        ids = np.random.randint(0, 50, size=(3, 7))
+        out = net.output(ids)
+        assert out.shape == (3, 4)
+
+
+class TestFlatParams:
+    def test_params_roundtrip(self):
+        net = MultiLayerNetwork(lenet_conf()).init()
+        flat = net.params()
+        assert flat.length() == net.numParams()
+        net2 = MultiLayerNetwork(lenet_conf()).init()
+        net2.setParams(flat)
+        assert net2.params().equals(flat)
+        x = np.random.rand(2, 784).astype(np.float32)
+        np.testing.assert_allclose(net.output(x).toNumpy(), net2.output(x).toNumpy(), atol=1e-6)
+
+    def test_num_params_lenet(self):
+        net = MultiLayerNetwork(lenet_conf()).init()
+        # standard LeNet param count with 500-unit dense: conv1 520, conv2 25050,
+        # dense 400500? -> (800*500 + 500) + (500*10+10)
+        expected = (20 * 1 * 25 + 20) + (50 * 20 * 25 + 50) + (800 * 500 + 500) + (500 * 10 + 10)
+        assert net.numParams() == expected
+
+
+class TestTraining:
+    def test_iris_mlp_converges(self):
+        it = IrisDataSetIterator(batch_size=32)
+        conf = (NeuralNetConfiguration.Builder().seed(42).updater(Adam(0.01))
+                .list()
+                .layer(DenseLayer(nIn=4, nOut=16, activation="RELU"))
+                .layer(OutputLayer(nIn=16, nOut=3, lossFunction="MCXENT"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=60)
+        ev = net.evaluate(IrisDataSetIterator(batch_size=150))
+        assert ev.accuracy() > 0.9, ev.stats()
+
+    def test_score_decreases(self):
+        x = np.random.rand(64, 10).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[np.random.randint(0, 4, 64)]
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1)).list()
+                .layer(DenseLayer(nIn=10, nOut=32, activation="TANH"))
+                .layer(OutputLayer(nIn=32, nOut=4, lossFunction="MCXENT"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y)
+        first = net.score()
+        net.fit(ListDataSetIterator([DataSet(x, y)]), epochs=30)
+        assert net.score() < first
+
+    def test_lenet_mnist_e2e(self):
+        """BASELINE config #1: LeNet on (synthetic-fallback) MNIST to >97% —
+        the minimum end-to-end slice (SURVEY.md §7.2)."""
+        train = MnistDataSetIterator(batch_size=64, train=True, num_examples=1024)
+        test = MnistDataSetIterator(batch_size=256, train=False, num_examples=512)
+        net = MultiLayerNetwork(lenet_conf()).init()
+        net.fit(train, epochs=3)
+        ev = net.evaluate(test)
+        assert ev.accuracy() > 0.97, ev.stats()
+
+    def test_rnn_classification_trains(self):
+        # two classes distinguished by sequence mean sign
+        rng = np.random.default_rng(3)
+        B, T = 128, 10
+        x = rng.normal(0, 1, (B, T, 4)).astype(np.float32)
+        labels = (x.mean(axis=(1, 2)) > 0).astype(int)
+        x[labels == 1] += 0.5
+        y = np.eye(2, dtype=np.float32)[labels]
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(0.01)).list()
+                .layer(LSTM(nIn=4, nOut=16))
+                .layer(LastTimeStep())
+                .layer(OutputLayer(nIn=16, nOut=2, lossFunction="MCXENT"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(ListDataSetIterator([DataSet(x, y)], batch_size=32), epochs=20)
+        pred = net.predict(x)
+        assert (pred == labels).mean() > 0.9
+
+    def test_rnn_output_layer_per_timestep(self):
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(0.01)).list()
+                .layer(SimpleRnn(nIn=3, nOut=8))
+                .layer(RnnOutputLayer(nIn=8, nOut=2, lossFunction="MCXENT"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.rand(4, 6, 3).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (4, 6, 2)
+        y = np.zeros((4, 6, 2), dtype=np.float32)
+        y[..., 0] = 1.0
+        net.fit(x, y)
+        assert np.isfinite(net.score())
+
+    def test_batchnorm_updates_running_stats(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.01)).list()
+                .layer(DenseLayer(nIn=5, nOut=8, activation="RELU"))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(nIn=8, nOut=2, lossFunction="MCXENT"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        before = np.asarray(net._state[1]["mean"]).copy()
+        x = np.random.rand(32, 5).astype(np.float32) + 3.0
+        y = np.eye(2, dtype=np.float32)[np.random.randint(0, 2, 32)]
+        net.fit(x, y)
+        after = np.asarray(net._state[1]["mean"])
+        assert not np.allclose(before, after)
+
+    def test_dropout_train_vs_infer(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).list()
+                .layer(DropoutLayer(dropOut=0.5))
+                .layer(OutputLayer(nIn=10, nOut=2, lossFunction="MCXENT"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.rand(8, 10).astype(np.float32)
+        o1 = net.output(x).toNumpy()
+        o2 = net.output(x).toNumpy()
+        np.testing.assert_allclose(o1, o2)  # inference deterministic
+
+
+class TestGradientChecks:
+    """(ref: GradientCheckTests / CNNGradientCheckTest / LSTMGradientCheckTests)"""
+
+    def _check(self, conf, x, y):
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, x, y, subset=96), "gradient check failed"
+
+    def test_mlp(self):
+        conf = (NeuralNetConfiguration.Builder().seed(12345).dataType("DOUBLE").list()
+                .layer(DenseLayer(nIn=4, nOut=8, activation="TANH"))
+                .layer(OutputLayer(nIn=8, nOut=3, lossFunction="MCXENT"))
+                .build())
+        x = np.random.rand(5, 4)
+        y = np.eye(3)[np.random.randint(0, 3, 5)]
+        self._check(conf, x, y)
+
+    def test_cnn(self):
+        conf = (NeuralNetConfiguration.Builder().seed(12345).dataType("DOUBLE").list()
+                .layer(ConvolutionLayer(nOut=3, kernelSize=(3, 3), activation="TANH"))
+                .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+                .layer(OutputLayer(nOut=2, lossFunction="MCXENT"))
+                .setInputType(InputType.convolutional(6, 6, 2))
+                .build())
+        x = np.random.rand(3, 2, 6, 6)
+        y = np.eye(2)[np.random.randint(0, 2, 3)]
+        self._check(conf, x, y)
+
+    def test_lstm(self):
+        conf = (NeuralNetConfiguration.Builder().seed(12345).dataType("DOUBLE").list()
+                .layer(LSTM(nIn=3, nOut=4, activation="TANH"))
+                .layer(RnnOutputLayer(nIn=4, nOut=2, lossFunction="MCXENT"))
+                .build())
+        x = np.random.rand(2, 5, 3)
+        y_idx = np.random.randint(0, 2, (2, 5))
+        y = np.eye(2)[y_idx]
+        self._check(conf, x, y)
+
+    def test_graves_lstm(self):
+        conf = (NeuralNetConfiguration.Builder().seed(12345).dataType("DOUBLE").list()
+                .layer(GravesLSTM(nIn=3, nOut=4))
+                .layer(GlobalPoolingLayer(poolingType="AVG"))
+                .layer(OutputLayer(nIn=4, nOut=2, lossFunction="MCXENT"))
+                .build())
+        x = np.random.rand(2, 4, 3)
+        y = np.eye(2)[np.random.randint(0, 2, 2)]
+        self._check(conf, x, y)
+
+    def test_batchnorm_mlp(self):
+        conf = (NeuralNetConfiguration.Builder().seed(12345).dataType("DOUBLE").list()
+                .layer(DenseLayer(nIn=4, nOut=6, activation="TANH"))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(nIn=6, nOut=2, lossFunction="MCXENT"))
+                .build())
+        x = np.random.rand(8, 4)
+        y = np.eye(2)[np.random.randint(0, 2, 8)]
+        self._check(conf, x, y)
+
+    def test_l2_regularization_gradient(self):
+        conf = (NeuralNetConfiguration.Builder().seed(12345).dataType("DOUBLE").l2(0.01).list()
+                .layer(DenseLayer(nIn=4, nOut=6, activation="SIGMOID"))
+                .layer(OutputLayer(nIn=6, nOut=2, lossFunction="MSE", activation="IDENTITY"))
+                .build())
+        x = np.random.rand(5, 4)
+        y = np.random.rand(5, 2)
+        self._check(conf, x, y)
+
+
+class TestEvaluationIntegration:
+    def test_evaluation_metrics(self):
+        from deeplearning4j_tpu.eval import Evaluation
+        ev = Evaluation(num_classes=2)
+        ev.eval(np.array([[1, 0], [0, 1], [1, 0], [0, 1]]),
+                np.array([[0.9, 0.1], [0.2, 0.8], [0.4, 0.6], [0.3, 0.7]]))
+        assert ev.accuracy() == 0.75
+        assert ev.confusionMatrix().tolist() == [[1, 1], [0, 2]]
+
+    def test_roc_auc(self):
+        from deeplearning4j_tpu.eval import ROC
+        roc = ROC()
+        roc.eval(np.array([1, 1, 0, 0]), np.array([0.9, 0.8, 0.2, 0.1]))
+        assert roc.calculateAUC() == 1.0
+
+    def test_regression_eval(self):
+        from deeplearning4j_tpu.eval import RegressionEvaluation
+        rev = RegressionEvaluation()
+        y = np.random.rand(50, 2)
+        rev.eval(y, y + 0.1)
+        assert abs(rev.meanAbsoluteError() - 0.1) < 1e-6

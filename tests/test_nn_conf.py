@@ -1,0 +1,87 @@
+"""Config DSL tests (ref: dl4j MultiLayerConfiguration serde + InputType
+shape-inference tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import InputType, MultiLayerConfiguration, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, Bidirectional, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingSequenceLayer, GlobalPoolingLayer, GravesLSTM, LastTimeStep, LSTM,
+    OutputLayer, RnnOutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.train import Adam, Nesterovs, StepSchedule
+
+
+def lenet_conf():
+    return (NeuralNetConfiguration.Builder()
+            .seed(12345)
+            .updater(Adam(1e-3))
+            .weightInit("XAVIER")
+            .list()
+            .layer(ConvolutionLayer(nOut=20, kernelSize=(5, 5), stride=(1, 1), activation="RELU"))
+            .layer(SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(nOut=50, kernelSize=(5, 5), stride=(1, 1), activation="RELU"))
+            .layer(SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(nOut=500, activation="RELU"))
+            .layer(OutputLayer(nOut=10, lossFunction="MCXENT", activation="SOFTMAX"))
+            .setInputType(InputType.convolutionalFlat(28, 28, 1))
+            .build())
+
+
+class TestBuilder:
+    def test_nin_autofill(self):
+        conf = lenet_conf()
+        assert conf.layers[0].nIn == 1
+        assert conf.layers[2].nIn == 20
+        # 28x28 -> conv5 valid -> 24 -> pool2 -> 12 -> conv5 -> 8 -> pool2 -> 4
+        assert conf.layers[4].nIn == 50 * 4 * 4
+        assert conf.layers[5].nIn == 500
+
+    def test_global_inheritance(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .activation("TANH").weightInit("RELU").dropOut(0.8)
+                .list()
+                .layer(DenseLayer(nIn=4, nOut=3))
+                .layer(OutputLayer(nIn=3, nOut=2, lossFunction="MCXENT"))
+                .build())
+        assert conf.layers[0].activation == "TANH"
+        assert conf.layers[0].weightInit == "RELU"
+        assert conf.layers[0].dropOut == 0.8
+        # output layer keeps its loss-implied softmax default? it inherits TANH
+        # only if unset; MCXENT post_init set SOFTMAX already
+        assert conf.layers[1].activation == "SOFTMAX"
+
+    def test_shape_inference_rnn(self):
+        conf = (NeuralNetConfiguration.Builder().list()
+                .layer(EmbeddingSequenceLayer(nIn=100, nOut=16))
+                .layer(LSTM(nOut=32))
+                .layer(RnnOutputLayer(nOut=5, lossFunction="MCXENT"))
+                .setInputType(InputType.recurrent(100, 12))
+                .build())
+        assert conf.layers[1].nIn == 16
+        assert conf.layers[2].nIn == 32
+
+    def test_json_roundtrip(self):
+        conf = lenet_conf()
+        js = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        assert conf2.to_json() == js
+        assert len(conf2.layers) == len(conf.layers)
+        assert conf2.layers[0].kernelSize == (5, 5)
+        assert isinstance(conf2.updater, Adam)
+        assert conf2.seed == 12345
+
+    def test_json_roundtrip_schedule_and_wrappers(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .updater(Nesterovs(StepSchedule(initialValue=0.1, decayRate=0.5, step=100), 0.9))
+                .list()
+                .layer(Bidirectional(fwd=LSTM(nIn=8, nOut=16)))
+                .layer(GlobalPoolingLayer(poolingType="MAX"))
+                .layer(OutputLayer(nIn=32, nOut=3, lossFunction="MCXENT"))
+                .build())
+        js = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        assert conf2.to_json() == js
+        assert isinstance(conf2.layers[0], Bidirectional)
+        assert isinstance(conf2.layers[0].fwd, LSTM)
+        assert isinstance(conf2.updater.lr, StepSchedule)
