@@ -1,0 +1,4 @@
+"""Declarative autodiff graph engine (ref: org.nd4j.autodiff.samediff)."""
+from deeplearning4j_tpu.autodiff.samediff import (  # noqa: F401
+    SameDiff, SDVariable, SameDiffOp, TrainingConfig, VariableType,
+)

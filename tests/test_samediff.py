@@ -1,0 +1,164 @@
+"""SameDiff graph engine tests (ref: SameDiffTests / SameDiffTrainingTest in
+nd4j platform-tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig, VariableType
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.train import Adam, Sgd
+
+
+class TestGraphBuild:
+    def test_basic_math(self):
+        sd = SameDiff.create()
+        a = sd.constant("a", np.array([1.0, 2.0]))
+        b = sd.constant("b", np.array([3.0, 4.0]))
+        c = a + b
+        out = c.eval()
+        np.testing.assert_allclose(out.toNumpy(), [4, 6])
+
+    def test_chained_ops_single_graph(self):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 3))
+        w = sd.var("w", np.ones((3, 2), np.float32))
+        b = sd.var("b", np.zeros((2,), np.float32))
+        z = x.mmul(w) + b
+        y = sd.math.tanh(z).rename("y")
+        out = sd.output({"x": np.array([[1.0, 2.0, 3.0]], np.float32)}, "y")["y"]
+        np.testing.assert_allclose(out.toNumpy(), np.tanh([[6.0, 6.0]]), rtol=1e-6)
+
+    def test_variable_types(self):
+        sd = SameDiff.create()
+        v = sd.var("v", np.zeros((2, 2)))
+        c = sd.constant("c", 1.0)
+        p = sd.placeHolder("p", shape=(2, 2))
+        assert v.varType == VariableType.VARIABLE
+        assert c.varType == VariableType.CONSTANT
+        assert p.varType == VariableType.PLACEHOLDER
+
+    def test_namespaces_and_reductions(self):
+        sd = SameDiff.create()
+        x = sd.constant("x", np.array([[1.0, 2.0], [3.0, 4.0]]))
+        s = x.sum(1)
+        m = sd.reduce.mean(x)
+        np.testing.assert_allclose(s.eval().toNumpy(), [3, 7])
+        assert float(m.eval().toNumpy()) == 2.5
+
+    def test_multi_output_op(self):
+        sd = SameDiff.create()
+        B, T, I, H = 2, 3, 4, 5
+        x = sd.placeHolder("x", shape=(B, T, I))
+        h0 = sd.constant("h0", np.zeros((B, H), np.float32))
+        c0 = sd.constant("c0", np.zeros((B, H), np.float32))
+        w = sd.var("w", np.random.randn(I, 4 * H).astype(np.float32) * 0.1)
+        rw = sd.var("rw", np.random.randn(H, 4 * H).astype(np.float32) * 0.1)
+        b = sd.var("b", np.zeros((4 * H,), np.float32))
+        ys, (hT, cT) = sd.rnn.lstmLayer(x, h0, c0, w, rw, b)
+        out = ys.eval({"x": np.random.rand(B, T, I).astype(np.float32)})
+        assert out.shape == (B, T, H)
+        assert hT.eval({"x": np.random.rand(B, T, I).astype(np.float32)}).shape == (B, H)
+
+
+class TestGradients:
+    def test_calculate_gradients(self):
+        sd = SameDiff.create()
+        w = sd.var("w", np.array([2.0, 3.0]))
+        loss = (w * w).sum().rename("loss")
+        sd.setLossVariables("loss")
+        grads = sd.calculateGradients({}, ["w"])
+        np.testing.assert_allclose(grads["w"].toNumpy(), [4.0, 6.0])
+        assert sd.getVariable("w").gradient() is not None
+
+    def test_grad_through_placeholder_graph(self):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 2))
+        w = sd.var("w", np.ones((2, 1), np.float32))
+        out = sd.math.tanh(x.mmul(w))
+        loss = (out * out).sum().rename("loss")
+        sd.setLossVariables("loss")
+        g = sd.calculateGradients({"x": np.array([[0.5, 0.5]], np.float32)}, ["w"])
+        assert g["w"].shape == (2, 1)
+        assert np.isfinite(g["w"].toNumpy()).all()
+
+
+class TestTraining:
+    def test_linear_regression(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(256, 3)).astype(np.float32)
+        true_w = np.array([[1.5], [-2.0], [0.5]], np.float32)
+        Y = X @ true_w + 0.3
+
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 3))
+        y = sd.placeHolder("y", shape=(None, 1))
+        w = sd.var("w", np.zeros((3, 1), np.float32))
+        b = sd.var("b", np.zeros((1,), np.float32))
+        pred = x.mmul(w) + b
+        loss = sd.loss.mse(y, pred).rename("loss")
+        sd.setLossVariables("loss")
+        sd.setTrainingConfig(TrainingConfig(updater=Adam(0.1),
+                                            dataSetFeatureMapping=["x"],
+                                            dataSetLabelMapping=["y"]))
+        ds = DataSet(X, Y)
+        history = sd.fit(ListDataSetIterator([ds], batch_size=64), epochs=50)
+        assert history[-1] < 0.01
+        np.testing.assert_allclose(sd.getVariable("w").getArr().toNumpy(), true_w, atol=0.1)
+        np.testing.assert_allclose(float(sd.getVariable("b").getArr().toNumpy()[0]), 0.3, atol=0.1)
+
+    def test_softmax_classifier(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        labels = (X[:, 0] + X[:, 1] > 0).astype(int)
+        Y = np.eye(2, dtype=np.float32)[labels]
+
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 4))
+        y = sd.placeHolder("y", shape=(None, 2))
+        w = sd.var("w", (4, 2), weightInit="XAVIER", seed=7)
+        b = sd.var("b", np.zeros((2,), np.float32))
+        logits = x.mmul(w) + b
+        probs = sd.nn.softmax(logits).rename("probs")
+        loss = sd.loss.mcxent(y, probs).rename("loss")
+        sd.setLossVariables("loss")
+        sd.setTrainingConfig(TrainingConfig(updater=Adam(0.05),
+                                            dataSetFeatureMapping=["x"],
+                                            dataSetLabelMapping=["y"]))
+        sd.fit(DataSet(X, Y), epochs=100)
+        pred = sd.output({"x": X}, "probs")["probs"].toNumpy().argmax(-1)
+        assert (pred == labels).mean() > 0.95
+
+    def test_regularization_in_training(self):
+        sd = SameDiff.create()
+        w = sd.var("w", np.array([10.0], np.float32))
+        loss = (w * w).sum().rename("loss")
+        sd.setLossVariables("loss")
+        from deeplearning4j_tpu.train import L2
+        sd.setTrainingConfig(TrainingConfig(updater=Sgd(0.1), regularization=[L2(0.1)]))
+        sd.fit({}, epochs=1)  # single empty-placeholder batch
+        # dict input path: data={} means one batch with no placeholders
+        assert float(sd.getVariable("w").getArr().toNumpy()[0]) < 10.0
+
+
+class TestPersistence:
+    def test_save_load_roundtrip(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 3))
+        w = sd.var("w", np.random.rand(3, 2).astype(np.float32))
+        b = sd.var("b", np.zeros((2,), np.float32))
+        out = sd.math.tanh(x.mmul(w) + b).rename("out")
+
+        path = str(tmp_path / "model.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+
+        xv = np.random.rand(4, 3).astype(np.float32)
+        o1 = sd.output({"x": xv}, "out")["out"].toNumpy()
+        o2 = sd2.output({"x": xv}, "out")["out"].toNumpy()
+        np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+    def test_batch_output_builder(self):
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 2))
+        y = sd.math.exp(x).rename("y")
+        out = sd.batchOutput().input("x", np.zeros((1, 2), np.float32)).output("y").execSingle()
+        np.testing.assert_allclose(out.toNumpy(), [[1.0, 1.0]])
