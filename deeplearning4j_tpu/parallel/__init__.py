@@ -1,0 +1,14 @@
+"""Distributed training: device-mesh DP/TP/SP over XLA collectives
+(ref: deeplearning4j-scaleout + nd4j-parameter-server — superseded, SURVEY §2.9/§2.10)."""
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS, PIPE_AXIS, make_mesh, replicate, shard_batch,
+)
+from deeplearning4j_tpu.parallel.data_parallel import ParallelInference, ParallelWrapper  # noqa: F401
+from deeplearning4j_tpu.parallel.sequence_parallel import (  # noqa: F401
+    reference_attention, ring_attention, ring_self_attention, ulysses_attention,
+)
+from deeplearning4j_tpu.parallel.gradient_sharing import (  # noqa: F401
+    AdaptiveThresholdAlgorithm, gradient_compression, int8_compression,
+    threshold_decode, threshold_encode,
+)
+from deeplearning4j_tpu.parallel import multihost  # noqa: F401

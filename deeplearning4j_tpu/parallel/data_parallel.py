@@ -1,0 +1,168 @@
+"""Data-parallel training & inference (ref: deeplearning4j-parallel-wrapper
+ParallelWrapper / ParallelInference, SURVEY.md §2.9 P2/P3/P7 and §3.4).
+
+The reference spawns one thread + model replica per device, round-robins
+batches, and periodically averages parameters (or asynchronously shares
+threshold-encoded gradients). Here the whole mechanism collapses into sharded
+jit: parameters live replicated on a Mesh, batches are sharded over the
+``data`` axis, and XLA's SPMD partitioner emits the psum gradient sync inside
+the *same* fused step — exact lockstep DP, semantically the reference's
+averagingFrequency=1 (strictly stronger than both its modes; the async
+staleness of gradient sharing is deliberately NOT reproduced — see
+gradient_sharing.py for the compression-hook parity).
+
+Multi-host: identical code — initialize jax.distributed (see multihost.py) and
+the same Mesh spans all hosts' devices; ICI collectives within a slice, DCN
+across slices, still zero framework networking code.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.ndarray.array import NDArray
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+
+class ParallelWrapper:
+    """Data-parallel trainer for a MultiLayerNetwork (ref: ParallelWrapper.Builder
+    surface: workers(n) ≙ mesh size; averaging/gradient-sharing modes are both
+    subsumed by exact per-step psum)."""
+
+    def __init__(self, model, mesh: Optional[Mesh] = None, workers: Optional[int] = None):
+        self.model = model
+        if mesh is None:
+            devs = jax.devices()
+            if workers is not None:
+                devs = devs[:workers]
+            mesh = make_mesh({DATA_AXIS: len(devs)}, devs)
+        self.mesh = mesh
+        self._n = mesh.shape[DATA_AXIS]
+        self._placed = False
+
+    class Builder:
+        """Fluent parity shim (ref: ParallelWrapper.Builder)."""
+
+        def __init__(self, model):
+            self._model = model
+            self._workers = None
+
+        def workers(self, n: int):
+            self._workers = n
+            return self
+
+        def averagingFrequency(self, n: int):
+            return self  # subsumed: exact sync every step
+
+        def prefetchBuffer(self, n: int):
+            return self  # jax async dispatch already overlaps host/device
+
+        def trainingMode(self, mode: str):
+            return self  # AVERAGING and SHARED_GRADIENTS both -> exact psum
+
+        def build(self) -> "ParallelWrapper":
+            return ParallelWrapper(self._model, workers=self._workers)
+
+    # ------------------------------------------------------------------ fit
+    def _place_params(self):
+        rep = NamedSharding(self.mesh, P())
+        m = self.model
+        m._params = jax.tree_util.tree_map(lambda a: jax.device_put(a, rep), m._params)
+        m._state = jax.tree_util.tree_map(lambda a: jax.device_put(a, rep), m._state)
+        m._opt_state = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, rep) if isinstance(a, jax.Array) else a, m._opt_state)
+        self._placed = True
+
+    def _shard_batch(self, arr):
+        arr = np.asarray(arr)
+        n = self._n
+        b = arr.shape[0]
+        if b % n:  # pad final partial batch by repeating (reference drops/round-robins)
+            pad = n - (b % n)
+            arr = np.concatenate([arr, arr[:pad]], axis=0)
+        spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def fit(self, data, epochs: int = 1):
+        """Sharded lockstep DP fit (ref: ParallelWrapper.fit)."""
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        if not self._placed:
+            self._place_params()
+        m = self.model
+        step = m._get_jitted("step")
+        with self.mesh:
+            for _ in range(epochs):
+                for ds in data:
+                    x = self._shard_batch(ds.features)
+                    y = self._shard_batch(ds.labels)
+                    fmask = self._shard_batch(ds.features_mask) if ds.features_mask is not None else None
+                    lmask = self._shard_batch(ds.labels_mask) if ds.labels_mask is not None else None
+                    m._rng_key, sub = jax.random.split(m._rng_key)
+                    m._params, m._state, m._opt_state, loss = step(
+                        m._params, m._state, m._opt_state, x, y, sub, fmask, lmask)
+                    m._score = float(loss)
+                    m._iteration += 1
+                    for lst in m.listeners:
+                        lst.iterationDone(m, m._iteration, m._epoch)
+                m._epoch += 1
+        return self.model
+
+    def shutdown(self):
+        pass  # no worker threads to stop — parity no-op
+
+
+class ParallelInference:
+    """Sharded batch inference (ref: deeplearning4j-parallel-wrapper
+    ParallelInference: per-device replicas + dynamic batching observables).
+    Here: one replicated jit executable; arbitrary batches are padded, sharded
+    over the data axis, and de-padded — XLA splits the work across devices."""
+
+    def __init__(self, model, mesh: Optional[Mesh] = None, workers: Optional[int] = None,
+                 batchLimit: int = 0):
+        self.model = model
+        if mesh is None:
+            devs = jax.devices()
+            if workers is not None:
+                devs = devs[:workers]
+            mesh = make_mesh({DATA_AXIS: len(devs)}, devs)
+        self.mesh = mesh
+        self._n = mesh.shape[DATA_AXIS]
+
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._workers = None
+
+        def workers(self, n: int):
+            self._workers = n
+            return self
+
+        def batchLimit(self, n: int):
+            return self
+
+        def inferenceMode(self, mode: str):
+            return self
+
+        def build(self) -> "ParallelInference":
+            return ParallelInference(self._model, workers=self._workers)
+
+    def output(self, x) -> NDArray:
+        arr = np.asarray(x)
+        b = arr.shape[0]
+        n = self._n
+        padded = b
+        if b % n:
+            pad = n - (b % n)
+            arr = np.concatenate([arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)], axis=0)
+            padded = arr.shape[0]
+        spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
+        xs = jax.device_put(arr, NamedSharding(self.mesh, spec))
+        with self.mesh:
+            out = self.model.output(xs)
+        return NDArray(out.jax[:b]) if padded != b else out

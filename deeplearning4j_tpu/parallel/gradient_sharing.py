@@ -1,0 +1,107 @@
+"""Gradient compression — threshold encoding with residual carry (ref:
+o.d.optimize.solvers.accumulation.EncodedGradientsAccumulator + encoding.
+ThresholdAlgorithm impls + libnd4j generic/compression/threshold.cpp,
+SURVEY.md §2.4 'Gradient sharing plumbing' / §2.9 P3/P5).
+
+The reference's 1-bit-style compressed async DP: |Δw| ≥ threshold entries are
+sent as sparse int messages over Aeron, the remainder accumulates locally as
+residual. On TPU, dense psum over ICI is cheaper than any sparse encode, so
+the DEFAULT DP path (data_parallel.py) doesn't compress. This module keeps the
+reference's *semantics* available as an optional optax hook for DCN-limited
+cross-slice setups:
+
+- ``threshold_encode/decode``     — the native op pair, as pure jnp
+- ``AdaptiveThresholdAlgorithm``  — dl4j's target-sparsity threshold adaptation
+- ``gradient_compression()``      — optax transform: residual += grad;
+  sent = quantize(residual); residual -= sent — applied before the updater,
+  inside the same jitted step (lockstep, not async; the reference's staleness
+  is deliberately not reproduced — documented divergence)
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def threshold_encode(grad: jax.Array, threshold) -> jax.Array:
+    """Quantize to {-t, 0, +t} (ref: encodeThreshold). Returns the dense
+    quantized tensor — the wire-format sparse int encoding is an IO concern
+    XLA collectives don't need; the *information content* matches."""
+    return jnp.where(jnp.abs(grad) >= threshold, jnp.sign(grad) * threshold, 0.0)
+
+
+def threshold_decode(encoded: jax.Array) -> jax.Array:
+    """(ref: decodeThreshold — scatter-add of sparse updates). With the dense
+    carrier this is the identity; kept for API parity."""
+    return encoded
+
+
+class ThresholdState(NamedTuple):
+    residual: optax.Params
+    threshold: jax.Array
+
+
+class AdaptiveThresholdAlgorithm:
+    """(ref: encoding.threshold.AdaptiveThresholdAlgorithm): adapt the
+    threshold toward a target sparsity ratio of transmitted entries."""
+
+    def __init__(self, initial: float = 1e-3, min_t: float = 1e-5, max_t: float = 1.0,
+                 target_sparsity: float = 1e-3, decay: float = 1.05):
+        self.initial = initial
+        self.min_t = min_t
+        self.max_t = max_t
+        self.target = target_sparsity
+        self.decay = decay
+
+    def update(self, threshold, sent_fraction):
+        t = jnp.where(sent_fraction > self.target, threshold * self.decay,
+                      threshold / self.decay)
+        return jnp.clip(t, self.min_t, self.max_t)
+
+
+def gradient_compression(algorithm: Optional[AdaptiveThresholdAlgorithm] = None,
+                         initial_threshold: float = 1e-3) -> optax.GradientTransformation:
+    """Optax transform reproducing EncodedGradientsAccumulator.storeUpdate
+    semantics: residual accumulation + threshold quantization, adaptive
+    threshold. Chain before an updater: optax.chain(gradient_compression(), adam)."""
+    algo = algorithm or AdaptiveThresholdAlgorithm(initial=initial_threshold)
+
+    def init(params):
+        return ThresholdState(
+            residual=jax.tree_util.tree_map(jnp.zeros_like, params),
+            threshold=jnp.asarray(algo.initial, dtype=jnp.float32),
+        )
+
+    def update(grads, state, params=None):
+        acc = jax.tree_util.tree_map(lambda r, g: r + g, state.residual, grads)
+        sent = jax.tree_util.tree_map(lambda a: threshold_encode(a, state.threshold), acc)
+        residual = jax.tree_util.tree_map(lambda a, s: a - s, acc, sent)
+        total = sum(jnp.size(l) for l in jax.tree_util.tree_leaves(sent))
+        nonzero = sum(jnp.sum(l != 0) for l in jax.tree_util.tree_leaves(sent))
+        frac = nonzero / max(total, 1)
+        new_t = algo.update(state.threshold, frac)
+        return sent, ThresholdState(residual=residual, threshold=new_t)
+
+    return optax.GradientTransformation(init, update)
+
+
+def int8_compression(scale_by_norm: bool = True) -> optax.GradientTransformation:
+    """TPU-native alternative for DCN cross-slice traffic: symmetric int8
+    quantization with per-tensor scale (dense, collective-friendly — unlike
+    sparse threshold messages). No reference equivalent; provided as the
+    idiomatic replacement recommended in SURVEY.md §2.9 P3."""
+
+    def init(params):
+        return ()
+
+    def update(grads, state, params=None):
+        def q(g):
+            scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-12) / 127.0
+            return jnp.round(g / scale).astype(jnp.int8).astype(g.dtype) * scale
+
+        return jax.tree_util.tree_map(q, grads), state
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,67 @@
+"""Device mesh management (supersedes ref: ParallelWrapper device pinning via
+AffinityManager + MeshOrganizer's k-ary UDP mesh topology, SURVEY.md §2.9/§2.10).
+
+The reference builds a *network* mesh of JVM processes and moves gradients
+through user-space UDP. On TPU the mesh is the **hardware**: a
+jax.sharding.Mesh over the slice's devices, with XLA emitting ICI collectives.
+Axis vocabulary used across this framework:
+
+- ``data``    — data parallelism (batch sharding; psum grad sync)
+- ``model``   — tensor parallelism (weight sharding; all-gather/reduce-scatter)
+- ``context`` — sequence/context parallelism (ring attention over seq axis)
+- ``pipe``    — reserved for pipeline stages (not used by the reference's nets)
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+CONTEXT_AXIS = "context"
+PIPE_AXIS = "pipe"
+
+
+def make_mesh(shape: Optional[dict] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """Create a Mesh. ``shape`` maps axis name -> size, e.g.
+    {'data': 4, 'model': 2}; axes multiply to len(devices). Default: all
+    devices on the 'data' axis (pure DP — the reference's only mode)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not shape:
+        shape = {DATA_AXIS: len(devices)}
+    names = tuple(shape.keys())
+    sizes = tuple(shape.values())
+    n = int(np.prod(sizes))
+    if n < len(devices):
+        devices = devices[:n]
+    if n != len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS, rank: int = 2) -> NamedSharding:
+    """Shard dim 0 (batch) over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (rank - 1))))
+
+
+def shard_batch(mesh: Mesh, tree, axis: str = DATA_AXIS):
+    """Place each array in the pytree with batch dim sharded over ``axis``."""
+    def place(x):
+        return jax.device_put(x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1)))))
+    return jax.tree_util.tree_map(place, tree)
+
+
+def replicate(mesh: Mesh, tree):
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, replicated(mesh)), tree)
+
+
+def local_mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)

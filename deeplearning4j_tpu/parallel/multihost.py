@@ -1,0 +1,64 @@
+"""Multi-host distributed runtime (ref: nd4j-parameter-server-parent —
+VoidParameterServer, MeshOrganizer, AeronUdpTransport, chunked messages,
+SURVEY.md §2.10 — all ~40k LoC of user-space networking DELETED by design).
+
+The control plane is jax.distributed (gRPC): process membership, device
+discovery, barrier. The data plane is compiler-emitted collectives: a Mesh
+spanning every host's devices makes psum/all_gather ride ICI within a slice
+and DCN across slices. Nothing else to build — this module is the thin init
+shim plus the health/elasticity conventions (checkpoint-restart recovery, ref
+§5.3: the reference has no true elasticity either).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None, num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """Join the multi-host job (ref: VoidParameterServer.init + MeshOrganizer
+    node join — replaced by jax.distributed.initialize). Reads the standard
+    env (COORDINATOR_ADDRESS, NUM_PROCESSES, PROCESS_ID) when args are None;
+    no-op when single-process."""
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return False
+    if process_id is None:
+        process_id = int(os.environ.get("PROCESS_ID", "0"))
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes, process_id=process_id)
+    return True
+
+
+def global_device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier", timeout_s: int = 600):
+    """Host-level barrier via a tiny psum across all devices (control-plane
+    sync; ref: parameter-server handshake/heartbeat round)."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = jax.devices()
+    mesh = Mesh(devs, ("all",))
+    x = jnp.ones((len(devs),))
+    y = jax.jit(lambda a: a.sum(),
+                in_shardings=NamedSharding(mesh, P("all")))(x)
+    return float(y)

@@ -1,0 +1,128 @@
+"""Sequence / context parallelism — ring attention and Ulysses-style
+all-to-all attention.
+
+No reference equivalent exists (SURVEY.md §5.7: the reference predates context
+parallelism; long sequences get truncated-BPTT only). This is the TPU-native
+*extension* the rebuild treats as first-class: attention over sequences sharded
+across a ``context`` mesh axis, K/V blocks rotating over ICI via ppermute with
+online-softmax accumulation (ring attention), or head-resharding via all_to_all
+(Ulysses). Both compose with data/tensor parallelism through shard_map.
+
+Public entry points:
+- ``ring_attention(q, k, v, axis_name, causal)``     — call inside shard_map
+- ``ulysses_attention(q, k, v, axis_name, causal)``  — call inside shard_map
+- ``ring_self_attention(mesh, q, k, v, ...)``        — whole-array convenience
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from deeplearning4j_tpu.parallel.mesh import CONTEXT_AXIS
+
+
+def _block_attn_update(q, k, v, m, l, o, scale, mask=None):
+    """One online-softmax block update (flash-attention accumulation).
+    q: (B,H,Tq,D), k/v: (B,H,Tk,D); m/l: (B,H,Tq,1); o: (B,H,Tq,D)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_blk)
+    # guard: fully-masked block rows produce -inf max -> exp(nan); clamp
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = alpha * o + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str = CONTEXT_AXIS, causal: bool = False):
+    """Ring attention over a sharded sequence axis. Call INSIDE shard_map with
+    q,k,v local blocks of shape (B, H, T_local, D); the global sequence is
+    axis_size * T_local. K/V blocks rotate around the ring (ppermute over ICI)
+    while each device accumulates its queries' attention online — O(T_local)
+    memory per device, exact full-attention result."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=q.dtype))
+
+    q_pos = my_idx * T + jnp.arange(T)
+
+    def body(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        kv_idx = (my_idx - i) % axis_size  # block currently held
+        if causal:
+            k_pos = kv_idx * T + jnp.arange(T)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            mask = mask[None, None, :, :]
+        else:
+            mask = None
+        m, l, o = _block_attn_update(q, k_blk, v_blk, m, l, o, scale, mask)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return o, l, m, k_blk, v_blk
+
+    o0 = jnp.zeros_like(q)
+    l0 = jnp.zeros((B, H, T, 1), dtype=q.dtype)
+    m0 = jnp.full((B, H, T, 1), -jnp.inf, dtype=q.dtype)
+    o, l, m, _, _ = lax.fori_loop(0, axis_size, body, (o0, l0, m0, k, v))
+    return o / jnp.maximum(l, 1e-30)
+
+
+def ulysses_attention(q, k, v, axis_name: str = CONTEXT_AXIS, causal: bool = False):
+    """All-to-all ("Ulysses") sequence parallelism: reshard from
+    sequence-sharded to head-sharded via all_to_all, run full attention on the
+    complete sequence for the local head subset, reshard back. Requires
+    num_heads % axis_size == 0. Call INSIDE shard_map with (B, H, T_local, D)."""
+    axis_size = lax.psum(1, axis_name)
+    # (B,H,T_local,D) -> gather seq, scatter heads -> (B,H_local,T,D)
+    q = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    D = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=q.dtype))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    # back: gather heads, scatter seq
+    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ring_self_attention(mesh: Mesh, q, k, v, causal: bool = False,
+                        axis_name: str = CONTEXT_AXIS, impl: str = "ring"):
+    """Whole-array convenience: q,k,v (B, H, T, D) with T divisible by the
+    context axis size; shard_maps the chosen implementation over the mesh."""
+    fn = ring_attention if impl == "ring" else ulysses_attention
+    spec = P(None, None, axis_name, None)
+    mapped = shard_map(
+        functools.partial(fn, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+    return mapped(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Single-device full attention — the numerics oracle for SP tests."""
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.asarray(D, dtype=q.dtype))
+    if causal:
+        T = q.shape[2]
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)

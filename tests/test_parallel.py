@@ -1,0 +1,136 @@
+"""Distributed tests on the virtual 8-device CPU mesh (the reference's
+Spark-local[N]/DummyTransport philosophy, SURVEY.md §4.2): DP parity vs
+single-device, ring/Ulysses attention vs the full-attention oracle, gradient
+compression semantics."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import (
+    ParallelInference, ParallelWrapper, make_mesh, reference_attention, ring_self_attention,
+)
+from deeplearning4j_tpu.parallel.gradient_sharing import (
+    AdaptiveThresholdAlgorithm, gradient_compression, threshold_encode,
+)
+from deeplearning4j_tpu.train import Sgd
+
+
+def mlp_conf(seed=7):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(nIn=6, nOut=16, activation="TANH"))
+            .layer(OutputLayer(nIn=16, nOut=3, lossFunction="MCXENT"))
+            .build())
+
+
+class TestMesh:
+    def test_make_mesh_axes(self):
+        mesh = make_mesh({"data": 4, "model": 2})
+        assert mesh.shape["data"] == 4
+        assert mesh.shape["model"] == 2
+
+    def test_default_all_data(self):
+        mesh = make_mesh()
+        assert mesh.shape["data"] == 8
+
+
+class TestDataParallel:
+    def test_dp_matches_single_device(self):
+        """Sharded-DP params after k steps == single-device params (exact
+        lockstep psum — the guarantee the reference's averaging only
+        approximates)."""
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 6)).astype(np.float32)
+        Y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        it = lambda: ListDataSetIterator([DataSet(X, Y)], batch_size=32)
+
+        single = MultiLayerNetwork(mlp_conf()).init()
+        single.fit(it(), epochs=3)
+
+        dp_net = MultiLayerNetwork(mlp_conf()).init()
+        pw = ParallelWrapper(dp_net, mesh=make_mesh({"data": 8}))
+        pw.fit(it(), epochs=3)
+
+        np.testing.assert_allclose(single.params().toNumpy(), dp_net.params().toNumpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_builder_parity_surface(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        pw = (ParallelWrapper.Builder(net).workers(4).averagingFrequency(5)
+              .prefetchBuffer(2).trainingMode("AVERAGING").build())
+        assert pw._n == 4
+
+    def test_parallel_inference(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        pi = ParallelInference.Builder(net).workers(8).build()
+        x = np.random.rand(13, 6).astype(np.float32)  # deliberately not divisible by 8
+        out = pi.output(x)
+        assert out.shape == (13, 3)
+        np.testing.assert_allclose(out.toNumpy(), net.output(x).toNumpy(), atol=1e-5)
+
+
+class TestSequenceParallel:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_matches_full_attention(self, causal, impl):
+        mesh = make_mesh({"context": 8})
+        B, H, T, D = 2, 8, 32, 16  # T divisible by 8; H divisible by 8 for ulysses
+        k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(k1, (B, H, T, D), dtype=jnp.float32)
+        k = jax.random.normal(k2, (B, H, T, D), dtype=jnp.float32)
+        v = jax.random.normal(k3, (B, H, T, D), dtype=jnp.float32)
+        expected = reference_attention(q, k, v, causal=causal)
+        got = ring_self_attention(mesh, q, k, v, causal=causal, impl=impl)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def test_ring_attention_differentiable(self):
+        mesh = make_mesh({"context": 4})
+        B, H, T, D = 1, 2, 16, 8
+        q = jax.random.normal(jax.random.key(1), (B, H, T, D))
+
+        def loss_ring(qq):
+            return jnp.sum(ring_self_attention(mesh, qq, qq, qq, causal=True) ** 2)
+
+        def loss_ref(qq):
+            return jnp.sum(reference_attention(qq, qq, qq, causal=True) ** 2)
+
+        g1 = jax.grad(loss_ring)(q)
+        g2 = jax.grad(loss_ref)(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+
+class TestGradientCompression:
+    def test_threshold_encode(self):
+        g = jnp.asarray([0.5, -0.001, 0.002, -2.0])
+        enc = threshold_encode(g, 0.01)
+        np.testing.assert_allclose(np.asarray(enc), [0.01, 0.0, 0.0, -0.01])
+
+    def test_residual_carry(self):
+        """Small gradients accumulate in the residual until they cross the
+        threshold (ref: ResidualPostProcessor semantics)."""
+        tx = gradient_compression(AdaptiveThresholdAlgorithm(initial=0.01, decay=1.0))
+        params = {"w": jnp.zeros(3)}
+        state = tx.init(params)
+        g = {"w": jnp.asarray([0.004, 0.0, 0.02])}
+        sent1, state = tx.update(g, state)
+        assert float(sent1["w"][0]) == 0.0  # below threshold: held back
+        assert float(sent1["w"][2]) == pytest.approx(0.01)
+        sent2, state = tx.update(g, state)
+        sent3, state = tx.update(g, state)
+        # 0.004*3 = 0.012 crossed the 0.01 threshold by step 3
+        assert float(sent3["w"][0]) == pytest.approx(0.01)
+
+    def test_compression_chain_trains(self):
+        import optax
+        tx = optax.chain(gradient_compression(AdaptiveThresholdAlgorithm(initial=0.1, max_t=10.0)),
+                         optax.sgd(0.2))
+        w = jnp.asarray([1.0, -1.0])
+        state = tx.init(w)
+        for _ in range(200):
+            grads = 2 * w  # d/dw ||w||^2
+            updates, state = tx.update(grads, state)
+            w = optax.apply_updates(w, updates)
+        assert float(jnp.sum(jnp.abs(w))) < 0.05
