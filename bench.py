@@ -1,0 +1,92 @@
+"""Headline benchmark: BERT-base masked-LM training throughput on one chip.
+
+Mirrors BASELINE.json's metric ("SameDiff BERT-base tokens/sec/chip"): the
+reference runs this workload through the SameDiff op-by-op JVM interpreter;
+here it is one fused XLA executable (fwd+bwd+AdamW, bf16 compute, remat).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is measured MFU / 0.35 (the north-star gate from
+BASELINE.json) since the reference publishes no in-tree numbers
+(SURVEY.md §6, BASELINE "published": {}).
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# bf16 peak FLOPs by TPU generation (fallback: v5e)
+_PEAK = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in _PEAK.items():
+        if k in kind:
+            return v
+    return 197e12
+
+
+def main():
+    from deeplearning4j_tpu.models import (
+        TransformerConfig, init_params, make_train_step)
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = TransformerConfig()          # BERT-base: 12L/768H/12 heads/512 seq
+        B, T, steps, warmup = 32, 512, 10, 3
+    else:                                   # CPU smoke fallback (driver runs TPU)
+        cfg = TransformerConfig(vocab_size=1024, hidden=128, layers=2, heads=4,
+                                mlp_dim=512, max_seq=128, dtype=jnp.float32,
+                                remat=False)
+        B, T, steps, warmup = 8, 128, 3, 1
+
+    key = jax.random.PRNGKey(0)
+    params = init_params(key, cfg)
+    init_state, step = make_train_step(cfg, learning_rate=1e-4)
+    opt_state = init_state(params)
+
+    rng = np.random.default_rng(0)
+    def make_batch():
+        return {
+            "tokens": jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32),
+            "targets": jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32),
+            "weights": jnp.ones((B, T), jnp.float32),
+        }
+
+    batch = make_batch()
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, batch)
+    # NB: under the axon tunnel block_until_ready is a no-op; a host transfer
+    # is the only reliable synchronization point.
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * T * steps / dt
+
+    # MFU: fwd+bwd ~ 6*N flops/token + attention 12*L*H*T flops/token.
+    # (Model flops only — remat recompute is deliberately NOT counted.)
+    n_params = sum(x.size for x in jax.tree.leaves(params)) \
+        - cfg.vocab_size * cfg.hidden - cfg.max_seq * cfg.hidden  # non-embedding
+    flops_per_token = 6 * n_params + 12 * cfg.layers * cfg.hidden * T
+    achieved = tokens_per_sec * flops_per_token
+    peak = _peak_flops(jax.devices()[0]) if on_tpu else 1e12
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.35, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

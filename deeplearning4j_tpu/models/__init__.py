@@ -1,0 +1,22 @@
+"""Flagship model family — TPU-native transformer (BERT-class encoder / causal LM).
+
+Reference parity target: the SameDiff BERT-base fine-tune path
+(dl4j-examples + samediff-import, BASELINE configs #4/#5). The reference
+executes BERT op-by-op through a JVM interpreter; here the whole train step
+(fwd + loss + bwd + optimizer) is ONE pjit-compiled XLA program sharded over a
+data/model/context device mesh.
+"""
+from deeplearning4j_tpu.models.bert import (
+    TransformerConfig,
+    init_params,
+    forward,
+    lm_loss,
+    make_train_step,
+    param_pspecs,
+    BERT_BASE,
+)
+
+__all__ = [
+    "TransformerConfig", "init_params", "forward", "lm_loss",
+    "make_train_step", "param_pspecs", "BERT_BASE",
+]

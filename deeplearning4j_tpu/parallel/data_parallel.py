@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator, ListDataSetIterator
 from deeplearning4j_tpu.ndarray.array import NDArray
-from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, batch_sharding, make_mesh
 
 
 class ParallelWrapper:
@@ -82,11 +82,9 @@ class ParallelWrapper:
         arr = np.asarray(arr)
         n = self._n
         b = arr.shape[0]
-        if b % n:  # pad final partial batch by repeating (reference drops/round-robins)
-            pad = n - (b % n)
-            arr = np.concatenate([arr, arr[:pad]], axis=0)
-        spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
-        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+        if b % n:  # pad final partial batch by cycling rows (reference drops/round-robins)
+            arr = arr[np.resize(np.arange(b), b + n - (b % n))]
+        return jax.device_put(arr, batch_sharding(self.mesh, rank=arr.ndim))
 
     def fit(self, data, epochs: int = 1):
         """Sharded lockstep DP fit (ref: ParallelWrapper.fit)."""
@@ -110,6 +108,9 @@ class ParallelWrapper:
                     m._iteration += 1
                     for lst in m.listeners:
                         lst.iterationDone(m, m._iteration, m._epoch)
+                for lst in m.listeners:
+                    if hasattr(lst, "onEpochEnd"):
+                        lst.onEpochEnd(m)
                 m._epoch += 1
         return self.model
 
@@ -161,8 +162,7 @@ class ParallelInference:
             pad = n - (b % n)
             arr = np.concatenate([arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)], axis=0)
             padded = arr.shape[0]
-        spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
-        xs = jax.device_put(arr, NamedSharding(self.mesh, spec))
+        xs = jax.device_put(arr, batch_sharding(self.mesh, rank=arr.ndim))
         with self.mesh:
             out = self.model.output(xs)
         return NDArray(out.jax[:b]) if padded != b else out

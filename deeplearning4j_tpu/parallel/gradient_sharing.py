@@ -88,7 +88,7 @@ def gradient_compression(algorithm: Optional[AdaptiveThresholdAlgorithm] = None,
     return optax.GradientTransformation(init, update)
 
 
-def int8_compression(scale_by_norm: bool = True) -> optax.GradientTransformation:
+def int8_compression() -> optax.GradientTransformation:
     """TPU-native alternative for DCN cross-slice traffic: symmetric int8
     quantization with per-tensor scale (dense, collective-friendly — unlike
     sparse threshold messages). No reference equivalent; provided as the

@@ -61,7 +61,3 @@ def shard_batch(mesh: Mesh, tree, axis: str = DATA_AXIS):
 
 def replicate(mesh: Mesh, tree):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, replicated(mesh)), tree)
-
-
-def local_mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
-    return tuple(mesh.axis_names)

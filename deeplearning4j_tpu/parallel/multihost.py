@@ -51,9 +51,11 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
-def barrier(name: str = "barrier", timeout_s: int = 600):
+def barrier(name: str = "barrier"):
     """Host-level barrier via a tiny psum across all devices (control-plane
-    sync; ref: parameter-server handshake/heartbeat round)."""
+    sync; ref: parameter-server handshake/heartbeat round). Blocks until all
+    hosts participate — there is no timeout plumbing in the XLA collective;
+    rely on the runtime's own liveness handling for hung peers."""
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     devs = jax.devices()
