@@ -67,6 +67,11 @@ class DataSet:
                         None if self.labels_mask is None else self.labels_mask[i:i + batch_size])
                 for i in range(0, n, batch_size)]
 
+    def toMultiDataSet(self) -> "MultiDataSet":
+        """Single-input/-output view (ref: DataSet.toMultiDataSet)."""
+        return MultiDataSet([self.features], [self.labels],
+                            [self.features_mask], [self.labels_mask])
+
     @staticmethod
     def merge(datasets: Sequence["DataSet"]) -> "DataSet":
         return DataSet(

@@ -1,0 +1,15 @@
+"""Early stopping (ref: org.deeplearning4j.earlystopping.*)."""
+from deeplearning4j_tpu.earlystopping.trainer import (
+    EarlyStoppingConfiguration, EarlyStoppingResult, EarlyStoppingTrainer,
+    InMemoryModelSaver, LocalFileModelSaver,
+    MaxEpochsTerminationCondition, MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    DataSetLossCalculator)
+
+__all__ = [
+    "EarlyStoppingConfiguration", "EarlyStoppingResult", "EarlyStoppingTrainer",
+    "InMemoryModelSaver", "LocalFileModelSaver",
+    "MaxEpochsTerminationCondition", "MaxScoreIterationTerminationCondition",
+    "MaxTimeIterationTerminationCondition", "ScoreImprovementEpochTerminationCondition",
+    "DataSetLossCalculator",
+]

@@ -224,7 +224,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     if mesh is None:
         return init_state, jax.jit(step, donate_argnums=(0, 1))
 
-    param_sh = _spec_tree_to_shardings(param_pspecs(cfg), mesh)
+    param_sh = _shardings(cfg, mesh)
     bspec = NamedSharding(mesh, batch_pspec(mesh))
     batch_sh = {"tokens": bspec, "targets": bspec, "weights": bspec}
 
@@ -236,8 +236,8 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
             if hasattr(s, "mu"):  # ScaleByAdamState: mu/nu mirror the param tree
                 placed.append(s._replace(
                     count=jax.device_put(s.count, repl),
-                    mu=_map_with_specs(lambda l, sh: jax.device_put(l, sh), s.mu, param_sh),
-                    nu=_map_with_specs(lambda l, sh: jax.device_put(l, sh), s.nu, param_sh)))
+                    mu=jax.device_put(s.mu, param_sh),
+                    nu=jax.device_put(s.nu, param_sh)))
             else:
                 placed.append(jax.tree.map(lambda l: jax.device_put(l, repl), s))
         return tuple(placed)
@@ -247,26 +247,12 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     return init_state_sharded, jstep
 
 
-def _map_with_specs(fn, tree, spec_tree):
-    """Recursively map fn(leaf, spec_leaf) over parallel (dict/list) trees.
-    spec_tree leaves (PartitionSpec / NamedSharding) match tree's array leaves."""
-    if isinstance(tree, dict):
-        return {k: _map_with_specs(fn, tree[k], spec_tree[k]) for k in tree}
-    if isinstance(tree, (list, tuple)):
-        out = [_map_with_specs(fn, t, s) for t, s in zip(tree, spec_tree)]
-        return type(tree)(out) if isinstance(tree, tuple) else out
-    return fn(tree, spec_tree)
-
-
-def _spec_tree_to_shardings(spec_tree, mesh: Mesh):
-    if isinstance(spec_tree, dict):
-        return {k: _spec_tree_to_shardings(v, mesh) for k, v in spec_tree.items()}
-    if isinstance(spec_tree, (list, tuple)):
-        return [_spec_tree_to_shardings(v, mesh) for v in spec_tree]
-    return NamedSharding(mesh, spec_tree)
+def _shardings(cfg: TransformerConfig, mesh: Mesh):
+    """param_pspecs as a matching pytree of NamedShardings (PartitionSpec is a
+    pytree leaf, so a plain tree.map suffices)."""
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg))
 
 
 def place_params(params, cfg: TransformerConfig, mesh: Mesh):
     """Shard a parameter pytree onto the mesh per param_pspecs."""
-    shardings = _spec_tree_to_shardings(param_pspecs(cfg), mesh)
-    return _map_with_specs(lambda leaf, sh: jax.device_put(leaf, sh), params, shardings)
+    return jax.device_put(params, _shardings(cfg, mesh))

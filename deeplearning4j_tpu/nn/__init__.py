@@ -3,3 +3,8 @@ from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration, NeuralNe
 from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
 from deeplearning4j_tpu.nn.conf import layers  # noqa: F401
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
+from deeplearning4j_tpu.nn.conf.graph import (  # noqa: F401
+    ComputationGraphConfiguration, GraphBuilder, GraphVertex, MergeVertex,
+    ElementWiseVertex, SubsetVertex, StackVertex, UnstackVertex, ScaleVertex,
+    ShiftVertex, L2NormalizeVertex, ReshapeVertex)
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph  # noqa: F401

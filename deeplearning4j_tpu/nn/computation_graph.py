@@ -1,0 +1,304 @@
+"""ComputationGraph runtime (ref: org.deeplearning4j.nn.graph.ComputationGraph,
+~6k LoC: topo-sorted GraphVertex[] execution with per-op JNI dispatch).
+
+TPU-native redesign: the DAG is traversed in Python at TRACE time only — the
+whole forward/backward/update collapses into one jit-compiled XLA program, the
+same architecture shift as MultiLayerNetwork (see multilayer.py docstring).
+Supports multiple inputs (fit(MultiDataSet)) and multiple outputs (loss =
+sum over output layers, as the reference sums ComputationGraph scores)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.eval import Evaluation, RegressionEvaluation
+from deeplearning4j_tpu.ndarray.array import NDArray, _unwrap
+from deeplearning4j_tpu.nn.conf.graph import (
+    ComputationGraphConfiguration, GraphNode, GraphVertex)
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseOutputLayer, BaseRecurrentLayer, Bidirectional, ConvolutionLayer,
+    FeedForwardLayer, GlobalPoolingLayer, LastTimeStep, Layer, LossLayer,
+    RnnOutputLayer, BatchNormalization)
+from deeplearning4j_tpu.nn.multilayer import _as_jnp, _clip_grads
+
+
+class ComputationGraph:
+    """DAG network over a ComputationGraphConfiguration."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self._order: List[GraphNode] = conf.topo_order()
+        self._by_name: Dict[str, GraphNode] = {n.name: n for n in self._order}
+        self._params: Optional[Dict[str, dict]] = None
+        self._state: Optional[Dict[str, dict]] = None
+        self._opt_state = None
+        self._tx = None
+        self._iteration = 0
+        self._epoch = 0
+        self._score = float("nan")
+        self.listeners: List[Any] = []
+        self._jit_cache: dict = {}
+        self._rng_key = jax.random.key(conf.seed)
+        self._dtype = jnp.float32 if conf.dataType == "FLOAT" else (
+            jnp.float64 if conf.dataType == "DOUBLE" else jnp.bfloat16)
+
+    # ------------------------------------------------------------------ init
+    def init(self):
+        key = jax.random.key(self.conf.seed)
+        layer_nodes = [n for n in self._order if isinstance(n.op, Layer)]
+        keys = jax.random.split(key, max(len(layer_nodes), 1))
+        self._params = {}
+        self._state = {}
+        for i, n in enumerate(layer_nodes):
+            self._params[n.name] = n.op.init_params(keys[i], self._dtype)
+            self._state[n.name] = n.op.init_state()
+        self._tx = self.conf.updater.to_optax()
+        self._opt_state = self._tx.init(self._params)
+        return self
+
+    # -------------------------------------------------------------- forward
+    def _adapt(self, layer: Layer, x):
+        """CNN->FF flatten adapter (same rule as MultiLayerNetwork._forward)."""
+        if x.ndim == 4 and isinstance(layer, FeedForwardLayer) and not isinstance(
+                layer, (ConvolutionLayer, BaseRecurrentLayer, BatchNormalization)):
+            return x.reshape(x.shape[0], -1)
+        return x
+
+    def _forward(self, params, state, inputs: Dict[str, Any], *, training, rng,
+                 masks: Optional[Dict[str, Any]] = None):
+        acts: Dict[str, Any] = dict(inputs)
+        new_state: Dict[str, dict] = {}
+        n_layers = max(sum(1 for n in self._order if isinstance(n.op, Layer)), 1)
+        rngs = jax.random.split(rng, n_layers) if rng is not None else None
+        li = 0
+        for node in self._order:
+            xs = [acts[i] for i in node.inputs]
+            if isinstance(node.op, GraphVertex):
+                acts[node.name] = node.op.apply(xs, training=training)
+                continue
+            layer = node.op
+            x = self._adapt(layer, xs[0])
+            r = rngs[li] if rngs is not None else None
+            li += 1
+            if training and layer.dropOut is not None and layer.dropOut < 1.0 and r is not None:
+                keep = layer.dropOut
+                m = jax.random.bernoulli(jax.random.fold_in(r, 7), keep, x.shape)
+                x = jnp.where(m, x / keep, 0.0)
+            kwargs = {}
+            mask = (masks or {}).get(node.inputs[0])
+            if isinstance(layer, (BaseRecurrentLayer, Bidirectional, LastTimeStep,
+                                  GlobalPoolingLayer)) and mask is not None:
+                kwargs["mask"] = mask
+            out, st = layer.apply(params.get(node.name, {}), x, training=training,
+                                  rng=r, state=state.get(node.name) or None, **kwargs)
+            acts[node.name] = out
+            new_state[node.name] = st if st is not None else {}
+        return acts, new_state
+
+    def _loss_for(self, params, state, inputs, labels, rng, lmasks, fmasks=None):
+        acts, new_state = self._forward(params, state, inputs, training=True, rng=rng,
+                                        masks=fmasks)
+        loss = 0.0
+        for i, out_name in enumerate(self.conf.networkOutputs):
+            layer = self._by_name[out_name].op
+            y = labels[i]
+            lm = lmasks[i] if lmasks is not None else None
+            if isinstance(layer, (BaseOutputLayer, LossLayer)):
+                loss = loss + layer.compute_loss(y, acts[out_name], lm)
+            else:
+                loss = loss + jnp.mean((acts[out_name] - y) ** 2)
+        for reg in self.conf.regularization:
+            for name, p in params.items():
+                layer = self._by_name[name].op
+                for k in layer.regularizable():
+                    if k in p:
+                        loss = loss + reg.penalty(p[k])
+        return loss, new_state
+
+    # ----------------------------------------------------------- jitted fns
+    def _build_step(self):
+        conf = self.conf
+
+        frozen = {n.name for n in self._order if getattr(n.op, "frozen", False)}
+
+        def zero_frozen(tree_dict):
+            if not frozen:
+                return tree_dict
+            return {k: (jax.tree_util.tree_map(jnp.zeros_like, g) if k in frozen else g)
+                    for k, g in tree_dict.items()}
+
+        def step(params, state, opt_state, inputs, labels, rng, lmasks, fmasks):
+            (loss, new_state), grads = jax.value_and_grad(
+                self._loss_for, has_aux=True)(params, state, inputs, labels, rng,
+                                              lmasks, fmasks)
+            grads = zero_frozen(grads)  # (ref: FrozenLayer)
+            grads = _clip_grads(grads, conf.gradientNormalization,
+                                conf.gradientNormalizationThreshold)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            updates = zero_frozen(updates)  # AdamW decay must not touch frozen params
+            params = optax.apply_updates(params, updates)
+            return params, new_state, opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def _build_infer(self):
+        def infer(params, state, inputs, fmasks):
+            acts, _ = self._forward(params, state, inputs, training=False, rng=None,
+                                    masks=fmasks)
+            return [acts[o] for o in self.conf.networkOutputs]
+
+        return jax.jit(infer)
+
+    def _get_jitted(self, kind):
+        if kind not in self._jit_cache:
+            self._jit_cache[kind] = self._build_step() if kind == "step" else self._build_infer()
+        return self._jit_cache[kind]
+
+    # ------------------------------------------------------------------ fit
+    def _input_dict(self, features: Sequence) -> Dict[str, Any]:
+        return {name: _as_jnp(f) for name, f in zip(self.conf.networkInputs, features)}
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        """fit(DataSet/MultiDataSet), fit(iterator), fit(features, labels)."""
+        if labels is not None:
+            data = [MultiDataSet([data], [labels])]
+        elif isinstance(data, DataSet):
+            data = [data.toMultiDataSet()]
+        elif isinstance(data, MultiDataSet):
+            data = [data]
+        step = self._get_jitted("step")
+        for _ in range(epochs):
+            for ds in data:
+                mds = ds.toMultiDataSet() if isinstance(ds, DataSet) else ds
+                inputs = self._input_dict(mds.features)
+                ys = [_as_jnp(y) for y in mds.labels]
+                lmasks = [(_as_jnp(m) if m is not None else None)
+                          for m in (mds.labels_masks or [None] * len(ys))]
+                if all(m is None for m in lmasks):
+                    lmasks = None
+                fmasks = {name: _as_jnp(m)
+                          for name, m in zip(self.conf.networkInputs,
+                                             mds.features_masks or [])
+                          if m is not None} or None
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                self._params, self._state, self._opt_state, loss = step(
+                    self._params, self._state, self._opt_state, inputs, ys, sub,
+                    lmasks, fmasks)
+                self._score = float(loss)
+                self._iteration += 1
+                for lst in self.listeners:
+                    lst.iterationDone(self, self._iteration, self._epoch)
+            self._epoch += 1
+            for lst in self.listeners:
+                if hasattr(lst, "onEpochEnd"):
+                    lst.onEpochEnd(self)
+        return self
+
+    # ------------------------------------------------------------- inference
+    def output(self, *features, train: bool = False, features_masks=None) -> List[NDArray]:
+        """(ref: ComputationGraph.output) — returns one NDArray per network
+        output."""
+        infer = self._get_jitted("infer")
+        fmasks = {name: _as_jnp(m)
+                  for name, m in zip(self.conf.networkInputs, features_masks or [])
+                  if m is not None} or None
+        outs = infer(self._params, self._state, self._input_dict(features), fmasks)
+        return [NDArray(o) for o in outs]
+
+    def outputSingle(self, *features) -> NDArray:
+        return self.output(*features)[0]
+
+    def feedForward(self, *features) -> Dict[str, NDArray]:
+        acts, _ = self._forward(self._params, self._state,
+                                self._input_dict(features), training=False, rng=None)
+        return {k: NDArray(v) for k, v in acts.items()}
+
+    # ---------------------------------------------------------------- score
+    def score(self, dataset=None) -> float:
+        if dataset is None:
+            return self._score
+        mds = dataset.toMultiDataSet() if isinstance(dataset, DataSet) else dataset
+        loss, _ = self._loss_for(self._params, self._state,
+                                 self._input_dict(mds.features),
+                                 [_as_jnp(y) for y in mds.labels], None, None, None)
+        return float(loss)
+
+    # ----------------------------------------------------------- evaluation
+    def evaluate(self, iterator, num_classes: Optional[int] = None) -> Evaluation:
+        ev = Evaluation(num_classes)
+        for ds in iterator:
+            mds = ds.toMultiDataSet() if isinstance(ds, DataSet) else ds
+            out = self.output(*mds.features, features_masks=mds.features_masks)[0]
+            ev.eval(np.asarray(_unwrap(mds.labels[0])), out.toNumpy(),
+                    mask=mds.labels_masks[0] if mds.labels_masks else None)
+        return ev
+
+    # ---------------------------------------------------- flat param surface
+    def params(self) -> NDArray:
+        leaves = []
+        for n in self._order:
+            if n.name in (self._params or {}):
+                p = self._params[n.name]
+                for k in sorted(p.keys()):
+                    leaves.append(jnp.ravel(p[k]))
+        if not leaves:
+            return NDArray(jnp.zeros((0,)))
+        return NDArray(jnp.concatenate(leaves))
+
+    def setParams(self, flat):
+        flat = _as_jnp(flat).ravel()
+        pos = 0
+        for n in self._order:
+            if n.name in (self._params or {}):
+                p = dict(self._params[n.name])
+                for k in sorted(p.keys()):
+                    cnt = int(np.prod(p[k].shape))
+                    p[k] = flat[pos:pos + cnt].reshape(p[k].shape).astype(p[k].dtype)
+                    pos += cnt
+                self._params[n.name] = p
+
+    def numParams(self) -> int:
+        return int(sum(np.prod(l.shape)
+                       for l in jax.tree_util.tree_leaves(self._params)))
+
+    # ------------------------------------------------------------- listeners
+    def setListeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def addListeners(self, *listeners):
+        self.listeners.extend(listeners)
+        return self
+
+    def getIterationCount(self) -> int:
+        return self._iteration
+
+    def getEpochCount(self) -> int:
+        return self._epoch
+
+    def clone(self) -> "ComputationGraph":
+        other = ComputationGraph(self.conf)
+        if self._params is not None:
+            other._params = jax.tree_util.tree_map(lambda a: a, self._params)
+            other._state = jax.tree_util.tree_map(lambda a: a, self._state)
+            other._tx = self.conf.updater.to_optax()
+            other._opt_state = other._tx.init(other._params)
+        return other
+
+    def summary(self) -> str:
+        rows = [("name", "type", "inputs", "nParams")]
+        total = 0
+        for n in self._order:
+            p = (self._params or {}).get(n.name, {})
+            cnt = int(sum(np.prod(v.shape) for v in p.values()))
+            total += cnt
+            rows.append((n.name, type(n.op).__name__, ",".join(n.inputs), str(cnt)))
+        widths = [max(len(r[c]) for r in rows) for c in range(4)]
+        lines = ["  ".join(r[c].ljust(widths[c]) for c in range(4)) for r in rows]
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)

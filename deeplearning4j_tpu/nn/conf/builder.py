@@ -140,6 +140,11 @@ class NeuralNetConfiguration:
         def list(self) -> "NeuralNetConfiguration.ListBuilder":
             return NeuralNetConfiguration.ListBuilder(self)
 
+        def graphBuilder(self):
+            """DAG networks (ref: NeuralNetConfiguration.Builder.graphBuilder)."""
+            from deeplearning4j_tpu.nn.conf.graph import GraphBuilder
+            return GraphBuilder(self)
+
     class ListBuilder:
         def __init__(self, parent: "NeuralNetConfiguration.Builder"):
             self._parent = parent

@@ -111,13 +111,17 @@ class Layer:
     def from_dict(d: dict) -> "Layer":
         d = dict(d)
         cls = LAYER_TYPES[d.pop("@type")]
+        frozen = d.pop("frozen", False)  # set dynamically by TransferLearning
         for k, v in list(d.items()):
             if isinstance(v, dict) and "@type" in v:
                 d[k] = Layer.from_dict(v)
             elif isinstance(v, list) and k in ("kernelSize", "stride", "padding", "dilation",
                                                "size", "cropping", "blocks", "poolingDimensions"):
                 d[k] = tuple(v)
-        return cls(**d)
+        obj = cls(**d)
+        if frozen:
+            obj.frozen = True
+        return obj
 
 
 @dataclass
@@ -604,6 +608,15 @@ class BaseRecurrentLayer(FeedForwardLayer):
     def _from_nwc(self, x):
         return jnp.swapaxes(x, 1, 2) if self.rnnDataFormat == "NCW" else x
 
+    # -- streaming/tBPTT state surface (ref: BaseRecurrentLayer.stateMap /
+    #    tBpttStateMap + rnnTimeStep/rnnActivateUsingStoredState)
+    def init_rnn_state(self, batch: int, dtype=jnp.float32) -> dict:
+        return {"h": jnp.zeros((batch, self.nOut), dtype)}
+
+    def apply_rnn(self, params, x, rnn_state: dict, *, mask=None):
+        """Run the recurrence from ``rnn_state``; returns (ys, final_state)."""
+        raise NotImplementedError
+
 
 @dataclass
 class LSTM(BaseRecurrentLayer):
@@ -627,18 +640,23 @@ class LSTM(BaseRecurrentLayer):
     def regularizable(self):
         return ("W", "RW")
 
+    def init_rnn_state(self, batch: int, dtype=jnp.float32) -> dict:
+        H = self.nOut
+        return {"h": jnp.zeros((batch, H), dtype), "c": jnp.zeros((batch, H), dtype)}
+
+    def apply_rnn(self, params, x, rnn_state, *, mask=None):
+        x = self._to_nwc(x)
+        ys, (hT, cT) = _nnops.lstm_layer(x, rnn_state["h"], rnn_state["c"],
+                                         params["W"], params["RW"], params["b"], mask=mask)
+        return self._from_nwc(ys), {"h": hT, "c": cT}
+
     def apply(self, params, x, *, training=False, rng=None, state=None, mask=None,
               initial_state=None):
-        x = self._to_nwc(x)
-        B, H = x.shape[0], self.nOut
-        if initial_state is None:
-            h0 = jnp.zeros((B, H), x.dtype)
-            c0 = jnp.zeros((B, H), x.dtype)
-        else:
-            h0, c0 = initial_state
-        ys, (hT, cT) = _nnops.lstm_layer(x, h0, c0, params["W"], params["RW"], params["b"],
-                                         mask=mask)
-        return self._from_nwc(ys), state
+        B = x.shape[0]
+        rs = self.init_rnn_state(B, x.dtype) if initial_state is None else \
+            {"h": initial_state[0], "c": initial_state[1]}
+        ys, _ = self.apply_rnn(params, x, rs, mask=mask)
+        return ys, state
 
 
 @dataclass
@@ -653,14 +671,9 @@ class GravesLSTM(LSTM):
         p["pO"] = jnp.zeros((H,), dtype)
         return p
 
-    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None,
-              initial_state=None):
+    def apply_rnn(self, params, x, rnn_state, *, mask=None):
         x = self._to_nwc(x)
-        B, H = x.shape[0], self.nOut
-        if initial_state is None:
-            h0, c0 = jnp.zeros((B, H), x.dtype), jnp.zeros((B, H), x.dtype)
-        else:
-            h0, c0 = initial_state
+        h0, c0 = rnn_state["h"], rnn_state["c"]
         W, RW, b = params["W"], params["RW"], params["b"]
         pI, pF, pO = params["pI"], params["pF"], params["pO"]
 
@@ -684,8 +697,8 @@ class GravesLSTM(LSTM):
                 c2 = jnp.where(m > 0, c2, c)
             return (h2, c2), h2
 
-        (_, _), ys = lax.scan(step, (h0, c0), (xs, ms) if ms is not None else xs)
-        return self._from_nwc(jnp.swapaxes(ys, 0, 1)), state
+        (hT, cT), ys = lax.scan(step, (h0, c0), (xs, ms) if ms is not None else xs)
+        return self._from_nwc(jnp.swapaxes(ys, 0, 1)), {"h": hT, "c": cT}
 
 
 @dataclass
@@ -704,14 +717,19 @@ class SimpleRnn(BaseRecurrentLayer):
     def regularizable(self):
         return ("W", "RW")
 
+    def apply_rnn(self, params, x, rnn_state, *, mask=None):
+        x = self._to_nwc(x)
+        act = _act.get(self.activation or "TANH")
+        ys, hT = _nnops.simple_rnn(x, rnn_state["h"], params["W"], params["RW"],
+                                   params["b"], activation=act)
+        return self._from_nwc(ys), {"h": hT}
+
     def apply(self, params, x, *, training=False, rng=None, state=None, mask=None,
               initial_state=None):
-        x = self._to_nwc(x)
-        B = x.shape[0]
-        h0 = initial_state if initial_state is not None else jnp.zeros((B, self.nOut), x.dtype)
-        act = _act.get(self.activation or "TANH")
-        ys, _ = _nnops.simple_rnn(x, h0, params["W"], params["RW"], params["b"], activation=act)
-        return self._from_nwc(ys), state
+        rs = self.init_rnn_state(x.shape[0], x.dtype) if initial_state is None \
+            else {"h": initial_state}
+        ys, _ = self.apply_rnn(params, x, rs, mask=mask)
+        return ys, state
 
 
 @dataclass
@@ -729,13 +747,18 @@ class GRU(BaseRecurrentLayer):
     def regularizable(self):
         return ("W", "RW")
 
+    def apply_rnn(self, params, x, rnn_state, *, mask=None):
+        x = self._to_nwc(x)
+        ys, hT = _nnops.gru_layer(x, rnn_state["h"], params["W"], params["RW"],
+                                  params["bi"], params["bh"])
+        return self._from_nwc(ys), {"h": hT}
+
     def apply(self, params, x, *, training=False, rng=None, state=None, mask=None,
               initial_state=None):
-        x = self._to_nwc(x)
-        B = x.shape[0]
-        h0 = initial_state if initial_state is not None else jnp.zeros((B, self.nOut), x.dtype)
-        ys, _ = _nnops.gru_layer(x, h0, params["W"], params["RW"], params["bi"], params["bh"])
-        return self._from_nwc(ys), state
+        rs = self.init_rnn_state(x.shape[0], x.dtype) if initial_state is None \
+            else {"h": initial_state}
+        ys, _ = self.apply_rnn(params, x, rs, mask=mask)
+        return ys, state
 
 
 @dataclass
@@ -861,7 +884,9 @@ class OutputLayer(BaseOutputLayer):
 
 @dataclass
 class RnnOutputLayer(BaseOutputLayer):
-    """Per-timestep output (ref: conf.layers.RnnOutputLayer). Input (B,T,F)."""
+    """Per-timestep output (ref: conf.layers.RnnOutputLayer). Input (B,T,F)
+    NWC or (B,F,T) NCW per ``rnnDataFormat`` (ref: RnnOutputLayer.dataFormat)."""
+    rnnDataFormat: str = "NWC"
 
     def set_n_in(self, input_type: InputType):
         if not self.nIn:
@@ -869,6 +894,22 @@ class RnnOutputLayer(BaseOutputLayer):
 
     def output_type(self, input_type: InputType) -> InputType:
         return InputType.recurrent(self.nOut, input_type.timeSeriesLength)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        ncw = self.rnnDataFormat == "NCW"
+        if ncw:
+            x = jnp.swapaxes(x, 1, 2)
+        z = jnp.matmul(x, params["W"])
+        if self.hasBias:
+            z = z + params["b"]
+        out = self._activate(z)
+        return (jnp.swapaxes(out, 1, 2) if ncw else out), state
+
+    def compute_loss(self, labels, output, mask=None):
+        if self.rnnDataFormat == "NCW":  # loss math runs in NWC
+            labels = jnp.swapaxes(labels, 1, 2)
+            output = jnp.swapaxes(output, 1, 2)
+        return _losses.get(self.lossFunction)(labels, output, mask)
 
 
 @dataclass

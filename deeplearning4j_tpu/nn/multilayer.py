@@ -15,7 +15,6 @@ params()/setParams() for serializer/averaging parity.
 """
 from __future__ import annotations
 
-import time
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -80,6 +79,14 @@ def _clip_l2(g, threshold):
     return g * jnp.where(norm > threshold, threshold / (norm + 1e-12), 1.0)
 
 
+def _zero_frozen(tree_list, frozen):
+    """Zero per-layer grad/update entries for frozen layers (ref: FrozenLayer)."""
+    if not any(frozen):
+        return tree_list
+    return [jax.tree_util.tree_map(jnp.zeros_like, t) if frozen[i] else t
+            for i, t in enumerate(tree_list)]
+
+
 class MultiLayerNetwork:
     """Sequential network over a MultiLayerConfiguration."""
 
@@ -118,11 +125,14 @@ class MultiLayerNetwork:
             return x.reshape(x.shape[0], it.channels, it.height, it.width)
         return x
 
-    def _forward(self, params, state, x, *, training, rng, mask=None):
-        """Full forward pass; returns (output, new_states). Auto-inserts the
-        CNN->FF flatten the reference handles via InputPreProcessors."""
+    def _forward(self, params, state, x, *, training, rng, mask=None, rnn_states=None):
+        """Full forward pass; returns (output, new_states, new_rnn_states).
+        Auto-inserts the CNN->FF flatten the reference handles via
+        InputPreProcessors. When ``rnn_states`` is given, recurrent layers run
+        from that state and report their final state (ref:
+        rnnActivateUsingStoredState — the tBPTT/streaming path)."""
         x = self._adapt_input(x)
-        new_states = []
+        new_states, new_rnn = [], []
         n = len(self.layers)
         rngs = jax.random.split(rng, n) if rng is not None else [None] * n
         for i, layer in enumerate(self.layers):
@@ -138,6 +148,13 @@ class MultiLayerNetwork:
                 if keep < 1.0 and rngs[i] is not None:
                     m = jax.random.bernoulli(jax.random.fold_in(rngs[i], 7), keep, x.shape)
                     x = jnp.where(m, x / keep, 0.0)
+            if rnn_states is not None and isinstance(layer, BaseRecurrentLayer) \
+                    and rnn_states[i]:
+                kwargs = {"mask": mask} if mask is not None else {}
+                x, rs = layer.apply_rnn(params[i], x, rnn_states[i], **kwargs)
+                new_rnn.append(rs)
+                new_states.append(state[i] if state[i] else {})
+                continue
             kwargs = {}
             if isinstance(layer, (BaseRecurrentLayer, Bidirectional, LastTimeStep,
                                   GlobalPoolingLayer)) and mask is not None:
@@ -145,11 +162,12 @@ class MultiLayerNetwork:
             x, st = layer.apply(params[i], x, training=training, rng=rngs[i],
                                 state=state[i] if state[i] else None, **kwargs)
             new_states.append(st if st is not None else {})
-        return x, new_states
+            new_rnn.append({})
+        return x, new_states, new_rnn
 
     # ----------------------------------------------------------- jitted fns
     def _loss_for(self, params, state, x, y, rng, fmask, lmask):
-        out, new_states = self._forward(params, state, x, training=True, rng=rng, mask=fmask)
+        out, new_states, _ = self._forward(params, state, x, training=True, rng=rng, mask=fmask)
         out_layer = self.layers[-1]
         if isinstance(out_layer, (BaseOutputLayer, LossLayer)):
             loss = out_layer.compute_loss(y, out, lmask if lmask is not None else
@@ -167,12 +185,19 @@ class MultiLayerNetwork:
     def _build_step(self):
         conf = self.conf
 
+        frozen = [getattr(l, "frozen", False) for l in self.layers]
+
         def step(params, state, opt_state, x, y, rng, fmask, lmask):
             (loss, new_states), grads = jax.value_and_grad(
                 self._loss_for, has_aux=True)(params, state, x, y, rng, fmask, lmask)
+            grads = _zero_frozen(grads, frozen)
             grads = _clip_grads(grads, conf.gradientNormalization,
                                 conf.gradientNormalizationThreshold)
             updates, opt_state = self._tx.update(grads, opt_state, params)
+            # zero the UPDATES too: decoupled weight decay (AdamW) would
+            # otherwise mutate frozen params despite zero grads (ref:
+            # FrozenLayer applies no update at all)
+            updates = _zero_frozen(updates, frozen)
             params = optax.apply_updates(params, updates)
             return params, new_states, opt_state, loss
 
@@ -180,7 +205,7 @@ class MultiLayerNetwork:
 
     def _build_infer(self):
         def infer(params, state, x, fmask):
-            out, _ = self._forward(params, state, x, training=False, rng=None, mask=fmask)
+            out, _, _ = self._forward(params, state, x, training=False, rng=None, mask=fmask)
             return out
 
         return jax.jit(infer)
@@ -190,6 +215,121 @@ class MultiLayerNetwork:
             self._jit_cache[kind] = self._build_step() if kind == "step" else self._build_infer()
         return self._jit_cache[kind]
 
+    # ---------------------------------------------- rnn state (tBPTT/stream)
+    def _rnn_format(self) -> str:
+        """Time-axis layout of this net's sequence data: 'NWC' (B,T,F) or the
+        reference's 'NCW' (B,F,T), taken from the first recurrent layer."""
+        for l in self.layers:
+            if isinstance(l, BaseRecurrentLayer):
+                return l.rnnDataFormat
+        return "NWC"
+
+    def _init_rnn_states(self, batch: int) -> list:
+        return [l.init_rnn_state(batch, self._dtype)
+                if isinstance(l, BaseRecurrentLayer) else {}
+                for l in self.layers]
+
+    def _build_tbptt_step(self):
+        conf = self.conf
+        frozen = [getattr(l, "frozen", False) for l in self.layers]
+
+        def loss_fn(params, state, x, y, rng, fmask, lmask, rnn_states):
+            out, new_states, new_rnn = self._forward(
+                params, state, x, rnn_states=rnn_states, training=True, rng=rng, mask=fmask)
+            out_layer = self.layers[-1]
+            if isinstance(out_layer, (BaseOutputLayer, LossLayer)):
+                loss = out_layer.compute_loss(y, out, lmask if lmask is not None else
+                                              (fmask if isinstance(out_layer, RnnOutputLayer) else None))
+            else:
+                loss = jnp.mean((out - y) ** 2)
+            for reg in conf.regularization:
+                for i, layer in enumerate(self.layers):
+                    for k in layer.regularizable():
+                        if k in params[i]:
+                            loss = loss + reg.penalty(params[i][k])
+            return loss, (new_states, new_rnn)
+
+        def step(params, state, opt_state, x, y, rng, fmask, lmask, rnn_states):
+            (loss, (new_states, new_rnn)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, state, x, y, rng, fmask, lmask, rnn_states)
+            grads = _zero_frozen(grads, frozen)
+            grads = _clip_grads(grads, conf.gradientNormalization,
+                                conf.gradientNormalizationThreshold)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            updates = _zero_frozen(updates, frozen)
+            params = optax.apply_updates(params, updates)
+            # state entering the next segment is a constant (ref: tBPTT detaches)
+            new_rnn = jax.lax.stop_gradient(new_rnn)
+            return params, new_states, opt_state, loss, new_rnn
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def _fit_tbptt(self, ds):
+        """One DataSet fitted by truncated BPTT (ref: MultiLayerNetwork.
+        doTruncatedBPTT): time axis sliced into fwdLength segments, recurrent
+        state carried (detached) across segments within the batch."""
+        if "tbptt" not in self._jit_cache:
+            self._jit_cache["tbptt"] = self._build_tbptt_step()
+        step = self._jit_cache["tbptt"]
+        x_all = _as_jnp(ds.features)
+        y_all = _as_jnp(ds.labels)
+        fmask_all = _as_jnp(ds.features_mask) if ds.features_mask is not None else None
+        lmask_all = _as_jnp(ds.labels_mask) if ds.labels_mask is not None else None
+        taxis = 2 if self._rnn_format() == "NCW" else 1  # NCW = (B,F,T)
+        T = x_all.shape[taxis]
+        k = self.conf.tbpttFwdLength
+        rnn_states = self._init_rnn_states(x_all.shape[0])
+
+        def tslice(arr, sl):
+            return arr[:, :, sl] if taxis == 2 else arr[:, sl]
+
+        for t0 in range(0, T, k):
+            sl = slice(t0, min(t0 + k, T))
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            self._params, self._state, self._opt_state, loss, rnn_states = step(
+                self._params, self._state, self._opt_state,
+                tslice(x_all, sl), tslice(y_all, sl), sub,
+                None if fmask_all is None else fmask_all[:, sl],  # masks are (B,T)
+                None if lmask_all is None else lmask_all[:, sl],
+                rnn_states)
+            self._score = float(loss)
+            self._iteration += 1
+            for lst in self.listeners:
+                lst.iterationDone(self, self._iteration, self._epoch)
+
+    def rnnTimeStep(self, x) -> NDArray:
+        """Streaming inference with stored state (ref: MultiLayerNetwork.
+        rnnTimeStep). x: (B,F) one step, or a full sequence in the net's
+        rnnDataFormat ((B,T,F) NWC / (B,F,T) NCW)."""
+        xv = _as_jnp(x)
+        ncw = self._rnn_format() == "NCW"
+        single = xv.ndim == 2
+        if single:
+            xv = xv[:, :, None] if ncw else xv[:, None, :]
+        if getattr(self, "_stream_rnn", None) is None or \
+                jax.tree_util.tree_leaves(self._stream_rnn) and \
+                jax.tree_util.tree_leaves(self._stream_rnn)[0].shape[0] != xv.shape[0]:
+            self._stream_rnn = self._init_rnn_states(xv.shape[0])
+        if "rnn_step" not in self._jit_cache:
+            def fwd(params, state, x, rnn_states):
+                out, _, new_rnn = self._forward(params, state, x, rnn_states=rnn_states,
+                                                training=False, rng=None)
+                return out, new_rnn
+            self._jit_cache["rnn_step"] = jax.jit(fwd)
+        out, self._stream_rnn = self._jit_cache["rnn_step"](
+            self._params, self._state, xv, self._stream_rnn)
+        if single and out.ndim == 3:
+            out = out[:, :, 0] if ncw else out[:, 0]
+        return NDArray(out)
+
+    def rnnClearPreviousState(self):
+        """(ref: rnnClearPreviousState)."""
+        self._stream_rnn = None
+
+    def rnnGetPreviousState(self, layer_idx: int) -> dict:
+        st = getattr(self, "_stream_rnn", None)
+        return {} if st is None else {k: NDArray(v) for k, v in st[layer_idx].items()}
+
     # ------------------------------------------------------------------ fit
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(DataSetIterator), fit(DataSet), or fit(features, labels)
@@ -198,15 +338,20 @@ class MultiLayerNetwork:
             data = ListDataSetIterator([DataSet(data, labels)])
         elif isinstance(data, DataSet):
             data = ListDataSetIterator([data])
-        step = self._get_jitted("step")
+        tbptt = self.conf.backpropType == "TruncatedBPTT"
+        step = None if tbptt else self._get_jitted("step")
         for _ in range(epochs):
             for ds in data:
+                if tbptt and np.ndim(ds.features) == 3:
+                    self._fit_tbptt(ds)
+                    continue
                 x = _as_jnp(ds.features)
                 y = _as_jnp(ds.labels)
                 fmask = _as_jnp(ds.features_mask) if ds.features_mask is not None else None
                 lmask = _as_jnp(ds.labels_mask) if ds.labels_mask is not None else None
                 self._rng_key, sub = jax.random.split(self._rng_key)
-                t0 = time.time()
+                if step is None:
+                    step = self._get_jitted("step")
                 self._params, self._state, self._opt_state, loss = step(
                     self._params, self._state, self._opt_state, x, y, sub, fmask, lmask)
                 self._score = float(loss)
@@ -216,7 +361,7 @@ class MultiLayerNetwork:
             self._epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "onEpochEnd"):
-                    lst.onEpochEnd(self, self._epoch)
+                    lst.onEpochEnd(self)
         return self
 
     # ------------------------------------------------------------- inference

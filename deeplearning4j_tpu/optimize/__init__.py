@@ -1,0 +1,13 @@
+"""Training listeners + optimization callbacks
+(ref: org.deeplearning4j.optimize.api.TrainingListener and
+org.deeplearning4j.optimize.listeners.*)."""
+from deeplearning4j_tpu.optimize.listeners import (
+    TrainingListener, ScoreIterationListener, PerformanceListener,
+    CollectScoresListener, TimeIterationListener, EvaluativeListener,
+    CheckpointListener)
+
+__all__ = [
+    "TrainingListener", "ScoreIterationListener", "PerformanceListener",
+    "CollectScoresListener", "TimeIterationListener", "EvaluativeListener",
+    "CheckpointListener",
+]

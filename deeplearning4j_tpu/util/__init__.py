@@ -1,0 +1,4 @@
+"""Utilities (ref: org.deeplearning4j.util)."""
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+__all__ = ["ModelSerializer"]

@@ -1,0 +1,101 @@
+"""Model persistence (ref: org.deeplearning4j.util.ModelSerializer — zip
+containing configuration.json + coefficients.bin + updaterState.bin).
+
+Same container design: a zip with
+- ``configuration.json`` — the network config (JSON round-trip DSL) plus a
+  ``networkType`` discriminator and iteration/epoch counters,
+- ``coefficients.npy``  — the flat parameter vector (the reference's
+  paramsFlattened invariant, preserved at this boundary),
+- ``updaterState.npz``  — optimizer-state leaves in tree order (structure is
+  reconstructed from a fresh ``tx.init`` on load, so only leaves are stored —
+  exact-resume parity with saveUpdater=true).
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class ModelSerializer:
+
+    @staticmethod
+    def writeModel(model, path: str, saveUpdater: bool = True):
+        """(ref: ModelSerializer.writeModel)."""
+        from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        if isinstance(model, MultiLayerNetwork):
+            net_type = "MultiLayerNetwork"
+        elif isinstance(model, ComputationGraph):
+            net_type = "ComputationGraph"
+        else:
+            raise TypeError(f"cannot serialize {type(model).__name__}")
+        meta = {
+            "networkType": net_type,
+            "configuration": json.loads(model.conf.to_json()),
+            "iterationCount": model.getIterationCount(),
+            "epochCount": model.getEpochCount(),
+            "saveUpdater": bool(saveUpdater),
+        }
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("configuration.json", json.dumps(meta, indent=2))
+            buf = io.BytesIO()
+            np.save(buf, np.asarray(model.params().jax, dtype=np.float64))
+            z.writestr("coefficients.npy", buf.getvalue())
+            if saveUpdater and model._opt_state is not None:
+                leaves = jax.tree_util.tree_leaves(model._opt_state)
+                buf = io.BytesIO()
+                np.savez(buf, **{f"leaf_{i}": np.asarray(l)
+                                 for i, l in enumerate(leaves)})
+                z.writestr("updaterState.npz", buf.getvalue())
+
+    @staticmethod
+    def _restore(path: str, expect_type: Optional[str], loadUpdater: bool):
+        from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with zipfile.ZipFile(path, "r") as z:
+            meta = json.loads(z.read("configuration.json"))
+            net_type = meta["networkType"]
+            if expect_type and net_type != expect_type:
+                raise ValueError(f"{path} contains a {net_type}, expected {expect_type}")
+            conf_json = json.dumps(meta["configuration"])
+            if net_type == "MultiLayerNetwork":
+                model = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json)).init()
+            else:
+                model = ComputationGraph(ComputationGraphConfiguration.from_json(conf_json)).init()
+            flat = np.load(io.BytesIO(z.read("coefficients.npy")))
+            model.setParams(flat)
+            model._iteration = meta.get("iterationCount", 0)
+            model._epoch = meta.get("epochCount", 0)
+            if loadUpdater and meta.get("saveUpdater") and "updaterState.npz" in z.namelist():
+                data = np.load(io.BytesIO(z.read("updaterState.npz")))
+                fresh = model._tx.init(model._params)
+                leaves, treedef = jax.tree_util.tree_flatten(fresh)
+                restored = [np.asarray(data[f"leaf_{i}"], dtype=np.asarray(l).dtype)
+                            .reshape(np.shape(l)) for i, l in enumerate(leaves)]
+                model._opt_state = jax.tree_util.tree_unflatten(
+                    treedef, [jax.numpy.asarray(r) for r in restored])
+        return model
+
+    @staticmethod
+    def restoreMultiLayerNetwork(path: str, loadUpdater: bool = True):
+        """(ref: ModelSerializer.restoreMultiLayerNetwork)."""
+        return ModelSerializer._restore(path, "MultiLayerNetwork", loadUpdater)
+
+    @staticmethod
+    def restoreComputationGraph(path: str, loadUpdater: bool = True):
+        """(ref: ModelSerializer.restoreComputationGraph)."""
+        return ModelSerializer._restore(path, "ComputationGraph", loadUpdater)
+
+    @staticmethod
+    def restoreModel(path: str, loadUpdater: bool = True):
+        """Type-sniffing restore (ref: ModelGuesser.loadModelGuess)."""
+        return ModelSerializer._restore(path, None, loadUpdater)

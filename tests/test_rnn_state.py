@@ -1,0 +1,89 @@
+"""tBPTT + streaming rnnTimeStep tests (ref: MultiLayerNetwork.doTruncatedBPTT,
+rnnTimeStep/rnnClearPreviousState semantics; SURVEY.md §5.7)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import GRU, LSTM, GravesLSTM, RnnOutputLayer, SimpleRnn
+from deeplearning4j_tpu.train.updaters import Adam
+
+
+def _char_rnn_conf(cell, tbptt=False, k=8, seed=5):
+    b = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(0.01)).list()
+         .layer(cell)
+         .layer(RnnOutputLayer(nIn=cell.nOut, nOut=4, activation="SOFTMAX",
+                               lossFunction="MCXENT")))
+    if tbptt:
+        b = b.backpropType("TruncatedBPTT").tBPTTForwardLength(k).tBPTTBackwardLength(k)
+    return b.build()
+
+
+def _seq_data(rng, B=4, T=24, F=4):
+    x = rng.normal(size=(B, T, F)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (B, T))]
+    return x, y
+
+
+@pytest.mark.parametrize("cell", [
+    LSTM(nIn=4, nOut=8), GravesLSTM(nIn=4, nOut=8),
+    SimpleRnn(nIn=4, nOut=8), GRU(nIn=4, nOut=8)])
+def test_tbptt_trains(cell):
+    rng = np.random.default_rng(0)
+    x, y = _seq_data(rng)
+    net = MultiLayerNetwork(_char_rnn_conf(type(cell)(nIn=4, nOut=8), tbptt=True)).init()
+    net.fit(DataSet(x, y))
+    # 24 timesteps / fwdLength 8 = 3 optimizer steps per DataSet
+    assert net.getIterationCount() == 3
+    s0 = net.score(DataSet(x, y))
+    for _ in range(10):
+        net.fit(DataSet(x, y))
+    assert net.score(DataSet(x, y)) < s0
+
+
+def test_tbptt_state_carries_across_segments():
+    """With state carry, segment k>0 sees history: a tBPTT fit on [0:2k] must
+    differ from two independent fits on [0:k], [k:2k] with cleared state —
+    verified indirectly: streaming forward (rnnTimeStep chunks) must equal
+    whole-sequence forward."""
+    rng = np.random.default_rng(1)
+    x, _ = _seq_data(rng, B=2, T=16)
+    net = MultiLayerNetwork(_char_rnn_conf(LSTM(nIn=4, nOut=8))).init()
+    whole = net.output(x).toNumpy()
+    net.rnnClearPreviousState()
+    parts = [net.rnnTimeStep(x[:, a:a + 4]).toNumpy() for a in range(0, 16, 4)]
+    np.testing.assert_allclose(whole, np.concatenate(parts, axis=1), atol=1e-5)
+
+
+def test_rnn_time_step_single_and_clear():
+    rng = np.random.default_rng(2)
+    x, _ = _seq_data(rng, B=3, T=6)
+    net = MultiLayerNetwork(_char_rnn_conf(GRU(nIn=4, nOut=8))).init()
+    whole = net.output(x).toNumpy()
+    net.rnnClearPreviousState()
+    steps = [net.rnnTimeStep(x[:, t]).toNumpy() for t in range(6)]  # (B,F) single steps
+    np.testing.assert_allclose(whole, np.stack(steps, axis=1), atol=1e-5)
+    # clearing resets: first step output repeats
+    net.rnnClearPreviousState()
+    again = net.rnnTimeStep(x[:, 0]).toNumpy()
+    np.testing.assert_allclose(again, steps[0], atol=1e-6)
+    # stored state accessible
+    st = net.rnnGetPreviousState(0)
+    assert "h" in st and st["h"].shape == (3, 8)
+
+
+def test_tbptt_ncw_layout():
+    """NCW (B,F,T) nets must segment over the TIME axis, not channels."""
+    rng = np.random.default_rng(3)
+    B, F, T = 2, 4, 24
+    x = rng.normal(size=(B, F, T)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (B, T))].transpose(0, 2, 1)  # (B,O,T)
+    cell = LSTM(nIn=4, nOut=8, rnnDataFormat="NCW")
+    conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(0.01)).list()
+            .layer(cell)
+            .layer(RnnOutputLayer(nIn=8, nOut=4, activation="SOFTMAX",
+                                  lossFunction="MCXENT", rnnDataFormat="NCW"))
+            .backpropType("TruncatedBPTT").tBPTTForwardLength(8).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DataSet(x, y))
+    assert net.getIterationCount() == 3  # 24/8 segments over TIME

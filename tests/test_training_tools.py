@@ -1,0 +1,174 @@
+"""ModelSerializer / listeners / early stopping / transfer learning tests
+(ref: dl4j-integration-tests serialize->restore->continue equivalence,
+EarlyStoppingTrainer tests, TransferLearning tests)."""
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition, ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning)
+from deeplearning4j_tpu.optimize import (
+    CheckpointListener, CollectScoresListener, ScoreIterationListener)
+from deeplearning4j_tpu.train.updaters import Adam, Sgd
+from deeplearning4j_tpu.util import ModelSerializer
+
+
+def _net(seed=7, lr=0.1):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(lr))
+            .list()
+            .layer(DenseLayer(nIn=4, nOut=16, activation="RELU"))
+            .layer(DenseLayer(nIn=16, nOut=16, activation="TANH"))
+            .layer(OutputLayer(nIn=16, nOut=3, activation="SOFTMAX",
+                               lossFunction="MCXENT"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(x, y)
+
+
+def test_serializer_roundtrip_exact_resume(tmp_path):
+    """save -> restore -> continue must equal continuous training (the
+    reference's serialize/restore/continue golden test)."""
+    ds = _data()
+    a = _net()
+    a.fit(ds, epochs=3)
+    path = str(tmp_path / "model.zip")
+    ModelSerializer.writeModel(a, path, saveUpdater=True)
+    b = ModelSerializer.restoreMultiLayerNetwork(path)
+    np.testing.assert_allclose(a.params().toNumpy(), b.params().toNumpy(), atol=1e-6)
+    assert b.getIterationCount() == a.getIterationCount()
+    # continue training both: identical trajectories requires identical rng —
+    # use a fresh deterministic comparison instead: one more fit step each
+    a.fit(ds)
+    b.fit(ds)
+    np.testing.assert_allclose(a.score(ds), b.score(ds), rtol=1e-4)
+
+
+def test_collect_scores_and_score_listener(capsys):
+    net = _net()
+    coll = CollectScoresListener()
+    net.setListeners(ScoreIterationListener(1), coll)
+    net.fit(_data(), epochs=3)
+    assert len(coll.scores) == 3
+    assert coll.scores[-1] < coll.scores[0]
+    assert "Score at iteration" in capsys.readouterr().out
+
+
+def test_checkpoint_listener_retention(tmp_path):
+    d = str(tmp_path / "cp")
+    net = _net()
+    net.setListeners(CheckpointListener(d, keepLast=2, saveEveryNIterations=1))
+    net.fit(_data(), epochs=5)
+    cps = CheckpointListener.availableCheckpoints(d)
+    assert len(cps) == 2  # retention pruned to keepLast
+    restored = CheckpointListener.loadCheckpointMLN(d)
+    np.testing.assert_allclose(restored.params().toNumpy(),
+                               net.params().toNumpy(), atol=1e-6)
+
+
+def test_early_stopping_max_epochs():
+    ds = _data()
+    it = ListDataSetIterator(ds.batchBy(8))
+    esc = (EarlyStoppingConfiguration.Builder()
+           .epochTerminationConditions(MaxEpochsTerminationCondition(4))
+           .scoreCalculator(DataSetLossCalculator(ListDataSetIterator(ds.batchBy(8))))
+           .modelSaver(InMemoryModelSaver())
+           .build())
+    result = EarlyStoppingTrainer(esc, _net(), it).fit()
+    assert result.totalEpochs == 4
+    assert result.bestModel is not None
+    assert result.bestModelScore <= max(result.scoreVsEpoch.values())
+
+
+def test_early_stopping_no_improvement(tmp_path):
+    ds = _data()
+    it = ListDataSetIterator(ds.batchBy(8))
+    esc = (EarlyStoppingConfiguration.Builder()
+           .epochTerminationConditions(
+               MaxEpochsTerminationCondition(100),
+               ScoreImprovementEpochTerminationCondition(2))
+           .scoreCalculator(DataSetLossCalculator(ListDataSetIterator(ds.batchBy(8))))
+           .modelSaver(LocalFileModelSaver(str(tmp_path)))
+           .build())
+    net = _net(lr=1.0)  # big lr so score oscillates and stops improving
+    result = EarlyStoppingTrainer(esc, net, it).fit()
+    assert result.totalEpochs < 100
+    assert os.path.exists(str(tmp_path / "bestModel.zip"))
+
+
+def test_early_stopping_divergence_guard():
+    ds = _data()
+    it = ListDataSetIterator(ds.batchBy(8))
+    esc = (EarlyStoppingConfiguration.Builder()
+           .epochTerminationConditions(MaxEpochsTerminationCondition(100))
+           .iterationTerminationConditions(MaxScoreIterationTerminationCondition(1e-9))
+           .build())
+    result = EarlyStoppingTrainer(esc, _net(), it).fit()
+    assert result.terminationReason == "IterationTerminationCondition"
+
+
+def test_transfer_learning_freeze_and_replace():
+    ds = _data()
+    base = _net()
+    base.fit(ds, epochs=5)
+    frozen_w = base.getParam(0, "W").toNumpy().copy()
+
+    net2 = (TransferLearning.Builder(base)
+            .fineTuneConfiguration(FineTuneConfiguration.Builder()
+                                   .updater(Sgd(0.5)).build())
+            .setFeatureExtractor(1)          # freeze layers 0..1
+            .removeOutputLayer()
+            .addLayer(OutputLayer(nIn=16, nOut=5, activation="SOFTMAX",
+                                  lossFunction="MCXENT"))
+            .build())
+    # retained body weights transferred
+    np.testing.assert_allclose(net2.getParam(0, "W").toNumpy(), frozen_w, atol=1e-6)
+    # new head has 5 classes
+    rng = np.random.default_rng(1)
+    y5 = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 32)]
+    ds5 = DataSet(ds.features, y5)
+    net2.fit(ds5, epochs=5)
+    # frozen layers unchanged, head trained
+    np.testing.assert_allclose(net2.getParam(0, "W").toNumpy(), frozen_w, atol=1e-6)
+    assert net2.output(ds.features).shape == (32, 5)
+
+
+def test_transfer_learning_nout_replace():
+    base = _net()
+    net2 = (TransferLearning.Builder(base)
+            .nOutReplace(1, 8)
+            .build())
+    assert net2._params[1]["W"].shape == (16, 8)
+    assert net2._params[2]["W"].shape == (8, 3)
+    # layer 0 transferred
+    np.testing.assert_allclose(net2.getParam(0, "W").toNumpy(),
+                               base.getParam(0, "W").toNumpy(), atol=1e-6)
+
+
+def test_frozen_layers_immune_to_adamw_decay():
+    """Decoupled weight decay must not mutate frozen layers (review finding:
+    zeroed grads alone leave AdamW's wd*param update active)."""
+    from deeplearning4j_tpu.train.updaters import AdamW
+    ds = _data()
+    base = _net()
+    base.fit(ds, epochs=2)
+    net2 = (TransferLearning.Builder(base)
+            .fineTuneConfiguration(FineTuneConfiguration.Builder()
+                                   .updater(AdamW(0.01)).build())
+            .setFeatureExtractor(0)
+            .build())
+    w0 = net2.getParam(0, "W").toNumpy().copy()
+    net2.fit(ds, epochs=3)
+    np.testing.assert_array_equal(net2.getParam(0, "W").toNumpy(), w0)
