@@ -56,7 +56,7 @@ class ComputationGraph:
         self._state = {}
         for i, n in enumerate(layer_nodes):
             self._params[n.name] = n.op.init_params(keys[i], self._dtype)
-            self._state[n.name] = n.op.init_state()
+            self._state[n.name] = n.op.init_state(self._dtype)
         self._tx = self.conf.updater.to_optax()
         self._opt_state = self._tx.init(self._params)
         return self

@@ -8,7 +8,8 @@ a pure ``apply(params, x) -> y`` traced under jit, so each config class here
 carries its own init/apply — one class per reference pair:
 
 - ``init_params(key, dtype)``   — parameter pytree (ref: nn.params.*ParamInitializer)
-- ``init_state()``              — non-trainable state (BN running stats)
+- ``init_state(dtype)``         — non-trainable state (BN running stats; norm
+  statistics are kept >= fp32 even when ``dtype`` is bf16)
 - ``apply(params, x, ...)``     — forward; gradients come from jax.grad, so the
   reference's per-layer ``backpropGradient`` has no analog (deleted by design)
 - ``output_type(input)``        — shape inference (ref: InputType.getOutputType)
@@ -76,7 +77,7 @@ class Layer:
     def init_params(self, key, dtype=jnp.float32) -> dict:
         return {}
 
-    def init_state(self) -> dict:
+    def init_state(self, dtype=jnp.float32) -> dict:
         return {}
 
     def regularizable(self) -> Tuple[str, ...]:
@@ -448,8 +449,12 @@ class BatchNormalization(FeedForwardLayer):
         return {"gamma": jnp.full((self.nIn,), self.gamma_init, dtype),
                 "beta": jnp.full((self.nIn,), self.beta_init, dtype)}
 
-    def init_state(self):
-        return {"mean": jnp.zeros((self.nIn,)), "var": jnp.ones((self.nIn,))}
+    def init_state(self, dtype=jnp.float32):
+        # norm statistics stay >= fp32 even for HALF networks (standard mixed-
+        # precision practice): bf16 EMA would quantize away small corrections
+        stat_dtype = jnp.float32 if dtype == jnp.bfloat16 else dtype
+        return {"mean": jnp.zeros((self.nIn,), stat_dtype),
+                "var": jnp.ones((self.nIn,), stat_dtype)}
 
     def regularizable(self):
         return ()
@@ -468,7 +473,7 @@ class BatchNormalization(FeedForwardLayer):
         y = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + self.eps)
         if params:
             y = y * params["gamma"].reshape(shape) + params["beta"].reshape(shape)
-        return self._activate(y), new_state
+        return self._activate(y).astype(x.dtype), new_state
 
 
 @dataclass

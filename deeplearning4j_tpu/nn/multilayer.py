@@ -113,7 +113,7 @@ class MultiLayerNetwork:
         key = jax.random.key(self.conf.seed)
         keys = jax.random.split(key, max(len(self.layers), 1))
         self._params = [l.init_params(keys[i], self._dtype) for i, l in enumerate(self.layers)]
-        self._state = [l.init_state() for l in self.layers]
+        self._state = [l.init_state(self._dtype) for l in self.layers]
         self._tx = self.conf.updater.to_optax()
         self._opt_state = self._tx.init(self._params)
         return self

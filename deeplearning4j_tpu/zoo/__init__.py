@@ -1,0 +1,10 @@
+"""Model zoo (ref: deeplearning4j-zoo — org.deeplearning4j.zoo.ZooModel and
+org.deeplearning4j.zoo.model.*)."""
+from deeplearning4j_tpu.zoo.models import (
+    ZooModel, LeNet, SimpleCNN, AlexNet, VGG16, VGG19, ResNet50, SqueezeNet,
+    Darknet19, UNet, Xception, TextGenerationLSTM)
+
+__all__ = [
+    "ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19", "ResNet50",
+    "SqueezeNet", "Darknet19", "UNet", "Xception", "TextGenerationLSTM",
+]

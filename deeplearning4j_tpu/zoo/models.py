@@ -1,0 +1,437 @@
+"""Reference architectures (ref: deeplearning4j-zoo/src/main/java/org/
+deeplearning4j/zoo/model/ — LeNet, SimpleCNN, AlexNet, VGG16/19, ResNet50,
+SqueezeNet, Darknet19, UNet, Xception, TextGenerationLSTM).
+
+Each model is a config builder over the nn DSL, exactly as the reference's
+ZooModel.conf() methods build MultiLayerConfiguration/
+ComputationGraphConfiguration. Pretrained-weight downloads (ZooModel.
+initPretrained) require network access the build environment lacks — the
+hook exists and raises with a clear message; the Keras-h5 importer covers
+weight loading for users with local files."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.nn import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, Deconvolution2D, GlobalPoolingLayer, LSTM, LocalResponseNormalization,
+    OutputLayer, RnnOutputLayer, SeparableConvolution2D, SubsamplingLayer,
+    ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.updaters import AdaDelta, Adam, Nesterovs
+
+
+class ZooModel:
+    """(ref: org.deeplearning4j.zoo.ZooModel)."""
+    numClasses: int
+    seed: int
+    inputShape: Tuple[int, int, int]
+
+    def __init__(self, numClasses: int = 1000, seed: int = 123,
+                 inputShape: Tuple[int, int, int] = (3, 224, 224)):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        """Build + initialize the network (ref: ZooModel.init)."""
+        c = self.conf()
+        from deeplearning4j_tpu.nn.conf.builder import MultiLayerConfiguration
+        if isinstance(c, MultiLayerConfiguration):
+            return MultiLayerNetwork(c).init()
+        return ComputationGraph(c).init()
+
+    def initPretrained(self, pretrained_type: str = "IMAGENET"):
+        raise NotImplementedError(
+            "pretrained weight download is unavailable in this environment; "
+            "use deeplearning4j_tpu.modelimport.keras to load local .h5 weights "
+            "(ref: ZooModel.initPretrained)")
+
+    def pretrainedAvailable(self, *_):
+        return False
+
+
+class LeNet(ZooModel):
+    """(ref: zoo.model.LeNet — BASELINE config #1)."""
+
+    def __init__(self, numClasses: int = 10, seed: int = 123,
+                 inputShape: Tuple[int, int, int] = (1, 28, 28)):
+        super().__init__(numClasses, seed, inputShape)
+
+    def conf(self):
+        c, h, w = self.inputShape
+        return (NeuralNetConfiguration.Builder().seed(self.seed)
+                .updater(Adam(1e-3)).weightInit("XAVIER")
+                .list()
+                .layer(ConvolutionLayer(nOut=20, kernelSize=(5, 5), stride=(1, 1),
+                                        convolutionMode="Same", activation="IDENTITY"))
+                .layer(SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(nOut=50, kernelSize=(5, 5), stride=(1, 1),
+                                        convolutionMode="Same", activation="IDENTITY"))
+                .layer(SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(nOut=500, activation="RELU"))
+                .layer(OutputLayer(nOut=self.numClasses, activation="SOFTMAX",
+                                   lossFunction="MCXENT"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+
+class SimpleCNN(ZooModel):
+    """(ref: zoo.model.SimpleCNN)."""
+
+    def __init__(self, numClasses: int = 10, seed: int = 123,
+                 inputShape: Tuple[int, int, int] = (3, 48, 48)):
+        super().__init__(numClasses, seed, inputShape)
+
+    def conf(self):
+        c, h, w = self.inputShape
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(AdaDelta()).activation("RELU").weightInit("XAVIER")
+             .list())
+        for n_out in (96, 96, 192, 192):
+            b = b.layer(ConvolutionLayer(nOut=n_out, kernelSize=(3, 3),
+                                         convolutionMode="Same", activation="IDENTITY"))
+            b = b.layer(BatchNormalization())
+            b = b.layer(ActivationLayer(activation="RELU"))
+        b = (b.layer(SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2), stride=(2, 2)))
+             .layer(DropoutLayer(dropOut=0.5))
+             .layer(GlobalPoolingLayer(poolingType="AVG"))
+             .layer(OutputLayer(nOut=self.numClasses, activation="SOFTMAX",
+                                lossFunction="MCXENT")))
+        return b.setInputType(InputType.convolutional(h, w, c)).build()
+
+
+class AlexNet(ZooModel):
+    """(ref: zoo.model.AlexNet — one-tower variant)."""
+
+    def conf(self):
+        c, h, w = self.inputShape
+        return (NeuralNetConfiguration.Builder().seed(self.seed)
+                .updater(Nesterovs(1e-2, 0.9)).weightInit("NORMAL")
+                .list()
+                .layer(ConvolutionLayer(nOut=96, kernelSize=(11, 11), stride=(4, 4),
+                                        activation="RELU"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(poolingType="MAX", kernelSize=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(nOut=256, kernelSize=(5, 5), convolutionMode="Same",
+                                        activation="RELU", biasInit=1.0))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(poolingType="MAX", kernelSize=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(nOut=384, kernelSize=(3, 3), convolutionMode="Same",
+                                        activation="RELU"))
+                .layer(ConvolutionLayer(nOut=384, kernelSize=(3, 3), convolutionMode="Same",
+                                        activation="RELU", biasInit=1.0))
+                .layer(ConvolutionLayer(nOut=256, kernelSize=(3, 3), convolutionMode="Same",
+                                        activation="RELU", biasInit=1.0))
+                .layer(SubsamplingLayer(poolingType="MAX", kernelSize=(3, 3), stride=(2, 2)))
+                .layer(DenseLayer(nOut=4096, activation="RELU", dropOut=0.5, biasInit=1.0))
+                .layer(DenseLayer(nOut=4096, activation="RELU", dropOut=0.5, biasInit=1.0))
+                .layer(OutputLayer(nOut=self.numClasses, activation="SOFTMAX",
+                                   lossFunction="MCXENT"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+
+def _vgg_blocks(b, spec):
+    for n_convs, n_out in spec:
+        for _ in range(n_convs):
+            b = b.layer(ConvolutionLayer(nOut=n_out, kernelSize=(3, 3),
+                                         convolutionMode="Same", activation="RELU"))
+        b = b.layer(SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2), stride=(2, 2)))
+    return b
+
+
+class VGG16(ZooModel):
+    """(ref: zoo.model.VGG16)."""
+
+    _spec = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+
+    def conf(self):
+        c, h, w = self.inputShape
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Nesterovs(1e-2, 0.9)).weightInit("XAVIER").list())
+        b = _vgg_blocks(b, self._spec)
+        return (b.layer(DenseLayer(nOut=4096, activation="RELU", dropOut=0.5))
+                .layer(DenseLayer(nOut=4096, activation="RELU", dropOut=0.5))
+                .layer(OutputLayer(nOut=self.numClasses, activation="SOFTMAX",
+                                   lossFunction="MCXENT"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+
+class VGG19(VGG16):
+    """(ref: zoo.model.VGG19)."""
+    _spec = [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)]
+
+
+class ResNet50(ZooModel):
+    """(ref: zoo.model.ResNet50 — BASELINE config #2). Bottleneck residual
+    blocks over ComputationGraph with ElementWiseVertex(Add) shortcuts."""
+
+    def conf(self):
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("RELU")  # he-style
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        g.addLayer("stem_conv", ConvolutionLayer(nOut=64, kernelSize=(7, 7), stride=(2, 2),
+                                                 convolutionMode="Same",
+                                                 activation="IDENTITY"), "input")
+        g.addLayer("stem_bn", BatchNormalization(activation="RELU"), "stem_conv")
+        g.addLayer("stem_pool", SubsamplingLayer(poolingType="MAX", kernelSize=(3, 3),
+                                                 stride=(2, 2), convolutionMode="Same"),
+                   "stem_bn")
+        prev = "stem_pool"
+        stages = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2), (3, 512, 2048, 2)]
+        for si, (blocks, mid, out, first_stride) in enumerate(stages):
+            for bi in range(blocks):
+                stride = first_stride if bi == 0 else 1
+                name = f"s{si}b{bi}"
+                g.addLayer(f"{name}_c1", ConvolutionLayer(nOut=mid, kernelSize=(1, 1),
+                                                          stride=(stride, stride),
+                                                          activation="IDENTITY"), prev)
+                g.addLayer(f"{name}_bn1", BatchNormalization(activation="RELU"), f"{name}_c1")
+                g.addLayer(f"{name}_c2", ConvolutionLayer(nOut=mid, kernelSize=(3, 3),
+                                                          convolutionMode="Same",
+                                                          activation="IDENTITY"), f"{name}_bn1")
+                g.addLayer(f"{name}_bn2", BatchNormalization(activation="RELU"), f"{name}_c2")
+                g.addLayer(f"{name}_c3", ConvolutionLayer(nOut=out, kernelSize=(1, 1),
+                                                          activation="IDENTITY"), f"{name}_bn2")
+                g.addLayer(f"{name}_bn3", BatchNormalization(activation="IDENTITY"), f"{name}_c3")
+                if bi == 0:
+                    g.addLayer(f"{name}_sc", ConvolutionLayer(nOut=out, kernelSize=(1, 1),
+                                                              stride=(stride, stride),
+                                                              activation="IDENTITY"), prev)
+                    g.addLayer(f"{name}_scbn", BatchNormalization(activation="IDENTITY"),
+                               f"{name}_sc")
+                    shortcut = f"{name}_scbn"
+                else:
+                    shortcut = prev
+                g.addVertex(f"{name}_add", ElementWiseVertex(op="Add"),
+                            f"{name}_bn3", shortcut)
+                g.addLayer(f"{name}_relu", ActivationLayer(activation="RELU"), f"{name}_add")
+                prev = f"{name}_relu"
+        g.addLayer("avgpool", GlobalPoolingLayer(poolingType="AVG"), prev)
+        g.addLayer("output", OutputLayer(nOut=self.numClasses, activation="SOFTMAX",
+                                         lossFunction="MCXENT"), "avgpool")
+        g.setOutputs("output")
+        return g.build()
+
+
+class SqueezeNet(ZooModel):
+    """(ref: zoo.model.SqueezeNet — fire modules: squeeze 1x1 -> expand 1x1|3x3)."""
+
+    def conf(self):
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("XAVIER")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        g.addLayer("conv1", ConvolutionLayer(nOut=64, kernelSize=(3, 3), stride=(2, 2),
+                                             convolutionMode="Same", activation="RELU"),
+                   "input")
+        g.addLayer("pool1", SubsamplingLayer(poolingType="MAX", kernelSize=(3, 3),
+                                             stride=(2, 2), convolutionMode="Same"), "conv1")
+        prev = "pool1"
+        fires = [(16, 64), (16, 64), (32, 128), (32, 128),
+                 (48, 192), (48, 192), (64, 256), (64, 256)]
+        for i, (sq, ex) in enumerate(fires):
+            n = f"fire{i + 2}"
+            g.addLayer(f"{n}_sq", ConvolutionLayer(nOut=sq, kernelSize=(1, 1),
+                                                   activation="RELU"), prev)
+            g.addLayer(f"{n}_e1", ConvolutionLayer(nOut=ex, kernelSize=(1, 1),
+                                                   activation="RELU"), f"{n}_sq")
+            g.addLayer(f"{n}_e3", ConvolutionLayer(nOut=ex, kernelSize=(3, 3),
+                                                   convolutionMode="Same",
+                                                   activation="RELU"), f"{n}_sq")
+            g.addVertex(f"{n}_cat", MergeVertex(), f"{n}_e1", f"{n}_e3")
+            prev = f"{n}_cat"
+            if i in (2, 6):
+                g.addLayer(f"pool{i}", SubsamplingLayer(poolingType="MAX", kernelSize=(3, 3),
+                                                        stride=(2, 2), convolutionMode="Same"),
+                           prev)
+                prev = f"pool{i}"
+        g.addLayer("drop", DropoutLayer(dropOut=0.5), prev)
+        g.addLayer("conv10", ConvolutionLayer(nOut=self.numClasses, kernelSize=(1, 1),
+                                              activation="RELU"), "drop")
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="AVG"), "conv10")
+        g.addLayer("output", OutputLayer(nIn=self.numClasses, nOut=self.numClasses,
+                                         activation="SOFTMAX", lossFunction="MCXENT"), "gap")
+        g.setOutputs("output")
+        return g.build()
+
+
+class Darknet19(ZooModel):
+    """(ref: zoo.model.Darknet19)."""
+
+    def conf(self):
+        c, h, w = self.inputShape
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Nesterovs(1e-3, 0.9)).weightInit("XAVIER").list())
+
+        def conv_bn(b, n_out, k):
+            return (b.layer(ConvolutionLayer(nOut=n_out, kernelSize=(k, k),
+                                             convolutionMode="Same", hasBias=False,
+                                             activation="IDENTITY"))
+                    .layer(BatchNormalization(activation="LEAKYRELU")))
+
+        spec = [(32, 3, True), (64, 3, True),
+                (128, 3, False), (64, 1, False), (128, 3, True),
+                (256, 3, False), (128, 1, False), (256, 3, True),
+                (512, 3, False), (256, 1, False), (512, 3, False), (256, 1, False),
+                (512, 3, True),
+                (1024, 3, False), (512, 1, False), (1024, 3, False), (512, 1, False),
+                (1024, 3, False)]
+        for n_out, k, pool in spec:
+            b = conv_bn(b, n_out, k)
+            if pool:
+                b = b.layer(SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2),
+                                             stride=(2, 2)))
+        return (b.layer(ConvolutionLayer(nOut=self.numClasses, kernelSize=(1, 1),
+                                         activation="IDENTITY"))
+                .layer(GlobalPoolingLayer(poolingType="AVG"))
+                .layer(OutputLayer(nIn=self.numClasses, nOut=self.numClasses,
+                                   activation="SOFTMAX", lossFunction="MCXENT"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+
+class UNet(ZooModel):
+    """(ref: zoo.model.UNet — encoder/decoder with skip MergeVertex concat;
+    sigmoid pixel output)."""
+
+    def __init__(self, numClasses: int = 1, seed: int = 123,
+                 inputShape: Tuple[int, int, int] = (3, 128, 128), depth: int = 4,
+                 baseFilters: int = 16):
+        super().__init__(numClasses, seed, inputShape)
+        self.depth = depth
+        self.baseFilters = baseFilters
+
+    def conf(self):
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("XAVIER")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def double_conv(name, n_out, src):
+            g.addLayer(f"{name}_c1", ConvolutionLayer(nOut=n_out, kernelSize=(3, 3),
+                                                      convolutionMode="Same",
+                                                      activation="RELU"), src)
+            g.addLayer(f"{name}_c2", ConvolutionLayer(nOut=n_out, kernelSize=(3, 3),
+                                                      convolutionMode="Same",
+                                                      activation="RELU"), f"{name}_c1")
+            return f"{name}_c2"
+
+        skips = []
+        prev = "input"
+        f = self.baseFilters
+        for d in range(self.depth):
+            prev = double_conv(f"enc{d}", f * (2 ** d), prev)
+            skips.append(prev)
+            g.addLayer(f"down{d}", SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2),
+                                                    stride=(2, 2)), prev)
+            prev = f"down{d}"
+        prev = double_conv("bottleneck", f * (2 ** self.depth), prev)
+        for d in reversed(range(self.depth)):
+            g.addLayer(f"up{d}", Deconvolution2D(nOut=f * (2 ** d), kernelSize=(2, 2),
+                                                 stride=(2, 2), convolutionMode="Same",
+                                                 activation="RELU"), prev)
+            g.addVertex(f"skip{d}", MergeVertex(), f"up{d}", skips[d])
+            prev = double_conv(f"dec{d}", f * (2 ** d), f"skip{d}")
+        g.addLayer("head", ConvolutionLayer(nOut=self.numClasses, kernelSize=(1, 1),
+                                            activation="SIGMOID"), prev)
+        from deeplearning4j_tpu.nn.conf.layers import LossLayer
+        g.addLayer("output", LossLayer(lossFunction="XENT"), "head")
+        g.setOutputs("output")
+        return g.build()
+
+
+class Xception(ZooModel):
+    """(ref: zoo.model.Xception — depthwise-separable conv towers with
+    residual shortcuts; simplified to entry + 4 middle blocks + exit)."""
+
+    def conf(self):
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("XAVIER")
+             .graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        g.addLayer("stem1", ConvolutionLayer(nOut=32, kernelSize=(3, 3), stride=(2, 2),
+                                             convolutionMode="Same", hasBias=False,
+                                             activation="IDENTITY"), "input")
+        g.addLayer("stem1_bn", BatchNormalization(activation="RELU"), "stem1")
+        g.addLayer("stem2", ConvolutionLayer(nOut=64, kernelSize=(3, 3),
+                                             convolutionMode="Same", hasBias=False,
+                                             activation="IDENTITY"), "stem1_bn")
+        g.addLayer("stem2_bn", BatchNormalization(activation="RELU"), "stem2")
+        prev = "stem2_bn"
+        for i, n_out in enumerate((128, 256, 728)):
+            n = f"entry{i}"
+            g.addLayer(f"{n}_s1", SeparableConvolution2D(nOut=n_out, kernelSize=(3, 3),
+                                                         convolutionMode="Same",
+                                                         activation="RELU"), prev)
+            g.addLayer(f"{n}_s2", SeparableConvolution2D(nOut=n_out, kernelSize=(3, 3),
+                                                         convolutionMode="Same",
+                                                         activation="IDENTITY"), f"{n}_s1")
+            g.addLayer(f"{n}_pool", SubsamplingLayer(poolingType="MAX", kernelSize=(3, 3),
+                                                     stride=(2, 2), convolutionMode="Same"),
+                       f"{n}_s2")
+            g.addLayer(f"{n}_sc", ConvolutionLayer(nOut=n_out, kernelSize=(1, 1),
+                                                   stride=(2, 2), convolutionMode="Same",
+                                                   activation="IDENTITY"), prev)
+            g.addVertex(f"{n}_add", ElementWiseVertex(op="Add"), f"{n}_pool", f"{n}_sc")
+            prev = f"{n}_add"
+        for i in range(4):  # middle flow (reference has 8; 4 keeps tests fast)
+            n = f"mid{i}"
+            src = prev
+            for j in range(3):
+                g.addLayer(f"{n}_s{j}", SeparableConvolution2D(
+                    nOut=728, kernelSize=(3, 3), convolutionMode="Same",
+                    activation="RELU"), prev)
+                prev = f"{n}_s{j}"
+            g.addVertex(f"{n}_add", ElementWiseVertex(op="Add"), prev, src)
+            prev = f"{n}_add"
+        g.addLayer("exit_s1", SeparableConvolution2D(nOut=1024, kernelSize=(3, 3),
+                                                     convolutionMode="Same",
+                                                     activation="RELU"), prev)
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="AVG"), "exit_s1")
+        g.addLayer("output", OutputLayer(nIn=1024, nOut=self.numClasses,
+                                         activation="SOFTMAX", lossFunction="MCXENT"), "gap")
+        g.setOutputs("output")
+        return g.build()
+
+
+class TextGenerationLSTM(ZooModel):
+    """(ref: zoo.model.TextGenerationLSTM — the GravesLSTM char-RNN,
+    BASELINE config #3)."""
+
+    def __init__(self, totalUniqueCharacters: int = 47, seed: int = 123,
+                 lstmLayerSize: int = 200):
+        super().__init__(totalUniqueCharacters, seed, (0, 0, 0))
+        self.lstmLayerSize = lstmLayerSize
+
+    def conf(self):
+        n = self.numClasses
+        return (NeuralNetConfiguration.Builder().seed(self.seed)
+                .updater(Adam(1e-3)).weightInit("XAVIER")
+                .list()
+                .layer(LSTM(nIn=n, nOut=self.lstmLayerSize, activation="TANH"))
+                .layer(LSTM(nIn=self.lstmLayerSize, nOut=self.lstmLayerSize,
+                            activation="TANH"))
+                .layer(RnnOutputLayer(nIn=self.lstmLayerSize, nOut=n,
+                                      activation="SOFTMAX", lossFunction="MCXENT"))
+                .backpropType("TruncatedBPTT").tBPTTForwardLength(50)
+                .tBPTTBackwardLength(50)
+                .build())

@@ -1,0 +1,90 @@
+"""Zoo architecture smoke tests (ref: deeplearning4j-zoo TestInstantiation
+pattern: build, forward shape, one fit step). Small spatial inputs keep the
+virtual-CPU suite fast; architectures are input-size agnostic via global
+pooling / Same convs."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.zoo import (
+    AlexNet, Darknet19, LeNet, ResNet50, SimpleCNN, SqueezeNet,
+    TextGenerationLSTM, UNet, VGG16, VGG19, Xception)
+
+RNG = np.random.default_rng(0)
+
+
+def _img(b, c, h, w):
+    return RNG.normal(size=(b, c, h, w)).astype(np.float32)
+
+
+def _onehot(b, n):
+    return np.eye(n, dtype=np.float32)[RNG.integers(0, n, b)]
+
+
+def test_lenet_mnist_shape_and_fit():
+    net = LeNet(numClasses=10).init()
+    x, y = _img(4, 1, 28, 28), _onehot(4, 10)
+    assert net.output(x).shape == (4, 10)
+    s0 = None
+    for _ in range(3):
+        net.fit(DataSet(x, y))
+        s0 = s0 or net.score()
+    assert np.isfinite(net.score())
+
+
+@pytest.mark.parametrize("cls,shape,ncls", [
+    (SimpleCNN, (3, 32, 32), 5),
+    (AlexNet, (3, 80, 80), 7),
+    (VGG16, (3, 32, 32), 5),
+    (VGG19, (3, 32, 32), 5),
+    (Darknet19, (3, 64, 64), 5),
+])
+def test_mln_zoo_forward(cls, shape, ncls):
+    net = cls(numClasses=ncls, inputShape=shape).init()
+    x = _img(2, *shape)
+    out = net.output(x)
+    assert out.shape == (2, ncls)
+    np.testing.assert_allclose(out.toNumpy().sum(1), 1.0, atol=1e-4)  # softmax
+
+
+@pytest.mark.parametrize("cls,shape,ncls", [
+    (ResNet50, (3, 64, 64), 6),
+    (SqueezeNet, (3, 64, 64), 6),
+    (Xception, (3, 64, 64), 6),
+])
+def test_cg_zoo_forward_and_fit(cls, shape, ncls):
+    net = cls(numClasses=ncls, inputShape=shape).init()
+    x, y = _img(2, *shape), _onehot(2, ncls)
+    out = net.outputSingle(x)
+    assert out.shape == (2, ncls)
+    net.fit(DataSet(x, y))
+    assert np.isfinite(net.score())
+
+
+def test_resnet50_depth():
+    conf = ResNet50(numClasses=4, inputShape=(3, 64, 64)).conf()
+    conv_count = sum(1 for n in conf.nodes
+                     if type(n.op).__name__ == "ConvolutionLayer")
+    assert conv_count == 53  # 1 stem + 16*3 bottleneck + 4 shortcuts
+
+
+def test_unet_segmentation_shape():
+    net = UNet(inputShape=(3, 32, 32), depth=2, baseFilters=4).init()
+    x = _img(2, 3, 32, 32)
+    out = net.outputSingle(x)
+    assert out.shape == (2, 1, 32, 32)
+    vals = out.toNumpy()
+    assert ((vals >= 0) & (vals <= 1)).all()  # sigmoid
+    y = (RNG.random((2, 1, 32, 32)) > 0.5).astype(np.float32)
+    net.fit(DataSet(x, y))
+    assert np.isfinite(net.score())
+
+
+def test_text_generation_lstm():
+    net = TextGenerationLSTM(totalUniqueCharacters=12, lstmLayerSize=16).init()
+    x = RNG.normal(size=(2, 60, 12)).astype(np.float32)
+    y = np.eye(12, dtype=np.float32)[RNG.integers(0, 12, (2, 60))]
+    net.fit(DataSet(x, y))
+    assert net.getIterationCount() == 2  # 60 steps / tbptt 50 -> 2 segments
+    out = net.output(x)
+    assert out.shape == (2, 60, 12)
