@@ -1,0 +1,1 @@
+"""Framework import (ref: deeplearning4j-modelimport + nd4j/samediff-import)."""

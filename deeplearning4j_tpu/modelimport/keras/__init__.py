@@ -1,0 +1,5 @@
+"""Keras h5 import (ref: deeplearning4j-modelimport —
+org.deeplearning4j.nn.modelimport.keras.KerasModelImport)."""
+from deeplearning4j_tpu.modelimport.keras.importer import KerasModelImport
+
+__all__ = ["KerasModelImport"]

@@ -1,0 +1,482 @@
+"""Keras h5 -> network import (ref: deeplearning4j-modelimport —
+KerasModelImport.importKerasSequentialModelAndWeights /
+importKerasModelAndWeights; per-layer mappers under
+o.d.nn.modelimport.keras.layers.*; weights via Hdf5Archive).
+
+Layout conversion is the core job, exactly as in the reference's KerasLayer
+mappers: Keras is channels_last (NHWC, HWIO kernels); this framework is NCHW /
+OIHW. Conv kernels are transposed; a Dense that directly follows a Flatten of
+a conv feature map gets its input rows permuted from Keras' (H,W,C) flatten
+order to our (C,H,W) order (ref: KerasModelUtils weight reshaping).
+
+Supports the Keras-3 legacy ``.h5`` container (``model_config`` JSON attr +
+``model_weights`` groups) for both Sequential and Functional models."""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.updaters import Adam
+
+_ACT = {
+    "relu": "RELU", "softmax": "SOFTMAX", "sigmoid": "SIGMOID", "tanh": "TANH",
+    "linear": "IDENTITY", "elu": "ELU", "selu": "SELU", "softplus": "SOFTPLUS",
+    "softsign": "SOFTSIGN", "hard_sigmoid": "HARDSIGMOID", "swish": "SWISH",
+    "gelu": "GELU", "leaky_relu": "LEAKYRELU", "exponential": "IDENTITY",
+}
+
+
+def _act(name: Optional[str]) -> str:
+    return _ACT.get((name or "linear").lower(), "IDENTITY")
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+class _WeightStore:
+    """Reads Keras-3 legacy h5 weight groups: model_weights/<layer>/**/<name>."""
+
+    def __init__(self, h5file):
+        self.f = h5file
+
+    def layer_weights(self, layer_name: str) -> Dict[str, np.ndarray]:
+        """Flat {basename: array} for the layer (unique within one layer)."""
+        return {k.rsplit("/", 1)[-1]: v
+                for k, v in self.layer_weight_paths(layer_name).items()}
+
+    def layer_weight_paths(self, layer_name: str) -> Dict[str, np.ndarray]:
+        """Full-path {path: array} — needed for wrappers (Bidirectional) whose
+        sub-layers repeat dataset names."""
+        mw = self.f["model_weights"]
+        if layer_name not in mw:
+            return {}
+        out = {}
+
+        def walk(group, prefix=""):
+            import h5py
+            for k in group:
+                item = group[k]
+                key = f"{prefix}{k}"
+                if isinstance(item, h5py.Group):
+                    walk(item, key + "/")
+                else:
+                    out[key.split(":")[0]] = np.asarray(item)
+
+        walk(mw[layer_name])
+        return out
+
+
+class KerasModelImport:
+    """(ref: org.deeplearning4j.nn.modelimport.keras.KerasModelImport)."""
+
+    @staticmethod
+    def importKerasSequentialModelAndWeights(path: str,
+                                             enforceTrainingConfig: bool = False
+                                             ) -> MultiLayerNetwork:
+        import h5py
+        with h5py.File(path, "r") as f:
+            cfg = json.loads(f.attrs["model_config"])
+            if cfg["class_name"] != "Sequential":
+                raise ValueError(
+                    f"{path} holds a {cfg['class_name']} — use importKerasModelAndWeights")
+            store = _WeightStore(f)
+            return _import_sequential(cfg["config"], store)
+
+    @staticmethod
+    def importKerasModelAndWeights(path: str,
+                                   enforceTrainingConfig: bool = False
+                                   ) -> ComputationGraph:
+        import h5py
+        with h5py.File(path, "r") as f:
+            cfg = json.loads(f.attrs["model_config"])
+            if cfg["class_name"] == "Sequential":
+                raise ValueError(
+                    f"{path} holds a Sequential — use importKerasSequentialModelAndWeights")
+            store = _WeightStore(f)
+            return _import_functional(cfg["config"], store)
+
+
+# ---------------------------------------------------------------- mapping
+
+def _input_type_from_shape(shape) -> Optional[InputType]:
+    """Keras batch_shape (None, H, W, C) / (None, T, F) / (None, F) -> InputType."""
+    dims = [d for d in shape[1:]]
+    if len(dims) == 3:
+        h, w, c = dims
+        return InputType.convolutional(h, w, c)
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0] or -1)
+    if len(dims) == 1:
+        return InputType.feedForward(dims[0])
+    return None
+
+
+def _map_layer(cls: str, c: dict) -> Tuple[Optional[L.Layer], bool]:
+    """Keras layer config -> (Layer | None, consumes_weights). None = structural
+    no-op at our level (Flatten/InputLayer)."""
+    act = _act(c.get("activation"))
+    same = (c.get("padding", "valid") == "same")
+    mode = "Same" if same else "Truncate"
+    if cls == "Dense":
+        return L.DenseLayer(nOut=c["units"], activation=act,
+                            hasBias=c.get("use_bias", True)), True
+    if cls == "Conv2D":
+        return L.ConvolutionLayer(nOut=c["filters"], kernelSize=_pair(c["kernel_size"]),
+                                  stride=_pair(c.get("strides", 1)),
+                                  dilation=_pair(c.get("dilation_rate", 1)),
+                                  convolutionMode=mode, activation=act,
+                                  hasBias=c.get("use_bias", True)), True
+    if cls == "DepthwiseConv2D":
+        return L.DepthwiseConvolution2D(depthMultiplier=c.get("depth_multiplier", 1),
+                                        kernelSize=_pair(c["kernel_size"]),
+                                        stride=_pair(c.get("strides", 1)),
+                                        convolutionMode=mode, activation=act,
+                                        hasBias=c.get("use_bias", True)), True
+    if cls == "SeparableConv2D":
+        return L.SeparableConvolution2D(nOut=c["filters"], kernelSize=_pair(c["kernel_size"]),
+                                        stride=_pair(c.get("strides", 1)),
+                                        convolutionMode=mode, activation=act,
+                                        hasBias=c.get("use_bias", True)), True
+    if cls == "Conv2DTranspose":
+        return L.Deconvolution2D(nOut=c["filters"], kernelSize=_pair(c["kernel_size"]),
+                                 stride=_pair(c.get("strides", 1)),
+                                 convolutionMode=mode, activation=act,
+                                 hasBias=c.get("use_bias", True)), True
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        return L.SubsamplingLayer(
+            poolingType="MAX" if cls.startswith("Max") else "AVG",
+            kernelSize=_pair(c.get("pool_size", 2)),
+            stride=_pair(c.get("strides") or c.get("pool_size", 2)),
+            convolutionMode=mode), False
+    if cls in ("GlobalAveragePooling2D", "GlobalMaxPooling2D",
+               "GlobalAveragePooling1D", "GlobalMaxPooling1D"):
+        return L.GlobalPoolingLayer(
+            poolingType="AVG" if "Average" in cls else "MAX"), False
+    if cls == "BatchNormalization":
+        return L.BatchNormalization(eps=c.get("epsilon", 1e-3),
+                                    decay=c.get("momentum", 0.99)), True
+    if cls == "Dropout":
+        return L.DropoutLayer(dropOut=1.0 - c["rate"]), False
+    if cls == "Activation":
+        return L.ActivationLayer(activation=act), False
+    if cls == "ReLU":
+        return L.ActivationLayer(activation="RELU"), False
+    if cls == "LeakyReLU":
+        # Keras default negative_slope is 0.3 (keras-2 key: "alpha")
+        return L.ActivationLayer(activation="LEAKYRELU",
+                                 alpha=c.get("negative_slope",
+                                             c.get("alpha", 0.3))), False
+    if cls == "Softmax":
+        return L.ActivationLayer(activation="SOFTMAX"), False
+    if cls == "ZeroPadding2D":
+        p = c.get("padding", 1)
+        if isinstance(p, (list, tuple)) and isinstance(p[0], (list, tuple)):
+            pad = (p[0][0], p[0][1], p[1][0], p[1][1])
+        else:
+            p = _pair(p)
+            pad = (p[0], p[0], p[1], p[1])
+        return L.ZeroPaddingLayer(padding=pad), False
+    if cls == "Cropping2D":
+        p = c.get("cropping", 1)
+        if isinstance(p, (list, tuple)) and isinstance(p[0], (list, tuple)):
+            crop = (p[0][0], p[0][1], p[1][0], p[1][1])
+        else:
+            p = _pair(p)
+            crop = (p[0], p[0], p[1], p[1])
+        return L.Cropping2D(cropping=crop), False
+    if cls == "UpSampling2D":
+        return L.Upsampling2D(size=_pair(c.get("size", 2))), False
+    if cls == "Embedding":
+        return L.EmbeddingSequenceLayer(nIn=c["input_dim"], nOut=c["output_dim"]), True
+    if cls in ("LSTM", "GRU", "SimpleRNN"):
+        if cls == "LSTM":
+            cell = L.LSTM(nOut=c["units"], activation=_act(c.get("activation", "tanh")))
+        elif cls == "GRU":
+            if not c.get("reset_after", True):
+                raise ValueError("GRU(reset_after=False) import is not supported")
+            cell = L.GRU(nOut=c["units"])
+        else:
+            cell = L.SimpleRnn(nOut=c["units"],
+                               activation=_act(c.get("activation", "tanh")))
+        if not c.get("return_sequences", False):
+            # Keras LSTM(units) returns the LAST step only (ref: KerasLSTM ->
+            # LastTimeStep wrapper)
+            return L.LastTimeStep(underlying=cell), True
+        return cell, True
+    if cls == "Bidirectional":
+        inner_cls = c["layer"]["class_name"]
+        inner, _ = _map_layer(inner_cls, c["layer"]["config"])
+        if isinstance(inner, L.LastTimeStep):
+            # Keras Bidirectional(return_sequences=False) concatenates the fwd
+            # state at T-1 with the bwd state at 0 — no single-wrapper parity
+            raise ValueError("Bidirectional(return_sequences=False) import is "
+                             "not supported; re-export with return_sequences=True")
+        return L.Bidirectional(fwd=inner, mode=c.get("merge_mode", "concat").upper()), True
+    if cls in ("Flatten", "InputLayer"):
+        return None, False
+    raise ValueError(f"Keras layer type {cls} is not supported by the importer "
+                     f"(ref: KerasLayer registry)")
+
+
+def _convert_weights(layer: L.Layer, kw: Dict[str, np.ndarray],
+                     flatten_src: Optional[InputType],
+                     paths: Optional[Dict[str, np.ndarray]] = None) -> dict:
+    """Keras weight dict -> our param dict, with layout conversion."""
+    def t_conv(k):  # HWIO -> OIHW
+        return np.transpose(k, (3, 2, 0, 1))
+
+    if isinstance(layer, L.LastTimeStep):  # params are the wrapped cell's
+        return _convert_weights(layer.underlying, kw, flatten_src, paths)
+    if isinstance(layer, L.Bidirectional):
+        fwd = {k.rsplit("/", 1)[-1]: v for k, v in (paths or {}).items()
+               if "backward" not in k}
+        bwd = {k.rsplit("/", 1)[-1]: v for k, v in (paths or {}).items()
+               if "backward" in k}
+        return {"fwd": _convert_weights(layer.fwd, fwd, None),
+                "bwd": _convert_weights(layer.fwd, bwd, None)}
+
+    if isinstance(layer, L.SeparableConvolution2D):
+        p = {"dW": np.transpose(kw["depthwise_kernel"], (2, 3, 0, 1)),
+             "pW": np.transpose(kw["pointwise_kernel"], (3, 2, 0, 1))}
+        if "bias" in kw:
+            p["b"] = kw["bias"]
+        return p
+    if isinstance(layer, L.DepthwiseConvolution2D):
+        k = kw["kernel"]  # (kh, kw, C, mult) -> (C*mult, 1, kh, kw)
+        kh, kwid, C, mult = k.shape
+        p = {"W": k.transpose(2, 3, 0, 1).reshape(C * mult, 1, kh, kwid)}
+        if "bias" in kw:
+            p["b"] = kw["bias"]
+        return p
+    if isinstance(layer, L.Deconvolution2D):
+        # keras Conv2DTranspose kernel: (kh, kw, out, in)
+        p = {"W": np.transpose(kw["kernel"], (2, 3, 0, 1))}
+        if "bias" in kw:
+            p["b"] = kw["bias"]
+        return p
+    if isinstance(layer, L.ConvolutionLayer):
+        p = {"W": t_conv(kw["kernel"])}
+        if "bias" in kw:
+            p["b"] = kw["bias"]
+        return p
+    if isinstance(layer, L.BatchNormalization):
+        return {"gamma": kw.get("gamma", np.ones_like(kw["moving_mean"])),
+                "beta": kw.get("beta", np.zeros_like(kw["moving_mean"])),
+                "_mean": kw["moving_mean"], "_var": kw["moving_variance"]}
+    if isinstance(layer, L.GRU):
+        W, U = kw["kernel"], kw["recurrent_kernel"]
+        b = kw.get("bias")
+        H = layer.nOut
+        perm = _gru_perm(H)  # keras [z,r,h] -> ours [r,z,n]
+        p = {"W": W[:, perm], "RW": U[:, perm]}
+        if b is not None:
+            b = np.asarray(b)
+            if b.ndim == 2:  # reset_after: (2, 3H) = [input bias, recurrent bias]
+                p["bi"], p["bh"] = b[0][perm], b[1][perm]
+            else:
+                p["bi"], p["bh"] = b[perm], np.zeros_like(b[perm])
+        else:
+            p["bi"] = np.zeros((3 * H,), W.dtype)
+            p["bh"] = np.zeros((3 * H,), W.dtype)
+        return p
+    if isinstance(layer, L.LSTM):  # keras gate order [i,f,c,o] == ours [i,f,g,o]
+        p = {"W": kw["kernel"], "RW": kw["recurrent_kernel"]}
+        p["b"] = kw.get("bias", np.zeros((4 * layer.nOut,), kw["kernel"].dtype))
+        return p
+    if isinstance(layer, L.SimpleRnn):
+        return {"W": kw["kernel"], "RW": kw["recurrent_kernel"],
+                "b": kw.get("bias", np.zeros((layer.nOut,), kw["kernel"].dtype))}
+    if isinstance(layer, (L.EmbeddingSequenceLayer, L.EmbeddingLayer)):
+        return {"W": kw["embeddings"]}
+    if isinstance(layer, (L.DenseLayer, L.BaseOutputLayer)):
+        W = kw["kernel"]
+        if flatten_src is not None and flatten_src.kind == "cnn":
+            # permute rows: keras flatten order (H,W,C) -> ours (C,H,W)
+            H, Wd, C = flatten_src.height, flatten_src.width, flatten_src.channels
+            idx = np.arange(H * Wd * C).reshape(H, Wd, C).transpose(2, 0, 1).ravel()
+            W = W[idx]
+        p = {"W": W}
+        if "bias" in kw:
+            p["b"] = kw["bias"]
+        return p
+    raise ValueError(f"no weight mapper for {type(layer).__name__}")
+
+
+def _gru_perm(H: int) -> np.ndarray:
+    # columns [z | r | h] -> [r | z | n]
+    return np.concatenate([np.arange(H, 2 * H), np.arange(0, H),
+                           np.arange(2 * H, 3 * H)])
+
+
+def _set_weights(net_params: dict, layer: L.Layer, state: dict, converted: dict):
+    import jax.numpy as jnp
+    mean = converted.pop("_mean", None)
+    var = converted.pop("_var", None)
+    for k, v in converted.items():
+        net_params[k] = ({kk: jnp.asarray(vv) for kk, vv in v.items()}
+                         if isinstance(v, dict) else jnp.asarray(v))
+    if mean is not None:
+        state["mean"] = jnp.asarray(mean)
+        state["var"] = jnp.asarray(var)
+
+
+def _import_sequential(cfg: dict, store: _WeightStore) -> MultiLayerNetwork:
+    layers_cfg = cfg["layers"]
+    built: List[Tuple[str, L.Layer, bool, Optional[InputType]]] = []
+    input_type: Optional[InputType] = None
+    cur_type: Optional[InputType] = None
+    flatten_pending: Optional[InputType] = None
+
+    b = NeuralNetConfiguration.Builder().updater(Adam(1e-3)).list()
+    for lc in layers_cfg:
+        cls, c = lc["class_name"], lc["config"]
+        if cls == "InputLayer":
+            input_type = _input_type_from_shape(c.get("batch_shape") or c["batch_input_shape"])
+            cur_type = input_type
+            continue
+        layer, has_w = _map_layer(cls, c)
+        if layer is None:  # Flatten: remember the conv shape for Dense row perm
+            if cur_type is not None and cur_type.kind == "cnn":
+                flatten_pending = cur_type
+                cur_type = InputType.feedForward(cur_type.flat_size())
+            continue
+        layer.name = c.get("name", cls.lower())
+        b = b.layer(layer)
+        # the flatten row-permutation applies to the first WEIGHTED consumer;
+        # weightless layers between Flatten and Dense (Dropout/Activation) are
+        # elementwise and preserve feature order, so the marker passes through
+        fl_for_layer = flatten_pending if has_w else None
+        built.append((layer.name, layer, has_w, fl_for_layer))
+        if has_w:
+            flatten_pending = None
+        if cur_type is not None:
+            layer.set_n_in(cur_type)
+            cur_type = layer.output_type(cur_type)
+    if input_type is not None:
+        b = b.setInputType(input_type)
+    net = MultiLayerNetwork(b.build()).init()
+    for i, (name, layer, has_w, fl_src) in enumerate(built):
+        if not has_w:
+            continue
+        kw = store.layer_weights(name)
+        if not kw:
+            continue
+        converted = _convert_weights(layer, kw, fl_src,
+                                     paths=store.layer_weight_paths(name))
+        net._params[i] = dict(net._params[i])
+        _set_weights(net._params[i], layer, net._state[i], converted)
+    net._opt_state = net._tx.init(net._params)
+    return net
+
+
+def _import_functional(cfg: dict, store: _WeightStore) -> ComputationGraph:
+    layers_cfg = cfg["layers"]
+    g = NeuralNetConfiguration.Builder().updater(Adam(1e-3)).graphBuilder()
+    input_types: List[InputType] = []
+    name_alias: Dict[str, str] = {}   # keras node name -> our graph node name
+    weighted: List[Tuple[str, L.Layer, Optional[InputType]]] = []
+    type_at: Dict[str, Optional[InputType]] = {}
+    flatten_src: Dict[str, Optional[InputType]] = {}
+
+    def inbound(lc) -> List[str]:
+        names = []
+        for node in lc.get("inbound_nodes", []):
+            if isinstance(node, dict):  # keras 3 format
+                for arg in node.get("args", []):
+                    names.extend(_hist_names(arg))
+            else:  # keras 2: [[name, idx, tensor_idx, {}], ...]
+                for item in node:
+                    names.append(item[0])
+        return [name_alias.get(n, n) for n in names]
+
+    def _hist_names(arg):
+        out = []
+        if isinstance(arg, dict) and arg.get("class_name") == "__keras_tensor__":
+            out.append(arg["config"]["keras_history"][0])
+        elif isinstance(arg, (list, tuple)):
+            for a in arg:
+                out.extend(_hist_names(a))
+        return out
+
+    for lc in layers_cfg:
+        cls, c = lc["class_name"], lc["config"]
+        name = c.get("name", cls.lower())
+        ins = inbound(lc)
+        if cls == "InputLayer":
+            g.addInputs(name)
+            t = _input_type_from_shape(c.get("batch_shape") or c["batch_input_shape"])
+            input_types.append(t)
+            type_at[name] = t
+            continue
+        if cls == "Add":
+            g.addVertex(name, ElementWiseVertex(op="Add"), *ins)
+            type_at[name] = type_at.get(ins[0])
+            continue
+        if cls in ("Concatenate", "Merge"):
+            g.addVertex(name, MergeVertex(), *ins)
+            ts = [type_at.get(i) for i in ins]
+            type_at[name] = MergeVertex().output_type(ts) if all(ts) else None
+            continue
+        if cls in ("Multiply", "Average", "Maximum", "Subtract"):
+            op = {"Multiply": "Product", "Average": "Average",
+                  "Maximum": "Max", "Subtract": "Subtract"}[cls]
+            g.addVertex(name, ElementWiseVertex(op=op), *ins)
+            type_at[name] = type_at.get(ins[0])
+            continue
+        layer, has_w = _map_layer(cls, c)
+        if layer is None:  # Flatten
+            src = ins[0]
+            t = type_at.get(src)
+            name_alias[name] = src
+            if t is not None and t.kind == "cnn":
+                flatten_src[src] = t
+                type_at[src] = t  # unchanged; Dense consumer handles perm
+            continue
+        layer.name = name
+        src = ins[0] if ins else None
+        g.addLayer(name, layer, *(ins if ins else []))
+        t = type_at.get(src) if src else None
+        fl = None
+        if src in flatten_src:
+            if has_w:  # first weighted consumer takes the row permutation
+                fl = flatten_src[src]
+                layer.set_n_in(InputType.feedForward(fl.flat_size()))
+                type_at[name] = layer.output_type(
+                    InputType.feedForward(fl.flat_size()))
+            else:      # weightless elementwise layer: marker flows through
+                flatten_src[name] = flatten_src[src]
+                type_at[name] = InputType.feedForward(flatten_src[src].flat_size())
+        elif t is not None:
+            layer.set_n_in(t)
+            type_at[name] = layer.output_type(t)
+        else:
+            type_at[name] = None
+        if has_w:
+            weighted.append((name, layer, fl))
+
+    ol = cfg.get("output_layers", [])
+    if ol and isinstance(ol[0], str):  # single output: ["name", idx, tensor_idx]
+        ol = [ol]
+    outputs = [name_alias.get(n[0], n[0]) for n in ol]
+    g.setOutputs(*outputs)
+    g.setInputTypes(*[t for t in input_types if t is not None])
+    net = ComputationGraph(g.build()).init()
+    for name, layer, fl in weighted:
+        kw = store.layer_weights(name)
+        if not kw:
+            continue
+        converted = _convert_weights(layer, kw, fl,
+                                     paths=store.layer_weight_paths(name))
+        net._params[name] = dict(net._params[name])
+        _set_weights(net._params[name], layer, net._state[name], converted)
+    net._opt_state = net._tx.init(net._params)
+    return net

@@ -1,0 +1,5 @@
+"""TF frozen-graph import (ref: nd4j/samediff-import-tensorflow —
+TensorflowFrameworkImporter / TFGraphMapper)."""
+from deeplearning4j_tpu.modelimport.tensorflow.importer import TensorflowFrameworkImporter
+
+__all__ = ["TensorflowFrameworkImporter"]

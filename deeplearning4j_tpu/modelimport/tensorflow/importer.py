@@ -1,0 +1,397 @@
+"""TF GraphDef -> SameDiff import (ref: nd4j/samediff-import-tensorflow —
+TensorflowFrameworkImporter.runImport + per-op MappingProcess rules;
+legacy path TFGraphMapper).
+
+Design mirrors the reference's declarative registry: one mapping rule per TF
+op type, translating a NodeDef (attrs + const-resolved inputs) into ops from
+the shared registry on a SameDiff graph. Layout: TF conv/pool nodes are NHWC;
+this framework's cnn ops are NCHW, so rules wrap them in transposes (XLA
+fuses/cancels adjacent transposes at compile time — free on TPU, unlike the
+reference which carries format flags through every kernel).
+
+The importer resolves Const nodes eagerly so attribute-carrying inputs
+(axes, shapes, paddings, perms) become python values, exactly as the
+reference's `MappingRule`s pull from initializers.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+
+_JNP_DT = {
+    1: "float32", 2: "float64", 3: "int32", 4: "uint8", 6: "int8",
+    9: "int64", 10: "bool", 14: "bfloat16", 19: "float16",
+}
+
+
+def _clean(name: str) -> str:
+    if name.startswith("^"):
+        return ""
+    return name.split(":")[0]
+
+
+class TensorflowFrameworkImporter:
+    """(ref: org.nd4j.samediff.frameworkimport.tensorflow.importer.
+    TensorflowFrameworkImporter)."""
+
+    @staticmethod
+    def runImport(graph_def_or_path) -> SameDiff:
+        """Import a frozen GraphDef (proto object, serialized bytes, or .pb
+        path) into a SameDiff graph (ref: runImport / importFrozenTF)."""
+        gd = _load_graphdef(graph_def_or_path)
+        return _GraphImporter(gd).run()
+
+    # reference-parity alias (SameDiff.importFrozenTF)
+    importFrozenTF = runImport
+
+
+def _load_graphdef(src):
+    from tensorflow.core.framework import graph_pb2
+    if isinstance(src, graph_pb2.GraphDef):
+        return src
+    gd = graph_pb2.GraphDef()
+    if isinstance(src, bytes):
+        gd.ParseFromString(src)
+        return gd
+    with open(src, "rb") as f:
+        gd.ParseFromString(f.read())
+    return gd
+
+
+class _GraphImporter:
+    def __init__(self, gd):
+        self.gd = gd
+        self.sd = SameDiff.create()
+        self.vars: Dict[str, SDVariable] = {}     # tf node name -> SDVariable
+        self.consts: Dict[str, np.ndarray] = {}   # eagerly-resolved Const values
+
+    # ------------------------------------------------------------- helpers
+    def _in(self, node, i) -> SDVariable:
+        return self.vars[_clean(node.input[i])]
+
+    def _const(self, node, i) -> np.ndarray:
+        name = _clean(node.input[i])
+        if name not in self.consts:
+            raise ValueError(
+                f"input {i} of {node.name} ({node.op}) must be a Const "
+                f"(dynamic attribute inputs are not supported)")
+        return self.consts[name]
+
+    def _ins(self, node) -> List[SDVariable]:
+        return [self.vars[_clean(n)] for n in node.input if _clean(n)]
+
+    def _emit(self, ns: str, opname: str, inputs, name: str, **kwargs) -> SDVariable:
+        out = self.sd._op(ns, opname, inputs, name=name, **kwargs)
+        return out
+
+    def _nhwc_to_nchw(self, v, name):
+        return self._emit("shape", "permute", [v], f"{name}/nchw", axes=(0, 3, 1, 2))
+
+    def _nchw_to_nhwc(self, v, name):
+        return self._emit("shape", "permute", [v], f"{name}/nhwc", axes=(0, 2, 3, 1))
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> SameDiff:
+        import tensorflow as tf
+        for node in self.gd.node:
+            self._map_node(node, tf)
+        return self.sd
+
+    def _map_node(self, node, tf):
+        op = node.op
+        name = node.name
+        sd = self.sd
+
+        if op == "Const":
+            val = tf.make_ndarray(node.attr["value"].tensor)
+            self.consts[name] = val
+            self.vars[name] = sd.constant(name, val)
+            return
+        if op == "Placeholder":
+            shape = None
+            if node.attr["shape"].shape.dim:
+                shape = tuple(d.size if d.size > 0 else None
+                              for d in node.attr["shape"].shape.dim)
+            import jax.numpy as jnp
+            dt = getattr(jnp, _JNP_DT.get(node.attr["dtype"].type, "float32"))
+            self.vars[name] = sd.placeHolder(name, shape=shape, dtype=dt)
+            return
+        if op in ("Identity", "StopGradient", "PreventGradient", "Snapshot",
+                  "CheckNumerics"):
+            src = _clean(node.input[0])
+            # emit a real node so the TF node name is addressable as a graph
+            # output (frozen-fn outputs are typically named "Identity")
+            self.vars[name] = self._emit("math", "identity", [self.vars[src]], name)
+            if src in self.consts:
+                self.consts[name] = self.consts[src]
+            return
+        if op == "NoOp":
+            return
+
+        fn = _RULES.get(op)
+        if fn is None:
+            raise ValueError(f"TF op '{op}' (node {name}) has no mapping rule "
+                             f"(ref: OpMappingRegistry lookup failure)")
+        out = fn(self, node)
+        if out is not None:
+            self.vars[name] = out
+
+
+# --------------------------------------------------------------- mapping rules
+
+def _rule(*tf_ops):
+    def deco(fn):
+        for t in tf_ops:
+            _RULES[t] = fn
+        return fn
+    return deco
+
+
+_RULES: Dict[str, Any] = {}
+
+_BINARY = {
+    "Add": ("math", "add"), "AddV2": ("math", "add"), "Sub": ("math", "sub"),
+    "Mul": ("math", "mul"), "RealDiv": ("math", "div"), "Div": ("math", "div"),
+    "Maximum": ("math", "max"), "Minimum": ("math", "min"),
+    "Pow": ("math", "pow"), "FloorDiv": ("math", "floorDiv"),
+    "FloorMod": ("math", "floorMod"), "Atan2": ("math", "atan2"),
+    "LogicalAnd": ("math", "logicalAnd"), "LogicalOr": ("math", "logicalOr"),
+}
+_UNARY = {
+    "Relu": ("nn", "relu"), "Relu6": ("nn", "relu6"), "Elu": ("nn", "elu"),
+    "Selu": ("nn", "selu"), "Sigmoid": ("nn", "sigmoid"),
+    "Softplus": ("nn", "softplus"), "Softsign": ("nn", "softsign"),
+    "Tanh": ("math", "tanh"), "Exp": ("math", "exp"), "Log": ("math", "log"),
+    "Log1p": ("math", "log1p"), "Neg": ("math", "neg"), "Abs": ("math", "abs"),
+    "Square": ("math", "square"), "Sqrt": ("math", "sqrt"),
+    "Rsqrt": ("math", "rsqrt"), "Erf": ("math", "erf"), "Floor": ("math", "floor"),
+    "Ceil": ("math", "ceil"), "Round": ("math", "round"), "Sign": ("math", "sign"),
+    "Sin": ("math", "sin"), "Cos": ("math", "cos"), "Tan": ("math", "tan"),
+    "Reciprocal": ("math", "reciprocal"), "LogicalNot": ("math", "logicalNot"),
+    "IsNan": ("math", "isnan"), "IsInf": ("math", "isinf"),
+    "IsFinite": ("math", "isfinite"),
+}
+_REDUCE = {
+    "Mean": "mean", "Sum": "sum", "Max": "max", "Min": "min", "Prod": "prod",
+    "All": "all", "Any": "any",
+}
+
+for _t, (_ns, _o) in list(_BINARY.items()):
+    _RULES[_t] = (lambda ns, o: lambda g, n: g._emit(
+        ns, o, [g._in(n, 0), g._in(n, 1)], n.name))(_ns, _o)
+for _t, (_ns, _o) in list(_UNARY.items()):
+    _RULES[_t] = (lambda ns, o: lambda g, n: g._emit(
+        ns, o, [g._in(n, 0)], n.name))(_ns, _o)
+for _t, _o in list(_REDUCE.items()):
+    def _red(g, n, _o=_o):
+        axes = g._const(n, 1)
+        dims = tuple(int(a) for a in np.atleast_1d(axes))
+        keep = bool(n.attr["keep_dims"].b)
+        return g._emit("reduce", _o, [g._in(n, 0)], n.name, dims=dims, keepdims=keep)
+    _RULES[_t] = _red
+
+
+@_rule("MatMul")
+def _matmul(g, n):
+    a, b = g._in(n, 0), g._in(n, 1)
+    if n.attr["transpose_a"].b:
+        a = g._emit("shape", "permute", [a], n.name + "/ta", axes=(1, 0))
+    if n.attr["transpose_b"].b:
+        b = g._emit("shape", "permute", [b], n.name + "/tb", axes=(1, 0))
+    return g._emit("linalg", "matmul", [a, b], n.name)
+
+
+@_rule("BatchMatMul", "BatchMatMulV2")
+def _bmm(g, n):
+    a, b = g._in(n, 0), g._in(n, 1)
+    if n.attr["adj_x"].b:
+        nd = len(a.shape or (0, 0, 0))
+        g_axes = tuple(range(nd - 2)) + (nd - 1, nd - 2)
+        a = g._emit("shape", "permute", [a], n.name + "/ta", axes=g_axes)
+    if n.attr["adj_y"].b:
+        nd = len(b.shape or (0, 0, 0))
+        g_axes = tuple(range(nd - 2)) + (nd - 1, nd - 2)
+        b = g._emit("shape", "permute", [b], n.name + "/tb", axes=g_axes)
+    return g._emit("linalg", "matmul", [a, b], n.name)
+
+
+@_rule("BiasAdd")
+def _bias_add(g, n):
+    # NHWC (default): bias broadcasts over the trailing channel dim
+    fmt = n.attr["data_format"].s.decode() or "NHWC"
+    x, b = g._in(n, 0), g._in(n, 1)
+    if fmt == "NCHW":
+        raise ValueError("BiasAdd NCHW import unsupported (TF frozen graphs are NHWC)")
+    return g._emit("math", "add", [x, b], n.name)
+
+
+@_rule("Softmax")
+def _softmax(g, n):
+    return g._emit("nn", "softmax", [g._in(n, 0)], n.name)
+
+
+@_rule("LeakyRelu")
+def _leaky(g, n):
+    return g._emit("nn", "leakyRelu", [g._in(n, 0)], n.name,
+                   alpha=float(n.attr["alpha"].f or 0.2))
+
+
+@_rule("Reshape")
+def _reshape(g, n):
+    shape = tuple(int(s) for s in g._const(n, 1))
+    return g._emit("shape", "reshape", [g._in(n, 0)], n.name, shape=shape)
+
+
+@_rule("Transpose")
+def _transpose(g, n):
+    perm = tuple(int(p) for p in g._const(n, 1))
+    return g._emit("shape", "permute", [g._in(n, 0)], n.name, axes=perm)
+
+
+@_rule("ExpandDims")
+def _expand(g, n):
+    axis = int(np.atleast_1d(g._const(n, 1))[0])
+    return g._emit("shape", "expandDims", [g._in(n, 0)], n.name, axis=axis)
+
+
+@_rule("Squeeze")
+def _squeeze(g, n):
+    dims = tuple(int(d) for d in n.attr["squeeze_dims"].list.i) or None
+    return g._emit("shape", "squeeze", [g._in(n, 0)], n.name, axis=dims)
+
+
+@_rule("ConcatV2")
+def _concat(g, n):
+    axis = int(np.atleast_1d(g._const(n, len(n.input) - 1))[0])
+    xs = [g._in(n, i) for i in range(len(n.input) - 1)]
+    return g._emit("shape", "concatN", xs, n.name, axis=axis)
+
+
+@_rule("Pack")
+def _pack(g, n):
+    axis = int(n.attr["axis"].i)
+    return g._emit("shape", "stackN", g._ins(n), n.name, axis=axis)
+
+
+@_rule("Pad", "PadV2")
+def _pad(g, n):
+    pads = tuple(tuple(int(v) for v in row) for row in g._const(n, 1))
+    return g._emit("shape", "pad", [g._in(n, 0)], n.name, paddings=pads)
+
+
+@_rule("GatherV2", "Gather")
+def _gather(g, n):
+    axis = 0
+    if len(n.input) > 2:
+        axis = int(np.atleast_1d(g._const(n, 2))[0])
+    return g._emit("shape", "gather", [g._in(n, 0), g._in(n, 1)], n.name, axis=axis)
+
+
+@_rule("Cast")
+def _cast(g, n):
+    import jax.numpy as jnp
+    dt = getattr(jnp, _JNP_DT.get(n.attr["DstT"].type, "float32"))
+    return g._emit("shape", "castTo", [g._in(n, 0)], n.name, dtype=dt)
+
+
+@_rule("ArgMax")
+def _argmax(g, n):
+    axis = int(np.atleast_1d(g._const(n, 1))[0])
+    return g._emit("reduce", "argmax", [g._in(n, 0)], n.name, dims=axis)
+
+
+@_rule("OneHot")
+def _onehot(g, n):
+    depth = int(np.atleast_1d(g._const(n, 1))[0])
+    on = float(np.atleast_1d(g._const(n, 2))[0])
+    off = float(np.atleast_1d(g._const(n, 3))[0])
+    return g._emit("shape", "oneHot", [g._in(n, 0)], n.name, depth=depth,
+                   on=on, off=off)
+
+
+@_rule("Shape")
+def _shape(g, n):
+    return g._emit("shape", "shapeOf", [g._in(n, 0)], n.name)
+
+
+@_rule("StridedSlice")
+def _strided_slice(g, n):
+    begin = [int(v) for v in g._const(n, 1)]
+    end = [int(v) for v in g._const(n, 2)]
+    strides = [int(v) for v in g._const(n, 3)]
+    bm = int(n.attr["begin_mask"].i)
+    em = int(n.attr["end_mask"].i)
+    sm = int(n.attr["shrink_axis_mask"].i)
+    nm = int(n.attr["new_axis_mask"].i)
+    el = int(n.attr["ellipsis_mask"].i)
+    if nm or el:
+        raise ValueError("StridedSlice with new_axis/ellipsis masks unsupported")
+    slices = []
+    for i in range(len(begin)):
+        if sm & (1 << i):
+            slices.append(begin[i])
+            continue
+        b = None if bm & (1 << i) else begin[i]
+        e = None if em & (1 << i) else end[i]
+        slices.append(slice(b, e, strides[i]))
+    return g._emit("shape", "stridedSlice", [g._in(n, 0)], n.name,
+                   slices=tuple(slices))
+
+
+@_rule("Conv2D")
+def _conv2d(g, n):
+    fmt = n.attr["data_format"].s.decode() or "NHWC"
+    if fmt != "NHWC":
+        raise ValueError(f"Conv2D data_format {fmt} unsupported (frozen TF graphs are NHWC)")
+    strides = list(n.attr["strides"].list.i)  # NHWC order
+    dil = list(n.attr["dilations"].list.i) or [1, 1, 1, 1]
+    padding = n.attr["padding"].s.decode()
+    x = g._nhwc_to_nchw(g._in(n, 0), n.name)
+    # kernel HWIO -> OIHW
+    w = g._emit("shape", "permute", [g._in(n, 1)], n.name + "/w", axes=(3, 2, 0, 1))
+    out = g._emit("cnn", "conv2d", [x, w], n.name + "/conv",
+                  strides=(strides[1], strides[2]), padding=padding,
+                  dilation=(dil[1], dil[2]))
+    return g._nchw_to_nhwc(out, n.name)
+
+
+@_rule("DepthwiseConv2dNative")
+def _depthwise(g, n):
+    strides = list(n.attr["strides"].list.i)
+    padding = n.attr["padding"].s.decode()
+    x = g._nhwc_to_nchw(g._in(n, 0), n.name)
+    # kernel (kh,kw,C,mult) -> (C*mult, 1, kh, kw); frozen graphs have it const
+    kv = g._const(n, 1)
+    kh, kw_, C, mult = kv.shape
+    w = g.sd.constant(n.name + "/w",
+                      kv.transpose(2, 3, 0, 1).reshape(C * mult, 1, kh, kw_))
+    out = g._emit("cnn", "depthwiseConv2d", [x, w], n.name + "/conv",
+                  strides=(strides[1], strides[2]), padding=padding)
+    return g._nchw_to_nhwc(out, n.name)
+
+
+@_rule("MaxPool", "AvgPool")
+def _pool(g, n):
+    fmt = n.attr["data_format"].s.decode() or "NHWC"
+    if fmt != "NHWC":
+        raise ValueError(f"{n.op} data_format {fmt} unsupported")
+    k = list(n.attr["ksize"].list.i)
+    s = list(n.attr["strides"].list.i)
+    padding = n.attr["padding"].s.decode()
+    x = g._nhwc_to_nchw(g._in(n, 0), n.name)
+    opname = "maxPool2d" if n.op == "MaxPool" else "avgPool2d"
+    out = g._emit("cnn", opname, [x], n.name + "/pool",
+                  kernel=(k[1], k[2]), strides=(s[1], s[2]), padding=padding)
+    return g._nchw_to_nhwc(out, n.name)
+
+
+@_rule("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _fused_bn(g, n):
+    eps = float(n.attr["epsilon"].f or 1e-3)
+    x, gamma, beta, mean, var = (g._in(n, i) for i in range(5))
+    # NHWC: channel is the last axis
+    return g._emit("nn", "batchNorm", [x, mean, var, gamma, beta],
+                   n.name, eps=eps, axis=-1)

@@ -509,9 +509,15 @@ class DropoutLayer(Layer):
 
 @dataclass
 class ActivationLayer(Layer):
-    """(ref: conf.layers.ActivationLayer)."""
+    """(ref: conf.layers.ActivationLayer). ``alpha`` parameterizes LEAKYRELU/ELU
+    (ref: ActivationLReLU(alpha) etc. carry their own coefficients)."""
+    alpha: Optional[float] = None
 
     def apply(self, params, x, *, training=False, rng=None, state=None):
+        if self.alpha is not None and (self.activation or "").upper() == "LEAKYRELU":
+            return jax.nn.leaky_relu(x, self.alpha), state
+        if self.alpha is not None and (self.activation or "").upper() == "ELU":
+            return jax.nn.elu(x, self.alpha), state
         return self._activate(x), state
 
 

@@ -34,6 +34,7 @@ for _name, _fn in {
 }.items():
     _simple(_name, _fn)
 
+op("identity", "math")(lambda x: x)
 op("pow", "math")(jnp.power)
 op("atan2", "math")(jnp.arctan2)
 op("add", "math")(jnp.add)
@@ -119,6 +120,8 @@ def variance(x, dims=None, keepdims=False, biasCorrected=True):
 
 @op("argmax", "reduce")
 def argmax(x, dims=None, keepdims=False):
+    if isinstance(dims, (tuple, list)):
+        dims = dims[0] if dims else None
     return jnp.argmax(x, axis=dims if dims is not None else None, keepdims=keepdims)
 
 
@@ -176,6 +179,9 @@ op("squeeze", "shape")(lambda x, axis=None: jnp.squeeze(x, axis=axis))
 op("flatten", "shape")(jnp.ravel)
 op("concat", "shape")(lambda arrays, axis=0: jnp.concatenate(arrays, axis=axis))
 op("stack", "shape")(lambda arrays, axis=0: jnp.stack(arrays, axis=axis))
+# variadic forms for graph-mode construction (one SDVariable per input)
+op("concatN", "shape")(lambda *arrays, axis=0: jnp.concatenate(arrays, axis=axis))
+op("stackN", "shape")(lambda *arrays, axis=0: jnp.stack(arrays, axis=axis))
 op("unstack", "shape")(lambda x, axis=0: [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)])
 op("tile", "shape")(lambda x, reps: jnp.tile(x, tuple(reps)))
 op("repeat", "shape")(lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=axis))

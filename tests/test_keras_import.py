@@ -1,0 +1,205 @@
+"""Keras h5 import parity tests (ref: KerasModelEndToEndTest — per-arch h5
+fixtures, imported outputs compared against Keras' own outputs on the same
+inputs, incl. weight-layout conversion)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport  # noqa: E402
+
+RNG = np.random.default_rng(0)
+
+
+def _save(model, tmp_path, name):
+    p = str(tmp_path / name)
+    model.save(p)
+    return p
+
+
+def _assert_parity(keras_model, imported, x_nhwc, atol=1e-4, cnn=False, seq=False):
+    """Compare Keras (channels_last) vs imported (channels_first) outputs."""
+    ref = np.asarray(keras_model(x_nhwc))
+    x = np.transpose(x_nhwc, (0, 3, 1, 2)) if cnn else x_nhwc
+    if hasattr(imported, "outputSingle"):
+        got = imported.outputSingle(x).toNumpy()
+    else:
+        got = imported.output(x).toNumpy()
+    if ref.ndim == 4:  # NHWC -> NCHW for comparison
+        ref = np.transpose(ref, (0, 3, 1, 2))
+    np.testing.assert_allclose(got, ref, atol=atol)
+
+
+def test_sequential_mlp(tmp_path):
+    tf.keras.utils.set_random_seed(1)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(16, activation="relu"),
+        tf.keras.layers.Dense(8, activation="tanh"),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "mlp.h5"))
+    x = RNG.normal(size=(4, 6)).astype(np.float32)
+    _assert_parity(m, net, x)
+
+
+def test_sequential_cnn_flatten_dense(tmp_path):
+    """The hard case: Flatten(H,W,C) -> Dense requires row permutation."""
+    tf.keras.utils.set_random_seed(2)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((10, 10, 3)),
+        tf.keras.layers.Conv2D(8, 3, activation="relu", padding="same"),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Conv2D(4, 3, activation="relu", padding="valid"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(5, activation="softmax"),
+    ])
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "cnn.h5"))
+    x = RNG.normal(size=(2, 10, 10, 3)).astype(np.float32)
+    _assert_parity(m, net, x, cnn=True)
+
+
+def test_sequential_bn_depthwise(tmp_path):
+    tf.keras.utils.set_random_seed(3)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((8, 8, 4)),
+        tf.keras.layers.DepthwiseConv2D(3, padding="same"),
+        tf.keras.layers.BatchNormalization(),
+        tf.keras.layers.ReLU(),
+        tf.keras.layers.SeparableConv2D(6, 3, padding="same"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(2, activation="softmax"),
+    ])
+    # make BN stats non-trivial
+    m(RNG.normal(size=(8, 8, 8, 4)).astype(np.float32), training=True)
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "dw.h5"))
+    x = RNG.normal(size=(2, 8, 8, 4)).astype(np.float32)
+    _assert_parity(m, net, x, cnn=True, atol=1e-3)
+
+
+def test_sequential_lstm(tmp_path):
+    tf.keras.utils.set_random_seed(4)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((12, 5)),
+        tf.keras.layers.LSTM(8, return_sequences=True),
+        tf.keras.layers.LSTM(6, return_sequences=True),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "lstm.h5"))
+    x = RNG.normal(size=(2, 12, 5)).astype(np.float32)
+    _assert_parity(m, net, x, atol=1e-4)
+
+
+def test_sequential_gru_simplernn(tmp_path):
+    tf.keras.utils.set_random_seed(5)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((10, 4)),
+        tf.keras.layers.GRU(6, return_sequences=True),
+        tf.keras.layers.SimpleRNN(5, return_sequences=True),
+    ])
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "gru.h5"))
+    x = RNG.normal(size=(2, 10, 4)).astype(np.float32)
+    _assert_parity(m, net, x, atol=1e-4)
+
+
+def test_sequential_bidirectional(tmp_path):
+    tf.keras.utils.set_random_seed(6)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((9, 4)),
+        tf.keras.layers.Bidirectional(tf.keras.layers.LSTM(5, return_sequences=True)),
+    ])
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "bi.h5"))
+    x = RNG.normal(size=(2, 9, 4)).astype(np.float32)
+    _assert_parity(m, net, x, atol=1e-4)
+
+
+def test_sequential_embedding(tmp_path):
+    tf.keras.utils.set_random_seed(7)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((7,)),
+        tf.keras.layers.Embedding(20, 6),
+        tf.keras.layers.LSTM(5, return_sequences=True),
+    ])
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "emb.h5"))
+    x = RNG.integers(0, 20, (3, 7)).astype(np.float32)
+    _assert_parity(m, net, x, atol=1e-4)
+
+
+def test_functional_residual(tmp_path):
+    """Functional API with Add + Concatenate -> ComputationGraph."""
+    tf.keras.utils.set_random_seed(8)
+    inp = tf.keras.layers.Input((8, 8, 4))
+    c1 = tf.keras.layers.Conv2D(4, 3, padding="same", activation="relu")(inp)
+    add = tf.keras.layers.Add()([inp, c1])
+    c2 = tf.keras.layers.Conv2D(4, 1, activation="relu")(add)
+    cat = tf.keras.layers.Concatenate()([c1, c2])
+    gap = tf.keras.layers.GlobalAveragePooling2D()(cat)
+    out = tf.keras.layers.Dense(3, activation="softmax")(gap)
+    m = tf.keras.Model(inp, out)
+    net = KerasModelImport.importKerasModelAndWeights(_save(m, tmp_path, "fn.h5"))
+    x = RNG.normal(size=(2, 8, 8, 4)).astype(np.float32)
+    _assert_parity(m, net, x, cnn=True)
+
+
+def test_wrong_entrypoint_errors(tmp_path):
+    tf.keras.utils.set_random_seed(9)
+    m = tf.keras.Sequential([tf.keras.layers.Input((4,)),
+                             tf.keras.layers.Dense(2)])
+    p = _save(m, tmp_path, "seq.h5")
+    with pytest.raises(ValueError, match="Sequential"):
+        KerasModelImport.importKerasModelAndWeights(p)
+
+
+def test_lstm_return_last_step(tmp_path):
+    """return_sequences=False (Keras default): final-step output only."""
+    tf.keras.utils.set_random_seed(10)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((7, 4)),
+        tf.keras.layers.LSTM(6),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "last.h5"))
+    x = RNG.normal(size=(2, 7, 4)).astype(np.float32)
+    _assert_parity(m, net, x, atol=1e-4)
+
+
+def test_flatten_dropout_dense(tmp_path):
+    """Weightless layers between Flatten and Dense must not lose the
+    (H,W,C)->(C,H,W) row permutation."""
+    tf.keras.utils.set_random_seed(11)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((6, 6, 3)),
+        tf.keras.layers.Conv2D(4, 3, padding="same"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dropout(0.5),
+        tf.keras.layers.Activation("relu"),
+        tf.keras.layers.Dense(5),
+    ])
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "fd.h5"))
+    x = RNG.normal(size=(2, 6, 6, 3)).astype(np.float32)
+    _assert_parity(m, net, x, cnn=True, atol=1e-4)
+
+
+def test_leaky_relu_alpha(tmp_path):
+    tf.keras.utils.set_random_seed(12)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Dense(6),
+        tf.keras.layers.LeakyReLU(),  # default negative_slope = 0.3
+    ])
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "lr.h5"))
+    x = RNG.normal(size=(3, 4)).astype(np.float32)
+    _assert_parity(m, net, x, atol=1e-5)

@@ -1,0 +1,139 @@
+"""TF frozen-graph import corpus (ref: TFGraphTestAllSameDiff — frozen graphs
+executed both by TF and by the imported SameDiff, outputs compared). Graphs are
+generated in-process with tf.function freezing instead of stored fixtures."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.tensorflow import TensorflowFrameworkImporter  # noqa: E402
+
+RNG = np.random.default_rng(0)
+
+
+def _freeze(fn, *specs):
+    """Concrete tf.function -> frozen GraphDef + input/output names."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    cf = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name.split(":")[0] for t in frozen.outputs]
+    return gd, in_names, out_names, frozen
+
+
+def _run_parity(fn, inputs, atol=1e-5):
+    specs = [tf.TensorSpec(x.shape, tf.as_dtype(x.dtype)) for x in inputs]
+    gd, in_names, out_names, frozen = _freeze(fn, *specs)
+    expected = frozen(*[tf.constant(x) for x in inputs])
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    sd = TensorflowFrameworkImporter.runImport(gd)
+    phs = dict(zip(in_names, inputs))
+    for out_name, exp in zip(out_names, expected):
+        got = sd.getVariable(out_name).eval(phs).toNumpy()
+        np.testing.assert_allclose(got, np.asarray(exp), atol=atol)
+    return sd
+
+
+def test_mlp_graph():
+    w1 = RNG.normal(size=(6, 16)).astype(np.float32)
+    b1 = RNG.normal(size=(16,)).astype(np.float32)
+    w2 = RNG.normal(size=(16, 3)).astype(np.float32)
+
+    def f(x):
+        h = tf.nn.relu(tf.matmul(x, w1) + b1)
+        return tf.nn.softmax(tf.matmul(h, w2))
+
+    _run_parity(f, [RNG.normal(size=(4, 6)).astype(np.float32)])
+
+
+def test_conv_pool_graph():
+    k = RNG.normal(size=(3, 3, 2, 4)).astype(np.float32) * 0.1
+
+    def f(x):  # NHWC
+        y = tf.nn.conv2d(x, k, strides=1, padding="SAME")
+        y = tf.nn.relu(y)
+        y = tf.nn.max_pool2d(y, 2, 2, padding="VALID")
+        return tf.reduce_mean(y, axis=[1, 2])
+
+    _run_parity(f, [RNG.normal(size=(2, 8, 8, 2)).astype(np.float32)], atol=1e-4)
+
+
+def test_attention_block_graph():
+    """Scaled-dot-product attention — the BERT core pattern."""
+    D, H = 16, 4
+    wq = RNG.normal(size=(D, D)).astype(np.float32) * 0.1
+    wk = RNG.normal(size=(D, D)).astype(np.float32) * 0.1
+    wv = RNG.normal(size=(D, D)).astype(np.float32) * 0.1
+
+    def f(x):  # (B, T, D)
+        B, T = tf.shape(x)[0], tf.shape(x)[1]
+        q = tf.matmul(x, tf.reshape(wq, (1, D, D)) + tf.zeros((1, 1, 1)))
+        k = tf.matmul(x, tf.reshape(wk, (1, D, D)) + tf.zeros((1, 1, 1)))
+        v = tf.matmul(x, tf.reshape(wv, (1, D, D)) + tf.zeros((1, 1, 1)))
+        s = tf.matmul(q, k, transpose_b=True) / tf.sqrt(tf.cast(D, tf.float32))
+        p = tf.nn.softmax(s, axis=-1)
+        return tf.matmul(p, v)
+
+    _run_parity(f, [RNG.normal(size=(2, 6, D)).astype(np.float32)], atol=1e-4)
+
+
+def test_layernorm_composite_graph():
+    """LayerNorm built from primitives (mean/sub/square/rsqrt) — exercises
+    reduce + broadcast chains."""
+    gamma = RNG.normal(size=(8,)).astype(np.float32)
+    beta = RNG.normal(size=(8,)).astype(np.float32)
+
+    def f(x):
+        mu = tf.reduce_mean(x, axis=-1, keepdims=True)
+        var = tf.reduce_mean(tf.square(x - mu), axis=-1, keepdims=True)
+        return (x - mu) * tf.math.rsqrt(var + 1e-6) * gamma + beta
+
+    _run_parity(f, [RNG.normal(size=(3, 5, 8)).astype(np.float32)], atol=1e-5)
+
+
+def test_shape_ops_graph():
+    def f(x):
+        y = tf.transpose(x, (0, 2, 1))
+        y = tf.reshape(y, (-1, 6))
+        y = tf.concat([y, y], axis=1)
+        y = tf.expand_dims(y, 1)
+        return tf.squeeze(y, axis=1)
+
+    _run_parity(f, [RNG.normal(size=(2, 6, 3)).astype(np.float32)])
+
+
+def test_embedding_gather_graph():
+    table = RNG.normal(size=(11, 5)).astype(np.float32)
+
+    def f(ids):
+        e = tf.gather(table, ids)
+        return tf.reduce_sum(e, axis=1)
+
+    _run_parity(f, [RNG.integers(0, 11, (3, 7)).astype(np.int32)])
+
+
+def test_strided_slice_graph():
+    def f(x):
+        return x[:, 1:4, ::2]
+
+    _run_parity(f, [RNG.normal(size=(2, 6, 8)).astype(np.float32)])
+
+
+def test_unknown_op_reports_clearly():
+    gd, *_ = _freeze(lambda x: tf.raw_ops.Betainc(a=x, b=x, x=x),
+                     tf.TensorSpec((2,), tf.float32))
+    with pytest.raises(ValueError, match="no mapping rule"):
+        TensorflowFrameworkImporter.runImport(gd)
+
+
+def test_argmax_and_dilated_conv_graph():
+    k = RNG.normal(size=(3, 3, 2, 4)).astype(np.float32) * 0.1
+
+    def f(x):
+        y = tf.nn.conv2d(x, k, strides=1, padding="SAME", dilations=[1, 2, 2, 1])
+        return tf.argmax(tf.reduce_mean(y, axis=[1, 2]), axis=1)
+
+    _run_parity(f, [RNG.normal(size=(2, 8, 8, 2)).astype(np.float32)], atol=1e-4)
