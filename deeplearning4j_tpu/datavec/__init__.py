@@ -1,0 +1,35 @@
+"""ETL / data vectorization (ref: datavec/ — records -> tensors pipeline,
+SURVEY.md §2.3)."""
+from deeplearning4j_tpu.datavec.writables import (
+    Writable, DoubleWritable, FloatWritable, IntWritable, LongWritable, Text,
+    BooleanWritable, NDArrayWritable, NullWritable)
+from deeplearning4j_tpu.datavec.split import (
+    InputSplit, FileSplit, CollectionInputSplit, NumberedFileInputSplit, StringSplit)
+from deeplearning4j_tpu.datavec.schema import Schema, ColumnType
+from deeplearning4j_tpu.datavec.records import (
+    RecordReader, SequenceRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    LineRecordReader, CollectionRecordReader, CollectionSequenceRecordReader,
+    RegexLineRecordReader, ComposableRecordReader, TransformProcessRecordReader)
+from deeplearning4j_tpu.datavec.transform import (
+    TransformProcess, Condition, ConditionOp, ConditionFilter, FilterInvalidValues,
+    MathOp)
+from deeplearning4j_tpu.datavec.local import LocalTransformExecutor
+from deeplearning4j_tpu.datavec.analysis import AnalyzeLocal
+from deeplearning4j_tpu.datavec.iterator import (
+    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
+from deeplearning4j_tpu.datavec.image import ImageRecordReader, NativeImageLoader
+
+__all__ = [
+    "Writable", "DoubleWritable", "FloatWritable", "IntWritable", "LongWritable",
+    "Text", "BooleanWritable", "NDArrayWritable", "NullWritable",
+    "InputSplit", "FileSplit", "CollectionInputSplit", "NumberedFileInputSplit",
+    "StringSplit", "Schema", "ColumnType",
+    "RecordReader", "SequenceRecordReader", "CSVRecordReader",
+    "CSVSequenceRecordReader", "LineRecordReader", "CollectionRecordReader",
+    "CollectionSequenceRecordReader", "RegexLineRecordReader",
+    "ComposableRecordReader", "TransformProcessRecordReader",
+    "TransformProcess", "Condition", "ConditionOp", "ConditionFilter",
+    "FilterInvalidValues", "MathOp", "LocalTransformExecutor", "AnalyzeLocal",
+    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+    "ImageRecordReader", "NativeImageLoader",
+]

@@ -1,0 +1,74 @@
+"""Data analysis (ref: datavec-api org.datavec.api.transform.analysis.
+AnalyzeLocal — per-column statistics used to parameterize normalizers)."""
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+from deeplearning4j_tpu.datavec.schema import ColumnType, Schema
+
+
+class ColumnAnalysis:
+    def __init__(self, stats: Dict[str, float]):
+        self.stats = stats
+
+    def getMin(self):
+        return self.stats.get("min")
+
+    def getMax(self):
+        return self.stats.get("max")
+
+    def getMean(self):
+        return self.stats.get("mean")
+
+    def getSampleStdev(self):
+        return self.stats.get("std")
+
+    def getCountTotal(self):
+        return self.stats.get("count")
+
+
+class DataAnalysis:
+    def __init__(self, schema: Schema, columns: Dict[str, ColumnAnalysis]):
+        self.schema = schema
+        self.columns = columns
+
+    def getColumnAnalysis(self, name: str) -> ColumnAnalysis:
+        return self.columns[name]
+
+
+class AnalyzeLocal:
+    """(ref: org.datavec.local.transforms.AnalyzeLocal.analyze)."""
+
+    @staticmethod
+    def analyze(schema: Schema, reader_or_rows) -> DataAnalysis:
+        rows = list(reader_or_rows)
+        out: Dict[str, ColumnAnalysis] = {}
+        for i, name in enumerate(schema.getColumnNames()):
+            t = schema.getType(i)
+            if t in (ColumnType.Double, ColumnType.Float, ColumnType.Integer,
+                     ColumnType.Long):
+                vals: List[float] = []
+                for r in rows:
+                    try:
+                        v = r[i].toDouble()
+                    except (ValueError, TypeError):
+                        continue
+                    if not (math.isnan(v) or math.isinf(v)):
+                        vals.append(v)
+                n = len(vals)
+                mean = sum(vals) / n if n else float("nan")
+                var = sum((v - mean) ** 2 for v in vals) / (n - 1) if n > 1 else 0.0
+                out[name] = ColumnAnalysis({
+                    "count": n, "min": min(vals) if vals else float("nan"),
+                    "max": max(vals) if vals else float("nan"),
+                    "mean": mean, "std": math.sqrt(var),
+                })
+            elif t == ColumnType.Categorical:
+                counts: Dict[str, int] = {}
+                for r in rows:
+                    counts[r[i].toString()] = counts.get(r[i].toString(), 0) + 1
+                out[name] = ColumnAnalysis({"count": len(rows), "stateCounts": counts})
+            else:
+                out[name] = ColumnAnalysis({"count": len(rows)})
+        return DataAnalysis(schema, out)

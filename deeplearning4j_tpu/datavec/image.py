@@ -1,0 +1,136 @@
+"""Image loading + augmentation (ref: datavec-data-image —
+org.datavec.image.loader.NativeImageLoader (JavaCPP OpenCV) and
+org.datavec.image.recordreader.ImageRecordReader).
+
+The reference decodes via native OpenCV; here PIL decodes on the host and
+NCHW float tensors feed straight to device. Augmentations (ref:
+org.datavec.image.transform.*) are numpy-side functions applied pre-transfer."""
+from __future__ import annotations
+
+import os
+import random
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
+from deeplearning4j_tpu.datavec.records import RecordReader
+from deeplearning4j_tpu.datavec.split import InputSplit
+from deeplearning4j_tpu.datavec.writables import IntWritable, NDArrayWritable, Writable
+
+
+class NativeImageLoader:
+    """Decode to NCHW float32 (ref: NativeImageLoader(h, w, c))."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.height = height
+        self.width = width
+        self.channels = channels
+
+    def asMatrix(self, path_or_img) -> np.ndarray:
+        from PIL import Image
+        img = path_or_img if hasattr(path_or_img, "resize") else Image.open(path_or_img)
+        img = img.convert("L" if self.channels == 1 else "RGB")
+        img = img.resize((self.width, self.height))
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.ndim == 2:
+            arr = arr[None]  # (1, H, W)
+        else:
+            arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+        return arr[None]  # (1, C, H, W)
+
+
+class ImageTransform:
+    """Augmentation SPI (ref: org.datavec.image.transform.ImageTransform)."""
+
+    def transform(self, chw: np.ndarray, rng: random.Random) -> np.ndarray:
+        raise NotImplementedError
+
+
+class FlipImageTransform(ImageTransform):
+    """Random horizontal flip (ref: FlipImageTransform)."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def transform(self, chw, rng):
+        return chw[:, :, ::-1].copy() if rng.random() < self.p else chw
+
+
+class CropImageTransform(ImageTransform):
+    """Random crop by up to ``margin`` px each side, resized back
+    (ref: CropImageTransform)."""
+
+    def __init__(self, margin: int):
+        self.margin = margin
+
+    def transform(self, chw, rng):
+        c, h, w = chw.shape
+        t = rng.randint(0, self.margin)
+        l = rng.randint(0, self.margin)
+        b = rng.randint(0, self.margin)
+        r = rng.randint(0, self.margin)
+        crop = chw[:, t:h - b or h, l:w - r or w]
+        # nearest-neighbor resize back
+        ys = (np.arange(h) * crop.shape[1] / h).astype(int)
+        xs = (np.arange(w) * crop.shape[2] / w).astype(int)
+        return crop[:, ys][:, :, xs]
+
+
+class PipelineImageTransform(ImageTransform):
+    def __init__(self, *transforms: ImageTransform):
+        self.transforms = list(transforms)
+
+    def transform(self, chw, rng):
+        for t in self.transforms:
+            chw = t.transform(chw, rng)
+        return chw
+
+
+class ParentPathLabelGenerator:
+    """Label = parent directory name (ref: org.datavec.api.io.labels.
+    ParentPathLabelGenerator)."""
+
+    def getLabelForPath(self, path: str) -> str:
+        return os.path.basename(os.path.dirname(path))
+
+
+class ImageRecordReader(RecordReader):
+    """(ref: org.datavec.image.recordreader.ImageRecordReader) — record =
+    [NDArrayWritable(CHW image), IntWritable(label)]."""
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 labelGenerator=None, imageTransform: Optional[ImageTransform] = None,
+                 seed: int = 0):
+        self.loader = NativeImageLoader(height, width, channels)
+        self.labelGen = labelGenerator or ParentPathLabelGenerator()
+        self.imageTransform = imageTransform
+        self._rng = random.Random(seed)
+        self._paths: List[str] = []
+        self._labels: List[str] = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit):
+        self._paths = split.locations()
+        labels = sorted({self.labelGen.getLabelForPath(p) for p in self._paths})
+        self._labels = labels
+        self._pos = 0
+        return self
+
+    def getLabels(self) -> List[str]:
+        return list(self._labels)
+
+    def next(self) -> List[Writable]:
+        p = self._paths[self._pos]
+        self._pos += 1
+        img = self.loader.asMatrix(p)[0]
+        if self.imageTransform is not None:
+            img = self.imageTransform.transform(img, self._rng)
+        label = self._labels.index(self.labelGen.getLabelForPath(p))
+        return [NDArrayWritable(img), IntWritable(label)]
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._paths)
+
+    def reset(self):
+        self._pos = 0

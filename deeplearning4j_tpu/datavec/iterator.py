@@ -1,0 +1,156 @@
+"""Record -> DataSet adapters (ref: deeplearning4j-datavec-iterators —
+RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, DataSetIterator
+from deeplearning4j_tpu.datavec.records import RecordReader, SequenceRecordReader
+from deeplearning4j_tpu.datavec.writables import NDArrayWritable
+
+
+def _row_to_floats(record, skip: Optional[int] = None) -> List[float]:
+    out = []
+    for i, w in enumerate(record):
+        if skip is not None and i == skip:
+            continue
+        if isinstance(w, NDArrayWritable):
+            out.extend(np.asarray(w.value, dtype=np.float64).ravel().tolist())
+        else:
+            out.append(w.toDouble())
+    return out
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """(ref: org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator).
+    labelIndex + numClasses -> classification (one-hot); regression=True keeps
+    the label column(s) raw."""
+
+    def __init__(self, recordReader: RecordReader, batchSize: int,
+                 labelIndex: Optional[int] = None, numClasses: Optional[int] = None,
+                 regression: bool = False,
+                 labelIndexFrom: Optional[int] = None, labelIndexTo: Optional[int] = None):
+        self.reader = recordReader
+        self.batchSize = batchSize
+        self.labelIndex = labelIndex
+        self.numClasses = numClasses
+        self.regression = regression
+        self.labelFrom = labelIndexFrom
+        self.labelTo = labelIndexTo
+        self._exhausted = False
+
+    def reset(self):
+        self.reader.reset()
+        self._exhausted = False
+
+    def hasNext(self) -> bool:
+        return not self._exhausted and self.reader.hasNext()
+
+    def batch(self) -> int:
+        return self.batchSize
+
+    def next(self) -> DataSet:
+        feats, labels = [], []
+        n = 0
+        while self.reader.hasNext() and n < self.batchSize:
+            rec = self.reader.next()
+            n += 1
+            if self.labelFrom is not None:
+                lo, hi = self.labelFrom, self.labelTo
+                labels.append([w.toDouble() for w in rec[lo:hi + 1]])
+                feats.append(_row_to_floats(rec[:lo] + rec[hi + 1:]))
+            elif self.labelIndex is not None:
+                label_w = rec[self.labelIndex]
+                feats.append(_row_to_floats(rec, skip=self.labelIndex))
+                if self.regression:
+                    labels.append([label_w.toDouble()])
+                else:
+                    labels.append(_one_hot(label_w.toInt(), self.numClasses))
+            else:
+                feats.append(_row_to_floats(rec))
+        if not self.reader.hasNext():
+            self._exhausted = True
+        x = np.asarray(feats, dtype=np.float32)
+        y = np.asarray(labels, dtype=np.float32) if labels else None
+        return DataSet(x, y if y is not None else x)
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """(ref: SequenceRecordReaderDataSetIterator) — either one reader with the
+    label as a column, or separate feature/label readers (ALIGN_END-style
+    same-length alignment). Output: (B, T, F) NWC."""
+
+    def __init__(self, featureReader: SequenceRecordReader, labelReader=None,
+                 miniBatchSize: int = 8, numPossibleLabels: int = -1,
+                 labelIndex: Optional[int] = None, regression: bool = False):
+        self.fr = featureReader
+        self.lr = labelReader
+        self.batchSize = miniBatchSize
+        self.numClasses = numPossibleLabels
+        self.labelIndex = labelIndex
+        self.regression = regression
+        self._exhausted = False
+
+    def reset(self):
+        self.fr.reset()
+        if self.lr is not None:
+            self.lr.reset()
+        self._exhausted = False
+
+    def hasNext(self) -> bool:
+        return not self._exhausted and self.fr.hasNext()
+
+    def batch(self) -> int:
+        return self.batchSize
+
+    def next(self) -> DataSet:
+        xs, ys, lens = [], [], []
+        n = 0
+        while self.fr.hasNext() and n < self.batchSize:
+            seq = self.fr.next()
+            n += 1
+            if self.lr is not None:
+                lab_seq = self.lr.next()
+                xs.append([[w.toDouble() for w in step] for step in seq])
+                ys.append([self._label(step) for step in lab_seq])
+            elif self.labelIndex is not None:
+                xs.append([[w.toDouble() for i, w in enumerate(step)
+                            if i != self.labelIndex] for step in seq])
+                ys.append([self._label([step[self.labelIndex]]) for step in seq])
+            else:
+                xs.append([[w.toDouble() for w in step] for step in seq])
+                ys.append(None)
+            lens.append(len(seq))
+        if not self.fr.hasNext():
+            self._exhausted = True
+        T = max(lens)
+        F = len(xs[0][0])
+        x = np.zeros((len(xs), T, F), np.float32)
+        mask = np.zeros((len(xs), T), np.float32)
+        for i, s in enumerate(xs):
+            x[i, :len(s)] = s
+            mask[i, :len(s)] = 1.0
+        if ys[0] is None:
+            return DataSet(x, x, features_mask=mask, labels_mask=mask)
+        L = len(ys[0][0])
+        y = np.zeros((len(ys), T, L), np.float32)
+        for i, s in enumerate(ys):
+            y[i, :len(s)] = s
+        return DataSet(x, y, features_mask=mask, labels_mask=mask)
+
+    def _label(self, step) -> List[float]:
+        w = step[-1]
+        if self.regression:
+            return [w.toDouble()]
+        return _one_hot(w.toInt(), self.numClasses)
+
+
+def _one_hot(label: int, num_classes: int) -> List[float]:
+    if not 0 <= label < num_classes:
+        raise ValueError(f"label {label} outside [0, {num_classes}) — negative "
+                         f"sentinels must be filtered before vectorization")
+    hot = [0.0] * num_classes
+    hot[label] = 1.0
+    return hot

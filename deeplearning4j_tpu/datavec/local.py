@@ -1,0 +1,21 @@
+"""Local transform executor (ref: datavec-local
+org.datavec.local.transforms.LocalTransformExecutor)."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from deeplearning4j_tpu.datavec.transform import TransformProcess
+from deeplearning4j_tpu.datavec.writables import Writable
+
+
+class LocalTransformExecutor:
+    """(ref: LocalTransformExecutor.execute)."""
+
+    @staticmethod
+    def execute(records: Sequence[Sequence[Writable]], tp: TransformProcess
+                ) -> List[List[Writable]]:
+        return tp.execute(records)
+
+    @staticmethod
+    def executeToSequence(sequences, tp: TransformProcess):
+        return [tp.execute(seq) for seq in sequences]

@@ -1,0 +1,244 @@
+"""Record readers (ref: datavec-api org.datavec.api.records.reader.* — pull-
+based record sources over InputSplits)."""
+from __future__ import annotations
+
+import csv
+import io
+import re
+from typing import Iterator, List, Optional, Sequence
+
+from deeplearning4j_tpu.datavec.split import InputSplit, StringSplit
+from deeplearning4j_tpu.datavec.writables import Text, Writable, as_writable
+
+
+class RecordReader:
+    """(ref: org.datavec.api.records.reader.RecordReader)."""
+
+    def initialize(self, split: InputSplit):
+        raise NotImplementedError
+
+    def next(self) -> List[Writable]:
+        raise NotImplementedError
+
+    def hasNext(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[List[Writable]]:
+        self.reset()
+        while self.hasNext():
+            yield self.next()
+
+
+class SequenceRecordReader(RecordReader):
+    """(ref: SequenceRecordReader) — next() returns a sequence: list of steps,
+    each a list of Writables."""
+
+    def sequenceRecord(self) -> List[List[Writable]]:
+        return self.next()
+
+
+class _LineBased(RecordReader):
+    """Shared machinery: enumerate lines across the split's locations."""
+
+    def __init__(self, skipNumLines: int = 0):
+        self.skip = skipNumLines
+        self._lines: List[str] = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit):
+        self._lines = []
+        for loc in split.locations():
+            if isinstance(split, StringSplit):
+                text = loc
+            else:
+                with open(loc, "r") as f:
+                    text = f.read()
+            lines = [l for l in text.splitlines()[self.skip:] if l.strip()]
+            self._lines.extend(lines)
+        self._pos = 0
+        return self
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._lines)
+
+    def reset(self):
+        self._pos = 0
+
+    def _next_line(self) -> str:
+        line = self._lines[self._pos]
+        self._pos += 1
+        return line
+
+
+class LineRecordReader(_LineBased):
+    """One Text writable per line (ref: LineRecordReader)."""
+
+    def next(self) -> List[Writable]:
+        return [Text(self._next_line())]
+
+
+class CSVRecordReader(_LineBased):
+    """(ref: org.datavec.api.records.reader.impl.csv.CSVRecordReader)."""
+
+    def __init__(self, skipNumLines: int = 0, delimiter: str = ","):
+        super().__init__(skipNumLines)
+        self.delimiter = delimiter
+
+    def next(self) -> List[Writable]:
+        row = next(csv.reader(io.StringIO(self._next_line()),
+                              delimiter=self.delimiter))
+        return [Text(v.strip()) for v in row]
+
+
+class RegexLineRecordReader(_LineBased):
+    """Line -> regex groups (ref: RegexLineRecordReader)."""
+
+    def __init__(self, regex: str, skipNumLines: int = 0):
+        super().__init__(skipNumLines)
+        self.pattern = re.compile(regex)
+
+    def next(self) -> List[Writable]:
+        line = self._next_line()
+        m = self.pattern.match(line)
+        if m is None:
+            raise ValueError(f"line does not match regex: {line!r}")
+        return [Text(g) for g in m.groups()]
+
+
+class CSVSequenceRecordReader(SequenceRecordReader):
+    """One sequence per FILE (ref: CSVSequenceRecordReader — each location is
+    a time series, rows = steps)."""
+
+    def __init__(self, skipNumLines: int = 0, delimiter: str = ","):
+        self.skip = skipNumLines
+        self.delimiter = delimiter
+        self._seqs: List[List[List[Writable]]] = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit):
+        self._seqs = []
+        for loc in split.locations():
+            with open(loc, "r") as f:
+                rows = list(csv.reader(f, delimiter=self.delimiter))[self.skip:]
+            self._seqs.append([[Text(v.strip()) for v in row] for row in rows if row])
+        self._pos = 0
+        return self
+
+    def next(self) -> List[List[Writable]]:
+        s = self._seqs[self._pos]
+        self._pos += 1
+        return s
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._seqs)
+
+    def reset(self):
+        self._pos = 0
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (ref: CollectionRecordReader)."""
+
+    def __init__(self, records: Sequence[Sequence]):
+        self._records = [[as_writable(v) for v in r] for r in records]
+        self._pos = 0
+
+    def initialize(self, split: Optional[InputSplit] = None):
+        self._pos = 0
+        return self
+
+    def next(self) -> List[Writable]:
+        r = self._records[self._pos]
+        self._pos += 1
+        return list(r)
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._records)
+
+    def reset(self):
+        self._pos = 0
+
+
+class CollectionSequenceRecordReader(SequenceRecordReader):
+    """(ref: CollectionSequenceRecordReader)."""
+
+    def __init__(self, sequences: Sequence[Sequence[Sequence]]):
+        self._seqs = [[[as_writable(v) for v in step] for step in seq]
+                      for seq in sequences]
+        self._pos = 0
+
+    def initialize(self, split: Optional[InputSplit] = None):
+        self._pos = 0
+        return self
+
+    def next(self):
+        s = self._seqs[self._pos]
+        self._pos += 1
+        return s
+
+    def hasNext(self):
+        return self._pos < len(self._seqs)
+
+    def reset(self):
+        self._pos = 0
+
+
+class ComposableRecordReader(RecordReader):
+    """Concatenate several readers' records per step (ref: ComposableRecordReader)."""
+
+    def __init__(self, *readers: RecordReader):
+        self.readers = list(readers)
+
+    def initialize(self, split: Optional[InputSplit] = None):
+        return self
+
+    def next(self) -> List[Writable]:
+        out: List[Writable] = []
+        for r in self.readers:
+            out.extend(r.next())
+        return out
+
+    def hasNext(self) -> bool:
+        return all(r.hasNext() for r in self.readers)
+
+    def reset(self):
+        for r in self.readers:
+            r.reset()
+
+
+class TransformProcessRecordReader(RecordReader):
+    """Wrap a reader with a TransformProcess applied per record
+    (ref: TransformProcessRecordReader). Filtered records are skipped."""
+
+    def __init__(self, recordReader: RecordReader, transformProcess):
+        self.reader = recordReader
+        self.tp = transformProcess
+        self._pending: Optional[List[Writable]] = None
+
+    def initialize(self, split: InputSplit):
+        self.reader.initialize(split)
+        return self
+
+    def _advance(self):
+        while self._pending is None and self.reader.hasNext():
+            out = self.tp.execute([self.reader.next()])
+            if out:
+                self._pending = out[0]
+
+    def hasNext(self) -> bool:
+        self._advance()
+        return self._pending is not None
+
+    def next(self) -> List[Writable]:
+        self._advance()
+        if self._pending is None:
+            raise StopIteration
+        r, self._pending = self._pending, None
+        return r
+
+    def reset(self):
+        self.reader.reset()
+        self._pending = None

@@ -1,0 +1,123 @@
+"""Schema (ref: datavec-api org.datavec.api.transform.schema.Schema — typed
+column metadata flowing through TransformProcess)."""
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence
+
+
+class ColumnType:
+    Double = "Double"
+    Float = "Float"
+    Integer = "Integer"
+    Long = "Long"
+    Categorical = "Categorical"
+    String = "String"
+    Boolean = "Boolean"
+    Time = "Time"
+    NDArray = "NDArray"
+
+
+class ColumnMeta:
+    def __init__(self, name: str, ctype: str, stateNames: Optional[Sequence[str]] = None):
+        self.name = name
+        self.type = ctype
+        self.stateNames = list(stateNames) if stateNames else None
+
+    def to_dict(self):
+        return {"name": self.name, "type": self.type, "stateNames": self.stateNames}
+
+    @staticmethod
+    def from_dict(d):
+        return ColumnMeta(d["name"], d["type"], d.get("stateNames"))
+
+
+class Schema:
+    """(ref: Schema + Schema.Builder)."""
+
+    def __init__(self, columns: Optional[List[ColumnMeta]] = None):
+        self.columns: List[ColumnMeta] = columns or []
+
+    # ---- query
+    def numColumns(self) -> int:
+        return len(self.columns)
+
+    def getColumnNames(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def getIndexOfColumn(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise ValueError(f"no column {name}")
+
+    def getType(self, name_or_idx) -> str:
+        if isinstance(name_or_idx, int):
+            return self.columns[name_or_idx].type
+        return self.columns[self.getIndexOfColumn(name_or_idx)].type
+
+    def getMetaData(self, name: str) -> ColumnMeta:
+        return self.columns[self.getIndexOfColumn(name)]
+
+    def to_json(self) -> str:
+        return json.dumps({"columns": [c.to_dict() for c in self.columns]}, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "Schema":
+        return Schema([ColumnMeta.from_dict(d) for d in json.loads(s)["columns"]])
+
+    class Builder:
+        def __init__(self):
+            self._cols: List[ColumnMeta] = []
+
+        def addColumnDouble(self, name: str):
+            self._cols.append(ColumnMeta(name, ColumnType.Double))
+            return self
+
+        def addColumnFloat(self, name: str):
+            self._cols.append(ColumnMeta(name, ColumnType.Float))
+            return self
+
+        def addColumnInteger(self, name: str):
+            self._cols.append(ColumnMeta(name, ColumnType.Integer))
+            return self
+
+        def addColumnLong(self, name: str):
+            self._cols.append(ColumnMeta(name, ColumnType.Long))
+            return self
+
+        def addColumnCategorical(self, name: str, *stateNames: str):
+            states = list(stateNames[0]) if len(stateNames) == 1 and \
+                isinstance(stateNames[0], (list, tuple)) else list(stateNames)
+            self._cols.append(ColumnMeta(name, ColumnType.Categorical, states))
+            return self
+
+        def addColumnString(self, name: str):
+            self._cols.append(ColumnMeta(name, ColumnType.String))
+            return self
+
+        def addColumnBoolean(self, name: str):
+            self._cols.append(ColumnMeta(name, ColumnType.Boolean))
+            return self
+
+        def addColumnTime(self, name: str, timezone: str = "UTC"):
+            self._cols.append(ColumnMeta(name, ColumnType.Time))
+            return self
+
+        def addColumnsDouble(self, *names: str):
+            for n in names:
+                self.addColumnDouble(n)
+            return self
+
+        def addColumnsInteger(self, *names: str):
+            for n in names:
+                self.addColumnInteger(n)
+            return self
+
+        def addColumnsString(self, *names: str):
+            for n in names:
+                self.addColumnString(n)
+            return self
+
+        def build(self) -> "Schema":
+            return Schema(list(self._cols))

@@ -1,0 +1,80 @@
+"""Input splits (ref: datavec-api org.datavec.api.split.* — enumerate the
+locations a RecordReader pulls from)."""
+from __future__ import annotations
+
+import glob
+import os
+import random
+from typing import List, Optional, Sequence
+
+
+class InputSplit:
+    def locations(self) -> List[str]:
+        raise NotImplementedError
+
+    def length(self) -> int:
+        return len(self.locations())
+
+
+class FileSplit(InputSplit):
+    """(ref: org.datavec.api.split.FileSplit) — a file, or a directory
+    recursively enumerated with optional extension filter + shuffle."""
+
+    def __init__(self, path: str, allowFormats: Optional[Sequence[str]] = None,
+                 recursive: bool = True, rngSeed: Optional[int] = None):
+        self.path = str(path)
+        self.formats = tuple(f.lstrip(".").lower() for f in (allowFormats or ()))
+        self.recursive = recursive
+        self.seed = rngSeed
+
+    def locations(self) -> List[str]:
+        if os.path.isfile(self.path):
+            return [self.path]
+        out = []
+        walker = os.walk(self.path) if self.recursive else \
+            [(self.path, [], os.listdir(self.path))]
+        for root, _dirs, files in walker:
+            for f in sorted(files):
+                p = os.path.join(root, f)
+                if not os.path.isfile(p):
+                    continue
+                if self.formats and f.rsplit(".", 1)[-1].lower() not in self.formats:
+                    continue
+                out.append(p)
+        out.sort()
+        if self.seed is not None:
+            random.Random(self.seed).shuffle(out)
+        return out
+
+
+class CollectionInputSplit(InputSplit):
+    def __init__(self, paths: Sequence[str]):
+        self._paths = list(paths)
+
+    def locations(self) -> List[str]:
+        return list(self._paths)
+
+
+class NumberedFileInputSplit(InputSplit):
+    """(ref: NumberedFileInputSplit) — pattern like "file_%d.txt", inclusive
+    min/max indices."""
+
+    def __init__(self, baseString: str, minIdx: int, maxIdx: int):
+        if "%d" not in baseString:
+            raise ValueError("baseString must contain %d")
+        self.base = baseString
+        self.min = minIdx
+        self.max = maxIdx
+
+    def locations(self) -> List[str]:
+        return [self.base % i for i in range(self.min, self.max + 1)]
+
+
+class StringSplit(InputSplit):
+    """A single in-memory string as the source (ref: StringSplit)."""
+
+    def __init__(self, data: str):
+        self.data = data
+
+    def locations(self) -> List[str]:
+        return [self.data]

@@ -1,0 +1,499 @@
+"""Transform engine (ref: datavec-api org.datavec.api.transform.* —
+TransformProcess fluent DSL over a Schema: column/row transforms, conditions,
+filters, grouped reductions; JSON-serializable)."""
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.datavec.schema import ColumnMeta, ColumnType, Schema
+from deeplearning4j_tpu.datavec.writables import (
+    BooleanWritable, DoubleWritable, IntWritable, NullWritable, Text, Writable,
+    as_writable)
+
+
+class MathOp:
+    Add = "Add"
+    Subtract = "Subtract"
+    Multiply = "Multiply"
+    Divide = "Divide"
+    Modulus = "Modulus"
+    ReverseSubtract = "ReverseSubtract"
+    ReverseDivide = "ReverseDivide"
+    ScalarMin = "ScalarMin"
+    ScalarMax = "ScalarMax"
+
+
+_MATH = {
+    MathOp.Add: lambda a, b: a + b,
+    MathOp.Subtract: lambda a, b: a - b,
+    MathOp.Multiply: lambda a, b: a * b,
+    MathOp.Divide: lambda a, b: a / b,
+    MathOp.Modulus: lambda a, b: a % b,
+    MathOp.ReverseSubtract: lambda a, b: b - a,
+    MathOp.ReverseDivide: lambda a, b: b / a,
+    MathOp.ScalarMin: min,
+    MathOp.ScalarMax: max,
+}
+
+
+class ConditionOp:
+    LessThan = "LessThan"
+    LessOrEqual = "LessOrEqual"
+    GreaterThan = "GreaterThan"
+    GreaterOrEqual = "GreaterOrEqual"
+    Equal = "Equal"
+    NotEqual = "NotEqual"
+    InSet = "InSet"
+    NotInSet = "NotInSet"
+
+
+_COND = {
+    ConditionOp.LessThan: lambda v, t: v < t,
+    ConditionOp.LessOrEqual: lambda v, t: v <= t,
+    ConditionOp.GreaterThan: lambda v, t: v > t,
+    ConditionOp.GreaterOrEqual: lambda v, t: v >= t,
+    ConditionOp.Equal: lambda v, t: v == t,
+    ConditionOp.NotEqual: lambda v, t: v != t,
+    ConditionOp.InSet: lambda v, t: v in t,
+    ConditionOp.NotInSet: lambda v, t: v not in t,
+}
+
+
+class Condition:
+    """(ref: o.d.api.transform.condition.column.*Condition)."""
+
+    def __init__(self, column: str, op: str, value, numeric: bool = True):
+        self.column = column
+        self.op = op
+        self.value = set(value) if op in (ConditionOp.InSet, ConditionOp.NotInSet) \
+            else value
+        self.numeric = numeric
+        self._idx_cache = None  # (schema, index) memo — avoids per-row scans
+
+    def matches(self, record: List[Writable], schema: Schema) -> bool:
+        if self._idx_cache is None or self._idx_cache[0] is not schema:
+            self._idx_cache = (schema, schema.getIndexOfColumn(self.column))
+        w = record[self._idx_cache[1]]
+        v = w.toDouble() if self.numeric else w.toString()
+        return _COND[self.op](v, self.value)
+
+    def to_dict(self):
+        return {"column": self.column, "op": self.op,
+                "value": list(self.value) if isinstance(self.value, (set, list, tuple))
+                else self.value, "numeric": self.numeric}
+
+    @staticmethod
+    def from_dict(d):
+        return Condition(d["column"], d["op"], d["value"], d.get("numeric", True))
+
+
+class ConditionFilter:
+    """Remove records matching the condition (ref: filter.ConditionFilter)."""
+
+    def __init__(self, condition: Condition):
+        self.condition = condition
+
+    def removeExample(self, record, schema) -> bool:
+        return self.condition.matches(record, schema)
+
+    def to_dict(self):
+        return {"@type": "ConditionFilter", "condition": self.condition.to_dict()}
+
+
+class FilterInvalidValues:
+    """Drop rows whose named columns fail to parse for their type
+    (ref: filter.FilterInvalidValues)."""
+
+    def __init__(self, *columns: str):
+        self.columns = list(columns)
+
+    def removeExample(self, record, schema) -> bool:
+        cols = self.columns or schema.getColumnNames()
+        for c in cols:
+            idx = schema.getIndexOfColumn(c)
+            t = schema.getType(idx)
+            w = record[idx]
+            try:
+                if t in (ColumnType.Double, ColumnType.Float):
+                    v = w.toDouble()
+                    if math.isnan(v) or math.isinf(v):
+                        return True
+                elif t in (ColumnType.Integer, ColumnType.Long):
+                    w.toInt()
+                elif t == ColumnType.Categorical:
+                    states = schema.getMetaData(c).stateNames or []
+                    if w.toString() not in states:
+                        return True
+            except (ValueError, TypeError):
+                return True
+        return False
+
+    def to_dict(self):
+        return {"@type": "FilterInvalidValues", "columns": self.columns}
+
+
+class _Step:
+    """One pipeline step: transform | filter | reduce."""
+
+    def __init__(self, kind: str, spec: Dict[str, Any]):
+        self.kind = kind
+        self.spec = spec
+
+
+class TransformProcess:
+    """(ref: org.datavec.api.transform.TransformProcess + .Builder)."""
+
+    def __init__(self, initialSchema: Schema, steps: List[_Step]):
+        self.initialSchema = initialSchema
+        self.steps = steps
+
+    # ------------------------------------------------------------- schema
+    def getFinalSchema(self) -> Schema:
+        schema = self.initialSchema
+        for s in self.steps:
+            schema = _apply_schema(schema, s)
+        return schema
+
+    # ---------------------------------------------------------------- exec
+    def execute(self, records: Sequence[Sequence[Writable]]) -> List[List[Writable]]:
+        rows = [list(r) for r in records]
+        schema = self.initialSchema
+        for s in self.steps:
+            rows = _apply_rows(rows, schema, s)
+            schema = _apply_schema(schema, s)
+        return rows
+
+    # ---------------------------------------------------------------- serde
+    def to_json(self) -> str:
+        return json.dumps({
+            "initialSchema": json.loads(self.initialSchema.to_json()),
+            "steps": [{"kind": s.kind, "spec": _spec_to_json(s.spec)}
+                      for s in self.steps],
+        }, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "TransformProcess":
+        d = json.loads(s)
+        schema = Schema.from_json(json.dumps(d["initialSchema"]))
+        steps = [_Step(sd["kind"], _spec_from_json(sd["spec"])) for sd in d["steps"]]
+        return TransformProcess(schema, steps)
+
+    class Builder:
+        def __init__(self, initialSchema: Schema):
+            self._schema = initialSchema
+            self._steps: List[_Step] = []
+
+        def _add(self, kind, **spec):
+            self._steps.append(_Step(kind, spec))
+            return self
+
+        # ---- column structure
+        def removeColumns(self, *names: str):
+            return self._add("removeColumns", names=list(names))
+
+        def removeAllColumnsExceptFor(self, *names: str):
+            return self._add("keepColumns", names=list(names))
+
+        def renameColumn(self, old: str, new: str):
+            return self._add("renameColumn", old=old, new=new)
+
+        def reorderColumns(self, *names: str):
+            return self._add("reorderColumns", names=list(names))
+
+        def duplicateColumn(self, src: str, dst: str):
+            return self._add("duplicateColumn", src=src, dst=dst)
+
+        # ---- categorical
+        def categoricalToInteger(self, *names: str):
+            return self._add("categoricalToInteger", names=list(names))
+
+        def categoricalToOneHot(self, *names: str):
+            return self._add("categoricalToOneHot", names=list(names))
+
+        def integerToCategorical(self, name: str, states: Sequence[str]):
+            return self._add("integerToCategorical", name=name, states=list(states))
+
+        def stringToCategorical(self, name: str, states: Sequence[str]):
+            return self._add("stringToCategorical", name=name, states=list(states))
+
+        # ---- math
+        def doubleMathOp(self, name: str, op: str, scalar: float):
+            return self._add("doubleMathOp", name=name, op=op, scalar=scalar)
+
+        def integerMathOp(self, name: str, op: str, scalar: int):
+            return self._add("integerMathOp", name=name, op=op, scalar=scalar)
+
+        def doubleColumnsMathOp(self, newName: str, op: str, *columns: str):
+            return self._add("doubleColumnsMathOp", newName=newName, op=op,
+                             columns=list(columns))
+
+        def normalize(self, name: str, mode: str, stats: Dict[str, float]):
+            """mode: 'MinMax' | 'Standardize' with stats from AnalyzeLocal."""
+            return self._add("normalize", name=name, mode=mode, stats=dict(stats))
+
+        # ---- strings
+        def stringMapTransform(self, name: str, mapping: Dict[str, str]):
+            return self._add("stringMap", name=name, mapping=dict(mapping))
+
+        def appendStringColumnTransform(self, name: str, toAppend: str):
+            return self._add("appendString", name=name, toAppend=toAppend)
+
+        def stringRemoveWhitespaceTransform(self, name: str):
+            return self._add("stringStrip", name=name)
+
+        def replaceStringTransform(self, name: str, mapping: Dict[str, str]):
+            return self._add("replaceString", name=name, mapping=dict(mapping))
+
+        # ---- conditional
+        def conditionalReplaceValueTransform(self, name: str, newValue,
+                                             condition: Condition):
+            return self._add("conditionalReplace", name=name, newValue=newValue,
+                             condition=condition)
+
+        # ---- filters
+        def filter(self, f):
+            return self._add("filter", filter=f)
+
+        # ---- grouped reduction
+        def reduce(self, keyColumn: str, aggregations: Dict[str, str]):
+            """aggregations: {column: 'sum'|'mean'|'min'|'max'|'count'|'first'}
+            (ref: o.d.api.transform.reduce.Reducer grouped by key)."""
+            return self._add("reduce", key=keyColumn, aggs=dict(aggregations))
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self._schema, list(self._steps))
+
+
+# ------------------------------------------------------------ serde helpers
+
+def _spec_to_json(spec):
+    out = {}
+    for k, v in spec.items():
+        if isinstance(v, (Condition,)):
+            out[k] = {"@cond": v.to_dict()}
+        elif isinstance(v, (ConditionFilter, FilterInvalidValues)):
+            out[k] = v.to_dict()
+        else:
+            out[k] = v
+    return out
+
+
+def _spec_from_json(spec):
+    out = {}
+    for k, v in spec.items():
+        if isinstance(v, dict) and "@cond" in v:
+            out[k] = Condition.from_dict(v["@cond"])
+        elif isinstance(v, dict) and v.get("@type") == "ConditionFilter":
+            out[k] = ConditionFilter(Condition.from_dict(v["condition"]))
+        elif isinstance(v, dict) and v.get("@type") == "FilterInvalidValues":
+            out[k] = FilterInvalidValues(*v["columns"])
+        else:
+            out[k] = v
+    return out
+
+
+# --------------------------------------------------------- schema evolution
+
+def _apply_schema(schema: Schema, step: _Step) -> Schema:
+    cols = [ColumnMeta(c.name, c.type, c.stateNames) for c in schema.columns]
+    k, s = step.kind, step.spec
+    if k == "removeColumns":
+        cols = [c for c in cols if c.name not in set(s["names"])]
+    elif k == "keepColumns":
+        keep = set(s["names"])
+        cols = [c for c in cols if c.name in keep]
+    elif k == "renameColumn":
+        for c in cols:
+            if c.name == s["old"]:
+                c.name = s["new"]
+    elif k == "reorderColumns":
+        by = {c.name: c for c in cols}
+        ordered = [by[n] for n in s["names"]]
+        ordered += [c for c in cols if c.name not in set(s["names"])]
+        cols = ordered
+    elif k == "duplicateColumn":
+        src = next(c for c in cols if c.name == s["src"])
+        cols.insert(cols.index(src) + 1, ColumnMeta(s["dst"], src.type, src.stateNames))
+    elif k == "categoricalToInteger":
+        for c in cols:
+            if c.name in set(s["names"]):
+                c.type = ColumnType.Integer
+    elif k == "categoricalToOneHot":
+        out = []
+        names = set(s["names"])
+        for c in cols:
+            if c.name in names:
+                for st in (c.stateNames or []):
+                    out.append(ColumnMeta(f"{c.name}[{st}]", ColumnType.Integer))
+            else:
+                out.append(c)
+        cols = out
+    elif k in ("integerToCategorical", "stringToCategorical"):
+        for c in cols:
+            if c.name == s["name"]:
+                c.type = ColumnType.Categorical
+                c.stateNames = list(s["states"])
+    elif k == "doubleColumnsMathOp":
+        cols.append(ColumnMeta(s["newName"], ColumnType.Double))
+    elif k == "reduce":
+        key = s["key"]
+        out = [ColumnMeta(key, schema.getType(key))]
+        for col, agg in s["aggs"].items():
+            ctype = ColumnType.Integer if agg == "count" else ColumnType.Double
+            out.append(ColumnMeta(f"{agg}({col})", ctype))
+        cols = out
+    return Schema(cols)
+
+
+# --------------------------------------------------------------- row apply
+
+def _apply_rows(rows: List[List[Writable]], schema: Schema, step: _Step
+                ) -> List[List[Writable]]:
+    k, s = step.kind, step.spec
+    names = schema.getColumnNames()
+    idx = {n: i for i, n in enumerate(names)}
+
+    if k == "removeColumns":
+        drop = {idx[n] for n in s["names"]}
+        return [[w for i, w in enumerate(r) if i not in drop] for r in rows]
+    if k == "keepColumns":
+        keep = [i for i, n in enumerate(names) if n in set(s["names"])]
+        return [[r[i] for i in keep] for r in rows]
+    if k == "renameColumn":
+        return rows
+    if k == "reorderColumns":
+        order = [idx[n] for n in s["names"]]
+        order += [i for i in range(len(names)) if i not in set(order)]
+        return [[r[i] for i in order] for r in rows]
+    if k == "duplicateColumn":
+        i = idx[s["src"]]
+        return [r[:i + 1] + [r[i]] + r[i + 1:] for r in rows]
+    if k == "categoricalToInteger":
+        out = []
+        targets = {idx[n]: (schema.getMetaData(n).stateNames or []) for n in s["names"]}
+        for r in rows:
+            r = list(r)
+            for i, states in targets.items():
+                r[i] = IntWritable(states.index(r[i].toString()))
+            out.append(r)
+        return out
+    if k == "categoricalToOneHot":
+        targets = {idx[n]: (schema.getMetaData(n).stateNames or []) for n in s["names"]}
+        out = []
+        for r in rows:
+            nr: List[Writable] = []
+            for i, w in enumerate(r):
+                if i in targets:
+                    states = targets[i]
+                    hot = states.index(w.toString())
+                    nr.extend(IntWritable(1 if j == hot else 0)
+                              for j in range(len(states)))
+                else:
+                    nr.append(w)
+            out.append(nr)
+        return out
+    if k == "integerToCategorical":
+        i = idx[s["name"]]
+        states = s["states"]
+        return [_set(r, i, Text(states[r[i].toInt()])) for r in rows]
+    if k == "stringToCategorical":
+        return rows
+    if k == "doubleMathOp":
+        i = idx[s["name"]]
+        fn = _MATH[s["op"]]
+        return [_set(r, i, DoubleWritable(fn(r[i].toDouble(), s["scalar"])))
+                for r in rows]
+    if k == "integerMathOp":
+        i = idx[s["name"]]
+        fn = _MATH[s["op"]]
+        return [_set(r, i, IntWritable(int(fn(r[i].toInt(), s["scalar"]))))
+                for r in rows]
+    if k == "doubleColumnsMathOp":
+        cols = [idx[n] for n in s["columns"]]
+        fn = _MATH[s["op"]]
+        out = []
+        for r in rows:
+            acc = r[cols[0]].toDouble()
+            for c in cols[1:]:
+                acc = fn(acc, r[c].toDouble())
+            out.append(list(r) + [DoubleWritable(acc)])
+        return out
+    if k == "normalize":
+        i = idx[s["name"]]
+        st = s["stats"]
+        if s["mode"] == "MinMax":
+            lo, hi = st["min"], st["max"]
+            return [_set(r, i, DoubleWritable((r[i].toDouble() - lo) / max(hi - lo, 1e-12)))
+                    for r in rows]
+        mu, sd = st["mean"], st.get("std", 1.0)
+        return [_set(r, i, DoubleWritable((r[i].toDouble() - mu) / max(sd, 1e-12)))
+                for r in rows]
+    if k == "stringMap":
+        i = idx[s["name"]]
+        m = s["mapping"]
+        return [_set(r, i, Text(m.get(r[i].toString(), r[i].toString()))) for r in rows]
+    if k == "appendString":
+        i = idx[s["name"]]
+        return [_set(r, i, Text(r[i].toString() + s["toAppend"])) for r in rows]
+    if k == "stringStrip":
+        i = idx[s["name"]]
+        return [_set(r, i, Text("".join(r[i].toString().split()))) for r in rows]
+    if k == "replaceString":
+        i = idx[s["name"]]
+        out = []
+        for r in rows:
+            v = r[i].toString()
+            for old, new in s["mapping"].items():
+                v = v.replace(old, new)
+            out.append(_set(r, i, Text(v)))
+        return out
+    if k == "conditionalReplace":
+        i = idx[s["name"]]
+        cond = s["condition"]
+        return [_set(r, i, as_writable(s["newValue"])) if cond.matches(r, schema)
+                else r for r in rows]
+    if k == "filter":
+        f = s["filter"]
+        return [r for r in rows if not f.removeExample(r, schema)]
+    if k == "reduce":
+        key_i = idx[s["key"]]
+        groups: Dict[str, List[List[Writable]]] = {}
+        order: List[str] = []
+        for r in rows:
+            kv = r[key_i].toString()
+            if kv not in groups:
+                groups[kv] = []
+                order.append(kv)
+            groups[kv].append(r)
+        out = []
+        for kv in order:
+            grp = groups[kv]
+            row: List[Writable] = [grp[0][key_i]]
+            for col, agg in s["aggs"].items():
+                ci = idx[col]
+                vals = [g[ci].toDouble() for g in grp]
+                if agg == "sum":
+                    row.append(DoubleWritable(sum(vals)))
+                elif agg == "mean":
+                    row.append(DoubleWritable(sum(vals) / len(vals)))
+                elif agg == "min":
+                    row.append(DoubleWritable(min(vals)))
+                elif agg == "max":
+                    row.append(DoubleWritable(max(vals)))
+                elif agg == "count":
+                    row.append(IntWritable(len(vals)))
+                elif agg == "first":
+                    row.append(grp[0][ci])
+                else:
+                    raise ValueError(f"unknown aggregation {agg}")
+            out.append(row)
+        return out
+    raise ValueError(f"unknown transform step {k}")
+
+
+def _set(r: List[Writable], i: int, w: Writable) -> List[Writable]:
+    r = list(r)
+    r[i] = w
+    return r

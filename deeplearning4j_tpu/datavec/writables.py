@@ -1,0 +1,113 @@
+"""Writable value family (ref: datavec-api org.datavec.api.writable.* —
+Hadoop-style typed cells)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Writable:
+    def __init__(self, value=None):
+        self.value = value
+
+    def toDouble(self) -> float:
+        return float(self.value)
+
+    def toFloat(self) -> float:
+        return float(self.value)
+
+    def toInt(self) -> int:
+        return int(float(self.value))
+
+    def toLong(self) -> int:
+        return int(float(self.value))
+
+    def toString(self) -> str:
+        return str(self.value)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.value!r})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.value == other.value
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.value))
+
+
+class DoubleWritable(Writable):
+    def __init__(self, value=0.0):
+        super().__init__(float(value))
+
+
+class FloatWritable(Writable):
+    def __init__(self, value=0.0):
+        super().__init__(float(value))
+
+
+class IntWritable(Writable):
+    def __init__(self, value=0):
+        super().__init__(int(value))
+
+
+class LongWritable(Writable):
+    def __init__(self, value=0):
+        super().__init__(int(value))
+
+
+class BooleanWritable(Writable):
+    def __init__(self, value=False):
+        super().__init__(bool(value))
+
+    def toDouble(self):
+        return 1.0 if self.value else 0.0
+
+    def toInt(self):
+        return 1 if self.value else 0
+
+
+class Text(Writable):
+    def __init__(self, value=""):
+        super().__init__(str(value))
+
+    def toDouble(self):
+        return float(self.value)
+
+    def toInt(self):
+        return int(float(self.value))
+
+
+class NullWritable(Writable):
+    def __init__(self):
+        super().__init__(None)
+
+    def toDouble(self):
+        return float("nan")
+
+    def toString(self):
+        return ""
+
+
+class NDArrayWritable(Writable):
+    """(ref: org.datavec.api.writable.NDArrayWritable)."""
+
+    def __init__(self, array):
+        super().__init__(np.asarray(array))
+
+    def toString(self):
+        return str(self.value)
+
+
+def as_writable(v) -> Writable:
+    if isinstance(v, Writable):
+        return v
+    if isinstance(v, bool):
+        return BooleanWritable(v)
+    if isinstance(v, (int, np.integer)):
+        return IntWritable(int(v))
+    if isinstance(v, (float, np.floating)):
+        return DoubleWritable(float(v))
+    if isinstance(v, np.ndarray):
+        return NDArrayWritable(v)
+    if v is None:
+        return NullWritable()
+    return Text(str(v))

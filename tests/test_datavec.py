@@ -1,0 +1,254 @@
+"""DataVec ETL tests (ref: datavec-api test patterns: reader semantics,
+TransformProcess execution + schema evolution + JSON round-trip, record->
+DataSet adapters, image pipeline end-to-end into a network)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.normalizers import (
+    ImagePreProcessingScaler, NormalizerMinMaxScaler, NormalizerStandardize)
+from deeplearning4j_tpu.datavec import (
+    AnalyzeLocal, CollectionRecordReader, CollectionSequenceRecordReader,
+    Condition, ConditionFilter, ConditionOp, CSVRecordReader,
+    CSVSequenceRecordReader, FileSplit, FilterInvalidValues, ImageRecordReader,
+    LineRecordReader, LocalTransformExecutor, MathOp, NumberedFileInputSplit,
+    RecordReaderDataSetIterator, RegexLineRecordReader, Schema,
+    SequenceRecordReaderDataSetIterator, StringSplit, TransformProcess,
+    TransformProcessRecordReader)
+
+
+CSV = "1.0,2.0,cat,0\n3.0,4.0,dog,1\n5.0,6.0,cat,2\nbad,8.0,fish,0\n"
+
+
+def _csv_reader(tmp_path, content=CSV, skip=0):
+    p = tmp_path / "data.csv"
+    p.write_text(content)
+    r = CSVRecordReader(skipNumLines=skip)
+    r.initialize(FileSplit(str(p)))
+    return r
+
+
+def test_csv_reader_and_splits(tmp_path):
+    r = _csv_reader(tmp_path)
+    rows = list(r)
+    assert len(rows) == 4
+    assert rows[0][2].toString() == "cat"
+    assert rows[1][0].toDouble() == 3.0
+    # reset works
+    assert len(list(r)) == 4
+    # NumberedFileInputSplit enumerates patterns
+    s = NumberedFileInputSplit("f_%d.txt", 2, 5)
+    assert s.locations() == ["f_2.txt", "f_3.txt", "f_4.txt", "f_5.txt"]
+
+
+def test_line_and_regex_readers():
+    lr = LineRecordReader()
+    lr.initialize(StringSplit("alpha\nbeta\n"))
+    assert [r[0].toString() for r in lr] == ["alpha", "beta"]
+    rr = RegexLineRecordReader(r"(\d+)-(\w+)")
+    rr.initialize(StringSplit("12-ab\n34-cd"))
+    out = list(rr)
+    assert out[0][0].toString() == "12" and out[1][1].toString() == "cd"
+
+
+def _schema():
+    return (Schema.Builder()
+            .addColumnsDouble("a", "b")
+            .addColumnCategorical("animal", ["cat", "dog", "fish"])
+            .addColumnInteger("label")
+            .build())
+
+
+def test_transform_process_pipeline(tmp_path):
+    schema = _schema()
+    tp = (TransformProcess.Builder(schema)
+          .filter(FilterInvalidValues("a"))                    # drops 'bad' row
+          .doubleMathOp("a", MathOp.Multiply, 2.0)
+          .categoricalToInteger("animal")
+          .removeColumns("b")
+          .build())
+    rows = list(_csv_reader(tmp_path))
+    out = LocalTransformExecutor.execute(rows, tp)
+    assert len(out) == 3
+    assert [r[0].toDouble() for r in out] == [2.0, 6.0, 10.0]
+    assert [r[1].toInt() for r in out] == [0, 1, 0]  # cat,dog,cat
+    final = tp.getFinalSchema()
+    assert final.getColumnNames() == ["a", "animal", "label"]
+    assert final.getType("animal") == "Integer"
+
+
+def test_transform_one_hot_and_conditional():
+    schema = _schema()
+    tp = (TransformProcess.Builder(schema)
+          .conditionalReplaceValueTransform(
+              "a", 0.0, Condition("a", ConditionOp.GreaterThan, 4.0))
+          .categoricalToOneHot("animal")
+          .build())
+    rr = CollectionRecordReader([[1.0, 2.0, "cat", 0], [5.0, 6.0, "fish", 1]])
+    out = tp.execute(list(rr))
+    assert out[1][0].toDouble() == 0.0          # replaced (5.0 > 4.0)
+    assert [w.toInt() for w in out[0][2:5]] == [1, 0, 0]
+    assert [w.toInt() for w in out[1][2:5]] == [0, 0, 1]
+    assert tp.getFinalSchema().getColumnNames() == [
+        "a", "b", "animal[cat]", "animal[dog]", "animal[fish]", "label"]
+
+
+def test_transform_reduce_and_json_roundtrip():
+    schema = (Schema.Builder().addColumnString("key")
+              .addColumnsDouble("v").build())
+    tp = (TransformProcess.Builder(schema)
+          .reduce("key", {"v": "mean"})
+          .build())
+    rr = CollectionRecordReader([["x", 1.0], ["y", 10.0], ["x", 3.0]])
+    out = tp.execute(list(rr))
+    assert len(out) == 2
+    assert out[0][1].toDouble() == 2.0
+    # JSON round-trip preserves behavior (ref: TransformProcess.toJson)
+    tp2 = TransformProcess.from_json(tp.to_json())
+    rr.reset()
+    out2 = tp2.execute(list(rr))
+    assert [r[1].toDouble() for r in out2] == [r[1].toDouble() for r in out]
+
+
+def test_transform_process_record_reader(tmp_path):
+    tp = (TransformProcess.Builder(_schema())
+          .filter(ConditionFilter(Condition("animal", ConditionOp.InSet,
+                                            {"fish"}, numeric=False)))
+          .build())
+    r = TransformProcessRecordReader(_csv_reader(tmp_path), tp)
+    rows = list(r)
+    assert len(rows) == 3  # fish row filtered
+
+
+def test_record_reader_dataset_iterator(tmp_path):
+    content = "1,2,0\n3,4,1\n5,6,2\n7,8,1\n"
+    r = _csv_reader(tmp_path, content)
+    it = RecordReaderDataSetIterator(r, batchSize=3, labelIndex=2, numClasses=3)
+    ds = it.next()
+    assert ds.features.shape == (3, 2)
+    assert ds.labels.shape == (3, 3)
+    np.testing.assert_array_equal(ds.labels[1], [0, 1, 0])
+    ds2 = it.next()
+    assert ds2.features.shape == (1, 2)
+    assert not it.hasNext()
+    it.reset()
+    assert it.hasNext()
+
+
+def test_sequence_iterator_padding():
+    seqs = [[[0.1, 0.2, 0], [0.3, 0.4, 1]],
+            [[0.5, 0.6, 2], [0.7, 0.8, 0], [0.9, 1.0, 1]]]
+    fr = CollectionSequenceRecordReader(seqs)
+    it = SequenceRecordReaderDataSetIterator(fr, miniBatchSize=2,
+                                             numPossibleLabels=3, labelIndex=2)
+    ds = it.next()
+    assert ds.features.shape == (2, 3, 2)   # padded to T=3
+    assert ds.labels.shape == (2, 3, 3)
+    np.testing.assert_array_equal(ds.features_mask, [[1, 1, 0], [1, 1, 1]])
+
+
+def test_image_pipeline_end_to_end(tmp_path):
+    """PNG files on disk -> ImageRecordReader -> iterator -> LeNet-style net."""
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for cls in ("zero", "one"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(4):
+            arr = rng.integers(0, 255, (12, 12), np.uint8)
+            Image.fromarray(arr, "L").save(d / f"{i}.png")
+    reader = ImageRecordReader(height=10, width=10, channels=1)
+    reader.initialize(FileSplit(str(tmp_path / "imgs"), allowFormats=["png"]))
+    assert reader.getLabels() == ["one", "zero"]
+    it = RecordReaderDataSetIterator(reader, batchSize=8, labelIndex=1, numClasses=2)
+    ds = it.next()
+    assert ds.features.shape == (8, 100)  # flattened CHW
+    scaler = ImagePreProcessingScaler()
+    scaler.transform(ds)
+    assert ds.features.max() <= 1.0
+
+    from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer, OutputLayer
+    conf = (NeuralNetConfiguration.Builder().seed(1).list()
+            .layer(ConvolutionLayer(nOut=4, kernelSize=(3, 3), convolutionMode="Same",
+                                    activation="RELU"))
+            .layer(OutputLayer(nOut=2, activation="SOFTMAX", lossFunction="MCXENT"))
+            .setInputType(InputType.convolutionalFlat(10, 10, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ds)
+    assert np.isfinite(net.score())
+
+
+def test_analysis_and_normalizers(tmp_path):
+    schema = _schema()
+    rows = [r for r in _csv_reader(tmp_path)][:3]  # drop bad row
+    analysis = AnalyzeLocal.analyze(schema, rows)
+    a = analysis.getColumnAnalysis("a")
+    assert a.getMin() == 1.0 and a.getMax() == 5.0 and a.getMean() == 3.0
+
+    x = np.array([[0.0, 10.0], [2.0, 20.0], [4.0, 30.0]], np.float32)
+    ds = DataSet(x.copy(), x.copy())
+    ns = NormalizerStandardize()
+    ns.fit(ds)
+    ns.transform(ds)
+    np.testing.assert_allclose(ds.features.mean(0), 0.0, atol=1e-6)
+    ns.revert(ds)
+    np.testing.assert_allclose(ds.features, x, atol=1e-5)
+
+    ds2 = DataSet(x.copy(), x.copy())
+    mm = NormalizerMinMaxScaler()
+    mm.fit(ds2)
+    mm.transform(ds2)
+    assert ds2.features.min() == 0.0 and ds2.features.max() == 1.0
+
+
+def test_csv_sequence_reader(tmp_path):
+    for i in range(2):
+        (tmp_path / f"seq_{i}.csv").write_text("1,2\n3,4\n5,6\n")
+    r = CSVSequenceRecordReader()
+    r.initialize(NumberedFileInputSplit(str(tmp_path / "seq_%d.csv"), 0, 1))
+    seqs = [r.next() for _ in range(2)]
+    assert not r.hasNext()
+    assert len(seqs[0]) == 3 and seqs[0][2][1].toDouble() == 6.0
+
+
+def test_sequence_normalizer_masked_nwc():
+    """Regression (review): 3D stats are per-FEATURE (NWC) and exclude padding."""
+    x1 = np.zeros((1, 3, 2), np.float32)
+    x1[0, :2] = [[1.0, 10.0], [3.0, 30.0]]          # third step is padding
+    m1 = np.array([[1, 1, 0]], np.float32)
+    x2 = np.zeros((1, 5, 2), np.float32)             # different T than batch 1
+    x2[0] = [[5.0, 50.0]] * 5
+    m2 = np.ones((1, 5), np.float32)
+    ds1 = DataSet(x1, x1, features_mask=m1)
+    ds2 = DataSet(x2, x2, features_mask=m2)
+
+    class _It:
+        def __init__(self):
+            self._d = [ds1, ds2]
+
+        def reset(self):
+            pass
+
+        def __iter__(self):
+            return iter([ds1, ds2])
+
+    ns = NormalizerStandardize()
+    ns.fit(_It())
+    # 7 unmasked rows: f0 mean = (1+3+5*5)/7
+    np.testing.assert_allclose(ns.mean, [(1 + 3 + 25) / 7, (10 + 30 + 250) / 7])
+    ns.transform(ds2)
+    assert ds2.features.shape == (1, 5, 2)  # broadcast over NWC
+
+
+def test_csv_blank_lines_skipped(tmp_path):
+    r = _csv_reader(tmp_path, "1,2\n\n3,4\n\n")
+    assert len(list(r)) == 2
+
+
+def test_negative_label_raises(tmp_path):
+    r = _csv_reader(tmp_path, "1,2,-1\n")
+    it = RecordReaderDataSetIterator(r, batchSize=1, labelIndex=2, numClasses=3)
+    with pytest.raises(ValueError, match="outside"):
+        it.next()
