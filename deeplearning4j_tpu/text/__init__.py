@@ -1,0 +1,19 @@
+"""NLP / embedding models (ref: deeplearning4j-nlp-parent — Word2Vec,
+ParagraphVectors, GloVe, tokenizers, vocab, serializer; SURVEY.md §2.4)."""
+from deeplearning4j_tpu.text.tokenization import (
+    DefaultTokenizerFactory, NGramTokenizerFactory, CommonPreprocessor,
+    LowCasePreProcessor)
+from deeplearning4j_tpu.text.sentence_iterator import (
+    BasicLineIterator, CollectionSentenceIterator, LineSentenceIterator)
+from deeplearning4j_tpu.text.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.text.word2vec import Word2Vec
+from deeplearning4j_tpu.text.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.text.glove import Glove
+from deeplearning4j_tpu.text.serializer import WordVectorSerializer
+
+__all__ = [
+    "DefaultTokenizerFactory", "NGramTokenizerFactory", "CommonPreprocessor",
+    "LowCasePreProcessor", "BasicLineIterator", "CollectionSentenceIterator",
+    "LineSentenceIterator", "VocabCache", "VocabWord", "Word2Vec",
+    "ParagraphVectors", "Glove", "WordVectorSerializer",
+]

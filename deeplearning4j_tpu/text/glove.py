@@ -1,0 +1,107 @@
+"""GloVe (ref: deeplearning4j-nlp org.deeplearning4j.models.glove.Glove —
+co-occurrence counting + weighted least-squares factorization with AdaGrad)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.text.word2vec import WordVectorsModel
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+
+
+def _glove_step(w, wt, b, bt, hw, hwt, hb, hbt, ci, cj, cx, xmax, alpha, lr):
+    """Batched AdaGrad GloVe update on co-occurrence triples (i, j, X_ij)."""
+    wi, wj = w[ci], wt[cj]
+    diff = jnp.sum(wi * wj, axis=-1) + b[ci] + bt[cj] - jnp.log(cx)
+    f = jnp.minimum(1.0, (cx / xmax) ** alpha)
+    fd = f * diff                                     # (B,)
+    gw = fd[:, None] * wj
+    gwt = fd[:, None] * wi
+    gb = fd
+    # AdaGrad accumulators
+    hw = hw.at[ci].add(gw * gw)
+    hwt = hwt.at[cj].add(gwt * gwt)
+    hb = hb.at[ci].add(gb * gb)
+    hbt = hbt.at[cj].add(gb * gb)
+    w = w.at[ci].add(-lr * gw / jnp.sqrt(hw[ci] + 1e-8))
+    wt = wt.at[cj].add(-lr * gwt / jnp.sqrt(hwt[cj] + 1e-8))
+    b = b.at[ci].add(-lr * gb / jnp.sqrt(hb[ci] + 1e-8))
+    bt = bt.at[cj].add(-lr * gb / jnp.sqrt(hbt[cj] + 1e-8))
+    loss = 0.5 * jnp.sum(f * diff * diff)
+    return w, wt, b, bt, hw, hwt, hb, hbt, loss
+
+
+_glove_step_jit = jax.jit(_glove_step)
+
+
+class Glove(WordVectorsModel):
+    """(ref: Glove.Builder)."""
+
+    def __init__(self, minWordFrequency=1, iterations=15, layerSize=50, seed=42,
+                 windowSize=5, learningRate=0.05, xMax=100.0, alpha=0.75,
+                 batchSize=1024, iterate=None, tokenizerFactory=None):
+        super().__init__()
+        self.minWordFrequency = minWordFrequency
+        self.iterations = iterations
+        self.layerSize = layerSize
+        self.seed = seed
+        self.windowSize = windowSize
+        self.learningRate = learningRate
+        self.xMax = xMax
+        self.alpha = alpha
+        self.batchSize = batchSize
+        self.iterator = iterate
+        self.tokenizer = tokenizerFactory or DefaultTokenizerFactory()
+
+    def fit(self):
+        for s in self.iterator:
+            for t in self.tokenizer.create(s).getTokens():
+                self.vocab.addToken(t)
+        self.vocab.finalize_vocab(self.minWordFrequency)
+        V, D = self.vocab.numWords(), self.layerSize
+
+        # co-occurrence accumulation with 1/distance weighting (ref:
+        # CoOccurrences)
+        cooc: dict = {}
+        for s in self.iterator:
+            ids = [self.vocab.indexOf(t)
+                   for t in self.tokenizer.create(s).getTokens()]
+            ids = [i for i in ids if i >= 0]
+            for i, ci in enumerate(ids):
+                for off in range(1, self.windowSize + 1):
+                    j = i + off
+                    if j >= len(ids):
+                        break
+                    key = (ci, ids[j])
+                    cooc[key] = cooc.get(key, 0.0) + 1.0 / off
+                    key2 = (ids[j], ci)
+                    cooc[key2] = cooc.get(key2, 0.0) + 1.0 / off
+        if not cooc:
+            raise ValueError("empty co-occurrence matrix")
+        triples = np.asarray([(i, j, x) for (i, j), x in cooc.items()],
+                             dtype=np.float64)
+
+        rng = np.random.default_rng(self.seed)
+        w = jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D)
+        wt = jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D)
+        b = jnp.zeros((V,), jnp.float32)
+        bt = jnp.zeros((V,), jnp.float32)
+        hw = jnp.zeros((V, D), jnp.float32)
+        hwt = jnp.zeros((V, D), jnp.float32)
+        hb = jnp.zeros((V,), jnp.float32)
+        hbt = jnp.zeros((V,), jnp.float32)
+
+        for _ in range(self.iterations):
+            rng.shuffle(triples)
+            for k in range(0, len(triples), self.batchSize):
+                t = triples[k:k + self.batchSize]
+                w, wt, b, bt, hw, hwt, hb, hbt, loss = _glove_step_jit(
+                    w, wt, b, bt, hw, hwt, hb, hbt,
+                    jnp.asarray(t[:, 0], jnp.int32), jnp.asarray(t[:, 1], jnp.int32),
+                    jnp.asarray(t[:, 2], jnp.float32), self.xMax, self.alpha,
+                    self.learningRate)
+        self.syn0 = np.asarray(w) + np.asarray(wt)  # standard GloVe: sum both
+        return self

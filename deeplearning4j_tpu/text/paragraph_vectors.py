@@ -1,0 +1,99 @@
+"""ParagraphVectors / doc2vec (ref: deeplearning4j-nlp
+org.deeplearning4j.models.paragraphvectors.ParagraphVectors — PV-DBOW:
+the document vector predicts its words, trained alongside Word2Vec tables)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.text.word2vec import Word2Vec, _sg_step_jit
+
+
+class LabelledDocument:
+    def __init__(self, content: str, label: str):
+        self.content = content
+        self.label = label
+
+
+class ParagraphVectors(Word2Vec):
+    """(ref: ParagraphVectors.Builder). Labels (documents) get their own
+    vector table; PV-DBOW training: doc vector predicts each word in the doc
+    via the shared negative-sampling objective."""
+
+    def __init__(self, labelledDocuments: Optional[Sequence[LabelledDocument]] = None,
+                 **kw):
+        super().__init__(**kw)
+        self.documents = list(labelledDocuments or [])
+        self.doc_labels: List[str] = []
+        self.doc_vectors: Optional[np.ndarray] = None
+
+    def fit(self):
+        from deeplearning4j_tpu.text.sentence_iterator import CollectionSentenceIterator
+        if self.iterator is None:
+            self.iterator = CollectionSentenceIterator(
+                [d.content for d in self.documents])
+        super().fit()  # trains word tables + builds vocab
+        self.doc_labels = [d.label for d in self.documents]
+        D = self.layerSize
+        rng = np.random.default_rng(self.seed + 1)
+        docvecs = jnp.asarray((rng.random((len(self.documents), D),
+                                          np.float32) - 0.5) / D)
+        syn1 = jnp.asarray(self._syn1)
+        table = self.vocab.unigram_table()
+        # PV-DBOW: (doc, word) pairs
+        pairs = []
+        for di, d in enumerate(self.documents):
+            for t in self.tokenizer.create(d.content).getTokens():
+                wi = self.vocab.indexOf(t)
+                if wi >= 0:
+                    pairs.append((di, wi))
+        pairs = np.asarray(pairs, dtype=np.int32)
+        for ep in range(max(self.epochs, 5)):
+            rng.shuffle(pairs)
+            lr = self.learningRate * (1 - ep / max(self.epochs, 5))
+            for k in range(0, len(pairs), self.batchSize):
+                b = pairs[k:k + self.batchSize]
+                neg = rng.choice(len(table), size=(len(b), self.negative),
+                                 p=table).astype(np.int32)
+                docvecs, syn1 = _sg_step_jit(docvecs, syn1, jnp.asarray(b[:, 0]),
+                                             jnp.asarray(b[:, 1]), jnp.asarray(neg),
+                                             lr)
+        self.doc_vectors = np.asarray(docvecs)
+        self._syn1 = np.asarray(syn1)
+        return self
+
+    # ---- doc-level API (ref: ParagraphVectors)
+    def getVectorForLabel(self, label: str) -> Optional[np.ndarray]:
+        if label in self.doc_labels:
+            return self.doc_vectors[self.doc_labels.index(label)]
+        return None
+
+    def inferVector(self, text: str, steps: int = 20, lr: float = 0.05) -> np.ndarray:
+        """Infer a vector for unseen text by gradient steps on a fresh doc
+        vector with frozen tables (ref: inferVector)."""
+        rng = np.random.default_rng(0)
+        ids = [self.vocab.indexOf(t)
+               for t in self.tokenizer.create(text).getTokens()]
+        ids = np.asarray([i for i in ids if i >= 0], dtype=np.int32)
+        v = (rng.random(self.layerSize).astype(np.float32) - 0.5) / self.layerSize
+        if len(ids) == 0:
+            return v
+        syn1 = self._syn1
+        table = self.vocab.unigram_table()
+        for _ in range(steps):
+            u = syn1[ids]
+            s = 1.0 / (1.0 + np.exp(-(u @ v)))
+            grad = ((s - 1.0)[:, None] * u).sum(0)
+            neg = rng.choice(len(table), size=(len(ids), self.negative), p=table)
+            un = syn1[neg]
+            sn = 1.0 / (1.0 + np.exp(-np.einsum("d,bkd->bk", v, un)))
+            grad = grad + np.einsum("bk,bkd->d", sn, un)
+            v = v - lr * grad / max(len(ids), 1)
+        return v
+
+    def similarityToLabel(self, text: str, label: str) -> float:
+        v = self.inferVector(text)
+        d = self.getVectorForLabel(label)
+        return float(v @ d / (np.linalg.norm(v) * np.linalg.norm(d) + 1e-12))

@@ -1,0 +1,57 @@
+"""Word-vector persistence (ref: deeplearning4j-nlp WordVectorSerializer —
+the ~4k-LoC class handling every w2v file format; here: the standard text
+format (word + space-separated floats per line, optional count header) and a
+compressed npz container)."""
+from __future__ import annotations
+
+import gzip
+import os
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.text.word2vec import Word2Vec, WordVectorsModel
+
+
+class WordVectorSerializer:
+
+    @staticmethod
+    def writeWord2VecModel(model: WordVectorsModel, path: str):
+        """Standard text format with "<vocab> <dim>" header
+        (ref: writeWord2VecModel)."""
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "wt") as f:
+            f.write(f"{model.vocab.numWords()} {model.layerSize}\n")
+            for i in range(model.vocab.numWords()):
+                vec = " ".join(f"{v:.6f}" for v in model.syn0[i])
+                f.write(f"{model.vocab.wordAtIndex(i)} {vec}\n")
+
+    @staticmethod
+    def readWord2VecModel(path: str) -> Word2Vec:
+        """(ref: readWord2VecModel / loadTxtVectors)."""
+        opener = gzip.open if path.endswith(".gz") else open
+        words, vecs = [], []
+        with opener(path, "rt") as f:
+            first = f.readline().split()
+            header = len(first) == 2  # "<vocab> <dim>"
+            if not header:
+                words.append(first[0])
+                vecs.append([float(v) for v in first[1:]])
+            for line in f:
+                parts = line.rstrip("\n").split(" ")
+                if len(parts) < 2:
+                    continue
+                words.append(parts[0])
+                vecs.append([float(v) for v in parts[1:] if v])
+        model = Word2Vec(layerSize=len(vecs[0]))
+        for w in words:
+            model.vocab.addToken(w)
+        model.vocab.finalize_vocab(1)
+        # preserve file order: re-index by appearance
+        syn0 = np.zeros((len(words), len(vecs[0])), np.float32)
+        for w, v in zip(words, vecs):
+            syn0[model.vocab.indexOf(w)] = v
+        model.syn0 = syn0
+        return model
+
+    loadTxtVectors = readWord2VecModel

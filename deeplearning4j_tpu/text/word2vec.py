@@ -1,0 +1,223 @@
+"""Word2Vec (ref: deeplearning4j-nlp org.deeplearning4j.models.word2vec.Word2Vec
++ SequenceVectors training loop + libnd4j skipgram/cbow fused ops).
+
+TPU-native redesign (SURVEY.md §2.9 P12): the reference trains with racing
+hogwild threads mutating a shared table through per-pair native ops. Here
+training is **batched negative-sampling SGD under one jitted step**: all
+(center, context) pairs of a batch update the tables at once via segment-sum
+scatter adds — deterministic, MXU-friendly, and convergence-equivalent (the
+reference's exact race nondeterminism is not reproducible nor desirable).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.text.sentence_iterator import SentenceIterator
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.text.vocab import VocabCache
+
+
+def _sg_step(syn0, syn1, center, ctx, neg, lr):
+    """One batched skip-gram negative-sampling step.
+    center/ctx: (B,) int32; neg: (B, K) int32. Returns updated (syn0, syn1)."""
+    v = syn0[center]                      # (B, D)
+    u_pos = syn1[ctx]                     # (B, D)
+    u_neg = syn1[neg]                     # (B, K, D)
+
+    s_pos = jax.nn.sigmoid(jnp.sum(v * u_pos, axis=-1))          # (B,)
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u_neg))   # (B, K)
+
+    g_pos = (s_pos - 1.0)[:, None]        # d/du_pos
+    g_neg = s_neg[:, :, None]             # d/du_neg
+
+    grad_v = g_pos * u_pos + jnp.einsum("bk,bkd->bd", s_neg, u_neg)
+    grad_u_pos = g_pos * v
+    grad_u_neg = g_neg * v[:, None, :]
+
+    syn0 = syn0.at[center].add(-lr * grad_v)
+    syn1 = syn1.at[ctx].add(-lr * grad_u_pos)
+    syn1 = syn1.at[neg.reshape(-1)].add(-lr * grad_u_neg.reshape(-1, grad_v.shape[-1]))
+    return syn0, syn1
+
+
+_sg_step_jit = jax.jit(_sg_step)
+
+
+def _cbow_step(syn0, syn1, ctx_win, ctx_mask, target, neg, lr):
+    """CBOW: mean of window context vectors predicts the target.
+    ctx_win: (B, W) int32 (padded), ctx_mask: (B, W) float."""
+    vs = syn0[ctx_win] * ctx_mask[:, :, None]
+    denom = jnp.maximum(ctx_mask.sum(-1, keepdims=True), 1.0)
+    h = vs.sum(1) / denom                                        # (B, D)
+    u_pos = syn1[target]
+    u_neg = syn1[neg]
+    s_pos = jax.nn.sigmoid(jnp.sum(h * u_pos, axis=-1))
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u_neg))
+    g_pos = (s_pos - 1.0)[:, None]
+    grad_h = g_pos * u_pos + jnp.einsum("bk,bkd->bd", s_neg, u_neg)
+    syn1 = syn1.at[target].add(-lr * g_pos * h)
+    syn1 = syn1.at[neg.reshape(-1)].add(
+        -lr * (s_neg[:, :, None] * h[:, None, :]).reshape(-1, h.shape[-1]))
+    grad_ctx = (grad_h / denom)[:, None, :] * ctx_mask[:, :, None]
+    syn0 = syn0.at[ctx_win.reshape(-1)].add(
+        -lr * grad_ctx.reshape(-1, h.shape[-1]))
+    return syn0, syn1
+
+
+_cbow_step_jit = jax.jit(_cbow_step)
+
+
+class WordVectorsModel:
+    """Shared lookup surface (ref: WordVectors / InMemoryLookupTable)."""
+
+    def __init__(self):
+        self.vocab = VocabCache()
+        self.syn0: Optional[np.ndarray] = None
+        self.layerSize = 0
+
+    # ---- lookups (ref: WordVectors interface)
+    def hasWord(self, word: str) -> bool:
+        return self.vocab.containsWord(word)
+
+    def getWordVector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.indexOf(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def getWordVectorMatrix(self, word: str):
+        return self.getWordVector(word)
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.getWordVector(a), self.getWordVector(b)
+        if va is None or vb is None:
+            return float("nan")
+        return float(np.dot(va, vb) /
+                     (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+
+    def wordsNearest(self, word_or_vec, topN: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            v = self.getWordVector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            v = np.asarray(word_or_vec)
+            exclude = set()
+        if v is None:
+            return []
+        m = np.asarray(self.syn0)
+        sims = m @ v / (np.linalg.norm(m, axis=1) * np.linalg.norm(v) + 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.wordAtIndex(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= topN:
+                break
+        return out
+
+
+class Word2Vec(WordVectorsModel):
+    """(ref: org.deeplearning4j.models.word2vec.Word2Vec + .Builder)."""
+
+    def __init__(self, minWordFrequency=1, iterations=1, epochs=1, layerSize=100,
+                 seed=42, windowSize=5, learningRate=0.025, minLearningRate=1e-4,
+                 negativeSample=5, sampling=0.0, batchSize=512,
+                 elementsLearningAlgorithm="SkipGram",
+                 iterate: Optional[SentenceIterator] = None,
+                 tokenizerFactory=None):
+        super().__init__()
+        self.minWordFrequency = minWordFrequency
+        self.iterations = iterations
+        self.epochs = epochs
+        self.layerSize = layerSize
+        self.seed = seed
+        self.windowSize = windowSize
+        self.learningRate = learningRate
+        self.minLearningRate = minLearningRate
+        self.negative = max(int(negativeSample), 1)
+        self.sampling = sampling
+        self.batchSize = batchSize
+        self.algorithm = elementsLearningAlgorithm
+        self.iterator = iterate
+        self.tokenizer = tokenizerFactory or DefaultTokenizerFactory()
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def __getattr__(self, name):
+            def setter(value):
+                self._kw[name] = value
+                return self
+            return setter
+
+        def build(self) -> "Word2Vec":
+            return Word2Vec(**self._kw)
+
+    # ------------------------------------------------------------------ fit
+    def _sentences_as_ids(self) -> List[np.ndarray]:
+        out = []
+        for s in self.iterator:
+            toks = self.tokenizer.create(s).getTokens()
+            ids = [self.vocab.indexOf(t) for t in toks]
+            ids = [i for i in ids if i >= 0]
+            if len(ids) > 1:
+                out.append(np.asarray(ids, dtype=np.int32))
+        return out
+
+    def fit(self):
+        # 1. vocab pass (ref: VocabConstructor)
+        for s in self.iterator:
+            for t in self.tokenizer.create(s).getTokens():
+                self.vocab.addToken(t)
+        self.vocab.finalize_vocab(self.minWordFrequency)
+        V, D = self.vocab.numWords(), self.layerSize
+        rng = np.random.default_rng(self.seed)
+        syn0 = jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D)
+        syn1 = jnp.zeros((V, D), jnp.float32)
+        table = self.vocab.unigram_table()
+        keep = self.vocab.subsample_keep_prob(self.sampling) if self.sampling > 0 else None
+
+        sentences = self._sentences_as_ids()
+        total_steps = max(self.epochs * self.iterations, 1)
+        step_no = 0
+        for _ in range(self.epochs):
+            # 2. generate (center, context) pairs with random window shrink
+            pairs = []
+            for ids in sentences:
+                if keep is not None:
+                    ids = ids[rng.random(len(ids)) < keep[ids]]
+                for i, c in enumerate(ids):
+                    b = rng.integers(1, self.windowSize + 1)
+                    lo, hi = max(0, i - b), min(len(ids), i + b + 1)
+                    for j in range(lo, hi):
+                        if j != i:
+                            pairs.append((c, ids[j]))
+            if not pairs:
+                continue
+            pairs = np.asarray(pairs, dtype=np.int32)
+            rng.shuffle(pairs)
+            lr = max(self.minLearningRate,
+                     self.learningRate * (1 - step_no / total_steps))
+            for _ in range(self.iterations):
+                for k in range(0, len(pairs), self.batchSize):
+                    batch = pairs[k:k + self.batchSize]
+                    neg = rng.choice(len(table), size=(len(batch), self.negative),
+                                     p=table).astype(np.int32)
+                    if self.algorithm == "CBOW":
+                        ctx = batch[:, 1][:, None]
+                        mask = np.ones_like(ctx, dtype=np.float32)
+                        syn0, syn1 = _cbow_step_jit(
+                            syn0, syn1, jnp.asarray(ctx), jnp.asarray(mask),
+                            jnp.asarray(batch[:, 0]), jnp.asarray(neg), lr)
+                    else:
+                        syn0, syn1 = _sg_step_jit(
+                            syn0, syn1, jnp.asarray(batch[:, 0]),
+                            jnp.asarray(batch[:, 1]), jnp.asarray(neg), lr)
+            step_no += 1
+        self.syn0 = np.asarray(syn0)
+        self._syn1 = np.asarray(syn1)
+        return self

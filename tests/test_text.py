@@ -1,0 +1,108 @@
+"""NLP tests (ref: deeplearning4j-nlp Word2Vec/ParagraphVectors/Glove tests —
+convergence-based, per SURVEY §7.3.7: hogwild trajectories are not
+reproducible, so semantic-structure assertions replace golden weights)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.text import (
+    BasicLineIterator, CollectionSentenceIterator, CommonPreprocessor,
+    DefaultTokenizerFactory, Glove, NGramTokenizerFactory, ParagraphVectors,
+    VocabCache, Word2Vec, WordVectorSerializer)
+from deeplearning4j_tpu.text.paragraph_vectors import LabelledDocument
+
+
+def _corpus(n=300, seed=0):
+    """Two topic clusters; words within a topic co-occur."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "sheep"]
+    tech = ["cpu", "gpu", "ram", "disk"]
+    sents = []
+    for _ in range(n):
+        topic = animals if rng.random() < 0.5 else tech
+        sents.append(" ".join(rng.choice(topic, size=6)))
+    return sents
+
+
+def test_tokenizers_and_vocab():
+    tf = DefaultTokenizerFactory()
+    tf.setTokenPreProcessor(CommonPreprocessor())
+    toks = tf.create("Hello, World! 123 foo").getTokens()
+    assert toks == ["hello", "world", "foo"]
+    ng = NGramTokenizerFactory(1, 2)
+    assert "a b" in ng.create("a b c").getTokens()
+
+    vc = VocabCache()
+    for w in ["a", "b", "a", "c", "a", "b"]:
+        vc.addToken(w)
+    vc.finalize_vocab(minWordFrequency=2)
+    assert vc.numWords() == 2
+    assert vc.wordAtIndex(0) == "a"  # most frequent first
+    assert not vc.containsWord("c")
+    table = vc.unigram_table()
+    assert table.shape == (2,) and abs(table.sum() - 1.0) < 1e-6
+
+
+def test_word2vec_semantic_clusters():
+    vec = Word2Vec(minWordFrequency=1, layerSize=16, seed=1, windowSize=3,
+                   epochs=3, learningRate=0.05, negativeSample=4,
+                   iterate=CollectionSentenceIterator(_corpus()),
+                   tokenizerFactory=DefaultTokenizerFactory())
+    vec.fit()
+    assert vec.getWordVector("cat").shape == (16,)
+    # intra-topic similarity must beat inter-topic
+    assert vec.similarity("cat", "dog") > vec.similarity("cat", "cpu")
+    assert vec.similarity("gpu", "ram") > vec.similarity("gpu", "sheep")
+    near = vec.wordsNearest("cat", 3)
+    assert set(near) <= {"dog", "horse", "sheep"}
+
+
+def test_word2vec_builder_and_cbow():
+    vec = (Word2Vec.Builder()
+           .minWordFrequency(1).layerSize(12).seed(2).windowSize(3)
+           .epochs(2).elementsLearningAlgorithm("CBOW")
+           .iterate(CollectionSentenceIterator(_corpus(200, seed=3)))
+           .build())
+    vec.fit()
+    assert vec.similarity("cat", "horse") > vec.similarity("cat", "disk")
+
+
+def test_serializer_roundtrip(tmp_path):
+    vec = Word2Vec(layerSize=8, epochs=1, seed=4,
+                   iterate=CollectionSentenceIterator(_corpus(50))).fit()
+    p = str(tmp_path / "vectors.txt")
+    WordVectorSerializer.writeWord2VecModel(vec, p)
+    loaded = WordVectorSerializer.readWord2VecModel(p)
+    assert loaded.vocab.numWords() == vec.vocab.numWords()
+    np.testing.assert_allclose(loaded.getWordVector("cat"),
+                               vec.getWordVector("cat"), atol=1e-5)
+    assert loaded.wordsNearest("cat", 2) == vec.wordsNearest("cat", 2)
+
+
+def test_paragraph_vectors_label_similarity():
+    docs = ([LabelledDocument(" ".join(["cat", "dog", "horse"] * 4), f"animal_{i}")
+             for i in range(6)] +
+            [LabelledDocument(" ".join(["cpu", "gpu", "ram"] * 4), f"tech_{i}")
+             for i in range(6)])
+    pv = ParagraphVectors(labelledDocuments=docs, layerSize=12, seed=5,
+                          epochs=10, learningRate=0.05)
+    pv.fit()
+    v_animal = pv.getVectorForLabel("animal_0")
+    v_tech = pv.getVectorForLabel("tech_0")
+    assert v_animal is not None and v_tech is not None
+    sim_aa = pv.similarityToLabel("dog horse cat", "animal_1")
+    sim_at = pv.similarityToLabel("dog horse cat", "tech_1")
+    assert sim_aa > sim_at
+
+
+def test_glove_clusters():
+    g = Glove(layerSize=12, seed=6, iterations=30, windowSize=3,
+              learningRate=0.1, iterate=CollectionSentenceIterator(_corpus(400)))
+    g.fit()
+    assert g.similarity("cat", "sheep") > g.similarity("cat", "gpu")
+
+
+def test_line_iterator(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text("line one\n\nline two\n")
+    it = BasicLineIterator(str(p))
+    assert list(it) == ["line one", "line two"]
