@@ -23,11 +23,20 @@ class MaxEpochsTerminationCondition:
 
 
 class ScoreImprovementEpochTerminationCondition:
-    """Stop after N epochs without improvement (ref: same name)."""
+    """Stop after N epochs without improvement (ref: same name).
+
+    Stateful: EarlyStoppingTrainer.fit() calls reset() at the start of every
+    run so a configuration can be reused. Only invoked on epochs where a score
+    was actually computed (requires_score flag)."""
+
+    requires_score = True
 
     def __init__(self, maxEpochsWithNoImprovement: int, minImprovement: float = 0.0):
         self.patience = maxEpochsWithNoImprovement
         self.minImprovement = minImprovement
+        self.reset()
+
+    def reset(self):
         self._best = float("inf")
         self._since = 0
 
@@ -45,6 +54,9 @@ class MaxTimeIterationTerminationCondition:
 
     def __init__(self, maxTimeSeconds: float):
         self.maxTime = maxTimeSeconds
+        self.reset()
+
+    def reset(self):
         self._start = time.perf_counter()
 
     def terminate_iteration(self, score: float) -> bool:
@@ -102,11 +114,13 @@ class LocalFileModelSaver:
 
     def getBestModel(self):
         from deeplearning4j_tpu.util.model_serializer import ModelSerializer
-        return ModelSerializer.restoreModel(self._path("bestModel.zip"))
+        p = self._path("bestModel.zip")
+        return ModelSerializer.restoreModel(p) if os.path.exists(p) else None
 
     def getLatestModel(self):
         from deeplearning4j_tpu.util.model_serializer import ModelSerializer
-        return ModelSerializer.restoreModel(self._path("latestModel.zip"))
+        p = self._path("latestModel.zip")
+        return ModelSerializer.restoreModel(p) if os.path.exists(p) else None
 
 
 # ---------------------------------------------------------- score calculator
@@ -214,6 +228,10 @@ class EarlyStoppingTrainer:
         score_vs_epoch = {}
         best_score, best_epoch = float("inf"), -1
         reason, details = "EpochTerminationCondition", ""
+        for c in list(cfg.epochTerminationConditions) + list(
+                cfg.iterationTerminationConditions):
+            if hasattr(c, "reset"):
+                c.reset()
         guard = _IterationGuard(cfg.iterationTerminationConditions)
         saved_listeners = list(self.model.listeners)
         if cfg.iterationTerminationConditions:
@@ -240,6 +258,8 @@ class EarlyStoppingTrainer:
                         cfg.modelSaver.saveLatestModel(self.model, score)
                 stop = False
                 for c in cfg.epochTerminationConditions:
+                    if getattr(c, "requires_score", False) and epoch not in score_vs_epoch:
+                        continue  # non-evaluation epoch: no score to judge
                     if c.terminate_epoch(epoch, score_vs_epoch.get(epoch, best_score),
                                          best_score):
                         details = type(c).__name__
@@ -250,7 +270,10 @@ class EarlyStoppingTrainer:
                 epoch += 1
         finally:
             self.model.listeners = saved_listeners
-        best = cfg.modelSaver.getBestModel() or self.model
+        # only consult the saver if THIS run saved a best model — a reused
+        # saver may hold a previous run's (stale) best
+        best = (cfg.modelSaver.getBestModel() if best_epoch >= 0 else None) \
+            or self.model
         return EarlyStoppingResult(
             terminationReason=reason, terminationDetails=details,
             scoreVsEpoch=score_vs_epoch, bestModelEpoch=best_epoch,

@@ -240,12 +240,13 @@ class ComputationGraph:
 
     # ---------------------------------------------------- flat param surface
     def params(self) -> NDArray:
+        """Flat params in topological vertex order; tree_flatten within a
+        vertex handles nested dicts (e.g. Bidirectional's {'fwd','bwd'})."""
         leaves = []
         for n in self._order:
             if n.name in (self._params or {}):
-                p = self._params[n.name]
-                for k in sorted(p.keys()):
-                    leaves.append(jnp.ravel(p[k]))
+                leaves.extend(jnp.ravel(l) for l in
+                              jax.tree_util.tree_leaves(self._params[n.name]))
         if not leaves:
             return NDArray(jnp.zeros((0,)))
         return NDArray(jnp.concatenate(leaves))
@@ -255,12 +256,13 @@ class ComputationGraph:
         pos = 0
         for n in self._order:
             if n.name in (self._params or {}):
-                p = dict(self._params[n.name])
-                for k in sorted(p.keys()):
-                    cnt = int(np.prod(p[k].shape))
-                    p[k] = flat[pos:pos + cnt].reshape(p[k].shape).astype(p[k].dtype)
+                leaves, treedef = jax.tree_util.tree_flatten(self._params[n.name])
+                new = []
+                for l in leaves:
+                    cnt = int(np.prod(l.shape))
+                    new.append(flat[pos:pos + cnt].reshape(l.shape).astype(l.dtype))
                     pos += cnt
-                self._params[n.name] = p
+                self._params[n.name] = jax.tree_util.tree_unflatten(treedef, new)
 
     def numParams(self) -> int:
         return int(sum(np.prod(l.shape)

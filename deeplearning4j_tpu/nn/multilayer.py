@@ -421,12 +421,10 @@ class MultiLayerNetwork:
 
     # ---------------------------------------------------- flat param surface
     def params(self) -> NDArray:
-        """Flat parameter vector, layer order, key order W,b,... per layer
-        (ref: MultiLayerNetwork.params / paramsFlattened)."""
-        leaves = []
-        for p in self._params:
-            for k in sorted(p.keys()):
-                leaves.append(jnp.ravel(p[k]))
+        """Flat parameter vector, layer order, sorted-key tree order within a
+        layer (ref: MultiLayerNetwork.params / paramsFlattened). tree_flatten
+        handles nested param dicts (e.g. Bidirectional's {'fwd','bwd'})."""
+        leaves = [jnp.ravel(l) for l in jax.tree_util.tree_leaves(self._params)]
         if not leaves:
             return NDArray(jnp.zeros((0,)))
         return NDArray(jnp.concatenate(leaves))
@@ -434,16 +432,13 @@ class MultiLayerNetwork:
     def setParams(self, flat):
         """(ref: MultiLayerNetwork.setParams) — inverse of params()."""
         flat = _as_jnp(flat).ravel()
-        pos = 0
-        new_params = []
-        for p in self._params:
-            q = {}
-            for k in sorted(p.keys()):
-                n = int(np.prod(p[k].shape))
-                q[k] = flat[pos:pos + n].reshape(p[k].shape).astype(p[k].dtype)
-                pos += n
-            new_params.append(q)
-        self._params = new_params
+        leaves, treedef = jax.tree_util.tree_flatten(self._params)
+        pos, new = 0, []
+        for l in leaves:
+            n = int(np.prod(l.shape))
+            new.append(flat[pos:pos + n].reshape(l.shape).astype(l.dtype))
+            pos += n
+        self._params = jax.tree_util.tree_unflatten(treedef, new)
 
     def numParams(self) -> int:
         return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(self._params)))

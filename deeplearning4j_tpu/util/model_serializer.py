@@ -8,7 +8,10 @@ Same container design: a zip with
   paramsFlattened invariant, preserved at this boundary),
 - ``updaterState.npz``  — optimizer-state leaves in tree order (structure is
   reconstructed from a fresh ``tx.init`` on load, so only leaves are stored —
-  exact-resume parity with saveUpdater=true).
+  exact-resume parity with saveUpdater=true),
+- ``state.npz``         — layer-state leaves in tree order (BatchNorm running
+  mean/var etc.; the reference stores BN global stats inside the params
+  vector, so its checkpoint preserves them — ours must too).
 """
 from __future__ import annotations
 
@@ -19,6 +22,31 @@ from typing import Optional
 
 import jax
 import numpy as np
+
+
+def _save_leaves(z: zipfile.ZipFile, name: str, tree) -> None:
+    """Serialize a pytree's leaves (tree order) into an npz archive member."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return
+    buf = io.BytesIO()
+    np.savez(buf, **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    z.writestr(name, buf.getvalue())
+
+
+def _load_leaves(z: zipfile.ZipFile, name: str, like):
+    """Restore a pytree saved by _save_leaves, taking structure/dtypes/shapes
+    from a freshly initialized ``like`` tree."""
+    data = np.load(io.BytesIO(z.read(name)))
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    if len(data.files) != len(leaves):
+        raise ValueError(
+            f"{name}: checkpoint has {len(data.files)} leaves but the model "
+            f"expects {len(leaves)} — incompatible framework version?")
+    restored = [jax.numpy.asarray(
+        np.asarray(data[f"leaf_{i}"], dtype=np.asarray(l).dtype)
+        .reshape(np.shape(l))) for i, l in enumerate(leaves)]
+    return jax.tree_util.tree_unflatten(treedef, restored)
 
 
 class ModelSerializer:
@@ -47,12 +75,9 @@ class ModelSerializer:
             buf = io.BytesIO()
             np.save(buf, np.asarray(model.params().jax, dtype=np.float64))
             z.writestr("coefficients.npy", buf.getvalue())
+            _save_leaves(z, "state.npz", model._state)
             if saveUpdater and model._opt_state is not None:
-                leaves = jax.tree_util.tree_leaves(model._opt_state)
-                buf = io.BytesIO()
-                np.savez(buf, **{f"leaf_{i}": np.asarray(l)
-                                 for i, l in enumerate(leaves)})
-                z.writestr("updaterState.npz", buf.getvalue())
+                _save_leaves(z, "updaterState.npz", model._opt_state)
 
     @staticmethod
     def _restore(path: str, expect_type: Optional[str], loadUpdater: bool):
@@ -73,16 +98,13 @@ class ModelSerializer:
                 model = ComputationGraph(ComputationGraphConfiguration.from_json(conf_json)).init()
             flat = np.load(io.BytesIO(z.read("coefficients.npy")))
             model.setParams(flat)
+            if "state.npz" in z.namelist():
+                model._state = _load_leaves(z, "state.npz", model._state)
             model._iteration = meta.get("iterationCount", 0)
             model._epoch = meta.get("epochCount", 0)
             if loadUpdater and meta.get("saveUpdater") and "updaterState.npz" in z.namelist():
-                data = np.load(io.BytesIO(z.read("updaterState.npz")))
-                fresh = model._tx.init(model._params)
-                leaves, treedef = jax.tree_util.tree_flatten(fresh)
-                restored = [np.asarray(data[f"leaf_{i}"], dtype=np.asarray(l).dtype)
-                            .reshape(np.shape(l)) for i, l in enumerate(leaves)]
-                model._opt_state = jax.tree_util.tree_unflatten(
-                    treedef, [jax.numpy.asarray(r) for r in restored])
+                model._opt_state = _load_leaves(
+                    z, "updaterState.npz", model._tx.init(model._params))
         return model
 
     @staticmethod

@@ -172,3 +172,83 @@ def test_frozen_layers_immune_to_adamw_decay():
     w0 = net2.getParam(0, "W").toNumpy().copy()
     net2.fit(ds, epochs=3)
     np.testing.assert_array_equal(net2.getParam(0, "W").toNumpy(), w0)
+
+
+def test_serializer_preserves_batchnorm_state(tmp_path):
+    """BN running mean/var must survive save/restore (advisor finding: the
+    reference stores BN global stats inside the params vector)."""
+    from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+    conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(nIn=4, nOut=8, activation="RELU"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(nIn=8, nOut=3, activation="SOFTMAX",
+                               lossFunction="MCXENT"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = _data()
+    net.fit(ds, epochs=5)  # moves running stats away from init (mean=0,var=1)
+    path = str(tmp_path / "bn.zip")
+    ModelSerializer.writeModel(net, path)
+    restored = ModelSerializer.restoreMultiLayerNetwork(path)
+    for a, b in zip(np.ravel(net._state[1]["mean"]),
+                    np.ravel(restored._state[1]["mean"])):
+        assert a == b
+    np.testing.assert_allclose(net.output(ds.features).toNumpy(),
+                               restored.output(ds.features).toNumpy(), atol=1e-6)
+
+
+def test_serializer_bidirectional_params_roundtrip(tmp_path):
+    """Bidirectional nets have nested param dicts; params()/writeModel must
+    flatten them (advisor finding: one-level ravel raised TypeError)."""
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, Bidirectional, RnnOutputLayer
+    conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(0.01))
+            .list()
+            .layer(Bidirectional(fwd=LSTM(nIn=4, nOut=6)))
+            .layer(RnnOutputLayer(nIn=12, nOut=3, activation="SOFTMAX",
+                                  lossFunction="MCXENT"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    flat = net.params().toNumpy()
+    assert flat.ndim == 1 and flat.size == net.numParams()
+    path = str(tmp_path / "bidi.zip")
+    ModelSerializer.writeModel(net, path)
+    restored = ModelSerializer.restoreMultiLayerNetwork(path)
+    np.testing.assert_allclose(restored.params().toNumpy(), flat, atol=1e-6)
+    x = np.random.default_rng(0).normal(size=(2, 5, 4)).astype(np.float32)
+    np.testing.assert_allclose(net.output(x).toNumpy(),
+                               restored.output(x).toNumpy(), atol=1e-6)
+
+
+def test_early_stopping_config_reusable():
+    """Reusing an EarlyStoppingConfiguration must reset stateful conditions
+    (advisor finding: stale _best/_since terminated the second fit at once)."""
+    ds = _data()
+    esc = (EarlyStoppingConfiguration.Builder()
+           .epochTerminationConditions(
+               MaxEpochsTerminationCondition(50),
+               ScoreImprovementEpochTerminationCondition(3))
+           .scoreCalculator(DataSetLossCalculator(ListDataSetIterator(ds.batchBy(8))))
+           .modelSaver(InMemoryModelSaver())
+           .build())
+    r1 = EarlyStoppingTrainer(esc, _net(lr=1.0),
+                              ListDataSetIterator(ds.batchBy(8))).fit()
+    r2 = EarlyStoppingTrainer(esc, _net(lr=1.0),
+                              ListDataSetIterator(ds.batchBy(8))).fit()
+    # second run must train several epochs, not terminate instantly on stale state
+    assert r2.totalEpochs > 1
+    assert r1.bestModel is not None and r2.bestModel is not None
+
+
+def test_early_stopping_immediate_stop_returns_result(tmp_path):
+    """An iteration condition tripping before the first save must still yield
+    a result with the in-progress model (advisor finding: FileNotFoundError)."""
+    ds = _data()
+    esc = (EarlyStoppingConfiguration.Builder()
+           .epochTerminationConditions(MaxEpochsTerminationCondition(100))
+           .iterationTerminationConditions(MaxScoreIterationTerminationCondition(1e-12))
+           .modelSaver(LocalFileModelSaver(str(tmp_path / "es")))
+           .build())
+    result = EarlyStoppingTrainer(esc, _net(), ListDataSetIterator(ds.batchBy(8))).fit()
+    assert result.terminationReason == "IterationTerminationCondition"
+    assert result.bestModel is not None
