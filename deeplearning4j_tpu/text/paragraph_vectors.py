@@ -50,11 +50,15 @@ class ParagraphVectors(Word2Vec):
                 if wi >= 0:
                     pairs.append((di, wi))
         pairs = np.asarray(pairs, dtype=np.int32)
-        for ep in range(max(self.epochs, 5)):
+        # small batches give the bounded-accumulation scatter step (see
+        # word2vec._mean_scatter) finer-grained, fresher updates per doc row
+        b_eff = min(self.batchSize, max(32, 2 * len(self.documents)))
+        n_ep = max(self.epochs, 5)
+        for ep in range(n_ep):
             rng.shuffle(pairs)
-            lr = self.learningRate * (1 - ep / max(self.epochs, 5))
-            for k in range(0, len(pairs), self.batchSize):
-                b = pairs[k:k + self.batchSize]
+            lr = self.learningRate * (1 - ep / n_ep)
+            for k in range(0, len(pairs), b_eff):
+                b = pairs[k:k + b_eff]
                 neg = rng.choice(len(table), size=(len(b), self.negative),
                                  p=table).astype(np.int32)
                 docvecs, syn1 = _sg_step_jit(docvecs, syn1, jnp.asarray(b[:, 0]),

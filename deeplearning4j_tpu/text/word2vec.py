@@ -4,9 +4,11 @@
 TPU-native redesign (SURVEY.md §2.9 P12): the reference trains with racing
 hogwild threads mutating a shared table through per-pair native ops. Here
 training is **batched negative-sampling SGD under one jitted step**: all
-(center, context) pairs of a batch update the tables at once via segment-sum
-scatter adds — deterministic, MXU-friendly, and convergence-equivalent (the
-reference's exact race nondeterminism is not reproducible nor desirable).
+(center, context) pairs of a batch update the tables at once via per-row
+MEAN-normalized scatter adds — deterministic, MXU-friendly, and
+convergence-equivalent (the reference's exact race nondeterminism is not
+reproducible nor desirable; plain gradient SUMS diverge on small vocabs where
+one row collects many stale contributions per batch).
 """
 from __future__ import annotations
 
@@ -21,6 +23,34 @@ from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
 from deeplearning4j_tpu.text.vocab import VocabCache
 
 
+_ACCUM_CAP = 8.0
+
+
+def _mean_scatter(table, idx_flat, grads_flat, lr, weights=None):
+    """SGD step with BOUNDED per-row gradient accumulation.
+
+    A plain scatter-add sums B/V stale gradients per row; on small vocabs that
+    multiplies the effective lr by the occurrence count and diverges (the
+    reference's hogwild loop applies them sequentially at fresh values, which
+    self-limits). Full mean-normalization is stable but over-damps — a row
+    with 60 pairs in the batch advances like it had one. Capping the
+    accumulation factor at _ACCUM_CAP keeps per-batch movement bounded
+    (≤ cap·lr·|grad|) while staying within ~cap× of the reference's
+    sequential convergence rate.
+
+    Stays sparse: only a (V,1) count buffer is materialized; each
+    contribution is pre-scaled by its row's factor and scatter-added
+    (sum_i scale_row*grad_i == scale_row * gsum_row). ``weights`` marks
+    which contributions are real (masked negative draws must not damp the
+    row's scale).
+    """
+    w = (jnp.ones((idx_flat.shape[0], 1), table.dtype)
+         if weights is None else weights[:, None].astype(table.dtype))
+    cnt = jnp.zeros((table.shape[0], 1), table.dtype).at[idx_flat].add(w)
+    scale = jnp.minimum(1.0, _ACCUM_CAP / jnp.maximum(cnt, 1.0))[idx_flat]
+    return table.at[idx_flat].add(-lr * grads_flat * scale)
+
+
 def _sg_step(syn0, syn1, center, ctx, neg, lr):
     """One batched skip-gram negative-sampling step.
     center/ctx: (B,) int32; neg: (B, K) int32. Returns updated (syn0, syn1)."""
@@ -30,6 +60,10 @@ def _sg_step(syn0, syn1, center, ctx, neg, lr):
 
     s_pos = jax.nn.sigmoid(jnp.sum(v * u_pos, axis=-1))          # (B,)
     s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u_neg))   # (B, K)
+    # a sampled negative that IS the positive context would cancel the
+    # positive update — the reference skips those draws
+    valid = (neg != ctx[:, None]).astype(s_neg.dtype)            # (B, K)
+    s_neg = s_neg * valid
 
     g_pos = (s_pos - 1.0)[:, None]        # d/du_pos
     g_neg = s_neg[:, :, None]             # d/du_neg
@@ -38,9 +72,13 @@ def _sg_step(syn0, syn1, center, ctx, neg, lr):
     grad_u_pos = g_pos * v
     grad_u_neg = g_neg * v[:, None, :]
 
-    syn0 = syn0.at[center].add(-lr * grad_v)
-    syn1 = syn1.at[ctx].add(-lr * grad_u_pos)
-    syn1 = syn1.at[neg.reshape(-1)].add(-lr * grad_u_neg.reshape(-1, grad_v.shape[-1]))
+    D = grad_v.shape[-1]
+    syn0 = _mean_scatter(syn0, center, grad_v, lr)
+    syn1 = _mean_scatter(
+        syn1, jnp.concatenate([ctx, neg.reshape(-1)]),
+        jnp.concatenate([grad_u_pos, grad_u_neg.reshape(-1, D)]), lr,
+        weights=jnp.concatenate([jnp.ones_like(ctx, valid.dtype),
+                                 valid.reshape(-1)]))
     return syn0, syn1
 
 
@@ -57,14 +95,20 @@ def _cbow_step(syn0, syn1, ctx_win, ctx_mask, target, neg, lr):
     u_neg = syn1[neg]
     s_pos = jax.nn.sigmoid(jnp.sum(h * u_pos, axis=-1))
     s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u_neg))
+    valid = (neg != target[:, None]).astype(s_neg.dtype)
+    s_neg = s_neg * valid
     g_pos = (s_pos - 1.0)[:, None]
     grad_h = g_pos * u_pos + jnp.einsum("bk,bkd->bd", s_neg, u_neg)
-    syn1 = syn1.at[target].add(-lr * g_pos * h)
-    syn1 = syn1.at[neg.reshape(-1)].add(
-        -lr * (s_neg[:, :, None] * h[:, None, :]).reshape(-1, h.shape[-1]))
+    D = h.shape[-1]
+    syn1 = _mean_scatter(
+        syn1, jnp.concatenate([target, neg.reshape(-1)]),
+        jnp.concatenate([g_pos * h,
+                         (s_neg[:, :, None] * h[:, None, :]).reshape(-1, D)]), lr,
+        weights=jnp.concatenate([jnp.ones_like(target, valid.dtype),
+                                 valid.reshape(-1)]))
     grad_ctx = (grad_h / denom)[:, None, :] * ctx_mask[:, :, None]
-    syn0 = syn0.at[ctx_win.reshape(-1)].add(
-        -lr * grad_ctx.reshape(-1, h.shape[-1]))
+    syn0 = _mean_scatter(syn0, ctx_win.reshape(-1), grad_ctx.reshape(-1, D), lr,
+                         weights=ctx_mask.reshape(-1))
     return syn0, syn1
 
 
@@ -182,9 +226,12 @@ class Word2Vec(WordVectorsModel):
         keep = self.vocab.subsample_keep_prob(self.sampling) if self.sampling > 0 else None
 
         sentences = self._sentences_as_ids()
-        total_steps = max(self.epochs * self.iterations, 1)
-        step_no = 0
-        for _ in range(self.epochs):
+        # cap the batch so each row averages only a few contributions: with
+        # mean-normalized updates a 512-pair batch over a tiny vocab would
+        # advance each word by just ~1 effective step — sequential-like
+        # freshness needs batches of O(vocab). Real vocabs keep full batches.
+        b_eff = min(self.batchSize, max(64, 4 * V))
+        for ep in range(self.epochs):
             # 2. generate (center, context) pairs with random window shrink
             pairs = []
             for ids in sentences:
@@ -200,11 +247,15 @@ class Word2Vec(WordVectorsModel):
                 continue
             pairs = np.asarray(pairs, dtype=np.int32)
             rng.shuffle(pairs)
-            lr = max(self.minLearningRate,
-                     self.learningRate * (1 - step_no / total_steps))
+            nb = max(1, -(-len(pairs) // b_eff) * self.iterations)
+            bi = 0
             for _ in range(self.iterations):
-                for k in range(0, len(pairs), self.batchSize):
-                    batch = pairs[k:k + self.batchSize]
+                for k in range(0, len(pairs), b_eff):
+                    # linear per-batch decay (ref: alpha decays per word seen)
+                    frac = (ep + bi / nb) / max(self.epochs, 1)
+                    lr = max(self.minLearningRate, self.learningRate * (1 - frac))
+                    bi += 1
+                    batch = pairs[k:k + b_eff]
                     neg = rng.choice(len(table), size=(len(batch), self.negative),
                                      p=table).astype(np.int32)
                     if self.algorithm == "CBOW":
@@ -217,7 +268,6 @@ class Word2Vec(WordVectorsModel):
                         syn0, syn1 = _sg_step_jit(
                             syn0, syn1, jnp.asarray(batch[:, 0]),
                             jnp.asarray(batch[:, 1]), jnp.asarray(neg), lr)
-            step_no += 1
         self.syn0 = np.asarray(syn0)
         self._syn1 = np.asarray(syn1)
         return self
