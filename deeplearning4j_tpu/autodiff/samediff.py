@@ -349,6 +349,183 @@ class SameDiff:
         self._jit_cache.clear()
         return tuple(outs) if multi else outs[0]
 
+    def convertToVariable(self, var) -> SDVariable:
+        """Constant -> trainable VARIABLE in place (ref:
+        SameDiff.convertToVariable; used to fine-tune imported frozen graphs
+        whose weights arrive as constants)."""
+        v = var if isinstance(var, SDVariable) else self._vars[var]
+        if v.varType == VariableType.CONSTANT:
+            v.varType = VariableType.VARIABLE
+            self._jit_cache.clear()
+        return v
+
+    def convertAllConstantsToVariables(self, min_size: int = 3) -> int:
+        """Make every float constant with ≥ min_size elements trainable —
+        the standard prelude to fine-tuning an imported frozen graph (small
+        constants are attribute carriers: axes, scales, epsilons). Returns
+        the number converted."""
+        n = 0
+        for v in list(self._vars.values()):
+            if v.varType == VariableType.CONSTANT and v.shape \
+                    and v.dtype is not None and "float" in str(v.dtype) \
+                    and int(np.prod(v.shape)) >= min_size:
+                self.convertToVariable(v)
+                n += 1
+        return n
+
+    def convertToConstant(self, var) -> SDVariable:
+        """VARIABLE -> frozen constant in place (ref: SameDiff.convertToConstant)."""
+        v = var if isinstance(var, SDVariable) else self._vars[var]
+        if v.varType == VariableType.VARIABLE:
+            v.varType = VariableType.CONSTANT
+            self._jit_cache.clear()
+        return v
+
+    # ----------------------------------------------------------- control flow
+    # The reference interprets Enter/Exit/Merge/Switch/NextIteration nodes in
+    # InferenceSession (SURVEY §3.2 — o.n.linalg.api.ops.impl.controlflow).
+    # TPU-native equivalent: STRUCTURED control flow — each construct is one
+    # graph node holding traced sub-graphs, lowered to lax.cond /
+    # lax.while_loop / lax.scan inside the single jitted executable (XLA
+    # requires structured control flow; dataflow-style Switch/Merge cannot be
+    # expressed under jit).
+
+    def _trace_subgraph(self, fn, arg_vars: Sequence[SDVariable], extra_args: int = 0):
+        """Run a SameDiffLambda-style ``fn(sub_sd, *args)`` against a fresh
+        sub-SameDiff whose placeholders mirror ``arg_vars`` (+ ``extra_args``
+        leading scalar int args, e.g. a loop counter)."""
+        sub = SameDiff()
+        args = []
+        for i in range(extra_args):
+            args.append(sub.placeHolder(f"__arg{i}", shape=(), dtype=jnp.int32))
+        for i, v in enumerate(arg_vars):
+            # unknown dims -> 2, the same convention _op's abstract eval uses
+            shape = tuple(2 if s is None else s for s in (v.shape or ()))
+            args.append(sub.placeHolder(f"__sgin{len(args)}", shape=shape,
+                                        dtype=v.dtype or jnp.float32))
+        out = fn(sub, *args)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        return sub, [a.name for a in args], [o.name for o in outs]
+
+    def _run_subgraph(self, sub: "SameDiff", in_names, in_vals, out_names):
+        env = {**sub._values, **dict(zip(in_names, in_vals))}
+        env = sub._interpret(env)
+        return [env[n] for n in out_names]
+
+    def _control_op(self, opname: str, input_vars: Sequence[SDVariable],
+                    kwargs: dict, name: Optional[str]):
+        """Append a control-flow node; output shapes via abstract eval."""
+        in_names = [v.name for v in input_vars]
+        base = name or self._fresh(opname)
+
+        def absval(v):
+            # unknown dims -> 2, matching _op's abstract-eval convention
+            shape = tuple(2 if s is None else s for s in (v.shape or ()))
+            return jax.ShapeDtypeStruct(shape, v.dtype or jnp.float32)
+
+        node = SameDiffOp("control", opname, in_names, [], kwargs)
+        try:
+            out_struct = jax.eval_shape(
+                lambda *xs: tuple(self._exec_control(node, list(xs))),
+                *[absval(v) for v in input_vars])
+        except Exception:
+            out_struct = None
+        # fallback arity: while/for return one value per input; "if" returns
+        # one per input minus the predicate
+        count = len(out_struct) if out_struct is not None else (
+            len(in_names) - 1 if opname == "if" else len(in_names))
+        node.outputs = [base] if count == 1 else [f"{base}#{i}" for i in range(count)]
+        self._ops.append(node)
+        outs = []
+        for i, on in enumerate(node.outputs):
+            st = out_struct[i] if out_struct is not None else None
+            v = SDVariable(self, on, VariableType.ARRAY,
+                           tuple(st.shape) if st is not None else None,
+                           st.dtype if st is not None else None)
+            self._vars[on] = v
+            outs.append(v)
+        self._jit_cache.clear()
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def _exec_control(self, node: SameDiffOp, args: list):
+        """Lower one control node onto lax primitives (called while tracing)."""
+        kw = node.kwargs
+        if node.opname == "if":
+            (sub_t, tin, tout) = kw["true_graph"]
+            (sub_f, fin, fout) = kw["false_graph"]
+            pred, rest = args[0], args[1:]
+            return jax.lax.cond(
+                jnp.asarray(pred).astype(bool).reshape(()),
+                lambda xs: tuple(self._run_subgraph(sub_t, tin, xs, tout)),
+                lambda xs: tuple(self._run_subgraph(sub_f, fin, xs, fout)),
+                tuple(rest))
+        if node.opname == "while":
+            (sub_c, cin, cout) = kw["cond_graph"]
+            (sub_b, bin_, bout) = kw["body_graph"]
+            state = tuple(jnp.asarray(a) for a in args)
+            # loop vars keep their initial dtypes (TF while-loop semantics;
+            # also guards against literal-promotion drift after serde)
+            dts = [s.dtype for s in state]
+
+            def body(s):
+                new = self._run_subgraph(sub_b, bin_, list(s), bout)
+                return tuple(jnp.asarray(n).astype(d) for n, d in zip(new, dts))
+
+            return tuple(jax.lax.while_loop(
+                lambda s: jnp.asarray(self._run_subgraph(sub_c, cin, list(s), cout)[0])
+                .astype(bool).reshape(()),
+                body, state))
+        if node.opname == "for":
+            (sub_b, bin_, bout) = kw["body_graph"]
+            n_iter = kw["n_iter"]
+            state0 = tuple(jnp.asarray(a) for a in args)
+            dts = [s.dtype for s in state0]
+
+            def body(state, i):
+                new = self._run_subgraph(sub_b, bin_, [i, *state], bout)
+                return tuple(jnp.asarray(n).astype(d)
+                             for n, d in zip(new, dts)), None
+
+            out, _ = jax.lax.scan(body, state0, jnp.arange(n_iter))
+            return out
+        raise ValueError(f"unknown control op {node.opname}")
+
+    def ifCond(self, cond, trueBody, falseBody, inputs=(), name: Optional[str] = None):
+        """Conditional (ref: SameDiff.ifCond — Switch/Merge in the reference;
+        lax.cond here, differentiable). ``cond`` is a scalar-bool SDVariable in
+        THIS graph; trueBody/falseBody are ``fn(sub_sd, *inputs)`` lambdas
+        (ref: SameDiffLambda.define) returning one or more sub-graph vars."""
+        inputs = list(inputs)
+        tg = self._trace_subgraph(trueBody, inputs)
+        fg = self._trace_subgraph(falseBody, inputs)
+        assert len(tg[2]) == len(fg[2]), "branches must return the same arity"
+        return self._control_op("if", [cond, *inputs],
+                                {"true_graph": tg, "false_graph": fg}, name)
+
+    def whileLoop(self, loopVars, condBody, loopBody, name: Optional[str] = None):
+        """While loop (ref: SameDiff.whileLoop — Enter/Exit/NextIteration in
+        the reference; lax.while_loop here). ``condBody(sub_sd, *state)`` must
+        return a scalar bool; ``loopBody(sub_sd, *state)`` returns the next
+        state (same arity/shapes). NOTE: like XLA, reverse-mode gradients do
+        not flow through a general while loop — use forLoop for trainable
+        iteration."""
+        loopVars = list(loopVars)
+        cg = self._trace_subgraph(condBody, loopVars)
+        bg = self._trace_subgraph(loopBody, loopVars)
+        assert len(bg[2]) == len(loopVars), "body must return one var per loop var"
+        return self._control_op("while", loopVars,
+                                {"cond_graph": cg, "body_graph": bg}, name)
+
+    def forLoop(self, n_iter: int, loopVars, loopBody, name: Optional[str] = None):
+        """Fixed-trip-count loop lowered to lax.scan — differentiable, the
+        TPU-idiomatic replacement for trainable while loops.
+        ``loopBody(sub_sd, i, *state)`` returns the next state."""
+        loopVars = list(loopVars)
+        bg = self._trace_subgraph(loopBody, loopVars, extra_args=1)
+        assert len(bg[2]) == len(loopVars), "body must return one var per loop var"
+        return self._control_op("for", loopVars,
+                                {"body_graph": bg, "n_iter": int(n_iter)}, name)
+
     # ------------------------------------------------------------- execution
     def _needed_ops(self, output_names) -> List[SameDiffOp]:
         """Ancestor-subgraph pruning (ref: AbstractSession executes only ops
@@ -369,9 +546,14 @@ class SameDiff:
         under jit — each registry fn call traces into the single jaxpr."""
         env = dict(values)
         for node in (only_ops if only_ops is not None else self._ops):
-            spec = _registry.get(node.opname, node.namespace)
             args = [env[i] for i in node.inputs]
-            out = spec.fn(*args, **node.kwargs)
+            if node.namespace == "control":
+                out = self._exec_control(node, args)
+                if len(node.outputs) == 1:
+                    out = out[0]
+            else:
+                spec = _registry.get(node.opname, node.namespace)
+                out = spec.fn(*args, **node.kwargs)
             if len(node.outputs) == 1 and not isinstance(out, (tuple, list)):
                 env[node.outputs[0]] = out
             else:
@@ -533,8 +715,7 @@ class SameDiff:
                       "shape": list(v.shape) if v.shape else None,
                       "dtype": str(v.dtype) if v.dtype is not None else None}
                      for v in self._vars.values() if "." not in v.name],
-            "ops": [{"namespace": o.namespace, "op": o.opname, "inputs": o.inputs,
-                     "outputs": o.outputs, "kwargs": _json_safe(o.kwargs)} for o in self._ops],
+            "ops": [_op_to_dict(o) for o in self._ops],
             "loss": self._loss_vars,
         }
         with zipfile.ZipFile(path, "w") as zf:
@@ -575,8 +756,7 @@ class SameDiff:
                                             tuple(vd["shape"]) if vd["shape"] else None,
                                             vd["dtype"])
         for od in graph["ops"]:
-            sd._ops.append(SameDiffOp(od["namespace"], od["op"], od["inputs"],
-                                      od["outputs"], od["kwargs"]))
+            sd._ops.append(_op_from_dict(od))
             for on in od["outputs"]:
                 if on not in sd._vars:
                     sd._vars[on] = SDVariable(sd, on, VariableType.ARRAY)
@@ -600,6 +780,64 @@ def _json_safe(d):
         else:
             out[k] = str(v)
     return out
+
+
+_SUBGRAPH_KEYS = ("true_graph", "false_graph", "cond_graph", "body_graph")
+
+
+def _op_to_dict(o: SameDiffOp) -> dict:
+    """Serialize one node; control nodes recurse into their sub-graphs."""
+    kw = dict(o.kwargs)
+    if o.namespace == "control":
+        for k in _SUBGRAPH_KEYS:
+            if k in kw:
+                sub, ins, outs = kw[k]
+                kw[k] = {"__subgraph__": _subgraph_to_dict(sub),
+                         "in": ins, "out": outs}
+    else:
+        kw = _json_safe(kw)
+    return {"namespace": o.namespace, "op": o.opname, "inputs": o.inputs,
+            "outputs": o.outputs, "kwargs": kw}
+
+
+def _op_from_dict(od: dict) -> SameDiffOp:
+    kw = dict(od["kwargs"])
+    if od["namespace"] == "control":
+        for k in _SUBGRAPH_KEYS:
+            if k in kw:
+                d = kw[k]
+                kw[k] = (_subgraph_from_dict(d["__subgraph__"]), d["in"], d["out"])
+    return SameDiffOp(od["namespace"], od["op"], od["inputs"], od["outputs"], kw)
+
+
+def _subgraph_to_dict(sd: "SameDiff") -> dict:
+    """Control sub-graphs carry their constants inline (they are small —
+    literals and shape params; top-level weights stay in npy blobs)."""
+    return {
+        "vars": [{"name": v.name, "type": v.varType,
+                  "shape": list(v.shape) if v.shape else None,
+                  "dtype": str(v.dtype) if v.dtype is not None else None}
+                 for v in sd._vars.values() if "." not in v.name],
+        "ops": [_op_to_dict(o) for o in sd._ops],
+        "values": {n: {"data": np.asarray(v).tolist(), "dtype": str(v.dtype)}
+                   for n, v in sd._values.items()},
+    }
+
+
+def _subgraph_from_dict(d: dict) -> "SameDiff":
+    sub = SameDiff()
+    for vd in d["vars"]:
+        sub._vars[vd["name"]] = SDVariable(
+            sub, vd["name"], vd["type"],
+            tuple(vd["shape"]) if vd["shape"] else None, vd["dtype"])
+    for n, spec in d["values"].items():
+        sub._values[n] = jnp.asarray(np.asarray(spec["data"], dtype=spec["dtype"]))
+    for od in d["ops"]:
+        sub._ops.append(_op_from_dict(od))
+        for on in od["outputs"]:
+            if on not in sub._vars:
+                sub._vars[on] = SDVariable(sub, on, VariableType.ARRAY)
+    return sub
 
 
 class _BatchOutputBuilder:

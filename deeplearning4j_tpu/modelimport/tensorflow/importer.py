@@ -67,10 +67,30 @@ class _GraphImporter:
         self.sd = SameDiff.create()
         self.vars: Dict[str, SDVariable] = {}     # tf node name -> SDVariable
         self.consts: Dict[str, np.ndarray] = {}   # eagerly-resolved Const values
+        # function library (control-flow bodies; GraphDef.library.function)
+        self.library: Dict[str, Any] = {
+            f.signature.name: f for f in gd.library.function} if gd is not None else {}
+        # NodeDef by name — rules peek at producers (e.g. Pack feeding Reshape)
+        self.nodes: Dict[str, Any] = {
+            n.name: n for n in gd.node} if gd is not None else {}
 
     # ------------------------------------------------------------- helpers
+    def _resolve(self, ref: str) -> SDVariable:
+        """Resolve a tensor reference. GraphDef refs are ``name[:idx]``;
+        FunctionDef refs are ``name:out_arg:idx`` — multi-output nodes (While,
+        If) register their extra outputs under ``name:idx``."""
+        parts = ref.split(":")
+        name = parts[0]
+        idx = int(parts[-1]) if len(parts) > 1 and parts[-1].isdigit() else 0
+        if idx:
+            if f"{name}:{idx}" not in self.vars:
+                raise KeyError(
+                    f"tensor ref {ref}: node {name} registered no output {idx}")
+            return self.vars[f"{name}:{idx}"]
+        return self.vars[name]
+
     def _in(self, node, i) -> SDVariable:
-        return self.vars[_clean(node.input[i])]
+        return self._resolve(node.input[i])
 
     def _const(self, node, i) -> np.ndarray:
         name = _clean(node.input[i])
@@ -81,7 +101,41 @@ class _GraphImporter:
         return self.consts[name]
 
     def _ins(self, node) -> List[SDVariable]:
-        return [self.vars[_clean(n)] for n in node.input if _clean(n)]
+        return [self._resolve(n) for n in node.input if _clean(n)]
+
+    def _register_outputs(self, node, outs):
+        """Register a multi-output node's results as name / name:i."""
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        self.vars[node.name] = outs[0]
+        for i, o in enumerate(outs[1:], start=1):
+            self.vars[f"{node.name}:{i}"] = o
+
+    def _import_function(self, fname: str):
+        """FunctionDef -> (sub-SameDiff, in_names, out_names) for control ops
+        (ref: samediff-import maps tf.function bodies to SameDiff subgraphs)."""
+        import jax.numpy as jnp
+        import tensorflow as tf
+        fdef = self.library[fname]
+        sub = _GraphImporter.__new__(_GraphImporter)
+        sub.gd = None
+        sub.sd = SameDiff.create()
+        sub.vars = {}
+        sub.consts = {}
+        sub.library = self.library
+        sub.nodes = {n.name: n for n in fdef.node_def}
+        in_names = []
+        for arg in fdef.signature.input_arg:
+            dt = getattr(jnp, _JNP_DT.get(arg.type, "float32"))
+            ph = sub.sd.placeHolder(arg.name, shape=None, dtype=dt)
+            sub.vars[arg.name] = ph
+            in_names.append(arg.name)
+        for node in fdef.node_def:
+            sub._map_node(node, tf)
+        out_names = []
+        for out_arg in fdef.signature.output_arg:
+            ref = fdef.ret[out_arg.name]
+            out_names.append(sub._resolve(ref).name)
+        return sub.sd, in_names, out_names
 
     def _emit(self, ns: str, opname: str, inputs, name: str, **kwargs) -> SDVariable:
         out = self.sd._op(ns, opname, inputs, name=name, **kwargs)
@@ -123,8 +177,10 @@ class _GraphImporter:
                   "CheckNumerics"):
             src = _clean(node.input[0])
             # emit a real node so the TF node name is addressable as a graph
-            # output (frozen-fn outputs are typically named "Identity")
-            self.vars[name] = self._emit("math", "identity", [self.vars[src]], name)
+            # output (frozen-fn outputs are typically named "Identity");
+            # _resolve keeps multi-output refs like "while:1" intact
+            self.vars[name] = self._emit(
+                "math", "identity", [self._resolve(node.input[0])], name)
             if src in self.consts:
                 self.consts[name] = self.consts[src]
             return
@@ -138,6 +194,26 @@ class _GraphImporter:
         out = fn(self, node)
         if out is not None:
             self.vars[name] = out
+            # eager const folding: a node whose inputs are all consts is
+            # itself a const (ref: the importer resolves constant subgraphs so
+            # downstream rules can read attribute-carrying inputs — e.g.
+            # StridedSlice over a constant-folded Shape feeding a Reshape)
+            ins = [_clean(x) for x in node.input if _clean(x)]
+            if ins and all(i in self.consts for i in ins):
+                try:
+                    val = _eval_const_node(self, node, out)
+                    if val is not None:
+                        self.consts[name] = val
+                except Exception:
+                    pass
+
+
+def _eval_const_node(g, node, out: SDVariable):
+    """Evaluate a const-input node's value at import time (small results only
+    — shape math; folding megabyte weights would duplicate them)."""
+    if out.shape is None or int(np.prod(out.shape or (1,))) > 4096:
+        return None
+    return np.asarray(out.eval({}).toNumpy())
 
 
 # --------------------------------------------------------------- mapping rules
@@ -159,6 +235,10 @@ _BINARY = {
     "Pow": ("math", "pow"), "FloorDiv": ("math", "floorDiv"),
     "FloorMod": ("math", "floorMod"), "Atan2": ("math", "atan2"),
     "LogicalAnd": ("math", "logicalAnd"), "LogicalOr": ("math", "logicalOr"),
+    "SquaredDifference": ("math", "squaredDifference"),
+    "Equal": ("math", "eq"), "NotEqual": ("math", "neq"),
+    "Less": ("math", "lt"), "LessEqual": ("math", "lte"),
+    "Greater": ("math", "gt"), "GreaterEqual": ("math", "gte"),
 }
 _UNARY = {
     "Relu": ("nn", "relu"), "Relu6": ("nn", "relu6"), "Elu": ("nn", "elu"),
@@ -241,8 +321,62 @@ def _leaky(g, n):
 
 @_rule("Reshape")
 def _reshape(g, n):
-    shape = tuple(int(s) for s in g._const(n, 1))
-    return g._emit("shape", "reshape", [g._in(n, 0)], n.name, shape=shape)
+    ref = _clean(n.input[1])
+    if ref in g.consts:
+        shape = tuple(int(s) for s in g.consts[ref])
+        return g._emit("shape", "reshape", [g._in(n, 0)], n.name, shape=shape)
+    # dynamic shape: typically Pack([batch_from_Shape, const, const, ...]).
+    # XLA needs static shapes, so resolve each dynamic component back to the
+    # tensor whose tf.shape() it came from ("dim:i" of a reference input,
+    # static at trace time); a single unresolvable one degrades to -1.
+    producer = g.nodes.get(ref)
+    if producer is not None and producer.op == "Pack":
+        dims: List[Any] = []
+        ref_node = None
+        for inp in producer.input:
+            nm = _clean(inp)
+            if nm in g.consts:
+                dims.append(int(np.atleast_1d(g.consts[nm])[0]))
+                continue
+            src = _dim_of_shape(g, nm)
+            if src is not None:
+                target, idx = src
+                if ref_node is None or ref_node == target:
+                    ref_node = target
+                    dims.append(f"dim:{idx}")
+                    continue
+            dims.append(-1)
+        n_unres = sum(1 for d in dims if d == -1)
+        if ref_node is not None and n_unres == 0:
+            return g._emit("shape", "reshapeRef",
+                           [g._in(n, 0), g._resolve(ref_node)], n.name,
+                           dims=list(dims))
+        if ref_node is None and n_unres <= 1:
+            return g._emit("shape", "reshape", [g._in(n, 0)], n.name,
+                           shape=tuple(dims))
+        if n_unres <= 1:
+            # mixed: keep ref dims, let the one unresolved dim be inferred
+            return g._emit("shape", "reshapeRef",
+                           [g._in(n, 0), g._resolve(ref_node)], n.name,
+                           dims=list(dims))
+    raise ValueError(
+        f"Reshape {n.name}: dynamic shape input {ref} unresolvable "
+        "(need Const or Pack of consts / tf.shape() components)")
+
+
+def _dim_of_shape(g, name):
+    """If node ``name`` is StridedSlice(Shape(y), [i]) return (y, i)."""
+    node = g.nodes.get(name)
+    if node is None or node.op != "StridedSlice":
+        return None
+    shp = g.nodes.get(_clean(node.input[0]))
+    if shp is None or shp.op != "Shape":
+        return None
+    try:
+        i = int(np.atleast_1d(g.consts[_clean(node.input[1])])[0])
+    except KeyError:
+        return None
+    return _clean(shp.input[0]), i
 
 
 @_rule("Transpose")
@@ -327,10 +461,13 @@ def _strided_slice(g, n):
     sm = int(n.attr["shrink_axis_mask"].i)
     nm = int(n.attr["new_axis_mask"].i)
     el = int(n.attr["ellipsis_mask"].i)
-    if nm or el:
-        raise ValueError("StridedSlice with new_axis/ellipsis masks unsupported")
+    if el:
+        raise ValueError("StridedSlice with ellipsis mask unsupported")
     slices = []
     for i in range(len(begin)):
+        if nm & (1 << i):
+            slices.append(None)  # np.newaxis (e.g. pos_emb[tf.newaxis])
+            continue
         if sm & (1 << i):
             slices.append(begin[i])
             continue
@@ -386,6 +523,93 @@ def _pool(g, n):
     out = g._emit("cnn", opname, [x], n.name + "/pool",
                   kernel=(k[1], k[2]), strides=(s[1], s[2]), padding=padding)
     return g._nchw_to_nhwc(out, n.name)
+
+
+@_rule("Split")
+def _split(g, n):
+    axis = int(np.atleast_1d(g._const(n, 0))[0])
+    num = int(n.attr["num_split"].i)
+    outs = g._emit("shape", "splitN", [g._in(n, 1)], n.name, num=num, axis=axis)
+    g._register_outputs(n, outs)
+    return None
+
+
+@_rule("SplitV")
+def _splitv(g, n):
+    sizes = [int(s) for s in g._const(n, 1)]
+    axis = int(np.atleast_1d(g._const(n, 2))[0])
+    x = g._in(n, 0)
+    rank = len(x.shape) if x.shape is not None else None
+    if axis < 0:
+        if rank is None:
+            raise ValueError(f"SplitV {n.name}: negative axis on unknown rank")
+        axis += rank
+    outs, off = [], 0
+    for j, sz in enumerate(sizes):
+        sl = [slice(None)] * axis + [slice(off, off + sz)]
+        outs.append(g._emit("shape", "stridedSlice", [x], f"{n.name}/s{j}",
+                            slices=tuple(sl)))
+        off += sz
+    g._register_outputs(n, outs)
+    return None
+
+
+@_rule("Fill")
+def _fill(g, n):
+    dims = tuple(int(d) for d in g._const(n, 0))
+    val = g._const(n, 1)
+    return g.sd.constant(n.name, np.full(dims, val))
+
+
+@_rule("Select", "SelectV2")
+def _select(g, n):
+    return g._emit("shape", "where", [g._in(n, 0), g._in(n, 1), g._in(n, 2)],
+                   n.name)
+
+
+@_rule("AddN")
+def _addn(g, n):
+    xs = g._ins(n)
+    acc = xs[0]
+    for j, x in enumerate(xs[1:]):
+        acc = g._emit("math", "add", [acc, x],
+                      n.name if j == len(xs) - 2 else f"{n.name}/p{j}")
+    return acc
+
+
+@_rule("Rank")
+def _rank(g, n):
+    return g._emit("shape", "rank", [g._in(n, 0)], n.name)
+
+
+@_rule("ZerosLike", "OnesLike")
+def _fill_like(g, n):
+    opname = "zerosLike" if n.op == "ZerosLike" else "onesLike"
+    return g._emit("math", opname, [g._in(n, 0)], n.name)
+
+
+@_rule("While", "StatelessWhile")
+def _while_rule(g, n):
+    """TF2 functional while: cond/body live in the function library (ref:
+    SameDiff InferenceSession Enter/Exit/... — structured lax loop here)."""
+    cg = g._import_function(n.attr["cond"].func.name)
+    bg = g._import_function(n.attr["body"].func.name)
+    loop_vars = g._ins(n)
+    outs = g.sd._control_op("while", loop_vars,
+                            {"cond_graph": cg, "body_graph": bg}, n.name)
+    g._register_outputs(n, outs)
+    return None
+
+
+@_rule("If", "StatelessIf")
+def _if_rule(g, n):
+    tg = g._import_function(n.attr["then_branch"].func.name)
+    fg = g._import_function(n.attr["else_branch"].func.name)
+    ins = g._ins(n)
+    outs = g.sd._control_op("if", ins,  # ins[0] is the predicate
+                            {"true_graph": tg, "false_graph": fg}, n.name)
+    g._register_outputs(n, outs)
+    return None
 
 
 @_rule("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")

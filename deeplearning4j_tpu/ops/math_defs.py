@@ -48,6 +48,18 @@ op("max", "math")(jnp.maximum)
 op("min", "math")(jnp.minimum)
 op("clipByValue", "math")(lambda x, lo, hi: jnp.clip(x, lo, hi))
 
+op("squaredDifference", "math")(lambda a, b: jnp.square(a - b))
+op("zerosLike", "math")(jnp.zeros_like)
+op("onesLike", "math")(jnp.ones_like)
+
+# comparisons (ref: SDMath eq/neq/lt/lte/gt/gte + impl.transforms.comparison)
+op("eq", "math")(jnp.equal)
+op("neq", "math")(jnp.not_equal)
+op("lt", "math")(jnp.less)
+op("lte", "math")(jnp.less_equal)
+op("gt", "math")(jnp.greater)
+op("gte", "math")(jnp.greater_equal)
+
 
 @op("clipByNorm", "math")
 def clip_by_norm(x, clip_norm, axis=None):
@@ -199,6 +211,19 @@ op("scatterMax", "shape")(lambda x, indices, updates: x.at[indices].max(updates)
 op("scatterMin", "shape")(lambda x, indices, updates: x.at[indices].min(updates))
 op("slice", "shape")(lambda x, begin, size: lax.dynamic_slice(x, tuple(begin), tuple(size)))
 op("stridedSlice", "shape")(lambda x, slices: x[tuple(slices)])
+op("splitN", "shape")(lambda x, num, axis=0: tuple(jnp.split(x, num, axis=axis)))
+
+
+@op("reshapeRef", "shape")
+def reshape_ref(x, ref, dims):
+    """Reshape where some target dims come from ``ref``'s (trace-time static)
+    shape: entries are ints, or "dim:i" meaning ref.shape[i]. Lets TF-imported
+    graphs whose Reshape shapes are computed from tf.shape() stay static under
+    jit (XLA requires static shapes)."""
+    shape = tuple(
+        ref.shape[int(d[4:])] if isinstance(d, str) and d.startswith("dim:")
+        else int(d) for d in dims)
+    return jnp.reshape(x, shape)
 op("where", "shape")(lambda cond, x, y: jnp.where(cond, x, y))
 op("cumsum", "shape")(lambda x, axis=None: jnp.cumsum(x, axis=axis))
 op("cumprod", "shape")(lambda x, axis=None: jnp.cumprod(x, axis=axis))

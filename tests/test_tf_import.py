@@ -16,7 +16,9 @@ def _freeze(fn, *specs):
     from tensorflow.python.framework.convert_to_constants import (
         convert_variables_to_constants_v2)
     cf = tf.function(fn).get_concrete_function(*specs)
-    frozen = convert_variables_to_constants_v2(cf)
+    # keep functional While/If nodes (+ library) — the importer maps them to
+    # structured lax control flow; v1-style Enter/Switch dataflow is not jittable
+    frozen = convert_variables_to_constants_v2(cf, lower_control_flow=False)
     gd = frozen.graph.as_graph_def()
     in_names = [t.name.split(":")[0] for t in frozen.inputs]
     out_names = [t.name.split(":")[0] for t in frozen.outputs]
@@ -137,3 +139,79 @@ def test_argmax_and_dilated_conv_graph():
         return tf.argmax(tf.reduce_mean(y, axis=[1, 2]), axis=1)
 
     _run_parity(f, [RNG.normal(size=(2, 8, 8, 2)).astype(np.float32)], atol=1e-4)
+
+
+def test_while_loop_graph():
+    """tf.while_loop freezes to a functional While node whose cond/body live
+    in the graph's function library — imported as a structured lax loop."""
+    def f(x):
+        i = tf.constant(0)
+        c = lambda i, acc: i < 4
+        b = lambda i, acc: (i + 1, acc * 2.0)
+        _, out = tf.while_loop(c, b, (i, x))
+        return out
+
+    _run_parity(f, [RNG.normal(size=(3,)).astype(np.float32)])
+
+
+def test_cond_graph():
+    """tf.cond freezes to StatelessIf with then/else function-library branches."""
+    def f(x):
+        return tf.cond(tf.reduce_sum(x) > 0.0,
+                       lambda: x * 2.0, lambda: x - 1.0)
+
+    _run_parity(f, [np.array([1.0, 2.0], np.float32)])
+    _run_parity(f, [np.array([-5.0, 2.0], np.float32)])
+
+
+def test_split_and_dynamic_reshape_graph():
+    def f(x):
+        a, b = tf.split(x, 2, axis=-1)
+        B = tf.shape(x)[0]
+        return tf.reshape(a * b, (B, -1))
+
+    _run_parity(f, [RNG.normal(size=(4, 8)).astype(np.float32)])
+
+
+def test_bert_base_architecture_import_parity():
+    """BASELINE config #4's import path at architecture fidelity: a BERT-style
+    encoder (frozen GraphDef, same op mix as BERT-base: Gather embeddings,
+    moments layernorm, BatchMatMulV2 attention, erf-GELU) imports and matches
+    live TF. Full-size import is exercised in tools/bench_tf_import.py."""
+    from tools.tf_bert import build_frozen_bert
+    gd, i, o, frozen = build_frozen_bert(L=2, H=64, A=4, V=100, T=16,
+                                         intermediate=128)
+    sd = TensorflowFrameworkImporter.runImport(gd)
+    ids = RNG.integers(0, 100, (2, 16)).astype(np.int32)
+    got = sd.getVariable(o).eval({i: ids}).toNumpy()
+    exp = frozen(tf.constant(ids))
+    if isinstance(exp, (list, tuple)):
+        exp = exp[0]
+    np.testing.assert_allclose(got, np.asarray(exp), atol=1e-4)
+
+
+def test_bert_import_finetune_loss_decreases():
+    """Fine-tune THROUGH the imported graph (ref: SameDiff BERT fine-tune,
+    SURVEY §3.3): constants -> variables, new head, whole-graph jitted fit."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.train import Adam
+    from tools.tf_bert import build_frozen_bert
+
+    gd, i, o, _ = build_frozen_bert(L=2, H=64, A=4, V=100, T=16,
+                                    intermediate=128)
+    sd = TensorflowFrameworkImporter.runImport(gd)
+    assert sd.convertAllConstantsToVariables() > 0
+    pooled = sd.reduce.mean(sd.getVariable(o), dims=(1,))
+    W = sd.var("cls_W", (64, 4), weightInit="XAVIER")
+    logits = sd.linalg.matmul(pooled, W)
+    labels = sd.placeHolder("labels", shape=(8,), dtype=jnp.int32)
+    loss = sd.loss.sparseMcxent(labels, logits)
+    sd.setLossVariables(loss.name)
+    sd.setTrainingConfig(TrainingConfig(updater=Adam(1e-3)))
+    ids = RNG.integers(0, 100, (8, 16)).astype(np.int32)
+    y = RNG.integers(0, 4, (8,)).astype(np.int32)
+    hist = []
+    for _ in range(12):
+        hist += sd.fit({i: ids, "labels": y})
+    assert hist[-1] < hist[0] * 0.7, (hist[0], hist[-1])

@@ -1,0 +1,76 @@
+"""Bench variant for BASELINE config #4 THROUGH the import path: a frozen
+BERT-base GraphDef is imported into SameDiff and fine-tuned under whole-graph
+jit (vs bench.py which trains the hand-written flagship transformer).
+
+Run manually: python tools/bench_tf_import.py
+Prints one JSON line in the same format as bench.py. ``vs_baseline`` is MFU
+against the 35% north-star gate, as in bench.py.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.train import Adam
+    from deeplearning4j_tpu.modelimport.tensorflow import TensorflowFrameworkImporter
+    from tools.tf_bert import build_frozen_bert
+    from bench import _peak_flops
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        L, H, A, V, T, inter = 12, 768, 12, 30522, 128, 3072
+        B, steps, warmup = 32, 10, 3
+    else:
+        L, H, A, V, T, inter = 2, 64, 4, 256, 16, 128
+        B, steps, warmup = 4, 3, 1
+
+    gd, in_name, out_name, _ = build_frozen_bert(L=L, H=H, A=A, V=V, T=T,
+                                                 intermediate=inter)
+    sd = TensorflowFrameworkImporter.runImport(gd)
+    sd.convertAllConstantsToVariables()
+    n_param = sum(int(np.prod(v.shape)) for v in sd.variables()
+                  if v.varType == "VARIABLE" and v.shape)
+
+    # MLM head over the imported encoder output
+    hidden = sd.getVariable(out_name)
+    lm_w = sd.var("lm_head", (H, V), weightInit="XAVIER")
+    logits = sd.linalg.matmul(hidden, lm_w)
+    targets = sd.placeHolder("targets", shape=(B, T), dtype=jnp.int32)
+    loss = sd.loss.sparseMcxent(targets, logits)
+    sd.setLossVariables(loss.name)
+    sd.setTrainingConfig(TrainingConfig(updater=Adam(1e-4)))
+
+    rng = np.random.default_rng(0)
+    batch = {in_name: rng.integers(0, V, (B, T)).astype(np.int32),
+             "targets": rng.integers(0, V, (B, T)).astype(np.int32)}
+    for _ in range(warmup):
+        hist = sd.fit(batch)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        hist = sd.fit(batch)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * T * steps / dt
+    n_emb = V * H + T * H
+    flops_per_token = 6 * (n_param - n_emb + H * V) + 12 * L * H * T
+    peak = _peak_flops(jax.devices()[0]) if on_tpu else 1e12
+    mfu = tokens_per_sec * flops_per_token / peak
+    print(json.dumps({
+        "metric": "bert_base_tf_import_finetune_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.35, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
