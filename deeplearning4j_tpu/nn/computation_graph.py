@@ -50,13 +50,15 @@ class ComputationGraph:
     # ------------------------------------------------------------------ init
     def init(self):
         key = jax.random.key(self.conf.seed)
-        layer_nodes = [n for n in self._order if isinstance(n.op, Layer)]
-        keys = jax.random.split(key, max(len(layer_nodes), 1))
+        param_nodes = [n for n in self._order
+                       if isinstance(n.op, Layer) or getattr(n.op, "has_params", False)]
+        keys = jax.random.split(key, max(len(param_nodes), 1))
         self._params = {}
         self._state = {}
-        for i, n in enumerate(layer_nodes):
+        for i, n in enumerate(param_nodes):
             self._params[n.name] = n.op.init_params(keys[i], self._dtype)
-            self._state[n.name] = n.op.init_state(self._dtype)
+            self._state[n.name] = n.op.init_state(self._dtype) \
+                if isinstance(n.op, Layer) else {}
         self._tx = self.conf.updater.to_optax()
         self._opt_state = self._tx.init(self._params)
         return self
@@ -64,8 +66,8 @@ class ComputationGraph:
     # -------------------------------------------------------------- forward
     def _adapt(self, layer: Layer, x):
         """CNN->FF flatten adapter (same rule as MultiLayerNetwork._forward)."""
-        if x.ndim == 4 and isinstance(layer, FeedForwardLayer) and not isinstance(
-                layer, (ConvolutionLayer, BaseRecurrentLayer, BatchNormalization)):
+        from deeplearning4j_tpu.nn.conf.layers import needs_flatten
+        if needs_flatten(layer, x.ndim):
             return x.reshape(x.shape[0], -1)
         return x
 
@@ -79,7 +81,11 @@ class ComputationGraph:
         for node in self._order:
             xs = [acts[i] for i in node.inputs]
             if isinstance(node.op, GraphVertex):
-                acts[node.name] = node.op.apply(xs, training=training)
+                if getattr(node.op, "has_params", False):
+                    acts[node.name] = node.op.apply(
+                        xs, params=params.get(node.name, {}), training=training)
+                else:
+                    acts[node.name] = node.op.apply(xs, training=training)
                 continue
             layer = node.op
             x = self._adapt(layer, xs[0])

@@ -12,6 +12,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -177,9 +178,117 @@ class ReshapeVertex(GraphVertex):
         return None  # shape inference stops; downstream must set nIn explicitly
 
 
+@dataclass
+class DotProductAttentionVertex(GraphVertex):
+    """Parameterless scaled dot-product attention over [queries, keys, values
+    (, mask)] inputs, NWC sequences (ref: conf.graph.DotProductAttentionVertex)."""
+    scale: Optional[float] = None
+
+    def apply(self, inputs, *, training=False, rng=None):
+        import math as _math
+        q, k, v = inputs[0], inputs[1], inputs[2]
+        scale = self.scale if self.scale is not None else 1.0 / _math.sqrt(q.shape[-1])
+        s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+        if len(inputs) > 3 and inputs[3] is not None:
+            s = s + jnp.where(inputs[3][:, None, :] > 0, 0.0, -1e9)
+        a = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bqk,bkd->bqd", a, v)
+
+    def output_type(self, input_types):
+        q, v = input_types[0], input_types[2]
+        return InputType.recurrent(v.size, q.timeSeriesLength)
+
+
+@dataclass
+class AttentionVertex(GraphVertex):
+    """Multi-head attention with learned projections over [queries, keys,
+    values] inputs (ref: conf.graph.AttentionVertex, SameDiff-backed)."""
+    nInQueries: int = 0
+    nInKeys: int = 0
+    nInValues: int = 0
+    nOut: int = 0
+    nHeads: int = 1
+    weightInit: Optional[str] = None
+
+    has_params = True
+
+    def init_params(self, key, dtype=jnp.float32):
+        from deeplearning4j_tpu.nn.conf import weights as _winit
+        ks = jax.random.split(key, 4)
+        wi = self.weightInit or "XAVIER"
+        O = self.nOut
+        return {"Wq": _winit.init(wi, ks[0], (self.nInQueries, O), self.nInQueries, O, dtype),
+                "Wk": _winit.init(wi, ks[1], (self.nInKeys, O), self.nInKeys, O, dtype),
+                "Wv": _winit.init(wi, ks[2], (self.nInValues, O), self.nInValues, O, dtype),
+                "Wo": _winit.init(wi, ks[3], (O, O), O, O, dtype)}
+
+    def apply(self, inputs, *, params=None, training=False, rng=None):
+        import math as _math
+        q = jnp.matmul(inputs[0], params["Wq"])
+        k = jnp.matmul(inputs[1], params["Wk"])
+        v = jnp.matmul(inputs[2], params["Wv"])
+        B, Tq, O = q.shape
+        H = self.nHeads
+        d = O // H
+
+        def heads(t):
+            return t.reshape(B, t.shape[1], H, d).transpose(0, 2, 1, 3)
+
+        s = jnp.einsum("bhqd,bhkd->bhqk", heads(q), heads(k)) / _math.sqrt(d)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", a, heads(v))
+        o = o.transpose(0, 2, 1, 3).reshape(B, Tq, O)
+        return jnp.matmul(o, params["Wo"])
+
+    def output_type(self, input_types):
+        return InputType.recurrent(self.nOut, input_types[0].timeSeriesLength)
+
+
+@dataclass
+class PreprocessorVertex(GraphVertex):
+    """Standalone input-format adapter (ref: conf.graph.PreprocessorVertex).
+    ``preprocessor``: 'cnnToFF' | 'ffToRnn' | 'rnnToFF' | 'rnnToCnn' | 'cnnToRnn'
+    (the reference's InputPreProcessor impls)."""
+    preprocessor: str = "cnnToFF"
+    channels: int = 0
+    height: int = 0
+    width: int = 0
+
+    def apply(self, inputs, *, training=False, rng=None):
+        x = inputs[0]
+        p = self.preprocessor
+        if p == "cnnToFF":
+            return x.reshape(x.shape[0], -1)
+        if p == "ffToRnn":
+            return x[:, None, :]
+        if p == "rnnToFF":
+            return x.reshape(-1, x.shape[-1])
+        if p == "rnnToCnn":
+            B, T = x.shape[0], x.shape[1]
+            return x.reshape(B * T, self.channels, self.height, self.width)
+        if p == "cnnToRnn":
+            return x.reshape(x.shape[0], 1, -1)
+        raise ValueError(p)
+
+    def output_type(self, input_types):
+        t = input_types[0]
+        if self.preprocessor == "cnnToFF":
+            return InputType.feedForward(t.flat_size())
+        if self.preprocessor == "ffToRnn":
+            return InputType.recurrent(t.size, 1)
+        if self.preprocessor == "rnnToFF":
+            return InputType.feedForward(t.size)
+        if self.preprocessor == "rnnToCnn":
+            return InputType.convolutional(self.height, self.width, self.channels)
+        if self.preprocessor == "cnnToRnn":
+            return InputType.recurrent(t.flat_size(), 1)
+        return t
+
+
 VERTEX_TYPES = {c.__name__: c for c in (
     MergeVertex, ElementWiseVertex, SubsetVertex, StackVertex, UnstackVertex,
-    ScaleVertex, ShiftVertex, L2NormalizeVertex, ReshapeVertex)}
+    ScaleVertex, ShiftVertex, L2NormalizeVertex, ReshapeVertex,
+    DotProductAttentionVertex, AttentionVertex, PreprocessorVertex)}
 
 
 @dataclass

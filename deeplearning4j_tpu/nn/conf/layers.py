@@ -200,10 +200,20 @@ class EmbeddingSequenceLayer(FeedForwardLayer):
 # --------------------------------------------------------------------- CNN
 
 
+def needs_flatten(layer, x_ndim: int) -> bool:
+    """CNN->FF flatten-adapter predicate (ref: CnnToFeedForwardPreProcessor
+    auto-insertion): spatial (4/5-dim) input into a dense-style layer is
+    flattened unless the layer consumes spatial input itself."""
+    return x_ndim in (4, 5) and isinstance(layer, FeedForwardLayer) \
+        and not getattr(layer, "spatial_input", False) \
+        and not isinstance(layer, (BaseRecurrentLayer, BatchNormalization))
+
+
 @dataclass
 class ConvolutionLayer(FeedForwardLayer):
     """2D conv, NCHW/OIHW (ref: conf.layers.ConvolutionLayer ->
     libnd4j conv2d; here lax.conv_general_dilated -> MXU)."""
+    spatial_input = True
     kernelSize: Tuple[int, int] = (5, 5)
     stride: Tuple[int, int] = (1, 1)
     padding: Tuple[int, int] = (0, 0)
@@ -976,6 +986,868 @@ class SelfAttentionLayer(BaseRecurrentLayer):
         return out, state
 
 
+# ------------------------------------------------- parametric activations etc.
+
+
+@dataclass
+class PReLULayer(Layer):
+    """Learned leaky-ReLU slope (ref: conf.layers.PReLULayer). ``inputShape``
+    is the per-example shape; ``sharedAxes`` broadcast alpha over those axes
+    (1-based, as the reference counts within the example)."""
+    inputShape: Tuple[int, ...] = ()
+    sharedAxes: Tuple[int, ...] = ()
+
+    def set_n_in(self, input_type: InputType):
+        if not self.inputShape:
+            self.inputShape = tuple(input_type.array_shape(1)[1:])
+
+    def init_params(self, key, dtype=jnp.float32):
+        shape = tuple(1 if (i + 1) in tuple(self.sharedAxes) else s
+                      for i, s in enumerate(self.inputShape))
+        return {"alpha": jnp.zeros(shape, dtype)}
+
+    def regularizable(self):
+        return ()
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return jnp.where(x >= 0, x, params["alpha"] * x), state
+
+
+@dataclass
+class ElementWiseMultiplicationLayer(FeedForwardLayer):
+    """out = activation(x * w + b), elementwise learned scale (ref:
+    conf.layers.misc.ElementWiseMultiplicationLayer)."""
+
+    def __post_init__(self):
+        if not self.nOut:
+            self.nOut = self.nIn
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"W": jnp.ones((self.nIn,), dtype),
+                "b": jnp.full((self.nIn,), self.biasInit or 0.0, dtype)}
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return self._activate(x * params["W"] + params["b"]), state
+
+
+@dataclass
+class MaskZeroLayer(Layer):
+    """Zeroes timesteps equal to maskValue before the underlying layer (ref:
+    conf.layers.util.MaskZeroLayer)."""
+    underlying: Optional[Layer] = None
+    maskValue: float = 0.0
+
+    def __post_init__(self):
+        if self.underlying is not None and not isinstance(self.underlying, Layer):
+            self.underlying = Layer.from_dict(self.underlying)
+
+    def inherit(self, globals_: dict):
+        super().inherit(globals_)
+        if self.underlying:
+            self.underlying.inherit(globals_)
+
+    def set_n_in(self, input_type: InputType):
+        if self.underlying:
+            self.underlying.set_n_in(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self.underlying.output_type(input_type) if self.underlying else input_type
+
+    def init_params(self, key, dtype=jnp.float32):
+        return self.underlying.init_params(key, dtype) if self.underlying else {}
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None):
+        step_mask = jnp.any(x != self.maskValue, axis=-1)  # (B,T)
+        x = x * step_mask[..., None].astype(x.dtype)
+        if self.underlying:
+            kwargs = {"mask": step_mask.astype(jnp.float32)} \
+                if isinstance(self.underlying, BaseRecurrentLayer) else {}
+            return self.underlying.apply(params, x, training=training, rng=rng,
+                                         state=state, **kwargs)
+        return x, state
+
+
+@dataclass
+class SpaceToDepthLayer(Layer):
+    """(ref: conf.layers.SpaceToDepthLayer), NCHW."""
+    blockSize: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        b = self.blockSize
+        return InputType.convolutional(input_type.height // b, input_type.width // b,
+                                       input_type.channels * b * b)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return _nnops.space_to_depth(x, self.blockSize), state
+
+
+# --------------------------------------------------------------- 1D/3D resize
+
+
+@dataclass
+class Upsampling1D(Layer):
+    """Repeat along time (ref: conf.layers.Upsampling1D). Input (B,T,C)."""
+    size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timeSeriesLength
+        return InputType.recurrent(input_type.size, t * self.size if t > 0 else -1)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return jnp.repeat(x, self.size, axis=1), state
+
+
+@dataclass
+class Upsampling3D(Layer):
+    """(ref: conf.layers.Upsampling3D), NCDHW."""
+    size: Tuple[int, int, int] = (2, 2, 2)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        s = self.size
+        return InputType.convolutional3D(input_type.depth * s[0], input_type.height * s[1],
+                                         input_type.width * s[2], input_type.channels)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        s = self.size
+        x = jnp.repeat(x, s[0], axis=2)
+        x = jnp.repeat(x, s[1], axis=3)
+        return jnp.repeat(x, s[2], axis=4), state
+
+
+@dataclass
+class Cropping1D(Layer):
+    """(ref: conf.layers.convolutional.Cropping1D). Input (B,T,C)."""
+    cropping: Tuple[int, int] = (0, 0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timeSeriesLength
+        c = self.cropping
+        return InputType.recurrent(input_type.size, t - c[0] - c[1] if t > 0 else -1)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b], state
+
+
+@dataclass
+class Cropping3D(Layer):
+    """(ref: conf.layers.convolutional.Cropping3D), NCDHW."""
+    cropping: Tuple[int, int, int, int, int, int] = (0, 0, 0, 0, 0, 0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        d0, d1, h0, h1, w0, w1 = self.cropping
+        return InputType.convolutional3D(input_type.depth - d0 - d1,
+                                         input_type.height - h0 - h1,
+                                         input_type.width - w0 - w1, input_type.channels)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        d0, d1, h0, h1, w0, w1 = self.cropping
+        return x[:, :, d0:x.shape[2] - d1, h0:x.shape[3] - h1, w0:x.shape[4] - w1], state
+
+
+@dataclass
+class ZeroPadding1DLayer(Layer):
+    """(ref: conf.layers.ZeroPadding1DLayer). Input (B,T,C)."""
+    padding: Tuple[int, int] = (0, 0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timeSeriesLength
+        p = self.padding
+        return InputType.recurrent(input_type.size, t + p[0] + p[1] if t > 0 else -1)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        a, b = self.padding
+        return jnp.pad(x, ((0, 0), (a, b), (0, 0))), state
+
+
+@dataclass
+class ZeroPadding3DLayer(Layer):
+    """(ref: conf.layers.ZeroPadding3DLayer), NCDHW."""
+    padding: Tuple[int, int, int, int, int, int] = (0, 0, 0, 0, 0, 0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        d0, d1, h0, h1, w0, w1 = self.padding
+        return InputType.convolutional3D(input_type.depth + d0 + d1,
+                                         input_type.height + h0 + h1,
+                                         input_type.width + w0 + w1, input_type.channels)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        d0, d1, h0, h1, w0, w1 = self.padding
+        return jnp.pad(x, ((0, 0), (0, 0), (d0, d1), (h0, h1), (w0, w1))), state
+
+
+# ------------------------------------------------------------------- 3D conv
+
+
+def _triple(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v, v, v)
+
+
+@dataclass
+class Convolution3D(FeedForwardLayer):
+    """3D conv, NCDHW (ref: conf.layers.Convolution3D -> libnd4j conv3dnew)."""
+    spatial_input = True
+    kernelSize: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    dilation: Tuple[int, int, int] = (1, 1, 1)
+    convolutionMode: str = "Truncate"
+    hasBias: bool = True
+
+    def set_n_in(self, input_type: InputType):
+        if not self.nIn:
+            self.nIn = input_type.channels
+
+    def output_type(self, input_type: InputType) -> InputType:
+        k, s, p = _triple(self.kernelSize), _triple(self.stride), _triple(self.padding)
+        d = _conv_out(input_type.depth, k[0], s[0], p[0], self.convolutionMode)
+        h = _conv_out(input_type.height, k[1], s[1], p[1], self.convolutionMode)
+        w = _conv_out(input_type.width, k[2], s[2], p[2], self.convolutionMode)
+        return InputType.convolutional3D(d, h, w, self.nOut)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k = _triple(self.kernelSize)
+        fan_in = self.nIn * k[0] * k[1] * k[2]
+        fan_out = self.nOut * k[0] * k[1] * k[2]
+        p = {"W": _winit.init(self.weightInit or "XAVIER", key,
+                              (self.nOut, self.nIn) + k, fan_in, fan_out, dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit or 0.0, dtype)
+        return p
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        if self.convolutionMode == "Same":
+            pad = "SAME"
+        else:
+            p = _triple(self.padding)
+            pad = [(p[0], p[0]), (p[1], p[1]), (p[2], p[2])]
+        z = _nnops.conv3d(x, params["W"], params.get("b"), strides=_triple(self.stride),
+                          padding=pad, dilation=_triple(self.dilation))
+        return self._activate(z), state
+
+
+@dataclass
+class Subsampling3DLayer(Layer):
+    """3D pooling, NCDHW (ref: conf.layers.Subsampling3DLayer)."""
+    poolingType: str = "MAX"
+    kernelSize: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (2, 2, 2)
+    convolutionMode: str = "Truncate"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        k, s = _triple(self.kernelSize), _triple(self.stride)
+        d = _conv_out(input_type.depth, k[0], s[0], 0, self.convolutionMode)
+        h = _conv_out(input_type.height, k[1], s[1], 0, self.convolutionMode)
+        w = _conv_out(input_type.width, k[2], s[2], 0, self.convolutionMode)
+        return InputType.convolutional3D(d, h, w, input_type.channels)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        pad = "SAME" if self.convolutionMode == "Same" else "VALID"
+        fn = _nnops.max_pool3d if self.poolingType == "MAX" else _nnops.avg_pool3d
+        return fn(x, _triple(self.kernelSize), _triple(self.stride), pad), state
+
+
+# ------------------------------------------------------------ locally connected
+
+
+@dataclass
+class LocallyConnected1D(FeedForwardLayer):
+    """Conv1D with UNSHARED weights per position (ref: conf.layers.
+    LocallyConnected1D, SameDiff-backed). Input (B,T,C); requires a fixed
+    sequence length."""
+    kernelSize: int = 2
+    stride: int = 1
+    inputLength: int = 0
+    hasBias: bool = True
+
+    def set_n_in(self, input_type: InputType):
+        if not self.nIn:
+            self.nIn = input_type.size
+        if not self.inputLength and input_type.timeSeriesLength > 0:
+            self.inputLength = input_type.timeSeriesLength
+
+    def _out_len(self):
+        return (self.inputLength - self.kernelSize) // self.stride + 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, self._out_len())
+
+    def init_params(self, key, dtype=jnp.float32):
+        T = self._out_len()
+        fan = self.kernelSize * self.nIn
+        p = {"W": _winit.init(self.weightInit or "XAVIER", key,
+                              (T, self.kernelSize * self.nIn, self.nOut),
+                              fan, self.nOut, dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((T, self.nOut), self.biasInit or 0.0, dtype)
+        return p
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        T = self._out_len()
+        k, s = self.kernelSize, self.stride
+        # patches (B, T_out, k*C): stacked strided windows
+        patches = jnp.stack([x[:, t * s:t * s + k].reshape(x.shape[0], -1)
+                             for t in range(T)], axis=1)
+        z = jnp.einsum("btk,tko->bto", patches, params["W"])
+        if self.hasBias:
+            z = z + params["b"][None]
+        return self._activate(z), state
+
+
+@dataclass
+class LocallyConnected2D(FeedForwardLayer):
+    """Conv2D with UNSHARED weights per output position (ref:
+    conf.layers.LocallyConnected2D). NCHW; requires fixed inputSize."""
+    spatial_input = True
+    kernelSize: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (1, 1)
+    inputSize: Tuple[int, int] = (0, 0)  # (H, W)
+    hasBias: bool = True
+
+    def set_n_in(self, input_type: InputType):
+        if not self.nIn:
+            self.nIn = input_type.channels
+        if not self.inputSize[0]:
+            self.inputSize = (input_type.height, input_type.width)
+
+    def _out_hw(self):
+        k, s = _pair(self.kernelSize), _pair(self.stride)
+        return ((self.inputSize[0] - k[0]) // s[0] + 1,
+                (self.inputSize[1] - k[1]) // s[1] + 1)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        h, w = self._out_hw()
+        return InputType.convolutional(h, w, self.nOut)
+
+    def init_params(self, key, dtype=jnp.float32):
+        h, w = self._out_hw()
+        k = _pair(self.kernelSize)
+        fan = k[0] * k[1] * self.nIn
+        p = {"W": _winit.init(self.weightInit or "XAVIER", key,
+                              (h * w, k[0] * k[1] * self.nIn, self.nOut),
+                              fan, self.nOut, dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((h * w, self.nOut), self.biasInit or 0.0, dtype)
+        return p
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        B = x.shape[0]
+        h, w = self._out_hw()
+        k, s = _pair(self.kernelSize), _pair(self.stride)
+        # im2col patches (B, H_out*W_out, k*k*C) via XLA's patch extraction
+        patches = lax.conv_general_dilated_patches(
+            x, filter_shape=k, window_strides=s, padding="VALID")
+        patches = patches.reshape(B, patches.shape[1], h * w).transpose(0, 2, 1)
+        z = jnp.einsum("bpk,pko->bpo", patches, params["W"])
+        if self.hasBias:
+            z = z + params["b"][None]
+        z = z.transpose(0, 2, 1).reshape(B, self.nOut, h, w)
+        return self._activate(z), state
+
+
+# --------------------------------------------------------------- autoencoders
+
+
+@dataclass
+class AutoEncoder(FeedForwardLayer):
+    """Denoising autoencoder (ref: conf.layers.AutoEncoder — pretrain via
+    corrupted-input reconstruction; supervised forward = encoder only)."""
+    corruptionLevel: float = 0.3
+    sparsity: float = 0.0
+    lossFunction: str = "MSE"
+
+    def init_params(self, key, dtype=jnp.float32):
+        kW, _ = jax.random.split(key)
+        return {"W": _winit.init(self.weightInit or "XAVIER", kW, (self.nIn, self.nOut),
+                                 self.nIn, self.nOut, dtype),
+                "b": jnp.full((self.nOut,), self.biasInit or 0.0, dtype),
+                "vb": jnp.zeros((self.nIn,), dtype)}  # visible bias (decoder)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return self._activate(jnp.matmul(x, params["W"]) + params["b"]), state
+
+    def pretrain_loss(self, params, x, rng):
+        """Reconstruction loss on corrupted input (ref: AutoEncoder.computeGradientAndScore)."""
+        xc = x
+        if self.corruptionLevel > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruptionLevel, x.shape)
+            xc = x * keep.astype(x.dtype)
+        h = self._activate(jnp.matmul(xc, params["W"]) + params["b"])
+        recon = jnp.matmul(h, params["W"].T) + params["vb"]  # tied weights
+        loss = jnp.mean((recon - x) ** 2) if self.lossFunction == "MSE" else \
+            _losses.get(self.lossFunction)(x, recon, None)
+        if self.sparsity > 0:
+            loss = loss + self.sparsity * jnp.mean(jnp.abs(h))
+        return loss
+
+
+@dataclass
+class VariationalAutoencoder(FeedForwardLayer):
+    """VAE (ref: conf.layers.variational.VariationalAutoencoder + runtime
+    nn.layers.variational.VariationalAutoencoder). Pretrain = ELBO with a
+    Gaussian q(z|x) (reparameterization) and a Gaussian reconstruction
+    distribution; supervised forward = mean of q(z|x) (ref: VAE forward uses
+    the mean vector)."""
+    encoderLayerSizes: Tuple[int, ...] = (100,)
+    decoderLayerSizes: Tuple[int, ...] = (100,)
+    pzxActivationFunction: str = "IDENTITY"
+    numSamples: int = 1
+    reconstructionDistribution: str = "GAUSSIAN"  # GAUSSIAN | BERNOULLI
+
+    def init_params(self, key, dtype=jnp.float32):
+        wi = self.weightInit or "XAVIER"
+        sizes_e = (self.nIn,) + tuple(self.encoderLayerSizes)
+        sizes_d = (self.nOut,) + tuple(self.decoderLayerSizes)
+        ks = jax.random.split(key, len(sizes_e) + len(sizes_d) + 2)
+        ki = iter(range(len(ks)))
+        p = {"enc": [], "dec": []}
+        for i in range(len(sizes_e) - 1):
+            p["enc"].append({
+                "W": _winit.init(wi, ks[next(ki)], (sizes_e[i], sizes_e[i + 1]),
+                                 sizes_e[i], sizes_e[i + 1], dtype),
+                "b": jnp.zeros((sizes_e[i + 1],), dtype)})
+        eh = sizes_e[-1]
+        p["zMean"] = {"W": _winit.init(wi, ks[next(ki)], (eh, self.nOut), eh, self.nOut, dtype),
+                      "b": jnp.zeros((self.nOut,), dtype)}
+        p["zLogStd"] = {"W": _winit.init(wi, ks[next(ki)], (eh, self.nOut), eh, self.nOut, dtype),
+                        "b": jnp.zeros((self.nOut,), dtype)}
+        for i in range(len(sizes_d) - 1):
+            p["dec"].append({
+                "W": _winit.init(wi, ks[next(ki)], (sizes_d[i], sizes_d[i + 1]),
+                                 sizes_d[i], sizes_d[i + 1], dtype),
+                "b": jnp.zeros((sizes_d[i + 1],), dtype)})
+        dh = sizes_d[-1]
+        out_mult = 2 if self.reconstructionDistribution == "GAUSSIAN" else 1
+        p["xOut"] = {"W": _winit.init(wi, ks[-1], (dh, self.nIn * out_mult),
+                                      dh, self.nIn * out_mult, dtype),
+                     "b": jnp.zeros((self.nIn * out_mult,), dtype)}
+        return p
+
+    def regularizable(self):
+        return ()
+
+    def _encode(self, params, x):
+        h = x
+        for lay in params["enc"]:
+            h = self._activate(jnp.matmul(h, lay["W"]) + lay["b"])
+        act = _act.get(self.pzxActivationFunction)
+        mean = act(jnp.matmul(h, params["zMean"]["W"]) + params["zMean"]["b"])
+        log_std = jnp.matmul(h, params["zLogStd"]["W"]) + params["zLogStd"]["b"]
+        return mean, log_std
+
+    def _decode(self, params, z):
+        h = z
+        for lay in params["dec"]:
+            h = self._activate(jnp.matmul(h, lay["W"]) + lay["b"])
+        return jnp.matmul(h, params["xOut"]["W"]) + params["xOut"]["b"]
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        mean, _ = self._encode(params, x)
+        return mean, state
+
+    def pretrain_loss(self, params, x, rng):
+        """Negative ELBO (ref: VariationalAutoencoder.computeGradientAndScore)."""
+        mean, log_std = self._encode(params, x)
+        std = jnp.exp(log_std)
+        loss = 0.0
+        rng = rng if rng is not None else jax.random.key(0)
+        for s in range(max(self.numSamples, 1)):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mean.shape, mean.dtype)
+            z = mean + std * eps
+            out = self._decode(params, z)
+            if self.reconstructionDistribution == "GAUSSIAN":
+                xm, xls = jnp.split(out, 2, axis=-1)
+                xs = jnp.exp(xls)
+                recon = 0.5 * jnp.sum(((x - xm) / xs) ** 2 + 2 * xls
+                                      + jnp.log(2 * jnp.pi), axis=-1)
+            else:
+                recon = jnp.sum(jnp.clip(out, 0) - out * x
+                                + jnp.log1p(jnp.exp(-jnp.abs(out))), axis=-1)
+            loss = loss + jnp.mean(recon)
+        loss = loss / max(self.numSamples, 1)
+        kl = -0.5 * jnp.sum(1 + 2 * log_std - mean ** 2 - jnp.exp(2 * log_std), axis=-1)
+        return loss + jnp.mean(kl)
+
+    def reconstructionProbability(self, params, x, num_samples=5):
+        """Monte-Carlo estimate of log p(x) (ref: VAE.reconstructionLogProbability)."""
+        mean, log_std = self._encode(params, x)
+        std = jnp.exp(log_std)
+        total = 0.0
+        for s in range(num_samples):
+            eps = jax.random.normal(jax.random.fold_in(jax.random.key(7), s),
+                                    mean.shape, mean.dtype)
+            out = self._decode(params, mean + std * eps)
+            if self.reconstructionDistribution == "GAUSSIAN":
+                xm, xls = jnp.split(out, 2, axis=-1)
+                xs = jnp.exp(xls)
+                lp = -0.5 * jnp.sum(((x - xm) / xs) ** 2 + 2 * xls
+                                    + jnp.log(2 * jnp.pi), axis=-1)
+            else:
+                lp = jnp.sum(x * jax.nn.log_sigmoid(out)
+                             + (1 - x) * jax.nn.log_sigmoid(-out), axis=-1)
+            total = total + lp
+        return total / num_samples
+
+
+# ------------------------------------------------------- special output layers
+
+
+@dataclass
+class CenterLossOutputLayer(BaseOutputLayer):
+    """Softmax + center loss (ref: conf.layers.CenterLossOutputLayer).
+    Centers are parameters minimized by the center-loss term itself (the
+    reference updates them with an EMA of rate alpha; SGD on the same
+    objective is the jit-native equivalent — gradientCheck=true for them)."""
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init_params(self, key, dtype=jnp.float32):
+        p = super().init_params(key, dtype)
+        p["centers"] = jnp.zeros((self.nOut, self.nIn), dtype)
+        return p
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        out, _ = super().apply(params, x, training=training, rng=rng, state=state)
+        # capture features for the center term (read by compute_loss_ext)
+        return out, {"features": x}
+
+    def compute_loss_ext(self, params, labels, output, features, mask=None):
+        base = _losses.get(self.lossFunction)(labels, output, mask)
+        y = jnp.argmax(labels, axis=-1)
+        centers = params["centers"][y]
+        center = 0.5 * jnp.mean(jnp.sum((features - centers) ** 2, axis=-1))
+        return base + self.lambda_ * center
+
+
+@dataclass
+class OCNNOutputLayer(BaseOutputLayer):
+    """One-class neural network output (ref: conf.ocnn.OCNNOutputLayer —
+    anomaly scoring with the one-class SVM-style objective of Chalapathy et
+    al.; hiddenSize V, output w·g(Vx), loss hinge around r)."""
+    hiddenSize: int = 10
+    nu: float = 0.04
+    initialRValue: float = 0.1
+
+    def __post_init__(self):
+        self.nOut = 1
+        if self.activation is None:
+            self.activation = "IDENTITY"
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        wi = self.weightInit or "XAVIER"
+        return {"V": _winit.init(wi, k1, (self.nIn, self.hiddenSize),
+                                 self.nIn, self.hiddenSize, dtype),
+                "W": _winit.init(wi, k2, (self.hiddenSize, 1), self.hiddenSize, 1, dtype),
+                "r": jnp.asarray(self.initialRValue, dtype)}
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        h = jax.nn.sigmoid(jnp.matmul(x, params["V"]))
+        return jnp.matmul(h, params["W"]) - params["r"], state
+
+    def compute_loss(self, labels, output, mask=None):
+        # one-class: labels unused; hinge on the decision value
+        return jnp.mean(jnp.maximum(0.0, -output)) / self.nu + jnp.mean(output) * 0
+
+
+@dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 detection output + loss (ref: conf.layers.objdetect.
+    Yolo2OutputLayer + nn.layers.objdetect.Yolo2OutputLayer). Input NCHW
+    (B, A*(5+C), H, W); labels (B, 4+C, H, W) grid format as the reference's
+    ObjectDetectionRecordReader emits. Anchors are in grid units."""
+    boundingBoxes: Tuple = ()          # ((w,h), ...) anchor priors
+    lambdaCoord: float = 5.0
+    lambdaNoObj: float = 0.5
+
+    def regularizable(self):
+        return ()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return x, state
+
+    def _split_predictions(self, x):
+        A = len(self.boundingBoxes)
+        B, _, H, W = x.shape
+        C = x.shape[1] // A - 5
+        x = x.reshape(B, A, 5 + C, H, W)
+        # sigmoid xy offsets within cell, exp wh scaled by anchors, sigmoid conf
+        xy = jax.nn.sigmoid(x[:, :, 0:2])
+        anchors = jnp.asarray(self.boundingBoxes, x.dtype)  # (A,2)
+        wh = jnp.exp(x[:, :, 2:4]) * anchors[None, :, :, None, None]
+        conf = jax.nn.sigmoid(x[:, :, 4])
+        cls = jax.nn.softmax(x[:, :, 5:], axis=2)
+        return xy, wh, conf, cls
+
+    def compute_loss(self, labels, output, mask=None):
+        """Grid-matched YOLOv2 loss. labels (B, 4+C, H, W): tx,ty,tw,th in
+        grid units + one-hot class; cells without an object have all-zero
+        class vector."""
+        xy, wh, conf, cls = self._split_predictions(output)
+        lab_xy = labels[:, 0:2]                      # (B,2,H,W) cell offsets
+        lab_wh = labels[:, 2:4]
+        lab_cls = labels[:, 4:]                      # (B,C,H,W) one-hot
+        obj = (jnp.sum(lab_cls, axis=1, keepdims=True) > 0)[:, 0]  # (B,H,W)
+        # responsibility: anchor with best IOU against the label box
+        inter = jnp.minimum(wh[:, :, 0], lab_wh[:, None, 0]) * \
+            jnp.minimum(wh[:, :, 1], lab_wh[:, None, 1])
+        union = wh[:, :, 0] * wh[:, :, 1] + \
+            lab_wh[:, None, 0] * lab_wh[:, None, 1] - inter
+        iou = inter / jnp.maximum(union, 1e-6)       # (B,A,H,W)
+        resp = jax.nn.one_hot(jnp.argmax(iou, axis=1), iou.shape[1], axis=1)
+        resp = resp * obj[:, None]
+        coord = jnp.sum(resp[:, :, None] * (
+            (xy - lab_xy[:, None]) ** 2 +
+            (jnp.sqrt(wh) - jnp.sqrt(jnp.maximum(lab_wh[:, None], 1e-8))) ** 2))
+        conf_obj = jnp.sum(resp * (conf - iou) ** 2)
+        conf_noobj = jnp.sum((1 - resp) * conf ** 2)
+        cls_loss = jnp.sum(resp[:, :, None] * (cls - lab_cls[:, None]) ** 2)
+        B = output.shape[0]
+        return (self.lambdaCoord * coord + conf_obj
+                + self.lambdaNoObj * conf_noobj + cls_loss) / B
+
+    def getPredictedObjects(self, output, threshold=0.5):
+        """Decode detections (ref: YoloUtils.getPredictedObjects): returns a
+        list per batch item of (x1, y1, x2, y2, conf, class) in grid units."""
+        import numpy as np
+        xy, wh, conf, cls = self._split_predictions(jnp.asarray(output))
+        xy, wh, conf, cls = map(np.asarray, (xy, wh, conf, cls))
+        B, A, H, W = conf.shape
+        gy, gx = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+        results = []
+        for b in range(B):
+            dets = []
+            for a in range(A):
+                score = conf[b, a] * cls[b, a].max(axis=0)
+                for (i, j) in zip(*np.nonzero(score > threshold)):
+                    cx = gx[i, j] + xy[b, a, 0, i, j]
+                    cy = gy[i, j] + xy[b, a, 1, i, j]
+                    w_, h_ = wh[b, a, 0, i, j], wh[b, a, 1, i, j]
+                    dets.append((cx - w_ / 2, cy - h_ / 2, cx + w_ / 2, cy + h_ / 2,
+                                 float(conf[b, a, i, j]),
+                                 int(cls[b, a, :, i, j].argmax())))
+            results.append(dets)
+        return results
+
+
+# ------------------------------------------------------------ recurrent extras
+
+
+@dataclass
+class GravesBidirectionalLSTM(BaseRecurrentLayer):
+    """Bidirectional peephole LSTM as ONE layer (ref: conf.layers.
+    GravesBidirectionalLSTM — forward and backward passes each produce nOut
+    and are combined additively, so output size stays nOut)."""
+    forgetGateBiasInit: float = 1.0
+
+    def _half(self) -> GravesLSTM:
+        return GravesLSTM(nIn=self.nIn, nOut=self.nOut, activation=self.activation,
+                          weightInit=self.weightInit,
+                          forgetGateBiasInit=self.forgetGateBiasInit,
+                          rnnDataFormat="NWC")
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        half = self._half()
+        return {"fwd": half.init_params(k1, dtype), "bwd": half.init_params(k2, dtype)}
+
+    def regularizable(self):
+        return ()
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None):
+        half = self._half()
+        x = self._to_nwc(x)
+        rs = half.init_rnn_state(x.shape[0], x.dtype)
+        yf, _ = half.apply_rnn(params["fwd"], x, rs, mask=mask)
+        xr = jnp.flip(x, axis=1)
+        mr = jnp.flip(mask, axis=1) if mask is not None else None
+        yb, _ = half.apply_rnn(params["bwd"], xr, rs, mask=mr)
+        return self._from_nwc(yf + jnp.flip(yb, axis=1)), state
+
+
+@dataclass
+class LearnedSelfAttentionLayer(BaseRecurrentLayer):
+    """Attention with LEARNED queries (ref: conf.layers.LearnedSelfAttentionLayer):
+    nQueries fixed learned query vectors attend over the sequence, output
+    (B, nQueries, nOut)."""
+    nHeads: int = 1
+    nQueries: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.nOut, self.nQueries)
+
+    def init_params(self, key, dtype=jnp.float32):
+        ks = jax.random.split(key, 5)
+        wi = self.weightInit or "XAVIER"
+        D, O = self.nIn, self.nOut
+        return {"Q": _winit.init(wi, ks[0], (self.nQueries, O), O, O, dtype),
+                "Wk": _winit.init(wi, ks[1], (D, O), D, O, dtype),
+                "Wv": _winit.init(wi, ks[2], (D, O), D, O, dtype),
+                "Wo": _winit.init(wi, ks[3], (O, O), O, O, dtype)}
+
+    def regularizable(self):
+        return ("Wk", "Wv", "Wo")
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None):
+        B = x.shape[0]
+        q = jnp.broadcast_to(params["Q"][None], (B,) + params["Q"].shape)
+        k = jnp.matmul(x, params["Wk"])
+        v = jnp.matmul(x, params["Wv"])
+        m = mask[:, None, :] if mask is not None else None
+        out = _nnops.dot_product_attention(q, k, v, mask=m)
+        return jnp.matmul(out, params["Wo"]), state
+
+
+@dataclass
+class RecurrentAttentionLayer(BaseRecurrentLayer):
+    """Recurrent cell whose input each step is attention over the full
+    sequence conditioned on the previous hidden state (ref:
+    conf.layers.RecurrentAttentionLayer, SameDiff-backed)."""
+    nHeads: int = 1
+
+    def init_params(self, key, dtype=jnp.float32):
+        ks = jax.random.split(key, 5)
+        wi = self.weightInit or "XAVIER"
+        D, O = self.nIn, self.nOut
+        return {"Wq": _winit.init(wi, ks[0], (O, O), O, O, dtype),
+                "Wk": _winit.init(wi, ks[1], (D, O), D, O, dtype),
+                "Wv": _winit.init(wi, ks[2], (D, O), D, O, dtype),
+                "W": _winit.init(wi, ks[3], (D, O), D, O, dtype),
+                "RW": _winit.init(wi, ks[4], (O, O), O, O, dtype),
+                "b": jnp.zeros((O,), dtype)}
+
+    def regularizable(self):
+        return ("Wq", "Wk", "Wv", "W", "RW")
+
+    def apply(self, params, x, *, training=False, rng=None, state=None, mask=None):
+        x = self._to_nwc(x)
+        B, T, _ = x.shape
+        keys = jnp.matmul(x, params["Wk"])          # (B,T,O)
+        vals = jnp.matmul(x, params["Wv"])
+        act = _act.get(self.activation or "TANH")
+        scale = 1.0 / math.sqrt(params["Wq"].shape[1])
+        mbias = None
+        if mask is not None:
+            mbias = jnp.where(mask > 0, 0.0, -1e9)  # (B,T)
+
+        def step(h, xt):
+            q = jnp.matmul(h, params["Wq"])         # (B,O)
+            s = jnp.einsum("bo,bto->bt", q, keys) * scale
+            if mbias is not None:
+                s = s + mbias
+            a = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bt,bto->bo", a, vals)
+            h2 = act(jnp.matmul(xt, params["W"]) + jnp.matmul(h, params["RW"])
+                     + ctx + params["b"])
+            return h2, h2
+
+        h0 = jnp.zeros((B, self.nOut), x.dtype)
+        _, ys = lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+        return self._from_nwc(jnp.swapaxes(ys, 0, 1)), state
+
+
+# ------------------------------------------------------------------- capsules
+
+
+@dataclass
+class PrimaryCapsules(Layer):
+    """Conv caps primary layer (ref: conf.layers.PrimaryCapsules): conv2d ->
+    reshape to (B, num_caps, capsuleDimensions) -> squash."""
+    capsules: int = 0               # derived if 0
+    capsuleDimensions: int = 8
+    channels: int = 32
+    kernelSize: Tuple[int, int] = (9, 9)
+    stride: Tuple[int, int] = (2, 2)
+    _nIn: int = 0
+    _hw: Tuple[int, int] = (0, 0)
+
+    def set_n_in(self, input_type: InputType):
+        self._nIn = input_type.channels
+        k, s = _pair(self.kernelSize), _pair(self.stride)
+        h = (input_type.height - k[0]) // s[0] + 1
+        w = (input_type.width - k[1]) // s[1] + 1
+        self._hw = (h, w)
+        if not self.capsules:
+            self.capsules = self.channels * h * w
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.capsuleDimensions, self.capsules)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k = _pair(self.kernelSize)
+        cout = self.channels * self.capsuleDimensions
+        fan_in = self._nIn * k[0] * k[1]
+        return {"W": _winit.init(self.weightInit or "XAVIER", key,
+                                 (cout, self._nIn, k[0], k[1]), fan_in, cout, dtype),
+                "b": jnp.zeros((cout,), dtype)}
+
+    @staticmethod
+    def _squash(s, axis=-1):
+        n2 = jnp.sum(s * s, axis=axis, keepdims=True)
+        return (n2 / (1.0 + n2)) * s / jnp.sqrt(n2 + 1e-9)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        z = _nnops.conv2d(x, params["W"], params["b"], strides=_pair(self.stride),
+                          padding="VALID")
+        B = z.shape[0]
+        z = z.reshape(B, -1, self.capsuleDimensions)
+        return self._squash(z), state
+
+
+@dataclass
+class CapsuleLayer(Layer):
+    """Dynamic-routing capsule layer (ref: conf.layers.CapsuleLayer).
+    Input (B, inputCaps, inputDims) -> (B, capsules, capsuleDimensions)."""
+    capsules: int = 10
+    capsuleDimensions: int = 16
+    routings: int = 3
+    inputCapsules: int = 0
+    inputCapsuleDimensions: int = 0
+
+    def set_n_in(self, input_type: InputType):
+        if not self.inputCapsules:
+            self.inputCapsules = input_type.timeSeriesLength
+            self.inputCapsuleDimensions = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.capsuleDimensions, self.capsules)
+
+    def init_params(self, key, dtype=jnp.float32):
+        shape = (self.inputCapsules, self.capsules,
+                 self.inputCapsuleDimensions, self.capsuleDimensions)
+        return {"W": jax.random.normal(key, shape, dtype) * 0.01}
+
+    def regularizable(self):
+        return ()
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        # prediction vectors u_hat (B, inCaps, outCaps, outDim)
+        u_hat = jnp.einsum("bid,iodk->biok", x, params["W"])
+        b = jnp.zeros(u_hat.shape[:3], x.dtype)
+        for _ in range(self.routings):
+            c = jax.nn.softmax(b, axis=2)
+            s = jnp.einsum("bio,biok->bok", c, u_hat)
+            v = PrimaryCapsules._squash(s)
+            b = b + jnp.einsum("biok,bok->bio", u_hat, v)
+        return v, state
+
+
+@dataclass
+class CapsuleStrengthLayer(Layer):
+    """Capsule norm per class (ref: conf.layers.CapsuleStrengthLayer)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feedForward(input_type.timeSeriesLength)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        return jnp.sqrt(jnp.sum(x * x, axis=-1) + 1e-9), state
+
+
 LAYER_TYPES = {c.__name__: c for c in [
     DenseLayer, EmbeddingLayer, EmbeddingSequenceLayer, ConvolutionLayer, Convolution1DLayer,
     Deconvolution2D, DepthwiseConvolution2D, SeparableConvolution2D, SubsamplingLayer,
@@ -983,4 +1855,11 @@ LAYER_TYPES = {c.__name__: c for c in [
     ActivationLayer, Upsampling2D, ZeroPaddingLayer, Cropping2D, GlobalPoolingLayer,
     LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional, LastTimeStep,
     OutputLayer, RnnOutputLayer, LossLayer, SelfAttentionLayer,
+    PReLULayer, ElementWiseMultiplicationLayer, MaskZeroLayer, SpaceToDepthLayer,
+    Upsampling1D, Upsampling3D, Cropping1D, Cropping3D, ZeroPadding1DLayer,
+    ZeroPadding3DLayer, Convolution3D, Subsampling3DLayer, LocallyConnected1D,
+    LocallyConnected2D, AutoEncoder, VariationalAutoencoder, CenterLossOutputLayer,
+    OCNNOutputLayer, Yolo2OutputLayer, GravesBidirectionalLSTM,
+    LearnedSelfAttentionLayer, RecurrentAttentionLayer,
+    PrimaryCapsules, CapsuleLayer, CapsuleStrengthLayer,
 ]}

@@ -135,13 +135,11 @@ class MultiLayerNetwork:
         new_states, new_rnn = [], []
         n = len(self.layers)
         rngs = jax.random.split(rng, n) if rng is not None else [None] * n
+        from deeplearning4j_tpu.nn.conf.layers import needs_flatten
         for i, layer in enumerate(self.layers):
-            # preprocessor-equivalent: flatten NCHW into (B, C*H*W) for FF layers
-            if x.ndim == 4 and isinstance(layer, FeedForwardLayer) and not isinstance(
-                    layer, (ConvolutionLayer, BaseRecurrentLayer)):
-                from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
-                if not isinstance(layer, BatchNormalization):
-                    x = x.reshape(x.shape[0], -1)
+            # preprocessor-equivalent: flatten NCHW/NCDHW into (B, -1) for FF layers
+            if needs_flatten(layer, x.ndim):
+                x = x.reshape(x.shape[0], -1)
             # dl4j conf-level dropout: applied to the layer INPUT during training
             if training and layer.dropOut is not None and not isinstance(layer, _DropoutLike):
                 keep = layer.dropOut
@@ -169,7 +167,11 @@ class MultiLayerNetwork:
     def _loss_for(self, params, state, x, y, rng, fmask, lmask):
         out, new_states, _ = self._forward(params, state, x, training=True, rng=rng, mask=fmask)
         out_layer = self.layers[-1]
-        if isinstance(out_layer, (BaseOutputLayer, LossLayer)):
+        from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer
+        if isinstance(out_layer, CenterLossOutputLayer):
+            loss = out_layer.compute_loss_ext(params[-1], y, out,
+                                              new_states[-1]["features"], lmask)
+        elif hasattr(out_layer, "compute_loss"):  # output/loss/yolo layers
             loss = out_layer.compute_loss(y, out, lmask if lmask is not None else
                                           (fmask if isinstance(out_layer, RnnOutputLayer) else None))
         else:
@@ -237,7 +239,7 @@ class MultiLayerNetwork:
             out, new_states, new_rnn = self._forward(
                 params, state, x, rnn_states=rnn_states, training=True, rng=rng, mask=fmask)
             out_layer = self.layers[-1]
-            if isinstance(out_layer, (BaseOutputLayer, LossLayer)):
+            if hasattr(out_layer, "compute_loss"):
                 loss = out_layer.compute_loss(y, out, lmask if lmask is not None else
                                               (fmask if isinstance(out_layer, RnnOutputLayer) else None))
             else:
@@ -330,6 +332,61 @@ class MultiLayerNetwork:
         st = getattr(self, "_stream_rnn", None)
         return {} if st is None else {k: NDArray(v) for k, v in st[layer_idx].items()}
 
+    # ------------------------------------------------------------- pretrain
+    def pretrainLayer(self, layer_idx: int, data, epochs: int = 1):
+        """Layer-wise unsupervised pretraining for AutoEncoder/VAE layers
+        (ref: MultiLayerNetwork.pretrainLayer): features forward through the
+        preceding layers (inference), then the layer's pretrain_loss is
+        minimized — feature extraction + loss + update in ONE jitted step."""
+        from deeplearning4j_tpu.data.dataset import DataSet, ListDataSetIterator
+        layer = self.layers[layer_idx]
+        if not hasattr(layer, "pretrain_loss"):
+            return self  # non-pretrainable layers are skipped (ref behavior)
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+
+        key = ("pretrain", layer_idx)
+        if key not in self._jit_cache:
+            tx = self.conf.updater.to_optax()
+
+            def step(lp, all_params, state, opt_state, x, rng):
+                from deeplearning4j_tpu.nn.conf.layers import needs_flatten
+                feats = self._adapt_input(x)
+                for i in range(layer_idx):
+                    if needs_flatten(self.layers[i], feats.ndim):
+                        feats = feats.reshape(feats.shape[0], -1)
+                    feats, _ = self.layers[i].apply(
+                        all_params[i], feats, training=False,
+                        state=state[i] if state[i] else None)
+                loss, g = jax.value_and_grad(layer.pretrain_loss)(lp, feats, rng)
+                updates, opt_state = tx.update(g, opt_state, lp)
+                return optax.apply_updates(lp, updates), opt_state, loss
+
+            # no donation: lp aliases all_params[layer_idx] in the call
+            self._jit_cache[key] = (jax.jit(step), tx)
+        step, tx = self._jit_cache[key]
+        lp = self._params[layer_idx]
+        opt_state = tx.init(lp)
+        for _ in range(epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                lp, opt_state, loss = step(lp, self._params, self._state,
+                                           opt_state, _as_jnp(ds.features), sub)
+                self._score = float(loss)
+                self._iteration += 1
+        self._params = list(self._params)
+        self._params[layer_idx] = lp
+        return self
+
+    def pretrain(self, data, epochs: int = 1):
+        """Pretrain every pretrainable layer in order (ref: MultiLayerNetwork.
+        pretrain)."""
+        for i in range(len(self.layers)):
+            self.pretrainLayer(i, data, epochs)
+        return self
+
     # ------------------------------------------------------------------ fit
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(DataSetIterator), fit(DataSet), or fit(features, labels)
@@ -373,15 +430,13 @@ class MultiLayerNetwork:
 
     def feedForward(self, x) -> List[NDArray]:
         """Per-layer activations list, input first (ref: feedForward)."""
+        from deeplearning4j_tpu.nn.conf.layers import needs_flatten
         acts = [NDArray(_as_jnp(x))]
         xv = self._adapt_input(_as_jnp(x))
         cur = xv
         for i, layer in enumerate(self.layers):
-            if cur.ndim == 4 and isinstance(layer, FeedForwardLayer) and not isinstance(
-                    layer, (ConvolutionLayer, BaseRecurrentLayer)):
-                from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
-                if not isinstance(layer, BatchNormalization):
-                    cur = cur.reshape(cur.shape[0], -1)
+            if needs_flatten(layer, cur.ndim):
+                cur = cur.reshape(cur.shape[0], -1)
             cur, _ = layer.apply(self._params[i], cur, training=False,
                                  state=self._state[i] if self._state[i] else None)
             acts.append(NDArray(cur))

@@ -2,9 +2,10 @@
 org.deeplearning4j.zoo.model.*)."""
 from deeplearning4j_tpu.zoo.models import (
     ZooModel, LeNet, SimpleCNN, AlexNet, VGG16, VGG19, ResNet50, SqueezeNet,
-    Darknet19, UNet, Xception, TextGenerationLSTM)
+    Darknet19, UNet, Xception, TextGenerationLSTM, TinyYOLO, YOLO2)
 
 __all__ = [
     "ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19", "ResNet50",
     "SqueezeNet", "Darknet19", "UNet", "Xception", "TextGenerationLSTM",
+    "TinyYOLO", "YOLO2",
 ]

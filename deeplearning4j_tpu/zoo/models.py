@@ -435,3 +435,91 @@ class TextGenerationLSTM(ZooModel):
                 .backpropType("TruncatedBPTT").tBPTTForwardLength(50)
                 .tBPTTBackwardLength(50)
                 .build())
+
+
+class TinyYOLO(ZooModel):
+    """(ref: zoo.model.TinyYOLO — Darknet-tiny backbone + Yolo2OutputLayer;
+    default anchors from the VOC-trained reference config, grid units)."""
+
+    DEFAULT_ANCHORS = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38),
+                       (9.42, 5.11), (16.62, 10.52))
+
+    def __init__(self, numClasses: int = 20, seed: int = 123,
+                 inputShape: Tuple[int, int, int] = (3, 416, 416),
+                 boundingBoxes=None):
+        super().__init__(numClasses, seed, inputShape)
+        self.boundingBoxes = tuple(boundingBoxes or self.DEFAULT_ANCHORS)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.layers import Yolo2OutputLayer
+        c, h, w = self.inputShape
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("XAVIER").list())
+
+        def conv_bn(b, n_out):
+            return (b.layer(ConvolutionLayer(nOut=n_out, kernelSize=(3, 3),
+                                             convolutionMode="Same", hasBias=False,
+                                             activation="IDENTITY"))
+                    .layer(BatchNormalization(activation="LEAKYRELU")))
+
+        for i, n_out in enumerate([16, 32, 64, 128, 256]):
+            b = conv_bn(b, n_out)
+            b = b.layer(SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2),
+                                         stride=(2, 2)))
+        b = conv_bn(b, 512)
+        b = b.layer(SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2),
+                                     stride=(1, 1), convolutionMode="Same"))
+        b = conv_bn(b, 1024)
+        A = len(self.boundingBoxes)
+        return (b.layer(ConvolutionLayer(nOut=A * (5 + self.numClasses),
+                                         kernelSize=(1, 1), activation="IDENTITY"))
+                .layer(Yolo2OutputLayer(boundingBoxes=self.boundingBoxes))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+
+class YOLO2(ZooModel):
+    """(ref: zoo.model.YOLO2 — Darknet19 backbone + Yolo2OutputLayer; the
+    reference's passthrough reorg layer is realized with SpaceToDepth)."""
+
+    DEFAULT_ANCHORS = ((0.57273, 0.677385), (1.87446, 2.06253),
+                       (3.33843, 5.47434), (7.88282, 3.52778),
+                       (9.77052, 9.16828))
+
+    def __init__(self, numClasses: int = 80, seed: int = 123,
+                 inputShape: Tuple[int, int, int] = (3, 608, 608),
+                 boundingBoxes=None):
+        super().__init__(numClasses, seed, inputShape)
+        self.boundingBoxes = tuple(boundingBoxes or self.DEFAULT_ANCHORS)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.layers import Yolo2OutputLayer
+        c, h, w = self.inputShape
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("XAVIER").list())
+
+        def conv_bn(b, n_out, k):
+            return (b.layer(ConvolutionLayer(nOut=n_out, kernelSize=(k, k),
+                                             convolutionMode="Same", hasBias=False,
+                                             activation="IDENTITY"))
+                    .layer(BatchNormalization(activation="LEAKYRELU")))
+
+        spec = [(32, 3, True), (64, 3, True),
+                (128, 3, False), (64, 1, False), (128, 3, True),
+                (256, 3, False), (128, 1, False), (256, 3, True),
+                (512, 3, False), (256, 1, False), (512, 3, False), (256, 1, False),
+                (512, 3, True),
+                (1024, 3, False), (512, 1, False), (1024, 3, False),
+                (512, 1, False), (1024, 3, False),
+                (1024, 3, False), (1024, 3, False)]
+        for n_out, k, pool in spec:
+            b = conv_bn(b, n_out, k)
+            if pool:
+                b = b.layer(SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2),
+                                             stride=(2, 2)))
+        A = len(self.boundingBoxes)
+        return (b.layer(ConvolutionLayer(nOut=A * (5 + self.numClasses),
+                                         kernelSize=(1, 1), activation="IDENTITY"))
+                .layer(Yolo2OutputLayer(boundingBoxes=self.boundingBoxes))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
