@@ -114,7 +114,15 @@ class ComputationGraph:
             layer = self._by_name[out_name].op
             y = labels[i]
             lm = lmasks[i] if lmasks is not None else None
-            if isinstance(layer, (BaseOutputLayer, LossLayer)):
+            from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer
+            if isinstance(layer, CenterLossOutputLayer):
+                loss = loss + layer.compute_loss_ext(
+                    params.get(out_name, {}), y, acts[out_name],
+                    new_state[out_name]["features"], lm)
+            elif hasattr(layer, "loss_with_params"):
+                loss = loss + layer.loss_with_params(
+                    params.get(out_name, {}), y, acts[out_name], lm)
+            elif isinstance(layer, (BaseOutputLayer, LossLayer)):
                 loss = loss + layer.compute_loss(y, acts[out_name], lm)
             else:
                 loss = loss + jnp.mean((acts[out_name] - y) ** 2)
@@ -127,7 +135,9 @@ class ComputationGraph:
         return loss, new_state
 
     # ----------------------------------------------------------- jitted fns
-    def _build_step(self):
+    def _build_step(self, with_stats: bool = False):
+        """See MultiLayerNetwork._build_step — same contract; ``with_stats``
+        also returns grad + update trees for StatsListener/panic listeners."""
         conf = self.conf
 
         frozen = {n.name for n in self._order if getattr(n.op, "frozen", False)}
@@ -147,10 +157,17 @@ class ComputationGraph:
                                 conf.gradientNormalizationThreshold)
             updates, opt_state = self._tx.update(grads, opt_state, params)
             updates = zero_frozen(updates)  # AdamW decay must not touch frozen params
-            params = optax.apply_updates(params, updates)
-            return params, new_state, opt_state, loss
+            new_params = optax.apply_updates(params, updates)
+            if with_stats:
+                return new_params, new_state, opt_state, loss, grads, updates
+            return new_params, new_state, opt_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 2))
+        return jax.jit(step, donate_argnums=() if with_stats else (0, 2))
+
+    def _stats_requested(self) -> bool:
+        return any(getattr(l, "requiresGradients", False)
+                   or getattr(l, "requiresUpdates", False)
+                   for l in self.listeners)
 
     def _build_infer(self):
         def infer(params, state, inputs, fmasks):
@@ -162,7 +179,9 @@ class ComputationGraph:
 
     def _get_jitted(self, kind):
         if kind not in self._jit_cache:
-            self._jit_cache[kind] = self._build_step() if kind == "step" else self._build_infer()
+            builders = {"step": self._build_step, "infer": self._build_infer,
+                        "step_stats": lambda: self._build_step(with_stats=True)}
+            self._jit_cache[kind] = builders[kind]()
         return self._jit_cache[kind]
 
     # ------------------------------------------------------------------ fit
@@ -177,7 +196,8 @@ class ComputationGraph:
             data = [data.toMultiDataSet()]
         elif isinstance(data, MultiDataSet):
             data = [data]
-        step = self._get_jitted("step")
+        stats = self._stats_requested()
+        step = self._get_jitted("step_stats" if stats else "step")
         for _ in range(epochs):
             for ds in data:
                 mds = ds.toMultiDataSet() if isinstance(ds, DataSet) else ds
@@ -192,9 +212,15 @@ class ComputationGraph:
                                              mds.features_masks or [])
                           if m is not None} or None
                 self._rng_key, sub = jax.random.split(self._rng_key)
-                self._params, self._state, self._opt_state, loss = step(
-                    self._params, self._state, self._opt_state, inputs, ys, sub,
-                    lmasks, fmasks)
+                if stats:
+                    (self._params, self._state, self._opt_state, loss,
+                     self._last_grads, self._last_updates) = step(
+                        self._params, self._state, self._opt_state, inputs, ys, sub,
+                        lmasks, fmasks)
+                else:
+                    self._params, self._state, self._opt_state, loss = step(
+                        self._params, self._state, self._opt_state, inputs, ys, sub,
+                        lmasks, fmasks)
                 self._score = float(loss)
                 self._iteration += 1
                 for lst in self.listeners:

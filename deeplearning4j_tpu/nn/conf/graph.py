@@ -30,6 +30,11 @@ class GraphVertex:
     def output_type(self, input_types: List[InputType]) -> InputType:
         return input_types[0]
 
+    def regularizable(self):
+        """Param keys subject to l1/l2 — vertices default to none (parameterized
+        subclasses like AttentionVertex opt in by overriding)."""
+        return ()
+
     def to_dict(self) -> dict:
         out = {"@type": type(self).__name__}
         out.update({k: (list(v) if isinstance(v, tuple) else v)
@@ -185,14 +190,14 @@ class DotProductAttentionVertex(GraphVertex):
     scale: Optional[float] = None
 
     def apply(self, inputs, *, training=False, rng=None):
-        import math as _math
+        from deeplearning4j_tpu.ops.nn_defs import dot_product_attention
         q, k, v = inputs[0], inputs[1], inputs[2]
-        scale = self.scale if self.scale is not None else 1.0 / _math.sqrt(q.shape[-1])
-        s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+        if self.scale is not None:
+            q = q * (self.scale * jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype)))
+        mask = None
         if len(inputs) > 3 and inputs[3] is not None:
-            s = s + jnp.where(inputs[3][:, None, :] > 0, 0.0, -1e9)
-        a = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bqk,bkd->bqd", a, v)
+            mask = inputs[3][:, None, :] > 0  # key mask -> (B, 1, Tk)
+        return dot_product_attention(q, k, v, mask=mask)
 
     def output_type(self, input_types):
         q, v = input_types[0], input_types[2]

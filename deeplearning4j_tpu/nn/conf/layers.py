@@ -1245,7 +1245,12 @@ class Subsampling3DLayer(Layer):
 
     def apply(self, params, x, *, training=False, rng=None, state=None):
         pad = "SAME" if self.convolutionMode == "Same" else "VALID"
-        fn = _nnops.max_pool3d if self.poolingType == "MAX" else _nnops.avg_pool3d
+        if self.poolingType == "MAX":
+            fn = _nnops.max_pool3d
+        elif self.poolingType == "AVG":
+            fn = _nnops.avg_pool3d
+        else:
+            raise ValueError(f"unsupported 3D poolingType: {self.poolingType}")
         return fn(x, _triple(self.kernelSize), _triple(self.stride), pad), state
 
 
@@ -1285,11 +1290,12 @@ class LocallyConnected1D(FeedForwardLayer):
         return p
 
     def apply(self, params, x, *, training=False, rng=None, state=None):
-        T = self._out_len()
-        k, s = self.kernelSize, self.stride
-        # patches (B, T_out, k*C): stacked strided windows
-        patches = jnp.stack([x[:, t * s:t * s + k].reshape(x.shape[0], -1)
-                             for t in range(T)], axis=1)
+        # im2col via XLA's patch primitive — one fused op instead of T_out
+        # strided slices (which would grow the jaxpr linearly in T)
+        patches = lax.conv_general_dilated_patches(
+            x.transpose(0, 2, 1), filter_shape=(self.kernelSize,),
+            window_strides=(self.stride,), padding="VALID")  # (B, C*k, T_out)
+        patches = patches.transpose(0, 2, 1)  # (B, T_out, C*k)
         z = jnp.einsum("btk,tko->bto", patches, params["W"])
         if self.hasBias:
             z = z + params["b"][None]
@@ -1548,8 +1554,15 @@ class OCNNOutputLayer(BaseOutputLayer):
         return jnp.matmul(h, params["W"]) - params["r"], state
 
     def compute_loss(self, labels, output, mask=None):
-        # one-class: labels unused; hinge on the decision value
-        return jnp.mean(jnp.maximum(0.0, -output)) / self.nu + jnp.mean(output) * 0
+        # one-class hinge only (no access to r here); prefer loss_with_params
+        return jnp.mean(jnp.maximum(0.0, -output)) / self.nu
+
+    def loss_with_params(self, params, labels, output, mask=None):
+        """Full one-class objective (Chalapathy et al.): labels unused;
+        (1/nu)·mean(max(0, r − score)) − r, with output = score − r. The −r
+        term drives the boundary up; without it r only ever shrinks and
+        training stalls at a degenerate zero-loss point."""
+        return jnp.mean(jnp.maximum(0.0, -output)) / self.nu - params["r"]
 
 
 @dataclass

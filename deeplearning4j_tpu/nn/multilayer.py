@@ -171,6 +171,8 @@ class MultiLayerNetwork:
         if isinstance(out_layer, CenterLossOutputLayer):
             loss = out_layer.compute_loss_ext(params[-1], y, out,
                                               new_states[-1]["features"], lmask)
+        elif hasattr(out_layer, "loss_with_params"):  # OCNN: loss needs own params
+            loss = out_layer.loss_with_params(params[-1], y, out, lmask)
         elif hasattr(out_layer, "compute_loss"):  # output/loss/yolo layers
             loss = out_layer.compute_loss(y, out, lmask if lmask is not None else
                                           (fmask if isinstance(out_layer, RnnOutputLayer) else None))
@@ -184,7 +186,12 @@ class MultiLayerNetwork:
                         loss = loss + reg.penalty(params[i][k])
         return loss, new_states
 
-    def _build_step(self):
+    def _build_step(self, with_stats: bool = False):
+        """One XLA executable: grad → clip → update. ``with_stats`` variants
+        also return the gradient and applied-update trees for listeners
+        advertising requiresGradients/requiresUpdates (StatsListener,
+        panic-mode ProfilingListener); params are then NOT donated since the
+        returned trees alias them."""
         conf = self.conf
 
         frozen = [getattr(l, "frozen", False) for l in self.layers]
@@ -200,10 +207,17 @@ class MultiLayerNetwork:
             # otherwise mutate frozen params despite zero grads (ref:
             # FrozenLayer applies no update at all)
             updates = _zero_frozen(updates, frozen)
-            params = optax.apply_updates(params, updates)
-            return params, new_states, opt_state, loss
+            new_params = optax.apply_updates(params, updates)
+            if with_stats:
+                return new_params, new_states, opt_state, loss, grads, updates
+            return new_params, new_states, opt_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 2))
+        return jax.jit(step, donate_argnums=() if with_stats else (0, 2))
+
+    def _stats_requested(self) -> bool:
+        return any(getattr(l, "requiresGradients", False)
+                   or getattr(l, "requiresUpdates", False)
+                   for l in self.listeners)
 
     def _build_infer(self):
         def infer(params, state, x, fmask):
@@ -214,7 +228,9 @@ class MultiLayerNetwork:
 
     def _get_jitted(self, kind):
         if kind not in self._jit_cache:
-            self._jit_cache[kind] = self._build_step() if kind == "step" else self._build_infer()
+            builders = {"step": self._build_step, "infer": self._build_infer,
+                        "step_stats": lambda: self._build_step(with_stats=True)}
+            self._jit_cache[kind] = builders[kind]()
         return self._jit_cache[kind]
 
     # ---------------------------------------------- rnn state (tBPTT/stream)
@@ -239,6 +255,11 @@ class MultiLayerNetwork:
             out, new_states, new_rnn = self._forward(
                 params, state, x, rnn_states=rnn_states, training=True, rng=rng, mask=fmask)
             out_layer = self.layers[-1]
+            if hasattr(out_layer, "compute_loss_ext") or hasattr(out_layer, "loss_with_params"):
+                # center-loss/OCNN heads have no tBPTT semantics in the
+                # reference either — refuse rather than silently drop terms
+                raise NotImplementedError(
+                    f"{type(out_layer).__name__} is not supported under TruncatedBPTT")
             if hasattr(out_layer, "compute_loss"):
                 loss = out_layer.compute_loss(y, out, lmask if lmask is not None else
                                               (fmask if isinstance(out_layer, RnnOutputLayer) else None))
@@ -396,7 +417,9 @@ class MultiLayerNetwork:
         elif isinstance(data, DataSet):
             data = ListDataSetIterator([data])
         tbptt = self.conf.backpropType == "TruncatedBPTT"
-        step = None if tbptt else self._get_jitted("step")
+        stats = self._stats_requested()
+        kind = "step_stats" if stats else "step"
+        step = None if tbptt else self._get_jitted(kind)
         for _ in range(epochs):
             for ds in data:
                 if tbptt and np.ndim(ds.features) == 3:
@@ -408,9 +431,14 @@ class MultiLayerNetwork:
                 lmask = _as_jnp(ds.labels_mask) if ds.labels_mask is not None else None
                 self._rng_key, sub = jax.random.split(self._rng_key)
                 if step is None:
-                    step = self._get_jitted("step")
-                self._params, self._state, self._opt_state, loss = step(
-                    self._params, self._state, self._opt_state, x, y, sub, fmask, lmask)
+                    step = self._get_jitted(kind)
+                if stats:
+                    (self._params, self._state, self._opt_state, loss,
+                     self._last_grads, self._last_updates) = step(
+                        self._params, self._state, self._opt_state, x, y, sub, fmask, lmask)
+                else:
+                    self._params, self._state, self._opt_state, loss = step(
+                        self._params, self._state, self._opt_state, x, y, sub, fmask, lmask)
                 self._score = float(loss)
                 self._iteration += 1
                 for lst in self.listeners:

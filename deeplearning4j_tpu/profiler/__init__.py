@@ -1,0 +1,17 @@
+from deeplearning4j_tpu.profiler.profiler import (
+    OpProfiler,
+    PanicException,
+    ProfilerConfig,
+    ProfilingListener,
+    device_trace,
+    mfu,
+)
+
+__all__ = [
+    "OpProfiler",
+    "PanicException",
+    "ProfilerConfig",
+    "ProfilingListener",
+    "device_trace",
+    "mfu",
+]

@@ -1,0 +1,222 @@
+"""Profiling + numerical-panic tooling (ref: org.nd4j.linalg.profiler.
+OpProfiler with ProfilerConfig's checkForNAN/checkForINF 'panic modes', and
+deeplearning4j's PerformanceListener timing hooks — SURVEY.md §5.1).
+
+The reference profiles per-op because each op is a discrete kernel launch.
+Under XLA a whole train step is ONE fused executable, so per-Java-op timing is
+meaningless here; the profiling unit is the **span** (a step, a data-load, an
+eval pass) plus XLA's own kernel-level profiler:
+
+- ``OpProfiler`` — named wall-clock spans, nestable, exported as a Chrome
+  trace JSON (chrome://tracing / Perfetto loadable), the TPU analog of the
+  reference's printOutDashboard().
+- ``device_trace(logdir)`` — delegates to ``jax.profiler.trace``: captures
+  XLA/TPU kernel timelines viewable in TensorBoard's profile tab (the real
+  per-kernel data the reference's OpProfiler approximates on CPU).
+- panic modes — ``ProfilerConfig(checkForNAN=True)`` makes attached
+  ``ProfilingListener``s scan score/params/grads each iteration and raise
+  ``PanicException`` on the first non-finite value (ref:
+  OpExecutionerUtil.checkForAny + ND4JOpProfilerException). Device-side
+  reduction: one jitted ``isfinite`` all-reduce per tree, no host transfer of
+  the tensors themselves.
+- ``mfu()`` — model-flops-utilization calculator used by bench.py.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+class PanicException(RuntimeError):
+    """Non-finite value detected under panic mode (ref:
+    ND4JOpProfilerException)."""
+
+
+@dataclass
+class ProfilerConfig:
+    """(ref: org.nd4j.linalg.profiler.ProfilerConfig builder)."""
+
+    checkForNAN: bool = False
+    checkForINF: bool = False
+    collectSpans: bool = True
+
+
+@dataclass
+class _Span:
+    name: str
+    start_us: float
+    dur_us: float
+    tid: int
+    args: Optional[dict] = None
+
+
+@jax.jit
+def _finite_report(leaves_stacked):
+    """all-finite / any-nan / any-inf flags for a flat f32 vector."""
+    return (jnp.all(jnp.isfinite(leaves_stacked)),
+            jnp.any(jnp.isnan(leaves_stacked)),
+            jnp.any(jnp.isinf(leaves_stacked)))
+
+
+def check_tree_finite(tree, what: str, check_nan=True, check_inf=True):
+    """Raise PanicException if any leaf of ``tree`` holds NaN (or Inf)."""
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "dtype") and jnp.issubdtype(
+                  jnp.asarray(l).dtype, jnp.inexact)]
+    if not leaves:
+        return
+    flat = jnp.concatenate([jnp.ravel(jnp.asarray(l)).astype(jnp.float32)
+                            for l in leaves])
+    ok, has_nan, has_inf = _finite_report(flat)
+    if bool(ok):
+        return
+    if check_nan and bool(has_nan):
+        raise PanicException(f"NaN detected in {what} (panic mode)")
+    if check_inf and bool(has_inf):
+        raise PanicException(f"Inf detected in {what} (panic mode)")
+
+
+class OpProfiler:
+    """Span collector with Chrome-trace export.
+
+    Use ``with profiler.span("train_step"):`` around anything; nesting is
+    expressed via Chrome trace's duration-event stacking per thread.
+    """
+
+    _instance: Optional["OpProfiler"] = None
+
+    def __init__(self, config: Optional[ProfilerConfig] = None):
+        self.config = config or ProfilerConfig()
+        self._spans: List[_Span] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    @classmethod
+    def getInstance(cls) -> "OpProfiler":
+        if cls._instance is None:
+            cls._instance = OpProfiler()
+        return cls._instance
+
+    def reset(self):
+        with self._lock:
+            self._spans = []
+            self._t0 = time.perf_counter()
+
+    @contextmanager
+    def span(self, name: str, **args):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            if self.config.collectSpans:
+                end = time.perf_counter()
+                with self._lock:
+                    self._spans.append(_Span(
+                        name=name,
+                        start_us=(start - self._t0) * 1e6,
+                        dur_us=(end - start) * 1e6,
+                        tid=threading.get_ident() % 100000,
+                        args=args or None,
+                    ))
+
+    def timeit(self, name: str, fn, *a, **kw):
+        with self.span(name):
+            return fn(*a, **kw)
+
+    @property
+    def spans(self) -> List[_Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def summary(self) -> dict:
+        """name -> {count, total_ms, mean_ms} (ref: printOutDashboard)."""
+        agg: dict = {}
+        for s in self.spans:
+            d = agg.setdefault(s.name, {"count": 0, "total_ms": 0.0})
+            d["count"] += 1
+            d["total_ms"] += s.dur_us / 1000.0
+        for d in agg.values():
+            d["mean_ms"] = d["total_ms"] / d["count"]
+        return agg
+
+    def export_chrome_trace(self, path: str) -> str:
+        events = [{"name": s.name, "ph": "X", "ts": s.start_us,
+                   "dur": s.dur_us, "pid": 1, "tid": s.tid,
+                   **({"args": s.args} if s.args else {})}
+                  for s in self.spans]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        return path
+
+
+@contextmanager
+def device_trace(logdir: str):
+    """XLA kernel-level profile → TensorBoard profile plugin
+    (jax.profiler.trace). Works on TPU and CPU backends."""
+    with jax.profiler.trace(logdir):
+        yield
+
+
+class ProfilingListener(TrainingListener):
+    """Per-iteration spans + panic checks as a listener (ref: the reference
+    enables OpProfiler globally via Nd4j environment; here it attaches to the
+    fit loop it should watch)."""
+
+    def __init__(self, profiler: Optional[OpProfiler] = None,
+                 config: Optional[ProfilerConfig] = None,
+                 checkParams: bool = True, checkGradients: bool = True):
+        self.profiler = profiler or OpProfiler.getInstance()
+        if config is not None:
+            self.profiler.config = config
+        self.checkParams = checkParams
+        self.checkGradients = checkGradients
+        self._last_t: Optional[float] = None
+
+    @property
+    def requiresGradients(self) -> bool:
+        cfg = self.profiler.config
+        return self.checkGradients and (cfg.checkForNAN or cfg.checkForINF)
+
+    def iterationDone(self, model, iteration, epoch):
+        now = time.perf_counter()
+        if self._last_t is not None and self.profiler.config.collectSpans:
+            with self.profiler._lock:
+                self.profiler._spans.append(_Span(
+                    name="iteration",
+                    start_us=(self._last_t - self.profiler._t0) * 1e6,
+                    dur_us=(now - self._last_t) * 1e6,
+                    tid=0, args={"iteration": iteration, "epoch": epoch}))
+        self._last_t = now
+
+        cfg = self.profiler.config
+        if not (cfg.checkForNAN or cfg.checkForINF):
+            return
+        score = model.score()
+        if cfg.checkForNAN and np.isnan(score):
+            raise PanicException(f"NaN score at iteration {iteration} (panic mode)")
+        if cfg.checkForINF and np.isinf(score):
+            raise PanicException(f"Inf score at iteration {iteration} (panic mode)")
+        if self.checkParams:
+            check_tree_finite(model._params, f"parameters@iter{iteration}",
+                              cfg.checkForNAN, cfg.checkForINF)
+        grads = getattr(model, "_last_grads", None)
+        if self.checkGradients and grads is not None:
+            check_tree_finite(grads, f"gradients@iter{iteration}",
+                              cfg.checkForNAN, cfg.checkForINF)
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float,
+        peak_flops: float = 197e12) -> float:
+    """Model FLOPs utilization. ``peak_flops`` defaults to one TPU v5e chip
+    (197 TFLOP/s bf16)."""
+    return tokens_per_sec * flops_per_token / peak_flops

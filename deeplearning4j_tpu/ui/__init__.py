@@ -1,0 +1,31 @@
+"""Training observability (ref: deeplearning4j-ui-parent — the ~40k-LoC
+stats/UI subsystem: deeplearning4j-ui-model's StatsListener + StatsStorage SPI,
+play-based dashboard, and SBE-encoded stat reports).
+
+The TPU rebuild keeps the reference's architecture — a listener that samples
+model internals into immutable reports, pushed through a pluggable storage
+router — and swaps the presentation layer: instead of an embedded web server,
+reports export to TensorBoard event files (the standard dashboard of the JAX
+ecosystem). Histograms are computed on host from device arrays fetched at the
+listener's frequency, so the jitted train step stays a single fused executable
+except when gradient collection is requested (which switches the model to a
+step variant that also returns the grad/update trees).
+"""
+from deeplearning4j_tpu.ui.storage import (
+    StatsStorage,
+    InMemoryStatsStorage,
+    FileStatsStorage,
+)
+from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport, StatsUpdateConfiguration
+from deeplearning4j_tpu.ui.tensorboard import TensorBoardExporter, TensorBoardStatsListener
+
+__all__ = [
+    "StatsStorage",
+    "InMemoryStatsStorage",
+    "FileStatsStorage",
+    "StatsListener",
+    "StatsReport",
+    "StatsUpdateConfiguration",
+    "TensorBoardExporter",
+    "TensorBoardStatsListener",
+]

@@ -1,0 +1,230 @@
+"""StatsListener — samples model internals into storage (ref:
+org.deeplearning4j.ui.model.stats.StatsListener + StatsUpdateConfiguration +
+SbeStatsReport in deeplearning4j-ui-model).
+
+What the reference captures per report, captured here identically: score,
+learning rate, iteration timing, and per-parameter summary statistics (mean
+magnitudes, stdev) + histograms for **parameters, updates and gradients**,
+plus the update:parameter mean-magnitude ratio — the reference's headline
+training-health signal (healthy nets sit near 1e-3).
+
+TPU specifics: parameters live on device as a pytree; summaries are computed
+on host from leaves fetched only on reporting iterations. Gradient/update
+collection requires the model to run its "stats" step variant (returns the
+grad and update trees alongside the new params) — the listener advertises
+``requiresGradients``/``requiresUpdates`` and models switch variants when any
+attached listener asks.
+"""
+from __future__ import annotations
+
+import resource
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.train import schedules as _sched
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
+
+
+@dataclass
+class StatsUpdateConfiguration:
+    """What to collect (ref: DefaultStatsUpdateConfiguration builder)."""
+
+    reportingFrequency: int = 1
+    collectParameterStats: bool = True
+    collectUpdateStats: bool = True
+    collectGradientStats: bool = True
+    collectHistograms: bool = True
+    numHistogramBins: int = 20
+    collectLearningRates: bool = True
+    collectMemoryStats: bool = True
+    collectPerformanceStats: bool = True
+
+
+def _named_leaves(tree):
+    """Flatten a params-like pytree to [(name, np.ndarray)] with stable
+    path-derived names ('0/W', '3/fwd/Wr', ...)."""
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:
+                parts.append(str(p))
+        out.append(("/".join(parts), np.asarray(leaf)))
+    return out
+
+
+def _summary(arr: np.ndarray) -> dict:
+    a = arr.astype(np.float64).ravel()
+    return {
+        "meanMagnitude": float(np.mean(np.abs(a))) if a.size else 0.0,
+        "mean": float(np.mean(a)) if a.size else 0.0,
+        "stdev": float(np.std(a)) if a.size else 0.0,
+    }
+
+
+def _histogram(arr: np.ndarray, bins: int) -> dict:
+    a = arr.astype(np.float64).ravel()
+    a = a[np.isfinite(a)]
+    if a.size == 0:
+        return {"min": 0.0, "max": 0.0, "counts": [0] * bins}
+    lo, hi = float(a.min()), float(a.max())
+    if lo == hi:
+        hi = lo + 1e-12
+    counts, _ = np.histogram(a, bins=bins, range=(lo, hi))
+    return {"min": lo, "max": hi, "counts": counts.tolist()}
+
+
+@dataclass
+class StatsReport:
+    """One sampled update (ref: SbeStatsReport; JSON instead of SBE)."""
+
+    iteration: int
+    epoch: int
+    timestamp: float
+    score: float
+    learningRate: Optional[float] = None
+    durationMs: Optional[float] = None
+    minibatchesPerSecond: Optional[float] = None
+    memoryRssMb: Optional[float] = None
+    parameterStats: dict = field(default_factory=dict)
+    updateStats: dict = field(default_factory=dict)
+    gradientStats: dict = field(default_factory=dict)
+    updateRatios: dict = field(default_factory=dict)
+    parameterHistograms: dict = field(default_factory=dict)
+    updateHistograms: dict = field(default_factory=dict)
+    gradientHistograms: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+    @staticmethod
+    def from_dict(d: dict) -> "StatsReport":
+        return StatsReport(**d)
+
+
+class StatsListener(TrainingListener):
+    """Push per-iteration stats into a StatsStorage (ref: StatsListener)."""
+
+    def __init__(self, statsStorage: Optional[StatsStorage] = None,
+                 frequency: int = 1,
+                 config: Optional[StatsUpdateConfiguration] = None,
+                 sessionId: Optional[str] = None,
+                 workerId: str = "worker_0"):
+        self.storage = statsStorage or InMemoryStatsStorage()
+        self.config = config or StatsUpdateConfiguration(reportingFrequency=frequency)
+        if config is None:
+            self.config.reportingFrequency = frequency
+        self.sessionId = sessionId or uuid.uuid4().hex[:12]
+        self.workerId = workerId
+        self.typeId = "StatsListener"
+        self._static_sent = False
+        self._last_t: Optional[float] = None
+        self._prev_params = None  # host copies for update-by-delta fallback
+
+    # model fit loops check these to pick the stats step variant
+    @property
+    def requiresGradients(self) -> bool:
+        return self.config.collectGradientStats
+
+    @property
+    def requiresUpdates(self) -> bool:
+        return self.config.collectUpdateStats
+
+    # ------------------------------------------------------------------
+    def _learning_rate(self, model, iteration):
+        upd = getattr(getattr(model, "conf", None), "updater", None)
+        if upd is None:
+            return None
+        lr = getattr(upd, "learningRate", None)
+        if isinstance(lr, _sched.Schedule):
+            return float(lr.value_at(iteration))
+        return float(lr) if lr is not None else None
+
+    def _send_static(self, model):
+        info = {
+            "modelClass": type(model).__name__,
+            "numParams": int(model.numParams()) if hasattr(model, "numParams") else None,
+            "backend": jax.default_backend(),
+            "deviceCount": jax.device_count(),
+            "startTime": time.time(),
+        }
+        self.storage.putStaticInfo(self.sessionId, self.typeId, self.workerId, info)
+        self._static_sent = True
+
+    def iterationDone(self, model, iteration, epoch):
+        cfg = self.config
+        now = time.perf_counter()
+        duration = None
+        if self._last_t is not None:
+            duration = (now - self._last_t) * 1000.0
+        self._last_t = now
+        if iteration % max(cfg.reportingFrequency, 1) != 0:
+            return
+        if not self._static_sent:
+            self._send_static(model)
+
+        report = StatsReport(
+            iteration=iteration, epoch=epoch, timestamp=time.time(),
+            score=float(model.score()),
+        )
+        if cfg.collectLearningRates:
+            report.learningRate = self._learning_rate(model, iteration)
+        if duration is not None and cfg.collectPerformanceStats:
+            report.durationMs = duration
+            report.minibatchesPerSecond = 1000.0 / duration if duration > 0 else None
+        if cfg.collectMemoryStats:
+            report.memoryRssMb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+        params = _named_leaves(model._params) if cfg.collectParameterStats else []
+        for name, arr in params:
+            report.parameterStats[name] = _summary(arr)
+            if cfg.collectHistograms:
+                report.parameterHistograms[name] = _histogram(arr, cfg.numHistogramBins)
+
+        updates = self._collect_updates(model, params)
+        for name, arr in updates:
+            report.updateStats[name] = _summary(arr)
+            if cfg.collectHistograms:
+                report.updateHistograms[name] = _histogram(arr, cfg.numHistogramBins)
+
+        if cfg.collectGradientStats and getattr(model, "_last_grads", None) is not None:
+            for name, arr in _named_leaves(model._last_grads):
+                report.gradientStats[name] = _summary(arr)
+                if cfg.collectHistograms:
+                    report.gradientHistograms[name] = _histogram(arr, cfg.numHistogramBins)
+
+        # update:param mean-magnitude ratio — THE training-health number
+        for name, u in report.updateStats.items():
+            p = report.parameterStats.get(name)
+            if p and p["meanMagnitude"] > 0:
+                report.updateRatios[name] = u["meanMagnitude"] / p["meanMagnitude"]
+
+        self.storage.putUpdate(self.sessionId, self.typeId, self.workerId,
+                               report.to_dict())
+
+    def _collect_updates(self, model, named_params):
+        """Applied updates: prefer the model's stats-step output, else diff
+        consecutive param snapshots (identical result — the applied update IS
+        param_t - param_{t-1})."""
+        if not self.config.collectUpdateStats:
+            return []
+        last = getattr(model, "_last_updates", None)
+        if last is not None:
+            return _named_leaves(last)
+        if self._prev_params is not None:
+            prev = dict(self._prev_params)
+            out = [(n, arr - prev[n]) for n, arr in named_params if n in prev]
+        else:
+            out = []
+        self._prev_params = {n: a.copy() for n, a in named_params}
+        return out

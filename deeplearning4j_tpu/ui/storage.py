@@ -1,0 +1,170 @@
+"""Stats storage SPI (ref: org.deeplearning4j.api.storage.StatsStorage and
+implementations InMemoryStatsStorage / FileStatsStorage in
+deeplearning4j-ui-model).
+
+The reference routes SBE-encoded binary reports through a StatsStorageRouter;
+listeners attach to a storage instance and the UI reads from it. Here reports
+are plain dicts (JSON-serializable), the SPI keeps the reference's
+session/type/worker addressing, and the file backend is append-only JSONL —
+human-readable, crash-tolerant (a torn tail line is dropped on read), and
+trivially consumed by external tooling.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+
+class StatsStorage:
+    """SPI (ref: StatsStorage + StatsStorageRouter merged — the reference
+    splits read and write interfaces; both ends live on one object here)."""
+
+    # -- write side (router) ------------------------------------------------
+    def putUpdate(self, sessionId: str, typeId: str, workerId: str, report: dict):
+        raise NotImplementedError
+
+    def putStaticInfo(self, sessionId: str, typeId: str, workerId: str, info: dict):
+        raise NotImplementedError
+
+    # -- read side ----------------------------------------------------------
+    def listSessionIDs(self) -> List[str]:
+        raise NotImplementedError
+
+    def listWorkerIDsForSession(self, sessionId: str) -> List[str]:
+        raise NotImplementedError
+
+    def getAllUpdatesAfter(self, sessionId: str, typeId: str, workerId: str,
+                           timestamp: float) -> List[dict]:
+        return [r for r in self.getUpdates(sessionId, typeId, workerId)
+                if r.get("timestamp", 0.0) > timestamp]
+
+    def getUpdates(self, sessionId: str, typeId: str, workerId: str) -> List[dict]:
+        raise NotImplementedError
+
+    def getStaticInfo(self, sessionId: str, typeId: str, workerId: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    # -- listeners (ref: StatsStorageListener) ------------------------------
+    def registerStatsStorageListener(self, cb: Callable[[dict], None]):
+        self._callbacks().append(cb)
+
+    def _callbacks(self) -> list:
+        if not hasattr(self, "_cbs"):
+            self._cbs = []
+        return self._cbs
+
+    def _notify(self, event: dict):
+        for cb in self._callbacks():
+            cb(event)
+
+
+def _key(sessionId, typeId, workerId):
+    return (sessionId, typeId, workerId)
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """Ephemeral storage (ref: InMemoryStatsStorage)."""
+
+    def __init__(self):
+        self._updates: Dict[tuple, List[dict]] = defaultdict(list)
+        self._static: Dict[tuple, dict] = {}
+        self._lock = threading.Lock()
+
+    def putUpdate(self, sessionId, typeId, workerId, report):
+        with self._lock:
+            self._updates[_key(sessionId, typeId, workerId)].append(report)
+        self._notify({"kind": "update", "sessionId": sessionId,
+                      "typeId": typeId, "workerId": workerId})
+
+    def putStaticInfo(self, sessionId, typeId, workerId, info):
+        with self._lock:
+            self._static[_key(sessionId, typeId, workerId)] = info
+        self._notify({"kind": "static", "sessionId": sessionId,
+                      "typeId": typeId, "workerId": workerId})
+
+    def listSessionIDs(self):
+        with self._lock:
+            keys = set(self._updates) | set(self._static)
+        return sorted({k[0] for k in keys})
+
+    def listWorkerIDsForSession(self, sessionId):
+        with self._lock:
+            keys = set(self._updates) | set(self._static)
+        return sorted({k[2] for k in keys if k[0] == sessionId})
+
+    def getUpdates(self, sessionId, typeId, workerId):
+        with self._lock:
+            return list(self._updates.get(_key(sessionId, typeId, workerId), []))
+
+    def getStaticInfo(self, sessionId, typeId, workerId):
+        with self._lock:
+            return self._static.get(_key(sessionId, typeId, workerId))
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSONL file storage (ref: FileStatsStorage — the reference
+    uses a MapDB file; JSONL keeps the same durability contract with a
+    greppable format). One file holds every session."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        if not os.path.exists(path):
+            with open(path, "w"):
+                pass
+
+    def _append(self, record: dict):
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+
+    def _scan(self):
+        with self._lock:
+            try:
+                with open(self.path) as f:
+                    lines = f.readlines()
+            except FileNotFoundError:
+                return
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail write — drop
+
+    def putUpdate(self, sessionId, typeId, workerId, report):
+        self._append({"kind": "update", "sessionId": sessionId, "typeId": typeId,
+                      "workerId": workerId, "report": report})
+        self._notify({"kind": "update", "sessionId": sessionId,
+                      "typeId": typeId, "workerId": workerId})
+
+    def putStaticInfo(self, sessionId, typeId, workerId, info):
+        self._append({"kind": "static", "sessionId": sessionId, "typeId": typeId,
+                      "workerId": workerId, "info": info})
+        self._notify({"kind": "static", "sessionId": sessionId,
+                      "typeId": typeId, "workerId": workerId})
+
+    def listSessionIDs(self):
+        return sorted({r["sessionId"] for r in self._scan()})
+
+    def listWorkerIDsForSession(self, sessionId):
+        return sorted({r["workerId"] for r in self._scan() if r["sessionId"] == sessionId})
+
+    def getUpdates(self, sessionId, typeId, workerId):
+        return [r["report"] for r in self._scan()
+                if r["kind"] == "update" and r["sessionId"] == sessionId
+                and r["typeId"] == typeId and r["workerId"] == workerId]
+
+    def getStaticInfo(self, sessionId, typeId, workerId):
+        out = None
+        for r in self._scan():
+            if r["kind"] == "static" and r["sessionId"] == sessionId \
+                    and r["typeId"] == typeId and r["workerId"] == workerId:
+                out = r["info"]
+        return out

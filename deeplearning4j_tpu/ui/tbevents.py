@@ -1,0 +1,170 @@
+"""Minimal TensorBoard event-file writer — no TensorFlow dependency.
+
+TensorBoard's on-disk format is a sequence of length-prefixed, CRC32C-checked
+records, each an ``Event`` protobuf. Only three message shapes are needed for
+scalar + histogram dashboards, so this module hand-encodes them with a ~40-line
+protobuf writer instead of importing TensorFlow (a ~1GB import) into the
+training process.
+
+Wire schema encoded here (field numbers from the public tensorflow/core
+event.proto + summary.proto):
+
+    Event:          1=wall_time(double) 2=step(int64) 3=file_version(string)
+                    5=summary(Summary)
+    Summary:        1=value(repeated Summary.Value)
+    Summary.Value:  1=tag(string) 2=simple_value(float) 5=histo(HistogramProto)
+    HistogramProto: 1=min 2=max 3=num 4=sum 5=sum_squares (double)
+                    6=bucket_limit 7=bucket (packed repeated double)
+
+Record framing: u64le(len) crc32c_masked(len_bytes) payload
+crc32c_masked(payload); mask(c) = ((c>>15 | c<<17) + 0xa282ead8) mod 2^32.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Iterable, Optional
+
+# ---------------------------------------------------------------- crc32c
+_CRC_TABLE = []
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if _CRC_TABLE:
+        return _CRC_TABLE
+    poly = 0x82F63B78  # Castagnoli, reflected
+    tbl = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        tbl.append(c)
+    _CRC_TABLE = tbl
+    return tbl
+
+
+def crc32c(data: bytes) -> int:
+    tbl = _crc_table()
+    c = 0xFFFFFFFF
+    for b in data:
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    c = crc32c(data)
+    return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------- protobuf
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_double(field: int, v: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", v)
+
+
+def _f_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def _f_int64(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _f_bytes(field: int, v: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(v)) + v
+
+
+def _f_string(field: int, v: str) -> bytes:
+    return _f_bytes(field, v.encode("utf-8"))
+
+
+def _f_packed_doubles(field: int, vals: Iterable[float]) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in vals)
+    return _f_bytes(field, payload)
+
+
+def encode_histogram(minv, maxv, num, total, sum_sq, bucket_limits, buckets) -> bytes:
+    return (_f_double(1, minv) + _f_double(2, maxv) + _f_double(3, num)
+            + _f_double(4, total) + _f_double(5, sum_sq)
+            + _f_packed_doubles(6, bucket_limits)
+            + _f_packed_doubles(7, buckets))
+
+
+def encode_scalar_value(tag: str, value: float) -> bytes:
+    return _f_string(1, tag) + _f_float(2, float(value))
+
+
+def encode_histo_value(tag: str, histo: bytes) -> bytes:
+    return _f_string(1, tag) + _f_bytes(5, histo)
+
+
+def encode_event(wall_time: float, step: Optional[int] = None,
+                 file_version: Optional[str] = None,
+                 summary_values: Optional[list] = None) -> bytes:
+    out = _f_double(1, wall_time)
+    if step is not None:
+        out += _f_int64(2, step)
+    if file_version is not None:
+        out += _f_string(3, file_version)
+    if summary_values:
+        out += _f_bytes(5, b"".join(_f_bytes(1, v) for v in summary_values))
+    return out
+
+
+class EventFileWriter:
+    """Append Events to an events.out.tfevents.* file in ``logdir``."""
+
+    def __init__(self, logdir: str, filename_suffix: str = ""):
+        os.makedirs(logdir, exist_ok=True)
+        host = socket.gethostname()
+        name = f"events.out.tfevents.{int(time.time())}.{host}{filename_suffix}"
+        self.path = os.path.join(logdir, name)
+        self._f = open(self.path, "ab")
+        self._write(encode_event(time.time(), file_version="brain.Event:2"))
+
+    def _write(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+
+    def add_scalar(self, tag: str, value: float, step: int,
+                   wall_time: Optional[float] = None):
+        ev = encode_event(wall_time or time.time(), step=step,
+                          summary_values=[encode_scalar_value(tag, value)])
+        self._write(ev)
+
+    def add_histogram_raw(self, tag: str, minv, maxv, num, total, sum_sq,
+                          bucket_limits, buckets, step: int,
+                          wall_time: Optional[float] = None):
+        histo = encode_histogram(minv, maxv, num, total, sum_sq,
+                                 bucket_limits, buckets)
+        ev = encode_event(wall_time or time.time(), step=step,
+                          summary_values=[encode_histo_value(tag, histo)])
+        self._write(ev)
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.flush()
+        self._f.close()

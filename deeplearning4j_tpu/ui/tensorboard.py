@@ -1,0 +1,107 @@
+"""TensorBoard presentation layer for stats (replaces the reference's
+play-framework web dashboard, deeplearning4j-ui — SURVEY.md §5.5 rebuild
+mapping: 'UI server → TensorBoard export').
+
+Two entry points:
+
+- ``TensorBoardExporter.export(storage, sessionId, logdir)`` — batch-convert a
+  recorded StatsStorage session into an events file (the reference's
+  'attach storage to UIServer' flow, offline).
+- ``TensorBoardStatsListener`` — a TrainingListener that streams scalars +
+  histograms straight to a logdir during fit() (the reference's
+  'StatsListener + UIServer live' flow).
+
+Scalars written: score, learning rate, iteration duration, update:param
+ratios (log10 — the reference plots this ratio on a log axis; ~-3 is
+healthy). Histograms written for params/updates/gradients when collected.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport, StatsUpdateConfiguration
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
+from deeplearning4j_tpu.ui.tbevents import EventFileWriter
+
+
+def _write_report(w: EventFileWriter, rep: dict):
+    step = int(rep["iteration"])
+    t = rep.get("timestamp")
+    w.add_scalar("train/score", rep["score"], step, t)
+    if rep.get("learningRate") is not None:
+        w.add_scalar("train/learning_rate", rep["learningRate"], step, t)
+    if rep.get("durationMs") is not None:
+        w.add_scalar("perf/iteration_ms", rep["durationMs"], step, t)
+    if rep.get("memoryRssMb") is not None:
+        w.add_scalar("perf/rss_mb", rep["memoryRssMb"], step, t)
+    for name, ratio in (rep.get("updateRatios") or {}).items():
+        if ratio > 0:
+            w.add_scalar(f"update_ratio_log10/{name}", float(np.log10(ratio)), step, t)
+    for group, key in (("parameters", "parameterHistograms"),
+                       ("updates", "updateHistograms"),
+                       ("gradients", "gradientHistograms")):
+        for name, h in (rep.get(key) or {}).items():
+            counts = np.asarray(h["counts"], dtype=np.float64)
+            num = float(counts.sum())
+            if num == 0:
+                continue
+            edges = np.linspace(h["min"], h["max"], len(counts) + 1)
+            centers = (edges[:-1] + edges[1:]) / 2.0
+            total = float((centers * counts).sum())
+            sum_sq = float((centers ** 2 * counts).sum())
+            w.add_histogram_raw(f"{group}/{name}", h["min"], h["max"], num,
+                                total, sum_sq, edges[1:].tolist(),
+                                counts.tolist(), step, t)
+
+
+class TensorBoardExporter:
+    """Offline StatsStorage → events-file conversion."""
+
+    @staticmethod
+    def export(storage: StatsStorage, sessionId: str, logdir: str,
+               typeId: str = "StatsListener") -> list:
+        paths = []
+        for workerId in storage.listWorkerIDsForSession(sessionId):
+            suffix = f".{workerId}" if workerId != "worker_0" else ""
+            w = EventFileWriter(logdir, filename_suffix=suffix)
+            try:
+                for rep in storage.getUpdates(sessionId, typeId, workerId):
+                    _write_report(w, rep)
+            finally:
+                w.close()
+            paths.append(w.path)
+        return paths
+
+
+class TensorBoardStatsListener(StatsListener):
+    """Live streaming variant: every report lands in storage AND the events
+    file, so a TensorBoard pointed at ``logdir`` follows training."""
+
+    def __init__(self, logdir: str, frequency: int = 1,
+                 config: Optional[StatsUpdateConfiguration] = None,
+                 statsStorage: Optional[StatsStorage] = None):
+        super().__init__(statsStorage or InMemoryStatsStorage(),
+                         frequency=frequency, config=config)
+        self.logdir = logdir
+        self._writer: Optional[EventFileWriter] = None
+
+    def _get_writer(self) -> EventFileWriter:
+        if self._writer is None:
+            self._writer = EventFileWriter(self.logdir)
+        return self._writer
+
+    def iterationDone(self, model, iteration, epoch):
+        before = len(self.storage.getUpdates(self.sessionId, self.typeId, self.workerId))
+        StatsListener.iterationDone(self, model, iteration, epoch)
+        reports = self.storage.getUpdates(self.sessionId, self.typeId, self.workerId)
+        if len(reports) > before:
+            w = self._get_writer()
+            _write_report(w, reports[-1])
+            w.flush()
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()

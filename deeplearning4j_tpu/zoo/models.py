@@ -479,8 +479,62 @@ class TinyYOLO(ZooModel):
 
 
 class YOLO2(ZooModel):
-    """(ref: zoo.model.YOLO2 — Darknet19 backbone + Yolo2OutputLayer; the
-    reference's passthrough reorg layer is realized with SpaceToDepth)."""
+    """(ref: zoo.model.YOLO2 — Darknet19 backbone + Yolo2OutputLayer).
+
+    Deviation from the reference: the passthrough reorg (26x26 features
+    SpaceToDepth'd and concatenated into the 13x13 head) needs a skip
+    connection, which a sequential conf cannot express — this build is the
+    straight-through backbone only. Use ``graph_conf()`` for the faithful
+    passthrough variant."""
+
+    def graph_conf(self):
+        """ComputationGraph variant WITH the passthrough: conv13's 26x26x512
+        features go through 1x1 conv(64) + SpaceToDepth(2) and merge into the
+        13x13 head (the reference's reorg route)."""
+        from deeplearning4j_tpu.nn.conf.layers import SpaceToDepthLayer, Yolo2OutputLayer
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("XAVIER").graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv_bn(name, n_out, k, frm):
+            g.addLayer(f"{name}c", ConvolutionLayer(nOut=n_out, kernelSize=(k, k),
+                                                    convolutionMode="Same", hasBias=False,
+                                                    activation="IDENTITY"), frm)
+            g.addLayer(name, BatchNormalization(activation="LEAKYRELU"), f"{name}c")
+            return name
+
+        spec = [(32, 3, True), (64, 3, True),
+                (128, 3, False), (64, 1, False), (128, 3, True),
+                (256, 3, False), (128, 1, False), (256, 3, True),
+                (512, 3, False), (256, 1, False), (512, 3, False), (256, 1, False),
+                (512, 3, True),
+                (1024, 3, False), (512, 1, False), (1024, 3, False),
+                (512, 1, False), (1024, 3, False),
+                (1024, 3, False), (1024, 3, False)]
+        prev, passthrough = "input", None
+        for i, (n_out, k, pool) in enumerate(spec):
+            prev = conv_bn(f"b{i}", n_out, k, prev)
+            if i == 12:
+                passthrough = prev  # conv13 output, 26x26x512, pre-pool
+            if pool:
+                g.addLayer(f"b{i}p", SubsamplingLayer(poolingType="MAX",
+                                                      kernelSize=(2, 2), stride=(2, 2)),
+                           prev)
+                prev = f"b{i}p"
+        pt = conv_bn("pt", 64, 1, passthrough)
+        g.addLayer("pt_s2d", SpaceToDepthLayer(blockSize=2), pt)  # 13x13x256
+        g.addVertex("cat", MergeVertex(), "pt_s2d", prev)
+        head = conv_bn("head", 1024, 3, "cat")
+        A = len(self.boundingBoxes)
+        g.addLayer("det", ConvolutionLayer(nOut=A * (5 + self.numClasses),
+                                           kernelSize=(1, 1), activation="IDENTITY"),
+                   head)
+        g.addLayer("output", Yolo2OutputLayer(boundingBoxes=self.boundingBoxes), "det")
+        g.setOutputs("output")
+        return g.build()
 
     DEFAULT_ANCHORS = ((0.57273, 0.677385), (1.87446, 2.06253),
                        (3.33843, 5.47434), (7.88282, 3.52778),

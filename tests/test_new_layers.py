@@ -176,8 +176,15 @@ class TestShapesAndBehavior:
         net = _net(DenseLayer(nIn=6, nOut=8, activation="RELU"),
                    OCNNOutputLayer(nIn=8, hiddenSize=4, nu=0.1))
         y = np.zeros((16, 1), np.float32)  # unused by the one-class loss
-        net.fit(DataSet(x, y), epochs=3)
+        r0 = float(net._params[-1]["r"])
+        net.fit(DataSet(x, y), epochs=10)
         assert np.isfinite(net.score())
+        # the −r objective term must drive the boundary: if the hinge is the
+        # only force, r only ever shrinks and gradients die at loss 0
+        assert float(net._params[-1]["r"]) != r0
+        # full objective (hinge/nu − r) can go negative; the degenerate
+        # hinge-only implementation would pin score at exactly 0 quickly
+        assert net.score() != 0.0
 
 
 class TestPretraining:
@@ -306,6 +313,34 @@ class TestVertices:
         kv = jnp.asarray(RNG.normal(size=(2, 5, 4)), jnp.float32)
         out = DotProductAttentionVertex().apply([q, kv, kv])
         assert out.shape == (2, 3, 4)
+        # masked keys must get (near-)zero attention weight
+        mask = np.ones((2, 5), np.float32)
+        mask[:, 3:] = 0.0
+        masked = DotProductAttentionVertex().apply([q, kv, kv, jnp.asarray(mask)])
+        oracle = DotProductAttentionVertex().apply([q, kv[:, :3], kv[:, :3]])
+        np.testing.assert_allclose(np.asarray(masked), np.asarray(oracle), atol=1e-6)
+
+    def test_attention_vertex_with_l2_regularization(self):
+        """Vertices in a regularized graph must not crash _loss_for
+        (GraphVertex.regularizable defaults to ())."""
+        from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.graph import AttentionVertex
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(0.01))
+                .l2(1e-4)
+                .graphBuilder()
+                .addInputs("seq")
+                .addVertex("attn", AttentionVertex(nInQueries=6, nInKeys=6,
+                                                   nInValues=6, nOut=4, nHeads=2),
+                           "seq", "seq", "seq")
+                .addLayer("out", RnnOutputLayer(nIn=4, nOut=3,
+                                                lossFunction="MCXENT"), "attn")
+                .setOutputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        x = RNG.normal(size=(2, 5, 6)).astype(np.float32)
+        y = np.eye(3)[RNG.integers(0, 3, (2, 5))].astype(np.float32)
+        g.fit(DataSet(x, y), epochs=2)
+        assert np.isfinite(g.score())
 
     def test_preprocessor_vertex(self):
         from deeplearning4j_tpu.nn.conf.graph import PreprocessorVertex

@@ -1,0 +1,228 @@
+"""Observability subsystem tests: StatsListener → storage SPI → TensorBoard
+export, plus the profiler's span/Chrome-trace/panic paths (reference analog:
+deeplearning4j-ui-model's StatsListener tests + nd4j OpProfiler tests,
+SURVEY.md §5.1/§5.5)."""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.profiler import (
+    OpProfiler, PanicException, ProfilerConfig, ProfilingListener,
+)
+from deeplearning4j_tpu.profiler.profiler import check_tree_finite
+from deeplearning4j_tpu.train import Adam
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, StatsListener,
+    StatsUpdateConfiguration, TensorBoardExporter, TensorBoardStatsListener,
+)
+
+
+def tiny_net(seed=12345):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(lr=1e-2))
+            .list()
+            .layer(DenseLayer(nOut=8, activation="relu"))
+            .layer(OutputLayer(nOut=3, lossFunction="MCXENT"))
+            .setInputType(InputType.feedForward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def tiny_data(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 5).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return DataSet(x, y)
+
+
+class TestStatsListener:
+    def test_reports_capture_params_grads_updates(self):
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, frequency=1)
+        net = tiny_net()
+        net.setListeners(lst)
+        net.fit(tiny_data(), epochs=3)
+
+        sessions = storage.listSessionIDs()
+        assert sessions == [lst.sessionId]
+        reports = storage.getUpdates(lst.sessionId, "StatsListener", "worker_0")
+        assert len(reports) == 3
+        rep = reports[-1]
+        assert math.isfinite(rep["score"])
+        assert rep["learningRate"] == pytest.approx(1e-2)
+        # params: 2 layers x (W, b)
+        assert set(rep["parameterStats"]) == {"0/W", "0/b", "1/W", "1/b"}
+        assert rep["parameterStats"]["0/W"]["meanMagnitude"] > 0
+        # gradient + update trees came back from the stats step variant
+        assert set(rep["gradientStats"]) == set(rep["parameterStats"])
+        assert set(rep["updateStats"]) == set(rep["parameterStats"])
+        # the update:param ratio — Adam lr=1e-2 on fresh params: > 0, sane
+        assert 0 < rep["updateRatios"]["0/W"] < 10
+        # histograms have the configured bin count and mass
+        h = rep["parameterHistograms"]["0/W"]
+        assert len(h["counts"]) == 20
+        assert sum(h["counts"]) == 5 * 8
+
+    def test_static_info_and_frequency(self):
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, frequency=2)
+        net = tiny_net()
+        net.setListeners(lst)
+        net.fit(tiny_data(), epochs=5)
+        reports = storage.getUpdates(lst.sessionId, "StatsListener", "worker_0")
+        assert len(reports) == 2  # iterations 2, 4
+        info = storage.getStaticInfo(lst.sessionId, "StatsListener", "worker_0")
+        assert info["modelClass"] == "MultiLayerNetwork"
+        assert info["numParams"] == net.numParams()
+
+    def test_stats_training_matches_plain_training(self):
+        """The stats step variant must be bit-identical math to the plain
+        step — collecting stats must not change training."""
+        ds = tiny_data()
+        a, b = tiny_net(), tiny_net()
+        b.setListeners(StatsListener(InMemoryStatsStorage()))
+        a.fit(ds, epochs=4)
+        b.fit(ds, epochs=4)
+        np.testing.assert_allclose(a.params().toNumpy(), b.params().toNumpy(),
+                                   rtol=0, atol=0)
+
+
+class TestStorage:
+    def test_file_storage_roundtrip(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        storage = FileStatsStorage(path)
+        storage.putStaticInfo("s1", "T", "w0", {"a": 1})
+        storage.putUpdate("s1", "T", "w0", {"iteration": 1, "score": 0.5, "timestamp": 10.0})
+        storage.putUpdate("s1", "T", "w1", {"iteration": 1, "score": 0.7, "timestamp": 11.0})
+
+        fresh = FileStatsStorage(path)  # re-open: durability
+        assert fresh.listSessionIDs() == ["s1"]
+        assert fresh.listWorkerIDsForSession("s1") == ["w0", "w1"]
+        assert fresh.getStaticInfo("s1", "T", "w0") == {"a": 1}
+        assert fresh.getUpdates("s1", "T", "w0")[0]["score"] == 0.5
+        assert fresh.getAllUpdatesAfter("s1", "T", "w1", 10.5)[0]["score"] == 0.7
+
+    def test_file_storage_tolerates_torn_tail(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        storage = FileStatsStorage(path)
+        storage.putUpdate("s1", "T", "w0", {"iteration": 1, "score": 0.5})
+        with open(path, "a") as f:
+            f.write('{"kind": "update", "sess')  # simulated crash mid-write
+        assert len(FileStatsStorage(path).getUpdates("s1", "T", "w0")) == 1
+
+    def test_storage_listener_callbacks(self):
+        storage = InMemoryStatsStorage()
+        events = []
+        storage.registerStatsStorageListener(events.append)
+        storage.putUpdate("s", "T", "w", {"iteration": 0})
+        assert events and events[0]["kind"] == "update"
+
+
+def _read_tfevents(path):
+    """Readback through TF's own event iterator — proves the hand-rolled
+    wire format is byte-valid."""
+    tf = pytest.importorskip("tensorflow")
+    events = list(tf.compat.v1.train.summary_iterator(path))
+    return events
+
+
+class TestTensorBoard:
+    def test_export_readback_with_tensorflow(self, tmp_path):
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, frequency=1)
+        net = tiny_net()
+        net.setListeners(lst)
+        net.fit(tiny_data(), epochs=2)
+
+        logdir = str(tmp_path / "tb")
+        paths = TensorBoardExporter.export(storage, lst.sessionId, logdir)
+        assert len(paths) == 1 and os.path.exists(paths[0])
+
+        events = _read_tfevents(paths[0])
+        assert events[0].file_version == "brain.Event:2"
+        scalar_tags = set()
+        histo_tags = set()
+        for ev in events[1:]:
+            for v in ev.summary.value:
+                if v.HasField("simple_value"):
+                    scalar_tags.add(v.tag)
+                    assert math.isfinite(v.simple_value)
+                elif v.HasField("histo"):
+                    histo_tags.add(v.tag)
+                    assert v.histo.num > 0
+                    assert len(v.histo.bucket) == len(v.histo.bucket_limit)
+        assert "train/score" in scalar_tags
+        assert "train/learning_rate" in scalar_tags
+        assert "update_ratio_log10/0/W" in scalar_tags
+        assert "parameters/0/W" in histo_tags
+        assert "gradients/1/W" in histo_tags
+
+    def test_live_listener_streams(self, tmp_path):
+        logdir = str(tmp_path / "tb_live")
+        lst = TensorBoardStatsListener(logdir, frequency=1)
+        net = tiny_net()
+        net.setListeners(lst)
+        net.fit(tiny_data(), epochs=2)
+        lst.close()
+        files = [f for f in os.listdir(logdir) if "tfevents" in f]
+        assert len(files) == 1
+        events = _read_tfevents(os.path.join(logdir, files[0]))
+        steps = sorted({e.step for e in events if e.summary.value})
+        assert steps == [1, 2]
+
+
+class TestProfiler:
+    def test_spans_and_chrome_trace(self, tmp_path):
+        prof = OpProfiler()
+        with prof.span("outer", phase="train"):
+            with prof.span("inner"):
+                pass
+        assert {s.name for s in prof.spans} == {"outer", "inner"}
+        summary = prof.summary()
+        assert summary["outer"]["count"] == 1
+        assert summary["outer"]["total_ms"] >= summary["inner"]["total_ms"]
+
+        path = prof.export_chrome_trace(str(tmp_path / "trace.json"))
+        trace = json.load(open(path))
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert names == {"outer", "inner"}
+        assert all(e["ph"] == "X" and e["dur"] >= 0 for e in trace["traceEvents"])
+
+    def test_profiling_listener_records_iterations(self, tmp_path):
+        prof = OpProfiler()
+        net = tiny_net()
+        net.setListeners(ProfilingListener(prof))
+        net.fit(tiny_data(), epochs=3)
+        iters = [s for s in prof.spans if s.name == "iteration"]
+        assert len(iters) == 2  # N-1 gaps between N iterationDone calls
+
+    def test_check_tree_finite(self):
+        check_tree_finite({"a": np.ones(3), "b": [np.zeros(2)]}, "ok")
+        with pytest.raises(PanicException, match="NaN"):
+            check_tree_finite({"a": np.array([1.0, np.nan])}, "bad")
+        with pytest.raises(PanicException, match="Inf"):
+            check_tree_finite({"a": np.array([1.0, np.inf])}, "bad",
+                              check_nan=True, check_inf=True)
+
+    def test_nan_panic_on_diverging_model(self):
+        class FakeModel:
+            _params = {"w": np.array([1.0])}
+            def score(self):
+                return float("nan")
+        lst = ProfilingListener(config=ProfilerConfig(checkForNAN=True))
+        with pytest.raises(PanicException, match="NaN score"):
+            lst.iterationDone(FakeModel(), 1, 0)
+
+    def test_panic_mode_catches_param_nan(self):
+        lst = ProfilingListener(config=ProfilerConfig(checkForNAN=True))
+        class FakeModel:
+            _params = {"w": np.array([1.0, np.nan])}
+            def score(self):
+                return 0.5
+        with pytest.raises(PanicException, match="parameters"):
+            lst.iterationDone(FakeModel(), 1, 0)

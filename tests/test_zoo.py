@@ -88,3 +88,19 @@ def test_text_generation_lstm():
     assert net.getIterationCount() == 2  # 60 steps / tbptt 50 -> 2 segments
     out = net.output(x)
     assert out.shape == (2, 60, 12)
+
+
+def test_yolo2_graph_conf_passthrough():
+    """The faithful YOLO2 build: SpaceToDepth passthrough merged into the
+    13x13-equivalent head (2x2 grid at 64px input)."""
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.zoo import YOLO2
+    m = YOLO2(numClasses=3, inputShape=(3, 64, 64))
+    conf = m.graph_conf()
+    names = {n.name for n in conf.nodes}
+    assert {"pt_s2d", "cat", "output"} <= names
+    net = ComputationGraph(conf).init()
+    x = _img(2, 3, 64, 64)
+    out = net.outputSingle(x)
+    A = len(m.boundingBoxes)
+    assert out.shape == (2, A * (5 + 3), 2, 2)
