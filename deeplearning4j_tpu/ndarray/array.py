@@ -110,6 +110,15 @@ class NDArray:
     def item(self):
         return self._jax.item()
 
+    def __float__(self) -> float:
+        return float(self._jax)
+
+    def __int__(self) -> int:
+        return int(self._jax)
+
+    def __bool__(self) -> bool:
+        return bool(self._jax)
+
     def getDouble(self, *indices) -> float:
         return float(self._jax[tuple(indices)] if indices else self._jax)
 

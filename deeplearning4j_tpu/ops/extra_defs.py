@@ -1,0 +1,600 @@
+"""Op families beyond the initial surface: segment/scatter/partition ops,
+sequence ops, top-k, image color/geometry, extended special functions,
+bitwise rotation, and linalg extensions.
+
+Reference inventory these map to (SURVEY.md §2.1 declarable custom ops):
+libnd4j ops.h families — segment_* / unsorted_segment_* (include/ops/declarable
+/generic/parity_ops), dynamic_partition/dynamic_stitch, scatter_* variants,
+sequence_mask/reverse_sequence, top_k/in_top_k, image ops (adjust_hue,
+adjust_saturation, rgb_to_hsv, resize variants), special math (zeta, polygamma,
+digamma, betainc, igamma), cyclic bit shifts, and the matrix ops the reference
+routes to LAPACK. Implementations are jnp/lax compositions — XLA emits fused
+TPU kernels; none of these need Pallas (no reuse patterns XLA can't see).
+
+Eager-only ops (dynamic output shapes that cannot live under jit — the
+reference computes them host-side too): dynamicPartition, bincount with
+unknown length. They are registered but documented as such.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+# ------------------------------------------------------------------ segment
+
+
+def _segment(name, base_fn, needs_num=True):
+    def fn(data, segment_ids, num_segments):
+        return base_fn(data, segment_ids, num_segments=num_segments)
+    fn.__name__ = name
+    return fn
+
+
+op("segmentSum", "math")(lambda data, ids, num: jax.ops.segment_sum(data, ids, num))
+op("segmentProd", "math")(lambda data, ids, num: jax.ops.segment_prod(data, ids, num))
+op("segmentMax", "math")(lambda data, ids, num: jax.ops.segment_max(data, ids, num))
+op("segmentMin", "math")(lambda data, ids, num: jax.ops.segment_min(data, ids, num))
+
+
+@op("segmentMean", "math")
+def segment_mean(data, ids, num):
+    sums = jax.ops.segment_sum(data, ids, num)
+    counts = jax.ops.segment_sum(jnp.ones_like(data, dtype=data.dtype), ids, num)
+    return sums / jnp.maximum(counts, 1)
+
+
+# The reference distinguishes sorted/unsorted variants because its CPU kernels
+# exploit sortedness; the XLA scatter they lower to here handles both.
+op("unsortedSegmentSum", "math")(lambda data, ids, num: jax.ops.segment_sum(data, ids, num))
+op("unsortedSegmentProd", "math")(lambda data, ids, num: jax.ops.segment_prod(data, ids, num))
+op("unsortedSegmentMax", "math")(lambda data, ids, num: jax.ops.segment_max(data, ids, num))
+op("unsortedSegmentMin", "math")(lambda data, ids, num: jax.ops.segment_min(data, ids, num))
+op("unsortedSegmentMean", "math")(segment_mean)
+
+
+@op("unsortedSegmentSqrtN", "math")
+def unsorted_segment_sqrt_n(data, ids, num):
+    sums = jax.ops.segment_sum(data, ids, num)
+    counts = jax.ops.segment_sum(jnp.ones_like(data, dtype=data.dtype), ids, num)
+    return sums / jnp.sqrt(jnp.maximum(counts, 1))
+
+
+# ------------------------------------------------------- partition / stitch
+
+
+@op("dynamicPartition", "shape")
+def dynamic_partition(x, partitions, num_partitions):
+    """EAGER-ONLY (dynamic output shapes): list of num_partitions arrays."""
+    import numpy as np
+    xn, pn = np.asarray(x), np.asarray(partitions)
+    return [jnp.asarray(xn[pn == i]) for i in range(num_partitions)]
+
+
+@op("dynamicStitch", "shape")
+def dynamic_stitch(indices, data):
+    """indices: list of int arrays; data: list of equally-ranked arrays.
+    Later occurrences of an index win, as in the reference."""
+    idx = jnp.concatenate([jnp.ravel(i) for i in indices])
+    flat = jnp.concatenate([d.reshape(len(jnp.ravel(i)), *d.shape[i.ndim:])
+                            for i, d in zip(indices, data)])
+    n = int(idx.max()) + 1
+    out = jnp.zeros((n,) + flat.shape[1:], dtype=flat.dtype)
+    return out.at[idx].set(flat)
+
+
+# ------------------------------------------------------------------ scatter
+
+
+op("scatterMul", "shape")(lambda ref, idx, upd: ref.at[idx].mul(upd))
+op("scatterDiv", "shape")(lambda ref, idx, upd: ref.at[idx].divide(upd))
+
+
+@op("scatterNd", "shape")
+def scatter_nd(indices, updates, shape):
+    out = jnp.zeros(shape, dtype=updates.dtype)
+    return out.at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+@op("scatterNdAdd", "shape")
+def scatter_nd_add(ref, indices, updates):
+    return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+@op("scatterNdUpdate", "shape")
+def scatter_nd_update(ref, indices, updates):
+    return ref.at[tuple(jnp.moveaxis(indices, -1, 0))].set(updates)
+
+
+# ------------------------------------------------------------------- top-k
+
+
+@op("topK", "math")
+def top_k(x, k, sorted=True):
+    """(values, indices) along the last axis (ref: top_k.cpp)."""
+    return lax.top_k(x, k)
+
+
+@op("inTopK", "math")
+def in_top_k(predictions, targets, k):
+    """(B, C) predictions, (B,) int targets -> (B,) bool."""
+    target_scores = jnp.take_along_axis(predictions, targets[:, None], axis=-1)
+    higher = jnp.sum((predictions > target_scores).astype(jnp.int32), axis=-1)
+    return higher < k
+
+
+@op("kthValue", "math")
+def kth_value(x, k):
+    """k-th SMALLEST along the last axis (1-based, as the reference)."""
+    return jnp.sort(x, axis=-1)[..., k - 1]
+
+
+# ----------------------------------------------------------- sequence ops
+
+
+@op("sequenceMask", "shape")
+def sequence_mask(lengths, maxlen, dtype=jnp.bool_):
+    return (jnp.arange(maxlen) < jnp.asarray(lengths)[..., None]).astype(dtype)
+
+
+@op("reverseSequence", "shape")
+def reverse_sequence(x, seq_lengths, seq_axis=1, batch_axis=0):
+    """Reverse the first seq_lengths[b] elements of each batch row."""
+    x = jnp.moveaxis(x, (batch_axis, seq_axis), (0, 1))
+    T = x.shape[1]
+    ar = jnp.arange(T)
+    lens = jnp.asarray(seq_lengths)[:, None]
+    idx = jnp.where(ar[None, :] < lens, lens - 1 - ar[None, :], ar[None, :])
+    out = jnp.take_along_axis(x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return jnp.moveaxis(out, (0, 1), (batch_axis, seq_axis))
+
+
+@op("invertPermutation", "shape")
+def invert_permutation(p):
+    return jnp.zeros_like(p).at[p].set(jnp.arange(p.shape[0], dtype=p.dtype))
+
+
+@op("confusionMatrix", "math")
+def confusion_matrix(labels, predictions, num_classes, weights=None):
+    w = jnp.ones_like(labels, dtype=jnp.float32) if weights is None else weights
+    out = jnp.zeros((num_classes, num_classes), dtype=w.dtype)
+    return out.at[labels, predictions].add(w)
+
+
+@op("bincount", "math")
+def bincount(x, weights=None, minlength=0):
+    """EAGER-friendly; pass ``minlength`` for a static shape under jit."""
+    length = minlength if minlength > 0 else int(jnp.max(x)) + 1
+    return jnp.bincount(x, weights=weights, length=length)
+
+
+@op("histogramFixedWidth", "math")
+def histogram_fixed_width(x, value_range, nbins):
+    lo, hi = value_range
+    scaled = (x - lo) / (hi - lo) * nbins
+    idx = jnp.clip(scaled.astype(jnp.int32), 0, nbins - 1)
+    return jnp.zeros((nbins,), jnp.int32).at[jnp.ravel(idx)].add(1)
+
+
+# ----------------------------------------------------------- merge / clip
+
+
+op("mergeAdd", "math")(lambda arrays: sum(arrays[1:], arrays[0]))
+op("mergeAvg", "math")(lambda arrays: sum(arrays[1:], arrays[0]) / len(arrays))
+
+
+@op("mergeMax", "math")
+def merge_max(arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = jnp.maximum(out, a)
+    return out
+
+
+@op("clipByNorm", "math")
+def clip_by_norm(x, clip_norm, axes=None):
+    n = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=axes is not None))
+    return x * jnp.minimum(1.0, clip_norm / jnp.maximum(n, 1e-12))
+
+
+@op("clipByGlobalNorm", "math")
+def clip_by_global_norm(arrays, clip_norm):
+    g = jnp.sqrt(sum(jnp.sum(a * a) for a in arrays))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(g, 1e-12))
+    return [a * scale for a in arrays], g
+
+
+@op("clipByAvgNorm", "math")
+def clip_by_avg_norm(x, clip_norm):
+    n = jnp.sqrt(jnp.mean(x * x))
+    return x * jnp.minimum(1.0, clip_norm / jnp.maximum(n, 1e-12))
+
+
+# ------------------------------------------------------------ moments etc.
+
+
+@op("moments", "math")
+def moments(x, axes=None, keepdims=False):
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=keepdims)
+    if not keepdims:
+        mean = jnp.squeeze(mean, axis=axes) if axes is not None else jnp.squeeze(mean)
+    return mean, var
+
+
+@op("normalizeMoments", "math")
+def normalize_moments(counts, mean_ss, variance_ss, shift=None):
+    div = jnp.maximum(counts, 1.0)
+    shift = 0.0 if shift is None else shift
+    mean = mean_ss / div + shift
+    variance = variance_ss / div - (mean - shift) ** 2
+    return mean, variance
+
+
+@op("standardize", "math")
+def standardize(x, axis=-1):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    std = jnp.std(x, axis=axis, keepdims=True)
+    return (x - mean) / jnp.maximum(std, 1e-12)
+
+
+# ------------------------------------------------------- special functions
+
+
+op("digamma", "math")(jax.scipy.special.digamma)
+op("lgamma", "math")(jax.scipy.special.gammaln)
+op("zeta", "math")(jax.scipy.special.zeta)
+op("polygamma", "math")(lambda n, x: jax.scipy.special.polygamma(n, x))
+op("betainc", "math")(jax.scipy.special.betainc)
+op("igamma", "math")(jax.scipy.special.gammainc)
+op("igammac", "math")(jax.scipy.special.gammaincc)
+op("rint", "math")(jnp.rint)
+op("trunc", "math")(jnp.trunc)
+op("step", "math")(lambda x: (x > 0).astype(x.dtype))
+op("cross", "math")(jnp.cross)
+op("dot", "reduce")(lambda a, b: jnp.sum(a * b))
+op("logit", "math")(jax.scipy.special.logit)
+
+
+# --------------------------------------------------------- abs-reductions
+
+
+op("amax", "reduce")(lambda x, axis=None: jnp.max(jnp.abs(x), axis=axis))
+op("amin", "reduce")(lambda x, axis=None: jnp.min(jnp.abs(x), axis=axis))
+op("amean", "reduce")(lambda x, axis=None: jnp.mean(jnp.abs(x), axis=axis))
+op("asum", "reduce")(lambda x, axis=None: jnp.sum(jnp.abs(x), axis=axis))
+op("iamin", "reduce")(lambda x, axis=None: jnp.argmin(jnp.abs(x), axis=axis))
+op("zeroFraction", "reduce")(lambda x: jnp.mean((x == 0).astype(jnp.float32)))
+
+
+@op("entropy", "reduce")
+def entropy(x, axis=None):
+    return -jnp.sum(x * jnp.log(jnp.maximum(x, 1e-12)), axis=axis)
+
+
+@op("logEntropy", "reduce")
+def log_entropy(x, axis=None):
+    return jnp.log(entropy(x, axis=axis))
+
+
+@op("cosineDistance", "reduce")
+def cosine_distance(a, b, axis=None):
+    num = jnp.sum(a * b, axis=axis)
+    den = jnp.sqrt(jnp.sum(a * a, axis=axis) * jnp.sum(b * b, axis=axis))
+    return 1.0 - num / jnp.maximum(den, 1e-12)
+
+
+@op("jaccardDistance", "reduce")
+def jaccard_distance(a, b, axis=None):
+    num = jnp.sum(jnp.minimum(a, b), axis=axis)
+    den = jnp.sum(jnp.maximum(a, b), axis=axis)
+    return 1.0 - num / jnp.maximum(den, 1e-12)
+
+
+@op("firstIndex", "reduce")
+def first_index(x, condition, axis=None):
+    """First index where condition(x) holds; -1 if none (ref: FirstIndex)."""
+    m = condition(x)
+    idx = jnp.argmax(m, axis=axis)
+    found = jnp.any(m, axis=axis)
+    return jnp.where(found, idx, -1)
+
+
+@op("lastIndex", "reduce")
+def last_index(x, condition, axis=None):
+    m = condition(x)
+    if axis is None:
+        flat = jnp.ravel(m)
+        rev_idx = jnp.argmax(flat[::-1])
+        return jnp.where(jnp.any(flat), flat.shape[0] - 1 - rev_idx, -1)
+    rev = jnp.flip(m, axis=axis)
+    idx = m.shape[axis] - 1 - jnp.argmax(rev, axis=axis)
+    return jnp.where(jnp.any(m, axis=axis), idx, -1)
+
+
+# ----------------------------------------------------------------- creation
+
+
+op("eye", "shape")(lambda n, m=None, dtype=jnp.float32: jnp.eye(n, m, dtype=dtype))
+op("linspace", "shape")(lambda start, stop, num: jnp.linspace(start, stop, num))
+op("arange", "shape")(lambda start, stop=None, step=1: jnp.arange(start, stop, step))
+op("fill", "shape")(lambda shape, value, dtype=None: jnp.full(shape, value, dtype=dtype))
+op("meshgrid", "shape")(lambda *xs, indexing="xy": jnp.meshgrid(*xs, indexing=indexing))
+op("tri", "shape")(lambda n, m=None, k=0: jnp.tri(n, m, k))
+op("triu", "shape")(jnp.triu)
+op("tril", "shape")(jnp.tril)
+
+
+# ------------------------------------------------------------------ bitwise
+
+
+def _as_unsigned(x):
+    bits = x.dtype.itemsize * 8
+    return x.astype(jnp.dtype(f"uint{bits}")), bits
+
+
+@op("cyclicShiftLeft", "bitwise")
+def cyclic_shift_left(x, shift):
+    u, bits = _as_unsigned(x)
+    s = shift % bits
+    return ((u << s) | (u >> (bits - s))).astype(x.dtype)
+
+
+@op("cyclicShiftRight", "bitwise")
+def cyclic_shift_right(x, shift):
+    u, bits = _as_unsigned(x)
+    s = shift % bits
+    return ((u >> s) | (u << (bits - s))).astype(x.dtype)
+
+
+op("toggleBits", "bitwise")(jnp.invert)
+op("bitCount", "bitwise")(lambda x: lax.population_count(x))
+
+
+# ------------------------------------------------------------------- linalg
+
+
+op("pinv", "linalg")(jnp.linalg.pinv)
+op("slogdet", "linalg")(jnp.linalg.slogdet)
+op("logdet", "linalg")(lambda x: jnp.linalg.slogdet(x)[1])
+op("expm", "linalg")(jax.scipy.linalg.expm)
+op("kron", "linalg")(jnp.kron)
+op("lu", "linalg")(jax.scipy.linalg.lu)
+op("norm", "linalg")(jnp.linalg.norm)
+op("matrixPower", "linalg")(jnp.linalg.matrix_power)
+op("triangularSolve", "linalg")(
+    lambda a, b, lower=True: jax.scipy.linalg.solve_triangular(a, b, lower=lower))
+op("matrixDiagPart", "linalg")(lambda x: jnp.diagonal(x, axis1=-2, axis2=-1))
+
+
+# -------------------------------------------------------------------- image
+# Layout: NHWC float, channels-last (matches the existing image namespace).
+
+
+@op("resizeBicubic", "image")
+def resize_bicubic(x, size, data_format="NCHW"):
+    if data_format == "NCHW":
+        N, C, H, W = x.shape
+        return jax.image.resize(x, (N, C, size[0], size[1]), method="cubic")
+    N, H, W, C = x.shape
+    return jax.image.resize(x, (N, size[0], size[1], C), method="cubic")
+
+
+@op("resizeArea", "image")
+def resize_area(x, size, data_format="NCHW"):
+    """Area resize = average pooling when downscaling by integer factors;
+    general case falls back to linear (the reference's kernel does the same
+    box filter)."""
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    N, C, H, W = x.shape
+    if H % size[0] == 0 and W % size[1] == 0:
+        fh, fw = H // size[0], W // size[1]
+        out = x.reshape(N, C, size[0], fh, size[1], fw).mean(axis=(3, 5))
+    else:
+        out = jax.image.resize(x, (N, C, size[0], size[1]), method="linear")
+    return out if data_format == "NCHW" else jnp.moveaxis(out, 1, -1)
+
+
+@op("rgbToHsv", "image")
+def rgb_to_hsv(x):
+    """NHWC RGB in [0,1] -> HSV (ref: rgb_to_hsv.cpp)."""
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.max(x, axis=-1)
+    mn = jnp.min(x, axis=-1)
+    diff = mx - mn
+    safe = jnp.where(diff == 0, 1.0, diff)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0)) / 6.0
+    h = jnp.where(diff == 0, 0.0, h)
+    s = jnp.where(mx == 0, 0.0, diff / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+@op("hsvToRgb", "image")
+def hsv_to_rgb(x):
+    h, s, v = x[..., 0] * 6.0, x[..., 1], x[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r, g, b], axis=-1)
+
+
+@op("adjustHue", "image")
+def adjust_hue(x, delta):
+    hsv = rgb_to_hsv(x)
+    h = (hsv[..., 0] + delta) % 1.0
+    return hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+@op("adjustSaturation", "image")
+def adjust_saturation(x, factor):
+    hsv = rgb_to_hsv(x)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
+
+
+_YUV = jnp.array([[0.299, 0.587, 0.114],
+                  [-0.14714119, -0.28886916, 0.43601035],
+                  [0.61497538, -0.51496512, -0.10001026]])
+
+
+@op("rgbToYuv", "image")
+def rgb_to_yuv(x):
+    return jnp.einsum("...c,kc->...k", x, _YUV.astype(x.dtype))
+
+
+@op("yuvToRgb", "image")
+def yuv_to_rgb(x):
+    inv = jnp.linalg.inv(_YUV).astype(x.dtype)
+    return jnp.einsum("...c,kc->...k", x, inv)
+
+
+@op("flipLeftRight", "image")
+def flip_left_right(x):
+    """NHWC."""
+    return jnp.flip(x, axis=-2)
+
+
+@op("flipUpDown", "image")
+def flip_up_down(x):
+    return jnp.flip(x, axis=-3)
+
+
+@op("rot90", "image")
+def rot90(x, k=1):
+    return jnp.rot90(x, k=k, axes=(-3, -2))
+
+
+@op("extractImagePatches", "image")
+def extract_image_patches(x, ksize, stride, data_format="NHWC"):
+    """(B, H', W', kh*kw*C) patches (ref: extract_image_patches.cpp)."""
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    C = x.shape[1]
+    p = lax.conv_general_dilated_patches(x, filter_shape=ksize,
+                                         window_strides=stride, padding="VALID")
+    # (B, C*kh*kw, H', W') channel-major -> TF's (kh, kw, C) minor order
+    B, _, Ho, Wo = p.shape
+    p = p.reshape(B, C, ksize[0], ksize[1], Ho, Wo)
+    p = jnp.moveaxis(p, (2, 3, 1), (3, 4, 5))  # B, Ho, Wo, kh, kw, C
+    return p.reshape(B, Ho, Wo, ksize[0] * ksize[1] * C)
+
+
+# ---------------------------------------------------------------- cnn extras
+
+
+@op("cropping1d", "cnn")
+def cropping1d(x, crop):
+    """(B, T, C); crop=(lo, hi)."""
+    return x[:, crop[0]:x.shape[1] - crop[1]]
+
+
+@op("cropping3d", "cnn")
+def cropping3d(x, crop):
+    """NCDHW; crop=((d0,d1),(h0,h1),(w0,w1))."""
+    (d0, d1), (h0, h1), (w0, w1) = crop
+    return x[:, :, d0:x.shape[2] - d1, h0:x.shape[3] - h1, w0:x.shape[4] - w1]
+
+
+@op("zeroPadding1d", "cnn")
+def zero_padding1d(x, pad):
+    return jnp.pad(x, ((0, 0), (pad[0], pad[1]), (0, 0)))
+
+
+@op("zeroPadding3d", "cnn")
+def zero_padding3d(x, pad):
+    (d0, d1), (h0, h1), (w0, w1) = pad
+    return jnp.pad(x, ((0, 0), (0, 0), (d0, d1), (h0, h1), (w0, w1)))
+
+
+@op("upsampling1d", "cnn")
+def upsampling1d(x, size):
+    """(B, T, C) -> repeat time axis."""
+    return jnp.repeat(x, size, axis=1)
+
+
+@op("upsampling3d", "cnn")
+def upsampling3d(x, size):
+    """NCDHW."""
+    x = jnp.repeat(x, size[0], axis=2)
+    x = jnp.repeat(x, size[1], axis=3)
+    return jnp.repeat(x, size[2], axis=4)
+
+
+@op("spaceToBatch", "cnn")
+def space_to_batch(x, block, pads):
+    """NHWC (ref: space_to_batch.cpp)."""
+    x = jnp.pad(x, ((0, 0), tuple(pads[0]), tuple(pads[1]), (0, 0)))
+    B, H, W, C = x.shape
+    x = x.reshape(B, H // block, block, W // block, block, C)
+    x = jnp.moveaxis(x, (2, 4), (0, 1))
+    return x.reshape(B * block * block, H // block, W // block, C)
+
+
+@op("batchToSpace", "cnn")
+def batch_to_space(x, block, crops):
+    BB, H, W, C = x.shape
+    B = BB // (block * block)
+    x = x.reshape(block, block, B, H, W, C)
+    x = jnp.moveaxis(x, (0, 1), (2, 4))
+    x = x.reshape(B, H * block, W * block, C)
+    (c00, c01), (c10, c11) = crops
+    return x[:, c00:x.shape[1] - c01, c10:x.shape[2] - c11]
+
+
+@op("col2im", "cnn")
+def col2im(cols, out_hw, ksize, stride):
+    """Inverse of im2col: (B, C*kh*kw, Ho, Wo) -> (B, C, H, W) with
+    overlap-add (matches this registry's im2col output layout)."""
+    B, CKK = cols.shape[:2]
+    kh, kw = ksize
+    C = CKK // (kh * kw)
+    H, W = out_hw
+    Ho = (H - kh) // stride[0] + 1
+    Wo = (W - kw) // stride[1] + 1
+    cols = cols.reshape(B, C, kh, kw, Ho, Wo)
+    out = jnp.zeros((B, C, H, W), cols.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i:i + Ho * stride[0]:stride[0],
+                         j:j + Wo * stride[1]:stride[1]].add(cols[:, :, i, j])
+    return out
+
+
+# ---------------------------------------------------------------- nn extras
+
+
+op("logSigmoid", "nn")(jax.nn.log_sigmoid)
+op("hardSwish", "nn")(jax.nn.hard_swish)
+op("glu", "nn")(lambda x, axis=-1: jax.nn.glu(x, axis=axis))
+op("crelu", "nn")(lambda x: jnp.concatenate([jax.nn.relu(x), jax.nn.relu(-x)], axis=-1))
+
+
+@op("layerNormNoBias", "nn")
+def layer_norm_no_bias(x, gain, axis=-1, eps=1e-5):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * gain
+
+
+# ------------------------------------------------------------------- random
+
+
+op("gumbel", "random")(lambda key, shape: jax.random.gumbel(key, shape))
+op("laplace", "random")(lambda key, shape: jax.random.laplace(key, shape))
+op("poisson", "random")(lambda key, lam, shape: jax.random.poisson(key, lam, shape))
+op("binomial", "random")(
+    lambda key, n, p, shape: jax.random.binomial(key, n, p, shape=shape))
+op("rademacher", "random")(lambda key, shape: jax.random.rademacher(key, shape))
+op("categorical", "random")(
+    lambda key, logits, shape=None: jax.random.categorical(key, logits, shape=shape))

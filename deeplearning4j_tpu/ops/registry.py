@@ -83,6 +83,8 @@ class EagerNamespace:
             raise AttributeError(f"no op {self._namespace}.{name}")
 
         def wrap_out(out):
+            if isinstance(out, tuple) and hasattr(out, "_fields"):  # namedtuple
+                return type(out)(*(wrap_out(o) for o in out))
             if isinstance(out, (tuple, list)):
                 return type(out)(wrap_out(o) for o in out)
             if isinstance(out, (int, float, bool)):
