@@ -1,27 +1,16 @@
-"""ONNX import entry point (ref: nd4j/samediff-import-onnx —
-OnnxFrameworkImporter). The ``onnx`` package is not available in this build
-environment, so the importer is gated: it raises at call time with guidance
-rather than at import time (environment policy: stub or gate optional deps)."""
-from __future__ import annotations
+"""ONNX import (ref: nd4j/samediff-import-onnx — OnnxFrameworkImporter).
 
+The pip ``onnx`` package is absent in this environment; the wire format is
+parsed with protoc-generated bindings from onnx_minimal.proto (a hand-written
+subset of the public ONNX IR schema with matching field numbers, so real
+.onnx files parse byte-compatibly).
+"""
+from deeplearning4j_tpu.modelimport.onnx.importer import (
+    OnnxFrameworkImporter,
+    numpy_to_tensor,
+    tensor_to_numpy,
+)
+from deeplearning4j_tpu.modelimport.onnx import onnx_minimal_pb2 as onnx_pb
 
-class OnnxFrameworkImporter:
-    """(ref: org.nd4j.samediff.frameworkimport.onnx.importer.OnnxFrameworkImporter)."""
-
-    @staticmethod
-    def runImport(path: str):
-        try:
-            import onnx  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "ONNX import requires the 'onnx' package, which is not "
-                "installed in this environment. Convert the model to a TF "
-                "frozen graph or Keras h5 and use "
-                "modelimport.tensorflow.TensorflowFrameworkImporter / "
-                "modelimport.keras.KerasModelImport instead.") from e
-        raise NotImplementedError(
-            "onnx runtime mapping not yet implemented; TF and Keras import "
-            "cover the reference corpus (SURVEY.md §2.2 samediff-import)")
-
-
-__all__ = ["OnnxFrameworkImporter"]
+__all__ = ["OnnxFrameworkImporter", "onnx_pb", "numpy_to_tensor",
+           "tensor_to_numpy"]

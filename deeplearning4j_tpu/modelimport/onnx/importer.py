@@ -1,0 +1,678 @@
+"""ONNX ModelProto -> SameDiff import (ref: nd4j/samediff-import-onnx —
+OnnxFrameworkImporter.runImport + per-op OnnxMappingProcess rules).
+
+Same declarative architecture as the TF importer (one rule per op_type,
+emitting shared-registry ops onto a SameDiff graph), with two ONNX-specific
+simplifications:
+
+- ONNX is **NCHW-native** for conv/pool, matching this framework's cnn ops —
+  no layout transposes are needed (the TF path wraps every spatial op in
+  NHWC<->NCHW permutes).
+- Attribute-carrying inputs (Reshape shapes, Slice starts/ends, Clip bounds)
+  are initializers or Constant nodes in practice; the importer resolves them
+  eagerly to python values, as the reference's mapping rules read initializer
+  protos.
+
+The wire format is parsed with protoc-generated bindings from a hand-written
+subset of the public ONNX schema (onnx_minimal.proto) — the pip ``onnx``
+package is not required.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+from deeplearning4j_tpu.modelimport.onnx import onnx_minimal_pb2 as onnx_pb
+
+# TensorProto.DataType -> numpy
+_NP_DT = {
+    1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
+    6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64,
+    12: np.uint32, 13: np.uint64,
+}
+
+
+def tensor_to_numpy(t) -> np.ndarray:
+    """Decode a TensorProto (raw_data or typed repeated fields)."""
+    dt = _NP_DT.get(t.data_type)
+    if dt is None:
+        raise ValueError(f"unsupported ONNX tensor dtype {t.data_type}")
+    dims = tuple(t.dims)
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=dt)
+    elif t.float_data:
+        arr = np.asarray(list(t.float_data), dtype=dt)
+    elif t.int64_data:
+        arr = np.asarray(list(t.int64_data), dtype=dt)
+    elif t.int32_data:
+        arr = np.asarray(list(t.int32_data), dtype=dt)
+    elif t.double_data:
+        arr = np.asarray(list(t.double_data), dtype=dt)
+    else:
+        arr = np.zeros(int(np.prod(dims)) if dims else 1, dtype=dt)
+    return arr.reshape(dims)
+
+
+def numpy_to_tensor(name: str, arr: np.ndarray):
+    """Encode (used by tests / model writers)."""
+    rev = {np.dtype(v): k for k, v in _NP_DT.items()}
+    t = onnx_pb.TensorProto()
+    t.name = name
+    t.data_type = rev[arr.dtype]
+    t.dims.extend(arr.shape)
+    t.raw_data = arr.tobytes()
+    return t
+
+
+class OnnxFrameworkImporter:
+    """(ref: org.nd4j.samediff.frameworkimport.onnx.importer.
+    OnnxFrameworkImporter)."""
+
+    @staticmethod
+    def runImport(model_or_path) -> SameDiff:
+        model = _load_model(model_or_path)
+        return _OnnxGraphImporter(model).run()
+
+
+def _load_model(src):
+    if isinstance(src, onnx_pb.ModelProto):
+        return src
+    m = onnx_pb.ModelProto()
+    if isinstance(src, bytes):
+        m.ParseFromString(src)
+        return m
+    with open(src, "rb") as f:
+        m.ParseFromString(f.read())
+    return m
+
+
+def _attrs(node) -> Dict[str, Any]:
+    out = {}
+    for a in node.attribute:
+        T = onnx_pb.AttributeProto
+        if a.type == T.FLOAT:
+            out[a.name] = a.f
+        elif a.type == T.INT:
+            out[a.name] = int(a.i)
+        elif a.type == T.STRING:
+            out[a.name] = a.s.decode("utf-8")
+        elif a.type == T.TENSOR:
+            out[a.name] = tensor_to_numpy(a.t)
+        elif a.type == T.FLOATS:
+            out[a.name] = list(a.floats)
+        elif a.type == T.INTS:
+            out[a.name] = [int(i) for i in a.ints]
+        elif a.type == T.STRINGS:
+            out[a.name] = [s.decode("utf-8") for s in a.strings]
+        else:
+            out[a.name] = a
+    return out
+
+
+def _onnx_pads(pads: List[int], spatial: int):
+    """ONNX pads = [b1..bn, e1..en] -> ((b1,e1), ...)."""
+    if not pads:
+        return [(0, 0)] * spatial
+    return list(zip(pads[:spatial], pads[spatial:]))
+
+
+class _OnnxGraphImporter:
+    def __init__(self, model):
+        self.model = model
+        self.g = model.graph
+        self.sd = SameDiff.create()
+        self.vars: Dict[str, SDVariable] = {}
+        self.consts: Dict[str, np.ndarray] = {}
+
+    # ------------------------------------------------------------- helpers
+    def _in(self, node, i) -> SDVariable:
+        return self.vars[node.input[i]]
+
+    def _opt(self, node, i):
+        if i < len(node.input) and node.input[i]:
+            return self.vars[node.input[i]]
+        return None
+
+    def _const(self, node, i) -> np.ndarray:
+        name = node.input[i]
+        if name not in self.consts:
+            raise ValueError(
+                f"input {i} of {node.name or node.op_type} must be an "
+                f"initializer/Constant (dynamic attribute inputs unsupported)")
+        return self.consts[name]
+
+    def _emit(self, ns, opname, inputs, name, **kw) -> SDVariable:
+        return self.sd._op(ns, opname, inputs, name=name, **kw)
+
+    def _register(self, node, outs):
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        for ref, o in zip(node.output, outs):
+            if ref:
+                self.vars[ref] = o
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> SameDiff:
+        import jax.numpy as jnp
+        init_names = set()
+        for t in self.g.initializer:
+            arr = tensor_to_numpy(t)
+            self.consts[t.name] = arr
+            self.vars[t.name] = self.sd.constant(t.name, arr)
+            init_names.add(t.name)
+        for vi in self.g.input:
+            if vi.name in init_names:
+                continue  # pre-IR4 models list initializers as inputs too
+            shape = None
+            tt = vi.type.tensor_type
+            if tt.shape.dim:
+                shape = tuple(d.dim_value if d.dim_value > 0 else None
+                              for d in tt.shape.dim)
+            dt = jnp.dtype(_NP_DT.get(tt.elem_type, np.float32))
+            self.vars[vi.name] = self.sd.placeHolder(vi.name, shape=shape, dtype=dt)
+        for node in self.g.node:
+            self._map_node(node)
+        # expose graph outputs under their ONNX names via identity when a
+        # node output name differs from the var name (they coincide here,
+        # since vars are registered by tensor name)
+        return self.sd
+
+    def outputs(self) -> List[str]:
+        return [o.name for o in self.g.output]
+
+    def _map_node(self, node):
+        op = node.op_type
+        rule = _RULES.get(op)
+        if rule is None:
+            raise ValueError(f"ONNX op '{op}' (node {node.name}) has no "
+                             f"mapping rule (ref: OpMappingRegistry lookup)")
+        out = rule(self, node)
+        if out is not None:
+            self._register(node, out)
+            # eager const folding for attribute-carrying chains
+            # (Shape->Gather->Unsqueeze->Concat feeding a Reshape)
+            if all((not i) or i in self.consts for i in node.input) and node.input:
+                try:
+                    outs = out if isinstance(out, (tuple, list)) else [out]
+                    for ref, o in zip(node.output, outs):
+                        self.consts[ref] = np.asarray(o.eval({}).toNumpy())
+                except Exception:
+                    pass
+
+
+_RULES: Dict[str, Any] = {}
+
+
+def rule(*op_types):
+    def deco(fn):
+        for t in op_types:
+            _RULES[t] = fn
+        return fn
+    return deco
+
+
+# ------------------------------------------------------------- elementwise
+
+for _t, _ns, _o in [
+    ("Add", "math", "add"), ("Sub", "math", "sub"), ("Mul", "math", "mul"),
+    ("Div", "math", "div"), ("Pow", "math", "pow"),
+    ("Equal", "math", "eq"), ("Greater", "math", "gt"), ("Less", "math", "lt"),
+    ("GreaterOrEqual", "math", "gte"), ("LessOrEqual", "math", "lte"),
+    ("And", "math", "logicalAnd"), ("Or", "math", "logicalOr"),
+    ("Xor", "math", "logicalXor"), ("Min", "math", "min"), ("Max", "math", "max"),
+]:
+    _RULES[_t] = (lambda ns, o: lambda g, n: g._emit(
+        ns, o, [g._in(n, 0), g._in(n, 1)], n.output[0]))(_ns, _o)
+
+for _t, _ns, _o in [
+    ("Abs", "math", "abs"), ("Neg", "math", "neg"), ("Exp", "math", "exp"),
+    ("Log", "math", "log"), ("Sqrt", "math", "sqrt"),
+    ("Reciprocal", "math", "reciprocal"), ("Floor", "math", "floor"),
+    ("Ceil", "math", "ceil"), ("Round", "math", "round"), ("Sign", "math", "sign"),
+    ("Sin", "math", "sin"), ("Cos", "math", "cos"), ("Tan", "math", "tan"),
+    ("Asin", "math", "asin"), ("Acos", "math", "acos"), ("Atan", "math", "atan"),
+    ("Sinh", "math", "sinh"), ("Cosh", "math", "cosh"), ("Tanh", "math", "tanh"),
+    ("Erf", "math", "erf"), ("Not", "math", "logicalNot"),
+    ("Relu", "nn", "relu"), ("Sigmoid", "nn", "sigmoid"),
+    ("Softplus", "nn", "softplus"), ("Softsign", "nn", "softsign"),
+    ("Identity", "math", "identity"),
+]:
+    _RULES[_t] = (lambda ns, o: lambda g, n: g._emit(
+        ns, o, [g._in(n, 0)], n.output[0]))(_ns, _o)
+
+
+@rule("Constant")
+def _constant(g, n):
+    a = _attrs(n)
+    if "value" in a:
+        val = a["value"]
+    elif "value_float" in a:
+        val = np.float32(a["value_float"])
+    elif "value_int" in a:
+        val = np.int64(a["value_int"])
+    elif "value_floats" in a:
+        val = np.asarray(a["value_floats"], np.float32)
+    elif "value_ints" in a:
+        val = np.asarray(a["value_ints"], np.int64)
+    else:
+        raise ValueError("Constant node without value attribute")
+    g.consts[n.output[0]] = np.asarray(val)
+    return g.sd.constant(n.output[0], np.asarray(val))
+
+
+@rule("LeakyRelu")
+def _leaky(g, n):
+    alpha = _attrs(n).get("alpha", 0.01)
+    return g._emit("nn", "leakyRelu", [g._in(n, 0)], n.output[0], alpha=alpha)
+
+
+@rule("Elu")
+def _elu(g, n):
+    alpha = _attrs(n).get("alpha", 1.0)
+    return g._emit("nn", "elu", [g._in(n, 0)], n.output[0], alpha=alpha)
+
+
+@rule("Selu")
+def _selu(g, n):
+    return g._emit("nn", "selu", [g._in(n, 0)], n.output[0])
+
+
+@rule("HardSigmoid")
+def _hard_sigmoid(g, n):
+    a = _attrs(n)
+    alpha, beta = a.get("alpha", 0.2), a.get("beta", 0.5)
+    x = g._in(n, 0)
+    ax = g._emit("math", "mul", [x, alpha], f"{n.output[0]}/ax")
+    axb = g._emit("math", "add", [ax, beta], f"{n.output[0]}/axb")
+    return g._emit("math", "clipByValue", [axb], n.output[0], lo=0.0, hi=1.0)
+
+
+@rule("PRelu")
+def _prelu(g, n):
+    return g._emit("nn", "prelu", [g._in(n, 0), g._in(n, 1)], n.output[0])
+
+
+@rule("Softmax")
+def _softmax(g, n):
+    axis = _attrs(n).get("axis", -1)
+    return g._emit("nn", "softmax", [g._in(n, 0)], n.output[0], axis=axis)
+
+
+@rule("LogSoftmax")
+def _log_softmax(g, n):
+    axis = _attrs(n).get("axis", -1)
+    return g._emit("nn", "logSoftmax", [g._in(n, 0)], n.output[0], axis=axis)
+
+
+@rule("Clip")
+def _clip(g, n):
+    a = _attrs(n)
+    if "min" in a or "max" in a:  # opset < 11
+        lo, hi = a.get("min", -np.inf), a.get("max", np.inf)
+    else:
+        lo = float(g._const(n, 1)) if len(n.input) > 1 and n.input[1] else -np.inf
+        hi = float(g._const(n, 2)) if len(n.input) > 2 and n.input[2] else np.inf
+    return g._emit("math", "clipByValue", [g._in(n, 0)], n.output[0], lo=lo, hi=hi)
+
+
+@rule("Where")
+def _where(g, n):
+    return g._emit("shape", "where", [g._in(n, 0), g._in(n, 1), g._in(n, 2)],
+                   n.output[0])
+
+
+@rule("Cast")
+def _cast(g, n):
+    to = _NP_DT[_attrs(n)["to"]]
+    return g._emit("shape", "castTo", [g._in(n, 0)], n.output[0],
+                   dtype=np.dtype(to).name)
+
+
+@rule("Dropout")
+def _dropout(g, n):
+    # inference import: dropout is identity (ref: the reference imports
+    # Dropout as noop outside training)
+    return g._emit("math", "identity", [g._in(n, 0)], n.output[0])
+
+
+# ------------------------------------------------------------------ matmul
+
+
+@rule("MatMul")
+def _matmul(g, n):
+    return g._emit("linalg", "matmul", [g._in(n, 0), g._in(n, 1)], n.output[0])
+
+
+@rule("Gemm")
+def _gemm(g, n):
+    a = _attrs(n)
+    alpha, beta = a.get("alpha", 1.0), a.get("beta", 1.0)
+    transA, transB = a.get("transA", 0), a.get("transB", 0)
+    A, B = g._in(n, 0), g._in(n, 1)
+    if transA:
+        A = g._emit("shape", "transpose", [A], f"{n.output[0]}/At")
+    if transB:
+        B = g._emit("shape", "transpose", [B], f"{n.output[0]}/Bt")
+    out = g._emit("linalg", "matmul", [A, B], f"{n.output[0]}/mm")
+    if alpha != 1.0:
+        out = g._emit("math", "mul", [out, alpha], f"{n.output[0]}/alpha")
+    if len(n.input) > 2 and n.input[2]:
+        C = g._in(n, 2)
+        if beta != 1.0:
+            C = g._emit("math", "mul", [C, beta], f"{n.output[0]}/beta")
+        out = g._emit("math", "add", [out, C], n.output[0])
+    else:
+        out = g._emit("math", "identity", [out], n.output[0])
+    return out
+
+
+# ------------------------------------------------------------ conv / pool
+
+
+@rule("Conv")
+def _conv(g, n):
+    a = _attrs(n)
+    w = g._in(n, 1)
+    b = g._opt(n, 2)
+    kshape = a.get("kernel_shape") or list(g._const(n, 1).shape[2:])
+    spatial = len(kshape)
+    strides = tuple(a.get("strides", [1] * spatial))
+    dilations = tuple(a.get("dilations", [1] * spatial))
+    groups = a.get("group", 1)
+    auto_pad = a.get("auto_pad", "NOTSET")
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+        padding = "SAME"
+    elif auto_pad == "VALID" or not a.get("pads"):
+        padding = "VALID" if not a.get("pads") else _onnx_pads(a["pads"], spatial)
+    else:
+        padding = _onnx_pads(a["pads"], spatial)
+    inputs = [g._in(n, 0), w] + ([b] if b is not None else [])
+    if spatial == 2:
+        return g._emit("cnn", "conv2d", inputs, n.output[0], strides=strides,
+                       padding=padding, dilation=dilations, groups=groups)
+    if spatial == 1:
+        if groups != 1:
+            raise ValueError("grouped Conv1d import unsupported")
+        return g._emit("cnn", "conv1d", inputs, n.output[0],
+                       strides=strides[0], padding=padding)
+    if groups != 1:
+        raise ValueError("grouped Conv3d import unsupported")
+    return g._emit("cnn", "conv3d", inputs, n.output[0], strides=strides,
+                   padding=padding)
+
+
+def _pool_rule(kind):
+    def fn(g, n):
+        a = _attrs(n)
+        kshape = a["kernel_shape"]
+        spatial = len(kshape)
+        strides = tuple(a.get("strides", [1] * spatial))
+        auto_pad = a.get("auto_pad", "NOTSET")
+        if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+            padding = "SAME"
+        elif a.get("pads"):
+            padding = _onnx_pads(a["pads"], spatial)
+        else:
+            padding = "VALID"
+        opname = {1: f"{kind}Pool1d", 2: f"{kind}Pool2d", 3: f"{kind}Pool3d"}[spatial]
+        kernel = kshape[0] if spatial == 1 else tuple(kshape)
+        stride = strides[0] if spatial == 1 else strides
+        return g._emit("cnn", opname, [g._in(n, 0)], n.output[0],
+                       kernel=kernel, strides=stride, padding=padding)
+    return fn
+
+
+_RULES["MaxPool"] = _pool_rule("max")
+_RULES["AveragePool"] = _pool_rule("avg")
+
+
+@rule("GlobalAveragePool")
+def _gap(g, n):
+    return g._emit("cnn", "globalAvgPool", [g._in(n, 0)], n.output[0],
+                   keepdims=True)
+
+
+@rule("GlobalMaxPool")
+def _gmp(g, n):
+    return g._emit("cnn", "globalMaxPool", [g._in(n, 0)], n.output[0],
+                   keepdims=True)
+
+
+@rule("BatchNormalization")
+def _batchnorm(g, n):
+    eps = _attrs(n).get("epsilon", 1e-5)
+    x, scale, bias, mean, var = (g._in(n, i) for i in range(5))
+    return g._emit("nn", "batchNorm", [x, mean, var, scale, bias], n.output[0],
+                   eps=eps, axis=1)
+
+
+@rule("InstanceNormalization")
+def _instancenorm(g, n):
+    eps = _attrs(n).get("epsilon", 1e-5)
+    x, scale, bias = g._in(n, 0), g._in(n, 1), g._in(n, 2)
+    # normalize over spatial dims per-sample per-channel
+    return g._emit("nn", "instanceNorm", [x, scale, bias], n.output[0], eps=eps)
+
+
+@rule("LRN")
+def _lrn(g, n):
+    a = _attrs(n)
+    size = a.get("size", 5)
+    return g._emit("nn", "lrn", [g._in(n, 0)], n.output[0],
+                   depth_radius=(size - 1) // 2, bias=a.get("bias", 1.0),
+                   alpha=a.get("alpha", 1e-4) / size, beta=a.get("beta", 0.75))
+
+
+@rule("Flatten")
+def _flatten(g, n):
+    """ONNX Flatten: 2D output (prod(dims[:axis]), prod(dims[axis:]))."""
+    axis = _attrs(n).get("axis", 1)
+    x = g._in(n, 0)
+    dims = list(x.shape or ())
+    lead, tail = dims[:axis], dims[axis:]
+    if all(d is not None for d in tail):
+        shape = (-1, int(np.prod(tail)) if tail else 1)
+    elif all(d is not None for d in lead):
+        shape = (int(np.prod(lead)) if lead else 1, -1)
+    else:
+        raise ValueError(f"Flatten {n.name}: unresolvable shape {dims}")
+    return g._emit("shape", "reshape", [x], n.output[0], shape=shape)
+
+
+# ------------------------------------------------------------ shape ops
+
+
+@rule("Reshape")
+def _reshape(g, n):
+    shape = [int(s) for s in g._const(n, 1)]
+    return g._emit("shape", "reshape", [g._in(n, 0)], n.output[0], shape=shape)
+
+
+@rule("Transpose")
+def _transpose(g, n):
+    perm = _attrs(n).get("perm")
+    if perm is None:
+        return g._emit("shape", "transpose", [g._in(n, 0)], n.output[0])
+    return g._emit("shape", "permute", [g._in(n, 0)], n.output[0],
+                   axes=tuple(perm))
+
+
+@rule("Concat")
+def _concat(g, n):
+    axis = _attrs(n)["axis"]
+    ins = [g.vars[i] for i in n.input]
+    return g._emit("shape", "concatN", ins, n.output[0], axis=axis)
+
+
+@rule("Split")
+def _split(g, n):
+    a = _attrs(n)
+    axis = a.get("axis", 0)
+    x = g._in(n, 0)
+    if "split" in a:
+        sizes = a["split"]
+    elif len(n.input) > 1 and n.input[1]:
+        sizes = [int(s) for s in g._const(n, 1)]
+    else:
+        sizes = None
+    if axis < 0:
+        axis += len(x.shape or ())
+    if sizes is None:
+        num = len(n.output)
+        outs = g._emit("shape", "splitN", [x], n.output[0], num=num, axis=axis)
+        return list(outs) if isinstance(outs, (tuple, list)) else [outs]
+    outs = []
+    start = 0
+    for i, s in enumerate(sizes):
+        sl = [slice(None)] * axis + [slice(start, start + s)]
+        outs.append(g._emit("shape", "stridedSlice", [x], n.output[i],
+                            slices=tuple(sl)))
+        start += s
+    return outs
+
+
+@rule("Squeeze")
+def _squeeze(g, n):
+    a = _attrs(n)
+    axes = a.get("axes")
+    if axes is None and len(n.input) > 1 and n.input[1]:
+        axes = [int(i) for i in g._const(n, 1)]
+    return g._emit("shape", "squeeze", [g._in(n, 0)], n.output[0],
+                   axis=tuple(axes) if axes else None)
+
+
+@rule("Unsqueeze")
+def _unsqueeze(g, n):
+    a = _attrs(n)
+    axes = a.get("axes")
+    if axes is None:
+        axes = [int(i) for i in g._const(n, 1)]
+    out = g._in(n, 0)
+    for i, ax in enumerate(sorted(axes)):
+        nm = n.output[0] if i == len(axes) - 1 else f"{n.output[0]}/u{i}"
+        out = g._emit("shape", "expandDims", [out], nm, axis=ax)
+    return out
+
+
+@rule("Gather")
+def _gather(g, n):
+    axis = _attrs(n).get("axis", 0)
+    return g._emit("shape", "gather", [g._in(n, 0), g._in(n, 1)], n.output[0],
+                   axis=axis)
+
+
+@rule("Slice")
+def _slice(g, n):
+    a = _attrs(n)
+    if "starts" in a:  # opset < 10
+        starts, ends = a["starts"], a["ends"]
+        axes = a.get("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    else:
+        starts = [int(i) for i in g._const(n, 1)]
+        ends = [int(i) for i in g._const(n, 2)]
+        axes = ([int(i) for i in g._const(n, 3)]
+                if len(n.input) > 3 and n.input[3] else list(range(len(starts))))
+        steps = ([int(i) for i in g._const(n, 4)]
+                 if len(n.input) > 4 and n.input[4] else [1] * len(starts))
+    x = g._in(n, 0)
+    rank = len(x.shape or ())
+    INT_MAX = 2 ** 31 - 1
+    slices = [slice(None)] * rank
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        if ax < 0:
+            ax += rank
+        en = None if en >= INT_MAX else en  # INT64_MAX/INT32_MAX = "to end"
+        slices[ax] = slice(st, en, sp)
+    return g._emit("shape", "stridedSlice", [x], n.output[0],
+                   slices=tuple(slices))
+
+
+@rule("Pad")
+def _pad_rule(g, n):
+    a = _attrs(n)
+    mode = a.get("mode", "constant")
+    if mode != "constant":
+        raise ValueError(f"Pad mode {mode} unsupported")
+    if "pads" in a:
+        pads = a["pads"]
+        value = a.get("value", 0.0)
+    else:
+        pads = [int(i) for i in g._const(n, 1)]
+        value = float(g._const(n, 2)) if len(n.input) > 2 and n.input[2] else 0.0
+    rank = len(pads) // 2
+    pairs = [(pads[i], pads[i + rank]) for i in range(rank)]
+    return g._emit("shape", "pad", [g._in(n, 0)], n.output[0],
+                   paddings=pairs, value=value)
+
+
+@rule("Expand")
+def _expand(g, n):
+    shape = [int(s) for s in g._const(n, 1)]
+    return g._emit("shape", "broadcastTo", [g._in(n, 0)], n.output[0],
+                   shape=shape)
+
+
+@rule("Shape")
+def _shape(g, n):
+    return g._emit("shape", "shapeOf", [g._in(n, 0)], n.output[0])
+
+
+@rule("ConstantOfShape")
+def _const_of_shape(g, n):
+    shape = [int(s) for s in g._const(n, 0)]
+    val = _attrs(n).get("value")
+    fill = float(val.ravel()[0]) if val is not None else 0.0
+    dtype = val.dtype if val is not None else np.float32
+    arr = np.full(shape, fill, dtype=dtype)
+    g.consts[n.output[0]] = arr
+    return g.sd.constant(n.output[0], arr)
+
+
+@rule("Tile")
+def _tile(g, n):
+    reps = [int(i) for i in g._const(n, 1)]
+    return g._emit("shape", "tile", [g._in(n, 0)], n.output[0], reps=reps)
+
+
+@rule("Range")
+def _range(g, n):
+    start, limit, delta = (float(g._const(n, i)) for i in range(3))
+    arr = np.arange(start, limit, delta)
+    g.consts[n.output[0]] = arr
+    return g.sd.constant(n.output[0], arr)
+
+
+# ------------------------------------------------------------- reductions
+
+
+def _reduce_rule(opname):
+    def fn(g, n):
+        a = _attrs(n)
+        axes = a.get("axes")
+        if axes is None and len(n.input) > 1 and n.input[1]:
+            axes = [int(i) for i in g._const(n, 1)]
+        keepdims = bool(a.get("keepdims", 1))
+        return g._emit("reduce", opname, [g._in(n, 0)], n.output[0],
+                       dims=tuple(axes) if axes else None, keepdims=keepdims)
+    return fn
+
+
+for _t, _o in [("ReduceSum", "sum"), ("ReduceMean", "mean"), ("ReduceMax", "max"),
+               ("ReduceMin", "min"), ("ReduceProd", "prod")]:
+    _RULES[_t] = _reduce_rule(_o)
+
+
+@rule("ArgMax")
+def _argmax(g, n):
+    a = _attrs(n)
+    return g._emit("reduce", "argmax", [g._in(n, 0)], n.output[0],
+                   dims=a.get("axis", 0), keepdims=bool(a.get("keepdims", 1)))
+
+
+@rule("ArgMin")
+def _argmin(g, n):
+    a = _attrs(n)
+    return g._emit("reduce", "argmin", [g._in(n, 0)], n.output[0],
+                   dims=a.get("axis", 0), keepdims=bool(a.get("keepdims", 1)))

@@ -587,6 +587,18 @@ def layer_norm_no_bias(x, gain, axis=-1, eps=1e-5):
     return (x - mean) / jnp.sqrt(var + eps) * gain
 
 
+@op("instanceNorm", "nn")
+def instance_norm(x, scale, bias, eps=1e-5):
+    """Per-sample per-channel normalization over spatial dims; NC+spatial
+    layout (ref: instance_norm / ONNX InstanceNormalization)."""
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return ((x - mean) / jnp.sqrt(var + eps)) * scale.reshape(shape) \
+        + bias.reshape(shape)
+
+
 # ------------------------------------------------------------------- random
 
 

@@ -146,14 +146,15 @@ def _pad(padding, kernel, strides, dilation):
 
 @op("conv2d", "cnn")
 def conv2d(x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 1),
-           data_format="NCHW"):
+           data_format="NCHW", groups=1):
     """2D convolution (ref: libnd4j generic/nn/convo/conv2d.cpp).
-    x: NCHW, w: OIHW (out_ch, in_ch, kh, kw) by default."""
+    x: NCHW, w: OIHW (out_ch, in_ch/groups, kh, kw) by default."""
     dn = lax.conv_dimension_numbers(x.shape, w.shape, _dims(data_format, 2))
     out = lax.conv_general_dilated(
         x, w, window_strides=tuple(strides),
         padding=_pad(padding, w.shape[-2:], strides, dilation),
-        rhs_dilation=tuple(dilation), dimension_numbers=dn)
+        rhs_dilation=tuple(dilation), dimension_numbers=dn,
+        feature_group_count=groups)
     if b is not None:
         shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
         out = out + b.reshape(shape)
