@@ -241,10 +241,10 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
     param_sh = _shardings(cfg, mesh)
     bspec = NamedSharding(mesh, batch_pspec(mesh))
     batch_sh = {"tokens": bspec, "targets": bspec, "weights": bspec}
+    repl = NamedSharding(mesh, P())
 
     def init_state_sharded(params):
         st = tx.init(params)
-        repl = NamedSharding(mesh, P())
         placed = []
         for s in st:
             if hasattr(s, "mu"):  # ScaleByAdamState: mu/nu mirror the param tree
@@ -256,15 +256,38 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                 placed.append(jax.tree.map(lambda l: jax.device_put(l, repl), s))
         return tuple(placed)
 
+    # optimizer-state sharding tree, structurally derived via eval_shape so
+    # the jit contract pins OUTPUT shardings too — leaving out_shardings
+    # unconstrained lets GSPMD re-shard returned params (e.g. pos_emb onto
+    # 'context'), which then fails the next call's in_shardings check
+    abstract_params = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg))
+    opt_sh = []
+    for s in jax.eval_shape(tx.init, abstract_params):
+        if hasattr(s, "mu"):
+            opt_sh.append(s._replace(count=repl,
+                                     mu=jax.tree.map(lambda _, p: p, s.mu, param_sh),
+                                     nu=jax.tree.map(lambda _, p: p, s.nu, param_sh)))
+        else:
+            opt_sh.append(jax.tree.map(lambda _: repl, s))
+    opt_sh = tuple(opt_sh)
+
     jstep = jax.jit(step, donate_argnums=(0, 1),
-                    in_shardings=(param_sh, None, batch_sh))
+                    in_shardings=(param_sh, opt_sh, batch_sh),
+                    out_shardings=(param_sh, opt_sh, None))
     return init_state_sharded, jstep
 
 
 def _shardings(cfg: TransformerConfig, mesh: Mesh):
     """param_pspecs as a matching pytree of NamedShardings (PartitionSpec is a
-    pytree leaf, so a plain tree.map suffices)."""
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg))
+    pytree leaf, so a plain tree.map suffices). Axes absent from the mesh
+    (e.g. a pure-DP mesh with no 'model') degrade to replication on that dim."""
+
+    def fix(spec: P) -> P:
+        return P(*(a if (a is None or a in mesh.axis_names) else None
+                   for a in spec))
+
+    return jax.tree.map(lambda s: NamedSharding(mesh, fix(s)), param_pspecs(cfg))
 
 
 def place_params(params, cfg: TransformerConfig, mesh: Mesh):

@@ -15,11 +15,17 @@ import jax.numpy as jnp
 
 
 class Random:
-    """Stateful key holder; each draw splits a fresh subkey."""
+    """Stateful key holder; each draw splits a fresh subkey.
+
+    Key creation is LAZY (first draw, not construction): the module-level
+    singleton below is built at package import, and materializing a jax key
+    there would initialize the XLA backend — breaking
+    jax.distributed.initialize() for any process that imports the package
+    before joining the job."""
 
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
-        self._key = jax.random.key(seed)
+        self._key = None
         self._seed = seed
 
     def setSeed(self, seed: int):
@@ -30,13 +36,19 @@ class Random:
     def getSeed(self) -> int:
         return self._seed
 
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
     def nextKey(self) -> jax.Array:
         with self._lock:
+            self._ensure()
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def split(self, n: int):
         with self._lock:
+            self._ensure()
             keys = jax.random.split(self._key, n + 1)
             self._key = keys[0]
             return keys[1:]

@@ -443,20 +443,24 @@ def adjust_saturation(x, factor):
     return hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
 
 
-_YUV = jnp.array([[0.299, 0.587, 0.114],
+# numpy (not jnp): a module-level jnp.array would initialize the XLA backend
+# at import time, breaking jax.distributed.initialize-after-import
+import numpy as _np
+
+_YUV = _np.array([[0.299, 0.587, 0.114],
                   [-0.14714119, -0.28886916, 0.43601035],
                   [0.61497538, -0.51496512, -0.10001026]])
+_YUV_INV = _np.linalg.inv(_YUV)
 
 
 @op("rgbToYuv", "image")
 def rgb_to_yuv(x):
-    return jnp.einsum("...c,kc->...k", x, _YUV.astype(x.dtype))
+    return jnp.einsum("...c,kc->...k", x, jnp.asarray(_YUV, x.dtype))
 
 
 @op("yuvToRgb", "image")
 def yuv_to_rgb(x):
-    inv = jnp.linalg.inv(_YUV).astype(x.dtype)
-    return jnp.einsum("...c,kc->...k", x, inv)
+    return jnp.einsum("...c,kc->...k", x, jnp.asarray(_YUV_INV, x.dtype))
 
 
 @op("flipLeftRight", "image")

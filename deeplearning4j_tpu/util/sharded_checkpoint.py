@@ -1,0 +1,158 @@
+"""Sharded checkpointing + preemption grace for the flagship-scale models
+(ref: SURVEY.md §5.4 rebuild mapping — the reference's ModelSerializer zip
+handles host-memory models; sharded device state needs per-shard persistence,
+which orbax provides: each host writes its addressable shards, restore
+re-places them per a target sharding tree).
+
+Components:
+- ``ShardedCheckpointManager`` — orbax CheckpointManager wrapper with the
+  CheckpointListener-style retention contract (keep-last-k, save-every-N);
+  saves {params, opt_state, step} + a JSON metadata sidecar, restores into
+  a sharding-annotated abstract tree so arrays land directly on the mesh.
+- ``GracefulShutdown`` — SIGTERM/SIGINT grace (ref §5.3 failure-detection
+  mapping: preemption -> final checkpoint -> clean exit; TPU pods deliver
+  SIGTERM on eviction).
+- ``train_with_checkpointing`` — the reference's fit-with-CheckpointListener
+  loop for pjit train steps: resume-exact (params AND optimizer state) from
+  the latest checkpoint.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class GracefulShutdown:
+    """SIGTERM/SIGINT -> flag; training loops poll should_stop() and write a
+    final checkpoint before exiting. Restores prior handlers on __exit__."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = signals
+        self._stop = False
+        self._prev: Dict[int, Any] = {}
+
+    def __enter__(self):
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._handler)
+        return self
+
+    def _handler(self, signum, frame):
+        self._stop = True
+
+    def should_stop(self) -> bool:
+        return self._stop
+
+    def __exit__(self, *exc):
+        for s, h in self._prev.items():
+            signal.signal(s, h)
+        return False
+
+
+class ShardedCheckpointManager:
+    """keep-last-k / save-every-N sharded checkpoints (ref: CheckpointListener
+    retention + ModelSerializer, rebuilt over orbax for sharded trees)."""
+
+    def __init__(self, directory: str, keep_last: int = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep_last or None,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=False))
+
+    def save(self, step: int, params, opt_state, metadata: Optional[dict] = None,
+             force: bool = False) -> bool:
+        ocp = self._ocp
+        state = {"params": params, "opt_state": opt_state}
+        if step in self.manager.all_steps():
+            return True  # already durable (e.g. preemption save of a step
+            # the periodic save just wrote) — idempotent by contract
+        saved = self.manager.save(
+            step, args=ocp.args.Composite(state=ocp.args.StandardSave(state)),
+            force=force)
+        if saved and metadata:
+            with open(os.path.join(self.directory, str(step), "meta.json"), "w") as f:
+                json.dump(metadata, f)
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def all_steps(self):
+        return sorted(self.manager.all_steps())
+
+    def restore(self, params_like, opt_state_like, step: Optional[int] = None):
+        """Restore (params, opt_state, step, metadata). ``*_like`` may be live
+        trees OR jax.ShapeDtypeStruct trees with .sharding set — arrays are
+        materialized directly onto those shardings (no host round-trip)."""
+        ocp = self._ocp
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+
+        def abstract(leaf):
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return leaf
+            arr = jax.numpy.asarray(leaf) if not hasattr(leaf, "dtype") else leaf
+            sharding = getattr(arr, "sharding", None)
+            return jax.ShapeDtypeStruct(np.shape(arr), arr.dtype, sharding=sharding)
+
+        target = {"params": jax.tree.map(abstract, params_like),
+                  "opt_state": jax.tree.map(abstract, opt_state_like)}
+        restored = self.manager.restore(
+            step, args=self._ocp.args.Composite(
+                state=ocp.args.StandardRestore(target)))["state"]
+        meta_path = os.path.join(self.directory, str(step), "meta.json")
+        metadata = None
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                metadata = json.load(f)
+        return restored["params"], restored["opt_state"], step, metadata
+
+    def wait(self):
+        self.manager.wait_until_finished()
+
+    def close(self):
+        self.manager.close()
+
+
+def train_with_checkpointing(
+        step_fn: Callable, params, opt_state, batch_fn: Callable[[int], Any],
+        num_steps: int, manager: ShardedCheckpointManager,
+        start_step: int = 0, shutdown: Optional[GracefulShutdown] = None,
+        listeners=()) -> tuple:
+    """Run ``step_fn(params, opt_state, batch) -> (params, opt_state, loss)``
+    from ``start_step`` to ``num_steps`` with periodic checkpoints (manager's
+    save_interval_steps) and preemption grace: on SIGTERM a final checkpoint
+    is forced before returning. ``batch_fn(step)`` supplies the batch — keyed
+    by step so a resumed run replays the identical schedule (resume-exact).
+    Returns (params, opt_state, last_step_completed, losses)."""
+    losses = []
+    step = start_step
+    for step in range(start_step, num_steps):
+        batch = batch_fn(step)
+        params, opt_state, loss = step_fn(params, opt_state, batch)
+        losses.append(float(loss))
+        for lst in listeners:
+            lst.iterationDone(None, step, 0)
+        completed = step + 1
+        manager.save(completed, params, opt_state,
+                     metadata={"step": completed, "loss": float(loss)})
+        if shutdown is not None and shutdown.should_stop():
+            manager.save(completed, params, opt_state, force=True,
+                         metadata={"step": completed, "loss": float(loss),
+                                   "preempted": True})
+            manager.wait()
+            return params, opt_state, completed, losses
+    manager.wait()
+    return params, opt_state, num_steps, losses
