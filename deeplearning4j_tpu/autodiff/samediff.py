@@ -729,6 +729,23 @@ class SameDiff:
                     zf.writestr(f"values/{n}.npy", buf.getvalue())
                     manifest.append({"name": n, "type": self._vars[n].varType})
             zf.writestr("values.json", json.dumps(manifest))
+            if save_updater_state and self._training_config is not None:
+                from deeplearning4j_tpu.train import updaters as _updz
+                cfg = self._training_config
+                zf.writestr("training.json", json.dumps({
+                    "updater": cfg.updater.to_dict(),
+                    "dataSetFeatureMapping": cfg.dataSetFeatureMapping,
+                    "dataSetLabelMapping": cfg.dataSetLabelMapping,
+                    "minimize": cfg.minimize,
+                    "hasOptState": self._opt_state is not None,
+                }))
+                if self._opt_state is not None:
+                    import io
+                    leaves = jax.tree_util.tree_leaves(self._opt_state)
+                    for i, leaf in enumerate(leaves):
+                        buf = io.BytesIO()
+                        np.save(buf, np.asarray(leaf))
+                        zf.writestr(f"updaterState/{i}.npy", buf.getvalue())
 
     @staticmethod
     def load(path: str) -> "SameDiff":
@@ -761,6 +778,31 @@ class SameDiff:
                 if on not in sd._vars:
                     sd._vars[on] = SDVariable(sd, on, VariableType.ARRAY)
         sd._loss_vars = graph.get("loss", [])
+
+        # updater state: rebuild the optax tree structurally (tx.init on the
+        # restored trainables) and refill its leaves in flatten order — the
+        # exact-resume contract (ref: SameDiff FlatBuffers updaterState)
+        with zipfile.ZipFile(path) as zf:
+            names = set(zf.namelist())
+            if "training.json" in names:
+                import io
+                from deeplearning4j_tpu.train import updaters as _updz
+                tj = json.loads(zf.read("training.json"))
+                sd.setTrainingConfig(TrainingConfig(
+                    updater=_updz.from_dict(tj["updater"]),
+                    dataSetFeatureMapping=tj.get("dataSetFeatureMapping", []),
+                    dataSetLabelMapping=tj.get("dataSetLabelMapping", []),
+                    minimize=tj.get("minimize", True)))
+                if tj.get("hasOptState"):
+                    trainables = {n: sd._values[n] for n in sd._trainable_names()}
+                    skeleton = sd._tx.init(trainables)
+                    leaves, treedef = jax.tree_util.tree_flatten(skeleton)
+                    loaded = []
+                    for i, ref in enumerate(leaves):
+                        arr = np.load(io.BytesIO(zf.read(f"updaterState/{i}.npy")))
+                        loaded.append(jnp.asarray(arr, dtype=ref.dtype)
+                                      if hasattr(ref, "dtype") else arr)
+                    sd._opt_state = jax.tree_util.tree_unflatten(treedef, loaded)
         return sd
 
     def summary(self) -> str:

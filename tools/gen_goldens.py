@@ -1,0 +1,42 @@
+"""Regenerate golden trajectories for tests/test_golden_trajectories.py.
+
+Run from the repo root: ``python tools/gen_goldens.py``. Forces the same
+platform config as tests/conftest.py (8-device virtual CPU mesh, fp64) so
+goldens are generated under the exact environment that replays them. Any
+regeneration must be explained in the commit message (the reference's golden
+update policy for dl4j-integration-tests).
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+from test_golden_trajectories import CASES, GOLDEN_DIR, run_trajectory  # noqa: E402
+
+
+def main():
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    for name in sorted(CASES):
+        losses, checksum, sq = run_trajectory(name)
+        path = os.path.join(GOLDEN_DIR, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump({"losses": losses, "param_abs_sum": checksum,
+                       "param_sq_sum": sq}, f, indent=1)
+        print(f"{name}: losses[0]={losses[0]:.6f} losses[-1]={losses[-1]:.6f} "
+              f"abs_sum={checksum:.6f} -> {path}")
+
+
+if __name__ == "__main__":
+    main()
