@@ -1,2 +1,5 @@
 """Evaluation suite (ref: org.nd4j.evaluation)."""
-from deeplearning4j_tpu.eval.evaluation import ROC, Evaluation, RegressionEvaluation, ROCMultiClass  # noqa: F401
+from deeplearning4j_tpu.eval.evaluation import (  # noqa: F401
+    ROC, Evaluation, EvaluationBinary, EvaluationCalibration,
+    RegressionEvaluation, ROCBinary, ROCMultiClass,
+)

@@ -233,3 +233,162 @@ class ROCMultiClass:
 
     def calculateAverageAUC(self) -> float:
         return float(np.mean([r.calculateAUC() for r in self.per_class.values()]))
+
+
+class EvaluationBinary:
+    """Per-output independent binary metrics for multi-label sigmoid outputs
+    (ref: org.nd4j.evaluation.classification.EvaluationBinary — counts
+    TP/FP/TN/FN per output column at a 0.5 decision threshold, mask-aware)."""
+
+    def __init__(self, n_columns: Optional[int] = None, decision_threshold: float = 0.5):
+        self.n = n_columns
+        self.threshold = decision_threshold
+        self._tp = self._fp = self._tn = self._fn = None
+
+    def _ensure(self, n):
+        if self._tp is None:
+            self.n = n
+            self._tp = np.zeros(n); self._fp = np.zeros(n)
+            self._tn = np.zeros(n); self._fn = np.zeros(n)
+
+    def eval(self, labels, predictions, mask=None):
+        y = _np(labels)
+        p = _np(predictions)
+        y2 = y.reshape(-1, y.shape[-1])
+        p2 = p.reshape(-1, p.shape[-1])
+        self._ensure(y2.shape[-1])
+        m = np.ones(y2.shape) if mask is None else _np(mask).reshape(-1, y2.shape[-1])
+        pred = (p2 >= self.threshold).astype(np.float64)
+        self._tp += ((pred == 1) & (y2 == 1) & (m > 0)).sum(0)
+        self._fp += ((pred == 1) & (y2 == 0) & (m > 0)).sum(0)
+        self._tn += ((pred == 0) & (y2 == 0) & (m > 0)).sum(0)
+        self._fn += ((pred == 0) & (y2 == 1) & (m > 0)).sum(0)
+
+    def truePositives(self, col):  return int(self._tp[col])
+    def falsePositives(self, col): return int(self._fp[col])
+    def trueNegatives(self, col):  return int(self._tn[col])
+    def falseNegatives(self, col): return int(self._fn[col])
+
+    def accuracy(self, col) -> float:
+        tot = self._tp[col] + self._fp[col] + self._tn[col] + self._fn[col]
+        return float((self._tp[col] + self._tn[col]) / max(tot, 1e-12))
+
+    def precision(self, col) -> float:
+        return float(self._tp[col] / max(self._tp[col] + self._fp[col], 1e-12))
+
+    def recall(self, col) -> float:
+        return float(self._tp[col] / max(self._tp[col] + self._fn[col], 1e-12))
+
+    def f1(self, col) -> float:
+        pr, rc = self.precision(col), self.recall(col)
+        return 2 * pr * rc / max(pr + rc, 1e-12)
+
+    def averageAccuracy(self) -> float:
+        return float(np.mean([self.accuracy(i) for i in range(self.n)]))
+
+    def averageF1(self) -> float:
+        return float(np.mean([self.f1(i) for i in range(self.n)]))
+
+    def stats(self) -> str:
+        lines = ["EvaluationBinary (threshold %.2f)" % self.threshold]
+        for i in range(self.n or 0):
+            lines.append(
+                f"  out {i}: acc {self.accuracy(i):.4f} precision "
+                f"{self.precision(i):.4f} recall {self.recall(i):.4f} "
+                f"f1 {self.f1(i):.4f}")
+        return "\n".join(lines)
+
+
+class ROCBinary:
+    """Per-output-column ROC for multi-label binary outputs
+    (ref: org.nd4j.evaluation.classification.ROCBinary)."""
+
+    def __init__(self):
+        self.per_output: dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        y = _np(labels)
+        p = _np(predictions)
+        y2 = y.reshape(-1, y.shape[-1])
+        p2 = p.reshape(-1, p.shape[-1])
+        m = None if mask is None else _np(mask).reshape(-1, y2.shape[-1])
+        for c in range(y2.shape[-1]):
+            yc, pc = y2[:, c], p2[:, c]
+            if m is not None:
+                keep = m[:, c] > 0
+                yc, pc = yc[keep], pc[keep]
+            self.per_output.setdefault(c, ROC()).eval(yc, pc)
+
+    def calculateAUC(self, col: int) -> float:
+        return self.per_output[col].calculateAUC()
+
+    def calculateAUCPR(self, col: int) -> float:
+        return self.per_output[col].calculateAUCPR()
+
+    def calculateAverageAUC(self) -> float:
+        return float(np.mean([r.calculateAUC() for r in self.per_output.values()]))
+
+
+class EvaluationCalibration:
+    """Probability-calibration diagnostics (ref: org.nd4j.evaluation.
+    classification.EvaluationCalibration): reliability diagram (accuracy vs
+    confidence per bin), expected calibration error, residual-probability and
+    predicted-probability histograms."""
+
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 50):
+        self.rbins = reliability_bins
+        self.hbins = histogram_bins
+        self._conf = []   # predicted prob of the true class's argmax decision
+        self._hit = []    # argmax correct?
+        self._probs = []  # every predicted probability (flattened)
+        self._residuals = []  # |label - p| per class entry
+
+    def eval(self, labels, predictions, mask=None):
+        y = _np(labels).reshape(-1, _np(labels).shape[-1])
+        p = _np(predictions).reshape(-1, y.shape[-1])
+        if mask is not None:
+            keep = _np(mask).reshape(-1) > 0
+            y, p = y[keep], p[keep]
+        pred_cls = p.argmax(-1)
+        true_cls = y.argmax(-1)
+        self._conf.append(p[np.arange(len(p)), pred_cls])
+        self._hit.append((pred_cls == true_cls).astype(np.float64))
+        self._probs.append(p.reshape(-1))
+        self._residuals.append(np.abs(y - p).reshape(-1))
+
+    def reliabilityDiagram(self):
+        """(bin_centers, mean_confidence, accuracy, counts) per bin."""
+        conf = np.concatenate(self._conf)
+        hit = np.concatenate(self._hit)
+        edges = np.linspace(0.0, 1.0, self.rbins + 1)
+        idx = np.clip(np.digitize(conf, edges) - 1, 0, self.rbins - 1)
+        centers = (edges[:-1] + edges[1:]) / 2
+        mean_conf = np.zeros(self.rbins)
+        acc = np.zeros(self.rbins)
+        counts = np.zeros(self.rbins)
+        for b in range(self.rbins):
+            sel = idx == b
+            counts[b] = sel.sum()
+            if counts[b]:
+                mean_conf[b] = conf[sel].mean()
+                acc[b] = hit[sel].mean()
+        return centers, mean_conf, acc, counts
+
+    def expectedCalibrationError(self) -> float:
+        _, mean_conf, acc, counts = self.reliabilityDiagram()
+        total = max(counts.sum(), 1e-12)
+        return float(np.sum(counts / total * np.abs(acc - mean_conf)))
+
+    def probabilityHistogram(self):
+        probs = np.concatenate(self._probs)
+        counts, edges = np.histogram(probs, bins=self.hbins, range=(0.0, 1.0))
+        return edges, counts
+
+    def residualPlot(self):
+        res = np.concatenate(self._residuals)
+        counts, edges = np.histogram(res, bins=self.hbins, range=(0.0, 1.0))
+        return edges, counts
+
+    def stats(self) -> str:
+        return (f"EvaluationCalibration: ECE "
+                f"{self.expectedCalibrationError():.4f} over {self.rbins} bins")

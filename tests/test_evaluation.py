@@ -1,0 +1,120 @@
+"""Evaluation-family tests incl. the binary/calibration variants (ref:
+org.nd4j.evaluation tests — EvaluationBinaryTest, ROCBinaryTest,
+EvaluationCalibrationTest), validated against sklearn where available and
+hand-computed counts otherwise."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (
+    Evaluation, EvaluationBinary, EvaluationCalibration, ROC, ROCBinary,
+    ROCMultiClass,
+)
+
+RNG = np.random.default_rng(5)
+
+
+class TestEvaluationBinary:
+    def test_counts_match_hand_computation(self):
+        y = np.array([[1, 0], [1, 1], [0, 0], [0, 1]], np.float32)
+        p = np.array([[0.9, 0.2], [0.4, 0.8], [0.3, 0.6], [0.1, 0.9]], np.float32)
+        ev = EvaluationBinary()
+        ev.eval(y, p)
+        # col 0: preds [1,0,0,0] vs [1,1,0,0] -> TP=1 FN=1 TN=2 FP=0
+        assert ev.truePositives(0) == 1
+        assert ev.falseNegatives(0) == 1
+        assert ev.trueNegatives(0) == 2
+        assert ev.falsePositives(0) == 0
+        # col 1: preds [0,1,1,1] vs [0,1,0,1] -> TP=2 FP=1 TN=1 FN=0
+        assert ev.truePositives(1) == 2
+        assert ev.falsePositives(1) == 1
+        assert ev.accuracy(0) == pytest.approx(0.75)
+        assert ev.precision(1) == pytest.approx(2 / 3)
+        assert ev.recall(1) == pytest.approx(1.0)
+        assert 0 < ev.averageF1() <= 1
+        assert "out 1" in ev.stats()
+
+    def test_mask_excludes_entries(self):
+        y = np.array([[1, 1], [0, 0]], np.float32)
+        p = np.array([[0.9, 0.9], [0.9, 0.1]], np.float32)
+        m = np.array([[1, 1], [0, 1]], np.float32)  # drop (1, col0): an FP
+        ev = EvaluationBinary()
+        ev.eval(y, p, mask=m)
+        assert ev.falsePositives(0) == 0
+        assert ev.truePositives(0) == 1
+
+    def test_accumulates_over_batches(self):
+        ev = EvaluationBinary()
+        for _ in range(3):
+            ev.eval(np.ones((4, 2), np.float32), np.full((4, 2), 0.9, np.float32))
+        assert ev.truePositives(0) == 12
+
+
+class TestROCBinary:
+    def test_auc_per_output_vs_sklearn(self):
+        sk = pytest.importorskip("sklearn.metrics")
+        y = (RNG.random((200, 3)) > 0.5).astype(np.float32)
+        p = np.clip(y * 0.6 + RNG.random((200, 3)) * 0.4, 0, 1).astype(np.float32)
+        roc = ROCBinary()
+        roc.eval(y, p)
+        for c in range(3):
+            want = sk.roc_auc_score(y[:, c], p[:, c])
+            assert roc.calculateAUC(c) == pytest.approx(want, abs=1e-6)
+        assert 0.5 < roc.calculateAverageAUC() <= 1.0
+
+    def test_perfect_separation(self):
+        y = np.array([[1], [1], [0], [0]], np.float32)
+        p = np.array([[0.9], [0.8], [0.2], [0.1]], np.float32)
+        roc = ROCBinary()
+        roc.eval(y, p)
+        assert roc.calculateAUC(0) == pytest.approx(1.0)
+
+
+class TestEvaluationCalibration:
+    def test_perfectly_calibrated_oracle(self):
+        """Predictions whose confidence == empirical accuracy -> ECE ~ 0."""
+        n = 5000
+        conf = RNG.uniform(0.55, 0.95, n)
+        correct = RNG.random(n) < conf  # hit rate equals confidence
+        y = np.zeros((n, 2), np.float32)
+        p = np.zeros((n, 2), np.float32)
+        # predicted class 0 with probability conf; true class = 0 when correct
+        p[:, 0] = conf
+        p[:, 1] = 1 - conf
+        y[np.arange(n), np.where(correct, 0, 1)] = 1.0
+        ev = EvaluationCalibration(reliability_bins=10)
+        ev.eval(y, p)
+        assert ev.expectedCalibrationError() < 0.03
+
+    def test_overconfident_model_flagged(self):
+        n = 2000
+        y = np.zeros((n, 2), np.float32)
+        y[np.arange(n), (RNG.random(n) < 0.5).astype(int)] = 1.0  # 50% accuracy
+        p = np.tile(np.array([[0.99, 0.01]], np.float32), (n, 1))  # 99% confident
+        ev = EvaluationCalibration()
+        ev.eval(y, p)
+        assert ev.expectedCalibrationError() > 0.4
+        assert "ECE" in ev.stats()
+
+    def test_reliability_diagram_and_histograms(self):
+        y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, 100)]
+        p = RNG.dirichlet(np.ones(3), 100).astype(np.float32)
+        ev = EvaluationCalibration(reliability_bins=5, histogram_bins=20)
+        ev.eval(y, p)
+        centers, mean_conf, acc, counts = ev.reliabilityDiagram()
+        assert len(centers) == 5 and counts.sum() == 100
+        edges, hist = ev.probabilityHistogram()
+        assert hist.sum() == 300  # every class prob counted
+        _, res = ev.residualPlot()
+        assert res.sum() == 300
+
+
+class TestExistingFamilyStillCoherent:
+    def test_roc_multiclass_against_binary(self):
+        y = np.eye(2, dtype=np.float32)[RNG.integers(0, 2, 50)]
+        p = RNG.random((50, 2)).astype(np.float32)
+        p = p / p.sum(-1, keepdims=True)
+        multi = ROCMultiClass()
+        multi.eval(y, p)
+        single = ROC()
+        single.eval(y[:, 1], p[:, 1])
+        assert multi.calculateAUC(1) == pytest.approx(single.calculateAUC())
