@@ -1,0 +1,22 @@
+"""Reinforcement learning (ref: rl4j — rl4j-core's QLearningDiscreteDense /
+A3CDiscreteDense, ExpReplay, EpsGreedy policies, MDP SPI; SURVEY.md §2.5).
+
+TPU-first redesign: rl4j threads actor/learner Java objects and steps the
+network op-by-op; here the environment SPI stays host-side python (gym-shaped)
+while every learning update — TD targets, double-DQN argmax/gather, advantage
+actor-critic — is ONE jitted XLA executable over the nn framework's layer
+forward. Replay sampling is vectorized numpy into device batches.
+"""
+from deeplearning4j_tpu.rl.env import MDP, CartPole, ChainMDP
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
+from deeplearning4j_tpu.rl.policy import BoltzmannPolicy, EpsGreedy, GreedyPolicy
+from deeplearning4j_tpu.rl.qlearning import QLearningConfiguration, QLearningDiscreteDense
+from deeplearning4j_tpu.rl.a2c import A2CConfiguration, A2CDiscreteDense
+
+__all__ = [
+    "MDP", "CartPole", "ChainMDP",
+    "ExpReplay", "Transition",
+    "EpsGreedy", "GreedyPolicy", "BoltzmannPolicy",
+    "QLearningConfiguration", "QLearningDiscreteDense",
+    "A2CConfiguration", "A2CDiscreteDense",
+]

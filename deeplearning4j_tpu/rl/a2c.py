@@ -1,0 +1,144 @@
+"""Advantage actor-critic (ref: org.deeplearning4j.rl4j.learning.async.a3c.
+discrete.A3CDiscreteDense — the synchronous-batch equivalent: rl4j's async
+workers exist to parallelize CPU gradient computation, which a single fused
+XLA update makes unnecessary; SURVEY.md §2.5 notes A3C's async machinery is
+deleted by design on TPU).
+
+One jitted executable per update: n-step returns, advantage, policy-gradient
+loss with entropy bonus, value MSE — both heads updated together.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.rl.env import MDP
+
+
+@dataclass
+class A2CConfiguration:
+    """(ref: A3CConfiguration builder, minus the async knobs)."""
+    seed: int = 0
+    gamma: float = 0.99
+    nStep: int = 32                # rollout length per update
+    entropyCoef: float = 0.01
+    valueCoef: float = 0.5
+    maxStep: int = 5000
+    maxEpochStep: int = 500
+
+
+class A2CDiscreteDense:
+    """Policy net (softmax over actions) + value net (scalar), both dense
+    layer stacks from the nn config DSL."""
+
+    def __init__(self, mdp: MDP, policy_conf, value_conf, config: A2CConfiguration):
+        self.mdp = mdp
+        self.config = config
+        self.pi_net = (policy_conf if isinstance(policy_conf, MultiLayerNetwork)
+                       else MultiLayerNetwork(policy_conf).init())
+        self.v_net = (value_conf if isinstance(value_conf, MultiLayerNetwork)
+                      else MultiLayerNetwork(value_conf).init())
+        self._pi = self.pi_net._params
+        self._v = self.v_net._params
+        self._tx = self.pi_net.conf.updater.to_optax()
+        self._opt = self._tx.init({"pi": self._pi, "v": self._v})
+        self._jit_update = jax.jit(self._update_fn)
+        self._jit_probs = jax.jit(self._probs_fn)
+        self.rng = np.random.RandomState(config.seed)
+        self.episode_rewards: List[float] = []
+        self._steps = 0
+
+    def _probs_fn(self, pi_params, obs):
+        out, _, _ = self.pi_net._forward(pi_params, self.pi_net._state, obs,
+                                         training=False, rng=None)
+        return out
+
+    def _value_fn(self, v_params, obs):
+        out, _, _ = self.v_net._forward(v_params, self.v_net._state, obs,
+                                        training=False, rng=None)
+        return out[:, 0]
+
+    def _update_fn(self, params, opt_state, obs, actions, returns):
+        cfg = self.config
+
+        def loss_fn(p):
+            probs = self._probs_fn(p["pi"], obs)
+            logp = jnp.log(jnp.clip(probs, 1e-8))
+            values = self._value_fn(p["v"], obs)
+            adv = jax.lax.stop_gradient(returns - values)
+            sel_logp = jnp.take_along_axis(logp, actions[:, None], -1)[:, 0]
+            policy_loss = -jnp.mean(sel_logp * adv)
+            entropy = -jnp.mean(jnp.sum(probs * logp, -1))
+            value_loss = jnp.mean((returns - values) ** 2)
+            return (policy_loss + cfg.valueCoef * value_loss
+                    - cfg.entropyCoef * entropy)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self._tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def action_probs(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._jit_probs(self._pi, jnp.asarray(obs[None])))[0]
+
+    def train(self) -> List[float]:
+        cfg = self.config
+        obs = self.mdp.reset()
+        ep_reward, ep_steps = 0.0, 0
+        buf_obs, buf_act, buf_rew, buf_done = [], [], [], []
+        while self._steps < cfg.maxStep:
+            p = self.action_probs(obs)
+            action = int(self.rng.choice(len(p), p=p / p.sum()))
+            next_obs, reward, done, _ = self.mdp.step(action)
+            buf_obs.append(obs); buf_act.append(action)
+            buf_rew.append(reward); buf_done.append(done)
+            obs = next_obs
+            ep_reward += reward
+            ep_steps += 1
+            self._steps += 1
+            episode_over = done or ep_steps >= cfg.maxEpochStep
+            if len(buf_obs) >= cfg.nStep or episode_over:
+                # n-step discounted returns, bootstrapped from V(s_T)
+                if episode_over:
+                    boot = 0.0
+                else:
+                    boot = float(np.asarray(self._value_fn(
+                        self._v, jnp.asarray(obs[None])))[0])
+                R = boot
+                returns = np.zeros(len(buf_rew), np.float32)
+                for i in reversed(range(len(buf_rew))):
+                    R = buf_rew[i] + cfg.gamma * R * (1.0 - float(buf_done[i]))
+                    returns[i] = R
+                params = {"pi": self._pi, "v": self._v}
+                params, self._opt, _ = self._jit_update(
+                    params, self._opt, jnp.asarray(np.stack(buf_obs)),
+                    jnp.asarray(np.array(buf_act, np.int32)),
+                    jnp.asarray(returns))
+                self._pi, self._v = params["pi"], params["v"]
+                buf_obs, buf_act, buf_rew, buf_done = [], [], [], []
+            if episode_over:
+                self.episode_rewards.append(ep_reward)
+                obs = self.mdp.reset()
+                ep_reward, ep_steps = 0.0, 0
+        self.pi_net._params = self._pi
+        self.v_net._params = self._v
+        return self.episode_rewards
+
+    def play(self, max_steps=None) -> float:
+        obs = self.mdp.reset()
+        total, steps = 0.0, 0
+        cap = max_steps or self.config.maxEpochStep
+        while steps < cap:
+            action = int(np.argmax(self.action_probs(obs)))
+            obs, reward, done, _ = self.mdp.step(action)
+            total += reward
+            steps += 1
+            if done:
+                break
+        return total

@@ -1,0 +1,152 @@
+"""Deep Q-learning (ref: org.deeplearning4j.rl4j.learning.sync.qlearning.
+discrete.QLearningDiscreteDense + QLearning.QLConfiguration).
+
+TPU redesign: rl4j's learner steps fetch/fit through the ND4J graph per
+minibatch with a separate target-network copy held as a second network
+object. Here the Q-network is the nn framework's layer stack applied purely
+(params in, Q out), the target network is just a second param pytree, and
+one jitted executable computes TD targets (double-DQN or vanilla), gathers
+the taken-action Q, and applies the optax update — env stepping is the only
+host-side work.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.rl.env import MDP
+from deeplearning4j_tpu.rl.policy import EpsGreedy, GreedyPolicy
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
+
+
+@dataclass
+class QLearningConfiguration:
+    """(ref: QLearning.QLConfiguration builder)."""
+    seed: int = 0
+    gamma: float = 0.99
+    batchSize: int = 32
+    expRepMaxSize: int = 10000
+    targetDqnUpdateFreq: int = 100
+    updateStart: int = 64          # env steps before learning begins
+    trainFreq: int = 1             # learn every N env steps
+    doubleDQN: bool = True
+    minEpsilon: float = 0.05
+    epsilonNbStep: int = 1000
+    maxStep: int = 5000            # total env steps
+    maxEpochStep: int = 500        # per-episode cap
+    errorClamp: Optional[float] = 1.0  # huber-style TD clamp (ref: errorClamp)
+
+
+class QLearningDiscreteDense:
+    """(ref: QLearningDiscreteDense — dense-observation discrete-action DQN)."""
+
+    def __init__(self, mdp: MDP, net_conf, config: QLearningConfiguration):
+        self.mdp = mdp
+        self.config = config
+        self.net = (net_conf if isinstance(net_conf, MultiLayerNetwork)
+                    else MultiLayerNetwork(net_conf).init())
+        self._params = self.net._params
+        self._target = jax.tree.map(jnp.array, self._params)
+        self._state = self.net._state
+        self._tx = self.net.conf.updater.to_optax()
+        self._opt_state = self._tx.init(self._params)
+        self.replay = ExpReplay(config.expRepMaxSize, mdp.obs_size,
+                                seed=config.seed)
+        self.policy = EpsGreedy(config.minEpsilon, config.epsilonNbStep,
+                                seed=config.seed)
+        self._jit_q = jax.jit(self._q_fn)
+        self._jit_update = jax.jit(self._update_fn)
+        self.episode_rewards: List[float] = []
+        self._steps = 0
+
+    # ---------------------------------------------------------------- pure
+    def _q_fn(self, params, obs):
+        out, _, _ = self.net._forward(params, self._state, obs,
+                                      training=False, rng=None)
+        return out
+
+    def _update_fn(self, params, target, opt_state, obs, actions, rewards,
+                   next_obs, dones):
+        cfg = self.config
+        q_next_target = self._q_fn(target, next_obs)
+        if cfg.doubleDQN:
+            # online net picks the argmax, target net evaluates it
+            sel = jnp.argmax(self._q_fn(params, next_obs), axis=-1)
+            q_next = jnp.take_along_axis(q_next_target, sel[:, None], -1)[:, 0]
+        else:
+            q_next = q_next_target.max(-1)
+        td_target = rewards + cfg.gamma * q_next * (1.0 - dones)
+        td_target = jax.lax.stop_gradient(td_target)
+
+        def loss_fn(p):
+            q = self._q_fn(p, obs)
+            q_sel = jnp.take_along_axis(q, actions[:, None].astype(jnp.int32), -1)[:, 0]
+            err = q_sel - td_target
+            if cfg.errorClamp is not None:
+                # huber: quadratic within the clamp, linear outside
+                c = cfg.errorClamp
+                ae = jnp.abs(err)
+                return jnp.mean(jnp.where(ae <= c, 0.5 * err ** 2,
+                                          c * (ae - 0.5 * c)))
+            return jnp.mean(err ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self._tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # ------------------------------------------------------------ training
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._jit_q(self._params, jnp.asarray(obs[None])))[0]
+
+    def train(self) -> List[float]:
+        """Run until maxStep env steps; returns per-episode rewards
+        (ref: ILearning.train + TrainingListener loop)."""
+        cfg = self.config
+        while self._steps < cfg.maxStep:
+            obs = self.mdp.reset()
+            ep_reward, ep_steps = 0.0, 0
+            while True:
+                action = self.policy.select(self.q_values(obs))
+                next_obs, reward, done, _ = self.mdp.step(action)
+                self.replay.store(Transition(obs, action, reward, next_obs, done))
+                obs = next_obs
+                ep_reward += reward
+                ep_steps += 1
+                self._steps += 1
+                if (len(self.replay) >= max(cfg.updateStart, cfg.batchSize)
+                        and self._steps % cfg.trainFreq == 0):
+                    b = self.replay.sample(cfg.batchSize)
+                    self._params, self._opt_state, _ = self._jit_update(
+                        self._params, self._target, self._opt_state,
+                        *(jnp.asarray(x) for x in b))
+                if self._steps % cfg.targetDqnUpdateFreq == 0:
+                    self._target = jax.tree.map(jnp.array, self._params)
+                if done or ep_steps >= cfg.maxEpochStep or self._steps >= cfg.maxStep:
+                    break
+            self.episode_rewards.append(ep_reward)
+        self.net._params = self._params  # expose learned weights on the net
+        return self.episode_rewards
+
+    def getPolicy(self) -> GreedyPolicy:
+        return GreedyPolicy()
+
+    def play(self, max_steps: Optional[int] = None) -> float:
+        """One greedy episode (ref: Policy.play)."""
+        obs = self.mdp.reset()
+        total, steps = 0.0, 0
+        cap = max_steps or self.config.maxEpochStep
+        while steps < cap:
+            action = int(np.argmax(self.q_values(obs)))
+            obs, reward, done, _ = self.mdp.step(action)
+            total += reward
+            steps += 1
+            if done:
+                break
+        return total

@@ -1,0 +1,162 @@
+"""RL module tests (ref: rl4j-core's QLearningDiscreteTest / policy tests —
+convergence on small MDPs stands in for rl4j's gym integration tests, which
+need an external gym server)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.rl import (
+    A2CConfiguration, A2CDiscreteDense, BoltzmannPolicy, CartPole, ChainMDP,
+    EpsGreedy, ExpReplay, QLearningConfiguration, QLearningDiscreteDense,
+    Transition,
+)
+from deeplearning4j_tpu.train import Adam
+
+
+def q_net_conf(obs, n_actions, seed=0):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(nOut=32, activation="RELU"))
+            .layer(OutputLayer(nOut=n_actions, activation="IDENTITY",
+                               lossFunction="MSE"))
+            .setInputType(InputType.feedForward(obs)).build())
+
+
+def pi_net_conf(obs, n_actions, seed=0):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(3e-3))
+            .list()
+            .layer(DenseLayer(nOut=32, activation="TANH"))
+            .layer(OutputLayer(nOut=n_actions, lossFunction="MCXENT"))  # softmax
+            .setInputType(InputType.feedForward(obs)).build())
+
+
+def v_net_conf(obs, seed=1):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(3e-3))
+            .list()
+            .layer(DenseLayer(nOut=32, activation="TANH"))
+            .layer(OutputLayer(nOut=1, activation="IDENTITY", lossFunction="MSE"))
+            .setInputType(InputType.feedForward(obs)).build())
+
+
+class TestReplay:
+    def test_ring_overwrite_and_sampling(self):
+        rep = ExpReplay(max_size=4, obs_size=2, seed=0)
+        for i in range(6):
+            rep.store(Transition(np.full(2, i, np.float32), i % 2, float(i),
+                                 np.full(2, i + 1, np.float32), False))
+        assert len(rep) == 4
+        obs, actions, rewards, next_obs, dones = rep.sample(16)
+        assert obs.shape == (16, 2)
+        assert set(np.unique(obs[:, 0])) <= {2, 3, 4, 5}  # 0,1 overwritten
+
+
+class TestPolicies:
+    def test_eps_greedy_anneals(self):
+        pol = EpsGreedy(min_epsilon=0.1, anneal_steps=10, seed=0)
+        assert pol.epsilon == pytest.approx(1.0)
+        for _ in range(10):
+            pol.select(np.array([0.0, 1.0]))
+        assert pol.epsilon == pytest.approx(0.1)
+        # at min epsilon, mostly greedy
+        picks = [pol.select(np.array([0.0, 1.0])) for _ in range(100)]
+        assert np.mean(picks) > 0.85
+
+    def test_boltzmann_prefers_high_q(self):
+        pol = BoltzmannPolicy(temperature=0.5, seed=0)
+        picks = [pol.select(np.array([0.0, 2.0])) for _ in range(200)]
+        assert np.mean(picks) > 0.9
+
+
+class TestEnvironments:
+    def test_chain_optimal_return(self):
+        env = ChainMDP(n_states=5, horizon=10)
+        obs = env.reset()
+        assert obs.argmax() == 1
+        total = 0.0
+        for _ in range(10):
+            obs, r, done, _ = env.step(1)
+            total += r
+        assert done and total == pytest.approx(8.0)  # arrives step 3, rewarded steps 3-10
+
+    def test_cartpole_random_falls(self):
+        env = CartPole(seed=0)
+        env.reset()
+        rng = np.random.RandomState(0)
+        steps = 0
+        done = False
+        while not done and steps < 500:
+            _, _, done, _ = env.step(int(rng.randint(2)))
+            steps += 1
+        assert steps < 200  # random policy cannot balance long
+
+
+class TestQLearning:
+    def test_dqn_solves_chain(self):
+        env = ChainMDP(n_states=5, horizon=10)
+        cfg = QLearningConfiguration(
+            seed=0, gamma=0.95, batchSize=32, expRepMaxSize=2000,
+            targetDqnUpdateFreq=50, updateStart=50, doubleDQN=True,
+            minEpsilon=0.05, epsilonNbStep=400, maxStep=2500, maxEpochStep=10)
+        dqn = QLearningDiscreteDense(env, q_net_conf(env.obs_size, env.n_actions),
+                                     cfg)
+        rewards = dqn.train()
+        assert len(rewards) == 250  # 2500 steps / 10-step episodes
+        # greedy play achieves the optimal return (always right: 8.0)
+        assert dqn.play() == pytest.approx(8.0)
+        # learned Q ranks 'right' above 'left' in interior states
+        for s in range(1, 4):
+            obs = np.zeros(5, np.float32)
+            obs[s] = 1.0
+            q = dqn.q_values(obs)
+            assert q[1] > q[0], (s, q)
+
+    def test_vanilla_vs_double_flag(self):
+        env = ChainMDP(n_states=4, horizon=8)
+        cfg = QLearningConfiguration(seed=1, doubleDQN=False, maxStep=600,
+                                     updateStart=40, epsilonNbStep=200,
+                                     maxEpochStep=8)
+        dqn = QLearningDiscreteDense(env, q_net_conf(env.obs_size, env.n_actions, 1),
+                                     cfg)
+        rewards = dqn.train()
+        assert np.mean(rewards[-10:]) > np.mean(rewards[:10])
+
+    def test_target_network_lags_online(self):
+        env = ChainMDP()
+        cfg = QLearningConfiguration(seed=0, targetDqnUpdateFreq=10 ** 9,
+                                     maxStep=150, updateStart=32, maxEpochStep=20)
+        dqn = QLearningDiscreteDense(env, q_net_conf(env.obs_size, env.n_actions),
+                                     cfg)
+        dqn.train()
+        import jax
+        online = np.concatenate([np.ravel(l) for l in jax.tree_util.tree_leaves(dqn._params)])
+        target = np.concatenate([np.ravel(l) for l in jax.tree_util.tree_leaves(dqn._target)])
+        assert not np.allclose(online, target)  # target never synced
+
+
+class TestA2C:
+    def test_a2c_improves_on_chain(self):
+        env = ChainMDP(n_states=5, horizon=10)
+        cfg = A2CConfiguration(seed=0, gamma=0.95, nStep=16, maxStep=4000,
+                               maxEpochStep=10, entropyCoef=0.01)
+        a2c = A2CDiscreteDense(env, pi_net_conf(env.obs_size, env.n_actions),
+                               v_net_conf(env.obs_size), cfg)
+        rewards = a2c.train()
+        assert np.mean(rewards[-20:]) > np.mean(rewards[:20])
+        assert a2c.play() >= 7.0  # near-optimal greedy rollout
+
+
+@pytest.mark.slow
+class TestCartPoleLearning:
+    def test_dqn_improves_cartpole(self):
+        env = CartPole(seed=0, max_steps=200)
+        cfg = QLearningConfiguration(
+            seed=0, gamma=0.99, batchSize=64, expRepMaxSize=10000,
+            targetDqnUpdateFreq=200, updateStart=200, doubleDQN=True,
+            minEpsilon=0.05, epsilonNbStep=2000, maxStep=8000, maxEpochStep=200)
+        dqn = QLearningDiscreteDense(env, q_net_conf(env.obs_size, env.n_actions),
+                                     cfg)
+        rewards = dqn.train()
+        early = np.mean(rewards[:10])
+        late = np.mean(rewards[-10:])
+        assert late > early * 2, (early, late)
