@@ -1,0 +1,41 @@
+"""Hyperparameter optimization (ref: arbiter — arbiter-core's
+ParameterSpace/CandidateGenerator/OptimizationConfiguration/
+LocalOptimizationRunner + arbiter-deeplearning4j's MultiLayerSpace;
+SURVEY.md §2.6).
+
+TPU-first simplification: arbiter serializes candidate configs through JSON
+and spins worker threads per candidate; here a candidate is a plain dict of
+sampled hyperparameters handed to a user model-builder, and the runner
+executes sequentially (XLA already saturates the chip per candidate — the
+reference's thread pool parallelized CPU training, which doesn't transfer).
+"""
+from deeplearning4j_tpu.arbiter.space import (
+    BooleanSpace,
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    FixedValue,
+    IntegerParameterSpace,
+    ParameterSpace,
+)
+from deeplearning4j_tpu.arbiter.generator import (
+    GridSearchCandidateGenerator,
+    RandomSearchGenerator,
+)
+from deeplearning4j_tpu.arbiter.runner import (
+    Candidate,
+    CandidateResult,
+    MaxCandidatesCondition,
+    MaxTimeCondition,
+    OptimizationConfiguration,
+    OptimizationRunner,
+    ScoreImprovementCondition,
+)
+
+__all__ = [
+    "ParameterSpace", "ContinuousParameterSpace", "DiscreteParameterSpace",
+    "IntegerParameterSpace", "BooleanSpace", "FixedValue",
+    "RandomSearchGenerator", "GridSearchCandidateGenerator",
+    "Candidate", "CandidateResult", "OptimizationConfiguration",
+    "OptimizationRunner", "MaxCandidatesCondition", "MaxTimeCondition",
+    "ScoreImprovementCondition",
+]

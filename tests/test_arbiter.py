@@ -1,0 +1,164 @@
+"""Hyperparameter-search tests (ref: arbiter-core's TestRandomSearch /
+TestGridSearch / LocalOptimizationRunner tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (
+    BooleanSpace, ContinuousParameterSpace, DiscreteParameterSpace, FixedValue,
+    GridSearchCandidateGenerator, IntegerParameterSpace, MaxCandidatesCondition,
+    MaxTimeCondition, OptimizationConfiguration, OptimizationRunner,
+    RandomSearchGenerator, ScoreImprovementCondition,
+)
+
+RNG = np.random.RandomState(0)
+
+
+class TestSpaces:
+    def test_continuous_bounds_and_log(self):
+        s = ContinuousParameterSpace(0.1, 10.0)
+        vals = [s.sample(RNG) for _ in range(200)]
+        assert all(0.1 <= v <= 10.0 for v in vals)
+        slog = ContinuousParameterSpace(1e-5, 1e-1, log_uniform=True)
+        lvals = np.log10([slog.sample(RNG) for _ in range(500)])
+        # log-uniform: roughly equal mass per decade
+        lo_frac = np.mean(lvals < -3)
+        assert 0.3 < lo_frac < 0.7
+
+    def test_integer_and_discrete(self):
+        s = IntegerParameterSpace(2, 5)
+        vals = {s.sample(RNG) for _ in range(100)}
+        assert vals == {2, 3, 4, 5}
+        d = DiscreteParameterSpace(["a", "b"])
+        assert {d.sample(RNG) for _ in range(50)} == {"a", "b"}
+        assert BooleanSpace().grid_values(7) == [False, True]
+        assert FixedValue(3).sample(RNG) == 3
+
+    def test_grid_values(self):
+        assert ContinuousParameterSpace(0.0, 1.0).grid_values(3) == [0.0, 0.5, 1.0]
+        assert IntegerParameterSpace(1, 8).grid_values(4) == [1, 3, 6, 8]
+
+
+class TestGenerators:
+    def test_grid_enumerates_cartesian_product(self):
+        gen = GridSearchCandidateGenerator(
+            {"lr": ContinuousParameterSpace(0.0, 1.0),
+             "units": DiscreteParameterSpace([8, 16])},
+            discretization_count=3)
+        combos = list(gen)
+        assert gen.total() == 6 and len(combos) == 6
+        assert {(c["lr"], c["units"]) for c in combos} == {
+            (0.0, 8), (0.5, 8), (1.0, 8), (0.0, 16), (0.5, 16), (1.0, 16)}
+
+    def test_grid_random_order_is_permutation(self):
+        spaces = {"x": DiscreteParameterSpace(list(range(10)))}
+        seq = [c["x"] for c in GridSearchCandidateGenerator(spaces)]
+        rnd = [c["x"] for c in GridSearchCandidateGenerator(spaces, order="RandomOrder")]
+        assert sorted(rnd) == seq and rnd != seq
+
+    def test_random_generator_streams(self):
+        gen = iter(RandomSearchGenerator(
+            {"lr": ContinuousParameterSpace(1e-4, 1e-1, log_uniform=True)}, seed=1))
+        vals = [next(gen)["lr"] for _ in range(10)]
+        assert len(set(vals)) == 10
+
+
+class TestRunner:
+    def _quadratic_config(self, generator, conditions, minimize=True):
+        # analytic "model": score = (lr - 0.3)^2 + 0.1*(units != 16)
+        return OptimizationConfiguration(
+            candidate_generator=generator,
+            model_builder=lambda hp: hp,
+            score_function=lambda model, hp:
+                (hp["lr"] - 0.3) ** 2 + (0.1 if hp["units"] != 16 else 0.0),
+            termination_conditions=conditions,
+            minimize_score=minimize)
+
+    def test_grid_finds_analytic_optimum(self):
+        gen = GridSearchCandidateGenerator(
+            {"lr": ContinuousParameterSpace(0.0, 0.6),
+             "units": DiscreteParameterSpace([8, 16])},
+            discretization_count=5)
+        runner = OptimizationRunner(self._quadratic_config(
+            gen, [MaxCandidatesCondition(100)]))
+        best = runner.execute()
+        assert best.candidate.hyperparameters == {"lr": 0.3, "units": 16}
+        assert best.score == pytest.approx(0.0)
+        assert runner.numCandidatesCompleted() == 10
+
+    def test_random_search_with_patience(self):
+        gen = RandomSearchGenerator(
+            {"lr": ContinuousParameterSpace(0.0, 1.0),
+             "units": DiscreteParameterSpace([8, 16])}, seed=3)
+        runner = OptimizationRunner(self._quadratic_config(
+            gen, [ScoreImprovementCondition(patience=15),
+                  MaxCandidatesCondition(200)]))
+        best = runner.execute()
+        assert best.score < 0.05
+        assert runner.numCandidatesCompleted() <= 200
+
+    def test_failed_candidates_recorded_not_fatal(self):
+        def builder(hp):
+            if hp["x"] == "boom":
+                raise RuntimeError("bad candidate")
+            return hp
+        cfg = OptimizationConfiguration(
+            candidate_generator=GridSearchCandidateGenerator(
+                {"x": DiscreteParameterSpace(["boom", "ok"])}),
+            model_builder=builder,
+            score_function=lambda m, hp: 1.0,
+            termination_conditions=[MaxCandidatesCondition(2)])
+        runner = OptimizationRunner(cfg)
+        best = runner.execute()
+        assert best.candidate.hyperparameters["x"] == "ok"
+        assert runner.numCandidatesFailed() == 1
+        assert "bad candidate" in runner.results[0].exception
+
+    def test_max_time_condition(self):
+        import itertools
+        cfg = OptimizationConfiguration(
+            candidate_generator=({"i": i} for i in itertools.count()),
+            model_builder=lambda hp: hp,
+            score_function=lambda m, hp: float(hp["i"]),
+            termination_conditions=[MaxTimeCondition(seconds=0.2)])
+        runner = OptimizationRunner(cfg)
+        best = runner.execute()
+        assert best.score == 0.0  # minimize: first candidate
+
+
+class TestEndToEndNetworkSearch:
+    def test_search_over_real_training(self):
+        """Search lr over actual MultiLayerNetwork training on a separable
+        toy problem — the best candidate must beat the worst clearly
+        (ref: arbiter-deeplearning4j MNIST example, shrunk)."""
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.train import Adam
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 0).astype(int)]
+        ds = DataSet(x, y)
+
+        def build(hp):
+            conf = (NeuralNetConfiguration.Builder().seed(0)
+                    .updater(Adam(hp["lr"])).list()
+                    .layer(DenseLayer(nOut=hp["units"], activation="RELU"))
+                    .layer(OutputLayer(nOut=2, lossFunction="MCXENT"))
+                    .setInputType(InputType.feedForward(4)).build())
+            return MultiLayerNetwork(conf).init()
+
+        def score(model, hp):
+            model.fit(ds, epochs=30)
+            return model.score()  # final training loss
+
+        gen = GridSearchCandidateGenerator(
+            {"lr": DiscreteParameterSpace([1e-5, 3e-2]),
+             "units": FixedValue(16)})
+        runner = OptimizationRunner(OptimizationConfiguration(
+            candidate_generator=gen, model_builder=build, score_function=score,
+            termination_conditions=[MaxCandidatesCondition(4)]))
+        best = runner.execute()
+        scores = sorted(r.score for r in runner.results)
+        assert best.candidate.hyperparameters["lr"] == pytest.approx(3e-2)
+        assert scores[0] < scores[-1] * 0.5  # good lr clearly beats tiny lr
