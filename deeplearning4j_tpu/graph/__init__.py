@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.walker import RandomWalkIterator, generate_walks
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
+
+__all__ = ["Graph", "RandomWalkIterator", "generate_walks", "DeepWalk",
+           "GraphVectors"]

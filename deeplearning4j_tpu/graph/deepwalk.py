@@ -1,0 +1,106 @@
+"""DeepWalk vertex embeddings (ref: deeplearning4j-graph
+org.deeplearning4j.graph.models.deepwalk.DeepWalk + GraphVectorsImpl).
+
+The reference trains hierarchical-softmax skip-gram over walks via its own
+GraphHuffman tree, one pair at a time. Here walks are a (num_walks, L) int32
+matrix and training reuses the word2vec module's batched
+negative-sampling skip-gram step (text/word2vec.py _sg_step) — one jitted
+scatter-update per batch; vertex ids are the vocabulary directly (no
+tokenizer round-trip).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.walker import generate_walks
+from deeplearning4j_tpu.text.word2vec import _sg_step_jit
+
+
+class GraphVectors:
+    """Learned vertex embeddings (ref: org.deeplearning4j.graph.models.
+    GraphVectors: getVertexVector / verticesNearest / similarity)."""
+
+    def __init__(self, vectors: np.ndarray, graph: Graph):
+        self.vectors = vectors
+        self.graph = graph
+
+    def numVertices(self) -> int:
+        return len(self.vectors)
+
+    def getVertexVector(self, v: int) -> np.ndarray:
+        return self.vectors[v]
+
+    def similarity(self, a: int, b: int) -> float:
+        va, vb = self.vectors[a], self.vectors[b]
+        den = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / max(den, 1e-12))
+
+    def verticesNearest(self, v: int, top: int = 5) -> List[int]:
+        sims = np.array([self.similarity(v, u) for u in range(len(self.vectors))])
+        sims[v] = -np.inf
+        return list(np.argsort(-sims)[:top])
+
+
+class DeepWalk:
+    """(ref: DeepWalk.Builder: windowSize/vectorSize/walkLength/learningRate)."""
+
+    def __init__(self, vectorSize: int = 64, windowSize: int = 5,
+                 walkLength: int = 40, walksPerVertex: int = 10,
+                 learningRate: float = 0.025, minLearningRate: float = 1e-4,
+                 negativeSample: int = 5, epochs: int = 1,
+                 batchSize: int = 512, seed: int = 42):
+        self.vectorSize = vectorSize
+        self.windowSize = windowSize
+        self.walkLength = walkLength
+        self.walksPerVertex = walksPerVertex
+        self.learningRate = learningRate
+        self.minLearningRate = minLearningRate
+        self.negative = max(int(negativeSample), 1)
+        self.epochs = epochs
+        self.batchSize = batchSize
+        self.seed = seed
+        self.vectors: Optional[np.ndarray] = None
+
+    def fit(self, graph: Graph) -> GraphVectors:
+        rng = np.random.default_rng(self.seed)
+        V, D = graph.numVertices(), self.vectorSize
+        syn0 = jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D)
+        syn1 = jnp.zeros((V, D), jnp.float32)
+
+        # unigram table from vertex degree^0.75 (the degree distribution is
+        # the walk-visit distribution's stationary proxy)
+        deg = np.array([max(graph.getDegree(v), 1) for v in range(V)], np.float64)
+        p = deg ** 0.75
+        p /= p.sum()
+
+        b_eff = min(self.batchSize, max(64, 4 * V))
+        for ep in range(self.epochs):
+            walks = generate_walks(graph, self.walkLength, self.walksPerVertex,
+                                   seed=self.seed + ep)
+            pairs = []
+            for walk in walks:
+                for i, c in enumerate(walk):
+                    b = rng.integers(1, self.windowSize + 1)
+                    lo, hi = max(0, i - b), min(len(walk), i + b + 1)
+                    for j in range(lo, hi):
+                        if j != i:
+                            pairs.append((c, walk[j]))
+            pairs = np.asarray(pairs, dtype=np.int32)
+            rng.shuffle(pairs)
+            nb = max(1, -(-len(pairs) // b_eff))
+            for bi, k in enumerate(range(0, len(pairs), b_eff)):
+                frac = (ep + bi / nb) / max(self.epochs, 1)
+                lr = max(self.minLearningRate, self.learningRate * (1 - frac))
+                batch = pairs[k:k + b_eff]
+                neg = rng.choice(V, size=(len(batch), self.negative),
+                                 p=p).astype(np.int32)
+                syn0, syn1 = _sg_step_jit(syn0, syn1,
+                                          jnp.asarray(batch[:, 0]),
+                                          jnp.asarray(batch[:, 1]),
+                                          jnp.asarray(neg), lr)
+        self.vectors = np.asarray(syn0)
+        return GraphVectors(self.vectors, graph)

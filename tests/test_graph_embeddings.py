@@ -1,0 +1,91 @@
+"""Graph-embedding tests (ref: deeplearning4j-graph's TestDeepWalk /
+TestGraph — structure invariants, walk statistics, and a two-community
+clustering test standing in for the reference's graph-distance assertions)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk, Graph, RandomWalkIterator, generate_walks,
+)
+
+
+def two_communities(n_per=8, inter_edges=1, seed=0):
+    """Two dense cliques joined by a bridge — the canonical DeepWalk test."""
+    rng = np.random.default_rng(seed)
+    g = Graph(2 * n_per)
+    for base in (0, n_per):
+        for i in range(n_per):
+            for j in range(i + 1, n_per):
+                if rng.random() < 0.8:
+                    g.addEdge(base + i, base + j)
+    for _ in range(inter_edges):
+        g.addEdge(0, n_per)
+    return g
+
+
+class TestGraph:
+    def test_structure_queries(self):
+        g = Graph.fromEdgeList([(0, 1), (1, 2), (2, 0), (2, 3)])
+        assert g.numVertices() == 4
+        assert g.getDegree(2) == 3
+        assert set(g.getConnectedVertices(1)) == {0, 2}
+
+    def test_directed(self):
+        g = Graph(3, directed=True)
+        g.addEdge(0, 1)
+        assert g.getConnectedVertices(0) == [1]
+        assert g.getConnectedVertices(1) == []
+
+    def test_isolated_vertex_padding(self):
+        g = Graph(3)
+        g.addEdge(0, 1)
+        nbr, deg = g.neighbors_arrays()
+        assert deg[2] == 1 and nbr[2, 0] == 2  # self-loop padding
+
+
+class TestWalks:
+    def test_walks_follow_edges(self):
+        g = Graph.fromEdgeList([(0, 1), (1, 2), (2, 3), (3, 0)])
+        walks = generate_walks(g, walk_length=10, walks_per_vertex=3, seed=1)
+        assert walks.shape == (12, 10)
+        edge_set = {(a, b) for a in range(4) for b in g.getConnectedVertices(a)}
+        for w in walks:
+            for a, b in zip(w[:-1], w[1:]):
+                assert (int(a), int(b)) in edge_set
+
+    def test_every_vertex_starts(self):
+        g = two_communities()
+        walks = generate_walks(g, 5, walks_per_vertex=2, seed=0)
+        counts = np.bincount(walks[:, 0], minlength=g.numVertices())
+        assert (counts == 2).all()
+
+    def test_iterator_facade(self):
+        g = Graph.fromEdgeList([(0, 1), (1, 2)])
+        walks = list(RandomWalkIterator(g, walk_length=4, seed=0))
+        assert len(walks) == 3 and all(len(w) == 4 for w in walks)
+
+
+class TestDeepWalk:
+    def test_communities_cluster_in_embedding_space(self):
+        g = two_communities(n_per=8)
+        dw = DeepWalk(vectorSize=16, windowSize=4, walkLength=20,
+                      walksPerVertex=8, epochs=3, seed=3)
+        gv = dw.fit(g)
+        assert gv.numVertices() == 16
+        # mean intra-community similarity far above inter-community
+        intra, inter = [], []
+        for a in range(16):
+            for b in range(a + 1, 16):
+                (intra if (a < 8) == (b < 8) else inter).append(gv.similarity(a, b))
+        assert np.mean(intra) > np.mean(inter) + 0.3, (np.mean(intra), np.mean(inter))
+        # nearest neighbors of an interior vertex stay inside its community
+        near = gv.verticesNearest(3, top=4)
+        assert sum(1 for v in near if v < 8) >= 3
+
+    def test_vertex_vector_api(self):
+        g = two_communities(n_per=4)
+        gv = DeepWalk(vectorSize=8, walkLength=10, walksPerVertex=4,
+                      epochs=1).fit(g)
+        v = gv.getVertexVector(0)
+        assert v.shape == (8,) and np.isfinite(v).all()
+        assert gv.similarity(0, 0) == pytest.approx(1.0)
