@@ -1,0 +1,180 @@
+"""Audio ETL (ref: datavec-data-audio — WavFileRecordReader over JavaSound,
+plus the reference's MFCC pipeline via musicg/jAudio helpers).
+
+WAV decode uses the stdlib ``wave`` module (PCM 8/16/32-bit); feature
+extraction (spectrogram, log-mel, MFCC) is jnp code — framing is one
+strided-window reshape, the filterbank is one matmul, the DCT one matmul:
+all fuse into a handful of XLA ops, where the reference loops frames in
+Java.
+"""
+from __future__ import annotations
+
+import wave
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datavec.records import RecordReader, SequenceRecordReader
+from deeplearning4j_tpu.datavec.split import InputSplit
+from deeplearning4j_tpu.datavec.writables import FloatWritable, NDArrayWritable, Writable
+
+
+def read_wav(path: str):
+    """-> (samples float32 in [-1, 1] shaped (n,) mono / (n, ch), rate)."""
+    with wave.open(path, "rb") as w:
+        n, ch, width, rate = (w.getnframes(), w.getnchannels(),
+                              w.getsampwidth(), w.getframerate())
+        raw = w.readframes(n)
+    if width == 2:
+        x = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+    elif width == 4:
+        x = np.frombuffer(raw, "<i4").astype(np.float32) / 2147483648.0
+    elif width == 1:  # unsigned 8-bit
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if ch > 1:
+        x = x.reshape(-1, ch)
+    return x, rate
+
+
+def write_wav(path: str, samples: np.ndarray, rate: int):
+    """Mono/multi-channel float [-1,1] -> 16-bit PCM (test-fixture helper)."""
+    x = np.asarray(samples)
+    ch = 1 if x.ndim == 1 else x.shape[1]
+    pcm = np.clip(x * 32767.0, -32768, 32767).astype("<i2")
+    with wave.open(path, "wb") as w:
+        w.setnchannels(ch)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(pcm.tobytes())
+
+
+# ------------------------------------------------------------- features
+
+def frame_signal(x, frame_length: int, frame_step: int):
+    """(n,) -> (num_frames, frame_length) via strided windows."""
+    x = jnp.asarray(x)
+    n_frames = 1 + max(0, (x.shape[0] - frame_length)) // frame_step
+    idx = (jnp.arange(frame_length)[None, :]
+           + frame_step * jnp.arange(n_frames)[:, None])
+    return x[idx]
+
+
+def spectrogram(x, frame_length: int = 256, frame_step: int = 128,
+                window: str = "hann"):
+    """Magnitude STFT (num_frames, frame_length//2 + 1)."""
+    frames = frame_signal(x, frame_length, frame_step)
+    if window == "hann":
+        frames = frames * jnp.hanning(frame_length)
+    return jnp.abs(jnp.fft.rfft(frames, axis=-1))
+
+
+def mel_filterbank(num_mel: int, frame_length: int, rate: int,
+                   fmin: float = 0.0, fmax: Optional[float] = None):
+    """(num_mel, frame_length//2+1) triangular filters on the mel scale."""
+    fmax = fmax or rate / 2
+
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    n_bins = frame_length // 2 + 1
+    mel_pts = np.linspace(hz_to_mel(fmin), hz_to_mel(fmax), num_mel + 2)
+    hz_pts = mel_to_hz(mel_pts)
+    bins = np.floor((frame_length + 1) * hz_pts / rate).astype(int)
+    fb = np.zeros((num_mel, n_bins), np.float32)
+    for m in range(1, num_mel + 1):
+        lo, ctr, hi = bins[m - 1], bins[m], bins[m + 1]
+        for k in range(lo, ctr):
+            if ctr > lo:
+                fb[m - 1, k] = (k - lo) / (ctr - lo)
+        for k in range(ctr, hi):
+            if hi > ctr:
+                fb[m - 1, k] = (hi - k) / (hi - ctr)
+    return jnp.asarray(fb)
+
+
+def _dct_matrix(n_out: int, n_in: int):
+    k = np.arange(n_out)[:, None]
+    i = np.arange(n_in)[None, :]
+    m = np.sqrt(2.0 / n_in) * np.cos(np.pi * k * (2 * i + 1) / (2 * n_in))
+    m[0] /= np.sqrt(2.0)
+    return jnp.asarray(m.astype(np.float32))
+
+
+def mfcc(x, rate: int, num_coeffs: int = 13, num_mel: int = 26,
+         frame_length: int = 256, frame_step: int = 128):
+    """(num_frames, num_coeffs) mel-frequency cepstral coefficients."""
+    spec = spectrogram(x, frame_length, frame_step)
+    fb = mel_filterbank(num_mel, frame_length, rate)
+    mel_energy = jnp.log(jnp.maximum(spec ** 2 @ fb.T, 1e-10))
+    return mel_energy @ _dct_matrix(num_coeffs, num_mel).T
+
+
+# -------------------------------------------------------------- readers
+
+class WavFileRecordReader(RecordReader):
+    """One record per WAV file: every amplitude sample as a FloatWritable
+    (ref: org.datavec.audio.recordreader.WavFileRecordReader)."""
+
+    def __init__(self):
+        self._paths: List[str] = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit):
+        self._paths = list(split.locations())
+        self._pos = 0
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._paths)
+
+    def next(self) -> List[Writable]:
+        x, _ = read_wav(self._paths[self._pos])
+        self._pos += 1
+        return [FloatWritable(float(v)) for v in np.ravel(x)]
+
+    def reset(self):
+        self._pos = 0
+
+
+class SpectrogramSequenceRecordReader(SequenceRecordReader):
+    """WAV -> feature-frame sequence: each step one NDArrayWritable row of
+    the spectrogram (or MFCC with ``features='mfcc'``). The datavec-native
+    route from audio files to masked sequence DataSets."""
+
+    def __init__(self, frame_length: int = 256, frame_step: int = 128,
+                 features: str = "spectrogram", num_coeffs: int = 13):
+        self.frame_length = frame_length
+        self.frame_step = frame_step
+        self.features = features
+        self.num_coeffs = num_coeffs
+        self._paths: List[str] = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit):
+        self._paths = list(split.locations())
+        self._pos = 0
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._paths)
+
+    def next(self):
+        x, rate = read_wav(self._paths[self._pos])
+        self._pos += 1
+        if x.ndim > 1:
+            x = x.mean(-1)
+        if self.features == "mfcc":
+            feats = mfcc(x, rate, num_coeffs=self.num_coeffs,
+                         frame_length=self.frame_length,
+                         frame_step=self.frame_step)
+        else:
+            feats = spectrogram(x, self.frame_length, self.frame_step)
+        feats = np.asarray(feats)
+        return [[NDArrayWritable(row)] for row in feats]
+
+    def reset(self):
+        self._pos = 0

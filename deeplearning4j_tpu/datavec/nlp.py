@@ -1,0 +1,119 @@
+"""NLP ETL (ref: datavec-data-nlp — org.datavec.nlp.reader.TfidfRecordReader
++ vectorizer.TfidfVectorizer / BagOfWordsVectorizer over the tokenizer SPI).
+
+Vectorization reuses the text package's tokenizer factories; the fitted
+vocabulary/IDF table lives on the vectorizer and document vectors come out
+as one dense numpy row (the reference emits a sparse INDArray through
+NDArrayWritable — dense is the TPU-friendly layout at these vocab sizes).
+"""
+from __future__ import annotations
+
+import math
+import os
+from collections import Counter
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datavec.records import RecordReader
+from deeplearning4j_tpu.datavec.split import InputSplit
+from deeplearning4j_tpu.datavec.writables import NDArrayWritable, Text, Writable
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+
+
+class BagOfWordsVectorizer:
+    """Count vectors (ref: org.datavec.nlp.vectorizer.BagOfWordsVectorizer)."""
+
+    def __init__(self, tokenizerFactory=None, minWordFrequency: int = 1):
+        self.tokenizer = tokenizerFactory or DefaultTokenizerFactory()
+        self.minWordFrequency = minWordFrequency
+        self.vocab: Dict[str, int] = {}
+
+    def _tokens(self, text: str) -> List[str]:
+        return self.tokenizer.create(text).getTokens()
+
+    def fit(self, documents: List[str]) -> "BagOfWordsVectorizer":
+        counts: Counter = Counter()
+        for doc in documents:
+            counts.update(self._tokens(doc))
+        words = sorted(w for w, c in counts.items() if c >= self.minWordFrequency)
+        self.vocab = {w: i for i, w in enumerate(words)}
+        return self
+
+    def numWords(self) -> int:
+        return len(self.vocab)
+
+    def transform(self, text: str) -> np.ndarray:
+        v = np.zeros(len(self.vocab), np.float32)
+        for t in self._tokens(text):
+            i = self.vocab.get(t)
+            if i is not None:
+                v[i] += 1.0
+        return v
+
+
+class TfidfVectorizer(BagOfWordsVectorizer):
+    """tf-idf with smoothed idf = ln((1+N)/(1+df)) + 1 (ref:
+    org.datavec.nlp.vectorizer.TfidfVectorizer)."""
+
+    def __init__(self, tokenizerFactory=None, minWordFrequency: int = 1):
+        super().__init__(tokenizerFactory, minWordFrequency)
+        self.idf: Optional[np.ndarray] = None
+
+    def fit(self, documents: List[str]) -> "TfidfVectorizer":
+        super().fit(documents)
+        df = np.zeros(len(self.vocab), np.float64)
+        for doc in documents:
+            for t in set(self._tokens(doc)):
+                i = self.vocab.get(t)
+                if i is not None:
+                    df[i] += 1
+        n = len(documents)
+        self.idf = (np.log((1.0 + n) / (1.0 + df)) + 1.0).astype(np.float32)
+        return self
+
+    def transform(self, text: str) -> np.ndarray:
+        tf = super().transform(text)
+        return tf * self.idf
+
+
+class TfidfRecordReader(RecordReader):
+    """Text files -> [tfidf NDArrayWritable, label Text] records; the label
+    is the parent directory name, as the reference's file-per-document corpus
+    layout (ref: org.datavec.nlp.reader.TfidfRecordReader)."""
+
+    def __init__(self, vectorizer: Optional[TfidfVectorizer] = None,
+                 appendLabel: bool = True):
+        self.vectorizer = vectorizer or TfidfVectorizer()
+        self.appendLabel = appendLabel
+        self._paths: List[str] = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit):
+        self._paths = list(split.locations())
+        self._pos = 0
+        docs = []
+        for p in self._paths:
+            with open(p) as f:
+                docs.append(f.read())
+        if not self.vectorizer.vocab:
+            self.vectorizer.fit(docs)
+        self._docs = docs
+
+    def getLabels(self) -> List[str]:
+        return sorted({os.path.basename(os.path.dirname(p)) for p in self._paths})
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._paths)
+
+    def next(self) -> List[Writable]:
+        vec = self.vectorizer.transform(self._docs[self._pos])
+        rec: List[Writable] = [NDArrayWritable(vec)]
+        if self.appendLabel:
+            rec.append(Text(os.path.basename(
+                os.path.dirname(self._paths[self._pos]))))
+        self._pos += 1
+        return rec
+
+    def reset(self):
+        self._pos = 0

@@ -1,0 +1,135 @@
+"""Audio + NLP ETL tests (ref: datavec-data-audio WavFileRecordReaderTest and
+datavec-data-nlp TfidfRecordReaderTest — synthetic WAV fixtures and a tiny
+file-per-document corpus)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec.audio import (
+    SpectrogramSequenceRecordReader, WavFileRecordReader, frame_signal,
+    mel_filterbank, mfcc, read_wav, spectrogram, write_wav,
+)
+from deeplearning4j_tpu.datavec.nlp import (
+    BagOfWordsVectorizer, TfidfRecordReader, TfidfVectorizer,
+)
+from deeplearning4j_tpu.datavec.split import CollectionInputSplit
+
+
+def sine_wav(path, freq, rate=8000, dur=0.25):
+    t = np.arange(int(rate * dur)) / rate
+    write_wav(str(path), 0.7 * np.sin(2 * np.pi * freq * t), rate)
+    return str(path)
+
+
+class TestWav:
+    def test_roundtrip_16bit(self, tmp_path):
+        p = sine_wav(tmp_path / "a.wav", 440)
+        x, rate = read_wav(p)
+        assert rate == 8000 and x.shape == (2000,)
+        assert np.abs(x).max() == pytest.approx(0.7, abs=0.01)
+
+    def test_reader_emits_samples(self, tmp_path):
+        p = sine_wav(tmp_path / "a.wav", 100, dur=0.05)
+        r = WavFileRecordReader()
+        r.initialize(CollectionInputSplit([p]))
+        rec = r.next()
+        assert len(rec) == 400
+        assert not r.hasNext()
+        r.reset()
+        assert r.hasNext()
+
+
+class TestFeatures:
+    def test_framing_shape_and_content(self):
+        x = np.arange(10, dtype=np.float32)
+        f = np.asarray(frame_signal(x, 4, 2))
+        assert f.shape == (4, 4)
+        np.testing.assert_allclose(f[1], [2, 3, 4, 5])
+
+    def test_spectrogram_peak_at_tone_bin(self, tmp_path):
+        rate, freq, n_fft = 8000, 1000, 256
+        x, _ = read_wav(sine_wav(tmp_path / "t.wav", freq, rate))
+        spec = np.asarray(spectrogram(x, n_fft, 128))
+        peak_bin = spec.mean(0).argmax()
+        assert peak_bin == pytest.approx(freq * n_fft / rate, abs=1)
+
+    def test_mel_filterbank_partition(self):
+        fb = np.asarray(mel_filterbank(20, 256, 8000))
+        assert fb.shape == (20, 129)
+        assert (fb >= 0).all()
+        # each filter has support; interior bins covered by some filter
+        assert (fb.sum(1) > 0).all()
+
+    def test_mfcc_distinguishes_tones(self, tmp_path):
+        xa, rate = read_wav(sine_wav(tmp_path / "a.wav", 300))
+        xb, _ = read_wav(sine_wav(tmp_path / "b.wav", 2500))
+        ma = np.asarray(mfcc(xa, rate)).mean(0)
+        mb = np.asarray(mfcc(xb, rate)).mean(0)
+        assert np.isfinite(ma).all() and np.isfinite(mb).all()
+        assert np.linalg.norm(ma - mb) > 1.0
+
+    def test_spectrogram_sequence_reader(self, tmp_path):
+        p = sine_wav(tmp_path / "a.wav", 500)
+        r = SpectrogramSequenceRecordReader(frame_length=128, frame_step=64,
+                                            features="mfcc", num_coeffs=13)
+        r.initialize(CollectionInputSplit([p]))
+        seq = r.next()
+        assert len(seq) > 10  # frames
+        assert seq[0][0].value.shape == (13,)
+
+
+CORPUS = {
+    "sports/d0.txt": "the match was a great win for the team",
+    "sports/d1.txt": "the team lost the final match",
+    "tech/d2.txt": "the new chip computes fast matmul kernels",
+    "tech/d3.txt": "compiler fuses matmul kernels on the chip",
+}
+
+
+def write_corpus(tmp_path):
+    paths = []
+    for rel, text in CORPUS.items():
+        p = tmp_path / rel
+        p.parent.mkdir(exist_ok=True)
+        p.write_text(text)
+        paths.append(str(p))
+    return paths
+
+
+class TestVectorizers:
+    def test_bag_of_words_counts(self):
+        v = BagOfWordsVectorizer().fit(["a b b c", "c d"])
+        assert v.numWords() == 4
+        vec = v.transform("b b d unknown")
+        assert vec[v.vocab["b"]] == 2 and vec[v.vocab["d"]] == 1
+        assert vec.sum() == 3  # unknown dropped
+
+    def test_tfidf_downweights_common_terms(self):
+        docs = ["the cat sat", "the dog ran", "the bird flew"]
+        v = TfidfVectorizer().fit(docs)
+        the_w = v.idf[v.vocab["the"]]
+        cat_w = v.idf[v.vocab["cat"]]
+        assert cat_w > the_w  # 'the' appears in every doc
+        vec = v.transform("the cat")
+        assert vec[v.vocab["cat"]] > vec[v.vocab["the"]]
+
+    def test_tfidf_record_reader_labels(self, tmp_path):
+        paths = write_corpus(tmp_path)
+        r = TfidfRecordReader()
+        r.initialize(CollectionInputSplit(paths))
+        assert r.getLabels() == ["sports", "tech"]
+        recs = list(r)
+        assert len(recs) == 4
+        vec0, label0 = recs[0][0].value, recs[0][1].toString()
+        assert label0 in ("sports", "tech")
+        assert vec0.shape == (r.vectorizer.numWords(),)
+        # same-topic documents are closer than cross-topic (cosine)
+        vecs = {p: rec[0].value for p, rec in zip(paths, recs)}
+        def cos(a, b):
+            return float(a @ b / max(np.linalg.norm(a) * np.linalg.norm(b), 1e-12))
+        sports = [vecs[p] for p in paths if "sports" in p]
+        tech = [vecs[p] for p in paths if "tech" in p]
+        intra = cos(sports[0], sports[1]) + cos(tech[0], tech[1])
+        inter = cos(sports[0], tech[0]) + cos(sports[1], tech[1])
+        assert intra > inter
