@@ -136,10 +136,16 @@ def _full_attention(q, k, v, causal: bool):
 
 
 def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
-    """Dispatch: full attention, or sequence-parallel ring/Ulysses via
-    shard_map over the 'context' axis when the mesh has one."""
+    """Dispatch: full attention, the Pallas flash kernel, or sequence-parallel
+    ring/Ulysses via shard_map over the 'context' axis when the mesh has one."""
     impl = cfg.attention_impl
-    if impl == "full" or mesh is None or CONTEXT_AXIS not in mesh.axis_names \
+    if impl == "flash" and (mesh is None or CONTEXT_AXIS not in mesh.axis_names
+                            or mesh.shape[CONTEXT_AXIS] == 1):
+        from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
+        interpret = jax.default_backend() != "tpu"
+        return flash_attention(q, k, v, cfg.causal, 128, 128, None, interpret)
+    if impl in ("full", "flash") or mesh is None \
+            or CONTEXT_AXIS not in mesh.axis_names \
             or mesh.shape[CONTEXT_AXIS] == 1:
         return _full_attention(q, k, v, cfg.causal)
     fn = ring_attention if impl == "ring" else ulysses_attention
