@@ -1,0 +1,30 @@
+"""Build the native library: ``python -m deeplearning4j_tpu.native.build``.
+
+Single g++ invocation — no cmake ceremony for one translation unit. The
+.so lands next to the source and is loaded by ctypes (see __init__.py);
+__init__ also auto-builds on first import when g++ is present.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "dl4j_native.cpp")
+OUT = os.path.join(HERE, "libdl4j_native.so")
+
+
+def build(verbose: bool = True) -> str:
+    gxx = shutil.which("g++") or shutil.which("clang++")
+    if gxx is None:
+        raise RuntimeError("no C++ compiler found (g++/clang++)")
+    cmd = [gxx, "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           SRC, "-o", OUT]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    sys.exit(0 if os.path.exists(build()) else 1)

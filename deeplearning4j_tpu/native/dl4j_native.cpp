@@ -1,0 +1,192 @@
+// Native data-pipeline hot path (ref: the reference's C++ ETL layer —
+// datavec native IO and libnd4j's cnpy/file loaders; SURVEY.md §2.3 notes
+// the JVM reference drops to native for exactly this: tokenize-and-parse
+// throughput on large record files).
+//
+// Exposed via ctypes (no pybind11 in this toolchain). All functions use a
+// plain C ABI; buffers are caller-allocated numpy arrays.
+//
+// Build: python -m deeplearning4j_tpu.native.build  (g++ -O3 -shared -fPIC)
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- CSV
+// Count rows (non-empty lines) and columns (fields in first non-empty line).
+// Returns 0 on success.
+int csv_dims(const char* buf, int64_t len, char delim, int64_t* rows,
+             int64_t* cols) {
+  *rows = 0;
+  *cols = 0;
+  int64_t i = 0;
+  // first non-empty line -> cols
+  while (i < len) {
+    int64_t start = i;
+    while (i < len && buf[i] != '\n') i++;
+    int64_t line_len = i - start;
+    i++;  // skip newline
+    if (line_len == 0 || (line_len == 1 && buf[start] == '\r')) continue;
+    if (*cols == 0) {
+      int64_t c = 1;
+      for (int64_t j = start; j < start + line_len; j++)
+        if (buf[j] == delim) c++;
+      *cols = c;
+    }
+    (*rows)++;
+  }
+  return 0;
+}
+
+// Parse one chunk of lines [line_lo, line_hi) given precomputed line offsets.
+static void parse_chunk(const char* buf, const int64_t* line_off,
+                        const int64_t* line_len, int64_t line_lo,
+                        int64_t line_hi, int64_t cols, char delim, double* out,
+                        std::atomic<int>* err) {
+  for (int64_t r = line_lo; r < line_hi; r++) {
+    const char* p = buf + line_off[r];
+    const char* end = p + line_len[r];
+    for (int64_t c = 0; c < cols; c++) {
+      char* next = nullptr;
+      double v = strtod(p, &next);
+      if (next == p) {  // not a number (empty field) -> NaN, advance to delim
+        v = NAN;
+        next = const_cast<char*>(p);
+      }
+      out[r * cols + c] = v;
+      p = next;
+      while (p < end && *p != delim) p++;
+      if (p < end) p++;  // skip delimiter
+    }
+  }
+}
+
+// Parse a full numeric CSV buffer into out[rows*cols] using `threads`
+// worker threads. Rows/cols must come from csv_dims. Returns 0 on success.
+int csv_parse(const char* buf, int64_t len, char delim, int64_t rows,
+              int64_t cols, double* out, int threads) {
+  // index line offsets (single pass)
+  std::vector<int64_t> off, llen;
+  off.reserve(rows);
+  llen.reserve(rows);
+  int64_t i = 0;
+  while (i < len && (int64_t)off.size() < rows) {
+    int64_t start = i;
+    while (i < len && buf[i] != '\n') i++;
+    int64_t L = i - start;
+    i++;
+    if (L == 0 || (L == 1 && buf[start] == '\r')) continue;
+    off.push_back(start);
+    llen.push_back(L);
+  }
+  if ((int64_t)off.size() != rows) return -1;
+
+  std::atomic<int> err{0};
+  if (threads <= 1 || rows < 1024) {
+    parse_chunk(buf, off.data(), llen.data(), 0, rows, cols, delim, out, &err);
+  } else {
+    std::vector<std::thread> pool;
+    int64_t per = (rows + threads - 1) / threads;
+    for (int t = 0; t < threads; t++) {
+      int64_t lo = t * per;
+      int64_t hi = lo + per < rows ? lo + per : rows;
+      if (lo >= hi) break;
+      pool.emplace_back(parse_chunk, buf, off.data(), llen.data(), lo, hi,
+                        cols, delim, out, &err);
+    }
+    for (auto& th : pool) th.join();
+  }
+  return err.load();
+}
+
+// ---------------------------------------------------------------- IDX
+// IDX (MNIST container) header: magic [0, 0, dtype, ndim], then ndim
+// big-endian uint32 dims, then data. Returns ndim, fills dims (max 8) and
+// dtype code; -1 on malformed magic.
+int idx_header(const char* buf, int64_t len, int64_t* dims, int* dtype) {
+  if (len < 4 || buf[0] != 0 || buf[1] != 0) return -1;
+  int dt = (unsigned char)buf[2];
+  int nd = (unsigned char)buf[3];
+  if (nd > 8 || len < 4 + 4 * nd) return -1;
+  for (int d = 0; d < nd; d++) {
+    const unsigned char* p = (const unsigned char*)buf + 4 + 4 * d;
+    dims[d] = ((int64_t)p[0] << 24) | ((int64_t)p[1] << 16) |
+              ((int64_t)p[2] << 8) | (int64_t)p[3];
+  }
+  *dtype = dt;
+  return nd;
+}
+
+// Decode IDX payload to float64, scaling uint8 by 1/255 when scale != 0.
+// Supports dtype 0x08 (uint8), 0x09 (int8), 0x0B (int16), 0x0C (int32),
+// 0x0D (float32), 0x0E (float64). Returns 0 on success.
+int idx_decode(const char* buf, int64_t len, int64_t offset, int64_t count,
+               int dtype, int scale, double* out) {
+  const unsigned char* p = (const unsigned char*)buf + offset;
+  switch (dtype) {
+    case 0x08: {
+      if (offset + count > len) return -1;
+      double k = scale ? (1.0 / 255.0) : 1.0;
+      for (int64_t i = 0; i < count; i++) out[i] = p[i] * k;
+      return 0;
+    }
+    case 0x09: {
+      if (offset + count > len) return -1;
+      for (int64_t i = 0; i < count; i++) out[i] = (signed char)p[i];
+      return 0;
+    }
+    case 0x0B: {
+      if (offset + 2 * count > len) return -1;
+      for (int64_t i = 0; i < count; i++) {
+        int16_t v = (int16_t)((p[2 * i] << 8) | p[2 * i + 1]);
+        out[i] = v;
+      }
+      return 0;
+    }
+    case 0x0C: {
+      if (offset + 4 * count > len) return -1;
+      for (int64_t i = 0; i < count; i++) {
+        int32_t v = (int32_t)(((uint32_t)p[4 * i] << 24) |
+                              ((uint32_t)p[4 * i + 1] << 16) |
+                              ((uint32_t)p[4 * i + 2] << 8) |
+                              (uint32_t)p[4 * i + 3]);
+        out[i] = v;
+      }
+      return 0;
+    }
+    case 0x0D: {
+      if (offset + 4 * count > len) return -1;
+      for (int64_t i = 0; i < count; i++) {
+        uint32_t u = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+                     ((uint32_t)p[4 * i + 2] << 8) | (uint32_t)p[4 * i + 3];
+        float f;
+        memcpy(&f, &u, 4);
+        out[i] = f;
+      }
+      return 0;
+    }
+    case 0x0E: {
+      if (offset + 8 * count > len) return -1;
+      for (int64_t i = 0; i < count; i++) {
+        uint64_t u = 0;
+        for (int b = 0; b < 8; b++) u = (u << 8) | p[8 * i + b];
+        double d;
+        memcpy(&d, &u, 8);
+        out[i] = d;
+      }
+      return 0;
+    }
+    default:
+      return -2;
+  }
+}
+
+int dl4j_native_abi_version() { return 1; }
+
+}  // extern "C"
