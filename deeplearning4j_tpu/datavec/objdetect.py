@@ -1,0 +1,156 @@
+"""Object-detection ETL (ref: datavec-data-image org.datavec.image.recordreader.
+objdetect — ObjectDetectionRecordReader + ImageObject + VocLabelProvider).
+
+The reader emits [image CHW, label grid (4+C, gridH, gridW)] records where
+the label grid carries, at each object's center cell, the YOLOv2 target
+encoding consumed by Yolo2OutputLayer.compute_loss (nn/conf/layers.py):
+tx,ty = center offset within the cell in [0,1); tw,th = box size in grid
+units; then the one-hot class vector. Cells without objects stay zero.
+"""
+from __future__ import annotations
+
+import os
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datavec.image import NativeImageLoader
+from deeplearning4j_tpu.datavec.records import RecordReader
+from deeplearning4j_tpu.datavec.split import InputSplit
+from deeplearning4j_tpu.datavec.writables import NDArrayWritable, Writable
+
+
+@dataclass
+class ImageObject:
+    """One annotated box in PIXEL coordinates (ref: o.d.image.recordreader.
+    objdetect.ImageObject)."""
+    x1: float
+    y1: float
+    x2: float
+    y2: float
+    label: str
+
+    @property
+    def cx(self):
+        return (self.x1 + self.x2) / 2.0
+
+    @property
+    def cy(self):
+        return (self.y1 + self.y2) / 2.0
+
+
+class ImageObjectLabelProvider:
+    """SPI (ref: objdetect.ImageObjectLabelProvider)."""
+
+    def getImageObjectsForPath(self, path: str) -> List[ImageObject]:
+        raise NotImplementedError
+
+
+class VocLabelProvider(ImageObjectLabelProvider):
+    """Pascal-VOC layout: <base>/Annotations/<stem>.xml beside
+    <base>/JPEGImages/<stem>.jpg (ref: objdetect.impl.VocLabelProvider)."""
+
+    def __init__(self, base_dir: str):
+        self.annotations = os.path.join(base_dir, "Annotations")
+
+    def getImageObjectsForPath(self, path: str) -> List[ImageObject]:
+        stem = os.path.splitext(os.path.basename(path))[0]
+        xml_path = os.path.join(self.annotations, stem + ".xml")
+        out: List[ImageObject] = []
+        root = ET.parse(xml_path).getroot()
+        for obj in root.iter("object"):
+            name = obj.findtext("name")
+            box = obj.find("bndbox")
+            out.append(ImageObject(
+                float(box.findtext("xmin")), float(box.findtext("ymin")),
+                float(box.findtext("xmax")), float(box.findtext("ymax")),
+                name))
+        return out
+
+
+class JsonLinesLabelProvider(ImageObjectLabelProvider):
+    """<image>.boxes.jsonl sidecar files: one JSON object per line with
+    x1/y1/x2/y2/label — a dependency-free fixture format for tests and
+    simple datasets."""
+
+    def getImageObjectsForPath(self, path: str) -> List[ImageObject]:
+        import json
+        side = os.path.splitext(path)[0] + ".boxes.jsonl"
+        out = []
+        with open(side) as f:
+            for line in f:
+                if line.strip():
+                    d = json.loads(line)
+                    out.append(ImageObject(d["x1"], d["y1"], d["x2"], d["y2"],
+                                           d["label"]))
+        return out
+
+
+class ObjectDetectionRecordReader(RecordReader):
+    """(ref: objdetect.ObjectDetectionRecordReader). next() ->
+    [image (C,H,W) NDArrayWritable, label (4+C, gridH, gridW) NDArrayWritable]."""
+
+    def __init__(self, height: int, width: int, channels: int,
+                 gridH: int, gridW: int,
+                 labelProvider: ImageObjectLabelProvider,
+                 labels: Optional[Sequence[str]] = None):
+        self.h, self.w, self.c = height, width, channels
+        self.gh, self.gw = gridH, gridW
+        self.provider = labelProvider
+        self._labels = list(labels) if labels else None
+        self._paths: List[str] = []
+        self._pos = 0
+        self._loader = NativeImageLoader(height, width, channels)
+
+    def initialize(self, split: InputSplit):
+        self._paths = list(split.locations())
+        self._pos = 0
+        if self._labels is None:
+            names = set()
+            for p in self._paths:
+                for o in self.provider.getImageObjectsForPath(p):
+                    names.add(o.label)
+            self._labels = sorted(names)
+
+    def getLabels(self) -> List[str]:
+        return list(self._labels or [])
+
+    def label_grid(self, path: str, orig_w: float, orig_h: float) -> np.ndarray:
+        """(4+C, gridH, gridW) YOLOv2 target grid for one image."""
+        C = len(self._labels)
+        grid = np.zeros((4 + C, self.gh, self.gw), np.float32)
+        for o in self.provider.getImageObjectsForPath(path):
+            # scale pixel coords to grid units
+            gx = o.cx / orig_w * self.gw
+            gy = o.cy / orig_h * self.gh
+            gw_box = (o.x2 - o.x1) / orig_w * self.gw
+            gh_box = (o.y2 - o.y1) / orig_h * self.gh
+            cx = min(int(gx), self.gw - 1)
+            cy = min(int(gy), self.gh - 1)
+            cls = self._labels.index(o.label)
+            grid[0, cy, cx] = gx - cx            # tx in [0,1)
+            grid[1, cy, cx] = gy - cy            # ty
+            grid[2, cy, cx] = gw_box             # tw (grid units)
+            grid[3, cy, cx] = gh_box             # th
+            grid[4 + cls, cy, cx] = 1.0
+        return grid
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._paths)
+
+    def next(self) -> List[Writable]:
+        path = self._paths[self._pos]
+        self._pos += 1
+        from PIL import Image
+        with Image.open(path) as im:
+            orig_w, orig_h = im.size
+        img = np.asarray(self._loader.asMatrix(path))
+        if img.ndim == 4:  # NativeImageLoader emits batch-leading (1,C,H,W)
+            img = img[0]
+        label = self.label_grid(path, orig_w, orig_h)
+        return [NDArrayWritable(img), NDArrayWritable(label)]
+
+    def reset(self):
+        self._pos = 0
