@@ -1,0 +1,72 @@
+"""Record-set joins (ref: org.datavec.api.transform.join.Join — Inner/
+LeftOuter/RightOuter/FullOuter on key columns, executed by
+LocalTransformExecutor.executeJoin; schemas merge left-then-right with key
+columns deduplicated)."""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from deeplearning4j_tpu.datavec.schema import Schema
+from deeplearning4j_tpu.datavec.writables import NullWritable, Writable
+
+
+class Join:
+    """Declarative join spec + executor.
+
+    joinType: 'Inner' | 'LeftOuter' | 'RightOuter' | 'FullOuter'
+    (ref: Join.Builder: setJoinColumns / setSchemas).
+    """
+
+    def __init__(self, joinType: str, leftSchema: Schema, rightSchema: Schema,
+                 joinColumns: Sequence[str]):
+        assert joinType in ("Inner", "LeftOuter", "RightOuter", "FullOuter"), joinType
+        self.joinType = joinType
+        self.left = leftSchema
+        self.right = rightSchema
+        self.keys = list(joinColumns)
+
+    # ------------------------------------------------------------- schema
+    def getOutputSchema(self) -> Schema:
+        cols = [self.left.getMetaData(n) for n in self.left.getColumnNames()]
+        cols += [self.right.getMetaData(n) for n in self.right.getColumnNames()
+                 if n not in self.keys]
+        return Schema(list(cols))
+
+    # ---------------------------------------------------------------- exec
+    def _key_of(self, row: List[Writable], schema: Schema) -> Tuple:
+        return tuple(row[schema.getIndexOfColumn(k)].toString() for k in self.keys)
+
+    def execute(self, leftRows: Sequence[Sequence[Writable]],
+                rightRows: Sequence[Sequence[Writable]]) -> List[List[Writable]]:
+        right_names = [n for n in self.right.getColumnNames() if n not in self.keys]
+        right_idx = [self.right.getIndexOfColumn(n) for n in right_names]
+        index: Dict[Tuple, List[List[Writable]]] = {}
+        for r in rightRows:
+            index.setdefault(self._key_of(list(r), self.right), []).append(list(r))
+
+        out: List[List[Writable]] = []
+        matched_keys = set()
+        for l in leftRows:
+            l = list(l)
+            key = self._key_of(l, self.left)
+            matches = index.get(key, [])
+            if matches:
+                matched_keys.add(key)
+                for r in matches:
+                    out.append(l + [r[i] for i in right_idx])
+            elif self.joinType in ("LeftOuter", "FullOuter"):
+                out.append(l + [NullWritable() for _ in right_idx])
+
+        if self.joinType in ("RightOuter", "FullOuter"):
+            left_key_idx = [self.left.getIndexOfColumn(k) for k in self.keys]
+            n_left = len(self.left.getColumnNames())
+            for r in rightRows:
+                r = list(r)
+                key = self._key_of(r, self.right)
+                if key in matched_keys:
+                    continue
+                left_row: List[Writable] = [NullWritable()] * n_left
+                for k, i in zip(self.keys, left_key_idx):
+                    left_row[i] = r[self.right.getIndexOfColumn(k)]
+                out.append(left_row + [r[i] for i in right_idx])
+        return out

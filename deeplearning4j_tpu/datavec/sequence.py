@@ -1,0 +1,163 @@
+"""Sequence transforms (ref: org.datavec.api.transform.sequence —
+ConvertToSequence + SequenceComparator, window.OverlappingTimeWindowFunction
+/ TimeWindowFunction, transform.SequenceOffsetTransform, trim/
+SequenceTrimTransform, split.SequenceSplitTimeSeparation,
+ReduceSequenceTransform).
+
+A sequence is List[List[Writable]] (steps x columns), matching the
+SequenceRecordReader contract. Operations are plain list/numpy code — this
+is host-side ETL; device work starts after iterators batch the output.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+from deeplearning4j_tpu.datavec.schema import Schema
+from deeplearning4j_tpu.datavec.writables import (
+    DoubleWritable, IntWritable, NullWritable, Writable, as_writable,
+)
+
+Seq = List[List[Writable]]
+
+
+def convertToSequence(rows: Sequence[Sequence[Writable]], schema: Schema,
+                      keyColumn: str, sortColumn: str,
+                      ascending: bool = True) -> List[Seq]:
+    """Group flat records by key, sort each group on sortColumn (ref:
+    ConvertToSequence + NumericalColumnComparator)."""
+    ki = schema.getIndexOfColumn(keyColumn)
+    si = schema.getIndexOfColumn(sortColumn)
+    groups: Dict[str, Seq] = {}
+    order: List[str] = []
+    for r in rows:
+        k = r[ki].toString()
+        if k not in groups:
+            groups[k] = []
+            order.append(k)
+        groups[k].append(list(r))
+    out = []
+    for k in order:
+        seq = sorted(groups[k], key=lambda row: row[si].toDouble(),
+                     reverse=not ascending)
+        out.append(seq)
+    return out
+
+
+def trimSequence(seq: Seq, numSteps: int, fromStart: bool) -> Seq:
+    """Drop numSteps from one end (ref: SequenceTrimTransform)."""
+    return seq[numSteps:] if fromStart else seq[:len(seq) - numSteps]
+
+
+def offsetSequence(seq: Seq, schema: Schema, columns: Sequence[str],
+                   offset: int, op: str = "InPlace") -> Seq:
+    """Shift ``columns`` by ``offset`` steps (positive = values move to later
+    steps — a lag feature; ref: SequenceOffsetTransform with
+    OperationType.InPlace/NewColumn). Steps whose shifted value would fall
+    outside the sequence are dropped, as the reference's EdgeHandling.
+    TrimSequence."""
+    idx = [schema.getIndexOfColumn(c) for c in columns]
+    n = len(seq)
+    out: Seq = []
+    for t in range(n):
+        src = t - offset
+        if src < 0 or src >= n:
+            continue
+        row = list(seq[t])
+        if op == "NewColumn":
+            row = row + [seq[src][i] for i in idx]
+        else:
+            for i in idx:
+                row[i] = seq[src][i]
+        out.append(row)
+    return out
+
+
+def reduceSequence(seq: Seq, schema: Schema,
+                   aggregations: Dict[str, str]) -> List[Writable]:
+    """Collapse a sequence to ONE row (ref: ReduceSequenceTransform).
+    aggregations: {column: 'sum'|'mean'|'min'|'max'|'count'|'first'|'last'}."""
+    out: List[Writable] = []
+    for name, agg in aggregations.items():
+        i = schema.getIndexOfColumn(name)
+        vals = [r[i].toDouble() for r in seq]
+        if agg == "sum":
+            out.append(DoubleWritable(sum(vals)))
+        elif agg == "mean":
+            out.append(DoubleWritable(sum(vals) / max(len(vals), 1)))
+        elif agg == "min":
+            out.append(DoubleWritable(min(vals)))
+        elif agg == "max":
+            out.append(DoubleWritable(max(vals)))
+        elif agg == "count":
+            out.append(IntWritable(len(vals)))
+        elif agg == "first":
+            out.append(seq[0][i])
+        elif agg == "last":
+            out.append(seq[-1][i])
+        else:
+            raise ValueError(f"unknown aggregation {agg}")
+    return out
+
+
+def windowSequence(seq: Seq, windowSize: int, step: int = 1,
+                   dropPartial: bool = True) -> List[Seq]:
+    """Overlapping fixed-size windows (ref: OverlappingTimeWindowFunction on
+    an integer time axis; step == windowSize gives the non-overlapping
+    TimeWindowFunction)."""
+    out = []
+    t = 0
+    n = len(seq)
+    while t < n:
+        w = seq[t:t + windowSize]
+        if len(w) == windowSize or (w and not dropPartial):
+            out.append([list(r) for r in w])
+        t += step
+        if t + (windowSize if dropPartial else 1) > n and dropPartial and t < n \
+                and n - t < windowSize:
+            break
+    return out
+
+
+def splitSequenceOnGap(seq: Seq, schema: Schema, timeColumn: str,
+                       maxGap: float) -> List[Seq]:
+    """Split where consecutive timestamps differ by more than maxGap (ref:
+    SequenceSplitTimeSeparation)."""
+    i = schema.getIndexOfColumn(timeColumn)
+    out: List[Seq] = []
+    cur: Seq = []
+    prev = None
+    for r in seq:
+        t = r[i].toDouble()
+        if prev is not None and t - prev > maxGap and cur:
+            out.append(cur)
+            cur = []
+        cur.append(list(r))
+        prev = t
+    if cur:
+        out.append(cur)
+    return out
+
+
+def sequenceMovingWindowReduce(seq: Seq, schema: Schema, column: str,
+                               window: int, agg: str = "mean",
+                               edge: str = "TrimSequence") -> Seq:
+    """Append a trailing-window statistic of ``column`` as a new column (ref:
+    SequenceMovingWindowReduceTransform; edge 'TrimSequence' drops the warmup
+    steps, 'SpecifiedValue'/'NoOp' keeps them with NullWritable)."""
+    i = schema.getIndexOfColumn(column)
+    fns: Dict[str, Callable[[List[float]], float]] = {
+        "mean": lambda v: sum(v) / len(v), "sum": sum,
+        "min": min, "max": max,
+    }
+    fn = fns[agg]
+    out: Seq = []
+    for t in range(len(seq)):
+        row = list(seq[t])
+        if t + 1 >= window:
+            vals = [seq[j][i].toDouble() for j in range(t + 1 - window, t + 1)]
+            row.append(DoubleWritable(fn(vals)))
+            out.append(row)
+        elif edge != "TrimSequence":
+            row.append(NullWritable())
+            out.append(row)
+    return out

@@ -1,0 +1,128 @@
+"""Join + sequence-transform tests (ref: datavec TestJoin +
+TestSequenceTransforms)."""
+import pytest
+
+from deeplearning4j_tpu.datavec.join import Join
+from deeplearning4j_tpu.datavec.schema import Schema
+from deeplearning4j_tpu.datavec.sequence import (
+    convertToSequence, offsetSequence, reduceSequence,
+    sequenceMovingWindowReduce, splitSequenceOnGap, trimSequence,
+    windowSequence,
+)
+from deeplearning4j_tpu.datavec.writables import (
+    DoubleWritable, IntWritable, NullWritable, Text,
+)
+
+
+def W(v):
+    if isinstance(v, str):
+        return Text(v)
+    if isinstance(v, int):
+        return IntWritable(v)
+    return DoubleWritable(v)
+
+
+def rows(*data):
+    return [[W(v) for v in r] for r in data]
+
+
+def left_schema():
+    return (Schema.Builder().addColumnString("id")
+            .addColumnDouble("price").build())
+
+
+def right_schema():
+    return (Schema.Builder().addColumnString("id")
+            .addColumnString("category").build())
+
+
+LEFT = rows(("a", 1.0), ("b", 2.0), ("c", 3.0))
+RIGHT = rows(("a", "fruit"), ("b", "veg"), ("d", "meat"))
+
+
+class TestJoin:
+    def test_inner(self):
+        j = Join("Inner", left_schema(), right_schema(), ["id"])
+        out = j.execute(LEFT, RIGHT)
+        assert [[w.toString() for w in r] for r in out] == [
+            ["a", "1.0", "fruit"], ["b", "2.0", "veg"]]
+        assert j.getOutputSchema().getColumnNames() == ["id", "price", "category"]
+
+    def test_left_outer(self):
+        out = Join("LeftOuter", left_schema(), right_schema(), ["id"]).execute(LEFT, RIGHT)
+        assert len(out) == 3
+        assert isinstance(out[2][2], NullWritable)  # 'c' has no category
+
+    def test_right_outer(self):
+        out = Join("RightOuter", left_schema(), right_schema(), ["id"]).execute(LEFT, RIGHT)
+        ids = sorted(r[0].toString() for r in out)
+        assert ids == ["a", "b", "d"]
+        d_row = next(r for r in out if r[0].toString() == "d")
+        assert isinstance(d_row[1], NullWritable)   # no price
+        assert d_row[2].toString() == "meat"
+
+    def test_full_outer(self):
+        out = Join("FullOuter", left_schema(), right_schema(), ["id"]).execute(LEFT, RIGHT)
+        assert sorted(r[0].toString() for r in out) == ["a", "b", "c", "d"]
+
+    def test_one_to_many(self):
+        right = rows(("a", "x"), ("a", "y"))
+        out = Join("Inner", left_schema(), right_schema(), ["id"]).execute(LEFT, right)
+        assert len(out) == 2
+        assert {r[2].toString() for r in out} == {"x", "y"}
+
+
+def seq_schema():
+    return (Schema.Builder().addColumnString("dev")
+            .addColumnInteger("t").addColumnDouble("v").build())
+
+
+class TestSequence:
+    def test_convert_to_sequence_groups_and_sorts(self):
+        flat = rows(("d1", 3, 30.0), ("d2", 1, 100.0), ("d1", 1, 10.0),
+                    ("d1", 2, 20.0), ("d2", 2, 200.0))
+        seqs = convertToSequence(flat, seq_schema(), "dev", "t")
+        assert len(seqs) == 2
+        assert [r[2].toDouble() for r in seqs[0]] == [10.0, 20.0, 30.0]
+        assert [r[2].toDouble() for r in seqs[1]] == [100.0, 200.0]
+
+    def test_trim(self):
+        seq = rows(("d", 1, 1.0), ("d", 2, 2.0), ("d", 3, 3.0))
+        assert [r[1].toInt() for r in trimSequence(seq, 1, True)] == [2, 3]
+        assert [r[1].toInt() for r in trimSequence(seq, 2, False)] == [1]
+
+    def test_offset_lag_feature(self):
+        seq = rows(("d", 1, 10.0), ("d", 2, 20.0), ("d", 3, 30.0))
+        out = offsetSequence(seq, seq_schema(), ["v"], offset=1, op="NewColumn")
+        # step t carries v[t-1]; first step trimmed
+        assert len(out) == 2
+        assert out[0][3].toDouble() == 10.0 and out[0][2].toDouble() == 20.0
+        assert out[1][3].toDouble() == 20.0
+
+    def test_reduce_sequence(self):
+        seq = rows(("d", 1, 10.0), ("d", 2, 30.0))
+        red = reduceSequence(seq, seq_schema(), {"v": "mean", "t": "count"})
+        assert red[0].toDouble() == 20.0 and red[1].toInt() == 2
+
+    def test_windows_overlapping_and_tumbling(self):
+        seq = rows(*[("d", i, float(i)) for i in range(6)])
+        over = windowSequence(seq, windowSize=3, step=1)
+        assert len(over) == 4
+        assert [r[1].toInt() for r in over[1]] == [1, 2, 3]
+        tumb = windowSequence(seq, windowSize=2, step=2)
+        assert len(tumb) == 3
+        assert [r[1].toInt() for r in tumb[2]] == [4, 5]
+
+    def test_split_on_time_gap(self):
+        seq = rows(("d", 1, 0.0), ("d", 2, 0.0), ("d", 10, 0.0), ("d", 11, 0.0))
+        parts = splitSequenceOnGap(seq, seq_schema(), "t", maxGap=3)
+        assert [len(p) for p in parts] == [2, 2]
+        assert parts[1][0][1].toInt() == 10
+
+    def test_moving_window_reduce(self):
+        seq = rows(*[("d", i, float(i)) for i in range(5)])
+        out = sequenceMovingWindowReduce(seq, seq_schema(), "v", window=3,
+                                         agg="mean")
+        assert len(out) == 3  # warmup trimmed
+        assert out[0][3].toDouble() == pytest.approx(1.0)  # mean(0,1,2)
+        assert out[2][3].toDouble() == pytest.approx(3.0)  # mean(2,3,4)
