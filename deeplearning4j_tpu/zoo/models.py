@@ -577,3 +577,291 @@ class YOLO2(ZooModel):
                 .layer(Yolo2OutputLayer(boundingBoxes=self.boundingBoxes))
                 .setInputType(InputType.convolutional(h, w, c))
                 .build())
+
+
+class InceptionResNetV1(ZooModel):
+    """(ref: zoo.model.InceptionResNetV1 — the FaceNet backbone: stem,
+    scaled-residual Inception-ResNet A/B/C blocks with reductions, global
+    pool, bottleneck embedding). Block counts are configurable (reference:
+    5/10/5) so tests instantiate shallow variants; the 1x1-linear-then-
+    ScaleVertex-then-ElementWiseAdd residual wiring is the reference's.
+    Ends with an L2-normalized ``embeddings`` output feeding a softmax
+    classification head (the reference trains it the same way and reads the
+    embedding layer at inference)."""
+
+    def __init__(self, numClasses: int = 1000, seed: int = 123,
+                 inputShape: Tuple[int, int, int] = (3, 160, 160),
+                 embeddingSize: int = 128, blocks: Tuple[int, int, int] = (5, 10, 5)):
+        super().__init__(numClasses, seed, inputShape)
+        self.embeddingSize = embeddingSize
+        self.blocks = blocks
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.graph import ScaleVertex, L2NormalizeVertex
+        from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                                       GlobalPoolingLayer)
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("RELU").graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv(name, frm, n_out, k, stride=1, act="RELU", same=True):
+            g.addLayer(name, ConvolutionLayer(
+                nOut=n_out, kernelSize=k if isinstance(k, tuple) else (k, k),
+                stride=(stride, stride),
+                convolutionMode="Same" if same else "Truncate",
+                activation=act), frm)
+            return name
+
+        # stem (ref: InceptionResNetV1.inputBlock)
+        prev = conv("stem1", "input", 32, 3, 2, same=False)
+        prev = conv("stem2", prev, 32, 3, same=False)
+        prev = conv("stem3", prev, 64, 3)
+        g.addLayer("stem_pool", SubsamplingLayer(poolingType="MAX",
+                                                 kernelSize=(3, 3), stride=(2, 2)),
+                   prev)
+        prev = conv("stem4", "stem_pool", 80, 1)
+        prev = conv("stem5", prev, 192, 3, same=False)
+        prev = conv("stem6", prev, 256, 3, 2, same=False)
+
+        def residual_block(name, frm, branches, filters, scale):
+            """branches: list of [(n_out, kernel), ...] chains; concat ->
+            1x1 linear to `filters` -> scale -> add -> relu."""
+            outs = []
+            for bi, chain in enumerate(branches):
+                p = frm
+                for ci, (n_out, k) in enumerate(chain):
+                    p = conv(f"{name}_b{bi}c{ci}", p, n_out, k)
+                outs.append(p)
+            g.addVertex(f"{name}_cat", MergeVertex(), *outs)
+            conv(f"{name}_up", f"{name}_cat", filters, 1, act="IDENTITY")
+            g.addVertex(f"{name}_scale", ScaleVertex(scaleFactor=scale),
+                        f"{name}_up")
+            g.addVertex(f"{name}_add", ElementWiseVertex(op="Add"), frm,
+                        f"{name}_scale")
+            g.addLayer(f"{name}_relu", ActivationLayer(activation="RELU"),
+                       f"{name}_add")
+            return f"{name}_relu"
+
+        a, b_, c_ = self.blocks
+        for i in range(a):  # Inception-ResNet-A (block35)
+            prev = residual_block(f"a{i}", prev,
+                                  [[(32, 1)], [(32, 1), (32, 3)],
+                                   [(32, 1), (32, 3), (32, 3)]], 256, 0.17)
+        # reduction-A
+        ra = [conv("redA_b0", prev, 384, 3, 2, same=False),
+              conv("redA_b1c2",
+                   conv("redA_b1c1", conv("redA_b1c0", prev, 192, 1), 192, 3),
+                   256, 3, 2, same=False)]
+        g.addLayer("redA_pool", SubsamplingLayer(poolingType="MAX",
+                                                 kernelSize=(3, 3), stride=(2, 2)),
+                   prev)
+        g.addVertex("redA", MergeVertex(), *ra, "redA_pool")
+        prev = "redA"
+        for i in range(b_):  # Inception-ResNet-B (block17), asymmetric 1x7/7x1
+            prev = residual_block(f"b{i}", prev,
+                                  [[(128, 1)],
+                                   [(128, 1), (128, (1, 7)), (128, (7, 1))]],
+                                  896, 0.10)
+        # reduction-B
+        rb = [conv("redB_b0c1", conv("redB_b0c0", prev, 256, 1), 384, 3, 2, same=False),
+              conv("redB_b1c1", conv("redB_b1c0", prev, 256, 1), 256, 3, 2, same=False),
+              conv("redB_b2c2",
+                   conv("redB_b2c1", conv("redB_b2c0", prev, 256, 1), 256, 3),
+                   256, 3, 2, same=False)]
+        g.addLayer("redB_pool", SubsamplingLayer(poolingType="MAX",
+                                                 kernelSize=(3, 3), stride=(2, 2)),
+                   prev)
+        g.addVertex("redB", MergeVertex(), *rb, "redB_pool")
+        prev = "redB"
+        for i in range(c_):  # Inception-ResNet-C (block8), asymmetric 1x3/3x1
+            prev = residual_block(f"c{i}", prev,
+                                  [[(192, 1)],
+                                   [(192, 1), (192, (1, 3)), (192, (3, 1))]],
+                                  1792, 0.20)
+
+        g.addLayer("avgpool", GlobalPoolingLayer(poolingType="AVG"), prev)
+        g.addLayer("bottleneck", DenseLayer(nOut=self.embeddingSize,
+                                            activation="IDENTITY"), "avgpool")
+        g.addVertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.addLayer("output", OutputLayer(nOut=self.numClasses,
+                                         lossFunction="MCXENT"), "embeddings")
+        g.setOutputs("output")
+        return g.build()
+
+
+class FaceNetNN4Small2(ZooModel):
+    """(ref: zoo.model.FaceNetNN4Small2Deep — inception-style face-embedding
+    net trained with CENTER LOSS on identities; embeddings read from the
+    L2-normalized bottleneck).
+
+    Deviation from the reference: the backbone reuses this zoo's
+    scaled-residual inception blocks (InceptionResNetV1 topology at reduced
+    widths) instead of replicating nn4.small2's exact hand-mixed inception
+    stack — the capability contract (identity classification via center
+    loss over an L2 embedding) is identical."""
+
+    def __init__(self, numClasses: int = 100, seed: int = 123,
+                 inputShape: Tuple[int, int, int] = (3, 96, 96),
+                 embeddingSize: int = 128, alpha: float = 0.5,
+                 lambda_: float = 3e-3):
+        super().__init__(numClasses, seed, inputShape)
+        self.embeddingSize = embeddingSize
+        self.alpha = alpha
+        self.lambda_ = lambda_
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.graph import L2NormalizeVertex, ScaleVertex
+        from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                                       CenterLossOutputLayer,
+                                                       GlobalPoolingLayer)
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("RELU").graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+
+        def conv(name, frm, n_out, k, stride=1, act="RELU"):
+            g.addLayer(name, ConvolutionLayer(nOut=n_out,
+                                              kernelSize=(k, k),
+                                              stride=(stride, stride),
+                                              convolutionMode="Same",
+                                              activation=act), frm)
+            return name
+
+        prev = conv("c1", "input", 32, 3, 2)
+        prev = conv("c2", prev, 64, 3)
+        g.addLayer("p1", SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2),
+                                          stride=(2, 2)), prev)
+        prev = conv("c3", "p1", 96, 3)
+        for i in range(3):
+            name = f"blk{i}"
+            b0 = conv(f"{name}_b0", prev, 24, 1)
+            b1 = conv(f"{name}_b1b", conv(f"{name}_b1a", prev, 24, 1), 24, 3)
+            g.addVertex(f"{name}_cat", MergeVertex(), b0, b1)
+            conv(f"{name}_up", f"{name}_cat", 96, 1, act="IDENTITY")
+            g.addVertex(f"{name}_scale", ScaleVertex(scaleFactor=0.2), f"{name}_up")
+            g.addVertex(f"{name}_add", ElementWiseVertex(op="Add"), prev,
+                        f"{name}_scale")
+            g.addLayer(f"{name}_relu", ActivationLayer(activation="RELU"),
+                       f"{name}_add")
+            prev = f"{name}_relu"
+        g.addLayer("avgpool", GlobalPoolingLayer(poolingType="AVG"), prev)
+        g.addLayer("bottleneck", DenseLayer(nOut=self.embeddingSize,
+                                            activation="IDENTITY"), "avgpool")
+        g.addVertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.addLayer("output", CenterLossOutputLayer(
+            nOut=self.numClasses, alpha=self.alpha, lambda_=self.lambda_,
+            lossFunction="MCXENT"), "embeddings")
+        g.setOutputs("output")
+        return g.build()
+
+
+class NASNetMobile(ZooModel):
+    """(ref: zoo.model.NASNet — NASNet-A cells). Normal cells combine
+    separable-conv/pool/identity pairs on (h, h_prev) with 5 block outputs
+    concatenated; reduction cells halve the spatial dims. Cell count and
+    penultimate-filter width are configurable (reference mobile: 4 cells @
+    1056 penultimate). Factorized h_prev adjustment is a 1x1 conv (the
+    reference's adjust block)."""
+
+    def __init__(self, numClasses: int = 1000, seed: int = 123,
+                 inputShape: Tuple[int, int, int] = (3, 224, 224),
+                 cells_per_stage: int = 2, stem_filters: int = 32,
+                 filters: int = 44):
+        super().__init__(numClasses, seed, inputShape)
+        self.cells_per_stage = cells_per_stage
+        self.stem_filters = stem_filters
+        self.filters = filters
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit("RELU").graphBuilder()
+             .addInputs("input")
+             .setInputTypes(InputType.convolutional(h, w, c)))
+        uid = [0]
+
+        def sep(frm, n_out, k, stride=1):
+            uid[0] += 1
+            name = f"sep{uid[0]}"
+            g.addLayer(name, SeparableConvolution2D(
+                nOut=n_out, kernelSize=(k, k), stride=(stride, stride),
+                convolutionMode="Same", activation="RELU"), frm)
+            return name
+
+        def adjust(frm, n_out, stride=1):
+            """1x1 conv to match filters (+ stride for reduced h_prev)."""
+            uid[0] += 1
+            name = f"adj{uid[0]}"
+            g.addLayer(name, ConvolutionLayer(
+                nOut=n_out, kernelSize=(1, 1), stride=(stride, stride),
+                activation="RELU"), frm)
+            return name
+
+        def pool(frm, kind, stride=1):
+            uid[0] += 1
+            name = f"pool{uid[0]}"
+            g.addLayer(name, SubsamplingLayer(
+                poolingType=kind, kernelSize=(3, 3), stride=(stride, stride),
+                convolutionMode="Same"), frm)
+            return name
+
+        def add(a, b):
+            uid[0] += 1
+            name = f"add{uid[0]}"
+            g.addVertex(name, ElementWiseVertex(op="Add"), a, b)
+            return name
+
+        def normal_cell(h_cur, h_prev, f):
+            """NASNet-A normal cell: 5 combinations concat'd."""
+            hc = adjust(h_cur, f)
+            hp = adjust(h_prev, f)
+            b1 = add(sep(hc, f, 3), hc)
+            b2 = add(sep(hp, f, 3), sep(hc, f, 5))
+            b3 = add(pool(hp, "AVG"), hp)
+            b4 = add(pool(hp, "AVG"), pool(hp, "AVG"))
+            b5 = add(sep(hp, f, 5), sep(hp, f, 3))
+            uid[0] += 1
+            name = f"ncell{uid[0]}"
+            g.addVertex(name, MergeVertex(), b1, b2, b3, b4, b5)
+            return name
+
+        def reduction_cell(h_cur, h_prev, f):
+            hc = adjust(h_cur, f)
+            hp = adjust(h_prev, f)
+            b1 = add(sep(hc, f, 5, 2), sep(hp, f, 7, 2))
+            b2 = add(pool(hc, "MAX", 2), sep(hp, f, 7, 2))
+            b3 = add(pool(hc, "AVG", 2), sep(hp, f, 5, 2))
+            b4 = add(pool(b1, "AVG"), b2)
+            b5 = add(sep(b1, f, 3), pool(hc, "MAX", 2))
+            uid[0] += 1
+            name = f"rcell{uid[0]}"
+            g.addVertex(name, MergeVertex(), b2, b3, b4, b5)
+            return name
+
+        g.addLayer("stem", ConvolutionLayer(nOut=self.stem_filters,
+                                            kernelSize=(3, 3), stride=(2, 2),
+                                            convolutionMode="Same",
+                                            activation="RELU"), "input")
+        h_prev, h_cur = "stem", "stem"
+        f = self.filters
+        for stage in range(3):
+            if stage > 0:
+                nxt = reduction_cell(h_cur, h_prev, f)
+                # post-reduction, h_prev sits at the old resolution; the
+                # reference runs factorized reduction on it — collapsing
+                # both streams onto the reduced tensor is the simplified
+                # equivalent (adjust() re-projects them independently)
+                h_prev, h_cur = nxt, nxt
+                f *= 2
+            for _ in range(self.cells_per_stage):
+                nxt = normal_cell(h_cur, h_prev, f)
+                h_prev, h_cur = h_cur, nxt
+        g.addLayer("gap", GlobalPoolingLayer(poolingType="AVG"), h_cur)
+        g.addLayer("output", OutputLayer(nOut=self.numClasses,
+                                         lossFunction="MCXENT"), "gap")
+        g.setOutputs("output")
+        return g.build()

@@ -104,3 +104,45 @@ def test_yolo2_graph_conf_passthrough():
     out = net.outputSingle(x)
     A = len(m.boundingBoxes)
     assert out.shape == (2, A * (5 + 3), 2, 2)
+
+
+def test_inception_resnet_v1_embedding_and_fit():
+    from deeplearning4j_tpu.zoo import InceptionResNetV1
+    m = InceptionResNetV1(numClasses=4, inputShape=(3, 96, 96), blocks=(1, 1, 1))
+    net = m.init()
+    x, y = _img(2, 3, 96, 96), _onehot(2, 4)
+    out = net.outputSingle(x)
+    assert out.shape == (2, 4)
+    # the embeddings vertex is L2-normalized
+    acts, _ = net._forward(net._params, net._state,
+                           {"input": np.asarray(x, np.float32)},
+                           training=False, rng=None)
+    emb = np.asarray(acts["embeddings"])
+    assert emb.shape == (2, 128)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, rtol=1e-4)
+    net.fit(DataSet(x, y))
+    assert np.isfinite(net.score())
+
+
+def test_facenet_center_loss_trains():
+    from deeplearning4j_tpu.zoo import FaceNetNN4Small2
+    net = FaceNetNN4Small2(numClasses=5, inputShape=(3, 64, 64)).init()
+    x, y = _img(4, 3, 64, 64), _onehot(4, 5)
+    net.fit(DataSet(x, y))
+    first = net.score()
+    net.fit(DataSet(x, y), epochs=4)
+    assert net.score() < first
+    # centers parameter exists and moved (the center-loss term is live)
+    centers = np.asarray(net._params["output"]["centers"])
+    assert centers.shape == (5, 128)
+    assert np.abs(centers).sum() > 0
+
+
+def test_nasnet_mobile_shapes():
+    from deeplearning4j_tpu.zoo import NASNetMobile
+    net = NASNetMobile(numClasses=3, inputShape=(3, 64, 64),
+                       cells_per_stage=1, filters=16).init()
+    x, y = _img(2, 3, 64, 64), _onehot(2, 3)
+    assert net.outputSingle(x).shape == (2, 3)
+    net.fit(DataSet(x, y))
+    assert np.isfinite(net.score())
