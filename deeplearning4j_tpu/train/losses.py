@@ -122,10 +122,16 @@ def get(name) -> Callable:
     (labels, preds, mask=None) -> scalar."""
     if callable(name):
         return name
-    fn = _LOSSES.get(str(name).upper())
+    key = str(name).upper()
+    fn = _LOSSES.get(_ALIASES.get(key, key))
     if fn is None:
         raise ValueError(f"unknown loss: {name}. Known: {sorted(_LOSSES)}")
     return fn
+
+
+_ALIASES = {"KLD": "KL_DIVERGENCE", "MAE": "MEAN_ABSOLUTE_ERROR",
+            "MAPE": "MEAN_ABSOLUTE_PERCENTAGE_ERROR",
+            "MSLE": "MEAN_SQUARED_LOGARITHMIC_ERROR"}
 
 
 def names():

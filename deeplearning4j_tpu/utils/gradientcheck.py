@@ -95,3 +95,52 @@ def check_function_gradients(fn, *args, epsilon: float = 1e-6, max_rel_error: fl
         for i, a, numv, rel in failures[:20]:
             print(f"  x[{i}]: analytic={a:.8g} numeric={numv:.8g} relErr={rel:.3g}")
     return not failures
+
+
+def check_gradients_graph(graph, inputs, labels, epsilon: float = 1e-6,
+                          max_rel_error: float = 1e-3,
+                          min_abs_error: float = 1e-8,
+                          subset: Optional[int] = 128, seed: int = 12345,
+                          print_failures: bool = True) -> bool:
+    """ComputationGraph variant of check_gradients (ref: GradientCheckUtil.
+    checkGradients(ComputationGraph, ...)). ``inputs``/``labels`` are lists
+    matching networkInputs/networkOutputs order."""
+    inputs = {name: jnp.asarray(x, dtype=jnp.float64)
+              for name, x in zip(graph.conf.networkInputs,
+                                 inputs if isinstance(inputs, (list, tuple))
+                                 else [inputs])}
+    labels = [jnp.asarray(y, dtype=jnp.float64)
+              for y in (labels if isinstance(labels, (list, tuple)) else [labels])]
+    params64 = jax.tree_util.tree_map(lambda p: p.astype(jnp.float64),
+                                      graph._params)
+    state = graph._state
+
+    def loss_fn(params):
+        loss, _ = graph._loss_for(params, state, inputs, labels, None, None)
+        return loss
+
+    analytic = jax.grad(loss_fn)(params64)
+    flat_p, unravel = jax.flatten_util.ravel_pytree(params64)
+    flat_g, _ = jax.flatten_util.ravel_pytree(analytic)
+    n = flat_p.shape[0]
+    rng = np.random.default_rng(seed)
+    idxs = (np.arange(n) if subset is None or subset >= n
+            else rng.choice(n, subset, replace=False))
+    flat_np = np.asarray(flat_p)
+    failures = []
+    for i in idxs:
+        plus = flat_np.copy(); plus[i] += epsilon
+        minus = flat_np.copy(); minus[i] -= epsilon
+        numeric = (float(loss_fn(unravel(jnp.asarray(plus))))
+                   - float(loss_fn(unravel(jnp.asarray(minus))))) / (2 * epsilon)
+        a = float(flat_g[i])
+        abs_err = abs(a - numeric)
+        denom = max(abs(a), abs(numeric))
+        rel_err = abs_err / denom if denom > 0 else 0.0
+        if rel_err > max_rel_error and abs_err > min_abs_error:
+            failures.append((int(i), a, numeric, rel_err))
+    if failures and print_failures:
+        for i, a, numv, rel in failures[:20]:
+            print(f"  param[{i}]: analytic={a:.8g} numeric={numv:.8g} relErr={rel:.3g}")
+        print(f"GraphGradientCheck FAILED: {len(failures)}/{len(idxs)}")
+    return not failures

@@ -1,0 +1,254 @@
+"""Systematic gradient-check sweep (ref: org.deeplearning4j.gradientcheck.* —
+GradientCheckTests / CNNGradientCheckTest / LSTMGradientCheckTests /
+VertexGradientCheckTests: 'THE correctness backbone', SURVEY.md §4.1).
+
+Every case: tiny net, fp64, central differences vs jax.grad on a random
+parameter subset. Layers with stochastic forward (dropout) are excluded, as
+the reference excludes them."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, Bidirectional, ConvolutionLayer,
+    Convolution1DLayer, DenseLayer, Deconvolution2D, DepthwiseConvolution2D,
+    ElementWiseMultiplicationLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
+    GravesLSTM, LSTM, LastTimeStep, LocallyConnected1D, LocallyConnected2D,
+    LossLayer, OutputLayer, PReLULayer, RnnOutputLayer, SeparableConvolution2D,
+    SimpleRnn, SpaceToDepthLayer, SubsamplingLayer, Upsampling2D,
+)
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.utils.gradientcheck import check_gradients, check_gradients_graph
+
+RNG = np.random.default_rng(42)
+
+
+def _mln(input_type, *layers):
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1)).list())
+    for l in layers:
+        conf = conf.layer(l)
+    return MultiLayerNetwork(conf.setInputType(input_type).build()).init()
+
+
+def _ff_data(n, nin, ncls):
+    x = RNG.normal(size=(n, nin)).astype(np.float64)
+    y = np.eye(ncls)[RNG.integers(0, ncls, n)].astype(np.float64)
+    return x, y
+
+
+def _seq_data(n, t, nin, ncls):
+    x = RNG.normal(size=(n, t, nin)).astype(np.float64)
+    y = np.eye(ncls)[RNG.integers(0, ncls, (n, t))].astype(np.float64)
+    return x, y
+
+
+class TestDenseFamilies:
+    @pytest.mark.parametrize("act", ["TANH", "SIGMOID", "SOFTPLUS", "ELU", "CUBE"])
+    def test_dense_activations(self, act):
+        net = _mln(InputType.feedForward(4),
+                   DenseLayer(nOut=5, activation=act),
+                   OutputLayer(nOut=3, lossFunction="MCXENT"))
+        x, y = _ff_data(6, 4, 3)
+        assert check_gradients(net, x, y, subset=40)
+
+    @pytest.mark.parametrize("loss", ["MSE", "L1", "XENT", "HINGE", "KL_DIVERGENCE"])
+    def test_loss_functions(self, loss):
+        act = {"XENT": "SIGMOID", "KL_DIVERGENCE": "SOFTMAX"}.get(loss, "TANH")
+        net = _mln(InputType.feedForward(4),
+                   DenseLayer(nOut=5, activation="TANH"),
+                   OutputLayer(nOut=3, activation=act, lossFunction=loss))
+        x = RNG.normal(size=(6, 4))
+        if loss in ("XENT",):
+            y = RNG.integers(0, 2, (6, 3)).astype(np.float64)
+        elif loss == "KL_DIVERGENCE":
+            y = np.abs(RNG.normal(size=(6, 3))) + 0.1
+            y = y / y.sum(-1, keepdims=True)
+        elif loss == "HINGE":
+            y = RNG.choice([-1.0, 1.0], (6, 3))
+        else:
+            y = RNG.normal(size=(6, 3))
+        assert check_gradients(net, x, y, subset=40)
+
+    def test_prelu_and_elementwise_mult(self):
+        net = _mln(InputType.feedForward(4),
+                   DenseLayer(nOut=6, activation="TANH"),
+                   PReLULayer(inputShape=(6,)),
+                   ElementWiseMultiplicationLayer(nIn=6),
+                   OutputLayer(nOut=2, lossFunction="MCXENT"))
+        x, y = _ff_data(5, 4, 2)
+        assert check_gradients(net, x, y, subset=40)
+
+    def test_batchnorm_dense(self):
+        net = _mln(InputType.feedForward(4),
+                   DenseLayer(nOut=6, activation="IDENTITY"),
+                   BatchNormalization(activation="TANH"),
+                   OutputLayer(nOut=3, lossFunction="MCXENT"))
+        x, y = _ff_data(8, 4, 3)
+        assert check_gradients(net, x, y, subset=40)
+
+
+class TestConvFamilies:
+    def test_conv2d_pool(self):
+        net = _mln(InputType.convolutional(8, 8, 2),
+                   ConvolutionLayer(nOut=3, kernelSize=(3, 3), activation="TANH"),
+                   SubsamplingLayer(poolingType="AVG", kernelSize=(2, 2), stride=(2, 2)),
+                   OutputLayer(nOut=2, lossFunction="MCXENT"))
+        x = RNG.normal(size=(3, 2, 8, 8))
+        y = np.eye(2)[RNG.integers(0, 2, 3)]
+        assert check_gradients(net, x, y, subset=50)
+
+    @pytest.mark.parametrize("layer", [
+        SeparableConvolution2D(nOut=3, kernelSize=(3, 3), activation="TANH"),
+        DepthwiseConvolution2D(kernelSize=(3, 3), depthMultiplier=2,
+                               activation="TANH"),
+        Deconvolution2D(nOut=3, kernelSize=(2, 2), stride=(2, 2),
+                        activation="TANH"),
+        LocallyConnected2D(nOut=3, kernelSize=(3, 3), activation="TANH"),
+    ])
+    def test_conv_variants(self, layer):
+        net = _mln(InputType.convolutional(6, 6, 2),
+                   layer,
+                   GlobalPoolingLayer(poolingType="AVG"),
+                   OutputLayer(nOut=2, lossFunction="MCXENT"))
+        x = RNG.normal(size=(3, 2, 6, 6))
+        y = np.eye(2)[RNG.integers(0, 2, 3)]
+        assert check_gradients(net, x, y, subset=50)
+
+    def test_conv1d(self):
+        net = _mln(InputType.recurrent(3, 8),
+                   Convolution1DLayer(nOut=4, kernelSize=3, activation="TANH"),
+                   GlobalPoolingLayer(poolingType="MAX"),
+                   OutputLayer(nOut=2, lossFunction="MCXENT"))
+        x, _ = _seq_data(3, 8, 3, 2)
+        y = np.eye(2)[RNG.integers(0, 2, 3)]
+        assert check_gradients(net, x, y, subset=40)
+
+    def test_space_to_depth_and_upsampling(self):
+        net = _mln(InputType.convolutional(4, 4, 2),
+                   Upsampling2D(size=(2, 2)),
+                   SpaceToDepthLayer(blockSize=2),
+                   ConvolutionLayer(nOut=2, kernelSize=(1, 1), activation="TANH"),
+                   GlobalPoolingLayer(poolingType="AVG"),
+                   OutputLayer(nOut=2, lossFunction="MCXENT"))
+        x = RNG.normal(size=(2, 2, 4, 4))
+        y = np.eye(2)[RNG.integers(0, 2, 2)]
+        assert check_gradients(net, x, y, subset=40)
+
+
+class TestRecurrentFamilies:
+    @pytest.mark.parametrize("cell", [
+        lambda: SimpleRnn(nOut=4, activation="TANH"),
+        lambda: LSTM(nOut=4),
+        lambda: GravesLSTM(nOut=4),
+    ])
+    def test_rnn_cells(self, cell):
+        net = _mln(InputType.recurrent(3, 5),
+                   cell(),
+                   RnnOutputLayer(nOut=2, lossFunction="MCXENT"))
+        x, y = _seq_data(3, 5, 3, 2)
+        assert check_gradients(net, x, y, subset=50)
+
+    def test_bidirectional_lasttimestep(self):
+        net = _mln(InputType.recurrent(3, 5),
+                   Bidirectional(fwd=LSTM(nOut=4)),
+                   LastTimeStep(underlying=None),
+                   OutputLayer(nOut=2, lossFunction="MCXENT"))
+        x, _ = _seq_data(3, 5, 3, 2)
+        y = np.eye(2)[RNG.integers(0, 2, 3)]
+        assert check_gradients(net, x, y, subset=50)
+
+    def test_embedding_sequence(self):
+        net = _mln(InputType.recurrent(10, 6),
+                   EmbeddingSequenceLayer(nIn=10, nOut=4),
+                   LSTM(nOut=4),
+                   GlobalPoolingLayer(poolingType="PNORM", pnorm=2),
+                   OutputLayer(nOut=2, lossFunction="MCXENT"))
+        x = RNG.integers(0, 10, (3, 6))
+        y = np.eye(2)[RNG.integers(0, 2, 3)]
+        assert check_gradients(net, x, y, subset=40)
+
+
+class TestGraphVertices:
+    def _graph(self, add_fn, nin=4, nout=2, n=4):
+        g = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1))
+             .graphBuilder().addInputs("in"))
+        last = add_fn(g)
+        g.addLayer("out", OutputLayer(nIn=None, nOut=nout,
+                                      lossFunction="MCXENT"), last)
+        g.setOutputs("out")
+        g.setInputTypes(InputType.feedForward(nin))
+        net = ComputationGraph(g.build()).init()
+        x = RNG.normal(size=(n, nin)).astype(np.float64)
+        y = np.eye(nout)[RNG.integers(0, nout, n)].astype(np.float64)
+        return net, x, y
+
+    def test_merge_vertex(self):
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+
+        def build(g):
+            g.addLayer("a", DenseLayer(nIn=4, nOut=3, activation="TANH"), "in")
+            g.addLayer("b", DenseLayer(nIn=4, nOut=3, activation="SIGMOID"), "in")
+            g.addVertex("m", MergeVertex(), "a", "b")
+            return "m"
+
+        net, x, y = self._graph(build)
+        assert check_gradients_graph(net, x, y, subset=50)
+
+    @pytest.mark.parametrize("op", ["Add", "Product", "Subtract", "Average", "Max"])
+    def test_elementwise_vertex(self, op):
+        from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+
+        def build(g):
+            g.addLayer("a", DenseLayer(nIn=4, nOut=3, activation="TANH"), "in")
+            g.addLayer("b", DenseLayer(nIn=4, nOut=3, activation="SIGMOID"), "in")
+            g.addVertex("e", ElementWiseVertex(op=op), "a", "b")
+            return "e"
+
+        net, x, y = self._graph(build)
+        assert check_gradients_graph(net, x, y, subset=40)
+
+    def test_scale_shift_l2norm(self):
+        from deeplearning4j_tpu.nn.conf.graph import (L2NormalizeVertex,
+                                                      ScaleVertex, ShiftVertex)
+
+        def build(g):
+            g.addLayer("a", DenseLayer(nIn=4, nOut=3, activation="TANH"), "in")
+            g.addVertex("s", ScaleVertex(scaleFactor=1.7), "a")
+            g.addVertex("sh", ShiftVertex(shiftFactor=0.3), "s")
+            g.addVertex("n", L2NormalizeVertex(), "sh")
+            return "n"
+
+        net, x, y = self._graph(build)
+        assert check_gradients_graph(net, x, y, subset=40)
+
+    def test_stack_unstack_subset(self):
+        from deeplearning4j_tpu.nn.conf.graph import (StackVertex, SubsetVertex,
+                                                      UnstackVertex)
+
+        def build(g):
+            g.addLayer("a", DenseLayer(nIn=4, nOut=4, activation="TANH"), "in")
+            g.addLayer("b", DenseLayer(nIn=4, nOut=4, activation="SIGMOID"), "in")
+            g.addVertex("st", StackVertex(), "a", "b")
+            g.addVertex("u0", UnstackVertex(fromIndex=0, stackSize=2), "st")
+            g.addVertex("sub", SubsetVertex(fromIndex=1, toIndex=2), "u0")
+            return "sub"
+
+        net, x, y = self._graph(build)
+        assert check_gradients_graph(net, x, y, subset=40)
+
+    def test_attention_vertex_gradcheck(self):
+        from deeplearning4j_tpu.nn.conf.graph import AttentionVertex
+        g = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1))
+             .graphBuilder().addInputs("seq"))
+        g.addVertex("attn", AttentionVertex(nInQueries=3, nInKeys=3, nInValues=3,
+                                            nOut=4, nHeads=2), "seq", "seq", "seq")
+        g.addLayer("out", RnnOutputLayer(nIn=4, nOut=2, lossFunction="MCXENT"),
+                   "attn")
+        g.setOutputs("out")
+        g.setInputTypes(InputType.recurrent(3, 4))
+        net = ComputationGraph(g.build()).init()
+        x = RNG.normal(size=(2, 4, 3)).astype(np.float64)
+        y = np.eye(2)[RNG.integers(0, 2, (2, 4))].astype(np.float64)
+        assert check_gradients_graph(net, x, y, subset=50)
