@@ -18,6 +18,7 @@ from deeplearning4j_tpu.ui.storage import (
 )
 from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport, StatsUpdateConfiguration
 from deeplearning4j_tpu.ui.tensorboard import TensorBoardExporter, TensorBoardStatsListener
+from deeplearning4j_tpu.ui.html_report import render_report
 
 __all__ = [
     "StatsStorage",
@@ -28,4 +29,5 @@ __all__ = [
     "StatsUpdateConfiguration",
     "TensorBoardExporter",
     "TensorBoardStatsListener",
+    "render_report",
 ]

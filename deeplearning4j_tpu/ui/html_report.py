@@ -1,0 +1,144 @@
+"""Self-contained HTML training report (ref: deeplearning4j-ui's play-based
+dashboard — the overview page's score chart, update:param ratio chart, lr
+chart, and per-layer histograms. The reference serves these live from an
+embedded web server; the TPU rebuild renders the same four panels into ONE
+dependency-free HTML file with inline SVG, viewable anywhere, plus the
+TensorBoard export for live monitoring).
+"""
+from __future__ import annotations
+
+import html
+import math
+from typing import List, Optional
+
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>Training report — {session}</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 24px; color: #222; }}
+ h1 {{ font-size: 20px; }} h2 {{ font-size: 15px; margin: 18px 0 4px; }}
+ .meta {{ color: #666; font-size: 13px; margin-bottom: 12px; }}
+ .grid {{ display: flex; flex-wrap: wrap; gap: 18px; }}
+ .panel {{ border: 1px solid #ddd; border-radius: 6px; padding: 10px; }}
+ svg text {{ font-size: 10px; fill: #555; }}
+</style></head><body>
+<h1>Training report</h1>
+<div class="meta">session {session} · {n} reports · model {model} ·
+ {params} params · backend {backend}</div>
+<div class="grid">{panels}</div>
+</body></html>"""
+
+
+def _polyline(xs: List[float], ys: List[float], w=420, h=160, pad=30,
+              color="#1f77b4", label="", logy=False) -> str:
+    if not xs or not ys:
+        return ""
+    vals = [(math.log10(v) if logy and v > 0 else v) for v in ys]
+    finite = [v for v in vals if math.isfinite(v)]
+    if not finite:
+        return ""
+    lo, hi = min(finite), max(finite)
+    if hi == lo:
+        hi = lo + 1e-9
+    x0, x1 = min(xs), max(xs)
+    if x1 == x0:
+        x1 = x0 + 1
+    pts = []
+    for x, v in zip(xs, vals):
+        if not math.isfinite(v):
+            continue
+        px = pad + (x - x0) / (x1 - x0) * (w - 2 * pad)
+        py = h - pad - (v - lo) / (hi - lo) * (h - 2 * pad)
+        pts.append(f"{px:.1f},{py:.1f}")
+    ylab = ("log10 " if logy else "") + label
+    return (f'<svg width="{w}" height="{h}">'
+            f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+            f'points="{" ".join(pts)}"/>'
+            f'<text x="{pad}" y="12">{html.escape(ylab)}</text>'
+            f'<text x="{pad}" y="{h - 8}">{x0:.0f}</text>'
+            f'<text x="{w - pad - 20}" y="{h - 8}">{x1:.0f}</text>'
+            f'<text x="2" y="{pad}">{hi:.3g}</text>'
+            f'<text x="2" y="{h - pad}">{lo:.3g}</text></svg>')
+
+
+def _histogram_svg(h: dict, w=200, hh=90, color="#888") -> str:
+    counts = h.get("counts") or []
+    if not counts or sum(counts) == 0:
+        return ""
+    mx = max(counts)
+    bw = (w - 10) / len(counts)
+    bars = []
+    for i, c in enumerate(counts):
+        bh = (c / mx) * (hh - 20)
+        bars.append(f'<rect x="{5 + i * bw:.1f}" y="{hh - 10 - bh:.1f}" '
+                    f'width="{max(bw - 1, 1):.1f}" height="{bh:.1f}" '
+                    f'fill="{color}"/>')
+    return (f'<svg width="{w}" height="{hh}">{"".join(bars)}'
+            f'<text x="5" y="{hh - 1}">{h["min"]:.2g}</text>'
+            f'<text x="{w - 40}" y="{hh - 1}">{h["max"]:.2g}</text></svg>')
+
+
+def render_report(storage: StatsStorage, sessionId: str, path: str,
+                  typeId: str = "StatsListener", workerId: str = "worker_0",
+                  max_histograms: int = 12) -> str:
+    """Write the report; returns ``path``."""
+    reports = storage.getUpdates(sessionId, typeId, workerId)
+    info = storage.getStaticInfo(sessionId, typeId, workerId) or {}
+    iters = [r["iteration"] for r in reports]
+    panels = []
+
+    panels.append('<div class="panel"><h2>Score</h2>' + _polyline(
+        iters, [r["score"] for r in reports], label="score") + "</div>")
+
+    lrs = [r.get("learningRate") for r in reports]
+    if any(v is not None for v in lrs):
+        panels.append('<div class="panel"><h2>Learning rate</h2>' + _polyline(
+            [i for i, v in zip(iters, lrs) if v is not None],
+            [v for v in lrs if v is not None], color="#2ca02c",
+            label="lr") + "</div>")
+
+    # update:param ratios, log10 per param (THE health chart)
+    names = sorted({n for r in reports for n in (r.get("updateRatios") or {})})
+    ratio_lines = []
+    for i, n in enumerate(names[:8]):
+        xs = [it for it, r in zip(iters, reports) if n in (r.get("updateRatios") or {})]
+        ys = [r["updateRatios"][n] for r in reports if n in (r.get("updateRatios") or {})]
+        color = ["#d62728", "#9467bd", "#8c564b", "#e377c2", "#7f7f7f",
+                 "#bcbd22", "#17becf", "#1f77b4"][i % 8]
+        ratio_lines.append(_polyline(xs, ys, color=color, label=n, logy=True))
+    if ratio_lines:
+        panels.append('<div class="panel"><h2>Update:param ratio (log10)</h2>'
+                      + "".join(ratio_lines) + "</div>")
+
+    durs = [r.get("durationMs") for r in reports]
+    if any(v is not None for v in durs):
+        panels.append('<div class="panel"><h2>Iteration time (ms)</h2>' + _polyline(
+            [i for i, v in zip(iters, durs) if v is not None],
+            [v for v in durs if v is not None], color="#ff7f0e",
+            label="ms/iter") + "</div>")
+
+    if reports:
+        last = reports[-1]
+        hist_parts = []
+        for group, key in (("parameters", "parameterHistograms"),
+                           ("gradients", "gradientHistograms"),
+                           ("updates", "updateHistograms")):
+            hs = last.get(key) or {}
+            for n in sorted(hs)[:max_histograms // 3 or 1]:
+                svg = _histogram_svg(hs[n])
+                if svg:
+                    hist_parts.append(
+                        f"<div><h2>{html.escape(group)}/{html.escape(n)}</h2>{svg}</div>")
+        if hist_parts:
+            panels.append('<div class="panel"><h2>Last-iteration histograms</h2>'
+                          '<div class="grid">' + "".join(hist_parts) + "</div></div>")
+
+    page = _PAGE.format(session=html.escape(sessionId), n=len(reports),
+                        model=html.escape(str(info.get("modelClass", "?"))),
+                        params=info.get("numParams", "?"),
+                        backend=html.escape(str(info.get("backend", "?"))),
+                        panels="".join(panels))
+    with open(path, "w") as f:
+        f.write(page)
+    return path

@@ -226,3 +226,21 @@ class TestProfiler:
                 return 0.5
         with pytest.raises(PanicException, match="parameters"):
             lst.iterationDone(FakeModel(), 1, 0)
+
+
+class TestHtmlReport:
+    def test_report_renders_all_panels(self, tmp_path):
+        from deeplearning4j_tpu.ui.html_report import render_report
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, frequency=1)
+        net = tiny_net()
+        net.setListeners(lst)
+        net.fit(tiny_data(), epochs=5)
+        path = render_report(storage, lst.sessionId, str(tmp_path / "report.html"))
+        page = open(path).read()
+        assert "<svg" in page and "Score" in page
+        assert "Update:param ratio" in page
+        assert "Last-iteration histograms" in page
+        assert "MultiLayerNetwork" in page
+        # every panel's polyline has points
+        assert 'points=""' not in page
