@@ -128,6 +128,12 @@ class RegressionEvaluation:
         p = _np(predictions).astype(np.float64)
         y = y.reshape(-1, y.shape[-1])
         p = p.reshape(-1, p.shape[-1])
+        if mask is None:
+            m = np.ones((y.shape[0], 1))
+        else:
+            m = _np(mask).astype(np.float64)
+            m = m.reshape(-1, y.shape[-1]) if m.size == y.size \
+                else m.reshape(-1, 1)  # per-timestep mask broadcast over cols
         if self.sum_err2 is None:
             cols = y.shape[-1]
             self.sum_err2 = np.zeros(cols)
@@ -137,15 +143,14 @@ class RegressionEvaluation:
             self.sum_p = np.zeros(cols)
             self.sum_p2 = np.zeros(cols)
             self.sum_yp = np.zeros(cols)
-        e = p - y
-        self.n += y.shape[0]
-        self.sum_err2 += (e ** 2).sum(0)
-        self.sum_abs += np.abs(e).sum(0)
-        self.sum_y += y.sum(0)
-        self.sum_y2 += (y ** 2).sum(0)
-        self.sum_p += p.sum(0)
-        self.sum_p2 += (p ** 2).sum(0)
-        self.sum_yp += (y * p).sum(0)
+        self.n = self.n + m.sum(0)  # per-col counts ((1,) broadcasts)
+        self.sum_err2 += ((p - y) ** 2 * m).sum(0)
+        self.sum_abs += (np.abs(p - y) * m).sum(0)
+        self.sum_y += (y * m).sum(0)
+        self.sum_y2 += (y ** 2 * m).sum(0)
+        self.sum_p += (p * m).sum(0)
+        self.sum_p2 += (p ** 2 * m).sum(0)
+        self.sum_yp += (y * p * m).sum(0)
 
     def meanSquaredError(self, col=None):
         mse = self.sum_err2 / self.n

@@ -638,8 +638,11 @@ def _tile(g, n):
 
 @rule("Range")
 def _range(g, n):
-    start, limit, delta = (float(g._const(n, i)) for i in range(3))
-    arr = np.arange(start, limit, delta)
+    start, limit, delta = (g._const(n, i) for i in range(3))
+    # ONNX: output dtype == input dtype (int Range must stay integer —
+    # float-folding would break Gather indices downstream)
+    dtype = np.result_type(start.dtype, limit.dtype, delta.dtype)
+    arr = np.arange(start.item(), limit.item(), delta.item(), dtype=dtype)
     g.consts[n.output[0]] = arr
     return g.sd.constant(n.output[0], arr)
 

@@ -141,9 +141,16 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
     impl = cfg.attention_impl
     if impl == "flash" and (mesh is None or CONTEXT_AXIS not in mesh.axis_names
                             or mesh.shape[CONTEXT_AXIS] == 1):
-        from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
-        interpret = jax.default_backend() != "tpu"
-        return flash_attention(q, k, v, cfg.causal, 128, 128, None, interpret)
+        T = q.shape[-2]
+        blk = 128
+        while blk > 8 and T % blk:
+            blk //= 2
+        if T % blk == 0:
+            from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
+            interpret = jax.default_backend() != "tpu"
+            return flash_attention(q, k, v, cfg.causal, blk, blk, None, interpret)
+        # T has no usable power-of-2 block divisor — full attention is correct
+        return _full_attention(q, k, v, cfg.causal)
     if impl in ("full", "flash") or mesh is None \
             or CONTEXT_AXIS not in mesh.axis_names \
             or mesh.shape[CONTEXT_AXIS] == 1:

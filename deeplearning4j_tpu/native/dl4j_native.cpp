@@ -53,16 +53,18 @@ static void parse_chunk(const char* buf, const int64_t* line_off,
     const char* p = buf + line_off[r];
     const char* end = p + line_len[r];
     for (int64_t c = 0; c < cols; c++) {
-      char* next = nullptr;
-      double v = strtod(p, &next);
-      if (next == p) {  // not a number (empty field) -> NaN, advance to delim
-        v = NAN;
-        next = const_cast<char*>(p);
+      // field span [p, fend): bound the parse so an empty trailing field
+      // cannot let strtod skip the newline and eat the NEXT row's value
+      const char* fend = p;
+      while (fend < end && *fend != delim) fend++;
+      double v = NAN;
+      if (fend > p) {
+        char* next = nullptr;
+        v = strtod(p, &next);
+        if (next == p || next > fend) v = NAN;
       }
       out[r * cols + c] = v;
-      p = next;
-      while (p < end && *p != delim) p++;
-      if (p < end) p++;  // skip delimiter
+      p = fend < end ? fend + 1 : end;
     }
   }
 }

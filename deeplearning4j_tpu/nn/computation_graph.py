@@ -119,6 +119,8 @@ class ComputationGraph:
                 loss = loss + layer.compute_loss_ext(
                     params.get(out_name, {}), y, acts[out_name],
                     new_state[out_name]["features"], lm)
+                new_state = dict(new_state)
+                new_state[out_name] = {}  # aux features must not persist
             elif hasattr(layer, "loss_with_params"):
                 loss = loss + layer.loss_with_params(
                     params.get(out_name, {}), y, acts[out_name], lm)

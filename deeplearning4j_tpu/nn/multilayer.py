@@ -171,6 +171,10 @@ class MultiLayerNetwork:
         if isinstance(out_layer, CenterLossOutputLayer):
             loss = out_layer.compute_loss_ext(params[-1], y, out,
                                               new_states[-1]["features"], lmask)
+            # the features were an aux channel for THIS loss only — strip
+            # them so a batch of activations is never persisted as model
+            # state (it would pin device memory and retrace on batch change)
+            new_states = new_states[:-1] + [{}]
         elif hasattr(out_layer, "loss_with_params"):  # OCNN: loss needs own params
             loss = out_layer.loss_with_params(params[-1], y, out, lmask)
         elif hasattr(out_layer, "compute_loss"):  # output/loss/yolo layers

@@ -104,8 +104,11 @@ class A2CDiscreteDense:
             self._steps += 1
             episode_over = done or ep_steps >= cfg.maxEpochStep
             if len(buf_obs) >= cfg.nStep or episode_over:
-                # n-step discounted returns, bootstrapped from V(s_T)
-                if episode_over:
+                # n-step discounted returns, bootstrapped from V(s_T).
+                # Time-limit truncation is NOT a terminal: bootstrap there
+                # too, else the value head trains toward 0 exactly where the
+                # agent survives longest
+                if done:
                     boot = 0.0
                 else:
                     boot = float(np.asarray(self._value_fn(

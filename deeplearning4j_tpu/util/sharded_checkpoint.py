@@ -139,12 +139,28 @@ def train_with_checkpointing(
     Returns (params, opt_state, last_step_completed, losses)."""
     losses = []
     step = start_step
+
+    class _LoopModel:
+        """Minimal model facade for TrainingListener consumers (score() +
+        _params are what StatsListener/ProfilingListener read)."""
+        def score(self):
+            return losses[-1] if losses else float("nan")
+
+        @property
+        def _params(self):
+            return params
+
+        def numParams(self):
+            import numpy as _np
+            return int(sum(_np.size(l) for l in jax.tree_util.tree_leaves(params)))
+
+    proxy = _LoopModel()
     for step in range(start_step, num_steps):
         batch = batch_fn(step)
         params, opt_state, loss = step_fn(params, opt_state, batch)
         losses.append(float(loss))
         for lst in listeners:
-            lst.iterationDone(None, step, 0)
+            lst.iterationDone(proxy, step, 0)
         completed = step + 1
         manager.save(completed, params, opt_state,
                      metadata={"step": completed, "loss": float(loss)})

@@ -118,3 +118,19 @@ class TestExistingFamilyStillCoherent:
         single = ROC()
         single.eval(y[:, 1], p[:, 1])
         assert multi.calculateAUC(1) == pytest.approx(single.calculateAUC())
+
+
+class TestRegressionMask:
+    def test_mask_excludes_padding_rows(self):
+        from deeplearning4j_tpu.eval import RegressionEvaluation
+        y = np.array([[1.0], [2.0], [99.0]])   # last row is padding garbage
+        p = np.array([[1.5], [2.5], [0.0]])
+        m = np.array([1.0, 1.0, 0.0])
+        ev = RegressionEvaluation()
+        ev.eval(y, p, mask=m)
+        assert ev.meanSquaredError() == pytest.approx(0.25)
+        assert ev.meanAbsoluteError() == pytest.approx(0.5)
+        # unmasked eval is diluted by the garbage row
+        ev2 = RegressionEvaluation()
+        ev2.eval(y, p)
+        assert ev2.meanSquaredError() > 1000

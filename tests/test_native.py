@@ -144,3 +144,12 @@ class TestPrefetch:
         pf = PrefetchIterator([1, 2, 3], depth=1)
         assert list(pf) == [1, 2, 3]
         assert list(pf) == [1, 2, 3]
+
+
+def test_trailing_empty_field_is_nan_not_next_row():
+    """Regression: strtod must not skip the newline and consume the next
+    row's first value for an empty trailing field."""
+    got = parse_csv("1,2,\n3,4,5\n")
+    want = parse_csv("1,2,\n3,4,5\n", force_python=True)
+    assert np.isnan(got[0, 2]) and np.isnan(want[0, 2])
+    np.testing.assert_allclose(got[1], [3, 4, 5])
