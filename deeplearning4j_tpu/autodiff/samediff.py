@@ -672,10 +672,23 @@ class SameDiff:
                 trainables, self._opt_state, loss = step(trainables, frozen,
                                                         self._opt_state, ph)
                 history.append(float(loss))
+                self._score = float(loss)
+                # listeners read current values (StatsListener param stats)
+                self._values.update(trainables)
                 for lst in self.listeners:
                     lst.iterationDone(self, len(history), 0)
         self._values.update(trainables)
         return history
+
+    def score(self) -> float:
+        """Last training loss (ref: the reference's SameDiff training score
+        surfaces through History/listeners; models expose score() here)."""
+        return getattr(self, "_score", float("nan"))
+
+    def numParams(self) -> int:
+        import numpy as _np
+        return int(sum(_np.size(self._values[n])
+                       for n in self._trainable_names()))
 
     def calculateGradients(self, placeholders: Dict[str, Any], wrt: Sequence[str]
                            ) -> Dict[str, NDArray]:

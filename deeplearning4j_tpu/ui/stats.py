@@ -185,7 +185,8 @@ class StatsListener(TrainingListener):
         if cfg.collectMemoryStats:
             report.memoryRssMb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
-        params = _named_leaves(model._params) if cfg.collectParameterStats else []
+        params = _named_leaves(self._param_tree(model)) \
+            if cfg.collectParameterStats else []
         for name, arr in params:
             report.parameterStats[name] = _summary(arr)
             if cfg.collectHistograms:
@@ -211,6 +212,15 @@ class StatsListener(TrainingListener):
 
         self.storage.putUpdate(self.sessionId, self.typeId, self.workerId,
                                report.to_dict())
+
+    @staticmethod
+    def _param_tree(model):
+        """Model params as a pytree: MLN/CG expose ``_params``; SameDiff
+        exposes trainable values by name."""
+        tree = getattr(model, "_params", None)
+        if tree is None and hasattr(model, "_trainable_names"):
+            tree = {n: model._values[n] for n in model._trainable_names()}
+        return tree if tree is not None else {}
 
     def _collect_updates(self, model, named_params):
         """Applied updates: prefer the model's stats-step output, else diff

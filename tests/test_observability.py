@@ -244,3 +244,35 @@ class TestHtmlReport:
         assert "MultiLayerNetwork" in page
         # every panel's polyline has points
         assert 'points=""' not in page
+
+
+class TestSameDiffStats:
+    def test_stats_listener_on_samediff_training(self):
+        """StatsListener attaches to SameDiff.fit too (param stats from the
+        trainable-variable values; grads come via the param-delta fallback)."""
+        from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+        rng = np.random.RandomState(0)
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 4))
+        yv = sd.placeHolder("y", shape=(None, 1))
+        w = sd.var("w", np.zeros((4, 1), np.float32))
+        pred = x.mmul(w)
+        loss = sd.loss.mse(yv, pred).rename("loss")
+        sd.setLossVariables("loss")
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(0.05), dataSetFeatureMapping=["x"],
+            dataSetLabelMapping=["y"]))
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, frequency=1,
+                            config=StatsUpdateConfiguration(
+                                collectGradientStats=False))
+        sd.listeners.append(lst)
+        X = rng.rand(32, 4).astype(np.float32)
+        Y = (X @ np.ones((4, 1))).astype(np.float32)
+        sd.fit(DataSet(X, Y), epochs=4)
+        reports = storage.getUpdates(lst.sessionId, "StatsListener", "worker_0")
+        assert len(reports) == 4
+        assert "w" in reports[-1]["parameterStats"]
+        assert reports[-1]["parameterStats"]["w"]["meanMagnitude"] > 0
+        # update stats via consecutive-param deltas (no _last_updates on sd)
+        assert "w" in reports[-1]["updateStats"]
