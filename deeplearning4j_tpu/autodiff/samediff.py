@@ -593,6 +593,27 @@ class SameDiff:
     def batchOutput(self):
         return _BatchOutputBuilder(self)
 
+    def evaluate(self, iterator, outputVariable: str, evaluation=None):
+        """Evaluate a dataset against one output variable (ref:
+        SameDiff.evaluate(DataSetIterator, String, IEvaluation...)).
+        Placeholder names come from the TrainingConfig's feature/label
+        mappings; labels feed the evaluation, not the graph."""
+        from deeplearning4j_tpu.eval import Evaluation
+        cfg = self._training_config
+        assert cfg is not None and cfg.dataSetFeatureMapping, \
+            "setTrainingConfig with dataSetFeatureMapping first"
+        ev = evaluation if evaluation is not None else Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                else [ds.features]
+            ph = {n: f for n, f in zip(cfg.dataSetFeatureMapping, feats)}
+            out = self.output(ph, outputVariable)[outputVariable]
+            ev.eval(ds.labels, out.toNumpy(),
+                    mask=getattr(ds, "labels_mask", None))
+        return ev
+
     # ------------------------------------------------------------- training
     def setLossVariables(self, *names):
         self._loss_vars = [n.name if isinstance(n, SDVariable) else n for n in names]

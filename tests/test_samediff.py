@@ -244,3 +244,32 @@ def test_control_flow_save_load_roundtrip(tmp_path):
     sd2 = SameDiff.load(p)
     out = sd2.output({}, [acc_out.name])[acc_out.name]
     assert float(out.toNumpy()) == 81.0
+
+
+class TestEvaluateApi:
+    def test_evaluate_classifier(self):
+        """sd.evaluate(iterator, output, Evaluation) — ref: SameDiff.evaluate."""
+        from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+        from deeplearning4j_tpu.eval import Evaluation
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(128, 4)).astype(np.float32)
+        labels = (X.sum(-1) > 0).astype(int)
+        Y = np.eye(2, dtype=np.float32)[labels]
+
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 4))
+        y = sd.placeHolder("y", shape=(None, 2))
+        w = sd.var("w", np.zeros((4, 2), np.float32))
+        b = sd.var("b", np.zeros((2,), np.float32))
+        logits = x.mmul(w) + b
+        probs = sd.nn.softmax(logits).rename("probs")
+        loss = sd.loss.mcxent(y, probs).rename("loss")
+        sd.setLossVariables("loss")
+        sd.setTrainingConfig(TrainingConfig(updater=Adam(0.1),
+                                            dataSetFeatureMapping=["x"],
+                                            dataSetLabelMapping=["y"]))
+        it = ListDataSetIterator([DataSet(X, Y)], batch_size=64)
+        sd.fit(it, epochs=40)
+        ev = sd.evaluate(ListDataSetIterator([DataSet(X, Y)], batch_size=64),
+                         "probs", Evaluation())
+        assert ev.accuracy() > 0.9, ev.stats()
