@@ -157,13 +157,61 @@ class TransformProcess:
         return schema
 
     # ---------------------------------------------------------------- exec
+    _SEQ_KINDS = ("convertToSequence", "trimSequence", "offsetSequence",
+                  "movingWindowReduce")
+
     def execute(self, records: Sequence[Sequence[Writable]]) -> List[List[Writable]]:
+        if any(s.kind in self._SEQ_KINDS for s in self.steps):
+            raise ValueError("process contains sequence steps — call "
+                             "executeToSequence (ref: TransformProcess."
+                             "execute throws on sequence processes)")
         rows = [list(r) for r in records]
         schema = self.initialSchema
         for s in self.steps:
             rows = _apply_rows(rows, schema, s)
             schema = _apply_schema(schema, s)
         return rows
+
+    def executeToSequence(self, records: Sequence[Sequence[Writable]]):
+        """Flat records -> list of sequences through a pipeline containing
+        convertToSequence + sequence ops (ref: LocalTransformExecutor.
+        executeToSequence). Row steps before the conversion apply to flat
+        rows; after it, row steps apply per sequence step-row and sequence
+        steps transform whole sequences."""
+        from deeplearning4j_tpu.datavec import sequence as _seq
+        rows = [list(r) for r in records]
+        sequences = None
+        schema = self.initialSchema
+        for s in self.steps:
+            k, spec = s.kind, s.spec
+            if k == "convertToSequence":
+                sequences = _seq.convertToSequence(
+                    rows, schema, spec["key"], spec["sort"],
+                    ascending=spec.get("ascending", True))
+            elif k == "trimSequence":
+                assert sequences is not None, "convertToSequence first"
+                sequences = [_seq.trimSequence(q, spec["numSteps"],
+                                               spec["fromFirst"])
+                             for q in sequences]
+            elif k == "offsetSequence":
+                assert sequences is not None, "convertToSequence first"
+                sequences = [_seq.offsetSequence(q, schema, spec["columns"],
+                                                 spec["offset"],
+                                                 op=spec.get("op", "InPlace"))
+                             for q in sequences]
+            elif k == "movingWindowReduce":
+                assert sequences is not None, "convertToSequence first"
+                sequences = [_seq.sequenceMovingWindowReduce(
+                    q, schema, spec["column"], spec["window"],
+                    agg=spec.get("agg", "mean")) for q in sequences]
+            elif sequences is None:
+                rows = _apply_rows(rows, schema, s)
+            else:
+                sequences = [_apply_rows(q, schema, s) for q in sequences]
+            schema = _apply_schema(schema, s)
+        assert sequences is not None, \
+            "no convertToSequence step in this process"
+        return sequences
 
     # ---------------------------------------------------------------- serde
     def to_json(self) -> str:
@@ -262,6 +310,28 @@ class TransformProcess:
             (ref: o.d.api.transform.reduce.Reducer grouped by key)."""
             return self._add("reduce", key=keyColumn, aggs=dict(aggregations))
 
+        # ---- sequence (ref: TransformProcess.Builder.convertToSequence /
+        # trimSequence / offsetSequence + SequenceMovingWindowReduceTransform;
+        # run via executeToSequence)
+        def convertToSequence(self, keyColumn: str, sortColumn: str,
+                              ascending: bool = True):
+            return self._add("convertToSequence", key=keyColumn,
+                             sort=sortColumn, ascending=ascending)
+
+        def trimSequence(self, numSteps: int, fromFirst: bool = True):
+            return self._add("trimSequence", numSteps=numSteps,
+                             fromFirst=fromFirst)
+
+        def offsetSequence(self, columns: Sequence[str], offset: int,
+                           op: str = "InPlace"):
+            return self._add("offsetSequence", columns=list(columns),
+                             offset=offset, op=op)
+
+        def sequenceMovingWindowReduce(self, column: str, window: int,
+                                       agg: str = "mean"):
+            return self._add("movingWindowReduce", column=column,
+                             window=window, agg=agg)
+
         def build(self) -> "TransformProcess":
             return TransformProcess(self._schema, list(self._steps))
 
@@ -344,6 +414,14 @@ def _apply_schema(schema: Schema, step: _Step) -> Schema:
             ctype = ColumnType.Integer if agg == "count" else ColumnType.Double
             out.append(ColumnMeta(f"{agg}({col})", ctype))
         cols = out
+    elif k == "offsetSequence" and s.get("op") == "NewColumn":
+        for name in s["columns"]:
+            src = next(c for c in cols if c.name == name)
+            cols.append(ColumnMeta(f"{name}_offset{s['offset']}", src.type,
+                                   src.stateNames))
+    elif k == "movingWindowReduce":
+        cols.append(ColumnMeta(f"{s.get('agg', 'mean')}({s['column']},{s['window']})",
+                               ColumnType.Double))
     return Schema(cols)
 
 

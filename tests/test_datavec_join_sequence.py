@@ -126,3 +126,52 @@ class TestSequence:
         assert len(out) == 3  # warmup trimmed
         assert out[0][3].toDouble() == pytest.approx(1.0)  # mean(0,1,2)
         assert out[2][3].toDouble() == pytest.approx(3.0)  # mean(2,3,4)
+
+
+class TestTransformProcessSequenceMode:
+    def test_pipeline_rows_to_sequences(self):
+        """Builder pipeline: row math -> convertToSequence -> lag feature ->
+        moving mean; executed via executeToSequence with schema tracking
+        (ref: LocalTransformExecutor.executeToSequence)."""
+        from deeplearning4j_tpu.datavec.transform import TransformProcess
+        schema = seq_schema()
+        tp = (TransformProcess.Builder(schema)
+              .doubleMathOp("v", "Multiply", 2.0)
+              .convertToSequence("dev", "t")
+              .offsetSequence(["v"], 1, op="NewColumn")
+              .sequenceMovingWindowReduce("v", 2, agg="mean")
+              .build())
+        flat = rows(("d1", 2, 2.0), ("d1", 1, 1.0), ("d1", 3, 3.0),
+                    ("d2", 1, 10.0), ("d2", 2, 20.0))
+        seqs = tp.executeToSequence(flat)
+        final = tp.getFinalSchema()
+        assert final.getColumnNames() == ["dev", "t", "v", "v_offset1",
+                                          "mean(v,2)"]
+        # d1: v doubled -> [2,4,6] sorted by t; lag drops t=1; window-2 mean
+        # then drops the first remaining step
+        d1 = seqs[0]
+        assert [r[1].toInt() for r in d1] == [3]
+        assert d1[0][2].toDouble() == 6.0          # v at t=3
+        assert d1[0][3].toDouble() == 4.0          # lag-1 (t=2 value)
+        assert d1[0][4].toDouble() == pytest.approx(5.0)  # mean(4, 6)
+
+    def test_execute_rejects_sequence_steps(self):
+        from deeplearning4j_tpu.datavec.transform import TransformProcess
+        tp = (TransformProcess.Builder(seq_schema())
+              .convertToSequence("dev", "t").build())
+        with pytest.raises(ValueError, match="executeToSequence"):
+            tp.execute(rows(("d", 1, 1.0)))
+
+    def test_sequence_process_json_roundtrip(self):
+        from deeplearning4j_tpu.datavec.transform import TransformProcess
+        tp = (TransformProcess.Builder(seq_schema())
+              .convertToSequence("dev", "t")
+              .trimSequence(1, fromFirst=True)
+              .offsetSequence(["v"], 1)
+              .build())
+        tp2 = TransformProcess.from_json(tp.to_json())
+        flat = rows(("d", 1, 1.0), ("d", 2, 2.0), ("d", 3, 3.0))
+        a = tp.executeToSequence(flat)
+        b = tp2.executeToSequence(flat)
+        assert [[w.toString() for w in r] for q in a for r in q] == \
+               [[w.toString() for w in r] for q in b for r in q]
