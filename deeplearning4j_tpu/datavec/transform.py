@@ -189,18 +189,21 @@ class TransformProcess:
                     rows, schema, spec["key"], spec["sort"],
                     ascending=spec.get("ascending", True))
             elif k == "trimSequence":
-                assert sequences is not None, "convertToSequence first"
+                if sequences is None:
+                    raise ValueError(f"{k} requires a convertToSequence step first")
                 sequences = [_seq.trimSequence(q, spec["numSteps"],
                                                spec["fromFirst"])
                              for q in sequences]
             elif k == "offsetSequence":
-                assert sequences is not None, "convertToSequence first"
+                if sequences is None:
+                    raise ValueError(f"{k} requires a convertToSequence step first")
                 sequences = [_seq.offsetSequence(q, schema, spec["columns"],
                                                  spec["offset"],
                                                  op=spec.get("op", "InPlace"))
                              for q in sequences]
             elif k == "movingWindowReduce":
-                assert sequences is not None, "convertToSequence first"
+                if sequences is None:
+                    raise ValueError(f"{k} requires a convertToSequence step first")
                 sequences = [_seq.sequenceMovingWindowReduce(
                     q, schema, spec["column"], spec["window"],
                     agg=spec.get("agg", "mean")) for q in sequences]
@@ -209,8 +212,8 @@ class TransformProcess:
             else:
                 sequences = [_apply_rows(q, schema, s) for q in sequences]
             schema = _apply_schema(schema, s)
-        assert sequences is not None, \
-            "no convertToSequence step in this process"
+        if sequences is None:
+            raise ValueError("executeToSequence: no convertToSequence step in this process")
         return sequences
 
     # ---------------------------------------------------------------- serde

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.ui.storage import (
 from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport, StatsUpdateConfiguration
 from deeplearning4j_tpu.ui.tensorboard import TensorBoardExporter, TensorBoardStatsListener
 from deeplearning4j_tpu.ui.html_report import render_report
+from deeplearning4j_tpu.ui.server import UIServer, RemoteStatsStorageRouter
 
 __all__ = [
     "StatsStorage",
@@ -30,4 +31,6 @@ __all__ = [
     "TensorBoardExporter",
     "TensorBoardStatsListener",
     "render_report",
+    "UIServer",
+    "RemoteStatsStorageRouter",
 ]

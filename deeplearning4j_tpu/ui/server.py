@@ -1,0 +1,308 @@
+"""Live training dashboard server (ref: org.deeplearning4j.ui.api.UIServer /
+VertxUIServer in deeplearning4j-ui — `UIServer.getInstance().attach(storage)`
+then browse the train overview while fit() runs).
+
+The reference embeds a Vert.x web server pushing SBE stats over websockets to
+JS charts. The rebuild serves the same overview — score, learning rate,
+update:param ratio (log10), iteration time — from a stdlib
+``ThreadingHTTPServer`` with a polling JSON API (no websockets, no
+dependencies; a 1 s poll is indistinguishable for training telemetry):
+
+  GET  /                               overview page (vanilla-JS canvas charts)
+  GET  /api/sessions                   [{sessionId, workers, info}, ...]
+  GET  /api/updates/<sid>/<worker>?from=N   reports N.. (incremental poll)
+  POST /remote/receive                 remote stats routing (see below)
+
+``RemoteStatsStorageRouter`` is the write-side client (ref:
+RemoteUIStatsStorageRouter): a StatsListener in another process (e.g. a
+multi-host worker, SURVEY §2.10 control plane) posts its reports to a central
+UI server over HTTP instead of writing a local file.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import warnings
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.stats import StatsListener  # noqa: F401 (re-export convenience)
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu — training</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 24px; color: #222; }
+ h1 { font-size: 20px; } h2 { font-size: 14px; margin: 0 0 4px; }
+ .meta { color: #666; font-size: 13px; margin-bottom: 14px; }
+ .grid { display: flex; flex-wrap: wrap; gap: 18px; }
+ .panel { border: 1px solid #ddd; border-radius: 6px; padding: 10px; }
+ select { margin-bottom: 12px; }
+</style></head><body>
+<h1>Training overview</h1>
+<div class="meta" id="meta">waiting for sessions…</div>
+<select id="session"></select>
+<div class="grid">
+ <div class="panel"><h2>Score</h2><canvas id="score" width="440" height="170"></canvas></div>
+ <div class="panel"><h2>Learning rate</h2><canvas id="lr" width="440" height="170"></canvas></div>
+ <div class="panel"><h2>Update:param ratio (log10)</h2><canvas id="ratio" width="440" height="170"></canvas></div>
+ <div class="panel"><h2>Iteration time (ms)</h2><canvas id="dur" width="440" height="170"></canvas></div>
+</div>
+<script>
+let cur = null, reports = [], nextFrom = 0;
+const COLORS = ['#d62728','#9467bd','#8c564b','#e377c2','#7f7f7f','#bcbd22','#17becf','#1f77b4'];
+function drawLines(id, seriesMap) {
+  const cv = document.getElementById(id), ctx = cv.getContext('2d');
+  ctx.clearRect(0, 0, cv.width, cv.height);
+  const pad = 34, W = cv.width, H = cv.height;
+  let lo = Infinity, hi = -Infinity, x0 = Infinity, x1 = -Infinity;
+  for (const pts of Object.values(seriesMap)) for (const [x, y] of pts) {
+    if (!isFinite(y)) continue;
+    lo = Math.min(lo, y); hi = Math.max(hi, y);
+    x0 = Math.min(x0, x); x1 = Math.max(x1, x);
+  }
+  if (!isFinite(lo)) return;
+  if (hi === lo) hi = lo + 1e-9; if (x1 === x0) x1 = x0 + 1;
+  ctx.font = '10px sans-serif'; ctx.fillStyle = '#555';
+  ctx.fillText(hi.toPrecision(3), 2, pad); ctx.fillText(lo.toPrecision(3), 2, H - pad);
+  ctx.fillText(String(x0), pad, H - 6); ctx.fillText(String(x1), W - pad - 20, H - 6);
+  let ci = 0;
+  for (const [name, pts] of Object.entries(seriesMap)) {
+    ctx.strokeStyle = COLORS[ci++ % COLORS.length]; ctx.beginPath();
+    let first = true;
+    for (const [x, y] of pts) {
+      if (!isFinite(y)) continue;
+      const px = pad + (x - x0) / (x1 - x0) * (W - 2 * pad);
+      const py = H - pad - (y - lo) / (hi - lo) * (H - 2 * pad);
+      if (first) { ctx.moveTo(px, py); first = false; } else ctx.lineTo(px, py);
+    }
+    ctx.stroke();
+  }
+}
+function redraw() {
+  const it = r => r.iteration;
+  drawLines('score', {score: reports.map(r => [it(r), r.score])});
+  drawLines('lr', {lr: reports.filter(r => r.learningRate != null).map(r => [it(r), r.learningRate])});
+  drawLines('dur', {ms: reports.filter(r => r.durationMs != null).map(r => [it(r), r.durationMs])});
+  const names = new Set();
+  for (const r of reports) for (const n of Object.keys(r.updateRatios || {})) names.add(n);
+  const ratio = {};
+  for (const n of Array.from(names).sort().slice(0, 8))
+    ratio[n] = reports.filter(r => (r.updateRatios || {})[n] > 0)
+                      .map(r => [it(r), Math.log10(r.updateRatios[n])]);
+  drawLines('ratio', ratio);
+}
+async function poll() {
+  try {
+    const sessions = await (await fetch('api/sessions')).json();
+    const sel = document.getElementById('session');
+    if (sel.options.length !== sessions.length) {
+      sel.replaceChildren(...sessions.map(s => {
+        const o = document.createElement('option');
+        o.textContent = s.sessionId;   // textContent: sessionId is untrusted
+        return o;
+      }));
+    }
+    if (!sessions.length) return;
+    const sid = sel.value || sessions[0].sessionId;
+    const s = sessions.find(x => x.sessionId === sid) || sessions[0];
+    if (cur !== sid) { cur = sid; reports = []; nextFrom = 0; }
+    const worker = s.workers[0];
+    const info = s.info || {};
+    document.getElementById('meta').textContent =
+      `${sid} · ${info.modelClass || '?'} · ${info.numParams ?? '?'} params · ` +
+      `${info.backend || '?'} · ${reports.length} reports`;
+    const fresh = await (await fetch(
+      `api/updates/${sid}/${worker}?from=${nextFrom}`)).json();
+    if (fresh.length) { reports = reports.concat(fresh); nextFrom += fresh.length; redraw(); }
+  } catch (e) { /* server restarting — keep polling */ }
+}
+setInterval(poll, 1000); poll();
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpu-ui/1.0"
+
+    def log_message(self, *a):  # silence per-request stderr spam
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _storages(self) -> List[StatsStorage]:
+        return self.server.ui._storages  # type: ignore[attr-defined]
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if not parts:
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if parts == ["api", "sessions"]:
+            out = []
+            for st in self._storages():
+                for sid in st.listSessionIDs():
+                    workers = st.listWorkerIDsForSession(sid) or ["worker_0"]
+                    out.append({
+                        "sessionId": sid, "workers": workers,
+                        "info": st.getStaticInfo(sid, "StatsListener", workers[0]),
+                    })
+            self._json(out)
+            return
+        if len(parts) == 4 and parts[:2] == ["api", "updates"]:
+            sid, worker = parts[2], parts[3]
+            start = int(parse_qs(url.query).get("from", ["0"])[0])
+            updates: List[dict] = []
+            for st in self._storages():
+                updates = st.getUpdates(sid, "StatsListener", worker)
+                if updates:
+                    break
+            self._json(updates[start:])
+            return
+        self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        if urlparse(self.path).path != "/remote/receive":
+            self._json({"error": "not found"}, 404)
+            return
+        n = int(self.headers.get("Content-Length", "0"))
+        try:
+            msg = json.loads(self.rfile.read(n).decode())
+            target = self.server.ui._remote_target()  # type: ignore[attr-defined]
+            if msg.get("kind") == "static":
+                target.putStaticInfo(msg["sessionId"], msg["typeId"],
+                                     msg["workerId"], msg["info"])
+            else:
+                target.putUpdate(msg["sessionId"], msg["typeId"],
+                                 msg["workerId"], msg["report"])
+            self._json({"ok": True})
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            self._json({"ok": False, "error": str(e)}, 400)
+
+
+class UIServer:
+    """Embedded dashboard (ref: UIServer.getInstance() — same lifecycle:
+    process-wide singleton, attach any number of storages, stop() to halt)."""
+
+    _instance: Optional["UIServer"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, port: int = 0):
+        self._storages: List[StatsStorage] = []
+        self._remote_storage: Optional[StatsStorage] = None
+        self._remote_lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.ui = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name="dl4jtpu-ui-server")
+        self._thread.start()
+
+    @classmethod
+    def getInstance(cls, port: int = 9000) -> "UIServer":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(port)
+        return cls._instance
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/"
+
+    def attach(self, storage: StatsStorage):
+        if storage not in self._storages:
+            self._storages.append(storage)
+
+    def detach(self, storage: StatsStorage):
+        if storage in self._storages:
+            self._storages.remove(storage)
+
+    def _remote_target(self) -> StatsStorage:
+        """Storage that /remote/receive lands in: the first attached storage,
+        lazily creating (and attaching) an in-memory one if none. Locked —
+        each POST runs on its own ThreadingHTTPServer thread, and two first
+        posts racing here must not each create a storage."""
+        with self._remote_lock:
+            if self._storages:
+                return self._storages[0]
+            if self._remote_storage is None:
+                self._remote_storage = InMemoryStatsStorage()
+                self.attach(self._remote_storage)
+            return self._remote_storage
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        with UIServer._lock:
+            if UIServer._instance is self:
+                UIServer._instance = None
+
+
+class RemoteStatsStorageRouter(StatsStorage):
+    """Write-side router posting reports to a UIServer over HTTP (ref:
+    RemoteUIStatsStorageRouter). Only the router (write) half of the SPI is
+    live; reads raise — exactly the reference's split.
+
+    Telemetry must never kill training: network failures are retried
+    ``retries`` times with a short backoff, then the report is DROPPED with a
+    one-time warning (the reference queues and retries asynchronously; a
+    drop-after-retry keeps the same "fit() survives a UI outage" contract
+    without a background thread)."""
+
+    def __init__(self, url: str, timeout: float = 5.0, retries: int = 2,
+                 retry_delay: float = 0.2):
+        self.url = url.rstrip("/") + "/remote/receive"
+        self.timeout = timeout
+        self.retries = retries
+        self.retry_delay = retry_delay
+        self.dropped = 0
+        self._warned = False
+
+    def _post(self, payload: dict):
+        data = json.dumps(payload).encode()
+        for attempt in range(self.retries + 1):
+            try:
+                req = urllib.request.Request(
+                    self.url, data=data,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read().decode())
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                if attempt < self.retries:
+                    time.sleep(self.retry_delay)
+                    continue
+                self.dropped += 1
+                if not self._warned:
+                    self._warned = True
+                    warnings.warn(
+                        f"RemoteStatsStorageRouter: dropping stats reports, "
+                        f"UI server at {self.url} unreachable ({e})")
+                return None
+
+    def putUpdate(self, sessionId, typeId, workerId, report):
+        self._post({"kind": "update", "sessionId": sessionId, "typeId": typeId,
+                    "workerId": workerId, "report": report})
+
+    def putStaticInfo(self, sessionId, typeId, workerId, info):
+        self._post({"kind": "static", "sessionId": sessionId, "typeId": typeId,
+                    "workerId": workerId, "info": info})

@@ -276,3 +276,94 @@ class TestSameDiffStats:
         assert reports[-1]["parameterStats"]["w"]["meanMagnitude"] > 0
         # update stats via consecutive-param deltas (no _last_updates on sd)
         assert "w" in reports[-1]["updateStats"]
+
+
+class TestUIServer:
+    """Live dashboard server (ref: VertxUIServer attach/poll lifecycle) +
+    remote stats routing (ref: RemoteUIStatsStorageRouter)."""
+
+    def _fetch(self, url):
+        import urllib.request
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.read().decode()
+
+    def test_overview_and_api(self):
+        from deeplearning4j_tpu.ui import UIServer
+        server = UIServer(port=0)  # ephemeral port; not the singleton
+        try:
+            storage = InMemoryStatsStorage()
+            server.attach(storage)
+            net = tiny_net()
+            lst = StatsListener(storage, frequency=1)
+            net.setListeners(lst)
+            net.fit(tiny_data(), epochs=3)
+
+            page = self._fetch(server.url)
+            assert "Training overview" in page and "api/sessions" in page
+
+            sessions = json.loads(self._fetch(server.url + "api/sessions"))
+            assert [s["sessionId"] for s in sessions] == [lst.sessionId]
+            assert sessions[0]["info"]["modelClass"] == "MultiLayerNetwork"
+
+            ups = json.loads(self._fetch(
+                f"{server.url}api/updates/{lst.sessionId}/worker_0?from=0"))
+            assert len(ups) == 3 and ups[-1]["score"] > 0
+            # incremental poll: nothing new past the end
+            tail = json.loads(self._fetch(
+                f"{server.url}api/updates/{lst.sessionId}/worker_0?from=3"))
+            assert tail == []
+        finally:
+            server.stop()
+
+    def test_remote_router_roundtrip(self):
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter, UIServer
+        server = UIServer(port=0)
+        try:
+            router = RemoteStatsStorageRouter(server.url)
+            net = tiny_net()
+            # the listener writes through the HTTP router, as a remote
+            # worker process would
+            lst = StatsListener(router, frequency=1,
+                                config=StatsUpdateConfiguration(
+                                    collectHistograms=False))
+            net.setListeners(lst)
+            net.fit(tiny_data(), epochs=2)
+
+            sessions = json.loads(self._fetch(server.url + "api/sessions"))
+            assert [s["sessionId"] for s in sessions] == [lst.sessionId]
+            ups = json.loads(self._fetch(
+                f"{server.url}api/updates/{lst.sessionId}/worker_0?from=0"))
+            assert len(ups) == 2
+            assert "0/W" in ups[-1]["parameterStats"]
+        finally:
+            server.stop()
+
+    def test_singleton_lifecycle(self):
+        from deeplearning4j_tpu.ui import UIServer
+        a = UIServer.getInstance(port=0)
+        try:
+            assert UIServer.getInstance() is a
+        finally:
+            a.stop()
+        b = UIServer.getInstance(port=0)
+        try:
+            assert b is not a
+        finally:
+            b.stop()
+
+    def test_remote_router_survives_server_outage(self):
+        """Telemetry must not kill training: router drops reports (with a
+        warning) when the UI server is unreachable."""
+        import warnings as _w
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter
+        router = RemoteStatsStorageRouter("http://127.0.0.1:1",  # nothing listens
+                                          timeout=0.2, retries=1, retry_delay=0.01)
+        net = tiny_net()
+        net.setListeners(StatsListener(router, frequency=1,
+                                       config=StatsUpdateConfiguration(
+                                           collectHistograms=False)))
+        with _w.catch_warnings(record=True) as caught:
+            _w.simplefilter("always")
+            net.fit(tiny_data(), epochs=2)  # must not raise
+        assert router.dropped >= 2
+        assert any("unreachable" in str(c.message) for c in caught)
