@@ -31,4 +31,15 @@ SURVEY.md) with a TPU-first architecture:
 
 __version__ = "0.1.0"
 
+import jax as _jax
+
+# fp32 arrays must get true-fp32 matmuls (reference semantics: exact BLAS
+# GEMM). JAX's DEFAULT dot precision lowers fp32 operands to bf16 passes on
+# TPU-class backends (~1e-2 error at small fan-in — measured vs a float64
+# oracle), which silently degrades every fp32 model and import-parity check.
+# "highest" restores fp32 accumulation for fp32 operands and is a NO-OP for
+# the bf16 compute paths (models/bert.py casts to bf16 explicitly — bf16
+# inputs have nothing to emulate, MXU throughput unchanged).
+_jax.config.update("jax_default_matmul_precision", "highest")
+
 from deeplearning4j_tpu.ndarray import NDArray, nd  # noqa: F401

@@ -18,6 +18,7 @@ from deeplearning4j_tpu.datavec.analysis import AnalyzeLocal
 from deeplearning4j_tpu.datavec.iterator import (
     RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
 from deeplearning4j_tpu.datavec.image import ImageRecordReader, NativeImageLoader
+from deeplearning4j_tpu.datavec.arrow import ArrowConverter, ArrowRecordReader
 
 __all__ = [
     "Writable", "DoubleWritable", "FloatWritable", "IntWritable", "LongWritable",
@@ -32,4 +33,5 @@ __all__ = [
     "FilterInvalidValues", "MathOp", "LocalTransformExecutor", "AnalyzeLocal",
     "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
     "ImageRecordReader", "NativeImageLoader",
+    "ArrowConverter", "ArrowRecordReader",
 ]

@@ -1,0 +1,171 @@
+"""Arrow interop (ref: datavec/datavec-arrow org.datavec.arrow.ArrowConverter
++ recordreader.ArrowRecordReader, and nd4j/nd4j-serde/nd4j-arrow — columnar
+record batches as the zero-copy interchange format).
+
+The reference converts List<List<Writable>> ⇄ Arrow record batches so
+DataVec pipelines can exchange data with Spark/Arrow tooling. Here the same
+conversion targets ``pyarrow.Table``; the IPC file format (Feather v2)
+round-trips records to disk. On TPU this is also the natural bridge from
+columnar stores into the host-side input pipeline (arrow column → numpy →
+device batch, no per-row Python loop).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from deeplearning4j_tpu.datavec.records import RecordReader
+from deeplearning4j_tpu.datavec.schema import ColumnType, Schema
+from deeplearning4j_tpu.datavec.writables import (
+    BooleanWritable, DoubleWritable, FloatWritable, IntWritable, LongWritable,
+    NullWritable, Text, Writable,
+)
+
+
+def _pa():
+    try:
+        import pyarrow
+        return pyarrow
+    except ImportError as e:  # pragma: no cover - pyarrow present in this env
+        raise ImportError("Arrow interop needs pyarrow") from e
+
+
+_TO_ARROW = {
+    ColumnType.Double: "float64",
+    ColumnType.Float: "float32",
+    ColumnType.Integer: "int32",
+    ColumnType.Long: "int64",
+    ColumnType.Boolean: "bool_",
+    ColumnType.String: "string",
+    ColumnType.Categorical: "string",
+    ColumnType.Time: "int64",
+}
+
+_FROM_ARROW_WRITABLE = {
+    "double": DoubleWritable, "float": FloatWritable,
+    "int32": IntWritable, "int64": LongWritable, "bool": BooleanWritable,
+    "string": Text, "large_string": Text,
+}
+
+
+class ArrowConverter:
+    """List-of-Writable-rows ⇄ pyarrow.Table (ref: ArrowConverter)."""
+
+    @staticmethod
+    def toArrowTable(records: Sequence[Sequence[Writable]], schema: Schema):
+        pa = _pa()
+        fields = []
+        for meta in schema.columns:
+            at = _TO_ARROW.get(meta.type)
+            if at is None:
+                raise ValueError(
+                    f"column '{meta.name}': type {meta.type} has no Arrow mapping")
+            fields.append(pa.field(meta.name, getattr(pa, at)()))
+        cols = []
+        for j, meta in enumerate(schema.columns):
+            vals = []
+            for r in records:
+                w = r[j]
+                if isinstance(w, NullWritable) or w.value is None:
+                    vals.append(None)
+                elif meta.type in (ColumnType.Double, ColumnType.Float):
+                    vals.append(w.toDouble())
+                elif meta.type in (ColumnType.Integer, ColumnType.Long,
+                                   ColumnType.Time):
+                    vals.append(w.toLong())
+                elif meta.type == ColumnType.Boolean:
+                    vals.append(bool(w.value))
+                else:
+                    vals.append(w.toString())
+            cols.append(pa.array(vals, type=fields[j].type))
+        return pa.Table.from_arrays(cols, schema=pa.schema(fields))
+
+    @staticmethod
+    def fromArrowTable(table) -> List[List[Writable]]:
+        rows: List[List[Writable]] = []
+        arrow_cols = [(str(f.type), table.column(i).to_pylist())
+                      for i, f in enumerate(table.schema)]
+        n = table.num_rows
+        for i in range(n):
+            row: List[Writable] = []
+            for tname, vals in arrow_cols:
+                v = vals[i]
+                if v is None:
+                    row.append(NullWritable())
+                else:
+                    row.append(_FROM_ARROW_WRITABLE.get(tname, Text)(v))
+            rows.append(row)
+        return rows
+
+    @staticmethod
+    def schemaFromArrow(table) -> Schema:
+        """Arrow schema → datavec Schema (lossy: categorical becomes String)."""
+        b = Schema.Builder()
+        for f in table.schema:
+            t = str(f.type)
+            if t in ("double", "float64"):
+                b.addColumnDouble(f.name)
+            elif t in ("float", "float32"):
+                b.addColumnFloat(f.name)
+            elif t in ("int8", "int16", "int32", "uint8", "uint16"):
+                b.addColumnInteger(f.name)
+            elif t in ("int64", "uint32", "uint64"):
+                b.addColumnLong(f.name)
+            elif t == "bool":
+                b.addColumnBoolean(f.name)
+            else:
+                b.addColumnString(f.name)
+        return b.build()
+
+    # ------------------------------------------------------------- IPC file
+    @staticmethod
+    def writeRecordsToFile(path: str, records: Sequence[Sequence[Writable]],
+                           schema: Schema) -> str:
+        pa = _pa()
+        table = ArrowConverter.toArrowTable(records, schema)
+        with pa.ipc.new_file(path, table.schema) as w:
+            w.write_table(table)
+        return path
+
+    @staticmethod
+    def _read_table(path: str):
+        pa = _pa()
+        with pa.ipc.open_file(path) as r:
+            return r.read_all()
+
+    @staticmethod
+    def readRecordsFromFile(path: str) -> List[List[Writable]]:
+        return ArrowConverter.fromArrowTable(ArrowConverter._read_table(path))
+
+
+class ArrowRecordReader(RecordReader):
+    """Reads Arrow IPC files as records (ref: org.datavec.arrow.recordreader.
+    ArrowRecordReader). ``initialize`` takes an InputSplit over .arrow files."""
+
+    def __init__(self):
+        self._rows: List[List[Writable]] = []
+        self._i = 0
+        self.schema: Optional[Schema] = None
+
+    def initialize(self, split):
+        self._rows = []
+        self.schema = None  # re-derive from the new split's first file
+        for loc in split.locations():
+            table = ArrowConverter._read_table(loc)
+            if self.schema is None:
+                self.schema = ArrowConverter.schemaFromArrow(table)
+            self._rows.extend(ArrowConverter.fromArrowTable(table))
+        self._i = 0
+        return self
+
+    def hasNext(self) -> bool:
+        return self._i < len(self._rows)
+
+    def next(self) -> List[Writable]:
+        if not self.hasNext():
+            raise StopIteration
+        r = self._rows[self._i]
+        self._i += 1
+        return r
+
+    def reset(self):
+        self._i = 0

@@ -1,0 +1,12 @@
+"""Runtime interop with other frameworks (ref: nd4j/nd4j-tensorflow's
+GraphRunner and nd4j/nd4j-onnxruntime's OnnxRuntimeRunner — escape hatches
+that execute foreign model formats with array I/O, for graphs the import
+pipeline cannot (yet) translate).
+
+``onnxruntime`` is not present in this environment; the ONNX analog of
+GraphRunner is served by the in-tree importer (``modelimport.onnx`` executes
+ONNX graphs natively on SameDiff/XLA), so no ORT wrapper is shipped.
+"""
+from deeplearning4j_tpu.interop.tf_runner import GraphRunner
+
+__all__ = ["GraphRunner"]

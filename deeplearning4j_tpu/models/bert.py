@@ -192,16 +192,22 @@ def _forward_raw(params, token_ids, cfg: TransformerConfig,
     (~3 GB at BERT-base bench shapes B=48/T=512; halving it + fusing the
     loss reduction was worth several points of MFU)."""
     B, T = token_ids.shape
-    x = params["tok_emb"][token_ids].astype(cfg.dtype) \
-        + params["pos_emb"][:T][None].astype(cfg.dtype)
-    blk = functools.partial(_block, cfg=cfg, mesh=mesh)
-    if cfg.remat:
-        blk = jax.checkpoint(
-            blk, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
-    for bp in params["blocks"]:
-        x = blk(bp, x)
-    x = _layernorm(x, params["ln_f"])
-    return x @ params["lm_head"].astype(x.dtype)
+    # The package pins jax_default_matmul_precision="highest" so fp32 models
+    # get exact fp32 GEMMs (reference semantics). This model casts operands
+    # to bf16 explicitly — precision emulation has nothing to add, but
+    # "highest" still steers XLA:TPU to a slower dot algorithm (measured
+    # ~5% tokens/sec on the bench). Scope the fast default back in here.
+    with jax.default_matmul_precision("default"):
+        x = params["tok_emb"][token_ids].astype(cfg.dtype) \
+            + params["pos_emb"][:T][None].astype(cfg.dtype)
+        blk = functools.partial(_block, cfg=cfg, mesh=mesh)
+        if cfg.remat:
+            blk = jax.checkpoint(
+                blk, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+        for bp in params["blocks"]:
+            x = blk(bp, x)
+        x = _layernorm(x, params["ln_f"])
+        return x @ params["lm_head"].astype(x.dtype)
 
 
 def forward(params, token_ids, cfg: TransformerConfig, mesh: Optional[Mesh] = None):

@@ -1,0 +1,136 @@
+"""Interop runtimes: TF GraphRunner (ref: nd4j-tensorflow GraphRunner tests)
+and Arrow record conversion (ref: datavec-arrow ArrowConverterTest)."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+pa = pytest.importorskip("pyarrow")
+
+
+def _frozen_mlp_graphdef():
+    """A tiny frozen graph: y = relu(x @ W + b), constants baked in."""
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+
+    @tf.function
+    def f(x):
+        return tf.nn.relu(tf.matmul(x, W) + b, name="y")
+
+    conc = f.get_concrete_function(tf.TensorSpec([None, 4], tf.float32, name="x"))
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    frozen = convert_variables_to_constants_v2(conc)
+    return frozen.graph.as_graph_def(), W, b
+
+
+class TestGraphRunner:
+    def test_run_frozen_graph(self):
+        from deeplearning4j_tpu.interop import GraphRunner
+        gd, W, b = _frozen_mlp_graphdef()
+        runner = GraphRunner(gd.SerializeToString(),
+                             inputNames=["x"], outputNames=["Identity"])
+        x = np.random.RandomState(1).rand(5, 4).astype(np.float32)
+        with runner:
+            out = runner.run({"x": x})
+        expected = np.maximum(x @ W + b, 0)
+        np.testing.assert_allclose(out["Identity"], expected, rtol=1e-5)
+
+    def test_autodetect_io(self):
+        from deeplearning4j_tpu.interop import GraphRunner
+        gd, W, b = _frozen_mlp_graphdef()
+        runner = GraphRunner(gd.SerializeToString())
+        assert runner.inputNames == ["x"]
+        assert len(runner.outputNames) >= 1
+        with runner:
+            out = runner.run({"x": np.zeros((2, 4), np.float32)})
+        # relu(0*W + b) = max(b, 0)
+        np.testing.assert_allclose(
+            list(out.values())[0], np.tile(np.maximum(b, 0), (2, 1)), rtol=1e-5)
+
+    def test_unknown_feed_raises(self):
+        from deeplearning4j_tpu.interop import GraphRunner
+        gd, _, _ = _frozen_mlp_graphdef()
+        runner = GraphRunner(gd.SerializeToString())
+        with pytest.raises(ValueError, match="unexpected input"):
+            runner.run({"bogus": np.zeros((1, 4), np.float32)})
+
+    def test_file_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.interop import GraphRunner
+        gd, W, b = _frozen_mlp_graphdef()
+        p = tmp_path / "frozen.pb"
+        p.write_bytes(gd.SerializeToString())
+        runner = GraphRunner(str(p), inputNames=["x"])
+        x = np.ones((1, 4), np.float32)
+        with runner:
+            out = runner.run({"x": x})
+        np.testing.assert_allclose(
+            list(out.values())[0], np.maximum(x @ W + b, 0), rtol=1e-5)
+
+
+class TestArrowConverter:
+    def _schema_and_records(self):
+        from deeplearning4j_tpu.datavec import (
+            BooleanWritable, DoubleWritable, IntWritable, NullWritable,
+            Schema, Text)
+        schema = (Schema.Builder()
+                  .addColumnDouble("d").addColumnInteger("i")
+                  .addColumnString("s").addColumnBoolean("b")
+                  .build())
+        records = [
+            [DoubleWritable(1.5), IntWritable(7), Text("a"), BooleanWritable(True)],
+            [DoubleWritable(-2.0), IntWritable(0), Text("bb"), BooleanWritable(False)],
+            [NullWritable(), IntWritable(3), Text(""), BooleanWritable(True)],
+        ]
+        return schema, records
+
+    def test_table_roundtrip(self):
+        from deeplearning4j_tpu.datavec import ArrowConverter, NullWritable
+        schema, records = self._schema_and_records()
+        table = ArrowConverter.toArrowTable(records, schema)
+        assert table.num_rows == 3
+        assert [f.name for f in table.schema] == ["d", "i", "s", "b"]
+        assert str(table.schema.field("d").type) == "double"
+        assert str(table.schema.field("i").type) == "int32"
+        back = ArrowConverter.fromArrowTable(table)
+        assert back[0][0].toDouble() == 1.5
+        assert back[1][2].toString() == "bb"
+        assert back[2][3].value is True
+        assert isinstance(back[2][0], NullWritable)
+
+    def test_schema_from_arrow(self):
+        from deeplearning4j_tpu.datavec import ArrowConverter, ColumnType
+        schema, records = self._schema_and_records()
+        table = ArrowConverter.toArrowTable(records, schema)
+        inferred = ArrowConverter.schemaFromArrow(table)
+        assert inferred.getColumnNames() == ["d", "i", "s", "b"]
+        assert inferred.columns[0].type == ColumnType.Double
+        assert inferred.columns[1].type == ColumnType.Integer
+        assert inferred.columns[3].type == ColumnType.Boolean
+
+    def test_ipc_file_and_reader(self, tmp_path):
+        from deeplearning4j_tpu.datavec import (
+            ArrowConverter, ArrowRecordReader, CollectionInputSplit)
+        schema, records = self._schema_and_records()
+        p = str(tmp_path / "recs.arrow")
+        ArrowConverter.writeRecordsToFile(p, records, schema)
+        back = ArrowConverter.readRecordsFromFile(p)
+        assert len(back) == 3 and back[0][1].toInt() == 7
+
+        reader = ArrowRecordReader()
+        reader.initialize(CollectionInputSplit([p]))
+        assert reader.schema.getColumnNames() == ["d", "i", "s", "b"]
+        rows = []
+        while reader.hasNext():
+            rows.append(reader.next())
+        assert len(rows) == 3
+        reader.reset()
+        assert reader.hasNext()
+
+    def test_unmappable_column_raises(self):
+        from deeplearning4j_tpu.datavec import ArrowConverter, Schema
+        from deeplearning4j_tpu.datavec.schema import ColumnMeta, ColumnType
+        schema, records = self._schema_and_records()
+        bad = Schema([ColumnMeta("nd", ColumnType.NDArray)] )
+        with pytest.raises(ValueError, match="no Arrow mapping"):
+            ArrowConverter.toArrowTable([[records[0][0]]], bad)
