@@ -12,6 +12,14 @@ from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
 from deeplearning4j_tpu.rl.policy import BoltzmannPolicy, EpsGreedy, GreedyPolicy
 from deeplearning4j_tpu.rl.qlearning import QLearningConfiguration, QLearningDiscreteDense
 from deeplearning4j_tpu.rl.a2c import A2CConfiguration, A2CDiscreteDense
+from deeplearning4j_tpu.rl.vector_env import VectorizedMDP
+from deeplearning4j_tpu.rl.nstep_q import (
+    AsyncNStepQLearningDiscreteDense, AsyncQLearningConfiguration)
+
+# A3C parity name (ref: A3CDiscreteDense): the vectorized-sync A2C with
+# numEnvs > 1 carries the same N experience streams minus gradient staleness.
+A3CDiscreteDense = A2CDiscreteDense
+A3CConfiguration = A2CConfiguration
 
 __all__ = [
     "MDP", "CartPole", "ChainMDP",
@@ -19,4 +27,7 @@ __all__ = [
     "EpsGreedy", "GreedyPolicy", "BoltzmannPolicy",
     "QLearningConfiguration", "QLearningDiscreteDense",
     "A2CConfiguration", "A2CDiscreteDense",
+    "A3CConfiguration", "A3CDiscreteDense",
+    "VectorizedMDP",
+    "AsyncQLearningConfiguration", "AsyncNStepQLearningDiscreteDense",
 ]

@@ -19,11 +19,12 @@ import optax
 
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.rl.env import MDP
+from deeplearning4j_tpu.rl.returns import nstep_returns
 
 
 @dataclass
 class A2CConfiguration:
-    """(ref: A3CConfiguration builder, minus the async knobs)."""
+    """(ref: A3CConfiguration builder; numThreads -> numEnvs)."""
     seed: int = 0
     gamma: float = 0.99
     nStep: int = 32                # rollout length per update
@@ -31,15 +32,31 @@ class A2CConfiguration:
     valueCoef: float = 0.5
     maxStep: int = 5000
     maxEpochStep: int = 500
+    numEnvs: int = 1               # >1: lockstep vectorized rollouts (the
+                                   # sync stand-in for A3C's worker threads)
 
 
 class A2CDiscreteDense:
     """Policy net (softmax over actions) + value net (scalar), both dense
     layer stacks from the nn config DSL."""
 
-    def __init__(self, mdp: MDP, policy_conf, value_conf, config: A2CConfiguration):
-        self.mdp = mdp
+    def __init__(self, mdp, policy_conf, value_conf, config: A2CConfiguration):
+        """``mdp``: an MDP instance (numEnvs=1), or an env factory callable /
+        VectorizedMDP when config.numEnvs > 1."""
         self.config = config
+        self.venv = None
+        if config.numEnvs > 1:
+            from deeplearning4j_tpu.rl.vector_env import VectorizedMDP
+            if isinstance(mdp, VectorizedMDP):
+                self.venv = mdp
+            elif callable(mdp) and not isinstance(mdp, MDP):
+                self.venv = VectorizedMDP([mdp for _ in range(config.numEnvs)])
+            else:
+                raise ValueError("numEnvs > 1 needs an env factory or "
+                                 "VectorizedMDP, not a single MDP instance")
+            self.mdp = self.venv.envs[0]
+        else:
+            self.mdp = mdp() if (callable(mdp) and not isinstance(mdp, MDP)) else mdp
         self.pi_net = (policy_conf if isinstance(policy_conf, MultiLayerNetwork)
                        else MultiLayerNetwork(policy_conf).init())
         self.v_net = (value_conf if isinstance(value_conf, MultiLayerNetwork)
@@ -88,6 +105,8 @@ class A2CDiscreteDense:
         return np.asarray(self._jit_probs(self._pi, jnp.asarray(obs[None])))[0]
 
     def train(self) -> List[float]:
+        if self.venv is not None:
+            return self._train_vectorized()
         cfg = self.config
         obs = self.mdp.reset()
         ep_reward, ep_steps = 0.0, 0
@@ -129,6 +148,59 @@ class A2CDiscreteDense:
                 self.episode_rewards.append(ep_reward)
                 obs = self.mdp.reset()
                 ep_reward, ep_steps = 0.0, 0
+        self.pi_net._params = self._pi
+        self.v_net._params = self._v
+        return self.episode_rewards
+
+    def _train_vectorized(self) -> List[float]:
+        """Lockstep N-env rollouts (ref: A3C's numThreads workers — same
+        experience parallelism, one batched policy eval + one fused update
+        per rollout instead of N async racing gradients)."""
+        cfg = self.config
+        N, S = self.venv.num_envs, cfg.nStep
+        obs = self.venv.reset()
+        while self._steps < cfg.maxStep:
+            ro = np.empty((S, N, self.venv.obs_size), np.float32)
+            ra = np.empty((S, N), np.int64)
+            rr = np.empty((S, N), np.float32)
+            rd = np.empty((S, N), bool)
+            # truncated streams were auto-reset: break the return chain at t
+            # and bootstrap from the episode's final_obs, not the next
+            # episode's rewards
+            rtrunc = np.zeros((S, N), bool)
+            tobs = np.zeros((S, N, self.venv.obs_size), np.float32)
+            for t in range(S):
+                probs = np.asarray(self._jit_probs(self._pi, jnp.asarray(obs)))
+                probs = probs / probs.sum(-1, keepdims=True)
+                # per-env categorical sample via inverse-CDF (one rand per env)
+                cdf = probs.cumsum(-1)
+                u = self.rng.rand(N, 1)
+                actions = (u > cdf[:, :-1]).sum(-1)
+                ro[t], ra[t] = obs, actions
+                obs, rr[t], rd[t], infos = self.venv.step(
+                    actions, max_episode_steps=cfg.maxEpochStep)
+                self._steps += N
+                for i, info in enumerate(infos):
+                    if "episode_reward" in info:
+                        self.episode_rewards.append(info["episode_reward"])
+                    if info.get("truncated"):
+                        rtrunc[t, i] = True
+                        tobs[t, i] = info["final_obs"]
+            # bootstrap: V(s_T) at the rollout tail, 0 at terminals,
+            # V(final_obs) at truncation points
+            boot = np.asarray(self._value_fn(self._v, jnp.asarray(obs)))
+            if rtrunc.any():
+                vtrunc = np.asarray(self._value_fn(
+                    self._v, jnp.asarray(tobs.reshape(S * N, -1)))).reshape(S, N)
+            else:  # no truncation this rollout — skip the masked-out eval
+                vtrunc = np.zeros((S, N), np.float32)
+            returns = nstep_returns(rr, rd, rtrunc, boot, vtrunc, cfg.gamma)
+            params = {"pi": self._pi, "v": self._v}
+            params, self._opt, _ = self._jit_update(
+                params, self._opt, jnp.asarray(ro.reshape(S * N, -1)),
+                jnp.asarray(ra.reshape(S * N).astype(np.int32)),
+                jnp.asarray(returns.reshape(S * N)))
+            self._pi, self._v = params["pi"], params["v"]
         self.pi_net._params = self._pi
         self.v_net._params = self._v
         return self.episode_rewards

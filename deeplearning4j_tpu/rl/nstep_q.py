@@ -1,0 +1,176 @@
+"""n-step Q-learning over vectorized environments (ref: org.deeplearning4j.
+rl4j.learning.async.nstep.discrete.AsyncNStepQLearningDiscreteDense +
+AsyncNStepQLConfiguration).
+
+The reference's async design: ``numThreads`` workers each roll ``nStep``
+transitions on a private MDP, compute gradients against a shared global
+network, and apply them asynchronously (hogwild-over-JVM). The TPU redesign
+keeps the same data flow — N parallel experience streams, n-step bootstrapped
+targets, one shared network — but synchronously: a ``VectorizedMDP`` steps N
+envs in lockstep, action selection is ONE batched jitted Q evaluation, and
+each rollout produces ONE fused update over the (N*nStep) batch. Equivalent
+sample parallelism, zero gradient staleness (the async variant's staleness is
+an artifact of JVM threading, not an algorithmic feature worth reproducing).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.rl.env import MDP
+from deeplearning4j_tpu.rl.returns import nstep_returns
+from deeplearning4j_tpu.rl.vector_env import VectorizedMDP
+
+
+@dataclass
+class AsyncQLearningConfiguration:
+    """(ref: AsyncNStepQLConfiguration builder; numThreads -> numEnvs)."""
+    seed: int = 0
+    gamma: float = 0.99
+    nStep: int = 5                  # rollout length per update
+    numEnvs: int = 4                # experience-stream parallelism (ref: numThreads)
+    targetDqnUpdateFreq: int = 100  # env steps between target-net syncs
+    minEpsilon: float = 0.05
+    epsilonNbStep: int = 1000
+    maxStep: int = 5000             # total env steps across all envs
+    maxEpochStep: int = 500         # per-episode cap (truncation, bootstrapped)
+    errorClamp: Optional[float] = 1.0
+
+
+class AsyncNStepQLearningDiscreteDense:
+    """(ref: AsyncNStepQLearningDiscreteDense — class name kept for parity;
+    see module docstring for the sync-vectorized redesign)."""
+
+    def __init__(self, mdp_fn: Union[Callable[[], MDP], VectorizedMDP],
+                 net_conf, config: AsyncQLearningConfiguration):
+        self.config = config
+        if isinstance(mdp_fn, VectorizedMDP):
+            self.venv = mdp_fn
+        elif callable(mdp_fn) and not isinstance(mdp_fn, MDP):
+            self.venv = VectorizedMDP([mdp_fn for _ in range(config.numEnvs)])
+        else:
+            raise ValueError("pass an env factory (lambda: MyMDP()) or a "
+                             "VectorizedMDP, not a single MDP instance")
+        self.net = (net_conf if isinstance(net_conf, MultiLayerNetwork)
+                    else MultiLayerNetwork(net_conf).init())
+        self._params = self.net._params
+        self._target = jax.tree.map(jnp.array, self._params)
+        self._net_state = self.net._state
+        self._tx = self.net.conf.updater.to_optax()
+        self._opt_state = self._tx.init(self._params)
+        self._jit_q = jax.jit(self._q_fn)
+        self._jit_update = jax.jit(self._update_fn)
+        self.rng = np.random.RandomState(config.seed)
+        self.episode_rewards: List[float] = []
+        self._steps = 0  # total env steps (all envs)
+
+    # ---------------------------------------------------------------- pure
+    def _q_fn(self, params, obs):
+        out, _, _ = self.net._forward(params, self._net_state, obs,
+                                      training=False, rng=None)
+        return out
+
+    def _update_fn(self, params, opt_state, obs, actions, returns):
+        cfg = self.config
+
+        def loss_fn(p):
+            q = self._q_fn(p, obs)
+            q_sel = jnp.take_along_axis(q, actions[:, None], -1)[:, 0]
+            err = q_sel - returns
+            if cfg.errorClamp is not None:
+                c = cfg.errorClamp
+                ae = jnp.abs(err)
+                return jnp.mean(jnp.where(ae <= c, 0.5 * err ** 2,
+                                          c * (ae - 0.5 * c)))
+            return jnp.mean(err ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self._tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # ------------------------------------------------------------ training
+    def _epsilon(self) -> float:
+        frac = min(self._steps / max(self.config.epsilonNbStep, 1), 1.0)
+        return 1.0 + (self.config.minEpsilon - 1.0) * frac
+
+    def _select_actions(self, obs: np.ndarray) -> np.ndarray:
+        """Batched eps-greedy: ONE device call scores all envs."""
+        q = np.asarray(self._jit_q(self._params, jnp.asarray(obs)))
+        greedy = q.argmax(-1)
+        explore = self.rng.rand(len(obs)) < self._epsilon()
+        randoms = self.rng.randint(self.venv.n_actions, size=len(obs))
+        return np.where(explore, randoms, greedy).astype(np.int64)
+
+    def train(self) -> List[float]:
+        cfg = self.config
+        N, S = self.venv.num_envs, cfg.nStep
+        obs = self.venv.reset()
+        last_sync = 0
+        while self._steps < cfg.maxStep:
+            # ---- rollout: S lockstep vector steps
+            ro = np.empty((S, N, self.venv.obs_size), np.float32)
+            ra = np.empty((S, N), np.int64)
+            rr = np.empty((S, N), np.float32)
+            rd = np.empty((S, N), bool)
+            # truncation breaks the return chain without a terminal: the
+            # stream was auto-reset, so step t bootstraps from the episode's
+            # final_obs instead of chaining into the NEXT episode's rewards
+            rtrunc = np.zeros((S, N), bool)
+            tobs = np.zeros((S, N, self.venv.obs_size), np.float32)
+            for t in range(S):
+                actions = self._select_actions(obs)
+                ro[t], ra[t] = obs, actions
+                obs, rr[t], rd[t], infos = self.venv.step(
+                    actions, max_episode_steps=cfg.maxEpochStep)
+                self._steps += N
+                for i, info in enumerate(infos):
+                    if "episode_reward" in info:
+                        self.episode_rewards.append(info["episode_reward"])
+                    if info.get("truncated"):
+                        rtrunc[t, i] = True
+                        tobs[t, i] = info["final_obs"]
+            # ---- n-step bootstrapped returns per env (one batched target
+            # eval for the rollout tail + every truncation point)
+            boot = np.asarray(self._jit_q(self._target, jnp.asarray(obs))).max(-1)
+            if rtrunc.any():
+                qtrunc = np.asarray(self._jit_q(
+                    self._target, jnp.asarray(tobs.reshape(S * N, -1)))
+                ).max(-1).reshape(S, N)
+            else:  # no truncation this rollout — skip the masked-out eval
+                qtrunc = np.zeros((S, N), np.float32)
+            returns = nstep_returns(rr, rd, rtrunc, boot, qtrunc, cfg.gamma)
+            # ---- one fused update over the (S*N) batch
+            self._params, self._opt_state, _ = self._jit_update(
+                self._params, self._opt_state,
+                jnp.asarray(ro.reshape(S * N, -1)),
+                jnp.asarray(ra.reshape(S * N).astype(np.int32)),
+                jnp.asarray(returns.reshape(S * N)))
+            if self._steps - last_sync >= cfg.targetDqnUpdateFreq:
+                self._target = jax.tree.map(jnp.array, self._params)
+                last_sync = self._steps
+        self.net._params = self._params
+        return self.episode_rewards
+
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._jit_q(self._params, jnp.asarray(obs[None])))[0]
+
+    def play(self, max_steps: Optional[int] = None) -> float:
+        """One greedy episode on a fresh single env."""
+        env = self.venv.envs[0]
+        obs = env.reset()
+        total, steps = 0.0, 0
+        cap = max_steps or self.config.maxEpochStep
+        while steps < cap:
+            obs, reward, done, _ = env.step(int(np.argmax(self.q_values(obs))))
+            total += reward
+            steps += 1
+            if done:
+                break
+        return total

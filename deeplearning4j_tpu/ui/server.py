@@ -100,12 +100,16 @@ async function poll() {
   try {
     const sessions = await (await fetch('api/sessions')).json();
     const sel = document.getElementById('session');
-    if (sel.options.length !== sessions.length) {
-      sel.replaceChildren(...sessions.map(s => {
+    const ids = sessions.map(s => s.sessionId);
+    const have = Array.from(sel.options).map(o => o.value);
+    if (ids.length !== have.length || ids.some((id, i) => id !== have[i])) {
+      const keep = sel.value;          // don't yank the user's selection
+      sel.replaceChildren(...ids.map(id => {
         const o = document.createElement('option');
-        o.textContent = s.sessionId;   // textContent: sessionId is untrusted
+        o.textContent = id;            // textContent: sessionId is untrusted
         return o;
       }));
+      if (ids.includes(keep)) sel.value = keep;
     }
     if (!sessions.length) return;
     const sid = sel.value || sessions[0].sessionId;
@@ -191,7 +195,8 @@ class _Handler(BaseHTTPRequestHandler):
                 target.putUpdate(msg["sessionId"], msg["typeId"],
                                  msg["workerId"], msg["report"])
             self._json({"ok": True})
-        except (KeyError, ValueError, json.JSONDecodeError) as e:
+        except (KeyError, ValueError, TypeError, AttributeError,
+                json.JSONDecodeError) as e:  # malformed body → 400, not a dead thread
             self._json({"ok": False, "error": str(e)}, 400)
 
 

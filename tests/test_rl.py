@@ -160,3 +160,113 @@ class TestCartPoleLearning:
         early = np.mean(rewards[:10])
         late = np.mean(rewards[-10:])
         assert late > early * 2, (early, late)
+
+
+class TestVectorizedMDP:
+    def test_lockstep_and_autoreset(self):
+        from deeplearning4j_tpu.rl import VectorizedMDP
+        venv = VectorizedMDP([lambda: ChainMDP(n_states=4, horizon=3)
+                              for _ in range(3)])
+        obs = venv.reset()
+        assert obs.shape == (3, 4) and venv.n_actions == 2
+        # horizon=3: third step ends every episode and auto-resets
+        for t in range(3):
+            obs, rewards, dones, infos = venv.step([1, 1, 0])
+        assert dones.all()
+        assert all("episode_reward" in i for i in infos)
+        # auto-reset: obs is the fresh reset state (state index 1)
+        assert (obs.argmax(-1) == 1).all()
+        # rightward walker reached the end (reward 1 at state 3)
+        assert infos[0]["episode_reward"] > infos[2]["episode_reward"]
+
+    def test_truncation_reports_but_not_done(self):
+        from deeplearning4j_tpu.rl import VectorizedMDP
+        venv = VectorizedMDP([lambda: ChainMDP(n_states=4, horizon=50)])
+        venv.reset()
+        for _ in range(5):
+            obs, rewards, dones, infos = venv.step([1], max_episode_steps=5)
+        assert not dones[0]                      # env itself didn't terminate
+        assert infos[0]["truncated"] is True     # ...but the limit tripped
+        assert "episode_reward" in infos[0]
+
+
+class TestNStepQ:
+    def test_chain_convergence(self):
+        """n-step Q over 4 lockstep envs learns the right-moving policy
+        (ref: AsyncNStepQLearningDiscreteTest's convergence criterion)."""
+        from deeplearning4j_tpu.rl import (
+            AsyncNStepQLearningDiscreteDense, AsyncQLearningConfiguration)
+        cfg = AsyncQLearningConfiguration(
+            seed=3, gamma=0.9, nStep=5, numEnvs=4, targetDqnUpdateFreq=80,
+            minEpsilon=0.05, epsilonNbStep=1500, maxStep=4000, maxEpochStep=20)
+        learner = AsyncNStepQLearningDiscreteDense(
+            lambda: ChainMDP(n_states=5, horizon=20),
+            q_net_conf(5, 2, seed=3), cfg)
+        rewards = learner.train()
+        assert len(rewards) > 20
+        # greedy policy walks right and collects the end reward repeatedly
+        assert learner.play() > 10.0
+        # Q(s, right) > Q(s, left) on interior states
+        for s in range(1, 4):
+            obs = np.zeros(5, np.float32); obs[s] = 1.0
+            q = learner.q_values(obs)
+            assert q[1] > q[0], f"state {s}: {q}"
+
+
+class TestVectorizedA2C:
+    def test_a3c_name_and_vector_training(self):
+        from deeplearning4j_tpu.rl import A3CConfiguration, A3CDiscreteDense
+        assert A3CDiscreteDense is A2CDiscreteDense  # documented sync alias
+        cfg = A3CConfiguration(seed=5, gamma=0.9, nStep=8, numEnvs=4,
+                               maxStep=4000, maxEpochStep=20)
+        learner = A3CDiscreteDense(
+            lambda: ChainMDP(n_states=5, horizon=20),
+            pi_net_conf(5, 2, seed=5), v_net_conf(5, seed=6), cfg)
+        rewards = learner.train()
+        assert len(rewards) > 20
+        tail = np.mean(rewards[-10:])
+        head = np.mean(rewards[:10])
+        assert tail > head, f"no improvement: head {head:.2f} tail {tail:.2f}"
+        assert learner.play() > 5.0
+
+    def test_single_instance_rejected_for_multi_env(self):
+        from deeplearning4j_tpu.rl import A2CConfiguration, A2CDiscreteDense
+        with pytest.raises(ValueError, match="factory"):
+            A2CDiscreteDense(ChainMDP(), pi_net_conf(6, 2), v_net_conf(6),
+                             A2CConfiguration(numEnvs=4))
+
+
+class TestNStepReturns:
+    """Hand-computed cases for the terminal/truncation semantics (the
+    cross-reset leak this guards against is invisible to convergence tests)."""
+
+    def test_plain_chain_bootstraps_tail(self):
+        from deeplearning4j_tpu.rl.returns import nstep_returns
+        S, N, g = 3, 1, 0.5
+        rr = np.array([[1.0], [2.0], [4.0]], np.float32)
+        no = np.zeros((S, N), bool)
+        out = nstep_returns(rr, no, no, np.array([8.0]), np.zeros((S, N)), g)
+        # R2 = 4 + .5*8 = 8; R1 = 2 + .5*8 = 6; R0 = 1 + .5*6 = 4
+        np.testing.assert_allclose(out[:, 0], [4.0, 6.0, 8.0])
+
+    def test_terminal_zeroes_value_beyond(self):
+        from deeplearning4j_tpu.rl.returns import nstep_returns
+        rr = np.array([[1.0], [2.0], [4.0]], np.float32)
+        dones = np.array([[False], [True], [False]])
+        no = np.zeros((3, 1), bool)
+        out = nstep_returns(rr, dones, no, np.array([100.0]),
+                            np.zeros((3, 1)), 0.5)
+        # R1 = 2 (terminal); R0 = 1 + .5*2 = 2; R2 belongs to the NEXT episode
+        np.testing.assert_allclose(out[:2, 0], [2.0, 2.0])
+        np.testing.assert_allclose(out[2, 0], 4.0 + 0.5 * 100.0)
+
+    def test_truncation_bootstraps_final_obs_not_next_episode(self):
+        from deeplearning4j_tpu.rl.returns import nstep_returns
+        rr = np.array([[1.0], [2.0], [4.0]], np.float32)
+        truncs = np.array([[False], [True], [False]])
+        no = np.zeros((3, 1), bool)
+        trunc_boot = np.array([[0.0], [10.0], [0.0]], np.float32)
+        out = nstep_returns(rr, no, truncs, np.array([100.0]), trunc_boot, 0.5)
+        # R1 = 2 + .5*V(final_obs)=7 — NOT chained through R2's episode
+        np.testing.assert_allclose(out[1, 0], 7.0)
+        np.testing.assert_allclose(out[0, 0], 1.0 + 0.5 * 7.0)
