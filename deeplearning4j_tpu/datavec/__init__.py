@@ -19,6 +19,7 @@ from deeplearning4j_tpu.datavec.iterator import (
     RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
 from deeplearning4j_tpu.datavec.image import ImageRecordReader, NativeImageLoader
 from deeplearning4j_tpu.datavec.arrow import ArrowConverter, ArrowRecordReader
+from deeplearning4j_tpu.datavec.codec import CodecRecordReader
 
 __all__ = [
     "Writable", "DoubleWritable", "FloatWritable", "IntWritable", "LongWritable",
@@ -34,4 +35,5 @@ __all__ = [
     "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
     "ImageRecordReader", "NativeImageLoader",
     "ArrowConverter", "ArrowRecordReader",
+    "CodecRecordReader",
 ]

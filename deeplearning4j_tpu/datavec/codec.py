@@ -1,0 +1,131 @@
+"""Video/codec frame-sequence reader (ref: datavec/datavec-data-codec
+org.datavec.codec.reader.CodecRecordReader — decodes video into one sequence
+record per file, each time step a frame; the reference decodes via JCodec/
+JavaCV with conf keys START_FRAME / TOTAL_FRAMES / ROWS_PER_FRAME).
+
+No ffmpeg exists in this environment, so the decode backends are:
+- **multi-frame images** (.gif / animated .webp / multipage .tif) via PIL's
+  frame-seek API — the same decode-to-frames contract;
+- **array containers** (.npy / .npz holding a (T, H, W, C) or (T, H, W)
+  uint8/float stack) — the interchange format scientific video pipelines
+  already produce.
+
+Each sequence step is one ``NDArrayWritable`` holding a (C, H, W) float32
+frame (optionally resized / normalized), matching ImageRecordReader's layout
+so downstream iterators treat video exactly like image sequences.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datavec.records import SequenceRecordReader
+from deeplearning4j_tpu.datavec.split import InputSplit
+from deeplearning4j_tpu.datavec.writables import NDArrayWritable, Writable
+
+_IMAGE_EXTS = {".gif", ".webp", ".tif", ".tiff", ".png", ".apng"}
+_ARRAY_EXTS = {".npy", ".npz"}
+
+
+class CodecRecordReader(SequenceRecordReader):
+    """One sequence per file; steps are frames (ref: CodecRecordReader).
+
+    ``startFrame`` / ``numFrames`` / ``frameStep`` window the decoded stream
+    (ref conf keys START_FRAME / TOTAL_FRAMES; frameStep is the rebuild's
+    stride generalization). ``size=(H, W)`` resizes frames; ``normalize``
+    scales uint8 content to [0, 1].
+    """
+
+    def __init__(self, startFrame: int = 0, numFrames: Optional[int] = None,
+                 frameStep: int = 1, size: Optional[Tuple[int, int]] = None,
+                 normalize: bool = True):
+        if frameStep < 1:
+            raise ValueError("frameStep must be >= 1")
+        self.startFrame = startFrame
+        self.numFrames = numFrames
+        self.frameStep = frameStep
+        self.size = size
+        self.normalize = normalize
+        self._locations: List[str] = []
+        self._pos = 0
+
+    # ------------------------------------------------------------- decode
+    def _decode_image_frames(self, path: str) -> List[np.ndarray]:
+        from PIL import Image, ImageSequence
+        frames = []
+        with Image.open(path) as im:
+            for frame in ImageSequence.Iterator(im):
+                f = frame.convert("RGB")
+                if self.size is not None:
+                    f = f.resize((self.size[1], self.size[0]))
+                frames.append(np.asarray(f, np.float32))  # (H, W, C)
+        return frames
+
+    def _decode_array_frames(self, path: str):
+        if path.endswith(".npz"):
+            with np.load(path) as z:
+                stack = z[list(z.files)[0]]
+        else:
+            stack = np.load(path)
+        was_uint8 = stack.dtype == np.uint8
+        if stack.ndim == 3:                       # (T, H, W) → add channel
+            stack = stack[..., None]
+        if stack.ndim != 4:
+            raise ValueError(
+                f"{path}: expected (T,H,W[,C]) video stack, got {stack.shape}")
+        frames = [np.asarray(f, np.float32) for f in stack]
+        if self.size is not None:
+            from PIL import Image
+            h, w = self.size
+            out = []
+            for f in frames:
+                # per-channel float resize (PIL mode "F") — no uint8
+                # roundtrip, so float-valued stacks survive untouched
+                chans = [np.asarray(
+                    Image.fromarray(f[..., c], mode="F").resize((w, h)),
+                    np.float32) for c in range(f.shape[-1])]
+                out.append(np.stack(chans, axis=-1))
+            frames = out
+        return frames, was_uint8
+
+    def _frames_for(self, path: str):
+        """Returns (frames, uint8_scaled) — the flag says pixel values live
+        in 0..255 and normalize should rescale them."""
+        ext = os.path.splitext(path)[1].lower()
+        if ext in _ARRAY_EXTS:
+            frames, uint8_scaled = self._decode_array_frames(path)
+        elif ext in _IMAGE_EXTS:
+            frames, uint8_scaled = self._decode_image_frames(path), True
+        else:
+            raise ValueError(f"unsupported container '{ext}' "
+                             f"(multi-frame image or .npy/.npz stack)")
+        stop = (self.startFrame + self.numFrames * self.frameStep
+                if self.numFrames is not None else None)
+        return frames[self.startFrame:stop:self.frameStep], uint8_scaled
+
+    # ---------------------------------------------------------------- SPI
+    def initialize(self, split: InputSplit):
+        self._locations = list(split.locations())
+        self._pos = 0
+        return self
+
+    def next(self) -> List[List[Writable]]:
+        path = self._locations[self._pos]
+        self._pos += 1
+        frames, uint8_scaled = self._frames_for(path)
+        steps: List[List[Writable]] = []
+        for hwc in frames:
+            chw = np.transpose(hwc, (2, 0, 1))
+            if self.normalize and uint8_scaled:
+                chw = chw / 255.0   # float stacks are already in the
+                                    # caller's scale — leave them alone
+            steps.append([NDArrayWritable(chw.astype(np.float32))])
+        return steps
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._locations)
+
+    def reset(self):
+        self._pos = 0

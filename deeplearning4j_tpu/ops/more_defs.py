@@ -1,0 +1,241 @@
+"""Op-surface widening, round 3: the remaining libnd4j declarable families
+(SURVEY.md §2.1) absent after extra_defs — SRU recurrences
+(generic/recurrent/sru.cpp), roll/unique/listdiff/searchsorted parity ops
+(generic/parity_ops), percentile/median reductions, reverse-broadcast
+arithmetic (nd4j's rsub/rdiv op pair), threshold compression as first-class
+ops (generic/compression/threshold.cpp), morphological dilation2d and
+max-pool-with-argmax (generic/nn/), and random crop.
+
+Dynamic-output-shape ops (unique, uniqueWithCounts, listDiff) are EAGER-ONLY
+— the reference computes them host-side for the same reason; under jit they
+raise jax's ConcretizationTypeError by design.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import op
+
+# ---------------------------------------------------------------- rnn: SRU
+# Simple Recurrent Unit (ref: libnd4j sru/sruCell/sru_bi; Lei et al. 2018).
+# The recurrence is elementwise — lax.scan keeps it compiler-friendly and the
+# heavy (3H x H) input projection stays a single batched MXU matmul outside
+# the scan, which is exactly why SRU exists.
+
+
+@op("sruCell", "rnn")
+def sru_cell(x_proj, c_prev, w_f, b_f, w_r, b_r):
+    """One step. x_proj: (B, 3H) precomputed x@W; returns (h, c)."""
+    xt, f_in, r_in = jnp.split(x_proj, 3, axis=-1)
+    f = jax.nn.sigmoid(f_in * w_f + b_f)
+    r = jax.nn.sigmoid(r_in * w_r + b_r)
+    c = f * c_prev + (1.0 - f) * xt
+    h = r * jnp.tanh(c) + (1.0 - r) * xt
+    return h, c
+
+
+@op("sru", "rnn")
+def sru(x, w, w_f, b_f, w_r, b_r, c0=None, reverse=False):
+    """Full-sequence SRU. x: (B, T, H); w: (H, 3H); returns (h (B,T,H), cT)."""
+    B, T, H = x.shape
+    proj = x @ w                                   # one batched MXU matmul
+    if c0 is None:
+        c0 = jnp.zeros((B, H), x.dtype)
+
+    def step(c, xp):
+        h, c = sru_cell(xp, c, w_f, b_f, w_r, b_r)
+        return c, h
+
+    xs = jnp.swapaxes(proj, 0, 1)                  # (T, B, 3H)
+    if reverse:
+        xs = xs[::-1]
+    cT, hs = lax.scan(step, c0, xs)
+    if reverse:
+        hs = hs[::-1]
+    return jnp.swapaxes(hs, 0, 1), cT
+
+
+@op("sruBi", "rnn")
+def sru_bi(x, w_fwd, w_bwd, params_fwd, params_bwd):
+    """Bidirectional SRU (ref: sru_bi): concat of fwd and reversed-bwd runs.
+    params_*: tuple (w_f, b_f, w_r, b_r)."""
+    h_f, _ = sru(x, w_fwd, *params_fwd)
+    h_b, _ = sru(x, w_bwd, *params_bwd, reverse=True)
+    return jnp.concatenate([h_f, h_b], axis=-1)
+
+
+# ------------------------------------------------------- parity: roll/unique
+
+
+op("roll", "shape")(lambda x, shift, axis=None: jnp.roll(x, shift, axis))
+
+
+@op("unique", "shape")
+def unique(x):
+    """Sorted unique values. EAGER-ONLY (data-dependent output shape)."""
+    return jnp.unique(jnp.ravel(x))
+
+
+@op("uniqueWithCounts", "shape")
+def unique_with_counts(x):
+    """(values, counts). EAGER-ONLY."""
+    return jnp.unique(jnp.ravel(x), return_counts=True)
+
+
+@op("listDiff", "shape")
+def list_diff(x, y):
+    """Values (and their indices in x) present in x but not y (ref:
+    listdiff / tf.setdiff1d). EAGER-ONLY."""
+    x = jnp.ravel(x)
+    mask = ~jnp.isin(x, jnp.ravel(y))
+    idx = jnp.nonzero(mask)[0]
+    return x[idx], idx
+
+
+op("searchsorted", "shape")(
+    lambda sorted_seq, values, side="left": jnp.searchsorted(
+        sorted_seq, values, side=side))
+
+
+# ------------------------------------------------------------- reductions
+
+
+op("percentile", "reduce")(
+    lambda x, q, axis=None, keepdims=False: jnp.percentile(
+        x, q, axis=axis, keepdims=keepdims))
+op("median", "reduce")(
+    lambda x, axis=None, keepdims=False: jnp.median(x, axis=axis,
+                                                    keepdims=keepdims))
+
+
+# ------------------------------------------ math: reverse-broadcast & misc
+# nd4j exposes reverse-subtraction/division as first-class ops because its
+# in-place op model cannot flip operands (INDArray.rsub/rdiv); kept for
+# API parity even though jnp operands flip for free.
+
+op("rsub", "math")(lambda x, y: y - x)
+op("rdiv", "math")(lambda x, y: y / x)
+op("mod", "math")(lambda x, y: jnp.mod(x, y))
+op("hypot", "math")(lambda x, y: jnp.hypot(x, y))
+op("xlogy", "math")(lambda x, y: jax.scipy.special.xlogy(x, y))
+op("erfinv", "math")(lambda x: jax.scipy.special.erfinv(x))
+op("sinc", "math")(lambda x: jnp.sinc(x))
+
+
+@op("isMax", "math")
+def is_max(x, axis=None):
+    """Boolean mask of the max position(s) (ref: transforms/ismax — used by
+    the reference's pooling backprop; here a plain comparison XLA fuses)."""
+    if axis is None:
+        return x == jnp.max(x)
+    return x == jnp.max(x, axis=axis, keepdims=True)
+
+
+# ------------------------------------------------------- compression ops
+# First-class registry surface over the gradient-sharing primitives (ref:
+# libnd4j generic/compression/threshold.cpp encode/decode custom ops).
+
+
+@op("thresholdEncode", "math")
+def threshold_encode_op(grad, threshold):
+    from deeplearning4j_tpu.parallel.gradient_sharing import threshold_encode
+    return threshold_encode(grad, threshold)
+
+
+@op("thresholdDecode", "math")
+def threshold_decode_op(encoded):
+    from deeplearning4j_tpu.parallel.gradient_sharing import threshold_decode
+    return threshold_decode(encoded)
+
+
+# ----------------------------------------------------------------- cnn/nn
+
+
+@op("dilation2d", "cnn")
+def dilation2d(x, kernel, strides=(1, 1), rates=(1, 1), padding="SAME"):
+    """Grayscale morphological dilation (ref: nn/dilation2d; NCHW in/out,
+    kernel (C, kH, kW)). max-plus correlation via reduce_window over patches."""
+    C, kH, kW = kernel.shape
+    B = x.shape[0]
+    # extract patches: (B, C*kH*kW, OH, OW) with the kernel window layout
+    patches = lax.conv_general_dilated_patches(
+        x, (kH, kW), strides, padding, rhs_dilation=rates,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    OH, OW = patches.shape[-2:]
+    patches = patches.reshape(B, C, kH * kW, OH, OW)
+    return jnp.max(patches + kernel.reshape(1, C, kH * kW, 1, 1), axis=2)
+
+
+@op("maxPoolWithArgmax", "cnn")
+def max_pool_with_argmax(x, kernel=(2, 2), strides=None, padding="VALID"):
+    """(pooled, flat argmax indices) (ref: nn/max_pool_with_argmax; NCHW).
+    Indices are flattened per-image (C*H*W space), matching TF semantics.
+    Index math is pure int32 arithmetic on the window argmax — never routed
+    through float patches, so indices are exact at any tensor size."""
+    kH, kW = kernel
+    strides = strides or kernel
+    sH, sW = strides
+    B, C, H, W = x.shape
+    if padding == "SAME":
+        OH, OW = -(-H // sH), -(-W // sW)
+        pad_h = max((OH - 1) * sH + kH - H, 0)
+        pad_w = max((OW - 1) * sW + kW - W, 0)
+        pt, pl = pad_h // 2, pad_w // 2
+        # pad with the dtype's finite min (NOT -inf: patch extraction is a
+        # convolution, and -inf * 0 = NaN): a padding cell can never win
+        # the argmax, so derived coordinates always land in-bounds
+        x = jnp.pad(x, ((0, 0), (0, 0), (pt, pad_h - pt), (pl, pad_w - pl)),
+                    constant_values=jnp.finfo(x.dtype).min)
+    elif padding == "VALID":
+        pt = pl = 0
+    else:
+        raise ValueError(f"padding must be SAME or VALID, got {padding!r}")
+    patches = lax.conv_general_dilated_patches(
+        x, kernel, strides, "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    OH, OW = patches.shape[-2:]
+    patches = patches.reshape(B, C, kH * kW, OH, OW)
+    k = jnp.argmax(patches, axis=2)
+    pooled = jnp.take_along_axis(patches, k[:, :, None], axis=2)[:, :, 0]
+    # window-relative argmax → absolute (row, col) → flat C*H*W index
+    oh = jnp.arange(OH, dtype=jnp.int32)[:, None]
+    ow = jnp.arange(OW, dtype=jnp.int32)[None, :]
+    row = oh * sH + (k // kW).astype(jnp.int32) - pt
+    col = ow * sW + (k % kW).astype(jnp.int32) - pl
+    c_off = (jnp.arange(C, dtype=jnp.int32) * H * W)[None, :, None, None]
+    argmax = c_off + row * W + col
+    return pooled, argmax
+
+
+# ------------------------------------------------------------------ image
+
+
+@op("randomCrop", "image")
+def random_crop(key, x, size):
+    """Random spatial crop (ref: image/random_crop; NCHW or HWC — crops the
+    trailing len(size) dims)."""
+    start_max = jnp.asarray(x.shape[-len(size):]) - jnp.asarray(size)
+    starts = jax.random.randint(key, (len(size),), 0, start_max + 1)
+    full_starts = [0] * (x.ndim - len(size)) + list(starts)
+    full_sizes = list(x.shape[: x.ndim - len(size)]) + list(size)
+    return lax.dynamic_slice(x, jnp.asarray(full_starts), full_sizes)
+
+
+@op("imageResize", "image")
+def image_resize(x, size, method="bilinear"):
+    """Unified resize dispatcher (ref: image/image_resize with its method
+    enum; NCHW). Methods: nearest | bilinear | bicubic | lanczos3 | area."""
+    H, W = size
+    if method == "area":
+        # jax.image has no area kernel; average-pool when downscaling by
+        # integer factors, else fall back to bilinear (reference behavior
+        # for non-integer area scaling is also an approximation)
+        sh, sw = x.shape[-2] // H, x.shape[-1] // W
+        if sh >= 1 and sw >= 1 and x.shape[-2] == H * sh and x.shape[-1] == W * sw:
+            return x.reshape(*x.shape[:-2], H, sh, W, sw).mean(axis=(-3, -1))
+        method = "bilinear"
+    jm = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+          "lanczos3": "lanczos3"}[method]
+    return jax.image.resize(x, (*x.shape[:-2], H, W), method=jm)

@@ -20,6 +20,7 @@ import optax
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.rl.env import MDP
 from deeplearning4j_tpu.rl.returns import nstep_returns
+from deeplearning4j_tpu.rl.vector_env import collect_rollout
 
 
 @dataclass
@@ -67,6 +68,7 @@ class A2CDiscreteDense:
         self._opt = self._tx.init({"pi": self._pi, "v": self._v})
         self._jit_update = jax.jit(self._update_fn)
         self._jit_probs = jax.jit(self._probs_fn)
+        self._jit_value = jax.jit(self._value_fn)
         self.rng = np.random.RandomState(config.seed)
         self.episode_rewards: List[float] = []
         self._steps = 0
@@ -130,7 +132,7 @@ class A2CDiscreteDense:
                 if done:
                     boot = 0.0
                 else:
-                    boot = float(np.asarray(self._value_fn(
+                    boot = float(np.asarray(self._jit_value(
                         self._v, jnp.asarray(obs[None])))[0])
                 R = boot
                 returns = np.zeros(len(buf_rew), np.float32)
@@ -159,38 +161,25 @@ class A2CDiscreteDense:
         cfg = self.config
         N, S = self.venv.num_envs, cfg.nStep
         obs = self.venv.reset()
+
+        def select_actions(o):
+            probs = np.asarray(self._jit_probs(self._pi, jnp.asarray(o)))
+            probs = probs / probs.sum(-1, keepdims=True)
+            # per-env categorical sample via inverse-CDF (one rand per env)
+            cdf = probs.cumsum(-1)
+            u = self.rng.rand(N, 1)
+            return (u > cdf[:, :-1]).sum(-1)
+
         while self._steps < cfg.maxStep:
-            ro = np.empty((S, N, self.venv.obs_size), np.float32)
-            ra = np.empty((S, N), np.int64)
-            rr = np.empty((S, N), np.float32)
-            rd = np.empty((S, N), bool)
-            # truncated streams were auto-reset: break the return chain at t
-            # and bootstrap from the episode's final_obs, not the next
-            # episode's rewards
-            rtrunc = np.zeros((S, N), bool)
-            tobs = np.zeros((S, N, self.venv.obs_size), np.float32)
-            for t in range(S):
-                probs = np.asarray(self._jit_probs(self._pi, jnp.asarray(obs)))
-                probs = probs / probs.sum(-1, keepdims=True)
-                # per-env categorical sample via inverse-CDF (one rand per env)
-                cdf = probs.cumsum(-1)
-                u = self.rng.rand(N, 1)
-                actions = (u > cdf[:, :-1]).sum(-1)
-                ro[t], ra[t] = obs, actions
-                obs, rr[t], rd[t], infos = self.venv.step(
-                    actions, max_episode_steps=cfg.maxEpochStep)
-                self._steps += N
-                for i, info in enumerate(infos):
-                    if "episode_reward" in info:
-                        self.episode_rewards.append(info["episode_reward"])
-                    if info.get("truncated"):
-                        rtrunc[t, i] = True
-                        tobs[t, i] = info["final_obs"]
+            obs, ro, ra, rr, rd, rtrunc, tobs = collect_rollout(
+                self.venv, obs, select_actions, S, cfg.maxEpochStep,
+                self.episode_rewards)
+            self._steps += S * N
             # bootstrap: V(s_T) at the rollout tail, 0 at terminals,
             # V(final_obs) at truncation points
-            boot = np.asarray(self._value_fn(self._v, jnp.asarray(obs)))
+            boot = np.asarray(self._jit_value(self._v, jnp.asarray(obs)))
             if rtrunc.any():
-                vtrunc = np.asarray(self._value_fn(
+                vtrunc = np.asarray(self._jit_value(
                     self._v, jnp.asarray(tobs.reshape(S * N, -1)))).reshape(S, N)
             else:  # no truncation this rollout — skip the masked-out eval
                 vtrunc = np.zeros((S, N), np.float32)

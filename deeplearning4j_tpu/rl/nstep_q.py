@@ -25,7 +25,7 @@ import optax
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.rl.env import MDP
 from deeplearning4j_tpu.rl.returns import nstep_returns
-from deeplearning4j_tpu.rl.vector_env import VectorizedMDP
+from deeplearning4j_tpu.rl.vector_env import VectorizedMDP, collect_rollout
 
 
 @dataclass
@@ -114,28 +114,10 @@ class AsyncNStepQLearningDiscreteDense:
         obs = self.venv.reset()
         last_sync = 0
         while self._steps < cfg.maxStep:
-            # ---- rollout: S lockstep vector steps
-            ro = np.empty((S, N, self.venv.obs_size), np.float32)
-            ra = np.empty((S, N), np.int64)
-            rr = np.empty((S, N), np.float32)
-            rd = np.empty((S, N), bool)
-            # truncation breaks the return chain without a terminal: the
-            # stream was auto-reset, so step t bootstraps from the episode's
-            # final_obs instead of chaining into the NEXT episode's rewards
-            rtrunc = np.zeros((S, N), bool)
-            tobs = np.zeros((S, N, self.venv.obs_size), np.float32)
-            for t in range(S):
-                actions = self._select_actions(obs)
-                ro[t], ra[t] = obs, actions
-                obs, rr[t], rd[t], infos = self.venv.step(
-                    actions, max_episode_steps=cfg.maxEpochStep)
-                self._steps += N
-                for i, info in enumerate(infos):
-                    if "episode_reward" in info:
-                        self.episode_rewards.append(info["episode_reward"])
-                    if info.get("truncated"):
-                        rtrunc[t, i] = True
-                        tobs[t, i] = info["final_obs"]
+            obs, ro, ra, rr, rd, rtrunc, tobs = collect_rollout(
+                self.venv, obs, self._select_actions, S, cfg.maxEpochStep,
+                self.episode_rewards)
+            self._steps += S * N
             # ---- n-step bootstrapped returns per env (one batched target
             # eval for the rollout tail + every truncation point)
             boot = np.asarray(self._jit_q(self._target, jnp.asarray(obs))).max(-1)

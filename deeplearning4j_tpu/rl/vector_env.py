@@ -76,3 +76,36 @@ class VectorizedMDP:
     def close(self):
         for e in self.envs:
             e.close()
+
+
+def collect_rollout(venv: VectorizedMDP, obs: np.ndarray, select_actions,
+                    n_steps: int, max_episode_steps: int,
+                    episode_rewards: list):
+    """Run ``n_steps`` lockstep vector steps (shared by the n-step Q and
+    A2C/A3C learners so their terminal/truncation bookkeeping cannot drift).
+
+    ``select_actions(obs) -> (N,) actions``. Completed-episode rewards are
+    appended to ``episode_rewards``. Returns
+    ``(obs, ro, ra, rr, rd, rtrunc, tobs)`` where ``rtrunc``/``tobs`` mark
+    truncated streams and their pre-reset final observations (see
+    returns.nstep_returns for why the chain must break there).
+    """
+    S, N = n_steps, venv.num_envs
+    ro = np.empty((S, N, venv.obs_size), np.float32)
+    ra = np.empty((S, N), np.int64)
+    rr = np.empty((S, N), np.float32)
+    rd = np.empty((S, N), bool)
+    rtrunc = np.zeros((S, N), bool)
+    tobs = np.zeros((S, N, venv.obs_size), np.float32)
+    for t in range(S):
+        actions = select_actions(obs)
+        ro[t], ra[t] = obs, actions
+        obs, rr[t], rd[t], infos = venv.step(
+            actions, max_episode_steps=max_episode_steps)
+        for i, info in enumerate(infos):
+            if "episode_reward" in info:
+                episode_rewards.append(info["episode_reward"])
+            if info.get("truncated"):
+                rtrunc[t, i] = True
+                tobs[t, i] = info["final_obs"]
+    return obs, ro, ra, rr, rd, rtrunc, tobs

@@ -1,0 +1,90 @@
+"""Codec (video-as-frame-sequence) reader tests (ref: datavec-data-codec
+CodecReaderTest — frame count, START_FRAME/TOTAL_FRAMES windowing)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec import (
+    CodecRecordReader, CollectionInputSplit, NDArrayWritable)
+
+
+def _gif(path, n_frames=6, size=(12, 10), seed=0):
+    from PIL import Image
+    rng = np.random.RandomState(seed)
+    frames = [Image.fromarray(rng.randint(0, 255, (size[0], size[1], 3),
+                                          dtype=np.uint8))
+              for _ in range(n_frames)]
+    frames[0].save(path, save_all=True, append_images=frames[1:],
+                   duration=50, loop=0)
+    return path
+
+
+class TestCodecRecordReader:
+    def test_gif_sequence(self, tmp_path):
+        p = _gif(str(tmp_path / "clip.gif"))
+        reader = CodecRecordReader()
+        reader.initialize(CollectionInputSplit([p]))
+        assert reader.hasNext()
+        seq = reader.next()
+        assert len(seq) == 6
+        frame = seq[0][0]
+        assert isinstance(frame, NDArrayWritable)
+        assert frame.value.shape == (3, 12, 10)
+        assert frame.value.dtype == np.float32
+        assert 0.0 <= frame.value.min() and frame.value.max() <= 1.0
+        assert not reader.hasNext()
+        reader.reset()
+        assert reader.hasNext()
+
+    def test_frame_windowing(self, tmp_path):
+        p = _gif(str(tmp_path / "clip.gif"), n_frames=10)
+        reader = CodecRecordReader(startFrame=2, numFrames=3, frameStep=2)
+        reader.initialize(CollectionInputSplit([p]))
+        seq = reader.next()
+        assert len(seq) == 3  # frames 2, 4, 6
+
+    def test_resize(self, tmp_path):
+        p = _gif(str(tmp_path / "clip.gif"), size=(20, 16))
+        reader = CodecRecordReader(size=(8, 6))
+        reader.initialize(CollectionInputSplit([p]))
+        seq = reader.next()
+        assert seq[0][0].value.shape == (3, 8, 6)
+
+    def test_npy_stack(self, tmp_path):
+        p = str(tmp_path / "vid.npy")
+        np.save(p, np.random.RandomState(1).randint(
+            0, 255, (5, 9, 7, 3), dtype=np.uint8))
+        reader = CodecRecordReader(normalize=False)
+        reader.initialize(CollectionInputSplit([p]))
+        seq = reader.next()
+        assert len(seq) == 5
+        assert seq[0][0].value.shape == (3, 9, 7)
+        assert seq[0][0].value.max() > 1.0  # un-normalized
+
+    def test_npy_grayscale_gets_channel(self, tmp_path):
+        p = str(tmp_path / "vid.npy")
+        np.save(p, np.zeros((4, 6, 5), np.uint8))
+        reader = CodecRecordReader()
+        reader.initialize(CollectionInputSplit([p]))
+        seq = reader.next()
+        assert seq[0][0].value.shape == (1, 6, 5)
+
+    def test_unsupported_extension_raises(self, tmp_path):
+        p = str(tmp_path / "clip.mp4")
+        open(p, "wb").close()
+        reader = CodecRecordReader()
+        reader.initialize(CollectionInputSplit([p]))
+        with pytest.raises(ValueError, match="unsupported container"):
+            reader.next()
+
+    def test_float_stack_survives_resize_untouched(self, tmp_path):
+        """Float-valued stacks must not roundtrip through uint8 (regression:
+        [0,1] floats came back all-zero) nor be re-divided by 255."""
+        p = str(tmp_path / "vid.npy")
+        data = np.random.RandomState(2).rand(3, 16, 16, 3).astype(np.float32)
+        np.save(p, data)
+        reader = CodecRecordReader(size=(8, 8))  # normalize=True default
+        reader.initialize(CollectionInputSplit([p]))
+        seq = reader.next()
+        vals = np.stack([s[0].value for s in seq])
+        assert vals.max() > 0.3            # not crushed to zero
+        assert 0.2 < vals.mean() < 0.8     # still in the original [0,1] scale
