@@ -186,8 +186,10 @@ def max_pool_with_argmax(x, kernel=(2, 2), strides=None, padding="VALID"):
         # pad with the dtype's finite min (NOT -inf: patch extraction is a
         # convolution, and -inf * 0 = NaN): a padding cell can never win
         # the argmax, so derived coordinates always land in-bounds
+        lowest = (jnp.iinfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.integer)
+                  else jnp.finfo(x.dtype).min)
         x = jnp.pad(x, ((0, 0), (0, 0), (pt, pad_h - pt), (pl, pad_w - pl)),
-                    constant_values=jnp.finfo(x.dtype).min)
+                    constant_values=lowest)
     elif padding == "VALID":
         pt = pl = 0
     else:

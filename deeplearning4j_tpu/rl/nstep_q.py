@@ -68,7 +68,8 @@ class AsyncNStepQLearningDiscreteDense:
         self._jit_update = jax.jit(self._update_fn)
         self.rng = np.random.RandomState(config.seed)
         self.episode_rewards: List[float] = []
-        self._steps = 0  # total env steps (all envs)
+        self._steps = 0      # total env steps (all envs)
+        self._eps_steps = 0  # epsilon-annealing counter (advances per selection)
 
     # ---------------------------------------------------------------- pure
     def _q_fn(self, params, obs):
@@ -97,7 +98,10 @@ class AsyncNStepQLearningDiscreteDense:
 
     # ------------------------------------------------------------ training
     def _epsilon(self) -> float:
-        frac = min(self._steps / max(self.config.epsilonNbStep, 1), 1.0)
+        # annealed on its own per-selection counter so the schedule advances
+        # every vector step, not once per rollout (self._steps updates only
+        # after collect_rollout returns)
+        frac = min(self._eps_steps / max(self.config.epsilonNbStep, 1), 1.0)
         return 1.0 + (self.config.minEpsilon - 1.0) * frac
 
     def _select_actions(self, obs: np.ndarray) -> np.ndarray:
@@ -105,6 +109,7 @@ class AsyncNStepQLearningDiscreteDense:
         q = np.asarray(self._jit_q(self._params, jnp.asarray(obs)))
         greedy = q.argmax(-1)
         explore = self.rng.rand(len(obs)) < self._epsilon()
+        self._eps_steps += len(obs)
         randoms = self.rng.randint(self.venv.n_actions, size=len(obs))
         return np.where(explore, randoms, greedy).astype(np.int64)
 

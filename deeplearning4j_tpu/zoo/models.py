@@ -692,15 +692,17 @@ class InceptionResNetV1(ZooModel):
 
 
 class FaceNetNN4Small2(ZooModel):
-    """(ref: zoo.model.FaceNetNN4Small2Deep — inception-style face-embedding
-    net trained with CENTER LOSS on identities; embeddings read from the
-    L2-normalized bottleneck).
+    """(ref: zoo.model.FaceNetNN4Small2Deep — OpenFace's nn4.small2
+    inception stack trained with CENTER LOSS on identities; embeddings read
+    from the L2-normalized 128-d bottleneck).
 
-    Deviation from the reference: the backbone reuses this zoo's
-    scaled-residual inception blocks (InceptionResNetV1 topology at reduced
-    widths) instead of replicating nn4.small2's exact hand-mixed inception
-    stack — the capability contract (identity classification via center
-    loss over an L2 embedding) is identical."""
+    Topology follows the public nn4.small2 definition exactly: conv1 7x7/2
+    -> maxpool -> LRN -> conv2 1x1 -> conv3 3x3 -> LRN -> maxpool ->
+    inception 3a/3b/3c -> 4a/4e -> 5a/5b (mixed 1x1 / reduced-3x3 /
+    reduced-5x5 branches with MAX or L2 (p-norm, p=2) pool projections;
+    3c/4e are the stride-2 grid reductions with pass-through pools) ->
+    global avgpool -> 128-d linear -> L2 normalize. Every conv carries
+    batch-norm + ReLU, as in the reference."""
 
     def __init__(self, numClasses: int = 100, seed: int = 123,
                  inputShape: Tuple[int, int, int] = (3, 96, 96),
@@ -712,41 +714,69 @@ class FaceNetNN4Small2(ZooModel):
         self.lambda_ = lambda_
 
     def conf(self):
-        from deeplearning4j_tpu.nn.conf.graph import L2NormalizeVertex, ScaleVertex
-        from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
-                                                       CenterLossOutputLayer,
-                                                       GlobalPoolingLayer)
+        from deeplearning4j_tpu.nn.conf.graph import L2NormalizeVertex
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ActivationLayer, BatchNormalization, CenterLossOutputLayer,
+            GlobalPoolingLayer, LocalResponseNormalization)
         c, h, w = self.inputShape
         g = (NeuralNetConfiguration.Builder().seed(self.seed)
              .updater(Adam(1e-3)).weightInit("RELU").graphBuilder()
              .addInputs("input")
              .setInputTypes(InputType.convolutional(h, w, c)))
 
-        def conv(name, frm, n_out, k, stride=1, act="RELU"):
-            g.addLayer(name, ConvolutionLayer(nOut=n_out,
-                                              kernelSize=(k, k),
-                                              stride=(stride, stride),
-                                              convolutionMode="Same",
-                                              activation=act), frm)
+        def conv_bn(name, frm, n_out, k, stride=1):
+            """conv -> BN -> ReLU (nn4 uses SpatialBatchNormalization)."""
+            g.addLayer(f"{name}_c", ConvolutionLayer(
+                nOut=n_out, kernelSize=(k, k), stride=(stride, stride),
+                convolutionMode="Same", activation="IDENTITY"), frm)
+            g.addLayer(f"{name}_bn", BatchNormalization(), f"{name}_c")
+            g.addLayer(name, ActivationLayer(activation="RELU"), f"{name}_bn")
             return name
 
-        prev = conv("c1", "input", 32, 3, 2)
-        prev = conv("c2", prev, 64, 3)
-        g.addLayer("p1", SubsamplingLayer(poolingType="MAX", kernelSize=(2, 2),
-                                          stride=(2, 2)), prev)
-        prev = conv("c3", "p1", 96, 3)
-        for i in range(3):
-            name = f"blk{i}"
-            b0 = conv(f"{name}_b0", prev, 24, 1)
-            b1 = conv(f"{name}_b1b", conv(f"{name}_b1a", prev, 24, 1), 24, 3)
-            g.addVertex(f"{name}_cat", MergeVertex(), b0, b1)
-            conv(f"{name}_up", f"{name}_cat", 96, 1, act="IDENTITY")
-            g.addVertex(f"{name}_scale", ScaleVertex(scaleFactor=0.2), f"{name}_up")
-            g.addVertex(f"{name}_add", ElementWiseVertex(op="Add"), prev,
-                        f"{name}_scale")
-            g.addLayer(f"{name}_relu", ActivationLayer(activation="RELU"),
-                       f"{name}_add")
-            prev = f"{name}_relu"
+        def inception(name, frm, n1, r3, n3, r5, n5, pool_kind, pool_proj,
+                      stride=1):
+            """nn4 inception module. n1=0 drops the 1x1 branch (3c/4e);
+            r5=0 drops the 5x5 branch (5a/5b); pool_proj=0 passes the pool
+            through unprojected (the stride-2 modules)."""
+            branches = []
+            if n1:
+                branches.append(conv_bn(f"{name}_1x1", frm, n1, 1))
+            branches.append(conv_bn(
+                f"{name}_3x3", conv_bn(f"{name}_3x3r", frm, r3, 1), n3, 3,
+                stride))
+            if r5:
+                branches.append(conv_bn(
+                    f"{name}_5x5", conv_bn(f"{name}_5x5r", frm, r5, 1), n5, 5,
+                    stride))
+            pool = f"{name}_pool"
+            g.addLayer(pool, SubsamplingLayer(
+                poolingType="MAX" if pool_kind == "max" else "PNORM",
+                pnorm=2, kernelSize=(3, 3), stride=(stride, stride),
+                convolutionMode="Same"), frm)
+            branches.append(conv_bn(f"{name}_poolproj", pool, pool_proj, 1)
+                            if pool_proj else pool)
+            g.addVertex(name, MergeVertex(), *branches)
+            return name
+
+        prev = conv_bn("conv1", "input", 64, 7, 2)
+        g.addLayer("pool1", SubsamplingLayer(poolingType="MAX", kernelSize=(3, 3),
+                                             stride=(2, 2),
+                                             convolutionMode="Same"), prev)
+        g.addLayer("lrn1", LocalResponseNormalization(), "pool1")
+        prev = conv_bn("conv2", "lrn1", 64, 1)
+        prev = conv_bn("conv3", prev, 192, 3)
+        g.addLayer("lrn2", LocalResponseNormalization(), prev)
+        g.addLayer("pool2", SubsamplingLayer(poolingType="MAX", kernelSize=(3, 3),
+                                             stride=(2, 2),
+                                             convolutionMode="Same"), "lrn2")
+        # (n1, r3, n3, r5, n5, pool, proj, stride) per the nn4.small2 table
+        prev = inception("inc3a", "pool2", 64, 96, 128, 16, 32, "max", 32)
+        prev = inception("inc3b", prev, 64, 96, 128, 32, 64, "l2", 64)
+        prev = inception("inc3c", prev, 0, 128, 256, 32, 64, "max", 0, stride=2)
+        prev = inception("inc4a", prev, 256, 96, 192, 32, 64, "l2", 128)
+        prev = inception("inc4e", prev, 0, 160, 256, 64, 128, "max", 0, stride=2)
+        prev = inception("inc5a", prev, 256, 96, 384, 0, 0, "l2", 96)
+        prev = inception("inc5b", prev, 256, 96, 384, 0, 0, "max", 96)
         g.addLayer("avgpool", GlobalPoolingLayer(poolingType="AVG"), prev)
         g.addLayer("bottleneck", DenseLayer(nOut=self.embeddingSize,
                                             activation="IDENTITY"), "avgpool")
@@ -763,8 +793,10 @@ class NASNetMobile(ZooModel):
     separable-conv/pool/identity pairs on (h, h_prev) with 5 block outputs
     concatenated; reduction cells halve the spatial dims. Cell count and
     penultimate-filter width are configurable (reference mobile: 4 cells @
-    1056 penultimate). Factorized h_prev adjustment is a 1x1 conv (the
-    reference's adjust block)."""
+    1056 penultimate). After each reduction, h_prev stays at the old
+    resolution and the next cell's adjust block applies the reference's
+    FACTORIZED REDUCTION: two 1x1-stride-2 average-pool paths, the second
+    offset one pixel, concatenated and batch-normed."""
 
     def __init__(self, numClasses: int = 1000, seed: int = 123,
                  inputShape: Tuple[int, int, int] = (3, 224, 224),
@@ -776,13 +808,18 @@ class NASNetMobile(ZooModel):
         self.filters = filters
 
     def conf(self):
-        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+        from deeplearning4j_tpu.nn.conf.layers import (
+            BatchNormalization, Cropping2D, GlobalPoolingLayer,
+            ZeroPaddingLayer)
         c, h, w = self.inputShape
         g = (NeuralNetConfiguration.Builder().seed(self.seed)
              .updater(Adam(1e-3)).weightInit("RELU").graphBuilder()
              .addInputs("input")
              .setInputTypes(InputType.convolutional(h, w, c)))
         uid = [0]
+        # spatial-resolution level per tensor name (increments at each
+        # stride-2 reduction) — drives h_prev factorized reduction
+        res: dict = {}
 
         def sep(frm, n_out, k, stride=1):
             uid[0] += 1
@@ -792,13 +829,46 @@ class NASNetMobile(ZooModel):
                 convolutionMode="Same", activation="RELU"), frm)
             return name
 
-        def adjust(frm, n_out, stride=1):
-            """1x1 conv to match filters (+ stride for reduced h_prev)."""
+        def factorized_reduction(frm, n_out):
+            """Stride-2 downsample without information loss at the grid
+            boundary (ref: NASNet's FactorizedReduction / adjust_block):
+            two 1x1-stride-2 average-pool paths, the second offset by one
+            pixel, each 1x1-conv'd to n_out/2, concatenated, batch-normed."""
+            uid[0] += 1
+            base = f"fr{uid[0]}"
+            g.addLayer(f"{base}_p1", SubsamplingLayer(
+                poolingType="AVG", kernelSize=(1, 1), stride=(2, 2)), frm)
+            g.addLayer(f"{base}_c1", ConvolutionLayer(
+                nOut=n_out // 2, kernelSize=(1, 1), activation="IDENTITY"),
+                f"{base}_p1")
+            # offset path: shift the grid by (1,1) so the concat covers the
+            # pixels the first path's stride skipped
+            g.addLayer(f"{base}_pad", ZeroPaddingLayer(padding=(0, 1, 0, 1)), frm)
+            g.addLayer(f"{base}_crop", Cropping2D(cropping=(1, 0, 1, 0)),
+                       f"{base}_pad")
+            g.addLayer(f"{base}_p2", SubsamplingLayer(
+                poolingType="AVG", kernelSize=(1, 1), stride=(2, 2)),
+                f"{base}_crop")
+            g.addLayer(f"{base}_c2", ConvolutionLayer(
+                nOut=n_out - n_out // 2, kernelSize=(1, 1),
+                activation="IDENTITY"), f"{base}_p2")
+            g.addVertex(f"{base}_cat", MergeVertex(), f"{base}_c1", f"{base}_c2")
+            g.addLayer(base, BatchNormalization(), f"{base}_cat")
+            return base
+
+        def adjust(frm, n_out, target_res=None):
+            """Match h_prev to the cell's filter count — and, when it sits
+            one resolution level behind (the cell right after a reduction),
+            bring it down via factorized reduction (ref: adjust_block)."""
+            if target_res is not None and res.get(frm, target_res) < target_res:
+                name = factorized_reduction(frm, n_out)
+                res[name] = target_res
+                return name
             uid[0] += 1
             name = f"adj{uid[0]}"
             g.addLayer(name, ConvolutionLayer(
-                nOut=n_out, kernelSize=(1, 1), stride=(stride, stride),
-                activation="RELU"), frm)
+                nOut=n_out, kernelSize=(1, 1), activation="RELU"), frm)
+            res[name] = res.get(frm, 0)
             return name
 
         def pool(frm, kind, stride=1):
@@ -818,7 +888,7 @@ class NASNetMobile(ZooModel):
         def normal_cell(h_cur, h_prev, f):
             """NASNet-A normal cell: 5 combinations concat'd."""
             hc = adjust(h_cur, f)
-            hp = adjust(h_prev, f)
+            hp = adjust(h_prev, f, target_res=res.get(h_cur, 0))
             b1 = add(sep(hc, f, 3), hc)
             b2 = add(sep(hp, f, 3), sep(hc, f, 5))
             b3 = add(pool(hp, "AVG"), hp)
@@ -827,11 +897,12 @@ class NASNetMobile(ZooModel):
             uid[0] += 1
             name = f"ncell{uid[0]}"
             g.addVertex(name, MergeVertex(), b1, b2, b3, b4, b5)
+            res[name] = res.get(h_cur, 0)
             return name
 
         def reduction_cell(h_cur, h_prev, f):
             hc = adjust(h_cur, f)
-            hp = adjust(h_prev, f)
+            hp = adjust(h_prev, f, target_res=res.get(h_cur, 0))
             b1 = add(sep(hc, f, 5, 2), sep(hp, f, 7, 2))
             b2 = add(pool(hc, "MAX", 2), sep(hp, f, 7, 2))
             b3 = add(pool(hc, "AVG", 2), sep(hp, f, 5, 2))
@@ -840,23 +911,24 @@ class NASNetMobile(ZooModel):
             uid[0] += 1
             name = f"rcell{uid[0]}"
             g.addVertex(name, MergeVertex(), b2, b3, b4, b5)
+            res[name] = res.get(h_cur, 0) + 1
             return name
 
         g.addLayer("stem", ConvolutionLayer(nOut=self.stem_filters,
                                             kernelSize=(3, 3), stride=(2, 2),
                                             convolutionMode="Same",
                                             activation="RELU"), "input")
+        res["stem"] = 0
         h_prev, h_cur = "stem", "stem"
         f = self.filters
         for stage in range(3):
             if stage > 0:
-                nxt = reduction_cell(h_cur, h_prev, f)
-                # post-reduction, h_prev sits at the old resolution; the
-                # reference runs factorized reduction on it — collapsing
-                # both streams onto the reduced tensor is the simplified
-                # equivalent (adjust() re-projects them independently)
-                h_prev, h_cur = nxt, nxt
                 f *= 2
+                nxt = reduction_cell(h_cur, h_prev, f)
+                # the reference keeps h_prev at the OLD resolution here; the
+                # next cell's adjust() brings it down via factorized
+                # reduction (two offset stride-2 avg-pool paths, concat, BN)
+                h_prev, h_cur = h_cur, nxt
             for _ in range(self.cells_per_stage):
                 nxt = normal_cell(h_cur, h_prev, f)
                 h_prev, h_cur = h_cur, nxt

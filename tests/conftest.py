@@ -35,3 +35,15 @@ def _seeded():
 @pytest.fixture
 def rtol():
     return 1e-5
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Cap in-process compiled-executable accumulation. Running the whole
+    suite in one process leaves hundreds of XLA:CPU executables loaded, after
+    which the NEXT very large compile (InceptionResNetV1's fused fit step in
+    test_zoo) segfaults inside backend_compile — reproducibly in-suite,
+    never in isolation. Dropping compilation caches at module boundaries
+    keeps the live-executable population at per-module scale."""
+    yield
+    jax.clear_caches()

@@ -539,6 +539,11 @@ class TestRound3Ops:
         np.testing.assert_allclose(ops.math.rdiv(a, b).toNumpy(), b / a)
         np.testing.assert_allclose(
             ops.math.hypot(np.float32(3.0), np.float32(4.0)).toNumpy(), 5.0)
+        np.testing.assert_allclose(
+            ops.math.mod(np.float32(7.5), np.float32(2.0)).toNumpy(), 1.5)
+        np.testing.assert_allclose(
+            ops.math.sinc(np.array([0.0, 0.5], np.float32)).toNumpy(),
+            [1.0, 2.0 / np.pi], rtol=1e-6)
         np.testing.assert_allclose(ops.math.xlogy(np.float32(0.0), np.float32(0.0)).toNumpy(), 0.0)
         np.testing.assert_allclose(
             ops.math.erfinv(np.float32(0.5)).toNumpy(), 0.47693628, rtol=1e-5)
@@ -626,3 +631,12 @@ class TestArgmaxPoolIndices:
         pooled, argmax = ops.cnn.maxPoolWithArgmax(x, (2, 2), (2, 2), "SAME")
         assert np.asarray(pooled).min() == -1.0      # -inf padding never wins
         assert (np.asarray(argmax) >= 0).all() and (np.asarray(argmax) < 9).all()
+
+
+def test_argmax_pool_integer_input_same_padding():
+    """int inputs must work in SAME mode (iinfo padding, not finfo)."""
+    from deeplearning4j_tpu import ops
+    x = np.arange(16, dtype=np.int32).reshape(1, 1, 4, 4)
+    pooled, argmax = ops.cnn.maxPoolWithArgmax(x, (3, 3), (2, 2), "SAME")
+    assert np.asarray(pooled).max() == 15
+    assert (np.asarray(argmax) >= 0).all()

@@ -124,6 +124,28 @@ def test_inception_resnet_v1_embedding_and_fit():
     assert np.isfinite(net.score())
 
 
+def test_facenet_nn4_small2_topology():
+    """Structural signature of the exact nn4.small2 stack: all 7 inception
+    modules, L2 (PNORM) pool projections in 3b/4a/5a, stride-2 pass-through
+    pools in 3c/4e, and the LRN pair from the stem."""
+    from deeplearning4j_tpu.zoo import FaceNetNN4Small2
+    conf = FaceNetNN4Small2(numClasses=5).conf()
+    from deeplearning4j_tpu.nn.conf.layers import Layer
+    layers = {n.name: n.op for n in conf.nodes if isinstance(n.op, Layer)}
+    for mod in ("inc3a", "inc3b", "inc3c", "inc4a", "inc4e", "inc5a", "inc5b"):
+        assert f"{mod}_pool" in layers, mod
+    for l2mod in ("inc3b", "inc4a", "inc5a"):
+        assert layers[f"{l2mod}_pool"].poolingType == "PNORM"
+        assert f"{l2mod}_poolproj_c" in layers
+    for red in ("inc3c", "inc4e"):
+        assert layers[f"{red}_pool"].stride == (2, 2)
+        assert f"{red}_poolproj_c" not in layers      # pass-through pool
+        assert f"{red}_1x1_c" not in layers           # no 1x1 branch
+    assert "lrn1" in layers and "lrn2" in layers
+    # 5a/5b drop the 5x5 branch
+    assert "inc5a_5x5_c" not in layers and "inc5b_5x5_c" not in layers
+
+
 def test_facenet_center_loss_trains():
     from deeplearning4j_tpu.zoo import FaceNetNN4Small2
     net = FaceNetNN4Small2(numClasses=5, inputShape=(3, 64, 64)).init()
