@@ -42,6 +42,22 @@ def _pair(v):
     return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
 
 
+def _one(v):
+    """Keras scalar-or-singleton-list -> scalar (1D layer configs)."""
+    return v[0] if isinstance(v, (list, tuple)) else v
+
+
+def _flat3(p):
+    """Keras 3D padding/cropping spec -> flat (d0,d1,h0,h1,w0,w1)."""
+    if isinstance(p, int):
+        return (p,) * 6
+    out = []
+    for d in p:
+        a, b = (d, d) if isinstance(d, int) else (d[0], d[1])
+        out += [a, b]
+    return tuple(out)
+
+
 class _WeightStore:
     """Reads Keras-3 legacy h5 weight groups: model_weights/<layer>/**/<name>."""
 
@@ -110,6 +126,9 @@ class KerasModelImport:
 def _input_type_from_shape(shape) -> Optional[InputType]:
     """Keras batch_shape (None, H, W, C) / (None, T, F) / (None, F) -> InputType."""
     dims = [d for d in shape[1:]]
+    if len(dims) == 4:  # NDHWC -> 3D conv, channels-first internally
+        d, h, w, c = dims
+        return InputType.convolutional3D(d, h, w, c)
     if len(dims) == 3:
         h, w, c = dims
         return InputType.convolutional(h, w, c)
@@ -195,6 +214,82 @@ def _map_layer(cls: str, c: dict) -> Tuple[Optional[L.Layer], bool]:
         return L.Cropping2D(cropping=crop), False
     if cls == "UpSampling2D":
         return L.Upsampling2D(size=_pair(c.get("size", 2))), False
+    if cls == "Conv1D":
+        if c.get("padding") == "causal":
+            raise ValueError("Conv1D(padding='causal') import is not supported")
+        return L.Convolution1DLayer(
+            nOut=c["filters"], kernelSize=_one(c["kernel_size"]),
+            stride=_one(c.get("strides", 1)),
+            dilation=_one(c.get("dilation_rate", 1)),
+            convolutionMode=mode, activation=act,
+            hasBias=c.get("use_bias", True)), True
+    if cls == "Conv3D":
+        dil = c.get("dilation_rate", (1, 1, 1))
+        return L.Convolution3D(nOut=c["filters"],
+                               kernelSize=tuple(c["kernel_size"]),
+                               stride=tuple(c.get("strides", (1, 1, 1))),
+                               dilation=tuple(dil) if isinstance(dil, (list, tuple))
+                               else (dil,) * 3,
+                               convolutionMode=mode, activation=act,
+                               hasBias=c.get("use_bias", True)), True
+    if cls in ("MaxPooling1D", "AveragePooling1D"):
+        if same:
+            raise ValueError(f"{cls}(padding='same') import is not supported")
+        ps = _one(c.get("pool_size", 2))
+        return L.Subsampling1DLayer(
+            poolingType="MAX" if cls.startswith("Max") else "AVG",
+            kernelSize=ps, stride=_one(c.get("strides") or ps)), False
+    if cls in ("MaxPooling3D", "AveragePooling3D"):
+        ps = c.get("pool_size", (2, 2, 2))
+        return L.Subsampling3DLayer(
+            poolingType="MAX" if cls.startswith("Max") else "AVG",
+            kernelSize=tuple(ps), stride=tuple(c.get("strides") or ps),
+            convolutionMode=mode), False
+    if cls == "UpSampling1D":
+        return L.Upsampling1D(size=c.get("size", 2)), False
+    if cls == "UpSampling3D":
+        return L.Upsampling3D(size=tuple(c.get("size", (2, 2, 2)))), False
+    if cls == "ZeroPadding1D":
+        p = _pair(c.get("padding", 1))
+        return L.ZeroPadding1DLayer(padding=(p[0], p[1])), False
+    if cls == "Cropping1D":
+        p = _pair(c.get("cropping", 1))
+        return L.Cropping1D(cropping=(p[0], p[1])), False
+    if cls == "ZeroPadding3D":
+        p = c.get("padding", 1)
+        flat = _flat3(p)
+        return L.ZeroPadding3DLayer(padding=flat), False
+    if cls == "Cropping3D":
+        flat = _flat3(c.get("cropping", 1))
+        return L.Cropping3D(cropping=flat), False
+    if cls == "ELU":
+        return L.ActivationLayer(activation="ELU",
+                                 alpha=c.get("alpha", 1.0)), False
+    if cls == "PReLU":
+        # shared_axes are keras channels-LAST per-example axes; ours are
+        # channels-first. 2D conv: (H,W,C) 1,2,3 -> (C,H,W) 2,3,1.
+        # 3D conv: (D,H,W,C) 1,2,3,4 -> (C,D,H,W) 2,3,4,1. The maps agree
+        # on axes {1,2}; axis 3 is ambiguous without the input rank, so it
+        # is only accepted when axis 4 disambiguates to the 3D case.
+        axes = tuple(c.get("shared_axes") or ())
+        if 4 in axes:
+            amap = {1: 2, 2: 3, 3: 4, 4: 1}
+        elif 3 in axes:
+            raise ValueError(
+                "PReLU(shared_axes containing 3) is ambiguous between 2D "
+                "(channel axis) and 3D (width axis) inputs; re-export with "
+                "explicit per-element alpha or include axis 4")
+        else:
+            amap = {1: 2, 2: 3}
+        return L.PReLULayer(sharedAxes=tuple(amap[a] for a in axes)), True
+    if cls == "Masking":
+        import warnings
+        warnings.warn(
+            "Keras Masking imports as value-zeroing only: a downstream RNN "
+            "still steps through masked positions (state at T-1, not at the "
+            "last unmasked step). Pass explicit masks / use padded-value "
+            "zeroing semantics, or slice sequences before import.")
+        return L.MaskZeroLayer(maskValue=c.get("mask_value", 0.0)), False
     if cls == "Embedding":
         return L.EmbeddingSequenceLayer(nIn=c["input_dim"], nOut=c["output_dim"]), True
     if cls in ("LSTM", "GRU", "SimpleRNN"):
@@ -263,11 +358,29 @@ def _convert_weights(layer: L.Layer, kw: Dict[str, np.ndarray],
         if "bias" in kw:
             p["b"] = kw["bias"]
         return p
+    if isinstance(layer, L.Convolution1DLayer):
+        p = {"W": np.transpose(kw["kernel"], (2, 1, 0))}  # (K,I,O) -> (O,I,K)
+        if "bias" in kw:
+            p["b"] = kw["bias"]
+        return p
+    if isinstance(layer, L.Convolution3D):
+        # (kd,kh,kw,I,O) -> (O,I,kd,kh,kw)
+        p = {"W": np.transpose(kw["kernel"], (4, 3, 0, 1, 2))}
+        if "bias" in kw:
+            p["b"] = kw["bias"]
+        return p
     if isinstance(layer, L.ConvolutionLayer):
         p = {"W": t_conv(kw["kernel"])}
         if "bias" in kw:
             p["b"] = kw["bias"]
         return p
+    if isinstance(layer, L.PReLULayer):
+        a = kw["alpha"]
+        if a.ndim == 3:    # keras (H,W,C) -> ours (C,H,W)
+            a = np.transpose(a, (2, 0, 1))
+        elif a.ndim == 4:  # keras (D,H,W,C) -> ours (C,D,H,W)
+            a = np.transpose(a, (3, 0, 1, 2))
+        return {"alpha": a}
     if isinstance(layer, L.BatchNormalization):
         return {"gamma": kw.get("gamma", np.ones_like(kw["moving_mean"])),
                 "beta": kw.get("beta", np.zeros_like(kw["moving_mean"])),
@@ -303,6 +416,13 @@ def _convert_weights(layer: L.Layer, kw: Dict[str, np.ndarray],
             # permute rows: keras flatten order (H,W,C) -> ours (C,H,W)
             H, Wd, C = flatten_src.height, flatten_src.width, flatten_src.channels
             idx = np.arange(H * Wd * C).reshape(H, Wd, C).transpose(2, 0, 1).ravel()
+            W = W[idx]
+        elif flatten_src is not None and flatten_src.kind == "cnn3d":
+            # keras flatten order (D,H,W,C) -> ours (C,D,H,W)
+            D, H, Wd, C = (flatten_src.depth, flatten_src.height,
+                           flatten_src.width, flatten_src.channels)
+            idx = np.arange(D * H * Wd * C).reshape(D, H, Wd, C) \
+                .transpose(3, 0, 1, 2).ravel()
             W = W[idx]
         p = {"W": W}
         if "bias" in kw:
@@ -345,7 +465,7 @@ def _import_sequential(cfg: dict, store: _WeightStore) -> MultiLayerNetwork:
             continue
         layer, has_w = _map_layer(cls, c)
         if layer is None:  # Flatten: remember the conv shape for Dense row perm
-            if cur_type is not None and cur_type.kind == "cnn":
+            if cur_type is not None and cur_type.kind in ("cnn", "cnn3d"):
                 flatten_pending = cur_type
                 cur_type = InputType.feedForward(cur_type.flat_size())
             continue
@@ -437,7 +557,7 @@ def _import_functional(cfg: dict, store: _WeightStore) -> ComputationGraph:
             src = ins[0]
             t = type_at.get(src)
             name_alias[name] = src
-            if t is not None and t.kind == "cnn":
+            if t is not None and t.kind in ("cnn", "cnn3d"):
                 flatten_src[src] = t
                 type_at[src] = t  # unchanged; Dense consumer handles perm
             continue

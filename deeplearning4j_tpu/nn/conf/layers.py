@@ -447,7 +447,9 @@ class BatchNormalization(FeedForwardLayer):
 
     def set_n_in(self, input_type: InputType):
         if not self.nIn:
-            self.nIn = input_type.channels if input_type.kind == "cnn" else input_type.flat_size()
+            self.nIn = (input_type.channels
+                        if input_type.kind in ("cnn", "cnn3d")
+                        else input_type.flat_size())
         self.nOut = self.nIn
 
     def output_type(self, input_type: InputType) -> InputType:
@@ -470,7 +472,8 @@ class BatchNormalization(FeedForwardLayer):
         return ()
 
     def apply(self, params, x, *, training=False, rng=None, state=None):
-        axes = (0,) if x.ndim == 2 else (0, 2, 3)
+        # stats over every non-channel axis: (B,F) / NCHW / NCDHW
+        axes = (0,) if x.ndim == 2 else (0,) + tuple(range(2, x.ndim))
         shape = [1, -1] + [1] * (x.ndim - 2)
         if training:
             mean = jnp.mean(x, axis=axes)
