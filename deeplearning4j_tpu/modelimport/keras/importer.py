@@ -468,6 +468,12 @@ def _import_sequential(cfg: dict, store: _WeightStore) -> MultiLayerNetwork:
             if cur_type is not None and cur_type.kind in ("cnn", "cnn3d"):
                 flatten_pending = cur_type
                 cur_type = InputType.feedForward(cur_type.flat_size())
+            elif cur_type is not None and cur_type.kind == "rnn":
+                raise ValueError(
+                    "Flatten over a sequence (T, C) feature map is not "
+                    "supported by the importer — use GlobalAveragePooling1D/"
+                    "GlobalMaxPooling1D (imported as GlobalPoolingLayer) or "
+                    "an RNN with return_sequences=False instead")
             continue
         layer.name = c.get("name", cls.lower())
         b = b.layer(layer)
@@ -560,6 +566,12 @@ def _import_functional(cfg: dict, store: _WeightStore) -> ComputationGraph:
             if t is not None and t.kind in ("cnn", "cnn3d"):
                 flatten_src[src] = t
                 type_at[src] = t  # unchanged; Dense consumer handles perm
+            elif t is not None and t.kind == "rnn":
+                raise ValueError(
+                    "Flatten over a sequence (T, C) feature map is not "
+                    "supported by the importer — use GlobalAveragePooling1D/"
+                    "GlobalMaxPooling1D (imported as GlobalPoolingLayer) or "
+                    "an RNN with return_sequences=False instead")
             continue
         layer.name = name
         src = ins[0] if ins else None

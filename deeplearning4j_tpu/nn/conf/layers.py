@@ -472,9 +472,15 @@ class BatchNormalization(FeedForwardLayer):
         return ()
 
     def apply(self, params, x, *, training=False, rng=None, state=None):
-        # stats over every non-channel axis: (B,F) / NCHW / NCDHW
-        axes = (0,) if x.ndim == 2 else (0,) + tuple(range(2, x.ndim))
-        shape = [1, -1] + [1] * (x.ndim - 2)
+        # stats over every non-channel axis. Channel placement by rank:
+        # (B,F) -> F; (B,T,C) recurrent is channels-LAST in this framework
+        # (1D convs swap to NCW only internally); NCHW/NCDHW channels-first.
+        if x.ndim == 3:
+            axes = (0, 1)
+            shape = [1, 1, -1]
+        else:
+            axes = (0,) if x.ndim == 2 else (0,) + tuple(range(2, x.ndim))
+            shape = [1, -1] + [1] * (x.ndim - 2)
         if training:
             mean = jnp.mean(x, axis=axes)
             var = jnp.var(x, axis=axes)

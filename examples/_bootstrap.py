@@ -1,0 +1,22 @@
+"""Shared example bootstrap: repo root on sys.path, an 8-device virtual CPU
+mesh for the distributed demos, and a working ``JAX_PLATFORMS`` env var —
+this environment's sitecustomize pins the ``axon`` TPU platform via jax
+config, which silently overrides the env var, so ``JAX_PLATFORMS=cpu
+python examples/foo.py`` would otherwise still run on (and possibly wait
+for) the TPU tunnel.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# before any jax import: virtual host devices for the mesh examples (only
+# affects the CPU platform; harmless on real TPU backends)
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -1,0 +1,36 @@
+"""Examples must stay runnable (ref: dl4j-examples is part of the
+reference's north-star surface). Each runs as a real subprocess from the
+repo root, exactly as a user would. Quick ones always; the training-heavy
+ones under the ``slow`` marker."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+QUICK = ["csv_datavec_pipeline", "samediff_training", "checkpoint_resume",
+         "early_stopping", "live_dashboard", "word2vec_nearest",
+         "hyperparameter_search"]
+SLOW = ["mnist_lenet", "rl_cartpole_a3c", "bert_sharded_training",
+        "data_parallel_training", "keras_import_finetune"]
+
+
+def _run(name, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", UI_PORT="0")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", f"{name}.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, f"{name} failed:\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+
+
+@pytest.mark.parametrize("name", QUICK)
+def test_quick_example(name):
+    _run(name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", SLOW)
+def test_slow_example(name):
+    _run(name, timeout=1200)

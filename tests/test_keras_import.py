@@ -314,3 +314,39 @@ def test_masking_import_warns(tmp_path):
         KerasModelImport.importKerasSequentialModelAndWeights(
             _save(m, tmp_path, "mask.h5"))
     assert any("Masking" in str(c.message) for c in caught)
+
+
+def test_conv1d_batchnorm_parity(tmp_path):
+    """BN over channels-last (B,T,C) activations (newly reachable via
+    Conv1D import) must normalize per-feature, not per-timestep."""
+    from deeplearning4j_tpu.data import DataSet
+    tf.keras.utils.set_random_seed(10)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((16, 4)),
+        tf.keras.layers.Conv1D(6, 3, padding="same"),
+        tf.keras.layers.BatchNormalization(),
+        tf.keras.layers.ReLU(),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(2),
+    ])
+    net = KerasModelImport.importKerasSequentialModelAndWeights(
+        _save(m, tmp_path, "c1dbn.h5"))
+    x = RNG.normal(size=(5, 16, 4)).astype(np.float32)
+    _assert_parity(m, net, x)
+    # trains too (EMA update shape against (C,) state)
+    y = np.eye(2, dtype=np.float32)[RNG.integers(0, 2, 5)]
+    net.fit(DataSet(x, y), epochs=2)
+    assert np.isfinite(net.score())
+
+
+def test_flatten_after_conv1d_rejected(tmp_path):
+    tf.keras.utils.set_random_seed(11)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((12, 5)),
+        tf.keras.layers.Conv1D(8, 3),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(3),
+    ])
+    with pytest.raises(ValueError, match="Flatten over a sequence"):
+        KerasModelImport.importKerasSequentialModelAndWeights(
+            _save(m, tmp_path, "flatseq.h5"))
