@@ -142,3 +142,34 @@ class TinyImageNetDataSetIterator(ArrayDataSetIterator):
         imgs, labels = _synthetic_images(n, num_classes, (3, 64, 64), seed=seed)
         self.synthetic = True
         super().__init__(imgs, _one_hot(labels, num_classes), batch_size, shuffle=True, seed=seed)
+
+
+class LFWDataSetIterator(ArrayDataSetIterator):
+    """(ref: LFWDataSetIterator — Labeled Faces in the Wild). NCHW
+    (B,3,64,64); synthetic surrogate (zero-egress env, see module
+    docstring), ``num_classes`` identities."""
+
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None, num_classes: int = 40):
+        n = num_examples or (1024 if train else 256)
+        imgs, labels = _synthetic_images(n, num_classes, (3, 64, 64),
+                                         seed=seed + (0 if train else 1))
+        self.synthetic = True
+        super().__init__(imgs, _one_hot(labels, num_classes), batch_size,
+                         shuffle=True, seed=seed)
+
+
+class SvhnDataSetIterator(ArrayDataSetIterator):
+    """(ref: SvhnDataSetIterator — Street View House Numbers). NCHW
+    (B,3,32,32), 10 digit classes; synthetic surrogate."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None):
+        n = num_examples or (2048 if train else 512)
+        imgs, labels = _synthetic_images(n, 10, (3, 32, 32),
+                                         seed=seed + (0 if train else 1))
+        self.synthetic = True
+        super().__init__(imgs, _one_hot(labels, 10), batch_size,
+                         shuffle=True, seed=seed)

@@ -444,6 +444,9 @@ class BatchNormalization(FeedForwardLayer):
     gamma_init: float = 1.0
     beta_init: float = 0.0
     lockGammaBeta: bool = False
+    # channel placement for rank-3 (sequence) activations — BN is otherwise
+    # layout-blind and cannot tell (B,T,C) from (B,C,T) at runtime
+    rnnDataFormat: str = "NWC"
 
     def set_n_in(self, input_type: InputType):
         if not self.nIn:
@@ -472,10 +475,10 @@ class BatchNormalization(FeedForwardLayer):
         return ()
 
     def apply(self, params, x, *, training=False, rng=None, state=None):
-        # stats over every non-channel axis. Channel placement by rank:
-        # (B,F) -> F; (B,T,C) recurrent is channels-LAST in this framework
-        # (1D convs swap to NCW only internally); NCHW/NCDHW channels-first.
-        if x.ndim == 3:
+        # stats over every non-channel axis. (B,F); rank-3 sequences follow
+        # rnnDataFormat (default NWC, the framework's inter-layer layout);
+        # NCHW/NCDHW channels-first.
+        if x.ndim == 3 and self.rnnDataFormat == "NWC":
             axes = (0, 1)
             shape = [1, 1, -1]
         else:

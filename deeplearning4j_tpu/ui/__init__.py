@@ -20,6 +20,7 @@ from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport, StatsUpdateC
 from deeplearning4j_tpu.ui.tensorboard import TensorBoardExporter, TensorBoardStatsListener
 from deeplearning4j_tpu.ui.html_report import render_report
 from deeplearning4j_tpu.ui.server import UIServer, RemoteStatsStorageRouter
+from deeplearning4j_tpu.ui.tsne import render_tsne, render_word_vectors, tsne_coords
 
 __all__ = [
     "StatsStorage",
@@ -33,4 +34,7 @@ __all__ = [
     "render_report",
     "UIServer",
     "RemoteStatsStorageRouter",
+    "render_tsne",
+    "render_word_vectors",
+    "tsne_coords",
 ]

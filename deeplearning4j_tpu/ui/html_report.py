@@ -104,8 +104,8 @@ def render_report(storage: StatsStorage, sessionId: str, path: str,
     for i, n in enumerate(names[:8]):
         xs = [it for it, r in zip(iters, reports) if n in (r.get("updateRatios") or {})]
         ys = [r["updateRatios"][n] for r in reports if n in (r.get("updateRatios") or {})]
-        color = ["#d62728", "#9467bd", "#8c564b", "#e377c2", "#7f7f7f",
-                 "#bcbd22", "#17becf", "#1f77b4"][i % 8]
+        from deeplearning4j_tpu.ui.palette import PALETTE
+        color = PALETTE[i % len(PALETTE)]
         ratio_lines.append(_polyline(xs, ys, color=color, label=n, logy=True))
     if ratio_lines:
         panels.append('<div class="panel"><h2>Update:param ratio (log10)</h2>'

@@ -1,0 +1,5 @@
+"""Shared categorical palette for every UI surface (static report, live
+dashboard, t-SNE page) — one place to change for rebranding/accessibility."""
+
+PALETTE = ["#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#ff7f0e",
+           "#8c564b", "#e377c2", "#17becf", "#bcbd22", "#7f7f7f"]

@@ -31,6 +31,7 @@ from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.ui.stats import StatsListener  # noqa: F401 (re-export convenience)
+from deeplearning4j_tpu.ui.palette import PALETTE
 from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
 
 _PAGE = """<!DOCTYPE html>
@@ -54,7 +55,7 @@ _PAGE = """<!DOCTYPE html>
 </div>
 <script>
 let cur = null, reports = [], nextFrom = 0;
-const COLORS = ['#d62728','#9467bd','#8c564b','#e377c2','#7f7f7f','#bcbd22','#17becf','#1f77b4'];
+const COLORS = __PALETTE__;
 function drawLines(id, seriesMap) {
   const cv = document.getElementById(id), ctx = cv.getContext('2d');
   ctx.clearRect(0, 0, cv.width, cv.height);
@@ -126,7 +127,7 @@ async function poll() {
   } catch (e) { /* server restarting — keep polling */ }
 }
 setInterval(poll, 1000); poll();
-</script></body></html>"""
+</script></body></html>""".replace("__PALETTE__", json.dumps(PALETTE))
 
 
 class _Handler(BaseHTTPRequestHandler):

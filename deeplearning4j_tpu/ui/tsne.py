@@ -1,0 +1,87 @@
+"""t-SNE embedding visualization (ref: deeplearning4j-ui's tsne tab +
+dl4j-examples TSNEStandardExample — project word/feature vectors to 2D and
+render an interactive-enough scatter).
+
+The reference runs its own Barnes-Hut t-SNE implementation (deeplearning4j-
+nearestneighbors-parent) and serves coords to a JS scatter. Here sklearn's
+Barnes-Hut TSNE (already in the environment) does the projection and the
+output is ONE dependency-free HTML file with an SVG scatter + hover labels —
+the same artifact workflow as ui/html_report.py.
+"""
+from __future__ import annotations
+
+import html
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>t-SNE — {title}</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 24px; color: #222; }}
+ h1 {{ font-size: 18px; }}
+ .meta {{ color: #666; font-size: 13px; margin-bottom: 10px; }}
+ svg text {{ font-size: 9px; fill: #333; }}
+ svg circle:hover + text {{ font-weight: bold; }}
+</style></head><body>
+<h1>t-SNE projection</h1>
+<div class="meta">{title} · {n} points · perplexity {perplexity}</div>
+<svg width="{w}" height="{h}" viewBox="0 0 {w} {h}">{marks}</svg>
+</body></html>"""
+
+
+def tsne_coords(vectors: np.ndarray, perplexity: float = 10.0,
+                seed: int = 0, n_iter: int = 500) -> np.ndarray:
+    """(N, D) -> (N, 2) via Barnes-Hut t-SNE (ref: BarnesHutTsne.fit)."""
+    from sklearn.manifold import TSNE
+    n = len(vectors)
+    perp = min(perplexity, max((n - 1) / 3.0, 1.0))
+    return TSNE(n_components=2, perplexity=perp, random_state=seed,
+                max_iter=max(n_iter, 250), init="pca").fit_transform(
+        np.asarray(vectors, np.float64))
+
+
+def render_tsne(labels: Sequence[str], vectors: np.ndarray, path: str,
+                title: str = "embeddings", perplexity: float = 10.0,
+                seed: int = 0, classes: Optional[Sequence[int]] = None,
+                width: int = 820, height: int = 620) -> str:
+    """Project + write the scatter page; returns ``path``.
+
+    ``classes`` (optional, one int per point) colors points categorically.
+    """
+    if len(labels) != len(vectors):
+        raise ValueError(f"{len(labels)} labels vs {len(vectors)} vectors")
+    xy = tsne_coords(vectors, perplexity=perplexity, seed=seed)
+    lo, hi = xy.min(0), xy.max(0)
+    span = np.where((hi - lo) > 0, hi - lo, 1.0)
+    pad = 40
+    pts = (xy - lo) / span * [width - 2 * pad, height - 2 * pad] + pad
+    from deeplearning4j_tpu.ui.palette import PALETTE as palette
+    marks = []
+    for i, (label, (px, py)) in enumerate(zip(labels, pts)):
+        color = palette[(classes[i] if classes is not None else 0) % len(palette)]
+        marks.append(
+            f'<circle cx="{px:.1f}" cy="{py:.1f}" r="3" fill="{color}" '
+            f'fill-opacity="0.75"><title>{html.escape(str(label))}</title></circle>'
+            f'<text x="{px + 4:.1f}" y="{py - 3:.1f}">{html.escape(str(label))}</text>')
+    page = _PAGE.format(title=html.escape(title), n=len(labels),
+                        perplexity=perplexity, w=width, h=height,
+                        marks="".join(marks))
+    with open(path, "w") as f:
+        f.write(page)
+    return path
+
+
+def render_word_vectors(model, path: str, words: Optional[Sequence[str]] = None,
+                        max_words: int = 200, **kw) -> str:
+    """t-SNE a trained word-vectors model (Word2Vec/GloVe/ParagraphVectors —
+    anything exposing ``vocab.words()`` + ``getWordVectorMatrix``), the
+    reference UI's word-vector tab workflow."""
+    vocab = list(words) if words is not None else list(model.vocab.words())[:max_words]
+    rows = []
+    for w in vocab:
+        v = model.getWordVectorMatrix(w)
+        if v is None:
+            raise ValueError(f"word {w!r} is not in the model vocabulary")
+        rows.append(np.asarray(v))
+    return render_tsne(vocab, np.stack(rows), path, title="word vectors", **kw)

@@ -29,3 +29,9 @@ sim_aa = vec.similarity("cat", "dog")
 sim_af = vec.similarity("cat", "bread")
 print(f"sim(cat,dog)={sim_aa:.3f}  sim(cat,bread)={sim_af:.3f}")
 assert sim_aa > sim_af
+
+# t-SNE page of the learned vectors (ref: UI tsne tab / TSNEStandardExample)
+from deeplearning4j_tpu.ui import render_word_vectors
+
+path = render_word_vectors(vec, "/tmp/word_vectors_tsne.html", perplexity=5)
+print("t-SNE page:", path)

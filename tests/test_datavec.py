@@ -252,3 +252,19 @@ def test_negative_label_raises(tmp_path):
     it = RecordReaderDataSetIterator(r, batchSize=1, labelIndex=2, numClasses=3)
     with pytest.raises(ValueError, match="outside"):
         it.next()
+
+
+def test_lfw_svhn_iterators():
+    """(ref: LFWDataSetIterator / SvhnDataSetIterator) — synthetic surrogate
+    shapes + honest flag."""
+    from deeplearning4j_tpu.data.fetchers import (
+        LFWDataSetIterator, SvhnDataSetIterator)
+    lfw = LFWDataSetIterator(batch_size=8, num_examples=32, num_classes=7)
+    ds = lfw.next()
+    assert np.asarray(ds.features).shape == (8, 3, 64, 64)
+    assert np.asarray(ds.labels).shape == (8, 7)
+    assert lfw.synthetic is True
+    svhn = SvhnDataSetIterator(batch_size=16, num_examples=64, train=False)
+    ds = svhn.next()
+    assert np.asarray(ds.features).shape == (16, 3, 32, 32)
+    assert np.asarray(ds.labels).sum() == 16

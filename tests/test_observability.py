@@ -367,3 +367,45 @@ class TestUIServer:
             net.fit(tiny_data(), epochs=2)  # must not raise
         assert router.dropped >= 2
         assert any("unreachable" in str(c.message) for c in caught)
+
+
+class TestTsne:
+    def test_render_clusters(self, tmp_path):
+        """Two well-separated gaussian clusters must stay separated in the
+        projection (ref: TSNEStandardExample's sanity criterion)."""
+        from deeplearning4j_tpu.ui import render_tsne, tsne_coords
+        rng = np.random.RandomState(0)
+        a = rng.normal(0, 0.3, (20, 16))
+        b = rng.normal(5, 0.3, (20, 16))
+        vecs = np.vstack([a, b])
+        labels = [f"a{i}" for i in range(20)] + [f"b{i}" for i in range(20)]
+        xy = tsne_coords(vecs, perplexity=8, seed=0)
+        da = xy[:20].mean(0)
+        db = xy[20:]. mean(0)
+        within = max(np.linalg.norm(xy[:20] - da, axis=1).mean(),
+                     np.linalg.norm(xy[20:] - db, axis=1).mean())
+        between = np.linalg.norm(da - db)
+        assert between > 2 * within
+        path = render_tsne(labels, vecs, str(tmp_path / "tsne.html"),
+                           classes=[0] * 20 + [1] * 20)
+        page = open(path).read()
+        assert page.count("<circle") == 40 and "a0" in page and "b19" in page
+
+    def test_word_vectors_page(self, tmp_path):
+        from deeplearning4j_tpu.text import (
+            CollectionSentenceIterator, DefaultTokenizerFactory, Word2Vec)
+        from deeplearning4j_tpu.ui import render_word_vectors
+        sents = [f"alpha beta gamma delta word{i % 5}" for i in range(60)]
+        vec = Word2Vec(minWordFrequency=1, layerSize=16, epochs=1,
+                       iterate=CollectionSentenceIterator(sents),
+                       tokenizerFactory=DefaultTokenizerFactory())
+        vec.fit()
+        path = render_word_vectors(vec, str(tmp_path / "words.html"),
+                                   perplexity=5)
+        page = open(path).read()
+        assert "alpha" in page and "<svg" in page
+
+    def test_label_vector_mismatch_raises(self):
+        from deeplearning4j_tpu.ui import render_tsne
+        with pytest.raises(ValueError, match="labels vs"):
+            render_tsne(["a"], np.zeros((2, 4)), "/tmp/x.html")
