@@ -33,13 +33,28 @@ __version__ = "0.1.0"
 
 import jax as _jax
 
-# fp32 arrays must get true-fp32 matmuls (reference semantics: exact BLAS
-# GEMM). JAX's DEFAULT dot precision lowers fp32 operands to bf16 passes on
-# TPU-class backends (~1e-2 error at small fan-in — measured vs a float64
-# oracle), which silently degrades every fp32 model and import-parity check.
-# "highest" restores fp32 accumulation for fp32 operands and is a NO-OP for
-# the bf16 compute paths (models/bert.py casts to bf16 explicitly — bf16
-# inputs have nothing to emulate, MXU throughput unchanged).
-_jax.config.update("jax_default_matmul_precision", "highest")
+# fp32 arrays must get true-fp32 matmuls on the HOST path (reference
+# semantics: exact BLAS GEMM). JAX's DEFAULT dot precision lowers fp32
+# operands to bf16 passes (~1e-2 error at small fan-in — measured vs a
+# float64 oracle), which silently degrades every fp32 model and
+# import-parity check. "highest" restores exact fp32 and costs nothing on
+# CPU.
+#
+# On ACCELERATOR platforms the pin stays off: "highest" forces 6-pass fp32
+# emulation through every conv/matmul — measured on this TPU it multiplies
+# conv-net compile times ~20x and cuts LeNet throughput ~50x — and the
+# reference's own GPU numbers come from cuDNN's TF32 default, which is
+# precisely JAX's DEFAULT behavior here. Opt into exactness per-scope with
+# ``jax.default_matmul_precision("highest")`` when you need it on-device.
+# The pin applies ONLY when the platform is explicitly CPU (config or env),
+# read without initializing a backend. On auto-detect machines the platform
+# is unknown at import time, and guessing wrong would silently put a real
+# TPU/GPU on the 6-pass slow path — so the guard fails open into the fast
+# default there. Exact-fp32 host semantics are guaranteed wherever the
+# platform is pinned to cpu (this repo's tests, multihost CPU workers).
+_plat = str(getattr(_jax.config, "jax_platforms", "") or "").lower()
+if _plat and set(_plat.split(",")) <= {"cpu"}:
+    _jax.config.update("jax_default_matmul_precision", "highest")
+del _plat
 
 from deeplearning4j_tpu.ndarray import NDArray, nd  # noqa: F401

@@ -692,19 +692,22 @@ class SameDiff:
                         ph[nm] = jnp.asarray(arr)
                 trainables, self._opt_state, loss = step(trainables, frozen,
                                                         self._opt_state, ph)
-                history.append(float(loss))
-                self._score = float(loss)
+                history.append(loss)   # device scalar; bulk-synced below
+                self._score = loss
                 # listeners read current values (StatsListener param stats)
                 self._values.update(trainables)
                 for lst in self.listeners:
                     lst.iterationDone(self, len(history), 0)
         self._values.update(trainables)
+        if history:  # ONE bulk device->host transfer instead of one per step
+            import numpy as _np
+            history = _np.asarray(jnp.stack(history)).astype(float).tolist()
         return history
 
     def score(self) -> float:
         """Last training loss (ref: the reference's SameDiff training score
         surfaces through History/listeners; models expose score() here)."""
-        return getattr(self, "_score", float("nan"))
+        return float(getattr(self, "_score", float("nan")))
 
     def numParams(self) -> int:
         import numpy as _np

@@ -223,7 +223,7 @@ class ComputationGraph:
                     self._params, self._state, self._opt_state, loss = step(
                         self._params, self._state, self._opt_state, inputs, ys, sub,
                         lmasks, fmasks)
-                self._score = float(loss)
+                self._score = loss  # device scalar; score() syncs on demand
                 self._iteration += 1
                 for lst in self.listeners:
                     lst.iterationDone(self, self._iteration, self._epoch)
@@ -255,7 +255,7 @@ class ComputationGraph:
     # ---------------------------------------------------------------- score
     def score(self, dataset=None) -> float:
         if dataset is None:
-            return self._score
+            return float(self._score)
         mds = dataset.toMultiDataSet() if isinstance(dataset, DataSet) else dataset
         loss, _ = self._loss_for(self._params, self._state,
                                  self._input_dict(mds.features),

@@ -478,7 +478,9 @@ class BatchNormalization(FeedForwardLayer):
         # stats over every non-channel axis. (B,F); rank-3 sequences follow
         # rnnDataFormat (default NWC, the framework's inter-layer layout);
         # NCHW/NCDHW channels-first.
-        if x.ndim == 3 and self.rnnDataFormat == "NWC":
+        # != "NCW" so unrecognized values degrade to NWC like the sibling
+        # recurrent layers, not silently to channels-first
+        if x.ndim == 3 and self.rnnDataFormat != "NCW":
             axes = (0, 1)
             shape = [1, 1, -1]
         else:

@@ -319,7 +319,7 @@ class MultiLayerNetwork:
                 None if fmask_all is None else fmask_all[:, sl],  # masks are (B,T)
                 None if lmask_all is None else lmask_all[:, sl],
                 rnn_states)
-            self._score = float(loss)
+            self._score = loss  # device scalar; score() syncs on demand
             self._iteration += 1
             for lst in self.listeners:
                 lst.iterationDone(self, self._iteration, self._epoch)
@@ -399,7 +399,7 @@ class MultiLayerNetwork:
                 self._rng_key, sub = jax.random.split(self._rng_key)
                 lp, opt_state, loss = step(lp, self._params, self._state,
                                            opt_state, _as_jnp(ds.features), sub)
-                self._score = float(loss)
+                self._score = loss  # device scalar; score() syncs on demand
                 self._iteration += 1
         self._params = list(self._params)
         self._params[layer_idx] = lp
@@ -443,7 +443,7 @@ class MultiLayerNetwork:
                 else:
                     self._params, self._state, self._opt_state, loss = step(
                         self._params, self._state, self._opt_state, x, y, sub, fmask, lmask)
-                self._score = float(loss)
+                self._score = loss  # device scalar; score() syncs on demand
                 self._iteration += 1
                 for lst in self.listeners:
                     lst.iterationDone(self, self._iteration, self._epoch)
@@ -482,7 +482,7 @@ class MultiLayerNetwork:
     def score(self, dataset: Optional[DataSet] = None) -> float:
         """Last-minibatch loss, or loss on a provided DataSet (ref: score())."""
         if dataset is None:
-            return self._score
+            return float(self._score)
         x = _as_jnp(dataset.features)
         y = _as_jnp(dataset.labels)
         loss, _ = self._loss_for(self._params, self._state, x, y, None,

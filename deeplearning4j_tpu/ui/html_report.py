@@ -11,6 +11,7 @@ import html
 import math
 from typing import List, Optional
 
+from deeplearning4j_tpu.ui.palette import PALETTE
 from deeplearning4j_tpu.ui.storage import StatsStorage
 
 _PAGE = """<!DOCTYPE html>
@@ -104,7 +105,6 @@ def render_report(storage: StatsStorage, sessionId: str, path: str,
     for i, n in enumerate(names[:8]):
         xs = [it for it, r in zip(iters, reports) if n in (r.get("updateRatios") or {})]
         ys = [r["updateRatios"][n] for r in reports if n in (r.get("updateRatios") or {})]
-        from deeplearning4j_tpu.ui.palette import PALETTE
         color = PALETTE[i % len(PALETTE)]
         ratio_lines.append(_polyline(xs, ys, color=color, label=n, logy=True))
     if ratio_lines:

@@ -1,0 +1,114 @@
+"""Measure the BASELINE.json configs #1-3 on the real TPU and print one JSON
+line per config (ref: BASELINE.md "record rebuild numbers alongside").
+
+Configs (#4 lives in tools/bench_tf_import.py, #5 is the multi-chip dryrun):
+  1. LeNet-MNIST MultiLayerNetwork       -> images/sec
+  2. ResNet-50 ComputationGraph (zoo)    -> images/sec
+  3. GravesLSTM char-RNN                 -> tokens/sec
+
+Run: ``python tools/bench_configs.py [--dtype HALF]``. fp32 is the
+reference-faithful default (the package pins exact-fp32 GEMMs); HALF shows
+the bf16 headroom the reference never had.
+"""
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def _timed_fit(net, ds, steps=8, warmup=2):
+    """Seconds per fit(ds) call after warmup (one fused step per call)."""
+    for _ in range(warmup):
+        net.fit(ds)
+    float(net.score())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net.fit(ds)
+    float(net.score())
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_lenet(dtype, B=256):
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import (
+        ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer)
+    from deeplearning4j_tpu.train import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-3))
+            .dataType(dtype).list()
+            .layer(ConvolutionLayer(nOut=20, kernelSize=(5, 5), activation="RELU"))
+            .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(nOut=50, kernelSize=(5, 5), activation="RELU"))
+            .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(nOut=500, activation="RELU"))
+            .layer(OutputLayer(nOut=10, lossFunction="MCXENT"))
+            .setInputType(InputType.convolutionalFlat(28, 28, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.random((B, 784), np.float32),
+                 np.eye(10, dtype=np.float32)[rng.integers(0, 10, B)])
+    dt = _timed_fit(net, ds)
+    return {"config": "lenet_mnist_mln", "metric": "images_per_sec",
+            "value": round(B / dt, 1), "batch": B, "dtype": dtype}
+
+
+def bench_resnet50(dtype, B=32):
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.zoo import ResNet50
+    net = ResNet50(numClasses=1000, inputShape=(3, 224, 224)).init()
+    if dtype == "HALF":  # zoo builder has no dtype knob; rebuild conf
+        net.conf.dataType = "HALF"
+        from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+        net = ComputationGraph(net.conf).init()
+    rng = np.random.default_rng(0)
+    ds = DataSet(rng.random((B, 3, 224, 224), np.float32),
+                 np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, B)])
+    dt = _timed_fit(net, ds, steps=5, warmup=2)
+    return {"config": "resnet50_cg", "metric": "images_per_sec",
+            "value": round(B / dt, 1), "batch": B, "dtype": dtype}
+
+
+def bench_graves_lstm(dtype, B=64, T=128, vocab=80, hidden=512):
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.train import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-3))
+            .dataType(dtype).list()
+            .layer(GravesLSTM(nOut=hidden, activation="TANH"))
+            .layer(GravesLSTM(nOut=hidden, activation="TANH"))
+            .layer(RnnOutputLayer(nOut=vocab, lossFunction="MCXENT"))
+            .setInputType(InputType.recurrent(vocab, T)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (B, T))]
+    y = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (B, T))]
+    ds = DataSet(x, y)
+    dt = _timed_fit(net, ds, steps=6, warmup=2)
+    return {"config": "graves_lstm_char_rnn", "metric": "tokens_per_sec",
+            "value": round(B * T / dt, 1), "batch": B, "seq": T, "dtype": dtype}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dtype", default="FLOAT", choices=["FLOAT", "HALF"])
+    ap.add_argument("--only", default=None,
+                    choices=[None, "lenet", "resnet", "lstm"])
+    args = ap.parse_args()
+    benches = {"lenet": bench_lenet, "resnet": bench_resnet50,
+               "lstm": bench_graves_lstm}
+    for name, fn in benches.items():
+        if args.only and name != args.only:
+            continue
+        print(json.dumps(fn(args.dtype)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
