@@ -1,0 +1,79 @@
+"""HTML analysis report (ref: datavec-api org.datavec.api.transform.ui.
+HtmlAnalysis — renders an AnalyzeLocal DataAnalysis as a standalone page with
+per-column stats tables and categorical state-count bars).
+
+Dependency-free HTML+SVG, same artifact style as ui/html_report.py.
+"""
+from __future__ import annotations
+
+import html
+from typing import Optional
+
+from deeplearning4j_tpu.datavec.analysis import DataAnalysis
+from deeplearning4j_tpu.ui.palette import PALETTE
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>Data analysis</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 24px; color: #222; }}
+ h1 {{ font-size: 18px; }} h2 {{ font-size: 14px; margin: 16px 0 4px; }}
+ table {{ border-collapse: collapse; font-size: 13px; }}
+ td, th {{ border: 1px solid #ddd; padding: 3px 10px; text-align: right; }}
+ th {{ background: #f5f5f5; }} td:first-child {{ text-align: left; }}
+ svg text {{ font-size: 10px; fill: #444; }}
+</style></head><body>
+<h1>Data analysis</h1>
+<div>{ncols} columns · {nrows} rows</div>
+{sections}
+</body></html>"""
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.5g}"
+    return str(v)
+
+
+def _bars(counts: dict, w=420, row_h=18) -> str:
+    if not counts:
+        return ""
+    items = sorted(counts.items(), key=lambda kv: -kv[1])[:20]
+    mx = max(c for _, c in items)
+    h = row_h * len(items) + 6
+    parts = [f'<svg width="{w}" height="{h}">']
+    for i, (state, c) in enumerate(items):
+        bw = (c / mx) * (w - 180)
+        y = i * row_h + 3
+        parts.append(
+            f'<text x="2" y="{y + 12}">{html.escape(str(state))[:18]}</text>'
+            f'<rect x="130" y="{y}" width="{bw:.1f}" height="{row_h - 4}" '
+            f'fill="{PALETTE[0]}"/>'
+            f'<text x="{134 + bw:.1f}" y="{y + 12}">{c}</text>')
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+class HtmlAnalysis:
+    """(ref: HtmlAnalysis.createHtmlAnalysisFile)."""
+
+    @staticmethod
+    def createHtmlAnalysisFile(analysis: DataAnalysis, path: str) -> str:
+        sections = []
+        nrows = 0
+        for name in analysis.schema.getColumnNames():
+            ca = analysis.getColumnAnalysis(name)
+            stats = ca.stats
+            nrows = max(nrows, int(stats.get("count", 0)))
+            rows = "".join(
+                f"<tr><td>{html.escape(k)}</td><td>{_fmt(v)}</td></tr>"
+                for k, v in stats.items() if k != "stateCounts")
+            section = (f"<h2>{html.escape(name)}</h2>"
+                       f"<table><tr><th>stat</th><th>value</th></tr>{rows}</table>")
+            if "stateCounts" in stats:
+                section += _bars(stats["stateCounts"])
+            sections.append(section)
+        page = _PAGE.format(ncols=analysis.schema.numColumns(), nrows=nrows,
+                            sections="".join(sections))
+        with open(path, "w") as f:
+            f.write(page)
+        return path

@@ -212,6 +212,8 @@ class _IterationGuard:
 
 
 class _StopTraining(Exception):
+    _control_flow = True  # not a crash: fit()'s dump-and-reraise skips it
+
     pass
 
 

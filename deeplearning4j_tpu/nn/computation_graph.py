@@ -191,7 +191,18 @@ class ComputationGraph:
         return {name: _as_jnp(f) for name, f in zip(self.conf.networkInputs, features)}
 
     def fit(self, data, labels=None, epochs: int = 1):
-        """fit(DataSet/MultiDataSet), fit(iterator), fit(features, labels)."""
+        """fit(DataSet/MultiDataSet), fit(iterator), fit(features, labels).
+        A crash during training writes a diagnostic dump (ref:
+        CrashReportingUtil), then re-raises."""
+        try:
+            return self._fit_impl(data, labels, epochs)
+        except Exception as e:  # dump-and-reraise; reporting never masks the error
+            from deeplearning4j_tpu.util import crash_reporting
+            if not getattr(e, "_control_flow", False):  # early-stop signals etc.
+                crash_reporting.writeMemoryCrashDump(self, e)
+            raise
+
+    def _fit_impl(self, data, labels=None, epochs: int = 1):
         if labels is not None:
             data = [MultiDataSet([data], [labels])]
         elif isinstance(data, DataSet):

@@ -415,7 +415,17 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------ fit
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(DataSetIterator), fit(DataSet), or fit(features, labels)
-        (ref: MultiLayerNetwork.fit overloads)."""
+        (ref: MultiLayerNetwork.fit overloads). A crash during training
+        writes a diagnostic dump (ref: CrashReportingUtil), then re-raises."""
+        try:
+            return self._fit_impl(data, labels, epochs)
+        except Exception as e:  # dump-and-reraise; reporting never masks the error
+            from deeplearning4j_tpu.util import crash_reporting
+            if not getattr(e, "_control_flow", False):  # early-stop signals etc.
+                crash_reporting.writeMemoryCrashDump(self, e)
+            raise
+
+    def _fit_impl(self, data, labels=None, epochs: int = 1):
         if labels is not None:
             data = ListDataSetIterator([DataSet(data, labels)])
         elif isinstance(data, DataSet):

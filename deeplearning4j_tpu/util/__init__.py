@@ -1,4 +1,5 @@
 """Utilities (ref: org.deeplearning4j.util)."""
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+from deeplearning4j_tpu.util import crash_reporting as CrashReportingUtil
 
-__all__ = ["ModelSerializer"]
+__all__ = ["ModelSerializer", "CrashReportingUtil"]

@@ -1,0 +1,96 @@
+"""Crash forensics (ref: org.deeplearning4j.util.CrashReportingUtil — on an
+OOM during fit, dl4j writes a crash dump with JVM/system memory state, the
+network configuration, and workspace info so users can diagnose without a
+debugger).
+
+The TPU analog dumps: the exception + traceback, backend + per-device memory
+stats (live/peak bytes from PJRT when the backend exposes them), host RSS,
+and the model's class/param-count/configuration JSON. Enabled by default,
+like the reference (``crashDumpsEnabled(False)`` to opt out); dumps land in
+the current directory or ``crashDumpOutputDirectory(path)``.
+"""
+from __future__ import annotations
+
+import datetime
+import os
+import sys
+import traceback
+from typing import Optional
+
+_enabled = True
+_out_dir: Optional[str] = None
+
+
+def crashDumpsEnabled(enabled: bool):
+    """(ref: CrashReportingUtil.crashDumpsEnabled)."""
+    global _enabled
+    _enabled = bool(enabled)
+
+
+def crashDumpOutputDirectory(path: Optional[str]):
+    """(ref: CrashReportingUtil.crashDumpOutputDirectory)."""
+    global _out_dir
+    _out_dir = path
+
+
+def writeMemoryCrashDump(model, exception: BaseException) -> Optional[str]:
+    """Write the dump; returns the path (None when disabled or the dump
+    itself fails — crash reporting must never mask the original error)."""
+    if not _enabled:
+        return None
+    try:
+        import jax
+        lines = []
+        lines.append("deeplearning4j_tpu crash dump")
+        lines.append(f"time: {datetime.datetime.now().isoformat()}")
+        lines.append(f"pid: {os.getpid()}")
+        lines.append("")
+        lines.append("---- exception " + "-" * 50)
+        lines.append("".join(traceback.format_exception(
+            type(exception), exception, exception.__traceback__)))
+        lines.append("---- devices " + "-" * 52)
+        try:
+            lines.append(f"backend: {jax.default_backend()}")
+            for d in jax.devices():
+                stats = {}
+                try:
+                    stats = d.memory_stats() or {}
+                except Exception:
+                    pass
+                keep = {k: v for k, v in stats.items()
+                        if k in ("bytes_in_use", "peak_bytes_in_use",
+                                 "bytes_limit", "largest_alloc_size")}
+                lines.append(f"  {d}: {keep or 'no memory stats exposed'}")
+        except Exception as e:  # backend itself may be the thing that died
+            lines.append(f"  <device query failed: {e}>")
+        try:
+            import resource  # Unix-only; dumps degrade gracefully elsewhere
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # ru_maxrss is KiB on Linux, BYTES on macOS
+            rss_mb = rss / (1048576.0 if sys.platform == "darwin" else 1024.0)
+            lines.append(f"host max RSS: {rss_mb:.1f} MB")
+        except ImportError:
+            pass
+        lines.append("")
+        lines.append("---- model " + "-" * 54)
+        lines.append(f"class: {type(model).__name__}")
+        try:
+            lines.append(f"numParams: {model.numParams()}")
+        except Exception:
+            pass
+        try:
+            conf = getattr(model, "conf", None)
+            if conf is not None and hasattr(conf, "to_json"):
+                lines.append("configuration:")
+                lines.append(conf.to_json())
+        except Exception:
+            pass
+        name = (f"dl4jtpu-crash-{datetime.datetime.now():%Y%m%d-%H%M%S}"
+                f"-{os.getpid()}.txt")
+        path = os.path.join(_out_dir or os.getcwd(), name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        return path
+    except Exception:
+        return None  # never shadow the original failure

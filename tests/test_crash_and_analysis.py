@@ -1,0 +1,105 @@
+"""CrashReportingUtil (ref: o.d.util.CrashReportingUtil tests) and DataVec
+HtmlAnalysis (ref: org.datavec.api.transform.ui.HtmlAnalysis)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.train import Adam
+from deeplearning4j_tpu.util import crash_reporting
+
+
+def _net():
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(nOut=8, activation="RELU"))
+            .layer(OutputLayer(nOut=3, lossFunction="MCXENT"))
+            .setInputType(InputType.feedForward(5)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestCrashReporting:
+    def test_dump_written_on_fit_crash(self, tmp_path):
+        crash_reporting.crashDumpOutputDirectory(str(tmp_path))
+        try:
+            net = _net()
+            bad = DataSet(np.zeros((4, 7), np.float32),   # wrong feature width
+                          np.zeros((4, 3), np.float32))
+            with pytest.raises((ValueError, RuntimeError, TypeError)):
+                net.fit(bad)
+            dumps = [f for f in os.listdir(tmp_path) if f.startswith("dl4jtpu-crash")]
+            assert len(dumps) == 1
+            text = open(tmp_path / dumps[0]).read()
+            assert "exception" in text and "MultiLayerNetwork" in text
+            assert "configuration" in text      # conf JSON included
+            assert "backend" in text            # device section present
+        finally:
+            crash_reporting.crashDumpOutputDirectory(None)
+
+    def test_disabled_writes_nothing(self, tmp_path):
+        crash_reporting.crashDumpOutputDirectory(str(tmp_path))
+        crash_reporting.crashDumpsEnabled(False)
+        try:
+            net = _net()
+            with pytest.raises((ValueError, RuntimeError, TypeError)):
+                net.fit(DataSet(np.zeros((4, 7), np.float32),
+                                np.zeros((4, 3), np.float32)))
+            assert not os.listdir(tmp_path)
+        finally:
+            crash_reporting.crashDumpsEnabled(True)
+            crash_reporting.crashDumpOutputDirectory(None)
+
+    def test_dump_api_direct(self, tmp_path):
+        crash_reporting.crashDumpOutputDirectory(str(tmp_path))
+        try:
+            p = crash_reporting.writeMemoryCrashDump(_net(), ValueError("boom"))
+            assert p is not None and os.path.exists(p)
+            assert "boom" in open(p).read()
+        finally:
+            crash_reporting.crashDumpOutputDirectory(None)
+
+
+class TestHtmlAnalysis:
+    def test_report_renders_stats_and_bars(self, tmp_path):
+        from deeplearning4j_tpu.datavec import Schema
+        from deeplearning4j_tpu.datavec.analysis import AnalyzeLocal
+        from deeplearning4j_tpu.datavec.html_analysis import HtmlAnalysis
+        from deeplearning4j_tpu.datavec.writables import (
+            DoubleWritable, Text)
+        schema = (Schema.Builder().addColumnDouble("v")
+                  .addColumnCategorical("k", "a", "b").build())
+        rows = [[DoubleWritable(i * 0.5), Text("a" if i % 3 else "b")]
+                for i in range(30)]
+        analysis = AnalyzeLocal.analyze(schema, rows)
+        path = HtmlAnalysis.createHtmlAnalysisFile(
+            analysis, str(tmp_path / "analysis.html"))
+        page = open(path).read()
+        assert "<h2>v</h2>" in page and "<h2>k</h2>" in page
+        assert "mean" in page
+        assert page.count("<rect") == 2        # two categorical states
+        assert "2 columns" in page and "30 rows" in page
+
+    def test_early_stopping_signal_is_not_a_crash(self, tmp_path):
+        """_StopTraining is control flow, not a failure — no dump litter."""
+        from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+        from deeplearning4j_tpu.earlystopping import (
+            EarlyStoppingConfiguration, EarlyStoppingTrainer, InMemoryModelSaver,
+            MaxScoreIterationTerminationCondition, MaxEpochsTerminationCondition)
+        crash_reporting.crashDumpOutputDirectory(str(tmp_path))
+        try:
+            rng = np.random.RandomState(0)
+            ds = DataSet(rng.rand(32, 5).astype(np.float32),
+                         np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)])
+            esc = EarlyStoppingConfiguration(
+                epochTerminationConditions=[MaxEpochsTerminationCondition(3)],
+                iterationTerminationConditions=[
+                    MaxScoreIterationTerminationCondition(1e-9)],  # trips instantly
+                modelSaver=InMemoryModelSaver())
+            EarlyStoppingTrainer(esc, _net(),
+                                 ListDataSetIterator(ds.batchBy(8))).fit()
+            assert not [f for f in os.listdir(tmp_path)
+                        if f.startswith("dl4jtpu-crash")]
+        finally:
+            crash_reporting.crashDumpOutputDirectory(None)
