@@ -74,6 +74,11 @@ class ComputationGraph:
     def _forward(self, params, state, inputs: Dict[str, Any], *, training, rng,
                  masks: Optional[Dict[str, Any]] = None):
         acts: Dict[str, Any] = dict(inputs)
+        if self._dtype != jnp.float32:  # HALF/DOUBLE nets: cast float inputs
+            # once; integer inputs (embedding ids) must not round through bf16
+            acts = {k: (v.astype(self._dtype)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for k, v in acts.items()}
         new_state: Dict[str, dict] = {}
         n_layers = max(sum(1 for n in self._order if isinstance(n.op, Layer)), 1)
         rngs = jax.random.split(rng, n_layers) if rng is not None else None

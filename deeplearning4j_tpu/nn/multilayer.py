@@ -132,6 +132,11 @@ class MultiLayerNetwork:
         from that state and report their final state (ref:
         rnnActivateUsingStoredState — the tBPTT/streaming path)."""
         x = self._adapt_input(x)
+        # HALF/DOUBLE nets: float inputs join the conf dtype (convs reject
+        # mixed operands). Integer inputs (embedding token ids) must NOT
+        # round-trip through bf16 — ids > 256 would silently collide.
+        if self._dtype != jnp.float32 and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(self._dtype)
         new_states, new_rnn = [], []
         n = len(self.layers)
         rngs = jax.random.split(rng, n) if rng is not None else [None] * n

@@ -254,3 +254,46 @@ class TestEvaluationIntegration:
         y = np.random.rand(50, 2)
         rev.eval(y, y + 0.1)
         assert abs(rev.meanAbsoluteError() - 0.1) < 1e-6
+
+
+def test_half_dtype_conv_net_trains():
+    """dataType('HALF') must work for conv nets: inputs cast to the conf
+    dtype at forward entry (convs reject mixed fp32/bf16 operands)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer, SubsamplingLayer
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+            .dataType("HALF").list()
+            .layer(ConvolutionLayer(nOut=4, kernelSize=(3, 3), activation="RELU"))
+            .layer(SubsamplingLayer(kernelSize=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(nOut=8, activation="RELU"))
+            .layer(OutputLayer(nOut=2, lossFunction="MCXENT"))
+            .setInputType(InputType.convolutionalFlat(8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 64).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)]
+    net.fit(DataSet(x, y), epochs=2)
+    assert np.isfinite(net.score())
+    assert net._params[0]["W"].dtype == jnp.bfloat16
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 2) and np.isfinite(out).all()
+
+
+def test_half_dtype_embedding_ids_not_rounded():
+    """Integer token ids must bypass the HALF input cast — bf16 rounds ids
+    above 256 (257 -> 256), silently colliding embedding rows."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.layers import (EmbeddingSequenceLayer,
+                                                   GlobalPoolingLayer)
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+            .dataType("HALF").list()
+            .layer(EmbeddingSequenceLayer(nIn=1000, nOut=8))
+            .layer(GlobalPoolingLayer(poolingType="AVG"))
+            .layer(OutputLayer(nOut=2, lossFunction="MCXENT"))
+            .setInputType(InputType.recurrent(1, 4)).build())
+    net = MultiLayerNetwork(conf).init()
+    a = np.full((1, 4), 256, np.int32)
+    b = np.full((1, 4), 257, np.int32)
+    oa = np.asarray(net.output(a), np.float32)
+    ob = np.asarray(net.output(b), np.float32)
+    assert not np.allclose(oa, ob), "ids 256 and 257 hit the same embedding row"
