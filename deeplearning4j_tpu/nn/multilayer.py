@@ -122,7 +122,12 @@ class MultiLayerNetwork:
     def _adapt_input(self, x):
         it = self.conf.inputType
         if it is not None and it.kind == "cnnflat" and x.ndim == 2:
-            return x.reshape(x.shape[0], it.channels, it.height, it.width)
+            x = x.reshape(x.shape[0], it.channels, it.height, it.width)
+        # HALF/DOUBLE nets: float inputs join the conf dtype (convs reject
+        # mixed operands). Integer inputs (embedding token ids) must NOT
+        # round-trip through bf16 — ids > 256 would silently collide.
+        if self._dtype != jnp.float32 and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(self._dtype)
         return x
 
     def _forward(self, params, state, x, *, training, rng, mask=None, rnn_states=None):
@@ -132,11 +137,6 @@ class MultiLayerNetwork:
         from that state and report their final state (ref:
         rnnActivateUsingStoredState — the tBPTT/streaming path)."""
         x = self._adapt_input(x)
-        # HALF/DOUBLE nets: float inputs join the conf dtype (convs reject
-        # mixed operands). Integer inputs (embedding token ids) must NOT
-        # round-trip through bf16 — ids > 256 would silently collide.
-        if self._dtype != jnp.float32 and jnp.issubdtype(x.dtype, jnp.floating):
-            x = x.astype(self._dtype)
         new_states, new_rnn = [], []
         n = len(self.layers)
         rngs = jax.random.split(rng, n) if rng is not None else [None] * n

@@ -15,6 +15,7 @@ from deeplearning4j_tpu.ops import math_defs as _math_defs  # noqa: F401
 from deeplearning4j_tpu.ops import nn_defs as _nn_defs  # noqa: F401
 from deeplearning4j_tpu.ops import extra_defs as _extra_defs  # noqa: F401
 from deeplearning4j_tpu.ops import more_defs as _more_defs  # noqa: F401
+from deeplearning4j_tpu.ops import wide_defs as _wide_defs  # noqa: F401
 
 math = EagerNamespace("math")
 reduce = EagerNamespace("reduce")
@@ -27,3 +28,4 @@ rnn = EagerNamespace("rnn")
 loss = EagerNamespace("loss")
 image = EagerNamespace("image")
 random = EagerNamespace("random")
+updaters = EagerNamespace("updaters")

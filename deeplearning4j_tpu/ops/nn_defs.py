@@ -603,6 +603,8 @@ def resize_nearest(x, size, data_format="NCHW"):
 @op("cropAndResize", "image")
 def crop_and_resize(x, boxes, box_indices, crop_size):
     """x: NHWC; boxes: (n,4) normalized [y1,x1,y2,x2]."""
+    x = jnp.asarray(x)  # numpy input would break x[idx] under the vmap trace
+
     def one(box, idx):
         y1, x1, y2, x2 = box
         img = x[idx]

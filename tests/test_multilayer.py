@@ -277,6 +277,9 @@ def test_half_dtype_conv_net_trains():
     assert net._params[0]["W"].dtype == jnp.bfloat16
     out = np.asarray(net.output(x))
     assert out.shape == (4, 2) and np.isfinite(out).all()
+    # feedForward shares the cast via _adapt_input (it bypasses _forward)
+    acts = net.feedForward(x)
+    assert np.isfinite(np.asarray(acts[-1].toNumpy())).all()
 
 
 def test_half_dtype_embedding_ids_not_rounded():

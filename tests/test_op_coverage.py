@@ -87,6 +87,38 @@ LEDGER = {
     "compression/threshold": ["math.thresholdEncode", "math.thresholdDecode"],
     "nn/morphology": ["cnn.dilation2d", "cnn.maxPoolWithArgmax"],
     "image/crop_resize": ["image.randomCrop", "image.imageResize"],
+    # --- wide_defs.py families (final widening toward the full inventory) ---
+    "updaters": [
+        "updaters.sgdUpdater", "updaters.nesterovsUpdater",
+        "updaters.adaGradUpdater", "updaters.rmsPropUpdater",
+        "updaters.adaDeltaUpdater", "updaters.adamUpdater",
+        "updaters.adaMaxUpdater", "updaters.nadamUpdater",
+        "updaters.amsGradUpdater", "updaters.adaBeliefUpdater",
+    ],
+    "boolean": ["math.isNonDecreasing", "math.isStrictlyIncreasing",
+                "math.isNumericTensor"],
+    "parity_ops/stragglers": [
+        "math.stopGradient", "math.assign", "math.axpy", "math.divideNoNan",
+        "math.realDiv", "math.truncateDiv", "math.cummax", "math.cummin",
+        "math.trigamma", "math.nextafter", "math.checkNumerics",
+        "math.nthElement", "math.sufficientStatistics", "math.histogram",
+        "nn.biasAdd", "shape.mirrorPad", "shape.broadcastShape",
+        "shape.select", "shape.sparseToDense", "shape.splitV",
+        "shape.intersection", "linalg.matrixSetDiag",
+    ],
+    "tsne": ["math.tsneGains", "math.tsneSymmetrized", "math.tsneEdgeForces",
+             "math.tsneCellContains"],
+    "compression/bitmap": ["math.encodeBitmap", "math.decodeBitmap"],
+    "recurrent/variants": ["rnn.lstmBlock", "rnn.lstmBlockCell",
+                           "rnn.dynamicRnn", "rnn.staticRnn",
+                           "rnn.dynamicBidirectionalRnn"],
+    "image/stragglers": ["image.nonMaxSuppressionOverlaps",
+                         "image.drawBoundingBoxes", "image.adjustGamma"],
+    "cnn/stragglers": ["cnn.deconv3d", "cnn.pnormPool2d",
+                       "cnn.spaceToBatchNd", "cnn.batchToSpaceNd"],
+    "loss/stragglers": ["loss.ctcLoss", "loss.weightedCrossEntropyWithLogits",
+                        "loss.meanPairwiseSquaredError"],
+    "random/extras": ["random.lognormal", "random.multinomial"],
 }
 
 RNG = np.random.default_rng(7)
@@ -102,7 +134,7 @@ def test_ledger_every_family_covered():
 
 def test_registry_size_floor():
     """The op surface must not silently shrink (VERDICT r1 asked 222 -> ~350)."""
-    assert len(REGISTRY) >= 368, len(REGISTRY)
+    assert len(REGISTRY) >= 427, len(REGISTRY)
 
 
 class TestSegment:
@@ -591,24 +623,10 @@ class TestRound3Ops:
         mark_validated("imageResize", "image")
 
 
-# runs LAST: every suite above marks its ops validated first
-def test_coverage_report_counts():
-    done, todo = coverage_report()
-    # every ledger op exercised above must be flagged validated
-    ledger_keys = {k for keys in LEDGER.values() for k in keys}
-    validated = set(done)
-    new_unvalidated = sorted(k for k in ledger_keys - validated
-                             if k.split(".")[1] in
-                             {"scatterAdd", "scatterSub", "scatterMax",
-                              "scatterMin", "scatterUpdate", "clipByValue",
-                              "cropping2d", "zeroPadding2d", "upsampling2d",
-                              "spaceToDepth", "depthToSpace", "im2col",
-                              "resizeBilinear", "resizeNearest", "adjustContrast",
-                              "rgbToGrayscale", "cropAndResize",
-                              "nonMaxSuppression"})
-    # pre-existing ops are validated in their own suites; ledger-new ones here
-    remaining = ledger_keys - validated - set(new_unvalidated)
-    assert not remaining, f"ledger ops never validated: {sorted(remaining)}"
+# NOTE: the ledger-completeness check (every ledger op marked validated by
+# some suite) lives at the end of tests/test_wide_ops.py, which pytest
+# collects after every other op suite in alphabetical order — so all
+# mark_validated calls have happened by the time it runs.
 
 
 class TestArgmaxPoolIndices:
