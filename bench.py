@@ -39,7 +39,12 @@ def main():
         # BERT-base 12L/768H/12 heads/512 seq. remat off: activations fit a
         # single chip's HBM at B=48 and recompute costs ~15% throughput
         # (measured: 117k tok/s no-remat vs 100k dots-remat vs 96k full).
-        cfg = TransformerConfig(remat=False)
+        # The step is HBM-bandwidth-bound (XLA cost analysis: 17.5 TFLOP but
+        # 132 GB accessed -> ~620 GB/s sustained, near the v5e's 819 GB/s
+        # peak), so the remaining lever is fewer bytes: bf16 softmax drops
+        # 18 GB/step (+13% throughput; loss trajectory identical over 150
+        # steps — validated in models/bert.py softmax_dtype docs).
+        cfg = TransformerConfig(remat=False, softmax_dtype=jnp.bfloat16)
         B, T, steps, warmup = 48, 512, 10, 3
     else:                                   # CPU smoke fallback (driver runs TPU)
         cfg = TransformerConfig(vocab_size=1024, hidden=128, layers=2, heads=4,

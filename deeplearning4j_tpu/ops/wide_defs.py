@@ -117,8 +117,10 @@ def amsgrad_updater(grad, m, v, vhat_max, t, lr=1e-3, beta1=0.9, beta2=0.999,
     m_new = beta1 * m + (1.0 - beta1) * grad
     v_new = beta2 * v + (1.0 - beta2) * grad * grad
     vhat_new = jnp.maximum(vhat_max, v_new)
-    mhat = m_new / (1.0 - beta1 ** t)
-    return lr * mhat / (jnp.sqrt(vhat_new) + eps), m_new, v_new, vhat_new, t
+    # Reddi et al. / DL4J form: alpha_t = lr*sqrt(1-b2^t)/(1-b1^t) folds the
+    # bias corrections of BOTH moments into the step size
+    alpha_t = lr * jnp.sqrt(1.0 - beta2 ** t) / (1.0 - beta1 ** t)
+    return alpha_t * m_new / (jnp.sqrt(vhat_new) + eps), m_new, v_new, vhat_new, t
 
 
 @op("adaBeliefUpdater", "updaters")

@@ -623,10 +623,9 @@ class TestRound3Ops:
         mark_validated("imageResize", "image")
 
 
-# NOTE: the ledger-completeness check (every ledger op marked validated by
-# some suite) lives at the end of tests/test_wide_ops.py, which pytest
-# collects after every other op suite in alphabetical order — so all
-# mark_validated calls have happened by the time it runs.
+# NOTE: the ledger-completeness gate lives at the end of tests/test_wide_ops.py
+# as a static source scan (word-boundary grep for each ledger op name across
+# test files), deliberately independent of pytest collection order/subsetting.
 
 
 class TestArgmaxPoolIndices:
