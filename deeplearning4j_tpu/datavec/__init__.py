@@ -1,6 +1,7 @@
 """ETL / data vectorization (ref: datavec/ — records -> tensors pipeline,
 SURVEY.md §2.3)."""
 from deeplearning4j_tpu.datavec.writables import (
+    BytesWritable,
     Writable, DoubleWritable, FloatWritable, IntWritable, LongWritable, Text,
     BooleanWritable, NDArrayWritable, NullWritable)
 from deeplearning4j_tpu.datavec.split import (
@@ -20,6 +21,8 @@ from deeplearning4j_tpu.datavec.iterator import (
 from deeplearning4j_tpu.datavec.image import ImageRecordReader, NativeImageLoader
 from deeplearning4j_tpu.datavec.arrow import ArrowConverter, ArrowRecordReader
 from deeplearning4j_tpu.datavec.codec import CodecRecordReader
+from deeplearning4j_tpu.datavec.jdbc import JdbcRecordReader
+from deeplearning4j_tpu.datavec.excel import ExcelRecordReader
 
 __all__ = [
     "Writable", "DoubleWritable", "FloatWritable", "IntWritable", "LongWritable",
@@ -35,5 +38,6 @@ __all__ = [
     "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
     "ImageRecordReader", "NativeImageLoader",
     "ArrowConverter", "ArrowRecordReader",
-    "CodecRecordReader",
+    "CodecRecordReader", "JdbcRecordReader", "ExcelRecordReader",
+    "BytesWritable",
 ]

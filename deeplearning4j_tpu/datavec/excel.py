@@ -1,0 +1,123 @@
+"""Excel record reader (ref: datavec-excel
+org.datavec.poi.excel.ExcelRecordReader — reads spreadsheet rows as records
+via Apache POI). POI's Python analog would be openpyxl, which is not in this
+environment; .xlsx is just a zip of XML (ECMA-376), so this reader parses
+the sheet XML directly with the stdlib — shared strings, inline strings,
+numeric cells, and sparse rows (missing cells become NullWritable).
+
+Only .xlsx (OOXML) is supported; legacy .xls (BIFF) raises — the reference
+supports both via POI, and BIFF is a binary format not worth reimplementing
+for parity (documented divergence).
+"""
+from __future__ import annotations
+
+import re
+import zipfile
+from typing import List, Optional
+from xml.etree import ElementTree
+
+from deeplearning4j_tpu.datavec.records import RecordReader
+from deeplearning4j_tpu.datavec.split import FileSplit, InputSplit
+from deeplearning4j_tpu.datavec.writables import (
+    BooleanWritable,
+    DoubleWritable,
+    NullWritable,
+    Text,
+    Writable,
+)
+
+_NS = {"m": "http://schemas.openxmlformats.org/spreadsheetml/2006/main"}
+
+
+def _col_index(cell_ref: str) -> int:
+    """'C7' -> 2 (zero-based column)."""
+    letters = re.match(r"[A-Z]+", cell_ref).group(0)
+    idx = 0
+    for ch in letters:
+        idx = idx * 26 + (ord(ch) - ord("A") + 1)
+    return idx - 1
+
+
+def _read_sheet(zf: zipfile.ZipFile, sheet_path: str,
+                shared: List[str]) -> List[List[Writable]]:
+    root = ElementTree.fromstring(zf.read(sheet_path))
+    rows: List[List[Writable]] = []
+    for row in root.iterfind(".//m:sheetData/m:row", _NS):
+        cells: List[Writable] = []
+        for c in row.iterfind("m:c", _NS):
+            ci = _col_index(c.get("r", "A1"))
+            while len(cells) < ci:
+                cells.append(NullWritable())
+            ctype = c.get("t", "n")
+            v = c.find("m:v", _NS)
+            if ctype == "s" and v is not None:          # shared string
+                cells.append(Text(shared[int(v.text)]))
+            elif ctype == "inlineStr":
+                t = c.find("m:is/m:t", _NS)
+                cells.append(Text(t.text if t is not None else ""))
+            elif ctype == "str" and v is not None:       # formula cached str
+                cells.append(Text(v.text))
+            elif ctype == "b" and v is not None:         # boolean
+                cells.append(BooleanWritable(v.text in ("1", "true")))
+            elif v is not None:                          # numeric
+                cells.append(DoubleWritable(float(v.text)))
+            else:
+                cells.append(NullWritable())
+        rows.append(cells)
+    width = max((len(r) for r in rows), default=0)
+    for r in rows:
+        while len(r) < width:
+            r.append(NullWritable())
+    return rows
+
+
+def _read_xlsx(path: str, sheet_index: int = 0) -> List[List[Writable]]:
+    if str(path).lower().endswith(".xls"):
+        raise ValueError(
+            ".xls (BIFF) is not supported — convert to .xlsx "
+            "(the reference reads both via Apache POI)")
+    with zipfile.ZipFile(path) as zf:
+        shared: List[str] = []
+        if "xl/sharedStrings.xml" in zf.namelist():
+            sroot = ElementTree.fromstring(zf.read("xl/sharedStrings.xml"))
+            for si in sroot.iterfind("m:si", _NS):
+                shared.append("".join(t.text or ""
+                                      for t in si.iterfind(".//m:t", _NS)))
+        sheets = sorted(
+            (n for n in zf.namelist()
+             if re.fullmatch(r"xl/worksheets/sheet\d+\.xml", n)),
+            key=lambda n: int(re.search(r"(\d+)\.xml$", n).group(1)))
+        if sheet_index >= len(sheets):
+            raise IndexError(f"sheet {sheet_index} of {len(sheets)}")
+        return _read_sheet(zf, sheets[sheet_index], shared)
+
+
+class ExcelRecordReader(RecordReader):
+    """(ref: ExcelRecordReader). Iterates every row of every file in the
+    split; ``skipNumLinesStart`` skips header rows per sheet."""
+
+    def __init__(self, sheet_index: int = 0, skipNumLinesStart: int = 0):
+        self._sheet = sheet_index
+        self._skip = skipNumLinesStart
+        self._rows: List[List[Writable]] = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit):
+        self._rows = []
+        for loc in split.locations():
+            self._rows.extend(_read_xlsx(loc, self._sheet)[self._skip:])
+        self._pos = 0
+        return self
+
+    def hasNext(self) -> bool:
+        return self._pos < len(self._rows)
+
+    def next(self) -> List[Writable]:
+        if not self.hasNext():
+            raise StopIteration
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def reset(self):
+        self._pos = 0

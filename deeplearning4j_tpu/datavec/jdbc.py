@@ -1,0 +1,80 @@
+"""JDBC-equivalent record reader (ref: datavec-jdbc
+org.datavec.jdbc.records.reader.impl.jdbc.JDBCRecordReader — reads rows of a
+SQL query as records; the reference takes a javax.sql.DataSource + query).
+
+Python has no JDBC; the natural analog is a DB-API 2.0 connection (sqlite3
+in the stdlib, or any driver with the same interface). The reader maps SQL
+types to Writables exactly as the reference's JdbcWritableConverter does:
+ints -> LongWritable, floats -> DoubleWritable, str -> Text, bytes ->
+BytesWritable, NULL -> NullWritable.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from deeplearning4j_tpu.datavec.records import RecordReader
+from deeplearning4j_tpu.datavec.writables import (
+    BooleanWritable,
+    BytesWritable,
+    DoubleWritable,
+    LongWritable,
+    NullWritable,
+    Text,
+    Writable,
+)
+
+
+def _to_writable(v: Any) -> Writable:
+    if v is None:
+        return NullWritable()
+    if isinstance(v, bool):
+        return BooleanWritable(v)
+    if isinstance(v, int):
+        return LongWritable(v)
+    if isinstance(v, float):
+        return DoubleWritable(v)
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return BytesWritable(bytes(v))
+    return Text(str(v))
+
+
+class JdbcRecordReader(RecordReader):
+    """(ref: JDBCRecordReader). ``conn`` is any DB-API connection; the query
+    runs on initialize()/reset() so the reader is re-iterable."""
+
+    def __init__(self, conn, query: str,
+                 params: Optional[Sequence[Any]] = None):
+        self._conn = conn
+        self._query = query
+        self._params = tuple(params or ())
+        self._rows: Optional[List[tuple]] = None
+        self._pos = 0
+        self._columns: List[str] = []
+
+    def initialize(self, split=None):
+        cur = self._conn.cursor()
+        cur.execute(self._query, self._params)
+        self._columns = [d[0] for d in cur.description or []]
+        self._rows = cur.fetchall()
+        cur.close()
+        self._pos = 0
+        return self
+
+    # metadata parity with the reference's record metadata
+    def getLabels(self) -> List[str]:
+        return list(self._columns)
+
+    def hasNext(self) -> bool:
+        if self._rows is None:
+            self.initialize()
+        return self._pos < len(self._rows)
+
+    def next(self) -> List[Writable]:
+        if not self.hasNext():
+            raise StopIteration
+        row = self._rows[self._pos]
+        self._pos += 1
+        return [_to_writable(v) for v in row]
+
+    def reset(self):
+        self.initialize()

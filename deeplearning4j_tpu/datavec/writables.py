@@ -76,6 +76,28 @@ class Text(Writable):
         return int(float(self.value))
 
 
+class BytesWritable(Writable):
+    """(ref: org.datavec.api.writable.BytesWritable)."""
+
+    def __init__(self, value=b""):
+        super().__init__(bytes(value))
+
+    def toDouble(self):
+        raise TypeError("BytesWritable cannot convert to double")
+
+    def toFloat(self):
+        raise TypeError("BytesWritable cannot convert to float")
+
+    def toInt(self):
+        raise TypeError("BytesWritable cannot convert to int")
+
+    def toLong(self):
+        raise TypeError("BytesWritable cannot convert to long")
+
+    def toString(self):
+        return self.value.hex()
+
+
 class NullWritable(Writable):
     def __init__(self):
         super().__init__(None)

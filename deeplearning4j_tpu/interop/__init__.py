@@ -3,10 +3,11 @@ GraphRunner and nd4j/nd4j-onnxruntime's OnnxRuntimeRunner — escape hatches
 that execute foreign model formats with array I/O, for graphs the import
 pipeline cannot (yet) translate).
 
-``onnxruntime`` is not present in this environment; the ONNX analog of
-GraphRunner is served by the in-tree importer (``modelimport.onnx`` executes
-ONNX graphs natively on SameDiff/XLA), so no ORT wrapper is shipped.
+``onnxruntime`` is not present in this environment; OnnxRunner keeps the
+reference runner's API (run/exec over name->array maps) but executes through
+the in-tree importer (``modelimport.onnx``) as one jitted XLA executable.
 """
 from deeplearning4j_tpu.interop.tf_runner import GraphRunner
+from deeplearning4j_tpu.interop.onnx_runner import OnnxRunner
 
-__all__ = ["GraphRunner"]
+__all__ = ["GraphRunner", "OnnxRunner"]

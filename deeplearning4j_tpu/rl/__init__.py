@@ -7,7 +7,7 @@ while every learning update — TD targets, double-DQN argmax/gather, advantage
 actor-critic — is ONE jitted XLA executable over the nn framework's layer
 forward. Replay sampling is vectorized numpy into device batches.
 """
-from deeplearning4j_tpu.rl.env import MDP, CartPole, ChainMDP
+from deeplearning4j_tpu.rl.env import MDP, CartPole, ChainMDP, MountainCar, GymEnvAdapter
 from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
 from deeplearning4j_tpu.rl.policy import BoltzmannPolicy, EpsGreedy, GreedyPolicy
 from deeplearning4j_tpu.rl.qlearning import QLearningConfiguration, QLearningDiscreteDense
@@ -22,7 +22,7 @@ A3CDiscreteDense = A2CDiscreteDense
 A3CConfiguration = A2CConfiguration
 
 __all__ = [
-    "MDP", "CartPole", "ChainMDP",
+    "MDP", "CartPole", "ChainMDP", "MountainCar", "GymEnvAdapter",
     "ExpReplay", "Transition",
     "EpsGreedy", "GreedyPolicy", "BoltzmannPolicy",
     "QLearningConfiguration", "QLearningDiscreteDense",

@@ -106,3 +106,77 @@ class CartPole(MDP):
         done = bool(abs(x) > self.x_limit or abs(theta) > self.theta_limit
                     or self._t >= self.max_steps)
         return self._state.copy(), 1.0, done, {}
+
+
+class MountainCar(MDP):
+    """Classic-control mountain car (ref: rl4j-gym MountainCar-v0 binding;
+    dynamics from the public equations — Moore 1990): position in
+    [-1.2, 0.6], velocity in [-0.07, 0.07], actions {0: left, 1: idle,
+    2: right}, reward -1 per step until the goal at x >= 0.5."""
+
+    def __init__(self, horizon: int = 200, seed: int = 0):
+        self.obs_size = 2
+        self.n_actions = 3
+        self.horizon = horizon
+        self._rng = np.random.default_rng(seed)
+        self._s = np.zeros(2, np.float32)
+        self._t = 0
+
+    def reset(self):
+        self._s = np.array([self._rng.uniform(-0.6, -0.4), 0.0], np.float32)
+        self._t = 0
+        return self._s.copy()
+
+    def step(self, action: int):
+        pos, vel = float(self._s[0]), float(self._s[1])
+        vel += (action - 1) * 0.001 + np.cos(3 * pos) * (-0.0025)
+        vel = float(np.clip(vel, -0.07, 0.07))
+        pos = float(np.clip(pos + vel, -1.2, 0.6))
+        if pos <= -1.2:
+            vel = 0.0
+        self._s = np.array([pos, vel], np.float32)
+        self._t += 1
+        done = pos >= 0.5 or self._t >= self.horizon
+        return self._s.copy(), -1.0, done, {}
+
+
+class GymEnvAdapter(MDP):
+    """Adapter over a gymnasium/gym environment (ref: rl4j-gym's GymEnv via
+    gym-java-client). Gated: neither package ships in this image, so
+    construction raises with instructions unless one is importable; the
+    adapter itself handles both the 5-tuple (gymnasium) and 4-tuple (legacy
+    gym) step signatures."""
+
+    def __init__(self, env_id: str, **make_kwargs):
+        gym = None
+        for mod in ("gymnasium", "gym"):
+            try:
+                gym = __import__(mod)
+                break
+            except ImportError:
+                continue
+        if gym is None:
+            raise ImportError(
+                "GymEnvAdapter needs gymnasium or gym (neither is installed "
+                "in this environment); use the built-in CartPole/MountainCar/"
+                "ChainMDP envs instead")
+        self._env = gym.make(env_id, **make_kwargs)
+        self.obs_size = int(np.prod(self._env.observation_space.shape))
+        self.n_actions = int(self._env.action_space.n)
+
+    def reset(self):
+        out = self._env.reset()
+        obs = out[0] if isinstance(out, tuple) else out
+        return np.asarray(obs, np.float32).ravel()
+
+    def step(self, action: int):
+        out = self._env.step(int(action))
+        if len(out) == 5:  # gymnasium: obs, reward, terminated, truncated, info
+            obs, r, term, trunc, info = out
+            done = bool(term or trunc)
+        else:              # legacy gym: obs, reward, done, info
+            obs, r, done, info = out
+        return np.asarray(obs, np.float32).ravel(), float(r), done, info
+
+    def close(self):
+        self._env.close()

@@ -9,11 +9,12 @@ from deeplearning4j_tpu.text.vocab import VocabCache, VocabWord
 from deeplearning4j_tpu.text.word2vec import Word2Vec
 from deeplearning4j_tpu.text.paragraph_vectors import ParagraphVectors
 from deeplearning4j_tpu.text.glove import Glove
+from deeplearning4j_tpu.text.fasttext import FastText
 from deeplearning4j_tpu.text.serializer import WordVectorSerializer
 
 __all__ = [
     "DefaultTokenizerFactory", "NGramTokenizerFactory", "CommonPreprocessor",
     "LowCasePreProcessor", "BasicLineIterator", "CollectionSentenceIterator",
     "LineSentenceIterator", "VocabCache", "VocabWord", "Word2Vec",
-    "ParagraphVectors", "Glove", "WordVectorSerializer",
+    "ParagraphVectors", "Glove", "FastText", "WordVectorSerializer",
 ]

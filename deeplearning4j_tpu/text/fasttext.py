@@ -1,0 +1,185 @@
+"""FastText subword embeddings (ref: deeplearning4j-nlp
+org.deeplearning4j.models.fasttext.FastText — the reference wraps the C++
+fasttext binary via JNI; this is a native reimplementation of the
+skipgram-with-subwords model on the same batched negative-sampling trainer
+as word2vec.py, so it runs as jitted XLA scatter updates instead of
+hogwild threads).
+
+Model (Bojanowski et al. 2017): each word's input representation is the mean
+of its word vector and the vectors of its char n-grams (3..6 by default),
+hashed into a fixed bucket table. OOV words — the point of fastText — get a
+vector from their n-grams alone.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.text.word2vec import Word2Vec, WordVectorsModel
+
+BOW, EOW = "<", ">"
+
+
+def _ngrams(word: str, minn: int, maxn: int) -> List[str]:
+    w = BOW + word + EOW
+    out = []
+    for n in range(minn, maxn + 1):
+        for i in range(0, len(w) - n + 1):
+            g = w[i:i + n]
+            if g != w:  # the full token is the word vector itself
+                out.append(g)
+    return out
+
+
+def _hash(gram: str, bucket: int) -> int:
+    """FNV-1a 32-bit (with intended wraparound), the hash fastText uses for
+    n-gram bucketing."""
+    h = 2166136261
+    for b in gram.encode("utf-8"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h % bucket
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _ft_sg_step(syn0, syn1, sub_ids, sub_mask, ctx, neg, lr):
+    """One batched subword skip-gram/negative-sampling step.
+
+    syn0: (V + bucket, D) input table (words then n-gram buckets).
+    sub_ids/sub_mask: (B, M) constituent rows of each center word.
+    ctx: (B,) positive context ids into syn1; neg: (B, K) negatives.
+    """
+    B, M = sub_ids.shape
+    nsub = jnp.maximum(sub_mask.sum(axis=1, keepdims=True), 1.0)
+
+    # a sampled negative that IS the positive context would cancel the
+    # positive update — the reference (and word2vec._sg_step) skips those
+    valid = (neg != ctx[:, None]).astype(syn0.dtype)  # (B, K)
+
+    def loss_fn(tables):
+        s0, s1 = tables
+        h = (s0[sub_ids] * sub_mask[..., None]).sum(axis=1) / nsub  # (B, D)
+        pos = jnp.einsum("bd,bd->b", h, s1[ctx])
+        negs = jnp.einsum("bd,bkd->bk", h, s1[neg])
+        l = -jax.nn.log_sigmoid(pos).sum() \
+            - (jax.nn.log_sigmoid(-negs) * valid).sum()
+        return l / B
+
+    grads = jax.grad(loss_fn)((syn0, syn1))
+    # dense grads are zero except at touched rows; jnp scatter-add semantics
+    # already accumulated duplicates — plain SGD applies exactly
+    return syn0 - lr * grads[0], syn1 - lr * grads[1]
+
+
+class FastText(Word2Vec):
+    """(ref: org.deeplearning4j.models.fasttext.FastText + .Builder)."""
+
+    def __init__(self, minn=3, maxn=6, bucket=20000, **kw):
+        super().__init__(**kw)
+        self.minn = minn
+        self.maxn = maxn
+        self.bucket = bucket
+        self._sub_ids: Optional[np.ndarray] = None   # (V, M) padded
+        self._sub_mask: Optional[np.ndarray] = None
+
+    class Builder(Word2Vec.Builder):
+        def build(self) -> "FastText":
+            return FastText(**self._kw)
+
+    # ------------------------------------------------------------------ fit
+    def _build_subwords(self):
+        V = self.vocab.numWords()
+        rows: List[List[int]] = []
+        for i in range(V):
+            w = self.vocab.wordAtIndex(i)
+            ids = [i]  # the word's own vector row
+            ids += [V + _hash(g, self.bucket)
+                    for g in _ngrams(w, self.minn, self.maxn)]
+            rows.append(ids)
+        M = max(len(r) for r in rows)
+        sub = np.zeros((V, M), np.int32)
+        mask = np.zeros((V, M), np.float32)
+        for i, r in enumerate(rows):
+            sub[i, :len(r)] = r
+            mask[i, :len(r)] = 1.0
+        self._sub_ids, self._sub_mask = sub, mask
+
+    def fit(self):
+        for s in self.iterator:
+            for t in self.tokenizer.create(s).getTokens():
+                self.vocab.addToken(t)
+        self.vocab.finalize_vocab(self.minWordFrequency)
+        self._build_subwords()
+        V, D = self.vocab.numWords(), self.layerSize
+        rng = np.random.default_rng(self.seed)
+        syn0 = jnp.asarray(
+            (rng.random((V + self.bucket, D), np.float32) - 0.5) / D)
+        syn1 = jnp.zeros((V, D), jnp.float32)
+        table = self.vocab.unigram_table()
+        keep = (self.vocab.subsample_keep_prob(self.sampling)
+                if self.sampling > 0 else None)
+        sentences = self._sentences_as_ids()
+        sub_ids = jnp.asarray(self._sub_ids)
+        sub_mask = jnp.asarray(self._sub_mask)
+        n_ep = max(self.epochs * self.iterations, 1)
+        trained_any = False
+        for ep in range(n_ep):
+            # fresh pairs per epoch: subsampling + random window shrink are
+            # stochastic, exactly as Word2Vec.fit regenerates them
+            pairs = []
+            for ids in sentences:
+                if keep is not None:
+                    ids = ids[rng.random(len(ids)) < keep[ids]]
+                for i, c in enumerate(ids):
+                    win = rng.integers(1, self.windowSize + 1)
+                    lo, hi = max(0, i - win), min(len(ids), i + win + 1)
+                    for j in range(lo, hi):
+                        if j != i:
+                            pairs.append((c, ids[j]))
+            pairs = np.asarray(pairs, dtype=np.int32)
+            if not len(pairs):
+                continue  # subsampling can empty a tiny corpus this epoch
+            trained_any = True
+            rng.shuffle(pairs)
+            lr = max(self.learningRate * (1 - ep / n_ep), self.minLearningRate)
+            for k in range(0, len(pairs), self.batchSize):
+                b = pairs[k:k + self.batchSize]
+                neg = rng.choice(len(table), size=(len(b), self.negative),
+                                 p=table).astype(np.int32)
+                syn0, syn1 = _ft_sg_step(
+                    syn0, syn1, sub_ids[b[:, 0]], sub_mask[b[:, 0]],
+                    jnp.asarray(b[:, 1]), jnp.asarray(neg), lr)
+        if not trained_any:
+            raise ValueError("no training pairs in any epoch — corpus too small")
+        full = np.asarray(syn0)
+        self._bucket_table = full  # (V + bucket, D)
+        # materialized per-word vectors (word row + ngram mean), the public API
+        nsub = np.maximum(self._sub_mask.sum(axis=1, keepdims=True), 1.0)
+        self.syn0 = (full[self._sub_ids] *
+                     self._sub_mask[..., None]).sum(axis=1) / nsub
+        self._syn1 = np.zeros_like(self.syn0)
+        return self
+
+    # ---------------------------------------------------------------- query
+    def getWordVector(self, word: str) -> Optional[np.ndarray]:
+        v = super().getWordVector(word)
+        if v is not None:
+            return v
+        return self.getOOVVector(word)
+
+    def getOOVVector(self, word: str) -> Optional[np.ndarray]:
+        """Subword composition for out-of-vocabulary words
+        (ref: FastText.getWordVector on OOV — the defining capability)."""
+        if self._bucket_table is None:
+            return None
+        V = self.vocab.numWords()
+        ids = [V + _hash(g, self.bucket)
+               for g in _ngrams(word, self.minn, self.maxn)]
+        if not ids:
+            return None
+        return self._bucket_table[ids].mean(axis=0)
+
+    _bucket_table: Optional[np.ndarray] = None

@@ -55,3 +55,93 @@ class WordVectorSerializer:
         return model
 
     loadTxtVectors = readWord2VecModel
+
+    # ------------------------------------------------- Google binary format
+    @staticmethod
+    def writeBinaryModel(model: WordVectorsModel, path: str):
+        """Google News word2vec .bin format: "<vocab> <dim>\\n" then per word
+        "<word> " + dim*float32 little-endian (ref: writeBinary path of
+        WordVectorSerializer)."""
+        with open(path, "wb") as f:
+            f.write(f"{model.vocab.numWords()} {model.layerSize}\n".encode())
+            for i in range(model.vocab.numWords()):
+                f.write(model.vocab.wordAtIndex(i).encode("utf-8") + b" ")
+                f.write(np.asarray(model.syn0[i], np.float32).tobytes())
+                f.write(b"\n")
+
+    @staticmethod
+    def readBinaryModel(path: str) -> Word2Vec:
+        """(ref: readBinaryModel — streams the Google News .bin format)."""
+        with open(path, "rb") as f:
+            header = f.readline().split()
+            vocab_size, dim = int(header[0]), int(header[1])
+            model = Word2Vec(layerSize=dim)
+            vecs = np.zeros((vocab_size, dim), np.float32)
+            words = []
+            for _ in range(vocab_size):
+                chars = bytearray()
+                while True:
+                    c = f.read(1)
+                    if c in (b" ", b""):
+                        break
+                    if c != b"\n":  # leading newline from previous record
+                        chars.extend(c)
+                words.append(chars.decode("utf-8"))
+                vecs[len(words) - 1] = np.frombuffer(
+                    f.read(4 * dim), dtype="<f4")
+        for w in words:
+            model.vocab.addToken(w)
+        model.vocab.finalize_vocab(1)
+        syn0 = np.zeros_like(vecs)
+        for w, v in zip(words, vecs):
+            syn0[model.vocab.indexOf(w)] = v
+        model.syn0 = syn0
+        return model
+
+    # ------------------------------------------------- ParagraphVectors serde
+    @staticmethod
+    def writeParagraphVectors(model, path: str):
+        """npz container: word tables + doc labels/vectors
+        (ref: writeParagraphVectors zip format)."""
+        from deeplearning4j_tpu.text.paragraph_vectors import ParagraphVectors
+        assert isinstance(model, ParagraphVectors)
+        words = [model.vocab.wordAtIndex(i)
+                 for i in range(model.vocab.numWords())]
+        np.savez_compressed(
+            path,
+            words=np.array(words, dtype=object),
+            syn0=np.asarray(model.syn0, np.float32),
+            syn1=np.asarray(model._syn1, np.float32),  # inferVector needs it
+            doc_labels=np.array(model.doc_labels, dtype=object),
+            doc_vectors=np.asarray(model.doc_vectors, np.float32),
+            layer_size=np.int64(model.layerSize))
+
+    @staticmethod
+    def readParagraphVectors(path: str):
+        """(ref: readParagraphVectors)."""
+        from deeplearning4j_tpu.text.paragraph_vectors import ParagraphVectors
+        z = np.load(path if str(path).endswith(".npz") else str(path) + ".npz",
+                    allow_pickle=True)
+        model = ParagraphVectors(layerSize=int(z["layer_size"]))
+        for w in z["words"]:
+            model.vocab.addToken(str(w))
+        model.vocab.finalize_vocab(1)
+        syn0 = np.zeros_like(z["syn0"])
+        syn1 = np.zeros_like(z["syn0"])
+        for i, w in enumerate(z["words"]):
+            j = model.vocab.indexOf(str(w))
+            syn0[j] = z["syn0"][i]
+            if "syn1" in z:
+                syn1[j] = z["syn1"][i]
+        model.syn0 = syn0
+        model._syn1 = syn1
+        model.doc_labels = [str(l) for l in z["doc_labels"]]
+        model.doc_vectors = z["doc_vectors"]
+        return model
+
+    # ------------------------------------------------------- GloVe text load
+    @staticmethod
+    def loadGloveVectors(path: str) -> Word2Vec:
+        """GloVe's headerless text format — same parser, header optional
+        (ref: loadTxtVectors handles both)."""
+        return WordVectorSerializer.readWord2VecModel(path)
