@@ -34,9 +34,6 @@ class OnnxRunner:
         outs = outputs or self.output_names
         res = self._sd.output({k: np.asarray(v) for k, v in inputs.items()},
                               outs)
-        if not isinstance(res, dict):
-            res = {outs[0]: res}
-        return {k: np.asarray(v.toNumpy() if hasattr(v, "toNumpy") else v)
-                for k, v in res.items()}
+        return {k: np.asarray(v.toNumpy()) for k, v in res.items()}
 
     exec = run  # reference method name

@@ -185,9 +185,39 @@ def _map_layer(cls: str, c: dict) -> Tuple[Optional[L.Layer], bool]:
                                     decay=c.get("momentum", 0.99)), True
     if cls == "Dropout":
         return L.DropoutLayer(dropOut=1.0 - c["rate"]), False
+    if cls == "GaussianDropout":
+        from deeplearning4j_tpu.nn.conf.dropout import GaussianDropout
+        return L.DropoutLayer(dropOut=GaussianDropout(rate=c["rate"])), False
+    if cls == "GaussianNoise":
+        from deeplearning4j_tpu.nn.conf.dropout import GaussianNoise
+        return L.DropoutLayer(dropOut=GaussianNoise(stddev=c["stddev"])), False
+    if cls == "AlphaDropout":
+        from deeplearning4j_tpu.nn.conf.dropout import AlphaDropout
+        return L.DropoutLayer(dropOut=AlphaDropout(p=1.0 - c["rate"])), False
+    if cls in ("SpatialDropout1D", "SpatialDropout2D", "SpatialDropout3D"):
+        from deeplearning4j_tpu.nn.conf.dropout import SpatialDropout
+        return L.DropoutLayer(dropOut=SpatialDropout(p=1.0 - c["rate"])), False
+    if cls == "ThresholdedReLU":
+        return L.ActivationLayer(activation="THRESHOLDEDRELU",
+                                 alpha=c.get("theta", 1.0)), False
     if cls == "Activation":
         return L.ActivationLayer(activation=act), False
     if cls == "ReLU":
+        # Keras 3 folded ThresholdedReLU into ReLU(threshold=...); honor the
+        # parameterization instead of silently dropping it
+        thr = c.get("threshold", 0.0) or 0.0
+        ns = c.get("negative_slope", 0.0) or 0.0
+        mv = c.get("max_value")
+        if thr and not ns and mv is None:
+            return L.ActivationLayer(activation="THRESHOLDEDRELU", alpha=thr), False
+        if ns and not thr and mv is None:
+            return L.ActivationLayer(activation="LEAKYRELU", alpha=ns), False
+        if mv == 6.0 and not thr and not ns:
+            return L.ActivationLayer(activation="RELU6"), False
+        if thr or ns or mv is not None:
+            raise ValueError(
+                f"ReLU(threshold={thr}, negative_slope={ns}, max_value={mv}) "
+                "combination not supported by the importer")
         return L.ActivationLayer(activation="RELU"), False
     if cls == "LeakyReLU":
         # Keras default negative_slope is 0.3 (keras-2 key: "alpha")

@@ -96,10 +96,14 @@ class ComputationGraph:
             x = self._adapt(layer, xs[0])
             r = rngs[li] if rngs is not None else None
             li += 1
-            if training and layer.dropOut is not None and layer.dropOut < 1.0 and r is not None:
-                keep = layer.dropOut
-                m = jax.random.bernoulli(jax.random.fold_in(r, 7), keep, x.shape)
-                x = jnp.where(m, x / keep, 0.0)
+            # conf-level input dropout — but NOT for DropoutLayer itself,
+            # whose apply() already drops (double-apply over-regularizes;
+            # same guard as multilayer.py's _DropoutLike check)
+            from deeplearning4j_tpu.nn.conf.layers import DropoutLayer as _DL
+            if training and layer.dropOut is not None and r is not None \
+                    and not isinstance(layer, _DL):
+                from deeplearning4j_tpu.nn.conf.dropout import apply_dropout
+                x = apply_dropout(layer.dropOut, jax.random.fold_in(r, 7), x)
             kwargs = {}
             mask = (masks or {}).get(node.inputs[0])
             if isinstance(layer, (BaseRecurrentLayer, Bidirectional, LastTimeStep,

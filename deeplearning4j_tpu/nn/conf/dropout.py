@@ -1,0 +1,135 @@
+"""Dropout variants (ref: org.deeplearning4j.nn.conf.dropout — IDropout SPI
+with Dropout, GaussianDropout, GaussianNoise, AlphaDropout, SpatialDropout).
+
+The reference applies these to a layer's INPUT during training via the
+conf-level ``dropOut`` setting; here ``Layer.dropOut`` accepts either a float
+(retain probability, plain inverted dropout — dl4j semantics, unchanged) or
+one of these objects. All are pure functions of (rng, x) so they live inside
+the fused jitted train step.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class IDropout:
+    """(ref: org.deeplearning4j.nn.conf.dropout.IDropout)."""
+
+    def apply(self, rng, x):
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        out = {"@dropout": type(self).__name__}
+        out.update({k: v for k, v in self.__dict__.items()})
+        return out
+
+    @staticmethod
+    def from_dict(d: dict) -> "IDropout":
+        d = dict(d)
+        cls = DROPOUT_TYPES[d.pop("@dropout")]
+        return cls(**d)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+
+class Dropout(IDropout):
+    """Plain inverted dropout; ``p`` is the RETAIN probability (dl4j
+    semantics, matching the float form of ``dropOut``)."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def apply(self, rng, x):
+        if self.p >= 1.0:
+            return x
+        mask = jax.random.bernoulli(rng, self.p, x.shape)
+        return jnp.where(mask, x / self.p, 0.0)
+
+
+class GaussianDropout(IDropout):
+    """Multiplicative gaussian noise N(1, sqrt(rate/(1-rate)))
+    (ref: GaussianDropout; Srivastava et al. §10)."""
+
+    def __init__(self, rate: float = 0.1):
+        self.rate = float(rate)
+
+    def apply(self, rng, x):
+        if self.rate <= 0.0:
+            return x
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + std * jax.random.normal(rng, x.shape, jnp.float32)
+        return x * noise.astype(x.dtype)
+
+
+class GaussianNoise(IDropout):
+    """Additive gaussian noise N(0, stddev) (ref: GaussianNoise)."""
+
+    def __init__(self, stddev: float = 0.1):
+        self.stddev = float(stddev)
+
+    def apply(self, rng, x):
+        n = self.stddev * jax.random.normal(rng, x.shape, jnp.float32)
+        return x + n.astype(x.dtype)
+
+
+class AlphaDropout(IDropout):
+    """SELU-preserving dropout (ref: AlphaDropout; Klambauer et al.): dropped
+    units are set to alpha' and an affine correction keeps self-normalizing
+    mean/variance. ``p`` is the RETAIN probability."""
+
+    _ALPHA = 1.6732632423543772
+    _LAMBDA = 1.0507009873554805
+
+    def __init__(self, p: float = 0.95):
+        self.p = float(p)
+
+    def apply(self, rng, x):
+        if self.p >= 1.0:
+            return x
+        p = self.p
+        alpha_p = -self._LAMBDA * self._ALPHA
+        a = (p + alpha_p ** 2 * p * (1 - p)) ** -0.5
+        b = -a * (1 - p) * alpha_p
+        mask = jax.random.bernoulli(rng, p, x.shape)
+        return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+class SpatialDropout(IDropout):
+    """Channel-wise dropout (ref: SpatialDropout; Tompson et al.): drops whole
+    feature maps. Channel axis 1 for conv inputs (NCHW/NCW/NCDHW rank>=3);
+    the last axis for 2D (B, F). ``p`` is the RETAIN probability."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def apply(self, rng, x):
+        if self.p >= 1.0:
+            return x
+        if x.ndim >= 3:
+            shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+        else:
+            shape = x.shape
+        mask = jax.random.bernoulli(rng, self.p, shape)
+        return jnp.where(mask, x / self.p, 0.0)
+
+
+DROPOUT_TYPES = {c.__name__: c for c in
+                 (Dropout, GaussianDropout, GaussianNoise, AlphaDropout,
+                  SpatialDropout)}
+
+
+def apply_dropout(drop, rng, x):
+    """Dispatch helper: float = retain prob (legacy path), IDropout = SPI."""
+    if drop is None or rng is None:
+        return x
+    if isinstance(drop, IDropout):
+        return drop.apply(rng, x)
+    keep = float(drop)
+    if keep >= 1.0:
+        return x
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)

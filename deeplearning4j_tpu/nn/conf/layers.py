@@ -98,9 +98,12 @@ class Layer:
 
     # ---- serde
     def to_dict(self) -> dict:
+        from deeplearning4j_tpu.nn.conf.dropout import IDropout
         out = {"@type": type(self).__name__}
         for k, v in self.__dict__.items():
             if isinstance(v, Layer):
+                out[k] = v.to_dict()
+            elif isinstance(v, IDropout):
                 out[k] = v.to_dict()
             elif isinstance(v, tuple):
                 out[k] = list(v)
@@ -114,7 +117,10 @@ class Layer:
         cls = LAYER_TYPES[d.pop("@type")]
         frozen = d.pop("frozen", False)  # set dynamically by TransferLearning
         for k, v in list(d.items()):
-            if isinstance(v, dict) and "@type" in v:
+            if isinstance(v, dict) and "@dropout" in v:
+                from deeplearning4j_tpu.nn.conf.dropout import IDropout
+                d[k] = IDropout.from_dict(v)
+            elif isinstance(v, dict) and "@type" in v:
                 d[k] = Layer.from_dict(v)
             elif isinstance(v, list) and k in ("kernelSize", "stride", "padding", "dilation",
                                                "size", "cropping", "blocks", "poolingDimensions"):
@@ -524,11 +530,10 @@ class DropoutLayer(Layer):
             self.dropOut = 0.5
 
     def apply(self, params, x, *, training=False, rng=None, state=None):
-        if not training or self.dropOut >= 1.0 or rng is None:
+        if not training or rng is None:
             return x, state
-        keep = self.dropOut
-        mask = jax.random.bernoulli(rng, keep, x.shape)
-        return jnp.where(mask, x / keep, 0.0), state
+        from deeplearning4j_tpu.nn.conf.dropout import apply_dropout
+        return apply_dropout(self.dropOut, rng, x), state
 
 
 @dataclass
@@ -542,6 +547,9 @@ class ActivationLayer(Layer):
             return jax.nn.leaky_relu(x, self.alpha), state
         if self.alpha is not None and (self.activation or "").upper() == "ELU":
             return jax.nn.elu(x, self.alpha), state
+        if (self.activation or "").upper() == "THRESHOLDEDRELU":
+            theta = self.alpha if self.alpha is not None else 1.0
+            return jnp.where(x > theta, x, 0.0), state
         return self._activate(x), state
 
 

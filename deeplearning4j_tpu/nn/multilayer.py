@@ -147,10 +147,10 @@ class MultiLayerNetwork:
                 x = x.reshape(x.shape[0], -1)
             # dl4j conf-level dropout: applied to the layer INPUT during training
             if training and layer.dropOut is not None and not isinstance(layer, _DropoutLike):
-                keep = layer.dropOut
-                if keep < 1.0 and rngs[i] is not None:
-                    m = jax.random.bernoulli(jax.random.fold_in(rngs[i], 7), keep, x.shape)
-                    x = jnp.where(m, x / keep, 0.0)
+                from deeplearning4j_tpu.nn.conf.dropout import apply_dropout
+                if rngs[i] is not None:
+                    x = apply_dropout(layer.dropOut,
+                                      jax.random.fold_in(rngs[i], 7), x)
             if rnn_states is not None and isinstance(layer, BaseRecurrentLayer) \
                     and rnn_states[i]:
                 kwargs = {"mask": mask} if mask is not None else {}

@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.text.word2vec import Word2Vec, WordVectorsModel
+from deeplearning4j_tpu.text.word2vec import (Word2Vec, WordVectorsModel,
+                                              _mean_scatter)
 
 BOW, EOW = "<", ">"
 
@@ -44,34 +45,43 @@ def _hash(gram: str, bucket: int) -> int:
     return h % bucket
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
+@jax.jit
 def _ft_sg_step(syn0, syn1, sub_ids, sub_mask, ctx, neg, lr):
-    """One batched subword skip-gram/negative-sampling step.
+    """One batched subword skip-gram/negative-sampling step. Structurally
+    _cbow_step with the window mean replaced by the subword mean: manual
+    per-pair gradients scattered sparsely through _mean_scatter's bounded
+    accumulation (see word2vec.py for why plain summed/mean updates are
+    wrong), NOT dense autodiff over the whole (V+bucket, D) table.
 
     syn0: (V + bucket, D) input table (words then n-gram buckets).
     sub_ids/sub_mask: (B, M) constituent rows of each center word.
     ctx: (B,) positive context ids into syn1; neg: (B, K) negatives.
     """
-    B, M = sub_ids.shape
-    nsub = jnp.maximum(sub_mask.sum(axis=1, keepdims=True), 1.0)
-
+    vs = syn0[sub_ids] * sub_mask[:, :, None]
+    denom = jnp.maximum(sub_mask.sum(-1, keepdims=True), 1.0)
+    h = vs.sum(1) / denom                                        # (B, D)
+    u_pos = syn1[ctx]
+    u_neg = syn1[neg]
+    s_pos = jax.nn.sigmoid(jnp.sum(h * u_pos, axis=-1))
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u_neg))
     # a sampled negative that IS the positive context would cancel the
     # positive update — the reference (and word2vec._sg_step) skips those
-    valid = (neg != ctx[:, None]).astype(syn0.dtype)  # (B, K)
-
-    def loss_fn(tables):
-        s0, s1 = tables
-        h = (s0[sub_ids] * sub_mask[..., None]).sum(axis=1) / nsub  # (B, D)
-        pos = jnp.einsum("bd,bd->b", h, s1[ctx])
-        negs = jnp.einsum("bd,bkd->bk", h, s1[neg])
-        l = -jax.nn.log_sigmoid(pos).sum() \
-            - (jax.nn.log_sigmoid(-negs) * valid).sum()
-        return l / B
-
-    grads = jax.grad(loss_fn)((syn0, syn1))
-    # dense grads are zero except at touched rows; jnp scatter-add semantics
-    # already accumulated duplicates — plain SGD applies exactly
-    return syn0 - lr * grads[0], syn1 - lr * grads[1]
+    valid = (neg != ctx[:, None]).astype(s_neg.dtype)
+    s_neg = s_neg * valid
+    g_pos = (s_pos - 1.0)[:, None]
+    grad_h = g_pos * u_pos + jnp.einsum("bk,bkd->bd", s_neg, u_neg)
+    D = h.shape[-1]
+    syn1 = _mean_scatter(
+        syn1, jnp.concatenate([ctx, neg.reshape(-1)]),
+        jnp.concatenate([g_pos * h,
+                         (s_neg[:, :, None] * h[:, None, :]).reshape(-1, D)]),
+        lr,
+        weights=jnp.concatenate([jnp.ones_like(ctx, valid.dtype),
+                                 valid.reshape(-1)]))
+    grad_sub = (grad_h / denom)[:, None, :] * sub_mask[:, :, None]
+    syn0 = _mean_scatter(syn0, sub_ids.reshape(-1), grad_sub.reshape(-1, D),
+                         lr, weights=sub_mask.reshape(-1))
+    return syn0, syn1
 
 
 class FastText(Word2Vec):
@@ -156,10 +166,16 @@ class FastText(Word2Vec):
             raise ValueError("no training pairs in any epoch — corpus too small")
         full = np.asarray(syn0)
         self._bucket_table = full  # (V + bucket, D)
-        # materialized per-word vectors (word row + ngram mean), the public API
+        # materialized per-word vectors (word row + ngram mean), the public
+        # API — chunked over vocab rows so the transient (chunk, M, D) stays
+        # small (a one-shot (V, M, D) gather can be GBs on realistic vocabs)
         nsub = np.maximum(self._sub_mask.sum(axis=1, keepdims=True), 1.0)
-        self.syn0 = (full[self._sub_ids] *
-                     self._sub_mask[..., None]).sum(axis=1) / nsub
+        out = np.empty((V, D), np.float32)
+        for lo in range(0, V, 1024):
+            hi = min(lo + 1024, V)
+            out[lo:hi] = (full[self._sub_ids[lo:hi]] *
+                          self._sub_mask[lo:hi, :, None]).sum(axis=1) / nsub[lo:hi]
+        self.syn0 = out
         self._syn1 = np.zeros_like(self.syn0)
         return self
 

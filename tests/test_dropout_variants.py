@@ -1,0 +1,159 @@
+"""Dropout-variant SPI (ref: org.deeplearning4j.nn.conf.dropout — IDropout,
+GaussianDropout, GaussianNoise, AlphaDropout, SpatialDropout)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.dropout import (
+    AlphaDropout, Dropout, GaussianDropout, GaussianNoise, IDropout,
+    SpatialDropout, apply_dropout)
+
+KEY = jax.random.PRNGKey(0)
+X = jnp.ones((256, 64), jnp.float32)
+
+
+class TestVariants:
+    def test_dropout_mean_preserved(self):
+        y = Dropout(p=0.8).apply(KEY, X)
+        assert abs(float(y.mean()) - 1.0) < 0.05
+        assert float((y == 0).mean()) == pytest.approx(0.2, abs=0.05)
+
+    def test_gaussian_dropout_multiplicative(self):
+        y = GaussianDropout(rate=0.2).apply(KEY, X)
+        assert abs(float(y.mean()) - 1.0) < 0.05
+        want_std = (0.2 / 0.8) ** 0.5
+        assert float(y.std()) == pytest.approx(want_std, rel=0.1)
+
+    def test_gaussian_noise_additive(self):
+        y = GaussianNoise(stddev=0.3).apply(KEY, X)
+        assert abs(float(y.mean()) - 1.0) < 0.05
+        assert float(y.std()) == pytest.approx(0.3, rel=0.1)
+
+    def test_alpha_dropout_preserves_selu_stats(self):
+        # self-normalized input: N(0, 1)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4096,), jnp.float32)
+        y = AlphaDropout(p=0.9).apply(KEY, x)
+        assert abs(float(y.mean())) < 0.1
+        assert float(y.std()) == pytest.approx(1.0, rel=0.15)
+
+    def test_spatial_dropout_drops_whole_channels(self):
+        x = jnp.ones((4, 16, 8, 8), jnp.float32)  # NCHW
+        y = np.asarray(SpatialDropout(p=0.5).apply(KEY, x))
+        per_channel = y.reshape(4, 16, -1)
+        for b in range(4):
+            for c in range(16):
+                vals = np.unique(per_channel[b, c])
+                assert len(vals) == 1  # all-kept (scaled) or all-zero
+        kept = (per_channel.sum(-1) != 0).mean()
+        assert kept == pytest.approx(0.5, abs=0.2)
+
+    def test_float_legacy_path(self):
+        y = apply_dropout(0.5, KEY, X)
+        assert float((np.asarray(y) == 0).mean()) == pytest.approx(0.5, abs=0.08)
+
+
+class TestSerdeAndTraining:
+    def test_json_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            DenseLayer, DropoutLayer, OutputLayer)
+        from deeplearning4j_tpu.train.updaters import Adam
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(nOut=8, activation="RELU",
+                                  dropOut=GaussianDropout(rate=0.2)))
+                .layer(DropoutLayer(dropOut=AlphaDropout(p=0.9)))
+                .layer(OutputLayer(nOut=2, lossFunction="MCXENT"))
+                .setInputType(InputType.feedForward(4)).build())
+        back = type(conf).from_json(conf.to_json())
+        assert back.layers[0].dropOut == GaussianDropout(rate=0.2)
+        assert back.layers[1].dropOut == AlphaDropout(p=0.9)
+
+    def test_training_with_variants_converges(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.train.updaters import Adam
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(nOut=16, activation="RELU",
+                                  dropOut=GaussianNoise(stddev=0.05)))
+                .layer(OutputLayer(nOut=2, lossFunction="MCXENT"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(DataSet(x, y), epochs=30)
+        assert net.score() < 0.4
+        out = np.asarray(net.output(x))
+        acc = (out.argmax(1) == y.argmax(1)).mean()
+        assert acc > 0.85
+
+    def test_inference_is_noise_free(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.train.updaters import Adam
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(nOut=8, activation="TANH",
+                                  dropOut=SpatialDropout(p=0.5)))
+                .layer(OutputLayer(nOut=2, lossFunction="MCXENT"))
+                .setInputType(InputType.feedForward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+        o1 = np.asarray(net.output(x))
+        o2 = np.asarray(net.output(x))
+        np.testing.assert_allclose(o1, o2)  # deterministic at inference
+
+
+class TestComputationGraphDropout:
+    def test_dropout_layer_not_double_applied(self):
+        """CG must not apply conf-level input dropout to a DropoutLayer whose
+        apply() already drops (zero fraction would exceed 1-p)."""
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DropoutLayer, OutputLayer
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.train.updaters import Adam
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("drop", DropoutLayer(dropOut=0.8), "in")
+                .addLayer("out", OutputLayer(nOut=2, lossFunction="MCXENT"), "drop")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(64)).build())
+        from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+        net = ComputationGraph(conf).init()
+        x = jnp.ones((128, 64), jnp.float32)
+        acts, _ = net._forward(net._params, net._state, {"in": x},
+                               training=True, rng=jax.random.PRNGKey(0))
+        zero_frac = float((np.asarray(acts["drop"]) == 0).mean())
+        assert zero_frac == pytest.approx(0.2, abs=0.05)  # NOT ~0.36
+
+
+class TestKerasMappers:
+    def test_keras_dropout_variants_import(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        import tensorflow as tf
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        model = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.GaussianDropout(0.2),
+            keras.layers.AlphaDropout(0.1),
+            keras.layers.ThresholdedReLU(theta=0.5)
+            if hasattr(keras.layers, "ThresholdedReLU") else
+            keras.layers.ReLU(threshold=0.5),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        p = str(tmp_path / "m.h5")
+        model.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        ours = np.asarray(net.output(x))
+        theirs = model.predict(x, verbose=0)
+        np.testing.assert_allclose(ours, theirs, atol=1e-5)

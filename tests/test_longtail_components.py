@@ -46,7 +46,9 @@ class TestFastText:
         def cos(a, b):
             return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
         v_fox = ft.getWordVector("foxes")
-        assert cos(oov, v_fox) > cos(ft.getWordVector("tree"), v_fox) - 0.5
+        # subword sharing makes the misspelling strictly closer than an
+        # unrelated word
+        assert cos(oov, v_fox) > cos(ft.getWordVector("tree"), v_fox)
 
     def test_builder(self):
         from deeplearning4j_tpu.text import FastText
