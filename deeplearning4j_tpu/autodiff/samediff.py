@@ -211,6 +211,8 @@ class SameDiff:
         self.linalg = GraphNamespace(self, "linalg")
         self.reduce = GraphNamespace(self, "reduce")
         self.shapes = GraphNamespace(self, "shape")
+        self.random = GraphNamespace(self, "random")    # ref: SDRandom
+        self.updaters = GraphNamespace(self, "updaters")  # ref: updater ops
 
     @staticmethod
     def create() -> "SameDiff":

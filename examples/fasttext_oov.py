@@ -1,0 +1,56 @@
+"""FastText subword embeddings: OOV vectors + serializer formats
+(ref: dl4j-examples FastText usage; deeplearning4j-nlp
+org.deeplearning4j.models.fasttext.FastText).
+
+Trains subword skip-gram on a tiny corpus, queries a vector for a word that
+was NEVER seen in training (composed from its character n-grams — the
+defining fastText capability), and round-trips the model through the
+Google-binary and text serializer formats.
+"""
+import _bootstrap  # noqa: F401
+
+import os
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.text import (
+    CollectionSentenceIterator, FastText, WordVectorSerializer)
+
+corpus = []
+for i in range(80):
+    corpus += ["the quick brown fox jumps over the lazy dog",
+               "foxes and dogs are clever animals",
+               "a quick cat naps under the warm sun"]
+
+ft = FastText(minWordFrequency=1, layerSize=24, epochs=3, seed=7, bucket=1024,
+              minn=3, maxn=5, iterate=CollectionSentenceIterator(corpus))
+ft.fit()
+
+print("in-vocab 'fox':", np.round(ft.getWordVector("fox")[:4], 3))
+assert not ft.hasWord("foxy")
+oov = ft.getWordVector("foxy")  # composed from <fo, fox, oxy, xy>, ...
+print("OOV 'foxy' (subword-composed):", np.round(oov[:4], 3))
+
+
+def cos(a, b):
+    return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+
+
+print(f"cos(foxy, fox)={cos(oov, ft.getWordVector('fox')):.3f}  "
+      f"cos(foxy, sun)={cos(oov, ft.getWordVector('sun')):.3f}")
+
+with tempfile.TemporaryDirectory() as d:
+    binp = os.path.join(d, "vectors.bin")
+    WordVectorSerializer.writeBinaryModel(ft, binp)
+    back = WordVectorSerializer.readBinaryModel(binp)
+    assert np.allclose(back.getWordVector("fox"), ft.getWordVector("fox"),
+                       rtol=1e-6)
+    print("Google-binary round-trip OK:", os.path.getsize(binp), "bytes")
+
+    txtp = os.path.join(d, "vectors.txt")
+    WordVectorSerializer.writeWord2VecModel(ft, txtp)
+    back_txt = WordVectorSerializer.readWord2VecModel(txtp)
+    assert np.allclose(back_txt.getWordVector("fox"), ft.getWordVector("fox"),
+                       atol=1e-5)  # text format stores 6 decimals
+    print("text-format round-trip OK:", os.path.getsize(txtp), "bytes")

@@ -273,3 +273,20 @@ class TestEvaluateApi:
         ev = sd.evaluate(ListDataSetIterator([DataSet(X, Y)], batch_size=64),
                          "probs", Evaluation())
         assert ev.accuracy() > 0.9, ev.stats()
+
+
+def test_random_and_updaters_namespaces():
+    """sd.random (ref: SDRandom) and sd.updaters (ref: libnd4j updater ops)
+    are graph namespaces over the same registry; static args (shape,
+    hyperparams) pass as kwargs."""
+    import jax
+    sd = SameDiff.create()
+    k = sd.constant("key", jax.random.PRNGKey(0))
+    r = sd.random.normal(k, shape=(4,))
+    out = sd.output({}, r.name)[r.name].toNumpy()
+    assert out.shape == (4,) and np.isfinite(out).all()
+
+    sd2 = SameDiff.create()
+    g = sd2.var("g", np.ones(3, np.float32))
+    u = sd2.updaters.sgdUpdater(g, lr=0.5)
+    np.testing.assert_allclose(sd2.output({}, u.name)[u.name].toNumpy(), 0.5)

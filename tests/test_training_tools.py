@@ -252,3 +252,118 @@ def test_early_stopping_immediate_stop_returns_result(tmp_path):
     result = EarlyStoppingTrainer(esc, _net(), ListDataSetIterator(ds.batchBy(8))).fit()
     assert result.terminationReason == "IterationTerminationCondition"
     assert result.bestModel is not None
+
+
+class TestResourcesAndArchives:
+    """(ref: nd4j-common Resources/ArchiveUtils — SURVEY §2.2)."""
+
+    def test_zip_roundtrip_and_traversal_guard(self, tmp_path):
+        from deeplearning4j_tpu.util.resources import ArchiveUtils
+        src = tmp_path / "src"; (src / "sub").mkdir(parents=True)
+        (src / "a.txt").write_text("alpha")
+        (src / "sub" / "b.txt").write_text("beta")
+        arc = tmp_path / "a.zip"
+        ArchiveUtils.zipDirectory(str(src), str(arc))
+        dest = tmp_path / "out"
+        ArchiveUtils.unzipFileTo(str(arc), str(dest))
+        assert (dest / "sub" / "b.txt").read_text() == "beta"
+        # traversal guard
+        import zipfile
+        evil = tmp_path / "evil.zip"
+        with zipfile.ZipFile(evil, "w") as zf:
+            zf.writestr("../escape.txt", "x")
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="escapes"):
+            ArchiveUtils.unzipFileTo(str(evil), str(dest))
+
+    def test_tar_extract_single(self, tmp_path):
+        import tarfile
+        from deeplearning4j_tpu.util.resources import ArchiveUtils
+        f = tmp_path / "x.txt"; f.write_text("payload")
+        arc = tmp_path / "t.tgz"
+        with tarfile.open(arc, "w:gz") as tf:
+            tf.add(f, arcname="data/x.txt")
+        out = tmp_path / "only.txt"
+        ArchiveUtils.tarGzExtractSingleFile(str(arc), str(out), "data/x.txt")
+        assert out.read_text() == "payload"
+
+    def test_resources_cache_and_checksum(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.resources import Resources, sha256_of
+        monkeypatch.setenv("DL4JTPU_RESOURCES_CACHE_DIR", str(tmp_path))
+        import pytest as _pytest
+        with _pytest.raises(FileNotFoundError, match="fetch hook"):
+            Resources.asFile("missing.bin")
+        (tmp_path / "present.bin").write_bytes(b"12345")
+        p = Resources.asFile("present.bin", sha256=sha256_of(str(tmp_path / "present.bin")))
+        assert p.read_bytes() == b"12345"
+        with _pytest.raises(IOError, match="checksum"):
+            Resources.asFile("present.bin", sha256="0" * 64)
+
+    def test_fetch_hook(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.resources import Resources
+        monkeypatch.setenv("DL4JTPU_RESOURCES_CACHE_DIR", str(tmp_path))
+        Resources.registerFetchHook(
+            lambda name, dest: dest.write_text(f"fetched:{name}"))
+        try:
+            p = Resources.asFile("remote/thing.txt")
+            assert p.read_text() == "fetched:remote/thing.txt"
+        finally:
+            Resources.registerFetchHook(None)
+
+    def test_untar_symlink_traversal_blocked(self, tmp_path):
+        """A symlink member pointing outside dest + a file written through it
+        must be rejected (PEP 706 data filter)."""
+        import io
+        import tarfile
+        from deeplearning4j_tpu.util.resources import ArchiveUtils
+        arc = tmp_path / "evil.tgz"
+        with tarfile.open(arc, "w:gz") as tf:
+            link = tarfile.TarInfo("link")
+            link.type = tarfile.SYMTYPE
+            link.linkname = "../outside"
+            tf.addfile(link)
+            data = b"pwn"
+            fi = tarfile.TarInfo("link/pwn.txt")
+            fi.size = len(data)
+            tf.addfile(fi, io.BytesIO(data))
+        dest = tmp_path / "dest"
+        import pytest as _pytest
+        with _pytest.raises(tarfile.LinkOutsideDestinationError):
+            ArchiveUtils.untarTo(str(arc), str(dest))
+        assert not (tmp_path / "outside" / "pwn.txt").exists()
+        assert not (tmp_path / "outside").exists()
+
+    def test_resource_name_traversal_blocked(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.resources import Resources
+        monkeypatch.setenv("DL4JTPU_RESOURCES_CACHE_DIR", str(tmp_path / "cache"))
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="escapes"):
+            Resources.asFile("../evil.txt")
+
+    def test_partial_fetch_not_cached(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.resources import Resources
+        monkeypatch.setenv("DL4JTPU_RESOURCES_CACHE_DIR", str(tmp_path))
+
+        def bad_hook(name, dest):
+            dest.write_text("partial")
+            raise IOError("network drop mid-transfer")
+
+        Resources.registerFetchHook(bad_hook)
+        try:
+            import pytest as _pytest
+            with _pytest.raises(IOError, match="network drop"):
+                Resources.asFile("thing.bin")
+            # the aborted download must not pose as a cached resource
+            assert not (tmp_path / "thing.bin").exists()
+            assert not (tmp_path / "thing.bin.part").exists()
+        finally:
+            Resources.registerFetchHook(None)
+
+    def test_checksum_mismatch_evicts(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.resources import Resources
+        monkeypatch.setenv("DL4JTPU_RESOURCES_CACHE_DIR", str(tmp_path))
+        (tmp_path / "c.bin").write_bytes(b"corrupt")
+        import pytest as _pytest
+        with _pytest.raises(IOError, match="checksum"):
+            Resources.asFile("c.bin", sha256="0" * 64)
+        assert not (tmp_path / "c.bin").exists()
