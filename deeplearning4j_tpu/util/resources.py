@@ -117,8 +117,10 @@ class Resources:
         return cls._resolve(name).exists()
 
     @classmethod
-    def asFile(cls, name: str, sha256: Optional[str] = None) -> Path:
+    def asFile(cls, name: str, sha256: Optional[str] = None,
+               evictOnMismatch: bool = True) -> Path:
         p = cls._resolve(name)
+        fetched = False
         if not p.exists():
             if cls._fetch_hook is None:
                 raise FileNotFoundError(
@@ -126,18 +128,30 @@ class Resources:
                     "fetch hook is registered (zero-egress environment; seed "
                     "the cache manually or registerFetchHook)")
             p.parent.mkdir(parents=True, exist_ok=True)
-            # fetch to a temp sibling and rename on success so an aborted
-            # download never poses as a valid cached resource
-            tmp = p.with_name(p.name + ".part")
+            # fetch to a unique temp sibling and rename on success: an
+            # aborted download never poses as cached, and concurrent
+            # fetchers of the same name cannot clobber each other's temp
+            import tempfile
+            fd, tmp_name = tempfile.mkstemp(prefix=p.name + ".", suffix=".part",
+                                            dir=p.parent)
+            os.close(fd)
+            tmp = Path(tmp_name)
             try:
                 cls._fetch_hook(name, tmp)
                 os.replace(tmp, p)
+                fetched = True
             finally:
                 tmp.unlink(missing_ok=True)
         if sha256 is not None:
             got = sha256_of(str(p))
             if got != sha256:
-                p.unlink(missing_ok=True)  # don't let corrupt bytes pose as cached
+                # a freshly fetched artifact is certainly bad — evict it; a
+                # pre-seeded file is only evicted when the caller opts in
+                # (evictOnMismatch=False protects user-seeded weights)
+                note = ""
+                if fetched or evictOnMismatch:
+                    p.unlink(missing_ok=True)
+                    note = " (cached copy removed)"
                 raise IOError(f"checksum mismatch for {name}: expected "
-                              f"{sha256}, got {got} (cached copy removed)")
+                              f"{sha256}, got {got}{note}")
         return p

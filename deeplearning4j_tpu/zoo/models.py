@@ -4,10 +4,11 @@ SqueezeNet, Darknet19, UNet, Xception, TextGenerationLSTM).
 
 Each model is a config builder over the nn DSL, exactly as the reference's
 ZooModel.conf() methods build MultiLayerConfiguration/
-ComputationGraphConfiguration. Pretrained-weight downloads (ZooModel.
-initPretrained) require network access the build environment lacks — the
-hook exists and raises with a clear message; the Keras-h5 importer covers
-weight loading for users with local files."""
+ComputationGraphConfiguration. Pretrained weights load through the
+Resources cache resolver (ZooModel.initPretrained): local-first (seed
+~/.deeplearning4j_tpu/resources/zoo/) with checksum verification, plus a
+pluggable fetch hook for networked environments — this build environment
+itself has zero egress."""
 from __future__ import annotations
 
 from typing import Optional, Tuple
@@ -48,14 +49,55 @@ class ZooModel:
             return MultiLayerNetwork(c).init()
         return ComputationGraph(c).init()
 
-    def initPretrained(self, pretrained_type: str = "IMAGENET"):
-        raise NotImplementedError(
-            "pretrained weight download is unavailable in this environment; "
-            "use deeplearning4j_tpu.modelimport.keras to load local .h5 weights "
-            "(ref: ZooModel.initPretrained)")
+    def pretrainedResourceName(self, pretrained_type: str = "IMAGENET") -> str:
+        """Cache-relative resource name for this model's weights
+        (ref: ZooModel.pretrainedUrl — here a Resources cache key)."""
+        return f"zoo/{type(self).__name__.lower()}_{pretrained_type.lower()}.zip"
 
-    def pretrainedAvailable(self, *_):
-        return False
+    def pretrainedAvailable(self, pretrained_type: str = "IMAGENET") -> bool:
+        """True when weights are loadable: cached (zip or .h5 sibling), or
+        fetchable via a registered hook (ref: ZooModel.pretrainedUrl != null)."""
+        from deeplearning4j_tpu.util.resources import Resources
+        name = self.pretrainedResourceName(pretrained_type)
+        return (Resources.exists(name)
+                or Resources.exists(name.removesuffix(".zip") + ".h5")
+                or Resources._fetch_hook is not None)
+
+    def initPretrained(self, pretrained_type: str = "IMAGENET",
+                       sha256: Optional[str] = None):
+        """Load pretrained weights through the Resources resolver
+        (ref: ZooModel.initPretrained — download + cache + checksum; here
+        the cache is local-first and the download is a pluggable fetch hook,
+        since this environment has zero egress). The cached artifact is a
+        ModelSerializer zip, or a Keras .h5 sibling routed by the h5's own
+        model_config class. ``sha256`` applies to whichever artifact is
+        picked; a mismatch raises without deleting the seeded file."""
+        from deeplearning4j_tpu.util.resources import Resources
+        name = self.pretrainedResourceName(pretrained_type)
+        h5 = name.removesuffix(".zip") + ".h5"
+        picked = name if (Resources.exists(name)
+                          or not Resources.exists(h5)) else h5
+        try:
+            path = Resources.asFile(picked, sha256=sha256,
+                                    evictOnMismatch=False)
+        except FileNotFoundError:
+            raise FileNotFoundError(
+                f"no cached weights for {type(self).__name__} "
+                f"({pretrained_type}): seed {Resources.cacheDir() / name} "
+                "(ModelSerializer zip) or the .h5 sibling (Keras), or "
+                "registerFetchHook for networked environments "
+                "(ref: ZooModel.initPretrained)") from None
+        if str(path).endswith(".h5"):
+            import h5py
+            import json as _json
+            from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+            with h5py.File(str(path), "r") as f:
+                cls = _json.loads(f.attrs["model_config"])["class_name"]
+            if cls == "Sequential":
+                return KerasModelImport.importKerasSequentialModelAndWeights(str(path))
+            return KerasModelImport.importKerasModelAndWeights(str(path))
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        return ModelSerializer.restoreModel(str(path))
 
 
 class LeNet(ZooModel):

@@ -367,3 +367,12 @@ class TestResourcesAndArchives:
         with _pytest.raises(IOError, match="checksum"):
             Resources.asFile("c.bin", sha256="0" * 64)
         assert not (tmp_path / "c.bin").exists()
+
+    def test_checksum_mismatch_preserves_when_opted_out(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.resources import Resources
+        monkeypatch.setenv("DL4JTPU_RESOURCES_CACHE_DIR", str(tmp_path))
+        (tmp_path / "seeded.bin").write_bytes(b"user-seeded weights")
+        import pytest as _pytest
+        with _pytest.raises(IOError, match="checksum"):
+            Resources.asFile("seeded.bin", sha256="0" * 64, evictOnMismatch=False)
+        assert (tmp_path / "seeded.bin").exists()  # user data not destroyed

@@ -168,3 +168,43 @@ def test_nasnet_mobile_shapes():
     assert net.outputSingle(x).shape == (2, 3)
     net.fit(DataSet(x, y))
     assert np.isfinite(net.score())
+
+
+def test_init_pretrained_from_seeded_cache(tmp_path, monkeypatch):
+    """initPretrained resolves weights through the Resources cache
+    (ref: ZooModel.initPretrained download+cache+checksum; here local-first
+    with pluggable fetch)."""
+    import numpy as np
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+    from deeplearning4j_tpu.util.resources import sha256_of
+    from deeplearning4j_tpu.zoo.models import LeNet
+    monkeypatch.setenv("DL4JTPU_RESOURCES_CACHE_DIR", str(tmp_path))
+
+    zoo = LeNet(numClasses=10, inputShape=(1, 28, 28))
+    with pytest.raises(FileNotFoundError, match="seed"):
+        zoo.initPretrained("MNIST")
+
+    # seed the cache with a trained-ish snapshot, then load through the zoo
+    net = zoo.init()
+    dest = tmp_path / zoo.pretrainedResourceName("MNIST")
+    dest.parent.mkdir(parents=True)
+    ModelSerializer.writeModel(net, str(dest), saveUpdater=False)
+    loaded = zoo.initPretrained("MNIST", sha256=sha256_of(str(dest)))
+    np.testing.assert_allclose(loaded.params().toNumpy(),
+                               net.params().toNumpy(), atol=1e-6)
+
+
+def test_init_pretrained_bad_checksum_preserves_seed(tmp_path, monkeypatch):
+    """A wrong sha256 must raise but NOT delete the user's seeded weights."""
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+    from deeplearning4j_tpu.zoo.models import LeNet
+    monkeypatch.setenv("DL4JTPU_RESOURCES_CACHE_DIR", str(tmp_path))
+    zoo = LeNet(numClasses=10, inputShape=(1, 28, 28))
+    net = zoo.init()
+    dest = tmp_path / zoo.pretrainedResourceName("MNIST")
+    dest.parent.mkdir(parents=True)
+    ModelSerializer.writeModel(net, str(dest), saveUpdater=False)
+    assert zoo.pretrainedAvailable("MNIST")
+    with pytest.raises(IOError, match="checksum"):
+        zoo.initPretrained("MNIST", sha256="0" * 64)
+    assert dest.exists()
