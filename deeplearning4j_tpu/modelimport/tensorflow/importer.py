@@ -253,6 +253,12 @@ _UNARY = {
     "Reciprocal": ("math", "reciprocal"), "LogicalNot": ("math", "logicalNot"),
     "IsNan": ("math", "isnan"), "IsInf": ("math", "isinf"),
     "IsFinite": ("math", "isfinite"),
+    "Sinh": ("math", "sinh"), "Cosh": ("math", "cosh"),
+    "Asin": ("math", "asin"), "Acos": ("math", "acos"),
+    "Atan": ("math", "atan"), "Asinh": ("math", "asinh"),
+    "Acosh": ("math", "acosh"), "Atanh": ("math", "atanh"),
+    "Expm1": ("math", "expm1"), "Erfc": ("math", "erfc"),
+    "Digamma": ("math", "digamma"), "Lgamma": ("math", "lgamma"),
 }
 _REDUCE = {
     "Mean": "mean", "Sum": "sum", "Max": "max", "Min": "min", "Prod": "prod",
@@ -619,3 +625,177 @@ def _fused_bn(g, n):
     # NHWC: channel is the last axis
     return g._emit("nn", "batchNorm", [x, mean, var, gamma, beta],
                    n.name, eps=eps, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Round-2 widening: high-frequency frozen-graph ops beyond the original set
+# (ref: samediff-import-tensorflow per-op mapping rules for the same TF ops).
+
+@_rule("Tile")
+def _tile(g, n):
+    reps = tuple(int(r) for r in np.atleast_1d(g._const(n, 1)))
+    return g._emit("shape", "tile", [g._in(n, 0)], n.name, reps=reps)
+
+
+@_rule("Range")
+def _range(g, n):
+    start = float(np.atleast_1d(g._const(n, 0))[0])
+    limit = float(np.atleast_1d(g._const(n, 1))[0])
+    delta = float(np.atleast_1d(g._const(n, 2))[0])
+    out_dtype = n.attr["Tidx"].type if "Tidx" in n.attr else None
+    v = g._emit("shape", "arange", [], n.name, start=start, stop=limit,
+                step=delta)
+    if out_dtype in (3, 9):  # DT_INT32 / DT_INT64
+        v = g._emit("shape", "castTo", [v], n.name + "/cast",
+                    dtype="int32" if out_dtype == 3 else "int64")
+    return v
+
+
+@_rule("Slice")
+def _slice(g, n):
+    x = g._in(n, 0)
+    begin = [int(b) for b in np.atleast_1d(g._const(n, 1))]
+    size = [int(s) for s in np.atleast_1d(g._const(n, 2))]
+    # TF size=-1 means "to the end of the dim" — needs a static dim to resolve
+    for i, s in enumerate(size):
+        if s == -1 and (x.shape is None or x.shape[i] is None):
+            raise ValueError(
+                f"Slice '{n.name}': size=-1 over dynamic dim {i} cannot be "
+                "resolved at import time (shape unknown)")
+    size = [x.shape[i] - begin[i] if s == -1 else s
+            for i, s in enumerate(size)]
+    return g._emit("shape", "slice", [x], n.name, begin=tuple(begin),
+                   size=tuple(size))
+
+
+@_rule("Unpack")
+def _unpack(g, n):
+    axis = int(n.attr["axis"].i)
+    outs = g._emit("shape", "unstack", [g._in(n, 0)], n.name, axis=axis)
+    g._register_outputs(n, outs)
+    return None
+
+
+@_rule("ReverseV2")
+def _reverse_v2(g, n):
+    dims = tuple(int(a) for a in np.atleast_1d(g._const(n, 1)))
+    return g._emit("shape", "reverse", [g._in(n, 0)], n.name, dims=dims)
+
+
+@_rule("Cumsum")
+def _cumsum(g, n):
+    axis = int(np.atleast_1d(g._const(n, 1))[0])
+    exclusive = bool(n.attr["exclusive"].b)
+    reverse = bool(n.attr["reverse"].b)
+    x = g._in(n, 0)
+    if reverse:
+        x = g._emit("shape", "reverse", [x], n.name + "/rev_in", dims=(axis,))
+    out = g._emit("shape", "cumsum", [x], n.name + "/cs", axis=axis)
+    if exclusive:  # shift right by one along axis: out - x
+        out = g._emit("math", "sub", [out, x], n.name + "/excl")
+    if reverse:
+        out = g._emit("shape", "reverse", [out], n.name + "/rev_out",
+                      dims=(axis,))
+    return out
+
+
+@_rule("TopKV2")
+def _topk(g, n):
+    k = int(np.atleast_1d(g._const(n, 1))[0])
+    outs = g._emit("math", "topK", [g._in(n, 0)], n.name, k=k)
+    g._register_outputs(n, outs)
+    return None
+
+
+@_rule("GatherNd")
+def _gather_nd(g, n):
+    return g._emit("shape", "gatherNd", [g._in(n, 0), g._in(n, 1)], n.name)
+
+
+@_rule("ScatterNd")
+def _scatter_nd(g, n):
+    shape = tuple(int(s) for s in np.atleast_1d(g._const(n, 2)))
+    return g._emit("shape", "scatterNd", [g._in(n, 0), g._in(n, 1)], n.name,
+                   shape=shape)
+
+
+@_rule("MirrorPad")
+def _mirror_pad(g, n):
+    pads = tuple(tuple(int(v) for v in row) for row in g._const(n, 1))
+    mode = n.attr["mode"].s.decode() or "REFLECT"
+    return g._emit("shape", "mirrorPad", [g._in(n, 0)], n.name,
+                   paddings=pads, mode=mode)
+
+
+@_rule("ClipByValue")
+def _clip_by_value(g, n):
+    return g._emit("math", "clipByValue",
+                   [g._in(n, 0), g._in(n, 1), g._in(n, 2)], n.name)
+
+
+@_rule("L2Loss")
+def _l2_loss(g, n):
+    return g._emit("loss", "l2Loss", [g._in(n, 0)], n.name)
+
+
+@_rule("LRN")
+def _lrn(g, n):
+    x = g._nhwc_to_nchw(g._in(n, 0), n.name)
+    out = g._emit("nn", "lrn", [x], n.name + "/lrn",
+                  depth_radius=int(n.attr["depth_radius"].i or 5),
+                  bias=float(n.attr["bias"].f or 1.0),
+                  alpha=float(n.attr["alpha"].f or 1.0),
+                  beta=float(n.attr["beta"].f or 0.5))
+    return g._nchw_to_nhwc(out, n.name)
+
+
+@_rule("SpaceToBatchND")
+def _space_to_batch_nd(g, n):
+    block = [int(b) for b in np.atleast_1d(g._const(n, 1))]
+    pads = [tuple(int(v) for v in row) for row in np.atleast_2d(g._const(n, 2))]
+    # TF layout (N, spatial..., rest) matches the op's contract directly
+    return g._emit("cnn", "spaceToBatchNd", [g._in(n, 0)], n.name,
+                   block_shape=block, paddings=pads)
+
+
+@_rule("BatchToSpaceND")
+def _batch_to_space_nd(g, n):
+    block = [int(b) for b in np.atleast_1d(g._const(n, 1))]
+    crops = [tuple(int(v) for v in row) for row in np.atleast_2d(g._const(n, 2))]
+    return g._emit("cnn", "batchToSpaceNd", [g._in(n, 0)], n.name,
+                   block_shape=block, crops=crops)
+
+
+@_rule("DepthToSpace")
+def _depth_to_space(g, n):
+    bs = int(n.attr["block_size"].i)
+    fmt = n.attr["data_format"].s.decode() or "NHWC"
+    return g._emit("cnn", "depthToSpace", [g._in(n, 0)], n.name,
+                   block_size=bs, data_format=fmt)
+
+
+@_rule("SpaceToDepth")
+def _space_to_depth_rule(g, n):
+    bs = int(n.attr["block_size"].i)
+    fmt = n.attr["data_format"].s.decode() or "NHWC"
+    return g._emit("cnn", "spaceToDepth", [g._in(n, 0)], n.name,
+                   block_size=bs, data_format=fmt)
+
+
+@_rule("ResizeBilinear", "ResizeNearestNeighbor")
+def _resize(g, n):
+    size = tuple(int(s) for s in np.atleast_1d(g._const(n, 1)))
+    opname = ("resizeBilinear" if n.op == "ResizeBilinear"
+              else "resizeNearest")
+    # TF1 graphs carry align_corners / legacy coordinates; TF2 emits
+    # half_pixel_centers=true — the op implements all three samplings
+    return g._emit("image", opname, [g._in(n, 0)], n.name, size=size,
+                   data_format="NHWC",
+                   align_corners=bool(n.attr["align_corners"].b),
+                   half_pixel_centers=bool(n.attr["half_pixel_centers"].b))
+
+
+@_rule("Einsum")
+def _einsum(g, n):
+    eq = n.attr["equation"].s.decode()
+    return g._emit("linalg", "einsum", g._ins(n), n.name, equation=eq)

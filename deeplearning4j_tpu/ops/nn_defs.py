@@ -582,22 +582,82 @@ def loss_sparse_mcxent_masked(labels, logits, mask, average=True):
 # (ref: libnd4j generic/parity_ops image ops + helpers/image_resize)
 
 
-@op("resizeBilinear", "image")
-def resize_bilinear(x, size, data_format="NCHW"):
+def _tf_resize_matrix(n_in, n_out, method, align_corners, half_pixel):
+    """1-D interpolation matrix (n_out, n_in) with TF's coordinate rules.
+
+    half_pixel (TF2 default): src = (i+0.5)*in/out - 0.5 — what
+    jax.image.resize implements. align_corners (TF1): src = i*(in-1)/(out-1).
+    Neither (TF1 legacy default): src = i*in/out.
+    """
+    import numpy as _np
+    i = _np.arange(n_out, dtype=_np.float64)
+    if align_corners:
+        scale = (n_in - 1) / (n_out - 1) if n_out > 1 else 0.0
+        src = i * scale
+    elif half_pixel:
+        src = (i + 0.5) * (n_in / n_out) - 0.5
+    else:
+        src = i * (n_in / n_out)
+    m = _np.zeros((n_out, n_in), _np.float32)
+    if method == "nearest":
+        if align_corners:
+            # TF uses roundf (half away from zero), NOT banker's rounding
+            idx = _np.floor(src + 0.5).astype(int)
+        else:
+            idx = _np.floor(src).astype(int)
+        idx = _np.clip(idx, 0, n_in - 1)
+        m[_np.arange(n_out), idx] = 1.0
+    else:  # bilinear
+        src = _np.clip(src, 0.0, n_in - 1)
+        lo = _np.floor(src).astype(int)
+        hi = _np.minimum(lo + 1, n_in - 1)
+        frac = (src - lo).astype(_np.float32)
+        m[_np.arange(n_out), lo] += 1.0 - frac
+        # hi may equal lo at the border: += accumulates to exactly 1.0
+        m[_np.arange(n_out), hi] += frac
+    return jnp.asarray(m)
+
+
+def _tf_resize(x, size, method, data_format, align_corners, half_pixel):
     if data_format == "NCHW":
-        N, C, H, W = x.shape
-        return jax.image.resize(x, (N, C, size[0], size[1]), method="bilinear")
-    N, H, W, C = x.shape
-    return jax.image.resize(x, (N, size[0], size[1], C), method="bilinear")
+        H, W = x.shape[2], x.shape[3]
+    else:
+        H, W = x.shape[1], x.shape[2]
+    if half_pixel and not align_corners:
+        # identical to jax.image.resize's sampling — use the fused path
+        jmethod = method if method != "nearest" else "nearest"
+        if data_format == "NCHW":
+            out_shape = (x.shape[0], x.shape[1], size[0], size[1])
+        else:
+            out_shape = (x.shape[0], size[0], size[1], x.shape[3])
+        return jax.image.resize(x, out_shape, method=jmethod)
+    wh = _tf_resize_matrix(H, size[0], method, align_corners, half_pixel)
+    ww = _tf_resize_matrix(W, size[1], method, align_corners, half_pixel)
+    # precision="highest": interpolation weights must not round through the
+    # accelerator's fast-matmul dtype (bf16/TF32-analog) — parity vs the TF
+    # kernels is the contract here and the matrices are tiny
+    if data_format == "NCHW":
+        return jnp.einsum("oh,nchw,pw->ncop", wh.astype(x.dtype), x,
+                          ww.astype(x.dtype), precision="highest")
+    return jnp.einsum("oh,nhwc,pw->nopc", wh.astype(x.dtype), x,
+                      ww.astype(x.dtype), precision="highest")
+
+
+@op("resizeBilinear", "image")
+def resize_bilinear(x, size, data_format="NCHW", align_corners=False,
+                    half_pixel_centers=True):
+    """TF-semantics bilinear resize incl. the TF1 align_corners /
+    legacy-coordinate modes (ref: helpers/image_resize computeInterpolation
+    weights; TF kernels are the behavioral oracle in tests)."""
+    return _tf_resize(x, size, "bilinear", data_format, align_corners,
+                      half_pixel_centers)
 
 
 @op("resizeNearest", "image")
-def resize_nearest(x, size, data_format="NCHW"):
-    if data_format == "NCHW":
-        N, C, H, W = x.shape
-        return jax.image.resize(x, (N, C, size[0], size[1]), method="nearest")
-    N, H, W, C = x.shape
-    return jax.image.resize(x, (N, size[0], size[1], C), method="nearest")
+def resize_nearest(x, size, data_format="NCHW", align_corners=False,
+                   half_pixel_centers=True):
+    return _tf_resize(x, size, "nearest", data_format, align_corners,
+                      half_pixel_centers)
 
 
 @op("cropAndResize", "image")

@@ -216,7 +216,10 @@ def space_to_batch_nd(x, block_shape, paddings):
     perm = [2 * i + 2 for i in range(nb)] + [0] + [2 * i + 1 for i in range(nb)]
     perm += list(range(1 + 2 * nb, x.ndim))
     x = jnp.transpose(x, perm)
-    out_shape = [n * int(jnp.prod(jnp.array(block_shape)))] + \
+    blk_prod = 1
+    for b in block_shape:
+        blk_prod *= int(b)
+    out_shape = [n * blk_prod] + \
         [dim // blk for dim, blk in zip(spatial, block_shape)] + list(rest)
     return jnp.reshape(x, out_shape)
 
@@ -614,3 +617,11 @@ def multinomial(key, logits, num_samples):
     return jax.vmap(
         lambda k, row: jax.random.categorical(k, row, shape=(num_samples,))
     )(keys, logits)
+
+
+# ----------------------------------------------- import-path conveniences
+# (ref: TF ops hit by frozen-graph corpora that had no direct registry slot)
+
+op("einsum", "linalg")(lambda *xs, equation: jnp.einsum(equation, *xs))
+op("l2Loss", "loss")(lambda x: 0.5 * jnp.sum(jnp.square(x)))
+# (math.erfc already registered in math_defs — no re-registration here)

@@ -215,3 +215,120 @@ def test_bert_import_finetune_loss_decreases():
     for _ in range(12):
         hist += sd.fit({i: ids, "labels": y})
     assert hist[-1] < hist[0] * 0.7, (hist[0], hist[-1])
+
+
+# ---- round-2 widened rule set (Tile/Range/Slice/Cumsum/TopK/Einsum/...) ----
+
+
+def test_shape_manipulation_ops():
+    def f(x):
+        t = tf.tile(x, [2, 1])
+        r = tf.reverse(t, axis=[1])
+        s = tf.slice(r, [1, 0], [3, -1])
+        return tf.unstack(s, axis=0)[1]
+
+    _run_parity(f, [RNG.normal(size=(3, 5)).astype(np.float32)])
+
+
+def test_range_and_cumsum_variants():
+    def f(x):
+        idx = tf.range(0.0, 4.0, 1.0)
+        c0 = tf.cumsum(x, axis=1)
+        c1 = tf.cumsum(x, axis=1, exclusive=True)
+        c2 = tf.cumsum(x, axis=1, reverse=True)
+        return c0 + c1 + c2 + idx
+
+    _run_parity(f, [RNG.normal(size=(2, 4)).astype(np.float32)])
+
+
+def test_topk_and_gather_nd():
+    def f(x):
+        vals, idx = tf.math.top_k(x, k=2)
+        g = tf.gather_nd(x, [[0, 1], [1, 0]])
+        return vals + tf.cast(idx, tf.float32)[:, :1] + g[0]
+
+    _run_parity(f, [RNG.normal(size=(3, 5)).astype(np.float32)])
+
+
+def test_scatter_nd_and_clip():
+    def f(x):
+        s = tf.scatter_nd([[0], [2]], [5.0, 7.0], [4])
+        return tf.clip_by_value(x + s, -1.0, 1.0)
+
+    _run_parity(f, [RNG.normal(size=(4,)).astype(np.float32)])
+
+
+def test_mirror_pad_and_l2loss():
+    def f(x):
+        p = tf.pad(x, [[1, 1], [0, 0]], mode="REFLECT")
+        return p + tf.nn.l2_loss(x)
+
+    _run_parity(f, [RNG.normal(size=(3, 4)).astype(np.float32)])
+
+
+def test_space_batch_and_depth_ops():
+    def f(x):  # NHWC
+        y = tf.space_to_batch(x, [2, 2], [[0, 0], [0, 0]])
+        y = tf.batch_to_space(y, [2, 2], [[0, 0], [0, 0]])
+        d = tf.nn.space_to_depth(x, 2)
+        d = tf.nn.depth_to_space(d, 2)
+        return y + d
+
+    _run_parity(f, [RNG.normal(size=(1, 4, 4, 3)).astype(np.float32)])
+
+
+def test_resize_ops():
+    def f(x):  # NHWC
+        a = tf.image.resize(x, [6, 6], method="bilinear")
+        b = tf.image.resize(x, [6, 6], method="nearest")
+        return a + b
+
+    _run_parity(f, [RNG.normal(size=(1, 3, 3, 2)).astype(np.float32)], atol=1e-4)
+
+
+def test_einsum_and_lrn():
+    def f(x, y):
+        e = tf.einsum("bij,bjk->bik", x, y)
+        return e
+
+    _run_parity(f, [RNG.normal(size=(2, 3, 4)).astype(np.float32),
+                    RNG.normal(size=(2, 4, 5)).astype(np.float32)])
+
+    def g(x):  # NHWC LRN
+        return tf.nn.local_response_normalization(
+            x, depth_radius=2, bias=1.0, alpha=0.5, beta=0.5)
+
+    _run_parity(g, [np.abs(RNG.normal(size=(1, 3, 3, 8))).astype(np.float32)],
+                atol=1e-4)
+
+
+def test_extra_unary_ops():
+    def f(x):
+        return (tf.math.sinh(x) + tf.math.cosh(x) + tf.math.expm1(x)
+                + tf.math.erfc(x) + tf.math.atan(x))
+
+    _run_parity(f, [RNG.normal(size=(8,)).astype(np.float32) * 0.5], atol=1e-4)
+
+
+def test_tf1_resize_coordinate_modes():
+    """align_corners / legacy (neither) coordinate rules must match the TF
+    kernels exactly — TF2's half-pixel default is a different sampling."""
+    x = RNG.normal(size=(1, 4, 5, 2)).astype(np.float32)
+
+    def ac_bilinear(x):
+        return tf.compat.v1.image.resize_bilinear(x, [7, 9], align_corners=True)
+
+    def legacy_bilinear(x):
+        return tf.compat.v1.image.resize_bilinear(x, [7, 9],
+                                                  align_corners=False)
+
+    def ac_nearest(x):
+        return tf.compat.v1.image.resize_nearest_neighbor(x, [7, 9],
+                                                          align_corners=True)
+
+    def legacy_nearest(x):
+        return tf.compat.v1.image.resize_nearest_neighbor(x, [7, 9],
+                                                          align_corners=False)
+
+    for fn in (ac_bilinear, legacy_bilinear, ac_nearest, legacy_nearest):
+        _run_parity(fn, [x], atol=1e-5)
