@@ -679,3 +679,385 @@ def _argmin(g, n):
     a = _attrs(n)
     return g._emit("reduce", "argmin", [g._in(n, 0)], n.output[0],
                    dims=a.get("axis", 0), keepdims=bool(a.get("keepdims", 1)))
+
+
+# ---------------------------------------------------------------------------
+# Round-2 widening: recurrent ops, ConvTranspose, Resize, einsum, indexing,
+# reductions, and activation stragglers (ref: samediff-import-onnx rule set).
+
+_UNARY2 = [
+    ("HardSwish", "nn", "hardSwish"), ("Mish", "nn", "mish"),
+    ("IsNaN", "math", "isnan"), ("IsInf", "math", "isinf"),
+    ("Acosh", "math", "acosh"), ("Asinh", "math", "asinh"),
+    ("Atanh", "math", "atanh"), ("Cosh", "math", "cosh"),
+    ("Tanh", "math", "tanh"), ("Erf", "math", "erf"),
+]
+for _t, _ns, _o in _UNARY2:
+    if _t not in _RULES:
+        _RULES[_t] = (lambda ns, o: lambda g, n: g._emit(
+            ns, o, [g._in(n, 0)], n.output[0]))(_ns, _o)
+
+
+@rule("Celu")
+def _celu(g, n):
+    return g._emit("nn", "celu", [g._in(n, 0)], n.output[0],
+                   alpha=_attrs(n).get("alpha", 1.0))
+
+
+@rule("ThresholdedRelu")
+def _thresholded_relu(g, n):
+    return g._emit("nn", "thresholdRelu", [g._in(n, 0)], n.output[0],
+                   theta=_attrs(n).get("alpha", 1.0))
+
+
+@rule("Shrink")
+def _shrink(g, n):
+    a = _attrs(n)
+    return g._emit("nn", "shrink", [g._in(n, 0)], n.output[0],
+                   bias=a.get("bias", 0.0), lambd=a.get("lambd", 0.5))
+
+
+@rule("Mod")
+def _mod(g, n):
+    fmod = _attrs(n).get("fmod", 0)
+    opname = "fmod" if fmod else "mod"
+    return g._emit("math", opname, [g._in(n, 0), g._in(n, 1)], n.output[0])
+
+
+@rule("Sum")
+def _sum_variadic(g, n):
+    if len(n.input) == 1:  # legal ONNX identity form
+        return g._emit("math", "identity", [g._in(n, 0)], n.output[0])
+    out = g._in(n, 0)
+    for i in range(1, len(n.input)):
+        out = g._emit("math", "add", [out, g._in(n, i)],
+                      f"{n.output[0]}/acc{i}" if i < len(n.input) - 1
+                      else n.output[0])
+    return out
+
+
+@rule("Mean")
+def _mean_variadic(g, n):
+    k = len(n.input)
+    out = g._in(n, 0)
+    for i in range(1, k):
+        out = g._emit("math", "add", [out, g._in(n, i)], f"{n.output[0]}/acc{i}")
+    inv = g.sd.constant(f"{n.output[0]}/invk", np.float32(1.0 / k))
+    return g._emit("math", "mul", [out, inv], n.output[0])
+
+
+def _reduce_composed(inner, post=None, pre=None):
+    """ReduceL1/L2/LogSum/LogSumExp/SumSquare as compositions."""
+    def fn(g, n):
+        a = _attrs(n)
+        axes = a.get("axes")
+        if axes is None and len(n.input) > 1 and n.input[1]:
+            axes = [int(i) for i in g._const(n, 1)]
+        keepdims = bool(a.get("keepdims", 1))
+        x = g._in(n, 0)
+        if pre:
+            x = g._emit("math", pre, [x], n.output[0] + "/pre")
+        red = g._emit("reduce", inner, [x],
+                      n.output[0] + "/red" if post else n.output[0],
+                      dims=tuple(axes) if axes else None, keepdims=keepdims)
+        if post:
+            return g._emit("math", post, [red], n.output[0])
+        return red
+    return fn
+
+
+_RULES["ReduceL1"] = _reduce_rule("norm1")
+_RULES["ReduceSumSquare"] = _reduce_rule("squaredNorm")
+_RULES["ReduceL2"] = _reduce_rule("norm2")
+_RULES["ReduceLogSum"] = _reduce_composed("sum", post="log")
+
+
+@rule("ReduceLogSumExp")
+def _reduce_lse(g, n):
+    a = _attrs(n)
+    axes = a.get("axes")
+    if axes is None and len(n.input) > 1 and n.input[1]:
+        axes = [int(i) for i in g._const(n, 1)]
+    keepdims = bool(a.get("keepdims", 1))
+    return g._emit("reduce", "logSumExp", [g._in(n, 0)], n.output[0],
+                   dims=tuple(axes) if axes else None, keepdims=keepdims)
+
+
+@rule("Einsum")
+def _einsum_onnx(g, n):
+    eq = _attrs(n)["equation"]
+    if isinstance(eq, bytes):
+        eq = eq.decode()
+    return g._emit("linalg", "einsum", [g._in(n, i) for i in range(len(n.input))],
+                   n.output[0], equation=eq)
+
+
+@rule("TopK")
+def _topk_onnx(g, n):
+    a = _attrs(n)
+    k = int(np.atleast_1d(g._const(n, 1))[0])
+    axis = a.get("axis", -1)
+    largest = a.get("largest", 1)
+    x = g._in(n, 0)
+    if axis not in (-1, len(x.shape or []) - 1):
+        raise ValueError("TopK: only last-axis supported")
+    if not largest:  # smallest-k via negation (indices unaffected)
+        x = g._emit("math", "neg", [x], n.output[0] + "/neg")
+    vals, idx = g._emit("math", "topK", [x], n.output[0] + "/tk", k=k)
+    if not largest:
+        vals = g._emit("math", "neg", [vals], n.output[0] + "/vneg")
+    outs = [g._emit("math", "identity", [o], ref)
+            for ref, o in zip(n.output, (vals, idx)) if ref]
+    g._register(n, outs)
+    return None
+
+
+@rule("CumSum")
+def _cumsum_onnx(g, n):
+    a = _attrs(n)
+    axis = int(np.atleast_1d(g._const(n, 1))[0])
+    x = g._in(n, 0)
+    if a.get("reverse"):
+        x = g._emit("shape", "reverse", [x], n.output[0] + "/rin", dims=(axis,))
+    out = g._emit("shape", "cumsum", [x], n.output[0] + "/cs", axis=axis)
+    if a.get("exclusive"):
+        out = g._emit("math", "sub", [out, x], n.output[0] + "/excl")
+    if a.get("reverse"):
+        out = g._emit("shape", "reverse", [out], n.output[0] + "/rout",
+                      dims=(axis,))
+    return g._emit("math", "identity", [out], n.output[0])
+
+
+@rule("OneHot")
+def _onehot_onnx(g, n):
+    depth = int(np.atleast_1d(g._const(n, 1))[0])
+    values = g._const(n, 2)  # [off, on]
+    axis = _attrs(n).get("axis", -1)
+    return g._emit("shape", "oneHot", [g._in(n, 0)], n.output[0],
+                   depth=depth, axis=axis, on=float(values[1]),
+                   off=float(values[0]))
+
+
+@rule("GatherND")
+def _gather_nd_onnx(g, n):
+    if _attrs(n).get("batch_dims", 0):
+        raise ValueError("GatherND: batch_dims unsupported")
+    return g._emit("shape", "gatherNd", [g._in(n, 0), g._in(n, 1)], n.output[0])
+
+
+@rule("ScatterND")
+def _scatter_nd_onnx(g, n):
+    red = _attrs(n).get("reduction", "none")
+    if isinstance(red, bytes):
+        red = red.decode()
+    opname = {"none": "scatterNdUpdate", "add": "scatterNdAdd"}.get(red)
+    if opname is None:
+        raise ValueError(f"ScatterND: reduction '{red}' unsupported")
+    return g._emit("shape", opname,
+                   [g._in(n, 0), g._in(n, 1), g._in(n, 2)], n.output[0])
+
+
+@rule("GatherElements")
+def _gather_elements(g, n):
+    return g._emit("shape", "gatherElements", [g._in(n, 0), g._in(n, 1)],
+                   n.output[0], axis=_attrs(n).get("axis", 0))
+
+
+@rule("ScatterElements")
+def _scatter_elements(g, n):
+    a = _attrs(n)
+    red = a.get("reduction", "none")
+    if isinstance(red, bytes):
+        red = red.decode()
+    return g._emit("shape", "scatterElements",
+                   [g._in(n, 0), g._in(n, 1), g._in(n, 2)], n.output[0],
+                   axis=a.get("axis", 0), reduction=red)
+
+
+@rule("EyeLike")
+def _eyelike(g, n):
+    return g._emit("shape", "eyeLike", [g._in(n, 0)], n.output[0],
+                   k=_attrs(n).get("k", 0))
+
+
+@rule("Trilu")
+def _trilu(g, n):
+    upper = _attrs(n).get("upper", 1)
+    k = 0
+    if len(n.input) > 1 and n.input[1]:
+        k = int(np.atleast_1d(g._const(n, 1))[0])
+    return g._emit("shape", "triu" if upper else "tril", [g._in(n, 0)],
+                   n.output[0], k=k)
+
+
+@rule("MeanVarianceNormalization")
+def _mvn(g, n):
+    axes = tuple(_attrs(n).get("axes", (0, 2, 3)))
+    return g._emit("nn", "meanVarianceNormalization", [g._in(n, 0)],
+                   n.output[0], axes=axes)
+
+
+@rule("DepthToSpace")
+def _d2s_onnx(g, n):
+    a = _attrs(n)
+    bs = int(a["blocksize"])
+    mode = a.get("mode", "DCR")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    x = g._in(n, 0)
+    if mode == "DCR":
+        return g._emit("cnn", "depthToSpace", [x], n.output[0],
+                       block_size=bs, data_format="NCHW")
+    # CRD: reshape (N, C', b, b, H, W) -> permute -> (N, C', H*b, W*b)
+    N, C, H, W = x.shape
+    r1 = g._emit("shape", "reshape", [x], n.output[0] + "/r1",
+                 shape=(N, C // (bs * bs), bs, bs, H, W))
+    p = g._emit("shape", "permute", [r1], n.output[0] + "/p",
+                axes=(0, 1, 4, 2, 5, 3))
+    return g._emit("shape", "reshape", [p], n.output[0],
+                   shape=(N, C // (bs * bs), H * bs, W * bs))
+
+
+@rule("SpaceToDepth")
+def _s2d_onnx(g, n):
+    bs = int(_attrs(n)["blocksize"])
+    return g._emit("cnn", "spaceToDepth", [g._in(n, 0)], n.output[0],
+                   block_size=bs, data_format="NCHW")
+
+
+@rule("ConvTranspose")
+def _conv_transpose(g, n):
+    a = _attrs(n)
+    w = g._in(n, 1)  # ONNX: (C_in, C_out/groups, kH, kW)
+    b = g._opt(n, 2)
+    spatial = len(a.get("kernel_shape") or g._const(n, 1).shape[2:])
+    if spatial != 2:
+        raise ValueError("ConvTranspose: only 2D supported")
+    if a.get("group", 1) != 1:
+        raise ValueError("ConvTranspose: groups unsupported")
+    strides = tuple(a.get("strides", [1, 1]))
+    pads = a.get("pads")
+    if a.get("output_padding") or a.get("output_shape"):
+        raise ValueError("ConvTranspose: output_padding/output_shape unsupported")
+    if pads and any(pads):
+        padding = _onnx_pads(pads, 2)
+    else:
+        padding = "VALID"
+    inputs = [g._in(n, 0), w] + ([b] if b is not None else [])
+    return g._emit("cnn", "deconv2d", inputs, n.output[0], strides=strides,
+                   padding=padding)
+
+
+@rule("Resize", "Upsample")
+def _resize_onnx(g, n):
+    a = _attrs(n)
+    mode = a.get("mode", "nearest")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    coord = a.get("coordinate_transformation_mode", "half_pixel")
+    if isinstance(coord, bytes):
+        coord = coord.decode()
+    if n.op_type == "Upsample":
+        coord = "asymmetric"  # the deprecated op's fixed semantics
+        a = dict(a, nearest_mode="floor")
+    x = g._in(n, 0)
+    N, C, H, W = x.shape
+    # sizes (input 3) take precedence over scales (input 2; Upsample: input 1)
+    sizes = None
+    if len(n.input) > 3 and n.input[3]:
+        sizes = [int(s) for s in g._const(n, 3)]
+        out_hw = (sizes[2], sizes[3])
+    else:
+        scale_idx = 1 if n.op_type == "Upsample" else 2
+        scales = [float(s) for s in g._const(n, scale_idx)]
+        out_hw = (int(H * scales[2]), int(W * scales[3]))
+    align = coord == "align_corners"
+    half_pixel = coord in ("half_pixel", "pytorch_half_pixel")
+    if not (align or half_pixel or coord == "asymmetric"):
+        raise ValueError(f"Resize: coordinate mode '{coord}' unsupported")
+    extra = {}
+    if mode == "nearest":
+        nearest_mode = a.get("nearest_mode", "round_prefer_floor")
+        if isinstance(nearest_mode, bytes):
+            nearest_mode = nearest_mode.decode()
+        if nearest_mode not in ("floor", "round_prefer_floor"):
+            raise ValueError(f"Resize: nearest_mode '{nearest_mode}' unsupported")
+        opname = "resizeNearest"
+        extra["nearest_mode"] = nearest_mode
+    elif mode in ("linear", "bilinear"):
+        opname = "resizeBilinear"
+    else:
+        raise ValueError(f"Resize: mode '{mode}' unsupported")
+    return g._emit("image", opname, [x], n.output[0], size=out_hw,
+                   data_format="NCHW", align_corners=align,
+                   half_pixel_centers=half_pixel, **extra)
+
+
+def _rnn_common(g, n, opname, extra_kw):
+    """ONNX LSTM/GRU/RNN share the optional-input layout (B, sequence_lens,
+    initial_h[, initial_c]); missing optionals are materialized as their
+    defaulting constants so the op call stays uniformly positional."""
+    a = _attrs(n)
+    direction = a.get("direction", "forward")
+    if isinstance(direction, bytes):
+        direction = direction.decode()
+    x, w, r = g._in(n, 0), g._in(n, 1), g._in(n, 2)
+    T, B = x.shape[0], x.shape[1]
+    if T is None or B is None:
+        raise ValueError(
+            f"{n.op_type} node '{n.name or n.output[0]}': dynamic time/batch "
+            "dims need explicit sequence_lens/initial state inputs (defaults "
+            "cannot be materialized from an unknown shape)")
+    D, gates_h = w.shape[0], w.shape[1]
+    H = {"lstmOnnx": gates_h // 4, "gruOnnx": gates_h // 3,
+         "rnnOnnx": gates_h}[opname]
+    n_b = {"lstmOnnx": 8, "gruOnnx": 6, "rnnOnnx": 2}[opname] * H
+
+    def opt_or(i, name, default):
+        v = g._opt(n, i)
+        if v is None:
+            v = g.sd.constant(f"{n.output[0]}/{name}", default)
+        return v
+
+    b = opt_or(3, "b0", np.zeros((D, n_b), np.float32))
+    seq = opt_or(4, "seqlens", np.full((B,), T, np.int32))
+    h0 = opt_or(5, "h0", np.zeros((D, B, H), np.float32))
+    inputs = [x, w, r, b, seq, h0]
+    if opname == "lstmOnnx":
+        inputs.append(opt_or(6, "c0", np.zeros((D, B, H), np.float32)))
+    outs = g._emit("rnn", opname, inputs, n.output[0] + "/rnn",
+                   direction=direction, **extra_kw)
+    # multi-output vars are named base#i — re-emit identities so each ONNX
+    # output ref is a real SameDiff variable name
+    outs = [g._emit("math", "identity", [o], ref)
+            for ref, o in zip(n.output, outs) if ref]
+    g._register(n, outs)
+    return None
+
+
+@rule("LSTM")
+def _lstm_onnx_rule(g, n):
+    if _attrs(n).get("layout", 0) != 0:
+        raise ValueError("LSTM: layout=1 unsupported (use default T,B,I)")
+    return _rnn_common(g, n, "lstmOnnx", {})
+
+
+@rule("GRU")
+def _gru_onnx_rule(g, n):
+    a = _attrs(n)
+    if a.get("layout", 0) != 0:
+        raise ValueError("GRU: layout=1 unsupported")
+    return _rnn_common(g, n, "gruOnnx",
+                       {"linear_before_reset": a.get("linear_before_reset", 0)})
+
+
+@rule("RNN")
+def _rnn_onnx_rule(g, n):
+    a = _attrs(n)
+    if a.get("layout", 0) != 0:
+        raise ValueError("RNN: layout=1 unsupported")
+    acts = a.get("activations")
+    act = "Tanh"
+    if acts:
+        act = acts[0].decode() if isinstance(acts[0], bytes) else acts[0]
+    return _rnn_common(g, n, "rnnOnnx", {"activation": act})

@@ -582,12 +582,15 @@ def loss_sparse_mcxent_masked(labels, logits, mask, average=True):
 # (ref: libnd4j generic/parity_ops image ops + helpers/image_resize)
 
 
-def _tf_resize_matrix(n_in, n_out, method, align_corners, half_pixel):
+def _tf_resize_matrix(n_in, n_out, method, align_corners, half_pixel,
+                      nearest_mode="floor"):
     """1-D interpolation matrix (n_out, n_in) with TF's coordinate rules.
 
     half_pixel (TF2 default): src = (i+0.5)*in/out - 0.5 — what
     jax.image.resize implements. align_corners (TF1): src = i*(in-1)/(out-1).
-    Neither (TF1 legacy default): src = i*in/out.
+    Neither (TF1 legacy default): src = i*in/out. ``nearest_mode``
+    (non-align-corners nearest only): 'floor' (TF legacy) or
+    'round_prefer_floor' (ONNX default — round, ties toward floor).
     """
     import numpy as _np
     i = _np.arange(n_out, dtype=_np.float64)
@@ -603,7 +606,9 @@ def _tf_resize_matrix(n_in, n_out, method, align_corners, half_pixel):
         if align_corners:
             # TF uses roundf (half away from zero), NOT banker's rounding
             idx = _np.floor(src + 0.5).astype(int)
-        else:
+        elif nearest_mode == "round_prefer_floor":
+            idx = _np.ceil(src - 0.5).astype(int)
+        else:  # floor
             idx = _np.floor(src).astype(int)
         idx = _np.clip(idx, 0, n_in - 1)
         m[_np.arange(n_out), idx] = 1.0
@@ -618,21 +623,23 @@ def _tf_resize_matrix(n_in, n_out, method, align_corners, half_pixel):
     return jnp.asarray(m)
 
 
-def _tf_resize(x, size, method, data_format, align_corners, half_pixel):
+def _tf_resize(x, size, method, data_format, align_corners, half_pixel,
+               nearest_mode="floor"):
     if data_format == "NCHW":
         H, W = x.shape[2], x.shape[3]
     else:
         H, W = x.shape[1], x.shape[2]
     if half_pixel and not align_corners:
         # identical to jax.image.resize's sampling — use the fused path
-        jmethod = method if method != "nearest" else "nearest"
         if data_format == "NCHW":
             out_shape = (x.shape[0], x.shape[1], size[0], size[1])
         else:
             out_shape = (x.shape[0], size[0], size[1], x.shape[3])
-        return jax.image.resize(x, out_shape, method=jmethod)
-    wh = _tf_resize_matrix(H, size[0], method, align_corners, half_pixel)
-    ww = _tf_resize_matrix(W, size[1], method, align_corners, half_pixel)
+        return jax.image.resize(x, out_shape, method=method)
+    wh = _tf_resize_matrix(H, size[0], method, align_corners, half_pixel,
+                           nearest_mode)
+    ww = _tf_resize_matrix(W, size[1], method, align_corners, half_pixel,
+                           nearest_mode)
     # precision="highest": interpolation weights must not round through the
     # accelerator's fast-matmul dtype (bf16/TF32-analog) — parity vs the TF
     # kernels is the contract here and the matrices are tiny
@@ -655,9 +662,9 @@ def resize_bilinear(x, size, data_format="NCHW", align_corners=False,
 
 @op("resizeNearest", "image")
 def resize_nearest(x, size, data_format="NCHW", align_corners=False,
-                   half_pixel_centers=True):
+                   half_pixel_centers=True, nearest_mode="floor"):
     return _tf_resize(x, size, "nearest", data_format, align_corners,
-                      half_pixel_centers)
+                      half_pixel_centers, nearest_mode)
 
 
 @op("cropAndResize", "image")

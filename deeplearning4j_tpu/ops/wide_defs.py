@@ -625,3 +625,180 @@ def multinomial(key, logits, num_samples):
 op("einsum", "linalg")(lambda *xs, equation: jnp.einsum(equation, *xs))
 op("l2Loss", "loss")(lambda x: 0.5 * jnp.sum(jnp.square(x)))
 # (math.erfc already registered in math_defs — no re-registration here)
+
+
+# ------------------------------------------------ ONNX-layout recurrent ops
+# (ref: samediff-import-onnx maps ONNX LSTM/GRU/RNN onto lstmLayer-class ops;
+# here the ONNX layouts/gate orders are first-class op variants, like
+# libnd4j's lstmLayer handles multiple data formats and directions.)
+
+
+def _onnx_dir_list(direction, num_dir):
+    if direction == "bidirectional":
+        return [(0, False), (1, True)]
+    return [(0, direction == "reverse")]
+
+
+@op("lstmOnnx", "rnn")
+def lstm_onnx(x, w, r, b=None, sequence_lens=None, initial_h=None,
+              initial_c=None, direction="forward"):
+    """ONNX LSTM: x (T,B,I); w (D,4H,I) gates IOFC; r (D,4H,H); b (D,8H)
+    = Wb|Rb; outputs (Y (T,D,B,H), Y_h (D,B,H), Y_c (D,B,H))."""
+    from deeplearning4j_tpu.ops.nn_defs import lstm_layer
+    x = jnp.asarray(x)
+    T, B, _ = x.shape
+    D, four_h, _ = w.shape
+    H = four_h // 4
+    mask = None
+    if sequence_lens is not None:
+        mask = (jnp.arange(T)[:, None] < jnp.asarray(sequence_lens)[None, :]
+                ).astype(x.dtype)  # (T,B)
+    perm = jnp.concatenate([jnp.arange(H),                # i
+                            2 * H + jnp.arange(H),        # f
+                            3 * H + jnp.arange(H),        # g (ONNX c)
+                            H + jnp.arange(H)])           # o
+    ys_all, h_all, c_all = [], [], []
+    for d, reverse in _onnx_dir_list(direction, D):
+        wi = jnp.transpose(w[d])[:, perm]                 # (I,4H) IFGO
+        ri = jnp.transpose(r[d])[:, perm]                 # (H,4H)
+        if b is not None:
+            bias = (b[d, :four_h] + b[d, four_h:])[perm]
+        else:
+            bias = jnp.zeros((four_h,), x.dtype)
+        h0 = initial_h[d] if initial_h is not None else jnp.zeros((B, H), x.dtype)
+        c0 = initial_c[d] if initial_c is not None else jnp.zeros((B, H), x.dtype)
+        ys, (hT, cT) = lstm_layer(x, h0, c0, wi, ri, bias, time_major=True,
+                                  reverse=reverse, mask=mask)
+        ys_all.append(ys); h_all.append(hT); c_all.append(cT)
+    return (jnp.stack(ys_all, axis=1),      # (T,D,B,H)
+            jnp.stack(h_all, axis=0),       # (D,B,H)
+            jnp.stack(c_all, axis=0))
+
+
+@op("gruOnnx", "rnn")
+def gru_onnx(x, w, r, b=None, sequence_lens=None, initial_h=None,
+             direction="forward", linear_before_reset=0):
+    """ONNX GRU: x (T,B,I); w (D,3H,I) gates ZRH; r (D,3H,H); b (D,6H)
+    = Wb|Rb. Both linear_before_reset semantics."""
+    x = jnp.asarray(x)
+    T, B, _ = x.shape
+    D, three_h, _ = w.shape
+    H = three_h // 3
+    mask = None
+    if sequence_lens is not None:
+        mask = (jnp.arange(T)[:, None] < jnp.asarray(sequence_lens)[None, :]
+                ).astype(x.dtype)
+
+    def run_dir(d, reverse):
+        wi = jnp.transpose(w[d])        # (I,3H) ZRH
+        ri = jnp.transpose(r[d])        # (H,3H)
+        wb = b[d, :three_h] if b is not None else jnp.zeros((three_h,), x.dtype)
+        rb = b[d, three_h:] if b is not None else jnp.zeros((three_h,), x.dtype)
+        h0 = initial_h[d] if initial_h is not None else jnp.zeros((B, H), x.dtype)
+        xs = jnp.flip(x, 0) if reverse else x
+        ms = None if mask is None else (jnp.flip(mask, 0) if reverse else mask)
+
+        def step(h, inp):
+            xt, mt = inp if ms is not None else (inp, None)
+            gx = jnp.matmul(xt, wi) + wb          # (B,3H)
+            gh = jnp.matmul(h, ri) + rb
+            z = jax.nn.sigmoid(gx[:, :H] + gh[:, :H])
+            rr = jax.nn.sigmoid(gx[:, H:2*H] + gh[:, H:2*H])
+            if linear_before_reset:
+                n = jnp.tanh(gx[:, 2*H:] + rr * gh[:, 2*H:])
+            else:
+                n = jnp.tanh(gx[:, 2*H:] +
+                             jnp.matmul(rr * h, ri[:, 2*H:]) + rb[2*H:])
+            h_new = (1.0 - z) * n + z * h
+            if mt is not None:
+                h_new = jnp.where(mt[:, None] > 0, h_new, h)
+            return h_new, h_new
+
+        inp = (xs, ms) if ms is not None else xs
+        hT, ys = lax.scan(step, h0, inp)
+        if reverse:
+            ys = jnp.flip(ys, 0)
+        return ys, hT
+
+    outs = [run_dir(d, rev) for d, rev in _onnx_dir_list(direction, D)]
+    return (jnp.stack([y for y, _ in outs], axis=1),
+            jnp.stack([h for _, h in outs], axis=0))
+
+
+@op("rnnOnnx", "rnn")
+def rnn_onnx(x, w, r, b=None, sequence_lens=None, initial_h=None,
+             direction="forward", activation="Tanh"):
+    """ONNX vanilla RNN: x (T,B,I); w (D,H,I); r (D,H,H); b (D,2H)."""
+    x = jnp.asarray(x)
+    T, B, _ = x.shape
+    D, H, _ = w.shape
+    act = {"Tanh": jnp.tanh, "Relu": jax.nn.relu,
+           "Sigmoid": jax.nn.sigmoid}[activation]
+    mask = None
+    if sequence_lens is not None:
+        mask = (jnp.arange(T)[:, None] < jnp.asarray(sequence_lens)[None, :]
+                ).astype(x.dtype)
+
+    def run_dir(d, reverse):
+        wi, ri = jnp.transpose(w[d]), jnp.transpose(r[d])
+        bias = (b[d, :H] + b[d, H:]) if b is not None else jnp.zeros((H,), x.dtype)
+        h0 = initial_h[d] if initial_h is not None else jnp.zeros((B, H), x.dtype)
+        xs = jnp.flip(x, 0) if reverse else x
+        ms = None if mask is None else (jnp.flip(mask, 0) if reverse else mask)
+
+        def step(h, inp):
+            xt, mt = inp if ms is not None else (inp, None)
+            h_new = act(jnp.matmul(xt, wi) + jnp.matmul(h, ri) + bias)
+            if mt is not None:
+                h_new = jnp.where(mt[:, None] > 0, h_new, h)
+            return h_new, h_new
+
+        inp = (xs, ms) if ms is not None else xs
+        hT, ys = lax.scan(step, h0, inp)
+        if reverse:
+            ys = jnp.flip(ys, 0)
+        return ys, hT
+
+    outs = [run_dir(d, rev) for d, rev in _onnx_dir_list(direction, D)]
+    return (jnp.stack([y for y, _ in outs], axis=1),
+            jnp.stack([h for _, h in outs], axis=0))
+
+
+# ---------------------------------------------- element-indexing stragglers
+
+op("gatherElements", "shape")(
+    lambda x, indices, axis=0: jnp.take_along_axis(
+        jnp.asarray(x), jnp.asarray(indices), axis=axis))
+
+
+@op("scatterElements", "shape")
+def scatter_elements(x, indices, updates, axis=0, reduction="none"):
+    x = jnp.asarray(x)
+    indices = jnp.asarray(indices)
+    idx = [jnp.broadcast_to(jnp.arange(s).reshape(
+        [-1 if i == d else 1 for i in range(indices.ndim)]), indices.shape)
+        for d, s in enumerate(indices.shape)]
+    idx[axis] = indices
+    ref = x.at[tuple(idx)]
+    return {"none": ref.set, "add": ref.add, "mul": ref.multiply,
+            "max": ref.max, "min": ref.min}[reduction](jnp.asarray(updates))
+
+
+op("eyeLike", "shape")(
+    lambda x, k=0, dtype=None: jnp.eye(jnp.asarray(x).shape[0],
+                                       jnp.asarray(x).shape[1], k=k,
+                                       dtype=dtype or jnp.asarray(x).dtype))
+
+
+@op("shrink", "nn")
+def shrink(x, bias=0.0, lambd=0.5):
+    x = jnp.asarray(x)
+    return jnp.where(x > lambd, x - bias, jnp.where(x < -lambd, x + bias, 0.0))
+
+
+@op("meanVarianceNormalization", "nn")
+def mean_variance_normalization(x, axes=(0, 2, 3), eps=1e-9):
+    x = jnp.asarray(x)
+    mu = jnp.mean(x, axis=tuple(axes), keepdims=True)
+    var = jnp.var(x, axis=tuple(axes), keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps)

@@ -1,0 +1,334 @@
+"""ONNX importer round-2 widening: recurrent ops (torch oracle),
+ConvTranspose, Resize coordinate modes, einsum/indexing/reduction/activation
+stragglers (ref: samediff-import-onnx rule coverage)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from test_onnx_import import make_model, node, run_import  # noqa: E402
+
+RNG = np.random.default_rng(5)
+
+
+def _f32(*shape):
+    return RNG.normal(size=shape).astype(np.float32)
+
+
+class TestRecurrent:
+    def test_lstm_matches_torch(self):
+        T, B, I, H = 6, 3, 4, 5
+        x = _f32(T, B, I)
+        tl = torch.nn.LSTM(I, H, bias=True)
+        with torch.no_grad():
+            y_t, (h_t, c_t) = tl(torch.from_numpy(x))
+        # torch gates IFGO -> ONNX IOFC
+        wi = tl.weight_ih_l0.detach().numpy()   # (4H, I) ifgo
+        wh = tl.weight_hh_l0.detach().numpy()
+        bi = tl.bias_ih_l0.detach().numpy()
+        bh = tl.bias_hh_l0.detach().numpy()
+
+        def to_iofc(m):
+            i, f, g_, o = np.split(m, 4, axis=0)
+            return np.concatenate([i, o, f, g_], axis=0)
+
+        W = to_iofc(wi)[None]                   # (1, 4H, I)
+        R = to_iofc(wh)[None]
+        Bb = np.concatenate([to_iofc(bi[:, None])[:, 0],
+                             to_iofc(bh[:, None])[:, 0]])[None]  # (1, 8H)
+        m = make_model(
+            [node("LSTM", ["x", "W", "R", "B"], ["Y", "Y_h", "Y_c"],
+                  hidden_size=H)],
+            inputs=[("x", (T, B, I))], outputs=[("Y", None), ("Y_h", None),
+                                                ("Y_c", None)],
+            initializers={"W": W.astype(np.float32),
+                          "R": R.astype(np.float32),
+                          "B": Bb.astype(np.float32)})
+        got_y = run_import(m, {"x": x}, "Y")        # (T, 1, B, H)
+        np.testing.assert_allclose(got_y[:, 0], y_t.numpy(), atol=1e-5)
+        got_h = run_import(m, {"x": x}, "Y_h")
+        np.testing.assert_allclose(got_h, h_t.numpy(), atol=1e-5)
+
+    def test_gru_matches_torch_lbr1(self):
+        T, B, I, H = 5, 2, 3, 4
+        x = _f32(T, B, I)
+        tg = torch.nn.GRU(I, H, bias=True)  # torch == linear_before_reset=1
+        with torch.no_grad():
+            y_t, h_t = tg(torch.from_numpy(x))
+        # torch gates RZN -> ONNX ZRH
+        wi = tg.weight_ih_l0.detach().numpy()
+        wh = tg.weight_hh_l0.detach().numpy()
+        bi = tg.bias_ih_l0.detach().numpy()
+        bh = tg.bias_hh_l0.detach().numpy()
+
+        def to_zrh(mm):
+            r, z, nn_ = np.split(mm, 3, axis=0)
+            return np.concatenate([z, r, nn_], axis=0)
+
+        W = to_zrh(wi)[None]
+        R = to_zrh(wh)[None]
+        Bb = np.concatenate([to_zrh(bi[:, None])[:, 0],
+                             to_zrh(bh[:, None])[:, 0]])[None]
+        m = make_model(
+            [node("GRU", ["x", "W", "R", "B"], ["Y", "Y_h"], hidden_size=H,
+                  linear_before_reset=1)],
+            inputs=[("x", (T, B, I))], outputs=[("Y", None), ("Y_h", None)],
+            initializers={"W": W.astype(np.float32),
+                          "R": R.astype(np.float32),
+                          "B": Bb.astype(np.float32)})
+        got = run_import(m, {"x": x}, "Y")
+        np.testing.assert_allclose(got[:, 0], y_t.numpy(), atol=1e-5)
+
+    def test_rnn_bidirectional_shapes_and_tail(self):
+        T, B, I, H = 4, 2, 3, 5
+        x = _f32(T, B, I)
+        W = _f32(2, H, I) * 0.3
+        R = _f32(2, H, H) * 0.3
+        m = make_model(
+            [node("RNN", ["x", "W", "R"], ["Y", "Y_h"], hidden_size=H,
+                  direction="bidirectional")],
+            inputs=[("x", (T, B, I))], outputs=[("Y", None), ("Y_h", None)],
+            initializers={"W": W, "R": R})
+        y = run_import(m, {"x": x}, "Y")
+        assert y.shape == (T, 2, B, H)
+        h = run_import(m, {"x": x}, "Y_h")
+        # forward final = last forward step; backward final = output at t=0
+        np.testing.assert_allclose(h[0], y[-1, 0], atol=1e-6)
+        np.testing.assert_allclose(h[1], y[0, 1], atol=1e-6)
+
+    def test_lstm_sequence_lens_freeze_state(self):
+        T, B, I, H = 6, 2, 3, 4
+        x = _f32(T, B, I)
+        W, R = _f32(1, 4 * H, I) * 0.2, _f32(1, 4 * H, H) * 0.2
+        m = make_model(
+            [node("LSTM", ["x", "W", "R", "", "lens"], ["Y", "Y_h"],
+                  hidden_size=H)],
+            inputs=[("x", (T, B, I))], outputs=[("Y", None), ("Y_h", None)],
+            initializers={"W": W, "R": R,
+                          "lens": np.array([3, 6], np.int32)})
+        y = run_import(m, {"x": x}, "Y")[:, 0]      # (T,B,H)
+        h = run_import(m, {"x": x}, "Y_h")[0]
+        np.testing.assert_allclose(h[0], y[2, 0], atol=1e-6)  # frozen at len 3
+        np.testing.assert_allclose(h[1], y[5, 1], atol=1e-6)
+
+
+class TestConvTransposeResize:
+    def test_conv_transpose_matches_torch(self):
+        x = _f32(1, 3, 5, 5)
+        w = _f32(3, 4, 3, 3) * 0.2  # (C_in, C_out, kH, kW)
+        with torch.no_grad():
+            want = torch.nn.functional.conv_transpose2d(
+                torch.from_numpy(x), torch.from_numpy(w), stride=2).numpy()
+        m = make_model(
+            [node("ConvTranspose", ["x", "w"], ["y"], kernel_shape=[3, 3],
+                  strides=[2, 2])],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"w": w})
+        got = run_import(m, {"x": x}, "y")
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_resize_modes_match_torch(self):
+        x = _f32(1, 2, 4, 4)
+        # linear + align_corners
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(7, 7), mode="bilinear",
+            align_corners=True).numpy()
+        m = make_model(
+            [node("Resize", ["x", "", "", "sizes"], ["y"], mode="linear",
+                  coordinate_transformation_mode="align_corners")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"sizes": np.array([1, 2, 7, 7], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"), want,
+                                   atol=1e-5)
+        # linear + half_pixel (the default)
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(7, 7), mode="bilinear",
+            align_corners=False).numpy()
+        m = make_model(
+            [node("Resize", ["x", "", "", "sizes"], ["y"], mode="linear",
+                  coordinate_transformation_mode="half_pixel")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"sizes": np.array([1, 2, 7, 7], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"), want,
+                                   atol=1e-5)
+        # nearest + asymmetric + floor == torch 'nearest'
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(7, 7), mode="nearest").numpy()
+        m = make_model(
+            [node("Resize", ["x", "", "", "sizes"], ["y"], mode="nearest",
+                  coordinate_transformation_mode="asymmetric",
+                  nearest_mode="floor")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"sizes": np.array([1, 2, 7, 7], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"), want,
+                                   atol=1e-6)
+
+    def test_upsample_deprecated(self):
+        x = _f32(1, 1, 3, 3)
+        m = make_model(
+            [node("Upsample", ["x", "scales"], ["y"], mode="nearest")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"scales": np.array([1, 1, 2, 2], np.float32)})
+        got = run_import(m, {"x": x}, "y")
+        np.testing.assert_allclose(got, np.kron(x, np.ones((1, 1, 2, 2),
+                                                           np.float32)))
+
+
+class TestIndexingAndReductions:
+    def test_einsum_topk_cumsum(self):
+        a, b = _f32(2, 3, 4), _f32(2, 4, 5)
+        m = make_model(
+            [node("Einsum", ["a", "b"], ["e"], equation="bij,bjk->bik")],
+            inputs=[("a", a.shape), ("b", b.shape)], outputs=[("e", None)])
+        np.testing.assert_allclose(run_import(m, {"a": a, "b": b}, "e"),
+                                   np.einsum("bij,bjk->bik", a, b), atol=1e-5)
+
+        x = _f32(3, 6)
+        m = make_model(
+            [node("TopK", ["x", "k"], ["v", "i"], axis=-1)],
+            inputs=[("x", x.shape)], outputs=[("v", None), ("i", None)],
+            initializers={"k": np.array([2], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "v"),
+                                   np.sort(x, axis=-1)[:, ::-1][:, :2],
+                                   atol=1e-6)
+
+        m = make_model(
+            [node("CumSum", ["x", "ax"], ["c"], exclusive=1)],
+            inputs=[("x", x.shape)], outputs=[("c", None)],
+            initializers={"ax": np.array([1], np.int32)})
+        want = np.cumsum(x, 1) - x
+        np.testing.assert_allclose(run_import(m, {"x": x}, "c"), want,
+                                   atol=1e-5)
+
+    def test_gather_scatter_elements(self):
+        x = _f32(3, 4)
+        idx = np.array([[0, 2, 1, 3], [3, 0, 0, 1], [1, 1, 2, 2]], np.int64)
+        m = make_model(
+            [node("GatherElements", ["x", "i"], ["y"], axis=1)],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"i": idx})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"),
+                                   np.take_along_axis(x, idx, 1))
+        upd = np.zeros((3, 4), np.float32)
+        m = make_model(
+            [node("ScatterElements", ["x", "i", "u"], ["y"], axis=1)],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"i": idx, "u": upd})
+        got = run_import(m, {"x": x}, "y")
+        want = x.copy()
+        np.put_along_axis(want, idx, upd, 1)
+        np.testing.assert_allclose(got, want)
+
+    def test_reduce_variants_and_onehot(self):
+        x = _f32(3, 4)
+        for opt, ref in [("ReduceL1", np.abs(x).sum(1)),
+                         ("ReduceL2", np.sqrt((x ** 2).sum(1))),
+                         ("ReduceSumSquare", (x ** 2).sum(1)),
+                         ("ReduceLogSumExp",
+                          np.log(np.exp(x).sum(1)))]:
+            m = make_model([node(opt, ["x"], ["y"], axes=[1], keepdims=0)],
+                           inputs=[("x", x.shape)], outputs=[("y", None)])
+            np.testing.assert_allclose(run_import(m, {"x": x}, "y"), ref,
+                                       atol=1e-5, rtol=1e-5)
+        ids = np.array([0, 2, 1], np.int64)
+        m = make_model(
+            [node("OneHot", ["i", "d", "v"], ["y"])],
+            inputs=[("i", ids.shape)], outputs=[("y", None)],
+            initializers={"d": np.array([3], np.int64),
+                          "v": np.array([0.5, 2.0], np.float32)})
+        got = run_import(m, {"i": ids}, "y")
+        want = np.full((3, 3), 0.5, np.float32)
+        want[np.arange(3), ids] = 2.0
+        np.testing.assert_allclose(got, want)
+
+    def test_misc_activations_and_structure(self):
+        x = _f32(2, 8, 4, 4)
+        m = make_model(
+            [node("DepthToSpace", ["x"], ["y"], blocksize=2, mode="CRD")],
+            inputs=[("x", x.shape)], outputs=[("y", None)])
+        got = run_import(m, {"x": x}, "y")
+        want = x.reshape(2, 2, 2, 2, 4, 4).transpose(0, 1, 4, 2, 5, 3) \
+                .reshape(2, 2, 8, 8)
+        np.testing.assert_allclose(got, want)
+
+        v = _f32(5)
+        for opt, kw, ref in [
+            ("ThresholdedRelu", {"alpha": 0.3}, np.where(v > 0.3, v, 0)),
+            ("Shrink", {"bias": 0.1, "lambd": 0.4},
+             np.where(v > 0.4, v - 0.1, np.where(v < -0.4, v + 0.1, 0))),
+            ("HardSwish", {}, v * np.clip(v / 6 + 0.5, 0, 1)),
+        ]:
+            m = make_model([node(opt, ["x"], ["y"], **kw)],
+                           inputs=[("x", v.shape)], outputs=[("y", None)])
+            np.testing.assert_allclose(run_import(m, {"x": v}, "y"), ref,
+                                       atol=1e-5)
+
+        m = make_model(
+            [node("Sum", ["a", "b", "c"], ["y"])],
+            inputs=[("a", v.shape), ("b", v.shape), ("c", v.shape)],
+            outputs=[("y", None)])
+        np.testing.assert_allclose(
+            run_import(m, {"a": v, "b": v, "c": v}, "y"), 3 * v, atol=1e-6)
+
+        m = make_model(
+            [node("Trilu", ["x"], ["y"], upper=0)],
+            inputs=[("x", (4, 4))], outputs=[("y", None)])
+        xm = _f32(4, 4)
+        np.testing.assert_allclose(run_import(m, {"x": xm}, "y"),
+                                   np.tril(xm))
+
+
+class TestReviewRegressions:
+    def test_resize_nearest_round_prefer_floor(self):
+        """asymmetric + default nearest_mode: 3->4 on [0,1,2] is [0,1,1,2]
+        (round-prefer-floor), NOT floor's [0,0,1,2]."""
+        x = np.arange(3, dtype=np.float32).reshape(1, 1, 1, 3)
+        m = make_model(
+            [node("Resize", ["x", "", "", "sizes"], ["y"], mode="nearest",
+                  coordinate_transformation_mode="asymmetric")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"sizes": np.array([1, 1, 1, 4], np.int64)})
+        got = run_import(m, {"x": x}, "y")
+        np.testing.assert_allclose(got[0, 0, 0], [0, 1, 1, 2])
+
+    def test_topk_smallest(self):
+        x = np.array([[1.0, 5.0, 2.0, 4.0, 3.0]], np.float32)
+        m = make_model(
+            [node("TopK", ["x", "k"], ["v", "i"], largest=0)],
+            inputs=[("x", x.shape)], outputs=[("v", None), ("i", None)],
+            initializers={"k": np.array([2], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "v"), [[1.0, 2.0]])
+        np.testing.assert_array_equal(run_import(m, {"x": x}, "i"), [[0, 2]])
+
+    def test_lstm_dynamic_batch_raises_clearly(self):
+        W = _f32(1, 16, 3)
+        R = _f32(1, 16, 4)
+        m = make_model(
+            [node("LSTM", ["x", "W", "R"], ["Y"], hidden_size=4)],
+            inputs=[("x", (5, 0, 3))],  # dim_value=0 -> dynamic batch
+            outputs=[("Y", None)], initializers={"W": W, "R": R})
+        with pytest.raises(ValueError, match="dynamic time/batch"):
+            run_import(m, {"x": _f32(5, 2, 3)}, "Y")
+
+    def test_sum_single_input_identity(self):
+        v = _f32(4)
+        m = make_model([node("Sum", ["x"], ["y"])],
+                       inputs=[("x", v.shape)], outputs=[("y", None)])
+        np.testing.assert_allclose(run_import(m, {"x": v}, "y"), v)
+
+    def test_scatter_nd_reduction_add(self):
+        x = np.ones((4,), np.float32)
+        m = make_model(
+            [node("ScatterND", ["x", "i", "u"], ["y"], reduction="add")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"i": np.array([[1], [1]], np.int64),
+                          "u": np.array([2.0, 3.0], np.float32)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"),
+                                   [1.0, 6.0, 1.0, 1.0])
+        m = make_model(
+            [node("ScatterND", ["x", "i", "u"], ["y"], reduction="mul")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"i": np.array([[1]], np.int64),
+                          "u": np.array([2.0], np.float32)})
+        with pytest.raises(ValueError, match="reduction 'mul'"):
+            run_import(m, {"x": x}, "y")
