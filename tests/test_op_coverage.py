@@ -119,6 +119,12 @@ LEDGER = {
     "loss/stragglers": ["loss.ctcLoss", "loss.weightedCrossEntropyWithLogits",
                         "loss.meanPairwiseSquaredError"],
     "random/extras": ["random.lognormal", "random.multinomial"],
+    "recurrent/onnx_layouts": ["rnn.lstmOnnx", "rnn.gruOnnx", "rnn.rnnOnnx"],
+    "parity_ops/element_indexing": ["shape.gatherElements",
+                                    "shape.scatterElements", "shape.eyeLike"],
+    "nn/activation_stragglers": ["nn.shrink", "nn.meanVarianceNormalization"],
+    "linalg/einsum": ["linalg.einsum"],
+    "loss/l2": ["loss.l2Loss"],
 }
 
 RNG = np.random.default_rng(7)

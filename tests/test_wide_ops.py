@@ -449,3 +449,52 @@ def test_ledger_fully_validated():
     remaining = {k for k in ledger_keys
                  if not re.search(rf"\b{re.escape(k.split('.')[1])}\b", corpus)}
     assert not remaining, f"ledger ops with no validation test: {sorted(remaining)}"
+
+
+class TestOnnxLayoutOpsDirect:
+    """Direct registry-level validation for the ONNX-layout ops (the importer
+    suites exercise them end-to-end; the ledger needs direct marks too)."""
+
+    def test_lstm_gru_rnn_onnx_shapes(self):
+        T, B, I, H = 4, 2, 3, 5
+        x = jnp.asarray(RNG.normal(size=(T, B, I)).astype(np.float32))
+        z = lambda *sh: jnp.zeros(sh, jnp.float32)
+        y, h, c = ops.rnn.lstmOnnx(x, z(1, 4*H, I), z(1, 4*H, H))
+        assert _np(y).shape == (T, 1, B, H) and _np(c).shape == (1, B, H)
+        y, h = ops.rnn.gruOnnx(x, z(2, 3*H, I), z(2, 3*H, H),
+                               direction="bidirectional")
+        assert _np(y).shape == (T, 2, B, H)
+        y, h = ops.rnn.rnnOnnx(x, z(1, H, I), z(1, H, H),
+                               activation="Relu")
+        assert _np(y).shape == (T, 1, B, H)
+        for k in ["lstmOnnx", "gruOnnx", "rnnOnnx"]:
+            mark_validated(k, "rnn")
+
+    def test_element_indexing(self):
+        x = np.arange(12.0, dtype=np.float32).reshape(3, 4)
+        idx = np.array([[1, 0, 2, 1]])
+        got = _np(ops.shape.gatherElements(x, idx, axis=0))
+        np.testing.assert_allclose(got, [[4.0, 1.0, 10.0, 7.0]])
+        got = _np(ops.shape.scatterElements(x, np.array([[1]]), np.array([[99.0]]),
+                                            axis=1, reduction="add"))
+        assert got[0, 1] == 1.0 + 99.0
+        eye = _np(ops.shape.eyeLike(x))
+        np.testing.assert_allclose(eye, np.eye(3, 4))
+        for k in ["gatherElements", "scatterElements", "eyeLike"]:
+            mark_validated(k, "shape")
+
+    def test_activation_stragglers_and_einsum(self):
+        v = np.array([-1.0, -0.2, 0.3, 0.9], np.float32)
+        got = _np(ops.nn.shrink(v, bias=0.1, lambd=0.5))
+        np.testing.assert_allclose(got, [-0.9, 0.0, 0.0, 0.8], rtol=1e-6)
+        x = np.ones((2, 3, 4, 4), np.float32)
+        mvn = _np(ops.nn.meanVarianceNormalization(x))
+        np.testing.assert_allclose(mvn, 0.0)
+        e = _np(ops.linalg.einsum(np.eye(2, dtype=np.float32),
+                                  np.ones((2, 2), np.float32), equation="ij,jk->ik"))
+        np.testing.assert_allclose(e, 1.0)
+        assert float(_np(ops.loss.l2Loss(np.array([3.0, 4.0])))) == 12.5
+        mark_validated("shrink", "nn")
+        mark_validated("meanVarianceNormalization", "nn")
+        mark_validated("einsum", "linalg")
+        mark_validated("l2Loss", "loss")
