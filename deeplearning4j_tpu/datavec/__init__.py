@@ -23,6 +23,7 @@ from deeplearning4j_tpu.datavec.arrow import ArrowConverter, ArrowRecordReader
 from deeplearning4j_tpu.datavec.codec import CodecRecordReader
 from deeplearning4j_tpu.datavec.jdbc import JdbcRecordReader
 from deeplearning4j_tpu.datavec.excel import ExcelRecordReader
+from deeplearning4j_tpu.datavec.geo import (GeoRecordReader, IPAddressToLocationTransform, IPLocationDatabase)
 
 __all__ = [
     "Writable", "DoubleWritable", "FloatWritable", "IntWritable", "LongWritable",
@@ -39,5 +40,6 @@ __all__ = [
     "ImageRecordReader", "NativeImageLoader",
     "ArrowConverter", "ArrowRecordReader",
     "CodecRecordReader", "JdbcRecordReader", "ExcelRecordReader",
+    "GeoRecordReader", "IPAddressToLocationTransform", "IPLocationDatabase",
     "BytesWritable",
 ]

@@ -1,0 +1,164 @@
+"""Geo record transforms (ref: datavec-geo —
+org.datavec.api.transform.transform.geo.IPAddressToLocationTransform backed
+by MaxMind GeoIP2; SURVEY.md §2.3 misc readers).
+
+The reference resolves IPs through a bundled GeoIP2 binary database. That
+database is proprietary and this environment has zero egress, so the
+TPU-native analog reads an open CSV network database (the format GeoLite2
+CSV exports use: ``network,latitude,longitude,city``) through the stdlib
+``ipaddress`` module. Point the transform at any such file — including a
+real GeoLite2 CSV export — via the Resources cache or a direct path.
+"""
+from __future__ import annotations
+
+import csv
+import ipaddress
+from bisect import bisect_right
+from typing import List, Optional, Tuple
+
+from deeplearning4j_tpu.datavec.records import RecordReader
+
+
+def _is_network(cell: str) -> bool:
+    try:
+        ipaddress.ip_network(cell.strip())
+        return True
+    except ValueError:
+        return False
+from deeplearning4j_tpu.datavec.writables import (
+    DoubleWritable,
+    NullWritable,
+    Text,
+    Writable,
+)
+
+
+class IPLocationDatabase:
+    """CIDR -> (lat, lon, label) lookup over a CSV network database.
+
+    Accepted layouts (auto-detected from the header):
+    - simple: ``network,latitude,longitude[,label]`` (header optional);
+    - GeoLite2 Blocks export: header names the columns (``network``,
+      ``latitude``, ``longitude``, label from ``geoname_id``); rows with
+      blank coordinates are skipped.
+
+    IPv4 and IPv6 networks live in separate keyspaces (an IPv6 address
+    whose integer happens to fall inside an IPv4 range must NOT match),
+    and nested CIDRs resolve to the most specific containing network.
+    """
+
+    def __init__(self, path: str):
+        nets4: List[Tuple[int, int, Tuple[float, float, str]]] = []
+        nets6: List[Tuple[int, int, Tuple[float, float, str]]] = []
+        with open(path, newline="") as f:
+            reader = csv.reader(f)
+            rows = list(reader)
+        cols = {"network": 0, "latitude": 1, "longitude": 2, "label": 3}
+        start_row = 0
+        if rows and not _is_network(rows[0][0]) \
+                and "network" in [c.strip().lower() for c in rows[0]]:
+            header = [c.strip().lower() for c in rows[0]]
+            cols["network"] = header.index("network")
+            cols["latitude"] = header.index("latitude")
+            cols["longitude"] = header.index("longitude")
+            if "label" in header:
+                cols["label"] = header.index("label")
+            elif "geoname_id" in header:
+                cols["label"] = header.index("geoname_id")
+            else:
+                cols["label"] = None
+            start_row = 1
+        for row in rows[start_row:]:
+            if not row or not row[cols["network"]].strip():
+                continue
+            lat_s = row[cols["latitude"]].strip() if cols["latitude"] < len(row) else ""
+            lon_s = row[cols["longitude"]].strip() if cols["longitude"] < len(row) else ""
+            if not lat_s or not lon_s:
+                continue  # GeoLite2 rows without coordinates
+            net = ipaddress.ip_network(row[cols["network"]].strip())
+            label = ""
+            if cols["label"] is not None and cols["label"] < len(row):
+                label = row[cols["label"]].strip()
+            loc = (float(lat_s), float(lon_s), label)
+            target = nets4 if net.version == 4 else nets6
+            target.append((int(net.network_address),
+                           int(net.broadcast_address), loc))
+        self._tables = {}
+        for ver, nets in ((4, nets4), (6, nets6)):
+            nets.sort()
+            # prefix max of interval ends: lets lookup() walk left past
+            # more-specific-but-non-containing subnets to find a supernet
+            pmax, cur = [], -1
+            for s, e, _ in nets:
+                cur = max(cur, e)
+                pmax.append(cur)
+            self._tables[ver] = ([n[0] for n in nets], nets, pmax)
+
+    def lookup(self, ip: str) -> Optional[Tuple[float, float, str]]:
+        try:
+            parsed = ipaddress.ip_address(ip.strip())
+        except ValueError:
+            return None
+        starts, nets, pmax = self._tables[parsed.version]
+        addr = int(parsed)
+        i = bisect_right(starts, addr) - 1
+        # walk left: the first containing interval is the most specific
+        # (largest start); pmax prunes once no remaining interval can reach
+        while i >= 0 and pmax[i] >= addr:
+            if nets[i][0] <= addr <= nets[i][1]:
+                return nets[i][2]
+            i -= 1
+        return None
+
+
+class IPAddressToLocationTransform:
+    """Column transform: replaces an IP string column with lat/lon(/label)
+    columns (ref: IPAddressToLocationTransform). Works standalone on
+    record lists; unresolvable IPs become NullWritable coordinates."""
+
+    def __init__(self, db: IPLocationDatabase, column_index: int,
+                 include_label: bool = False):
+        self.db = db
+        self.col = column_index
+        self.include_label = include_label
+
+    def map(self, record: List[Writable]) -> List[Writable]:
+        ip = record[self.col].toString() if hasattr(record[self.col], "toString") \
+            else str(record[self.col].value)
+        loc = self.db.lookup(ip)
+        if loc is None:
+            repl: List[Writable] = [NullWritable(), NullWritable()]
+            if self.include_label:
+                repl.append(NullWritable())
+        else:
+            repl = [DoubleWritable(loc[0]), DoubleWritable(loc[1])]
+            if self.include_label:
+                repl.append(Text(loc[2]))
+        return record[:self.col] + repl + record[self.col + 1:]
+
+
+class GeoRecordReader(RecordReader):
+    """Wraps another reader, applying the IP->location transform per record
+    (ref: datavec-geo usage pattern: reader + transform in a pipeline).
+
+    Deliberately NOT a TransformProcess step: steps are JSON-serializable
+    (kind, spec) pairs, and this transform closes over a loaded database;
+    a thin wrapper reader is simpler than threading a DB handle through the
+    serde machinery."""
+
+    def __init__(self, base: RecordReader, transform: IPAddressToLocationTransform):
+        self.base = base
+        self.transform = transform
+
+    def initialize(self, split):
+        self.base.initialize(split)
+        return self
+
+    def hasNext(self) -> bool:
+        return self.base.hasNext()
+
+    def next(self) -> List[Writable]:
+        return self.transform.map(self.base.next())
+
+    def reset(self):
+        self.base.reset()

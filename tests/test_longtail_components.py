@@ -296,3 +296,69 @@ class TestOnnxRunner:
         out = runner.run({"a": np.ones((2, 2), np.float32),
                           "b": np.full((2, 2), 2.0, np.float32)})
         np.testing.assert_allclose(out["c"], 3.0)
+
+
+class TestGeo:
+    """(ref: datavec-geo IPAddressToLocationTransform — SURVEY §2.3)."""
+
+    def _db(self, tmp_path):
+        p = tmp_path / "geo.csv"
+        p.write_text(
+            "network,latitude,longitude,label\n"
+            "10.0.0.0/8,52.52,13.40,berlin\n"
+            "192.168.1.0/24,37.77,-122.42,sf\n"
+            "2001:db8::/32,35.68,139.69,tokyo\n")
+        from deeplearning4j_tpu.datavec import IPLocationDatabase
+        return IPLocationDatabase(str(p))
+
+    def test_lookup_cidr_ranges(self, tmp_path):
+        db = self._db(tmp_path)
+        assert db.lookup("10.1.2.3")[2] == "berlin"
+        assert db.lookup("192.168.1.200")[2] == "sf"
+        assert db.lookup("192.168.2.1") is None     # outside the /24
+        assert db.lookup("2001:db8::42")[2] == "tokyo"
+        assert db.lookup("not-an-ip") is None
+
+    def test_transform_and_reader(self, tmp_path):
+        from deeplearning4j_tpu.datavec import (
+            CollectionRecordReader, GeoRecordReader,
+            IPAddressToLocationTransform)
+        from deeplearning4j_tpu.datavec.writables import (
+            DoubleWritable, NullWritable, Text)
+        db = self._db(tmp_path)
+        records = [[Text("alice"), Text("10.0.0.7")],
+                   [Text("bob"), Text("8.8.8.8")]]
+        rr = GeoRecordReader(
+            CollectionRecordReader(records),
+            IPAddressToLocationTransform(db, 1, include_label=True))
+        rows = list(rr)
+        assert isinstance(rows[0][1], DoubleWritable)
+        assert rows[0][1].value == 52.52 and rows[0][3].value == "berlin"
+        assert isinstance(rows[1][1], NullWritable)  # unknown network
+
+    def test_ipv6_keyspace_isolated(self, tmp_path):
+        db = self._db(tmp_path)
+        # '::a00:1' as an int falls inside 10.0.0.0/8's IPv4 span — must NOT match
+        assert db.lookup("::a00:1") is None
+
+    def test_nested_cidrs_most_specific_with_supernet_fallback(self, tmp_path):
+        from deeplearning4j_tpu.datavec import IPLocationDatabase
+        p = tmp_path / "nested.csv"
+        p.write_text("10.0.0.0/8,1.0,1.0,super\n10.0.1.0/24,2.0,2.0,sub\n")
+        db = IPLocationDatabase(str(p))
+        assert db.lookup("10.0.1.5")[2] == "sub"    # most specific wins
+        assert db.lookup("10.0.2.5")[2] == "super"  # supernet fallback
+
+    def test_geolite2_blocks_layout(self, tmp_path):
+        from deeplearning4j_tpu.datavec import IPLocationDatabase
+        p = tmp_path / "blocks.csv"
+        p.write_text(
+            "network,geoname_id,registered_country_geoname_id,represented_country_geoname_id,"
+            "is_anonymous_proxy,is_satellite_provider,postal_code,latitude,longitude,accuracy_radius\n"
+            "1.0.0.0/24,2077456,2077456,,0,0,,-33.49,143.21,1000\n"
+            "1.0.1.0/24,,,,0,0,,,,\n")  # blank coords: skipped
+        db = IPLocationDatabase(str(p))
+        loc = db.lookup("1.0.0.7")
+        assert loc is not None and abs(loc[0] + 33.49) < 1e-6
+        assert loc[2] == "2077456"
+        assert db.lookup("1.0.1.7") is None
