@@ -1,0 +1,105 @@
+"""Graph-mode op sweep (ref: OpValidation's per-op forward + serialization
+round-trip tier, SURVEY §4.1): for a broad sample of registry ops, the
+SameDiff graph execution must match eager execution, and the graph must
+survive save/load with the op's kwargs intact."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import ops as eager_ops
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+RNG = np.random.default_rng(21)
+X_POS = np.abs(RNG.normal(size=(3, 4))).astype(np.float32) + 0.1
+X_ANY = RNG.normal(size=(3, 4)).astype(np.float32)
+X_UNIT = (RNG.random((3, 4)).astype(np.float32) * 0.8 + 0.1)  # in (0,1)
+
+# op -> (namespace, input array). Positive-domain ops get X_POS etc.
+UNARY = {
+    "abs": ("math", X_ANY), "ceil": ("math", X_ANY), "floor": ("math", X_ANY),
+    "cos": ("math", X_ANY), "sin": ("math", X_ANY), "tan": ("math", X_ANY),
+    "cosh": ("math", X_ANY), "sinh": ("math", X_ANY), "tanh": ("math", X_ANY),
+    "acos": ("math", X_UNIT), "asin": ("math", X_UNIT), "atan": ("math", X_ANY),
+    "asinh": ("math", X_ANY), "atanh": ("math", X_UNIT),
+    "exp": ("math", X_ANY), "expm1": ("math", X_ANY),
+    "log": ("math", X_POS), "log1p": ("math", X_POS), "log2": ("math", X_POS),
+    "log10": ("math", X_POS), "sqrt": ("math", X_POS), "rsqrt": ("math", X_POS),
+    "square": ("math", X_ANY), "cube": ("math", X_ANY), "neg": ("math", X_ANY),
+    "reciprocal": ("math", X_POS), "sign": ("math", X_ANY),
+    "round": ("math", X_ANY), "rint": ("math", X_ANY), "trunc": ("math", X_ANY),
+    "erf": ("math", X_ANY), "erfc": ("math", X_ANY),
+    "digamma": ("math", X_POS), "lgamma": ("math", X_POS),
+    "sinc": ("math", X_ANY), "logit": ("math", X_UNIT),
+    "isnan": ("math", X_ANY), "isinf": ("math", X_ANY),
+    "isfinite": ("math", X_ANY), "cummax": ("math", X_ANY),
+    "cummin": ("math", X_ANY), "stopGradient": ("math", X_ANY),
+    "trigamma": ("math", X_POS), "step": ("math", X_ANY),
+    "relu": ("nn", X_ANY), "relu6": ("nn", X_ANY), "elu": ("nn", X_ANY),
+    "selu": ("nn", X_ANY), "celu": ("nn", X_ANY), "gelu": ("nn", X_ANY),
+    "sigmoid": ("nn", X_ANY), "hardSigmoid": ("nn", X_ANY),
+    "hardTanh": ("nn", X_ANY), "hardSwish": ("nn", X_ANY),
+    "softplus": ("nn", X_ANY), "softsign": ("nn", X_ANY),
+    "swish": ("nn", X_ANY), "mish": ("nn", X_ANY),
+    "logSigmoid": ("nn", X_ANY), "softmax": ("nn", X_ANY),
+    "logSoftmax": ("nn", X_ANY), "shrink": ("nn", X_ANY),
+}
+
+BINARY = {
+    "add": "math", "sub": "math", "mul": "math", "div": "math",
+    "max": "math", "min": "math", "pow": "math", "atan2": "math",
+    "hypot": "math", "squaredDifference": "math", "rsub": "math",
+    "rdiv": "math", "xlogy": "math", "nextafter": "math",
+    "realDiv": "math", "divideNoNan": "math",
+}
+
+
+@pytest.mark.parametrize("name", sorted(UNARY))
+def test_unary_graph_matches_eager_with_serde(name, tmp_path):
+    ns, x = UNARY[name]
+    eager = np.asarray(getattr(getattr(eager_ops, ns), name)(x).toNumpy())
+
+    sd = SameDiff.create()
+    v = sd.var("x", x)
+    out = getattr(getattr(sd, ns), name)(v)
+    got = np.asarray(sd.output({}, out.name)[out.name].toNumpy())
+    np.testing.assert_allclose(got, eager, rtol=1e-6, atol=1e-6)
+
+    # serialization round-trip preserves the op (ref: OpValidation serde leg)
+    p = str(tmp_path / f"{name}.zip")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    got2 = np.asarray(sd2.output({}, out.name)[out.name].toNumpy())
+    np.testing.assert_allclose(got2, eager, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", sorted(BINARY))
+def test_binary_graph_matches_eager(name):
+    ns = BINARY[name]
+    a = X_POS
+    b = (np.abs(RNG.normal(size=a.shape)) + 0.2).astype(np.float32)
+    eager = np.asarray(getattr(getattr(eager_ops, ns), name)(a, b).toNumpy())
+    sd = SameDiff.create()
+    va, vb = sd.var("a", a), sd.var("b", b)
+    out = getattr(getattr(sd, ns), name)(va, vb)
+    got = np.asarray(sd.output({}, out.name)[out.name].toNumpy())
+    np.testing.assert_allclose(got, eager, rtol=1e-6, atol=1e-6)
+
+
+def test_reduce_ops_graph_with_dims_kwargs(tmp_path):
+    """kwargs (dims/keepdims) must survive graph serde."""
+    x = X_ANY
+    for name in ["sum", "mean", "max", "min", "prod", "norm1", "norm2",
+                 "squaredNorm", "logSumExp", "normMax", "countNonZero"]:
+        xx = x
+        eager = np.asarray(getattr(eager_ops.reduce, name)(
+            xx, dims=(1,), keepdims=True).toNumpy())
+        sd = SameDiff.create()
+        v = sd.var("x", xx)
+        out = sd.reduce.__getattr__(name)(v, dims=(1,), keepdims=True)
+        p = str(tmp_path / f"{name}.zip")
+        sd.save(p)
+        got = np.asarray(SameDiff.load(p).output({}, out.name)[out.name].toNumpy())
+        np.testing.assert_allclose(got, eager, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
