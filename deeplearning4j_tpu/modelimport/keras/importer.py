@@ -145,6 +145,17 @@ def _map_layer(cls: str, c: dict) -> Tuple[Optional[L.Layer], bool]:
     act = _act(c.get("activation"))
     same = (c.get("padding", "valid") == "same")
     mode = "Same" if same else "Truncate"
+    if cls == "TimeDistributed":
+        # ref: KerasTimeDistributed — unwrap; a Dense applied per timestep is
+        # exactly our DenseLayer on (B, T, F) (the matmul broadcasts over T)
+        inner = c["layer"]
+        inner_cls = inner["class_name"]
+        if inner_cls not in ("Dense", "Activation", "Dropout"):
+            raise ValueError(
+                f"TimeDistributed({inner_cls}) not supported by the importer")
+        return _map_layer(inner_cls, inner["config"])
+    if cls == "RepeatVector":
+        return L.RepeatVector(repetitionFactor=c["n"]), False
     if cls == "Dense":
         return L.DenseLayer(nOut=c["units"], activation=act,
                             hasBias=c.get("use_bias", True)), True

@@ -855,6 +855,25 @@ class Bidirectional(Layer):
 
 
 @dataclass
+class RepeatVector(Layer):
+    """Repeats a (B, F) feature vector n times into a (B, n, F) sequence
+    (ref: conf.layers.misc.RepeatVector — the reference stores NCW [B, F, n];
+    this framework's recurrent stack is NWC, so the time axis is axis 1, the
+    same tensor transposed)."""
+    repetitionFactor: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.size, self.repetitionFactor)
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        if x.ndim != 2:
+            raise ValueError(
+                f"RepeatVector expects (B, F) feed-forward input, got rank "
+                f"{x.ndim} — the reference requires FF input too")
+        return jnp.repeat(x[:, None, :], self.repetitionFactor, axis=1), state
+
+
+@dataclass
 class LastTimeStep(Layer):
     """Wrapper extracting the last (masked) timestep (ref:
     conf.layers.recurrent.LastTimeStep)."""
@@ -1896,5 +1915,5 @@ LAYER_TYPES = {c.__name__: c for c in [
     LocallyConnected2D, AutoEncoder, VariationalAutoencoder, CenterLossOutputLayer,
     OCNNOutputLayer, Yolo2OutputLayer, GravesBidirectionalLSTM,
     LearnedSelfAttentionLayer, RecurrentAttentionLayer,
-    PrimaryCapsules, CapsuleLayer, CapsuleStrengthLayer,
+    PrimaryCapsules, CapsuleLayer, CapsuleStrengthLayer, RepeatVector,
 ]}

@@ -157,3 +157,24 @@ class TestKerasMappers:
         ours = np.asarray(net.output(x))
         theirs = model.predict(x, verbose=0)
         np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+class TestKerasSeq2SeqMappers:
+    def test_repeat_vector_and_time_distributed(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        model = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(8, activation="tanh"),
+            keras.layers.RepeatVector(5),
+            keras.layers.LSTM(7, return_sequences=True),
+            keras.layers.TimeDistributed(keras.layers.Dense(3, activation="softmax")),
+        ])
+        p = str(tmp_path / "s2s.h5")
+        model.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        ours = np.asarray(net.output(x))
+        theirs = model.predict(x, verbose=0)
+        assert ours.shape == theirs.shape == (4, 5, 3)
+        np.testing.assert_allclose(ours, theirs, atol=1e-5)

@@ -75,7 +75,7 @@ def test_unary_graph_matches_eager_with_serde(name, tmp_path):
 
 
 @pytest.mark.parametrize("name", sorted(BINARY))
-def test_binary_graph_matches_eager(name):
+def test_binary_graph_matches_eager_with_serde(name, tmp_path):
     ns = BINARY[name]
     a = X_POS
     b = (np.abs(RNG.normal(size=a.shape)) + 0.2).astype(np.float32)
@@ -85,6 +85,11 @@ def test_binary_graph_matches_eager(name):
     out = getattr(getattr(sd, ns), name)(va, vb)
     got = np.asarray(sd.output({}, out.name)[out.name].toNumpy())
     np.testing.assert_allclose(got, eager, rtol=1e-6, atol=1e-6)
+    # two-input wiring must survive serde (input order matters for sub/div)
+    p = str(tmp_path / f"{name}.zip")
+    sd.save(p)
+    got2 = np.asarray(SameDiff.load(p).output({}, out.name)[out.name].toNumpy())
+    np.testing.assert_allclose(got2, eager, rtol=1e-6, atol=1e-6)
 
 
 def test_reduce_ops_graph_with_dims_kwargs(tmp_path):
@@ -92,12 +97,11 @@ def test_reduce_ops_graph_with_dims_kwargs(tmp_path):
     x = X_ANY
     for name in ["sum", "mean", "max", "min", "prod", "norm1", "norm2",
                  "squaredNorm", "logSumExp", "normMax", "countNonZero"]:
-        xx = x
         eager = np.asarray(getattr(eager_ops.reduce, name)(
-            xx, dims=(1,), keepdims=True).toNumpy())
+            x, dims=(1,), keepdims=True).toNumpy())
         sd = SameDiff.create()
-        v = sd.var("x", xx)
-        out = sd.reduce.__getattr__(name)(v, dims=(1,), keepdims=True)
+        v = sd.var("x", x)
+        out = getattr(sd.reduce, name)(v, dims=(1,), keepdims=True)
         p = str(tmp_path / f"{name}.zip")
         sd.save(p)
         got = np.asarray(SameDiff.load(p).output({}, out.name)[out.name].toNumpy())
