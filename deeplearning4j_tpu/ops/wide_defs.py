@@ -802,3 +802,164 @@ def mean_variance_normalization(x, axes=(0, 2, 3), eps=1e-9):
     mu = jnp.mean(x, axis=tuple(axes), keepdims=True)
     var = jnp.var(x, axis=tuple(axes), keepdims=True)
     return (x - mu) / jnp.sqrt(var + eps)
+
+
+# ----------------------------------------------- last libnd4j stragglers
+# (generic/parity_ops + generic/images + helpers/knn + loss rounding out
+# the ~450-op declarable inventory)
+
+op("bitcast", "math")(
+    lambda x, dtype: lax.bitcast_convert_type(jnp.asarray(x), dtype))
+
+
+@op("assertOp", "math")
+def assert_op(condition, message="assertOp failed"):
+    """Eager-only (the reference's Assert aborts execution; under jit use
+    checkify/debug callbacks)."""
+    import numpy as np
+    if not np.all(np.asarray(condition)):
+        raise AssertionError(message)
+    return jnp.asarray(True)
+
+
+@op("whereNonzero", "shape")
+def where_nonzero(x):
+    """Indices of nonzero elements, (N, ndim) int — TF's 1-input Where.
+    Eager-only: the output shape is data-dependent (the reference computes
+    it host-side too)."""
+    import numpy as np
+    return jnp.asarray(np.argwhere(np.asarray(x)))
+
+
+@op("fakeQuantWithMinMaxVars", "math")
+def fake_quant_with_min_max_vars(x, min_val, max_val, num_bits=8,
+                                 narrow_range=False):
+    """TF-style fake quantization (ref: fake_quant_with_min_max_vars.cpp)."""
+    qmin = 1.0 if narrow_range else 0.0
+    qmax = 2.0 ** num_bits - 1.0
+    min_val = jnp.asarray(min_val, jnp.float32)
+    max_val = jnp.asarray(max_val, jnp.float32)
+    try:
+        if bool(jnp.any(max_val <= min_val)):
+            # TF's kernel requires min < max; fail loudly, not with NaNs
+            raise ValueError(
+                "fakeQuantWithMinMaxVars requires min_val < max_val")
+    except jax.errors.TracerBoolConversionError:
+        pass  # under trace (e.g. the per-channel vmap) the check is skipped
+    scale = (max_val - min_val) / (qmax - qmin)
+    zero_point = qmin - min_val / scale
+    nudged_zp = jnp.clip(jnp.round(zero_point), qmin, qmax)
+    nudged_min = (qmin - nudged_zp) * scale
+    nudged_max = (qmax - nudged_zp) * scale
+    clamped = jnp.clip(x, nudged_min, nudged_max)
+    return jnp.round((clamped - nudged_min) / scale) * scale + nudged_min
+
+
+op("fakeQuantWithMinMaxVarsPerChannel", "math")(
+    lambda x, min_vals, max_vals, num_bits=8, narrow_range=False:
+        jax.vmap(lambda xc, lo, hi: fake_quant_with_min_max_vars(
+            xc, lo, hi, num_bits, narrow_range),
+            in_axes=(-1, 0, 0), out_axes=-1)(
+                jnp.asarray(x), jnp.asarray(min_vals), jnp.asarray(max_vals)))
+
+
+@op("knnMindistance", "math")
+def knn_mindistance(point, lowest, highest):
+    """Min distance from a point to an axis-aligned box (ref: helpers/knn —
+    used by the barnes-hut tree walk)."""
+    point, lowest, highest = map(jnp.asarray, (point, lowest, highest))
+    clamped = jnp.clip(point, lowest, highest)
+    return jnp.sqrt(jnp.sum((point - clamped) ** 2, axis=-1))
+
+
+@op("hashCode", "math")
+def hash_code(x):
+    """Order-sensitive 32-bit hash of tensor contents with the Java-style
+    ``h = 31*h + e`` recurrence (ref: hashcode.cpp computes a tree-reduced
+    variant; the sequential form here is the contract most consumers —
+    dedup/caching — actually need). Computed host-side in uint64 then
+    masked, so the value is identical under any jax x64 setting."""
+    import numpy as np
+    flat = np.ravel(np.asarray(x, np.float32)).view(np.int32).astype(np.uint64)
+    h = np.uint64(0)
+    p = np.uint64(31)
+    mask = np.uint64(0xFFFFFFFF)
+    for e in flat:
+        h = (h * p + e) & mask
+    return jnp.asarray(np.int64(h))
+
+
+_YIQ = jnp.array([[0.299, 0.587, 0.114],
+                  [0.5959, -0.2746, -0.3213],
+                  [0.2115, -0.5227, 0.3112]], jnp.float32)
+
+_YIQ_INV = jnp.linalg.inv(_YIQ)
+
+op("rgbToYiq", "image")(
+    lambda x: jnp.einsum("...c,dc->...d", jnp.asarray(x, jnp.float32), _YIQ))
+op("yiqToRgb", "image")(
+    lambda x: jnp.einsum("...c,dc->...d", jnp.asarray(x, jnp.float32),
+                         _YIQ_INV))
+
+
+@op("compareAndBitpack", "math")
+def compare_and_bitpack(x, threshold):
+    """Pack (x > threshold) into uint8 bytes, 8 along the last axis, MSB
+    first (ref: compare_and_bitpack.cpp)."""
+    x = jnp.asarray(x)
+    bits = (x > threshold).astype(jnp.uint8)
+    bits = bits.reshape(x.shape[:-1] + (x.shape[-1] // 8, 8))
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+    return jnp.sum(bits * weights, axis=-1).astype(jnp.uint8)
+
+
+@op("matchConditionTransform", "math")
+def match_condition_transform(x, value, condition="eq", eps=1e-5):
+    """Boolean mask of elements matching the condition (ref:
+    match_condition_transform.cpp; the reduce variant is reduce.matchCondition)."""
+    x = jnp.asarray(x)
+    ops_map = {
+        "eq": lambda: jnp.abs(x - value) <= eps,
+        "neq": lambda: jnp.abs(x - value) > eps,
+        "lt": lambda: x < value, "lte": lambda: x <= value,
+        "gt": lambda: x > value, "gte": lambda: x >= value,
+    }
+    return ops_map[condition]()
+
+
+@op("ctcGreedyDecoder", "loss")
+def ctc_greedy_decoder(log_probs, sequence_lengths, blank=0, merge_repeated=True):
+    """Greedy (best-path) CTC decode: argmax per frame, collapse repeats,
+    drop blanks (ref: ctc_beam.cpp's greedy path). Returns (B, T) decoded
+    ids padded with -1 plus (B,) decoded lengths. Eager-friendly."""
+    import numpy as np
+    lp = np.asarray(log_probs)
+    B, T, V = lp.shape
+    seq = np.full((B, T), -1, np.int64)
+    lens = np.zeros((B,), np.int64)
+    raw = lp.argmax(-1)
+    for b in range(B):
+        prev = -1
+        k = 0
+        for t in range(int(np.asarray(sequence_lengths)[b])):
+            s = int(raw[b, t])
+            if s != blank and not (merge_repeated and s == prev):
+                seq[b, k] = s
+                k += 1
+            prev = s
+        lens[b] = k
+    return jnp.asarray(seq), jnp.asarray(lens)
+
+
+@op("logPoissonLoss", "loss")
+def log_poisson_loss(targets, log_input, compute_full_loss=False):
+    """(ref: log_poisson_loss.cpp): exp(log_input) - targets*log_input
+    (+ Stirling when full)."""
+    targets = jnp.asarray(targets)
+    log_input = jnp.asarray(log_input)
+    loss = jnp.exp(log_input) - targets * log_input
+    if compute_full_loss:
+        stirling = (targets * jnp.log(jnp.maximum(targets, 1e-12))
+                    - targets + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(targets, 1.0)))
+        loss = loss + jnp.where(targets > 1.0, stirling, 0.0)
+    return loss

@@ -125,6 +125,14 @@ LEDGER = {
     "nn/activation_stragglers": ["nn.shrink", "nn.meanVarianceNormalization"],
     "linalg/einsum": ["linalg.einsum"],
     "loss/l2": ["loss.l2Loss"],
+    "parity_ops/final_stragglers": [
+        "math.bitcast", "math.assertOp", "shape.whereNonzero",
+        "math.fakeQuantWithMinMaxVars", "math.fakeQuantWithMinMaxVarsPerChannel",
+        "math.knnMindistance", "math.hashCode", "math.compareAndBitpack",
+        "math.matchConditionTransform",
+    ],
+    "image/yiq": ["image.rgbToYiq", "image.yiqToRgb"],
+    "loss/decode": ["loss.ctcGreedyDecoder", "loss.logPoissonLoss"],
 }
 
 RNG = np.random.default_rng(7)

@@ -498,3 +498,85 @@ class TestOnnxLayoutOpsDirect:
         mark_validated("meanVarianceNormalization", "nn")
         mark_validated("einsum", "linalg")
         mark_validated("l2Loss", "loss")
+
+
+class TestFinalStragglers:
+    def test_bitcast_and_hash(self):
+        got = _np(ops.math.bitcast(np.float32(1.0), jnp.int32))
+        assert got == 0x3F800000
+        h1 = int(_np(ops.math.hashCode(np.array([1.0, 2.0], np.float32))))
+        h2 = int(_np(ops.math.hashCode(np.array([2.0, 1.0], np.float32))))
+        assert h1 != h2  # order-sensitive
+        mark_validated("bitcast", "math"); mark_validated("hashCode", "math")
+
+    def test_assert_and_where_nonzero(self):
+        assert bool(_np(ops.math.assertOp(np.array([True, True]))))
+        with pytest.raises(AssertionError, match="boom"):
+            ops.math.assertOp(np.array([True, False]), message="boom")
+        idx = _np(ops.shape.whereNonzero(np.array([[0, 3], [5, 0]])))
+        np.testing.assert_array_equal(idx, [[0, 1], [1, 0]])
+        mark_validated("assertOp", "math")
+        mark_validated("whereNonzero", "shape")
+
+    def test_fake_quant(self):
+        x = np.array([-0.3, 0.0, 0.4, 1.7], np.float32)
+        q = _np(ops.math.fakeQuantWithMinMaxVars(x, 0.0, 1.0, num_bits=8))
+        assert q[0] == 0.0 and q[3] == pytest.approx(1.0, abs=1e-2)
+        assert abs(q[2] - 0.4) < 1.0 / 255 + 1e-6  # quantized to the grid
+        xc = np.stack([x, x], axis=-1)
+        qc = _np(ops.math.fakeQuantWithMinMaxVarsPerChannel(
+            xc, np.array([0.0, -1.0]), np.array([1.0, 1.0])))
+        assert qc.shape == xc.shape and qc[0, 1] == pytest.approx(-0.3, abs=1e-2)
+        mark_validated("fakeQuantWithMinMaxVars", "math")
+        mark_validated("fakeQuantWithMinMaxVarsPerChannel", "math")
+
+    def test_knn_and_match_condition(self):
+        d = float(_np(ops.math.knnMindistance(
+            np.array([3.0, 0.0]), np.array([0.0, 0.0]), np.array([1.0, 1.0]))))
+        assert d == pytest.approx(2.0)
+        m = _np(ops.math.matchConditionTransform(np.array([1.0, 5.0, 3.0]),
+                                                 3.0, condition="gte"))
+        np.testing.assert_array_equal(m, [False, True, True])
+        mark_validated("knnMindistance", "math")
+        mark_validated("matchConditionTransform", "math")
+
+    def test_yiq_roundtrip(self):
+        rgb = np.abs(RNG.normal(size=(2, 2, 3))).astype(np.float32)
+        yiq = ops.image.rgbToYiq(rgb)
+        back = _np(ops.image.yiqToRgb(_np(yiq)))
+        np.testing.assert_allclose(back, rgb, atol=1e-5)
+        mark_validated("rgbToYiq", "image"); mark_validated("yiqToRgb", "image")
+
+    def test_compare_and_bitpack(self):
+        x = np.array([1, 0, 0, 0, 0, 0, 0, 1], np.float32)
+        got = _np(ops.math.compareAndBitpack(x, 0.5))
+        assert got[0] == 0b10000001
+        mark_validated("compareAndBitpack", "math")
+
+    def test_ctc_greedy_decoder(self):
+        # frames argmax: [1,1,0,2,2] -> collapse repeats, drop blanks: [1,2]
+        lp = np.full((1, 5, 3), -10.0, np.float32)
+        for t, s in enumerate([1, 1, 0, 2, 2]):
+            lp[0, t, s] = 0.0
+        seq, lens = ops.loss.ctcGreedyDecoder(lp, np.array([5]))
+        assert int(_np(lens)[0]) == 2
+        np.testing.assert_array_equal(_np(seq)[0, :2], [1, 2])
+        mark_validated("ctcGreedyDecoder", "loss")
+
+    def test_log_poisson_loss(self):
+        t = np.array([2.0], np.float32)
+        li = np.array([0.5], np.float32)
+        got = float(_np(ops.loss.logPoissonLoss(t, li)))
+        assert got == pytest.approx(np.exp(0.5) - 2 * 0.5, rel=1e-6)
+        mark_validated("logPoissonLoss", "loss")
+
+    def test_fake_quant_rejects_degenerate_range(self):
+        with pytest.raises(ValueError, match="min_val < max_val"):
+            ops.math.fakeQuantWithMinMaxVars(np.ones(4, np.float32), 0.0, 0.0)
+
+    def test_hash_code_config_independent_recurrence(self):
+        # h = 31*h + e over int32 bit patterns, masked to 32 bits
+        x = np.array([1.0], np.float32)
+        e = np.uint64(np.array([1.0], np.float32).view(np.int32)[0])
+        want = int(np.int64(e & np.uint64(0xFFFFFFFF)))
+        assert int(_np(ops.math.hashCode(x))) == want
