@@ -874,18 +874,23 @@ def knn_mindistance(point, lowest, highest):
 
 @op("hashCode", "math")
 def hash_code(x):
-    """Order-sensitive 32-bit hash of tensor contents with the Java-style
-    ``h = 31*h + e`` recurrence (ref: hashcode.cpp computes a tree-reduced
-    variant; the sequential form here is the contract most consumers —
-    dedup/caching — actually need). Computed host-side in uint64 then
-    masked, so the value is identical under any jax x64 setting."""
+    """Order-sensitive 32-bit hash over the tensor's RAW bytes with the
+    Java-style ``h = 31*h + e`` recurrence (ref: hashcode.cpp computes a
+    tree-reduced variant; the sequential form is the contract dedup/caching
+    consumers need). Hashing native bytes keeps distinct float64/int64
+    tensors distinct (no float32 round-through), and is dtype- and
+    x64-config-independent. Vectorized: h = sum(e_i * 31^(n-1-i)) — uint64
+    wraparound preserves residues mod 2^32 since 2^32 | 2^64."""
     import numpy as np
-    flat = np.ravel(np.asarray(x, np.float32)).view(np.int32).astype(np.uint64)
-    h = np.uint64(0)
-    p = np.uint64(31)
-    mask = np.uint64(0xFFFFFFFF)
-    for e in flat:
-        h = (h * p + e) & mask
+    data = np.ascontiguousarray(np.asarray(x))
+    flat = np.frombuffer(data.tobytes(), np.uint8).astype(np.uint64)
+    n = flat.size
+    if n == 0:
+        return jnp.asarray(np.int64(0))
+    pows = np.ones(n, np.uint64)
+    if n > 1:
+        np.multiply.accumulate(np.full(n - 1, 31, np.uint64), out=pows[1:])
+    h = np.uint64((flat * pows[::-1]).sum()) & np.uint64(0xFFFFFFFF)
     return jnp.asarray(np.int64(h))
 
 

@@ -1,0 +1,61 @@
+"""ONNX import + execution (ref: dl4j-examples ONNX/import usage;
+nd4j-onnxruntime's OnnxRuntimeRunner API shape).
+
+Builds a small MLP ONNX model in-process with the vendored proto bindings
+(no `onnx` pip package needed), imports it onto SameDiff — one jitted XLA
+executable — and runs it through the ORT-shaped OnnxRunner facade.
+"""
+import _bootstrap  # noqa: F401
+
+import numpy as np
+
+from deeplearning4j_tpu.interop import OnnxRunner
+from deeplearning4j_tpu.modelimport.onnx import numpy_to_tensor, onnx_pb
+
+rng = np.random.default_rng(0)
+W1 = rng.normal(size=(6, 16)).astype(np.float32) * 0.3
+B1 = np.zeros(16, np.float32)
+W2 = rng.normal(size=(16, 3)).astype(np.float32) * 0.3
+
+m = onnx_pb.ModelProto()
+m.ir_version = 8
+opset = m.opset_import.add(); opset.domain = ""; opset.version = 17
+g = m.graph
+g.name = "mlp"
+
+def node(op, ins, outs, **attrs):
+    n = g.node.add()
+    n.op_type = op; n.name = outs[0]
+    n.input.extend(ins); n.output.extend(outs)
+    for k, v in attrs.items():
+        a = n.attribute.add(); a.name = k
+        a.type = onnx_pb.AttributeProto.INT; a.i = int(v)
+    return n
+
+node("MatMul", ["x", "W1"], ["h0"])
+node("Add", ["h0", "B1"], ["h1"])
+node("Relu", ["h1"], ["h2"])
+node("MatMul", ["h2", "W2"], ["logits"])
+node("Softmax", ["logits"], ["probs"], axis=-1)
+
+vi = g.input.add(); vi.name = "x"
+vi.type.tensor_type.elem_type = 1
+for d in (4, 6):
+    vi.type.tensor_type.shape.dim.add().dim_value = d
+g.output.add().name = "probs"
+g.initializer.extend([numpy_to_tensor("W1", W1), numpy_to_tensor("B1", B1),
+                      numpy_to_tensor("W2", W2)])
+
+runner = OnnxRunner(m)
+x = rng.normal(size=(4, 6)).astype(np.float32)
+out = runner.run({"x": x})["probs"]
+print("inputs:", runner.input_names, "outputs:", runner.output_names)
+print("probs row sums:", np.round(out.sum(axis=1), 5))
+
+# numpy oracle — 1e-3 tolerance: on accelerators fp32 matmuls use the
+# platform's fast default precision (see the dtype-policy note in README)
+ref = np.maximum(x @ W1 + B1, 0) @ W2
+ref = np.exp(ref - ref.max(1, keepdims=True))
+ref /= ref.sum(1, keepdims=True)
+assert np.allclose(out, ref, atol=1e-3)
+print("matches the numpy oracle")

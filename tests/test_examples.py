@@ -12,7 +12,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 QUICK = ["csv_datavec_pipeline", "samediff_training", "checkpoint_resume",
          "early_stopping", "live_dashboard", "word2vec_nearest",
-         "hyperparameter_search", "fasttext_oov"]
+         "hyperparameter_search", "fasttext_oov", "onnx_import_run"]
 SLOW = ["mnist_lenet", "rl_cartpole_a3c", "bert_sharded_training",
         "data_parallel_training", "keras_import_finetune"]
 

@@ -575,8 +575,15 @@ class TestFinalStragglers:
             ops.math.fakeQuantWithMinMaxVars(np.ones(4, np.float32), 0.0, 0.0)
 
     def test_hash_code_config_independent_recurrence(self):
-        # h = 31*h + e over int32 bit patterns, masked to 32 bits
-        x = np.array([1.0], np.float32)
-        e = np.uint64(np.array([1.0], np.float32).view(np.int32)[0])
-        want = int(np.int64(e & np.uint64(0xFFFFFFFF)))
-        assert int(_np(ops.math.hashCode(x))) == want
+        # h = 31*h + e over the RAW bytes, masked to 32 bits:
+        # float32 1.0 = 00 00 80 3f (LE) -> ((0*31+0)*31+128)*31+63
+        assert int(_np(ops.math.hashCode(np.array([1.0], np.float32)))) \
+            == 128 * 31 + 63
+        # dtype-sensitive: int64 values that collide under a float32 cast
+        # must hash differently (hash is over native bytes)
+        a = ops.math.hashCode(np.array([16777216], np.int64))
+        b = ops.math.hashCode(np.array([16777217], np.int64))
+        assert int(_np(a)) != int(_np(b))
+        # vectorized path handles large inputs fast
+        big = np.arange(1_000_000, dtype=np.float32)
+        assert np.isfinite(float(_np(ops.math.hashCode(big))))
