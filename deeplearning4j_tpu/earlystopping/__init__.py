@@ -1,6 +1,7 @@
 """Early stopping (ref: org.deeplearning4j.earlystopping.*)."""
 from deeplearning4j_tpu.earlystopping.trainer import (
     EarlyStoppingConfiguration, EarlyStoppingResult, EarlyStoppingTrainer,
+    EarlyStoppingParallelTrainer,
     InMemoryModelSaver, LocalFileModelSaver,
     MaxEpochsTerminationCondition, MaxScoreIterationTerminationCondition,
     MaxTimeIterationTerminationCondition, ScoreImprovementEpochTerminationCondition,
@@ -8,6 +9,7 @@ from deeplearning4j_tpu.earlystopping.trainer import (
 
 __all__ = [
     "EarlyStoppingConfiguration", "EarlyStoppingResult", "EarlyStoppingTrainer",
+    "EarlyStoppingParallelTrainer",
     "InMemoryModelSaver", "LocalFileModelSaver",
     "MaxEpochsTerminationCondition", "MaxScoreIterationTerminationCondition",
     "MaxTimeIterationTerminationCondition", "ScoreImprovementEpochTerminationCondition",

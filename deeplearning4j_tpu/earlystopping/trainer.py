@@ -225,6 +225,11 @@ class EarlyStoppingTrainer:
         self.model = model
         self.trainData = trainData
 
+    def _fit_epoch(self):
+        """One epoch of training; subclasses swap the executor (the parallel
+        trainer routes through ParallelWrapper)."""
+        self.model.fit(self.trainData)
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         score_vs_epoch = {}
@@ -244,7 +249,7 @@ class EarlyStoppingTrainer:
                 if hasattr(self.trainData, "reset"):
                     self.trainData.reset()
                 try:
-                    self.model.fit(self.trainData)
+                    self._fit_epoch()
                 except _StopTraining:
                     reason = "IterationTerminationCondition"
                     details = guard.tripped or ""
@@ -280,3 +285,19 @@ class EarlyStoppingTrainer:
             terminationReason=reason, terminationDetails=details,
             scoreVsEpoch=score_vs_epoch, bestModelEpoch=best_epoch,
             bestModelScore=best_score, totalEpochs=epoch + 1, bestModel=best)
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping over sharded data-parallel training (ref:
+    org.deeplearning4j.parallelism.EarlyStoppingParallelTrainer — the
+    reference threads replicas; here each epoch runs through
+    ParallelWrapper's lockstep-psum jit, and scoring/saving read the single
+    authoritative model the wrapper trains in place)."""
+
+    def __init__(self, config, model, trainData, mesh=None, workers=None):
+        super().__init__(config, model, trainData)
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+        self.wrapper = ParallelWrapper(model, mesh=mesh, workers=workers)
+
+    def _fit_epoch(self):
+        self.wrapper.fit(self.trainData)

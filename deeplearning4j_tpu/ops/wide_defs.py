@@ -875,23 +875,32 @@ def knn_mindistance(point, lowest, highest):
 @op("hashCode", "math")
 def hash_code(x):
     """Order-sensitive 32-bit hash over the tensor's RAW bytes with the
-    Java-style ``h = 31*h + e`` recurrence (ref: hashcode.cpp computes a
-    tree-reduced variant; the sequential form is the contract dedup/caching
-    consumers need). Hashing native bytes keeps distinct float64/int64
-    tensors distinct (no float32 round-through), and is dtype- and
-    x64-config-independent. Vectorized: h = sum(e_i * 31^(n-1-i)) — uint64
-    wraparound preserves residues mod 2^32 since 2^32 | 2^64."""
+    Java-style ``h = 31*h + e`` recurrence (ref: hashcode.cpp hashes the
+    native buffer in the array's own dtype; a float32 and float64 view of
+    the same values hash DIFFERENTLY, there as here — canonicalize dtype
+    before hashing if config-independent keys are needed). Vectorized in
+    fixed-size chunks: per chunk sum(e_i * 31^(m-1-i)), chained with
+    h = h*31^m + chunk — uint64 wraparound preserves residues mod 2^32
+    since 2^32 | 2^64, and peak memory stays bounded for GB-scale tensors."""
     import numpy as np
-    data = np.ascontiguousarray(np.asarray(x))
-    flat = np.frombuffer(data.tobytes(), np.uint8).astype(np.uint64)
-    n = flat.size
+    arr = np.ravel(np.asarray(x))  # contiguous; copies only if it must
+    bytes_view = arr.view(np.uint8)
+    n = bytes_view.size
     if n == 0:
         return jnp.asarray(np.int64(0))
-    pows = np.ones(n, np.uint64)
-    if n > 1:
-        np.multiply.accumulate(np.full(n - 1, 31, np.uint64), out=pows[1:])
-    h = np.uint64((flat * pows[::-1]).sum()) & np.uint64(0xFFFFFFFF)
-    return jnp.asarray(np.int64(h))
+    CHUNK = 1 << 20
+    h = np.uint64(0)
+    with np.errstate(over="ignore"):  # uint64 wraparound is the algorithm
+        for start in range(0, n, CHUNK):
+            block = bytes_view[start:start + CHUNK].astype(np.uint64)
+            m = block.size
+            pows = np.ones(m, np.uint64)
+            if m > 1:
+                np.multiply.accumulate(np.full(m - 1, 31, np.uint64),
+                                       out=pows[1:])
+            h = (h * np.uint64(pow(31, m, 1 << 64))
+                 + np.uint64((block * pows[::-1]).sum()))
+    return jnp.asarray(np.int64(h & np.uint64(0xFFFFFFFF)))
 
 
 _YIQ = jnp.array([[0.299, 0.587, 0.114],

@@ -151,3 +151,43 @@ class TestLongContext:
         want = reference_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5)
+
+
+class TestEarlyStoppingParallel:
+    def test_early_stopping_over_parallel_wrapper(self):
+        """(ref: EarlyStoppingParallelTrainer) — the ES loop drives sharded
+        DP epochs; best model and termination bookkeeping behave as in the
+        single-device trainer."""
+        from deeplearning4j_tpu.data.dataset import DataSet, ListDataSetIterator
+        from deeplearning4j_tpu.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingParallelTrainer, InMemoryModelSaver,
+            MaxEpochsTerminationCondition)
+        from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.train.updaters import Adam
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+        ds = DataSet(x, y)
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(nIn=4, nOut=16, activation="RELU"))
+                .layer(OutputLayer(nIn=16, nOut=2, activation="SOFTMAX",
+                                   lossFunction="MCXENT"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        esc = (EarlyStoppingConfiguration.Builder()
+               .epochTerminationConditions(MaxEpochsTerminationCondition(5))
+               .scoreCalculator(DataSetLossCalculator(
+                   ListDataSetIterator(ds.batchBy(16))))
+               .modelSaver(InMemoryModelSaver())
+               .build())
+        trainer = EarlyStoppingParallelTrainer(
+            esc, net, ListDataSetIterator(ds.batchBy(16)))
+        result = trainer.fit()
+        assert result.totalEpochs == 5
+        assert result.bestModel is not None
+        scores = list(result.scoreVsEpoch.values())
+        assert scores[-1] < scores[0]  # DP epochs actually trained the model
