@@ -123,9 +123,16 @@ class KerasModelImport:
 
 # ---------------------------------------------------------------- mapping
 
-def _input_type_from_shape(shape) -> Optional[InputType]:
-    """Keras batch_shape (None, H, W, C) / (None, T, F) / (None, F) -> InputType."""
+def _input_type_from_shape(shape, consumer_cls: Optional[str] = None
+                           ) -> Optional[InputType]:
+    """Keras batch_shape (None, H, W, C) / (None, T, F) / (None, F) ->
+    InputType. A 4-post-batch-dim input is ambiguous (NDHWC 3D conv vs a
+    (T, H, W, C) image sequence); ``consumer_cls`` — the first layer that
+    consumes this input — disambiguates."""
     dims = [d for d in shape[1:]]
+    if len(dims) == 4 and consumer_cls == "ConvLSTM2D":
+        t, h, w, c = dims
+        return InputType.convolutionalSequence(h, w, c, t or -1)
     if len(dims) == 4:  # NDHWC -> 3D conv, channels-first internally
         d, h, w, c = dims
         return InputType.convolutional3D(d, h, w, c)
@@ -156,6 +163,20 @@ def _map_layer(cls: str, c: dict) -> Tuple[Optional[L.Layer], bool]:
         return _map_layer(inner_cls, inner["config"])
     if cls == "RepeatVector":
         return L.RepeatVector(repetitionFactor=c["n"]), False
+    if cls == "ConvLSTM2D":
+        if c.get("data_format", "channels_last") != "channels_last":
+            raise ValueError("ConvLSTM2D: only channels_last exports supported")
+        if c.get("padding", "valid") != "same":
+            raise ValueError("ConvLSTM2D: only padding='same' supported "
+                             "(the layer keeps H, W)")
+        if c.get("activation", "tanh") != "tanh":
+            raise ValueError("ConvLSTM2D: only activation='tanh' supported")
+        if c.get("recurrent_activation", "sigmoid") != "sigmoid":
+            raise ValueError("ConvLSTM2D: only recurrent_activation='sigmoid' supported")
+        if _pair(c.get("strides", 1)) != (1, 1) or _pair(c.get("dilation_rate", 1)) != (1, 1):
+            raise ValueError("ConvLSTM2D: strides/dilation_rate must be 1")
+        return L.ConvLSTM2D(nOut=c["filters"], kernelSize=_pair(c["kernel_size"]),
+                            returnSequences=c.get("return_sequences", False)), True
     if cls == "Dense":
         return L.DenseLayer(nOut=c["units"], activation=act,
                             hasBias=c.get("use_bias", True)), True
@@ -380,6 +401,12 @@ def _convert_weights(layer: L.Layer, kw: Dict[str, np.ndarray],
         return {"fwd": _convert_weights(layer.fwd, fwd, None),
                 "bwd": _convert_weights(layer.fwd, bwd, None)}
 
+    if isinstance(layer, L.ConvLSTM2D):
+        p = {"W": np.transpose(kw["kernel"], (3, 2, 0, 1)),
+             "RW": np.transpose(kw["recurrent_kernel"], (3, 2, 0, 1))}
+        if "bias" in kw:
+            p["b"] = kw["bias"]
+        return p
     if isinstance(layer, L.SeparableConvolution2D):
         p = {"dW": np.transpose(kw["depthwise_kernel"], (2, 3, 0, 1)),
              "pW": np.transpose(kw["pointwise_kernel"], (3, 2, 0, 1))}
@@ -498,10 +525,16 @@ def _import_sequential(cfg: dict, store: _WeightStore) -> MultiLayerNetwork:
     flatten_pending: Optional[InputType] = None
 
     b = NeuralNetConfiguration.Builder().updater(Adam(1e-3)).list()
+    # a 4-post-batch-dim input is NDHWC (3D conv) UNLESS the first real layer
+    # is ConvLSTM2D, where it is a (T, H, W, C) image sequence
+    first_real = next((lc["class_name"] for lc in layers_cfg
+                       if lc["class_name"] != "InputLayer"), None)
     for lc in layers_cfg:
         cls, c = lc["class_name"], lc["config"]
         if cls == "InputLayer":
-            input_type = _input_type_from_shape(c.get("batch_shape") or c["batch_input_shape"])
+            input_type = _input_type_from_shape(
+                c.get("batch_shape") or c["batch_input_shape"],
+                consumer_cls=first_real)
             cur_type = input_type
             continue
         layer, has_w = _map_layer(cls, c)
@@ -509,9 +542,9 @@ def _import_sequential(cfg: dict, store: _WeightStore) -> MultiLayerNetwork:
             if cur_type is not None and cur_type.kind in ("cnn", "cnn3d"):
                 flatten_pending = cur_type
                 cur_type = InputType.feedForward(cur_type.flat_size())
-            elif cur_type is not None and cur_type.kind == "rnn":
+            elif cur_type is not None and cur_type.kind in ("rnn", "cnnseq"):
                 raise ValueError(
-                    "Flatten over a sequence (T, C) feature map is not "
+                    "Flatten over a sequence feature map is not "
                     "supported by the importer — use GlobalAveragePooling1D/"
                     "GlobalMaxPooling1D (imported as GlobalPoolingLayer) or "
                     "an RNN with return_sequences=False instead")
@@ -580,7 +613,13 @@ def _import_functional(cfg: dict, store: _WeightStore) -> ComputationGraph:
         ins = inbound(lc)
         if cls == "InputLayer":
             g.addInputs(name)
-            t = _input_type_from_shape(c.get("batch_shape") or c["batch_input_shape"])
+            consumer = next(
+                (lc2["class_name"] for lc2 in layers_cfg
+                 if lc2["class_name"] != "InputLayer"
+                 and name in inbound(lc2)), None)
+            t = _input_type_from_shape(
+                c.get("batch_shape") or c["batch_input_shape"],
+                consumer_cls=consumer)
             input_types.append(t)
             type_at[name] = t
             continue
@@ -607,12 +646,12 @@ def _import_functional(cfg: dict, store: _WeightStore) -> ComputationGraph:
             if t is not None and t.kind in ("cnn", "cnn3d"):
                 flatten_src[src] = t
                 type_at[src] = t  # unchanged; Dense consumer handles perm
-            elif t is not None and t.kind == "rnn":
+            elif t is not None and t.kind in ("rnn", "cnnseq"):
                 raise ValueError(
-                    "Flatten over a sequence (T, C) feature map is not "
+                    "Flatten over a sequence feature map is not "
                     "supported by the importer — use GlobalAveragePooling1D/"
                     "GlobalMaxPooling1D (imported as GlobalPoolingLayer) or "
-                    "an RNN with return_sequences=False instead")
+                    "a recurrent layer with return_sequences=False instead")
             continue
         layer.name = name
         src = ins[0] if ins else None

@@ -49,10 +49,19 @@ class InputType:
     def recurrent(size: int, timeSeriesLength: int = -1) -> "InputType":
         return InputType("rnn", size=size, timeSeriesLength=timeSeriesLength)
 
+    @staticmethod
+    def convolutionalSequence(height: int, width: int, channels: int,
+                              timeSeriesLength: int = -1) -> "InputType":
+        """Sequence of images (B, T, C, H, W) — the ConvLSTM2D input
+        (ref: KerasConvLSTM2D's 5D input; upstream InputType has no distinct
+        kind, the importer there juggles preprocessors instead)."""
+        return InputType("cnnseq", channels=channels, height=height,
+                         width=width, timeSeriesLength=timeSeriesLength)
+
     def flat_size(self) -> int:
         if self.kind == "ff":
             return self.size
-        if self.kind == "cnn":
+        if self.kind in ("cnn", "cnnseq"):  # cnnseq: per-frame feature count
             return self.channels * self.height * self.width
         if self.kind == "cnn3d":
             return self.channels * self.depth * self.height * self.width
@@ -65,6 +74,9 @@ class InputType:
             return (batch, self.channels, self.height, self.width)
         if self.kind == "cnn3d":
             return (batch, self.channels, self.depth, self.height, self.width)
+        if self.kind == "cnnseq":
+            t = self.timeSeriesLength if self.timeSeriesLength > 0 else 1
+            return (batch, t, self.channels, self.height, self.width)
         t = self.timeSeriesLength if self.timeSeriesLength > 0 else 1
         return (batch, t, self.size)
 

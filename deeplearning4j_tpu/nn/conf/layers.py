@@ -855,6 +855,83 @@ class Bidirectional(Layer):
 
 
 @dataclass
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM over image sequences (ref: the reference ships
+    this via its Keras importer, KerasConvLSTM2D; Shi et al. 2015). Input
+    (B, T, C, H, W); gates are SAME-padded convolutions instead of matmuls,
+    the time recurrence is one lax.scan. ``returnSequences=False`` emits the
+    final hidden map (B, nOut, H, W) — a drop-in head for the CNN stack;
+    True emits (B, T, nOut, H, W) for stacked ConvLSTMs. Gate order
+    [i, f, g(c), o], matching LSTM/Keras."""
+    nIn: int = 0
+    nOut: int = 0
+    kernelSize: Tuple[int, int] = (3, 3)
+    returnSequences: bool = False
+    forgetGateBiasInit: float = 1.0
+
+    def set_n_in(self, input_type: InputType):
+        if not self.nIn and input_type.kind == "cnnseq":
+            self.nIn = input_type.channels
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if self.returnSequences:
+            return InputType.convolutionalSequence(
+                input_type.height, input_type.width, self.nOut,
+                input_type.timeSeriesLength)
+        return InputType.convolutional(input_type.height, input_type.width,
+                                       self.nOut)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        kh, kw = self.kernelSize
+        C, H4 = self.nIn, 4 * self.nOut
+        fan_in = C * kh * kw
+        p = {
+            "W": _winit.init(self.weightInit or "XAVIER", k1, (H4, C, kh, kw),
+                             fan_in, H4, dtype),
+            "RW": _winit.init(self.weightInit or "XAVIER", k2,
+                              (H4, self.nOut, kh, kw),
+                              self.nOut * kh * kw, H4, dtype),
+        }
+        b = jnp.zeros((H4,), dtype)
+        b = b.at[self.nOut:2 * self.nOut].set(self.forgetGateBiasInit)
+        p["b"] = b
+        return p
+
+    def regularizable(self):
+        return ("W", "RW")
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        if x.ndim != 5:
+            raise ValueError(
+                f"ConvLSTM2D expects (B, T, C, H, W), got rank {x.ndim}")
+        B, T, C, H, W = x.shape
+        nOut = self.nOut
+        dn = lax.conv_dimension_numbers((B, C, H, W), params["W"].shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+
+        def conv(inp, w):
+            return lax.conv_general_dilated(inp, w, (1, 1), "SAME",
+                                            dimension_numbers=dn)
+
+        def step(carry, xt):
+            h, c = carry
+            z = conv(xt, params["W"]) + conv(h, params["RW"]) \
+                + params["b"][None, :, None, None]
+            i, f, g, o = jnp.split(z, 4, axis=1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        h0 = jnp.zeros((B, nOut, H, W), x.dtype)
+        (hT, _), ys = lax.scan(step, (h0, h0),
+                               jnp.swapaxes(x, 0, 1))  # scan over T
+        if self.returnSequences:
+            return jnp.swapaxes(ys, 0, 1), state
+        return hT, state
+
+
+@dataclass
 class RepeatVector(Layer):
     """Repeats a (B, F) feature vector n times into a (B, n, F) sequence
     (ref: conf.layers.misc.RepeatVector — the reference stores NCW [B, F, n];
@@ -1916,4 +1993,5 @@ LAYER_TYPES = {c.__name__: c for c in [
     OCNNOutputLayer, Yolo2OutputLayer, GravesBidirectionalLSTM,
     LearnedSelfAttentionLayer, RecurrentAttentionLayer,
     PrimaryCapsules, CapsuleLayer, CapsuleStrengthLayer, RepeatVector,
+    ConvLSTM2D,
 ]}

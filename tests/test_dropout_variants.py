@@ -178,3 +178,82 @@ class TestKerasSeq2SeqMappers:
         theirs = model.predict(x, verbose=0)
         assert ours.shape == theirs.shape == (4, 5, 3)
         np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+class TestConvLSTM2D:
+    def test_layer_shapes_and_gradcheck_smoke(self):
+        from deeplearning4j_tpu.nn.conf.layers import ConvLSTM2D
+        l = ConvLSTM2D(nIn=2, nOut=3, kernelSize=(3, 3))
+        p = l.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 2, 6, 6),
+                        jnp.float32)
+        out, _ = l.apply(p, x)
+        assert out.shape == (2, 3, 6, 6)
+        # differentiable end to end
+        g = jax.grad(lambda pp: jnp.sum(l.apply(pp, x)[0] ** 2))(p)
+        assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+
+    def test_keras_convlstm_import_parity(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        model = keras.Sequential([
+            keras.layers.Input((5, 6, 6, 2)),          # (T, H, W, C)
+            keras.layers.ConvLSTM2D(4, (3, 3), padding="same",
+                                    return_sequences=False),
+            keras.layers.Flatten(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        p = str(tmp_path / "convlstm.h5")
+        model.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x_keras = np.random.RandomState(1).randn(2, 5, 6, 6, 2).astype(np.float32)
+        x_ours = np.transpose(x_keras, (0, 1, 4, 2, 3))  # (B,T,C,H,W)
+        ours = np.asarray(net.output(x_ours))
+        theirs = model.predict(x_keras, verbose=0)
+        np.testing.assert_allclose(ours, theirs, atol=2e-5)
+
+    def test_unsupported_convlstm_configs_raise(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+        def save(model, name):
+            p = str(tmp_path / name)
+            model.save(p)
+            return p
+
+        # default padding='valid' changes H,W -> must refuse, not silently SAME
+        m1 = keras.Sequential([keras.layers.Input((5, 6, 6, 2)),
+                               keras.layers.ConvLSTM2D(4, (3, 3))])
+        with pytest.raises(ValueError, match="padding"):
+            KerasModelImport.importKerasSequentialModelAndWeights(save(m1, "v.h5"))
+        # non-tanh activation
+        m2 = keras.Sequential([keras.layers.Input((5, 6, 6, 2)),
+                               keras.layers.ConvLSTM2D(4, (3, 3), padding="same",
+                                                       activation="relu")])
+        with pytest.raises(ValueError, match="tanh"):
+            KerasModelImport.importKerasSequentialModelAndWeights(save(m2, "a.h5"))
+        # Flatten over return_sequences=True output
+        m3 = keras.Sequential([keras.layers.Input((5, 6, 6, 2)),
+                               keras.layers.ConvLSTM2D(4, (3, 3), padding="same",
+                                                       return_sequences=True),
+                               keras.layers.Flatten(),
+                               keras.layers.Dense(3)])
+        with pytest.raises(ValueError, match="sequence feature map"):
+            KerasModelImport.importKerasSequentialModelAndWeights(save(m3, "f.h5"))
+
+    def test_functional_convlstm_import_parity(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        inp = keras.layers.Input((5, 6, 6, 2))
+        h = keras.layers.ConvLSTM2D(4, (3, 3), padding="same")(inp)
+        h = keras.layers.GlobalAveragePooling2D(data_format="channels_last")(h)
+        out = keras.layers.Dense(3, activation="softmax")(h)
+        model = keras.Model(inp, out)
+        p = str(tmp_path / "func.h5")
+        model.save(p)
+        net = KerasModelImport.importKerasModelAndWeights(p)
+        x_keras = np.random.RandomState(2).randn(2, 5, 6, 6, 2).astype(np.float32)
+        x_ours = np.transpose(x_keras, (0, 1, 4, 2, 3))
+        ours = np.asarray(net.outputSingle(x_ours))
+        theirs = model.predict(x_keras, verbose=0)
+        np.testing.assert_allclose(ours, theirs, atol=2e-5)
