@@ -404,8 +404,10 @@ def _convert_weights(layer: L.Layer, kw: Dict[str, np.ndarray],
     if isinstance(layer, L.ConvLSTM2D):
         p = {"W": np.transpose(kw["kernel"], (3, 2, 0, 1)),
              "RW": np.transpose(kw["recurrent_kernel"], (3, 2, 0, 1))}
-        if "bias" in kw:
-            p["b"] = kw["bias"]
+        # apply() reads params['b'] unconditionally, so use_bias=False h5
+        # files get an explicit zero bias (gate order i,f,c,o; 4*filters).
+        p["b"] = kw.get("bias", np.zeros(kw["kernel"].shape[-1],
+                                         dtype=kw["kernel"].dtype))
         return p
     if isinstance(layer, L.SeparableConvolution2D):
         p = {"dW": np.transpose(kw["depthwise_kernel"], (2, 3, 0, 1)),

@@ -100,16 +100,29 @@ class AlphaDropout(IDropout):
 
 class SpatialDropout(IDropout):
     """Channel-wise dropout (ref: SpatialDropout; Tompson et al.): drops whole
-    feature maps. Channel axis 1 for conv inputs (NCHW/NCW/NCDHW rank>=3);
-    the last axis for 2D (B, F). ``p`` is the RETAIN probability."""
+    feature maps. Conv inputs here are NCHW/NCDHW so rank-4/5 masks axis 1.
+    For rank-3 sequences the channel axis depends on ``rnnDataFormat``: the
+    framework default is NWC (B, T, F) → mask the LAST axis (dropping feature
+    channels, matching dl4j-on-NCW and Keras SpatialDropout1D behavior, not
+    whole timesteps); set ``rnnDataFormat="NCW"`` for (B, F, T) layouts →
+    mask axis 1. Last axis for 2D (B, F). ``p`` is the RETAIN probability."""
 
-    def __init__(self, p: float = 0.5):
+    def __init__(self, p: float = 0.5, rnnDataFormat: str = "NWC"):
         self.p = float(p)
+        self.rnnDataFormat = str(rnnDataFormat).upper()
+        if self.rnnDataFormat not in ("NWC", "NCW"):
+            raise ValueError(f"rnnDataFormat must be NWC or NCW, "
+                             f"got {rnnDataFormat}")
 
     def apply(self, rng, x):
         if self.p >= 1.0:
             return x
-        if x.ndim >= 3:
+        if x.ndim == 3:
+            if self.rnnDataFormat == "NWC":
+                shape = (x.shape[0], 1, x.shape[2])
+            else:
+                shape = (x.shape[0], x.shape[1], 1)
+        elif x.ndim >= 4:
             shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
         else:
             shape = x.shape

@@ -405,6 +405,10 @@ def dynamic_rnn(x, h0, w_ih, w_hh, b, seq_lengths=None, time_major=False):
         if seq_lengths is not None:
             mask = (t < jnp.asarray(seq_lengths))[:, None]
             h_new = jnp.where(mask, h_new, h)
+            # TF dynamic_rnn semantics: carry holds the last valid state,
+            # but OUTPUTS past each example's length are zero, so time
+            # reductions and the bidirectional concat never see stale values.
+            return h_new, jnp.where(mask, h_new, jnp.zeros_like(h_new))
         return h_new, h_new
 
     h_fin, hs = lax.scan(step, h0, (jnp.arange(T), x))

@@ -49,6 +49,33 @@ class TestVariants:
         kept = (per_channel.sum(-1) != 0).mean()
         assert kept == pytest.approx(0.5, abs=0.2)
 
+    def test_spatial_dropout_rank3_drops_feature_columns(self):
+        # sequences are NWC (B, T, F): whole FEATURE columns must zero, with
+        # the mask shared across time — not whole timesteps (ADVICE r2)
+        x = jnp.ones((4, 12, 16), jnp.float32)
+        y = np.asarray(SpatialDropout(p=0.5).apply(KEY, x))
+        for b in range(4):
+            for f in range(16):
+                vals = np.unique(y[b, :, f])
+                assert len(vals) == 1  # constant over time: kept or zeroed
+        # and the mask varies ACROSS features within a sample — whole-timestep
+        # dropping would zero every feature at once
+        first_t = y[:, 0, :]
+        assert ((first_t != 0).any(axis=1) & (first_t == 0).any(axis=1)).any()
+        kept = (first_t != 0).mean()
+        assert kept == pytest.approx(0.5, abs=0.2)
+
+    def test_spatial_dropout_rank3_ncw_layout(self):
+        # NCW-configured nets carry (B, F, T): channel axis is 1
+        x = jnp.ones((4, 16, 12), jnp.float32)
+        d = SpatialDropout(p=0.5, rnnDataFormat="NCW")
+        y = np.asarray(d.apply(KEY, x))
+        for b in range(4):
+            for f in range(16):
+                assert len(np.unique(y[b, f, :])) == 1
+        # serde keeps the layout field
+        assert IDropout.from_dict(d.to_dict()) == d
+
     def test_float_legacy_path(self):
         y = apply_dropout(0.5, KEY, X)
         assert float((np.asarray(y) == 0).mean()) == pytest.approx(0.5, abs=0.08)
@@ -209,6 +236,25 @@ class TestConvLSTM2D:
         x_keras = np.random.RandomState(1).randn(2, 5, 6, 6, 2).astype(np.float32)
         x_ours = np.transpose(x_keras, (0, 1, 4, 2, 3))  # (B,T,C,H,W)
         ours = np.asarray(net.output(x_ours))
+        theirs = model.predict(x_keras, verbose=0)
+        np.testing.assert_allclose(ours, theirs, atol=2e-5)
+
+    def test_keras_convlstm_no_bias_import(self, tmp_path):
+        # use_bias=False h5 must import with an explicit zero bias (ADVICE r2)
+        keras = pytest.importorskip("keras")
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        model = keras.Sequential([
+            keras.layers.Input((5, 6, 6, 2)),
+            keras.layers.ConvLSTM2D(4, (3, 3), padding="same", use_bias=False),
+            keras.layers.Flatten(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        p = str(tmp_path / "convlstm_nb.h5")
+        model.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        x_keras = np.random.RandomState(3).randn(2, 5, 6, 6, 2).astype(np.float32)
+        x_ours = np.transpose(x_keras, (0, 1, 4, 2, 3))
+        ours = np.asarray(net.output(x_ours))   # would KeyError pre-fix
         theirs = model.predict(x_keras, verbose=0)
         np.testing.assert_allclose(ours, theirs, atol=2e-5)
 

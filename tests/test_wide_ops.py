@@ -238,9 +238,11 @@ class TestRecurrentVariants:
         hs, h_fin = ops.rnn.dynamicRnn(x, jnp.zeros((B, H)), w_ih, w_hh, b,
                                        seq_lengths=np.array([3, 6]))
         hs = _np(hs)
-        # after t >= len, state freezes
-        np.testing.assert_allclose(hs[0, 3], hs[0, 2], rtol=1e-6)
-        np.testing.assert_allclose(hs[0, 5], hs[0, 2], rtol=1e-6)
+        # TF dynamic_rnn semantics: outputs past each length are ZERO, while
+        # the carried final state holds the last valid hidden state
+        np.testing.assert_allclose(hs[0, 3], np.zeros_like(hs[0, 3]))
+        np.testing.assert_allclose(hs[0, 5], np.zeros_like(hs[0, 5]))
+        assert not np.allclose(hs[0, 2], 0.0)
         assert not np.allclose(hs[1, 5], hs[1, 2])
         np.testing.assert_allclose(_np(h_fin)[0], hs[0, 2], rtol=1e-6)
         mark_validated("dynamicRnn", "rnn"); mark_validated("staticRnn", "rnn")
