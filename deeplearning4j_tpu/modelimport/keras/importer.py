@@ -378,6 +378,10 @@ def _map_layer(cls: str, c: dict) -> Tuple[Optional[L.Layer], bool]:
             raise ValueError("Bidirectional(return_sequences=False) import is "
                              "not supported; re-export with return_sequences=True")
         return L.Bidirectional(fwd=inner, mode=c.get("merge_mode", "concat").upper()), True
+    if cls == "Reshape":
+        return L.ReshapeLayer(targetShape=tuple(c["target_shape"])), False
+    if cls == "Permute":
+        return L.PermuteLayer(permuteDims=tuple(c["dims"])), False
     if cls in ("Flatten", "InputLayer"):
         return None, False
     raise ValueError(f"Keras layer type {cls} is not supported by the importer "

@@ -962,20 +962,38 @@ def _resize_onnx(g, n):
         a = dict(a, nearest_mode="floor")
     x = g._in(n, 0)
     N, C, H, W = x.shape
+    align = coord == "align_corners"
+    half_pixel = coord in ("half_pixel", "pytorch_half_pixel")
+    tf_car = coord == "tf_crop_and_resize"
+    if not (align or half_pixel or coord == "asymmetric" or tf_car):
+        raise ValueError(f"Resize: coordinate mode '{coord}' unsupported")
+    extra = {}
+    roi_hw = ((0.0, 1.0), (0.0, 1.0))
+    if tf_car:
+        # roi (input 1): 2*rank normalized starts then ends; only the
+        # spatial axes may crop — N/C roi must be the identity [0, 1]
+        roi_vals = [float(v) for v in g._const(n, 1)]
+        starts, ends = roi_vals[:4], roi_vals[4:]
+        if (starts[0], starts[1], ends[0], ends[1]) != (0.0, 0.0, 1.0, 1.0):
+            raise ValueError("Resize(tf_crop_and_resize): N/C roi must be "
+                             "[0, 1] — only spatial cropping is supported")
+        roi_hw = ((starts[2], ends[2]), (starts[3], ends[3]))
+        extra["roi"] = roi_hw
+        extra["extrapolation_value"] = float(a.get("extrapolation_value", 0.0))
+    if coord == "pytorch_half_pixel":
+        extra["pytorch_half_pixel"] = True
     # sizes (input 3) take precedence over scales (input 2; Upsample: input 1)
-    sizes = None
     if len(n.input) > 3 and n.input[3]:
         sizes = [int(s) for s in g._const(n, 3)]
         out_hw = (sizes[2], sizes[3])
     else:
         scale_idx = 1 if n.op_type == "Upsample" else 2
         scales = [float(s) for s in g._const(n, scale_idx)]
-        out_hw = (int(H * scales[2]), int(W * scales[3]))
-    align = coord == "align_corners"
-    half_pixel = coord in ("half_pixel", "pytorch_half_pixel")
-    if not (align or half_pixel or coord == "asymmetric"):
-        raise ValueError(f"Resize: coordinate mode '{coord}' unsupported")
-    extra = {}
+        # tf_crop_and_resize scales apply to the ROI extent, not the full
+        # image: output_dim = floor(input_dim * (roi_end - roi_start) * scale)
+        eh = roi_hw[0][1] - roi_hw[0][0]
+        ew = roi_hw[1][1] - roi_hw[1][0]
+        out_hw = (int(H * eh * scales[2]), int(W * ew * scales[3]))
     if mode == "nearest":
         nearest_mode = a.get("nearest_mode", "round_prefer_floor")
         if isinstance(nearest_mode, bytes):
@@ -986,6 +1004,10 @@ def _resize_onnx(g, n):
         extra["nearest_mode"] = nearest_mode
     elif mode in ("linear", "bilinear"):
         opname = "resizeBilinear"
+    elif mode == "cubic":
+        opname = "resizeBicubic"
+        extra["cubic_coeff_a"] = float(a.get("cubic_coeff_a", -0.75))
+        extra["exclude_outside"] = bool(a.get("exclude_outside", 0))
     else:
         raise ValueError(f"Resize: mode '{mode}' unsupported")
     return g._emit("image", opname, [x], n.output[0], size=out_hw,

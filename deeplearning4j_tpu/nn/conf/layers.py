@@ -123,7 +123,8 @@ class Layer:
             elif isinstance(v, dict) and "@type" in v:
                 d[k] = Layer.from_dict(v)
             elif isinstance(v, list) and k in ("kernelSize", "stride", "padding", "dilation",
-                                               "size", "cropping", "blocks", "poolingDimensions"):
+                                               "size", "cropping", "blocks", "poolingDimensions",
+                                               "targetShape", "permuteDims"):
                 d[k] = tuple(v)
         obj = cls(**d)
         if frozen:
@@ -1185,6 +1186,119 @@ class MaskZeroLayer(Layer):
         return x, state
 
 
+def _keras_space_shape(t: InputType):
+    """Post-batch dims of ``t`` in Keras channels-LAST coordinates."""
+    k = t.kind
+    if k == "ff":
+        return (t.size,)
+    if k == "rnn":
+        return (t.timeSeriesLength, t.size)
+    if k in ("cnn", "cnnflat"):
+        return (t.height, t.width, t.channels)
+    if k == "cnn3d":
+        return (t.depth, t.height, t.width, t.channels)
+    raise ValueError(f"Reshape/Permute do not support input kind {k!r}")
+
+
+def _type_from_keras_shape(s) -> InputType:
+    if len(s) == 1:
+        return InputType.feedForward(s[0])
+    if len(s) == 2:
+        return InputType.recurrent(s[1], s[0])
+    if len(s) == 3:
+        return InputType.convolutional(s[0], s[1], s[2])
+    if len(s) == 4:
+        return InputType.convolutional3D(s[0], s[1], s[2], s[3])
+    raise ValueError(f"Reshape/Permute target rank {len(s)} not supported")
+
+
+def _to_keras_layout(x):
+    if x.ndim == 4:    # NCHW -> NHWC
+        return jnp.transpose(x, (0, 2, 3, 1))
+    if x.ndim == 5:    # NCDHW -> NDHWC
+        return jnp.transpose(x, (0, 2, 3, 4, 1))
+    return x
+
+def _from_keras_layout(y):
+    if y.ndim == 4:
+        return jnp.transpose(y, (0, 3, 1, 2))
+    if y.ndim == 5:
+        return jnp.transpose(y, (0, 4, 1, 2, 3))
+    return y
+
+
+@dataclass
+class ReshapeLayer(Layer):
+    """Keras-semantics reshape (ref: modelimport.keras.layers.core.KerasReshape
+    -> ReshapePreprocessor). ``targetShape`` is the post-batch shape in Keras'
+    channels-LAST coordinates (one -1 allowed); data is converted from/to this
+    framework's channels-first layouts at the boundary, so a following conv
+    layer sees NCHW and a following Dense sees Keras' flatten order."""
+    targetShape: Tuple[int, ...] = ()
+
+    def _resolve(self, src):
+        tgt = tuple(int(v) for v in self.targetShape)
+        if any(d <= 0 for d in src):
+            raise ValueError(
+                "ReshapeLayer needs fully-known input dims (variable-length "
+                "sequence inputs are not reshapeable)")
+        total = 1
+        for d in src:
+            total *= d
+        if tgt.count(-1) > 1:
+            raise ValueError(f"ReshapeLayer: at most one -1 in {tgt}")
+        if -1 in tgt:
+            known = 1
+            for d in tgt:
+                if d != -1:
+                    known *= d
+            if known == 0 or total % known:
+                raise ValueError(f"ReshapeLayer: cannot infer -1 in {tgt} "
+                                 f"from input of {total} elements")
+            tgt = tuple(total // known if d == -1 else d for d in tgt)
+        out = 1
+        for d in tgt:
+            out *= d
+        if out != total:
+            raise ValueError(f"ReshapeLayer: target {tgt} has {out} elements, "
+                             f"input has {total}")
+        return tgt
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return _type_from_keras_shape(
+            self._resolve(_keras_space_shape(input_type)))
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        x = _to_keras_layout(x)
+        tgt = self._resolve(x.shape[1:])
+        return _from_keras_layout(jnp.reshape(x, (x.shape[0],) + tgt)), state
+
+
+@dataclass
+class PermuteLayer(Layer):
+    """Keras-semantics axis permutation (ref: KerasPermute ->
+    PermutePreprocessor). ``permuteDims`` are 1-based post-batch axis indices
+    in Keras channels-last coordinates, exactly as Keras ``Permute(dims)``."""
+    permuteDims: Tuple[int, ...] = ()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        src = _keras_space_shape(input_type)
+        if any(d <= 0 for d in src):
+            raise ValueError(
+                "PermuteLayer needs fully-known input dims (variable-length "
+                "sequence inputs are not permutable)")
+        if sorted(self.permuteDims) != list(range(1, len(src) + 1)):
+            raise ValueError(f"Permute dims {self.permuteDims} do not match "
+                             f"input rank {len(src)}")
+        return _type_from_keras_shape(
+            tuple(src[d - 1] for d in self.permuteDims))
+
+    def apply(self, params, x, *, training=False, rng=None, state=None):
+        x = _to_keras_layout(x)
+        y = jnp.transpose(x, (0,) + tuple(self.permuteDims))
+        return _from_keras_layout(y), state
+
+
 @dataclass
 class SpaceToDepthLayer(Layer):
     """(ref: conf.layers.SpaceToDepthLayer), NCHW."""
@@ -1993,5 +2107,5 @@ LAYER_TYPES = {c.__name__: c for c in [
     OCNNOutputLayer, Yolo2OutputLayer, GravesBidirectionalLSTM,
     LearnedSelfAttentionLayer, RecurrentAttentionLayer,
     PrimaryCapsules, CapsuleLayer, CapsuleStrengthLayer, RepeatVector,
-    ConvLSTM2D,
+    ConvLSTM2D, ReshapeLayer, PermuteLayer,
 ]}

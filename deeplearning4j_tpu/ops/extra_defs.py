@@ -374,12 +374,21 @@ op("matrixDiagPart", "linalg")(lambda x: jnp.diagonal(x, axis1=-2, axis2=-1))
 
 
 @op("resizeBicubic", "image")
-def resize_bicubic(x, size, data_format="NCHW"):
-    if data_format == "NCHW":
-        N, C, H, W = x.shape
-        return jax.image.resize(x, (N, C, size[0], size[1]), method="cubic")
-    N, H, W, C = x.shape
-    return jax.image.resize(x, (N, size[0], size[1], C), method="cubic")
+def resize_bicubic(x, size, data_format="NCHW", align_corners=False,
+                   half_pixel_centers=True, cubic_coeff_a=-0.5,
+                   exclude_outside=False, roi=None, extrapolation_value=0.0,
+                   pytorch_half_pixel=False):
+    """Cubic-convolution resize. Defaults (a=-0.5, half-pixel) are the
+    Keys/TF kernel = jax.image.resize's fused path; ONNX Resize uses
+    a=-0.75 (spec default) and may set exclude_outside / align_corners /
+    asymmetric / tf_crop_and_resize coordinates — all routed through the
+    separable-matrix path in nn_defs._tf_resize."""
+    from deeplearning4j_tpu.ops.nn_defs import _tf_resize
+    return _tf_resize(x, size, "cubic", data_format, align_corners,
+                      half_pixel_centers, cubic_a=cubic_coeff_a,
+                      exclude_outside=exclude_outside, roi=roi,
+                      extrapolation_value=extrapolation_value,
+                      pytorch_half_pixel=pytorch_half_pixel)
 
 
 @op("resizeArea", "image")

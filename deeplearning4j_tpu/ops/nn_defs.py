@@ -583,24 +583,47 @@ def loss_sparse_mcxent_masked(labels, logits, mask, average=True):
 
 
 def _tf_resize_matrix(n_in, n_out, method, align_corners, half_pixel,
-                      nearest_mode="floor"):
-    """1-D interpolation matrix (n_out, n_in) with TF's coordinate rules.
+                      nearest_mode="floor", cubic_a=-0.5,
+                      exclude_outside=False, roi=None,
+                      pytorch_half_pixel=False):
+    """1-D interpolation matrix (n_out, n_in) with TF/ONNX coordinate rules.
 
     half_pixel (TF2 default): src = (i+0.5)*in/out - 0.5 — what
     jax.image.resize implements. align_corners (TF1): src = i*(in-1)/(out-1).
     Neither (TF1 legacy default): src = i*in/out. ``nearest_mode``
     (non-align-corners nearest only): 'floor' (TF legacy) or
     'round_prefer_floor' (ONNX default — round, ties toward floor).
+    ``method='cubic'`` uses the ONNX/Keys convolution kernel with coefficient
+    ``cubic_a`` (-0.75 per ONNX spec, -0.5 = Keys/TF); ``exclude_outside``
+    zeroes taps outside the image and renormalizes (ONNX attribute).
+    ``roi=(start, end)`` (normalized) switches to ONNX tf_crop_and_resize
+    coordinates; returns (matrix, valid) then, where ~valid rows must take
+    the extrapolation value.
     """
     import numpy as _np
     i = _np.arange(n_out, dtype=_np.float64)
-    if align_corners:
+    if roi is not None:
+        start, end = roi
+        if n_out > 1:
+            src = start * (n_in - 1) + i * (end - start) * (n_in - 1) / (n_out - 1)
+        else:
+            src = _np.full(1, 0.5 * (start + end) * (n_in - 1))
+        valid = (src >= 0.0) & (src <= n_in - 1)
+    elif align_corners:
         scale = (n_in - 1) / (n_out - 1) if n_out > 1 else 0.0
         src = i * scale
+        valid = None
     elif half_pixel:
-        src = (i + 0.5) * (n_in / n_out) - 0.5
+        # ONNX pytorch_half_pixel: a length-1 output samples coordinate 0,
+        # not the center (the only place the two half-pixel variants differ)
+        if pytorch_half_pixel and n_out == 1:
+            src = _np.zeros(1)
+        else:
+            src = (i + 0.5) * (n_in / n_out) - 0.5
+        valid = None
     else:
         src = i * (n_in / n_out)
+        valid = None
     m = _np.zeros((n_out, n_in), _np.float32)
     if method == "nearest":
         if align_corners:
@@ -612,6 +635,29 @@ def _tf_resize_matrix(n_in, n_out, method, align_corners, half_pixel,
             idx = _np.floor(src).astype(int)
         idx = _np.clip(idx, 0, n_in - 1)
         m[_np.arange(n_out), idx] = 1.0
+    elif method == "cubic":
+        lo = _np.floor(src).astype(int)
+        a = float(cubic_a)
+
+        def kern(t):
+            at = _np.abs(t)
+            return _np.where(
+                at <= 1.0, (a + 2) * at ** 3 - (a + 3) * at ** 2 + 1.0,
+                _np.where(at < 2.0,
+                          a * at ** 3 - 5 * a * at ** 2 + 8 * a * at - 4 * a,
+                          0.0))
+
+        rows = _np.arange(n_out)
+        for k in (-1, 0, 1, 2):
+            j = lo + k
+            w = kern(src - j)
+            inside = (j >= 0) & (j < n_in)
+            if exclude_outside:
+                w = _np.where(inside, w, 0.0)
+            _np.add.at(m, (rows, _np.clip(j, 0, n_in - 1)), w)
+        if exclude_outside:
+            s = m.sum(axis=1, keepdims=True)
+            m = m / _np.where(s == 0.0, 1.0, s)
     else:  # bilinear
         src = _np.clip(src, 0.0, n_in - 1)
         lo = _np.floor(src).astype(int)
@@ -620,51 +666,89 @@ def _tf_resize_matrix(n_in, n_out, method, align_corners, half_pixel,
         m[_np.arange(n_out), lo] += 1.0 - frac
         # hi may equal lo at the border: += accumulates to exactly 1.0
         m[_np.arange(n_out), hi] += frac
-    return jnp.asarray(m)
+    m = jnp.asarray(m.astype(_np.float32))
+    if roi is not None:
+        return m, _np.asarray(valid)
+    return m
 
 
 def _tf_resize(x, size, method, data_format, align_corners, half_pixel,
-               nearest_mode="floor"):
+               nearest_mode="floor", cubic_a=-0.5, exclude_outside=False,
+               roi=None, extrapolation_value=0.0, pytorch_half_pixel=False):
     if data_format == "NCHW":
         H, W = x.shape[2], x.shape[3]
     else:
         H, W = x.shape[1], x.shape[2]
-    if half_pixel and not align_corners:
+    fast_ok = (roi is None and half_pixel and not align_corners
+               and not (method == "cubic"
+                        and (cubic_a != -0.5 or exclude_outside))
+               and not (pytorch_half_pixel and min(size) == 1))
+    if fast_ok:
         # identical to jax.image.resize's sampling — use the fused path
         if data_format == "NCHW":
             out_shape = (x.shape[0], x.shape[1], size[0], size[1])
         else:
             out_shape = (x.shape[0], size[0], size[1], x.shape[3])
         return jax.image.resize(x, out_shape, method=method)
+    roi_h = roi_w = None
+    if roi is not None:
+        (roi_h, roi_w) = roi
     wh = _tf_resize_matrix(H, size[0], method, align_corners, half_pixel,
-                           nearest_mode)
+                           nearest_mode, cubic_a, exclude_outside, roi_h,
+                           pytorch_half_pixel)
     ww = _tf_resize_matrix(W, size[1], method, align_corners, half_pixel,
-                           nearest_mode)
+                           nearest_mode, cubic_a, exclude_outside, roi_w,
+                           pytorch_half_pixel)
+    valid_h = valid_w = None
+    if roi is not None:
+        wh, valid_h = wh
+        ww, valid_w = ww
     # precision="highest": interpolation weights must not round through the
     # accelerator's fast-matmul dtype (bf16/TF32-analog) — parity vs the TF
     # kernels is the contract here and the matrices are tiny
     if data_format == "NCHW":
-        return jnp.einsum("oh,nchw,pw->ncop", wh.astype(x.dtype), x,
-                          ww.astype(x.dtype), precision="highest")
-    return jnp.einsum("oh,nhwc,pw->nopc", wh.astype(x.dtype), x,
-                      ww.astype(x.dtype), precision="highest")
+        out = jnp.einsum("oh,nchw,pw->ncop", wh.astype(x.dtype), x,
+                         ww.astype(x.dtype), precision="highest")
+    else:
+        out = jnp.einsum("oh,nhwc,pw->nopc", wh.astype(x.dtype), x,
+                         ww.astype(x.dtype), precision="highest")
+    if roi is not None:
+        # ONNX tf_crop_and_resize: coordinates outside the image take the
+        # extrapolation value
+        vh = jnp.asarray(valid_h)
+        vw = jnp.asarray(valid_w)
+        mask = vh[:, None] & vw[None, :]
+        if data_format == "NCHW":
+            mask = mask[None, None, :, :]
+        else:
+            mask = mask[None, :, :, None]
+        out = jnp.where(mask, out, jnp.asarray(extrapolation_value, x.dtype))
+    return out
 
 
 @op("resizeBilinear", "image")
 def resize_bilinear(x, size, data_format="NCHW", align_corners=False,
-                    half_pixel_centers=True):
+                    half_pixel_centers=True, roi=None,
+                    extrapolation_value=0.0, pytorch_half_pixel=False):
     """TF-semantics bilinear resize incl. the TF1 align_corners /
     legacy-coordinate modes (ref: helpers/image_resize computeInterpolation
-    weights; TF kernels are the behavioral oracle in tests)."""
+    weights; TF kernels are the behavioral oracle in tests). ``roi`` =
+    ((start_h, end_h), (start_w, end_w)) normalized switches to ONNX
+    tf_crop_and_resize coordinates with ``extrapolation_value`` outside."""
     return _tf_resize(x, size, "bilinear", data_format, align_corners,
-                      half_pixel_centers)
+                      half_pixel_centers, roi=roi,
+                      extrapolation_value=extrapolation_value,
+                      pytorch_half_pixel=pytorch_half_pixel)
 
 
 @op("resizeNearest", "image")
 def resize_nearest(x, size, data_format="NCHW", align_corners=False,
-                   half_pixel_centers=True, nearest_mode="floor"):
+                   half_pixel_centers=True, nearest_mode="floor", roi=None,
+                   extrapolation_value=0.0, pytorch_half_pixel=False):
     return _tf_resize(x, size, "nearest", data_format, align_corners,
-                      half_pixel_centers, nearest_mode)
+                      half_pixel_centers, nearest_mode, roi=roi,
+                      extrapolation_value=extrapolation_value,
+                      pytorch_half_pixel=pytorch_half_pixel)
 
 
 @op("cropAndResize", "image")

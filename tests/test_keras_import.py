@@ -350,3 +350,99 @@ def test_flatten_after_conv1d_rejected(tmp_path):
     with pytest.raises(ValueError, match="Flatten over a sequence"):
         KerasModelImport.importKerasSequentialModelAndWeights(
             _save(m, tmp_path, "flatseq.h5"))
+
+
+class TestReshapePermute:
+    """Keras Reshape/Permute mappers (ref: KerasReshape/KerasPermute ->
+    Reshape/PermutePreprocessor) — channels-last semantics preserved across
+    this framework's channels-first layouts."""
+
+    def test_reshape_flat_to_image_then_conv(self, tmp_path):
+        tf.keras.utils.set_random_seed(20)
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((32,)),
+            tf.keras.layers.Dense(32, activation="relu"),
+            tf.keras.layers.Reshape((4, 4, 2)),
+            tf.keras.layers.Conv2D(3, (3, 3), padding="same"),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(5, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            _save(m, tmp_path, "rs_img.h5"))
+        x = RNG.normal(size=(4, 32)).astype(np.float32)
+        _assert_parity(m, net, x)
+
+    def test_reshape_conv_to_sequence_then_lstm(self, tmp_path):
+        tf.keras.utils.set_random_seed(21)
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 4, 2)),
+            tf.keras.layers.Conv2D(3, (3, 3), padding="same"),
+            tf.keras.layers.Reshape((8, 6)),
+            tf.keras.layers.LSTM(5, return_sequences=True),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            _save(m, tmp_path, "rs_seq.h5"))
+        x = RNG.normal(size=(3, 4, 4, 2)).astype(np.float32)
+        _assert_parity(m, net, x, cnn=True)
+
+    def test_reshape_minus_one_flatten_equivalent(self, tmp_path):
+        tf.keras.utils.set_random_seed(22)
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((3, 3, 2)),
+            tf.keras.layers.Conv2D(4, (2, 2)),
+            tf.keras.layers.Reshape((-1,)),
+            tf.keras.layers.Dense(3, activation="softmax"),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            _save(m, tmp_path, "rs_flat.h5"))
+        x = RNG.normal(size=(4, 3, 3, 2)).astype(np.float32)
+        _assert_parity(m, net, x, cnn=True)
+
+    def test_permute_sequence_axes(self, tmp_path):
+        tf.keras.utils.set_random_seed(23)
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((6, 4)),
+            tf.keras.layers.Permute((2, 1)),
+            tf.keras.layers.LSTM(5, return_sequences=True),
+            tf.keras.layers.Dense(3),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            _save(m, tmp_path, "perm_seq.h5"))
+        x = RNG.normal(size=(3, 6, 4)).astype(np.float32)
+        _assert_parity(m, net, x)
+
+    def test_permute_image_axes_then_conv(self, tmp_path):
+        tf.keras.utils.set_random_seed(24)
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((4, 6, 2)),
+            tf.keras.layers.Permute((2, 1, 3)),   # (H,W,C) -> (W,H,C)
+            tf.keras.layers.Conv2D(3, (3, 3), padding="same"),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(2),
+        ])
+        net = KerasModelImport.importKerasSequentialModelAndWeights(
+            _save(m, tmp_path, "perm_img.h5"))
+        x = RNG.normal(size=(3, 4, 6, 2)).astype(np.float32)
+        _assert_parity(m, net, x, cnn=True)
+
+    def test_reshape_serde_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf.layers import (Layer, PermuteLayer,
+                                                       ReshapeLayer)
+        r = ReshapeLayer(targetShape=(4, 4, 2))
+        p = PermuteLayer(permuteDims=(2, 1))
+        assert Layer.from_dict(r.to_dict()) == r
+        assert Layer.from_dict(p.to_dict()) == p
+
+    def test_reshape_bad_target_raises_at_config_time(self):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (PermuteLayer,
+                                                       ReshapeLayer)
+        with pytest.raises(ValueError, match="cannot infer"):
+            ReshapeLayer(targetShape=(-1, 7)).output_type(
+                InputType.feedForward(32))
+        with pytest.raises(ValueError, match="elements"):
+            ReshapeLayer(targetShape=(5, 7)).output_type(
+                InputType.feedForward(32))
+        with pytest.raises(ValueError, match="variable-length"):
+            PermuteLayer(permuteDims=(2, 1)).output_type(
+                InputType.recurrent(4, -1))

@@ -332,3 +332,124 @@ class TestReviewRegressions:
                           "u": np.array([2.0], np.float32)})
         with pytest.raises(ValueError, match="reduction 'mul'"):
             run_import(m, {"x": x}, "y")
+
+
+class TestResizeCubicAndCrop:
+    """Round-3 widening: Resize mode=cubic (ONNX a=-0.75) and the
+    tf_crop_and_resize coordinate mode (ref: samediff-import-onnx Resize)."""
+
+    def test_resize_cubic_half_pixel_matches_torch(self):
+        x = _f32(1, 2, 4, 4)
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(7, 7), mode="bicubic",
+            align_corners=False).numpy()
+        m = make_model(
+            [node("Resize", ["x", "", "", "sizes"], ["y"], mode="cubic",
+                  coordinate_transformation_mode="half_pixel")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"sizes": np.array([1, 2, 7, 7], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"), want,
+                                   atol=1e-4)
+
+    def test_resize_cubic_align_corners_matches_torch(self):
+        x = _f32(1, 1, 5, 5)
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(8, 8), mode="bicubic",
+            align_corners=True).numpy()
+        m = make_model(
+            [node("Resize", ["x", "", "", "sizes"], ["y"], mode="cubic",
+                  coordinate_transformation_mode="align_corners")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"sizes": np.array([1, 1, 8, 8], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"), want,
+                                   atol=1e-4)
+
+    def test_resize_cubic_downscale(self):
+        # downscale exercises taps beyond the 4-neighborhood edge clamps
+        x = _f32(1, 2, 8, 8)
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(3, 3), mode="bicubic",
+            align_corners=False).numpy()
+        m = make_model(
+            [node("Resize", ["x", "", "", "sizes"], ["y"], mode="cubic",
+                  coordinate_transformation_mode="half_pixel")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"sizes": np.array([1, 2, 3, 3], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"), want,
+                                   atol=1e-4)
+
+    def test_resize_tf_crop_and_resize_matches_tf(self):
+        import tensorflow as tf
+        x = _f32(1, 2, 5, 5)
+        box = [0.2, 0.3, 0.9, 0.8]  # y1, x1, y2, x2
+        want_nhwc = tf.image.crop_and_resize(
+            np.transpose(x, (0, 2, 3, 1)), boxes=[box], box_indices=[0],
+            crop_size=(6, 6)).numpy()
+        want = np.transpose(want_nhwc, (0, 3, 1, 2))
+        roi = np.array([0, 0, box[0], box[1], 1, 1, box[2], box[3]],
+                       np.float32)
+        m = make_model(
+            [node("Resize", ["x", "roi", "", "sizes"], ["y"], mode="linear",
+                  coordinate_transformation_mode="tf_crop_and_resize")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"roi": roi,
+                          "sizes": np.array([1, 2, 6, 6], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"), want,
+                                   atol=1e-5)
+
+    def test_resize_tf_crop_and_resize_extrapolates(self):
+        import tensorflow as tf
+        x = _f32(1, 1, 4, 4)
+        box = [-0.2, 0.0, 1.3, 1.0]  # out-of-image rows -> extrapolation
+        want_nhwc = tf.image.crop_and_resize(
+            np.transpose(x, (0, 2, 3, 1)), boxes=[box], box_indices=[0],
+            crop_size=(5, 5), extrapolation_value=7.5).numpy()
+        want = np.transpose(want_nhwc, (0, 3, 1, 2))
+        roi = np.array([0, 0, box[0], box[1], 1, 1, box[2], box[3]],
+                       np.float32)
+        m = make_model(
+            [node("Resize", ["x", "roi", "", "sizes"], ["y"], mode="linear",
+                  coordinate_transformation_mode="tf_crop_and_resize",
+                  extrapolation_value=7.5)],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"roi": roi,
+                          "sizes": np.array([1, 1, 5, 5], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"), want,
+                                   atol=1e-5)
+
+    def test_resize_pytorch_half_pixel_outdim1(self):
+        # ONNX pytorch_half_pixel maps a length-1 OUTPUT dim to coordinate 0
+        # — i.e. exactly input row 0 (the only divergence from half_pixel;
+        # torch itself samples src=-0.5 there, so the oracle slices row 0
+        # first and resizes only the >1 axis)
+        x = _f32(1, 1, 4, 6)
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x[:, :, 0:1, :]), size=(1, 9), mode="bicubic",
+            align_corners=False).numpy()
+        m = make_model(
+            [node("Resize", ["x", "", "", "sizes"], ["y"], mode="cubic",
+                  coordinate_transformation_mode="pytorch_half_pixel")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"sizes": np.array([1, 1, 1, 9], np.int64)})
+        np.testing.assert_allclose(run_import(m, {"x": x}, "y"), want,
+                                   atol=1e-4)
+
+    def test_resize_tf_crop_scales_use_roi_extent(self):
+        # output_dim = floor(input_dim * (roi_end - roi_start) * scale)
+        import tensorflow as tf
+        x = _f32(1, 1, 10, 10)
+        box = [0.0, 0.0, 0.5, 0.5]
+        want_nhwc = tf.image.crop_and_resize(
+            np.transpose(x, (0, 2, 3, 1)), boxes=[box], box_indices=[0],
+            crop_size=(10, 10)).numpy()
+        want = np.transpose(want_nhwc, (0, 3, 1, 2))
+        roi = np.array([0, 0, 0.0, 0.0, 1, 1, 0.5, 0.5], np.float32)
+        m = make_model(
+            [node("Resize", ["x", "roi", "scales"], ["y"], mode="linear",
+                  coordinate_transformation_mode="tf_crop_and_resize")],
+            inputs=[("x", x.shape)], outputs=[("y", None)],
+            initializers={"roi": roi,
+                          "scales": np.array([1, 1, 2, 2], np.float32)})
+        got = run_import(m, {"x": x}, "y")
+        assert got.shape == (1, 1, 10, 10)
+        np.testing.assert_allclose(got, want, atol=1e-5)
