@@ -156,12 +156,21 @@ class SameDiffOp:
 
 @dataclass
 class TrainingConfig:
-    """(ref: org.nd4j.autodiff.samediff.TrainingConfig)."""
+    """(ref: org.nd4j.autodiff.samediff.TrainingConfig).
+
+    ``computeDtype``: mixed-precision training for imported graphs — float32
+    leaves (params, constants, float placeholders) are cast to this dtype at
+    the top of the traced step, the loss is reduced in float32, and gradients
+    land back on the float32 master params through the cast's VJP. "HALF" =
+    bfloat16, the TPU-native choice (BASELINE.md config #4: fp32-as-imported
+    leaves the MXU at half rate AND doubles the HBM traffic). None = run in
+    the imported dtype."""
     updater: _upd.Updater = field(default_factory=lambda: _upd.Adam(1e-3))
     dataSetFeatureMapping: List[str] = field(default_factory=list)
     dataSetLabelMapping: List[str] = field(default_factory=list)
     regularization: List[_rega.Regularization] = field(default_factory=list)
     minimize: bool = True
+    computeDtype: Optional[str] = None  # None | "HALF"/"BFLOAT16" | "FLOAT"
 
 
 class GraphNamespace:
@@ -642,10 +651,25 @@ class SameDiff:
 
             ops = self._needed_ops(loss_names)
 
+            cdt = {"HALF": jnp.bfloat16, "BFLOAT16": jnp.bfloat16,
+                   "FLOAT": None, None: None}[
+                       (cfg.computeDtype or "").upper() or None]
+
+            def cast_tree(tree):
+                if cdt is None:
+                    return tree
+                return {k: (v.astype(cdt)
+                            if hasattr(v, "dtype") and v.dtype == jnp.float32
+                            else v)
+                        for k, v in tree.items()}
+
             def loss_fn(trainables, frozen, placeholders):
-                env = {**frozen, **trainables, **placeholders}
+                env = {**cast_tree(frozen), **cast_tree(trainables),
+                       **cast_tree(placeholders)}
                 env = self._interpret(env, only_ops=ops)
-                loss = sum(jnp.sum(env[l]) for l in loss_names)
+                # loss reduced in fp32 regardless of the compute dtype
+                loss = sum(jnp.sum(env[l].astype(jnp.float32))
+                           for l in loss_names)
                 for reg in cfg.regularization:
                     for n in t_names:
                         loss = loss + reg.penalty(trainables[n])
@@ -776,6 +800,7 @@ class SameDiff:
                     "dataSetFeatureMapping": cfg.dataSetFeatureMapping,
                     "dataSetLabelMapping": cfg.dataSetLabelMapping,
                     "minimize": cfg.minimize,
+                    "computeDtype": cfg.computeDtype,
                     "hasOptState": self._opt_state is not None,
                 }))
                 if self._opt_state is not None:
@@ -831,7 +856,8 @@ class SameDiff:
                     updater=_updz.from_dict(tj["updater"]),
                     dataSetFeatureMapping=tj.get("dataSetFeatureMapping", []),
                     dataSetLabelMapping=tj.get("dataSetLabelMapping", []),
-                    minimize=tj.get("minimize", True)))
+                    minimize=tj.get("minimize", True),
+                    computeDtype=tj.get("computeDtype")))
                 if tj.get("hasOptState"):
                     trainables = {n: sd._values[n] for n in sd._trainable_names()}
                     skeleton = sd._tx.init(trainables)

@@ -43,6 +43,8 @@ class ComputationGraph:
         self._score = float("nan")
         self.listeners: List[Any] = []
         self._jit_cache: dict = {}
+        from deeplearning4j_tpu.nn.multilayer import _DeviceCache
+        self._dev_cache = _DeviceCache()
         self._rng_key = jax.random.key(conf.seed)
         self._dtype = jnp.float32 if conf.dataType == "FLOAT" else (
             jnp.float64 if conf.dataType == "DOUBLE" else jnp.bfloat16)
@@ -180,6 +182,44 @@ class ComputationGraph:
                    or getattr(l, "requiresUpdates", False)
                    for l in self.listeners)
 
+    # see MultiLayerNetwork.fuseSteps — same de-dispatch rationale
+    fuseSteps: int = 8
+
+    def _build_multi_step(self):
+        """``fuseSteps`` steps in one executable (lax.scan over stacked
+        minibatches) — see MultiLayerNetwork._build_multi_step."""
+        conf = self.conf
+        frozen = {n.name for n in self._order if getattr(n.op, "frozen", False)}
+
+        def zero_frozen(tree_dict):
+            if not frozen:
+                return tree_dict
+            return {k: (jax.tree_util.tree_map(jnp.zeros_like, g) if k in frozen else g)
+                    for k, g in tree_dict.items()}
+
+        def body(carry, inp):
+            params, state, opt_state = carry
+            inputs, labels, rng = inp
+            (loss, new_state), grads = jax.value_and_grad(
+                self._loss_for, has_aux=True)(params, state, inputs, labels,
+                                              rng, None, None)
+            grads = zero_frozen(grads)
+            grads = _clip_grads(grads, conf.gradientNormalization,
+                                conf.gradientNormalizationThreshold)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            updates = zero_frozen(updates)
+            params = optax.apply_updates(params, updates)
+            return (params, new_state, opt_state), loss
+
+        def multi(params, state, opt_state, inputs_stacked, labels_stacked,
+                  rngs):
+            (params, state, opt_state), losses = jax.lax.scan(
+                body, (params, state, opt_state),
+                (inputs_stacked, labels_stacked, rngs))
+            return params, state, opt_state, losses[-1]
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
     def _build_infer(self):
         def infer(params, state, inputs, fmasks):
             acts, _ = self._forward(params, state, inputs, training=False, rng=None,
@@ -191,7 +231,8 @@ class ComputationGraph:
     def _get_jitted(self, kind):
         if kind not in self._jit_cache:
             builders = {"step": self._build_step, "infer": self._build_infer,
-                        "step_stats": lambda: self._build_step(with_stats=True)}
+                        "step_stats": lambda: self._build_step(with_stats=True),
+                        "multi": self._build_multi_step}
             self._jit_cache[kind] = builders[kind]()
         return self._jit_cache[kind]
 
@@ -220,37 +261,96 @@ class ComputationGraph:
             data = [data]
         stats = self._stats_requested()
         step = self._get_jitted("step_stats" if stats else "step")
+        fuse_k = 0 if (stats or self.listeners) else self.fuseSteps
+        buf: list = []  # (features tuple, labels tuple) host batches
+
+        def run_single(mds):
+            raws = [_unwrap(f) for f in mds.features] + \
+                   [_unwrap(y) for y in mds.labels]
+            maskless = not any(m is not None
+                               for m in (mds.features_masks or [])) \
+                and not any(m is not None for m in (mds.labels_masks or []))
+            if maskless and all(isinstance(r, np.ndarray) for r in raws):
+                inputs, ys = self._dev_cache.get_or_put(
+                    raws, lambda: (self._input_dict(mds.features),
+                                   [_as_jnp(y) for y in mds.labels]))
+            else:
+                inputs = self._input_dict(mds.features)
+                ys = [_as_jnp(y) for y in mds.labels]
+            lmasks = [(_as_jnp(m) if m is not None else None)
+                      for m in (mds.labels_masks or [None] * len(ys))]
+            if all(m is None for m in lmasks):
+                lmasks = None
+            fmasks = {name: _as_jnp(m)
+                      for name, m in zip(self.conf.networkInputs,
+                                         mds.features_masks or [])
+                      if m is not None} or None
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            if stats:
+                (self._params, self._state, self._opt_state, loss,
+                 self._last_grads, self._last_updates) = step(
+                    self._params, self._state, self._opt_state, inputs, ys, sub,
+                    lmasks, fmasks)
+            else:
+                self._params, self._state, self._opt_state, loss = step(
+                    self._params, self._state, self._opt_state, inputs, ys, sub,
+                    lmasks, fmasks)
+            self._score = loss  # device scalar; score() syncs on demand
+            self._iteration += 1
+            for lst in self.listeners:
+                lst.iterationDone(self, self._iteration, self._epoch)
+
+        def flush(buf):
+            from deeplearning4j_tpu.nn.multilayer import _stack_batches
+            while len(buf) >= fuse_k > 1:
+                chunk, buf = buf[:fuse_k], buf[fuse_k:]
+
+                def build():
+                    return ({name: _stack_batches([c[0][i] for c in chunk])
+                             for i, name in enumerate(self.conf.networkInputs)},
+                            [_stack_batches([c[1][i] for c in chunk])
+                             for i in range(len(chunk[0][1]))])
+
+                raws = [_unwrap(f) for c in chunk for f in c[0]] + \
+                       [_unwrap(y) for c in chunk for y in c[1]]
+                if all(isinstance(r, np.ndarray) for r in raws):
+                    inputs, ys = self._dev_cache.get_or_put(raws, build)
+                else:
+                    inputs, ys = build()
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                rngs = jax.random.split(sub, fuse_k)
+                multi = self._get_jitted("multi")
+                (self._params, self._state, self._opt_state,
+                 self._score) = multi(self._params, self._state,
+                                      self._opt_state, inputs, ys, rngs)
+                self._iteration += fuse_k
+            return buf
+
+        def _sig(mds):
+            return ([np.shape(f) for f in mds.features],
+                    [np.shape(y) for y in mds.labels])
+
         for _ in range(epochs):
             for ds in data:
                 mds = ds.toMultiDataSet() if isinstance(ds, DataSet) else ds
-                inputs = self._input_dict(mds.features)
-                ys = [_as_jnp(y) for y in mds.labels]
-                lmasks = [(_as_jnp(m) if m is not None else None)
-                          for m in (mds.labels_masks or [None] * len(ys))]
-                if all(m is None for m in lmasks):
-                    lmasks = None
-                fmasks = {name: _as_jnp(m)
-                          for name, m in zip(self.conf.networkInputs,
-                                             mds.features_masks or [])
-                          if m is not None} or None
-                self._rng_key, sub = jax.random.split(self._rng_key)
-                if stats:
-                    (self._params, self._state, self._opt_state, loss,
-                     self._last_grads, self._last_updates) = step(
-                        self._params, self._state, self._opt_state, inputs, ys, sub,
-                        lmasks, fmasks)
+                maskfree = not any(m is not None
+                                   for m in (mds.features_masks or [])) \
+                    and not any(m is not None for m in (mds.labels_masks or []))
+                if fuse_k > 1 and maskfree:
+                    if buf and _sig(buf[0][2]) != _sig(mds):
+                        for item in buf:  # shape change: drain as singles
+                            run_single(item[2])
+                        buf = []
+                    buf.append((mds.features, mds.labels, mds))
+                    buf = flush(buf)
                 else:
-                    self._params, self._state, self._opt_state, loss = step(
-                        self._params, self._state, self._opt_state, inputs, ys, sub,
-                        lmasks, fmasks)
-                self._score = loss  # device scalar; score() syncs on demand
-                self._iteration += 1
-                for lst in self.listeners:
-                    lst.iterationDone(self, self._iteration, self._epoch)
+                    run_single(mds)
             self._epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "onEpochEnd"):
                     lst.onEpochEnd(self)
+        for item in buf:  # leftover (< fuseSteps) steps run individually
+            run_single(item[2])
         return self
 
     # ------------------------------------------------------------- inference

@@ -79,6 +79,46 @@ def _clip_l2(g, threshold):
     return g * jnp.where(norm > threshold, threshold / (norm + 1e-12), 1.0)
 
 
+def _stack_batches(items):
+    """Stack K minibatches into one (K, ...) array with a SINGLE host->device
+    transfer when the sources are host arrays (the common iterator case)."""
+    raw = [_unwrap(i) for i in items]
+    if all(isinstance(r, np.ndarray) for r in raw):
+        return jnp.asarray(np.stack(raw))
+    return jnp.stack([_as_jnp(i) for i in items])
+
+
+class _DeviceCache:
+    """Identity-keyed host->device transfer cache (bounded FIFO).
+
+    The axon tunnel's host->device bandwidth is orders of magnitude below
+    PCIe (measured ~6-60 MB/s, BASELINE.md round-3), so re-transferring the
+    same minibatch every epoch dominates small training steps. Training
+    loops that revisit the same host arrays (fit(ds, epochs=N), epoch
+    iterators over in-memory data) hit this cache and transfer once — the
+    TPU answer to the reference's workspace-pinned device buffers
+    (ref: MemoryWorkspace / AsyncDataSetIterator prefetch-to-GPU).
+    Keys hold strong references to the host arrays so ids cannot be
+    recycled; entries are dropped FIFO past ``cap``. In-place mutation of a
+    cached host array is NOT observed (same contract as dl4j's pinned
+    workspace buffers)."""
+
+    def __init__(self, cap: int = 64):
+        self.cap = cap
+        self._d: dict = {}
+
+    def get_or_put(self, raws, build):
+        key = tuple(id(r) for r in raws)
+        hit = self._d.get(key)
+        if hit is not None:
+            return hit[0]
+        value = build()
+        if len(self._d) >= self.cap:
+            self._d.pop(next(iter(self._d)))
+        self._d[key] = (value, list(raws))  # refs pin the ids
+        return value
+
+
 def _zero_frozen(tree_list, frozen):
     """Zero per-layer grad/update entries for frozen layers (ref: FrozenLayer)."""
     if not any(frozen):
@@ -102,6 +142,7 @@ class MultiLayerNetwork:
         self._score = float("nan")
         self.listeners: List[Any] = []
         self._jit_cache: dict = {}
+        self._dev_cache = _DeviceCache()
         self._rng_key = jax.random.key(conf.seed)
         self._dtype = jnp.float32 if conf.dataType == "FLOAT" else (
             jnp.float64 if conf.dataType == "DOUBLE" else jnp.bfloat16)
@@ -228,6 +269,42 @@ class MultiLayerNetwork:
                    or getattr(l, "requiresUpdates", False)
                    for l in self.listeners)
 
+    # Steps fused into one executable by fit()'s multi-step path. 8 amortizes
+    # the axon tunnel's per-dispatch latency (BASELINE.md configs #1-#3 show
+    # 2-3x run-to-run spread from it) without inflating compile time.
+    fuseSteps: int = 8
+
+    def _build_multi_step(self):
+        """``fuseSteps`` training steps in ONE XLA executable: lax.scan over
+        stacked minibatches, params/opt-state carried on device. This is the
+        de-dispatch move one level up from the per-step fusion — the
+        reference's per-op JNI dispatch disease (SURVEY §3.1) reappears as
+        per-STEP Python dispatch on small models; the scan deletes it.
+        Used by fit() when no listener/mask/tBPTT forces host hops."""
+        conf = self.conf
+        frozen = [getattr(l, "frozen", False) for l in self.layers]
+
+        def body(carry, inp):
+            params, state, opt_state = carry
+            x, y, rng = inp
+            (loss, new_states), grads = jax.value_and_grad(
+                self._loss_for, has_aux=True)(params, state, x, y, rng,
+                                              None, None)
+            grads = _zero_frozen(grads, frozen)
+            grads = _clip_grads(grads, conf.gradientNormalization,
+                                conf.gradientNormalizationThreshold)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            updates = _zero_frozen(updates, frozen)
+            params = optax.apply_updates(params, updates)
+            return (params, new_states, opt_state), loss
+
+        def multi(params, state, opt_state, xs, ys, rngs):
+            (params, state, opt_state), losses = jax.lax.scan(
+                body, (params, state, opt_state), (xs, ys, rngs))
+            return params, state, opt_state, losses[-1]
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
     def _build_infer(self):
         def infer(params, state, x, fmask):
             out, _, _ = self._forward(params, state, x, training=False, rng=None, mask=fmask)
@@ -238,7 +315,8 @@ class MultiLayerNetwork:
     def _get_jitted(self, kind):
         if kind not in self._jit_cache:
             builders = {"step": self._build_step, "infer": self._build_infer,
-                        "step_stats": lambda: self._build_step(with_stats=True)}
+                        "step_stats": lambda: self._build_step(with_stats=True),
+                        "multi": self._build_multi_step}
             self._jit_cache[kind] = builders[kind]()
         return self._jit_cache[kind]
 
@@ -439,33 +517,80 @@ class MultiLayerNetwork:
         stats = self._stats_requested()
         kind = "step_stats" if stats else "step"
         step = None if tbptt else self._get_jitted(kind)
+        # De-dispatch path: without listeners/stats/tBPTT there is no per-step
+        # host interaction, so steps buffer into fuseSteps-sized lax.scan
+        # chunks (one dispatch each) — epoch boundaries included.
+        fuse_k = 0 if (tbptt or stats or self.listeners) else self.fuseSteps
+        buf: list = []  # (features, labels) pairs of identical shape
+
+        def run_single(ds):
+            nonlocal step
+            raw_f, raw_y = _unwrap(ds.features), _unwrap(ds.labels)
+            if isinstance(raw_f, np.ndarray) and isinstance(raw_y, np.ndarray):
+                x, y = self._dev_cache.get_or_put(
+                    [raw_f, raw_y], lambda: (_as_jnp(raw_f), _as_jnp(raw_y)))
+            else:
+                x, y = _as_jnp(ds.features), _as_jnp(ds.labels)
+            fmask = _as_jnp(ds.features_mask) if ds.features_mask is not None else None
+            lmask = _as_jnp(ds.labels_mask) if ds.labels_mask is not None else None
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            if step is None:
+                step = self._get_jitted(kind)
+            if stats:
+                (self._params, self._state, self._opt_state, loss,
+                 self._last_grads, self._last_updates) = step(
+                    self._params, self._state, self._opt_state, x, y, sub, fmask, lmask)
+            else:
+                self._params, self._state, self._opt_state, loss = step(
+                    self._params, self._state, self._opt_state, x, y, sub, fmask, lmask)
+            self._score = loss  # device scalar; score() syncs on demand
+            self._iteration += 1
+            for lst in self.listeners:
+                lst.iterationDone(self, self._iteration, self._epoch)
+
+        def flush(buf):
+            while len(buf) >= fuse_k > 1:
+                chunk, buf = buf[:fuse_k], buf[fuse_k:]
+                raws = [_unwrap(f) for f, _ in chunk] + \
+                       [_unwrap(y) for _, y in chunk]
+                if all(isinstance(r, np.ndarray) for r in raws):
+                    xs, ys = self._dev_cache.get_or_put(
+                        raws, lambda: (_stack_batches([f for f, _ in chunk]),
+                                       _stack_batches([y for _, y in chunk])))
+                else:
+                    xs = _stack_batches([f for f, _ in chunk])
+                    ys = _stack_batches([y for _, y in chunk])
+                self._rng_key, sub = jax.random.split(self._rng_key)
+                rngs = jax.random.split(sub, fuse_k)
+                multi = self._get_jitted("multi")
+                (self._params, self._state, self._opt_state,
+                 self._score) = multi(self._params, self._state,
+                                      self._opt_state, xs, ys, rngs)
+                self._iteration += fuse_k
+            return buf
+
         for _ in range(epochs):
             for ds in data:
                 if tbptt and np.ndim(ds.features) == 3:
                     self._fit_tbptt(ds)
                     continue
-                x = _as_jnp(ds.features)
-                y = _as_jnp(ds.labels)
-                fmask = _as_jnp(ds.features_mask) if ds.features_mask is not None else None
-                lmask = _as_jnp(ds.labels_mask) if ds.labels_mask is not None else None
-                self._rng_key, sub = jax.random.split(self._rng_key)
-                if step is None:
-                    step = self._get_jitted(kind)
-                if stats:
-                    (self._params, self._state, self._opt_state, loss,
-                     self._last_grads, self._last_updates) = step(
-                        self._params, self._state, self._opt_state, x, y, sub, fmask, lmask)
+                if fuse_k > 1 and ds.features_mask is None \
+                        and ds.labels_mask is None:
+                    if buf and (np.shape(buf[0][0]) != np.shape(ds.features)
+                                or np.shape(buf[0][1]) != np.shape(ds.labels)):
+                        for f, y in buf:  # shape change: drain as singles
+                            run_single(DataSet(f, y))
+                        buf = []
+                    buf.append((ds.features, ds.labels))
+                    buf = flush(buf)
                 else:
-                    self._params, self._state, self._opt_state, loss = step(
-                        self._params, self._state, self._opt_state, x, y, sub, fmask, lmask)
-                self._score = loss  # device scalar; score() syncs on demand
-                self._iteration += 1
-                for lst in self.listeners:
-                    lst.iterationDone(self, self._iteration, self._epoch)
+                    run_single(ds)
             self._epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "onEpochEnd"):
                     lst.onEpochEnd(self)
+        for f, y in buf:  # leftover (< fuseSteps) steps run individually
+            run_single(DataSet(f, y))
         return self
 
     # ------------------------------------------------------------- inference

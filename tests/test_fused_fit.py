@@ -1,0 +1,143 @@
+"""De-dispatched fit(): fuseSteps training steps per XLA executable
+(lax.scan over stacked minibatches — the per-STEP analog of SURVEY §3.1's
+per-op JNI-dispatch deletion). Parity contract: the fused path must produce
+exactly the same parameters as the per-step path for deterministic nets."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization, DenseLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.train.updaters import Adam
+
+RNG = np.random.default_rng(11)
+
+
+def _mlp_conf(seed=0, bn=False):
+    b = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+         .list()
+         .layer(DenseLayer(nOut=16, activation="TANH")))
+    if bn:
+        b = b.layer(BatchNormalization())
+    return (b.layer(OutputLayer(nOut=3, lossFunction="MCXENT"))
+            .setInputType(InputType.feedForward(6)).build())
+
+
+def _batches(n, B=8):
+    out = []
+    for _ in range(n):
+        x = RNG.normal(size=(B, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, B)]
+        out.append(DataSet(x, y))
+    return out
+
+
+def _params_flat(net):
+    return np.asarray(net.params().toNumpy())
+
+
+class TestFusedFitMLN:
+    def test_parity_with_per_step_path(self):
+        batches = _batches(16)
+        fused = MultiLayerNetwork(_mlp_conf()).init()
+        single = MultiLayerNetwork(_mlp_conf()).init()
+        single.fuseSteps = 0  # force the per-step executable
+        fused.fit(ListDataSetIterator(batches))
+        single.fit(ListDataSetIterator(batches))
+        assert fused._iteration == single._iteration == 16
+        np.testing.assert_allclose(_params_flat(fused), _params_flat(single),
+                                   atol=1e-6)
+
+    def test_parity_with_batchnorm_state(self):
+        batches = _batches(16)
+        fused = MultiLayerNetwork(_mlp_conf(bn=True)).init()
+        single = MultiLayerNetwork(_mlp_conf(bn=True)).init()
+        single.fuseSteps = 0
+        fused.fit(ListDataSetIterator(batches))
+        single.fit(ListDataSetIterator(batches))
+        np.testing.assert_allclose(_params_flat(fused), _params_flat(single),
+                                   atol=1e-6)
+        # running stats threaded through the scan carry
+        np.testing.assert_allclose(np.asarray(fused._state[1]["mean"]),
+                                   np.asarray(single._state[1]["mean"]),
+                                   atol=1e-6)
+
+    def test_leftover_steps_run(self):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.fit(ListDataSetIterator(_batches(11)))  # 8 fused + 3 single
+        assert net._iteration == 11
+        assert np.isfinite(net.score())
+
+    def test_epoch_boundaries_fuse(self):
+        # 3 batches x 4 epochs = 12 steps -> one 8-chunk + 4 leftovers
+        batches = _batches(3)
+        fused = MultiLayerNetwork(_mlp_conf()).init()
+        single = MultiLayerNetwork(_mlp_conf()).init()
+        single.fuseSteps = 0
+        fused.fit(ListDataSetIterator(batches), epochs=4)
+        single.fit(ListDataSetIterator(batches), epochs=4)
+        assert fused._iteration == single._iteration == 12
+        assert fused._epoch == single._epoch == 4
+        np.testing.assert_allclose(_params_flat(fused), _params_flat(single),
+                                   atol=1e-6)
+
+    def test_shape_change_drains_buffer(self):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        mixed = _batches(3, B=8) + _batches(3, B=4) + _batches(2, B=8)
+        net.fit(ListDataSetIterator(mixed))
+        assert net._iteration == 8
+        assert np.isfinite(net.score())
+
+    def test_listeners_force_per_step(self):
+        calls = []
+
+        class L:
+            def iterationDone(self, net, it, ep):
+                calls.append(it)
+
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.setListeners(L())
+        net.fit(ListDataSetIterator(_batches(10)))
+        assert calls == list(range(1, 11))
+
+    def test_training_converges_through_fused_path(self):
+        x = RNG.normal(size=(64, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[x[:, :3].argmax(1)]
+        batches = [DataSet(x[i:i + 8], y[i:i + 8]) for i in range(0, 64, 8)]
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.fit(ListDataSetIterator(batches), epochs=30)
+        out = np.asarray(net.output(x).toNumpy())
+        assert (out.argmax(1) == y.argmax(1)).mean() > 0.8
+
+
+class TestFusedFitCG:
+    def _cg_conf(self, seed=0):
+        return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("h", DenseLayer(nOut=16, activation="TANH"), "in")
+                .addLayer("out", OutputLayer(nOut=3, lossFunction="MCXENT"), "h")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(6)).build())
+
+    def test_parity_with_per_step_path(self):
+        batches = _batches(16)
+        fused = ComputationGraph(self._cg_conf()).init()
+        single = ComputationGraph(self._cg_conf()).init()
+        single.fuseSteps = 0
+        fused.fit(ListDataSetIterator(batches))
+        single.fit(ListDataSetIterator(batches))
+        assert fused._iteration == single._iteration == 16
+        np.testing.assert_allclose(_params_flat(fused), _params_flat(single),
+                                   atol=1e-6)
+
+    def test_leftover_and_score(self):
+        net = ComputationGraph(self._cg_conf()).init()
+        net.fit(ListDataSetIterator(_batches(9)))
+        assert net._iteration == 9
+        assert np.isfinite(net.score())

@@ -1,0 +1,740 @@
+"""Round-3 op-validation closure (ref: org.nd4j.autodiff.validation.OpValidation
+— SURVEY §4.1 "coverage ledger, fails CI if an op has no test").
+
+Validates every op the round-2 ledger left unverified: numeric check against a
+numpy/scipy/torch oracle, a float64 finite-difference gradient check where the
+op is differentiable, and eager-vs-graph parity through the SameDiff surface
+for a representative slice (the broad graph sweep lives in
+test_graph_op_sweep.py). The enforcement gate is tests/test_zz_op_gate.py.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import special as scipy_special
+
+from deeplearning4j_tpu import nd, ops
+from deeplearning4j_tpu.ops import mark_validated
+from deeplearning4j_tpu.ops.registry import get as get_op
+
+RNG = np.random.default_rng(33)
+
+
+def _np(x):
+    return np.asarray(x.toNumpy() if hasattr(x, "toNumpy") else x)
+
+
+def check(ns, name, got, want, atol=1e-5, rtol=1e-5):
+    np.testing.assert_allclose(_np(got).astype(np.float64), want,
+                               atol=atol, rtol=rtol)
+    mark_validated(name, ns)
+
+
+def gradcheck(fn, args, idx=0, eps=1e-6, rtol=1e-3, atol=1e-6):
+    """float64 central-difference gradient check of sum(fn(*args)) wrt
+    args[idx] (the reference's OpValidation gradient leg runs in double)."""
+    with jax.enable_x64(True):
+        a64 = [jnp.asarray(np.asarray(a, np.float64)) for a in args]
+
+        def scalar(v):
+            return jnp.sum(fn(*a64[:idx], v, *a64[idx + 1:]))
+
+        g = np.asarray(jax.grad(scalar)(a64[idx]))
+        x = np.asarray(a64[idx], np.float64)
+        num = np.zeros_like(x)
+        it = np.nditer(x, flags=["multi_index"])
+        while not it.finished:
+            i = it.multi_index
+            xp, xm = x.copy(), x.copy()
+            xp[i] += eps
+            xm[i] -= eps
+            num[i] = (float(scalar(jnp.asarray(xp)))
+                      - float(scalar(jnp.asarray(xm)))) / (2 * eps)
+            it.iternext()
+        np.testing.assert_allclose(g, num, rtol=rtol, atol=atol)
+
+
+X_ANY = RNG.normal(size=(2, 5)).astype(np.float64)
+X_POS = np.abs(RNG.normal(size=(2, 5))).astype(np.float64) + 0.2
+X_UNIT = RNG.uniform(-0.85, 0.85, size=(2, 5)).astype(np.float64)
+X_GT1 = RNG.uniform(1.2, 3.0, size=(2, 5)).astype(np.float64)
+X_SPECIAL = np.array([[1.0, np.inf, -np.inf, np.nan, 0.0]])
+X_BOOL = np.array([[True, False, True], [False, False, True]])
+Y_BOOL = np.array([[True, True, False], [False, True, True]])
+
+
+# --------------------------------------------------------------------- math
+
+# name -> (oracle, input, differentiable)
+MATH_UNARY = {
+    "acos": (np.arccos, X_UNIT, True),
+    "acosh": (np.arccosh, X_GT1, True),
+    "asin": (np.arcsin, X_UNIT, True),
+    "asinh": (np.arcsinh, X_ANY, True),
+    "atan": (np.arctan, X_ANY, True),
+    "atanh": (np.arctanh, X_UNIT, True),
+    "ceil": (np.ceil, X_ANY, False),
+    "cos": (np.cos, X_ANY, True),
+    "cosh": (np.cosh, X_ANY, True),
+    "cube": (lambda x: x ** 3, X_ANY, True),
+    "erfc": (scipy_special.erfc, X_ANY, True),
+    "expm1": (np.expm1, X_ANY, True),
+    "identity": (lambda x: x, X_ANY, True),
+    "isfinite": (np.isfinite, X_SPECIAL, False),
+    "isinf": (np.isinf, X_SPECIAL, False),
+    "isnan": (np.isnan, X_SPECIAL, False),
+    "log10": (np.log10, X_POS, True),
+    "log1p": (np.log1p, X_POS, True),
+    "log2": (np.log2, X_POS, True),
+    "logicalNot": (np.logical_not, X_BOOL, False),
+    "neg": (np.negative, X_ANY, True),
+    "onesLike": (np.ones_like, X_ANY, False),
+    "reciprocal": (lambda x: 1.0 / x, X_POS, True),
+    "round": (np.round, X_ANY, False),
+    "rsqrt": (lambda x: 1.0 / np.sqrt(x), X_POS, True),
+    "sin": (np.sin, X_ANY, True),
+    "sinh": (np.sinh, X_ANY, True),
+    "tan": (np.tan, X_UNIT, True),
+    "zerosLike": (np.zeros_like, X_ANY, False),
+}
+
+B_A = RNG.normal(size=(2, 4)).astype(np.float64)
+B_B = RNG.normal(size=(2, 4)).astype(np.float64) + 3.0  # positive divisor
+B_MIX = np.array([[5.0, -5.0, 7.3], [-7.3, 2.5, -2.5]])
+B_DIV = np.array([[3.0, 3.0, -2.0], [2.0, -1.5, 1.5]])
+
+MATH_BINARY = {
+    "add": (np.add, (B_A, B_B), True),
+    "sub": (np.subtract, (B_A, B_B), True),
+    "mul": (np.multiply, (B_A, B_B), True),
+    "div": (np.divide, (B_A, B_B), True),
+    "atan2": (np.arctan2, (B_A, B_B), True),
+    "squaredDifference": (lambda a, b: (a - b) ** 2, (B_A, B_B), True),
+    # floorDiv/floorMod follow python floor semantics, fmod truncates toward
+    # zero — mixed-sign operands distinguish the three
+    "floorDiv": (np.floor_divide, (B_MIX, B_DIV), False),
+    "floorMod": (np.mod, (B_MIX, B_DIV), False),
+    "fmod": (np.fmod, (B_MIX, B_DIV), False),
+    "eq": (np.equal, (B_MIX, np.abs(B_MIX)), False),
+    "neq": (np.not_equal, (B_MIX, np.abs(B_MIX)), False),
+    "gt": (np.greater, (B_A, B_B), False),
+    "gte": (np.greater_equal, (B_MIX, np.abs(B_MIX)), False),
+    "lt": (np.less, (B_A, B_B), False),
+    "lte": (np.less_equal, (B_MIX, np.abs(B_MIX)), False),
+    "logicalAnd": (np.logical_and, (X_BOOL, Y_BOOL), False),
+    "logicalOr": (np.logical_or, (X_BOOL, Y_BOOL), False),
+    "logicalXor": (np.logical_xor, (X_BOOL, Y_BOOL), False),
+}
+
+
+class TestMathClosure:
+    @pytest.mark.parametrize("name", sorted(MATH_UNARY))
+    def test_unary_oracle_and_grad(self, name):
+        oracle, x, diff = MATH_UNARY[name]
+        got = getattr(ops.math, name)(x.astype(np.float32)
+                                      if x.dtype == np.float64 else x)
+        np.testing.assert_allclose(_np(got).astype(np.float64), oracle(x),
+                                   rtol=1e-5, atol=1e-5)
+        if diff:
+            gradcheck(get_op(name, "math").fn, [x])
+        mark_validated(name, "math")
+
+    @pytest.mark.parametrize("name", sorted(MATH_BINARY))
+    def test_binary_oracle_and_grad(self, name):
+        oracle, (a, b), diff = MATH_BINARY[name]
+        cast = (lambda v: v.astype(np.float32)
+                if v.dtype == np.float64 else v)
+        got = getattr(ops.math, name)(cast(a), cast(b))
+        np.testing.assert_allclose(_np(got).astype(np.float64), oracle(a, b),
+                                   rtol=1e-5, atol=1e-5)
+        if diff:
+            gradcheck(get_op(name, "math").fn, [a, b], idx=0)
+            gradcheck(get_op(name, "math").fn, [a, b], idx=1)
+        mark_validated(name, "math")
+
+    def test_graph_parity_spot(self):
+        # eager-vs-graph parity for the newly-validated binaries that the
+        # broad sweep (test_graph_op_sweep) does not cover
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+        sd = SameDiff.create()
+        a = sd.var("a", B_MIX.astype(np.float32))
+        b = sd.var("b", B_DIV.astype(np.float32))
+        out = sd.math.floorMod(a, b)
+        got = _np(sd.output({}, out.name)[out.name])
+        np.testing.assert_allclose(got, np.mod(B_MIX, B_DIV).astype(np.float32),
+                                   rtol=1e-6)
+
+
+# ----------------------------------------------------------------------- nn
+
+def _selu_oracle(x):
+    a, l = 1.6732632423543772, 1.0507009873554805
+    return l * np.where(x > 0, x, a * (np.exp(x) - 1))
+
+
+def _gelu_tanh_oracle(x):
+    return 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                  * (x + 0.044715 * x ** 3)))
+
+
+NN_UNARY = {
+    "celu": (lambda x: np.where(x > 0, x, np.expm1(x)), X_ANY, True),
+    "gelu": (_gelu_tanh_oracle, X_ANY, True),
+    "hardSigmoid": (lambda x: np.clip(x / 6.0 + 0.5, 0, 1), X_ANY, False),
+    "logSoftmax": (lambda x: x - np.log(np.sum(np.exp(x), axis=-1,
+                                               keepdims=True)), X_ANY, True),
+    "mish": (lambda x: x * np.tanh(np.log1p(np.exp(x))), X_ANY, True),
+    "rationalTanh": (lambda x: 1.7159 * np.tanh(2.0 * x / 3.0), X_ANY, True),
+    "rectifiedTanh": (lambda x: np.maximum(0.0, np.tanh(x)), X_ANY, False),
+    "relu6": (lambda x: np.clip(x, 0, 6), X_ANY, False),
+    "selu": (_selu_oracle, X_ANY, True),
+    "softsign": (lambda x: x / (1 + np.abs(x)), X_ANY, True),
+    "swish": (lambda x: x / (1 + np.exp(-x)), X_ANY, True),
+}
+
+
+class TestNNClosure:
+    @pytest.mark.parametrize("name", sorted(NN_UNARY))
+    def test_activation_oracle_and_grad(self, name):
+        oracle, x, diff = NN_UNARY[name]
+        got = getattr(ops.nn, name)(x.astype(np.float32))
+        np.testing.assert_allclose(_np(got).astype(np.float64), oracle(x),
+                                   rtol=1e-4, atol=1e-5)
+        if diff:
+            gradcheck(get_op(name, "nn").fn, [x])
+        mark_validated(name, "nn")
+
+    def test_gelu_exact_erf_variant(self):
+        got = ops.nn.gelu(X_ANY.astype(np.float32), approximate=False)
+        want = X_ANY * 0.5 * (1 + scipy_special.erf(X_ANY / np.sqrt(2)))
+        np.testing.assert_allclose(_np(got).astype(np.float64), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_threshold_relu(self):
+        x = np.array([[-1.0, 0.5, 1.5, 3.0]], np.float32)
+        check("nn", "thresholdRelu", ops.nn.thresholdRelu(x, theta=1.0),
+              np.where(x > 1.0, x, 0.0))
+
+    def test_prelu(self):
+        x = np.array([[-2.0, -0.5, 1.0, 3.0]], np.float32)
+        alpha = np.float32(0.25)
+        check("nn", "prelu", ops.nn.prelu(x, alpha),
+              np.where(x > 0, x, 0.25 * x))
+        gradcheck(get_op("prelu", "nn").fn, [x.astype(np.float64) + 0.01,
+                                             np.float64(0.25)])
+
+    def test_linear(self):
+        x = RNG.normal(size=(3, 4))
+        w = RNG.normal(size=(4, 2))
+        b = RNG.normal(size=(2,))
+        check("nn", "linear",
+              ops.nn.linear(x.astype(np.float32), w.astype(np.float32),
+                            b.astype(np.float32)),
+              x @ w + b, atol=1e-4)
+        gradcheck(get_op("linear", "nn").fn, [x, w, b], idx=1)
+
+    def test_instance_norm(self):
+        x = RNG.normal(size=(2, 3, 4, 4))
+        scale = RNG.normal(size=(3,)) + 1.0
+        bias = RNG.normal(size=(3,))
+        mean = x.mean(axis=(2, 3), keepdims=True)
+        var = x.var(axis=(2, 3), keepdims=True)
+        want = ((x - mean) / np.sqrt(var + 1e-5)) \
+            * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        check("nn", "instanceNorm",
+              ops.nn.instanceNorm(x.astype(np.float32),
+                                  scale.astype(np.float32),
+                                  bias.astype(np.float32)),
+              want, atol=1e-4)
+        gradcheck(get_op("instanceNorm", "nn").fn,
+                  [x[:1, :2, :2, :2], scale[:2], bias[:2]], rtol=5e-3)
+
+    def test_lrn_matches_tf(self):
+        import tensorflow as tf
+        x = RNG.normal(size=(2, 7, 3, 3)).astype(np.float32)
+        want = tf.raw_ops.LRN(input=np.transpose(x, (0, 2, 3, 1)),
+                              depth_radius=2, bias=1.0, alpha=0.5,
+                              beta=0.75).numpy()
+        got = ops.nn.lrn(x, depth_radius=2, bias=1.0, alpha=0.5, beta=0.75)
+        np.testing.assert_allclose(np.transpose(_np(got), (0, 2, 3, 1)), want,
+                                   atol=1e-4)
+        mark_validated("lrn", "nn")
+
+    def test_gumbel_softmax(self):
+        key = jax.random.PRNGKey(0)
+        logits = np.array([[2.0, 0.0, -2.0]] * 256, np.float32)
+        out = _np(ops.nn.gumbelSoftmax(key, logits, temperature=0.5))
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+        # at tau=0.5 the hottest logit wins most draws
+        assert (out.argmax(-1) == 0).mean() > 0.7
+        g = jax.grad(lambda l: jnp.sum(
+            get_op("gumbelSoftmax", "nn").fn(key, l) ** 2))(jnp.asarray(logits))
+        assert np.isfinite(_np(g)).all()
+        mark_validated("gumbelSoftmax", "nn")
+
+
+# --------------------------------------------------------------------- loss
+
+L_L = np.abs(RNG.normal(size=(4, 3))) + 0.2
+L_P = np.abs(RNG.normal(size=(4, 3))) + 0.2
+L_W = np.array([1.0, 0.0, 2.0, 0.5])
+
+LOSSES = {
+    "mae": lambda l, p: np.mean(np.abs(p - l), axis=-1),
+    "l1": lambda l, p: np.sum(np.abs(p - l), axis=-1),
+    "l2": lambda l, p: np.sum((p - l) ** 2, axis=-1),
+    "logCosh": lambda l, p: np.mean(np.log(np.cosh(p - l)), axis=-1),
+    "mape": lambda l, p: np.mean(np.abs((l - p) / np.abs(l)), axis=-1) * 100,
+    "msle": lambda l, p: np.mean((np.log1p(p) - np.log1p(l)) ** 2, axis=-1),
+    "poisson": lambda l, p: np.mean(p - l * np.log(p), axis=-1),
+    "kld": lambda l, p: np.sum(l * np.log(l / p), axis=-1),
+    "squaredHinge": lambda l, p: np.mean(np.maximum(0, 1 - l * p) ** 2,
+                                         axis=-1),
+    "cosineProximity": lambda l, p: -np.sum(l * p, axis=-1) / (
+        np.linalg.norm(l, axis=-1) * np.linalg.norm(p, axis=-1)),
+}
+
+
+class TestLossClosure:
+    @pytest.mark.parametrize("name", sorted(LOSSES))
+    def test_oracle_weights_average_grad(self, name):
+        oracle = LOSSES[name]
+        if name == "kld":  # domain: probability distributions
+            ll = L_L / L_L.sum(-1, keepdims=True)
+            pp = L_P / L_P.sum(-1, keepdims=True)
+        else:
+            ll, pp = L_L, L_P
+        per = oracle(ll, pp)
+        fn = get_op(name, "loss").fn
+        l32, p32 = ll.astype(np.float32), pp.astype(np.float32)
+        np.testing.assert_allclose(_np(getattr(ops.loss, name)(l32, p32)),
+                                   per.mean(), rtol=1e-4)
+        np.testing.assert_allclose(
+            _np(getattr(ops.loss, name)(l32, p32, average=False)),
+            per.sum(), rtol=1e-4)
+        np.testing.assert_allclose(
+            _np(getattr(ops.loss, name)(l32, p32,
+                                        weights=L_W.astype(np.float32))),
+            (per * L_W).mean(), rtol=1e-4)
+        gradcheck(lambda l, p: fn(l, p), [ll, pp], idx=1, rtol=5e-3)
+        mark_validated(name, "loss")
+
+    def test_sparse_mcxent_with_mask(self):
+        logits = RNG.normal(size=(2, 4, 5)).astype(np.float32)
+        labels = RNG.integers(0, 5, size=(2, 4))
+        mask = np.array([[1, 1, 0, 1], [0, 1, 1, 0]], np.float32)
+        logp = logits - scipy_special.logsumexp(logits, axis=-1,
+                                                keepdims=True)
+        nll = -np.take_along_axis(logp, labels[..., None],
+                                  axis=-1)[..., 0] * mask
+        want = nll.sum() / mask.sum()
+        got = ops.loss.sparseMcxentWithMask(labels, logits, mask)
+        np.testing.assert_allclose(_np(got), want, rtol=1e-5)
+        g = jax.grad(lambda lg: get_op("sparseMcxentWithMask", "loss").fn(
+            jnp.asarray(labels), lg, jnp.asarray(mask)))(jnp.asarray(logits))
+        assert np.isfinite(_np(g)).all()
+        # masked positions contribute no gradient
+        np.testing.assert_allclose(_np(g)[0, 2], 0.0, atol=1e-7)
+        mark_validated("sparseMcxentWithMask", "loss")
+
+
+# ------------------------------------------------------------------- reduce
+
+R_X = RNG.normal(size=(3, 4)).astype(np.float64)
+R_P = np.abs(RNG.normal(size=(2, 6))) + 0.1
+R_P = R_P / R_P.sum(axis=-1, keepdims=True)
+
+
+class TestReduceClosure:
+    def test_boolean_family(self):
+        xb = np.array([[1.0, 0.0, 2.0], [0.0, 0.0, 0.0]])
+        check("reduce", "all", ops.reduce.all(xb != 0, dims=1),
+              np.all(xb != 0, axis=1))
+        check("reduce", "any", ops.reduce.any(xb != 0, dims=1),
+              np.any(xb != 0, axis=1))
+        check("reduce", "countNonZero", ops.reduce.countNonZero(xb),
+              np.count_nonzero(xb))
+        check("reduce", "countZero", ops.reduce.countZero(xb),
+              xb.size - np.count_nonzero(xb))
+        check("reduce", "matchCondition",
+              ops.reduce.matchCondition(R_X, lambda t: t > 0),
+              (R_X > 0).sum())
+
+    def test_extrema_family(self):
+        x32 = R_X.astype(np.float32)
+        check("reduce", "min", ops.reduce.min(x32, dims=1),
+              R_X.min(axis=1), rtol=1e-6)
+        check("reduce", "argmin", ops.reduce.argmin(x32, dims=1),
+              R_X.argmin(axis=1))
+        check("reduce", "iamax", ops.reduce.iamax(x32),
+              np.abs(R_X).argmax())
+        check("reduce", "prod", ops.reduce.prod(x32, dims=0),
+              R_X.prod(axis=0), rtol=1e-5)
+        gradcheck(get_op("prod", "reduce").fn, [R_X])
+
+    def test_norm_family(self):
+        x32 = R_X.astype(np.float32)
+        check("reduce", "norm1", ops.reduce.norm1(x32, dims=1),
+              np.abs(R_X).sum(axis=1), rtol=1e-5)
+        check("reduce", "normMax", ops.reduce.normMax(x32),
+              np.abs(R_X).max(), rtol=1e-6)
+        check("reduce", "squaredNorm", ops.reduce.squaredNorm(x32, dims=0),
+              (R_X ** 2).sum(axis=0), rtol=1e-5)
+        gradcheck(get_op("squaredNorm", "reduce").fn, [R_X])
+
+    def test_moments_family(self):
+        x32 = R_X.astype(np.float32)
+        check("reduce", "std", ops.reduce.std(x32, dims=1),
+              R_X.std(axis=1, ddof=1), rtol=1e-5)
+        check("reduce", "std",
+              ops.reduce.std(x32, dims=1, biasCorrected=False),
+              R_X.std(axis=1, ddof=0), rtol=1e-5)
+        check("reduce", "variance", ops.reduce.variance(x32, dims=1),
+              R_X.var(axis=1, ddof=1), rtol=1e-5)
+        gradcheck(lambda x: get_op("variance", "reduce").fn(x, dims=1),
+                  [R_X], rtol=5e-3)
+
+    def test_distance_entropy(self):
+        a = np.array([[1.0, 2.0, 3.0]])
+        b = np.array([[1.0, 5.0, 3.0]])
+        check("reduce", "hammingDistance", ops.reduce.hammingDistance(a, b),
+              1.0)
+        check("reduce", "shannonEntropy",
+              ops.reduce.shannonEntropy(R_P.astype(np.float32), dims=1),
+              -np.sum(R_P * np.log2(R_P), axis=1), rtol=1e-4)
+
+
+# -------------------------------------------------------------------- shape
+
+class TestShapeClosure:
+    def test_reshape_family(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        check("shape", "reshape", ops.shape.reshape(x, (4, 6)),
+              x.reshape(4, 6))
+        check("shape", "flatten", ops.shape.flatten(x), x.ravel())
+        check("shape", "permute", ops.shape.permute(x, (2, 0, 1)),
+              x.transpose(2, 0, 1))
+        check("shape", "squeeze",
+              ops.shape.squeeze(x.reshape(2, 1, 3, 4), axis=1), x.reshape(2, 3, 4))
+        check("shape", "broadcastTo",
+              ops.shape.broadcastTo(np.float32(3.0), (2, 2)),
+              np.full((2, 2), 3.0))
+        got = ops.shape.reshapeRef(x, np.zeros((6, 7)), ["dim:0", -1])
+        check("shape", "reshapeRef", got, x.reshape(6, 4))
+        assert _np(ops.shape.castTo(x, jnp.int32)).dtype == np.int32
+        mark_validated("castTo", "shape")
+
+    def test_introspection(self):
+        x = np.zeros((2, 5, 3), np.float32)
+        check("shape", "shapeOf", ops.shape.shapeOf(x), [2, 5, 3])
+        assert ops.shape.rank(x) == 3
+        assert ops.shape.sizeAt(x, 1) == 5
+        mark_validated("rank", "shape")
+        mark_validated("sizeAt", "shape")
+
+    def test_join_split_family(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        b = a + 10
+        check("shape", "concat", ops.shape.concat([a, b], axis=0),
+              np.concatenate([a, b], axis=0))
+        check("shape", "concatN", ops.shape.concatN(a, b, axis=1),
+              np.concatenate([a, b], axis=1))
+        check("shape", "stack", ops.shape.stack([a, b], axis=0),
+              np.stack([a, b]))
+        check("shape", "stackN", ops.shape.stackN(a, b, axis=1),
+              np.stack([a, b], axis=1))
+        parts = ops.shape.splitN(a, 3, axis=1)
+        for got, want in zip(parts, np.split(a, 3, axis=1)):
+            np.testing.assert_allclose(_np(got), want)
+        mark_validated("splitN", "shape")
+        pieces = ops.shape.unstack(a, axis=0)
+        for got, want in zip(pieces, a):
+            np.testing.assert_allclose(_np(got), want)
+        mark_validated("unstack", "shape")
+
+    def test_slicing_family(self):
+        x = np.arange(60, dtype=np.float32).reshape(3, 4, 5)
+        check("shape", "slice", ops.shape.slice(x, (1, 0, 2), (2, 3, 2)),
+              x[1:3, 0:3, 2:4])
+        check("shape", "stridedSlice",
+              ops.shape.stridedSlice(x, (slice(0, 3, 2), slice(None),
+                                         slice(4, None, -2))),
+              x[0:3:2, :, 4::-2])
+        check("shape", "reverse", ops.shape.reverse(x, (0, 2)),
+              np.flip(x, (0, 2)))
+        check("shape", "gatherNd",
+              ops.shape.gatherNd(x, np.array([[0, 1], [2, 3]])),
+              x[[0, 2], [1, 3]])
+        check("shape", "repeat", ops.shape.repeat(x, 2, axis=1),
+              np.repeat(x, 2, axis=1))
+
+    def test_pad_family(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        check("shape", "pad",
+              ops.shape.pad(x, ((1, 0), (0, 2)), value=9.0),
+              np.pad(x, ((1, 0), (0, 2)), constant_values=9.0))
+        np.testing.assert_allclose(
+            _np(ops.shape.pad(x, ((1, 1), (1, 1)), mode="reflect")),
+            np.pad(x, 1, mode="reflect"))
+
+    def test_diag_family(self):
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        check("shape", "diag", ops.shape.diag(v), np.diag(v))
+        m = RNG.normal(size=(3, 3)).astype(np.float32)
+        check("shape", "diagPart", ops.shape.diagPart(m),
+              np.diagonal(m), rtol=1e-6)
+
+    def test_cumulative_family(self):
+        x = RNG.normal(size=(2, 4)).astype(np.float32)
+        check("shape", "cumsum", ops.shape.cumsum(x, axis=1),
+              np.cumsum(x, axis=1), rtol=1e-5)
+        check("shape", "cumprod", ops.shape.cumprod(x, axis=0),
+              np.cumprod(x, axis=0), rtol=1e-5)
+        gradcheck(lambda v: get_op("cumsum", "shape").fn(v, axis=1),
+                  [x.astype(np.float64)])
+
+    def test_segment_mean(self):
+        data = np.array([1.0, 2.0, 5.0, 7.0], np.float32)
+        ids = np.array([0, 0, 1, 1])
+        check("shape", "segmentMean", ops.shape.segmentMean(data, ids, 2),
+              [1.5, 6.0])
+
+
+# ------------------------------------------------------------------- linalg
+
+class TestLinalgClosure:
+    def test_mmul_gemm_tensormmul(self):
+        a = RNG.normal(size=(3, 4))
+        b = RNG.normal(size=(4, 5))
+        c = RNG.normal(size=(3, 5))
+        check("linalg", "mmul",
+              ops.linalg.mmul(a.astype(np.float32), b.astype(np.float32)),
+              a @ b, atol=1e-4)
+        got = ops.linalg.gemm(a.T.astype(np.float32), b.astype(np.float32),
+                              alpha=2.0, beta=0.5, transposeA=True,
+                              c=c.astype(np.float32))
+        check("linalg", "gemm", got, 2.0 * (a @ b) + 0.5 * c, atol=1e-4)
+        t1 = RNG.normal(size=(2, 3, 4))
+        t2 = RNG.normal(size=(4, 3, 5))
+        got = ops.linalg.tensorMmul(t1.astype(np.float32),
+                                    t2.astype(np.float32),
+                                    axes=((1, 2), (1, 0)))
+        check("linalg", "tensorMmul", got,
+              np.tensordot(t1, t2, axes=((1, 2), (1, 0))), atol=1e-4)
+        gradcheck(lambda x, y: get_op("mmul", "linalg").fn(x, y), [a, b],
+                  idx=0)
+
+    def test_qr_svd_eig(self):
+        a = RNG.normal(size=(5, 3))
+        q, r = ops.linalg.qr(a.astype(np.float32))
+        q, r = _np(q), _np(r)
+        np.testing.assert_allclose(q @ r, a, atol=1e-4)
+        np.testing.assert_allclose(q.T @ q, np.eye(3), atol=1e-4)
+        assert np.allclose(np.tril(r, -1), 0.0, atol=1e-5)
+        mark_validated("qr", "linalg")
+
+        u, s, vt = ops.linalg.svd(a.astype(np.float32), full_matrices=False)
+        u, s, vt = _np(u), _np(s), _np(vt)
+        np.testing.assert_allclose(u @ np.diag(s) @ vt, a, atol=1e-4)
+        np.testing.assert_allclose(s, np.linalg.svd(a, compute_uv=False),
+                                   atol=1e-4)
+        mark_validated("svd", "linalg")
+
+        sym = a.T @ a
+        w, v = ops.linalg.eig(sym.astype(np.float32))
+        w, v = _np(w), _np(v)
+        np.testing.assert_allclose(sym @ v, v @ np.diag(w), atol=1e-3)
+        np.testing.assert_allclose(np.sort(w),
+                                   np.sort(np.linalg.eigvalsh(sym)),
+                                   atol=1e-3)
+        mark_validated("eig", "linalg")
+
+    def test_lstsq(self):
+        a = RNG.normal(size=(6, 3))
+        b = RNG.normal(size=(6, 2))
+        want = np.linalg.lstsq(a, b, rcond=None)[0]
+        got = ops.linalg.lstsq(a.astype(np.float32), b.astype(np.float32))
+        got = got[0] if isinstance(got, (tuple, list)) else got
+        np.testing.assert_allclose(_np(got), want, atol=1e-3)
+        mark_validated("lstsq", "linalg")
+
+    def test_matrix_band_diag(self):
+        m = RNG.normal(size=(4, 4)).astype(np.float32)
+        want = m.copy()
+        for i in range(4):
+            for j in range(4):
+                if (i - j) > 1 or (j - i) > 2:  # lower=1, upper=2
+                    want[i, j] = 0.0
+        check("linalg", "matrixBandPart", ops.linalg.matrixBandPart(m, 1, 2),
+              want, rtol=1e-6)
+        v = np.array([1.0, 2.0], np.float32)
+        check("linalg", "matrixDiag", ops.linalg.matrixDiag(v), np.diag(v))
+
+
+# ---------------------------------------------------------------------- cnn
+
+torch = pytest.importorskip("torch")
+
+
+class TestCnnClosure:
+    def test_conv1d_matches_torch(self):
+        x = RNG.normal(size=(2, 3, 12)).astype(np.float32)
+        w = RNG.normal(size=(5, 3, 4)).astype(np.float32) * 0.3  # (O,I,K)
+        b = RNG.normal(size=(5,)).astype(np.float32)
+        with torch.no_grad():
+            want = torch.nn.functional.conv1d(
+                torch.from_numpy(x), torch.from_numpy(w),
+                torch.from_numpy(b), stride=2, dilation=1).numpy()
+        got = ops.cnn.conv1d(x, w, b, stride=2, padding="VALID")
+        np.testing.assert_allclose(_np(got), want, atol=1e-4)
+        # SAME keeps length at stride 1
+        assert ops.cnn.conv1d(x, w, padding="SAME").shape == (2, 5, 12)
+        gradcheck(lambda xx, ww: get_op("conv1d", "cnn").fn(
+            xx, ww, padding="VALID"),
+            [x[:1, :, :6].astype(np.float64), w[:2].astype(np.float64)],
+            idx=1, rtol=5e-3)
+        mark_validated("conv1d", "cnn")
+
+    def test_conv3d_matches_torch(self):
+        x = RNG.normal(size=(1, 2, 5, 6, 7)).astype(np.float32)
+        w = RNG.normal(size=(4, 2, 3, 3, 3)).astype(np.float32) * 0.2
+        with torch.no_grad():
+            want = torch.nn.functional.conv3d(
+                torch.from_numpy(x), torch.from_numpy(w), stride=(1, 2, 2)).numpy()
+        got = ops.cnn.conv3d(x, w, strides=(1, 2, 2), padding="VALID")
+        np.testing.assert_allclose(_np(got), want, atol=1e-4)
+        mark_validated("conv3d", "cnn")
+
+    def test_deconv2d_matches_torch(self):
+        x = RNG.normal(size=(1, 3, 5, 5)).astype(np.float32)
+        w = RNG.normal(size=(3, 4, 3, 3)).astype(np.float32) * 0.2  # (I,O,kh,kw)
+        with torch.no_grad():
+            want = torch.nn.functional.conv_transpose2d(
+                torch.from_numpy(x), torch.from_numpy(w), stride=2).numpy()
+        got = ops.cnn.deconv2d(x, w, strides=(2, 2), padding="VALID")
+        np.testing.assert_allclose(_np(got), want, atol=1e-4)
+        mark_validated("deconv2d", "cnn")
+
+    def test_separable_conv2d_matches_torch(self):
+        x = RNG.normal(size=(1, 3, 8, 8)).astype(np.float32)
+        dw = RNG.normal(size=(3, 1, 3, 3)).astype(np.float32) * 0.3
+        pw = RNG.normal(size=(6, 3, 1, 1)).astype(np.float32) * 0.3
+        with torch.no_grad():
+            mid = torch.nn.functional.conv2d(
+                torch.from_numpy(x), torch.from_numpy(dw), groups=3)
+            want = torch.nn.functional.conv2d(
+                mid, torch.from_numpy(pw)).numpy()
+        got = ops.cnn.separableConv2d(x, dw, pw, padding="VALID")
+        np.testing.assert_allclose(_np(got), want, atol=1e-4)
+        mark_validated("separableConv2d", "cnn")
+
+    def test_pool1d_matches_torch(self):
+        x = RNG.normal(size=(2, 3, 11)).astype(np.float32)
+        with torch.no_grad():
+            want_max = torch.nn.functional.max_pool1d(
+                torch.from_numpy(x), 3, stride=2).numpy()
+            want_avg = torch.nn.functional.avg_pool1d(
+                torch.from_numpy(x), 3, stride=2).numpy()
+        np.testing.assert_allclose(
+            _np(ops.cnn.maxPool1d(x, 3, strides=2)), want_max, atol=1e-5)
+        np.testing.assert_allclose(
+            _np(ops.cnn.avgPool1d(x, 3, strides=2)), want_avg, atol=1e-5)
+        mark_validated("maxPool1d", "cnn")
+        mark_validated("avgPool1d", "cnn")
+
+    def test_pool3d_matches_torch(self):
+        x = RNG.normal(size=(1, 2, 6, 6, 6)).astype(np.float32)
+        with torch.no_grad():
+            want_max = torch.nn.functional.max_pool3d(
+                torch.from_numpy(x), 2).numpy()
+            want_avg = torch.nn.functional.avg_pool3d(
+                torch.from_numpy(x), 2).numpy()
+        np.testing.assert_allclose(
+            _np(ops.cnn.maxPool3d(x, (2, 2, 2))), want_max, atol=1e-5)
+        np.testing.assert_allclose(
+            _np(ops.cnn.avgPool3d(x, (2, 2, 2))), want_avg, atol=1e-5)
+        mark_validated("maxPool3d", "cnn")
+        mark_validated("avgPool3d", "cnn")
+
+    def test_global_max_pool(self):
+        x = RNG.normal(size=(2, 3, 4, 5)).astype(np.float32)
+        check("cnn", "globalMaxPool", ops.cnn.globalMaxPool(x),
+              x.max(axis=(2, 3)), rtol=1e-6)
+
+    def test_im2col_reconstructs_conv(self):
+        # functional oracle: conv2d(x, w) == w-matmul over im2col patches
+        x = RNG.normal(size=(2, 3, 6, 6)).astype(np.float32)
+        w = RNG.normal(size=(4, 3, 2, 2)).astype(np.float32)
+        patches = _np(ops.cnn.im2col(x, (2, 2)))  # (N, C*kh*kw, oh, ow)
+        want = _np(ops.cnn.conv2d(x, w, padding="VALID"))
+        got = np.einsum("of,nfij->noij",
+                        w.reshape(4, -1), patches.reshape(2, 12, 5, 5))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        mark_validated("im2col", "cnn")
+
+
+# ------------------------------------------------------------------- random
+
+class TestRandomClosure:
+    def test_distributions(self):
+        key = jax.random.PRNGKey(7)
+        n = (20000,)
+        b = _np(ops.random.bernoulli(key, n, p=0.3))
+        assert abs(b.mean() - 0.3) < 0.02
+        mark_validated("bernoulli", "random")
+        e = _np(ops.random.exponential(key, n, lam=2.0))
+        assert abs(e.mean() - 0.5) < 0.02 and (e >= 0).all()
+        mark_validated("exponential", "random")
+        g = _np(ops.random.gamma(key, n, alpha=3.0))
+        assert abs(g.mean() - 3.0) < 0.1
+        mark_validated("gamma", "random")
+        m = _np(ops.random.normal(key, n, mean=1.5, std=2.0))
+        assert abs(m.mean() - 1.5) < 0.05 and abs(m.std() - 2.0) < 0.05
+        mark_validated("normal", "random")
+        t = _np(ops.random.truncatedNormal(key, n, mean=0.0, std=1.0))
+        assert np.abs(t).max() <= 2.0 + 1e-6
+        assert abs(t.mean()) < 0.05
+        mark_validated("truncatedNormal", "random")
+
+    def test_shuffle_is_permutation(self):
+        key = jax.random.PRNGKey(3)
+        x = np.arange(100, dtype=np.float32)
+        s = _np(ops.random.shuffle(key, x))
+        assert not np.array_equal(s, x)
+        np.testing.assert_array_equal(np.sort(s), x)
+        mark_validated("shuffle", "random")
+
+
+# ------------------------------------------------------------------ bitwise
+
+class TestBitwiseClosure:
+    def test_bit_family(self):
+        a = np.array([0b1100, 0b1010, 255], np.int32)
+        b = np.array([0b1010, 0b0110, 15], np.int32)
+        check("bitwise", "and_", ops.bitwise.and_(a, b), a & b)
+        check("bitwise", "or_", ops.bitwise.or_(a, b), a | b)
+        check("bitwise", "xor", ops.bitwise.xor(a, b), a ^ b)
+        check("bitwise", "leftShift", ops.bitwise.leftShift(a, 2), a << 2)
+        check("bitwise", "rightShift", ops.bitwise.rightShift(a, 1), a >> 1)
+        want = sum(bin(int(x) ^ int(y)).count("1") for x, y in zip(a, b))
+        check("bitwise", "bitsHammingDistance",
+              ops.bitwise.bitsHammingDistance(a, b), want)
+
+
+# ---------------------------------------------------------------------- rnn
+
+class TestGruCellClosure:
+    def test_matches_torch_gru_cell(self):
+        B, I, H = 3, 4, 5
+        x = RNG.normal(size=(B, I)).astype(np.float32)
+        h = RNG.normal(size=(B, H)).astype(np.float32)
+        cell = torch.nn.GRUCell(I, H)
+        with torch.no_grad():
+            want = cell(torch.from_numpy(x), torch.from_numpy(h)).numpy()
+        w_ih = cell.weight_ih.detach().numpy().T  # (I, 3H), gate order r|z|n
+        w_hh = cell.weight_hh.detach().numpy().T
+        b_ih = cell.bias_ih.detach().numpy()
+        b_hh = cell.bias_hh.detach().numpy()
+        got = ops.rnn.gruCell(x, h, w_ih, w_hh, b_ih, b_hh)
+        np.testing.assert_allclose(_np(got), want, atol=1e-5)
+        mark_validated("gruCell", "rnn")

@@ -290,3 +290,51 @@ def test_random_and_updaters_namespaces():
     g = sd2.var("g", np.ones(3, np.float32))
     u = sd2.updaters.sgdUpdater(g, lr=0.5)
     np.testing.assert_allclose(sd2.output({}, u.name)[u.name].toNumpy(), 0.5)
+
+
+class TestMixedPrecisionTraining:
+    """TrainingConfig.computeDtype: bf16 compute over fp32 master params
+    (the import-time dtype-rewrite for TF/ONNX-imported graphs — BASELINE.md
+    config #4)."""
+
+    def _build(self, compute_dtype):
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(128, 6)).astype(np.float32)
+        W = rng.normal(size=(6, 3)).astype(np.float32)
+        Y = (X @ W + rng.normal(size=(128, 3)) * 0.05).astype(np.float32)
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", shape=(None, 6))
+        y = sd.placeHolder("y", shape=(None, 3))
+        w1 = sd.var("w1", (rng.normal(size=(6, 16)) * 0.3).astype(np.float32))
+        w2 = sd.var("w2", (rng.normal(size=(16, 3)) * 0.3).astype(np.float32))
+        h = sd.math.tanh(x.mmul(w1))
+        pred = h.mmul(w2)
+        sd.loss.mse(y, pred).rename("loss")
+        sd.setLossVariables("loss")
+        sd.setTrainingConfig(TrainingConfig(updater=Adam(0.05),
+                                            dataSetFeatureMapping=["x"],
+                                            dataSetLabelMapping=["y"],
+                                            computeDtype=compute_dtype))
+        return sd, DataSet(X, Y)
+
+    def test_bf16_trains_to_fp32_quality(self):
+        sd32, ds = self._build(None)
+        h32 = sd32.fit(ds, epochs=200)
+        sd16, ds = self._build("HALF")
+        h16 = sd16.fit(ds, epochs=200)
+        assert h32[-1] < 0.05
+        # bf16 compute converges to the same loss basin (loose tol: 8-bit
+        # mantissa), and params stay fp32 masters
+        assert h16[-1] < max(2 * h32[-1], 0.08)
+        w1 = sd16.getVariable("w1").getArr().jax
+        assert w1.dtype == jnp.float32
+
+    def test_compute_dtype_survives_serde(self, tmp_path):
+        sd16, ds = self._build("HALF")
+        sd16.fit(ds, epochs=2)
+        p = str(tmp_path / "mp.zip")
+        sd16.save(p, save_updater_state=True)
+        back = SameDiff.load(p)
+        assert back._training_config.computeDtype == "HALF"
+        h = back.fit(ds, epochs=2)
+        assert np.isfinite(h[-1])

@@ -21,14 +21,20 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np
 
 
-def _timed_fit(net, ds, steps=8, warmup=2):
-    """Seconds per fit(ds) call after warmup (one fused step per call)."""
-    for _ in range(warmup):
-        net.fit(ds)
+def _timed_fit(net, ds, steps=16, warmup=None):
+    """Seconds per training step, driving fit(iterator) the way real training
+    does — which engages the de-dispatched multi-step path (fuseSteps steps
+    per XLA executable; BASELINE.md round-3). ``steps`` should be a multiple
+    of net.fuseSteps so the whole run is fused. Synchronization is a host
+    transfer of the score (block_until_ready is a no-op under axon)."""
+    from deeplearning4j_tpu.data import ListDataSetIterator
+    k = max(getattr(net, "fuseSteps", 8), 1)
+    warm = ListDataSetIterator([ds] * (warmup or 2 * k))
+    net.fit(warm)                       # compiles multi + leftover step paths
     float(net.score())
+    it = ListDataSetIterator([ds] * steps)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        net.fit(ds)
+    net.fit(it)
     float(net.score())
     return (time.perf_counter() - t0) / steps
 
@@ -53,7 +59,7 @@ def bench_lenet(dtype, B=256):
     rng = np.random.default_rng(0)
     ds = DataSet(rng.random((B, 784), np.float32),
                  np.eye(10, dtype=np.float32)[rng.integers(0, 10, B)])
-    dt = _timed_fit(net, ds)
+    dt = _timed_fit(net, ds, steps=32)
     return {"config": "lenet_mnist_mln", "metric": "images_per_sec",
             "value": round(B / dt, 1), "batch": B, "dtype": dtype}
 
@@ -69,7 +75,7 @@ def bench_resnet50(dtype, B=32):
     rng = np.random.default_rng(0)
     ds = DataSet(rng.random((B, 3, 224, 224), np.float32),
                  np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, B)])
-    dt = _timed_fit(net, ds, steps=5, warmup=2)
+    dt = _timed_fit(net, ds, steps=16)
     return {"config": "resnet50_cg", "metric": "images_per_sec",
             "value": round(B / dt, 1), "batch": B, "dtype": dtype}
 
@@ -91,7 +97,7 @@ def bench_graves_lstm(dtype, B=64, T=128, vocab=80, hidden=512):
     x = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (B, T))]
     y = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (B, T))]
     ds = DataSet(x, y)
-    dt = _timed_fit(net, ds, steps=6, warmup=2)
+    dt = _timed_fit(net, ds, steps=16)
     return {"config": "graves_lstm_char_rnn", "metric": "tokens_per_sec",
             "value": round(B * T / dt, 1), "batch": B, "seq": T, "dtype": dtype}
 
