@@ -39,12 +39,14 @@ def main():
         # BERT-base 12L/768H/12 heads/512 seq. remat off: activations fit a
         # single chip's HBM at B=48 and recompute costs ~15% throughput
         # (measured: 117k tok/s no-remat vs 100k dots-remat vs 96k full).
-        # The step is HBM-bandwidth-bound (XLA cost analysis: 17.5 TFLOP but
-        # 132 GB accessed -> ~620 GB/s sustained, near the v5e's 819 GB/s
-        # peak), so the remaining lever is fewer bytes: bf16 softmax drops
-        # 18 GB/step (+13% throughput; loss trajectory identical over 150
-        # steps — validated in models/bert.py softmax_dtype docs).
-        cfg = TransformerConfig(remat=False, softmax_dtype=jnp.bfloat16)
+        # attention_impl='flash' routes to the packed whole-head VMEM Pallas
+        # kernel (fwd+bwd on-chip, fp32 softmax in VMEM, no (T,T) HBM
+        # traffic, no head transposes) — the round-4 lever that broke the
+        # round-2/3 HBM plateau (tools/profile_flagship.py: the XLA
+        # attention score path was 67 ms of the 182 ms step; now 135.4k ->
+        # 166.6k tok/s). softmax_dtype only affects the non-kernel XLA
+        # attention path and is left at its default here.
+        cfg = TransformerConfig(remat=False, attention_impl="flash")
         B, T, steps, warmup = 48, 512, 10, 3
     else:                                   # CPU smoke fallback (driver runs TPU)
         cfg = TransformerConfig(vocab_size=1024, hidden=128, layers=2, heads=4,

@@ -145,15 +145,19 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
     """Dispatch: full attention, the Pallas flash kernel, or sequence-parallel
     ring/Ulysses via shard_map over the 'context' axis when the mesh has one."""
     impl = cfg.attention_impl
-    if impl == "flash" and (mesh is None or CONTEXT_AXIS not in mesh.axis_names
-                            or mesh.shape[CONTEXT_AXIS] == 1):
+    if impl == "flash" and mesh is None:
+        # Meshless only: a monolithic pallas_call over sharded operands
+        # would defeat GSPMD (all-gather per layer). Short sequences
+        # (T <= 1024) never reach here either — _block routes them to the
+        # packed whole-head VMEM kernel via _use_packed_kernel before the
+        # head transpose. This branch serves single-chip long T only.
         T = q.shape[-2]
+        interpret = jax.default_backend() != "tpu"
         blk = 128
         while blk > 8 and T % blk:
             blk //= 2
         if T % blk == 0:
             from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
-            interpret = jax.default_backend() != "tpu"
             return flash_attention(q, k, v, cfg.causal, blk, blk, None, interpret)
         # T has no usable power-of-2 block divisor — full attention is correct
         return _full_attention(q, k, v, cfg.causal, cfg.softmax_dtype)
@@ -172,15 +176,35 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
     return mapped(q, k, v)
 
 
+def _use_packed_kernel(cfg: TransformerConfig, mesh: Optional[Mesh], T: int) -> bool:
+    """True when attention routes to the packed-layout Pallas kernel: the
+    (B, T, H*D) projections feed the kernel directly, so the (B, H, T, D)
+    head transposes (6 physical copies per layer, ~5 GB/step at bench
+    shapes) never materialize."""
+    if cfg.attention_impl != "flash":
+        return False
+    if mesh is not None:
+        # A monolithic pallas_call over sharded operands defeats GSPMD (it
+        # would all-gather q/k/v); sharded meshes keep the einsum/ring paths
+        # that partition cleanly over model/context axes.
+        return False
+    return T % 8 == 0 and T <= 1024
+
+
 def _block(params, x, cfg: TransformerConfig, mesh: Optional[Mesh]):
     B, T, H = x.shape
     h = _layernorm(x, params["ln1"])
     qkv = h @ params["qkv"]["kernel"].astype(h.dtype) + params["qkv"]["bias"].astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    def heads(t):  # (B,T,H) -> (B,heads,T,D)
-        return t.reshape(B, T, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
-    o = _attention(heads(q), heads(k), heads(v), cfg, mesh)
-    o = o.transpose(0, 2, 1, 3).reshape(B, T, H)
+    if _use_packed_kernel(cfg, mesh, T):
+        from deeplearning4j_tpu.ops.pallas_kernels import mha_attention_packed
+        o = mha_attention_packed(q, k, v, cfg.heads, cfg.causal, None,
+                                 jax.default_backend() != "tpu")
+    else:
+        def heads(t):  # (B,T,H) -> (B,heads,T,D)
+            return t.reshape(B, T, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        o = _attention(heads(q), heads(k), heads(v), cfg, mesh)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, H)
     x = x + o @ params["attn_out"]["kernel"].astype(o.dtype) \
         + params["attn_out"]["bias"].astype(o.dtype)
     h = _layernorm(x, params["ln2"])
