@@ -49,11 +49,14 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16       # compute dtype (params stay fp32)
     attention_impl: str = "full"    # 'full' | 'ring' | 'ulysses' (ring/ulysses need context axis)
     remat: bool = True              # jax.checkpoint each block (HBM <-> FLOPs trade)
-    # Softmax accumulation dtype for full attention. fp32 is the safe default
-    # (and what gradcheck/parity suites assume); bf16 cuts ~18 GB/step of HBM
-    # traffic on the BERT-base bench (+13% throughput) with a loss trajectory
-    # indistinguishable over 150 steps (max-subtraction keeps exp() in range;
-    # see bench.py). The step is bandwidth-bound, so bytes == time here.
+    # Softmax probability dtype, consumed by BOTH attention paths: the XLA
+    # einsum path accumulates its softmax in this dtype, and the packed VMEM
+    # Pallas kernel uses it as the probability dtype (p_dtype). fp32 is the
+    # safe default (what gradcheck/parity suites assume); bf16 halves the
+    # VPU softmax work in the kernel (5.8 -> 4.8 ms/layer fwd+bwd) and cut
+    # ~18 GB/step on the old XLA path, with a loss trajectory
+    # indistinguishable over 150 steps (max-subtraction keeps exp() in
+    # range; see bench.py).
     softmax_dtype: Any = jnp.float32
 
     @property
@@ -198,8 +201,11 @@ def _block(params, x, cfg: TransformerConfig, mesh: Optional[Mesh]):
     q, k, v = jnp.split(qkv, 3, axis=-1)
     if _use_packed_kernel(cfg, mesh, T):
         from deeplearning4j_tpu.ops.pallas_kernels import mha_attention_packed
+        # cfg.softmax_dtype doubles as the kernel's probability dtype —
+        # bf16 halves the VPU softmax work (bench config), fp32 is exact
         o = mha_attention_packed(q, k, v, cfg.heads, cfg.causal, None,
-                                 jax.default_backend() != "tpu")
+                                 jax.default_backend() != "tpu",
+                                 cfg.softmax_dtype)
     else:
         def heads(t):  # (B,T,H) -> (B,heads,T,D)
             return t.reshape(B, T, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
@@ -215,12 +221,12 @@ def _block(params, x, cfg: TransformerConfig, mesh: Optional[Mesh]):
     return x
 
 
-def _forward_raw(params, token_ids, cfg: TransformerConfig,
-                 mesh: Optional[Mesh] = None):
-    """Logits in the COMPUTE dtype (bf16) — the loss path consumes these
-    directly so the (B, T, vocab) tensor is never materialized in fp32
-    (~3 GB at BERT-base bench shapes B=48/T=512; halving it + fusing the
-    loss reduction was worth several points of MFU)."""
+def encode(params, token_ids, cfg: TransformerConfig,
+           mesh: Optional[Mesh] = None, block_fn=None):
+    """Embeddings + transformer stack + final layernorm (no lm_head).
+    ``block_fn`` overrides the per-block function — used by
+    tools/profile_flagship.py's ablations so they stay in sync with the
+    real forward by construction."""
     B, T = token_ids.shape
     # The package pins jax_default_matmul_precision="highest" so fp32 models
     # get exact fp32 GEMMs (reference semantics). This model casts operands
@@ -230,13 +236,23 @@ def _forward_raw(params, token_ids, cfg: TransformerConfig,
     with jax.default_matmul_precision("default"):
         x = params["tok_emb"][token_ids].astype(cfg.dtype) \
             + params["pos_emb"][:T][None].astype(cfg.dtype)
-        blk = functools.partial(_block, cfg=cfg, mesh=mesh)
+        blk = block_fn or functools.partial(_block, cfg=cfg, mesh=mesh)
         if cfg.remat:
             blk = jax.checkpoint(
                 blk, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
         for bp in params["blocks"]:
             x = blk(bp, x)
-        x = _layernorm(x, params["ln_f"])
+        return _layernorm(x, params["ln_f"])
+
+
+def _forward_raw(params, token_ids, cfg: TransformerConfig,
+                 mesh: Optional[Mesh] = None):
+    """Logits in the COMPUTE dtype (bf16) — the loss path consumes these
+    directly so the (B, T, vocab) tensor is never materialized in fp32
+    (~3 GB at BERT-base bench shapes B=48/T=512; halving it + fusing the
+    loss reduction was worth several points of MFU)."""
+    x = encode(params, token_ids, cfg, mesh)
+    with jax.default_matmul_precision("default"):
         return x @ params["lm_head"].astype(x.dtype)
 
 
@@ -245,20 +261,24 @@ def forward(params, token_ids, cfg: TransformerConfig, mesh: Optional[Mesh] = No
     return _forward_raw(params, token_ids, cfg, mesh).astype(jnp.float32)
 
 
-def lm_loss(params, batch, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
-    """Masked/causal LM cross-entropy. batch = {'tokens': (B,T) int32,
-    'targets': (B,T) int32, 'weights': (B,T) float} — weights zero out
-    unmasked positions (MLM) or padding.
-
-    Computed as logsumexp(logits) - logits[target] with fp32 accumulation:
-    XLA fuses the reduction, so no (B, T, vocab) log-prob tensor is ever
-    written to HBM (the log_softmax formulation materialized one in fp32)."""
-    logits = _forward_raw(params, batch["tokens"], cfg, mesh)
+def loss_from_logits(logits, batch):
+    """Weighted LM cross-entropy from compute-dtype logits, as
+    logsumexp(logits) - logits[target] with fp32 accumulation: XLA fuses the
+    reduction, so no (B, T, vocab) log-prob tensor is ever written to HBM
+    (the log_softmax formulation materialized one in fp32)."""
     lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
     tgt = jnp.take_along_axis(
         logits, batch["targets"][..., None], axis=-1)[..., 0].astype(jnp.float32)
     w = batch["weights"]
     return ((lse - tgt) * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def lm_loss(params, batch, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+    """Masked/causal LM cross-entropy. batch = {'tokens': (B,T) int32,
+    'targets': (B,T) int32, 'weights': (B,T) float} — weights zero out
+    unmasked positions (MLM) or padding."""
+    return loss_from_logits(
+        _forward_raw(params, batch["tokens"], cfg, mesh), batch)
 
 
 def batch_pspec(mesh: Mesh) -> P:

@@ -171,6 +171,25 @@ class TestMhaAttentionPacked:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5, rtol=2e-5)
 
+    def test_bf16_probability_dtype_close_to_fp32(self):
+        """p_dtype=bf16 (the bench fast path) must track the fp32 softmax
+        within bf16 resolution, fwd and bwd."""
+        q, k, v = (_rand(self.B, self.T, self.H * self.D) for _ in range(3))
+        g = _rand(self.B, self.T, self.H * self.D)
+        got = mha_attention_packed(q, k, v, self.H, False, None, True,
+                                   jnp.bfloat16)
+        want = self._ref(q, k, v, False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-2, rtol=2e-2)
+        gb = jax.grad(lambda *a: (mha_attention_packed(
+            *a, self.H, False, None, True, jnp.bfloat16) * g).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(lambda *a: (self._ref(*a, False) * g).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gb, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-2, rtol=5e-2)
+
 
 class TestSoftmaxCrossEntropy:
     def test_matches_optax(self):

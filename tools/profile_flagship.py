@@ -34,66 +34,58 @@ def _build(variant: str):
         TransformerConfig, init_params)
     from deeplearning4j_tpu.models import bert as bert_mod
 
-    # baseline == the shipped bench.py config (packed VMEM attention kernel)
+    # baseline == the shipped bench.py config (packed VMEM attention
+    # kernel, fp32 softmax default) — keep these two in lockstep so the
+    # committed artifact attributes the config the bench actually runs
     cfg = TransformerConfig(remat=False, attention_impl="flash")
-    B, T = 48, 512
+    B, T = 96, 512
     if variant == "xla_attention":
         # round-3 shipped config: XLA fused attention, bf16 softmax
         cfg = TransformerConfig(remat=False, softmax_dtype=jnp.bfloat16)
-    elif variant == "softmax_fp32":
+    elif variant == "xla_softmax_fp32":
+        # XLA attention with fp32 softmax — vs xla_attention isolates the
+        # softmax dtype on the einsum path (attention impl held constant)
         cfg = TransformerConfig(remat=False, softmax_dtype=jnp.float32)
+    elif variant == "kernel_softmax_bf16":
+        # packed kernel with bf16 probabilities — vs baseline isolates
+        # p_dtype on the kernel path (attention impl held constant)
+        cfg = TransformerConfig(remat=False, attention_impl="flash",
+                                softmax_dtype=jnp.bfloat16)
 
     key = jax.random.PRNGKey(0)
     params = init_params(key, cfg)
     tx = optax.adamw(1e-4, weight_decay=0.01)
     opt_state = tx.init(params)
 
+    def ident_block(bp, x):
+        # qkv + out-proj matmuls kept (FLOPs preserved), score matmuls +
+        # softmax removed: isolates the (T,T) attention-interior cost
+        h = bert_mod._layernorm(x, bp["ln1"])
+        qkv = h @ bp["qkv"]["kernel"].astype(h.dtype) \
+            + bp["qkv"]["bias"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        o = q + k + v
+        x = x + o @ bp["attn_out"]["kernel"].astype(o.dtype) \
+            + bp["attn_out"]["bias"].astype(o.dtype)
+        h = bert_mod._layernorm(x, bp["ln2"])
+        h = h @ bp["mlp_in"]["kernel"].astype(h.dtype) \
+            + bp["mlp_in"]["bias"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        return x + h @ bp["mlp_out"]["kernel"].astype(h.dtype) \
+            + bp["mlp_out"]["bias"].astype(h.dtype)
+
     def loss_fn(p, batch):
+        # ablations reuse bert.encode/loss_from_logits so they cannot
+        # desynchronize from the real forward/loss
         if variant == "no_losshead":
-            # stop before lm_head: mean of final hidden state
-            tokens = batch["tokens"]
-            x = p["tok_emb"][tokens].astype(cfg.dtype) \
-                + p["pos_emb"][:T][None].astype(cfg.dtype)
-            import functools
-            blk = functools.partial(bert_mod._block, cfg=cfg, mesh=None)
-            with jax.default_matmul_precision("default"):
-                for bp in p["blocks"]:
-                    x = blk(bp, x)
-                x = bert_mod._layernorm(x, p["ln_f"])
+            x = bert_mod.encode(p, batch["tokens"], cfg, None)
             return x.astype(jnp.float32).mean()
         if variant == "no_attention":
-            import functools
-
-            def ident_block(bp, x):
-                h = bert_mod._layernorm(x, bp["ln1"])
-                # qkv + out-proj matmuls kept (FLOPs preserved), score
-                # matmuls + softmax removed: isolates the (T,T) tensor cost
-                qkv = h @ bp["qkv"]["kernel"].astype(h.dtype) \
-                    + bp["qkv"]["bias"].astype(h.dtype)
-                q, k, v = jnp.split(qkv, 3, axis=-1)
-                o = q + k + v
-                x = x + o @ bp["attn_out"]["kernel"].astype(o.dtype) \
-                    + bp["attn_out"]["bias"].astype(o.dtype)
-                h = bert_mod._layernorm(x, bp["ln2"])
-                h = h @ bp["mlp_in"]["kernel"].astype(h.dtype) \
-                    + bp["mlp_in"]["bias"].astype(h.dtype)
-                h = jax.nn.gelu(h, approximate=True)
-                return x + h @ bp["mlp_out"]["kernel"].astype(h.dtype) \
-                    + bp["mlp_out"]["bias"].astype(h.dtype)
-
-            tokens = batch["tokens"]
+            x = bert_mod.encode(p, batch["tokens"], cfg, None,
+                                block_fn=ident_block)
             with jax.default_matmul_precision("default"):
-                x = p["tok_emb"][tokens].astype(cfg.dtype) \
-                    + p["pos_emb"][:T][None].astype(cfg.dtype)
-                for bp in p["blocks"]:
-                    x = ident_block(bp, x)
-                x = bert_mod._layernorm(x, p["ln_f"])
                 logits = x @ p["lm_head"].astype(x.dtype)
-            lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
-            tgt = jnp.take_along_axis(
-                logits, batch["targets"][..., None], axis=-1)[..., 0].astype(jnp.float32)
-            w = batch["weights"]
-            return ((lse - tgt) * w).sum() / jnp.maximum(w.sum(), 1.0)
+            return bert_mod.loss_from_logits(logits, batch)
         return bert_mod.lm_loss(p, batch, cfg, None)
 
     if variant == "fwd_only":
@@ -137,11 +129,16 @@ def _time_variant(variant: str, steps: int, warmup: int = 3):
     for _ in range(warmup):
         params, opt_state, loss = jstep(params, opt_state, batch)
     float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = jstep(params, opt_state, batch)
-    float(loss)
-    dt = (time.perf_counter() - t0) / steps
+    # median of 3 windows, mirroring bench.py: the axon tunnel adds ±3%
+    # per-window noise that would otherwise masquerade as variant deltas
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = jstep(params, opt_state, batch)
+        float(loss)
+        dts.append((time.perf_counter() - t0) / steps)
+    dt = sorted(dts)[1]
     return {
         "variant": variant,
         "step_ms": round(dt * 1e3, 2),
@@ -157,7 +154,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--out", default=None)
-    ap.add_argument("--variants", default="baseline,xla_attention,fwd_only,no_losshead,no_attention,no_adamw,softmax_fp32")
+    ap.add_argument("--variants", default="baseline,xla_attention,fwd_only,no_losshead,no_attention,no_adamw,xla_softmax_fp32,kernel_softmax_bf16")
     args = ap.parse_args()
 
     results = []
