@@ -170,7 +170,21 @@ class TrainingConfig:
     dataSetLabelMapping: List[str] = field(default_factory=list)
     regularization: List[_rega.Regularization] = field(default_factory=list)
     minimize: bool = True
-    computeDtype: Optional[str] = None  # None | "HALF"/"BFLOAT16" | "FLOAT"
+    # "BFLOAT16" is the canonical value. "HALF" is accepted as a dl4j-config
+    # compatibility alias but ALSO maps to bfloat16 (the reference's
+    # DataType.HALF means IEEE float16, which the MXU does not natively
+    # train in) — a warning flags the numerics difference at the boundary.
+    computeDtype: Optional[str] = None  # None | "BFLOAT16"/"HALF" | "FLOAT"
+
+    def __post_init__(self):
+        if (self.computeDtype or "").upper() == "HALF":
+            import warnings
+            warnings.warn(
+                "TrainingConfig.computeDtype='HALF' maps to bfloat16 on "
+                "TPU (the reference's HALF is IEEE float16; bf16 shares "
+                "fp32's exponent range, so checkpoints/losses will differ "
+                "from a CUDA fp16 run in the tails). Use 'BFLOAT16' to "
+                "state the TPU dtype explicitly.", stacklevel=3)
 
 
 class GraphNamespace:

@@ -216,7 +216,8 @@ class ComputationGraph:
             (params, state, opt_state), losses = jax.lax.scan(
                 body, (params, state, opt_state),
                 (inputs_stacked, labels_stacked, rngs))
-            return params, state, opt_state, losses[-1]
+            # full per-step losses: fit() replays them to listeners
+            return params, state, opt_state, losses
 
         return jax.jit(multi, donate_argnums=(0, 1, 2))
 
@@ -261,7 +262,8 @@ class ComputationGraph:
             data = [data]
         stats = self._stats_requested()
         step = self._get_jitted("step_stats" if stats else "step")
-        fuse_k = 0 if (stats or self.listeners) else self.fuseSteps
+        # listeners no longer disable fusing — see MultiLayerNetwork._fit_impl
+        fuse_k = 0 if stats else self.fuseSteps
         buf: list = []  # (features tuple, labels tuple) host batches
 
         def run_single(mds):
@@ -300,10 +302,23 @@ class ComputationGraph:
             for lst in self.listeners:
                 lst.iterationDone(self, self._iteration, self._epoch)
 
+        def drain(buf):
+            for item in buf:  # singles reuse the already-compiled step
+                run_single(item[2])
+            return []
+
         def flush(buf):
-            from deeplearning4j_tpu.nn.multilayer import _stack_batches
-            while len(buf) >= fuse_k > 1:
-                chunk, buf = buf[:fuse_k], buf[fuse_k:]
+            from deeplearning4j_tpu.nn.multilayer import (
+                _chain_split, _chunk_limit, _replay_chunk, _stack_batches)
+            while buf:
+                k = _chunk_limit(self.listeners, self._iteration, fuse_k)
+                if k <= 1:
+                    run_single(buf[0][2])
+                    buf = buf[1:]
+                    continue
+                if len(buf) < k:
+                    break
+                chunk, buf = buf[:k], buf[k:]
 
                 def build():
                     return ({name: _stack_batches([c[0][i] for c in chunk])
@@ -317,13 +332,13 @@ class ComputationGraph:
                     inputs, ys = self._dev_cache.get_or_put(raws, build)
                 else:
                     inputs, ys = build()
-                self._rng_key, sub = jax.random.split(self._rng_key)
-                rngs = jax.random.split(sub, fuse_k)
+                # RNG stream identical to k single steps
+                self._rng_key, rngs = _chain_split(self._rng_key, k)
                 multi = self._get_jitted("multi")
                 (self._params, self._state, self._opt_state,
-                 self._score) = multi(self._params, self._state,
-                                      self._opt_state, inputs, ys, rngs)
-                self._iteration += fuse_k
+                 losses) = multi(self._params, self._state,
+                                 self._opt_state, inputs, ys, rngs)
+                _replay_chunk(self, losses, k)
             return buf
 
         def _sig(mds):
@@ -338,19 +353,20 @@ class ComputationGraph:
                     and not any(m is not None for m in (mds.labels_masks or []))
                 if fuse_k > 1 and maskfree:
                     if buf and _sig(buf[0][2]) != _sig(mds):
-                        for item in buf:  # shape change: drain as singles
-                            run_single(item[2])
-                        buf = []
+                        buf = drain(buf)  # shape change: drain as singles
                     buf.append((mds.features, mds.labels, mds))
                     buf = flush(buf)
                 else:
+                    # masked batch: buffered earlier steps apply FIRST
+                    # (sequential SGD order, round-3 advisor)
+                    buf = drain(buf)
                     run_single(mds)
+            # epoch boundary: apply leftovers before onEpochEnd
+            buf = drain(buf)
             self._epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "onEpochEnd"):
                     lst.onEpochEnd(self)
-        for item in buf:  # leftover (< fuseSteps) steps run individually
-            run_single(item[2])
         return self
 
     # ------------------------------------------------------------- inference
@@ -429,6 +445,12 @@ class ComputationGraph:
 
     def addListeners(self, *listeners):
         self.listeners.extend(listeners)
+        return self
+
+    def setHostTransferCache(self, enabled: bool):
+        """Toggle the host->device minibatch transfer cache (on by default;
+        mutation-safe — see _DeviceCache)."""
+        self._dev_cache.enabled = enabled
         return self
 
     def getIterationCount(self) -> int:

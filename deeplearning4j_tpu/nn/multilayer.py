@@ -98,25 +98,109 @@ class _DeviceCache:
     iterators over in-memory data) hit this cache and transfer once — the
     TPU answer to the reference's workspace-pinned device buffers
     (ref: MemoryWorkspace / AsyncDataSetIterator prefetch-to-GPU).
-    Keys hold strong references to the host arrays so ids cannot be
-    recycled; entries are dropped FIFO past ``cap``. In-place mutation of a
-    cached host array is NOT observed (same contract as dl4j's pinned
-    workspace buffers)."""
 
-    def __init__(self, cap: int = 64):
-        self.cap = cap
+    Safety/limits (round-4 advisor findings):
+    - **In-place mutation IS observed**: every hit verifies the current
+      host bytes against a snapshot taken at insert (np.array_equal — host
+      memcmp is 100-1000x cheaper than a tunnel re-transfer) and rebuilds
+      on mismatch, so pipelines that refill a preallocated batch buffer
+      train on the fresh data.
+    - **Byte-bounded**, not entry-bounded: entries evict FIFO once the
+      summed host-array bytes (a proxy for the pinned device copies)
+      exceed ``max_bytes``.
+    - **Streaming detection**: after ``_STREAM_MISSES`` consecutive
+      misses the cache stops inserting (it would only pin HBM for batches
+      that never repeat); a hit re-arms it.
+    Disable entirely with ``enabled = False`` (networks expose
+    ``setHostTransferCache``)."""
+
+    _STREAM_MISSES = 16
+
+    def __init__(self, max_bytes: int = 2 << 30):
+        self.max_bytes = max_bytes
+        self.enabled = True
         self._d: dict = {}
+        self._bytes = 0
+        self._consec_misses = 0
+
+    def _evict_to_fit(self):
+        while self._bytes > self.max_bytes and self._d:
+            _, snaps = self._d.pop(next(iter(self._d)))  # FIFO (insert order)
+            self._bytes -= sum(s.nbytes for s in snaps)
 
     def get_or_put(self, raws, build):
+        if not self.enabled:
+            return build()
         key = tuple(id(r) for r in raws)
         hit = self._d.get(key)
         if hit is not None:
-            return hit[0]
+            value, snaps = hit
+            if all(np.array_equal(r, s) for r, s in zip(raws, snaps)):
+                self._consec_misses = 0
+                return value
+            # host buffer was mutated in place: rebuild and re-snapshot
+            # (still a key hit — re-arm streaming detection)
+            self._consec_misses = 0
+            value = build()
+            self._bytes -= sum(s.nbytes for s in snaps)
+            new_snaps = [np.array(r, copy=True) for r in raws]
+            self._bytes += sum(s.nbytes for s in new_snaps)
+            self._d[key] = (value, new_snaps)
+            self._evict_to_fit()
+            return value
         value = build()
-        if len(self._d) >= self.cap:
-            self._d.pop(next(iter(self._d)))
-        self._d[key] = (value, list(raws))  # refs pin the ids
+        self._consec_misses += 1
+        if self._consec_misses > self._STREAM_MISSES:
+            return value  # streaming workload: don't pin HBM for one-shots
+        snaps = [np.array(r, copy=True) for r in raws]
+        self._bytes += sum(s.nbytes for s in snaps)
+        self._d[key] = (value, snaps)
+        self._evict_to_fit()
         return value
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnums=1)
+def _chain_split(key, k: int):
+    """k sequential ``key, sub = jax.random.split(key)`` draws in ONE
+    dispatch (lax.scan). Returns (advanced_key, (k, ...) stacked subs) with
+    values IDENTICAL to the per-step loop — so the fused multi-step path
+    consumes the RNG stream exactly like the single-step path and the same
+    seed yields the same trajectory regardless of fusing (round-3 advisor
+    finding)."""
+
+    def body(c, _):
+        ks = jax.random.split(c)
+        return ks[0], ks[1]
+
+    return jax.lax.scan(body, key, None, length=k)
+
+
+def _chunk_limit(listeners, iteration: int, fuse_k: int) -> int:
+    """Steps the fused fit may scan from ``iteration`` before some listener
+    needs the live model (1 = no fusing right now). Shared by
+    MultiLayerNetwork and ComputationGraph."""
+    k = fuse_k
+    for lst in listeners:
+        req = getattr(lst, "requiresModelAtIteration", lambda it: True)
+        for j in range(1, k + 1):
+            if req(iteration + j):
+                k = j
+                break
+    return k
+
+
+def _replay_chunk(net, losses, k: int):
+    """Replay k buffered per-step losses to listeners after a fused chunk —
+    the same callback sequence the per-step path fires, with the model
+    synced at chunk end (= every requiresModelAtIteration boundary)."""
+    for j in range(k):
+        net._score = losses[j]
+        net._iteration += 1
+        for lst in net.listeners:
+            lst.iterationDone(net, net._iteration, net._epoch)
 
 
 def _zero_frozen(tree_list, frozen):
@@ -301,7 +385,9 @@ class MultiLayerNetwork:
         def multi(params, state, opt_state, xs, ys, rngs):
             (params, state, opt_state), losses = jax.lax.scan(
                 body, (params, state, opt_state), (xs, ys, rngs))
-            return params, state, opt_state, losses[-1]
+            # full per-step losses: fit() replays them to listeners after
+            # the chunk (one host sync per chunk at most, not per step)
+            return params, state, opt_state, losses
 
         return jax.jit(multi, donate_argnums=(0, 1, 2))
 
@@ -517,11 +603,17 @@ class MultiLayerNetwork:
         stats = self._stats_requested()
         kind = "step_stats" if stats else "step"
         step = None if tbptt else self._get_jitted(kind)
-        # De-dispatch path: without listeners/stats/tBPTT there is no per-step
-        # host interaction, so steps buffer into fuseSteps-sized lax.scan
-        # chunks (one dispatch each) — epoch boundaries included.
-        fuse_k = 0 if (tbptt or stats or self.listeners) else self.fuseSteps
+        # De-dispatch path: steps buffer into fuseSteps-sized lax.scan
+        # chunks (one dispatch each). Listeners no longer disable it
+        # (round-3 verdict #3): chunks are cut so the scan flushes exactly
+        # at iterations where a listener needs the LIVE model
+        # (requiresModelAtIteration — e.g. CheckpointListener save points),
+        # and the buffered per-step losses are replayed to listeners after
+        # each chunk. Only stats-requesting listeners and tBPTT force the
+        # true per-step path.
+        fuse_k = 0 if (tbptt or stats) else self.fuseSteps
         buf: list = []  # (features, labels) pairs of identical shape
+
 
         def run_single(ds):
             nonlocal step
@@ -548,9 +640,24 @@ class MultiLayerNetwork:
             for lst in self.listeners:
                 lst.iterationDone(self, self._iteration, self._epoch)
 
+        def drain(buf):
+            for f, y in buf:  # singles reuse the already-compiled step
+                run_single(DataSet(f, y))
+            return []
+
         def flush(buf):
-            while len(buf) >= fuse_k > 1:
-                chunk, buf = buf[:fuse_k], buf[fuse_k:]
+            while buf:
+                k = _chunk_limit(self.listeners, self._iteration, fuse_k)
+                if k <= 1:
+                    # a listener needs the live model at the very next
+                    # iteration: run it as a single (exact semantics)
+                    f, y = buf[0]
+                    run_single(DataSet(f, y))
+                    buf = buf[1:]
+                    continue
+                if len(buf) < k:
+                    break
+                chunk, buf = buf[:k], buf[k:]
                 raws = [_unwrap(f) for f, _ in chunk] + \
                        [_unwrap(y) for _, y in chunk]
                 if all(isinstance(r, np.ndarray) for r in raws):
@@ -560,13 +667,13 @@ class MultiLayerNetwork:
                 else:
                     xs = _stack_batches([f for f, _ in chunk])
                     ys = _stack_batches([y for _, y in chunk])
-                self._rng_key, sub = jax.random.split(self._rng_key)
-                rngs = jax.random.split(sub, fuse_k)
+                # RNG stream identical to k single steps (_chain_split)
+                self._rng_key, rngs = _chain_split(self._rng_key, k)
                 multi = self._get_jitted("multi")
                 (self._params, self._state, self._opt_state,
-                 self._score) = multi(self._params, self._state,
-                                      self._opt_state, xs, ys, rngs)
-                self._iteration += fuse_k
+                 losses) = multi(self._params, self._state,
+                                 self._opt_state, xs, ys, rngs)
+                _replay_chunk(self, losses, k)
             return buf
 
         for _ in range(epochs):
@@ -578,19 +685,21 @@ class MultiLayerNetwork:
                         and ds.labels_mask is None:
                     if buf and (np.shape(buf[0][0]) != np.shape(ds.features)
                                 or np.shape(buf[0][1]) != np.shape(ds.labels)):
-                        for f, y in buf:  # shape change: drain as singles
-                            run_single(DataSet(f, y))
-                        buf = []
+                        buf = drain(buf)  # shape change: drain as singles
                     buf.append((ds.features, ds.labels))
                     buf = flush(buf)
                 else:
+                    # masked/ineligible batch: buffered earlier steps must
+                    # apply FIRST (sequential SGD order, round-3 advisor)
+                    buf = drain(buf)
                     run_single(ds)
+            # epoch boundary: apply leftovers so epoch listeners see a
+            # fully-stepped model, then fire onEpochEnd
+            buf = drain(buf)
             self._epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "onEpochEnd"):
                     lst.onEpochEnd(self)
-        for f, y in buf:  # leftover (< fuseSteps) steps run individually
-            run_single(DataSet(f, y))
         return self
 
     # ------------------------------------------------------------- inference
@@ -684,6 +793,13 @@ class MultiLayerNetwork:
 
     def addListeners(self, *listeners):
         self.listeners.extend(listeners)
+        return self
+
+    def setHostTransferCache(self, enabled: bool):
+        """Toggle the host->device minibatch transfer cache (on by default;
+        mutation-safe — see _DeviceCache). Off = every fit() batch is
+        re-transferred."""
+        self._dev_cache.enabled = enabled
         return self
 
     def getIterationCount(self) -> int:

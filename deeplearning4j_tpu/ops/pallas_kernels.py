@@ -227,7 +227,7 @@ def _mha_packed_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dp = jax.lax.dot_general(doh, vh, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         delta = jnp.sum(p.astype(jnp.float32) * dp, axis=-1, keepdims=True)
-        if p_dtype == jnp.float32:
+        if jnp.dtype(p_dtype) == jnp.dtype(jnp.float32):  # normalize spellings
             ds = (p * (dp - delta)).astype(q.dtype)
         else:
             ds = pb * (dp - delta).astype(q.dtype)

@@ -28,6 +28,17 @@ class TrainingListener:
     def onEpochEnd(self, model):
         pass
 
+    def requiresModelAtIteration(self, iteration: int) -> bool:
+        """Whether iterationDone at ``iteration`` reads anything beyond
+        ``model.score()`` / wall-clock (parameters, activations, saving the
+        model, ...). The fused fit path packs fuseSteps optimizer steps into
+        one lax.scan executable and replays the buffered per-step losses to
+        listeners afterwards; it flushes the scan so the model is CURRENT
+        exactly at iterations where this returns True. The conservative
+        default (True for every iteration) keeps unknown listeners on the
+        exact per-step path; score-only built-ins override to False."""
+        return True
+
 
 class ScoreIterationListener(TrainingListener):
     """Log score every N iterations (ref: ScoreIterationListener)."""
@@ -39,6 +50,9 @@ class ScoreIterationListener(TrainingListener):
         if iteration % self.n == 0:
             log.info("Score at iteration %d is %s", iteration, model.score())
             print(f"Score at iteration {iteration} is {model.score()}")
+
+    def requiresModelAtIteration(self, iteration: int) -> bool:
+        return False  # reads only score() — fuse freely
 
 
 class PerformanceListener(TrainingListener):
@@ -64,6 +78,12 @@ class PerformanceListener(TrainingListener):
         elif self._last_t is None:
             self._last_t, self._last_iter = now, iteration
 
+    def requiresModelAtIteration(self, iteration: int) -> bool:
+        # flush the fused scan exactly at measurement iterations so the
+        # wall-clock intervals it reports are real step time, not the
+        # ~0-us replay artifacts of callbacks fired back-to-back mid-chunk
+        return iteration % self.frequency == 0
+
 
 class CollectScoresListener(TrainingListener):
     """Accumulate (iteration, score) pairs (ref: CollectScoresListener)."""
@@ -77,6 +97,9 @@ class CollectScoresListener(TrainingListener):
         if iteration % self.frequency == 0:
             self.iterations.append(iteration)
             self.scores.append(model.score())
+
+    def requiresModelAtIteration(self, iteration: int) -> bool:
+        return False  # reads only score() — fuse freely
 
 
 class TimeIterationListener(TrainingListener):
@@ -92,6 +115,12 @@ class TimeIterationListener(TrainingListener):
             remaining = elapsed / iteration * (self.total - iteration)
             log.info("Remaining time estimate: %.1fs (%d/%d)", remaining,
                      iteration, self.total)
+
+    def requiresModelAtIteration(self, iteration: int) -> bool:
+        # cumulative ETA only (elapsed/iteration extrapolation): replaying
+        # callbacks after a chunk shifts each estimate by at most one chunk
+        # of wall-clock, it does not corrupt the cumulative math — fuse
+        return False
 
 
 class EvaluativeListener(TrainingListener):
@@ -113,6 +142,10 @@ class EvaluativeListener(TrainingListener):
     def iterationDone(self, model, iteration, epoch):
         if self.unit == "iteration" and iteration % self.frequency == 0:
             self._evaluate(model)
+
+    def requiresModelAtIteration(self, iteration: int) -> bool:
+        # needs live params exactly at its evaluation iterations
+        return self.unit == "iteration" and iteration % self.frequency == 0
 
     def onEpochEnd(self, model, *_):
         if self.unit == "epoch" and model.getEpochCount() % self.frequency == 0:
@@ -177,6 +210,11 @@ class CheckpointListener(TrainingListener):
     def iterationDone(self, model, iteration, epoch):
         if self.everyNIterations and iteration % self.everyNIterations == 0:
             self._save(model)
+
+    def requiresModelAtIteration(self, iteration: int) -> bool:
+        # needs live params exactly at its save iterations
+        return bool(self.everyNIterations) \
+            and iteration % self.everyNIterations == 0
 
     def onEpochEnd(self, model, *_):
         if self.everyNEpochs and model.getEpochCount() % self.everyNEpochs == 0:
