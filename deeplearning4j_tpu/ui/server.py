@@ -34,16 +34,39 @@ from deeplearning4j_tpu.ui.stats import StatsListener  # noqa: F401 (re-export c
 from deeplearning4j_tpu.ui.palette import PALETTE
 from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
 
-_PAGE = """<!DOCTYPE html>
-<html><head><meta charset="utf-8"><title>deeplearning4j_tpu — training</title>
-<style>
+_STYLE = """<style>
  body { font-family: system-ui, sans-serif; margin: 24px; color: #222; }
  h1 { font-size: 20px; } h2 { font-size: 14px; margin: 0 0 4px; }
  .meta { color: #666; font-size: 13px; margin-bottom: 14px; }
  .grid { display: flex; flex-wrap: wrap; gap: 18px; }
  .panel { border: 1px solid #ddd; border-radius: 6px; padding: 10px; }
  select { margin-bottom: 12px; }
-</style></head><body>
+ nav { margin-bottom: 16px; font-size: 14px; }
+ nav a { margin-right: 14px; color: #06c; text-decoration: none; }
+ nav a.here { color: #222; font-weight: 600; }
+ .node { border: 1px solid #bbb; border-radius: 4px; padding: 6px 10px;
+         margin: 4px 0; cursor: pointer; font-size: 13px; background: #fafafa; }
+ .node.sel { border-color: #06c; background: #eef5ff; }
+ .node .k { color: #888; font-size: 11px; }
+ .arrow { text-align: center; color: #999; font-size: 11px; }
+ table.kv { border-collapse: collapse; font-size: 13px; }
+ table.kv td { border: 1px solid #ddd; padding: 4px 10px; }
+</style>"""
+
+_NAV = """<nav><a href="/" class="%(ov)s">Overview</a>
+<a href="/model" class="%(mo)s">Model</a>
+<a href="/system" class="%(sy)s">System</a></nav>"""
+
+
+def _nav(which: str) -> str:
+    return _NAV % {k: ("here" if k == which else "")
+                   for k in ("ov", "mo", "sy")}
+
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu — training</title>
+__STYLE__</head><body>
+__NAV__
 <h1>Training overview</h1>
 <div class="meta" id="meta">waiting for sessions…</div>
 <select id="session"></select>
@@ -54,7 +77,31 @@ _PAGE = """<!DOCTYPE html>
  <div class="panel"><h2>Iteration time (ms)</h2><canvas id="dur" width="440" height="170"></canvas></div>
 </div>
 <script>
-let cur = null, reports = [], nextFrom = 0;
+__COMMON__
+function render(fresh) {
+  document.getElementById('meta').textContent =
+    `${cur} · ${curInfo.modelClass || '?'} · ${curInfo.numParams ?? '?'} params · ` +
+    `${curInfo.backend || '?'} · ${reports.length} reports`;
+  if (!fresh) return;
+  const it = r => r.iteration;
+  drawLines('score', {score: reports.map(r => [it(r), r.score])});
+  drawLines('lr', {lr: reports.filter(r => r.learningRate != null).map(r => [it(r), r.learningRate])});
+  drawLines('dur', {ms: reports.filter(r => r.durationMs != null).map(r => [it(r), r.durationMs])});
+  const names = new Set();
+  for (const r of reports) for (const n of Object.keys(r.updateRatios || {})) names.add(n);
+  const ratio = {};
+  for (const n of Array.from(names).sort().slice(0, 8))
+    ratio[n] = reports.filter(r => (r.updateRatios || {})[n] > 0)
+                      .map(r => [it(r), Math.log10(r.updateRatios[n])]);
+  drawLines('ratio', ratio);
+}
+</script></body></html>"""
+
+
+# Shared JS for all tabs: line/bar chart renderers plus the session poller;
+# each page provides a render(fresh) callback over (cur, curInfo, reports).
+_COMMON_JS = """
+let cur = null, reports = [], nextFrom = 0, curInfo = {};
 const COLORS = __PALETTE__;
 function drawLines(id, seriesMap) {
   const cv = document.getElementById(id), ctx = cv.getContext('2d');
@@ -84,18 +131,21 @@ function drawLines(id, seriesMap) {
     ctx.stroke();
   }
 }
-function redraw() {
-  const it = r => r.iteration;
-  drawLines('score', {score: reports.map(r => [it(r), r.score])});
-  drawLines('lr', {lr: reports.filter(r => r.learningRate != null).map(r => [it(r), r.learningRate])});
-  drawLines('dur', {ms: reports.filter(r => r.durationMs != null).map(r => [it(r), r.durationMs])});
-  const names = new Set();
-  for (const r of reports) for (const n of Object.keys(r.updateRatios || {})) names.add(n);
-  const ratio = {};
-  for (const n of Array.from(names).sort().slice(0, 8))
-    ratio[n] = reports.filter(r => (r.updateRatios || {})[n] > 0)
-                      .map(r => [it(r), Math.log10(r.updateRatios[n])]);
-  drawLines('ratio', ratio);
+function drawBars(id, hist) {
+  const cv = document.getElementById(id), ctx = cv.getContext('2d');
+  ctx.clearRect(0, 0, cv.width, cv.height);
+  if (!hist || !hist.counts || !hist.counts.length) return;
+  const pad = 30, W = cv.width, H = cv.height;
+  const mx = Math.max(...hist.counts, 1), n = hist.counts.length;
+  ctx.fillStyle = COLORS[0];
+  for (let i = 0; i < n; i++) {
+    const h = hist.counts[i] / mx * (H - 2 * pad);
+    const bw = (W - 2 * pad) / n;
+    ctx.fillRect(pad + i * bw, H - pad - h, Math.max(bw - 1, 1), h);
+  }
+  ctx.font = '10px sans-serif'; ctx.fillStyle = '#555';
+  ctx.fillText(hist.min.toPrecision(3), pad, H - 8);
+  ctx.fillText(hist.max.toPrecision(3), W - pad - 34, H - 8);
 }
 async function poll() {
   try {
@@ -104,10 +154,10 @@ async function poll() {
     const ids = sessions.map(s => s.sessionId);
     const have = Array.from(sel.options).map(o => o.value);
     if (ids.length !== have.length || ids.some((id, i) => id !== have[i])) {
-      const keep = sel.value;          // don't yank the user's selection
+      const keep = sel.value;
       sel.replaceChildren(...ids.map(id => {
         const o = document.createElement('option');
-        o.textContent = id;            // textContent: sessionId is untrusted
+        o.textContent = id;
         return o;
       }));
       if (ids.includes(keep)) sel.value = keep;
@@ -116,18 +166,230 @@ async function poll() {
     const sid = sel.value || sessions[0].sessionId;
     const s = sessions.find(x => x.sessionId === sid) || sessions[0];
     if (cur !== sid) { cur = sid; reports = []; nextFrom = 0; }
+    curInfo = s.info || {};
     const worker = s.workers[0];
-    const info = s.info || {};
-    document.getElementById('meta').textContent =
-      `${sid} · ${info.modelClass || '?'} · ${info.numParams ?? '?'} params · ` +
-      `${info.backend || '?'} · ${reports.length} reports`;
     const fresh = await (await fetch(
       `api/updates/${sid}/${worker}?from=${nextFrom}`)).json();
-    if (fresh.length) { reports = reports.concat(fresh); nextFrom += fresh.length; redraw(); }
+    if (fresh.length) { reports = reports.concat(fresh); nextFrom += fresh.length; }
+    render(fresh.length > 0);
   } catch (e) { /* server restarting — keep polling */ }
 }
 setInterval(poll, 1000); poll();
-</script></body></html>""".replace("__PALETTE__", json.dumps(PALETTE))
+""".replace("__PALETTE__", json.dumps(PALETTE))
+
+_PAGE = _PAGE.replace("__COMMON__", _COMMON_JS) \
+    .replace("__STYLE__", _STYLE).replace("__NAV__", _nav("ov"))
+
+
+_MODEL_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu — model</title>
+__STYLE__</head><body>
+__NAV__
+<h1>Model graph</h1>
+<div class="meta" id="meta">waiting for sessions…</div>
+<select id="session"></select>
+<div style="display:flex; gap:24px; align-items:flex-start">
+ <div id="graph" style="min-width:230px"></div>
+ <div class="grid" id="layerPanels" style="display:none; flex-wrap:wrap">
+  <div class="panel"><h2>Param mean magnitude</h2><canvas id="pmm" width="420" height="160"></canvas></div>
+  <div class="panel"><h2>Gradient mean magnitude</h2><canvas id="gmm" width="420" height="160"></canvas></div>
+  <div class="panel"><h2>Update:param ratio (log10)</h2><canvas id="upr" width="420" height="160"></canvas></div>
+  <div class="panel"><h2>Param histogram (latest)</h2><canvas id="phist" width="420" height="160"></canvas></div>
+ </div>
+</div>
+<script>
+__COMMON__
+let selNode = null, builtFor = null;
+function layerSeries(prefix, field) {
+  // stats keys are '<nodeId>/<leaf>' — join per-leaf series for this node
+  const out = {};
+  for (const r of reports) {
+    for (const [k, st] of Object.entries(r[field] || {})) {
+      if (k.split('/')[0] !== prefix) continue;
+      (out[k] = out[k] || []).push([r.iteration, st.meanMagnitude]);
+    }
+  }
+  return out;
+}
+function ratioSeries(prefix) {
+  const out = {};
+  for (const r of reports) {
+    for (const [k, v] of Object.entries(r.updateRatios || {})) {
+      if (k.split('/')[0] !== prefix || !(v > 0)) continue;
+      (out[k] = out[k] || []).push([r.iteration, Math.log10(v)]);
+    }
+  }
+  return out;
+}
+function latestHist(prefix) {
+  for (let i = reports.length - 1; i >= 0; i--) {
+    for (const [k, h] of Object.entries(reports[i].parameterHistograms || {}))
+      if (k.split('/')[0] === prefix) return h;
+  }
+  return null;
+}
+function buildGraph(topo) {
+  const g = document.getElementById('graph');
+  g.replaceChildren();
+  if (!topo) { g.textContent = 'no topology for this model type'; return; }
+  const byId = {};
+  topo.nodes.forEach(n => byId[n.id] = n);
+  topo.nodes.forEach((n, i) => {
+    const ins = topo.edges.filter(e => e[1] === n.id).map(e => e[0]);
+    if (i > 0) {
+      // draw the chain arrow only for a REAL edge from the node above;
+      // branching graphs get a plain gap + the explicit fan-in list below
+      const a = document.createElement('div');
+      a.className = 'arrow';
+      a.textContent = ins.includes(topo.nodes[i - 1].id) ? '\\u2193' : '\\u00b7';
+      g.appendChild(a);
+    }
+    const d = document.createElement('div');
+    d.className = 'node'; d.dataset.id = n.id;
+    const t = document.createElement('div'); t.textContent = n.label +
+      (n.nOut ? ` (nOut=${n.nOut})` : '');
+    const k = document.createElement('div'); k.className = 'k';
+    k.textContent = n.id + (ins.length ? ' \\u2190 ' + ins.join(', ') : '');
+    d.appendChild(t); d.appendChild(k);
+    d.onclick = () => { selNode = n.id; render(true); };
+    g.appendChild(d);
+  });
+}
+function render(fresh) {
+  document.getElementById('meta').textContent =
+    `${cur} · ${curInfo.modelClass || '?'} · ${curInfo.numParams ?? '?'} params`;
+  if (builtFor !== cur) { builtFor = cur; selNode = null; buildGraph(curInfo.topology); }
+  document.querySelectorAll('.node').forEach(d =>
+    d.classList.toggle('sel', d.dataset.id === selNode));
+  const panels = document.getElementById('layerPanels');
+  panels.style.display = selNode == null ? 'none' : 'flex';
+  if (selNode == null || !fresh) return;
+  drawLines('pmm', layerSeries(selNode, 'parameterStats'));
+  drawLines('gmm', layerSeries(selNode, 'gradientStats'));
+  drawLines('upr', ratioSeries(selNode));
+  drawBars('phist', latestHist(selNode));
+}
+</script></body></html>""".replace("__COMMON__", _COMMON_JS) \
+    .replace("__STYLE__", _STYLE).replace("__NAV__", _nav("mo"))
+
+
+_SYSTEM_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu — system</title>
+__STYLE__</head><body>
+__NAV__
+<h1>System</h1>
+<div class="meta" id="meta">waiting for sessions…</div>
+<select id="session"></select>
+<table class="kv" id="static"></table><br>
+<div class="grid">
+ <div class="panel"><h2>Host memory RSS (MB)</h2><canvas id="rss" width="440" height="170"></canvas></div>
+ <div class="panel"><h2>Device memory in use (MB)</h2><canvas id="dev" width="440" height="170"></canvas></div>
+ <div class="panel"><h2>Iteration time (ms)</h2><canvas id="dur" width="440" height="170"></canvas></div>
+ <div class="panel"><h2>Minibatches / second</h2><canvas id="mbs" width="440" height="170"></canvas></div>
+</div>
+<script>
+__COMMON__
+async function liveRow() {
+  try { return await (await fetch('api/system-now')).json(); }
+  catch (e) { return null; }
+}
+function series(field) {
+  return reports.filter(r => r[field] != null).map(r => [r.iteration, r[field]]);
+}
+let lastLive = null;
+async function render(fresh) {
+  document.getElementById('meta').textContent =
+    `${cur} · ${curInfo.modelClass || '?'} · ${reports.length} reports`;
+  const live = await liveRow();
+  const rows = [
+    ['backend', curInfo.backend], ['device count', curInfo.deviceCount],
+    ['model', curInfo.modelClass], ['parameters', curInfo.numParams],
+  ];
+  if (live) {
+    rows.push(['host RSS now (MB)', live.hostRssMb &&
+               live.hostRssMb.toFixed(1)]);
+    (live.devices || []).forEach((d, i) => rows.push(
+      [`device ${i} (${d.kind})`, d.bytesInUse == null ? 'n/a' :
+       `${(d.bytesInUse / 1e6).toFixed(1)} MB` +
+       (d.bytesLimit ? ` / ${(d.bytesLimit / 1e6).toFixed(0)} MB` : '')]));
+  }
+  const tbl = document.getElementById('static');
+  tbl.replaceChildren(...rows.map(([k, v]) => {
+    const tr = document.createElement('tr');
+    const td1 = document.createElement('td'); td1.textContent = k;
+    const td2 = document.createElement('td'); td2.textContent = v ?? '?';
+    tr.appendChild(td1); tr.appendChild(td2);
+    return tr;
+  }));
+  if (!fresh) return;
+  drawLines('rss', {rss: series('memoryRssMb')});
+  drawLines('dev', {dev: series('deviceMemMb')});
+  drawLines('dur', {ms: series('durationMs')});
+  drawLines('mbs', {mbs: series('minibatchesPerSecond')});
+}
+</script></body></html>""".replace("__COMMON__", _COMMON_JS) \
+    .replace("__STYLE__", _STYLE).replace("__NAV__", _nav("sy"))
+
+
+def _host_rss_mb() -> dict:
+    """Current and peak host RSS. getrusage only exposes the lifetime PEAK
+    (ru_maxrss); current usage comes from /proc/self/statm so the system tab
+    can show memory actually going down after a spike."""
+    import resource
+
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    cur = None
+    try:
+        with open("/proc/self/statm") as f:
+            cur = int(f.read().split()[1]) * (resource.getpagesize() / 1e6)
+    except (OSError, ValueError, IndexError):
+        pass  # non-Linux: only the peak is available
+    return {"hostRssMb": cur if cur is not None else peak,
+            "hostPeakRssMb": peak}
+
+
+def _jax_initialized() -> bool:
+    """True only if a JAX backend already exists in THIS process. The UI
+    server may run standalone (remote-router deployment); calling
+    jax.local_devices() there would force-initialize XLA — grabbing the TPU
+    lock / preallocating GPU memory out from under the actual trainer."""
+    import sys
+
+    jx = sys.modules.get("jax")
+    if jx is None:
+        return False
+    try:
+        from jax._src import xla_bridge
+        return bool(xla_bridge._backends) \
+            or xla_bridge._default_backend is not None
+    except Exception:
+        return False
+
+
+def _system_now() -> dict:
+    """Live host + device memory snapshot (system tab; ref: the train UI's
+    system page showing JVM/off-heap/GPU memory)."""
+    out = dict(_host_rss_mb())
+    out["devices"] = []
+    if not _jax_initialized():
+        return out
+    import jax
+
+    try:
+        for d in jax.local_devices():
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                pass
+            out["devices"].append({
+                "kind": getattr(d, "device_kind", str(d)),
+                "bytesInUse": stats.get("bytes_in_use"),
+                "bytesLimit": stats.get("bytes_limit"),
+            })
+    except Exception:
+        pass
+    return out
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -147,16 +409,28 @@ class _Handler(BaseHTTPRequestHandler):
     def _storages(self) -> List[StatsStorage]:
         return self.server.ui._storages  # type: ignore[attr-defined]
 
+    def _html(self, page: str):
+        body = page.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         if not parts:
-            body = _PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._html(_PAGE)
+            return
+        if parts == ["model"]:
+            self._html(_MODEL_PAGE)
+            return
+        if parts == ["system"]:
+            self._html(_SYSTEM_PAGE)
+            return
+        if parts == ["api", "system-now"]:
+            self._json(_system_now())
             return
         if parts == ["api", "sessions"]:
             out = []

@@ -46,6 +46,33 @@ class StatsUpdateConfiguration:
     collectPerformanceStats: bool = True
 
 
+def _topology(model):
+    """Layer graph from the config DSL: {nodes: [{id, label, kind}], edges:
+    [[src, dst]]}. MLN = sequential chain by index; CG = the conf's DAG
+    (network inputs included); models without a conf DSL (SameDiff) -> None."""
+    conf = getattr(model, "conf", None)
+    layers = getattr(conf, "layers", None)
+    if layers is not None:  # MultiLayerNetwork
+        nodes = [{"id": str(i), "label": type(l).__name__,
+                  "kind": "layer",
+                  "nOut": getattr(l, "nOut", None)}
+                 for i, l in enumerate(layers)]
+        edges = [[str(i), str(i + 1)] for i in range(len(layers) - 1)]
+        return {"nodes": nodes, "edges": edges}
+    graph_nodes = getattr(conf, "nodes", None)
+    if graph_nodes is not None:  # ComputationGraph
+        nodes = [{"id": name, "label": "input", "kind": "input"}
+                 for name in getattr(conf, "networkInputs", [])]
+        edges = []
+        for n in graph_nodes:
+            nodes.append({"id": n.name, "label": type(n.op).__name__,
+                          "kind": "layer",
+                          "nOut": getattr(n.op, "nOut", None)})
+            edges.extend([[src, n.name] for src in n.inputs])
+        return {"nodes": nodes, "edges": edges}
+    return None
+
+
 def _named_leaves(tree):
     """Flatten a params-like pytree to [(name, np.ndarray)] with stable
     path-derived names ('0/W', '3/fwd/Wr', ...)."""
@@ -96,6 +123,7 @@ class StatsReport:
     durationMs: Optional[float] = None
     minibatchesPerSecond: Optional[float] = None
     memoryRssMb: Optional[float] = None
+    deviceMemMb: Optional[float] = None  # accelerator bytes_in_use (system tab)
     parameterStats: dict = field(default_factory=dict)
     updateStats: dict = field(default_factory=dict)
     gradientStats: dict = field(default_factory=dict)
@@ -157,9 +185,29 @@ class StatsListener(TrainingListener):
             "backend": jax.default_backend(),
             "deviceCount": jax.device_count(),
             "startTime": time.time(),
+            # layer graph for the dashboard's model tab (ref: the train UI's
+            # model page renders the conf DSL topology): node ids equal the
+            # first path component of the per-parameter stats keys ('0/W',
+            # 'dense1/W') so the page can join stats onto the graph
+            "topology": _topology(model),
         }
         self.storage.putStaticInfo(self.sessionId, self.typeId, self.workerId, info)
         self._static_sent = True
+
+    @staticmethod
+    def _device_mem_mb():
+        """Summed bytes_in_use over ALL local devices (a single-device
+        sample would hide an imbalanced shard approaching OOM)."""
+        total, seen = 0.0, False
+        try:
+            for d in jax.local_devices():
+                used = (d.memory_stats() or {}).get("bytes_in_use")
+                if used is not None:
+                    total += used
+                    seen = True
+        except Exception:
+            pass
+        return total / 1e6 if seen else None  # None: no telemetry (CPU)
 
     def iterationDone(self, model, iteration, epoch):
         cfg = self.config
@@ -184,6 +232,7 @@ class StatsListener(TrainingListener):
             report.minibatchesPerSecond = 1000.0 / duration if duration > 0 else None
         if cfg.collectMemoryStats:
             report.memoryRssMb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+            report.deviceMemMb = self._device_mem_mb()
 
         params = _named_leaves(self._param_tree(model)) \
             if cfg.collectParameterStats else []

@@ -315,6 +315,67 @@ class TestUIServer:
         finally:
             server.stop()
 
+    def test_model_and_system_tabs(self):
+        """Round-4: the model-graph and system pages (SURVEY §5.5's train UI
+        tabs) — pages served, topology in static info, device/host memory in
+        reports, live system endpoint."""
+        from deeplearning4j_tpu.ui import UIServer
+        server = UIServer(port=0)
+        try:
+            storage = InMemoryStatsStorage()
+            server.attach(storage)
+            net = tiny_net()
+            lst = StatsListener(storage, frequency=1)
+            net.setListeners(lst)
+            net.fit(tiny_data(), epochs=2)
+
+            model_page = self._fetch(server.url + "model")
+            assert "Model graph" in model_page and "parameterStats" in model_page
+            system_page = self._fetch(server.url + "system")
+            assert "System" in system_page and "deviceMemMb" in system_page
+            # nav cross-links on every page
+            for path in ("", "model", "system"):
+                page = self._fetch(server.url + path)
+                assert '/model"' in page and '/system"' in page
+
+            # topology rides in static info; node ids join onto stats keys
+            sessions = json.loads(self._fetch(server.url + "api/sessions"))
+            topo = sessions[0]["info"]["topology"]
+            assert [n["label"] for n in topo["nodes"]] == [
+                "DenseLayer", "OutputLayer"]
+            assert topo["edges"] == [["0", "1"]]
+            ups = json.loads(self._fetch(
+                f"{server.url}api/updates/{lst.sessionId}/worker_0?from=0"))
+            stat_prefixes = {k.split("/")[0]
+                             for k in ups[-1]["parameterStats"]}
+            assert {n["id"] for n in topo["nodes"]} == stat_prefixes
+            # system series present in reports
+            assert ups[-1]["memoryRssMb"] > 0
+
+            live = json.loads(self._fetch(server.url + "api/system-now"))
+            assert live["hostRssMb"] > 0
+            assert isinstance(live["devices"], list) and live["devices"]
+            assert "kind" in live["devices"][0]
+        finally:
+            server.stop()
+
+    def test_topology_for_computation_graph(self):
+        from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+        from deeplearning4j_tpu.ui.stats import _topology
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("h", DenseLayer(nOut=8, activation="TANH"), "in")
+                .addLayer("out", OutputLayer(nOut=3, lossFunction="MCXENT"), "h")
+                .setOutputs("out")
+                .setInputTypes(InputType.feedForward(5)).build())
+        net = ComputationGraph(conf).init()
+        topo = _topology(net)
+        ids = [n["id"] for n in topo["nodes"]]
+        assert ids == ["in", "h", "out"]
+        assert ["in", "h"] in topo["edges"] and ["h", "out"] in topo["edges"]
+        assert topo["nodes"][0]["kind"] == "input"
+
     def test_remote_router_roundtrip(self):
         from deeplearning4j_tpu.ui import RemoteStatsStorageRouter, UIServer
         server = UIServer(port=0)
