@@ -154,6 +154,23 @@ class SameDiffOp:
     kwargs: dict = field(default_factory=dict)
 
 
+def _compute_dtype(cfg) -> Optional[Any]:
+    """TrainingConfig.computeDtype -> jnp dtype (or None = as-imported)."""
+    return {"HALF": jnp.bfloat16, "BFLOAT16": jnp.bfloat16,
+            "FLOAT": None, None: None}[
+                (cfg.computeDtype or "").upper() or None]
+
+
+def _cast_fp32_leaves(tree: Dict[str, Any], cdt) -> Dict[str, Any]:
+    """Cast float32 leaves to the compute dtype (no-op for cdt None and for
+    leaves already cast — the idempotence the frozen pre-cast relies on)."""
+    if cdt is None:
+        return tree
+    return {k: (v.astype(cdt)
+                if hasattr(v, "dtype") and v.dtype == jnp.float32 else v)
+            for k, v in tree.items()}
+
+
 @dataclass
 class TrainingConfig:
     """(ref: org.nd4j.autodiff.samediff.TrainingConfig).
@@ -659,29 +676,52 @@ class SameDiff:
     def _train_step_fn(self):
         key = "train_step"
         if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(self._train_step_inner(),
+                                           donate_argnums=(0, 2))
+        return self._jit_cache[key]
+
+    # steps fused into one executable by fit()'s multi-step path — same
+    # de-dispatch rationale as MultiLayerNetwork.fuseSteps (the axon
+    # tunnel's per-dispatch latency dominates small whole-graph steps:
+    # config #4 measured ~110 ms/step wall for ~30 ms of compute)
+    fuseSteps: int = 8
+
+    def _train_multi_fn(self):
+        key = "train_multi"
+        if key not in self._jit_cache:
+            step_inner = self._train_step_inner()
+
+            def multi(trainables, opt_state, frozen, ph_stacked):
+                def body(carry, ph):
+                    tr, opt = carry
+                    tr, opt, loss = step_inner(tr, frozen, opt, ph)
+                    return (tr, opt), loss
+
+                (trainables, opt_state), losses = jax.lax.scan(
+                    body, (trainables, opt_state), ph_stacked)
+                return trainables, opt_state, losses
+
+            self._jit_cache[key] = jax.jit(multi, donate_argnums=(0, 1))
+        return self._jit_cache[key]
+
+    def _train_step_inner(self):
+        """The un-jitted single training step (fwd+bwd+update) shared by the
+        per-step executable and the fused lax.scan."""
+        key = "train_step_inner"
+        if key not in self._jit_cache:
             t_names = tuple(self._trainable_names())
             loss_names = tuple(self._loss_vars)
             cfg = self._training_config
-
             ops = self._needed_ops(loss_names)
-
-            cdt = {"HALF": jnp.bfloat16, "BFLOAT16": jnp.bfloat16,
-                   "FLOAT": None, None: None}[
-                       (cfg.computeDtype or "").upper() or None]
+            cdt = _compute_dtype(cfg)
 
             def cast_tree(tree):
-                if cdt is None:
-                    return tree
-                return {k: (v.astype(cdt)
-                            if hasattr(v, "dtype") and v.dtype == jnp.float32
-                            else v)
-                        for k, v in tree.items()}
+                return _cast_fp32_leaves(tree, cdt)
 
             def loss_fn(trainables, frozen, placeholders):
                 env = {**cast_tree(frozen), **cast_tree(trainables),
                        **cast_tree(placeholders)}
                 env = self._interpret(env, only_ops=ops)
-                # loss reduced in fp32 regardless of the compute dtype
                 loss = sum(jnp.sum(env[l].astype(jnp.float32))
                            for l in loss_names)
                 for reg in cfg.regularization:
@@ -690,12 +730,15 @@ class SameDiff:
                 return loss if cfg.minimize else -loss
 
             def step(trainables, frozen, opt_state, placeholders):
-                loss, grads = jax.value_and_grad(loss_fn)(trainables, frozen, placeholders)
-                updates, opt_state = self._tx.update(grads, opt_state, trainables)
-                trainables = jax.tree_util.tree_map(lambda p, u: p + u, trainables, updates)
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    trainables, frozen, placeholders)
+                updates, opt_state = self._tx.update(grads, opt_state,
+                                                     trainables)
+                trainables = jax.tree_util.tree_map(
+                    lambda p, u: p + u, trainables, updates)
                 return trainables, opt_state, loss
 
-            self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 2))
+            self._jit_cache[key] = step
         return self._jit_cache[key]
 
     def fit(self, data, epochs: int = 1):
@@ -714,30 +757,81 @@ class SameDiff:
         t_names = self._trainable_names()
         trainables = {n: self._values[n] for n in t_names}
         frozen = {n: v for n, v in self._values.items() if n not in trainables}
+        # Cast frozen fp32 leaves ONCE per fit call (constants, imported
+        # frozen weights): the in-step cast then no-ops on them —
+        # frozen-weight HBM reads happen at bf16 width every step instead
+        # of fp32-read-plus-cast. Trainables keep fp32 masters (cast
+        # inside the step so gradients land on the masters).
+        frozen = _cast_fp32_leaves(frozen, _compute_dtype(cfg))
         if self._opt_state is None:
             self._opt_state = self._tx.init(trainables)
         step = self._train_step_fn()
         history = []
+        # De-dispatch: without listeners, steps buffer into fuseSteps-sized
+        # lax.scan chunks — one tunnel dispatch each (see fuseSteps).
+        # Listeners read per-iteration state, so they keep the per-step path.
+        fuse_k = 0 if self.listeners else max(self.fuseSteps, 0)
+        buf: list = []  # host placeholder dicts of identical shapes
+
+        def ph_host(ds):
+            if isinstance(ds, dict):
+                return {k: _unwrap(v) for k, v in ds.items()}
+            ph = {}
+            feats = ds.features if isinstance(ds.features, (list, tuple)) else [ds.features]
+            labs = ds.labels if isinstance(ds.labels, (list, tuple)) else [ds.labels]
+            for nm, arr in zip(cfg.dataSetFeatureMapping, feats):
+                ph[nm] = _unwrap(arr)
+            for nm, arr in zip(cfg.dataSetLabelMapping, labs):
+                ph[nm] = _unwrap(arr)
+            return ph
+
+        def _sig(ph):
+            return tuple(sorted((k, np.shape(v)) for k, v in ph.items()))
+
+        def run_single(ph):
+            nonlocal trainables
+            phj = {k: jnp.asarray(v) for k, v in ph.items()}
+            trainables, self._opt_state, loss = step(trainables, frozen,
+                                                     self._opt_state, phj)
+            history.append(loss)   # device scalar; bulk-synced below
+            self._score = loss
+            # listeners read current values (StatsListener param stats)
+            self._values.update(trainables)
+            for lst in self.listeners:
+                lst.iterationDone(self, len(history), 0)
+
+        def flush(buf):
+            nonlocal trainables
+            while fuse_k > 1 and len(buf) >= fuse_k:
+                chunk, buf = buf[:fuse_k], buf[fuse_k:]
+                stacked = {k: jnp.asarray(np.stack([c[k] for c in chunk]))
+                           for k in chunk[0]}
+                multi = self._train_multi_fn()
+                trainables, self._opt_state, losses = multi(
+                    trainables, self._opt_state, frozen, stacked)
+                for j in range(fuse_k):
+                    history.append(losses[j])
+                self._score = losses[fuse_k - 1]
+                # rebind after every chunk: the jit donated the previous
+                # buffers, and self._values must never dangle on deleted
+                # arrays if a later batch raises mid-fit
+                self._values.update(trainables)
+            return buf
+
         for _ in range(epochs):
             for ds in data:
-                if isinstance(ds, dict):
-                    ph = {k: jnp.asarray(_unwrap(v)) for k, v in ds.items()}
+                ph = ph_host(ds)
+                if fuse_k > 1:
+                    if buf and _sig(buf[0]) != _sig(ph):
+                        for b in buf:   # shape change: drain as singles
+                            run_single(b)
+                        buf = []
+                    buf.append(ph)
+                    buf = flush(buf)
                 else:
-                    ph = {}
-                    feats = ds.features if isinstance(ds.features, (list, tuple)) else [ds.features]
-                    labs = ds.labels if isinstance(ds.labels, (list, tuple)) else [ds.labels]
-                    for nm, arr in zip(cfg.dataSetFeatureMapping, feats):
-                        ph[nm] = jnp.asarray(arr)
-                    for nm, arr in zip(cfg.dataSetLabelMapping, labs):
-                        ph[nm] = jnp.asarray(arr)
-                trainables, self._opt_state, loss = step(trainables, frozen,
-                                                        self._opt_state, ph)
-                history.append(loss)   # device scalar; bulk-synced below
-                self._score = loss
-                # listeners read current values (StatsListener param stats)
-                self._values.update(trainables)
-                for lst in self.listeners:
-                    lst.iterationDone(self, len(history), 0)
+                    run_single(ph)
+        for b in buf:   # leftover (< fuseSteps) steps run individually
+            run_single(b)
         self._values.update(trainables)
         if history:  # ONE bulk device->host transfer instead of one per step
             import numpy as _np

@@ -1,5 +1,6 @@
 """Tensor core: NDArray facade + factory + dtypes + RNG (nd4j-api equivalent)."""
 from deeplearning4j_tpu.ndarray.array import NDArray
+from deeplearning4j_tpu.ndarray.indexing import INDArrayIndex, NDArrayIndex
 from deeplearning4j_tpu.ndarray.factory import nd
 from deeplearning4j_tpu.ndarray import dtypes
 from deeplearning4j_tpu.ndarray.random import Random, getRandom

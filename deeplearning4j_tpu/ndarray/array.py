@@ -91,9 +91,19 @@ class NDArray:
     def columns(self) -> int:
         return self.shape[1]
 
-    def dup(self) -> "NDArray":
-        """Semantic copy (ref: INDArray.dup)."""
+    def dup(self, order: str = "c") -> "NDArray":
+        """Semantic copy (ref: INDArray.dup / dup(char)). The copy's VALUES
+        are identical either way — in the reference, order only changes the
+        underlying buffer layout, which this facade does not expose (XLA
+        owns layout). The observable face of ordering is flattening:
+        ravel/reshape take an ``order`` argument."""
         return NDArray(jnp.array(self._jax))
+
+    def ordering(self) -> str:
+        """(ref: INDArray.ordering) — the facade is always c-order
+        observable; 'f' semantics surface via the order arguments on
+        ravel/reshape where flattening order leaks into serialization."""
+        return "c"
 
     def castTo(self, dtype) -> "NDArray":
         return NDArray(self._jax.astype(_dt.resolve(dtype)))
@@ -220,12 +230,24 @@ class NDArray:
         return self
 
     # ------------------------------------------------------------------ shape
-    def reshape(self, *shape) -> "NDArray":
+    def reshape(self, *shape, order: str = "c") -> "NDArray":
+        """(ref: INDArray.reshape(char order, ...)): 'f' enumerates/refills
+        elements column-major — the reference's f-order reshape semantics,
+        reproduced functionally (jnp lacks order=F; transpose-compose)."""
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
+        if order.lower() == "f":
+            flat = self.ravel(order="f")._jax
+            return NDArray(jnp.transpose(
+                jnp.reshape(flat, tuple(reversed(shape)))))
         return NDArray(jnp.reshape(self._jax, shape))
 
-    def ravel(self) -> "NDArray":
+    def ravel(self, order: str = "c") -> "NDArray":
+        """(ref: INDArray.ravel(char)): 'f' flattens column-major — the
+        order that leaks into the reference's flat-params serialization."""
+        if order.lower() == "f":
+            axes = tuple(range(self.ndim))[::-1]
+            return NDArray(jnp.ravel(jnp.transpose(self._jax, axes)))
         return NDArray(jnp.ravel(self._jax))
 
     flatten = ravel
@@ -380,8 +402,12 @@ class NDArray:
         self._jax = self._jax.at[idx].set(_unwrap(value))
 
     def get(self, *indices):
-        """Row/point access (simplified NDArrayIndex: ints and slices)."""
-        return NDArray(self._jax[tuple(indices)])
+        """View selection (ref: INDArray.get(INDArrayIndex...)): accepts
+        NDArrayIndex.point/all/interval/newAxis/indices objects as well as
+        raw ints and slices; fewer indices than rank leaves trailing
+        dimensions as all()."""
+        from deeplearning4j_tpu.ndarray.indexing import lower_indices
+        return NDArray(self._jax[lower_indices(indices)])
 
     def getRow(self, i):
         return NDArray(self._jax[i])
@@ -402,9 +428,13 @@ class NDArray:
         return self
 
     def put(self, indices, value):
+        """Assign into a view selection (ref: INDArray.put(INDArrayIndex...,
+        INDArray)): value broadcasts into the selected region; the update is
+        observable through THIS handle (functional .at[].set rebind)."""
+        from deeplearning4j_tpu.ndarray.indexing import lower_indices
         if not isinstance(indices, (tuple, list)):
             indices = (indices,)
-        self._jax = self._jax.at[tuple(indices)].set(_unwrap(value))
+        self._jax = self._jax.at[lower_indices(indices)].set(_unwrap(value))
         return self
 
     def putRow(self, i, row):

@@ -105,10 +105,14 @@ def dot_product_attention(q, k, v, mask=None, scaled=True):
 
 @op("multiHeadDotProductAttention", "nn")
 def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None):
-    """Fused MHA: x_q (B,Tq,D), x_kv (B,Tk,D); w*: (D,D); wo: (D,D)."""
-    B, Tq, D = x_q.shape
+    """Fused MHA: x_q (B,Tq,D), x_kv (B,Tk,D); wq/wk/wv: (D,O); wo: (O,O).
+    Head dims derive from the PROJECTION width O, not the input width D —
+    rectangular projections (nIn != nOut, e.g. SelfAttentionLayer with
+    distinct sizes) are valid."""
+    B, Tq, _ = x_q.shape
     Tk = x_kv.shape[1]
-    hd = D // num_heads
+    O = wq.shape[-1]
+    hd = O // num_heads
 
     def split(x, w, T):
         return jnp.matmul(x, w).reshape(B, T, num_heads, hd).transpose(0, 2, 1, 3)
@@ -116,7 +120,7 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None):
     q, k, v = split(x_q, wq, Tq), split(x_kv, wk, Tk), split(x_kv, wv, Tk)
     m = mask[:, None, None, :] if (mask is not None and mask.ndim == 2) else mask
     out = dot_product_attention(q, k, v, mask=m)
-    out = out.transpose(0, 2, 1, 3).reshape(B, Tq, D)
+    out = out.transpose(0, 2, 1, 3).reshape(B, Tq, O)
     return jnp.matmul(out, wo)
 
 

@@ -336,8 +336,11 @@ def _host_rss_mb() -> dict:
     (ru_maxrss); current usage comes from /proc/self/statm so the system tab
     can show memory actually going down after a spike."""
     import resource
+    import sys
 
-    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    # ru_maxrss is KiB on Linux but BYTES on macOS
+    div = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / div
     cur = None
     try:
         with open("/proc/self/statm") as f:

@@ -231,7 +231,10 @@ class StatsListener(TrainingListener):
             report.durationMs = duration
             report.minibatchesPerSecond = 1000.0 / duration if duration > 0 else None
         if cfg.collectMemoryStats:
-            report.memoryRssMb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+            import sys
+            div = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
+            report.memoryRssMb = \
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / div
             report.deviceMemMb = self._device_mem_mb()
 
         params = _named_leaves(self._param_tree(model)) \

@@ -194,3 +194,124 @@ class TestPytree:
         out = f(nd.create([1.0, 2.0]))
         assert isinstance(out, NDArray)
         np.testing.assert_allclose(out.toNumpy(), [3, 5])
+
+
+class TestNDArrayIndexBoundary:
+    """INDArrayIndex view semantics at the API boundary (SURVEY §2.2 /
+    §7.3 item 4): interval/point/newAxis/indices get+put parity against the
+    reference's reconstructed semantics, numpy as the oracle."""
+
+    def _arr(self):
+        return np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+
+    def test_point_removes_dimension(self):
+        from deeplearning4j_tpu.ndarray import NDArrayIndex as I
+        a = nd.create(self._arr())
+        got = a.get(I.all(), I.point(1))
+        assert got.shape == (2, 4)
+        np.testing.assert_array_equal(got.toNumpy(), self._arr()[:, 1])
+
+    def test_interval_half_open_keeps_dimension(self):
+        from deeplearning4j_tpu.ndarray import NDArrayIndex as I
+        a = nd.create(self._arr())
+        got = a.get(I.point(1), I.interval(0, 2))
+        assert got.shape == (2, 4)
+        np.testing.assert_array_equal(got.toNumpy(), self._arr()[1, 0:2])
+
+    def test_interval_stride_and_inclusive(self):
+        from deeplearning4j_tpu.ndarray import NDArrayIndex as I
+        a = nd.create(np.arange(10, dtype=np.float32))
+        np.testing.assert_array_equal(
+            a.get(I.interval(1, 2, 9)).toNumpy(), [1, 3, 5, 7])
+        # the reference's 4-arg inclusive form closes the upper bound
+        np.testing.assert_array_equal(
+            a.get(I.interval(1, 2, 9, True)).toNumpy(), [1, 3, 5, 7, 9])
+        np.testing.assert_array_equal(
+            a.get(I.interval(2, 5, inclusive=True)).toNumpy(), [2, 3, 4, 5])
+
+    def test_new_axis_inserts_dimension(self):
+        from deeplearning4j_tpu.ndarray import NDArrayIndex as I
+        a = nd.create(self._arr())
+        got = a.get(I.newAxis(), I.all(), I.point(0))
+        assert got.shape == (1, 2, 4)
+        np.testing.assert_array_equal(got.toNumpy(), self._arr()[None, :, 0])
+
+    def test_specified_indices(self):
+        from deeplearning4j_tpu.ndarray import NDArrayIndex as I
+        a = nd.create(self._arr())
+        got = a.get(I.point(0), I.indices(2, 0))
+        np.testing.assert_array_equal(got.toNumpy(), self._arr()[0][[2, 0]])
+
+    def test_trailing_dims_implicit_all(self):
+        from deeplearning4j_tpu.ndarray import NDArrayIndex as I
+        a = nd.create(self._arr())
+        got = a.get(I.point(1))
+        assert got.shape == (3, 4)
+        np.testing.assert_array_equal(got.toNumpy(), self._arr()[1])
+
+    def test_put_into_interval_view_broadcasts(self):
+        from deeplearning4j_tpu.ndarray import NDArrayIndex as I
+        a = nd.create(self._arr())
+        a.put((I.all(), I.interval(1, 3), I.point(0)), 99.0)
+        want = self._arr()
+        want[:, 1:3, 0] = 99.0
+        np.testing.assert_array_equal(a.toNumpy(), want)
+
+    def test_put_array_value_through_same_handle(self):
+        from deeplearning4j_tpu.ndarray import NDArrayIndex as I
+        a = nd.create(np.zeros((3, 4), np.float32))
+        block = nd.create(np.ones((2, 2), np.float32) * 7)
+        ret = a.put((I.interval(0, 2), I.interval(2, 4)), block)
+        assert ret is a  # reference mutates + returns this
+        want = np.zeros((3, 4), np.float32)
+        want[0:2, 2:4] = 7
+        np.testing.assert_array_equal(a.toNumpy(), want)
+
+    def test_raw_ints_and_slices_still_work(self):
+        a = nd.create(self._arr())
+        np.testing.assert_array_equal(a.get(0, slice(1, 3)).toNumpy(),
+                                      self._arr()[0, 1:3])
+
+
+class TestOrderingBoundary:
+    """f-order observability where it leaks into flattening/serialization
+    (SURVEY §7.3 item 4): ravel/reshape order parity with numpy's F-order."""
+
+    def test_ravel_f_order(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        a = nd.create(x)
+        np.testing.assert_array_equal(a.ravel(order="f").toNumpy(),
+                                      x.ravel(order="F"))
+        np.testing.assert_array_equal(a.ravel().toNumpy(), x.ravel())
+
+    def test_ravel_f_order_rank3(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        a = nd.create(x)
+        np.testing.assert_array_equal(a.ravel(order="f").toNumpy(),
+                                      x.ravel(order="F"))
+
+    def test_reshape_f_order(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        a = nd.create(x)
+        np.testing.assert_array_equal(
+            a.reshape(4, 3, order="f").toNumpy(), x.reshape(4, 3, order="F"))
+        np.testing.assert_array_equal(
+            a.reshape(2, 6, order="f").toNumpy(), x.reshape(2, 6, order="F"))
+
+    def test_f_ravel_roundtrip_through_serialization(self):
+        """An f-order flat vector written to bytes and reshaped back must
+        reproduce the source — the exact reference leak path (flat param
+        vectors serialized in a chosen order)."""
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        a = nd.create(x)
+        blob = a.ravel(order="f").toNumpy().tobytes()
+        back = np.frombuffer(blob, np.float32)
+        restored = nd.create(back).reshape(2, 3, 4, order="f")
+        np.testing.assert_array_equal(restored.toNumpy(), x)
+
+    def test_dup_order_values_identical(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        a = nd.create(x)
+        assert a.ordering() == "c"
+        np.testing.assert_array_equal(a.dup("f").toNumpy(), x)
+        np.testing.assert_array_equal(a.dup().toNumpy(), x)

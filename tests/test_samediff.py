@@ -292,6 +292,66 @@ def test_random_and_updaters_namespaces():
     np.testing.assert_allclose(sd2.output({}, u.name)[u.name].toNumpy(), 0.5)
 
 
+def _fit_parity_model(seed=17):
+    rng = np.random.RandomState(seed)
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", shape=(None, 4))
+    y = sd.placeHolder("y", shape=(None, 1))
+    w = sd.var("w", (rng.rand(4, 8).astype(np.float32) - 0.5))
+    b = sd.var("b", np.zeros((8,), np.float32))
+    w2 = sd.var("w2", (rng.rand(8, 1).astype(np.float32) - 0.5))
+    h = sd.math.tanh(x.mmul(w) + b)
+    loss = sd.loss.mse(y, h.mmul(w2)).rename("loss")
+    sd.setLossVariables("loss")
+    sd.setTrainingConfig(TrainingConfig(updater=Adam(1e-2),
+                                        dataSetFeatureMapping=["x"],
+                                        dataSetLabelMapping=["y"]))
+    batches = [{"x": rng.rand(8, 4).astype(np.float32),
+                "y": rng.rand(8, 1).astype(np.float32)} for _ in range(11)]
+    return sd, batches
+
+
+class TestFusedFit:
+    """SameDiff.fit's de-dispatched multi-step path (round 4: fuseSteps
+    lax.scan, the fix that took TF-import config #4 from 29k to >100k
+    tok/s on TPU) must be loss- and param-identical to the per-step path."""
+
+    def test_fused_matches_per_step(self):
+        runs = {}
+        for name, fuse in (("fused", 4), ("single", 0)):
+            sd, batches = _fit_parity_model()
+            sd.fuseSteps = fuse
+            hist = sd.fit(batches)   # 11 batches: 2 chunks of 4 + 3 singles
+            runs[name] = (hist, {n: np.asarray(sd.getVariable(n).getArr().toNumpy())
+                                 for n in ("w", "b", "w2")})
+        assert len(runs["fused"][0]) == len(runs["single"][0]) == 11
+        np.testing.assert_allclose(runs["fused"][0], runs["single"][0],
+                                   rtol=1e-6)
+        for n in ("w", "b", "w2"):
+            np.testing.assert_allclose(runs["fused"][1][n],
+                                       runs["single"][1][n], atol=1e-6)
+
+    def test_listeners_force_per_step_history(self):
+        calls = []
+
+        class L:
+            def iterationDone(self, model, it, ep):
+                calls.append((it, float(model.score())))
+
+        sd, batches = _fit_parity_model()
+        sd.listeners = [L()]
+        hist = sd.fit(batches[:5])
+        assert [c[0] for c in calls] == [1, 2, 3, 4, 5]
+        np.testing.assert_allclose([c[1] for c in calls], hist, rtol=1e-6)
+
+    def test_shape_change_drains_buffer(self):
+        sd, batches = _fit_parity_model()
+        small = [{"x": b["x"][:4], "y": b["y"][:4]} for b in batches[:3]]
+        hist = sd.fit(batches[:5] + small)
+        assert len(hist) == 8
+        assert all(np.isfinite(h) for h in hist)
+
+
 class TestMixedPrecisionTraining:
     """TrainingConfig.computeDtype: bf16 compute over fp32 master params
     (the import-time dtype-rewrite for TF/ONNX-imported graphs — BASELINE.md
