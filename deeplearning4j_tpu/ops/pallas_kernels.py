@@ -4,11 +4,14 @@ and softmax-loss — SURVEY.md §2.1 'custom kernel' row; guide:
 /opt/skills/guides/pallas_guide.md):
 
 - ``flash_attention`` — blocked online-softmax attention. The (T, T) score
-  matrix never materializes in HBM: each q-block streams k/v-blocks through
-  VMEM keeping running max/denominator (the flash-attention recurrence).
-  O(T) memory instead of O(T^2); causal masking supported. Backward is a
-  custom-VJP recompute in plain jnp (XLA's attention backward is already
-  fused + rematerializable; the forward is where HBM blows up at long T).
+  matrix never materializes in HBM in EITHER direction: the forward streams
+  k/v-blocks per q-block with the running max/denominator recurrence (and
+  saves the per-row logsumexp); the backward is two Pallas passes (dq over
+  q-blocks, dk/dv over k-blocks) that rebuild p from the saved logsumexp.
+  O(T) memory, causal masking supported. Note: like hand-written CUDA
+  attention kernels, the Pallas backward is first-order only — grad-of-grad
+  through it is not differentiable (use ``_attention_reference`` for
+  higher-order experiments).
 - ``softmax_cross_entropy`` — fused logsumexp + target-logit gather over a
   large vocab (the lm_head loss). One pass over the logits block in VMEM,
   no (N, V) softmax materialization; custom-VJP backward is the closed form
@@ -42,11 +45,19 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
+def _causal_block_mask(s, q_off, k_off):
+    """Mask a (BQ, BK) score block at absolute offsets (q_off, k_off)."""
+    bq, bk = s.shape
+    qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(qpos >= kpos, s, _NEG_INF)
+
+
 # ------------------------------------------------------------ flash attn
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  causal: bool, scale: float):
     q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
     bq, d = q.shape
     t = k_ref.shape[1]
@@ -60,9 +71,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (BQ, BK)
         if causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+            s = _causal_block_mask(s, qi * bq, j * block_k)
         m_new = jnp.maximum(m, s.max(-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -81,7 +90,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
 def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
@@ -97,7 +108,7 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
 
     kern = functools.partial(_flash_kernel, block_k=bk, causal=causal, scale=sc)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kern,
         grid=(bh, t // bq),
         in_specs=[
@@ -105,13 +116,91 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, t, d), lambda b_, i: (b_, 0, 0)),
             pl.BlockSpec((1, t, d), lambda b_, i: (b_, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b_, i: (b_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_specs=[pl.BlockSpec((1, bq, d), lambda b_, i: (b_, i, 0)),
+                   pl.BlockSpec((1, 1, bq), lambda b_, i: (b_, 0, i))],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, 1, t), jnp.float32)],
         interpret=interpret,
+        compiler_params=None if interpret else _tpu_params(),
     )(q, k, v)
     if orig_rank == 4:
         out = out.reshape(b, h, t, d)
-    return out
+    return out, lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool, scale: float):
+    """dQ pass: one q-block per grid step, stream k/v-blocks.
+    ds = p * (dp - delta), dq = scale * ds @ k  with p rebuilt from the
+    saved logsumexp (no (T, T) materialization)."""
+    q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]                      # (BQ, 1)
+    delta = delta_ref[0, 0][:, None]
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+    nkb = t // block_k
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_block_mask(s, qi * bq, j * block_k)
+        p = jnp.exp(s - lse)                          # (BQ, BK), rows sum<=1
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    upper = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nkb) \
+        if causal else nkb
+    dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float):
+    """dK/dV pass: one k-block per grid step, stream q-blocks.
+    dv = p^T @ do, dk = scale * ds^T @ q."""
+    k = k_ref[0].astype(jnp.float32)                  # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    t = q_ref.shape[1]
+    ki = pl.program_id(1)
+    nqb = t // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (BQ, BK)
+        if causal:
+            s = _causal_block_mask(s, i * block_q, ki * bk)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    # causal: q-blocks strictly before this k-block's diagonal see none of it
+    lower = (ki * bk) // block_q if causal else 0
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lower, nqb, body, (z, z))
+    # dL/dk = ds^T @ (scale*q) — q was loaded pre-scaled, so no extra factor
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _attention_reference(q, k, v, causal, scale):
@@ -130,26 +219,70 @@ def _attention_reference(q, k, v, causal, scale):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
                     scale=None, interpret=False):
-    """(B, H, T, D) or (BH, T, D) attention; T must divide by the blocks."""
-    return _flash_forward(q, k, v, causal=causal, block_q=block_q,
-                          block_k=block_k, scale=scale, interpret=interpret)
+    """(B, H, T, D) or (BH, T, D) attention; T must divide by the blocks.
+    Forward AND backward stream k/v-blocks through VMEM with the online-
+    softmax recurrence (two-pass backward: dq over q-blocks, dk/dv over
+    k-blocks) — O(T) memory in both directions. This is the long-context
+    path (round 2's backward recomputed full attention in fp32 via XLA,
+    materializing the (T, T) scores the forward avoided)."""
+    out, _ = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                            block_k=block_k, scale=scale, interpret=interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, scale, interpret):
-    out = _flash_forward(q, k, v, causal=causal, block_q=block_q,
-                         block_k=block_k, scale=scale, interpret=interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                              block_k=block_k, scale=scale,
+                              interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, scale, interpret, res, g):
-    q, k, v = res
-    # recompute-based backward in plain jnp under remat: XLA fuses the
-    # recomputation; peak memory is one (T, T) block per vmapped head,
-    # which jax.checkpoint keeps off HBM between layers
-    f = jax.checkpoint(lambda q_, k_, v_: _attention_reference(
-        q_, k_, v_, causal, scale))
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g.astype(q.dtype))
+    q, k, v, out, lse = res
+    orig_rank = q.ndim
+    if orig_rank == 4:
+        b, h, t, d = q.shape
+        q, k, v, out, g = (x.reshape(b * h, t, d)
+                           for x in (q, k, v, out, g))
+    bh, t, d = q.shape
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    do = g.astype(q.dtype)
+    # delta_i = rowsum(dO_i * O_i): the softmax-backward correction term,
+    # one cheap fused elementwise reduction in XLA
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, 1, t)
+
+    qblk = pl.BlockSpec((1, bq, d), lambda b_, i: (b_, i, 0))
+    kfull = pl.BlockSpec((1, t, d), lambda b_, i: (b_, 0, 0))
+    qvec = pl.BlockSpec((1, 1, bq), lambda b_, i: (b_, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=bk, causal=causal,
+                          scale=sc),
+        grid=(bh, t // bq),
+        in_specs=[qblk, kfull, kfull, qblk, qvec, qvec],
+        out_specs=qblk,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else _tpu_params(),
+    )(q, k, v, do, lse, delta)
+
+    kblk = pl.BlockSpec((1, bk, d), lambda b_, i: (b_, i, 0))
+    tvec = pl.BlockSpec((1, 1, t), lambda b_, i: (b_, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=bq, causal=causal,
+                          scale=sc),
+        grid=(bh, t // bk),
+        in_specs=[kfull, kblk, kblk, kfull, tvec, tvec],
+        out_specs=[kblk, kblk],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype)] * 2,
+        interpret=interpret,
+        compiler_params=None if interpret else _tpu_params(),
+    )(q, k, v, do, lse, delta)
+    if orig_rank == 4:
+        dq, dk, dv = (x.reshape(b, h, t, d) for x in (dq, dk, dv))
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -269,3 +269,32 @@ class TestFusedFitCG:
         net.fit(ListDataSetIterator(_batches(9)))
         assert net._iteration == 9
         assert np.isfinite(net.score())
+
+    def test_score_listener_fuses_with_identical_callbacks(self):
+        """CG mirror of the MLN test: score-only listeners fuse, callback
+        sequence and params identical to the per-step path."""
+        from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+
+        batches = _batches(12)
+        runs = {}
+        for name, fuse in (("fused", 8), ("single", 0)):
+            net = ComputationGraph(self._cg_conf()).init()
+            net.fuseSteps = fuse
+            seq = []
+
+            class Rec(CollectScoresListener):
+                def iterationDone(self, model, it, ep):
+                    seq.append((it, ep, float(model.score())))
+
+            net.setListeners(Rec(frequency=1))
+            net.fit(ListDataSetIterator(batches))
+            runs[name] = (_params_flat(net), seq, net._iteration)
+
+        assert runs["fused"][2] == runs["single"][2] == 12
+        assert [(i, e) for i, e, _ in runs["fused"][1]] == \
+            [(i, e) for i, e, _ in runs["single"][1]]
+        np.testing.assert_allclose([s for _, _, s in runs["fused"][1]],
+                                   [s for _, _, s in runs["single"][1]],
+                                   atol=1e-6)
+        np.testing.assert_allclose(runs["fused"][0], runs["single"][0],
+                                   atol=1e-6)

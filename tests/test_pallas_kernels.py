@@ -36,18 +36,39 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5, rtol=2e-5)
 
-    def test_gradients_match_reference(self):
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("bq,bk", [(32, 32), (64, 16), (16, 64)])
+    def test_gradients_match_reference(self, causal, bq, bk):
+        """Two-pass Pallas backward (round 4) parity across causal modes
+        and asymmetric q/k block sizes."""
         q, k, v = _rand(2, 64, 8), _rand(2, 64, 8), _rand(2, 64, 8)
 
         def loss_flash(q, k, v):
-            return jnp.sum(flash_attention(q, k, v, True, 32, 32, None, True) ** 2)
+            return jnp.sum(flash_attention(q, k, v, causal, bq, bk, None, True) ** 2)
 
         def loss_ref(q, k, v):
-            return jnp.sum(_attention_reference(q, k, v, True, None) ** 2)
+            return jnp.sum(_attention_reference(q, k, v, causal, None) ** 2)
 
         g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
         g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_gradients_4d_and_custom_scale(self):
+        q, k, v = (_rand(2, 3, 32, 8) for _ in range(3))
+        g = _rand(2, 3, 32, 8)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, False, 16, 16, 0.5, True) * g).sum()
+
+        def loss_ref(q, k, v):
+            return (_attention_reference(q, k, v, False, 0.5) * g).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            assert a.shape == (2, 3, 32, 8)
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-4)
 
