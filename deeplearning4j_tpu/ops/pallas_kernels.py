@@ -16,6 +16,10 @@ and softmax-loss — SURVEY.md §2.1 'custom kernel' row; guide:
   large vocab (the lm_head loss). One pass over the logits block in VMEM,
   no (N, V) softmax materialization; custom-VJP backward is the closed form
   softmax(logits) - onehot, computed blockwise in a second kernel.
+  NB (round-4 measurement, BASELINE.md): at BERT-base bench shapes the XLA
+  lm_head+loss path already sits AT its matmul floor (~45 ms vs ~49 ms pure
+  matmul at measured MXU rates), so the flagship does not route through this
+  kernel — it pays at much larger vocab / smaller models.
 
 Both run in interpret mode on CPU (how the test suite exercises them) and
 compile natively on TPU. Use ``flash_attention(..., interpret=True)`` off-TPU.

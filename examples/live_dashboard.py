@@ -1,6 +1,8 @@
 """Live training dashboard (ref: dl4j-examples UIExample):
-UIServer + StatsListener — browse http://127.0.0.1:9000 while training runs.
-Also renders the static HTML report at the end.
+UIServer + StatsListener — browse http://127.0.0.1:9000 while training runs:
+/ (overview: score, lr, update:param ratio), /model (layer graph with
+per-layer param/grad series + histograms), /system (host/device memory,
+step timing). Also renders the static HTML report at the end.
 """
 import os
 
