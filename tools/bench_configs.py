@@ -27,7 +27,7 @@ _FUSE_OVERRIDE = None  # set by --fuseSteps for the sweep
 def _timed_fit(net, ds, steps=16, warmup=None):
     """Seconds per training step, driving fit(iterator) the way real training
     does — which engages the de-dispatched multi-step path (fuseSteps steps
-    per XLA executable; BASELINE.md round-3). ``steps`` should be a multiple
+    per XLA executable; BASELINE.md round-4 config tables). ``steps`` should be a multiple
     of net.fuseSteps so the whole run is fused. Synchronization is a host
     transfer of the score (block_until_ready is a no-op under axon)."""
     from deeplearning4j_tpu.data import ListDataSetIterator
