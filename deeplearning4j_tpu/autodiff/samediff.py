@@ -767,10 +767,14 @@ class SameDiff:
             self._opt_state = self._tx.init(trainables)
         step = self._train_step_fn()
         history = []
-        # De-dispatch: without listeners, steps buffer into fuseSteps-sized
-        # lax.scan chunks — one tunnel dispatch each (see fuseSteps).
-        # Listeners read per-iteration state, so they keep the per-step path.
-        fuse_k = 0 if self.listeners else max(self.fuseSteps, 0)
+        # De-dispatch: steps buffer into fuseSteps-sized lax.scan chunks —
+        # one tunnel dispatch each (see fuseSteps). Listeners no longer
+        # disable fusing (round-5, mirroring MultiLayerNetwork): chunks are
+        # cut at iterations where a listener needs the LIVE model
+        # (requiresModelAtIteration), and buffered per-step losses are
+        # replayed to listeners after each chunk — identical callback
+        # sequence to the per-step path.
+        fuse_k = max(self.fuseSteps, 0)
         buf: list = []  # host placeholder dicts of identical shapes
 
         def ph_host(ds):
@@ -786,7 +790,13 @@ class SameDiff:
             return ph
 
         def _sig(ph):
-            return tuple(sorted((k, np.shape(v)) for k, v in ph.items()))
+            # dtype is part of the signature: same-shaped batches of
+            # different dtypes must not np.stack into one chunk (the
+            # promotion would silently train on different numerics than
+            # the per-step path — round-4 advisor finding). result_type
+            # reads the dtype without forcing a device->host transfer.
+            return tuple(sorted((k, np.shape(v), str(jnp.result_type(v)))
+                                for k, v in ph.items()))
 
         def run_single(ph):
             nonlocal trainables
@@ -802,20 +812,34 @@ class SameDiff:
 
         def flush(buf):
             nonlocal trainables
-            while fuse_k > 1 and len(buf) >= fuse_k:
-                chunk, buf = buf[:fuse_k], buf[fuse_k:]
-                stacked = {k: jnp.asarray(np.stack([c[k] for c in chunk]))
-                           for k in chunk[0]}
+            from deeplearning4j_tpu.nn.multilayer import _chunk_limit
+            while buf:
+                k = _chunk_limit(self.listeners, len(history), fuse_k)
+                if k <= 1:
+                    # a listener needs the live model at the very next
+                    # iteration: run it as a single (exact semantics)
+                    run_single(buf[0])
+                    buf = buf[1:]
+                    continue
+                if len(buf) < k:
+                    break
+                chunk, buf = buf[:k], buf[k:]
+                stacked = {key: jnp.asarray(np.stack([c[key] for c in chunk]))
+                           for key in chunk[0]}
                 multi = self._train_multi_fn()
                 trainables, self._opt_state, losses = multi(
                     trainables, self._opt_state, frozen, stacked)
-                for j in range(fuse_k):
-                    history.append(losses[j])
-                self._score = losses[fuse_k - 1]
                 # rebind after every chunk: the jit donated the previous
                 # buffers, and self._values must never dangle on deleted
-                # arrays if a later batch raises mid-fit
+                # arrays if a later batch raises mid-fit. Listeners then
+                # see the chunk-end model — _chunk_limit guaranteed none
+                # of them needed it mid-chunk.
                 self._values.update(trainables)
+                for j in range(k):
+                    history.append(losses[j])
+                    self._score = losses[j]
+                    for lst in self.listeners:
+                        lst.iterationDone(self, len(history), 0)
             return buf
 
         for _ in range(epochs):

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -34,6 +35,19 @@ from jax.experimental.shard_map import shard_map
 
 from deeplearning4j_tpu.parallel.mesh import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
 from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention, ulysses_attention
+
+_log = logging.getLogger(__name__)
+_flash_fallback_warned: set = set()
+
+
+def _warn_flash_fallback(reason: str) -> None:
+    """One-time notice when attention_impl='flash' routes to the XLA einsum
+    path anyway — a silent perf cliff otherwise (round-4 advisor finding)."""
+    if reason not in _flash_fallback_warned:
+        _flash_fallback_warned.add(reason)
+        _log.warning(
+            "attention_impl='flash' falling back to the XLA einsum path: %s",
+            reason)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,27 +162,59 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
     """Dispatch: full attention, the Pallas flash kernel, or sequence-parallel
     ring/Ulysses via shard_map over the 'context' axis when the mesh has one."""
     impl = cfg.attention_impl
-    if impl == "flash" and mesh is None:
-        # Meshless only: a monolithic pallas_call over sharded operands
-        # would defeat GSPMD (all-gather per layer). Short sequences
-        # (T <= 1024) never reach here either — _block routes them to the
-        # packed whole-head VMEM kernel via _use_packed_kernel before the
-        # head transpose. This branch serves single-chip long T only.
-        T = q.shape[-2]
+    if impl == "flash":
+        # Streamed long-context kernel (T > 1024 — shorter sequences never
+        # reach here; _block routes them to the packed whole-head VMEM
+        # kernel via _use_packed_kernel before the head transpose). Under a
+        # dp/tp mesh the kernel runs per-device via shard_map — batch over
+        # 'data', heads over 'model' (embarrassingly parallel, zero extra
+        # collectives); a sequence-sharded ('context') mesh falls through to
+        # ring/Ulysses below, which own that regime.
+        B, nh, T, _ = q.shape
+        mesh_spec = None
+        if mesh is not None:
+            ok = not (CONTEXT_AXIS in mesh.axis_names
+                      and mesh.shape[CONTEXT_AXIS] > 1) \
+                and B % mesh.shape.get(DATA_AXIS, 1) == 0 \
+                and nh % mesh.shape.get(MODEL_AXIS, 1) == 0
+            if ok:
+                mesh_spec = P(
+                    DATA_AXIS if DATA_AXIS in mesh.axis_names else None,
+                    MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None,
+                    None, None)
         interpret = jax.default_backend() != "tpu"
         blk = 128
         while blk > 8 and T % blk:
             blk //= 2
-        if T % blk == 0:
+        if T % blk == 0 and (mesh is None or mesh_spec is not None):
             from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
-            return flash_attention(q, k, v, cfg.causal, blk, blk, None, interpret)
-        # T has no usable power-of-2 block divisor — full attention is correct
-        return _full_attention(q, k, v, cfg.causal, cfg.softmax_dtype)
-    if impl in ("full", "flash") or mesh is None \
+
+            def _local(ql, kl, vl):
+                return flash_attention(ql, kl, vl, cfg.causal, blk, blk,
+                                       None, interpret)
+
+            if mesh is None:
+                return _local(q, k, v)
+            return shard_map(_local, mesh=mesh,
+                             in_specs=(mesh_spec,) * 3, out_specs=mesh_spec,
+                             check_rep=False)(q, k, v)
+        # T has no usable power-of-2 block divisor, or the mesh shards the
+        # sequence/doesn't divide batch+heads — fall through (ring/Ulysses
+        # when a context axis exists, XLA einsum otherwise)
+        if mesh is None or CONTEXT_AXIS not in mesh.axis_names \
+                or mesh.shape[CONTEXT_AXIS] == 1:
+            _warn_flash_fallback(
+                f"streamed kernel unavailable for T={T} under mesh "
+                f"{dict(mesh.shape) if mesh is not None else None}")
+            return _full_attention(q, k, v, cfg.causal, cfg.softmax_dtype)
+    if impl == "full" or mesh is None \
             or CONTEXT_AXIS not in mesh.axis_names \
             or mesh.shape[CONTEXT_AXIS] == 1:
         return _full_attention(q, k, v, cfg.causal, cfg.softmax_dtype)
-    fn = ring_attention if impl == "ring" else ulysses_attention
+    # 'ring' and sequence-sharded 'flash' both take the ppermute ring —
+    # ring attention IS flash attention's online-softmax recurrence with
+    # k/v blocks arriving over ICI instead of from HBM
+    fn = ulysses_attention if impl == "ulysses" else ring_attention
     # heads sharded over 'model', sequence over 'context'
     spec = P(DATA_AXIS if DATA_AXIS in mesh.axis_names else None,
              MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None,
@@ -179,19 +225,42 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
     return mapped(q, k, v)
 
 
-def _use_packed_kernel(cfg: TransformerConfig, mesh: Optional[Mesh], T: int) -> bool:
+def _packed_mesh_spec(cfg: TransformerConfig, mesh: Mesh, B: int):
+    """PartitionSpec + local head count for running the packed VMEM kernel
+    under ``mesh`` via shard_map — batch rides the 'data' axis and heads ride
+    the 'model' axis (both embarrassingly parallel: per-device pallas_call,
+    zero extra collectives). Returns None when the kernel cannot partition
+    over this mesh (sequence sharded over 'context', heads or batch not
+    divisible) and the einsum/ring paths must serve instead."""
+    if CONTEXT_AXIS in mesh.axis_names and mesh.shape[CONTEXT_AXIS] > 1:
+        return None  # sequence is sharded — ring/Ulysses own that regime
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    if B % dp or cfg.heads % tp:
+        return None
+    spec = P(DATA_AXIS if DATA_AXIS in mesh.axis_names else None, None,
+             MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None)
+    return spec, cfg.heads // tp
+
+
+def _use_packed_kernel(cfg: TransformerConfig, mesh: Optional[Mesh],
+                       B: int, T: int) -> bool:
     """True when attention routes to the packed-layout Pallas kernel: the
     (B, T, H*D) projections feed the kernel directly, so the (B, H, T, D)
     head transposes (6 physical copies per layer, ~5 GB/step at bench
-    shapes) never materialize."""
+    shapes) never materialize. Under a mesh the kernel runs per-device via
+    shard_map over the (data, model) axes (round-5; a monolithic pallas_call
+    over sharded operands would have forced GSPMD all-gathers, which is why
+    round 4 disabled it under any mesh)."""
     if cfg.attention_impl != "flash":
         return False
-    if mesh is not None:
-        # A monolithic pallas_call over sharded operands defeats GSPMD (it
-        # would all-gather q/k/v); sharded meshes keep the einsum/ring paths
-        # that partition cleanly over model/context axes.
+    if not (T % 8 == 0 and T <= 1024):
         return False
-    return T % 8 == 0 and T <= 1024
+    if mesh is not None and _packed_mesh_spec(cfg, mesh, B) is None:
+        # no warning here: _attention still serves this — ring/Ulysses for
+        # sequence-sharded meshes, and ITS einsum fallback warns accurately
+        return False
+    return True
 
 
 def _block(params, x, cfg: TransformerConfig, mesh: Optional[Mesh]):
@@ -199,13 +268,30 @@ def _block(params, x, cfg: TransformerConfig, mesh: Optional[Mesh]):
     h = _layernorm(x, params["ln1"])
     qkv = h @ params["qkv"]["kernel"].astype(h.dtype) + params["qkv"]["bias"].astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    if _use_packed_kernel(cfg, mesh, T):
+    if _use_packed_kernel(cfg, mesh, B, T):
         from deeplearning4j_tpu.ops.pallas_kernels import mha_attention_packed
         # cfg.softmax_dtype doubles as the kernel's probability dtype —
         # bf16 halves the VPU softmax work (bench config), fp32 is exact
-        o = mha_attention_packed(q, k, v, cfg.heads, cfg.causal, None,
-                                 jax.default_backend() != "tpu",
-                                 cfg.softmax_dtype)
+        interp = jax.default_backend() != "tpu"
+        if mesh is None:
+            o = mha_attention_packed(q, k, v, cfg.heads, cfg.causal, None,
+                                     interp, cfg.softmax_dtype)
+        else:
+            # Per-device kernel under shard_map: batch over 'data', heads
+            # over 'model' (the qkv projection is column-parallel, so the
+            # packed H*D dim is already laid out head-contiguous per shard).
+            # Attention never mixes batch elements or heads, so in==out
+            # specs and no collectives; scale is per-head (1/sqrt(D)) and D
+            # is shard-invariant.
+            spec, local_heads = _packed_mesh_spec(cfg, mesh, B)
+
+            def _local(ql, kl, vl):
+                return mha_attention_packed(ql, kl, vl, local_heads,
+                                            cfg.causal, None, interp,
+                                            cfg.softmax_dtype)
+
+            o = shard_map(_local, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec, check_rep=False)(q, k, v)
     else:
         def heads(t):  # (B,T,H) -> (B,heads,T,D)
             return t.reshape(B, T, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)

@@ -10,8 +10,8 @@ and softmax-loss — SURVEY.md §2.1 'custom kernel' row; guide:
   q-blocks, dk/dv over k-blocks) that rebuild p from the saved logsumexp.
   O(T) memory, causal masking supported. Note: like hand-written CUDA
   attention kernels, the Pallas backward is first-order only — grad-of-grad
-  through it is not differentiable (use ``_attention_reference`` for
-  higher-order experiments).
+  through it raises; enter :func:`higher_order_attention` to route the
+  public kernels to the fully-differentiable XLA reference instead.
 - ``softmax_cross_entropy`` — fused logsumexp + target-logit gather over a
   large vocab (the lm_head loss). One pass over the logits block in VMEM,
   no (N, V) softmax materialization; custom-VJP backward is the closed form
@@ -30,15 +30,19 @@ scores never in HBM, no head transposes) beats XLA's fused attention 5.7 ms
 vs 9.4 ms per layer fwd+bwd and lifts the BERT-base bench 135.4k -> 164.8k
 tok/s; the streamed ``flash_attention`` recurrence here only wins at long
 context (T=8192, B=2: ~48x faster than full attention, which OOMs one batch
-size higher). On a meshless (single-chip) setup, ``attention_impl='flash'``
-routes T<=1024 to the VMEM kernel and longer T to the streamed one; under a
-mesh both Pallas paths are skipped (a monolithic pallas_call over sharded
-operands would force GSPMD all-gathers) in favor of the partitionable
-einsum/ring paths. Ring/Ulysses
-(parallel/sequence_parallel.py) shard longer-still sequences across chips.
+size higher). ``attention_impl='flash'`` routes T<=1024 to the VMEM kernel
+and longer T to the streamed one; under a dp/tp mesh the same kernels run
+per-device via shard_map (batch over 'data', heads over 'model' — both
+embarrassingly parallel, zero extra collectives; round 5). A monolithic
+pallas_call over sharded operands would instead force GSPMD all-gathers,
+which is why the kernels are never called on globally-sharded values
+directly. Sequence-sharded ('context') meshes route to ring/Ulysses
+(parallel/sequence_parallel.py), which shard longer-still sequences
+across chips.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional
 
@@ -47,6 +51,59 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
+
+# --- higher-order autodiff escape hatch -------------------------------
+# The Pallas attention backwards are custom-VJP kernels: FIRST-ORDER ONLY.
+# Differentiating through them again raises JAX's standard "can't apply
+# forward-mode autodiff (jvp) to a custom_vjp function" error. For
+# grad-of-grad experiments (Hessian-vector products, influence functions),
+# enter ``higher_order_attention()``: the public kernels then route to the
+# plain-XLA ``_attention_reference`` path, which is differentiable to any
+# order (at the cost of materializing the (T, T) scores).
+_HIGHER_ORDER = False
+
+
+@jax.custom_jvp
+def _first_order_only(x):
+    """Identity marker baked into the kernels' saved-residual path. After
+    the first (reverse-mode) differentiation inlines the custom-VJP, a
+    second differentiation would otherwise reach a raw pallas_call and die
+    with an inscrutable internal error (observed: ``safe_zip() argument 2 is
+    longer``); this marker's JVP rule intercepts that with an error naming
+    the escape hatch."""
+    return x
+
+
+@_first_order_only.defjvp
+def _first_order_only_jvp(primals, tangents):
+    raise NotImplementedError(
+        "grad-of-grad through the Pallas attention kernels is unsupported — "
+        "their custom-VJP backward is first-order only. Wrap the computation "
+        "in deeplearning4j_tpu.ops.pallas_kernels.higher_order_attention() "
+        "to route attention to the fully differentiable XLA reference "
+        "implementation.")
+
+
+@contextlib.contextmanager
+def higher_order_attention():
+    """Context manager: route ``flash_attention`` / ``mha_attention_packed``
+    / ``mha_attention`` to the fully-differentiable XLA reference
+    implementation so grad-of-grad works. Outside this context the Pallas
+    custom-VJP kernels are used and second-order autodiff raises.
+
+    The flag is read at TRACE time: a ``jax.jit``-compiled function bakes in
+    whichever path was active when it was first traced and keeps it for the
+    life of its cache entry, regardless of later enter/exit. Enter this
+    context before the first call of the jitted function you want on the
+    reference path, and ``jax.clear_caches()`` if you need to switch an
+    already-traced function back to the Pallas kernels."""
+    global _HIGHER_ORDER
+    prev = _HIGHER_ORDER
+    _HIGHER_ORDER = True
+    try:
+        yield
+    finally:
+        _HIGHER_ORDER = prev
 
 
 def _causal_block_mask(s, q_off, k_off):
@@ -221,6 +278,13 @@ def _attention_reference(q, k, v, causal, scale):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_kernel(q, k, v, causal=False, block_q=128, block_k=128,
+                            scale=None, interpret=False):
+    out, _ = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                            block_k=block_k, scale=scale, interpret=interpret)
+    return out
+
+
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
                     scale=None, interpret=False):
     """(B, H, T, D) or (BH, T, D) attention; T must divide by the blocks.
@@ -228,13 +292,16 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
     softmax recurrence (two-pass backward: dq over q-blocks, dk/dv over
     k-blocks) — O(T) memory in both directions. This is the long-context
     path (round 2's backward recomputed full attention in fp32 via XLA,
-    materializing the (T, T) scores the forward avoided)."""
-    out, _ = _flash_forward(q, k, v, causal=causal, block_q=block_q,
-                            block_k=block_k, scale=scale, interpret=interpret)
-    return out
+    materializing the (T, T) scores the forward avoided). First-order
+    autodiff only — see :func:`higher_order_attention` for grad-of-grad."""
+    if _HIGHER_ORDER:
+        return _attention_reference(q, k, v, causal, scale)
+    return _flash_attention_kernel(q, k, v, causal, block_q, block_k,
+                                   scale, interpret)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, scale, interpret):
+    q, k, v = map(_first_order_only, (q, k, v))
     out, lse = _flash_forward(q, k, v, causal=causal, block_q=block_q,
                               block_k=block_k, scale=scale,
                               interpret=interpret)
@@ -289,7 +356,7 @@ def _flash_bwd(causal, block_q, block_k, scale, interpret, res, g):
     return dq, dk, dv
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash_attention_kernel.defvjp(_flash_fwd, _flash_bwd)
 
 
 # ------------------- whole-head VMEM attention, packed (B, T, H*D) layout
@@ -409,20 +476,49 @@ def _mha_packed_forward(q, k, v, heads, *, causal, scale, interpret, p_dtype):
     return o, lse
 
 
+def _packed_reference(q, k, v, heads, causal, scale):
+    """XLA reference attention on the packed (B, T, H*D) layout —
+    differentiable to any order; the higher_order_attention() route."""
+    b, t, hd = q.shape
+    d = hd // heads
+
+    def hsplit(x):
+        return x.reshape(b, t, heads, d).transpose(0, 2, 1, 3)
+
+    o = _attention_reference(hsplit(q), hsplit(k), hsplit(v), causal, scale)
+    return o.transpose(0, 2, 1, 3).reshape(b, t, hd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _mha_packed_kernel(q, k, v, heads, causal=False, scale=None,
+                       interpret=False, p_dtype=jnp.float32):
+    o, _ = _mha_packed_forward(q, k, v, heads, causal=causal, scale=scale,
+                               interpret=interpret, p_dtype=p_dtype)
+    return o
+
+
 def mha_attention_packed(q, k, v, heads, causal=False, scale=None,
                          interpret=False, p_dtype=jnp.float32):
     """Attention on the packed projection layout (B, T, heads*head_dim) —
     no (B, H, T, D) transpose ever materializes, and the per-head (T, T)
     scores live only in VMEM (fwd and bwd both Pallas). ``p_dtype`` is the
     softmax probability dtype: fp32 (default) is exact; bf16 halves the
-    VPU work and wins ~17% kernel time at BERT-base bench shapes."""
-    o, _ = _mha_packed_forward(q, k, v, heads, causal=causal, scale=scale,
-                               interpret=interpret, p_dtype=p_dtype)
-    return o
+    VPU work and wins ~17% kernel time at BERT-base bench shapes. With
+    p_dtype=bf16 the backward rebuilds p as exp_bf16(s - lse) while the
+    forward computed exp_bf16(s - m)/l: the two differ by one bf16 rounding
+    (~2^-8 relative), so the VJP is the gradient of a function within bf16
+    resolution of the one the forward ran — bounded by the
+    test_bf16_probability_dtype tolerance (5e-2); fp32 (the default and
+    gradcheck config) is bitwise self-consistent. First-order autodiff
+    only — see :func:`higher_order_attention` for grad-of-grad."""
+    if _HIGHER_ORDER:
+        return _packed_reference(q, k, v, heads, causal, scale)
+    return _mha_packed_kernel(q, k, v, heads, causal, scale, interpret,
+                              p_dtype)
 
 
 def _mha_packed_fwd_rule(q, k, v, heads, causal, scale, interpret, p_dtype):
+    q, k, v = map(_first_order_only, (q, k, v))
     o, lse = _mha_packed_forward(q, k, v, heads, causal=causal, scale=scale,
                                  interpret=interpret, p_dtype=p_dtype)
     return o, (q, k, v, lse)
@@ -448,7 +544,7 @@ def _mha_packed_bwd_rule(heads, causal, scale, interpret, p_dtype, res, g):
     return dq, dk, dv
 
 
-mha_attention_packed.defvjp(_mha_packed_fwd_rule, _mha_packed_bwd_rule)
+_mha_packed_kernel.defvjp(_mha_packed_fwd_rule, _mha_packed_bwd_rule)
 
 
 def mha_attention(q, k, v, causal=False, scale=None, interpret=False,

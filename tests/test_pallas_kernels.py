@@ -212,6 +212,68 @@ class TestMhaAttentionPacked:
                                        atol=5e-2, rtol=5e-2)
 
 
+class TestHigherOrderAutodiff:
+    """The Pallas attention backwards are first-order custom-VJP kernels.
+    Default: grad-of-grad raises (JAX's custom_vjp error). Escape hatch:
+    higher_order_attention() routes the public entry points to the
+    differentiable XLA reference (round-5 verdict #7)."""
+
+    def _hvp(self, f, x, v):
+        return jax.jvp(jax.grad(f), (x,), (v,))[1]
+
+    def test_double_grad_raises_explanatory_error(self):
+        """Not the raw pallas internal error ('safe_zip() argument 2 is
+        longer') — a message naming the higher_order_attention() switch."""
+        q, k, v = (_rand(2, 32, 16) for _ in range(3))
+
+        def loss(q):
+            return jnp.sum(mha_attention_packed(q, k, v, 2, False, None, True) ** 2)
+
+        with pytest.raises(NotImplementedError, match="higher_order_attention"):
+            self._hvp(loss, q, jnp.ones_like(q))
+
+        def loss_flash(q):
+            return jnp.sum(flash_attention(q, k, v, False, 16, 16, None, True) ** 2)
+
+        with pytest.raises(NotImplementedError, match="higher_order_attention"):
+            self._hvp(loss_flash, q, jnp.ones_like(q))
+
+    def test_higher_order_context_routes_to_reference(self):
+        from deeplearning4j_tpu.ops.pallas_kernels import higher_order_attention
+
+        q, k, v = (_rand(2, 32, 16) for _ in range(3))
+        tang = jnp.asarray(RNG.normal(size=q.shape).astype(np.float32))
+
+        def loss_ref(q):
+            h = q.reshape(2, 32, 2, 8).transpose(0, 2, 1, 3)
+            hk = k.reshape(2, 32, 2, 8).transpose(0, 2, 1, 3)
+            hv = v.reshape(2, 32, 2, 8).transpose(0, 2, 1, 3)
+            return jnp.sum(_attention_reference(h, hk, hv, False, None) ** 2)
+
+        want = self._hvp(loss_ref, q, tang)
+        with higher_order_attention():
+            def loss(q):
+                return jnp.sum(
+                    mha_attention_packed(q, k, v, 2, False, None, True) ** 2)
+
+            got = self._hvp(loss, q, tang)
+            # first-order results must also still match inside the context
+            g = jax.grad(loss)(q)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_context_restores_kernel_path(self):
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            _HIGHER_ORDER, higher_order_attention)
+        import deeplearning4j_tpu.ops.pallas_kernels as pk
+
+        assert not pk._HIGHER_ORDER
+        with higher_order_attention():
+            assert pk._HIGHER_ORDER
+        assert not pk._HIGHER_ORDER
+
+
 class TestSoftmaxCrossEntropy:
     def test_matches_optax(self):
         import optax

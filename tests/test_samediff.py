@@ -331,7 +331,10 @@ class TestFusedFit:
             np.testing.assert_allclose(runs["fused"][1][n],
                                        runs["single"][1][n], atol=1e-6)
 
-    def test_listeners_force_per_step_history(self):
+    def test_unknown_listeners_force_per_step_history(self):
+        """A listener WITHOUT requiresModelAtIteration gets the conservative
+        per-step path (the fused path may only replay callbacks when the
+        listener declared it doesn't need the live model mid-chunk)."""
         calls = []
 
         class L:
@@ -343,6 +346,89 @@ class TestFusedFit:
         hist = sd.fit(batches[:5])
         assert [c[0] for c in calls] == [1, 2, 3, 4, 5]
         np.testing.assert_allclose([c[1] for c in calls], hist, rtol=1e-6)
+
+    def test_score_listener_fuses_with_identical_callbacks(self):
+        """Round-5 verdict #2: a score-only listener must NOT de-fuse
+        SameDiff.fit (config #4's 146k tok/s has a ScoreListener attached in
+        the representative setup) — callback sequence (iteration, score) and
+        final params identical to the per-step path, via the same
+        _chunk_limit/replay machinery as MultiLayerNetwork."""
+        from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+        runs = {}
+        for name, fuse in (("fused", 4), ("single", 0)):
+            sd, batches = _fit_parity_model()
+            sd.fuseSteps = fuse
+            seq = []
+
+            class Rec(ScoreIterationListener):
+                def iterationDone(self, model, it, ep):
+                    seq.append((it, float(model.score())))
+
+            sd.listeners = [Rec()]
+            hist = sd.fit(batches)   # 11 batches: 2 chunks of 4 + 3 singles
+            runs[name] = (hist, seq,
+                          {n: np.asarray(sd.getVariable(n).getArr().toNumpy())
+                           for n in ("w", "b", "w2")})
+        assert len(runs["fused"][1]) == len(runs["single"][1]) == 11
+        assert [i for i, _ in runs["fused"][1]] == \
+            [i for i, _ in runs["single"][1]]
+        np.testing.assert_allclose([s for _, s in runs["fused"][1]],
+                                   [s for _, s in runs["single"][1]],
+                                   rtol=1e-6)
+        for n in ("w", "b", "w2"):
+            np.testing.assert_allclose(runs["fused"][2][n],
+                                       runs["single"][2][n], atol=1e-6)
+
+    def test_model_boundary_listener_sees_current_values(self):
+        """A listener needing the live model at iteration k observes exactly
+        the values the per-step path shows at k (scan flushed there)."""
+        snaps = {}
+
+        class SnapAt:
+            def __init__(self, tag, at):
+                self.tag, self.at = tag, at
+
+            def requiresModelAtIteration(self, it):
+                return it in self.at
+
+            def iterationDone(self, model, it, ep):
+                if it in self.at:
+                    snaps.setdefault(self.tag, {})[it] = np.asarray(
+                        model.getVariable("w").getArr().toNumpy()).copy()
+
+        for tag, fuse in (("fused", 4), ("single", 0)):
+            sd, batches = _fit_parity_model()
+            sd.fuseSteps = fuse
+            sd.listeners = [SnapAt(tag, {3, 7})]
+            sd.fit(batches)
+        for it in (3, 7):
+            np.testing.assert_allclose(snaps["fused"][it],
+                                       snaps["single"][it], atol=1e-6)
+
+    def test_dtype_change_not_stacked_into_chunk(self):
+        """Round-4 advisor: same-shaped batches of different dtypes must not
+        np.stack into one fused chunk (silent promotion). Parity with the
+        per-step path across an fp32/fp64 batch sequence proves the
+        signature split."""
+        runs = {}
+        for name, fuse in (("fused", 4), ("single", 0)):
+            sd, batches = _fit_parity_model()
+            sd.fuseSteps = fuse
+            mixed = []
+            for i, b in enumerate(batches[:8]):
+                if i >= 4:
+                    b = {k: v.astype(np.float64) for k, v in b.items()}
+                mixed.append(b)
+            hist = sd.fit(mixed)
+            runs[name] = (hist,
+                          {n: np.asarray(sd.getVariable(n).getArr().toNumpy())
+                           for n in ("w", "b", "w2")})
+        np.testing.assert_allclose(runs["fused"][0], runs["single"][0],
+                                   rtol=1e-6)
+        for n in ("w", "b", "w2"):
+            np.testing.assert_allclose(runs["fused"][1][n],
+                                       runs["single"][1][n], atol=1e-6)
 
     def test_shape_change_drains_buffer(self):
         sd, batches = _fit_parity_model()

@@ -10,10 +10,21 @@ validating test fails here.
 Exemptions must be listed in EXEMPT with an inline justification — none are
 currently needed.
 """
+import sys
+
 import pytest
 
 from deeplearning4j_tpu.ops import coverage_report
 from deeplearning4j_tpu.ops.registry import REGISTRY
+
+# The validation tiers whose in-process run closes the ledger. Enforcement
+# requires ALL of them to have been collected in this pytest process —
+# a partial run (e.g. `pytest tests/test_ndarray.py tests/test_zz_op_gate.py`)
+# skips instead of failing with hundreds of false "unvalidated op" entries
+# (round-4 advisor finding). The registry-size pin below still runs on every
+# invocation as the tamper check.
+TIER_MODULES = ("test_op_coverage", "test_ops", "test_op_validation_r3",
+                "test_wide_ops", "test_graph_op_sweep")
 
 # op-key -> justification. Keep empty unless an op genuinely cannot be
 # validated in CI (document why inline).
@@ -38,11 +49,15 @@ def test_registry_size_pinned():
 def test_ledger_is_closed():
     done, todo = coverage_report()
     assert len(done) + len(todo) == len(REGISTRY)
+    missing_tiers = [m for m in TIER_MODULES if m not in sys.modules]
+    if missing_tiers:
+        pytest.skip(f"validation tiers not in this run: {missing_tiers} — "
+                    "run the full suite for ledger enforcement")
     if not done:
-        # the gate file was run in isolation — no tier ran in this process.
-        # ANY tier having run (even partially) enforces the full ledger.
-        pytest.skip("no validation tier ran in this process — "
-                    "run the full suite for enforcement")
+        # tier modules were COLLECTED (imported) but their bodies were
+        # deselected (-k/-m/--deselect): nothing marked, nothing to enforce
+        pytest.skip("validation tiers collected but deselected — "
+                    "run the full suite for ledger enforcement")
     open_items = [k for k in todo if k not in EXEMPT]
     assert not open_items, (
         f"{len(open_items)} registry ops have no validating test: "
