@@ -18,16 +18,9 @@ import jax.numpy as jnp
 import numpy as np
 
 
-# bf16 peak FLOPs by TPU generation (fallback: v5e)
-_PEAK = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
-
-
 def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for k, v in _PEAK.items():
-        if k in kind:
-            return v
-    return 197e12
+    from deeplearning4j_tpu.profiler.profiler import peak_flops
+    return peak_flops(device)
 
 
 def main():
@@ -89,20 +82,26 @@ def main():
 
     tokens_per_sec = B * T * steps / dt
 
-    # MFU: fwd+bwd ~ 6*N flops/token + attention 12*L*H*T flops/token
-    # (model flops only; no remat recompute occurs at bench config).
-    n_params = sum(x.size for x in jax.tree.leaves(params)) \
-        - cfg.vocab_size * cfg.hidden - cfg.max_seq * cfg.hidden  # non-embedding
-    flops_per_token = 6 * n_params + 12 * cfg.layers * cfg.hidden * T
-    achieved = tokens_per_sec * flops_per_token
+    # MFU on the repo-wide single basis (profiler.MFU_BASIS): analytic model
+    # flops, no remat recompute at bench config. XLA-counted flops for the
+    # same step live in the committed profile artifact as mfu_xla
+    # (tools/profile_flagship.py).
+    from deeplearning4j_tpu.profiler.profiler import (
+        MFU_BASIS, mfu as _mfu, non_embedding_params,
+        transformer_flops_per_token)
+    flops_per_token = transformer_flops_per_token(
+        non_embedding_params(params, cfg), cfg.layers, cfg.hidden, T)
     peak = _peak_flops(jax.devices()[0]) if on_tpu else 1e12
-    mfu = achieved / peak
+    mfu = _mfu(tokens_per_sec, flops_per_token, peak)
 
     print(json.dumps({
         "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/sec",
+        "mfu": round(mfu, 4),
+        "mfu_basis": MFU_BASIS,
         "vs_baseline": round(mfu / 0.35, 4),
+        "vs_baseline_basis": "mfu / 0.35 north-star gate (BASELINE.json)",
     }))
 
 

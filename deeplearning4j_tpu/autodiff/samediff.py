@@ -685,6 +685,13 @@ class SameDiff:
     # tunnel's per-dispatch latency dominates small whole-graph steps:
     # config #4 measured ~110 ms/step wall for ~30 ms of compute)
     fuseSteps: int = 8
+    # how many fused chunks score-only listener callbacks may lag the
+    # dispatch head before a forced batched replay (staleness bound; the
+    # replay itself is one bulk device->host transfer — see drain_pending).
+    # 0 = replay right after each chunk (live streaming, pays one host
+    # round trip per chunk — on tunneled/remote devices that round trip is
+    # ~100x the per-chunk compute at small step sizes)
+    listenerReplayLag: int = 16
 
     def _train_multi_fn(self):
         key = "train_multi"
@@ -798,11 +805,48 @@ class SameDiff:
             return tuple(sorted((k, np.shape(v), str(jnp.result_type(v)))
                                 for k, v in ph.items()))
 
+        dispatched = 0     # steps dispatched to the device (dispatch head)
+        pending: list = []  # FIFO of (k, device losses) chunks not yet replayed
+
+        def drain_pending(keep: int = 0):
+            """Replay buffered chunks' callbacks (all but the newest ``keep``)
+            in step order. With listeners, ALL drained chunks' losses move
+            device->host in ONE batched transfer: under the axon tunnel any
+            host read costs a full round trip (~hundreds of ms) regardless of
+            readiness, so per-chunk syncing erased the fusing win (measured
+            148k -> 101k tok/s on bench config #4). Score-only listeners
+            (requiresModelAtIteration False) therefore receive their
+            callbacks LATE — batched at fit end / every listenerReplayLag
+            chunks — but in exact order with exact scores; listeners that
+            need the live model still flush synchronously at their declared
+            boundaries (see flush())."""
+            if len(pending) <= keep:
+                return
+            drain, rest = pending[:len(pending) - keep], pending[len(pending) - keep:]
+            pending[:] = rest
+            if self.listeners:
+                flat = np.asarray(jnp.concatenate(
+                    [jnp.ravel(l) for _, l in drain])).astype(float)
+                off = 0
+                items = []
+                for k, _ in drain:
+                    items.append((k, flat[off:off + k]))
+                    off += k
+                drain = items
+            for k, losses in drain:
+                for j in range(k):
+                    history.append(losses[j])
+                    self._score = losses[j]
+                    for lst in self.listeners:
+                        lst.iterationDone(self, len(history), 0)
+
         def run_single(ph):
-            nonlocal trainables
+            nonlocal trainables, dispatched
+            drain_pending()   # keep callback order: chunks before this step
             phj = {k: jnp.asarray(v) for k, v in ph.items()}
             trainables, self._opt_state, loss = step(trainables, frozen,
                                                      self._opt_state, phj)
+            dispatched += 1
             history.append(loss)   # device scalar; bulk-synced below
             self._score = loss
             # listeners read current values (StatsListener param stats)
@@ -811,10 +855,10 @@ class SameDiff:
                 lst.iterationDone(self, len(history), 0)
 
         def flush(buf):
-            nonlocal trainables
+            nonlocal trainables, dispatched
             from deeplearning4j_tpu.nn.multilayer import _chunk_limit
             while buf:
-                k = _chunk_limit(self.listeners, len(history), fuse_k)
+                k = _chunk_limit(self.listeners, dispatched, fuse_k)
                 if k <= 1:
                     # a listener needs the live model at the very next
                     # iteration: run it as a single (exact semantics)
@@ -831,35 +875,62 @@ class SameDiff:
                     trainables, self._opt_state, frozen, stacked)
                 # rebind after every chunk: the jit donated the previous
                 # buffers, and self._values must never dangle on deleted
-                # arrays if a later batch raises mid-fit. Listeners then
-                # see the chunk-end model — _chunk_limit guaranteed none
-                # of them needed it mid-chunk.
+                # arrays if a later batch raises mid-fit.
                 self._values.update(trainables)
-                for j in range(k):
-                    history.append(losses[j])
-                    self._score = losses[j]
-                    for lst in self.listeners:
-                        lst.iterationDone(self, len(history), 0)
+                dispatched += k
+                pending.append((k, losses))
+                if any(getattr(l, "requiresModelAtIteration",
+                               lambda it: True)(dispatched)
+                       for l in self.listeners):
+                    # a listener must observe the model exactly as of this
+                    # chunk boundary — replay now, before anything newer
+                    # overwrites self._values
+                    drain_pending()
+                else:
+                    # score-only replays lag the dispatch head by up to
+                    # listenerReplayLag chunks (staleness bound for long
+                    # fits), then drain in one batched transfer
+                    drain_pending(keep=max(int(self.listenerReplayLag), 0))
             return buf
 
-        for _ in range(epochs):
-            for ds in data:
-                ph = ph_host(ds)
-                if fuse_k > 1:
-                    if buf and _sig(buf[0]) != _sig(ph):
-                        for b in buf:   # shape change: drain as singles
-                            run_single(b)
-                        buf = []
-                    buf.append(ph)
-                    buf = flush(buf)
-                else:
-                    run_single(ph)
-        for b in buf:   # leftover (< fuseSteps) steps run individually
-            run_single(b)
+        try:
+            for _ in range(epochs):
+                for ds in data:
+                    ph = ph_host(ds)
+                    if fuse_k > 1:
+                        if buf and _sig(buf[0]) != _sig(ph):
+                            for b in buf:   # shape change: drain as singles
+                                run_single(b)
+                            buf = []
+                        buf.append(ph)
+                        buf = flush(buf)
+                    else:
+                        run_single(ph)
+            for b in buf:   # leftover (< fuseSteps) steps run individually
+                run_single(b)
+            drain_pending()
+        except BaseException:
+            # an exception mid-fit must not lose the callbacks/scores of
+            # chunks that DID complete (pending holds completed chunks
+            # only); never mask the original error with a replay failure
+            try:
+                drain_pending()
+            except Exception:
+                pass
+            raise
         self._values.update(trainables)
-        if history:  # ONE bulk device->host transfer instead of one per step
-            import numpy as _np
-            history = _np.asarray(jnp.stack(history)).astype(float).tolist()
+        if history:
+            # ONE bulk device->host transfer for whatever is still on
+            # device. Replayed entries are already host floats (listener
+            # path) — re-stacking those onto the device just to read them
+            # back would cost a second tunnel round trip.
+            dev = [(i, h) for i, h in enumerate(history)
+                   if not isinstance(h, float)]
+            if dev:
+                vals = np.asarray(jnp.stack([h for _, h in dev])).astype(float)
+                for (i, _), v in zip(dev, vals):
+                    history[i] = float(v)
+            history = [float(h) for h in history]
         return history
 
     def score(self) -> float:

@@ -7,3 +7,6 @@ from deeplearning4j_tpu.data.fetchers import (  # noqa: F401
     Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator, MnistDataSetIterator,
     TinyImageNetDataSetIterator,
 )
+from deeplearning4j_tpu.data.sharding import (  # noqa: F401
+    ShardedDataSetIterator, ShardedInputSplit, ShardSpec, shard,
+)

@@ -195,7 +195,13 @@ def _chunk_limit(listeners, iteration: int, fuse_k: int) -> int:
 def _replay_chunk(net, losses, k: int):
     """Replay k buffered per-step losses to listeners after a fused chunk —
     the same callback sequence the per-step path fires, with the model
-    synced at chunk end (= every requiresModelAtIteration boundary)."""
+    synced at chunk end (= every requiresModelAtIteration boundary). With
+    listeners attached, the chunk's losses move device->host in ONE bulk
+    transfer first: under a tunneled device every host read is a full round
+    trip, so per-callback ``score()`` syncs would serialize the replay
+    (round-5; same rationale as SameDiff.fit's batched drain)."""
+    if net.listeners:
+        losses = np.asarray(losses).astype(float)
     for j in range(k):
         net._score = losses[j]
         net._iteration += 1

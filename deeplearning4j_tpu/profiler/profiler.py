@@ -220,3 +220,48 @@ def mfu(tokens_per_sec: float, flops_per_token: float,
     """Model FLOPs utilization. ``peak_flops`` defaults to one TPU v5e chip
     (197 TFLOP/s bf16)."""
     return tokens_per_sec * flops_per_token / peak_flops
+
+
+# ---- THE single flop-counting basis for committed MFU numbers --------
+# Round-5 verdict #5: bench.py quoted analytic-flop MFU (~61%) while the
+# profile artifact quoted XLA-counted MFU (56.6%) for the same workload,
+# with neither stating its basis. Every committed headline MFU now uses
+# ``MFU_BASIS`` below; XLA cost-analysis numbers are reported alongside as
+# ``mfu_xla`` (XLA counts implementation flops — e.g. attention-softmax
+# rebuilds, remat — so it sits a few points off the analytic model number;
+# both are valid, they answer different questions).
+
+MFU_BASIS = "analytic_model_flops: 6*N_nonemb + 12*L*H*T per token"
+
+# bf16 peak FLOP/s by TPU generation (fallback: v5e)
+PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+
+
+def peak_flops(device) -> float:
+    """bf16 peak for a jax device (by device_kind; v5e fallback)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return 197e12
+
+
+def transformer_flops_per_token(n_params_non_embedding: int, layers: int,
+                                hidden: int, seq_len: int) -> float:
+    """Analytic model flops per trained token for a dense transformer:
+    6*N (fwd 2N + bwd 4N matmul flops on non-embedding params) plus the
+    attention interior 12*L*H*T (QK^T + PV, fwd+bwd). The standard
+    PaLM-appendix accounting; no remat recompute included."""
+    return 6 * n_params_non_embedding + 12 * layers * hidden * seq_len
+
+
+def non_embedding_params(params, cfg) -> int:
+    """Non-embedding parameter count for the flagship transformer pytree —
+    the N in ``transformer_flops_per_token``. One definition shared by
+    bench.py and tools/profile_flagship.py (embedding lookups do ~0 matmul
+    flops, so tok/pos embedding tables are excluded; the untied lm_head
+    stays in)."""
+    import jax
+
+    total = sum(int(x.size) for x in jax.tree.leaves(params))
+    return total - cfg.vocab_size * cfg.hidden - cfg.max_seq * cfg.hidden

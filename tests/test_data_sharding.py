@@ -1,0 +1,237 @@
+"""Multi-host input sharding (round-5 verdict #6; ref: the reference's Spark
+executors each training on their own RDD partition via rdd.mapPartitions,
+SURVEY.md §3.5). Unit tests on the wrappers, plus a REAL 2-process
+jax.distributed run where each process reads a DISJOINT shard via the
+public shard() API and the result matches a single-host golden."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data import (
+    DataSet, ListDataSetIterator, ShardSpec, ShardedDataSetIterator,
+    ShardedInputSplit, shard)
+from deeplearning4j_tpu.datavec.split import CollectionInputSplit, FileSplit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stream(n, b=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.normal(size=(b, 6)).astype(np.float32),
+                    rng.normal(size=(b, 2)).astype(np.float32))
+            for _ in range(n)]
+
+
+class TestShardSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ShardSpec(2, 2)
+        with pytest.raises(ValueError):
+            ShardSpec(-1, 2)
+
+    def test_current_single_process(self):
+        spec = ShardSpec.current()
+        assert (spec.index, spec.count) == (0, 1)
+
+
+class TestShardedInputSplit:
+    def test_disjoint_and_covering(self):
+        base = CollectionInputSplit([f"f{i}" for i in range(10)])
+        shards = [ShardedInputSplit(base, ShardSpec(i, 3)).locations()
+                  for i in range(3)]
+        assert [len(s) for s in shards] == [4, 3, 3]  # balanced within 1
+        seen = [p for s in shards for p in s]
+        assert sorted(seen) == sorted(base.locations())
+        assert len(set(seen)) == 10  # disjoint
+
+    def test_file_split_deterministic_order(self, tmp_path):
+        for i in range(6):
+            (tmp_path / f"r{i}.csv").write_text("x")
+        base = FileSplit(str(tmp_path), allowFormats=[".csv"])
+        a = ShardedInputSplit(base, ShardSpec(0, 2)).locations()
+        b = ShardedInputSplit(base, ShardSpec(1, 2)).locations()
+        assert len(a) == len(b) == 3 and not set(a) & set(b)
+
+    def test_shard_dispatch(self):
+        base = CollectionInputSplit(["a", "b", "c"])
+        assert isinstance(shard(base, 0, 2), ShardedInputSplit)
+        assert shard(base, 1, 2).locations() == ["b"]
+        with pytest.raises(TypeError):
+            shard(42, 0, 2)
+
+
+class TestShardedDataSetIterator:
+    def test_round_robin_assignment_drops_partial_round(self):
+        """7 batches / 2 shards: the incomplete final round (batch 6) is
+        dropped by BOTH shards — every shard steps exactly 3 times, so a
+        lockstep collective per step cannot hang on an uneven tail."""
+        data = _stream(7)
+        got = {i: list(shard(ListDataSetIterator(data), i, 2)) for i in range(2)}
+        assert [d.features.tolist() for d in got[0]] == \
+            [data[j].features.tolist() for j in (0, 2, 4)]
+        assert [d.features.tolist() for d in got[1]] == \
+            [data[j].features.tolist() for j in (1, 3, 5)]
+        assert len(got[0]) == len(got[1]) == 3
+
+    def test_keep_partial_round_option(self):
+        data = _stream(7)
+        a = list(ShardedDataSetIterator(ListDataSetIterator(data),
+                                        ShardSpec(0, 2),
+                                        drop_partial_round=False))
+        b = list(ShardedDataSetIterator(ListDataSetIterator(data),
+                                        ShardSpec(1, 2),
+                                        drop_partial_round=False))
+        assert len(a) == 4 and len(b) == 3  # within-1 tail kept on request
+
+    def test_shard_arg_validation(self):
+        it = ListDataSetIterator(_stream(4))
+        with pytest.raises(ValueError, match="both index and count"):
+            shard(it, count=2)
+        with pytest.raises(ValueError, match="both index and count"):
+            shard(it, index=1)
+
+    def test_reset_replays(self):
+        it = shard(ListDataSetIterator(_stream(6)), 1, 3)
+        first = [d.features.sum() for d in it]
+        again = [d.features.sum() for d in it]   # __iter__ resets
+        assert first == again and len(first) == 2
+
+    def test_explicit_spi_calls(self):
+        it = ShardedDataSetIterator(ListDataSetIterator(_stream(4), 4),
+                                    ShardSpec(0, 2))
+        it.reset()
+        n = 0
+        while it.hasNext():
+            it.next()
+            n += 1
+        assert n == 2
+        assert it.batch() == 4
+        with pytest.raises(StopIteration):
+            it.next()
+
+    def test_global_step_order_reconstruction(self):
+        """step s's global batch = concat of every shard's step-s batch, in
+        shard order — the property that makes single-host goldens exact."""
+        data = _stream(8)
+        its = [list(shard(ListDataSetIterator(data), i, 2)) for i in range(2)]
+        for s in range(4):
+            np.testing.assert_array_equal(its[0][s].features,
+                                          data[2 * s].features)
+            np.testing.assert_array_equal(its[1][s].features,
+                                          data[2 * s + 1].features)
+
+
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+outdir = sys.argv[4]
+
+from deeplearning4j_tpu.parallel import multihost
+multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
+                     num_processes=nproc, process_id=pid)
+
+import numpy as np, jax.numpy as jnp
+import jax.experimental.multihost_utils as mhu
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator, shard
+from deeplearning4j_tpu.models import TransformerConfig, init_params
+from deeplearning4j_tpu.models.bert import make_train_step, place_params
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+cfg = TransformerConfig(vocab_size=128, hidden=32, layers=2, heads=4,
+                        mlp_dim=64, max_seq=32, remat=False, dtype=jnp.float32)
+mesh = make_mesh({"data": jax.device_count()})
+init_state, step_fn = make_train_step(cfg, mesh)
+
+# every process builds the SAME deterministic global batch stream, then the
+# public shard() API (defaulting to jax.process_index()/process_count())
+# hands each one its disjoint round-robin shard — no hand-rolled seeding
+rng = np.random.default_rng(7)
+B, T = 4, 16
+stream = []
+for _ in range(8):
+    toks = rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    stream.append(DataSet(toks, toks))
+it = shard(ListDataSetIterator(stream))
+assert isinstance(it.spec.count, int) and it.spec.count == nproc
+
+params = place_params(init_params(jax.random.PRNGKey(0), cfg), cfg, mesh)
+opt = init_state(params)
+steps = 0
+for ds in it:
+    batch = mhu.host_local_array_to_global_array(
+        {"tokens": ds.features, "targets": ds.labels,
+         "weights": np.ones((B, T), np.float32)},
+        mesh, jax.sharding.PartitionSpec("data"))
+    params, opt, loss = step_fn(params, opt, batch)
+    steps += 1
+assert steps == len(stream) // nproc, steps
+flat = np.concatenate([np.ravel(np.asarray(l))
+                       for l in jax.tree_util.tree_leaves(params)])
+if pid == 0:
+    np.save(os.path.join(outdir, "final_params.npy"), flat)
+print(f"proc {pid}: DONE steps={steps}", flush=True)
+"""
+
+
+@pytest.mark.slow
+class TestTwoProcessShardedData:
+    def test_disjoint_shards_match_single_host_golden(self, tmp_path):
+        """2 jax.distributed processes, each reading its shard via the
+        public shard() API (no hand-rolled per-host seeding): final params
+        must equal a single-host run whose step-s batch is the concatenation
+        of the shards' step-s batches."""
+        script = tmp_path / "worker.py"
+        script.write_text(_WORKER)
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", "29881", str(tmp_path)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True) for i in range(2)]
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {i} failed:\n{out}"
+            assert "DONE steps=4" in out, out
+        got = np.load(tmp_path / "final_params.npy")
+
+        # single-host golden: same stream, global batch = concat of the two
+        # shards' step batches (round-robin order: 2s, 2s+1)
+        from deeplearning4j_tpu.models import TransformerConfig, init_params
+        from deeplearning4j_tpu.models.bert import make_train_step, place_params
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+        cfg = TransformerConfig(vocab_size=128, hidden=32, layers=2, heads=4,
+                                mlp_dim=64, max_seq=32, remat=False,
+                                dtype=jnp.float32)
+        rng = np.random.default_rng(7)
+        B, T = 4, 16
+        stream = [rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+                  for _ in range(8)]
+        mesh = make_mesh({"data": 4})
+        init_state, step_fn = make_train_step(cfg, mesh)
+        params = place_params(init_params(jax.random.PRNGKey(0), cfg),
+                              cfg, mesh)
+        opt = init_state(params)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        bsh = NamedSharding(mesh, P("data"))
+        for s in range(4):
+            toks = np.concatenate([stream[2 * s], stream[2 * s + 1]])
+            batch = {"tokens": jax.device_put(jnp.asarray(toks), bsh),
+                     "targets": jax.device_put(jnp.asarray(toks), bsh),
+                     "weights": jax.device_put(
+                         jnp.ones((2 * B, T), jnp.float32), bsh)}
+            params, opt, _ = step_fn(params, opt, batch)
+        want = np.concatenate([np.ravel(np.asarray(l))
+                               for l in jax.tree_util.tree_leaves(params)])
+        np.testing.assert_allclose(got, want, atol=1e-5)

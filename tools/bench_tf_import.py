@@ -31,6 +31,12 @@ def main():
     # (TrainingConfig.computeDtype) is the whole-graph-compile payoff this
     # config exists to show (fp32 numbers stay reproducible via --dtype FLOAT)
     ap.add_argument("--dtype", default="HALF", choices=["FLOAT", "HALF"])
+    # representative configuration (round-5 verdict #2): a score listener
+    # attached the way reference users run sd.fit — must stay within ~5%
+    # of the listener-free number now that SameDiff.fit fuses through
+    # listeners via requiresModelAtIteration chunking
+    ap.add_argument("--listener", action="store_true",
+                    help="attach ScoreIterationListener(10) during timing")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() not in ("cpu",)
@@ -67,6 +73,10 @@ def main():
         updater=Adam(1e-4),
         computeDtype="HALF" if args.dtype == "HALF" else None))
 
+    if args.listener:
+        from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+        sd.listeners = [ScoreIterationListener(printIterations=10)]
+
     rng = np.random.default_rng(0)
     batch = {in_name: rng.integers(0, V, (B, T)).astype(np.int32),
              "targets": rng.integers(0, V, (B, T)).astype(np.int32)}
@@ -76,21 +86,34 @@ def main():
     # tunnel every step (measured 130 ms/step vs ~30 ms compute at these
     # shapes, BASELINE.md round 4)
     sd.fit([batch] * warmup)
-    t0 = time.perf_counter()
-    hist = sd.fit([batch] * steps)
-    dt = time.perf_counter() - t0
-    assert len(hist) == steps
+    # median of 3 timing windows, mirroring bench.py: the first post-warmup
+    # fit window pays a one-off multi-second transient (measured identically
+    # with and without listeners) and the tunnel adds per-window noise —
+    # a single window reports the transient, the median reports steady state
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        hist = sd.fit([batch] * steps)
+        dts.append(time.perf_counter() - t0)
+        assert len(hist) == steps
+    dt = sorted(dts)[1]
 
     tokens_per_sec = B * T * steps / dt
+    from deeplearning4j_tpu.profiler.profiler import (
+        MFU_BASIS, mfu as _mfu, transformer_flops_per_token)
     n_emb = V * H + T * H
-    flops_per_token = 6 * (n_param - n_emb + H * V) + 12 * L * H * T
+    flops_per_token = transformer_flops_per_token(
+        n_param - n_emb + H * V, L, H, T)
     peak = _peak_flops(jax.devices()[0]) if on_tpu else 1e12
-    mfu = tokens_per_sec * flops_per_token / peak
+    mfu = _mfu(tokens_per_sec, flops_per_token, peak)
     print(json.dumps({
         "metric": "bert_base_tf_import_finetune_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/sec",
         "dtype": args.dtype,
+        "listener": bool(args.listener),
+        "mfu": round(mfu, 4),
+        "mfu_basis": MFU_BASIS,
         "vs_baseline": round(mfu / 0.35, 4),
     }))
 

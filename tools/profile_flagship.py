@@ -113,11 +113,18 @@ def _build(variant: str):
         "targets": jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32),
         "weights": jnp.ones((B, T), jnp.float32),
     }
-    return jstep, params, opt_state, batch, B * T
+    # analytic flops/token from the LIVE param pytree + the actual T (the
+    # same shared helpers as bench.py — derived, not hand-expanded, so it
+    # cannot drift from the step _build actually runs)
+    from deeplearning4j_tpu.profiler.profiler import (
+        non_embedding_params, transformer_flops_per_token)
+    fpt = transformer_flops_per_token(
+        non_embedding_params(params, cfg), cfg.layers, cfg.hidden, T)
+    return jstep, params, opt_state, batch, B * T, fpt
 
 
 def _time_variant(variant: str, steps: int, warmup: int = 3):
-    jstep, params, opt_state, batch, ntok = _build(variant)
+    jstep, params, opt_state, batch, ntok, fpt = _build(variant)
     lowered = jstep.lower(params, opt_state, batch)
     compiled = lowered.compile()
     cost = compiled.cost_analysis()
@@ -139,7 +146,13 @@ def _time_variant(variant: str, steps: int, warmup: int = 3):
         float(loss)
         dts.append((time.perf_counter() - t0) / steps)
     dt = sorted(dts)[1]
-    return {
+    # both MFU bases side by side (round-5 verdict #5): the headline uses
+    # the analytic basis (profiler.MFU_BASIS, same as bench.py, computed
+    # from the live params in _build); mfu_xla divides XLA's implementation-
+    # flop count by peak — a few points lower is expected, not a discrepancy
+    from deeplearning4j_tpu.profiler.profiler import mfu as _mfu, peak_flops
+    peak = peak_flops(jax.devices()[0])
+    row = {
         "variant": variant,
         "step_ms": round(dt * 1e3, 2),
         "tokens_per_sec": round(ntok / dt, 0),
@@ -147,7 +160,14 @@ def _time_variant(variant: str, steps: int, warmup: int = 3):
         "xla_bytes_accessed": bytes_acc,
         "sustained_gbps": round(bytes_acc / dt / 1e9, 1),
         "achieved_tflops": round(flops / dt / 1e12, 2),
+        "mfu_xla": round(flops / dt / peak, 4),
     }
+    if variant in ("baseline", "xla_attention", "xla_softmax_fp32",
+                   "kernel_softmax_bf16"):
+        # analytic MFU only where the variant runs the FULL train step —
+        # ablated steps do fewer model flops than the analytic count assumes
+        row["mfu_analytic"] = round(_mfu(ntok / dt, fpt, peak), 4)
+    return row
 
 
 def main():
