@@ -687,7 +687,7 @@ class SameDiff:
     fuseSteps: int = 8
     # how many fused chunks score-only listener callbacks may lag the
     # dispatch head before a forced batched replay (staleness bound; the
-    # replay itself is one bulk device->host transfer — see drain_pending).
+    # replay itself is one bulk device->host transfer — see _ReplayQueue).
     # 0 = replay right after each chunk (live streaming, pays one host
     # round trip per chunk — on tunneled/remote devices that round trip is
     # ~100x the per-chunk compute at small step sizes)
@@ -805,48 +805,35 @@ class SameDiff:
             return tuple(sorted((k, np.shape(v), str(jnp.result_type(v)))
                                 for k, v in ph.items()))
 
-        dispatched = 0     # steps dispatched to the device (dispatch head)
-        pending: list = []  # FIFO of (k, device losses) chunks not yet replayed
+        # Lagged, batched listener replay — the SHARED queue (see
+        # nn.multilayer._ReplayQueue): with listeners, drained chunks'
+        # losses move device->host in ONE batched transfer (under the axon
+        # tunnel any host read costs a full round trip regardless of
+        # readiness; per-chunk syncing erased the fusing win, measured
+        # 148k -> 101k tok/s on bench config #4). Score-only listeners get
+        # their callbacks LATE — batched at fit end / every
+        # listenerReplayLag chunks — but in exact order with exact scores;
+        # listeners that need the live model flush synchronously at their
+        # declared boundaries (rq.push).
+        from deeplearning4j_tpu.nn.multilayer import _ReplayQueue, _chunk_limit
 
-        def drain_pending(keep: int = 0):
-            """Replay buffered chunks' callbacks (all but the newest ``keep``)
-            in step order. With listeners, ALL drained chunks' losses move
-            device->host in ONE batched transfer: under the axon tunnel any
-            host read costs a full round trip (~hundreds of ms) regardless of
-            readiness, so per-chunk syncing erased the fusing win (measured
-            148k -> 101k tok/s on bench config #4). Score-only listeners
-            (requiresModelAtIteration False) therefore receive their
-            callbacks LATE — batched at fit end / every listenerReplayLag
-            chunks — but in exact order with exact scores; listeners that
-            need the live model still flush synchronously at their declared
-            boundaries (see flush())."""
-            if len(pending) <= keep:
-                return
-            drain, rest = pending[:len(pending) - keep], pending[len(pending) - keep:]
-            pending[:] = rest
-            if self.listeners:
-                flat = np.asarray(jnp.concatenate(
-                    [jnp.ravel(l) for _, l in drain])).astype(float)
-                off = 0
-                items = []
-                for k, _ in drain:
-                    items.append((k, flat[off:off + k]))
-                    off += k
-                drain = items
-            for k, losses in drain:
-                for j in range(k):
-                    history.append(losses[j])
-                    self._score = losses[j]
-                    for lst in self.listeners:
-                        lst.iterationDone(self, len(history), 0)
+        def _replay(losses, k):
+            for j in range(k):
+                history.append(losses[j])
+                self._score = losses[j]
+                for lst in self.listeners:
+                    lst.iterationDone(self, len(history), 0)
+
+        rq = _ReplayQueue(self, replay=_replay)
+        rq.dispatched = 0   # iteration numbers are per-fit (len(history))
 
         def run_single(ph):
-            nonlocal trainables, dispatched
-            drain_pending()   # keep callback order: chunks before this step
+            nonlocal trainables
+            rq.drain()   # keep callback order: chunks before this step
             phj = {k: jnp.asarray(v) for k, v in ph.items()}
             trainables, self._opt_state, loss = step(trainables, frozen,
                                                      self._opt_state, phj)
-            dispatched += 1
+            rq.dispatched += 1
             history.append(loss)   # device scalar; bulk-synced below
             self._score = loss
             # listeners read current values (StatsListener param stats)
@@ -855,10 +842,9 @@ class SameDiff:
                 lst.iterationDone(self, len(history), 0)
 
         def flush(buf):
-            nonlocal trainables, dispatched
-            from deeplearning4j_tpu.nn.multilayer import _chunk_limit
+            nonlocal trainables
             while buf:
-                k = _chunk_limit(self.listeners, dispatched, fuse_k)
+                k = _chunk_limit(self.listeners, rq.dispatched, fuse_k)
                 if k <= 1:
                     # a listener needs the live model at the very next
                     # iteration: run it as a single (exact semantics)
@@ -875,22 +861,11 @@ class SameDiff:
                     trainables, self._opt_state, frozen, stacked)
                 # rebind after every chunk: the jit donated the previous
                 # buffers, and self._values must never dangle on deleted
-                # arrays if a later batch raises mid-fit.
+                # arrays if a later batch raises mid-fit. rq.push replays
+                # synchronously when a boundary listener needs the model
+                # as of this chunk end, lagged+batched otherwise.
                 self._values.update(trainables)
-                dispatched += k
-                pending.append((k, losses))
-                if any(getattr(l, "requiresModelAtIteration",
-                               lambda it: True)(dispatched)
-                       for l in self.listeners):
-                    # a listener must observe the model exactly as of this
-                    # chunk boundary — replay now, before anything newer
-                    # overwrites self._values
-                    drain_pending()
-                else:
-                    # score-only replays lag the dispatch head by up to
-                    # listenerReplayLag chunks (staleness bound for long
-                    # fits), then drain in one batched transfer
-                    drain_pending(keep=max(int(self.listenerReplayLag), 0))
+                rq.push(losses, k)
             return buf
 
         try:
@@ -908,13 +883,13 @@ class SameDiff:
                         run_single(ph)
             for b in buf:   # leftover (< fuseSteps) steps run individually
                 run_single(b)
-            drain_pending()
+            rq.drain()
         except BaseException:
             # an exception mid-fit must not lose the callbacks/scores of
             # chunks that DID complete (pending holds completed chunks
             # only); never mask the original error with a replay failure
             try:
-                drain_pending()
+                rq.drain()
             except Exception:
                 pass
             raise

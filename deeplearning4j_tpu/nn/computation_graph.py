@@ -184,6 +184,8 @@ class ComputationGraph:
 
     # see MultiLayerNetwork.fuseSteps — same de-dispatch rationale
     fuseSteps: int = 8
+    # see MultiLayerNetwork.listenerReplayLag — lagged batched replay
+    listenerReplayLag: int = 16
 
     def _build_multi_step(self):
         """``fuseSteps`` steps in one executable (lax.scan over stacked
@@ -265,8 +267,11 @@ class ComputationGraph:
         # listeners no longer disable fusing — see MultiLayerNetwork._fit_impl
         fuse_k = 0 if stats else self.fuseSteps
         buf: list = []  # (features tuple, labels tuple) host batches
+        from deeplearning4j_tpu.nn.multilayer import _ReplayQueue
+        rq = _ReplayQueue(self)
 
         def run_single(mds):
+            rq.drain()   # callback order: buffered chunks before this step
             raws = [_unwrap(f) for f in mds.features] + \
                    [_unwrap(y) for y in mds.labels]
             maskless = not any(m is not None
@@ -299,6 +304,7 @@ class ComputationGraph:
                     lmasks, fmasks)
             self._score = loss  # device scalar; score() syncs on demand
             self._iteration += 1
+            rq.dispatched += 1
             for lst in self.listeners:
                 lst.iterationDone(self, self._iteration, self._epoch)
 
@@ -309,9 +315,9 @@ class ComputationGraph:
 
         def flush(buf):
             from deeplearning4j_tpu.nn.multilayer import (
-                _chain_split, _chunk_limit, _replay_chunk, _stack_batches)
+                _chain_split, _chunk_limit, _stack_batches)
             while buf:
-                k = _chunk_limit(self.listeners, self._iteration, fuse_k)
+                k = _chunk_limit(self.listeners, rq.dispatched, fuse_k)
                 if k <= 1:
                     run_single(buf[0][2])
                     buf = buf[1:]
@@ -338,35 +344,44 @@ class ComputationGraph:
                 (self._params, self._state, self._opt_state,
                  losses) = multi(self._params, self._state,
                                  self._opt_state, inputs, ys, rngs)
-                _replay_chunk(self, losses, k)
+                rq.push(losses, k)
             return buf
 
         def _sig(mds):
             return ([np.shape(f) for f in mds.features],
                     [np.shape(y) for y in mds.labels])
 
-        for _ in range(epochs):
-            for ds in data:
-                mds = ds.toMultiDataSet() if isinstance(ds, DataSet) else ds
-                maskfree = not any(m is not None
-                                   for m in (mds.features_masks or [])) \
-                    and not any(m is not None for m in (mds.labels_masks or []))
-                if fuse_k > 1 and maskfree:
-                    if buf and _sig(buf[0][2]) != _sig(mds):
-                        buf = drain(buf)  # shape change: drain as singles
-                    buf.append((mds.features, mds.labels, mds))
-                    buf = flush(buf)
-                else:
-                    # masked batch: buffered earlier steps apply FIRST
-                    # (sequential SGD order, round-3 advisor)
-                    buf = drain(buf)
-                    run_single(mds)
-            # epoch boundary: apply leftovers before onEpochEnd
-            buf = drain(buf)
-            self._epoch += 1
-            for lst in self.listeners:
-                if hasattr(lst, "onEpochEnd"):
-                    lst.onEpochEnd(self)
+        try:
+            for _ in range(epochs):
+                for ds in data:
+                    mds = ds.toMultiDataSet() if isinstance(ds, DataSet) else ds
+                    maskfree = not any(m is not None
+                                       for m in (mds.features_masks or [])) \
+                        and not any(m is not None
+                                    for m in (mds.labels_masks or []))
+                    if fuse_k > 1 and maskfree:
+                        if buf and _sig(buf[0][2]) != _sig(mds):
+                            buf = drain(buf)  # shape change: drain as singles
+                        buf.append((mds.features, mds.labels, mds))
+                        buf = flush(buf)
+                    else:
+                        # masked batch: buffered earlier steps apply FIRST
+                        # (sequential SGD order, round-3 advisor)
+                        buf = drain(buf)
+                        run_single(mds)
+                # epoch boundary: apply leftovers before onEpochEnd
+                buf = drain(buf)
+                rq.drain()
+                self._epoch += 1
+                for lst in self.listeners:
+                    if hasattr(lst, "onEpochEnd"):
+                        lst.onEpochEnd(self)
+        except BaseException:
+            try:
+                rq.drain()   # deliver completed chunks' callbacks
+            except Exception:
+                pass
+            raise
         return self
 
     # ------------------------------------------------------------- inference

@@ -192,15 +192,69 @@ def _chunk_limit(listeners, iteration: int, fuse_k: int) -> int:
     return k
 
 
+class _ReplayQueue:
+    """Lagged, batched listener replay for the fused fit paths (round 5,
+    shared by MultiLayerNetwork and ComputationGraph — same design as
+    SameDiff.fit's drain_pending). Completed chunks' device losses queue
+    here; score-only listener callbacks replay up to ``listenerReplayLag``
+    chunks behind the dispatch head, and each drain moves ALL drained
+    chunks' losses device->host in ONE transfer — on a tunneled device any
+    host read is a full round trip, so per-chunk syncing serializes the
+    scan pipeline (measured -32% on the SameDiff bench before this).
+    ``dispatched`` tracks the dispatch head for _chunk_limit; the net's
+    ``_iteration`` advances only at replay (so listeners see exact
+    per-step iteration numbers)."""
+
+    def __init__(self, net, replay=None):
+        self.net = net
+        # replay(losses, k): fire one chunk's worth of per-step callbacks.
+        # Default is the MLN/CG _replay_chunk; SameDiff.fit passes its own
+        # (history-indexed iteration numbers) so all three fit paths share
+        # THIS queue/transfer logic instead of three hand-rolled copies.
+        self.replay = replay or (lambda losses, k: _replay_chunk(net, losses, k))
+        self.pending: list = []
+        self.dispatched = getattr(net, "_iteration", 0)
+
+    def push(self, losses, k: int):
+        self.dispatched += k
+        self.pending.append((k, losses))
+        need_model = any(
+            getattr(l, "requiresModelAtIteration", lambda it: True)(
+                self.dispatched) for l in self.net.listeners)
+        if need_model or not self.net.listeners:
+            # boundary listeners must observe the model exactly as of this
+            # chunk end (before anything newer overwrites it); without
+            # listeners the replay is free bookkeeping — keep it current
+            self.drain()
+        else:
+            self.drain(keep=max(
+                int(getattr(self.net, "listenerReplayLag", 16)), 0))
+
+    def drain(self, keep: int = 0):
+        if len(self.pending) <= keep:
+            return
+        take = self.pending[:len(self.pending) - keep]
+        self.pending = self.pending[len(self.pending) - keep:]
+        if self.net.listeners:
+            flat = np.asarray(jnp.concatenate(
+                [jnp.ravel(l) for _, l in take])).astype(float)
+            off = 0
+            for k, _ in take:
+                self.replay(flat[off:off + k], k)
+                off += k
+        else:
+            for k, losses in take:
+                self.replay(losses, k)
+
+
 def _replay_chunk(net, losses, k: int):
     """Replay k buffered per-step losses to listeners after a fused chunk —
     the same callback sequence the per-step path fires, with the model
-    synced at chunk end (= every requiresModelAtIteration boundary). With
-    listeners attached, the chunk's losses move device->host in ONE bulk
-    transfer first: under a tunneled device every host read is a full round
-    trip, so per-callback ``score()`` syncs would serialize the replay
-    (round-5; same rationale as SameDiff.fit's batched drain)."""
-    if net.listeners:
+    synced at chunk end (= every requiresModelAtIteration boundary).
+    ``losses`` arrive already host-converted from _ReplayQueue.drain's
+    single bulk transfer when listeners are attached; the conversion here
+    covers direct callers only."""
+    if net.listeners and not isinstance(losses, np.ndarray):
         losses = np.asarray(losses).astype(float)
     for j in range(k):
         net._score = losses[j]
@@ -363,6 +417,10 @@ class MultiLayerNetwork:
     # the axon tunnel's per-dispatch latency (BASELINE.md configs #1-#3 show
     # 2-3x run-to-run spread from it) without inflating compile time.
     fuseSteps: int = 8
+    # How many fused chunks score-only listener callbacks may lag the
+    # dispatch head before a forced batched replay (see _ReplayQueue;
+    # 0 = replay right after every chunk, paying one host round trip each)
+    listenerReplayLag: int = 16
 
     def _build_multi_step(self):
         """``fuseSteps`` training steps in ONE XLA executable: lax.scan over
@@ -619,10 +677,11 @@ class MultiLayerNetwork:
         # true per-step path.
         fuse_k = 0 if (tbptt or stats) else self.fuseSteps
         buf: list = []  # (features, labels) pairs of identical shape
-
+        rq = _ReplayQueue(self)
 
         def run_single(ds):
             nonlocal step
+            rq.drain()   # callback order: buffered chunks before this step
             raw_f, raw_y = _unwrap(ds.features), _unwrap(ds.labels)
             if isinstance(raw_f, np.ndarray) and isinstance(raw_y, np.ndarray):
                 x, y = self._dev_cache.get_or_put(
@@ -643,6 +702,7 @@ class MultiLayerNetwork:
                     self._params, self._state, self._opt_state, x, y, sub, fmask, lmask)
             self._score = loss  # device scalar; score() syncs on demand
             self._iteration += 1
+            rq.dispatched += 1
             for lst in self.listeners:
                 lst.iterationDone(self, self._iteration, self._epoch)
 
@@ -653,7 +713,7 @@ class MultiLayerNetwork:
 
         def flush(buf):
             while buf:
-                k = _chunk_limit(self.listeners, self._iteration, fuse_k)
+                k = _chunk_limit(self.listeners, rq.dispatched, fuse_k)
                 if k <= 1:
                     # a listener needs the live model at the very next
                     # iteration: run it as a single (exact semantics)
@@ -679,33 +739,45 @@ class MultiLayerNetwork:
                 (self._params, self._state, self._opt_state,
                  losses) = multi(self._params, self._state,
                                  self._opt_state, xs, ys, rngs)
-                _replay_chunk(self, losses, k)
+                rq.push(losses, k)
             return buf
 
-        for _ in range(epochs):
-            for ds in data:
-                if tbptt and np.ndim(ds.features) == 3:
-                    self._fit_tbptt(ds)
-                    continue
-                if fuse_k > 1 and ds.features_mask is None \
-                        and ds.labels_mask is None:
-                    if buf and (np.shape(buf[0][0]) != np.shape(ds.features)
-                                or np.shape(buf[0][1]) != np.shape(ds.labels)):
-                        buf = drain(buf)  # shape change: drain as singles
-                    buf.append((ds.features, ds.labels))
-                    buf = flush(buf)
-                else:
-                    # masked/ineligible batch: buffered earlier steps must
-                    # apply FIRST (sequential SGD order, round-3 advisor)
-                    buf = drain(buf)
-                    run_single(ds)
-            # epoch boundary: apply leftovers so epoch listeners see a
-            # fully-stepped model, then fire onEpochEnd
-            buf = drain(buf)
-            self._epoch += 1
-            for lst in self.listeners:
-                if hasattr(lst, "onEpochEnd"):
-                    lst.onEpochEnd(self)
+        try:
+            for _ in range(epochs):
+                for ds in data:
+                    if tbptt and np.ndim(ds.features) == 3:
+                        # NB fuse_k is 0 whenever tbptt is set, so buf/rq
+                        # are necessarily empty here — nothing to drain
+                        self._fit_tbptt(ds)
+                        continue
+                    if fuse_k > 1 and ds.features_mask is None \
+                            and ds.labels_mask is None:
+                        if buf and (np.shape(buf[0][0]) != np.shape(ds.features)
+                                    or np.shape(buf[0][1]) != np.shape(ds.labels)):
+                            buf = drain(buf)  # shape change: drain as singles
+                        buf.append((ds.features, ds.labels))
+                        buf = flush(buf)
+                    else:
+                        # masked/ineligible batch: buffered earlier steps must
+                        # apply FIRST (sequential SGD order, round-3 advisor)
+                        buf = drain(buf)
+                        run_single(ds)
+                # epoch boundary: apply leftovers so epoch listeners see a
+                # fully-stepped model, then fire onEpochEnd
+                buf = drain(buf)
+                rq.drain()
+                self._epoch += 1
+                for lst in self.listeners:
+                    if hasattr(lst, "onEpochEnd"):
+                        lst.onEpochEnd(self)
+        except BaseException:
+            # an exception mid-fit must not lose completed chunks'
+            # callbacks; never mask the original error with a replay failure
+            try:
+                rq.drain()
+            except Exception:
+                pass
+            raise
         return self
 
     # ------------------------------------------------------------- inference

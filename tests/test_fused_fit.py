@@ -168,6 +168,66 @@ class TestFusedFitMLN:
             np.testing.assert_allclose(snaps["fused"][it],
                                        snaps["single"][it], atol=1e-6)
 
+    def test_exception_mid_fit_preserves_completed_callbacks(self):
+        """MLN mirror of the SameDiff test: an exception injected into the
+        THIRD fused chunk's dispatch must still deliver the two completed
+        (lag-buffered) chunks' callbacks via the except-path drain."""
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.fuseSteps = 4
+        calls = []
+
+        class Rec:
+            def requiresModelAtIteration(self, it):
+                return False
+
+            def iterationDone(self, model, it, ep):
+                calls.append((it, float(model.score())))
+
+        net.setListeners(Rec())
+        orig = net._get_jitted("multi")
+        n = {"calls": 0}
+
+        def bomb(*args):
+            n["calls"] += 1
+            if n["calls"] == 3:
+                raise RuntimeError("injected chunk failure")
+            return orig(*args)
+
+        net._jit_cache["multi"] = bomb
+        from deeplearning4j_tpu.util import crash_reporting
+        crash_reporting.crashDumpsEnabled(False)  # no dump file for the
+        try:                                      # intentional failure
+            with pytest.raises(RuntimeError, match="injected chunk failure"):
+                net.fit(ListDataSetIterator(_batches(12)))
+        finally:
+            crash_reporting.crashDumpsEnabled(True)
+        assert [i for i, _ in calls] == list(range(1, 9))
+        assert all(np.isfinite(s) for _, s in calls)
+
+    def test_replay_lag_zero_streams_per_chunk(self):
+        """listenerReplayLag=0 (live streaming): callbacks fire right after
+        each chunk, still in exact order/score parity with per-step."""
+        from deeplearning4j_tpu.optimize.listeners import CollectScoresListener
+
+        batches = _batches(10)
+        runs = {}
+        for name, (fuse, lag) in (("lag0", (4, 0)), ("single", (0, 0))):
+            net = MultiLayerNetwork(_mlp_conf()).init()
+            net.fuseSteps = fuse
+            net.listenerReplayLag = lag
+            seq = []
+
+            class Rec(CollectScoresListener):
+                def iterationDone(self, model, it, ep):
+                    seq.append((it, float(model.score())))
+
+            net.setListeners(Rec(frequency=1))
+            net.fit(ListDataSetIterator(batches))
+            runs[name] = seq
+        assert [i for i, _ in runs["lag0"]] == [i for i, _ in runs["single"]]
+        np.testing.assert_allclose([s for _, s in runs["lag0"]],
+                                   [s for _, s in runs["single"]], atol=1e-6)
+
     def test_masked_batch_applies_after_buffered_steps(self):
         """Round-3 advisor: a masked DataSet arriving while unmasked steps
         sit in the fusion buffer must apply AFTER them (sequential order).

@@ -406,6 +406,65 @@ class TestFusedFit:
             np.testing.assert_allclose(snaps["fused"][it],
                                        snaps["single"][it], atol=1e-6)
 
+    def test_replay_lag_zero_streams_per_chunk(self):
+        """listenerReplayLag=0 (live-streaming mode): callbacks still fire in
+        exact order with exact scores — parity with the per-step path."""
+        runs = {}
+        for name, (fuse, lag) in (("lag0", (4, 0)), ("single", (0, 0))):
+            sd, batches = _fit_parity_model()
+            sd.fuseSteps = fuse
+            sd.listenerReplayLag = lag
+            seq = []
+
+            class Rec:
+                def requiresModelAtIteration(self, it):
+                    return False
+
+                def iterationDone(self, model, it, ep):
+                    seq.append((it, float(model.score())))
+
+            sd.listeners = [Rec()]
+            sd.fit(batches)
+            runs[name] = seq
+        assert [i for i, _ in runs["lag0"]] == [i for i, _ in runs["single"]]
+        np.testing.assert_allclose([s for _, s in runs["lag0"]],
+                                   [s for _, s in runs["single"]], rtol=1e-6)
+
+    def test_exception_mid_fit_preserves_completed_callbacks(self):
+        """An exception raised while lagged replays are still BUFFERED must
+        not lose the completed chunks' callbacks/scores — the except-path
+        drain delivers them. The failure is injected into the THIRD fused
+        chunk's dispatch, so two chunks sit undelivered in the replay queue
+        at raise time (a shape-mismatched batch would be drained as a
+        single and deliver them on the normal path, proving nothing)."""
+        sd, batches = _fit_parity_model()
+        sd.fuseSteps = 4
+        calls = []
+
+        class Rec:
+            def requiresModelAtIteration(self, it):
+                return False
+
+            def iterationDone(self, model, it, ep):
+                calls.append((it, float(model.score())))
+
+        sd.listeners = [Rec()]
+        orig = sd._train_multi_fn()
+        n = {"calls": 0}
+
+        def bomb(*args):
+            n["calls"] += 1
+            if n["calls"] == 3:
+                raise RuntimeError("injected chunk failure")
+            return orig(*args)
+
+        sd._jit_cache["train_multi"] = bomb
+        with pytest.raises(RuntimeError, match="injected chunk failure"):
+            sd.fit((batches + batches)[:12])   # 3 same-signature chunks of 4
+        # the two completed chunks' callbacks arrived, in order
+        assert [i for i, _ in calls] == list(range(1, 9))
+        assert all(np.isfinite(s) for _, s in calls)
+
     def test_dtype_change_not_stacked_into_chunk(self):
         """Round-4 advisor: same-shaped batches of different dtypes must not
         np.stack into one fused chunk (silent promotion). Parity with the
