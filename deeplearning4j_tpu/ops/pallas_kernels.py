@@ -125,12 +125,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     qi = pl.program_id(1)
     nkb = t // block_k
 
-    def body(j, carry):
-        m, l, acc = carry
+    def scores(j):
+        # j is clamped by callers so the last iteration's prefetch stays
+        # in-bounds (the wasted dot is one block out of t/block_k)
         k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        return jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    def body(j, carry):
+        # software-pipelined (round 5, same as the packed kernel): block
+        # j's scores arrive via the carry; block j+1's QK^T dot issues
+        # BEFORE this block's softmax so MXU and VPU work overlap
+        m, l, acc, s = carry
+        s_next = scores(jnp.minimum(j + 1, nkb - 1))
         v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (BQ, BK)
         if causal:
             s = _causal_block_mask(s, qi * bq, j * block_k)
         m_new = jnp.maximum(m, s.max(-1, keepdims=True))
@@ -139,7 +147,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         l_new = l * alpha + p.sum(-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        return m_new, l_new, acc_new, s_next
 
     # causal: blocks strictly above the diagonal contribute nothing — stop
     # the stream at the q-block's diagonal block
@@ -150,7 +158,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    m, l, acc, _ = jax.lax.fori_loop(0, upper, body,
+                                     (m0, l0, acc0, scores(0)))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
@@ -203,23 +212,30 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     nkb = t // block_k
 
-    def body(j, dq):
+    def scores(j):
         k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        return k, jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+
+    def body(j, carry):
+        dq, (k, s) = carry  # pipelined: next block's QK^T before exp; the
+        #                     k tile rides the carry so it loads only once
+        nxt = scores(jnp.minimum(j + 1, nkb - 1))
         v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
         if causal:
             s = _causal_block_mask(s, qi * bq, j * block_k)
         p = jnp.exp(s - lse)                          # (BQ, BK), rows sum<=1
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        dq = dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dq, nxt
 
     upper = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nkb) \
         if causal else nkb
-    dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
+    dq, _ = jax.lax.fori_loop(0, upper, body,
+                              (jnp.zeros((bq, d), jnp.float32), scores(0)))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -235,14 +251,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     ki = pl.program_id(1)
     nqb = t // block_q
 
-    def body(i, carry):
-        dk, dv = carry
+    def scores(i):
         q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        return q, jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+
+    def body(i, carry):
+        dk, dv, (q, s) = carry   # pipelined: next q-block's QK^T before exp
+        nxt = scores(jnp.minimum(i + 1, nqb - 1))
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (BQ, BK)
         if causal:
             s = _causal_block_mask(s, i * block_q, ki * bk)
         p = jnp.exp(s - lse)
@@ -253,12 +272,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                       preferred_element_type=jnp.float32)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
-        return dk, dv
+        return dk, dv, nxt
 
     # causal: q-blocks strictly before this k-block's diagonal see none of it
     lower = (ki * bk) // block_q if causal else 0
     z = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lower, nqb, body, (z, z))
+    dk, dv, _ = jax.lax.fori_loop(lower, nqb, body, (z, z, scores(lower)))
     # dL/dk = ds^T @ (scale*q) — q was loaded pre-scaled, so no extra factor
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
