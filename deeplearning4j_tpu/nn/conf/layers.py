@@ -1075,6 +1075,12 @@ class SelfAttentionLayer(BaseRecurrentLayer):
     nHeads: int = 1
     headSize: int = 0
     projectInput: bool = True
+    # Pallas-kernel routing for the unmasked case: None = auto (packed VMEM
+    # kernel on TPU — first-order autodiff only, see
+    # ops.pallas_kernels.higher_order_attention); False pins the fully
+    # differentiable XLA einsum path per-layer (e.g. for HVP training);
+    # True forces the kernel (interpret mode off-TPU)
+    attentionKernel: Optional[bool] = None
 
     def output_type(self, input_type: InputType) -> InputType:
         size = self.nOut if self.projectInput else input_type.size
@@ -1098,7 +1104,8 @@ class SelfAttentionLayer(BaseRecurrentLayer):
     def apply(self, params, x, *, training=False, rng=None, state=None, mask=None):
         if self.projectInput:
             out = _nnops.multi_head_attention(x, x, params["Wq"], params["Wk"], params["Wv"],
-                                              params["Wo"], self.nHeads, mask=mask)
+                                              params["Wo"], self.nHeads, mask=mask,
+                                              use_kernel=self.attentionKernel)
         else:
             m = mask[:, None, :] if mask is not None else None
             out = _nnops.dot_product_attention(x, x, x, mask=m)

@@ -104,15 +104,38 @@ def dot_product_attention(q, k, v, mask=None, scaled=True):
 
 
 @op("multiHeadDotProductAttention", "nn")
-def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None):
+def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
+                         use_kernel=None):
     """Fused MHA: x_q (B,Tq,D), x_kv (B,Tk,D); wq/wk/wv: (D,O); wo: (O,O).
     Head dims derive from the PROJECTION width O, not the input width D —
     rectangular projections (nIn != nOut, e.g. SelfAttentionLayer with
-    distinct sizes) are valid."""
+    distinct sizes) are valid.
+
+    ``use_kernel``: route the unmasked square (Tq == Tk) case through the
+    packed whole-head VMEM Pallas kernel — the flagship-bench attention
+    path (round 5): the (B, T, O) projections feed the kernel directly, so
+    the (B, H, T, hd) head transposes never materialize and the per-head
+    (T, T) scores stay on-chip. None (default) = auto: kernel on TPU,
+    XLA einsum elsewhere (interpret-mode Pallas would slow CPU runs);
+    True forces it (tests use interpret mode); False forces the einsum
+    path. Masked / cross-length attention always uses the einsum path
+    (the kernel supports only causal/none masking)."""
     B, Tq, _ = x_q.shape
     Tk = x_kv.shape[1]
     O = wq.shape[-1]
     hd = O // num_heads
+
+    eligible = (mask is None and Tq == Tk and Tq % 8 == 0 and Tq <= 1024
+                and O % num_heads == 0)
+    on_tpu = jax.default_backend() == "tpu"
+    if eligible and (use_kernel or (use_kernel is None and on_tpu)):
+        from deeplearning4j_tpu.ops.pallas_kernels import mha_attention_packed
+        qp = jnp.matmul(x_q, wq)
+        kp = jnp.matmul(x_kv, wk)
+        vp = jnp.matmul(x_kv, wv)
+        out = mha_attention_packed(qp, kp, vp, num_heads, False, None,
+                                   not on_tpu, jnp.float32)
+        return jnp.matmul(out, wo)
 
     def split(x, w, T):
         return jnp.matmul(x, w).reshape(B, T, num_heads, hd).transpose(0, 2, 1, 3)

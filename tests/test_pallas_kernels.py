@@ -274,6 +274,86 @@ class TestHigherOrderAutodiff:
         assert not pk._HIGHER_ORDER
 
 
+class TestLayerMhaKernelRoute:
+    """Round 5: the layer-DSL multiHeadDotProductAttention op routes its
+    unmasked square case through the packed VMEM Pallas kernel (auto on
+    TPU; use_kernel=True forces it for these interpret-mode parity tests).
+    The einsum path remains for masked / cross-length attention."""
+
+    def _setup(self, B=2, T=32, D=24, O=32, H=4):
+        # 0.15 weight scale keeps the softmax un-saturated — saturated
+        # attention has degenerate gradients that amplify benign fp32
+        # reduction-order differences between the two paths
+        ws = {n: _rand(*s) * 0.15 for n, s in (
+            ("wq", (D, O)), ("wk", (D, O)), ("wv", (D, O)), ("wo", (O, O)))}
+        return _rand(B, T, D), ws
+
+    def test_kernel_route_matches_einsum_fwd_and_grads(self):
+        from deeplearning4j_tpu.ops.nn_defs import multi_head_attention
+
+        x, ws = self._setup()
+        g = _rand(2, 32, 32)
+
+        def run(use_kernel, xx, w):
+            return (multi_head_attention(
+                xx, xx, w["wq"], w["wk"], w["wv"], w["wo"], 4,
+                use_kernel=use_kernel) * g).sum()
+
+        got = run(True, x, ws)
+        want = run(False, x, ws)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+        gk = jax.grad(lambda xx, w: run(True, xx, w), argnums=(0, 1))(x, ws)
+        ge = jax.grad(lambda xx, w: run(False, xx, w), argnums=(0, 1))(x, ws)
+        for a, b in zip(jax.tree.leaves(gk), jax.tree.leaves(ge)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=1e-4)
+
+    def test_layer_attention_kernel_knob(self):
+        """SelfAttentionLayer.attentionKernel plumbs through to the op:
+        True (interpret-mode kernel here) must match the default einsum
+        path through a full MLN forward."""
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (GlobalPoolingLayer,
+                                                       OutputLayer,
+                                                       SelfAttentionLayer)
+        from deeplearning4j_tpu.train import Adam
+
+        x = np.asarray(RNG.normal(size=(2, 16, 16)), np.float32)
+        outs = {}
+        for knob in (True, False):
+            conf = (NeuralNetConfiguration.Builder().seed(9)
+                    .updater(Adam(1e-3)).list()
+                    .layer(SelfAttentionLayer(nOut=32, nHeads=4,
+                                              attentionKernel=knob))
+                    .layer(GlobalPoolingLayer())
+                    .layer(OutputLayer(nOut=3, lossFunction="MCXENT"))
+                    .setInputType(InputType.recurrent(16, 16)).build())
+            net = MultiLayerNetwork(conf).init()
+            outs[knob] = np.asarray(net.output(x).toNumpy())
+        np.testing.assert_allclose(outs[True], outs[False],
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_masked_and_cross_length_stay_on_einsum(self):
+        """Mask or Tq != Tk makes the case ineligible — use_kernel=True must
+        not change results (the einsum path serves it)."""
+        from deeplearning4j_tpu.ops.nn_defs import multi_head_attention
+
+        x, ws = self._setup()
+        mask = jnp.asarray(RNG.integers(0, 2, (2, 32)).astype(np.float32))
+        a = multi_head_attention(x, x, ws["wq"], ws["wk"], ws["wv"],
+                                 ws["wo"], 4, mask=mask, use_kernel=True)
+        b = multi_head_attention(x, x, ws["wq"], ws["wk"], ws["wv"],
+                                 ws["wo"], 4, mask=mask, use_kernel=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+        xkv = _rand(2, 16, 24)   # cross-attention, Tk != Tq
+        c = multi_head_attention(x, xkv, ws["wq"], ws["wk"], ws["wv"],
+                                 ws["wo"], 4, use_kernel=True)
+        d = multi_head_attention(x, xkv, ws["wq"], ws["wk"], ws["wv"],
+                                 ws["wo"], 4, use_kernel=False)
+        np.testing.assert_allclose(np.asarray(c), np.asarray(d), atol=1e-6)
+
+
 class TestSoftmaxCrossEntropy:
     def test_matches_optax(self):
         import optax
