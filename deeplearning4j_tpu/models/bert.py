@@ -254,7 +254,8 @@ def _use_packed_kernel(cfg: TransformerConfig, mesh: Optional[Mesh],
     round 4 disabled it under any mesh)."""
     if cfg.attention_impl != "flash":
         return False
-    if not (T % 8 == 0 and T <= 1024):
+    from deeplearning4j_tpu.ops.pallas_kernels import packed_kernel_shape_ok
+    if not packed_kernel_shape_ok(T):
         return False
     if mesh is not None and _packed_mesh_spec(cfg, mesh, B) is None:
         # no warning here: _attention still serves this — ring/Ulysses for

@@ -1079,7 +1079,10 @@ class SelfAttentionLayer(BaseRecurrentLayer):
     # kernel on TPU — first-order autodiff only, see
     # ops.pallas_kernels.higher_order_attention); False pins the fully
     # differentiable XLA einsum path per-layer (e.g. for HVP training);
-    # True forces the kernel (interpret mode off-TPU)
+    # True forces the kernel (interpret mode off-TPU). Only meaningful with
+    # projectInput=True — the unprojected path has no kernel route and an
+    # explicit setting there raises at apply time rather than silently
+    # no-opping
     attentionKernel: Optional[bool] = None
 
     def output_type(self, input_type: InputType) -> InputType:
@@ -1107,6 +1110,11 @@ class SelfAttentionLayer(BaseRecurrentLayer):
                                               params["Wo"], self.nHeads, mask=mask,
                                               use_kernel=self.attentionKernel)
         else:
+            if self.attentionKernel is not None:
+                raise ValueError(
+                    "SelfAttentionLayer.attentionKernel requires "
+                    "projectInput=True; the unprojected path has no "
+                    "Pallas kernel route")
             m = mask[:, None, :] if mask is not None else None
             out = _nnops.dot_product_attention(x, x, x, mask=m)
         return out, state

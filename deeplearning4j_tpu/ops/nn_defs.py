@@ -119,17 +119,24 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
     XLA einsum elsewhere (interpret-mode Pallas would slow CPU runs);
     True forces it (tests use interpret mode); False forces the einsum
     path. Masked / cross-length attention always uses the einsum path
-    (the kernel supports only causal/none masking)."""
+    (the kernel supports only causal/none masking). Auto never routes to
+    the kernel while a global mesh context is active (ParallelWrapper's
+    sharded-jit fit): a monolithic pallas_call over sharded operands
+    would force GSPMD all-gathers — the einsum path partitions cleanly
+    instead. ``use_kernel=True`` overrides even that (single-device
+    meshes, tests)."""
     B, Tq, _ = x_q.shape
     Tk = x_kv.shape[1]
     O = wq.shape[-1]
     hd = O // num_heads
 
-    eligible = (mask is None and Tq == Tk and Tq % 8 == 0 and Tq <= 1024
+    from deeplearning4j_tpu.ops.pallas_kernels import (
+        active_global_mesh, mha_attention_packed, packed_kernel_shape_ok)
+    eligible = (mask is None and Tq == Tk and packed_kernel_shape_ok(Tq)
                 and O % num_heads == 0)
     on_tpu = jax.default_backend() == "tpu"
-    if eligible and (use_kernel or (use_kernel is None and on_tpu)):
-        from deeplearning4j_tpu.ops.pallas_kernels import mha_attention_packed
+    auto = use_kernel is None and on_tpu and active_global_mesh() is None
+    if eligible and (use_kernel or auto):
         qp = jnp.matmul(x_q, wq)
         kp = jnp.matmul(x_kv, wk)
         vp = jnp.matmul(x_kv, wv)

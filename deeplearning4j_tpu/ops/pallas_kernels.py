@@ -396,6 +396,47 @@ _flash_attention_kernel.defvjp(_flash_fwd, _flash_bwd)
 # copies per layer) never materialize.
 
 
+def packed_kernel_shape_ok(t: int) -> bool:
+    """Shape envelope of :func:`mha_attention_packed`: the whole (T, T)
+    fp32 score block must fit VMEM next to its operands (T <= ~1024 on
+    v5e's budget) and T must tile the 8-sublane dimension. The ONE place
+    this envelope is encoded — models/bert.py's ``_use_packed_kernel`` and
+    the layer-DSL ``multiHeadDotProductAttention`` auto-route both consume
+    it, so the two call sites cannot drift."""
+    return t % 8 == 0 and t <= 1024
+
+
+def active_global_mesh():
+    """The ``with mesh:`` context's mesh, or None. The packed/streamed
+    kernels are monolithic pallas_calls: invoked on globally-sharded
+    values they force GSPMD all-gathers (the module-header invariant), so
+    auto-routing call sites that cannot see an explicit ``mesh`` argument
+    (the layer DSL under ParallelWrapper's ``with self.mesh:`` fit) use
+    this to detect sharded tracing and fall back to the einsum path.
+
+    Reads a private JAX attribute (there is no public "current mesh
+    context" API as of jax 0.9); if an upgrade moves it this fails OPEN
+    (kernel routing resumes) — but loudly, once, so the guard's loss is
+    visible rather than a silent perf regression."""
+    global _MESH_PROBE_BROKEN
+    try:
+        pm = jax._src.mesh.thread_resources.env.physical_mesh
+        return None if pm.empty else pm
+    except AttributeError:
+        if not _MESH_PROBE_BROKEN:
+            _MESH_PROBE_BROKEN = True
+            import warnings
+            warnings.warn(
+                "jax._src.mesh.thread_resources is gone in this JAX "
+                "version; active-mesh detection is disabled and the packed "
+                "attention kernel may be auto-routed under sharded traces "
+                "(set use_kernel/attentionKernel=False there)")
+        return None
+
+
+_MESH_PROBE_BROKEN = False
+
+
 def _causal_mask(s):
     rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)

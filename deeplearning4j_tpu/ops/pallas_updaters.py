@@ -1,0 +1,145 @@
+"""Fused in-place AdamW as a single Pallas pass per parameter leaf.
+
+Motivation and MEASURED OUTCOME (negative result, kept honest): the
+round-5 ablation profile (``BASELINE_r5_profile.json``, ``no_adamw`` row)
+measured the optax AdamW tail of the flagship step at 15.6 ms / 6.7 GB of
+HBM traffic — ~61 bytes/param against the analytic minimum of 28 (read
+p,g,m,v + write p,m,v in fp32) — suggesting an updates-tree
+materialization a hand-fused kernel could delete. The experiment says
+otherwise: inside the donated whole-step executable the three variants
+measure identical on a real v5e chip (optax 290.9 / this Pallas kernel
+295.7 / hand-fused jnp 290.6 ms/step at bench shapes) — XLA already
+fuses ``tx.update`` + ``apply_updates`` into minimal-traffic in-place
+sweeps, and the per-leaf ``pallas_call`` dispatch actually *loses* the
+overlap XLA schedules between late-layer backward compute and early-layer
+updater sweeps. The ablation's 6.7 GB delta is grad-buffer lifetime, not
+removable updater traffic. The module stays as the opt-in fused-updater
+op (parity-pinned vs optax, SURVEY §2.1 "updater ops are single fused
+native calls", §2.2 L2 updaters) and as the recorded experiment; it is
+deliberately NOT wired into ``make_train_step``.
+
+Semantics are exactly ``optax.adamw`` (scale_by_adam -> add_decayed_weights
+-> scale(-lr), eps outside the sqrt, eps_root=0, bias correction by
+``1 - beta**count`` AFTER the count increment); parity is pinned to 1e-6
+over multi-step trajectories in ``tests/test_pallas_updaters.py``.
+
+Layout: each leaf is viewed as (rows, 128) lanes and swept by a 1D grid of
+(block_rows, 128) tiles; leaves whose size is not lane-divisible (or tiny)
+take a hand-fused jnp path instead — same math, and XLA fuses a handful of
+small leaves fine; it is the multi-MB matmul weights where the traffic
+lives. Scalars that depend on the step count (the two bias corrections)
+ride in SMEM so one compiled kernel serves every step.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+
+# one tile = block_rows x 128 lanes; 2048 rows = 1 MB fp32 per operand,
+# 7 operands in flight ≈ 7 MB VMEM — comfortably under the 16 MB default.
+_BLOCK_ROWS = 2048
+# below this many elements the pallas dispatch is not worth it; the jnp
+# path is a single XLA fusion for such leaves (biases, layernorm scales)
+_MIN_PALLAS_SIZE = 1 << 16
+
+
+def _adamw_kernel(bc_ref, p_ref, g_ref, m_ref, v_ref,
+                  p_out, m_out, v_out, *, lr, b1, b2, eps, wd):
+    # fp32 accumulation regardless of storage dtype; results cast back to
+    # each operand's own dtype (mirrors optax's promote-then-cast behavior
+    # for bf16 params)
+    g = g_ref[...].astype(jnp.float32)
+    m = b1 * m_ref[...].astype(jnp.float32) + (1.0 - b1) * g
+    v = b2 * v_ref[...].astype(jnp.float32) + (1.0 - b2) * (g * g)
+    p = p_ref[...].astype(jnp.float32)
+    m_hat = m / bc_ref[0]
+    v_hat = v / bc_ref[1]
+    new_p = p - lr * (m_hat / (jnp.sqrt(v_hat) + eps) + wd * p)
+    p_out[...] = new_p.astype(p_out.dtype)
+    m_out[...] = m.astype(m_out.dtype)
+    v_out[...] = v.astype(v_out.dtype)
+
+
+def _adamw_jnp(p, g, m, v, bc1, bc2, *, lr, b1, b2, eps, wd):
+    """Hand-fused fallback with identical math (one XLA fusion per leaf)."""
+    g32 = g.astype(jnp.float32)
+    m_new = b1 * m.astype(jnp.float32) + (1.0 - b1) * g32
+    v_new = b2 * v.astype(jnp.float32) + (1.0 - b2) * (g32 * g32)
+    p32 = p.astype(jnp.float32)
+    p_new = p32 - lr * ((m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+                        + wd * p32)
+    return p_new.astype(p.dtype), m_new.astype(m.dtype), v_new.astype(v.dtype)
+
+
+def _adamw_leaf(p, g, m, v, bc, *, lr, b1, b2, eps, wd, interpret):
+    """One leaf: (new_p, new_m, new_v), p/m/v buffers aliased in place."""
+    shape, size = p.shape, p.size
+    if size < _MIN_PALLAS_SIZE or size % 128:
+        return _adamw_jnp(p, g, m, v, bc[0], bc[1],
+                          lr=lr, b1=b1, b2=b2, eps=eps, wd=wd)
+    rows = size // 128
+    p2, g2, m2, v2 = (x.reshape(rows, 128) for x in (p, g, m, v))
+    blk = pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (i, 0))
+    grid = ((rows + _BLOCK_ROWS - 1) // _BLOCK_ROWS,)
+    out_shapes = [jax.ShapeDtypeStruct((rows, 128), x.dtype)
+                  for x in (p, m, v)]
+    if interpret:
+        sc_spec = pl.BlockSpec((2,), lambda i: (0,))
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+        sc_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    p_new, m_new, v_new = pl.pallas_call(
+        functools.partial(_adamw_kernel, lr=lr, b1=b1, b2=b2, eps=eps, wd=wd),
+        grid=grid,
+        in_specs=[sc_spec, blk, blk, blk, blk],
+        out_specs=[blk, blk, blk],
+        out_shape=out_shapes,
+        # p/m/v are read-modify-write in place: input index -> output index
+        # (index 0 is the SMEM scalar vector, so operands start at 1)
+        input_output_aliases={1: 0, 3: 1, 4: 2},
+        interpret=interpret,
+    )(bc, p2, g2, m2, v2)
+    return (p_new.reshape(shape), m_new.reshape(shape), v_new.reshape(shape))
+
+
+class FusedAdamW(NamedTuple):
+    """``(init, apply)`` pair. ``init`` builds the standard ``optax.adamw``
+    state tuple (so sharding placement, serde and resume code that expects
+    ``ScaleByAdamState`` keeps working unchanged); ``apply`` consumes grads
+    and returns ``(new_params, new_state)`` directly — there is no
+    intermediate ``updates`` tree by construction."""
+    init: Any
+    apply: Any
+
+
+def fused_adamw(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+                eps: float = 1e-8, weight_decay: float = 1e-4,
+                interpret: bool = False) -> FusedAdamW:
+    # defaults mirror optax.adamw exactly (incl. weight_decay=1e-4) — the
+    # module's contract is drop-in parity
+    tx = optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps,
+                     weight_decay=weight_decay)
+    leaf = functools.partial(_adamw_leaf, lr=learning_rate, b1=b1, b2=b2,
+                             eps=eps, wd=weight_decay, interpret=interpret)
+
+    def apply(params, opt_state, grads):
+        adam = next(s for s in opt_state if hasattr(s, "mu"))
+        count = optax.safe_increment(adam.count)
+        t = count.astype(jnp.float32)
+        bc = jnp.stack([1.0 - b1 ** t, 1.0 - b2 ** t])
+        triples = jax.tree.map(lambda p, g, m, v: leaf(p, g, m, v, bc),
+                               params, grads, adam.mu, adam.nu)
+        outer = jax.tree.structure(params)
+        inner = jax.tree.structure((0, 0, 0))
+        new_p, new_m, new_v = jax.tree.transpose(outer, inner, triples)
+        new_state = tuple(
+            s._replace(count=count, mu=new_m, nu=new_v)
+            if hasattr(s, "mu") else s for s in opt_state)
+        return new_p, new_state
+
+    return FusedAdamW(init=tx.init, apply=apply)

@@ -334,6 +334,38 @@ class TestLayerMhaKernelRoute:
         np.testing.assert_allclose(outs[True], outs[False],
                                    atol=2e-5, rtol=1e-4)
 
+    def test_auto_route_disabled_under_active_mesh(self, monkeypatch):
+        """use_kernel=None (auto) must NOT take the monolithic pallas_call
+        while a global mesh context is active (ParallelWrapper's sharded
+        fit traces inside ``with mesh:``) — GSPMD would all-gather the
+        sharded operands. Explicit use_kernel=True still overrides."""
+        import deeplearning4j_tpu.ops.pallas_kernels as pk
+        from deeplearning4j_tpu.ops import nn_defs
+
+        calls = []
+
+        def stub(q, k, v, heads, *a, **kw):
+            calls.append(1)
+            return jnp.zeros_like(q)
+
+        monkeypatch.setattr(pk, "mha_attention_packed", stub)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        x, ws = self._setup()
+
+        def run(use_kernel):
+            return nn_defs.multi_head_attention(
+                x, x, ws["wq"], ws["wk"], ws["wv"], ws["wo"], 4,
+                use_kernel=use_kernel)
+
+        run(None)
+        assert len(calls) == 1          # auto, no mesh: kernel route
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+        with mesh:
+            run(None)
+            assert len(calls) == 1      # auto under mesh: einsum route
+            run(True)
+            assert len(calls) == 2      # explicit force still respected
+
     def test_masked_and_cross_length_stay_on_einsum(self):
         """Mask or Tq != Tk makes the case ineligible — use_kernel=True must
         not change results (the einsum path serves it)."""

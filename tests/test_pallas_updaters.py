@@ -1,0 +1,114 @@
+"""Fused AdamW (ops/pallas_updaters.py) vs optax.adamw parity.
+
+The fused updater is an opt-in standalone op (and a recorded negative
+result for the flagship step — see the module docstring); these tests pin
+its math to optax exactly: same params, same state tree, same trajectory.
+"""
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from deeplearning4j_tpu.ops.pallas_updaters import (
+    _MIN_PALLAS_SIZE, fused_adamw)
+
+
+def _tree(key):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        # lane-divisible and big enough for the pallas path
+        "w": jax.random.normal(k1, (max(_MIN_PALLAS_SIZE // 128, 1024), 128)),
+        # pallas path with a partial final grid block (rows % block != 0)
+        "e": jax.random.normal(k2, (3000, 128)) * 0.1,
+        # jnp fallback: tiny and not lane-divisible
+        "b": jax.random.normal(k3, (7,)),
+    }
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.01])
+def test_trajectory_matches_optax(wd):
+    params = _tree(jax.random.PRNGKey(0))
+    tx = optax.adamw(3e-3, weight_decay=wd)
+    fu = fused_adamw(3e-3, weight_decay=wd, interpret=True)
+    st_o, st_f = tx.init(params), fu.init(params)
+    p_o, p_f = params, params
+    for i in range(4):
+        g_o = jax.tree.map(lambda p: jnp.sin(p * (i + 1)), p_o)
+        up, st_o = tx.update(g_o, st_o, p_o)
+        p_o = optax.apply_updates(p_o, up)
+        g_f = jax.tree.map(lambda p: jnp.sin(p * (i + 1)), p_f)
+        p_f, st_f = fu.apply(p_f, st_f, g_f)
+    for k in params:
+        assert jnp.max(jnp.abs(p_o[k] - p_f[k])) < 1e-6, k
+    adam_o = next(s for s in st_o if hasattr(s, "mu"))
+    adam_f = next(s for s in st_f if hasattr(s, "mu"))
+    assert int(adam_o.count) == int(adam_f.count) == 4
+    for k in params:
+        assert jnp.max(jnp.abs(adam_o.mu[k] - adam_f.mu[k])) < 1e-6, k
+        assert jnp.max(jnp.abs(adam_o.nu[k] - adam_f.nu[k])) < 1e-6, k
+
+
+def test_state_tree_is_optax_shaped():
+    """Sharding placement + serde code keys on ScaleByAdamState — the fused
+    updater must produce the identical state structure."""
+    params = {"w": jnp.ones((256, 128))}
+    fu = fused_adamw(1e-3, interpret=True)
+    st = fu.init(params)
+    new_p, new_st = fu.apply(params, st, params)
+    assert jax.tree.structure(st) == jax.tree.structure(new_st)
+    assert jax.tree.structure(new_p) == jax.tree.structure(params)
+
+
+def test_bf16_params_preserve_dtype():
+    """bf16 trees (a) keep their dtype through the update (donation-safe),
+    (b) track optax.adamw within bf16 resolution on both leaf paths."""
+    key = jax.random.PRNGKey(2)
+    params = {
+        "w": jax.random.normal(key, (1024, 128)).astype(jnp.bfloat16),
+        "b": jax.random.normal(key, (7,)).astype(jnp.bfloat16),
+    }
+    tx = optax.adamw(1e-2, weight_decay=1e-4)
+    fu = fused_adamw(1e-2, interpret=True)
+    st_o, st_f = tx.init(params), fu.init(params)
+    p_o, p_f = params, params
+    for i in range(3):
+        g_o = jax.tree.map(lambda p: jnp.sin(p.astype(jnp.float32) * (i + 1))
+                           .astype(p.dtype), p_o)
+        up, st_o = tx.update(g_o, st_o, p_o)
+        p_o = optax.apply_updates(p_o, up)
+        g_f = jax.tree.map(lambda p: jnp.sin(p.astype(jnp.float32) * (i + 1))
+                           .astype(p.dtype), p_f)
+        p_f, st_f = fu.apply(p_f, st_f, g_f)
+    for k in params:
+        assert p_f[k].dtype == params[k].dtype, k
+        d = jnp.max(jnp.abs(p_o[k].astype(jnp.float32)
+                            - p_f[k].astype(jnp.float32)))
+        assert d < 3e-2, (k, float(d))
+
+
+def test_default_weight_decay_matches_optax():
+    """Drop-in contract: identical defaults, incl. weight_decay=1e-4."""
+    params = {"w": jnp.full((256, 128), 2.0)}
+    tx, fu = optax.adamw(1e-2), fused_adamw(1e-2, interpret=True)
+    up, _ = tx.update(jax.tree.map(jnp.ones_like, params), tx.init(params),
+                      params)
+    p_o = optax.apply_updates(params, up)
+    p_f, _ = fu.apply(params, fu.init(params), jax.tree.map(jnp.ones_like,
+                                                            params))
+    assert jnp.max(jnp.abs(p_o["w"] - p_f["w"])) < 1e-6
+
+
+def test_jit_donation_compatible():
+    """One donated jitted step — the deployment shape."""
+    params = _tree(jax.random.PRNGKey(1))
+    fu = fused_adamw(1e-3, interpret=True)
+
+    @jax.jit
+    def step(p, st, g):
+        return fu.apply(p, st, g)
+
+    st = fu.init(params)
+    g = jax.tree.map(jnp.ones_like, params)
+    p2, st2 = step(params, st, g)
+    assert jnp.all(jnp.isfinite(p2["w"]))
+    assert int(next(s for s in st2 if hasattr(s, "mu")).count) == 1
