@@ -183,11 +183,17 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
                     MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None,
                     None, None)
         interpret = jax.default_backend() != "tpu"
-        blk = 128
-        while blk > 8 and T % blk:
-            blk //= 2
-        if T % blk == 0 and (mesh is None or mesh_spec is not None):
-            from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
+        from deeplearning4j_tpu.ops.pallas_kernels import (auto_flash_block,
+                                                           flash_attention)
+        # auto_flash_block always returns a divisor (worst case T itself),
+        # so the usability gate is on the BLOCK: small enough that a
+        # (blk, T) score tile fits VMEM, and 8-sublane aligned — unaligned
+        # whole-T blocks do compile (Mosaic masks partial tiles, verified
+        # on v5e), but that envelope is unswept for perf, so odd-T
+        # sequences stay on the known-good einsum path here
+        blk = auto_flash_block(T)
+        if blk % 8 == 0 and blk <= 1024 \
+                and (mesh is None or mesh_spec is not None):
 
             def _local(ql, kl, vl):
                 return flash_attention(ql, kl, vl, cfg.causal, blk, blk,

@@ -1079,10 +1079,10 @@ class SelfAttentionLayer(BaseRecurrentLayer):
     # kernel on TPU — first-order autodiff only, see
     # ops.pallas_kernels.higher_order_attention); False pins the fully
     # differentiable XLA einsum path per-layer (e.g. for HVP training);
-    # True forces the kernel (interpret mode off-TPU). Only meaningful with
-    # projectInput=True — the unprojected path has no kernel route and an
-    # explicit setting there raises at apply time rather than silently
-    # no-opping
+    # True forces the kernel (interpret mode off-TPU). The kernel route
+    # exists only with projectInput=True: forcing True on the unprojected
+    # path raises at apply time (False is trivially satisfied there — the
+    # unprojected path IS the einsum path)
     attentionKernel: Optional[bool] = None
 
     def output_type(self, input_type: InputType) -> InputType:
@@ -1110,9 +1110,11 @@ class SelfAttentionLayer(BaseRecurrentLayer):
                                               params["Wo"], self.nHeads, mask=mask,
                                               use_kernel=self.attentionKernel)
         else:
-            if self.attentionKernel is not None:
+            # False is satisfied trivially (this IS the einsum path); only
+            # forcing the kernel is unsatisfiable without projections
+            if self.attentionKernel is True:
                 raise ValueError(
-                    "SelfAttentionLayer.attentionKernel requires "
+                    "SelfAttentionLayer.attentionKernel=True requires "
                     "projectInput=True; the unprojected path has no "
                     "Pallas kernel route")
             m = mask[:, None, :] if mask is not None else None

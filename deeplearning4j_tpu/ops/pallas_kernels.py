@@ -119,7 +119,14 @@ def _causal_block_mask(s, q_off, k_off):
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                   causal: bool, scale: float):
-    q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+    # dots take NATIVE-dtype operands (bf16 at bench) with fp32
+    # accumulation, matching the packed kernel's convention. Measured
+    # NEUTRAL on v5e vs the old fp32 pre-cast (BASELINE.md round-5
+    # streamed-kernel sweep: Mosaic already feeds the MXU bf16 for
+    # operands upcast from bf16) — kept for consistency, not speed;
+    # softmax stays fp32
+    q = q_ref[0]                                      # (BQ, D)
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
     bq, d = q.shape
     t = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -128,8 +135,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     def scores(j):
         # j is clamped by callers so the last iteration's prefetch stays
         # in-bounds (the wasted dot is one block out of t/block_k)
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        return jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        return jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
                                    preferred_element_type=jnp.float32)
 
     def body(j, carry):
@@ -138,7 +145,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         # BEFORE this block's softmax so MXU and VPU work overlap
         m, l, acc, s = carry
         s_next = scores(jnp.minimum(j + 1, nkb - 1))
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         if causal:
             s = _causal_block_mask(s, qi * bq, j * block_k)
         m_new = jnp.maximum(m, s.max(-1, keepdims=True))
@@ -146,7 +153,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new, s_next
 
     # causal: blocks strictly above the diagonal contribute nothing — stop
@@ -172,8 +180,7 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
         b, h, t, d = q.shape
         q, k, v = (x.reshape(b * h, t, d) for x in (q, k, v))
     bh, t, d = q.shape
-    bq = min(block_q, t)
-    bk = min(block_k, t)
+    bq, bk = _resolve_flash_blocks(t, block_q, block_k)
     assert t % bq == 0 and t % bk == 0, (t, bq, bk)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
 
@@ -202,9 +209,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_k: int, causal: bool, scale: float):
     """dQ pass: one q-block per grid step, stream k/v-blocks.
     ds = p * (dp - delta), dq = scale * ds @ k  with p rebuilt from the
-    saved logsumexp (no (T, T) materialization)."""
-    q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
-    do = do_ref[0].astype(jnp.float32)
+    saved logsumexp (no (T, T) materialization). Dots run on NATIVE-dtype
+    operands (measured neutral vs fp32 pre-cast — see _flash_kernel)."""
+    q = q_ref[0]                                      # (BQ, D)
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    do = do_ref[0]
     lse = lse_ref[0, 0][:, None]                      # (BQ, 1)
     delta = delta_ref[0, 0][:, None]
     bq, d = q.shape
@@ -213,21 +222,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     nkb = t // block_k
 
     def scores(j):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        return k, jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        return k, jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
                                       preferred_element_type=jnp.float32)
 
     def body(j, carry):
         dq, (k, s) = carry  # pipelined: next block's QK^T before exp; the
         #                     k tile rides the carry so it loads only once
         nxt = scores(jnp.minimum(j + 1, nkb - 1))
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         if causal:
             s = _causal_block_mask(s, qi * bq, j * block_k)
         p = jnp.exp(s - lse)                          # (BQ, BK), rows sum<=1
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k.dtype)
         dq = dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dq, nxt
@@ -243,23 +252,25 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, block_q: int, causal: bool,
                           scale: float):
     """dK/dV pass: one k-block per grid step, stream q-blocks.
-    dv = p^T @ do, dk = scale * ds^T @ q."""
-    k = k_ref[0].astype(jnp.float32)                  # (BK, D)
-    v = v_ref[0].astype(jnp.float32)
+    dv = p^T @ do, dk = scale * ds^T @ q. Dots run on NATIVE-dtype
+    operands (measured neutral vs fp32 pre-cast — see _flash_kernel)."""
+    k = k_ref[0]                                      # (BK, D)
+    v = v_ref[0]
     bk, d = k.shape
     t = q_ref.shape[1]
     ki = pl.program_id(1)
     nqb = t // block_q
 
     def scores(i):
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
-        return q, jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
+        return qs, jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
 
     def body(i, carry):
         dk, dv, (q, s) = carry   # pipelined: next q-block's QK^T before exp
         nxt = scores(jnp.minimum(i + 1, nqb - 1))
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
         if causal:
@@ -267,8 +278,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -296,23 +308,62 @@ def _attention_reference(q, k, v, causal, scale):
     return jnp.einsum("...qk,...kd->...qd", w, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def auto_flash_block(t: int) -> int:
+    """Largest divisor of t of the form min(512, t)/2^k — 512 is the
+    measured fwd+bwd optimum of the streamed kernels on v5e (T=8192 sweep,
+    BASELINE_r5_longcontext.json: 128->58.6, 256->32.1, 512->25.0 ms/layer;
+    no swept config beat 512x512). Small blocks pay per-block loop/mask
+    overhead ~2.4x; blocks past 512 regress mildly (1024x1024: 26.0;
+    asymmetric mixes 26.2-28.4).
+    Always returns a divisor: falls back to t itself (single whole-T
+    block) for lengths with no power-of-2 structure, matching the old
+    ``min(block, t)`` clamp's behavior on short odd sequences; callers
+    resolving a ``None`` block reject the degenerate fallback beyond
+    t=1024 (whole-(T, T) score tiles blow VMEM) rather than launch it."""
+    blk = min(512, t)
+    while blk > 8 and t % blk:
+        blk //= 2
+    return blk if blk and t % blk == 0 else t
+
+
+def _resolve_flash_blocks(t: int, block_q, block_k):
+    """None -> auto_flash_block with a guard: if auto-resolution
+    degenerates to a whole-T block beyond the VMEM-safe envelope, raise an
+    actionable error (the old fixed-128 default produced a bare divisor
+    AssertionError here). Explicit blocks stay caller's choice.
+    Non-8-aligned whole-T blocks WITHIN the envelope are allowed: Mosaic
+    masks partial tiles — hardware-verified on v5e at T=100 and T=900,
+    fwd+bwd, parity vs the einsum reference."""
+    bq = auto_flash_block(t) if block_q is None else min(block_q, t)
+    bk = auto_flash_block(t) if block_k is None else min(block_k, t)
+    if (block_q is None or block_k is None) and max(bq, bk) > 1024:
+        raise ValueError(
+            f"flash_attention: T={t} has no power-of-2 block structure, so "
+            "the auto block degenerates to a whole-T score tile that "
+            "cannot fit VMEM; pass explicit block_q/block_k dividing T, "
+            "pad the sequence, or use reference attention")
+    return bq, bk
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_kernel(q, k, v, causal=False, block_q=128, block_k=128,
+def _flash_attention_kernel(q, k, v, causal=False, block_q=None, block_k=None,
                             scale=None, interpret=False):
     out, _ = _flash_forward(q, k, v, causal=causal, block_q=block_q,
                             block_k=block_k, scale=scale, interpret=interpret)
     return out
 
 
-def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
+def flash_attention(q, k, v, causal=False, block_q=None, block_k=None,
                     scale=None, interpret=False):
-    """(B, H, T, D) or (BH, T, D) attention; T must divide by the blocks.
-    Forward AND backward stream k/v-blocks through VMEM with the online-
-    softmax recurrence (two-pass backward: dq over q-blocks, dk/dv over
-    k-blocks) — O(T) memory in both directions. This is the long-context
-    path (round 2's backward recomputed full attention in fp32 via XLA,
-    materializing the (T, T) scores the forward avoided). First-order
-    autodiff only — see :func:`higher_order_attention` for grad-of-grad."""
+    """(B, H, T, D) or (BH, T, D) attention; T must divide by the blocks
+    (block_q/block_k None = :func:`auto_flash_block`, the measured v5e
+    optimum). Forward AND backward stream k/v-blocks through VMEM with the
+    online-softmax recurrence (two-pass backward: dq over q-blocks, dk/dv
+    over k-blocks) — O(T) memory in both directions. This is the
+    long-context path (round 2's backward recomputed full attention in
+    fp32 via XLA, materializing the (T, T) scores the forward avoided).
+    First-order autodiff only — see :func:`higher_order_attention` for
+    grad-of-grad."""
     if _HIGHER_ORDER:
         return _attention_reference(q, k, v, causal, scale)
     return _flash_attention_kernel(q, k, v, causal, block_q, block_k,
@@ -335,8 +386,7 @@ def _flash_bwd(causal, block_q, block_k, scale, interpret, res, g):
         q, k, v, out, g = (x.reshape(b * h, t, d)
                            for x in (q, k, v, out, g))
     bh, t, d = q.shape
-    bq = min(block_q, t)
-    bk = min(block_k, t)
+    bq, bk = _resolve_flash_blocks(t, block_q, block_k)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     do = g.astype(q.dtype)
     # delta_i = rowsum(dO_i * O_i): the softmax-backward correction term,

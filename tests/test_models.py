@@ -171,6 +171,36 @@ def test_flash_long_context_streamed_under_mesh():
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-4)
 
 
+def test_flash_blockless_long_T_falls_back_to_full_attention(monkeypatch):
+    """T > 1024 with no power-of-2 block structure (auto_flash_block
+    degenerates to a whole-T block) must take the XLA full-attention
+    fallback, never a whole-(T,T)-tile streamed kernel launch that would
+    blow VMEM on hardware."""
+    import deeplearning4j_tpu.ops.pallas_kernels as pk
+    from deeplearning4j_tpu.models.bert import _attention
+
+    def boom(*a, **k):
+        raise AssertionError("streamed kernel must not launch for "
+                             "blockless T")
+
+    monkeypatch.setattr(pk, "flash_attention", boom)
+    # T=1030: > 1024 with no block structure; T=900: <= 1024 but the
+    # whole-T fallback block is not 8-sublane aligned (900 % 8 != 0) —
+    # both must serve via the einsum path, never a raw kernel launch
+    for T in (1030, 900):
+        assert pk.auto_flash_block(T) == T
+        cfg = TransformerConfig(vocab_size=64, hidden=16, layers=1, heads=2,
+                                mlp_dim=32, max_seq=T, dtype=jnp.float32,
+                                remat=False, attention_impl="flash")
+        cfg0 = TransformerConfig(**{**cfg.__dict__, "attention_impl": "full"})
+        q, k, v = (jnp.asarray(np.random.default_rng(i).normal(
+            size=(1, 2, T, 8)) * 0.1, jnp.float32) for i in range(3))
+        got = _attention(q, k, v, cfg, None)
+        want = _attention(q, k, v, cfg0, None)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6)
+
+
 def test_packed_mesh_spec_rejects_unpartitionable_meshes():
     """_packed_mesh_spec: None (-> einsum/ring fallback) when the sequence
     axis is sharded or batch/heads don't divide the mesh axes."""

@@ -36,6 +36,27 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5, rtol=2e-5)
 
+    def test_auto_block_default_and_awkward_lengths(self):
+        """block_q/block_k=None resolves via auto_flash_block, which must
+        always return a DIVISOR of T — incl. T with no power-of-2
+        structure (100, 24) and tiny T (4), which the old fixed-128
+        default served via its min(block, t) clamp."""
+        from deeplearning4j_tpu.ops.pallas_kernels import auto_flash_block
+        for t in (4, 8, 24, 100, 512, 640, 1000, 8192):
+            assert t % auto_flash_block(t) == 0, t
+        assert auto_flash_block(8192) == 512
+        for t in (100, 24):
+            q, k, v = _rand(2, t, 8), _rand(2, t, 8), _rand(2, t, 8)
+            got = flash_attention(q, k, v, False, None, None, None, True)
+            want = _attention_reference(q, k, v, False, None)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=2e-5, rtol=2e-5)
+        # blockless LONG T: the auto default must refuse the degenerate
+        # whole-(T, T) tile with an actionable error, not launch it
+        q, k, v = _rand(1, 8191, 8), _rand(1, 8191, 8), _rand(1, 8191, 8)
+        with pytest.raises(ValueError, match="no power-of-2 block"):
+            flash_attention(q, k, v, False, None, None, None, True)
+
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize("bq,bk", [(32, 32), (64, 16), (16, 64)])
     def test_gradients_match_reference(self, causal, bq, bk):

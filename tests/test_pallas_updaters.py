@@ -4,6 +4,8 @@ The fused updater is an opt-in standalone op (and a recorded negative
 result for the flagship step — see the module docstring); these tests pin
 its math to optax exactly: same params, same state tree, same trajectory.
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -103,7 +105,7 @@ def test_jit_donation_compatible():
     params = _tree(jax.random.PRNGKey(1))
     fu = fused_adamw(1e-3, interpret=True)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(p, st, g):
         return fu.apply(p, st, g)
 
