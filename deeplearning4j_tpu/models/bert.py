@@ -13,7 +13,9 @@ LM loss, backward, AdamW update — into one XLA executable over a
   out-projections → one all-reduce per block half).
 - **context** — sequence parallelism: ring attention (K/V blocks rotating
   over ICI via ppermute with online-softmax accumulation) from
-  ``deeplearning4j_tpu.parallel.sequence_parallel``.
+  ``deeplearning4j_tpu.parallel.sequence_parallel`` — Pallas-backed
+  (``ring_flash_attention``: per-pair streamed kernels, second-ring-pass
+  backward) whenever the local shard fits the kernel envelope.
 
 Params are fp32; matmul compute is bf16 (MXU-native); layernorm/softmax in
 fp32. Everything is a plain pytree of jnp arrays — no framework object graph.
@@ -34,7 +36,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from deeplearning4j_tpu.parallel.mesh import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
-from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention, ulysses_attention
+from deeplearning4j_tpu.parallel.sequence_parallel import (
+    ring_attention, ring_flash_attention, ulysses_attention)
 
 _log = logging.getLogger(__name__)
 _flash_fallback_warned: set = set()
@@ -219,8 +222,19 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
         return _full_attention(q, k, v, cfg.causal, cfg.softmax_dtype)
     # 'ring' and sequence-sharded 'flash' both take the ppermute ring —
     # ring attention IS flash attention's online-softmax recurrence with
-    # k/v blocks arriving over ICI instead of from HBM
-    fn = ulysses_attention if impl == "ulysses" else ring_attention
+    # k/v blocks arriving over ICI instead of from HBM. When the local
+    # shard fits the streamed kernel's envelope (same gate as the
+    # single-device streamed route), the per-pair block attention runs in
+    # Pallas with a second-ring-pass custom backward (O(T_local) memory
+    # both directions); otherwise the einsum ring serves as fallback.
+    if impl == "ulysses":
+        fn = ulysses_attention
+    else:
+        T_local = q.shape[2] // mesh.shape[CONTEXT_AXIS]
+        from deeplearning4j_tpu.ops.pallas_kernels import auto_flash_block
+        lblk = auto_flash_block(T_local)
+        fn = ring_flash_attention \
+            if (lblk % 8 == 0 and lblk <= 1024) else ring_attention
     # heads sharded over 'model', sequence over 'context'
     spec = P(DATA_AXIS if DATA_AXIS in mesh.axis_names else None,
              MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None,

@@ -336,7 +336,7 @@ def _resolve_flash_blocks(t: int, block_q, block_k):
     fwd+bwd, parity vs the einsum reference."""
     bq = auto_flash_block(t) if block_q is None else min(block_q, t)
     bk = auto_flash_block(t) if block_k is None else min(block_k, t)
-    if (block_q is None or block_k is None) and max(bq, bk) > 1024:
+    if (block_q is None and bq > 1024) or (block_k is None and bk > 1024):
         raise ValueError(
             f"flash_attention: T={t} has no power-of-2 block structure, so "
             "the auto block degenerates to a whole-T score tile that "
@@ -378,6 +378,46 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, scale, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _launch_bwd_dq(q, k, v, do, lse, delta, causal, bq, bk, sc, interpret):
+    """One dq pallas_call for a (q-shard, k/v-shard) pair: (BH, T, D)
+    operands, lse/delta (BH, 1, T) fp32 in the GLOBAL softmax frame.
+    Shared by the single-device backward and the ring-attention backward
+    (where the pair's k/v arrived over ICI)."""
+    bh, t, d = q.shape
+    qblk = pl.BlockSpec((1, bq, d), lambda b_, i: (b_, i, 0))
+    kfull = pl.BlockSpec((1, t, d), lambda b_, i: (b_, 0, 0))
+    qvec = pl.BlockSpec((1, 1, bq), lambda b_, i: (b_, 0, i))
+    return pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=bk, causal=causal,
+                          scale=sc),
+        grid=(bh, t // bq),
+        in_specs=[qblk, kfull, kfull, qblk, qvec, qvec],
+        out_specs=qblk,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else _tpu_params(),
+    )(q, k, v, do, lse, delta)
+
+
+def _launch_bwd_dkv(q, k, v, do, lse, delta, causal, bq, bk, sc, interpret):
+    """One dk/dv pallas_call for a (q-shard, k/v-shard) pair — see
+    :func:`_launch_bwd_dq`."""
+    bh, t, d = q.shape
+    kblk = pl.BlockSpec((1, bk, d), lambda b_, i: (b_, i, 0))
+    kfull = pl.BlockSpec((1, t, d), lambda b_, i: (b_, 0, 0))
+    tvec = pl.BlockSpec((1, 1, t), lambda b_, i: (b_, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=bq, causal=causal,
+                          scale=sc),
+        grid=(bh, t // bk),
+        in_specs=[kfull, kblk, kblk, kfull, tvec, tvec],
+        out_specs=[kblk, kblk],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype)] * 2,
+        interpret=interpret,
+        compiler_params=None if interpret else _tpu_params(),
+    )(q, k, v, do, lse, delta)
+
+
 def _flash_bwd(causal, block_q, block_k, scale, interpret, res, g):
     q, k, v, out, lse = res
     orig_rank = q.ndim
@@ -393,33 +433,10 @@ def _flash_bwd(causal, block_q, block_k, scale, interpret, res, g):
     # one cheap fused elementwise reduction in XLA
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(bh, 1, t)
-
-    qblk = pl.BlockSpec((1, bq, d), lambda b_, i: (b_, i, 0))
-    kfull = pl.BlockSpec((1, t, d), lambda b_, i: (b_, 0, 0))
-    qvec = pl.BlockSpec((1, 1, bq), lambda b_, i: (b_, 0, i))
-    dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=bk, causal=causal,
-                          scale=sc),
-        grid=(bh, t // bq),
-        in_specs=[qblk, kfull, kfull, qblk, qvec, qvec],
-        out_specs=qblk,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-        interpret=interpret,
-        compiler_params=None if interpret else _tpu_params(),
-    )(q, k, v, do, lse, delta)
-
-    kblk = pl.BlockSpec((1, bk, d), lambda b_, i: (b_, i, 0))
-    tvec = pl.BlockSpec((1, 1, t), lambda b_, i: (b_, 0, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=bq, causal=causal,
-                          scale=sc),
-        grid=(bh, t // bk),
-        in_specs=[kfull, kblk, kblk, kfull, tvec, tvec],
-        out_specs=[kblk, kblk],
-        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype)] * 2,
-        interpret=interpret,
-        compiler_params=None if interpret else _tpu_params(),
-    )(q, k, v, do, lse, delta)
+    dq = _launch_bwd_dq(q, k, v, do, lse, delta, causal, bq, bk, sc,
+                        interpret)
+    dk, dv = _launch_bwd_dkv(q, k, v, do, lse, delta, causal, bq, bk, sc,
+                             interpret)
     if orig_rank == 4:
         dq, dk, dv = (x.reshape(b, h, t, d) for x in (dq, dk, dv))
     return dq, dk, dv

@@ -69,7 +69,7 @@ def ring_attention(q, k, v, axis_name: str = CONTEXT_AXIS, causal: bool = False)
         else:
             mask = None
         m, l, o = _block_attn_update(q, k_blk, v_blk, m, l, o, scale, mask)
-        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        perm = _ring_perm(axis_size)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return o, l, m, k_blk, v_blk
@@ -104,11 +104,193 @@ def ulysses_attention(q, k, v, axis_name: str = CONTEXT_AXIS, causal: bool = Fal
     return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
 
+# ------------------------------------------------- Pallas-backed ring
+#
+# ring_attention above is the einsum reference: exact, any-shape, but each
+# ring step materializes a (T_local, T_local) score tensor in HBM, and
+# reverse-mode through its scan saves every ROTATED k/v copy — backward
+# memory is O(T_global) per device, quietly defeating the ring's purpose.
+# ring_flash_attention replaces both: the per-pair block attention is the
+# streamed Pallas flash kernel (scores stay in VMEM), and a custom VJP
+# runs the backward as a SECOND ring pass (dk/dv partial sums rotate with
+# their k/v blocks; p is rebuilt from the saved global logsumexp), so both
+# directions are O(T_local) memory per device. Per-pair kernels are the
+# same _launch_bwd_dq/_launch_bwd_dkv the single-device backward uses.
+
+
+def _merge_partial(o, lse, o_b, lse_b):
+    """Combine two normalized attention partials (o, lse) -> (o, lse).
+    All fp32; lse shaped (BH, 1, T), o shaped (BH, T, D)."""
+    m = jnp.maximum(lse, lse_b)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    w = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(lse - m_safe))
+    w_b = jnp.where(jnp.isneginf(lse_b), 0.0, jnp.exp(lse_b - m_safe))
+    denom = jnp.maximum(w + w_b, 1e-30)
+    wT, wbT, dT = (x.transpose(0, 2, 1) for x in (w, w_b, denom))
+    o_new = (o * wT + o_b * wbT) / dT
+    lse_new = m_safe + jnp.log(denom)
+    lse_new = jnp.where(jnp.isneginf(m), m, lse_new)
+    return o_new, lse_new
+
+
+def _ring_perm(axis_size):
+    return [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret):
+    from deeplearning4j_tpu.ops.pallas_kernels import _flash_forward
+
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    q3 = q.reshape(B * H, T, D)
+
+    def pair(k_blk, v_blk, pair_causal):
+        o_b, lse_b = _flash_forward(
+            q3, k_blk.reshape(B * H, T, D), v_blk.reshape(B * H, T, D),
+            causal=pair_causal, block_q=None, block_k=None, scale=None,
+            interpret=interpret)
+        return o_b.astype(jnp.float32), lse_b
+
+    # step 0 always holds the device's own (diagonal) block: causal there
+    # means the standard lower-triangular mask in the local frame
+    o, lse = pair(k, v, causal)
+    if axis_size > 1:
+        def body(i, carry):
+            o, lse, k_blk, v_blk = carry
+            k_blk = lax.ppermute(k_blk, axis_name, _ring_perm(axis_size))
+            v_blk = lax.ppermute(v_blk, axis_name, _ring_perm(axis_size))
+            kv_idx = (my_idx - i) % axis_size
+            if causal:
+                # kv_idx > my_idx: a strictly-future block — contributes
+                # nothing; branch skips the kernel entirely (conditional
+                # HLO, only the taken side executes)
+                o_b, lse_b = lax.cond(
+                    kv_idx < my_idx,
+                    lambda ops: pair(ops[0], ops[1], False),
+                    lambda ops: (jnp.zeros((B * H, T, D), jnp.float32),
+                                 jnp.full((B * H, 1, T), -jnp.inf,
+                                          jnp.float32)),
+                    (k_blk, v_blk))
+            else:
+                o_b, lse_b = pair(k_blk, v_blk, False)
+            o, lse = _merge_partial(o, lse, o_b, lse_b)
+            return o, lse, k_blk, v_blk
+
+        o, lse, _, _ = lax.fori_loop(1, axis_size, body, (o, lse, k, v))
+    out = o.astype(q.dtype).reshape(B, H, T, D)
+    return out, lse
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, causal, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, causal, interpret, res, g):
+    from deeplearning4j_tpu.ops.pallas_kernels import (
+        _launch_bwd_dq, _launch_bwd_dkv, auto_flash_block)
+
+    q, k, v, out, lse = res
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    bq = bk = auto_flash_block(T)
+    sc = 1.0 / (D ** 0.5)
+    q3 = q.reshape(B * H, T, D)
+    do3 = g.reshape(B * H, T, D).astype(q.dtype)
+    delta = jnp.sum(do3.astype(jnp.float32)
+                    * out.reshape(B * H, T, D).astype(jnp.float32),
+                    axis=-1).reshape(B * H, 1, T)
+
+    def pair_grads(k_blk, v_blk, pair_causal):
+        k3 = k_blk.reshape(B * H, T, D)
+        v3 = v_blk.reshape(B * H, T, D)
+        dq_c = _launch_bwd_dq(q3, k3, v3, do3, lse, delta, pair_causal,
+                              bq, bk, sc, interpret)
+        dk_c, dv_c = _launch_bwd_dkv(q3, k3, v3, do3, lse, delta,
+                                     pair_causal, bq, bk, sc, interpret)
+        return (dq_c.astype(jnp.float32), dk_c.astype(jnp.float32),
+                dv_c.astype(jnp.float32))
+
+    # second ring pass: dk/dv partial sums ride the ring WITH their k/v
+    # block; after axis_size rotations each block (and its accumulated
+    # gradient) is home. dq accumulates locally.
+    dq, dk, dv = pair_grads(k, v, causal)
+
+    if axis_size > 1:
+        zeros3 = jnp.zeros((B * H, T, D), jnp.float32)
+
+        def body(i, carry):
+            dq, k_blk, v_blk, dk_blk, dv_blk = carry
+            k_blk = lax.ppermute(k_blk, axis_name, _ring_perm(axis_size))
+            v_blk = lax.ppermute(v_blk, axis_name, _ring_perm(axis_size))
+            dk_blk = lax.ppermute(dk_blk, axis_name, _ring_perm(axis_size))
+            dv_blk = lax.ppermute(dv_blk, axis_name, _ring_perm(axis_size))
+            kv_idx = (my_idx - i) % axis_size
+            if causal:
+                dq_c, dk_c, dv_c = lax.cond(
+                    kv_idx < my_idx,
+                    lambda ops: pair_grads(ops[0], ops[1], False),
+                    lambda ops: (zeros3, zeros3, zeros3),
+                    (k_blk, v_blk))
+            else:
+                dq_c, dk_c, dv_c = pair_grads(k_blk, v_blk, False)
+            return (dq + dq_c, k_blk, v_blk, dk_blk + dk_c, dv_blk + dv_c)
+
+        dq, _, _, dk, dv = lax.fori_loop(
+            1, axis_size, body, (dq, k, v, dk, dv))
+        # one more hop brings each dk/dv partial sum back to its owner
+        dk = lax.ppermute(dk, axis_name, _ring_perm(axis_size))
+        dv = lax.ppermute(dv, axis_name, _ring_perm(axis_size))
+
+    shape = (B, H, T, D)
+    return (dq.astype(q.dtype).reshape(shape),
+            dk.astype(k.dtype).reshape(shape),
+            dv.astype(v.dtype).reshape(shape))
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
+def ring_flash_attention(q, k, v, axis_name: str = CONTEXT_AXIS,
+                         causal: bool = False,
+                         interpret: Optional[bool] = None):
+    """Ring attention whose per-pair block attention is the streamed Pallas
+    flash kernel — call INSIDE shard_map with (B, H, T_local, D) shards,
+    like :func:`ring_attention` (which remains the einsum reference).
+    Exact full-attention result; O(T_local) memory per device in BOTH
+    directions (the einsum ring's scan backward saves every rotated k/v
+    copy — O(T_global)). First-order autodiff only, like the kernels it
+    launches. For causal masking, strictly-future blocks skip their kernel
+    launch entirely (conditional HLO), matching the einsum ring's
+    all-False-mask semantics at less cost; the inherent tail-device load
+    imbalance of a plain (non-zigzag) causal ring remains. Under
+    :func:`deeplearning4j_tpu.ops.pallas_kernels.higher_order_attention`
+    this falls back to the any-order-differentiable einsum ring, same as
+    the single-device kernels fall back to their XLA reference."""
+    from deeplearning4j_tpu.ops import pallas_kernels as _pk
+    if _pk._HIGHER_ORDER:
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _ring_flash(q, k, v, axis_name, causal, interpret)
+
+
 def ring_self_attention(mesh: Mesh, q, k, v, causal: bool = False,
                         axis_name: str = CONTEXT_AXIS, impl: str = "ring"):
     """Whole-array convenience: q,k,v (B, H, T, D) with T divisible by the
-    context axis size; shard_maps the chosen implementation over the mesh."""
-    fn = ring_attention if impl == "ring" else ulysses_attention
+    context axis size; shard_maps the chosen implementation over the mesh.
+    impl: 'ring' (einsum), 'ring_flash' (Pallas per-pair kernels),
+    'ulysses' (all-to-all)."""
+    fn = {"ring": ring_attention, "ring_flash": ring_flash_attention,
+          "ulysses": ulysses_attention}[impl]
     spec = P(None, None, axis_name, None)
     mapped = shard_map(
         functools.partial(fn, axis_name=axis_name, causal=causal),

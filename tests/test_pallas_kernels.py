@@ -56,6 +56,13 @@ class TestFlashAttention:
         q, k, v = _rand(1, 8191, 8), _rand(1, 8191, 8), _rand(1, 8191, 8)
         with pytest.raises(ValueError, match="no power-of-2 block"):
             flash_attention(q, k, v, False, None, None, None, True)
+        # mixed explicit/auto: an explicit big block is the CALLER'S
+        # choice and must not trip the auto-side guard
+        q, k, v = _rand(1, 2048, 8), _rand(1, 2048, 8), _rand(1, 2048, 8)
+        got = flash_attention(q, k, v, False, 2048, None, None, True)
+        want = _attention_reference(q, k, v, False, None)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
 
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize("bq,bk", [(32, 32), (64, 16), (16, 64)])

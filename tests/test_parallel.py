@@ -74,7 +74,7 @@ class TestDataParallel:
 
 class TestSequenceParallel:
     @pytest.mark.parametrize("causal", [False, True])
-    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    @pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
     def test_matches_full_attention(self, causal, impl):
         mesh = make_mesh({"context": 8})
         B, H, T, D = 2, 8, 32, 16  # T divisible by 8; H divisible by 8 for ulysses
@@ -100,6 +100,61 @@ class TestSequenceParallel:
         g1 = jax.grad(loss_ring)(q)
         g2 = jax.grad(loss_ref)(q)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_flash_gradients_match_reference(self, causal):
+        """The Pallas-backed ring's custom second-ring-pass backward must
+        match reference grads for all three operands — incl. the causal
+        case where strictly-future blocks skip their kernels entirely."""
+        mesh = make_mesh({"context": 4})
+        B, H, T, D = 2, 3, 64, 8
+        k1, k2, k3 = jax.random.split(jax.random.key(7), 3)
+        q = jax.random.normal(k1, (B, H, T, D), jnp.float32) * 0.3
+        k = jax.random.normal(k2, (B, H, T, D), jnp.float32) * 0.3
+        v = jax.random.normal(k3, (B, H, T, D), jnp.float32) * 0.3
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+        ring = loss(lambda q, k, v: ring_self_attention(
+            mesh, q, k, v, causal=causal, impl="ring_flash"))
+        ref = loss(lambda q, k, v: reference_attention(q, k, v,
+                                                       causal=causal))
+        gf = jax.grad(ring, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=1e-4)
+
+    def test_ring_flash_higher_order_escape_hatch(self):
+        """higher_order_attention() must route the ring to the any-order
+        einsum implementation — grad-of-grad works inside the context and
+        raises outside it (first-order custom_vjp)."""
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            higher_order_attention)
+        mesh = make_mesh({"context": 2})
+        q = jax.random.normal(jax.random.key(5), (1, 2, 16, 8),
+                              jnp.float32) * 0.3
+
+        def loss(s):
+            return jnp.sum(ring_self_attention(
+                mesh, q * s, q, q, causal=True, impl="ring_flash") ** 2)
+
+        with higher_order_attention():
+            h = jax.grad(jax.grad(loss))(1.0)
+        assert np.isfinite(float(h))
+        with pytest.raises(Exception):
+            jax.grad(jax.grad(loss))(1.0)
+
+    def test_ring_flash_single_shard_degenerates_to_flash(self):
+        """axis_size=1: no rotations, just the local streamed kernel."""
+        mesh = make_mesh({"context": 1})
+        q = jax.random.normal(jax.random.key(3), (1, 2, 32, 8), jnp.float32)
+        got = ring_self_attention(mesh, q, q, q, causal=True,
+                                  impl="ring_flash")
+        want = reference_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
 
 
 class TestGradientCompression:
@@ -151,6 +206,28 @@ class TestLongContext:
         want = reference_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5)
+
+    def test_ring_flash_long_sequence_sharded(self):
+        """Same 2048-token/8-shard case through the Pallas-backed ring —
+        fwd AND grads vs the oracle (the einsum ring's backward saves every
+        rotated k/v copy; this one re-rotates instead, O(T_local))."""
+        mesh = make_mesh({"context": 8})
+        B, H, T, D = 1, 2, 2048, 16
+        k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(k1, (B, H, T, D), jnp.float32) * 0.1
+        k = jax.random.normal(k2, (B, H, T, D), jnp.float32) * 0.1
+        v = jax.random.normal(k3, (B, H, T, D), jnp.float32)
+        got = ring_self_attention(mesh, q, k, v, causal=True,
+                                  impl="ring_flash")
+        want = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+        gf = jax.grad(lambda q: jnp.sum(ring_self_attention(
+            mesh, q, k, v, causal=True, impl="ring_flash") ** 2))(q)
+        gr = jax.grad(lambda q: jnp.sum(reference_attention(
+            q, k, v, causal=True) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=1e-4, rtol=1e-3)
 
 
 class TestEarlyStoppingParallel:
