@@ -186,20 +186,17 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
                     MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None,
                     None, None)
         interpret = jax.default_backend() != "tpu"
-        from deeplearning4j_tpu.ops.pallas_kernels import (auto_flash_block,
-                                                           flash_attention)
-        # auto_flash_block always returns a divisor (worst case T itself),
-        # so the usability gate is on the BLOCK: small enough that a
-        # (blk, T) score tile fits VMEM, and 8-sublane aligned — unaligned
-        # whole-T blocks do compile (Mosaic masks partial tiles, verified
-        # on v5e), but that envelope is unswept for perf, so odd-T
-        # sequences stay on the known-good einsum path here
-        blk = auto_flash_block(T)
-        if blk % 8 == 0 and blk <= 1024 \
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            flash_attention, flash_envelope_ok)
+        # flash_envelope_ok: the auto block must be 8-sublane aligned and
+        # VMEM-safe — unaligned whole-T blocks do compile (Mosaic masks
+        # partial tiles, verified on v5e), but that envelope is unswept
+        # for perf, so odd-T sequences stay on the known-good einsum path
+        if flash_envelope_ok(T) \
                 and (mesh is None or mesh_spec is not None):
 
             def _local(ql, kl, vl):
-                return flash_attention(ql, kl, vl, cfg.causal, blk, blk,
+                return flash_attention(ql, kl, vl, cfg.causal, None, None,
                                        None, interpret)
 
             if mesh is None:
@@ -231,10 +228,9 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
         fn = ulysses_attention
     else:
         T_local = q.shape[2] // mesh.shape[CONTEXT_AXIS]
-        from deeplearning4j_tpu.ops.pallas_kernels import auto_flash_block
-        lblk = auto_flash_block(T_local)
-        fn = ring_flash_attention \
-            if (lblk % 8 == 0 and lblk <= 1024) else ring_attention
+        from deeplearning4j_tpu.ops.pallas_kernels import flash_envelope_ok
+        fn = ring_flash_attention if flash_envelope_ok(T_local) \
+            else ring_attention
     # heads sharded over 'model', sequence over 'context'
     spec = P(DATA_AXIS if DATA_AXIS in mesh.axis_names else None,
              MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None,

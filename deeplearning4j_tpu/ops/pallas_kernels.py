@@ -326,6 +326,16 @@ def auto_flash_block(t: int) -> int:
     return blk if blk and t % blk == 0 else t
 
 
+def flash_envelope_ok(t: int) -> bool:
+    """True when ``auto_flash_block(t)`` yields a block the streamed
+    kernels are known-good for: 8-sublane aligned and within the
+    (blk, T)-score-tile VMEM bound. The ONE encoding of the routing
+    envelope — the model streamed route, the ring route, and Ulysses all
+    consume it, so the three sites cannot drift."""
+    blk = auto_flash_block(t)
+    return blk % 8 == 0 and blk <= 1024
+
+
 def _resolve_flash_blocks(t: int, block_q, block_k):
     """None -> auto_flash_block with a guard: if auto-resolution
     degenerates to a whole-T block beyond the VMEM-safe envelope, raise an

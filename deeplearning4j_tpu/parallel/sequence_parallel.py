@@ -9,8 +9,13 @@ online-softmax accumulation (ring attention), or head-resharding via all_to_all
 (Ulysses). Both compose with data/tensor parallelism through shard_map.
 
 Public entry points:
-- ``ring_attention(q, k, v, axis_name, causal)``     — call inside shard_map
-- ``ulysses_attention(q, k, v, axis_name, causal)``  — call inside shard_map
+- ``ring_flash_attention(q, k, v, axis_name, causal)`` — the default ring:
+  per-pair streamed Pallas kernels + second-ring-pass backward,
+  O(T_local) memory both directions; call inside shard_map
+- ``ring_attention(q, k, v, axis_name, causal)``     — einsum reference ring
+  (any-order differentiable; backward saves rotated k/v copies)
+- ``ulysses_attention(q, k, v, axis_name, causal)``  — all-to-all head
+  resharding; local full-T attention routes through the streamed kernel
 - ``ring_self_attention(mesh, q, k, v, ...)``        — whole-array convenience
 """
 from __future__ import annotations
@@ -81,21 +86,45 @@ def ring_attention(q, k, v, axis_name: str = CONTEXT_AXIS, causal: bool = False)
     return o / jnp.maximum(l, 1e-30)
 
 
-def ulysses_attention(q, k, v, axis_name: str = CONTEXT_AXIS, causal: bool = False):
+def ulysses_attention(q, k, v, axis_name: str = CONTEXT_AXIS,
+                      causal: bool = False, use_kernel: Optional[bool] = None):
     """All-to-all ("Ulysses") sequence parallelism: reshard from
     sequence-sharded to head-sharded via all_to_all, run full attention on the
     complete sequence for the local head subset, reshard back. Requires
-    num_heads % axis_size == 0. Call INSIDE shard_map with (B, H, T_local, D)."""
+    num_heads % axis_size == 0. Call INSIDE shard_map with (B, H, T_local, D).
+
+    ``use_kernel``: the local full-T attention is a per-device computation,
+    so it routes through the streamed Pallas flash kernel (scores stay in
+    VMEM instead of a (B, H_local, T, T) HBM tensor at GLOBAL T) when the
+    resolved block fits the kernel envelope. None = auto (kernel on TPU,
+    einsum elsewhere/in tests that need exact einsum semantics); False
+    pins einsum; True forces the kernel in interpret mode off-TPU.
+    ``flash_attention`` itself honors ``higher_order_attention()``."""
     axis_size = lax.psum(1, axis_name)
     # (B,H,T_local,D) -> gather seq, scatter heads -> (B,H_local,T,D)
     q = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
     v = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
     D = q.shape[-1]
+    T = q.shape[2]
+    on_tpu = jax.default_backend() == "tpu"
+    from deeplearning4j_tpu.ops.pallas_kernels import (flash_attention,
+                                                       flash_envelope_ok)
+    fits = flash_envelope_ok(T)
+    if use_kernel and not fits:
+        raise ValueError(
+            f"ulysses_attention: use_kernel=True but global T={T} is "
+            "outside the streamed kernel's block envelope; pad the "
+            "sequence or drop to use_kernel=None/False")
+    if use_kernel is None:
+        use_kernel = on_tpu and fits
+    if use_kernel:
+        o = flash_attention(q, k, v, causal, None, None, None, not on_tpu)
+        return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=q.dtype))
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
-        T = q.shape[2]
         mask = jnp.tril(jnp.ones((T, T), dtype=bool))
         s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
     p = jax.nn.softmax(s, axis=-1)

@@ -126,6 +126,72 @@ class TestSequenceParallel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-5, rtol=1e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ulysses_kernel_route_matches_einsum(self, causal):
+        """Ulysses' local full-T attention through the streamed Pallas
+        kernel (use_kernel=True, interpret off-TPU) must match its einsum
+        path — fwd and grads."""
+        from deeplearning4j_tpu.parallel.sequence_parallel import (
+            ulysses_attention)
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        import functools as ft
+
+        mesh = make_mesh({"context": 4})
+        B, H, T, D = 1, 4, 32, 8
+        k1, k2, k3 = jax.random.split(jax.random.key(9), 3)
+        q = jax.random.normal(k1, (B, H, T, D), jnp.float32) * 0.3
+        k = jax.random.normal(k2, (B, H, T, D), jnp.float32) * 0.3
+        v = jax.random.normal(k3, (B, H, T, D), jnp.float32) * 0.3
+        spec = P(None, None, "context", None)
+
+        def run(use_kernel):
+            fn = shard_map(
+                ft.partial(ulysses_attention, axis_name="context",
+                           causal=causal, use_kernel=use_kernel),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_rep=False)
+            return fn(q, k, v)
+
+        np.testing.assert_allclose(np.asarray(run(True)),
+                                   np.asarray(run(False)), atol=2e-5)
+
+        def loss(use_kernel):
+            def f(q_, k_, v_):
+                fn = shard_map(
+                    ft.partial(ulysses_attention, axis_name="context",
+                               causal=causal, use_kernel=use_kernel),
+                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                    check_rep=False)
+                return jnp.sum(fn(q_, k_, v_) ** 2)
+            return f
+
+        ga = jax.grad(loss(True), argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(loss(False), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=1e-4)
+
+    def test_ulysses_forced_kernel_off_envelope_raises(self):
+        """use_kernel=True must not silently fall back to einsum when the
+        global T is outside the kernel envelope."""
+        from deeplearning4j_tpu.parallel.sequence_parallel import (
+            ulysses_attention)
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        import functools as ft
+
+        mesh = make_mesh({"context": 2})
+        q = jax.random.normal(jax.random.key(2), (1, 2, 36, 8), jnp.float32)
+        spec = P(None, None, "context", None)
+        fn = shard_map(
+            ft.partial(ulysses_attention, axis_name="context",
+                       causal=False, use_kernel=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False)
+        with pytest.raises(ValueError, match="outside the streamed"):
+            fn(q, q, q)  # global T=36: 36 % 8 != 0 -> off-envelope
+
     def test_ring_flash_higher_order_escape_hatch(self):
         """higher_order_attention() must route the ring to the any-order
         einsum implementation — grad-of-grad works inside the context and
