@@ -1,0 +1,157 @@
+"""Graph-level kernel-fusion rewrites for SameDiff (beyond-parity).
+
+The reference executes imported graphs node by node (SURVEY §3.3:
+``TrainingSession`` op-at-a-time); this rebuild already compiles the whole
+graph into one XLA program, but XLA still materializes the (B, H, T, T)
+attention score tensor between the four matmul/scale/softmax/matmul nodes
+an importer emits. ``fuse_attention`` pattern-matches that chain and
+collapses it onto the ``scaledDotProductAttentionFused`` registry op, whose
+TPU path is the whole-head VMEM Pallas kernel — the same lever that moved
+the hand-written flagship (BASELINE.md round 4), applied to IMPORTED
+graphs (BASELINE config #4).
+
+Matched shape (what the TF importer emits for BERT-style attention,
+verified against tools/tf_bert.py's frozen graph):
+
+    q ----------------------------\
+    k -> permute(0,1,3,2) -> matmul -> [mul(scalar)] -> softmax -> matmul -> out
+    v -------------------------------------------------------------^
+
+Intermediates must be single-consumer and not loss variables (a
+later ``sd.output(...)`` request for a fused-away intermediate will
+fail — intermediates are implementation detail, same as under plain
+jit fusion); the optional ``mul`` must be by a scalar constant (the
+1/sqrt(D) scale — trainable scalar scales are left unfused). Masked
+attention (an ``add`` between scale and softmax) is NOT yet matched —
+config #4's frozen graph has none; extend here when an imported workload
+needs it.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiffOp, VariableType
+
+
+def _scalar_const(sd, name) -> Optional[float]:
+    """The float value of a size-1 CONSTANT, else None. Trainable scalars
+    (varType VARIABLE) are rejected: baking their current value into the
+    fused op's static kwargs would silently freeze a learnable scale."""
+    try:
+        v = sd.getVariable(name)
+        if v.varType != VariableType.CONSTANT:
+            return None
+        arr = v.getArr()
+    except Exception:
+        return None
+    if arr is None:
+        return None
+    a = np.asarray(arr.toNumpy() if hasattr(arr, "toNumpy") else arr)
+    if a.size != 1:
+        return None
+    return float(a.reshape(()))
+
+
+def fuse_attention(sd) -> int:
+    """Collapse matmul->[scale]->softmax->matmul chains onto
+    ``scaledDotProductAttentionFused``. Returns the number of sites fused.
+    Output names are preserved, so downstream nodes and graph outputs are
+    untouched; numerics are identical on the einsum path and within kernel
+    tolerance (~1e-6 fp32 / bf16-rounding) on TPU."""
+    ops = sd._ops
+    producer = {}
+    consumers = defaultdict(list)
+    for i, node in enumerate(ops):
+        for out in node.outputs:
+            producer[out] = i
+        for inp in node.inputs:
+            consumers[inp].append(i)
+
+    def prod(name):
+        i = producer.get(name)
+        return (i, ops[i]) if i is not None else (None, None)
+
+    loss_vars = set(getattr(sd, "_loss_vars", []))
+
+    def single_internal(name):
+        """name has exactly one op consumer and is not a loss variable
+        (fusing away a loss var's producer would break fit())."""
+        return len(consumers.get(name, [])) == 1 and name not in loss_vars
+
+    to_remove = set()
+    replacements = {}
+    fused = 0
+    for i, node in enumerate(ops):
+        if (node.namespace, node.opname) != ("nn", "softmax"):
+            continue
+        if node.kwargs.get("dim", -1) not in (-1,):
+            continue
+        # upward: [mul(scale)] <- matmul(q, permute(k))
+        scale = None
+        mul_i = None
+        up_i, up = prod(node.inputs[0])
+        if up is not None and (up.namespace, up.opname) == ("math", "mul"):
+            a, b = up.inputs
+            mm_i, mm = prod(a)
+            scale_name = b
+            if mm is None or mm.opname != "matmul":
+                mm_i, mm = prod(b)
+                scale_name = a
+            if mm is None or mm.opname != "matmul":
+                continue
+            scale = _scalar_const(sd, scale_name)
+            if scale is None:
+                continue
+            mul_i = up_i
+        elif up is not None and up.opname == "matmul":
+            mm_i, mm = up_i, up
+            scale = 1.0
+        else:
+            continue
+        q_name, kt_name = mm.inputs
+        kt_i, kt = prod(kt_name)
+        if kt is None or kt.opname != "permute" \
+                or tuple(kt.kwargs.get("axes", ())) != (0, 1, 3, 2):
+            continue
+        k_name = kt.inputs[0]
+        # downward: softmax -> matmul(p, v)
+        p_name = node.outputs[0]
+        cons = consumers.get(p_name, [])
+        if len(cons) != 1:
+            continue
+        pv_i = cons[0]
+        pv = ops[pv_i]
+        if pv.opname != "matmul" or pv.inputs[0] != p_name:
+            continue
+        v_name = pv.inputs[1]
+        # all pattern intermediates single-consumer (and the kT permute
+        # removable only if nothing else reads it)
+        mids = [mm.outputs[0], p_name] \
+            + ([ops[mul_i].outputs[0]] if mul_i is not None else [])
+        if not all(single_internal(m) for m in mids):
+            continue
+        # shapes: split-head rank-4, square T, matching k/v
+        q_v, k_v, v_v = (sd.getVariable(n) for n in (q_name, k_name, v_name))
+        shapes = [getattr(x, "shape", None) for x in (q_v, k_v, v_v)]
+        if any(s is None or len(s) != 4 for s in shapes):
+            continue
+        # FULL shape equality (all four dims): the original matmul chain
+        # broadcasts leading dims, the fused einsum does not
+        if not (shapes[0] == shapes[1] == shapes[2]):
+            continue
+        replacements[pv_i] = SameDiffOp(
+            "nn", "scaledDotProductAttentionFused",
+            [q_name, k_name, v_name], [pv.outputs[0]], {"scale": scale})
+        to_remove.update(x for x in (mm_i, mul_i, i) if x is not None)
+        if single_internal(kt_name):
+            to_remove.add(kt_i)
+        fused += 1
+
+    if fused:
+        sd._ops = [replacements.get(idx, node) for idx, node in enumerate(ops)
+                   if idx not in to_remove]
+        sd._jit_cache.clear()
+    return fused

@@ -415,6 +415,20 @@ class SameDiff:
                 n += 1
         return n
 
+    def fuseAttention(self) -> int:
+        """Collapse imported matmul->[scale]->softmax->matmul attention
+        chains onto the kernel-backed ``scaledDotProductAttentionFused``
+        op (beyond-parity — see autodiff/rewrites.py for the matched
+        pattern and its guarantees). Returns the number of sites fused.
+        Typical use, mirroring the reference's fine-tune prelude::
+
+            sd = TensorflowFrameworkImporter.runImport(graph_def)
+            sd.convertAllConstantsToVariables()
+            sd.fuseAttention()        # optional kernel-fusion pass
+        """
+        from deeplearning4j_tpu.autodiff.rewrites import fuse_attention
+        return fuse_attention(self)
+
     def convertToConstant(self, var) -> SDVariable:
         """VARIABLE -> frozen constant in place (ref: SameDiff.convertToConstant)."""
         v = var if isinstance(var, SDVariable) else self._vars[var]

@@ -103,6 +103,58 @@ def dot_product_attention(q, k, v, mask=None, scaled=True):
     return jnp.einsum("...qk,...kd->...qd", weights, v)
 
 
+@op("scaledDotProductAttentionFused", "nn")
+def scaled_dot_product_attention_fused(q, k, v, scale=None, causal=False,
+                                       use_kernel=None):
+    """Kernel-backed scaled-dot-product attention on split-head
+    (B, H, T, D) layouts — the target op of the SameDiff attention-fusion
+    rewrite (``SameDiff.fuseAttention``): an imported graph's
+    matmul->scale->softmax->matmul chain collapses onto this, so the
+    (B, H, T, T) score tensor stays in VMEM instead of round-tripping HBM
+    between four graph nodes. ``use_kernel``: None = auto, True forces a
+    kernel (interpret mode off-TPU), False pins the einsum. First-order
+    autodiff when a kernel is taken; the einsum path differentiates to any
+    order.
+
+    The auto gate is MEASURED, not assumed (BASELINE.md round-5 "imported
+    attention fusion"): on this split-head layout the per-(b, h) kernel
+    grid only beats XLA's batched einsum once the per-row (T, T) block is
+    large — (32, 12, T, 64) fwd+bwd: einsum 3.1/3.3/6.9/20.6 ms vs kernel
+    3.2/4.0/7.1/9.4 at T=128/256/512/1024. Auto therefore takes the
+    whole-head kernel at T >= 768, the STREAMED flash kernel past the
+    whole-(T, T) VMEM envelope (T > 1024), and the einsum below — which is
+    why fusing config #4's T=128 graph is perf-neutral by design there."""
+    B, H, T, D = q.shape
+    from deeplearning4j_tpu.ops.pallas_kernels import (
+        active_global_mesh, flash_attention, flash_envelope_ok,
+        mha_attention, packed_kernel_shape_ok)
+    on_tpu = jax.default_backend() == "tpu"
+    same = k.shape == q.shape and v.shape == q.shape
+    whole_ok = same and packed_kernel_shape_ok(T)
+    stream_ok = same and T > 1024 and flash_envelope_ok(T)
+    if use_kernel and not (whole_ok or stream_ok):
+        raise ValueError(
+            f"scaledDotProductAttentionFused: use_kernel=True but shape "
+            f"{q.shape} fits neither the whole-head (T % 8 == 0, T <= "
+            f"1024, matching q/k/v) nor the streamed kernel envelope; "
+            f"use_kernel=None/False for the einsum path")
+    auto = use_kernel is None and on_tpu and active_global_mesh() is None
+    take_whole = whole_ok and (use_kernel or (auto and T >= 768))
+    take_stream = stream_ok and (use_kernel or auto)
+    if take_whole:
+        return mha_attention(q, k, v, causal, scale, not on_tpu)
+    if take_stream:
+        return flash_attention(q, k, v, causal, None, None, scale,
+                               not on_tpu)
+    sc = scale if scale is not None else 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sc
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
 @op("multiHeadDotProductAttention", "nn")
 def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
                          use_kernel=None):

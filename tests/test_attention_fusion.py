@@ -1,0 +1,152 @@
+"""SameDiff.fuseAttention (autodiff/rewrites.py): collapse imported
+matmul->[scale]->softmax->matmul chains onto the kernel-backed
+scaledDotProductAttentionFused op. Parity contract: identical outputs and
+training trajectories; non-matching graphs untouched."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff
+
+
+def _tiny_bert_sd():
+    tf = pytest.importorskip("tensorflow")  # noqa: F841
+    import sys
+    import pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from deeplearning4j_tpu.modelimport.tensorflow import (
+        TensorflowFrameworkImporter)
+    from tools.tf_bert import build_frozen_bert
+    gd, in_name, out_name, _ = build_frozen_bert(L=2, H=32, A=4, V=64, T=16,
+                                                 intermediate=64)
+    return TensorflowFrameworkImporter.runImport(gd), in_name, out_name
+
+
+class TestFuseAttention:
+    def test_imported_bert_output_parity(self):
+        sd, in_name, out_name = _tiny_bert_sd()
+        x = np.random.default_rng(0).integers(0, 64, (2, 16)).astype(np.int32)
+        before = np.asarray(sd.output({in_name: x}, out_name)[out_name]
+                            .toNumpy())
+        n_before = len(sd._ops)
+        assert sd.fuseAttention() == 2          # one site per layer
+        assert len(sd._ops) < n_before
+        after = np.asarray(sd.output({in_name: x}, out_name)[out_name]
+                           .toNumpy())
+        np.testing.assert_allclose(after, before, atol=1e-6)
+        # idempotent: nothing left to match
+        assert sd.fuseAttention() == 0
+
+    def test_training_trajectory_parity(self):
+        """One fit epoch fused vs unfused: identical losses (einsum path —
+        the rewrite must be numerically invisible)."""
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.train import Adam
+
+        losses = {}
+        for fuse in (False, True):
+            sd, in_name, out_name = _tiny_bert_sd()
+            sd.convertAllConstantsToVariables()
+            if fuse:
+                assert sd.fuseAttention() == 2
+            hidden = sd.getVariable(out_name)
+            w = sd.var("w", jnp.zeros((32, 4)))
+            logits = sd.linalg.matmul(hidden, w)
+            tgt = sd.placeHolder("t", shape=(2, 16), dtype=jnp.int32)
+            loss = sd.loss.sparseMcxent(tgt, logits)
+            sd.setLossVariables(loss.name)
+            sd.setTrainingConfig(TrainingConfig(updater=Adam(1e-3)))
+            rng = np.random.default_rng(1)
+            batch = {in_name: rng.integers(0, 64, (2, 16)).astype(np.int32),
+                     "t": rng.integers(0, 4, (2, 16)).astype(np.int32)}
+            hist = sd.fit([batch] * 3)
+            losses[fuse] = [float(h) for h in hist]
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+
+    def test_multi_consumer_softmax_not_fused(self):
+        """A softmax whose probabilities feed anything besides the PV
+        matmul must stay un-fused (the rewrite would delete a tensor the
+        graph still needs)."""
+        sd = SameDiff.create()
+        rng = np.random.default_rng(2)
+        q = sd.var("q", jnp.asarray(rng.normal(size=(1, 2, 8, 4)),
+                                    jnp.float32))
+        k = sd.var("k", jnp.asarray(rng.normal(size=(1, 2, 8, 4)),
+                                    jnp.float32))
+        v = sd.var("v", jnp.asarray(rng.normal(size=(1, 2, 8, 4)),
+                                    jnp.float32))
+        kt = sd.shapes.permute(k, axes=[0, 1, 3, 2])
+        s = sd.linalg.matmul(q, kt)
+        p = sd.nn.softmax(s)
+        out = sd.linalg.matmul(p, v)          # noqa: F841 — pattern tail
+        extra = p.sum()                        # second consumer
+        assert sd.fuseAttention() == 0
+        assert np.isfinite(float(extra.eval().toNumpy()))
+
+    def test_trainable_scalar_scale_not_fused(self):
+        """A learnable (VARIABLE) scalar scale must block fusion — baking
+        its current value into static kwargs would freeze it."""
+        sd = SameDiff.create()
+        rng = np.random.default_rng(4)
+        q = sd.var("q", jnp.asarray(rng.normal(size=(1, 2, 8, 4)),
+                                    jnp.float32))
+        k = sd.var("k", jnp.asarray(rng.normal(size=(1, 2, 8, 4)),
+                                    jnp.float32))
+        v = sd.var("v", jnp.asarray(rng.normal(size=(1, 2, 8, 4)),
+                                    jnp.float32))
+        temp = sd.var("temperature", jnp.asarray(0.5))   # trainable scalar
+        kt = sd.shapes.permute(k, axes=[0, 1, 3, 2])
+        s = sd.linalg.matmul(q, kt).mul(temp)
+        p = sd.nn.softmax(s)
+        sd.linalg.matmul(p, v)
+        assert sd.fuseAttention() == 0
+
+    def test_broadcast_kv_not_fused(self):
+        """q (B,H,T,D) against shared k/v (1,1,T,D): the original matmul
+        chain broadcasts, the fused einsum cannot — must stay unfused and
+        keep working."""
+        sd = SameDiff.create()
+        rng = np.random.default_rng(5)
+        q = sd.var("q", jnp.asarray(rng.normal(size=(2, 3, 8, 4)),
+                                    jnp.float32))
+        k = sd.var("k", jnp.asarray(rng.normal(size=(1, 1, 8, 4)),
+                                    jnp.float32))
+        v = sd.var("v", jnp.asarray(rng.normal(size=(1, 1, 8, 4)),
+                                    jnp.float32))
+        kt = sd.shapes.permute(k, axes=[0, 1, 3, 2])
+        p = sd.nn.softmax(sd.linalg.matmul(q, kt))
+        out = sd.linalg.matmul(p, v)
+        want = np.asarray(out.eval().toNumpy())
+        assert sd.fuseAttention() == 0
+        np.testing.assert_allclose(np.asarray(out.eval().toNumpy()), want)
+
+    def test_forced_kernel_off_envelope_raises(self):
+        from deeplearning4j_tpu import ops
+        import pytest as _pytest
+        q = np.random.default_rng(6).normal(size=(1, 2, 10, 4)) \
+            .astype(np.float32)  # T=10: not a multiple of 8
+        with _pytest.raises(ValueError, match="use_kernel=True"):
+            ops.nn.scaledDotProductAttentionFused(q, q, q, use_kernel=True)
+
+    def test_unscaled_pattern_and_scale_value(self):
+        """matmul->softmax->matmul (no scale mul) fuses with scale=1; a
+        scalar-constant mul is captured as the fused op's scale kwarg."""
+        sd = SameDiff.create()
+        rng = np.random.default_rng(3)
+        q = sd.var("q", jnp.asarray(rng.normal(size=(1, 2, 8, 4)) * 0.3,
+                                    jnp.float32))
+        k = sd.var("k", jnp.asarray(rng.normal(size=(1, 2, 8, 4)) * 0.3,
+                                    jnp.float32))
+        v = sd.var("v", jnp.asarray(rng.normal(size=(1, 2, 8, 4)) * 0.3,
+                                    jnp.float32))
+        kt = sd.shapes.permute(k, axes=[0, 1, 3, 2])
+        p = sd.nn.softmax(sd.linalg.matmul(q, kt))
+        out = sd.linalg.matmul(p, v)
+        want = np.asarray(out.eval().toNumpy())
+        assert sd.fuseAttention() == 1
+        node = next(o for o in sd._ops
+                    if o.opname == "scaledDotProductAttentionFused")
+        assert node.kwargs["scale"] == 1.0
+        np.testing.assert_allclose(np.asarray(out.eval().toNumpy()), want,
+                                   atol=1e-6)

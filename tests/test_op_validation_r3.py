@@ -204,6 +204,45 @@ class TestNNClosure:
             gradcheck(get_op(name, "nn").fn, [x])
         mark_validated(name, "nn")
 
+    def test_scaled_dot_product_attention_fused(self):
+        """Oracle (numpy softmax attention), fp64 gradcheck on the einsum
+        path, kernel-vs-einsum parity (interpret mode), and graph parity —
+        the target op of SameDiff.fuseAttention."""
+        B, H, T, D = 2, 3, 16, 8
+        q, k, v = (RNG.normal(size=(B, H, T, D)).astype(np.float32) * 0.3
+                   for _ in range(3))
+        sc = 0.125
+
+        def oracle(q, k, v):
+            s = np.einsum("bhqd,bhkd->bhqk", q, k).astype(np.float64) * sc
+            e = np.exp(s - s.max(-1, keepdims=True))
+            p = e / e.sum(-1, keepdims=True)
+            return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+        got = ops.nn.scaledDotProductAttentionFused(q, k, v, scale=sc,
+                                                    use_kernel=False)
+        np.testing.assert_allclose(_np(got).astype(np.float64),
+                                   oracle(q, k, v), rtol=1e-4, atol=1e-5)
+        # kernel (interpret) == einsum
+        gk = ops.nn.scaledDotProductAttentionFused(q, k, v, scale=sc,
+                                                   use_kernel=True)
+        np.testing.assert_allclose(_np(gk), _np(got), rtol=1e-4, atol=1e-5)
+        fn = get_op("scaledDotProductAttentionFused", "nn").fn
+        gradcheck(lambda q, k, v: fn(q, k, v, scale=sc, use_kernel=False),
+                  [q[:1, :1].astype(np.float64), k[:1, :1].astype(np.float64),
+                   v[:1, :1].astype(np.float64)], idx=0, rtol=3e-3)
+        # graph parity through the SameDiff surface
+        from deeplearning4j_tpu.autodiff import SameDiff
+        sd = SameDiff.create()
+        qv = sd.var("q", jnp.asarray(q))
+        kv = sd.var("k", jnp.asarray(k))
+        vv = sd.var("v", jnp.asarray(v))
+        out = sd.nn.scaledDotProductAttentionFused(qv, kv, vv, scale=sc,
+                                                   use_kernel=False)
+        np.testing.assert_allclose(np.asarray(out.eval().toNumpy()),
+                                   _np(got), rtol=1e-5, atol=1e-6)
+        mark_validated("scaledDotProductAttentionFused", "nn")
+
     def test_gelu_exact_erf_variant(self):
         got = ops.nn.gelu(X_ANY.astype(np.float32), approximate=False)
         want = X_ANY * 0.5 * (1 + scipy_special.erf(X_ANY / np.sqrt(2)))

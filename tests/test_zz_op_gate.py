@@ -35,7 +35,10 @@ EXEMPT: dict = {}
 # validating test for the new op. (Round-3 verdict: the old `len(done) < 400`
 # soft floor let 50 ops lose their tests before the gate noticed, and a
 # partial-suite run silently skipped enforcement.)
-EXPECTED_OPS = 450
+# 450 = the reference's declarable-op count (parity, rounds 1-4);
+# +1 round-5 beyond-parity op: scaledDotProductAttentionFused, the target
+# of the SameDiff attention-fusion rewrite (autodiff/rewrites.py)
+EXPECTED_OPS = 451
 
 
 def test_registry_size_pinned():

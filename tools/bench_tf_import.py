@@ -37,6 +37,10 @@ def main():
     # listeners via requiresModelAtIteration chunking
     ap.add_argument("--listener", action="store_true",
                     help="attach ScoreIterationListener(10) during timing")
+    ap.add_argument("--fuse-attention", action="store_true",
+                    help="run sd.fuseAttention() before training (collapse "
+                    "imported matmul/scale/softmax/matmul chains onto the "
+                    "Pallas-backed fused attention op)")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() not in ("cpu",)
@@ -59,6 +63,9 @@ def main():
     sd.convertAllConstantsToVariables()
     if on_tpu:
         sd.fuseSteps = 32  # measured sweep, see comment above
+    if args.fuse_attention:
+        nf = sd.fuseAttention()
+        print(f"# fuseAttention: {nf} sites", file=sys.stderr)
     n_param = sum(int(np.prod(v.shape)) for v in sd.variables()
                   if v.varType == "VARIABLE" and v.shape)
 
