@@ -14,17 +14,18 @@ Matched shape (what the TF importer emits for BERT-style attention,
 verified against tools/tf_bert.py's frozen graph):
 
     q ----------------------------\
-    k -> permute(0,1,3,2) -> matmul -> [mul(scalar)] -> softmax -> matmul -> out
-    v -------------------------------------------------------------^
+    k -> permute(0,1,3,2) -> matmul -> [mul(scalar)] -> [add(mask)] -> softmax -> matmul -> out
+    v ---------------------------------------------------------------------------^
 
 Intermediates must be single-consumer and not loss variables (a
 later ``sd.output(...)`` request for a fused-away intermediate will
 fail — intermediates are implementation detail, same as under plain
 jit fusion); the optional ``mul`` must be by a scalar constant (the
-1/sqrt(D) scale — trainable scalar scales are left unfused). Masked
-attention (an ``add`` between scale and softmax) is NOT yet matched —
-config #4's frozen graph has none; extend here when an imported workload
-needs it.
+1/sqrt(D) scale — trainable scalar scales are left unfused). The
+optional ``add`` is the BERT-import additive padding mask: it becomes
+the fused op's ``mask`` input (still a graph variable — masks are
+usually placeholder-derived, so they must stay dynamic), which pins
+the einsum path (kernels are causal/none only).
 """
 from __future__ import annotations
 
@@ -89,28 +90,51 @@ def fuse_attention(sd) -> int:
             continue
         if node.kwargs.get("dim", -1) not in (-1,):
             continue
-        # upward: [mul(scale)] <- matmul(q, permute(k))
-        scale = None
-        mul_i = None
-        up_i, up = prod(node.inputs[0])
-        if up is not None and (up.namespace, up.opname) == ("math", "mul"):
+        # upward: [add(mask)] <- [mul(scale)] <- matmul(q, permute(k))
+
+        def match_score_chain(name):
+            """name -> (mm_i, mm, mul_i, scale) when it is produced by
+            matmul or mul(scalar-const)<-matmul, else None."""
+            ci, cop = prod(name)
+            if cop is None:
+                return None
+            if (cop.namespace, cop.opname) == ("math", "mul"):
+                a, b = cop.inputs
+                mm_i, mm = prod(a)
+                scale_name = b
+                if mm is None or mm.opname != "matmul":
+                    mm_i, mm = prod(b)
+                    scale_name = a
+                if mm is None or mm.opname != "matmul":
+                    return None
+                sc = _scalar_const(sd, scale_name)
+                if sc is None:
+                    return None
+                return mm_i, mm, ci, sc
+            if cop.opname == "matmul":
+                return ci, cop, None, 1.0
+            return None
+
+        add_i = None
+        mask_name = None
+        chain = match_score_chain(node.inputs[0])
+        if chain is None:
+            up_i, up = prod(node.inputs[0])
+            if up is None or (up.namespace, up.opname) != ("math", "add"):
+                continue
+            # additive mask: try BOTH orientations fully — the mask side
+            # may itself be mul-produced (e.g. (1-m) * -1e4), so "has a
+            # mul producer" does not identify the score side; only a
+            # complete chain match does
             a, b = up.inputs
-            mm_i, mm = prod(a)
-            scale_name = b
-            if mm is None or mm.opname != "matmul":
-                mm_i, mm = prod(b)
-                scale_name = a
-            if mm is None or mm.opname != "matmul":
+            for cand, other in ((a, b), (b, a)):
+                chain = match_score_chain(cand)
+                if chain is not None and single_internal(cand):
+                    add_i, mask_name = up_i, other
+                    break
+            if chain is None or add_i is None:
                 continue
-            scale = _scalar_const(sd, scale_name)
-            if scale is None:
-                continue
-            mul_i = up_i
-        elif up is not None and up.opname == "matmul":
-            mm_i, mm = up_i, up
-            scale = 1.0
-        else:
-            continue
+        mm_i, mm, mul_i, scale = chain
         q_name, kt_name = mm.inputs
         kt_i, kt = prod(kt_name)
         if kt is None or kt.opname != "permute" \
@@ -130,22 +154,28 @@ def fuse_attention(sd) -> int:
         # all pattern intermediates single-consumer (and the kT permute
         # removable only if nothing else reads it)
         mids = [mm.outputs[0], p_name] \
-            + ([ops[mul_i].outputs[0]] if mul_i is not None else [])
+            + ([ops[mul_i].outputs[0]] if mul_i is not None else []) \
+            + ([ops[add_i].outputs[0]] if add_i is not None else [])
         if not all(single_internal(m) for m in mids):
             continue
-        # shapes: split-head rank-4, square T, matching k/v
+        # shapes: split-head rank-4 with consistent (T, D) trailing dims.
+        # Leading dims may differ (or be dynamic-dim sentinels in the
+        # recorded metadata): the fused op's einsum path uses broadcasting
+        # jnp.matmul with EXACTLY the original chain's semantics, and its
+        # kernel gate re-checks true traced shapes at execution time
         q_v, k_v, v_v = (sd.getVariable(n) for n in (q_name, k_name, v_name))
         shapes = [getattr(x, "shape", None) for x in (q_v, k_v, v_v)]
         if any(s is None or len(s) != 4 for s in shapes):
             continue
-        # FULL shape equality (all four dims): the original matmul chain
-        # broadcasts leading dims, the fused einsum does not
-        if not (shapes[0] == shapes[1] == shapes[2]):
+        if not (shapes[0][2:] == shapes[1][2:] == shapes[2][2:]):
             continue
+        inputs = [q_name, k_name, v_name] \
+            + ([mask_name] if mask_name is not None else [])
         replacements[pv_i] = SameDiffOp(
             "nn", "scaledDotProductAttentionFused",
-            [q_name, k_name, v_name], [pv.outputs[0]], {"scale": scale})
-        to_remove.update(x for x in (mm_i, mul_i, i) if x is not None)
+            inputs, [pv.outputs[0]], {"scale": scale})
+        to_remove.update(x for x in (mm_i, mul_i, add_i, i)
+                         if x is not None)
         if single_internal(kt_name):
             to_remove.add(kt_i)
         fused += 1

@@ -104,8 +104,8 @@ def dot_product_attention(q, k, v, mask=None, scaled=True):
 
 
 @op("scaledDotProductAttentionFused", "nn")
-def scaled_dot_product_attention_fused(q, k, v, scale=None, causal=False,
-                                       use_kernel=None):
+def scaled_dot_product_attention_fused(q, k, v, mask=None, scale=None,
+                                       causal=False, use_kernel=None):
     """Kernel-backed scaled-dot-product attention on split-head
     (B, H, T, D) layouts — the target op of the SameDiff attention-fusion
     rewrite (``SameDiff.fuseAttention``): an imported graph's
@@ -123,19 +123,26 @@ def scaled_dot_product_attention_fused(q, k, v, scale=None, causal=False,
     3.2/4.0/7.1/9.4 at T=128/256/512/1024. Auto therefore takes the
     whole-head kernel at T >= 768, the STREAMED flash kernel past the
     whole-(T, T) VMEM envelope (T > 1024), and the einsum below — which is
-    why fusing config #4's T=128 graph is perf-neutral by design there."""
+    why fusing config #4's T=128 graph is perf-neutral by design there.
+
+    ``mask`` is ADDITIVE, broadcast onto the (B, H, T, T) scores after
+    scaling (the BERT-import convention: 0 for visible, a large negative
+    number for padding). A masked call always takes the einsum path — the
+    kernels support only causal/none masking — so for masked graphs the
+    fusion is a node-collapse, not a kernel win."""
     B, H, T, D = q.shape
     from deeplearning4j_tpu.ops.pallas_kernels import (
         active_global_mesh, flash_attention, flash_envelope_ok,
         mha_attention, packed_kernel_shape_ok)
     on_tpu = jax.default_backend() == "tpu"
-    same = k.shape == q.shape and v.shape == q.shape
+    same = mask is None and k.shape == q.shape and v.shape == q.shape
     whole_ok = same and packed_kernel_shape_ok(T)
     stream_ok = same and T > 1024 and flash_envelope_ok(T)
     if use_kernel and not (whole_ok or stream_ok):
         raise ValueError(
             f"scaledDotProductAttentionFused: use_kernel=True but shape "
-            f"{q.shape} fits neither the whole-head (T % 8 == 0, T <= "
+            f"{q.shape} (mask={'set' if mask is not None else 'None'}) "
+            f"fits neither the whole-head (unmasked, T % 8 == 0, T <= "
             f"1024, matching q/k/v) nor the streamed kernel envelope; "
             f"use_kernel=None/False for the einsum path")
     auto = use_kernel is None and on_tpu and active_global_mesh() is None
@@ -147,12 +154,18 @@ def scaled_dot_product_attention_fused(q, k, v, scale=None, causal=False,
         return flash_attention(q, k, v, causal, None, None, scale,
                                not on_tpu)
     sc = scale if scale is not None else 1.0 / (D ** 0.5)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sc
+    # matmul (not einsum) so leading dims BROADCAST exactly like the
+    # original imported matmul chain — shared-across-batch/head k/v
+    # remain valid after the fuseAttention rewrite, and static-shape
+    # sentinels in SameDiff metadata can't manufacture a runtime mismatch
+    s = jnp.matmul(q, jnp.swapaxes(k, -1, -2)) * sc
+    if mask is not None:
+        s = s + mask.astype(s.dtype)
     if causal:
-        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
-        s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+        cm = jnp.tril(jnp.ones((T, T), dtype=bool))
+        s = jnp.where(cm[None, None], s, jnp.finfo(s.dtype).min)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return jnp.matmul(p, v)
 
 
 @op("multiHeadDotProductAttention", "nn")

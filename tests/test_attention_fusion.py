@@ -102,10 +102,10 @@ class TestFuseAttention:
         sd.linalg.matmul(p, v)
         assert sd.fuseAttention() == 0
 
-    def test_broadcast_kv_not_fused(self):
-        """q (B,H,T,D) against shared k/v (1,1,T,D): the original matmul
-        chain broadcasts, the fused einsum cannot — must stay unfused and
-        keep working."""
+    def test_broadcast_kv_fuses_and_broadcasts(self):
+        """q (B,H,T,D) against shared k/v (1,1,T,D): the fused op's einsum
+        path uses broadcasting jnp.matmul (exactly the original chain's
+        semantics), so fusion is safe — and numerically identical."""
         sd = SameDiff.create()
         rng = np.random.default_rng(5)
         q = sd.var("q", jnp.asarray(rng.normal(size=(2, 3, 8, 4)),
@@ -118,8 +118,84 @@ class TestFuseAttention:
         p = sd.nn.softmax(sd.linalg.matmul(q, kt))
         out = sd.linalg.matmul(p, v)
         want = np.asarray(out.eval().toNumpy())
-        assert sd.fuseAttention() == 0
-        np.testing.assert_allclose(np.asarray(out.eval().toNumpy()), want)
+        assert sd.fuseAttention() == 1
+        np.testing.assert_allclose(np.asarray(out.eval().toNumpy()), want,
+                                   atol=1e-6)
+
+    def test_masked_pattern_mask_operand_first(self):
+        """Operand order (mask, scores) on the add — and a mask that is
+        ITSELF mul-produced, the standard (1-m) * -1e9 adder — must still
+        fuse via full-chain matching on both orientations."""
+        sd = SameDiff.create()
+        rng = np.random.default_rng(9)
+        q = sd.var("q", jnp.asarray(rng.normal(size=(2, 2, 8, 4)) * 0.3,
+                                    jnp.float32))
+        k = sd.var("k", jnp.asarray(rng.normal(size=(2, 2, 8, 4)) * 0.3,
+                                    jnp.float32))
+        v = sd.var("v", jnp.asarray(rng.normal(size=(2, 2, 8, 4)) * 0.3,
+                                    jnp.float32))
+        m = sd.var("m", jnp.asarray(rng.integers(0, 2, (2, 1, 1, 8))
+                                    .astype(np.float32)))
+        neg = sd.constant("neg", jnp.asarray(-1e9))
+        adder = m.rsub(1.0).mul(neg)          # (1 - m) * -1e9, mul-produced
+        sc = sd.constant("sc", jnp.asarray(0.5))
+        kt = sd.shapes.permute(k, axes=[0, 1, 3, 2])
+        scores = sd.linalg.matmul(q, kt).mul(sc)
+        s = adder.add(scores)                 # mask operand FIRST
+        p = sd.nn.softmax(s)
+        out = sd.linalg.matmul(p, v)
+        want = np.asarray(out.eval().toNumpy())
+        assert sd.fuseAttention() == 1
+        np.testing.assert_allclose(np.asarray(out.eval().toNumpy()), want,
+                                   atol=1e-6)
+
+    def test_masked_pattern_fuses_with_dynamic_mask(self):
+        """The BERT-import form — matmul -> mul(scale) -> add(mask) ->
+        softmax -> matmul — fuses with the mask kept as a live graph
+        input (placeholder-derived masks change per batch)."""
+        sd = SameDiff.create()
+        rng = np.random.default_rng(7)
+        q = sd.var("q", jnp.asarray(rng.normal(size=(2, 2, 8, 4)) * 0.3,
+                                    jnp.float32))
+        k = sd.var("k", jnp.asarray(rng.normal(size=(2, 2, 8, 4)) * 0.3,
+                                    jnp.float32))
+        v = sd.var("v", jnp.asarray(rng.normal(size=(2, 2, 8, 4)) * 0.3,
+                                    jnp.float32))
+        mask_ph = sd.placeHolder("mask", shape=(2, 1, 1, 8))
+        sc = sd.constant("sc", jnp.asarray(0.5))
+        kt = sd.shapes.permute(k, axes=[0, 1, 3, 2])
+        s = sd.linalg.matmul(q, kt).mul(sc).add(mask_ph)
+        p = sd.nn.softmax(s)
+        out = sd.linalg.matmul(p, v)
+        mask_val = np.where(rng.integers(0, 2, (2, 1, 1, 8)) > 0,
+                            0.0, -1e9).astype(np.float32)
+        want = np.asarray(
+            sd.output({"mask": mask_val}, out.name)[out.name].toNumpy())
+        assert sd.fuseAttention() == 1
+        node = next(o for o in sd._ops
+                    if o.opname == "scaledDotProductAttentionFused")
+        assert len(node.inputs) == 4          # mask rides as a live input
+        got = np.asarray(
+            sd.output({"mask": mask_val}, out.name)[out.name].toNumpy())
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        # a DIFFERENT mask value flows through the fused op dynamically
+        mask2 = np.zeros((2, 1, 1, 8), np.float32)
+        got2 = np.asarray(
+            sd.output({"mask": mask2}, out.name)[out.name].toNumpy())
+        assert np.max(np.abs(got2 - got)) > 1e-4
+
+    def test_masked_call_pins_einsum_and_forced_kernel_raises(self):
+        from deeplearning4j_tpu import ops
+        rng = np.random.default_rng(8)
+        q = rng.normal(size=(1, 2, 16, 4)).astype(np.float32)
+        mask = np.zeros((1, 1, 1, 16), np.float32)
+        out = ops.nn.scaledDotProductAttentionFused(q, q, q, mask=mask)
+        ref = ops.nn.scaledDotProductAttentionFused(q, q, q)
+        np.testing.assert_allclose(np.asarray(out.toNumpy()),
+                                   np.asarray(ref.toNumpy()), atol=1e-6)
+        with pytest.raises(ValueError, match="use_kernel=True"):
+            ops.nn.scaledDotProductAttentionFused(q, q, q, mask=mask,
+                                                  use_kernel=True)
 
     def test_forced_kernel_off_envelope_raises(self):
         from deeplearning4j_tpu import ops
