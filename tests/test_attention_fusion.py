@@ -10,7 +10,7 @@ import pytest
 from deeplearning4j_tpu.autodiff import SameDiff
 
 
-def _tiny_bert_sd():
+def _tiny_bert_sd(masked=False):
     tf = pytest.importorskip("tensorflow")  # noqa: F841
     import sys
     import pathlib
@@ -19,7 +19,8 @@ def _tiny_bert_sd():
         TensorflowFrameworkImporter)
     from tools.tf_bert import build_frozen_bert
     gd, in_name, out_name, _ = build_frozen_bert(L=2, H=32, A=4, V=64, T=16,
-                                                 intermediate=64)
+                                                 intermediate=64,
+                                                 masked=masked)
     return TensorflowFrameworkImporter.runImport(gd), in_name, out_name
 
 
@@ -183,6 +184,25 @@ class TestFuseAttention:
         got2 = np.asarray(
             sd.output({"mask": mask2}, out.name)[out.name].toNumpy())
         assert np.max(np.abs(got2 - got)) > 1e-4
+
+    def test_masked_import_end_to_end(self):
+        """A MASKED frozen BERT through the real importer: every layer's
+        attention (with the importer's actual add/mul emission order)
+        fuses, and outputs respect a varying dynamic mask."""
+        sd, (ids_name, mask_name), out_name = _tiny_bert_sd(masked=True)
+        rng = np.random.default_rng(11)
+        x = rng.integers(0, 64, (2, 16)).astype(np.int32)
+        m = np.ones((2, 16), np.float32)
+        m[:, 10:] = 0.0                      # padded tail
+        feed = {ids_name: x, mask_name: m}
+        before = np.asarray(sd.output(feed, out_name)[out_name].toNumpy())
+        assert sd.fuseAttention() == 2
+        after = np.asarray(sd.output(feed, out_name)[out_name].toNumpy())
+        np.testing.assert_allclose(after, before, atol=1e-5)
+        # mask is live: unmasking the tail changes the output
+        feed2 = {ids_name: x, mask_name: np.ones((2, 16), np.float32)}
+        other = np.asarray(sd.output(feed2, out_name)[out_name].toNumpy())
+        assert np.max(np.abs(other - after)) > 1e-4
 
     def test_masked_call_pins_einsum_and_forced_kernel_raises(self):
         from deeplearning4j_tpu import ops

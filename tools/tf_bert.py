@@ -17,10 +17,13 @@ import numpy as np
 
 
 def build_frozen_bert(L=12, H=768, A=12, V=30522, T=128, intermediate=3072,
-                      seed=0):
+                      seed=0, masked=False):
     """Returns (graph_def, input_name, output_name, concrete_fn).
 
     Output: final-layer hidden states (B, T, H) of a token-id input (B, T).
+    ``masked=True`` adds the standard BERT additive padding mask (a second
+    (B, T) float input; scores get ``(1 - m) * -1e4`` after scaling) —
+    input names become a 2-tuple (ids, mask).
     """
     import tensorflow as tf
 
@@ -50,8 +53,12 @@ def build_frozen_bert(L=12, H=768, A=12, V=30522, T=128, intermediate=3072,
     def gelu(x):
         return 0.5 * x * (1.0 + tf.math.erf(x / np.sqrt(2.0).astype(np.float32)))
 
-    def encoder(ids):
+    def encoder(ids, mask=None):
         B = tf.shape(ids)[0]
+        if mask is not None:
+            # (B, T) -> additive (B, 1, 1, T), BERT convention
+            adder = (1.0 - mask[:, tf.newaxis, tf.newaxis, :]) \
+                * tf.constant(-1e4, tf.float32)
         x = tf.gather(tok_emb, ids) + pos_emb[tf.newaxis]
         x = layer_norm(x, ln_g[2 * L], ln_b[2 * L])
         for i in range(L):
@@ -65,6 +72,8 @@ def build_frozen_bert(L=12, H=768, A=12, V=30522, T=128, intermediate=3072,
 
             s = tf.matmul(heads(q), heads(k), transpose_b=True)
             s = s * tf.constant(1.0 / np.sqrt(D), tf.float32)
+            if mask is not None:
+                s = s + adder
             p = tf.nn.softmax(s, axis=-1)
             o = tf.matmul(p, heads(v))
             o = tf.reshape(tf.transpose(o, (0, 2, 1, 3)), (B, T, H))
@@ -76,10 +85,18 @@ def build_frozen_bert(L=12, H=768, A=12, V=30522, T=128, intermediate=3072,
 
     from tensorflow.python.framework.convert_to_constants import (
         convert_variables_to_constants_v2)
-    cf = tf.function(encoder).get_concrete_function(
-        tf.TensorSpec((None, T), tf.int32))
+    if masked:
+        cf = tf.function(encoder).get_concrete_function(
+            tf.TensorSpec((None, T), tf.int32),
+            tf.TensorSpec((None, T), tf.float32))
+    else:
+        cf = tf.function(encoder).get_concrete_function(
+            tf.TensorSpec((None, T), tf.int32))
     frozen = convert_variables_to_constants_v2(cf)
     gd = frozen.graph.as_graph_def()
-    in_name = frozen.inputs[0].name.split(":")[0]
     out_name = frozen.outputs[0].name.split(":")[0]
+    if masked:
+        in_names = tuple(t.name.split(":")[0] for t in frozen.inputs)
+        return gd, in_names, out_name, frozen
+    in_name = frozen.inputs[0].name.split(":")[0]
     return gd, in_name, out_name, frozen
