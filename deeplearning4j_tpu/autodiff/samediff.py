@@ -1069,16 +1069,30 @@ class SameDiff:
         return "\n".join(lines)
 
 
+def _enc_kw_val(v):
+    """JSON-encode one kwarg value. Python slice objects (stridedSlice's
+    'slices' tuple — what TF's mask[:, newaxis, newaxis, :] imports to)
+    get a tagged form so load() restores REAL slices, not their repr."""
+    if isinstance(v, slice):
+        return {"__slice__": [v.start, v.stop, v.step]}
+    if isinstance(v, (list, tuple)):
+        return [_enc_kw_val(x) for x in v]
+    if isinstance(v, (int, float, str, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def _dec_kw_val(v):
+    if isinstance(v, dict) and "__slice__" in v:
+        s = v["__slice__"]
+        return slice(s[0], s[1], s[2])
+    if isinstance(v, list):
+        return [_dec_kw_val(x) for x in v]
+    return v
+
+
 def _json_safe(d):
-    out = {}
-    for k, v in d.items():
-        if isinstance(v, (list, tuple)):
-            out[k] = list(v)
-        elif isinstance(v, (int, float, str, bool)) or v is None:
-            out[k] = v
-        else:
-            out[k] = str(v)
-    return out
+    return {k: _enc_kw_val(v) for k, v in d.items()}
 
 
 _SUBGRAPH_KEYS = ("true_graph", "false_graph", "cond_graph", "body_graph")
@@ -1106,6 +1120,8 @@ def _op_from_dict(od: dict) -> SameDiffOp:
             if k in kw:
                 d = kw[k]
                 kw[k] = (_subgraph_from_dict(d["__subgraph__"]), d["in"], d["out"])
+    else:
+        kw = {k: _dec_kw_val(v) for k, v in kw.items()}
     return SameDiffOp(od["namespace"], od["op"], od["inputs"], od["outputs"], kw)
 
 

@@ -204,6 +204,31 @@ class TestFuseAttention:
         other = np.asarray(sd.output(feed2, out_name)[out_name].toNumpy())
         assert np.max(np.abs(other - after)) > 1e-4
 
+    def test_fused_masked_graph_serde_roundtrip(self):
+        """save/load of a FUSED masked import must reproduce outputs —
+        regression for the slice-kwargs serde bug: stridedSlice kwargs
+        (what TF's mask[:, newaxis, newaxis, :] imports to) contain
+        Python slice objects, which the JSON graph serde now encodes with
+        a tagged form and restores as real slices."""
+        import os
+        import tempfile
+
+        sd, (ids_name, mask_name), out_name = _tiny_bert_sd(masked=True)
+        assert sd.fuseAttention() == 2
+        rng = np.random.default_rng(12)
+        feed = {ids_name: rng.integers(0, 64, (2, 16)).astype(np.int32),
+                mask_name: np.ones((2, 16), np.float32)}
+        want = np.asarray(sd.output(feed, out_name)[out_name].toNumpy())
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.zip")
+            sd.save(p)
+            sd2 = SameDiff.load(p)
+        got = np.asarray(sd2.output(feed, out_name)[out_name].toNumpy())
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        assert any(isinstance(s, slice)
+                   for o in sd2._ops if o.opname == "stridedSlice"
+                   for s in o.kwargs["slices"])
+
     def test_masked_call_pins_einsum_and_forced_kernel_raises(self):
         from deeplearning4j_tpu import ops
         rng = np.random.default_rng(8)
