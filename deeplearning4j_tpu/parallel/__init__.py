@@ -6,7 +6,8 @@ from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
 from deeplearning4j_tpu.parallel.data_parallel import ParallelInference, ParallelWrapper  # noqa: F401
 from deeplearning4j_tpu.parallel.sequence_parallel import (  # noqa: F401
     reference_attention, ring_attention, ring_flash_attention,
-    ring_self_attention, ulysses_attention,
+    ring_self_attention, ulysses_attention, zigzag_indices,
+    zigzag_ring_flash_attention, zigzag_ring_self_attention,
 )
 from deeplearning4j_tpu.parallel.gradient_sharing import (  # noqa: F401
     AdaptiveThresholdAlgorithm, gradient_compression, int8_compression,

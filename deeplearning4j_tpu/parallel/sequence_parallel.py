@@ -16,6 +16,9 @@ Public entry points:
   (any-order differentiable; backward saves rotated k/v copies)
 - ``ulysses_attention(q, k, v, axis_name, causal)``  — all-to-all head
   resharding; local full-T attention routes through the streamed kernel
+- ``zigzag_ring_flash_attention`` / ``zigzag_ring_self_attention`` —
+  load-BALANCED causal ring (zigzag chunk layout: constant per-device
+  work where the plain causal ring leaves early devices idle)
 - ``ring_self_attention(mesh, q, k, v, ...)``        — whole-array convenience
 """
 from __future__ import annotations
@@ -25,6 +28,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
@@ -222,16 +226,28 @@ def _ring_flash_fwd_rule(q, k, v, axis_name, causal, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd_rule(axis_name, causal, interpret, res, g):
+def _pair_grads3(q3, k3, v3, do3, lse, delta, pair_causal, interpret):
+    """One (q-shard, k/v-shard) pair's (dq, dk, dv) in fp32 — the shared
+    building block of the ring and zigzag backward passes. Operands are
+    (BH, T, D) with lse/delta (BH, 1, T) in the GLOBAL softmax frame."""
     from deeplearning4j_tpu.ops.pallas_kernels import (
         _launch_bwd_dq, _launch_bwd_dkv, auto_flash_block)
+    T, D = q3.shape[1], q3.shape[2]
+    bq = bk = auto_flash_block(T)
+    sc = 1.0 / (D ** 0.5)
+    dq_c = _launch_bwd_dq(q3, k3, v3, do3, lse, delta, pair_causal,
+                          bq, bk, sc, interpret)
+    dk_c, dv_c = _launch_bwd_dkv(q3, k3, v3, do3, lse, delta,
+                                 pair_causal, bq, bk, sc, interpret)
+    return (dq_c.astype(jnp.float32), dk_c.astype(jnp.float32),
+            dv_c.astype(jnp.float32))
 
+
+def _ring_flash_bwd_rule(axis_name, causal, interpret, res, g):
     q, k, v, out, lse = res
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     B, H, T, D = q.shape
-    bq = bk = auto_flash_block(T)
-    sc = 1.0 / (D ** 0.5)
     q3 = q.reshape(B * H, T, D)
     do3 = g.reshape(B * H, T, D).astype(q.dtype)
     delta = jnp.sum(do3.astype(jnp.float32)
@@ -239,14 +255,9 @@ def _ring_flash_bwd_rule(axis_name, causal, interpret, res, g):
                     axis=-1).reshape(B * H, 1, T)
 
     def pair_grads(k_blk, v_blk, pair_causal):
-        k3 = k_blk.reshape(B * H, T, D)
-        v3 = v_blk.reshape(B * H, T, D)
-        dq_c = _launch_bwd_dq(q3, k3, v3, do3, lse, delta, pair_causal,
-                              bq, bk, sc, interpret)
-        dk_c, dv_c = _launch_bwd_dkv(q3, k3, v3, do3, lse, delta,
-                                     pair_causal, bq, bk, sc, interpret)
-        return (dq_c.astype(jnp.float32), dk_c.astype(jnp.float32),
-                dv_c.astype(jnp.float32))
+        return _pair_grads3(q3, k_blk.reshape(B * H, T, D),
+                            v_blk.reshape(B * H, T, D), do3, lse, delta,
+                            pair_causal, interpret)
 
     # second ring pass: dk/dv partial sums ride the ring WITH their k/v
     # block; after axis_size rotations each block (and its accumulated
@@ -325,6 +336,230 @@ def ring_self_attention(mesh: Mesh, q, k, v, causal: bool = False,
         functools.partial(fn, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
     return mapped(q, k, v)
+
+
+# --------------------------------------------- zigzag (balanced) causal ring
+#
+# A plain causal ring is load-imbalanced: device 0's queries see one k/v
+# block, device n-1's see all n — the tail device gates every step. The
+# zigzag layout (as in striped/zigzag ring attention) splits the sequence
+# into 2n chunks and gives device d the PAIR (chunk d, chunk 2n-1-d): its
+# low stripe sees d+1 chunks, its high stripe 2n-d, so every device does
+# a constant ~(2n+1) half-stripe attentions per full ring — balanced.
+# Per ring step, of the four (q-stripe, kv-stripe) pairs exactly one of
+# (lo, lo)/(hi, hi) is live for s != d (plus both diagonals at s == d),
+# (hi, lo) is always fully visible, and (lo, hi) is always future/hidden.
+# Causal-only by construction — non-causal needs no balancing; use
+# ring_flash_attention.
+
+
+def zigzag_indices(T: int, n: int) -> np.ndarray:
+    """Gather indices putting a length-T sequence into the zigzag layout
+    for an n-device context axis: device d's shard is [chunk d ; chunk
+    2n-1-d] of the 2n equal chunks. Apply with x[..., idx, :]; invert
+    with np.argsort(idx)."""
+    if T % (2 * n):
+        raise ValueError(
+            f"zigzag layout needs T divisible by 2*axis_size; got T={T}, "
+            f"n={n}")
+    c = T // (2 * n)
+    order = []
+    for d in range(n):
+        order.extend(range(d * c, (d + 1) * c))
+        order.extend(range((2 * n - 1 - d) * c, (2 * n - d) * c))
+    return np.asarray(order)
+
+
+def _zz_flash_fwd_impl(q, k, v, axis_name, interpret):
+    from deeplearning4j_tpu.ops.pallas_kernels import _flash_forward
+
+    n = lax.psum(1, axis_name)
+    d = lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    Th = Tl // 2
+    BH = B * H
+
+    def halves(x):
+        x3 = x.reshape(BH, Tl, D)
+        return x3[:, :Th], x3[:, Th:]
+
+    q_lo, q_hi = halves(q)
+
+    def vis(qs):
+        def f(ops):
+            o, l = _flash_forward(qs, ops[0], ops[1], causal=False,
+                                  block_q=None, block_k=None, scale=None,
+                                  interpret=interpret)
+            return o.astype(jnp.float32), l
+        return f
+
+    def diag(qs):
+        def f(ops):
+            o, l = _flash_forward(qs, ops[0], ops[1], causal=True,
+                                  block_q=None, block_k=None, scale=None,
+                                  interpret=interpret)
+            return o.astype(jnp.float32), l
+        return f
+
+    def hidden(ops):
+        return (jnp.zeros((BH, Th, D), jnp.float32),
+                jnp.full((BH, 1, Th), -jnp.inf, jnp.float32))
+
+    def step(i, carry):
+        o_lo, l_lo, o_hi, l_hi, k_blk, v_blk = carry
+        k_lo, k_hi = halves(k_blk)
+        v_lo, v_hi = halves(v_blk)
+        s = (d - i) % n
+        # rel: 0 hidden (s > d), 1 diagonal (s == d), 2 visible (s < d)
+        rel = jnp.where(s > d, 0, jnp.where(s == d, 1, 2))
+        ob, lb = lax.switch(rel, [hidden, diag(q_lo), vis(q_lo)],
+                            (k_lo, v_lo))
+        o_lo, l_lo = _merge_partial(o_lo, l_lo, ob, lb)
+        ob, lb = lax.switch(rel, [vis(q_hi), diag(q_hi), hidden],
+                            (k_hi, v_hi))
+        o_hi, l_hi = _merge_partial(o_hi, l_hi, ob, lb)
+        ob, lb = vis(q_hi)((k_lo, v_lo))      # always fully visible
+        o_hi, l_hi = _merge_partial(o_hi, l_hi, ob, lb)
+        perm = _ring_perm(n)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return o_lo, l_lo, o_hi, l_hi, k_blk, v_blk
+
+    z = jnp.zeros((BH, Th, D), jnp.float32)
+    ninf = jnp.full((BH, 1, Th), -jnp.inf, jnp.float32)
+    o_lo, l_lo, o_hi, l_hi, _, _ = lax.fori_loop(
+        0, n, step, (z, ninf, z, ninf, k, v))
+    out = jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype) \
+        .reshape(B, H, Tl, D)
+    return out, (l_lo, l_hi)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _zigzag_ring(q, k, v, axis_name, interpret):
+    out, _ = _zz_flash_fwd_impl(q, k, v, axis_name, interpret)
+    return out
+
+
+def _zz_fwd_rule(q, k, v, axis_name, interpret):
+    out, (l_lo, l_hi) = _zz_flash_fwd_impl(q, k, v, axis_name, interpret)
+    return out, (q, k, v, out, l_lo, l_hi)
+
+
+def _zz_bwd_rule(axis_name, interpret, res, g):
+    q, k, v, out, l_lo, l_hi = res
+    n = lax.psum(1, axis_name)
+    d = lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    Th = Tl // 2
+    BH = B * H
+
+    def halves(x):
+        x3 = x.reshape(BH, Tl, D)
+        return x3[:, :Th], x3[:, Th:]
+
+    q_lo, q_hi = halves(q)
+    do_lo, do_hi = (h.astype(q.dtype) for h in halves(g))
+    out_lo, out_hi = halves(out)
+
+    def delta_of(do_s, out_s):
+        return jnp.sum(do_s.astype(jnp.float32)
+                       * out_s.astype(jnp.float32),
+                       axis=-1).reshape(BH, 1, Th)
+
+    d_lo, d_hi = delta_of(do_lo, out_lo), delta_of(do_hi, out_hi)
+    z3 = jnp.zeros((BH, Th, D), jnp.float32)
+
+    def grads(qs, do_s, lse_s, del_s, pair_causal):
+        def f(ops):
+            return _pair_grads3(qs, ops[0], ops[1], do_s, lse_s, del_s,
+                                pair_causal, interpret)
+        return f
+
+    def hidden(ops):
+        return z3, z3, z3
+
+    def step(i, carry):
+        dq_lo, dq_hi, k_blk, v_blk, dk_blk, dv_blk = carry
+        k_lo, k_hi = halves(k_blk)
+        v_lo, v_hi = halves(v_blk)
+        dk_lo, dk_hi = dk_blk[:, :Th], dk_blk[:, Th:]
+        dv_lo, dv_hi = dv_blk[:, :Th], dv_blk[:, Th:]
+        s = (d - i) % n
+        rel = jnp.where(s > d, 0, jnp.where(s == d, 1, 2))
+        a, b, c_ = lax.switch(
+            rel, [hidden, grads(q_lo, do_lo, l_lo, d_lo, True),
+                  grads(q_lo, do_lo, l_lo, d_lo, False)], (k_lo, v_lo))
+        dq_lo, dk_lo, dv_lo = dq_lo + a, dk_lo + b, dv_lo + c_
+        a, b, c_ = lax.switch(
+            rel, [grads(q_hi, do_hi, l_hi, d_hi, False),
+                  grads(q_hi, do_hi, l_hi, d_hi, True), hidden],
+            (k_hi, v_hi))
+        dq_hi, dk_hi, dv_hi = dq_hi + a, dk_hi + b, dv_hi + c_
+        a, b, c_ = grads(q_hi, do_hi, l_hi, d_hi, False)((k_lo, v_lo))
+        dq_hi, dk_lo, dv_lo = dq_hi + a, dk_lo + b, dv_lo + c_
+        perm = _ring_perm(n)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = lax.ppermute(jnp.concatenate([dk_lo, dk_hi], axis=1),
+                              axis_name, perm)
+        dv_blk = lax.ppermute(jnp.concatenate([dv_lo, dv_hi], axis=1),
+                              axis_name, perm)
+        return dq_lo, dq_hi, k_blk, v_blk, dk_blk, dv_blk
+
+    big_z = jnp.zeros((BH, Tl, D), jnp.float32)
+    dq_lo, dq_hi, _, _, dk, dv = lax.fori_loop(
+        0, n, step, (z3, z3, k, v, big_z, big_z))
+    # after n process+rotate rounds each dk/dv partial sum is back home
+    shape = (B, H, Tl, D)
+    dq = jnp.concatenate([dq_lo, dq_hi], axis=1)
+    return (dq.astype(q.dtype).reshape(shape),
+            dk.astype(k.dtype).reshape(shape),
+            dv.astype(v.dtype).reshape(shape))
+
+
+_zigzag_ring.defvjp(_zz_fwd_rule, _zz_bwd_rule)
+
+
+def zigzag_ring_flash_attention(q, k, v, axis_name: str = CONTEXT_AXIS,
+                                interpret: Optional[bool] = None):
+    """Load-balanced CAUSAL ring attention — call INSIDE shard_map with
+    shards in the zigzag layout (:func:`zigzag_indices`; or use
+    :func:`zigzag_ring_self_attention`, which handles the permutation).
+    Per-pair compute is the streamed Pallas kernels with the same
+    second-ring-pass backward as :func:`ring_flash_attention`; unlike the
+    plain causal ring, every device does constant work per step.
+    First-order autodiff only."""
+    from deeplearning4j_tpu.ops import pallas_kernels as _pk
+    if _pk._HIGHER_ORDER:
+        raise NotImplementedError(
+            "zigzag ring is first-order only; under higher_order_attention()"
+            " use zigzag_ring_self_attention (which falls back to the exact"
+            " reference) or the einsum ring on a contiguous layout")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _zigzag_ring(q, k, v, axis_name, interpret)
+
+
+def zigzag_ring_self_attention(mesh: Mesh, q, k, v,
+                               axis_name: str = CONTEXT_AXIS):
+    """Whole-array convenience for the balanced causal ring: permutes the
+    sequence into the zigzag layout, shard_maps, inverse-permutes the
+    output. q/k/v: (B, H, T, D) with T divisible by 2 * axis size."""
+    from deeplearning4j_tpu.ops import pallas_kernels as _pk
+    if _pk._HIGHER_ORDER:
+        return reference_attention(q, k, v, causal=True)
+    n = mesh.shape[axis_name]
+    T = q.shape[2]
+    idx_np = zigzag_indices(T, n)
+    idx = jnp.asarray(idx_np)
+    inv = jnp.asarray(np.argsort(idx_np))
+    spec = P(None, None, axis_name, None)
+    mapped = shard_map(
+        functools.partial(zigzag_ring_flash_attention, axis_name=axis_name),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    out = mapped(q[:, :, idx], k[:, :, idx], v[:, :, idx])
+    return out[:, :, inv]
 
 
 def reference_attention(q, k, v, causal: bool = False):

@@ -212,6 +212,61 @@ class TestSequenceParallel:
         with pytest.raises(Exception):
             jax.grad(jax.grad(loss))(1.0)
 
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_zigzag_ring_matches_reference(self, n):
+        """Balanced causal ring (zigzag layout): fwd + all three grads
+        exact vs the full-attention oracle; the whole-array convenience
+        owns the permutation round-trip."""
+        from deeplearning4j_tpu.parallel.sequence_parallel import (
+            zigzag_ring_self_attention)
+        mesh = make_mesh({"context": n})
+        B, H, T, D = 2, 3, 64, 8
+        k1, k2, k3 = jax.random.split(jax.random.key(21), 3)
+        q = jax.random.normal(k1, (B, H, T, D), jnp.float32) * 0.3
+        k = jax.random.normal(k2, (B, H, T, D), jnp.float32) * 0.3
+        v = jax.random.normal(k3, (B, H, T, D), jnp.float32) * 0.3
+        want = reference_attention(q, k, v, causal=True)
+        got = zigzag_ring_self_attention(mesh, q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+        gr = jax.grad(lambda q, k, v: jnp.sum(reference_attention(
+            q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gz = jax.grad(lambda q, k, v: jnp.sum(zigzag_ring_self_attention(
+            mesh, q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gz, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=1e-4)
+
+    def test_zigzag_indices_partition(self):
+        """The zigzag permutation is a true permutation assigning device d
+        chunks (d, 2n-1-d) — the balance invariant."""
+        from deeplearning4j_tpu.parallel.sequence_parallel import (
+            zigzag_indices)
+        T, n = 64, 4
+        idx = zigzag_indices(T, n)
+        assert sorted(idx.tolist()) == list(range(T))
+        c = T // (2 * n)
+        shard0 = idx[: T // n]
+        assert shard0[:c].tolist() == list(range(0, c))              # chunk 0
+        assert shard0[c:].tolist() == list(range(7 * c, 8 * c))      # chunk 7
+
+    def test_zigzag_higher_order_falls_back(self):
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            higher_order_attention)
+        from deeplearning4j_tpu.parallel.sequence_parallel import (
+            zigzag_ring_self_attention)
+        mesh = make_mesh({"context": 2})
+        q = jax.random.normal(jax.random.key(22), (1, 2, 16, 8),
+                              jnp.float32) * 0.3
+
+        def loss(s):
+            return jnp.sum(zigzag_ring_self_attention(
+                mesh, q * s, q, q) ** 2)
+
+        with higher_order_attention():
+            h = jax.grad(jax.grad(loss))(1.0)
+        assert np.isfinite(float(h))
+
     def test_ring_flash_single_shard_degenerates_to_flash(self):
         """axis_size=1: no rotations, just the local streamed kernel."""
         mesh = make_mesh({"context": 1})
