@@ -547,7 +547,12 @@ def zigzag_ring_self_attention(mesh: Mesh, q, k, v,
     output. q/k/v: (B, H, T, D) with T divisible by 2 * axis size."""
     from deeplearning4j_tpu.ops import pallas_kernels as _pk
     if _pk._HIGHER_ORDER:
-        return reference_attention(q, k, v, causal=True)
+        # any-order-differentiable fallback that STAYS sequence-parallel:
+        # the einsum ring on the contiguous layout (single-device reference
+        # attention would materialize the full (T, T) scores the SP design
+        # exists to avoid)
+        return ring_self_attention(mesh, q, k, v, causal=True,
+                                   axis_name=axis_name, impl="ring")
     n = mesh.shape[axis_name]
     T = q.shape[2]
     idx_np = zigzag_indices(T, n)

@@ -75,6 +75,25 @@ if ctx > 1:
           f"{['%.3f' % l for l in losses_sp]}")
     # same init, same data, exact attention: trajectories agree closely
     assert abs(losses_sp[0] - losses[0]) < 0.05, (losses_sp[0], losses[0])
+
+    # ---- 2b. the load-BALANCED causal ring (zigzag layout) ------------------
+    # a plain causal ring leaves early devices idle; the zigzag layout
+    # gives every device constant work. The convenience API owns the
+    # sequence permutation — drop-in for standalone attention calls:
+    from deeplearning4j_tpu.parallel import (reference_attention,
+                                             zigzag_ring_self_attention)
+    rng2 = np.random.default_rng(1)
+    # reduced length for the oracle check only: reference_attention
+    # materializes (T_zz, T_zz) scores, which is exactly what the demo's
+    # training legs avoid
+    T_zz = min(T, 1024)
+    qkv = [jnp.asarray(rng2.normal(size=(1, heads, T_zz, 64)) * 0.2,
+                       jnp.float32) for _ in range(3)]
+    zz = zigzag_ring_self_attention(mesh, *qkv)
+    ref = reference_attention(*qkv, causal=True)
+    err = float(jnp.max(jnp.abs(zz - ref)))
+    print(f"zigzag balanced causal ring vs oracle: max err {err:.2e}")
+    assert err < 1e-3
 else:
     print("single device only - skipping the context-mesh leg "
           "(run with JAX_PLATFORMS=cpu for the virtual 8-device mesh "
