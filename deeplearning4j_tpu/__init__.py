@@ -21,6 +21,10 @@ SURVEY.md) with a TPU-first architecture:
 - ``parallel``  — device-mesh distributed training: DP/TP/SP over ICI/DCN collectives
                   (ref: ParallelWrapper / Spark masters / Aeron parameter server —
                   superseded by sharded pjit, see SURVEY.md §2.9/§2.10).
+- ``serving``   — inference serving runtime: dynamic micro-batching engine,
+                  versioned model registry, admission control, metrics
+                  (ref: deeplearning4j-parallel-wrapper ParallelInference
+                  BATCHED mode, extended with Clipper/ORCA-style admission).
 - ``models``    — model zoo (ref: deeplearning4j-zoo) + BERT flagship.
 - ``importers`` — Keras h5 / TF GraphDef / ONNX import (ref: samediff-import,
                   deeplearning4j-modelimport).

@@ -181,6 +181,17 @@ def fuse_attention(sd) -> int:
         fused += 1
 
     if fused:
+        # the fused op reproduces only the chain's FINAL output; every other
+        # output of a removed node (scores, softmax probs, kT permute) no
+        # longer exists. Record them so SameDiff.output() can raise a
+        # targeted error naming this rewrite instead of a deep KeyError
+        # when one is requested later.
+        removed_names = {o for idx in to_remove for o in ops[idx].outputs}
+        registry = getattr(sd, "_removed_by_rewrite", None)
+        if registry is None:
+            registry = sd._removed_by_rewrite = {}
+        for name in removed_names:
+            registry[name] = "fuseAttention"
         sd._ops = [replacements.get(idx, node) for idx, node in enumerate(ops)
                    if idx not in to_remove]
         sd._jit_cache.clear()

@@ -641,6 +641,16 @@ class SameDiff:
         """Whole-graph compiled inference (ref: SameDiff.output/batchOutput)."""
         if isinstance(outputs, str):
             outputs = [outputs]
+        removed = getattr(self, "_removed_by_rewrite", None)
+        if removed:
+            for n in outputs:
+                base = n.split(".")[0] if "." in n else n
+                if base in removed:
+                    raise ValueError(
+                        f"variable '{n}' was an attention-chain intermediate "
+                        f"removed by the {removed[base]} graph rewrite and "
+                        f"can no longer be computed; request it before "
+                        f"fusing, or skip the rewrite to keep it")
         ph = {k: jnp.asarray(_unwrap(v)) for k, v in placeholders.items()}
         fn = self._exec_fn(tuple(outputs))
         out = fn(self._values, ph)
@@ -973,6 +983,11 @@ class SameDiff:
             "ops": [_op_to_dict(o) for o in self._ops],
             "loss": self._loss_vars,
         }
+        removed = getattr(self, "_removed_by_rewrite", None)
+        if removed:
+            # keep the targeted removed-by-rewrite error working across a
+            # save/load roundtrip (else it degrades back to a deep KeyError)
+            graph["removed_by_rewrite"] = removed
         with zipfile.ZipFile(path, "w") as zf:
             zf.writestr("graph.json", json.dumps(graph, indent=2))
             manifest = []
@@ -1034,6 +1049,8 @@ class SameDiff:
                 if on not in sd._vars:
                     sd._vars[on] = SDVariable(sd, on, VariableType.ARRAY)
         sd._loss_vars = graph.get("loss", [])
+        if graph.get("removed_by_rewrite"):
+            sd._removed_by_rewrite = dict(graph["removed_by_rewrite"])
 
         # updater state: rebuild the optax tree structurally (tx.init on the
         # restored trainables) and refill its leaves in flatten order — the
@@ -1102,11 +1119,16 @@ def _op_to_dict(o: SameDiffOp) -> dict:
     """Serialize one node; control nodes recurse into their sub-graphs."""
     kw = dict(o.kwargs)
     if o.namespace == "control":
-        for k in _SUBGRAPH_KEYS:
-            if k in kw:
-                sub, ins, outs = kw[k]
+        # non-subgraph kwargs go through the SAME tagged encoder as every
+        # other op so slice-valued kwargs round-trip serde uniformly
+        # (previously they fell through as raw repr strings)
+        for k, v in kw.items():
+            if k in _SUBGRAPH_KEYS:
+                sub, ins, outs = v
                 kw[k] = {"__subgraph__": _subgraph_to_dict(sub),
                          "in": ins, "out": outs}
+            else:
+                kw[k] = _enc_kw_val(v)
     else:
         kw = _json_safe(kw)
     return {"namespace": o.namespace, "op": o.opname, "inputs": o.inputs,
@@ -1116,10 +1138,11 @@ def _op_to_dict(o: SameDiffOp) -> dict:
 def _op_from_dict(od: dict) -> SameDiffOp:
     kw = dict(od["kwargs"])
     if od["namespace"] == "control":
-        for k in _SUBGRAPH_KEYS:
-            if k in kw:
-                d = kw[k]
-                kw[k] = (_subgraph_from_dict(d["__subgraph__"]), d["in"], d["out"])
+        for k, v in kw.items():
+            if k in _SUBGRAPH_KEYS:
+                kw[k] = (_subgraph_from_dict(v["__subgraph__"]), v["in"], v["out"])
+            else:
+                kw[k] = _dec_kw_val(v)
     else:
         kw = {k: _dec_kw_val(v) for k, v in kw.items()}
     return SameDiffOp(od["namespace"], od["op"], od["inputs"], od["outputs"], kw)

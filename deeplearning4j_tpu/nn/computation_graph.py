@@ -398,6 +398,17 @@ class ComputationGraph:
     def outputSingle(self, *features) -> NDArray:
         return self.output(*features)[0]
 
+    def warmup(self, *example_rows, batch_sizes=(1,)) -> "ComputationGraph":
+        """Pre-compile inference for the given batch sizes; one example row
+        (no batch dim) per network input. See MultiLayerNetwork.warmup —
+        the serving registry's warmup-on-deploy hook."""
+        exs = [np.asarray(e) for e in example_rows]
+        for b in batch_sizes:
+            feats = [np.broadcast_to(e, (b,) + e.shape).copy() for e in exs]
+            for o in self.output(*feats):
+                np.asarray(o.jax)
+        return self
+
     def feedForward(self, *features) -> Dict[str, NDArray]:
         acts, _ = self._forward(self._params, self._state,
                                 self._input_dict(features), training=False, rng=None)

@@ -787,6 +787,17 @@ class MultiLayerNetwork:
         fmask = _as_jnp(features_mask) if features_mask is not None else None
         return NDArray(infer(self._params, self._state, _as_jnp(x), fmask))
 
+    def warmup(self, example_row, batch_sizes=(1,)) -> "MultiLayerNetwork":
+        """Pre-compile the inference executable for the given batch sizes.
+        ``example_row`` is ONE row (feature shape, no batch dim); each size
+        runs a throwaway forward so jit's shape-specialized cache is hot
+        before real traffic — the serving registry's warmup-on-deploy hook
+        (serving/registry.py) and a useful standalone latency tool."""
+        ex = np.asarray(example_row)
+        for b in batch_sizes:
+            np.asarray(self.output(np.broadcast_to(ex, (b,) + ex.shape).copy()).jax)
+        return self
+
     def feedForward(self, x) -> List[NDArray]:
         """Per-layer activations list, input first (ref: feedForward)."""
         from deeplearning4j_tpu.nn.conf.layers import needs_flatten

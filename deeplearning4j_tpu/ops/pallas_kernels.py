@@ -491,24 +491,58 @@ def active_global_mesh():
     (the layer DSL under ParallelWrapper's ``with self.mesh:`` fit) use
     this to detect sharded tracing and fall back to the einsum path.
 
-    Reads a private JAX attribute (there is no public "current mesh
-    context" API as of jax 0.9); if an upgrade moves it this fails OPEN
-    (kernel routing resumes) — but loudly, once, so the guard's loss is
-    visible rather than a silent perf regression."""
+    Probes public surfaces first — ``jax.sharding.get_mesh()`` /
+    ``get_abstract_mesh()`` where a JAX version provides them, then the
+    long-stable ``jax.interpreters.pxla.thread_resources`` export — and
+    only then the private ``jax._src.mesh`` attribute. If every probe is
+    gone this fails OPEN (kernel routing resumes) — but loudly, once, so
+    the guard's loss is visible rather than a silent perf regression."""
     global _MESH_PROBE_BROKEN
-    try:
-        pm = jax._src.mesh.thread_resources.env.physical_mesh
-        return None if pm.empty else pm
-    except AttributeError:
-        if not _MESH_PROBE_BROKEN:
-            _MESH_PROBE_BROKEN = True
-            import warnings
-            warnings.warn(
-                "jax._src.mesh.thread_resources is gone in this JAX "
-                "version; active-mesh detection is disabled and the packed "
-                "attention kernel may be auto-routed under sharded traces "
-                "(set use_kernel/attentionKernel=False there)")
+    answered = False
+    for probe in _MESH_PROBES:
+        try:
+            pm = probe()
+        except Exception:
+            continue
+        if pm is not None and not getattr(pm, "empty", True):
+            return pm
+        if pm is not None:
+            # an empty mesh is NOT definitive: each probe tracks its own
+            # context mechanism (get_mesh follows use_mesh; thread_resources
+            # follows `with mesh:`) — keep consulting the later probes
+            answered = True
+    if answered:
         return None
+    if not _MESH_PROBE_BROKEN:
+        _MESH_PROBE_BROKEN = True
+        import warnings
+        warnings.warn(
+            "no known JAX API exposes the active mesh context in this JAX "
+            "version; active-mesh detection is disabled and the packed "
+            "attention kernel may be auto-routed under sharded traces "
+            "(set use_kernel/attentionKernel=False there)")
+    return None
+
+
+def _probe_public_get_mesh():
+    """jax.sharding.get_mesh (newer JAX; returns the context mesh)."""
+    fn = getattr(jax.sharding, "get_mesh", None)
+    return fn() if fn is not None else None
+
+
+def _probe_pxla_thread_resources():
+    """jax.interpreters.pxla.thread_resources — the public-namespace alias
+    of the thread-local mesh state (stable across every 0.4.x release)."""
+    from jax.interpreters import pxla
+    return pxla.thread_resources.env.physical_mesh
+
+
+def _probe_private_thread_resources():
+    return jax._src.mesh.thread_resources.env.physical_mesh
+
+
+_MESH_PROBES = (_probe_public_get_mesh, _probe_pxla_thread_resources,
+                _probe_private_thread_resources)
 
 
 _MESH_PROBE_BROKEN = False

@@ -122,7 +122,15 @@ class ParallelInference:
     """Sharded batch inference (ref: deeplearning4j-parallel-wrapper
     ParallelInference: per-device replicas + dynamic batching observables).
     Here: one replicated jit executable; arbitrary batches are padded, sharded
-    over the data axis, and de-padded — XLA splits the work across devices."""
+    over the data axis, and de-padded — XLA splits the work across devices.
+
+    Batch sizes are padded UP to a geometric ladder of multiples of the
+    mesh size (n, 2n, 4n, ...) rather than merely to the next multiple of
+    n: jit specializes per shape, so the old padding still compiled a
+    fresh executable per novel ``ceil(b/n)`` while the ladder bounds live
+    signatures to log2(max batch seen). The reference's BATCHED inference
+    mode (cross-caller coalescing + admission control) lives in
+    :mod:`deeplearning4j_tpu.serving`; :meth:`engine` bridges to it."""
 
     def __init__(self, model, mesh: Optional[Mesh] = None, workers: Optional[int] = None,
                  batchLimit: int = 0):
@@ -134,35 +142,56 @@ class ParallelInference:
             mesh = make_mesh({DATA_AXIS: len(devs)}, devs)
         self.mesh = mesh
         self._n = mesh.shape[DATA_AXIS]
+        self.batchLimit = batchLimit
 
     class Builder:
         def __init__(self, model):
             self._model = model
             self._workers = None
+            self._batch_limit = 0
+            self._mode = "INPLACE"
 
         def workers(self, n: int):
             self._workers = n
             return self
 
         def batchLimit(self, n: int):
+            self._batch_limit = n
             return self
 
         def inferenceMode(self, mode: str):
+            self._mode = mode  # INPLACE/SEQUENTIAL ≙ direct; BATCHED -> .engine()
             return self
 
         def build(self) -> "ParallelInference":
-            return ParallelInference(self._model, workers=self._workers)
+            return ParallelInference(self._model, workers=self._workers,
+                                     batchLimit=self._batch_limit)
+
+    def _bucket(self, b: int) -> int:
+        """Smallest n * 2^k >= b — the compiled-signature ladder."""
+        s = self._n
+        while s < b:
+            s *= 2
+        return s
 
     def output(self, x) -> NDArray:
         arr = np.asarray(x)
         b = arr.shape[0]
-        n = self._n
-        padded = b
-        if b % n:
-            pad = n - (b % n)
-            arr = np.concatenate([arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)], axis=0)
-            padded = arr.shape[0]
+        padded = self._bucket(b)
+        if padded != b:
+            arr = np.concatenate(
+                [arr, np.zeros((padded - b,) + arr.shape[1:], arr.dtype)], axis=0)
         xs = jax.device_put(arr, batch_sharding(self.mesh, rank=arr.ndim))
         with self.mesh:
             out = self.model.output(xs)
         return NDArray(out.jax[:b]) if padded != b else out
+
+    def engine(self, **engine_kwargs):
+        """The reference's BATCHED inference mode: an
+        :class:`~deeplearning4j_tpu.serving.InferenceEngine` coalescing
+        concurrent callers over this wrapper's model and mesh."""
+        from deeplearning4j_tpu.serving import InferenceEngine
+
+        if self.batchLimit and "max_batch_size" not in engine_kwargs:
+            engine_kwargs["max_batch_size"] = self.batchLimit
+        return InferenceEngine(self.model, mesh=self.mesh, **engine_kwargs)

@@ -231,9 +231,12 @@ def _pair_grads3(q3, k3, v3, do3, lse, delta, pair_causal, interpret):
     building block of the ring and zigzag backward passes. Operands are
     (BH, T, D) with lse/delta (BH, 1, T) in the GLOBAL softmax frame."""
     from deeplearning4j_tpu.ops.pallas_kernels import (
-        _launch_bwd_dq, _launch_bwd_dkv, auto_flash_block)
+        _launch_bwd_dq, _launch_bwd_dkv, _resolve_flash_blocks)
     T, D = q3.shape[1], q3.shape[2]
-    bq = bk = auto_flash_block(T)
+    # route through _resolve_flash_blocks (not bare auto_flash_block) so the
+    # backward tile is self-guarding: a whole-T degenerate block beyond the
+    # VMEM envelope raises the actionable error instead of a Mosaic OOM
+    bq, bk = _resolve_flash_blocks(T, None, None)
     sc = 1.0 / (D ** 0.5)
     dq_c = _launch_bwd_dq(q3, k3, v3, do3, lse, delta, pair_causal,
                           bq, bk, sc, interpret)

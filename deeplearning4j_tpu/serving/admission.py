@@ -1,0 +1,180 @@
+"""Admission control for the serving engine: a bounded request queue with
+backpressure, per-request deadlines, and graceful shedding.
+
+The design point (Clipper NSDI'17 §4.3, ORCA OSDI'22 §5): an inference
+service under overload must convert unbounded queueing latency into a
+typed, immediate rejection the caller can act on (retry elsewhere,
+degrade, drop). Every request therefore carries a deadline; expired
+requests are shed AT DEQUEUE TIME — they never occupy a batch slot — and
+a full queue rejects at submit() rather than growing without bound.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class RejectedError(RuntimeError):
+    """Request refused by admission control. ``reason`` is machine-readable:
+    'queue_full' | 'deadline' | 'shutdown'."""
+
+    def __init__(self, msg: str, reason: str):
+        super().__init__(msg)
+        self.reason = reason
+
+
+class QueueFullError(RejectedError):
+    def __init__(self, msg: str):
+        super().__init__(msg, "queue_full")
+
+
+class DeadlineExceededError(RejectedError):
+    def __init__(self, msg: str):
+        super().__init__(msg, "deadline")
+
+
+@dataclass
+class Request:
+    """One submitted inference request (``rows`` leading-dim rows of x)."""
+
+    x: object                      # np.ndarray, batch-major
+    rows: int
+    future: Future = field(default_factory=Future)
+    submit_t: float = field(default_factory=time.perf_counter)
+    deadline_t: Optional[float] = None   # perf_counter timestamp, or None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline_t is None:
+            return False
+        return (now if now is not None else time.perf_counter()) >= self.deadline_t
+
+
+class AdmissionController:
+    """Bounded FIFO of :class:`Request` measured in ROWS (the unit devices
+    care about), with condition-variable handoff to the dispatcher.
+
+    - ``admit()`` raises :class:`QueueFullError` when capacity_rows would be
+      exceeded — backpressure is synchronous and immediate.
+    - ``take(max_rows, timeout)`` pops the head if it fits the remaining
+      batch budget; expired heads are shed (future completed with
+      :class:`DeadlineExceededError`) without consuming budget.
+    - ``close()`` wakes the dispatcher and rejects everything still queued.
+    """
+
+    def __init__(self, capacity_rows: int = 1024,
+                 default_timeout_ms: Optional[float] = None):
+        if capacity_rows <= 0:
+            raise ValueError("capacity_rows must be positive")
+        self.capacity_rows = capacity_rows
+        self.default_timeout_ms = default_timeout_ms
+        self._q: deque = deque()
+        self._rows = 0
+        self._cv = threading.Condition()
+        self._closed = False
+        self.shed_count = 0
+        # observer hook: called with each shed Request AFTER its future is
+        # failed (the engine wires its rejection counters here so sheds at
+        # dequeue time and at dispatch time land in the same metrics)
+        self.on_shed: Optional[callable] = None
+
+    # ------------------------------------------------------------- metrics
+    @property
+    def depth_rows(self) -> int:
+        with self._cv:
+            return self._rows
+
+    @property
+    def depth_requests(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    # ---------------------------------------------------------- submit side
+    def admit(self, req: Request, timeout_ms: Optional[float] = None) -> Request:
+        """Enqueue or raise. ``timeout_ms`` (or the controller default)
+        stamps the request deadline relative to now."""
+        tmo = timeout_ms if timeout_ms is not None else self.default_timeout_ms
+        if tmo is not None:
+            req.deadline_t = req.submit_t + tmo / 1000.0
+        with self._cv:
+            if self._closed:
+                raise RejectedError("engine is shut down", "shutdown")
+            if self._rows + req.rows > self.capacity_rows:
+                raise QueueFullError(
+                    f"queue full: {self._rows} rows queued + {req.rows} "
+                    f"submitted > capacity {self.capacity_rows}")
+            self._q.append(req)
+            self._rows += req.rows
+            self._cv.notify()
+        return req
+
+    # -------------------------------------------------------- dispatch side
+    def _shed(self, req: Request):
+        self.shed_count += 1
+        try:
+            req.future.set_exception(DeadlineExceededError(
+                f"deadline exceeded after "
+                f"{(time.perf_counter() - req.submit_t) * 1e3:.1f} ms in queue"))
+        except InvalidStateError:
+            pass  # caller cancelled the future while it was queued
+        if self.on_shed is not None:
+            self.on_shed(req)
+
+    def take(self, max_rows: int, timeout: float) -> Optional[Request]:
+        """Pop the head request if it fits in ``max_rows``; block up to
+        ``timeout`` seconds for one to arrive. Returns None on timeout, on
+        close, or when the head is too large for the remaining budget (the
+        dispatcher should then seal the batch and come back).
+
+        Expired heads are unlinked under the lock but their futures are
+        failed OUTSIDE it: set_exception runs done-callbacks synchronously,
+        and a callback that re-enters the controller (retry-on-shed) would
+        deadlock on the non-reentrant condition lock (close() orders its
+        rejections the same way)."""
+        end = time.perf_counter() + timeout
+        while True:
+            shed, out, decided = [], None, False
+            with self._cv:
+                while True:
+                    if self._q:
+                        head = self._q[0]
+                        if head.expired():
+                            self._q.popleft()
+                            self._rows -= head.rows
+                            shed.append(head)
+                            continue
+                        decided = True
+                        if head.rows <= max_rows:
+                            self._q.popleft()
+                            self._rows -= head.rows
+                            out = head
+                        break
+                    remaining = end - time.perf_counter()
+                    if self._closed or remaining <= 0:
+                        decided = True
+                        break
+                    if shed:
+                        break  # drop the lock to fail shed futures first
+                    self._cv.wait(remaining)
+            for req in shed:
+                self._shed(req)
+            if decided:
+                return out
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            pending = list(self._q)
+            self._q.clear()
+            self._rows = 0
+            self._cv.notify_all()
+        for req in pending:
+            try:
+                req.future.set_exception(
+                    RejectedError("engine shut down with request queued",
+                                  "shutdown"))
+            except InvalidStateError:
+                pass

@@ -1,0 +1,337 @@
+"""Dynamic micro-batching inference engine (ref: deeplearning4j
+ParallelInference's InferenceMode.BATCHED — BatchedInferenceObservable
+coalesces concurrent observers into one model pass per replica; see
+SURVEY.md §2.9. Same contract here, rebuilt for the XLA execution model).
+
+Why batching is THE serving lever on TPU: a compiled executable's launch
+cost is amortized over the batch dimension, so k concurrent 1-row calls
+cost ~k full dispatches while one 8-row call costs ~1. The reference
+coalesces per replica thread; here a single background dispatcher thread
+coalesces across ALL callers and lets XLA's SPMD partitioner spread the
+fused batch over the mesh (the same collapse data_parallel.py applies to
+ParallelWrapper).
+
+Two serving-specific invariants the reference does not have:
+
+- **bounded compiled signatures.** jit specializes on shape: serving raw
+  request sizes would compile a fresh executable per novel batch size
+  (unbounded memory + latency spikes). Batches are padded UP to a small
+  geometric ladder of bucket sizes (:func:`bucket_ladder`), so at most
+  ``len(buckets)`` inference signatures can ever exist, and every
+  dispatch after the warm set is a cache hit — tracked per-bucket in
+  :class:`~deeplearning4j_tpu.serving.metrics.ServingMetrics`.
+- **bounded queueing.** Admission control (admission.py) turns overload
+  into typed :class:`RejectedError`\\ s instead of unbounded latency.
+
+Determinism: pad rows are zeros, outputs are sliced back per request, and
+row-wise model math makes each caller's result bitwise-identical to a
+direct ``model.output()`` call on the same rows (asserted by the tier-1
+stress test on the 8-device CPU mesh).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.array import NDArray
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, batch_sharding
+from deeplearning4j_tpu.profiler import OpProfiler
+from deeplearning4j_tpu.serving.admission import (
+    AdmissionController, DeadlineExceededError, QueueFullError, RejectedError,
+    Request,
+)
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+
+def bucket_ladder(max_batch_size: int, multiple_of: int = 1,
+                  min_bucket: int = 1) -> Tuple[int, ...]:
+    """Geometric (doubling) ladder of batch buckets ending at or above
+    ``max_batch_size``, every rung a multiple of ``multiple_of`` (the mesh
+    data-axis size, so sharding never needs a second padding pass).
+    Doubling keeps the ladder |log2| small while wasting at most 50% of a
+    bucket — the standard bucketing compromise (cf. TF Serving's
+    ``allowed_batch_sizes``)."""
+    if max_batch_size <= 0:
+        raise ValueError("max_batch_size must be positive")
+    base = max(min_bucket, multiple_of)
+    base = ((base + multiple_of - 1) // multiple_of) * multiple_of
+    out = [base]
+    while out[-1] < max_batch_size:
+        out.append(out[-1] * 2)
+    return tuple(out)
+
+
+class InferenceEngine:
+    """Future-based batching front-end for one deployed model.
+
+    ``submit(x)`` enqueues ``x`` (batch-major, 1..max_batch_size rows) and
+    returns a :class:`concurrent.futures.Future`; a background dispatcher
+    coalesces queued requests into one padded bucket batch per device
+    pass. ``output(x)`` is the blocking convenience wrapper.
+
+    Parameters mirror the reference Builder surface where one exists:
+    ``max_batch_size`` ≙ batchLimit, ``max_wait_ms`` is the batching
+    window (the reference's nanotime spin in BatchedInferenceObservable),
+    ``queue_capacity_rows``/``default_timeout_ms`` are the admission
+    bounds, ``buckets`` overrides the padding ladder.
+    """
+
+    def __init__(self, model, *, mesh=None, max_batch_size: int = 32,
+                 max_wait_ms: float = 5.0,
+                 buckets: Optional[Sequence[int]] = None,
+                 queue_capacity_rows: int = 1024,
+                 default_timeout_ms: Optional[float] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 profiler: Optional[OpProfiler] = None,
+                 name: str = "engine"):
+        from deeplearning4j_tpu.serving.registry import ModelAdapter, as_adapter
+
+        self.adapter = model if isinstance(model, ModelAdapter) else as_adapter(model)
+        self.mesh = mesh
+        self._n = mesh.shape[DATA_AXIS] if mesh is not None else 1
+        if max_batch_size <= 0:
+            raise ValueError("max_batch_size must be positive")
+        self.max_batch_size = max_batch_size
+        self.max_wait_ms = max_wait_ms
+        if buckets is None:
+            self.buckets = bucket_ladder(max_batch_size, multiple_of=self._n)
+        else:
+            self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+            if not self.buckets or self.buckets[-1] < max_batch_size:
+                raise ValueError(
+                    f"buckets {self.buckets} must cover max_batch_size "
+                    f"{max_batch_size}")
+            if any(b % self._n for b in self.buckets):
+                raise ValueError(
+                    f"every bucket must be a multiple of the mesh data-axis "
+                    f"size {self._n}: {self.buckets}")
+        self.name = name
+        self.metrics = metrics or ServingMetrics()
+        self.profiler = profiler or OpProfiler.getInstance()
+        self._admission = AdmissionController(
+            capacity_rows=queue_capacity_rows,
+            default_timeout_ms=default_timeout_ms)
+        self._admission.on_shed = self._count_shed
+        self._seen_buckets: set = set()
+        self._row_sig = None  # (feature shape, dtype) pinned by first request
+        self._seen_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serving-dispatcher[{self.name}]",
+            daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def shutdown(self, wait: bool = True):
+        """Stop the dispatcher; queued requests are rejected ('shutdown')."""
+        self._stop.set()
+        self._admission.close()
+        if wait and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    # --------------------------------------------------------------- submit
+    def submit(self, x, timeout_ms: Optional[float] = None) -> Future:
+        """Enqueue a batch-major array; the Future resolves to an NDArray
+        holding exactly ``x.shape[0]`` output rows, or raises
+        :class:`RejectedError` / the model's own exception."""
+        arr = np.asarray(x)
+        if arr.ndim < 1 or arr.shape[0] == 0:
+            raise ValueError("submit() needs a batch-major array with >=1 row")
+        if arr.shape[0] > self.max_batch_size:
+            raise ValueError(
+                f"request of {arr.shape[0]} rows exceeds max_batch_size "
+                f"{self.max_batch_size}; split the call")
+        self._check_row_sig(arr.shape[1:], arr.dtype)
+        self.metrics.requests_total.inc()
+        req = Request(x=arr, rows=int(arr.shape[0]))
+        try:
+            self._admission.admit(req, timeout_ms=timeout_ms)
+        except QueueFullError:
+            self.metrics.rejected_total.inc()
+            self.metrics.rejected_queue_full.inc()
+            raise
+        except RejectedError:
+            self.metrics.rejected_total.inc()
+            raise
+        self.metrics.queue_depth.set(self._admission.depth_rows)
+        return req.future
+
+    def output(self, x, timeout_ms: Optional[float] = None) -> NDArray:
+        """Blocking submit (ref: ParallelInference.output)."""
+        return self.submit(x, timeout_ms=timeout_ms).result()
+
+    def _check_row_sig(self, feature_shape, dtype):
+        """All requests to one engine must share feature shape and dtype:
+        the dispatcher concatenates co-batched rows, so a mismatch would
+        either fail the whole batch (shape) or silently upcast neighbors'
+        rows — breaking bitwise parity AND doubling compiled signatures
+        (dtype). Pinned by the first request (or warmup) and enforced
+        client-side, where the error belongs."""
+        sig = (tuple(feature_shape), np.dtype(dtype))
+        with self._seen_lock:
+            if self._row_sig is None:
+                self._row_sig = sig
+            elif sig != self._row_sig:
+                raise ValueError(
+                    f"request rows {sig} do not match this engine's pinned "
+                    f"row signature {self._row_sig}; one engine serves one "
+                    f"input surface — use a second engine for other inputs")
+
+    # -------------------------------------------------------------- batching
+    def _loop(self):
+        while not self._stop.is_set():
+            first = self._admission.take(self.max_batch_size, timeout=0.05)
+            if first is None:
+                continue
+            batch = [first]
+            rows = first.rows
+            t_open = time.perf_counter()
+            window = self.max_wait_ms / 1000.0
+            while rows < self.max_batch_size:
+                remaining = t_open + window - time.perf_counter()
+                if remaining <= 0:
+                    break
+                nxt = self._admission.take(self.max_batch_size - rows,
+                                           timeout=remaining)
+                if nxt is None:  # window elapsed, or head won't fit: seal
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            try:
+                self._dispatch(batch)
+            except BaseException as e:  # never kill the dispatcher thread
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+        # drain anything admitted between close() and loop exit
+        while True:
+            req = self._admission.take(self.max_batch_size, timeout=0.0)
+            if req is None:
+                break
+            if not req.future.done():
+                req.future.set_exception(
+                    RejectedError("engine shut down", "shutdown"))
+
+    def _count_shed(self, req):
+        self.metrics.rejected_total.inc()
+        self.metrics.rejected_deadline.inc()
+
+    def _bucket_for(self, b: int) -> int:
+        for s in self.buckets:
+            if s >= b:
+                return s
+        return self.buckets[-1]
+
+    def _run(self, x: np.ndarray) -> np.ndarray:
+        if self.mesh is not None:
+            xs = jax.device_put(x, batch_sharding(self.mesh, rank=x.ndim))
+            with self.mesh:
+                return self.adapter.infer(xs)
+        return self.adapter.infer(x)
+
+    def _dispatch(self, batch):
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.expired(now):  # re-check: the window may have eaten it
+                self._admission._shed(req)  # counts via _count_shed
+            elif not req.future.set_running_or_notify_cancel():
+                continue  # caller cancelled while queued: drop silently
+            else:
+                self.metrics.queue_wait_ms.observe((now - req.submit_t) * 1e3)
+                live.append(req)
+        self.metrics.queue_depth.set(self._admission.depth_rows)
+        if not live:
+            return
+        b = sum(r.rows for r in live)
+        x = live[0].x if len(live) == 1 else np.concatenate([r.x for r in live])
+        bucket = self._bucket_for(b)
+        if bucket > b:
+            pad = np.zeros((bucket - b,) + x.shape[1:], x.dtype)
+            x = np.concatenate([x, pad])
+        with self._seen_lock:
+            first_time = bucket not in self._seen_buckets
+            self._seen_buckets.add(bucket)
+        self.metrics.inflight_rows.set(bucket)
+        t0 = time.perf_counter()
+        try:
+            with self.profiler.span("serving.dispatch", engine=self.name,
+                                    bucket=bucket, rows=b,
+                                    requests=len(live)):
+                y = np.asarray(self._run(x))
+        except BaseException as e:
+            self.metrics.failed_total.inc(len(live))
+            for req in live:
+                req.future.set_exception(e)
+            return
+        finally:
+            self.metrics.inflight_rows.set(0)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.batches_total.inc()
+        self.metrics.rows_total.inc(b)
+        self.metrics.padded_rows_total.inc(bucket - b)
+        self.metrics.requests_per_batch.observe(len(live))
+        self.metrics.fill_ratio.observe(b / bucket)
+        self.metrics.dispatch_ms.observe(dt_ms)
+        self.metrics.record_bucket(bucket, b, first_time)
+        off = 0
+        done_t = time.perf_counter()
+        for req in live:
+            # copy: a view would pin the whole bucket buffer (pad rows and
+            # other tenants' outputs) for as long as the caller holds it
+            out = y[off:off + req.rows].copy()
+            off += req.rows
+            self.metrics.latency_ms.observe((done_t - req.submit_t) * 1e3)
+            req.future.set_result(NDArray(out))
+
+    # --------------------------------------------------------------- warmup
+    def warmup(self, example_row) -> "InferenceEngine":
+        """Compile every bucket signature up front from one example row
+        (feature shape, NO batch dim). After warmup, all traffic hits the
+        executable cache — registry.deploy() calls this when given a
+        warmup example."""
+        from deeplearning4j_tpu.serving.registry import tile_rows
+
+        ex = np.asarray(example_row)
+        self._check_row_sig(ex.shape, ex.dtype)
+        for bucket in self.buckets:
+            x = tile_rows(ex, bucket)
+            with self._seen_lock:
+                first_time = bucket not in self._seen_buckets
+                self._seen_buckets.add(bucket)
+            with self.profiler.span("serving.warmup", engine=self.name,
+                                    bucket=bucket):
+                np.asarray(self._run(x))
+            self.metrics.record_bucket(bucket, 0, first_time)
+        return self
+
+    # -------------------------------------------------------------- insight
+    def compiled_signatures(self) -> int:
+        """Inference signatures compiled so far: the adapter's live jit
+        cache size when the backend exposes one, else the engine's own
+        first-sight bucket count. Bounded by ``len(self.buckets)`` for all
+        traffic routed through this engine."""
+        n = self.adapter.cache_size()
+        if n is None:
+            with self._seen_lock:
+                n = len(self._seen_buckets)
+        return n
+
+    @property
+    def queue_depth_rows(self) -> int:
+        return self._admission.depth_rows
+
+
+__all__ = ["InferenceEngine", "bucket_ladder", "RejectedError",
+           "QueueFullError", "DeadlineExceededError"]

@@ -1,0 +1,207 @@
+"""Serving metrics: thread-safe counters, gauges and fixed-bucket histograms
+(ref: deeplearning4j's ParallelInference exposes no metrics at all — the
+observability surface here follows the Clipper/ORCA serving literature:
+QPS, queue depth, batch fill ratio and the compiled-signature cache hit
+rate are THE four signals that tell you whether dynamic batching is
+earning its latency budget).
+
+Integration points (no new plumbing, per the subsystem contract):
+
+- ``ServingMetrics.snapshot()`` — one JSON-safe dict, consumed by tests,
+  by ``ui.server``'s ``/api/serving`` endpoint, and by bench tooling.
+- ``ServingMetrics.publish(storage)`` — posts the snapshot as an update
+  report into any ``ui.storage.StatsStorage`` (typeId ``ServingMetrics``),
+  the same SPI StatsListener training reports ride.
+- the engine wraps every dispatched batch in an ``OpProfiler`` span, so
+  Chrome traces show serving batches interleaved with training steps.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+class Counter:
+    """Monotone non-negative counter."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0):
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._v
+
+
+class Gauge:
+    """Point-in-time value (queue depth, in-flight rows)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float):
+        with self._lock:
+            self._v = float(v)
+
+    def add(self, d: float):
+        with self._lock:
+            self._v += d
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._v
+
+
+class Histogram:
+    """Fixed-boundary histogram with running sum/count (Prometheus-style
+    cumulative-le semantics on export; boundaries are upper-inclusive)."""
+
+    DEFAULT_MS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500)
+
+    def __init__(self, name: str, boundaries: Sequence[float] = DEFAULT_MS):
+        self.name = name
+        self.boundaries = tuple(boundaries)
+        self._counts = [0] * (len(self.boundaries) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        with self._lock:
+            i = 0
+            while i < len(self.boundaries) and v > self.boundaries[i]:
+                i += 1
+            self._counts[i] += 1
+            self._sum += v
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._n if self._n else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Upper boundary of the bucket holding the q-quantile (coarse but
+        monotone — good enough for dashboards; exact values need traces)."""
+        with self._lock:
+            if not self._n:
+                return 0.0
+            target = q * self._n
+            seen = 0
+            for i, c in enumerate(self._counts):
+                seen += c
+                if seen >= target:
+                    return (self.boundaries[i] if i < len(self.boundaries)
+                            else float("inf"))
+            return float("inf")
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {"boundaries": list(self.boundaries),
+                    "counts": list(self._counts),
+                    "sum": self._sum, "count": self._n}
+
+
+class ServingMetrics:
+    """The engine's full metric set. All members are monotone counters or
+    derived ratios except the two gauges — tests assert monotonicity over
+    the counter set via :meth:`counters`."""
+
+    def __init__(self):
+        self.requests_total = Counter("requests_total")
+        self.rows_total = Counter("rows_total")
+        self.batches_total = Counter("batches_total")
+        self.padded_rows_total = Counter("padded_rows_total")
+        self.rejected_total = Counter("rejected_total")
+        self.rejected_queue_full = Counter("rejected_queue_full")
+        self.rejected_deadline = Counter("rejected_deadline")
+        self.failed_total = Counter("failed_total")
+        self.bucket_hits = Counter("bucket_hits")            # warm executable
+        self.bucket_compiles = Counter("bucket_compiles")    # first sight
+        self.queue_depth = Gauge("queue_depth")              # rows waiting
+        self.inflight_rows = Gauge("inflight_rows")
+        self.latency_ms = Histogram("latency_ms")            # submit->result
+        self.dispatch_ms = Histogram("dispatch_ms")          # device time
+        self.queue_wait_ms = Histogram("queue_wait_ms")
+        self.requests_per_batch = Histogram(
+            "requests_per_batch", boundaries=(1, 2, 4, 8, 16, 32, 64))
+        self.fill_ratio = Histogram(                          # rows / bucket
+            "fill_ratio", boundaries=(0.125, 0.25, 0.5, 0.75, 0.875, 1.0))
+        self._per_bucket: Dict[int, Dict[str, int]] = {}
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+
+    # ------------------------------------------------------------ recording
+    def record_bucket(self, bucket: int, rows: int, first_time: bool):
+        with self._lock:
+            d = self._per_bucket.setdefault(
+                bucket, {"batches": 0, "rows": 0, "compiles": 0, "hits": 0})
+            d["batches"] += 1
+            d["rows"] += rows
+            d["compiles" if first_time else "hits"] += 1
+        (self.bucket_compiles if first_time else self.bucket_hits).inc()
+
+    # ------------------------------------------------------------- reading
+    def counters(self) -> Dict[str, float]:
+        return {c.name: c.value for c in (
+            self.requests_total, self.rows_total, self.batches_total,
+            self.padded_rows_total, self.rejected_total,
+            self.rejected_queue_full, self.rejected_deadline,
+            self.failed_total, self.bucket_hits, self.bucket_compiles)}
+
+    def bucket_cache_hit_rate(self) -> float:
+        h, c = self.bucket_hits.value, self.bucket_compiles.value
+        return h / (h + c) if (h + c) else 0.0
+
+    def mean_requests_per_batch(self) -> float:
+        b = self.batches_total.value
+        return self.requests_total.value / b if b else 0.0
+
+    def qps(self) -> float:
+        dt = time.time() - self._t0
+        return self.requests_total.value / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            per_bucket = {str(k): dict(v) for k, v in self._per_bucket.items()}
+        return {
+            "timestamp": time.time(),
+            **self.counters(),
+            "queue_depth": self.queue_depth.value,
+            "inflight_rows": self.inflight_rows.value,
+            "qps": self.qps(),
+            "bucket_cache_hit_rate": self.bucket_cache_hit_rate(),
+            "mean_requests_per_batch": self.mean_requests_per_batch(),
+            "latency_ms": self.latency_ms.to_dict(),
+            "dispatch_ms": self.dispatch_ms.to_dict(),
+            "queue_wait_ms": self.queue_wait_ms.to_dict(),
+            "requests_per_batch": self.requests_per_batch.to_dict(),
+            "fill_ratio": self.fill_ratio.to_dict(),
+            "per_bucket": per_bucket,
+        }
+
+    # -------------------------------------------------------- ui.stats SPI
+    def publish(self, storage, sessionId: str = "serving",
+                workerId: str = "engine_0"):
+        """Post one snapshot into a StatsStorage (typeId ``ServingMetrics``)
+        — rides the exact update SPI the training StatsListener uses, so
+        ``UIServer.attach(storage)`` makes it visible at /api/serving."""
+        storage.putUpdate(sessionId, "ServingMetrics", workerId,
+                          self.snapshot())

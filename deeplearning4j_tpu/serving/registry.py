@@ -1,0 +1,312 @@
+"""Versioned model registry for the serving runtime (ref: deeplearning4j
+has no registry — model lifecycle there is "construct a ParallelInference
+around a live net". The registry follows the TF-Serving/Clipper servable
+lifecycle instead: deploy -> warmup-compile -> ready -> undeploy, with
+monotone integer versions per name and mutable aliases for routing).
+
+Model-kind adapters normalize the three inference surfaces to ONE
+row-in/row-out contract the engine can batch behind:
+
+- ``MultiLayerNetwork.output(x)``        -> NDArray
+- ``ComputationGraph.output(x)[i]``      -> List[NDArray] (one per output)
+- ``SameDiff.output({ph: x}, [name])``   -> Dict[str, NDArray]
+
+Warmup-compile on deploy: jit specializes per input shape, so the first
+request at each bucket size would otherwise pay full XLA compilation
+inline (seconds, against a millisecond SLO). ``deploy(warmup_example=...)``
+tiles one example row to every bucket and runs the model once per rung,
+so the executable cache is fully populated before traffic arrives.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+
+def tile_rows(example_row, batch: int) -> np.ndarray:
+    """Tile ONE example row (feature shape, no batch dim) into a
+    ``batch``-row array — the shared warmup idiom (registry deploy +
+    engine warmup)."""
+    ex = np.asarray(example_row)
+    return np.broadcast_to(ex, (batch,) + ex.shape).copy()
+
+
+class ModelAdapter:
+    """Uniform inference surface: ``infer(batch) -> np.ndarray`` (host),
+    row i of the output belonging to row i of the input."""
+
+    kind: str = "unknown"
+
+    def __init__(self, model):
+        self.model = model
+
+    def infer(self, x) -> np.ndarray:
+        raise NotImplementedError
+
+    def cache_size(self) -> Optional[int]:
+        """Live compiled-signature count of the underlying jit executable,
+        or None when the backend doesn't expose one."""
+        return None
+
+
+def _jit_cache_size(fn) -> Optional[int]:
+    try:
+        return fn._cache_size()
+    except Exception:
+        return None
+
+
+class MultiLayerNetworkAdapter(ModelAdapter):
+    kind = "MultiLayerNetwork"
+
+    def infer(self, x) -> np.ndarray:
+        return np.asarray(self.model.output(x).jax)
+
+    def cache_size(self) -> Optional[int]:
+        fn = self.model._jit_cache.get("infer")
+        return _jit_cache_size(fn) if fn is not None else 0
+
+
+class ComputationGraphAdapter(ModelAdapter):
+    """Single-feature graphs; ``output_index`` picks among multiple network
+    outputs (the engine contract is one array per request)."""
+
+    kind = "ComputationGraph"
+
+    def __init__(self, model, output_index: int = 0):
+        super().__init__(model)
+        self.output_index = output_index
+
+    def infer(self, x) -> np.ndarray:
+        return np.asarray(self.model.output(x)[self.output_index].jax)
+
+    def cache_size(self) -> Optional[int]:
+        fn = self.model._jit_cache.get("infer")
+        return _jit_cache_size(fn) if fn is not None else 0
+
+
+class SameDiffAdapter(ModelAdapter):
+    kind = "SameDiff"
+
+    def __init__(self, model, input_name: Optional[str] = None,
+                 output_name: Optional[str] = None):
+        super().__init__(model)
+        from deeplearning4j_tpu.autodiff.samediff import VariableType
+
+        if input_name is None:
+            phs = [n for n, v in model._vars.items()
+                   if v.varType == VariableType.PLACEHOLDER]
+            if len(phs) != 1:
+                raise ValueError(
+                    f"SameDiff graph has {len(phs)} placeholders {phs}; pass "
+                    "input_name= to pick the batch input")
+            input_name = phs[0]
+        if output_name is None:
+            if not model._ops:
+                raise ValueError("SameDiff graph has no ops to serve")
+            output_name = model._ops[-1].outputs[0]
+        self.input_name = input_name
+        self.output_name = output_name
+
+    def infer(self, x) -> np.ndarray:
+        out = self.model.output({self.input_name: x}, [self.output_name])
+        return np.asarray(out[self.output_name].jax)
+
+    def cache_size(self) -> Optional[int]:
+        fn = self.model._jit_cache.get(("exec", (self.output_name,)))
+        return _jit_cache_size(fn) if fn is not None else 0
+
+
+def as_adapter(model, input_name: Optional[str] = None,
+               output_name: Optional[str] = None,
+               output_index: int = 0) -> ModelAdapter:
+    """Wrap any supported model kind; passthrough for ready adapters."""
+    if isinstance(model, ModelAdapter):
+        return model
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    if isinstance(model, MultiLayerNetwork):
+        return MultiLayerNetworkAdapter(model)
+    if isinstance(model, ComputationGraph):
+        return ComputationGraphAdapter(model, output_index=output_index)
+    if isinstance(model, SameDiff):
+        return SameDiffAdapter(model, input_name=input_name,
+                               output_name=output_name)
+    raise TypeError(
+        f"cannot serve {type(model).__name__}: expected MultiLayerNetwork, "
+        "ComputationGraph, SameDiff, or a ModelAdapter")
+
+
+@dataclass
+class Deployment:
+    """One (name, version) servable."""
+
+    name: str
+    version: int
+    adapter: ModelAdapter
+    buckets: Tuple[int, ...]
+    deployed_at: float = field(default_factory=time.time)
+    warmup_ms: Optional[float] = None
+    warmup_example: Optional[object] = None  # one row; re-warms mesh engines
+    state: str = "ready"
+
+    @property
+    def ref(self) -> str:
+        return f"{self.name}:{self.version}"
+
+
+class ModelRegistry:
+    """deploy/undeploy/alias with per-name monotone versions.
+
+    Refs accepted everywhere a model is looked up: ``"name"`` (latest
+    version), ``"name:3"`` (pinned), or an alias previously bound with
+    :meth:`alias` (e.g. ``"prod" -> "bert:2"`` for canary flips)."""
+
+    def __init__(self, default_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32)):
+        self.default_buckets = tuple(default_buckets)
+        self._models: Dict[str, Dict[int, Deployment]] = {}
+        self._aliases: Dict[str, str] = {}
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------- lifecycle
+    def deploy(self, name: str, model, *, version: Optional[int] = None,
+               buckets: Optional[Sequence[int]] = None,
+               warmup_example=None, input_name: Optional[str] = None,
+               output_name: Optional[str] = None,
+               output_index: int = 0) -> Deployment:
+        """Register ``model`` under ``name``; returns the Deployment. When
+        ``warmup_example`` (ONE row, no batch dim) is given, every bucket
+        size is compiled before the deployment becomes visible."""
+        if ":" in name:
+            raise ValueError(f"model name {name!r} may not contain ':'")
+        adapter = as_adapter(model, input_name=input_name,
+                             output_name=output_name,
+                             output_index=output_index)
+        bks = tuple(sorted(set(buckets))) if buckets else self.default_buckets
+        ex = np.asarray(warmup_example) if warmup_example is not None else None
+        dep = Deployment(name=name, version=0, adapter=adapter, buckets=bks,
+                         warmup_example=ex,
+                         state="warming" if ex is not None else "ready")
+        with self._lock:
+            # reserve the slot under the lock: concurrent deploys of the
+            # same name must not pick the same version and silently clobber
+            # each other's entry after the (lock-free) warmup below
+            versions = self._models.setdefault(name, {})
+            if version is None:
+                version = max(versions) + 1 if versions else 1
+            elif version in versions:
+                raise ValueError(f"{name}:{version} is already deployed")
+            dep.version = version
+            versions[version] = dep
+        if ex is not None:
+            try:
+                t0 = time.perf_counter()
+                for b in bks:
+                    adapter.infer(tile_rows(ex, b))
+                dep.warmup_ms = (time.perf_counter() - t0) * 1e3
+            except BaseException:
+                with self._lock:
+                    versions.pop(version, None)
+                    if not versions:
+                        self._models.pop(name, None)
+                raise
+            dep.state = "ready"
+        return dep
+
+    def undeploy(self, name: str, version: Optional[int] = None) -> int:
+        """Remove one version (or all). Aliases that pointed at removed
+        deployments are dropped too. Returns how many were removed."""
+        with self._lock:
+            versions = self._models.get(name, {})
+            victims = ([version] if version is not None
+                       else sorted(versions))
+            removed = 0
+            for v in victims:
+                if v in versions:
+                    versions.pop(v).state = "retired"
+                    removed += 1
+            if not versions:
+                self._models.pop(name, None)
+            dangling = [a for a, tgt in self._aliases.items()
+                        if self._resolve_unlocked(tgt) is None]
+            for a in dangling:
+                del self._aliases[a]
+            return removed
+
+    def alias(self, alias: str, target: str):
+        """Bind ``alias`` -> ``target`` ("name" or "name:version"). The
+        binding is validated now but resolved per-lookup, so re-deploying
+        a floating target moves the alias with it."""
+        with self._lock:
+            if self._resolve_unlocked(target) is None:
+                raise KeyError(f"alias target {target!r} is not deployed")
+            self._aliases[alias] = target
+
+    # -------------------------------------------------------------- lookup
+    def _resolve_unlocked(self, ref: str) -> Optional[Deployment]:
+        seen = set()
+        while ref in self._aliases and ref not in seen:
+            seen.add(ref)
+            ref = self._aliases[ref]
+        if ":" in ref:
+            name, _, v = ref.partition(":")
+            try:
+                dep = self._models.get(name, {}).get(int(v))
+            except ValueError:
+                return None
+            return dep if dep is not None and dep.state == "ready" else None
+        ready = [v for v, d in (self._models.get(ref) or {}).items()
+                 if d.state == "ready"]
+        return self._models[ref][max(ready)] if ready else None
+
+    def get(self, ref: str) -> Deployment:
+        with self._lock:
+            dep = self._resolve_unlocked(ref)
+        if dep is None:
+            raise KeyError(f"no deployment for {ref!r}")
+        return dep
+
+    def versions(self, name: str) -> List[int]:
+        with self._lock:
+            return sorted(self._models.get(name, {}))
+
+    def models(self) -> Dict[str, List[int]]:
+        with self._lock:
+            return {n: sorted(vs) for n, vs in self._models.items()}
+
+    def aliases(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._aliases)
+
+    # -------------------------------------------------------------- serving
+    def engine(self, ref: str, **engine_kwargs):
+        """Spin up an :class:`InferenceEngine` over a deployment. The
+        deployment's bucket ladder is the default padding ladder; when the
+        deployment carries a warmup example, the engine re-warms through
+        its OWN dispatch path — with a mesh, sharded inputs are a distinct
+        jit signature per bucket, so deploy-time (unmeshed) warmup alone
+        would still pay full compilation on first live traffic."""
+        from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+        dep = self.get(ref)
+        mesh = engine_kwargs.get("mesh")
+        n = mesh.shape[DATA_AXIS] if mesh is not None else 1
+        if all(b % n == 0 for b in dep.buckets):
+            engine_kwargs.setdefault("buckets", dep.buckets)
+        # else: the deployment ladder is not mesh-aligned (e.g. the 1,2,4...
+        # defaults on an 8-way mesh) — let the engine build its own
+        # bucket_ladder(max_batch_size, multiple_of=n) instead of erroring
+        engine_kwargs.setdefault("max_batch_size", dep.buckets[-1])
+        engine_kwargs.setdefault("name", dep.ref)
+        eng = InferenceEngine(dep.adapter, **engine_kwargs)
+        if dep.warmup_example is not None:
+            eng.warmup(dep.warmup_example)
+        return eng

@@ -446,6 +446,21 @@ class _Handler(BaseHTTPRequestHandler):
                     })
             self._json(out)
             return
+        if parts == ["api", "serving"]:
+            # serving-engine metric snapshots (typeId ServingMetrics —
+            # published by serving.metrics.ServingMetrics.publish through
+            # the same storage SPI as training stats)
+            out = []
+            for st in self._storages():
+                for sid in st.listSessionIDs():
+                    for worker in st.listWorkerIDsForSession(sid) or []:
+                        ups = st.getUpdates(sid, "ServingMetrics", worker)
+                        if ups:
+                            out.append({"sessionId": sid, "workerId": worker,
+                                        "reports": len(ups),
+                                        "latest": ups[-1]})
+            self._json(out)
+            return
         if len(parts) == 4 and parts[:2] == ["api", "updates"]:
             sid, worker = parts[2], parts[3]
             start = int(parse_qs(url.query).get("from", ["0"])[0])

@@ -123,6 +123,42 @@ class TestFuseAttention:
         np.testing.assert_allclose(np.asarray(out.eval().toNumpy()), want,
                                    atol=1e-6)
 
+    def test_fused_away_intermediate_raises_targeted_error(self):
+        """Requesting a chain intermediate (softmax probs / raw scores)
+        after fusion must raise an error NAMING fuseAttention, not a deep
+        KeyError; the preserved final output keeps working."""
+        sd = SameDiff.create()
+        rng = np.random.default_rng(11)
+        q = sd.var("q", jnp.asarray(rng.normal(size=(2, 3, 8, 4)),
+                                    jnp.float32))
+        k = sd.var("k", jnp.asarray(rng.normal(size=(2, 3, 8, 4)),
+                                    jnp.float32))
+        v = sd.var("v", jnp.asarray(rng.normal(size=(2, 3, 8, 4)),
+                                    jnp.float32))
+        kt = sd.shapes.permute(k, axes=[0, 1, 3, 2])
+        p = sd.nn.softmax(sd.linalg.matmul(q, kt))
+        out = sd.linalg.matmul(p, v)
+        p_name, out_name = p.name, out.name
+        probs_before = np.asarray(
+            sd.output({}, p_name)[p_name].toNumpy())  # reachable pre-fusion
+        assert probs_before.shape == (2, 3, 8, 8)
+        assert sd.fuseAttention() == 1
+        with pytest.raises(ValueError, match="fuseAttention"):
+            sd.output({}, p_name)
+        assert sd.output({}, out_name)[out_name].shape == (2, 3, 8, 4)
+        # the targeted error survives a save/load roundtrip
+        import os
+        import tempfile
+        fd, path = tempfile.mkstemp(suffix=".zip")
+        os.close(fd)
+        try:
+            sd.save(path)
+            sd2 = SameDiff.load(path)
+            with pytest.raises(ValueError, match="fuseAttention"):
+                sd2.output({}, p_name)
+        finally:
+            os.unlink(path)
+
     def test_masked_pattern_mask_operand_first(self):
         """Operand order (mask, scores) on the add — and a mask that is
         ITSELF mul-produced, the standard (1-m) * -1e9 adder — must still

@@ -448,3 +448,49 @@ class TestSoftmaxCrossEntropy:
         want = lse - logits[jnp.arange(32), targets]
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-4, rtol=1e-5)
+
+
+class TestActiveMeshProbe:
+    """active_global_mesh() consults a probe chain; an empty answer from an
+    earlier probe must not mask an active mesh a later probe can see (each
+    probe tracks a different context mechanism)."""
+
+    def test_empty_probe_does_not_short_circuit_chain(self, monkeypatch):
+        from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+        class _EmptyMesh:
+            empty = True
+
+        class _LiveMesh:
+            empty = False
+
+        monkeypatch.setattr(pk, "_MESH_PROBES",
+                            (lambda: _EmptyMesh(), lambda: _LiveMesh()))
+        got = pk.active_global_mesh()
+        assert isinstance(got, _LiveMesh)
+
+    def test_all_empty_answers_mean_no_mesh_without_warning(self, monkeypatch):
+        import warnings
+
+        from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+        class _EmptyMesh:
+            empty = True
+
+        monkeypatch.setattr(pk, "_MESH_PROBES", (lambda: _EmptyMesh(),))
+        monkeypatch.setattr(pk, "_MESH_PROBE_BROKEN", False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert pk.active_global_mesh() is None
+        assert pk._MESH_PROBE_BROKEN is False
+
+    def test_real_probe_chain_sees_entered_mesh(self):
+        from deeplearning4j_tpu.ops.pallas_kernels import active_global_mesh
+        from deeplearning4j_tpu.parallel import make_mesh
+
+        assert active_global_mesh() is None
+        mesh = make_mesh({"data": jax.device_count()})
+        with mesh:
+            got = active_global_mesh()
+            assert got is not None and not got.empty
+        assert active_global_mesh() is None
