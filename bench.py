@@ -1,14 +1,18 @@
-"""Headline benchmark: BERT-base masked-LM training throughput on one chip.
+"""Headline benchmark: BERT-base masked-LM training throughput on one chip,
+plus a continuous-batching decode leg (serving/generation.py).
 
 Mirrors BASELINE.json's metric ("SameDiff BERT-base tokens/sec/chip"): the
 reference runs this workload through the SameDiff op-by-op JVM interpreter;
 here it is one fused XLA executable (fwd+bwd+AdamW, bf16 compute, no remat —
 activations fit HBM at bench shapes and recompute cost ~15% throughput).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "decode"}.
 ``vs_baseline`` is measured MFU / 0.35 (the north-star gate from
 BASELINE.json) since the reference publishes no in-tree numbers
-(SURVEY.md §6, BASELINE "published": {}).
+(SURVEY.md §6, BASELINE "published": {}). ``decode`` reports the
+GenerationEngine's steady-state numbers: decode tokens/sec across all
+slots, median time-to-first-token, slot occupancy at steady state, and
+the compiled-signature count (must stay ≤ prefill ladder + 1).
 """
 import json
 import time
@@ -102,7 +106,73 @@ def main():
         "mfu_basis": MFU_BASIS,
         "vs_baseline": round(mfu / 0.35, 4),
         "vs_baseline_basis": "mfu / 0.35 north-star gate (BASELINE.json)",
+        "decode": decode_leg(on_tpu),
     }))
+
+
+def decode_leg(on_tpu: bool) -> dict:
+    """Continuous-batching decode throughput: saturate every slot of one
+    GenerationEngine with staggered prompts (the ORCA regime — admissions
+    and retirements interleave with decode iterations) and report the
+    scheduler's sustained rate. Decode tokens/sec is summed across slots:
+    one decode_step samples a token for EVERY live slot, which is exactly
+    why iteration-level scheduling wins over request-level batching."""
+    from deeplearning4j_tpu.models import (
+        TransformerConfig, init_params)
+    from deeplearning4j_tpu.serving import GenerationEngine
+
+    if on_tpu:
+        cfg = TransformerConfig(causal=True, remat=False,
+                                attention_impl="flash")
+        slots, max_len, n_requests, max_new = 16, 512, 48, 64
+    else:                                   # CPU smoke (driver runs TPU)
+        cfg = TransformerConfig(vocab_size=1024, hidden=128, layers=2,
+                                heads=4, mlp_dim=512, max_seq=128,
+                                dtype=jnp.float32, causal=True, remat=False)
+        slots, max_len, n_requests, max_new = 4, 64, 8, 12
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    with GenerationEngine(params, cfg, slots=slots, max_len=max_len,
+                          queue_capacity=n_requests + slots) as eng:
+        eng.warmup()
+        # fresh metrics past this point: warmup's samples include the
+        # one-time XLA compiles (decode wall, TTFT, step histograms), which
+        # would swamp the steady-state numbers this leg exists to report —
+        # the engine is idle here, so the swap cannot race a live stream
+        from deeplearning4j_tpu.serving import ServingMetrics
+        eng.metrics = ServingMetrics()
+        handles = []
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            n = int(rng.integers(4, max_len - max_new))
+            handles.append(eng.submit(
+                rng.integers(0, cfg.vocab_size, n).astype(np.int32),
+                max_new_tokens=max_new))
+        # steady-state occupancy: poll the gauge while the backlog drains
+        # (sampling at submit time would race the scheduler's admissions)
+        occ_samples = []
+        while not handles[-1].future.done():
+            occ_samples.append(eng.metrics.slot_occupancy.value)
+            time.sleep(0.005)
+        for h in handles:
+            h.result(timeout=600)
+        wall_s = time.perf_counter() - t0
+        m = eng.metrics
+        return {
+            "decode_tokens_per_sec": round(m.decode_tokens_per_sec(), 2),
+            "end_to_end_tokens_per_sec": round(
+                n_requests * max_new / wall_s, 2),
+            "ttft_ms_p50": round(m.ttft_ms.quantile(0.5), 3),
+            "decode_step_ms_p50": round(m.decode_step_ms.quantile(0.5), 3),
+            "steady_state_slot_occupancy": round(
+                float(np.median(occ_samples)) if occ_samples else 1.0, 3),
+            "slots": slots,
+            "requests": n_requests,
+            "max_new_tokens": max_new,
+            "compiled_signatures": eng.compiled_signatures(),
+            "signature_bound": len(eng.buckets) + 1,
+        }
 
 
 if __name__ == "__main__":

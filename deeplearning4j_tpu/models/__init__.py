@@ -14,9 +14,17 @@ from deeplearning4j_tpu.models.bert import (
     make_train_step,
     param_pspecs,
     BERT_BASE,
+    init_kv_cache,
+    kv_cache_pspecs,
+    place_kv_cache,
+    make_prefill,
+    make_decode_step,
+    sample_token,
 )
 
 __all__ = [
     "TransformerConfig", "init_params", "forward", "lm_loss",
     "make_train_step", "param_pspecs", "BERT_BASE",
+    "init_kv_cache", "kv_cache_pspecs", "place_kv_cache",
+    "make_prefill", "make_decode_step", "sample_token",
 ]

@@ -35,7 +35,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
-from deeplearning4j_tpu.parallel.mesh import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
+from deeplearning4j_tpu.parallel.mesh import (
+    CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS, tree_shardings)
 from deeplearning4j_tpu.parallel.sequence_parallel import (
     ring_attention, ring_flash_attention, ulysses_attention)
 
@@ -280,11 +281,19 @@ def _use_packed_kernel(cfg: TransformerConfig, mesh: Optional[Mesh],
     return True
 
 
-def _block(params, x, cfg: TransformerConfig, mesh: Optional[Mesh]):
+def _block(params, x, cfg: TransformerConfig, mesh: Optional[Mesh],
+           return_kv: bool = False):
     B, T, H = x.shape
     h = _layernorm(x, params["ln1"])
     qkv = h @ params["qkv"]["kernel"].astype(h.dtype) + params["qkv"]["bias"].astype(h.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
+    if return_kv:
+        # (B, T, heads, head_dim) — the KV-cache layout. The packed (B, T,
+        # H*D) projection is head-contiguous, so this reshape is free and
+        # identical whichever attention impl serves below (prefill captures
+        # these for the generation cache without forking the forward).
+        kv_out = (k.reshape(B, T, cfg.heads, cfg.head_dim),
+                  v.reshape(B, T, cfg.heads, cfg.head_dim))
     if _use_packed_kernel(cfg, mesh, B, T):
         from deeplearning4j_tpu.ops.pallas_kernels import mha_attention_packed
         # cfg.softmax_dtype doubles as the kernel's probability dtype —
@@ -321,6 +330,8 @@ def _block(params, x, cfg: TransformerConfig, mesh: Optional[Mesh]):
     h = jax.nn.gelu(h, approximate=True)
     x = x + h @ params["mlp_out"]["kernel"].astype(h.dtype) \
         + params["mlp_out"]["bias"].astype(h.dtype)
+    if return_kv:
+        return x, kv_out[0], kv_out[1]
     return x
 
 
@@ -451,17 +462,243 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
 
 
 def _shardings(cfg: TransformerConfig, mesh: Mesh):
-    """param_pspecs as a matching pytree of NamedShardings (PartitionSpec is a
-    pytree leaf, so a plain tree.map suffices). Axes absent from the mesh
-    (e.g. a pure-DP mesh with no 'model') degrade to replication on that dim."""
-
-    def fix(spec: P) -> P:
-        return P(*(a if (a is None or a in mesh.axis_names) else None
-                   for a in spec))
-
-    return jax.tree.map(lambda s: NamedSharding(mesh, fix(s)), param_pspecs(cfg))
+    """param_pspecs as a matching pytree of NamedShardings; axes absent from
+    the mesh (e.g. a pure-DP mesh with no 'model') degrade to replication."""
+    return tree_shardings(mesh, param_pspecs(cfg))
 
 
 def place_params(params, cfg: TransformerConfig, mesh: Mesh):
     """Shard a parameter pytree onto the mesh per param_pspecs."""
     return jax.device_put(params, _shardings(cfg, mesh))
+
+
+# --------------------------------------------------------------------------
+# Autoregressive generation: slot-based KV cache + prefill + decode_step
+# --------------------------------------------------------------------------
+#
+# The generative path is built for continuous batching (ORCA OSDI'22 /
+# vLLM SOSP'23): the cache is a FIXED-SHAPE (slots, max_len) tensor per
+# layer, per-slot lengths drive the causal mask, and dead slots simply
+# compute masked garbage — so the whole serving lifetime compiles exactly
+# ONE decode executable (shape (slots,) regardless of how many slots are
+# live) plus one prefill executable per prompt-length bucket. Without a
+# cache every generated token would re-run full prefill: O(T²) work and a
+# fresh jit signature per novel length.
+#
+# Cache pytree:  {"layers": [{"k","v"}: (slots, max_len, heads, head_dim)
+#                 per layer], "lengths": (slots,) int32}
+# ``lengths[s]`` counts tokens whose K/V live in slot s. Sharded over the
+# mesh like the params: heads ride the 'model' axis (the qkv projection is
+# column-parallel, so per-shard heads are already contiguous), slots and
+# positions replicate — see kv_cache_pspecs.
+
+
+def init_kv_cache(cfg: TransformerConfig, slots: int, max_len: int,
+                  dtype: Any = None) -> Dict[str, Any]:
+    """Allocate the fixed-shape generation cache. ``dtype`` defaults to the
+    compute dtype (bf16 on TPU) — the cache is read every decode step, so
+    halving it halves decode's dominant HBM stream."""
+    if max_len > cfg.max_seq:
+        raise ValueError(
+            f"max_len {max_len} exceeds the model's positional table "
+            f"max_seq={cfg.max_seq}")
+    if slots <= 0 or max_len <= 0:
+        raise ValueError("slots and max_len must be positive")
+    dt = cfg.dtype if dtype is None else dtype
+    shape = (slots, max_len, cfg.heads, cfg.head_dim)
+    return {
+        "layers": [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+                   for _ in range(cfg.layers)],
+        "lengths": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def kv_cache_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs for the cache: heads over 'model' (matching the
+    column-parallel qkv layout), slots/positions replicated. Slots stay off
+    the 'data' axis on purpose: prefill writes ONE slot at a time via
+    dynamic_update_slice, which a slot-sharded cache would turn into an
+    all-gather per admission."""
+    kv = P(None, None, MODEL_AXIS, None)
+    return {
+        "layers": [{"k": kv, "v": kv} for _ in range(cfg.layers)],
+        "lengths": P(),
+    }
+
+
+def place_kv_cache(cache, cfg: TransformerConfig, mesh: Mesh):
+    """Shard a generation cache onto the mesh per kv_cache_pspecs."""
+    return jax.device_put(cache, tree_shardings(mesh, kv_cache_pspecs(cfg)))
+
+
+def sample_token(logits, key, temperature, top_k):
+    """On-device sampling for ONE stream: greedy (``temperature <= 0``),
+    temperature, and top-k — all shape-static so per-request knobs never
+    mint a new executable (``top_k == 0`` disables the filter; greedy is a
+    select, not a python branch). Sampling itself is the gumbel-max trick,
+    so only ``key`` (not co-scheduled neighbors) touches the draw —
+    bitwise-identical streams whether a slot decodes alone or co-batched.
+
+    The gumbel draw runs under ``threefry_partitionable``: inside the
+    sharded prefill/decode executables the logits are vocab-sharded
+    (column-parallel lm_head), and legacy threefry generates DIFFERENT
+    bits when GSPMD partitions the random op — the partitionable
+    implementation is sharding-invariant, so a stream is also bitwise
+    independent of the mesh shape serving it."""
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+    desc = jnp.sort(logits)[::-1]
+    kth = desc[jnp.clip(top_k - 1, 0, v - 1)]
+    filtered = jnp.where(
+        logits >= jnp.where(top_k > 0, kth, -jnp.inf), logits, -jnp.inf)
+    greedy = temperature <= 0.0
+    with jax.threefry_partitionable(True):
+        gumbel = jax.random.gumbel(key, (v,), jnp.float32)
+    z = jnp.where(greedy, filtered,
+                  filtered / jnp.where(greedy, 1.0, temperature) + gumbel)
+    return jnp.argmax(z).astype(jnp.int32)
+
+
+def _sample_at(logits, key, step, temperature, top_k):
+    """Per-stream sample of token index ``step``: the request's base PRNG
+    key folded with the step index, so a stream's draws depend only on
+    (key, step) — never on which slot or iteration served it."""
+    with jax.threefry_partitionable(True):
+        folded = jax.random.fold_in(key, step)
+    return sample_token(logits, folded, temperature, top_k)
+
+
+def make_prefill(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+    """Build the jitted prefill: run one PADDED prompt through the standard
+    forward (the same ``_block`` — flash/packed attention routing included),
+    write its per-layer K/V into cache slot ``slot``, and sample token 0.
+
+    ``prefill(params, cache, tokens, slot, length, key, temperature, top_k)
+    -> (cache, token0)`` with tokens (1, T_bucket) int32 and ``length`` the
+    real prompt length. One executable per T bucket; the cache is donated so
+    prefill updates in place. Prompts prefill one at a time (batch dim 1):
+    batching prompts too would square the signature ladder (T × B buckets)
+    and break per-request bitwise determinism."""
+    if not cfg.causal:
+        raise ValueError("generation needs a causal LM: set "
+                         "TransformerConfig(causal=True)")
+
+    def prefill(params, cache, tokens, slot, length, key, temperature, top_k):
+        _, T = tokens.shape
+        slot = jnp.asarray(slot, jnp.int32)
+        z = jnp.zeros((), jnp.int32)   # literal 0s would be int64 under x64
+        with jax.default_matmul_precision("default"):
+            x = params["tok_emb"][tokens].astype(cfg.dtype) \
+                + params["pos_emb"][:T][None].astype(cfg.dtype)
+            layers = []
+            for bp, lc in zip(params["blocks"], cache["layers"]):
+                x, k, v = _block(bp, x, cfg, mesh, return_kv=True)
+                layers.append({
+                    "k": lax.dynamic_update_slice(
+                        lc["k"], k.astype(lc["k"].dtype), (slot, z, z, z)),
+                    "v": lax.dynamic_update_slice(
+                        lc["v"], v.astype(lc["v"].dtype), (slot, z, z, z)),
+                })
+            x = _layernorm(x, params["ln_f"])
+            last = lax.dynamic_index_in_dim(x[0], length - 1, axis=0,
+                                            keepdims=False)
+            logits = (last @ params["lm_head"].astype(last.dtype)
+                      ).astype(jnp.float32)
+        token0 = _sample_at(logits, key, 0, temperature, top_k)
+        new_cache = {"layers": layers,
+                     "lengths": cache["lengths"].at[slot].set(length)}
+        return new_cache, token0
+
+    if mesh is None:
+        return jax.jit(prefill, donate_argnums=(1,))
+    param_sh = _shardings(cfg, mesh)
+    cache_sh = tree_shardings(mesh, kv_cache_pspecs(cfg))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        prefill, donate_argnums=(1,),
+        in_shardings=(param_sh, cache_sh, repl, repl, repl, repl, repl, repl),
+        out_shardings=(cache_sh, repl))
+
+
+def make_decode_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+    """Build THE decode executable: one token for every slot, live or dead.
+
+    ``decode_step(params, cache, tokens, live, keys, steps, temperatures,
+    top_ks) -> (cache, next_tokens)`` where every argument after ``cache``
+    is a (slots,)-leading array — tokens int32 (last sampled token per
+    slot), live bool (dead slots compute masked garbage and keep their
+    lengths), keys (slots, 2) uint32 per-request base PRNG keys, steps
+    int32 (index of the token being sampled). Shape is (slots,) no matter
+    how many slots are occupied, so this compiles EXACTLY ONCE per engine
+    lifetime; the cache is donated, so decode is a true in-place update.
+
+    Per-slot math is row-wise (layernorm, GEMMs, masked attention over the
+    slot's own cache rows, gumbel-max under the slot's own folded key), so
+    a stream's tokens are bitwise-independent of its co-tenants — the
+    property continuous batching needs to be transparent to callers."""
+    if not cfg.causal:
+        raise ValueError("generation needs a causal LM: set "
+                         "TransformerConfig(causal=True)")
+
+    def decode_block(bp, x, lc, pos):
+        # x: (S, hidden); lc["k"]/["v"]: (S, L, heads, D); pos: (S,) write
+        # position (== current length, clamped). New K/V land at pos, the
+        # query attends positions 0..pos inclusive — per-slot causal mask.
+        S, H = x.shape
+        L = lc["k"].shape[1]
+        h = _layernorm(x, bp["ln1"])
+        qkv = h @ bp["qkv"]["kernel"].astype(h.dtype) \
+            + bp["qkv"]["bias"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(S, cfg.heads, cfg.head_dim)
+        rows = jnp.arange(S)
+        ck = lc["k"].at[rows, pos].set(
+            k.reshape(S, cfg.heads, cfg.head_dim).astype(lc["k"].dtype))
+        cv = lc["v"].at[rows, pos].set(
+            v.reshape(S, cfg.heads, cfg.head_dim).astype(lc["v"].dtype))
+        scale = 1.0 / np.sqrt(cfg.head_dim)
+        s = jnp.einsum("shd,slhd->shl", q, ck.astype(q.dtype)) * scale
+        mask = jnp.arange(L)[None, :] <= pos[:, None]          # (S, L)
+        s = jnp.where(mask[:, None, :], s, jnp.finfo(s.dtype).min)
+        p = jax.nn.softmax(s.astype(cfg.softmax_dtype), axis=-1).astype(q.dtype)
+        o = jnp.einsum("shl,slhd->shd", p, cv.astype(p.dtype)).reshape(S, H)
+        x = x + o @ bp["attn_out"]["kernel"].astype(o.dtype) \
+            + bp["attn_out"]["bias"].astype(o.dtype)
+        h = _layernorm(x, bp["ln2"])
+        h = h @ bp["mlp_in"]["kernel"].astype(h.dtype) \
+            + bp["mlp_in"]["bias"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        x = x + h @ bp["mlp_out"]["kernel"].astype(h.dtype) \
+            + bp["mlp_out"]["bias"].astype(h.dtype)
+        return x, {"k": ck, "v": cv}
+
+    def decode_step(params, cache, tokens, live, keys, steps,
+                    temperatures, top_ks):
+        lengths = cache["lengths"]
+        max_len = cache["layers"][0]["k"].shape[1]
+        pos = jnp.clip(lengths, 0, max_len - 1)
+        with jax.default_matmul_precision("default"):
+            x = params["tok_emb"][tokens].astype(cfg.dtype) \
+                + params["pos_emb"][pos].astype(cfg.dtype)
+            layers = []
+            for bp, lc in zip(params["blocks"], cache["layers"]):
+                x, lc = decode_block(bp, x, lc, pos)
+                layers.append(lc)
+            x = _layernorm(x, params["ln_f"])
+            logits = (x @ params["lm_head"].astype(x.dtype)
+                      ).astype(jnp.float32)
+        next_tokens = jax.vmap(_sample_at)(logits, keys, steps,
+                                           temperatures, top_ks)
+        new_cache = {"layers": layers,
+                     "lengths": jnp.where(live, lengths + 1, lengths)}
+        return new_cache, next_tokens
+
+    if mesh is None:
+        return jax.jit(decode_step, donate_argnums=(1,))
+    param_sh = _shardings(cfg, mesh)
+    cache_sh = tree_shardings(mesh, kv_cache_pspecs(cfg))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        decode_step, donate_argnums=(1,),
+        in_shardings=(param_sh, cache_sh) + (repl,) * 6,
+        out_shardings=(cache_sh, repl))

@@ -61,3 +61,19 @@ def shard_batch(mesh: Mesh, tree, axis: str = DATA_AXIS):
 
 def replicate(mesh: Mesh, tree):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, replicated(mesh)), tree)
+
+
+def tree_shardings(mesh: Mesh, pspec_tree):
+    """Convert a pytree of PartitionSpecs into a matching pytree of
+    NamedShardings (PartitionSpec is a pytree leaf, so a plain tree.map
+    suffices). Axes named in a spec but absent from the mesh (e.g. a
+    pure-DP mesh with no 'model') degrade to replication on that dim —
+    the shared sharding-normalization idiom for params (models/bert.py)
+    and serving KV caches."""
+
+    def fix(spec: P) -> P:
+        return P(*(a if (a is None or a in mesh.axis_names) else None
+                   for a in spec))
+
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, fix(s)), pspec_tree)

@@ -1,22 +1,28 @@
 """TPU-native serving runtime: dynamic micro-batching inference engine,
-versioned model registry, admission control, and serving metrics
-(ref: deeplearning4j-parallel-wrapper ParallelInference BATCHED mode,
-rebuilt around XLA's compile-once/dispatch-many execution model —
-see serving/engine.py for the design notes)."""
+versioned model registry, admission control, serving metrics, and the
+continuous-batching autoregressive generation engine (ORCA-style
+iteration-level scheduling over the slot-based KV cache in models/bert —
+ref: deeplearning4j-parallel-wrapper ParallelInference BATCHED mode,
+rebuilt around XLA's compile-once/dispatch-many execution model — see
+serving/engine.py and serving/generation.py for the design notes)."""
 from deeplearning4j_tpu.serving.admission import (  # noqa: F401
     AdmissionController, DeadlineExceededError, QueueFullError, RejectedError,
 )
 from deeplearning4j_tpu.serving.engine import InferenceEngine, bucket_ladder  # noqa: F401
+from deeplearning4j_tpu.serving.generation import (  # noqa: F401
+    GenerationEngine, GenerationHandle, prefill_buckets,
+)
 from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, ServingMetrics,
 )
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
-    Deployment, ModelAdapter, ModelRegistry, as_adapter,
+    CausalLMAdapter, Deployment, ModelAdapter, ModelRegistry, as_adapter,
 )
 
 __all__ = [
     "AdmissionController", "DeadlineExceededError", "QueueFullError",
     "RejectedError", "InferenceEngine", "bucket_ladder", "Counter", "Gauge",
     "Histogram", "ServingMetrics", "Deployment", "ModelAdapter",
-    "ModelRegistry", "as_adapter",
+    "ModelRegistry", "as_adapter", "GenerationEngine", "GenerationHandle",
+    "prefill_buckets", "CausalLMAdapter",
 ]

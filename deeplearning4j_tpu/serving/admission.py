@@ -164,6 +164,29 @@ class AdmissionController:
             if decided:
                 return out
 
+    def expire_queued(self) -> int:
+        """Proactively shed every expired request still queued, returning
+        the number shed. The batching dispatcher sheds lazily (expired
+        heads drop at ``take()``), which is fine when dequeue is frequent —
+        but a slot-bound scheduler (continuous-batching decode) only calls
+        ``take()`` when a cache slot is FREE, so under full occupancy a
+        dead prompt would sit in the queue holding capacity_rows budget and
+        masking the queue-full backpressure signal. The generation loop
+        calls this once per iteration; futures fail outside the lock for
+        the same retry-on-shed reentrancy reason as ``take()``."""
+        now = time.perf_counter()
+        shed = []
+        with self._cv:
+            if any(r.expired(now) for r in self._q):
+                keep: deque = deque()
+                for req in self._q:
+                    (shed if req.expired(now) else keep).append(req)
+                self._q = keep
+                self._rows = sum(r.rows for r in keep)
+        for req in shed:
+            self._shed(req)
+        return len(shed)
+
     def close(self):
         with self._cv:
             self._closed = True

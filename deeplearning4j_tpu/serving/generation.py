@@ -1,0 +1,498 @@
+"""Continuous-batching generation engine: ORCA-style iteration-level
+scheduling over the slot-based KV cache in ``models/bert.py``.
+
+The request-level batching in ``serving/engine.py`` is wrong for
+autoregressive decode: batching whole GENERATIONS means a 4-token reply
+waits for the 400-token reply it was co-batched with (head-of-line
+blocking), and every (prompt len, output len) pair is a fresh jit
+signature. ORCA (Yu et al., OSDI '22) moves the scheduling decision to
+the ITERATION: every loop turn the scheduler (1) admits queued prompts
+into free cache slots (prefill, padded to a prompt-length bucket ladder),
+(2) runs ONE ``decode_step`` for all occupied slots, (3) streams each new
+token to its caller, and (4) retires EOS/max-token slots immediately so
+their slots are free for the next admission — a short request enters and
+leaves mid-flight of a long one. vLLM (Kwon et al., SOSP '23) showed the
+cache layout is the other half of the lever; here the fixed (slots,
+max_len) layout is chosen so XLA compiles exactly ONE decode executable
+plus one prefill per bucket for the engine's whole lifetime.
+
+Determinism: sampling is gumbel-max under a per-request PRNG key folded
+with the token index, and every per-slot computation is row-wise — so a
+stream is bitwise-identical whether it decodes alone or co-scheduled with
+arbitrary neighbors (asserted by the tier-1 determinism test).
+
+Admission control reuses :class:`AdmissionController` with slot-unit
+accounting: one queued request will occupy one cache slot, so the queue
+is bounded in REQUESTS (``rows=1`` each) and deadline shedding drops
+prompts that waited too long before ever touching a slot.
+"""
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.profiler import OpProfiler
+from deeplearning4j_tpu.serving.admission import (
+    AdmissionController, QueueFullError, RejectedError, Request,
+)
+from deeplearning4j_tpu.serving.engine import bucket_ladder
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+_DONE = object()
+_UNSET = object()   # submit()'s "use the engine default" eos sentinel
+
+
+def prefill_buckets(max_len: int, min_bucket: int = 8) -> Tuple[int, ...]:
+    """Prompt-length bucket ladder: geometric like the batch ladder, but
+    CLAMPED to ``max_len`` (a prefill longer than the cache cannot be
+    written), so the top rung may be a non-power-of-two."""
+    return tuple(sorted({min(b, max_len)
+                         for b in bucket_ladder(max_len,
+                                                min_bucket=min(min_bucket,
+                                                               max_len))}))
+
+
+@dataclasses.dataclass
+class GenerationRequest:
+    """One queued generation (rides ``Request.x`` through admission)."""
+
+    prompt: np.ndarray              # (n,) int32
+    max_new_tokens: int
+    temperature: float
+    top_k: int
+    eos_id: Optional[int]
+    key: np.ndarray                 # (2,) uint32 base PRNG key
+    handle: "GenerationHandle" = None
+
+
+class GenerationHandle:
+    """Per-request streaming surface. ``result()`` blocks for the full
+    token list; ``stream()`` yields tokens as the scheduler emits them
+    (single consumer); ``future`` is the underlying admission future, so
+    shedding/shutdown surface as :class:`RejectedError` here too."""
+
+    def __init__(self, request: Request, prompt_len: int,
+                 on_token: Optional[Callable[[int], None]] = None):
+        self._req = request
+        self.prompt_len = prompt_len
+        self.finish_reason: Optional[str] = None   # 'eos' | 'max_tokens'
+        self._tokens: List[int] = []
+        self._lock = threading.Lock()
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._on_token = on_token
+        # tokens are pushed before the future resolves, so _DONE always
+        # trails the last token (and any exception) in the stream queue
+        request.future.add_done_callback(lambda _f: self._q.put(_DONE))
+
+    @property
+    def future(self) -> Future:
+        return self._req.future
+
+    def tokens_so_far(self) -> List[int]:
+        with self._lock:
+            return list(self._tokens)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Generated token ids (prompt excluded; EOS included when hit)."""
+        return self._req.future.result(timeout)
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield tokens as they are generated; raises the request's error
+        (shed, shutdown, model failure) at the point it occurred."""
+        while True:
+            item = self._q.get(timeout=timeout)
+            if item is _DONE:
+                exc = self._req.future.exception()
+                if exc is not None:
+                    raise exc
+                return
+            yield item
+
+    # ------------------------------------------------- scheduler-side hooks
+    def _push(self, token: int):
+        with self._lock:
+            self._tokens.append(token)
+        self._q.put(token)
+        if self._on_token is not None:
+            try:
+                self._on_token(token)
+            except BaseException as e:
+                # a broken consumer callback fails ITS OWN stream only —
+                # it must not reach the scheduler loop, where it would be
+                # treated as a device failure (co-tenants failed, cache
+                # rebuilt)
+                self._fail(e)
+
+    def _finish(self, reason: str) -> bool:
+        self.finish_reason = reason
+        try:
+            self._req.future.set_result(self.tokens_so_far())
+            return True
+        except InvalidStateError:
+            return False   # caller cancelled while queued/running
+
+    def _fail(self, exc: BaseException):
+        try:
+            self._req.future.set_exception(exc)
+        except InvalidStateError:
+            pass
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Scheduler-side state of one occupied cache slot."""
+
+    greq: GenerationRequest
+    request: Request
+    n_generated: int = 0
+    last_token: int = 0
+
+
+class GenerationEngine:
+    """Iteration-level scheduler over one causal LM and one KV cache.
+
+    ``submit(prompt)`` returns a :class:`GenerationHandle`; a background
+    scheduler thread runs the admit → decode → stream → retire loop.
+    ``slots`` bounds concurrent generations, ``max_len`` is the per-slot
+    cache capacity (prompt + generated tokens must fit), and the compiled
+    footprint over the engine's lifetime is ``len(self.buckets)`` prefill
+    executables + ONE decode executable, asserted by
+    :meth:`compiled_signatures`.
+    """
+
+    def __init__(self, params, cfg, *, mesh=None, slots: int = 8,
+                 max_len: Optional[int] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 cache_dtype: Any = None,
+                 queue_capacity: int = 64,
+                 default_timeout_ms: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 profiler: Optional[OpProfiler] = None,
+                 name: str = "generation"):
+        from deeplearning4j_tpu.models.bert import (
+            init_kv_cache, make_decode_step, make_prefill, place_kv_cache,
+            place_params)
+
+        if not cfg.causal:
+            raise ValueError(
+                "GenerationEngine needs a causal LM: TransformerConfig("
+                "causal=True) — a bidirectional encoder has no decode order")
+        if slots <= 0:
+            raise ValueError("slots must be positive")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.slots = slots
+        self.max_len = max_len if max_len is not None else cfg.max_seq
+        self.buckets = (tuple(sorted(set(int(b) for b in buckets)))
+                        if buckets else prefill_buckets(self.max_len))
+        if self.buckets[-1] > self.max_len:
+            raise ValueError(f"prefill buckets {self.buckets} exceed "
+                             f"max_len {self.max_len}")
+        self.eos_id = eos_id
+        self.name = name
+        self.metrics = metrics or ServingMetrics()
+        self.profiler = profiler or OpProfiler.getInstance()
+        if mesh is not None:
+            params = place_params(params, cfg, mesh)
+        self.params = params
+        self._prefill = make_prefill(cfg, mesh)
+        self._decode = make_decode_step(cfg, mesh)
+        self._cache_dtype = cache_dtype
+        self._place_kv_cache = place_kv_cache
+        self._init_kv_cache = init_kv_cache
+        self._reset_cache()
+        # slot-unit admission: one request == one future slot (rows=1)
+        self._admission = AdmissionController(
+            capacity_rows=queue_capacity,
+            default_timeout_ms=default_timeout_ms)
+        self._admission.on_shed = self._count_shed
+        self._slots: List[Optional[_Slot]] = [None] * slots
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"generation-scheduler[{self.name}]",
+            daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def __enter__(self) -> "GenerationEngine":
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def shutdown(self, wait: bool = True):
+        """Idempotent: stop the scheduler; queued AND in-flight requests
+        are rejected ('shutdown') — partial streams surface what they have
+        via :meth:`GenerationHandle.tokens_so_far`."""
+        self._stop.set()
+        self._admission.close()
+        if wait and self._thread.is_alive():
+            self._thread.join(timeout=30.0)
+
+    # --------------------------------------------------------------- submit
+    def submit(self, prompt, *, max_new_tokens: int = 16,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_id: Any = _UNSET, seed: int = 0,
+               timeout_ms: Optional[float] = None,
+               on_token: Optional[Callable[[int], None]] = None
+               ) -> GenerationHandle:
+        """Queue one prompt. Greedy by default; ``temperature`` > 0 samples,
+        ``top_k`` > 0 restricts sampling to the k highest-probability
+        tokens, ``seed`` fixes the stream's
+        PRNG key (a fixed seed gives a bitwise-reproducible stream
+        regardless of co-scheduling). ``eos_id`` defaults to the engine's;
+        pass ``eos_id=None`` to disable EOS retirement for this request.
+        ``timeout_ms`` bounds QUEUE time: prompts shed on deadline never
+        occupy a slot."""
+        toks = np.ascontiguousarray(np.asarray(prompt, np.int32).ravel())
+        if toks.size == 0:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        if toks.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({toks.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the cache capacity max_len={self.max_len}")
+        if toks.size > self.buckets[-1]:
+            raise ValueError(
+                f"prompt ({toks.size}) exceeds the top prefill bucket "
+                f"{self.buckets[-1]} — extend `buckets` up to max_len")
+        greq = GenerationRequest(
+            prompt=toks, max_new_tokens=max_new_tokens,
+            temperature=float(temperature), top_k=int(top_k),
+            eos_id=self.eos_id if eos_id is _UNSET else eos_id,
+            key=np.asarray(jax.random.PRNGKey(seed)))
+        req = Request(x=greq, rows=1)
+        greq.handle = GenerationHandle(req, toks.size, on_token=on_token)
+        self.metrics.requests_total.inc()
+        try:
+            self._admission.admit(req, timeout_ms=timeout_ms)
+        except QueueFullError:
+            self.metrics.rejected_total.inc()
+            self.metrics.rejected_queue_full.inc()
+            raise
+        except RejectedError:
+            self.metrics.rejected_total.inc()
+            raise
+        self.metrics.queue_depth.set(self._admission.depth_requests)
+        return greq.handle
+
+    def generate(self, prompt, timeout: Optional[float] = None,
+                 **kwargs) -> List[int]:
+        """Blocking submit: the full generated-token list."""
+        return self.submit(prompt, **kwargs).result(timeout=timeout)
+
+    # ------------------------------------------------------------ scheduler
+    def _live_count(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    def _reset_cache(self):
+        """(Re)allocate the KV cache. Called at construction AND after any
+        prefill/decode failure: both jitted calls DONATE the cache, so an
+        exception raised after dispatch leaves ``self._cache`` bound to
+        deleted buffers — without a rebuild every later call would die with
+        'Array has been deleted' while submit() kept accepting work."""
+        cache = self._init_kv_cache(self.cfg, self.slots, self.max_len,
+                                    dtype=self._cache_dtype)
+        self._cache = self._place_kv_cache(cache, self.cfg, self.mesh) \
+            if self.mesh is not None else cache
+
+    def _loop(self):
+        try:
+            while not self._stop.is_set():
+                self._admit()
+                if self._live_count():
+                    try:
+                        self._decode_iteration()
+                    except BaseException as e:   # fail tenants, keep thread
+                        self._fail_live(e)
+                        self._reset_cache()
+        finally:
+            # queued requests are failed by _admission.close() itself
+            self._fail_live(RejectedError(
+                "engine shut down mid-generation", "shutdown"))
+
+    def _admit(self):
+        """Fill free slots from the queue. Blocks briefly only when the
+        engine is fully idle; with live tenants admission is opportunistic
+        so decode cadence never stalls on an empty queue. Expired prompts
+        are shed even under FULL occupancy (no free slot -> no ``take()``
+        -> lazy head-shedding alone would let dead prompts hold queue
+        budget and mask the queue-full backpressure signal)."""
+        self._admission.expire_queued()
+        for i in range(self.slots):
+            if self._stop.is_set():
+                return
+            if self._slots[i] is not None:
+                continue
+            block = self._live_count() == 0
+            req = self._admission.take(1, timeout=0.05 if block else 0.0)
+            self.metrics.queue_depth.set(self._admission.depth_requests)
+            if req is None:
+                if block:
+                    return   # idle and nothing queued: back to the loop
+                continue
+            if not req.future.set_running_or_notify_cancel():
+                continue     # caller cancelled while queued
+            try:
+                self._prefill_into(i, req)
+            except BaseException as e:
+                req.x.handle._fail(e)
+                self.metrics.failed_total.inc()
+                # the failed call may have consumed the donated cache, and
+                # with it every live tenant's K/V — fail them and rebuild
+                self._fail_live(e)
+                self._reset_cache()
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _prefill_into(self, slot: int, req: Request):
+        greq: GenerationRequest = req.x
+        n = int(greq.prompt.size)
+        bucket = self._bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = greq.prompt
+        t0 = time.perf_counter()
+        with self.profiler.span("serving.prefill", engine=self.name,
+                                slot=slot, bucket=bucket, prompt=n):
+            self._cache, tok = self._prefill(
+                self.params, self._cache, padded, np.int32(slot),
+                np.int32(n), greq.key, np.float32(greq.temperature),
+                np.int32(greq.top_k))
+            tok = int(np.asarray(tok))
+        now = time.perf_counter()
+        self.metrics.prefill_ms.observe((now - t0) * 1e3)
+        self.metrics.ttft_ms.observe((now - req.submit_t) * 1e3)
+        self.metrics.prefills_total.inc()
+        self.metrics.generated_tokens_total.inc()
+        state = _Slot(greq=greq, request=req, n_generated=1, last_token=tok)
+        greq.handle._push(tok)
+        if not self._maybe_retire(state, tok):
+            self._slots[slot] = state
+
+    def _decode_iteration(self):
+        """One scheduler turn: a single fixed-shape decode_step over ALL
+        slots, then stream/retire per live slot."""
+        S = self.slots
+        tokens = np.zeros(S, np.int32)
+        live = np.zeros(S, bool)
+        keys = np.zeros((S, 2), np.uint32)
+        steps = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        top_ks = np.zeros(S, np.int32)
+        n_live = 0
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            n_live += 1
+            tokens[i] = st.last_token
+            live[i] = True
+            keys[i] = st.greq.key
+            steps[i] = st.n_generated
+            temps[i] = st.greq.temperature
+            top_ks[i] = st.greq.top_k
+        self.metrics.slot_occupancy.set(n_live / S)
+        t0 = time.perf_counter()
+        with self.profiler.span("serving.decode_step", engine=self.name,
+                                live=n_live, slots=S):
+            self._cache, toks = self._decode(
+                self.params, self._cache, tokens, live, keys, steps,
+                temps, top_ks)
+            toks = np.asarray(toks)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.decode_step_ms.observe(dt_ms)
+        self.metrics.decode_wall_ms.inc(dt_ms)
+        self.metrics.decode_steps_total.inc()
+        self.metrics.generated_tokens_total.inc(n_live)
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            tok = int(toks[i])
+            st.n_generated += 1
+            st.last_token = tok
+            st.greq.handle._push(tok)
+            if self._maybe_retire(st, tok):
+                self._slots[i] = None   # freed for the NEXT admission turn
+        # re-read after retirement so an engine that drains to idle shows
+        # its true occupancy instead of the pre-retire value forever
+        self.metrics.slot_occupancy.set(self._live_count() / S)
+
+    def _maybe_retire(self, st: _Slot, tok: int) -> bool:
+        """Retire a finished stream immediately — EOS or the token budget —
+        so a long co-tenant never holds its slot hostage."""
+        if st.greq.eos_id is not None and tok == st.greq.eos_id:
+            reason = "eos"
+        elif st.n_generated >= st.greq.max_new_tokens:
+            reason = "max_tokens"
+        else:
+            return False
+        st.greq.handle._finish(reason)
+        self.metrics.generations_completed.inc()
+        self.metrics.latency_ms.observe(
+            (time.perf_counter() - st.request.submit_t) * 1e3)
+        return True
+
+    def _fail_live(self, exc: BaseException):
+        for i, st in enumerate(self._slots):
+            if st is not None:
+                st.greq.handle._fail(exc)
+                self._slots[i] = None
+
+    def _count_shed(self, req):
+        self.metrics.rejected_total.inc()
+        self.metrics.rejected_deadline.inc()
+
+    # -------------------------------------------------------------- insight
+    def compiled_signatures(self) -> int:
+        """Live compiled-executable count across the whole generation path:
+        bounded by ``len(self.buckets) + 1`` (prefill ladder + the single
+        decode step) for the engine's lifetime."""
+        from deeplearning4j_tpu.serving.registry import _jit_cache_size
+
+        return (_jit_cache_size(self._prefill) or 0) + \
+            (_jit_cache_size(self._decode) or 0)
+
+    @property
+    def queue_depth(self) -> int:
+        return self._admission.depth_requests
+
+    @property
+    def live_slots(self) -> int:
+        return self._live_count()
+
+    def warmup(self) -> "GenerationEngine":
+        """Compile every prefill bucket + the decode executable up front by
+        generating one short throwaway stream per bucket (token id 0
+        prompts) — after warmup, live traffic never pays XLA compilation
+        inline. Each rung is probed with the SHORTEST prompt that maps to
+        it, so even a top rung that only admits near-max_len prompts (no
+        room for 2 generated tokens) still compiles, via a 1-token
+        stream."""
+        prev = 0
+        for b in self.buckets:
+            n, prev = prev + 1, b
+            new = min(2, self.max_len - n)
+            if new < 1:
+                continue   # rung admits no prompt at all (n == max_len)
+            # eos_id=None: an engine-level eos_id matching the warmup
+            # continuation would retire every stream at prefill and leave
+            # the decode executable uncompiled
+            self.generate(np.zeros(n, np.int32), max_new_tokens=new,
+                          eos_id=None, timeout=300.0)
+        return self
+
+
+__all__ = ["GenerationEngine", "GenerationHandle", "GenerationRequest",
+           "prefill_buckets"]

@@ -144,6 +144,16 @@ class ServingMetrics:
             "requests_per_batch", boundaries=(1, 2, 4, 8, 16, 32, 64))
         self.fill_ratio = Histogram(                          # rows / bucket
             "fill_ratio", boundaries=(0.125, 0.25, 0.5, 0.75, 0.875, 1.0))
+        # ---- generation (continuous-batching decode) signals -------------
+        self.prefills_total = Counter("prefills_total")
+        self.decode_steps_total = Counter("decode_steps_total")
+        self.generated_tokens_total = Counter("generated_tokens_total")
+        self.generations_completed = Counter("generations_completed")
+        self.decode_wall_ms = Counter("decode_wall_ms")   # summed step time
+        self.slot_occupancy = Gauge("slot_occupancy")     # live/total slots
+        self.ttft_ms = Histogram("ttft_ms")               # submit->token 0
+        self.prefill_ms = Histogram("prefill_ms")
+        self.decode_step_ms = Histogram("decode_step_ms")
         self._per_bucket: Dict[int, Dict[str, int]] = {}
         self._lock = threading.Lock()
         self._t0 = time.time()
@@ -164,7 +174,18 @@ class ServingMetrics:
             self.requests_total, self.rows_total, self.batches_total,
             self.padded_rows_total, self.rejected_total,
             self.rejected_queue_full, self.rejected_deadline,
-            self.failed_total, self.bucket_hits, self.bucket_compiles)}
+            self.failed_total, self.bucket_hits, self.bucket_compiles,
+            self.prefills_total, self.decode_steps_total,
+            self.generated_tokens_total, self.generations_completed,
+            self.decode_wall_ms)}
+
+    def decode_tokens_per_sec(self) -> float:
+        """Steady-state decode throughput: tokens sampled by decode_step
+        over summed decode wall time (prefill and queueing excluded — this
+        is the iteration-level scheduler's sustained rate)."""
+        wall_s = self.decode_wall_ms.value / 1e3
+        return (self.generated_tokens_total.value - self.prefills_total.value
+                ) / wall_s if wall_s > 0 else 0.0
 
     def bucket_cache_hit_rate(self) -> float:
         h, c = self.bucket_hits.value, self.bucket_compiles.value
@@ -189,6 +210,11 @@ class ServingMetrics:
             "qps": self.qps(),
             "bucket_cache_hit_rate": self.bucket_cache_hit_rate(),
             "mean_requests_per_batch": self.mean_requests_per_batch(),
+            "slot_occupancy": self.slot_occupancy.value,
+            "decode_tokens_per_sec": self.decode_tokens_per_sec(),
+            "ttft_ms": self.ttft_ms.to_dict(),
+            "prefill_ms": self.prefill_ms.to_dict(),
+            "decode_step_ms": self.decode_step_ms.to_dict(),
             "latency_ms": self.latency_ms.to_dict(),
             "dispatch_ms": self.dispatch_ms.to_dict(),
             "queue_wait_ms": self.queue_wait_ms.to_dict(),

@@ -123,6 +123,47 @@ class SameDiffAdapter(ModelAdapter):
         return _jit_cache_size(fn) if fn is not None else 0
 
 
+class CausalLMAdapter(ModelAdapter):
+    """Generative surface for the flagship causal LM (models/bert.py):
+    ``model`` is the parameter pytree, plus the TransformerConfig. Serves
+    BOTH engine kinds — ``infer`` gives last-position logits for the
+    batching :class:`InferenceEngine`, :meth:`generation_engine` spins up
+    the continuous-batching decode scheduler."""
+
+    kind = "CausalLM"
+
+    def __init__(self, params, cfg, mesh=None):
+        super().__init__(model=params)
+        if not cfg.causal:
+            raise ValueError("CausalLMAdapter needs TransformerConfig("
+                             "causal=True)")
+        self.params = params
+        self.cfg = cfg
+        self.mesh = mesh
+        self._fwd = None
+
+    def infer(self, x) -> np.ndarray:
+        """Token ids (B, T) -> last-position logits (B, vocab)."""
+        if self._fwd is None:
+            import jax
+
+            from deeplearning4j_tpu.models.bert import forward
+
+            self._fwd = jax.jit(
+                lambda p, t: forward(p, t, self.cfg, self.mesh)[:, -1, :])
+        return np.asarray(self._fwd(self.params,
+                                    np.asarray(x, dtype=np.int32)))
+
+    def cache_size(self) -> Optional[int]:
+        return _jit_cache_size(self._fwd) if self._fwd is not None else 0
+
+    def generation_engine(self, **engine_kwargs):
+        from deeplearning4j_tpu.serving.generation import GenerationEngine
+
+        engine_kwargs.setdefault("mesh", self.mesh)
+        return GenerationEngine(self.params, self.cfg, **engine_kwargs)
+
+
 def as_adapter(model, input_name: Optional[str] = None,
                output_name: Optional[str] = None,
                output_index: int = 0) -> ModelAdapter:
@@ -175,6 +216,35 @@ class ModelRegistry:
         self._models: Dict[str, Dict[int, Deployment]] = {}
         self._aliases: Dict[str, str] = {}
         self._lock = threading.RLock()
+        self._engines: List[object] = []   # engines spun up via engine()
+        self._closed = False
+
+    # --------------------------------------------------------------- teardown
+    def __enter__(self) -> "ModelRegistry":
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def shutdown(self, wait: bool = True):
+        """Idempotent teardown mirroring ``InferenceEngine.shutdown``: stop
+        every engine this registry spun up (their dispatcher/scheduler
+        threads otherwise outlive tests and serving shells) and refuse new
+        engine construction. Deployments stay readable — a registry can be
+        shut down and inspected."""
+        with self._lock:
+            self._closed = True
+            engines, self._engines = self._engines, []
+        for eng in engines:
+            eng.shutdown(wait=wait)
+
+    def _track(self, eng):
+        with self._lock:
+            if self._closed:
+                eng.shutdown(wait=False)
+                raise RuntimeError("registry is shut down")
+            self._engines.append(eng)
+        return eng
 
     # ------------------------------------------------------------- lifecycle
     def deploy(self, name: str, model, *, version: Optional[int] = None,
@@ -307,6 +377,27 @@ class ModelRegistry:
         engine_kwargs.setdefault("max_batch_size", dep.buckets[-1])
         engine_kwargs.setdefault("name", dep.ref)
         eng = InferenceEngine(dep.adapter, **engine_kwargs)
-        if dep.warmup_example is not None:
-            eng.warmup(dep.warmup_example)
-        return eng
+        try:
+            if dep.warmup_example is not None:
+                eng.warmup(dep.warmup_example)
+            return self._track(eng)
+        except BaseException:
+            eng.shutdown(wait=False)
+            raise
+
+    def generation_engine(self, ref: str, **engine_kwargs):
+        """Spin up a continuous-batching :class:`GenerationEngine` over a
+        deployed generative model (a :class:`CausalLMAdapter` deployment).
+        Tracked for :meth:`shutdown` like batch engines."""
+        dep = self.get(ref)
+        if not hasattr(dep.adapter, "generation_engine"):
+            raise TypeError(
+                f"{dep.ref} ({dep.adapter.kind}) is not generative: deploy a "
+                "CausalLMAdapter to serve autoregressive decode")
+        engine_kwargs.setdefault("name", dep.ref)
+        eng = dep.adapter.generation_engine(**engine_kwargs)
+        try:
+            return self._track(eng)
+        except BaseException:
+            eng.shutdown(wait=False)
+            raise

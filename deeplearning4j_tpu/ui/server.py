@@ -449,16 +449,32 @@ class _Handler(BaseHTTPRequestHandler):
         if parts == ["api", "serving"]:
             # serving-engine metric snapshots (typeId ServingMetrics —
             # published by serving.metrics.ServingMetrics.publish through
-            # the same storage SPI as training stats)
+            # the same storage SPI as training stats). Generation engines
+            # publish through the same snapshot; their headline decode
+            # signals are lifted into a "generation" roll-up so dashboards
+            # need not dig through the full snapshot.
             out = []
             for st in self._storages():
                 for sid in st.listSessionIDs():
                     for worker in st.listWorkerIDsForSession(sid) or []:
                         ups = st.getUpdates(sid, "ServingMetrics", worker)
-                        if ups:
-                            out.append({"sessionId": sid, "workerId": worker,
-                                        "reports": len(ups),
-                                        "latest": ups[-1]})
+                        if not ups:
+                            continue
+                        entry = {"sessionId": sid, "workerId": worker,
+                                 "reports": len(ups), "latest": ups[-1]}
+                        latest = ups[-1]
+                        # gate on prefills (not decode steps): an engine
+                        # serving max_new_tokens=1 retires every stream at
+                        # prefill and never runs a decode iteration
+                        if isinstance(latest, dict) \
+                                and latest.get("prefills_total"):
+                            entry["generation"] = {
+                                k: latest.get(k) for k in (
+                                    "decode_tokens_per_sec", "slot_occupancy",
+                                    "generated_tokens_total",
+                                    "generations_completed", "ttft_ms",
+                                    "prefill_ms", "decode_step_ms")}
+                        out.append(entry)
             self._json(out)
             return
         if len(parts) == 4 and parts[:2] == ["api", "updates"]:

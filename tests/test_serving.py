@@ -44,6 +44,32 @@ class TestBucketLadder:
         with pytest.raises(ValueError):
             bucket_ladder(0)
 
+    def test_min_bucket_exceeds_max_batch_size(self):
+        """A floor above the batch cap still yields a valid single-rung
+        ladder (the rung covers max_batch_size by construction)."""
+        assert bucket_ladder(4, min_bucket=16) == (16,)
+        assert bucket_ladder(4, min_bucket=9) == (9,)
+        ladder = bucket_ladder(4, min_bucket=16, multiple_of=8)
+        assert ladder == (16,) and ladder[-1] >= 4
+
+    def test_non_power_of_two_multiple_of(self):
+        """Every rung is a multiple_of-multiple even when multiple_of is
+        not a power of two (a 3- or 6-way mesh data axis)."""
+        for mult in (3, 6, 12):
+            ladder = bucket_ladder(32, multiple_of=mult)
+            assert all(b % mult == 0 for b in ladder), (mult, ladder)
+            assert ladder[-1] >= 32
+            assert all(b2 == 2 * b1 for b1, b2 in zip(ladder, ladder[1:]))
+        assert bucket_ladder(32, multiple_of=3) == (3, 6, 12, 24, 48)
+        # min_bucket rounds UP to the next multiple, never down
+        assert bucket_ladder(32, multiple_of=6, min_bucket=8)[0] == 12
+
+    def test_single_bucket_ladders(self):
+        assert bucket_ladder(1) == (1,)
+        assert bucket_ladder(8, min_bucket=8) == (8,)
+        assert bucket_ladder(7, multiple_of=7) == (7,)
+        assert bucket_ladder(64, min_bucket=64, multiple_of=64) == (64,)
+
 
 class TestEngineCoalescing:
     def test_concurrent_submitters_coalesce_into_one_batch(self):
@@ -219,6 +245,28 @@ class TestAdmissionControl:
             with pytest.raises(ValueError, match="row signature"):
                 eng.submit(np.zeros((2, 7), np.float32))
             assert eng.output(np.zeros((1, 6), np.float32)).shape == (1, 3)
+
+    def test_expire_queued_sheds_proactively(self):
+        """Slot-bound schedulers (continuous-batching decode) never call
+        take() while full — expire_queued must shed expired entries in
+        place, anywhere in the queue, and release their rows budget."""
+        from deeplearning4j_tpu.serving import AdmissionController
+        from deeplearning4j_tpu.serving.admission import Request
+
+        ac = AdmissionController(capacity_rows=4)
+        keep1 = ac.admit(Request(x="a", rows=1))
+        doomed = ac.admit(Request(x="b", rows=2), timeout_ms=1e-4)
+        keep2 = ac.admit(Request(x="c", rows=1))
+        time.sleep(0.01)
+        assert ac.expire_queued() == 1
+        assert ac.expire_queued() == 0       # idempotent once drained
+        assert ac.depth_requests == 2 and ac.depth_rows == 2
+        with pytest.raises(DeadlineExceededError):
+            doomed.future.result(timeout=1)
+        # FIFO order of survivors intact; budget freed for new admissions
+        assert ac.take(4, timeout=0.0) is keep1
+        ac.admit(Request(x="d", rows=3))
+        assert ac.take(4, timeout=0.0) is keep2
 
     def test_model_error_propagates_to_futures(self):
         class _Boom(ModelAdapter):
