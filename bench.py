@@ -6,13 +6,15 @@ reference runs this workload through the SameDiff op-by-op JVM interpreter;
 here it is one fused XLA executable (fwd+bwd+AdamW, bf16 compute, no remat —
 activations fit HBM at bench shapes and recompute cost ~15% throughput).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "decode"}.
-``vs_baseline`` is measured MFU / 0.35 (the north-star gate from
-BASELINE.json) since the reference publishes no in-tree numbers
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "decode",
+"availability"}. ``vs_baseline`` is measured MFU / 0.35 (the north-star
+gate from BASELINE.json) since the reference publishes no in-tree numbers
 (SURVEY.md §6, BASELINE "published": {}). ``decode`` reports the
 GenerationEngine's steady-state numbers: decode tokens/sec across all
 slots, median time-to-first-token, slot occupancy at steady state, and
 the compiled-signature count (must stay ≤ prefill ladder + 1).
+``availability`` is the resilience leg: success rate and p99 latency under
+a fixed seeded FaultPlan injecting 5% transient dispatch failures.
 """
 import json
 import time
@@ -107,6 +109,7 @@ def main():
         "vs_baseline": round(mfu / 0.35, 4),
         "vs_baseline_basis": "mfu / 0.35 north-star gate (BASELINE.json)",
         "decode": decode_leg(on_tpu),
+        "availability": availability_leg(on_tpu),
     }))
 
 
@@ -172,6 +175,76 @@ def decode_leg(on_tpu: bool) -> dict:
             "max_new_tokens": max_new,
             "compiled_signatures": eng.compiled_signatures(),
             "signature_bound": len(eng.buckets) + 1,
+        }
+
+
+def availability_leg(on_tpu: bool) -> dict:
+    """Availability under injected faults: drive the batching engine with a
+    fixed seeded FaultPlan failing 5% of ``engine.dispatch`` calls
+    transiently, and report the success rate and p99 latency the retry
+    layer sustains. The plan is seeded, so this leg is the same fault
+    schedule on every run — a regression here is a resilience regression,
+    not noise. (The train/decode legs above run with NO plan installed,
+    which is the FaultPlan-inactive overhead condition: one global read
+    per dispatch.)"""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.serving import (
+        FaultPlan, InferenceEngine, ModelAdapter, RetryPolicy)
+
+    class _Mlp(ModelAdapter):
+        """Tiny jitted row-wise model: the leg measures the resilience
+        layer, not the network."""
+
+        def __init__(self):
+            import jax
+            super().__init__(model=None)
+            w = jax.random.normal(jax.random.PRNGKey(0), (16, 16),
+                                  jnp.float32)
+            self._fn = jax.jit(lambda x: jnp.tanh(x @ w))
+
+        def infer(self, x):
+            return np.asarray(self._fn(jnp.asarray(x, jnp.float32)))
+
+    n_requests = 400 if on_tpu else 120
+    fault_rate = 0.05
+    # 5% Bernoulli background failures PLUS fixed early call indices: the
+    # dispatch count varies with coalescing, so the at= anchors guarantee
+    # the retry path is exercised every run (>=15 dispatches at
+    # max_batch_size=8 for 120 single-row requests)
+    plan = (FaultPlan(seed=0)
+            .fail("engine.dispatch", rate=fault_rate)
+            .fail("engine.dispatch", at=(1, 3, 7, 11)))
+    with InferenceEngine(
+            _Mlp(), max_batch_size=8, max_wait_ms=1.0,
+            retry_policy=RetryPolicy(max_attempts=4, base_delay_ms=0.5,
+                                     max_delay_ms=8.0, seed=0),
+            name="availability") as eng:
+        eng.warmup(np.zeros(16, np.float32))
+        from deeplearning4j_tpu.serving import ServingMetrics
+        eng.metrics = ServingMetrics()   # exclude warmup compiles from p99
+        rng = np.random.default_rng(0)
+        ok = 0
+        with plan:
+            futures = [eng.submit(
+                rng.standard_normal((1, 16)).astype(np.float32))
+                       for _ in range(n_requests)]
+            for f in futures:
+                try:
+                    f.result(timeout=120)
+                    ok += 1
+                except Exception:
+                    pass
+        m = eng.metrics
+        return {
+            "injected_fault_rate": fault_rate,
+            "injection_point": "engine.dispatch",
+            "requests": n_requests,
+            "success_rate": round(ok / n_requests, 4),
+            "latency_ms_p99": round(m.latency_ms.quantile(0.99), 3),
+            "retries": int(m.retries_total.value),
+            "faults_fired": len(plan.fired()),
+            "breaker_state": eng.breaker.state,
         }
 
 

@@ -9,20 +9,29 @@ from deeplearning4j_tpu.serving.admission import (  # noqa: F401
     AdmissionController, DeadlineExceededError, QueueFullError, RejectedError,
 )
 from deeplearning4j_tpu.serving.engine import InferenceEngine, bucket_ladder  # noqa: F401
+from deeplearning4j_tpu.serving.faults import (  # noqa: F401
+    FaultInjectedError, FaultPlan, inject,
+)
 from deeplearning4j_tpu.serving.generation import (  # noqa: F401
     GenerationEngine, GenerationHandle, prefill_buckets,
 )
 from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
-    Counter, Gauge, Histogram, ServingMetrics,
+    Counter, Gauge, Histogram, ReasonCounter, ServingMetrics,
 )
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     CausalLMAdapter, Deployment, ModelAdapter, ModelRegistry, as_adapter,
+)
+from deeplearning4j_tpu.serving.resilience import (  # noqa: F401
+    CircuitBreaker, CircuitOpenError, RetryPolicy, Watchdog,
+    WatchdogTimeoutError,
 )
 
 __all__ = [
     "AdmissionController", "DeadlineExceededError", "QueueFullError",
     "RejectedError", "InferenceEngine", "bucket_ladder", "Counter", "Gauge",
-    "Histogram", "ServingMetrics", "Deployment", "ModelAdapter",
-    "ModelRegistry", "as_adapter", "GenerationEngine", "GenerationHandle",
-    "prefill_buckets", "CausalLMAdapter",
+    "Histogram", "ReasonCounter", "ServingMetrics", "Deployment",
+    "ModelAdapter", "ModelRegistry", "as_adapter", "GenerationEngine",
+    "GenerationHandle", "prefill_buckets", "CausalLMAdapter", "FaultPlan",
+    "FaultInjectedError", "inject", "RetryPolicy", "CircuitBreaker",
+    "Watchdog", "CircuitOpenError", "WatchdogTimeoutError",
 ]

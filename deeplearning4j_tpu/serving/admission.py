@@ -28,8 +28,16 @@ class RejectedError(RuntimeError):
 
 
 class QueueFullError(RejectedError):
-    def __init__(self, msg: str):
+    """Backpressure rejection. Carries the observed ``depth`` and the
+    configured ``capacity`` (in the controller's unit — rows for the batch
+    engine, requests for the generation engine) so callers and dashboards
+    see HOW full, not just "full"."""
+
+    def __init__(self, msg: str, depth: Optional[int] = None,
+                 capacity: Optional[int] = None):
         super().__init__(msg, "queue_full")
+        self.depth = depth
+        self.capacity = capacity
 
 
 class DeadlineExceededError(RejectedError):
@@ -66,11 +74,13 @@ class AdmissionController:
     """
 
     def __init__(self, capacity_rows: int = 1024,
-                 default_timeout_ms: Optional[float] = None):
+                 default_timeout_ms: Optional[float] = None,
+                 unit: str = "rows"):
         if capacity_rows <= 0:
             raise ValueError("capacity_rows must be positive")
         self.capacity_rows = capacity_rows
         self.default_timeout_ms = default_timeout_ms
+        self.unit = unit  # 'rows' (batch engine) | 'requests' (generation)
         self._q: deque = deque()
         self._rows = 0
         self._cv = threading.Condition()
@@ -104,8 +114,10 @@ class AdmissionController:
                 raise RejectedError("engine is shut down", "shutdown")
             if self._rows + req.rows > self.capacity_rows:
                 raise QueueFullError(
-                    f"queue full: {self._rows} rows queued + {req.rows} "
-                    f"submitted > capacity {self.capacity_rows}")
+                    f"queue full: {self._rows} {self.unit} queued + "
+                    f"{req.rows} submitted > capacity {self.capacity_rows} "
+                    f"{self.unit}", depth=self._rows,
+                    capacity=self.capacity_rows)
             self._q.append(req)
             self._rows += req.rows
             self._cv.notify()

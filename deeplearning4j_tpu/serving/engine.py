@@ -32,8 +32,8 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
-from typing import Optional, Sequence, Tuple
+from concurrent.futures import Future, InvalidStateError
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -45,7 +45,12 @@ from deeplearning4j_tpu.serving.admission import (
     AdmissionController, DeadlineExceededError, QueueFullError, RejectedError,
     Request,
 )
+from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.resilience import (
+    CircuitBreaker, CircuitOpenError, RetryPolicy, Watchdog,
+    WatchdogTimeoutError,
+)
 
 
 def bucket_ladder(max_batch_size: int, multiple_of: int = 1,
@@ -88,6 +93,9 @@ class InferenceEngine:
                  default_timeout_ms: Optional[float] = None,
                  metrics: Optional[ServingMetrics] = None,
                  profiler: Optional[OpProfiler] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 watchdog_timeout_ms: Optional[float] = None,
                  name: str = "engine"):
         from deeplearning4j_tpu.serving.registry import ModelAdapter, as_adapter
 
@@ -121,10 +129,28 @@ class InferenceEngine:
         self._row_sig = None  # (feature shape, dtype) pinned by first request
         self._seen_lock = threading.Lock()
         self._stop = threading.Event()
+        # ---- resilience layer (serving/resilience.py design notes) -------
+        # default RetryPolicy retries only transient-tagged failures, so a
+        # deterministic model error still fails fast; default breaker opens
+        # after 5 consecutive batch failures. Pass explicit instances to
+        # share a breaker across engines of one deployment (the registry
+        # does) or to disable retries (max_attempts=1).
+        self._retry = retry_policy if retry_policy is not None \
+            else RetryPolicy()
+        self._breaker = breaker if breaker is not None \
+            else CircuitBreaker(name=self.name)
+        self._breaker.add_listener(self.metrics.record_breaker_transition)
+        self._epoch = 0          # bumped by the watchdog; stales zombies
+        self._inflight: List[Request] = []
+        self._wd_lock = threading.Lock()
+        self._crash_dumped = False
+        self._watchdog: Optional[Watchdog] = None
         self._thread = threading.Thread(
-            target=self._loop, name=f"serving-dispatcher[{self.name}]",
-            daemon=True)
+            target=self._loop, args=(0,),
+            name=f"serving-dispatcher[{self.name}]", daemon=True)
         self._thread.start()
+        if watchdog_timeout_ms is not None:
+            self.arm_watchdog(watchdog_timeout_ms)
 
     # ------------------------------------------------------------ lifecycle
     def __enter__(self) -> "InferenceEngine":
@@ -135,8 +161,13 @@ class InferenceEngine:
 
     def shutdown(self, wait: bool = True):
         """Stop the dispatcher; queued requests are rejected ('shutdown')."""
+        if self._watchdog is not None:   # no restarts during teardown
+            self._watchdog.stop()
         self._stop.set()
         self._admission.close()
+        # the breaker may outlive this engine (shared per deployment):
+        # detach our metrics listener so dead engines don't accumulate
+        self._breaker.remove_listener(self.metrics.record_breaker_transition)
         if wait and self._thread.is_alive():
             self._thread.join(timeout=5.0)
 
@@ -154,15 +185,25 @@ class InferenceEngine:
                 f"{self.max_batch_size}; split the call")
         self._check_row_sig(arr.shape[1:], arr.dtype)
         self.metrics.requests_total.inc()
+        if not self._breaker.allow():
+            self.metrics.rejected_total.inc()
+            self.metrics.rejected_circuit_open.inc()
+            self.metrics.record_rejection("circuit_open")
+            raise CircuitOpenError(
+                f"circuit open for engine[{self.name}] after "
+                f"{self._breaker.consecutive_failures} consecutive dispatch "
+                f"failures; retry after the cooldown")
         req = Request(x=arr, rows=int(arr.shape[0]))
         try:
             self._admission.admit(req, timeout_ms=timeout_ms)
         except QueueFullError:
             self.metrics.rejected_total.inc()
             self.metrics.rejected_queue_full.inc()
+            self.metrics.record_rejection("queue_full")
             raise
-        except RejectedError:
+        except RejectedError as e:
             self.metrics.rejected_total.inc()
+            self.metrics.record_rejection(e.reason)
             raise
         self.metrics.queue_depth.set(self._admission.depth_rows)
         return req.future
@@ -189,8 +230,14 @@ class InferenceEngine:
                     f"input surface — use a second engine for other inputs")
 
     # -------------------------------------------------------------- batching
-    def _loop(self):
-        while not self._stop.is_set():
+    def _loop(self, epoch: int):
+        """Dispatcher loop for one epoch. The watchdog bumps ``_epoch``
+        when it restarts the engine: this (possibly wedged) thread then
+        exits at the next check instead of racing its replacement, and
+        result delivery tolerates futures the watchdog already failed."""
+        while not self._stop.is_set() and self._epoch == epoch:
+            if self._watchdog is not None:
+                self._watchdog.beat()
             first = self._admission.take(self.max_batch_size, timeout=0.05)
             if first is None:
                 continue
@@ -208,24 +255,100 @@ class InferenceEngine:
                     break
                 batch.append(nxt)
                 rows += nxt.rows
+            with self._wd_lock:   # visible to the watchdog while on-device
+                self._inflight = list(batch)
             try:
                 self._dispatch(batch)
             except BaseException as e:  # never kill the dispatcher thread
                 for req in batch:
                     if not req.future.done():
-                        req.future.set_exception(e)
-        # drain anything admitted between close() and loop exit
-        while True:
-            req = self._admission.take(self.max_batch_size, timeout=0.0)
-            if req is None:
-                break
-            if not req.future.done():
-                req.future.set_exception(
-                    RejectedError("engine shut down", "shutdown"))
+                        try:
+                            req.future.set_exception(e)
+                        except InvalidStateError:
+                            pass
+            finally:
+                with self._wd_lock:
+                    # epoch guard: a watchdog restart mid-dispatch hands
+                    # _inflight to the replacement thread — this (zombie)
+                    # thread's clear must not blind the watchdog to the
+                    # replacement's in-flight batch
+                    if self._epoch == epoch:
+                        self._inflight = []
+        # drain anything admitted between close() and loop exit — current-
+        # epoch thread only: a watchdog-staled zombie must not reject work
+        # its replacement is about to serve
+        if self._stop.is_set() and self._epoch == epoch:
+            while True:
+                req = self._admission.take(self.max_batch_size, timeout=0.0)
+                if req is None:
+                    break
+                if not req.future.done():
+                    try:
+                        req.future.set_exception(
+                            RejectedError("engine shut down", "shutdown"))
+                    except InvalidStateError:
+                        pass
+                    self.metrics.record_rejection("shutdown")
 
     def _count_shed(self, req):
         self.metrics.rejected_total.inc()
         self.metrics.rejected_deadline.inc()
+        self.metrics.record_rejection("deadline")
+
+    # ------------------------------------------------------------- watchdog
+    def arm_watchdog(self, timeout_ms: float) -> "InferenceEngine":
+        """Arm (or re-arm) the dispatcher watchdog: a dispatcher that stops
+        heartbeating for ``timeout_ms`` with work outstanding is declared
+        wedged — in-flight futures fail typed and a fresh dispatcher takes
+        over the queue. Size the timeout at N× the engine's deadline and
+        arm AFTER :meth:`warmup`: a first-compile pause reads exactly like
+        a stall."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        self._watchdog = Watchdog(
+            timeout_s=timeout_ms / 1e3,
+            busy=self._watchdog_busy, on_stall=self._watchdog_stall,
+            name=self.name).start()
+        return self
+
+    def _watchdog_busy(self) -> bool:
+        with self._wd_lock:
+            if self._inflight:
+                return True
+        return self._admission.depth_requests > 0
+
+    def _watchdog_stall(self):
+        """Recovery hook: the dispatcher stopped heartbeating with work
+        outstanding. Fail the in-flight batch typed (callers get an answer
+        NOW instead of a hang), stale the wedged thread via the epoch, and
+        start a fresh dispatcher over the same admission queue — queued
+        requests are preserved, nothing is double-delivered because every
+        delivery path tolerates an already-resolved future."""
+        with self._wd_lock:
+            self._epoch += 1
+            epoch = self._epoch
+            victims, self._inflight = self._inflight, []
+        exc = WatchdogTimeoutError(
+            f"engine[{self.name}] dispatcher missed its heartbeat for "
+            f">{self._watchdog.timeout_s * 1e3:.0f} ms with "
+            f"{len(victims)} request(s) in flight; batch failed, "
+            f"dispatcher restarted")
+        failed = 0
+        for req in victims:
+            try:
+                req.future.set_exception(exc)
+                failed += 1
+            except InvalidStateError:
+                pass
+        if failed:
+            self.metrics.failed_total.inc(failed)
+        self.metrics.watchdog_restarts.inc()
+        self.metrics.record_rejection("watchdog")
+        self._breaker.record_failure()
+        self._thread = threading.Thread(
+            target=self._loop, args=(epoch,),
+            name=f"serving-dispatcher[{self.name}]#{epoch}", daemon=True)
+        self._thread.start()
 
     def _bucket_for(self, b: int) -> int:
         for s in self.buckets:
@@ -239,6 +362,39 @@ class InferenceEngine:
             with self.mesh:
                 return self.adapter.infer(xs)
         return self.adapter.infer(x)
+
+    def _guarded_run(self, x: np.ndarray) -> np.ndarray:
+        """The resilient device call: ``engine.dispatch`` fault point +
+        bounded retry. Safe to retry because futures resolve only after
+        the final outcome — a retried batch cannot double-deliver."""
+        def call():
+            return np.asarray(inject("engine.dispatch", self._run, x))
+
+        return self._retry.call(call, on_retry=self._on_retry)
+
+    def _on_retry(self, attempt: int, exc: BaseException):
+        self.metrics.retries_total.inc()
+        if getattr(exc, "injected", False):
+            self.metrics.faults_injected_total.inc()
+
+    def _maybe_crash_dump(self, exc: BaseException, **context):
+        """Serving crashes get the training path's forensics: the FIRST
+        non-injected unexpected dispatch failure writes a memory crash
+        dump (util/crash_reporting). Injected chaos faults and typed
+        admission sheds never dump, and the dump itself can never mask
+        the original error (writeMemoryCrashDump swallows its own)."""
+        if getattr(exc, "injected", False):
+            self.metrics.faults_injected_total.inc()
+            return
+        if self._crash_dumped or isinstance(exc, RejectedError):
+            return
+        self._crash_dumped = True
+        from deeplearning4j_tpu.util.crash_reporting import (
+            writeMemoryCrashDump)
+        writeMemoryCrashDump(
+            self.adapter.model, exc,
+            context={"component": "serving.InferenceEngine",
+                     "engine": self.name, **context})
 
     def _dispatch(self, batch):
         now = time.perf_counter()
@@ -269,14 +425,20 @@ class InferenceEngine:
             with self.profiler.span("serving.dispatch", engine=self.name,
                                     bucket=bucket, rows=b,
                                     requests=len(live)):
-                y = np.asarray(self._run(x))
+                y = self._guarded_run(x)
         except BaseException as e:
             self.metrics.failed_total.inc(len(live))
+            self._breaker.record_failure()
+            self._maybe_crash_dump(e, bucket=bucket, requests=len(live))
             for req in live:
-                req.future.set_exception(e)
+                try:
+                    req.future.set_exception(e)
+                except InvalidStateError:
+                    pass  # watchdog or caller got there first
             return
         finally:
             self.metrics.inflight_rows.set(0)
+        self._breaker.record_success()
         dt_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.batches_total.inc()
         self.metrics.rows_total.inc(b)
@@ -293,7 +455,10 @@ class InferenceEngine:
             out = y[off:off + req.rows].copy()
             off += req.rows
             self.metrics.latency_ms.observe((done_t - req.submit_t) * 1e3)
-            req.future.set_result(NDArray(out))
+            try:
+                req.future.set_result(NDArray(out))
+            except InvalidStateError:
+                pass  # failed by the watchdog while this zombie computed
 
     # --------------------------------------------------------------- warmup
     def warmup(self, example_row) -> "InferenceEngine":
@@ -312,7 +477,7 @@ class InferenceEngine:
                 self._seen_buckets.add(bucket)
             with self.profiler.span("serving.warmup", engine=self.name,
                                     bucket=bucket):
-                np.asarray(self._run(x))
+                np.asarray(inject("engine.warmup", self._run, x))
             self.metrics.record_bucket(bucket, 0, first_time)
         return self
 
@@ -332,6 +497,15 @@ class InferenceEngine:
     def queue_depth_rows(self) -> int:
         return self._admission.depth_rows
 
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self._breaker
+
+    @property
+    def watchdog_restarts(self) -> int:
+        return self._watchdog.restarts if self._watchdog is not None else 0
+
 
 __all__ = ["InferenceEngine", "bucket_ladder", "RejectedError",
-           "QueueFullError", "DeadlineExceededError"]
+           "QueueFullError", "DeadlineExceededError", "CircuitOpenError",
+           "WatchdogTimeoutError"]

@@ -43,7 +43,12 @@ from deeplearning4j_tpu.serving.admission import (
     AdmissionController, QueueFullError, RejectedError, Request,
 )
 from deeplearning4j_tpu.serving.engine import bucket_ladder
+from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.resilience import (
+    CircuitBreaker, CircuitOpenError, RetryPolicy, Watchdog,
+    WatchdogTimeoutError,
+)
 
 _DONE = object()
 _UNSET = object()   # submit()'s "use the engine default" eos sentinel
@@ -176,6 +181,9 @@ class GenerationEngine:
                  eos_id: Optional[int] = None,
                  metrics: Optional[ServingMetrics] = None,
                  profiler: Optional[OpProfiler] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 watchdog_timeout_ms: Optional[float] = None,
                  name: str = "generation"):
         from deeplearning4j_tpu.models.bert import (
             init_kv_cache, make_decode_step, make_prefill, place_kv_cache,
@@ -212,14 +220,31 @@ class GenerationEngine:
         # slot-unit admission: one request == one future slot (rows=1)
         self._admission = AdmissionController(
             capacity_rows=queue_capacity,
-            default_timeout_ms=default_timeout_ms)
+            default_timeout_ms=default_timeout_ms, unit="requests")
         self._admission.on_shed = self._count_shed
         self._slots: List[Optional[_Slot]] = [None] * slots
         self._stop = threading.Event()
+        # ---- resilience layer (serving/resilience.py design notes) -------
+        # injected/tagged-transient prefill and decode failures raise
+        # BEFORE the donated call executes, so retrying them re-uses the
+        # intact cache; everything else still takes the fail-tenants +
+        # rebuild path from PR 2.
+        self._retry = retry_policy if retry_policy is not None \
+            else RetryPolicy()
+        self._breaker = breaker if breaker is not None \
+            else CircuitBreaker(name=self.name)
+        self._breaker.add_listener(self.metrics.record_breaker_transition)
+        self._epoch = 0          # bumped by the watchdog; stales zombies
+        self._inflight_prefill: Optional[Request] = None
+        self._wd_lock = threading.Lock()
+        self._crash_dumped = False
+        self._watchdog: Optional[Watchdog] = None
         self._thread = threading.Thread(
-            target=self._loop, name=f"generation-scheduler[{self.name}]",
-            daemon=True)
+            target=self._loop, args=(0,),
+            name=f"generation-scheduler[{self.name}]", daemon=True)
         self._thread.start()
+        if watchdog_timeout_ms is not None:
+            self.arm_watchdog(watchdog_timeout_ms)
 
     # ------------------------------------------------------------ lifecycle
     def __enter__(self) -> "GenerationEngine":
@@ -232,8 +257,13 @@ class GenerationEngine:
         """Idempotent: stop the scheduler; queued AND in-flight requests
         are rejected ('shutdown') — partial streams surface what they have
         via :meth:`GenerationHandle.tokens_so_far`."""
+        if self._watchdog is not None:   # no restarts during teardown
+            self._watchdog.stop()
         self._stop.set()
         self._admission.close()
+        # shared-per-deployment breaker outlives the engine: detach our
+        # metrics listener so dead engines don't accumulate
+        self._breaker.remove_listener(self.metrics.record_breaker_transition)
         if wait and self._thread.is_alive():
             self._thread.join(timeout=30.0)
 
@@ -273,14 +303,24 @@ class GenerationEngine:
         req = Request(x=greq, rows=1)
         greq.handle = GenerationHandle(req, toks.size, on_token=on_token)
         self.metrics.requests_total.inc()
+        if not self._breaker.allow():
+            self.metrics.rejected_total.inc()
+            self.metrics.rejected_circuit_open.inc()
+            self.metrics.record_rejection("circuit_open")
+            raise CircuitOpenError(
+                f"circuit open for engine[{self.name}] after "
+                f"{self._breaker.consecutive_failures} consecutive "
+                f"prefill/decode failures; retry after the cooldown")
         try:
             self._admission.admit(req, timeout_ms=timeout_ms)
         except QueueFullError:
             self.metrics.rejected_total.inc()
             self.metrics.rejected_queue_full.inc()
+            self.metrics.record_rejection("queue_full")
             raise
-        except RejectedError:
+        except RejectedError as e:
             self.metrics.rejected_total.inc()
+            self.metrics.record_rejection(e.reason)
             raise
         self.metrics.queue_depth.set(self._admission.depth_requests)
         return greq.handle
@@ -305,22 +345,44 @@ class GenerationEngine:
         self._cache = self._place_kv_cache(cache, self.cfg, self.mesh) \
             if self.mesh is not None else cache
 
-    def _loop(self):
+    def _loop(self, epoch: int):
+        """Scheduler loop for one epoch. The watchdog bumps ``_epoch`` on
+        restart: this (possibly wedged) thread then exits at its next
+        check, and any state it computes afterwards is dropped by the
+        epoch guards instead of corrupting its replacement's cache."""
         try:
-            while not self._stop.is_set():
-                self._admit()
-                if self._live_count():
+            while not self._stop.is_set() and self._epoch == epoch:
+                if self._watchdog is not None:
+                    self._watchdog.beat()
+                self._admit(epoch)
+                if self._live_count() and self._epoch == epoch:
                     try:
-                        self._decode_iteration()
+                        self._decode_iteration(epoch)
                     except BaseException as e:   # fail tenants, keep thread
-                        self._fail_live(e)
-                        self._reset_cache()
+                        self._on_device_failure(e, epoch,
+                                                point="generation.decode_step")
         finally:
-            # queued requests are failed by _admission.close() itself
-            self._fail_live(RejectedError(
-                "engine shut down mid-generation", "shutdown"))
+            # queued requests are failed by _admission.close() itself;
+            # current-epoch thread only — a staled zombie must not fail
+            # the replacement scheduler's live tenants
+            if self._stop.is_set() and self._epoch == epoch:
+                self._fail_live(RejectedError(
+                    "engine shut down mid-generation", "shutdown"))
 
-    def _admit(self):
+    def _on_device_failure(self, exc: BaseException, epoch: int, point: str):
+        """Shared failure tail for prefill/decode: the failed call may have
+        consumed the donated cache, and with it every live tenant's K/V —
+        fail them and rebuild. Epoch-guarded so a zombie observing its own
+        (post-restart) failure cannot rebuild the replacement's cache."""
+        self._breaker.record_failure()
+        self._maybe_crash_dump(exc, point=point)
+        with self._wd_lock:
+            current = self._epoch == epoch
+        if current:
+            self._fail_live(exc)
+            self._reset_cache()
+
+    def _admit(self, epoch: int):
         """Fill free slots from the queue. Blocks briefly only when the
         engine is fully idle; with live tenants admission is opportunistic
         so decode cadence never stalls on an empty queue. Expired prompts
@@ -329,7 +391,7 @@ class GenerationEngine:
         budget and mask the queue-full backpressure signal)."""
         self._admission.expire_queued()
         for i in range(self.slots):
-            if self._stop.is_set():
+            if self._stop.is_set() or self._epoch != epoch:
                 return
             if self._slots[i] is not None:
                 continue
@@ -342,15 +404,18 @@ class GenerationEngine:
                 continue
             if not req.future.set_running_or_notify_cancel():
                 continue     # caller cancelled while queued
+            with self._wd_lock:  # visible to the watchdog while on-device
+                self._inflight_prefill = req
             try:
-                self._prefill_into(i, req)
+                self._prefill_into(i, req, epoch)
             except BaseException as e:
                 req.x.handle._fail(e)
                 self.metrics.failed_total.inc()
-                # the failed call may have consumed the donated cache, and
-                # with it every live tenant's K/V — fail them and rebuild
-                self._fail_live(e)
-                self._reset_cache()
+                self._on_device_failure(e, epoch, point="generation.prefill")
+            finally:
+                with self._wd_lock:
+                    if self._inflight_prefill is req:
+                        self._inflight_prefill = None
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -358,7 +423,32 @@ class GenerationEngine:
                 return b
         return self.buckets[-1]
 
-    def _prefill_into(self, slot: int, req: Request):
+    def _donated_call(self, point: str, fn, *args):
+        """Run a DONATED jitted call under the ``point`` fault hook, and
+        stamp any exception that escapes after the call started executing
+        with ``donated_state_consumed=True``: injected faults raise before
+        execution (retry-safe, cache intact), but a real failure from the
+        call itself may have consumed the donated buffers — the retry
+        classifier refuses those and the fail-tenants-and-rebuild path
+        takes over."""
+        started = False
+
+        def run(*a):
+            nonlocal started
+            started = True
+            return fn(*a)
+
+        try:
+            return inject(point, run, *args)
+        except BaseException as e:
+            if started:
+                try:
+                    e.donated_state_consumed = True
+                except Exception:
+                    pass   # exotic __slots__ exception: stays conservative
+            raise
+
+    def _prefill_into(self, slot: int, req: Request, epoch: int):
         greq: GenerationRequest = req.x
         n = int(greq.prompt.size)
         bucket = self._bucket_for(n)
@@ -367,11 +457,32 @@ class GenerationEngine:
         t0 = time.perf_counter()
         with self.profiler.span("serving.prefill", engine=self.name,
                                 slot=slot, bucket=bucket, prompt=n):
-            self._cache, tok = self._prefill(
-                self.params, self._cache, padded, np.int32(slot),
-                np.int32(n), greq.key, np.float32(greq.temperature),
-                np.int32(greq.top_k))
+            def call():
+                # self._cache re-read per attempt: a retryable fault raises
+                # BEFORE the donated call runs (enforced by _donated_call's
+                # consumed-stamp), so the cache is intact and the retry
+                # re-binds the same live buffers
+                return self._donated_call(
+                    "generation.prefill", self._prefill,
+                    self.params, self._cache, padded, np.int32(slot),
+                    np.int32(n), greq.key, np.float32(greq.temperature),
+                    np.int32(greq.top_k))
+
+            new_cache, tok = self._retry.call(call, on_retry=self._on_retry)
             tok = int(np.asarray(tok))
+        with self._wd_lock:
+            current = self._epoch == epoch
+            if current:
+                self._cache = new_cache
+        if not current:
+            # the watchdog restarted the engine while this (zombie) prefill
+            # was on-device: its write landed in an abandoned cache — fail
+            # the request typed rather than leave its future hanging
+            greq.handle._fail(WatchdogTimeoutError(
+                f"engine[{self.name}] restarted while this prompt was in "
+                f"prefill; resubmit"))
+            return
+        self._breaker.record_success()
         now = time.perf_counter()
         self.metrics.prefill_ms.observe((now - t0) * 1e3)
         self.metrics.ttft_ms.observe((now - req.submit_t) * 1e3)
@@ -380,9 +491,15 @@ class GenerationEngine:
         state = _Slot(greq=greq, request=req, n_generated=1, last_token=tok)
         greq.handle._push(tok)
         if not self._maybe_retire(state, tok):
-            self._slots[slot] = state
+            with self._wd_lock:
+                # re-check: a restart between the cache writeback and here
+                # reset the cache, so this tenant's K/V no longer exists —
+                # registering it would decode garbage. The watchdog already
+                # failed its handle (it was the in-flight prefill).
+                if self._epoch == epoch:
+                    self._slots[slot] = state
 
-    def _decode_iteration(self):
+    def _decode_iteration(self, epoch: int):
         """One scheduler turn: a single fixed-shape decode_step over ALL
         slots, then stream/retire per live slot."""
         S = self.slots
@@ -393,7 +510,11 @@ class GenerationEngine:
         temps = np.zeros(S, np.float32)
         top_ks = np.zeros(S, np.int32)
         n_live = 0
-        for i, st in enumerate(self._slots):
+        # snapshot the slot table: after a watchdog restart the live list
+        # belongs to the replacement scheduler (possibly re-tenanted), and
+        # this thread must only ever touch the tenants IT dispatched
+        states = list(self._slots)
+        for i, st in enumerate(states):
             if st is None:
                 continue
             n_live += 1
@@ -405,43 +526,78 @@ class GenerationEngine:
             top_ks[i] = st.greq.top_k
         self.metrics.slot_occupancy.set(n_live / S)
         t0 = time.perf_counter()
+        # snapshot the cache binding: if the watchdog restarts the engine
+        # mid-step, this (zombie) call must keep donating the OLD cache —
+        # re-reading self._cache after a restart would consume the
+        # replacement scheduler's live buffers
+        cache = self._cache
         with self.profiler.span("serving.decode_step", engine=self.name,
                                 live=n_live, slots=S):
-            self._cache, toks = self._decode(
-                self.params, self._cache, tokens, live, keys, steps,
-                temps, top_ks)
+            def call():
+                return self._donated_call(
+                    "generation.decode_step", self._decode,
+                    self.params, cache, tokens, live, keys, steps,
+                    temps, top_ks)
+
+            new_cache, toks = self._retry.call(call, on_retry=self._on_retry)
             toks = np.asarray(toks)
+        with self._wd_lock:
+            current = self._epoch == epoch
+            if current:
+                self._cache = new_cache
+        if not current:
+            return   # zombie: tenants were already failed typed on restart
+        self._breaker.record_success()
         dt_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.decode_step_ms.observe(dt_ms)
         self.metrics.decode_wall_ms.inc(dt_ms)
         self.metrics.decode_steps_total.inc()
         self.metrics.generated_tokens_total.inc(n_live)
-        for i, st in enumerate(self._slots):
+        for i, st in enumerate(states):
             if st is None:
                 continue
             tok = int(toks[i])
-            st.n_generated += 1
-            st.last_token = tok
+            with self._wd_lock:
+                # serialize each slot-table touch with _watchdog_stall's
+                # epoch bump (taken under this lock): the instant the
+                # epoch moves, the replacement scheduler owns the table —
+                # a re-tenanted slot i must not receive this step's token
+                if self._epoch != epoch:
+                    return
+                st.n_generated += 1
+                st.last_token = tok
+                reason = self._retire_reason(st, tok)
+                if reason is not None:
+                    self._slots[i] = None   # freed for the NEXT admission
             st.greq.handle._push(tok)
-            if self._maybe_retire(st, tok):
-                self._slots[i] = None   # freed for the NEXT admission turn
+            if reason is not None:
+                self._finish_stream(st, reason)
         # re-read after retirement so an engine that drains to idle shows
         # its true occupancy instead of the pre-retire value forever
         self.metrics.slot_occupancy.set(self._live_count() / S)
 
-    def _maybe_retire(self, st: _Slot, tok: int) -> bool:
-        """Retire a finished stream immediately — EOS or the token budget —
-        so a long co-tenant never holds its slot hostage."""
+    def _retire_reason(self, st: _Slot, tok: int) -> Optional[str]:
+        """Pure retirement decision — EOS or the token budget — split from
+        the side effects so the decode tail can take it under _wd_lock."""
         if st.greq.eos_id is not None and tok == st.greq.eos_id:
-            reason = "eos"
-        elif st.n_generated >= st.greq.max_new_tokens:
-            reason = "max_tokens"
-        else:
-            return False
+            return "eos"
+        if st.n_generated >= st.greq.max_new_tokens:
+            return "max_tokens"
+        return None
+
+    def _finish_stream(self, st: _Slot, reason: str):
         st.greq.handle._finish(reason)
         self.metrics.generations_completed.inc()
         self.metrics.latency_ms.observe(
             (time.perf_counter() - st.request.submit_t) * 1e3)
+
+    def _maybe_retire(self, st: _Slot, tok: int) -> bool:
+        """Retire a finished stream immediately — EOS or the token budget —
+        so a long co-tenant never holds its slot hostage."""
+        reason = self._retire_reason(st, tok)
+        if reason is None:
+            return False
+        self._finish_stream(st, reason)
         return True
 
     def _fail_live(self, exc: BaseException):
@@ -453,6 +609,87 @@ class GenerationEngine:
     def _count_shed(self, req):
         self.metrics.rejected_total.inc()
         self.metrics.rejected_deadline.inc()
+        self.metrics.record_rejection("deadline")
+
+    def _on_retry(self, attempt: int, exc: BaseException):
+        self.metrics.retries_total.inc()
+        if getattr(exc, "injected", False):
+            self.metrics.faults_injected_total.inc()
+
+    def _maybe_crash_dump(self, exc: BaseException, **context):
+        """First non-injected unexpected scheduler failure writes a memory
+        crash dump (util/crash_reporting) — serving crashes get the same
+        forensics as the training path. Injected chaos faults and typed
+        sheds never dump; the dump can never mask the original error."""
+        if getattr(exc, "injected", False):
+            self.metrics.faults_injected_total.inc()
+            return
+        if self._crash_dumped or isinstance(exc, RejectedError):
+            return
+        self._crash_dumped = True
+        from deeplearning4j_tpu.util.crash_reporting import (
+            writeMemoryCrashDump)
+        writeMemoryCrashDump(
+            self.params, exc,
+            context={"component": "serving.GenerationEngine",
+                     "engine": self.name, "slots": self.slots,
+                     "live_slots": self._live_count(), **context})
+
+    # ------------------------------------------------------------- watchdog
+    def arm_watchdog(self, timeout_ms: float) -> "GenerationEngine":
+        """Arm (or re-arm) the scheduler watchdog: a scheduler that stops
+        heartbeating for ``timeout_ms`` with work outstanding is declared
+        wedged — live generations fail typed, the cache is rebuilt, a
+        fresh scheduler takes over the queue. Arm AFTER :meth:`warmup`:
+        first-compile prefill/decode pauses read exactly like stalls."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        self._watchdog = Watchdog(
+            timeout_s=timeout_ms / 1e3,
+            busy=self._watchdog_busy, on_stall=self._watchdog_stall,
+            name=self.name).start()
+        return self
+
+    def _watchdog_busy(self) -> bool:
+        with self._wd_lock:
+            if self._inflight_prefill is not None:
+                return True
+        return self._live_count() > 0 or self._admission.depth_requests > 0
+
+    def _watchdog_stall(self):
+        """Recovery hook: the scheduler stopped heartbeating with work
+        outstanding (wedged in a device call). Fail the in-prefill request
+        and every live slot typed, rebuild the donated cache (the wedged
+        call's eventual write is epoch-staled), and start a fresh
+        scheduler over the preserved admission queue."""
+        with self._wd_lock:
+            self._epoch += 1
+            epoch = self._epoch
+            pre, self._inflight_prefill = self._inflight_prefill, None
+        exc = WatchdogTimeoutError(
+            f"engine[{self.name}] scheduler missed its heartbeat for "
+            f">{self._watchdog.timeout_s * 1e3:.0f} ms; live generations "
+            f"failed, scheduler restarted")
+        failed = 0
+        if pre is not None:
+            pre.x.handle._fail(exc)
+            failed += 1
+        for i, st in enumerate(self._slots):
+            if st is not None:
+                st.greq.handle._fail(exc)
+                self._slots[i] = None
+                failed += 1
+        if failed:
+            self.metrics.failed_total.inc(failed)
+        self.metrics.watchdog_restarts.inc()
+        self.metrics.record_rejection("watchdog")
+        self.metrics.slot_occupancy.set(0.0)
+        self._breaker.record_failure()
+        self._reset_cache()
+        self._thread = threading.Thread(
+            target=self._loop, args=(epoch,),
+            name=f"generation-scheduler[{self.name}]#{epoch}", daemon=True)
+        self._thread.start()
 
     # -------------------------------------------------------------- insight
     def compiled_signatures(self) -> int:
@@ -471,6 +708,14 @@ class GenerationEngine:
     @property
     def live_slots(self) -> int:
         return self._live_count()
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self._breaker
+
+    @property
+    def watchdog_restarts(self) -> int:
+        return self._watchdog.restarts if self._watchdog is not None else 0
 
     def warmup(self) -> "GenerationEngine":
         """Compile every prefill bucket + the decode executable up front by

@@ -119,6 +119,32 @@ class Histogram:
                     "sum": self._sum, "count": self._n}
 
 
+class ReasonCounter:
+    """Labeled monotone counter (reason -> count): the shedding causes
+    roll-up. A flat dict rather than N pre-declared counters because the
+    reason set is open (queue_full, deadline, shutdown, circuit_open,
+    watchdog, ...)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._d: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, reason: str, n: float = 1.0):
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._d[reason] = self._d.get(reason, 0.0) + n
+
+    def get(self, reason: str) -> float:
+        with self._lock:
+            return self._d.get(reason, 0.0)
+
+    def to_dict(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._d)
+
+
 class ServingMetrics:
     """The engine's full metric set. All members are monotone counters or
     derived ratios except the two gauges — tests assert monotonicity over
@@ -154,6 +180,16 @@ class ServingMetrics:
         self.ttft_ms = Histogram("ttft_ms")               # submit->token 0
         self.prefill_ms = Histogram("prefill_ms")
         self.decode_step_ms = Histogram("decode_step_ms")
+        # ---- resilience signals (retry / breaker / watchdog / fallback) --
+        self.retries_total = Counter("retries_total")
+        self.rejected_circuit_open = Counter("rejected_circuit_open")
+        self.breaker_opened_total = Counter("breaker_opened_total")
+        self.breaker_half_open_total = Counter("breaker_half_open_total")
+        self.breaker_closed_total = Counter("breaker_closed_total")
+        self.watchdog_restarts = Counter("watchdog_restarts")
+        self.fallback_serves = Counter("fallback_serves")
+        self.faults_injected_total = Counter("faults_injected_total")
+        self.rejections_by_reason = ReasonCounter("rejections_by_reason")
         self._per_bucket: Dict[int, Dict[str, int]] = {}
         self._lock = threading.Lock()
         self._t0 = time.time()
@@ -168,6 +204,23 @@ class ServingMetrics:
             d["compiles" if first_time else "hits"] += 1
         (self.bucket_compiles if first_time else self.bucket_hits).inc()
 
+    def record_rejection(self, reason: str):
+        """Attribute one shed/rejection to its cause — rides beside the
+        existing per-cause counters so ``/api/serving`` can answer "WHY is
+        this engine shedding" without diffing counter pairs."""
+        self.rejections_by_reason.inc(reason)
+
+    def record_breaker_transition(self, old: str, new: str):
+        """CircuitBreaker listener hook: counts entries into each state so
+        the CLOSED→OPEN→HALF_OPEN→CLOSED cycle is observable as monotone
+        counters."""
+        if new == "OPEN":
+            self.breaker_opened_total.inc()
+        elif new == "HALF_OPEN":
+            self.breaker_half_open_total.inc()
+        elif new == "CLOSED":
+            self.breaker_closed_total.inc()
+
     # ------------------------------------------------------------- reading
     def counters(self) -> Dict[str, float]:
         return {c.name: c.value for c in (
@@ -177,7 +230,11 @@ class ServingMetrics:
             self.failed_total, self.bucket_hits, self.bucket_compiles,
             self.prefills_total, self.decode_steps_total,
             self.generated_tokens_total, self.generations_completed,
-            self.decode_wall_ms)}
+            self.decode_wall_ms, self.retries_total,
+            self.rejected_circuit_open, self.breaker_opened_total,
+            self.breaker_half_open_total, self.breaker_closed_total,
+            self.watchdog_restarts, self.fallback_serves,
+            self.faults_injected_total)}
 
     def decode_tokens_per_sec(self) -> float:
         """Steady-state decode throughput: tokens sampled by decode_step
@@ -212,6 +269,7 @@ class ServingMetrics:
             "mean_requests_per_batch": self.mean_requests_per_batch(),
             "slot_occupancy": self.slot_occupancy.value,
             "decode_tokens_per_sec": self.decode_tokens_per_sec(),
+            "rejections_by_reason": self.rejections_by_reason.to_dict(),
             "ttft_ms": self.ttft_ms.to_dict(),
             "prefill_ms": self.prefill_ms.to_dict(),
             "decode_step_ms": self.decode_step_ms.to_dict(),

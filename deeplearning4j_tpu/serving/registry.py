@@ -27,6 +27,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+from deeplearning4j_tpu.serving.faults import inject
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.resilience import CircuitBreaker
 
 
 def tile_rows(example_row, batch: int) -> np.ndarray:
@@ -198,6 +201,10 @@ class Deployment:
     warmup_ms: Optional[float] = None
     warmup_example: Optional[object] = None  # one row; re-warms mesh engines
     state: str = "ready"
+    # one breaker per (name, version): every engine over this deployment
+    # shares it, so failures anywhere trip it everywhere and the registry
+    # can route around it (health() / previous-version fallback)
+    breaker: Optional[CircuitBreaker] = None
 
     @property
     def ref(self) -> str:
@@ -211,8 +218,14 @@ class ModelRegistry:
     version), ``"name:3"`` (pinned), or an alias previously bound with
     :meth:`alias` (e.g. ``"prod" -> "bert:2"`` for canary flips)."""
 
-    def __init__(self, default_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32)):
+    def __init__(self, default_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
+                 breaker_failure_threshold: int = 5,
+                 breaker_cooldown_s: float = 5.0,
+                 metrics: Optional[ServingMetrics] = None):
         self.default_buckets = tuple(default_buckets)
+        self.breaker_failure_threshold = breaker_failure_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.metrics = metrics or ServingMetrics()
         self._models: Dict[str, Dict[int, Deployment]] = {}
         self._aliases: Dict[str, str] = {}
         self._lock = threading.RLock()
@@ -280,7 +293,7 @@ class ModelRegistry:
             try:
                 t0 = time.perf_counter()
                 for b in bks:
-                    adapter.infer(tile_rows(ex, b))
+                    inject("registry.warmup", adapter.infer, tile_rows(ex, b))
                 dep.warmup_ms = (time.perf_counter() - t0) * 1e3
             except BaseException:
                 with self._lock:
@@ -337,12 +350,96 @@ class ModelRegistry:
                  if d.state == "ready"]
         return self._models[ref][max(ready)] if ready else None
 
-    def get(self, ref: str) -> Deployment:
+    def _fallback_unlocked(self, dep: Deployment) -> Optional[Deployment]:
+        """Degraded-mode routing: when ``dep``'s breaker is OPEN, the
+        previous healthy version of the SAME model name (highest version
+        below it that is ready with a non-OPEN breaker) serves in its
+        place. Alias-aware for free: aliases resolve to a (name, version)
+        before this runs."""
+        br = dep.breaker
+        if br is None or br.state != CircuitBreaker.OPEN:
+            return None
+        versions = self._models.get(dep.name, {})
+        for v in sorted(versions, reverse=True):
+            if v >= dep.version:
+                continue
+            cand = versions[v]
+            if cand.state != "ready":
+                continue
+            if cand.breaker is not None \
+                    and cand.breaker.state == CircuitBreaker.OPEN:
+                continue
+            return cand
+        return None
+
+    def get(self, ref: str, fallback: bool = True) -> Deployment:
+        """Resolve ``ref``; with ``fallback`` (the default), a deployment
+        whose circuit breaker is OPEN is transparently replaced by the
+        previous healthy version of the same name when one exists —
+        callers keep getting answers from a known-good model while the
+        broken version cools down. ``fallback=False`` gives the literal
+        resolution (health introspection, undeploy tooling)."""
+        fell_back = False
         with self._lock:
             dep = self._resolve_unlocked(ref)
+            if dep is not None and fallback:
+                fb = self._fallback_unlocked(dep)
+                if fb is not None:
+                    dep, fell_back = fb, True
         if dep is None:
             raise KeyError(f"no deployment for {ref!r}")
+        if fell_back:
+            self.metrics.fallback_serves.inc()
         return dep
+
+    # --------------------------------------------------------------- health
+    def _breaker_for(self, dep: Deployment) -> CircuitBreaker:
+        with self._lock:
+            if dep.breaker is None:
+                dep.breaker = CircuitBreaker(
+                    failure_threshold=self.breaker_failure_threshold,
+                    cooldown_s=self.breaker_cooldown_s, name=dep.ref)
+                dep.breaker.add_listener(
+                    self.metrics.record_breaker_transition)
+            return dep.breaker
+
+    def health(self) -> Dict[str, dict]:
+        """Per-deployment health roll-up: ``SERVING`` (ready, breaker
+        CLOSED or never exercised), ``DEGRADED`` (breaker HALF_OPEN — a
+        probe is deciding), ``CIRCUIT_OPEN`` (shedding; served by the
+        fallback version when one exists), or the deployment's own
+        lifecycle state upper-cased (``WARMING``). ``serving`` names the
+        ref traffic actually routes to after fallback."""
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for name, versions in self._models.items():
+                vs = {}
+                for v, d in sorted(versions.items()):
+                    br = d.breaker
+                    if d.state != "ready":
+                        state = d.state.upper()
+                    elif br is None or br.state == CircuitBreaker.CLOSED:
+                        state = "SERVING"
+                    elif br.state == CircuitBreaker.OPEN:
+                        state = "CIRCUIT_OPEN"
+                    else:
+                        state = "DEGRADED"
+                    vs[v] = {
+                        "state": state,
+                        "breaker": br.state if br is not None else None,
+                        "consecutive_failures":
+                            br.consecutive_failures if br is not None else 0,
+                    }
+                primary = self._resolve_unlocked(name)
+                serving = fallback_from = None
+                if primary is not None:
+                    fb = self._fallback_unlocked(primary)
+                    serving = (fb or primary).ref
+                    if fb is not None:
+                        fallback_from = primary.ref
+                out[name] = {"versions": vs, "serving": serving,
+                             "fallback_from": fallback_from}
+            return out
 
     def versions(self, name: str) -> List[int]:
         with self._lock:
@@ -376,6 +473,9 @@ class ModelRegistry:
         # bucket_ladder(max_batch_size, multiple_of=n) instead of erroring
         engine_kwargs.setdefault("max_batch_size", dep.buckets[-1])
         engine_kwargs.setdefault("name", dep.ref)
+        # share the deployment's breaker: trips observed by any engine make
+        # the registry route NEW lookups to the previous healthy version
+        engine_kwargs.setdefault("breaker", self._breaker_for(dep))
         eng = InferenceEngine(dep.adapter, **engine_kwargs)
         try:
             if dep.warmup_example is not None:
@@ -395,6 +495,7 @@ class ModelRegistry:
                 f"{dep.ref} ({dep.adapter.kind}) is not generative: deploy a "
                 "CausalLMAdapter to serve autoregressive decode")
         engine_kwargs.setdefault("name", dep.ref)
+        engine_kwargs.setdefault("breaker", self._breaker_for(dep))
         eng = dep.adapter.generation_engine(**engine_kwargs)
         try:
             return self._track(eng)

@@ -1,0 +1,312 @@
+"""Resilience primitives for the serving stack: bounded retry, per-
+deployment circuit breaking, and a dispatcher watchdog.
+
+The failure model follows from iteration-level scheduling (ORCA OSDI '22):
+because the engines fail *per batch / per iteration* rather than per
+process, every fault lands in one of three regimes, each with its own
+primitive:
+
+- **transient** (a single dispatch/prefill/decode call fails but the next
+  would succeed): :class:`RetryPolicy` — bounded attempts with
+  exponential backoff + deterministic seeded jitter. Futures are only
+  resolved after the final outcome, so a retried batch can never
+  double-deliver.
+- **persistent** (the deployment fails every call): :class:`CircuitBreaker`
+  — CLOSED→OPEN on a consecutive-failure threshold, fast typed
+  :class:`CircuitOpenError` shedding while OPEN (callers stop burning
+  queue budget and deadlines on a dead model), one HALF_OPEN probe after
+  the cooldown, probe outcome decides CLOSED vs back to OPEN.
+- **wedged** (the dispatcher thread itself hangs in a device call and
+  stops heartbeating): :class:`Watchdog` — a monitor thread that detects
+  a stale heartbeat while work is outstanding, fails the in-flight
+  futures with a typed :class:`WatchdogTimeoutError`, and invokes the
+  engine's recovery hook (epoch bump + state rebuild + fresh dispatcher
+  thread). The wedged thread becomes an epoch-stale zombie whose late
+  effects the engines suppress.
+
+All three surface in ``ServingMetrics`` (retries, breaker transitions,
+watchdog restarts) and therefore in ``/api/serving``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.admission import RejectedError
+
+
+class CircuitOpenError(RejectedError):
+    """Shed because the deployment's breaker is OPEN (reason
+    'circuit_open') — the typed fast-fail callers route around."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg, "circuit_open")
+
+
+class WatchdogTimeoutError(RejectedError):
+    """In-flight request failed by the dispatcher watchdog (reason
+    'watchdog'): the engine loop stopped heartbeating and was restarted."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg, "watchdog")
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Default retry classifier: an exception is retry-worthy iff it says
+    so (``transient=True`` attribute — FaultInjectedError and any backend
+    error a caller tags) AND it did not escape an already-executing
+    donated call (``donated_state_consumed=True``, stamped by the
+    generation engine): once a donated prefill/decode has dispatched, its
+    cache buffers may be consumed, so re-invoking would use-after-donate —
+    that failure must take the fail-tenants-and-rebuild path instead.
+    Deterministic model errors (bad input, shape mismatch) must NOT be
+    retried either: they re-fail and burn the latency budget of every
+    co-batched tenant."""
+    return bool(getattr(exc, "transient", False)) \
+        and not getattr(exc, "donated_state_consumed", False)
+
+
+class RetryPolicy:
+    """Bounded retry with exponential backoff and seeded jitter.
+
+    ``max_attempts`` counts the first try; backoff before attempt k is
+    ``base_delay_ms * 2^(k-1)``, capped at ``max_delay_ms``, scaled by a
+    deterministic jitter in [1, 1+jitter) drawn from a seeded PRNG —
+    chaos tests replay the exact same sleep schedule."""
+
+    def __init__(self, max_attempts: int = 3, base_delay_ms: float = 1.0,
+                 max_delay_ms: float = 50.0, jitter: float = 0.5,
+                 classify: Callable[[BaseException], bool] = is_transient,
+                 seed: int = 0):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base_delay_ms = base_delay_ms
+        self.max_delay_ms = max_delay_ms
+        self.jitter = jitter
+        self.classify = classify
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+
+    def backoff_ms(self, attempt: int) -> float:
+        """Delay before retry ``attempt`` (1-based)."""
+        base = min(self.base_delay_ms * (2.0 ** (attempt - 1)),
+                   self.max_delay_ms)
+        with self._lock:
+            u = float(self._rng.random())
+        return base * (1.0 + self.jitter * u)
+
+    def call(self, fn: Callable[[], object],
+             on_retry: Optional[Callable[[int, BaseException], None]] = None):
+        """Run ``fn`` with retries. ``on_retry(attempt, exc)`` fires before
+        each backoff sleep (the engines count retries there). The final
+        failure — non-transient, or attempts exhausted — propagates."""
+        attempt = 1
+        while True:
+            try:
+                return fn()
+            except BaseException as e:
+                if attempt >= self.max_attempts or not self.classify(e):
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                time.sleep(self.backoff_ms(attempt) / 1e3)
+                attempt += 1
+
+
+class CircuitBreaker:
+    """Per-deployment breaker: CLOSED -> OPEN after ``failure_threshold``
+    CONSECUTIVE failures; while OPEN, :meth:`allow` returns False (the
+    engine sheds with :class:`CircuitOpenError`) until ``cooldown_s``
+    elapses, then exactly ONE caller gets a HALF_OPEN probe; the probe's
+    outcome closes the breaker or re-opens it for another cooldown.
+
+    Thread-safe; transition listeners (``add_listener``) receive
+    ``(old_state, new_state)`` and feed ServingMetrics / registry health.
+    """
+
+    CLOSED = "CLOSED"
+    OPEN = "OPEN"
+    HALF_OPEN = "HALF_OPEN"
+
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 5.0,
+                 name: str = "", clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.name = name
+        self._clock = clock
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._probe_started = 0.0
+        self._listeners: List[Callable[[str, str], None]] = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- listeners
+    def add_listener(self, fn: Callable[[str, str], None]) -> "CircuitBreaker":
+        with self._lock:
+            self._listeners.append(fn)
+        return self
+
+    def remove_listener(self, fn: Callable[[str, str], None]):
+        """Engines sharing a deployment breaker detach their metrics
+        listener on shutdown — otherwise a long-lived registry spinning up
+        engines leaks one listener (and double-counts transitions) per
+        dead engine."""
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def _transition(self, new: str):
+        """Caller holds the lock. Listener callbacks run outside it."""
+        old, self._state = self._state, new
+        return old
+
+    def _notify(self, old: str, new: str):
+        for fn in list(self._listeners):
+            try:
+                fn(old, new)
+            except Exception:
+                pass  # a broken listener must not poison the breaker
+
+    # ---------------------------------------------------------------- state
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive
+
+    def allow(self) -> bool:
+        """Admission-time gate. CLOSED: always. OPEN: False until the
+        cooldown expires, then the first caller flips to HALF_OPEN and is
+        the probe. HALF_OPEN: only while no probe is outstanding — but a
+        probe older than another full cooldown is treated as LOST (the
+        probe request can die before ever reaching dispatch: shed on
+        deadline, queue-full, caller cancel — none of which report back)
+        and its permit is re-granted, so the breaker cannot wedge in
+        HALF_OPEN forever."""
+        notify = None
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            now = self._clock()
+            if self._state == self.OPEN:
+                if now - self._opened_at < self.cooldown_s:
+                    return False
+                notify = (self._transition(self.HALF_OPEN), self.HALF_OPEN)
+                self._probe_inflight = True
+                self._probe_started = now
+                ok = True
+            else:  # HALF_OPEN
+                if self._probe_inflight and \
+                        now - self._probe_started >= self.cooldown_s:
+                    self._probe_inflight = False   # lost probe: re-grant
+                ok = not self._probe_inflight
+                if ok:
+                    self._probe_inflight = True
+                    self._probe_started = now
+        if notify is not None:
+            self._notify(*notify)
+        return ok
+
+    def record_success(self):
+        notify = None
+        with self._lock:
+            self._consecutive = 0
+            self._probe_inflight = False
+            if self._state != self.CLOSED:
+                notify = (self._transition(self.CLOSED), self.CLOSED)
+        if notify is not None:
+            self._notify(*notify)
+
+    def record_failure(self):
+        notify = None
+        with self._lock:
+            self._consecutive += 1
+            self._probe_inflight = False
+            if self._state == self.HALF_OPEN or (
+                    self._state == self.CLOSED
+                    and self._consecutive >= self.failure_threshold):
+                self._opened_at = self._clock()
+                notify = (self._transition(self.OPEN), self.OPEN)
+            elif self._state == self.OPEN:
+                # a straggler failure while already OPEN re-arms the
+                # cooldown but is not a new transition
+                self._opened_at = self._clock()
+        if notify is not None:
+            self._notify(*notify)
+
+
+class Watchdog:
+    """Heartbeat monitor for an engine's dispatcher/scheduler thread.
+
+    The monitored loop calls :meth:`beat` once per iteration; the watchdog
+    thread wakes every ``interval_s`` and, when the heartbeat is older
+    than ``timeout_s`` AND ``busy()`` reports outstanding work, declares
+    the loop wedged and invokes ``on_stall()`` (the engine's recovery
+    hook: fail in-flight futures typed, bump the epoch so the zombie's
+    late effects are suppressed, rebuild donated state, start a fresh
+    thread). An idle loop blocked on an empty queue heartbeats on every
+    poll timeout and never trips.
+
+    Size ``timeout_s`` at N× the engine's deadline/worst dispatch (first
+    compiles included, or warm the engine first) — a false trip costs the
+    in-flight batch."""
+
+    def __init__(self, *, timeout_s: float, busy: Callable[[], bool],
+                 on_stall: Callable[[], None], name: str = "engine",
+                 interval_s: Optional[float] = None):
+        if timeout_s <= 0:
+            raise ValueError("timeout_s must be positive")
+        self.timeout_s = timeout_s
+        self._busy = busy
+        self._on_stall = on_stall
+        self._interval = interval_s if interval_s is not None else max(
+            timeout_s / 4.0, 0.01)
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self.restarts = 0
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serving-watchdog[{name}]", daemon=True)
+
+    def start(self) -> "Watchdog":
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self._last = time.monotonic()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            if time.monotonic() - self._last <= self.timeout_s:
+                continue
+            if not self._busy():
+                self.beat()  # idle staleness is not a stall
+                continue
+            self.restarts += 1
+            try:
+                self._on_stall()
+            except Exception:
+                pass  # recovery failure must not kill the monitor itself
+            self.beat()
+
+
+__all__ = ["RetryPolicy", "CircuitBreaker", "Watchdog", "CircuitOpenError",
+           "WatchdogTimeoutError", "is_transient"]

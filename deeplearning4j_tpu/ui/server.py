@@ -474,6 +474,22 @@ class _Handler(BaseHTTPRequestHandler):
                                     "generated_tokens_total",
                                     "generations_completed", "ttft_ms",
                                     "prefill_ms", "decode_step_ms")}
+                        # resilience roll-up (PR 3): retry/breaker/watchdog/
+                        # fallback counters + shedding causes, so "why is
+                        # this engine degraded" is one GET. Gated on the
+                        # new-format key so pre-PR-3 snapshots still render.
+                        if isinstance(latest, dict) \
+                                and "retries_total" in latest:
+                            entry["resilience"] = {
+                                k: latest.get(k) for k in (
+                                    "retries_total", "watchdog_restarts",
+                                    "fallback_serves",
+                                    "rejected_circuit_open",
+                                    "breaker_opened_total",
+                                    "breaker_half_open_total",
+                                    "breaker_closed_total",
+                                    "faults_injected_total",
+                                    "rejections_by_reason")}
                         out.append(entry)
             self._json(out)
             return

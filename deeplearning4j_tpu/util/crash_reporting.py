@@ -33,9 +33,12 @@ def crashDumpOutputDirectory(path: Optional[str]):
     _out_dir = path
 
 
-def writeMemoryCrashDump(model, exception: BaseException) -> Optional[str]:
+def writeMemoryCrashDump(model, exception: BaseException,
+                         context: Optional[dict] = None) -> Optional[str]:
     """Write the dump; returns the path (None when disabled or the dump
-    itself fails — crash reporting must never mask the original error)."""
+    itself fails — crash reporting must never mask the original error).
+    ``context`` adds caller-provided key/value lines (the serving engines
+    record which component/engine/bucket was dispatching when it died)."""
     if not _enabled:
         return None
     try:
@@ -71,6 +74,11 @@ def writeMemoryCrashDump(model, exception: BaseException) -> Optional[str]:
             lines.append(f"host max RSS: {rss_mb:.1f} MB")
         except ImportError:
             pass
+        if context:
+            lines.append("")
+            lines.append("---- context " + "-" * 52)
+            for k in sorted(context):
+                lines.append(f"{k}: {context[k]}")
         lines.append("")
         lines.append("---- model " + "-" * 54)
         lines.append(f"class: {type(model).__name__}")

@@ -43,6 +43,15 @@ class GracefulShutdown:
 
     def _handler(self, signum, frame):
         self._stop = True
+        # chain-call the handler we displaced so wrapping an outer
+        # GracefulShutdown (or any app-level handler) doesn't silently
+        # disable it. SIG_DFL/SIG_IGN aren't callable; the stock
+        # default_int_handler is excluded because chaining it would turn a
+        # graceful SIGINT into a KeyboardInterrupt mid-checkpoint —
+        # exactly what this class exists to prevent.
+        prev = self._prev.get(signum)
+        if callable(prev) and prev is not signal.default_int_handler:
+            prev(signum, frame)
 
     def should_stop(self) -> bool:
         return self._stop

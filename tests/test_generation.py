@@ -134,35 +134,43 @@ class TestGreedyGeneration:
             seq.append(ref[-1])
         assert out == ref
 
-    def test_engine_survives_jit_failure_with_cache_rebuild(self, params):
+    def test_engine_survives_jit_failure_with_cache_rebuild(
+            self, params, tmp_path):
         """A runtime failure in a donated prefill/decode call must not
         brick the engine: live tenants fail, the (possibly consumed) cache
-        is rebuilt, and the next request serves normally."""
-        with GenerationEngine(params, CFG, slots=2, max_len=32) as eng:
-            ref = eng.generate(prompt(5), max_new_tokens=4, timeout=120)
+        is rebuilt, and the next request serves normally. Crash dumps for
+        the (real, non-injected) failures land in tmp, not the cwd."""
+        from deeplearning4j_tpu.util import crash_reporting
 
-            real_prefill = eng._prefill
+        crash_reporting.crashDumpOutputDirectory(str(tmp_path))
+        try:
+            with GenerationEngine(params, CFG, slots=2, max_len=32) as eng:
+                ref = eng.generate(prompt(5), max_new_tokens=4, timeout=120)
 
-            def boom(*a, **kw):
-                raise RuntimeError("injected prefill failure")
+                real_prefill = eng._prefill
 
-            eng._prefill = boom
-            h = eng.submit(prompt(5), max_new_tokens=4)
-            with pytest.raises(RuntimeError, match="injected"):
-                h.result(timeout=30)
-            eng._prefill = real_prefill
-            assert eng.generate(prompt(5), max_new_tokens=4,
-                                timeout=120) == ref
+                def boom(*a, **kw):
+                    raise RuntimeError("injected prefill failure")
 
-            real_decode = eng._decode
-            mid = eng.submit(prompt(4, seed=2), max_new_tokens=8)
-            _wait_until_decoding(mid)
-            eng._decode = boom
-            with pytest.raises(RuntimeError, match="injected"):
-                mid.result(timeout=30)
-            eng._decode = real_decode
-            assert eng.generate(prompt(5), max_new_tokens=4,
-                                timeout=120) == ref
+                eng._prefill = boom
+                h = eng.submit(prompt(5), max_new_tokens=4)
+                with pytest.raises(RuntimeError, match="injected"):
+                    h.result(timeout=30)
+                eng._prefill = real_prefill
+                assert eng.generate(prompt(5), max_new_tokens=4,
+                                    timeout=120) == ref
+
+                real_decode = eng._decode
+                mid = eng.submit(prompt(4, seed=2), max_new_tokens=8)
+                _wait_until_decoding(mid)
+                eng._decode = boom
+                with pytest.raises(RuntimeError, match="injected"):
+                    mid.result(timeout=30)
+                eng._decode = real_decode
+                assert eng.generate(prompt(5), max_new_tokens=4,
+                                    timeout=120) == ref
+        finally:
+            crash_reporting.crashDumpOutputDirectory(None)
 
     def test_needs_causal_config(self, params):
         bidir = TransformerConfig(vocab_size=50, hidden=32, layers=2,

@@ -1,0 +1,737 @@
+"""Chaos tests for the serving resilience layer (PR 3): seeded
+deterministic fault injection (serving/faults.py) driven through the
+retry / circuit-breaker / watchdog / fallback machinery
+(serving/resilience.py + engine/generation/registry wiring).
+
+Every test here is seeded — the fault schedule is bit-for-bit identical
+on every run — and tier-1 fast; the soak variant rides the existing
+``stress`` marker. The module-wide acceptance property: under injected
+faults, every submitted request terminates with either a CORRECT result
+or a TYPED error (no hung futures, no double delivery)."""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import (
+    CircuitBreaker, CircuitOpenError, DeadlineExceededError,
+    FaultInjectedError, FaultPlan, GenerationEngine, InferenceEngine,
+    ModelAdapter, ModelRegistry, QueueFullError, RejectedError, RetryPolicy,
+    ServingMetrics, WatchdogTimeoutError,
+)
+from deeplearning4j_tpu.serving import faults as faults_mod
+from deeplearning4j_tpu.util import crash_reporting
+
+pytestmark = pytest.mark.chaos
+
+
+class EchoAdapter(ModelAdapter):
+    """Pure-numpy row-wise model: chaos tests measure the resilience
+    machinery, not XLA."""
+
+    def __init__(self, scale: float = 2.0):
+        super().__init__(model=None)
+        self.scale = scale
+        self.calls = 0
+
+    def infer(self, x):
+        self.calls += 1
+        return np.asarray(x) * self.scale
+
+
+@pytest.fixture(autouse=True)
+def _no_stray_fault_plan():
+    """A test that fails mid-``with plan:`` must not poison its neighbors."""
+    yield
+    if faults_mod.active_plan() is not None:
+        faults_mod.active_plan().uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _dumps_to_tmp(tmp_path):
+    """Crash forensics from deliberately-failed engines land in tmp."""
+    crash_reporting.crashDumpOutputDirectory(str(tmp_path))
+    yield tmp_path
+    crash_reporting.crashDumpOutputDirectory(None)
+
+
+# --------------------------------------------------------------------------
+# FaultPlan: determinism and the three fault kinds
+# --------------------------------------------------------------------------
+class TestFaultPlan:
+    def test_inactive_is_passthrough(self):
+        assert faults_mod.active_plan() is None
+        assert faults_mod.inject("engine.dispatch", lambda v: v + 1, 41) == 42
+
+    def test_index_faults_fire_exactly_at_indices(self):
+        plan = FaultPlan(seed=0).fail("p", at=(1, 3))
+        with plan:
+            for i in range(5):
+                if i in (1, 3):
+                    with pytest.raises(FaultInjectedError) as ei:
+                        faults_mod.inject("p", lambda: i)
+                    assert ei.value.transient and ei.value.injected
+                    assert ei.value.index == i
+                else:
+                    assert faults_mod.inject("p", lambda: i) == i
+        assert [e["index"] for e in plan.fired()] == [1, 3]
+        assert plan.calls("p") == 5
+
+    def test_rate_faults_replay_bit_for_bit(self):
+        def run(seed):
+            plan = FaultPlan(seed=seed).fail("p", rate=0.3)
+            hits = []
+            with plan:
+                for i in range(50):
+                    try:
+                        faults_mod.inject("p", lambda: None)
+                    except FaultInjectedError:
+                        hits.append(i)
+            return hits
+
+        a, b = run(7), run(7)
+        assert a == b and 0 < len(a) < 50          # same schedule, not all/none
+        assert run(8) != a                          # seed actually matters
+
+    def test_delay_and_poison(self):
+        plan = (FaultPlan(seed=0)
+                .delay("p", ms=30, at=(0,))
+                .poison("p", lambda y: y * 0 - 1, at=(1,)))
+        with plan:
+            t0 = time.perf_counter()
+            assert faults_mod.inject("p", lambda: np.ones(2)).sum() == 2
+            assert (time.perf_counter() - t0) * 1e3 >= 25
+            assert faults_mod.inject("p", lambda: np.ones(2)).sum() == -2
+        assert [e["kind"] for e in plan.fired()] == ["delay", "poison"]
+
+    def test_single_active_plan(self):
+        with FaultPlan() as _p:
+            with pytest.raises(RuntimeError, match="already installed"):
+                FaultPlan().install()
+        assert faults_mod.active_plan() is None
+
+
+# --------------------------------------------------------------------------
+# RetryPolicy / CircuitBreaker units
+# --------------------------------------------------------------------------
+class TestRetryPolicy:
+    def test_retries_transient_then_succeeds(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise FaultInjectedError("p", calls["n"])
+            return "ok"
+
+        seen = []
+        pol = RetryPolicy(max_attempts=3, base_delay_ms=0.1, seed=0)
+        assert pol.call(flaky, on_retry=lambda a, e: seen.append(a)) == "ok"
+        assert calls["n"] == 3 and seen == [1, 2]
+
+    def test_non_transient_raises_immediately(self):
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise ValueError("deterministic")
+
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=5, base_delay_ms=0.1).call(broken)
+        assert calls["n"] == 1
+
+    def test_attempts_exhausted_propagates(self):
+        with pytest.raises(FaultInjectedError):
+            RetryPolicy(max_attempts=2, base_delay_ms=0.1).call(
+                lambda: (_ for _ in ()).throw(FaultInjectedError("p", 0)))
+
+    def test_backoff_deterministic_and_bounded(self):
+        a = RetryPolicy(seed=3, base_delay_ms=2.0, max_delay_ms=8.0)
+        b = RetryPolicy(seed=3, base_delay_ms=2.0, max_delay_ms=8.0)
+        da = [a.backoff_ms(k) for k in (1, 2, 3, 4)]
+        db = [b.backoff_ms(k) for k in (1, 2, 3, 4)]
+        assert da == db
+        assert all(d <= 8.0 * 1.5 for d in da)      # cap * (1 + jitter)
+
+
+class TestCircuitBreaker:
+    def test_full_cycle_closed_open_half_open_closed(self):
+        seen = []
+        br = CircuitBreaker(failure_threshold=2, cooldown_s=0.05)
+        br.add_listener(lambda old, new: seen.append((old, new)))
+        assert br.allow() and br.state == "CLOSED"
+        br.record_failure()
+        assert br.state == "CLOSED"                 # 1 < threshold
+        br.record_failure()
+        assert br.state == "OPEN"
+        assert not br.allow()                       # cooling down
+        time.sleep(0.06)
+        assert br.allow()                           # the HALF_OPEN probe
+        assert br.state == "HALF_OPEN"
+        assert not br.allow()                       # one probe at a time
+        br.record_success()
+        assert br.state == "CLOSED" and br.allow()
+        assert seen == [("CLOSED", "OPEN"), ("OPEN", "HALF_OPEN"),
+                        ("HALF_OPEN", "CLOSED")]
+
+    def test_failed_probe_reopens(self):
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=0.05)
+        br.record_failure()
+        time.sleep(0.06)
+        assert br.allow() and br.state == "HALF_OPEN"
+        br.record_failure()
+        assert br.state == "OPEN"
+        assert not br.allow()                       # cooldown re-armed
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(failure_threshold=2)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == "CLOSED"
+
+    def test_lost_probe_permit_regrants_after_cooldown(self):
+        """A probe request can die before dispatch (shed, queue-full,
+        cancel) without reporting back; the permit must self-heal instead
+        of wedging the breaker in HALF_OPEN forever."""
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=0.05)
+        br.record_failure()
+        time.sleep(0.06)
+        assert br.allow()                      # probe granted ... and lost
+        assert not br.allow()                  # still outstanding
+        time.sleep(0.06)
+        assert br.allow()                      # lost probe re-granted
+        br.record_success()
+        assert br.state == "CLOSED"
+
+    def test_remove_listener_stops_notifications(self):
+        seen = []
+        br = CircuitBreaker(failure_threshold=1)
+        fn = lambda old, new: seen.append(new)   # noqa: E731
+        br.add_listener(fn)
+        br.record_failure()
+        br.remove_listener(fn)
+        br.record_success()
+        assert seen == ["OPEN"]                  # CLOSED transition unseen
+
+
+# --------------------------------------------------------------------------
+# InferenceEngine chaos
+# --------------------------------------------------------------------------
+class TestEngineChaos:
+    def test_retry_then_succeed_no_double_delivery(self):
+        plan = FaultPlan(seed=0).fail("engine.dispatch", at=(0,))
+        with InferenceEngine(EchoAdapter(), max_batch_size=4,
+                             max_wait_ms=0) as eng:
+            with plan:
+                out = eng.output(np.ones((2, 3), np.float32))
+            assert np.array_equal(out.toNumpy(), np.full((2, 3), 2.0))
+            assert eng.metrics.retries_total.value == 1
+            assert eng.metrics.failed_total.value == 0
+            assert eng.breaker.state == "CLOSED"
+        assert [e["point"] for e in plan.fired()] == ["engine.dispatch"]
+
+    def test_breaker_trips_sheds_typed_and_recovers(self):
+        plan = FaultPlan(seed=0).fail("engine.dispatch", at=(0, 1))
+        with InferenceEngine(
+                EchoAdapter(), max_batch_size=4, max_wait_ms=0,
+                retry_policy=RetryPolicy(max_attempts=1),   # no retries
+                breaker=CircuitBreaker(failure_threshold=2,
+                                       cooldown_s=0.1)) as eng:
+            with plan:
+                for _ in range(2):   # two consecutive batch failures
+                    with pytest.raises(FaultInjectedError):
+                        eng.output(np.ones((1, 3), np.float32))
+                assert eng.breaker.state == "OPEN"
+                with pytest.raises(CircuitOpenError) as ei:
+                    eng.submit(np.ones((1, 3), np.float32))
+                assert ei.value.reason == "circuit_open"
+                time.sleep(0.12)     # cooldown -> HALF_OPEN probe succeeds
+                out = eng.output(np.ones((1, 3), np.float32))
+                assert np.array_equal(out.toNumpy(), np.full((1, 3), 2.0))
+                assert eng.breaker.state == "CLOSED"
+            m = eng.metrics
+            assert m.breaker_opened_total.value == 1
+            assert m.breaker_half_open_total.value == 1
+            assert m.breaker_closed_total.value == 1
+            assert m.rejected_circuit_open.value == 1
+            assert m.rejections_by_reason.get("circuit_open") == 1
+
+    def test_watchdog_restart_no_lost_or_hung_futures(self):
+        plan = FaultPlan(seed=0).delay("engine.dispatch", ms=900, at=(0,))
+        with InferenceEngine(EchoAdapter(), max_batch_size=4,
+                             max_wait_ms=0) as eng:
+            eng.output(np.ones((1, 3), np.float32))   # warm the path
+            eng.arm_watchdog(150)
+            with plan:
+                hung = eng.submit(np.ones((1, 3), np.float32))
+                with pytest.raises(WatchdogTimeoutError) as ei:
+                    hung.result(timeout=30)
+                assert ei.value.reason == "watchdog"
+                # the restarted dispatcher serves the very next request
+                out = eng.output(np.ones((1, 3), np.float32))
+                assert np.array_equal(out.toNumpy(), np.full((1, 3), 2.0))
+            assert eng.watchdog_restarts == 1
+            assert eng.metrics.watchdog_restarts.value == 1
+            assert eng.metrics.rejections_by_reason.get("watchdog") == 1
+            time.sleep(0.8)   # let the zombie wake; it must exit harmlessly
+            out = eng.output(np.ones((1, 3), np.float32))
+            assert np.array_equal(out.toNumpy(), np.full((1, 3), 2.0))
+
+    def test_acceptance_every_request_terminates_under_dispatch_chaos(self):
+        """The PR acceptance property for the batch engine: seeded
+        transient dispatch faults + retry -> every future terminates with
+        a correct result or a typed error, never hangs."""
+        plan = FaultPlan(seed=11).fail("engine.dispatch", rate=0.2)
+        with InferenceEngine(
+                EchoAdapter(), max_batch_size=8, max_wait_ms=1.0,
+                retry_policy=RetryPolicy(max_attempts=3,
+                                         base_delay_ms=0.2)) as eng:
+            with plan:
+                futs = [eng.submit(np.full((1, 3), i, np.float32))
+                        for i in range(40)]
+                ok = failed = 0
+                for i, f in enumerate(futs):
+                    try:
+                        out = f.result(timeout=60)
+                        assert np.array_equal(out.toNumpy(),
+                                              np.full((1, 3), 2.0 * i))
+                        ok += 1
+                    except (FaultInjectedError, RejectedError):
+                        failed += 1
+                assert ok + failed == 40
+                assert ok > 0
+        assert plan.calls("engine.dispatch") >= 40 / 8
+
+    def test_injected_faults_never_write_crash_dumps(self, _dumps_to_tmp):
+        plan = FaultPlan(seed=0).fail("engine.dispatch", rate=1.0)
+        with InferenceEngine(EchoAdapter(), max_batch_size=2, max_wait_ms=0,
+                             retry_policy=RetryPolicy(max_attempts=2,
+                                                      base_delay_ms=0.1),
+                             breaker=CircuitBreaker(failure_threshold=50)
+                             ) as eng:
+            with plan:
+                with pytest.raises(FaultInjectedError):
+                    eng.output(np.ones((1, 3), np.float32))
+            assert eng.metrics.faults_injected_total.value >= 1
+        assert [f for f in os.listdir(_dumps_to_tmp)
+                if f.startswith("dl4jtpu-crash")] == []
+
+    def test_real_failure_dumps_once_with_serving_context(self,
+                                                          _dumps_to_tmp):
+        class _Boom(ModelAdapter):
+            def infer(self, x):
+                raise RuntimeError("device melted")
+
+        with InferenceEngine(_Boom(model=None), max_batch_size=2,
+                             max_wait_ms=0, name="boomer",
+                             breaker=CircuitBreaker(failure_threshold=50)
+                             ) as eng:
+            for _ in range(2):
+                with pytest.raises(RuntimeError, match="melted"):
+                    eng.output(np.ones((1, 3), np.float32))
+        dumps = [f for f in os.listdir(_dumps_to_tmp)
+                 if f.startswith("dl4jtpu-crash")]
+        assert len(dumps) == 1                       # first failure only
+        text = open(os.path.join(_dumps_to_tmp, dumps[0])).read()
+        assert "serving.InferenceEngine" in text and "boomer" in text
+
+    def test_queue_full_error_reports_depth_and_limit(self):
+        class _Slow(ModelAdapter):
+            def infer(self, x):
+                time.sleep(0.2)
+                return np.asarray(x)
+
+        with InferenceEngine(_Slow(model=None), max_batch_size=2,
+                             max_wait_ms=0, queue_capacity_rows=4) as eng:
+            eng.submit(np.ones((2, 4)))
+            time.sleep(0.05)                 # dispatcher occupied
+            eng.submit(np.ones((2, 4)))
+            eng.submit(np.ones((2, 4)))
+            with pytest.raises(QueueFullError) as ei:
+                eng.submit(np.ones((2, 4)))
+            assert ei.value.depth == 4 and ei.value.capacity == 4
+            assert "4 rows queued" in str(ei.value)
+            assert "capacity 4" in str(ei.value)
+            assert eng.metrics.rejections_by_reason.get("queue_full") == 1
+
+    def test_deadline_sheds_attributed_by_reason(self):
+        with InferenceEngine(EchoAdapter(), max_batch_size=4,
+                             max_wait_ms=0) as eng:
+            fut = eng.submit(np.zeros((1, 3), np.float32), timeout_ms=1e-4)
+            with pytest.raises(DeadlineExceededError):
+                fut.result(timeout=30)
+            assert eng.metrics.rejections_by_reason.get("deadline") >= 1
+
+
+# --------------------------------------------------------------------------
+# GenerationEngine chaos
+# --------------------------------------------------------------------------
+import jax  # noqa: E402  (conftest pins the CPU mesh first)
+import jax.numpy as jnp  # noqa: E402
+
+from deeplearning4j_tpu.models import TransformerConfig, init_params  # noqa: E402
+
+CFG = TransformerConfig(vocab_size=64, hidden=32, layers=2, heads=2,
+                        mlp_dim=64, max_seq=32, dtype=jnp.float32,
+                        causal=True)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def clean_streams(params):
+    """Reference streams decoded with no faults installed."""
+    out = {}
+    with GenerationEngine(params, CFG, slots=2, max_len=32) as eng:
+        for seed in (0, 1):
+            out[seed] = eng.generate(_prompt(5, seed), max_new_tokens=6,
+                                     timeout=120)
+    return out
+
+
+def _prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n).astype(np.int32)
+
+
+class TestGenerationChaos:
+    def test_transient_prefill_and_decode_faults_bitwise_clean(
+            self, params, clean_streams):
+        """Acceptance: transient faults in BOTH generation injection
+        points, absorbed by retry — the streams are bitwise identical to
+        the fault-free engine (the retried call re-runs against the intact
+        donated cache)."""
+        plan = (FaultPlan(seed=5)
+                .fail("generation.prefill", at=(0,))
+                .fail("generation.decode_step", at=(1, 4)))
+        with GenerationEngine(params, CFG, slots=2, max_len=32) as eng:
+            with plan:
+                a = eng.generate(_prompt(5, 0), max_new_tokens=6, timeout=120)
+                b = eng.generate(_prompt(5, 1), max_new_tokens=6, timeout=120)
+            assert a == clean_streams[0]
+            assert b == clean_streams[1]
+            assert eng.metrics.retries_total.value == 3
+            assert eng.metrics.failed_total.value == 0
+            assert eng.compiled_signatures() <= len(eng.buckets) + 1
+        assert len(plan.fired()) == 3
+
+    def test_exhausted_retries_fail_typed_and_engine_recovers(
+            self, params, clean_streams):
+        plan = FaultPlan(seed=0).fail("generation.decode_step", rate=1.0)
+        with GenerationEngine(
+                params, CFG, slots=2, max_len=32,
+                retry_policy=RetryPolicy(max_attempts=2, base_delay_ms=0.1),
+                breaker=CircuitBreaker(failure_threshold=50)) as eng:
+            with plan:
+                h = eng.submit(_prompt(5, 0), max_new_tokens=6)
+                with pytest.raises(FaultInjectedError):
+                    h.result(timeout=60)
+            # plan gone: the rebuilt cache serves the reference stream
+            assert eng.generate(_prompt(5, 0), max_new_tokens=6,
+                                timeout=120) == clean_streams[0]
+            assert eng.compiled_signatures() <= len(eng.buckets) + 1
+
+    def test_breaker_cycle_observable_in_metrics(self, params, clean_streams):
+        """CLOSED→OPEN→HALF_OPEN→CLOSED on the generation path, observable
+        through the metrics counters (acceptance criterion)."""
+        plan = FaultPlan(seed=0).fail("generation.prefill", at=(0, 1))
+        with GenerationEngine(
+                params, CFG, slots=2, max_len=32,
+                retry_policy=RetryPolicy(max_attempts=1),
+                breaker=CircuitBreaker(failure_threshold=2,
+                                       cooldown_s=0.1)) as eng:
+            with plan:
+                for _ in range(2):
+                    with pytest.raises(FaultInjectedError):
+                        eng.generate(_prompt(5, 0), max_new_tokens=2,
+                                     timeout=60)
+                assert eng.breaker.state == "OPEN"
+                with pytest.raises(CircuitOpenError):
+                    eng.submit(_prompt(5, 0), max_new_tokens=2)
+                time.sleep(0.12)
+                got = eng.generate(_prompt(5, 0), max_new_tokens=6,
+                                   timeout=120)   # HALF_OPEN probe, succeeds
+                assert got == clean_streams[0]
+            m = eng.metrics
+            assert eng.breaker.state == "CLOSED"
+            assert m.breaker_opened_total.value == 1
+            assert m.breaker_half_open_total.value == 1
+            assert m.breaker_closed_total.value == 1
+            assert m.rejections_by_reason.get("circuit_open") == 1
+
+    def test_watchdog_restart_preserves_signature_bound(
+            self, params, clean_streams):
+        """A decode hang trips the watchdog: live generations fail typed,
+        the queue survives, the rebuilt engine serves bitwise-clean
+        streams, and compiled_signatures() stays within bounds
+        (acceptance criterion)."""
+        plan = FaultPlan(seed=0).delay("generation.decode_step", ms=900,
+                                       at=(2,))
+        with GenerationEngine(params, CFG, slots=2, max_len=32) as eng:
+            eng.generate(_prompt(5, 0), max_new_tokens=2, timeout=120)
+            eng.arm_watchdog(200)
+            with plan:
+                h = eng.submit(_prompt(5, 0), max_new_tokens=8)
+                with pytest.raises(WatchdogTimeoutError) as ei:
+                    h.result(timeout=60)
+                assert ei.value.reason == "watchdog"
+            assert eng.watchdog_restarts == 1
+            assert eng.metrics.watchdog_restarts.value == 1
+            time.sleep(1.0)    # zombie wakes against its abandoned cache
+            assert eng.generate(_prompt(5, 0), max_new_tokens=6,
+                                timeout=120) == clean_streams[0]
+            assert eng.compiled_signatures() <= len(eng.buckets) + 1
+
+    def test_transient_tag_on_executed_donated_call_is_not_retried(
+            self, params, clean_streams):
+        """A REAL failure that escapes an already-executing donated call
+        may have consumed the cache: even if it is tagged transient, the
+        retry layer must refuse it (use-after-donate) and take the
+        fail-tenants-and-rebuild path instead."""
+        class _TaggedError(RuntimeError):
+            transient = True   # lies: raised mid-execution, cache consumed
+
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              breaker=CircuitBreaker(failure_threshold=50)
+                              ) as eng:
+            eng.generate(_prompt(5, 0), max_new_tokens=2, timeout=120)
+            real_decode = eng._decode
+
+            def mid_execution_boom(*a, **kw):
+                raise _TaggedError("device died mid-step")
+
+            eng._decode = mid_execution_boom
+            h = eng.submit(_prompt(5, 0), max_new_tokens=6)
+            with pytest.raises(_TaggedError):
+                h.result(timeout=60)
+            assert eng.metrics.retries_total.value == 0   # never re-invoked
+            eng._decode = real_decode
+            assert eng.generate(_prompt(5, 0), max_new_tokens=6,
+                                timeout=120) == clean_streams[0]
+
+    def test_engine_shutdown_detaches_breaker_listener(self):
+        br = CircuitBreaker(failure_threshold=50)
+        engines = []
+        for _ in range(3):
+            eng = InferenceEngine(EchoAdapter(), max_batch_size=2,
+                                  max_wait_ms=0, breaker=br)
+            engines.append(eng)
+            eng.shutdown()
+        assert br._listeners == []      # no leak across engine lifetimes
+        live = InferenceEngine(EchoAdapter(), max_batch_size=2,
+                               max_wait_ms=0, breaker=br)
+        try:
+            br.record_failure()
+            for dead in engines:        # dead engines saw nothing
+                assert dead.metrics.breaker_opened_total.value == 0
+        finally:
+            live.shutdown()
+
+    def test_queue_full_error_in_request_units(self, params):
+        with GenerationEngine(params, CFG, slots=1, max_len=32,
+                              queue_capacity=1) as eng:
+            blocker = eng.submit(_prompt(2, 0), max_new_tokens=20)
+            deadline = time.time() + 60
+            while eng.live_slots == 0:
+                assert time.time() < deadline
+                time.sleep(0.001)
+            eng.submit(_prompt(2, 1), max_new_tokens=2)
+            with pytest.raises(QueueFullError) as ei:
+                eng.submit(_prompt(2, 2), max_new_tokens=2)
+            assert ei.value.depth == 1 and ei.value.capacity == 1
+            assert "requests" in str(ei.value)
+            blocker.result(timeout=120)
+
+
+# --------------------------------------------------------------------------
+# Registry: warmup injection, fallback routing, health surface
+# --------------------------------------------------------------------------
+class TestRegistryResilience:
+    def test_warmup_fault_rolls_back_deploy(self):
+        plan = FaultPlan(seed=0).fail("registry.warmup", at=(0,))
+        with ModelRegistry() as reg:
+            with plan:
+                with pytest.raises(FaultInjectedError):
+                    reg.deploy("m", EchoAdapter(),
+                               warmup_example=np.zeros(4, np.float32))
+            assert reg.models() == {}           # failed deploy left no trace
+            reg.deploy("m", EchoAdapter(),
+                       warmup_example=np.zeros(4, np.float32))
+            assert reg.versions("m") == [1]
+
+    def test_open_breaker_falls_back_to_previous_healthy_version(self):
+        with ModelRegistry(breaker_failure_threshold=2,
+                           breaker_cooldown_s=60.0) as reg:
+            reg.deploy("m", EchoAdapter(scale=1.0))
+            d2 = reg.deploy("m", EchoAdapter(scale=2.0))
+            reg.alias("prod", "m")
+            br = reg._breaker_for(d2)
+            br.record_failure(), br.record_failure()
+            assert br.state == "OPEN"
+            # alias-aware fallback: prod -> m -> m:2(OPEN) -> m:1
+            assert reg.get("prod").version == 1
+            assert reg.get("m:2").version == 1   # pinned ref falls back too
+            assert reg.get("m:2", fallback=False).version == 2
+            assert reg.metrics.fallback_serves.value >= 2
+            eng = reg.engine("prod", max_wait_ms=0)
+            out = eng.output(np.ones((1, 4), np.float32))
+            assert float(np.asarray(out.jax)[0, 0]) == 1.0   # v1 served
+
+    def test_health_states_and_serving_ref(self):
+        with ModelRegistry(breaker_failure_threshold=1,
+                           breaker_cooldown_s=60.0) as reg:
+            reg.deploy("m", EchoAdapter(scale=1.0))
+            d2 = reg.deploy("m", EchoAdapter(scale=2.0))
+            h = reg.health()
+            assert h["m"]["versions"][1]["state"] == "SERVING"
+            assert h["m"]["versions"][2]["state"] == "SERVING"
+            assert h["m"]["serving"] == "m:2" and h["m"]["fallback_from"] is None
+            br = reg._breaker_for(d2)
+            br.record_failure()
+            h = reg.health()
+            assert h["m"]["versions"][2]["state"] == "CIRCUIT_OPEN"
+            assert h["m"]["serving"] == "m:1"
+            assert h["m"]["fallback_from"] == "m:2"
+            # HALF_OPEN (probe pending) reads as DEGRADED
+            br._clock = lambda: time.monotonic() + 120.0
+            assert br.allow()
+            assert reg.health()["m"]["versions"][2]["state"] == "DEGRADED"
+
+    def test_engine_failures_trip_shared_deployment_breaker(self):
+        class _Boom(ModelAdapter):
+            def infer(self, x):
+                raise RuntimeError("dead version")
+
+        with ModelRegistry(breaker_failure_threshold=1,
+                           breaker_cooldown_s=60.0) as reg:
+            reg.deploy("m", EchoAdapter(scale=1.0))
+            reg.deploy("m", _Boom(model=None))
+            eng = reg.engine("m", max_wait_ms=0)
+            with pytest.raises(RuntimeError, match="dead version"):
+                eng.output(np.ones((1, 4), np.float32))
+            # the engine's failure tripped the DEPLOYMENT breaker: new
+            # lookups route to v1 and health reflects it
+            assert reg.get("m").version == 1
+            assert reg.health()["m"]["serving"] == "m:1"
+
+
+# --------------------------------------------------------------------------
+# Metrics / UI surface
+# --------------------------------------------------------------------------
+class TestResilienceObservability:
+    def test_snapshot_carries_resilience_counters(self):
+        m = ServingMetrics()
+        m.retries_total.inc(3)
+        m.record_rejection("circuit_open")
+        m.record_breaker_transition("CLOSED", "OPEN")
+        snap = m.snapshot()
+        assert snap["retries_total"] == 3
+        assert snap["breaker_opened_total"] == 1
+        assert snap["rejections_by_reason"] == {"circuit_open": 1.0}
+        import json
+        json.dumps(snap)
+
+    def test_api_serving_exposes_resilience_rollup(self):
+        import json
+        import urllib.request
+
+        from deeplearning4j_tpu.ui import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+        plan = FaultPlan(seed=0).fail("engine.dispatch", at=(0,))
+        with InferenceEngine(EchoAdapter(), max_batch_size=4,
+                             max_wait_ms=0) as eng:
+            with plan:
+                eng.output(np.ones((1, 3), np.float32))
+            storage = InMemoryStatsStorage()
+            eng.metrics.publish(storage)
+        server = UIServer(port=0)
+        try:
+            server.attach(storage)
+            with urllib.request.urlopen(server.url + "api/serving",
+                                        timeout=5) as r:
+                entries = json.loads(r.read().decode())
+            assert len(entries) == 1
+            res = entries[0]["resilience"]
+            assert res["retries_total"] == 1
+            assert res["watchdog_restarts"] == 0
+            assert res["rejections_by_reason"] == {}
+        finally:
+            server.stop()
+
+
+# --------------------------------------------------------------------------
+# GracefulShutdown handler chaining (satellite)
+# --------------------------------------------------------------------------
+class TestGracefulShutdownChaining:
+    def test_outer_handler_chain_called(self):
+        from deeplearning4j_tpu.util.sharded_checkpoint import GracefulShutdown
+
+        outer_calls = []
+        prev = signal.signal(signal.SIGTERM,
+                             lambda s, f: outer_calls.append(s))
+        try:
+            with GracefulShutdown(signals=(signal.SIGTERM,)) as gs:
+                signal.raise_signal(signal.SIGTERM)
+                assert gs.should_stop()
+                assert outer_calls == [signal.SIGTERM]   # chained, not dropped
+            # __exit__ restored the outer handler
+            signal.raise_signal(signal.SIGTERM)
+            assert outer_calls == [signal.SIGTERM] * 2
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_default_int_handler_not_chained(self):
+        from deeplearning4j_tpu.util.sharded_checkpoint import GracefulShutdown
+
+        prev = signal.signal(signal.SIGINT, signal.default_int_handler)
+        try:
+            with GracefulShutdown(signals=(signal.SIGINT,)) as gs:
+                signal.raise_signal(signal.SIGINT)   # no KeyboardInterrupt
+                assert gs.should_stop()
+        finally:
+            signal.signal(signal.SIGINT, prev)
+
+
+# --------------------------------------------------------------------------
+# Soak (stress-marked: out of tier-1)
+# --------------------------------------------------------------------------
+@pytest.mark.stress
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_sustained_traffic_under_rate_faults(self):
+        plan = FaultPlan(seed=1).fail("engine.dispatch", rate=0.1)
+        with InferenceEngine(
+                EchoAdapter(), max_batch_size=8, max_wait_ms=1.0,
+                retry_policy=RetryPolicy(max_attempts=4,
+                                         base_delay_ms=0.2)) as eng:
+            with plan:
+                errs, oks = [], []
+
+                def client(k):
+                    for i in range(50):
+                        try:
+                            out = eng.output(
+                                np.full((1, 3), k * 100 + i, np.float32))
+                            assert np.array_equal(
+                                out.toNumpy(),
+                                np.full((1, 3), 2.0 * (k * 100 + i)))
+                            oks.append(1)
+                        except (FaultInjectedError, RejectedError):
+                            errs.append(1)
+
+                threads = [threading.Thread(target=client, args=(k,))
+                           for k in range(8)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=120)
+                assert len(oks) + len(errs) == 400
+                assert len(oks) > 300   # retries absorb most of the 10%

@@ -268,17 +268,30 @@ class TestAdmissionControl:
         ac.admit(Request(x="d", rows=3))
         assert ac.take(4, timeout=0.0) is keep2
 
-    def test_model_error_propagates_to_futures(self):
+    def test_model_error_propagates_to_futures(self, tmp_path):
+        import os
+
+        from deeplearning4j_tpu.util import crash_reporting
+
         class _Boom(ModelAdapter):
             def infer(self, x):
                 raise RuntimeError("kernel exploded")
 
-        with InferenceEngine(_Boom(model=None), max_batch_size=4,
-                             max_wait_ms=0) as eng:
-            fut = eng.submit(np.ones((1, 4)))
-            with pytest.raises(RuntimeError, match="kernel exploded"):
-                fut.result(timeout=30)
-            assert eng.metrics.failed_total.value == 1
+        crash_reporting.crashDumpOutputDirectory(str(tmp_path))
+        try:
+            with InferenceEngine(_Boom(model=None), max_batch_size=4,
+                                 max_wait_ms=0) as eng:
+                fut = eng.submit(np.ones((1, 4)))
+                with pytest.raises(RuntimeError, match="kernel exploded"):
+                    fut.result(timeout=30)
+                assert eng.metrics.failed_total.value == 1
+            # serving crashes get the training path's forensics (PR 3):
+            # the first unexpected dispatch failure wrote a crash dump
+            dumps = [f for f in os.listdir(tmp_path)
+                     if f.startswith("dl4jtpu-crash")]
+            assert len(dumps) == 1
+        finally:
+            crash_reporting.crashDumpOutputDirectory(None)
 
 
 class TestModelRegistry:
