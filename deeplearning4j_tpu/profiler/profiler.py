@@ -149,11 +149,22 @@ class OpProfiler:
             d["mean_ms"] = d["total_ms"] / d["count"]
         return agg
 
-    def export_chrome_trace(self, path: str) -> str:
+    def export_chrome_trace(self, path: str, tracer=None) -> str:
+        """Chrome-trace JSON of the collected spans (pid 1). Pass a
+        ``serving.tracing.Tracer`` to merge its retained request traces
+        into the same file on the same perf_counter clock — serving lanes
+        (one pid per engine, one tid per request) render beside the
+        training spans in one Perfetto view."""
         events = [{"name": s.name, "ph": "X", "ts": s.start_us,
                    "dur": s.dur_us, "pid": 1, "tid": s.tid,
                    **({"args": s.args} if s.args else {})}
                   for s in self.spans]
+        if tracer is not None:
+            # name this process's lane only in the merged view (the
+            # plain export stays exactly the span events)
+            events.append({"ph": "M", "name": "process_name", "pid": 1,
+                           "args": {"name": "training"}})
+            events.extend(tracer.chrome_events(t0=self._t0))
         with open(path, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
         return path

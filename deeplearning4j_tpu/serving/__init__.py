@@ -17,21 +17,30 @@ from deeplearning4j_tpu.serving.generation import (  # noqa: F401
 )
 from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, ReasonCounter, ServingMetrics,
+    SlidingWindowStats,
 )
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     CausalLMAdapter, Deployment, ModelAdapter, ModelRegistry, as_adapter,
 )
 from deeplearning4j_tpu.serving.resilience import (  # noqa: F401
-    CircuitBreaker, CircuitOpenError, RetryPolicy, Watchdog,
-    WatchdogTimeoutError,
+    CircuitBreaker, CircuitOpenError, PoisonedResultError,
+    ResilientEngineMixin, RetryPolicy, Watchdog, WatchdogTimeoutError,
 )
+from deeplearning4j_tpu.serving.tracing import (  # noqa: F401
+    FlightRecorder, RequestTrace, Tracer, all_tracers, default_tracer,
+    flight_recorder, terminal_reason,
+)
+from deeplearning4j_tpu.serving import tracing as tracing  # noqa: F401
 
 __all__ = [
     "AdmissionController", "DeadlineExceededError", "QueueFullError",
     "RejectedError", "InferenceEngine", "bucket_ladder", "Counter", "Gauge",
-    "Histogram", "ReasonCounter", "ServingMetrics", "Deployment",
-    "ModelAdapter", "ModelRegistry", "as_adapter", "GenerationEngine",
-    "GenerationHandle", "prefill_buckets", "CausalLMAdapter", "FaultPlan",
-    "FaultInjectedError", "inject", "RetryPolicy", "CircuitBreaker",
-    "Watchdog", "CircuitOpenError", "WatchdogTimeoutError",
+    "Histogram", "ReasonCounter", "ServingMetrics", "SlidingWindowStats",
+    "Deployment", "ModelAdapter", "ModelRegistry", "as_adapter",
+    "GenerationEngine", "GenerationHandle", "prefill_buckets",
+    "CausalLMAdapter", "FaultPlan", "FaultInjectedError", "inject",
+    "RetryPolicy", "CircuitBreaker", "Watchdog", "CircuitOpenError",
+    "PoisonedResultError", "ResilientEngineMixin", "WatchdogTimeoutError",
+    "Tracer", "RequestTrace", "FlightRecorder", "flight_recorder",
+    "default_tracer", "all_tracers", "terminal_reason", "tracing",
 ]

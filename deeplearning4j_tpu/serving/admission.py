@@ -17,6 +17,8 @@ from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from typing import Optional
 
+from deeplearning4j_tpu.serving.tracing import NULL_TRACE
+
 
 class RejectedError(RuntimeError):
     """Request refused by admission control. ``reason`` is machine-readable:
@@ -54,6 +56,9 @@ class Request:
     future: Future = field(default_factory=Future)
     submit_t: float = field(default_factory=time.perf_counter)
     deadline_t: Optional[float] = None   # perf_counter timestamp, or None
+    # request-scoped trace (serving/tracing.py). NULL_TRACE is the shared
+    # no-op singleton, so un-sampled requests pay nothing here
+    trace: object = NULL_TRACE
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline_t is None:
@@ -86,10 +91,17 @@ class AdmissionController:
         self._cv = threading.Condition()
         self._closed = False
         self.shed_count = 0
-        # observer hook: called with each shed Request AFTER its future is
-        # failed (the engine wires its rejection counters here so sheds at
-        # dequeue time and at dispatch time land in the same metrics)
+        # observer hooks: called with each shed / close-rejected Request
+        # AFTER its future is failed (the engine wires its rejection
+        # counters + SLO outcomes here so terminals from every path land
+        # in the same metrics). Neither fires for a request whose terminal
+        # someone else already delivered.
         self.on_shed: Optional[callable] = None
+        self.on_close_reject: Optional[callable] = None
+        # a queued future that is already done when we try to fail it can
+        # only have been cancelled by the caller (the watchdog only fails
+        # in-flight work): this hook records that terminal instead
+        self.on_cancelled: Optional[callable] = None
 
     # ------------------------------------------------------------- metrics
     @property
@@ -120,20 +132,31 @@ class AdmissionController:
                     capacity=self.capacity_rows)
             self._q.append(req)
             self._rows += req.rows
+            depth = self._rows
             self._cv.notify()
+        req.trace.event("queue.admit", depth=depth, unit=self.unit)
         return req
 
     # -------------------------------------------------------- dispatch side
     def _shed(self, req: Request):
         self.shed_count += 1
+        waited_ms = (time.perf_counter() - req.submit_t) * 1e3
+        req.trace.event("queue.shed", waited_ms=round(waited_ms, 3))
+        delivered = True
         try:
             req.future.set_exception(DeadlineExceededError(
-                f"deadline exceeded after "
-                f"{(time.perf_counter() - req.submit_t) * 1e3:.1f} ms in queue"))
+                f"deadline exceeded after {waited_ms:.1f} ms in queue"))
         except InvalidStateError:
-            pass  # caller cancelled the future while it was queued
+            # the caller cancelled this future while it was queued — that
+            # IS the terminal; record it as such (not as a shed)
+            delivered = False
+        if not delivered:
+            self._cancelled(req)
+            return
         if self.on_shed is not None:
-            self.on_shed(req)
+            self.on_shed(req)   # engine hook: metrics + trace terminal
+        else:
+            req.trace.finish("deadline", latency_ms=waited_ms)
 
     def take(self, max_rows: int, timeout: float) -> Optional[Request]:
         """Pop the head request if it fits in ``max_rows``; block up to
@@ -212,4 +235,15 @@ class AdmissionController:
                     RejectedError("engine shut down with request queued",
                                   "shutdown"))
             except InvalidStateError:
-                pass
+                self._cancelled(req)   # caller-cancelled while queued
+                continue
+            if self.on_close_reject is not None:
+                self.on_close_reject(req)
+            else:
+                req.trace.finish("shutdown")
+
+    def _cancelled(self, req: Request):
+        if self.on_cancelled is not None:
+            self.on_cancelled(req)
+        else:
+            req.trace.finish("cancelled")

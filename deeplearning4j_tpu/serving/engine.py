@@ -48,9 +48,10 @@ from deeplearning4j_tpu.serving.admission import (
 from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.resilience import (
-    CircuitBreaker, CircuitOpenError, RetryPolicy, Watchdog,
-    WatchdogTimeoutError,
+    CircuitBreaker, CircuitOpenError, PoisonedResultError,
+    ResilientEngineMixin, RetryPolicy, WatchdogTimeoutError,
 )
+from deeplearning4j_tpu.serving.tracing import terminal_reason
 
 
 def bucket_ladder(max_batch_size: int, multiple_of: int = 1,
@@ -71,7 +72,7 @@ def bucket_ladder(max_batch_size: int, multiple_of: int = 1,
     return tuple(out)
 
 
-class InferenceEngine:
+class InferenceEngine(ResilientEngineMixin):
     """Future-based batching front-end for one deployed model.
 
     ``submit(x)`` enqueues ``x`` (batch-major, 1..max_batch_size rows) and
@@ -83,8 +84,14 @@ class InferenceEngine:
     ``max_batch_size`` ≙ batchLimit, ``max_wait_ms`` is the batching
     window (the reference's nanotime spin in BatchedInferenceObservable),
     ``queue_capacity_rows``/``default_timeout_ms`` are the admission
-    bounds, ``buckets`` overrides the padding ladder.
-    """
+    bounds, ``buckets`` overrides the padding ladder. ``tracer`` opts the
+    engine into request-scoped tracing (serving/tracing.py; defaults to
+    the process tracer, which is off until configured) and
+    ``screen_outputs`` is the cheap NaN/inf poisoned-result guard on
+    every dispatch output."""
+
+    _COMPONENT = "serving.InferenceEngine"
+    _FAILURE_NOUN = "dispatch"
 
     def __init__(self, model, *, mesh=None, max_batch_size: int = 32,
                  max_wait_ms: float = 5.0,
@@ -96,6 +103,7 @@ class InferenceEngine:
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  watchdog_timeout_ms: Optional[float] = None,
+                 tracer=None, recorder=None, screen_outputs: bool = True,
                  name: str = "engine"):
         from deeplearning4j_tpu.serving.registry import ModelAdapter, as_adapter
 
@@ -125,26 +133,18 @@ class InferenceEngine:
             capacity_rows=queue_capacity_rows,
             default_timeout_ms=default_timeout_ms)
         self._admission.on_shed = self._count_shed
+        self._admission.on_close_reject = self._count_close_reject
+        self._admission.on_cancelled = self._count_cancelled
         self._seen_buckets: set = set()
         self._row_sig = None  # (feature shape, dtype) pinned by first request
         self._seen_lock = threading.Lock()
         self._stop = threading.Event()
-        # ---- resilience layer (serving/resilience.py design notes) -------
-        # default RetryPolicy retries only transient-tagged failures, so a
-        # deterministic model error still fails fast; default breaker opens
-        # after 5 consecutive batch failures. Pass explicit instances to
-        # share a breaker across engines of one deployment (the registry
-        # does) or to disable retries (max_attempts=1).
-        self._retry = retry_policy if retry_policy is not None \
-            else RetryPolicy()
-        self._breaker = breaker if breaker is not None \
-            else CircuitBreaker(name=self.name)
-        self._breaker.add_listener(self.metrics.record_breaker_transition)
-        self._epoch = 0          # bumped by the watchdog; stales zombies
+        self.screen_outputs = screen_outputs
+        # resilience + observability scaffolding is the shared mixin
+        # (serving/resilience.py ResilientEngineMixin design notes)
+        self._init_resilience(retry_policy=retry_policy, breaker=breaker,
+                              tracer=tracer, recorder=recorder)
         self._inflight: List[Request] = []
-        self._wd_lock = threading.Lock()
-        self._crash_dumped = False
-        self._watchdog: Optional[Watchdog] = None
         self._thread = threading.Thread(
             target=self._loop, args=(0,),
             name=f"serving-dispatcher[{self.name}]", daemon=True)
@@ -161,13 +161,10 @@ class InferenceEngine:
 
     def shutdown(self, wait: bool = True):
         """Stop the dispatcher; queued requests are rejected ('shutdown')."""
-        if self._watchdog is not None:   # no restarts during teardown
-            self._watchdog.stop()
+        self._shutdown_resilience()   # watchdog off, breaker detached
         self._stop.set()
         self._admission.close()
-        # the breaker may outlive this engine (shared per deployment):
-        # detach our metrics listener so dead engines don't accumulate
-        self._breaker.remove_listener(self.metrics.record_breaker_transition)
+        self._recorder.record("engine.shutdown", engine=self.name)
         if wait and self._thread.is_alive():
             self._thread.join(timeout=5.0)
 
@@ -185,25 +182,14 @@ class InferenceEngine:
                 f"{self.max_batch_size}; split the call")
         self._check_row_sig(arr.shape[1:], arr.dtype)
         self.metrics.requests_total.inc()
-        if not self._breaker.allow():
-            self.metrics.rejected_total.inc()
-            self.metrics.rejected_circuit_open.inc()
-            self.metrics.record_rejection("circuit_open")
-            raise CircuitOpenError(
-                f"circuit open for engine[{self.name}] after "
-                f"{self._breaker.consecutive_failures} consecutive dispatch "
-                f"failures; retry after the cooldown")
-        req = Request(x=arr, rows=int(arr.shape[0]))
+        trace = self._tracer.begin(self.name, "infer",
+                                   rows=int(arr.shape[0]))
+        self._breaker_gate(trace)
+        req = Request(x=arr, rows=int(arr.shape[0]), trace=trace)
         try:
             self._admission.admit(req, timeout_ms=timeout_ms)
-        except QueueFullError:
-            self.metrics.rejected_total.inc()
-            self.metrics.rejected_queue_full.inc()
-            self.metrics.record_rejection("queue_full")
-            raise
         except RejectedError as e:
-            self.metrics.rejected_total.inc()
-            self.metrics.record_rejection(e.reason)
+            self._reject_submit(trace, e)
             raise
         self.metrics.queue_depth.set(self._admission.depth_rows)
         return req.future
@@ -260,10 +246,12 @@ class InferenceEngine:
             try:
                 self._dispatch(batch)
             except BaseException as e:  # never kill the dispatcher thread
+                reason = terminal_reason(e)
                 for req in batch:
                     if not req.future.done():
                         try:
                             req.future.set_exception(e)
+                            self._finish_request(req.trace, reason)
                         except InvalidStateError:
                             pass
             finally:
@@ -282,35 +270,21 @@ class InferenceEngine:
                 req = self._admission.take(self.max_batch_size, timeout=0.0)
                 if req is None:
                     break
-                if not req.future.done():
-                    try:
-                        req.future.set_exception(
-                            RejectedError("engine shut down", "shutdown"))
-                    except InvalidStateError:
-                        pass
-                    self.metrics.record_rejection("shutdown")
-
-    def _count_shed(self, req):
-        self.metrics.rejected_total.inc()
-        self.metrics.rejected_deadline.inc()
-        self.metrics.record_rejection("deadline")
+                if req.future.done():
+                    # a still-queued future can only be done because the
+                    # caller cancelled it: that terminal counts too
+                    self._count_cancelled(req)
+                    continue
+                try:
+                    req.future.set_exception(
+                        RejectedError("engine shut down", "shutdown"))
+                except InvalidStateError:
+                    self._count_cancelled(req)   # cancel won the race
+                    continue
+                self.metrics.record_rejection("shutdown")
+                self._finish_request(req.trace, "shutdown")
 
     # ------------------------------------------------------------- watchdog
-    def arm_watchdog(self, timeout_ms: float) -> "InferenceEngine":
-        """Arm (or re-arm) the dispatcher watchdog: a dispatcher that stops
-        heartbeating for ``timeout_ms`` with work outstanding is declared
-        wedged — in-flight futures fail typed and a fresh dispatcher takes
-        over the queue. Size the timeout at N× the engine's deadline and
-        arm AFTER :meth:`warmup`: a first-compile pause reads exactly like
-        a stall."""
-        if self._watchdog is not None:
-            self._watchdog.stop()
-        self._watchdog = Watchdog(
-            timeout_s=timeout_ms / 1e3,
-            busy=self._watchdog_busy, on_stall=self._watchdog_stall,
-            name=self.name).start()
-        return self
-
     def _watchdog_busy(self) -> bool:
         with self._wd_lock:
             if self._inflight:
@@ -335,15 +309,19 @@ class InferenceEngine:
             f"dispatcher restarted")
         failed = 0
         for req in victims:
+            req.trace.event("watchdog.restart", epoch=epoch)
             try:
                 req.future.set_exception(exc)
                 failed += 1
+                self._finish_request(req.trace, "watchdog")
             except InvalidStateError:
                 pass
         if failed:
             self.metrics.failed_total.inc(failed)
         self.metrics.watchdog_restarts.inc()
         self.metrics.record_rejection("watchdog")
+        self._recorder.record("watchdog.restart", engine=self.name,
+                              epoch=epoch, victims=len(victims))
         self._breaker.record_failure()
         self._thread = threading.Thread(
             target=self._loop, args=(epoch,),
@@ -372,29 +350,13 @@ class InferenceEngine:
 
         return self._retry.call(call, on_retry=self._on_retry)
 
-    def _on_retry(self, attempt: int, exc: BaseException):
-        self.metrics.retries_total.inc()
-        if getattr(exc, "injected", False):
-            self.metrics.faults_injected_total.inc()
+    # ------------------------------------------- ResilientEngineMixin hooks
+    def _retry_traces(self):
+        with self._wd_lock:
+            return [r.trace for r in self._inflight]
 
-    def _maybe_crash_dump(self, exc: BaseException, **context):
-        """Serving crashes get the training path's forensics: the FIRST
-        non-injected unexpected dispatch failure writes a memory crash
-        dump (util/crash_reporting). Injected chaos faults and typed
-        admission sheds never dump, and the dump itself can never mask
-        the original error (writeMemoryCrashDump swallows its own)."""
-        if getattr(exc, "injected", False):
-            self.metrics.faults_injected_total.inc()
-            return
-        if self._crash_dumped or isinstance(exc, RejectedError):
-            return
-        self._crash_dumped = True
-        from deeplearning4j_tpu.util.crash_reporting import (
-            writeMemoryCrashDump)
-        writeMemoryCrashDump(
-            self.adapter.model, exc,
-            context={"component": "serving.InferenceEngine",
-                     "engine": self.name, **context})
+    def _crash_dump_model(self):
+        return self.adapter.model
 
     def _dispatch(self, batch):
         now = time.perf_counter()
@@ -403,9 +365,14 @@ class InferenceEngine:
             if req.expired(now):  # re-check: the window may have eaten it
                 self._admission._shed(req)  # counts via _count_shed
             elif not req.future.set_running_or_notify_cancel():
-                continue  # caller cancelled while queued: drop silently
+                # caller cancelled while queued: drop silently
+                self._finish_request(req.trace, "cancelled")
+                continue
             else:
-                self.metrics.queue_wait_ms.observe((now - req.submit_t) * 1e3)
+                qw = (now - req.submit_t) * 1e3
+                self.metrics.queue_wait_ms.observe(qw)
+                req.trace.event("queue.wait", queue_wait_ms=round(qw, 3),
+                                batch_requests=len(batch))
                 live.append(req)
         self.metrics.queue_depth.set(self._admission.depth_rows)
         if not live:
@@ -426,13 +393,28 @@ class InferenceEngine:
                                     bucket=bucket, rows=b,
                                     requests=len(live)):
                 y = self._guarded_run(x)
+            if self.screen_outputs:
+                self._screen_finite(y, "engine.dispatch")
         except BaseException as e:
             self.metrics.failed_total.inc(len(live))
             self._breaker.record_failure()
+            if not getattr(e, "injected", False) \
+                    and not isinstance(e, PoisonedResultError):
+                # poisoned/injected failures flight-record themselves;
+                # recorded BEFORE the dump so the dump's snapshot has it
+                self._recorder.record(
+                    "dispatch.failed", engine=self.name, bucket=bucket,
+                    requests=len(live), error=type(e).__name__)
             self._maybe_crash_dump(e, bucket=bucket, requests=len(live))
+            reason = terminal_reason(e)
+            fail_t = time.perf_counter()
             for req in live:
+                req.trace.event("dispatch.failed", error=type(e).__name__)
                 try:
                     req.future.set_exception(e)
+                    self._finish_request(
+                        req.trace, reason,
+                        latency_ms=(fail_t - req.submit_t) * 1e3)
                 except InvalidStateError:
                     pass  # watchdog or caller got there first
             return
@@ -454,9 +436,13 @@ class InferenceEngine:
             # other tenants' outputs) for as long as the caller holds it
             out = y[off:off + req.rows].copy()
             off += req.rows
-            self.metrics.latency_ms.observe((done_t - req.submit_t) * 1e3)
+            lat = (done_t - req.submit_t) * 1e3
+            self.metrics.latency_ms.observe(lat)
+            req.trace.event("dispatch", dur_ms=round(dt_ms, 3),
+                            bucket=bucket, rows=req.rows)
             try:
                 req.future.set_result(NDArray(out))
+                self._finish_request(req.trace, "ok", latency_ms=lat)
             except InvalidStateError:
                 pass  # failed by the watchdog while this zombie computed
 
@@ -497,15 +483,7 @@ class InferenceEngine:
     def queue_depth_rows(self) -> int:
         return self._admission.depth_rows
 
-    @property
-    def breaker(self) -> CircuitBreaker:
-        return self._breaker
-
-    @property
-    def watchdog_restarts(self) -> int:
-        return self._watchdog.restarts if self._watchdog is not None else 0
-
 
 __all__ = ["InferenceEngine", "bucket_ladder", "RejectedError",
            "QueueFullError", "DeadlineExceededError", "CircuitOpenError",
-           "WatchdogTimeoutError"]
+           "PoisonedResultError", "WatchdogTimeoutError"]

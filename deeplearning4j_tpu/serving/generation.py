@@ -40,15 +40,15 @@ import numpy as np
 
 from deeplearning4j_tpu.profiler import OpProfiler
 from deeplearning4j_tpu.serving.admission import (
-    AdmissionController, QueueFullError, RejectedError, Request,
+    AdmissionController, RejectedError, Request,
 )
 from deeplearning4j_tpu.serving.engine import bucket_ladder
 from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.resilience import (
-    CircuitBreaker, CircuitOpenError, RetryPolicy, Watchdog,
-    WatchdogTimeoutError,
+    CircuitBreaker, ResilientEngineMixin, RetryPolicy, WatchdogTimeoutError,
 )
+from deeplearning4j_tpu.serving.tracing import terminal_reason
 
 _DONE = object()
 _UNSET = object()   # submit()'s "use the engine default" eos sentinel
@@ -121,7 +121,12 @@ class GenerationHandle:
             yield item
 
     # ------------------------------------------------- scheduler-side hooks
-    def _push(self, token: int):
+    def _push(self, token: int) -> Optional[BaseException]:
+        """Deliver one token. Returns the consumer callback's exception
+        when a broken ``on_token`` failed this stream — the scheduler then
+        retires the slot and records the outcome; the error must not reach
+        the scheduler loop itself, where it would be treated as a device
+        failure (co-tenants failed, cache rebuilt)."""
         with self._lock:
             self._tokens.append(token)
         self._q.put(token)
@@ -129,11 +134,9 @@ class GenerationHandle:
             try:
                 self._on_token(token)
             except BaseException as e:
-                # a broken consumer callback fails ITS OWN stream only —
-                # it must not reach the scheduler loop, where it would be
-                # treated as a device failure (co-tenants failed, cache
-                # rebuilt)
-                self._fail(e)
+                if self._fail(e):
+                    return e
+        return None
 
     def _finish(self, reason: str) -> bool:
         self.finish_reason = reason
@@ -143,11 +146,15 @@ class GenerationHandle:
         except InvalidStateError:
             return False   # caller cancelled while queued/running
 
-    def _fail(self, exc: BaseException):
+    def _fail(self, exc: BaseException) -> bool:
+        """True iff this call delivered the terminal — False when the
+        watchdog/a zombie/a cancel got there first. Callers use the
+        return to record each request's SLO outcome exactly once."""
         try:
             self._req.future.set_exception(exc)
+            return True
         except InvalidStateError:
-            pass
+            return False
 
 
 @dataclasses.dataclass
@@ -160,7 +167,7 @@ class _Slot:
     last_token: int = 0
 
 
-class GenerationEngine:
+class GenerationEngine(ResilientEngineMixin):
     """Iteration-level scheduler over one causal LM and one KV cache.
 
     ``submit(prompt)`` returns a :class:`GenerationHandle`; a background
@@ -169,8 +176,15 @@ class GenerationEngine:
     cache capacity (prompt + generated tokens must fit), and the compiled
     footprint over the engine's lifetime is ``len(self.buckets)`` prefill
     executables + ONE decode executable, asserted by
-    :meth:`compiled_signatures`.
+    :meth:`compiled_signatures`. ``tracer`` opts requests into
+    request-scoped tracing (serving/tracing.py — slot assignment, prefill,
+    every decode-step participation, retries, retirement);
+    ``screen_outputs`` is the cheap poisoned-result guard on sampled
+    tokens (NaN/inf or out-of-vocab ids fail the iteration typed).
     """
+
+    _COMPONENT = "serving.GenerationEngine"
+    _FAILURE_NOUN = "prefill/decode"
 
     def __init__(self, params, cfg, *, mesh=None, slots: int = 8,
                  max_len: Optional[int] = None,
@@ -184,6 +198,7 @@ class GenerationEngine:
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  watchdog_timeout_ms: Optional[float] = None,
+                 tracer=None, recorder=None, screen_outputs: bool = True,
                  name: str = "generation"):
         from deeplearning4j_tpu.models.bert import (
             init_kv_cache, make_decode_step, make_prefill, place_kv_cache,
@@ -222,23 +237,20 @@ class GenerationEngine:
             capacity_rows=queue_capacity,
             default_timeout_ms=default_timeout_ms, unit="requests")
         self._admission.on_shed = self._count_shed
+        self._admission.on_close_reject = self._count_close_reject
+        self._admission.on_cancelled = self._count_cancelled
         self._slots: List[Optional[_Slot]] = [None] * slots
         self._stop = threading.Event()
-        # ---- resilience layer (serving/resilience.py design notes) -------
-        # injected/tagged-transient prefill and decode failures raise
-        # BEFORE the donated call executes, so retrying them re-uses the
-        # intact cache; everything else still takes the fail-tenants +
-        # rebuild path from PR 2.
-        self._retry = retry_policy if retry_policy is not None \
-            else RetryPolicy()
-        self._breaker = breaker if breaker is not None \
-            else CircuitBreaker(name=self.name)
-        self._breaker.add_listener(self.metrics.record_breaker_transition)
-        self._epoch = 0          # bumped by the watchdog; stales zombies
+        self.screen_outputs = screen_outputs
+        # resilience + observability scaffolding is the shared mixin
+        # (serving/resilience.py). Note the retry-safety property is
+        # generation-specific: injected/tagged-transient prefill and
+        # decode failures raise BEFORE the donated call executes, so
+        # retrying them re-uses the intact cache; everything else still
+        # takes the fail-tenants + rebuild path from PR 2.
+        self._init_resilience(retry_policy=retry_policy, breaker=breaker,
+                              tracer=tracer, recorder=recorder)
         self._inflight_prefill: Optional[Request] = None
-        self._wd_lock = threading.Lock()
-        self._crash_dumped = False
-        self._watchdog: Optional[Watchdog] = None
         self._thread = threading.Thread(
             target=self._loop, args=(0,),
             name=f"generation-scheduler[{self.name}]", daemon=True)
@@ -257,13 +269,10 @@ class GenerationEngine:
         """Idempotent: stop the scheduler; queued AND in-flight requests
         are rejected ('shutdown') — partial streams surface what they have
         via :meth:`GenerationHandle.tokens_so_far`."""
-        if self._watchdog is not None:   # no restarts during teardown
-            self._watchdog.stop()
+        self._shutdown_resilience()   # watchdog off, breaker detached
         self._stop.set()
         self._admission.close()
-        # shared-per-deployment breaker outlives the engine: detach our
-        # metrics listener so dead engines don't accumulate
-        self._breaker.remove_listener(self.metrics.record_breaker_transition)
+        self._recorder.record("engine.shutdown", engine=self.name)
         if wait and self._thread.is_alive():
             self._thread.join(timeout=30.0)
 
@@ -300,27 +309,17 @@ class GenerationEngine:
             temperature=float(temperature), top_k=int(top_k),
             eos_id=self.eos_id if eos_id is _UNSET else eos_id,
             key=np.asarray(jax.random.PRNGKey(seed)))
-        req = Request(x=greq, rows=1)
+        trace = self._tracer.begin(self.name, "generate",
+                                   prompt_len=int(toks.size),
+                                   max_new_tokens=max_new_tokens)
+        req = Request(x=greq, rows=1, trace=trace)
         greq.handle = GenerationHandle(req, toks.size, on_token=on_token)
         self.metrics.requests_total.inc()
-        if not self._breaker.allow():
-            self.metrics.rejected_total.inc()
-            self.metrics.rejected_circuit_open.inc()
-            self.metrics.record_rejection("circuit_open")
-            raise CircuitOpenError(
-                f"circuit open for engine[{self.name}] after "
-                f"{self._breaker.consecutive_failures} consecutive "
-                f"prefill/decode failures; retry after the cooldown")
+        self._breaker_gate(trace)
         try:
             self._admission.admit(req, timeout_ms=timeout_ms)
-        except QueueFullError:
-            self.metrics.rejected_total.inc()
-            self.metrics.rejected_queue_full.inc()
-            self.metrics.record_rejection("queue_full")
-            raise
         except RejectedError as e:
-            self.metrics.rejected_total.inc()
-            self.metrics.record_rejection(e.reason)
+            self._reject_submit(trace, e)
             raise
         self.metrics.queue_depth.set(self._admission.depth_requests)
         return greq.handle
@@ -375,6 +374,13 @@ class GenerationEngine:
         fail them and rebuild. Epoch-guarded so a zombie observing its own
         (post-restart) failure cannot rebuild the replacement's cache."""
         self._breaker.record_failure()
+        if not getattr(exc, "injected", False) \
+                and not isinstance(exc, RejectedError):
+            # injected faults and typed serving errors (poison screens)
+            # already flight-recorded themselves at the raise site;
+            # recorded BEFORE the dump so the dump's snapshot has it
+            self._recorder.record("device.failure", engine=self.name,
+                                  point=point, error=type(exc).__name__)
         self._maybe_crash_dump(exc, point=point)
         with self._wd_lock:
             current = self._epoch == epoch
@@ -403,14 +409,24 @@ class GenerationEngine:
                     return   # idle and nothing queued: back to the loop
                 continue
             if not req.future.set_running_or_notify_cancel():
+                self._finish_request(req.trace, "cancelled")
                 continue     # caller cancelled while queued
+            qw = (time.perf_counter() - req.submit_t) * 1e3
+            req.trace.event("queue.wait", queue_wait_ms=round(qw, 3))
             with self._wd_lock:  # visible to the watchdog while on-device
                 self._inflight_prefill = req
             try:
                 self._prefill_into(i, req, epoch)
             except BaseException as e:
-                req.x.handle._fail(e)
                 self.metrics.failed_total.inc()
+                req.trace.event("prefill.failed", error=type(e).__name__)
+                # outcome recorded only by the terminal's winner: if the
+                # watchdog already failed this request, its "watchdog"
+                # outcome stands and this late failure must not re-count
+                if req.x.handle._fail(e):
+                    self._finish_request(
+                        req.trace, terminal_reason(e),
+                        latency_ms=(time.perf_counter() - req.submit_t) * 1e3)
                 self._on_device_failure(e, epoch, point="generation.prefill")
             finally:
                 with self._wd_lock:
@@ -448,12 +464,37 @@ class GenerationEngine:
                     pass   # exotic __slots__ exception: stays conservative
             raise
 
+    # ------------------------------------------------- poisoned-result screen
+    def _screen_prefill(self, raw):
+        if self.screen_outputs:
+            self._screen_token_ids(np.asarray(raw[1]), "generation.prefill")
+
+    def _screen_token_ids(self, toks, point: str, live=None):
+        """Cheap poisoned-result guard on sampled tokens: NaN/inf (a
+        poison rule can mutate the host copy to float) or ids outside
+        [0, vocab) fail the iteration typed. Dead slots compute masked
+        garbage by design, so only ``live`` entries are screened."""
+        a = np.asarray(toks)
+        if live is not None:
+            a = a[np.asarray(live)]
+        if a.size == 0:
+            return
+        if np.issubdtype(a.dtype, np.inexact) \
+                and not bool(np.all(np.isfinite(a))):
+            self._poisoned(point, "non-finite sampled token values")
+        bad = (a < 0) | (a >= self.cfg.vocab_size)
+        if bool(np.any(bad)):
+            self._poisoned(
+                point, f"{int(np.count_nonzero(bad))} sampled token id(s) "
+                       f"outside [0, {self.cfg.vocab_size})")
+
     def _prefill_into(self, slot: int, req: Request, epoch: int):
         greq: GenerationRequest = req.x
         n = int(greq.prompt.size)
         bucket = self._bucket_for(n)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = greq.prompt
+        req.trace.event("slot.assign", slot=slot, bucket=bucket)
         t0 = time.perf_counter()
         with self.profiler.span("serving.prefill", engine=self.name,
                                 slot=slot, bucket=bucket, prompt=n):
@@ -468,7 +509,9 @@ class GenerationEngine:
                     np.int32(n), greq.key, np.float32(greq.temperature),
                     np.int32(greq.top_k))
 
-            new_cache, tok = self._retry.call(call, on_retry=self._on_retry)
+            raw = self._retry.call(call, on_retry=self._on_retry)
+            self._screen_prefill(raw)
+            new_cache, tok = raw
             tok = int(np.asarray(tok))
         with self._wd_lock:
             current = self._epoch == epoch
@@ -478,18 +521,31 @@ class GenerationEngine:
             # the watchdog restarted the engine while this (zombie) prefill
             # was on-device: its write landed in an abandoned cache — fail
             # the request typed rather than leave its future hanging
-            greq.handle._fail(WatchdogTimeoutError(
-                f"engine[{self.name}] restarted while this prompt was in "
-                f"prefill; resubmit"))
+            req.trace.event("watchdog.restart", stale=True)
+            if greq.handle._fail(WatchdogTimeoutError(
+                    f"engine[{self.name}] restarted while this prompt was "
+                    f"in prefill; resubmit")):
+                self._finish_request(req.trace, "watchdog")
+            # else: the watchdog delivered (and recorded) the terminal —
+            # this zombie must not double-count the outcome
             return
         self._breaker.record_success()
         now = time.perf_counter()
+        req.trace.event("prefill", dur_ms=round((now - t0) * 1e3, 3),
+                        slot=slot, bucket=bucket, prompt=n)
         self.metrics.prefill_ms.observe((now - t0) * 1e3)
         self.metrics.ttft_ms.observe((now - req.submit_t) * 1e3)
         self.metrics.prefills_total.inc()
         self.metrics.generated_tokens_total.inc()
         state = _Slot(greq=greq, request=req, n_generated=1, last_token=tok)
-        greq.handle._push(tok)
+        err = greq.handle._push(tok)
+        if err is not None:
+            # broken on_token consumer failed its own stream at token 0:
+            # the handle delivered the terminal — record it (client_error:
+            # the caller's callback raised, not the model), never tenant
+            req.trace.event("on_token.failed", error=type(err).__name__)
+            self._finish_request(req.trace, "client_error")
+            return
         if not self._maybe_retire(state, tok):
             with self._wd_lock:
                 # re-check: a restart between the cache writeback and here
@@ -541,6 +597,12 @@ class GenerationEngine:
 
             new_cache, toks = self._retry.call(call, on_retry=self._on_retry)
             toks = np.asarray(toks)
+            if self.screen_outputs:
+                # raises BEFORE the cache writeback: a poisoned iteration
+                # takes the fail-tenants + rebuild path, never re-tenants
+                # over the (possibly poisoned) cache
+                self._screen_token_ids(toks, "generation.decode_step",
+                                       live=live)
         with self._wd_lock:
             current = self._epoch == epoch
             if current:
@@ -569,8 +631,21 @@ class GenerationEngine:
                 reason = self._retire_reason(st, tok)
                 if reason is not None:
                     self._slots[i] = None   # freed for the NEXT admission
-            st.greq.handle._push(tok)
-            if reason is not None:
+            st.request.trace.event("decode.step", step=st.n_generated - 1,
+                                   dur_ms=round(dt_ms, 3), slot=i, token=tok)
+            err = st.greq.handle._push(tok)
+            if err is not None:
+                # broken on_token consumer: the handle delivered the
+                # terminal — retire the slot now (no point decoding a dead
+                # stream) and record the one outcome
+                st.request.trace.event("on_token.failed",
+                                       error=type(err).__name__)
+                if reason is None:
+                    with self._wd_lock:
+                        if self._epoch == epoch and self._slots[i] is st:
+                            self._slots[i] = None
+                self._finish_request(st.request.trace, "client_error")
+            elif reason is not None:
                 self._finish_stream(st, reason)
         # re-read after retirement so an engine that drains to idle shows
         # its true occupancy instead of the pre-retire value forever
@@ -586,10 +661,25 @@ class GenerationEngine:
         return None
 
     def _finish_stream(self, st: _Slot, reason: str):
-        st.greq.handle._finish(reason)
+        delivered = st.greq.handle._finish(reason)
         self.metrics.generations_completed.inc()
-        self.metrics.latency_ms.observe(
-            (time.perf_counter() - st.request.submit_t) * 1e3)
+        lat = (time.perf_counter() - st.request.submit_t) * 1e3
+        self.metrics.latency_ms.observe(lat)
+        st.request.trace.event("stream.finish", finish_reason=reason,
+                               tokens=st.n_generated)
+        if delivered:
+            self._finish_request(st.request.trace, "ok", latency_ms=lat)
+        else:
+            # the terminal was already delivered elsewhere (watchdog win,
+            # broken on_token) and its outcome recorded there — just make
+            # sure the trace closes, labeled by the actual terminal
+            try:
+                exc = st.request.future.exception(timeout=0)
+            except BaseException:
+                exc = None   # cancelled future: exception() raises
+            st.request.trace.finish(
+                "cancelled" if exc is None else terminal_reason(exc),
+                latency_ms=lat)
 
     def _maybe_retire(self, st: _Slot, tok: int) -> bool:
         """Retire a finished stream immediately — EOS or the token budget —
@@ -601,55 +691,28 @@ class GenerationEngine:
         return True
 
     def _fail_live(self, exc: BaseException):
+        reason = terminal_reason(exc)
         for i, st in enumerate(self._slots):
             if st is not None:
-                st.greq.handle._fail(exc)
+                if st.greq.handle._fail(exc):
+                    self._finish_request(st.request.trace, reason)
                 self._slots[i] = None
 
-    def _count_shed(self, req):
-        self.metrics.rejected_total.inc()
-        self.metrics.rejected_deadline.inc()
-        self.metrics.record_rejection("deadline")
+    # ------------------------------------------- ResilientEngineMixin hooks
+    def _retry_traces(self):
+        with self._wd_lock:
+            if self._inflight_prefill is not None:
+                return (self._inflight_prefill.trace,)
+        return tuple(s.request.trace for s in list(self._slots)
+                     if s is not None)
 
-    def _on_retry(self, attempt: int, exc: BaseException):
-        self.metrics.retries_total.inc()
-        if getattr(exc, "injected", False):
-            self.metrics.faults_injected_total.inc()
+    def _crash_dump_model(self):
+        return self.params
 
-    def _maybe_crash_dump(self, exc: BaseException, **context):
-        """First non-injected unexpected scheduler failure writes a memory
-        crash dump (util/crash_reporting) — serving crashes get the same
-        forensics as the training path. Injected chaos faults and typed
-        sheds never dump; the dump can never mask the original error."""
-        if getattr(exc, "injected", False):
-            self.metrics.faults_injected_total.inc()
-            return
-        if self._crash_dumped or isinstance(exc, RejectedError):
-            return
-        self._crash_dumped = True
-        from deeplearning4j_tpu.util.crash_reporting import (
-            writeMemoryCrashDump)
-        writeMemoryCrashDump(
-            self.params, exc,
-            context={"component": "serving.GenerationEngine",
-                     "engine": self.name, "slots": self.slots,
-                     "live_slots": self._live_count(), **context})
+    def _crash_dump_context(self) -> dict:
+        return {"slots": self.slots, "live_slots": self._live_count()}
 
     # ------------------------------------------------------------- watchdog
-    def arm_watchdog(self, timeout_ms: float) -> "GenerationEngine":
-        """Arm (or re-arm) the scheduler watchdog: a scheduler that stops
-        heartbeating for ``timeout_ms`` with work outstanding is declared
-        wedged — live generations fail typed, the cache is rebuilt, a
-        fresh scheduler takes over the queue. Arm AFTER :meth:`warmup`:
-        first-compile prefill/decode pauses read exactly like stalls."""
-        if self._watchdog is not None:
-            self._watchdog.stop()
-        self._watchdog = Watchdog(
-            timeout_s=timeout_ms / 1e3,
-            busy=self._watchdog_busy, on_stall=self._watchdog_stall,
-            name=self.name).start()
-        return self
-
     def _watchdog_busy(self) -> bool:
         with self._wd_lock:
             if self._inflight_prefill is not None:
@@ -672,17 +735,24 @@ class GenerationEngine:
             f"failed, scheduler restarted")
         failed = 0
         if pre is not None:
-            pre.x.handle._fail(exc)
+            pre.trace.event("watchdog.restart", epoch=epoch, in_prefill=True)
+            if pre.x.handle._fail(exc):
+                self._finish_request(pre.trace, "watchdog")
             failed += 1
         for i, st in enumerate(self._slots):
             if st is not None:
-                st.greq.handle._fail(exc)
+                st.request.trace.event("watchdog.restart", epoch=epoch,
+                                       slot=i)
+                if st.greq.handle._fail(exc):
+                    self._finish_request(st.request.trace, "watchdog")
                 self._slots[i] = None
                 failed += 1
         if failed:
             self.metrics.failed_total.inc(failed)
         self.metrics.watchdog_restarts.inc()
         self.metrics.record_rejection("watchdog")
+        self._recorder.record("watchdog.restart", engine=self.name,
+                              epoch=epoch, victims=failed)
         self.metrics.slot_occupancy.set(0.0)
         self._breaker.record_failure()
         self._reset_cache()
@@ -708,14 +778,6 @@ class GenerationEngine:
     @property
     def live_slots(self) -> int:
         return self._live_count()
-
-    @property
-    def breaker(self) -> CircuitBreaker:
-        return self._breaker
-
-    @property
-    def watchdog_restarts(self) -> int:
-        return self._watchdog.restarts if self._watchdog is not None else 0
 
     def warmup(self) -> "GenerationEngine":
         """Compile every prefill bucket + the decode executable up front by

@@ -17,9 +17,11 @@ Integration points (no new plumbing, per the subsystem contract):
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
 
 
 class Counter:
@@ -145,12 +147,78 @@ class ReasonCounter:
             return dict(self._d)
 
 
+class SlidingWindowStats:
+    """Rolling-window latency/error tracker — the SLO view.
+
+    The lifetime :class:`Histogram` answers "how has this engine ever
+    behaved"; an SLO answers "is it healthy NOW". This keeps the last
+    ``window_s`` seconds of per-request terminal outcomes (bounded by
+    ``max_samples`` — fixed memory under a request storm) and computes
+    exact p50/p95/p99 over the in-window success latencies plus an error
+    rate bucketed by the same reason strings
+    ``rejections_by_reason`` uses (see serving/tracing.py
+    ``TERMINAL_REASONS`` — one taxonomy, no drift)."""
+
+    def __init__(self, window_s: float = 60.0, max_samples: int = 4096,
+                 clock: Callable[[], float] = time.monotonic):
+        if window_s <= 0:
+            raise ValueError("window_s must be positive")
+        if max_samples <= 0:
+            raise ValueError("max_samples must be positive")
+        self.window_s = float(window_s)
+        self.max_samples = max_samples
+        self._clock = clock
+        self._buf: deque = deque()   # (t, reason, latency_ms-or-None)
+        self._lock = threading.Lock()
+
+    def record(self, reason: str = "ok",
+               latency_ms: Optional[float] = None):
+        now = self._clock()
+        with self._lock:
+            self._buf.append((now, reason, latency_ms))
+            self._evict(now)
+
+    def _evict(self, now: float):
+        cut = now - self.window_s
+        buf = self._buf
+        while buf and (buf[0][0] < cut or len(buf) > self.max_samples):
+            buf.popleft()
+
+    def stats(self) -> dict:
+        with self._lock:
+            self._evict(self._clock())
+            rows = list(self._buf)
+        lats = sorted(l for _, r, l in rows if r == "ok" and l is not None)
+        errors_by_reason: Dict[str, int] = {}
+        for _, r, _ in rows:
+            if r != "ok":
+                errors_by_reason[r] = errors_by_reason.get(r, 0) + 1
+        total = len(rows)
+        n_err = sum(errors_by_reason.values())
+
+        def pct(q: float) -> float:
+            if not lats:
+                return 0.0
+            return lats[min(len(lats) - 1,
+                            max(0, int(math.ceil(q * len(lats))) - 1))]
+
+        return {"window_s": self.window_s, "total": total,
+                "ok": total - n_err, "errors": n_err,
+                "error_rate": n_err / total if total else 0.0,
+                "errors_by_reason": errors_by_reason,
+                "p50_ms": round(pct(0.50), 3),
+                "p95_ms": round(pct(0.95), 3),
+                "p99_ms": round(pct(0.99), 3)}
+
+
 class ServingMetrics:
     """The engine's full metric set. All members are monotone counters or
     derived ratios except the two gauges — tests assert monotonicity over
-    the counter set via :meth:`counters`."""
+    the counter set via :meth:`counters`. ``slo_windows_s`` configures the
+    rolling SLO windows (:class:`SlidingWindowStats`) every per-request
+    terminal outcome feeds via :meth:`record_outcome`."""
 
-    def __init__(self):
+    def __init__(self, slo_windows_s: Sequence[float] = (10.0, 60.0)):
         self.requests_total = Counter("requests_total")
         self.rows_total = Counter("rows_total")
         self.batches_total = Counter("batches_total")
@@ -190,6 +258,11 @@ class ServingMetrics:
         self.fallback_serves = Counter("fallback_serves")
         self.faults_injected_total = Counter("faults_injected_total")
         self.rejections_by_reason = ReasonCounter("rejections_by_reason")
+        # ---- observability signals (tracing / poison screen / SLO) -------
+        self.poisoned_results_total = Counter("poisoned_results_total")
+        self.slo_windows: Dict[str, SlidingWindowStats] = {
+            f"{w:g}s": SlidingWindowStats(window_s=w)
+            for w in slo_windows_s}
         self._per_bucket: Dict[int, Dict[str, int]] = {}
         self._lock = threading.Lock()
         self._t0 = time.time()
@@ -209,6 +282,21 @@ class ServingMetrics:
         existing per-cause counters so ``/api/serving`` can answer "WHY is
         this engine shedding" without diffing counter pairs."""
         self.rejections_by_reason.inc(reason)
+
+    def record_outcome(self, reason: str, latency_ms: Optional[float] = None):
+        """One request reached a terminal state: feed every rolling SLO
+        window. ``reason`` is the shared terminal taxonomy ("ok" or the
+        exact string this cause also counts under in
+        ``rejections_by_reason`` — see serving/tracing.terminal_reason),
+        ``latency_ms`` the submit->terminal wall time when known."""
+        for w in self.slo_windows.values():
+            w.record(reason, latency_ms)
+
+    def slo_snapshot(self) -> Dict[str, dict]:
+        """Rolling-window SLO roll-up: per window, exact p50/p95/p99 over
+        in-window successes plus the reason-bucketed error rate — the
+        /api/slo payload."""
+        return {k: w.stats() for k, w in self.slo_windows.items()}
 
     def record_breaker_transition(self, old: str, new: str):
         """CircuitBreaker listener hook: counts entries into each state so
@@ -234,7 +322,7 @@ class ServingMetrics:
             self.rejected_circuit_open, self.breaker_opened_total,
             self.breaker_half_open_total, self.breaker_closed_total,
             self.watchdog_restarts, self.fallback_serves,
-            self.faults_injected_total)}
+            self.faults_injected_total, self.poisoned_results_total)}
 
     def decode_tokens_per_sec(self) -> float:
         """Steady-state decode throughput: tokens sampled by decode_step
@@ -270,6 +358,7 @@ class ServingMetrics:
             "slot_occupancy": self.slot_occupancy.value,
             "decode_tokens_per_sec": self.decode_tokens_per_sec(),
             "rejections_by_reason": self.rejections_by_reason.to_dict(),
+            "slo": self.slo_snapshot(),
             "ttft_ms": self.ttft_ms.to_dict(),
             "prefill_ms": self.prefill_ms.to_dict(),
             "decode_step_ms": self.decode_step_ms.to_dict(),

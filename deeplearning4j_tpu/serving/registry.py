@@ -30,6 +30,7 @@ from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
 from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.resilience import CircuitBreaker
+from deeplearning4j_tpu.serving.tracing import flight_recorder
 
 
 def tile_rows(example_row, batch: int) -> np.ndarray:
@@ -221,11 +222,19 @@ class ModelRegistry:
     def __init__(self, default_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
                  breaker_failure_threshold: int = 5,
                  breaker_cooldown_s: float = 5.0,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 tracer=None, recorder=None):
         self.default_buckets = tuple(default_buckets)
         self.breaker_failure_threshold = breaker_failure_threshold
         self.breaker_cooldown_s = breaker_cooldown_s
         self.metrics = metrics or ServingMetrics()
+        # request tracing for every engine this registry spins up
+        # (serving/tracing.py; None = the process default, off until
+        # configured) + the always-on flight recorder for deploy/fallback
+        # lifecycle events
+        self._tracer = tracer
+        self._recorder = recorder if recorder is not None \
+            else flight_recorder()
         self._models: Dict[str, Dict[int, Deployment]] = {}
         self._aliases: Dict[str, str] = {}
         self._lock = threading.RLock()
@@ -300,8 +309,12 @@ class ModelRegistry:
                     versions.pop(version, None)
                     if not versions:
                         self._models.pop(name, None)
+                self._recorder.record("registry.deploy_failed", ref=dep.ref)
                 raise
             dep.state = "ready"
+        self._recorder.record("registry.deploy", ref=dep.ref,
+                              adapter_kind=adapter.kind,
+                              warmed=dep.warmup_ms is not None)
         return dep
 
     def undeploy(self, name: str, version: Optional[int] = None) -> int:
@@ -322,7 +335,10 @@ class ModelRegistry:
                         if self._resolve_unlocked(tgt) is None]
             for a in dangling:
                 del self._aliases[a]
-            return removed
+        if removed:
+            self._recorder.record("registry.undeploy", name=name,
+                                  version=version, removed=removed)
+        return removed
 
     def alias(self, alias: str, target: str):
         """Bind ``alias`` -> ``target`` ("name" or "name:version"). The
@@ -379,17 +395,20 @@ class ModelRegistry:
         callers keep getting answers from a known-good model while the
         broken version cools down. ``fallback=False`` gives the literal
         resolution (health introspection, undeploy tooling)."""
-        fell_back = False
+        fell_back, primary_ref = False, None
         with self._lock:
             dep = self._resolve_unlocked(ref)
             if dep is not None and fallback:
                 fb = self._fallback_unlocked(dep)
                 if fb is not None:
+                    primary_ref = dep.ref
                     dep, fell_back = fb, True
         if dep is None:
             raise KeyError(f"no deployment for {ref!r}")
         if fell_back:
             self.metrics.fallback_serves.inc()
+            self._recorder.record("registry.fallback", requested=primary_ref,
+                                  served=dep.ref)
         return dep
 
     # --------------------------------------------------------------- health
@@ -476,6 +495,9 @@ class ModelRegistry:
         # share the deployment's breaker: trips observed by any engine make
         # the registry route NEW lookups to the previous healthy version
         engine_kwargs.setdefault("breaker", self._breaker_for(dep))
+        if self._tracer is not None:
+            engine_kwargs.setdefault("tracer", self._tracer)
+        engine_kwargs.setdefault("recorder", self._recorder)
         eng = InferenceEngine(dep.adapter, **engine_kwargs)
         try:
             if dep.warmup_example is not None:
@@ -496,6 +518,9 @@ class ModelRegistry:
                 "CausalLMAdapter to serve autoregressive decode")
         engine_kwargs.setdefault("name", dep.ref)
         engine_kwargs.setdefault("breaker", self._breaker_for(dep))
+        if self._tracer is not None:
+            engine_kwargs.setdefault("tracer", self._tracer)
+        engine_kwargs.setdefault("recorder", self._recorder)
         eng = dep.adapter.generation_engine(**engine_kwargs)
         try:
             return self._track(eng)

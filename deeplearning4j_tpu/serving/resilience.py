@@ -54,6 +54,21 @@ class WatchdogTimeoutError(RejectedError):
         super().__init__(msg, "watchdog")
 
 
+class PoisonedResultError(RejectedError):
+    """A dispatch/decode produced a poisoned result — non-finite values
+    (NaN/inf) or out-of-vocab token ids — caught by the engines' output
+    screen before any caller saw it (reason 'poisoned'). A RejectedError
+    subclass on purpose: typed for callers, counted in
+    ``rejections_by_reason``, and NOT crash-dumped (the flight recorder
+    carries the forensics; a sick replica screening every batch must not
+    litter the workspace). It still counts as a dispatch failure, so a
+    persistently-poisoned deployment trips its circuit breaker and is
+    quarantined behind registry fallback."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg, "poisoned")
+
+
 def is_transient(exc: BaseException) -> bool:
     """Default retry classifier: an exception is retry-worthy iff it says
     so (``transient=True`` attribute — FaultInjectedError and any backend
@@ -308,5 +323,222 @@ class Watchdog:
             self.beat()
 
 
+class ResilientEngineMixin:
+    """The shared resilience + observability scaffolding both serving
+    engines carry (InferenceEngine and GenerationEngine grew these blocks
+    in parallel in PR 3, ~70 duplicated lines; a fix to one copy could
+    silently miss the other — now there is one copy with engine-specific
+    hooks).
+
+    The host class must provide, before calling :meth:`_init_resilience`:
+    ``self.name`` and ``self.metrics``; and must implement the watchdog
+    hooks ``_watchdog_busy()`` / ``_watchdog_stall()`` (busy/stall
+    behavior is the part that genuinely differs between a batch
+    dispatcher and an iteration scheduler). Optional hooks:
+
+    - ``_retry_traces()`` — traces to stamp ``retry.attempt`` events on
+      (the in-flight batch / the in-prefill request / the live slots).
+    - ``_crash_dump_model()`` / ``_crash_dump_context()`` — what the
+      crash dump describes.
+    """
+
+    _COMPONENT = "serving.Engine"
+    _FAILURE_NOUN = "dispatch"   # breaker shed message wording
+
+    def _init_resilience(self, *, retry_policy: Optional[RetryPolicy] = None,
+                         breaker: Optional[CircuitBreaker] = None,
+                         tracer=None, recorder=None):
+        from deeplearning4j_tpu.serving.tracing import (
+            default_tracer, flight_recorder)
+
+        self._tracer = tracer if tracer is not None else default_tracer()
+        self._recorder = recorder if recorder is not None \
+            else flight_recorder()
+        # default RetryPolicy retries only transient-tagged failures, so a
+        # deterministic model error still fails fast; default breaker opens
+        # after 5 consecutive failures. Pass explicit instances to share a
+        # breaker across engines of one deployment (the registry does) or
+        # to disable retries (max_attempts=1).
+        self._retry = retry_policy if retry_policy is not None \
+            else RetryPolicy()
+        self._breaker = breaker if breaker is not None \
+            else CircuitBreaker(name=self.name)
+        self._breaker.add_listener(self.metrics.record_breaker_transition)
+        self._breaker.add_listener(self._flight_breaker)
+        self._epoch = 0          # bumped by the watchdog; stales zombies
+        self._wd_lock = threading.Lock()
+        self._crash_dumped = False
+        self._watchdog: Optional[Watchdog] = None
+
+    def _shutdown_resilience(self):
+        """Teardown half: stop the watchdog (no restarts mid-shutdown) and
+        detach our listeners from the breaker — it may outlive this engine
+        (shared per deployment) and dead engines must not accumulate."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        self._breaker.remove_listener(self.metrics.record_breaker_transition)
+        self._breaker.remove_listener(self._flight_breaker)
+
+    # ------------------------------------------------------------- breaker
+    def _flight_breaker(self, old: str, new: str):
+        self._recorder.record("breaker.transition", engine=self.name,
+                              old=old, new=new)
+
+    def _breaker_gate(self, trace):
+        """Submit-time shed while the breaker is OPEN: typed, counted,
+        traced."""
+        if self._breaker.allow():
+            return
+        self.metrics.rejected_total.inc()
+        self.metrics.rejected_circuit_open.inc()
+        self.metrics.record_rejection("circuit_open")
+        self._finish_request(trace, "circuit_open")
+        raise CircuitOpenError(
+            f"circuit open for engine[{self.name}] after "
+            f"{self._breaker.consecutive_failures} consecutive "
+            f"{self._FAILURE_NOUN} failures; retry after the cooldown")
+
+    # ------------------------------------------------------------ terminals
+    def _finish_request(self, trace, reason: str,
+                        latency_ms: Optional[float] = None):
+        """One request reached a terminal state: close its trace (tail
+        sampling decides retention) and feed the SLO windows — the same
+        reason string both places, and the same string
+        ``record_rejection`` used for this cause, so /api/slo error
+        buckets match ``rejections_by_reason`` keys exactly."""
+        self.metrics.record_outcome(reason, latency_ms)
+        trace.finish(reason, latency_ms=latency_ms)
+
+    def _count_shed(self, req):
+        """AdmissionController.on_shed hook: a queued request expired."""
+        self.metrics.rejected_total.inc()
+        self.metrics.rejected_deadline.inc()
+        self.metrics.record_rejection("deadline")
+        self._finish_request(req.trace, "deadline")
+
+    def _count_close_reject(self, req):
+        """AdmissionController.on_close_reject hook: a queued request was
+        rejected by shutdown — same accounting as the engine's post-close
+        drain, so a shutdown terminal reaches the SLO windows and
+        ``rejections_by_reason`` no matter which path rejected it."""
+        self.metrics.record_rejection("shutdown")
+        self._finish_request(req.trace, "shutdown")
+
+    def _count_cancelled(self, req):
+        """AdmissionController.on_cancelled hook: a caller cancelled a
+        queued future — recorded with the same 'cancelled' outcome the
+        dispatch-time cancel path uses, whichever thread observes it."""
+        self._finish_request(req.trace, "cancelled")
+
+    def _reject_submit(self, trace, exc: RejectedError):
+        """Shared accounting for a submit-time admission rejection."""
+        self.metrics.rejected_total.inc()
+        if getattr(exc, "reason", None) == "queue_full":
+            self.metrics.rejected_queue_full.inc()
+        self.metrics.record_rejection(exc.reason)
+        self._finish_request(trace, exc.reason)
+
+    # -------------------------------------------------------------- retries
+    def _on_retry(self, attempt: int, exc: BaseException):
+        self.metrics.retries_total.inc()
+        if getattr(exc, "injected", False):
+            self.metrics.faults_injected_total.inc()
+        self._recorder.record("retry", engine=self.name, attempt=attempt,
+                              error=type(exc).__name__)
+        for tr in self._retry_traces():
+            tr.event("retry.attempt", attempt=attempt,
+                     error=type(exc).__name__)
+
+    def _retry_traces(self):
+        return ()
+
+    # ---------------------------------------------------- poisoned results
+    def _poisoned(self, point: str, detail: str):
+        """A dispatch/decode output failed the NaN/inf/vocab screen: count
+        it, flight-record it, and fail the batch typed. Raised inside the
+        dispatch try-block, so the normal failure tail applies — breaker
+        failure, tenants failed typed — while the RejectedError lineage
+        keeps crash dumps quiet."""
+        self.metrics.poisoned_results_total.inc()
+        self.metrics.record_rejection("poisoned")
+        self._recorder.record("poisoned_result", engine=self.name,
+                              point=point, detail=detail)
+        raise PoisonedResultError(
+            f"poisoned result from {point} on engine[{self.name}]: {detail} "
+            f"— batch failed before delivery; the deployment breaker "
+            f"records the failure")
+
+    def _screen_finite(self, y, point: str):
+        """Cheap poisoned-result guard: NaN or +inf in an inexact-dtype
+        output fails the batch typed. ``-inf`` is deliberately allowed —
+        masked logits and log-probabilities of impossible classes are
+        legitimately ``-inf``, and screening them would quarantine healthy
+        models. Two cheap reductions over a host array the dispatcher
+        already holds — noise next to the device call it follows."""
+        arr = np.asarray(y)
+        if not np.issubdtype(arr.dtype, np.inexact):
+            return
+        n_nan = int(np.count_nonzero(np.isnan(arr)))
+        n_pinf = int(np.count_nonzero(np.isposinf(arr)))
+        if n_nan or n_pinf:
+            self._poisoned(
+                point, f"{n_nan} NaN + {n_pinf} +inf values in "
+                       f"{arr.size}-element output")
+
+    # ------------------------------------------------------------ forensics
+    def _maybe_crash_dump(self, exc: BaseException, **context):
+        """Serving crashes get the training path's forensics: the FIRST
+        non-injected unexpected failure writes a memory crash dump
+        (util/crash_reporting — which appends the flight-recorder
+        snapshot). Injected chaos faults and typed serving errors
+        (RejectedError lineage, poisoned screens included) never dump, and
+        the dump itself can never mask the original error."""
+        if getattr(exc, "injected", False):
+            self.metrics.faults_injected_total.inc()
+            return
+        if self._crash_dumped or isinstance(exc, RejectedError):
+            return
+        self._crash_dumped = True
+        self._recorder.record("crash_dump", engine=self.name,
+                              error=type(exc).__name__)
+        from deeplearning4j_tpu.util.crash_reporting import (
+            writeMemoryCrashDump)
+        writeMemoryCrashDump(
+            self._crash_dump_model(), exc,
+            context={"component": self._COMPONENT, "engine": self.name,
+                     **self._crash_dump_context(), **context})
+
+    def _crash_dump_model(self):
+        return None
+
+    def _crash_dump_context(self) -> dict:
+        return {}
+
+    # ------------------------------------------------------------- watchdog
+    def arm_watchdog(self, timeout_ms: float):
+        """Arm (or re-arm) the loop watchdog: a dispatcher/scheduler that
+        stops heartbeating for ``timeout_ms`` with work outstanding is
+        declared wedged — in-flight work fails typed and a fresh thread
+        takes over (the engine's ``_watchdog_stall``). Size the timeout at
+        N× the engine's deadline and arm AFTER warmup: a first-compile
+        pause reads exactly like a stall."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        self._watchdog = Watchdog(
+            timeout_s=timeout_ms / 1e3,
+            busy=self._watchdog_busy, on_stall=self._watchdog_stall,
+            name=self.name).start()
+        return self
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self._breaker
+
+    @property
+    def watchdog_restarts(self) -> int:
+        return self._watchdog.restarts if self._watchdog is not None else 0
+
+
 __all__ = ["RetryPolicy", "CircuitBreaker", "Watchdog", "CircuitOpenError",
-           "WatchdogTimeoutError", "is_transient"]
+           "WatchdogTimeoutError", "PoisonedResultError",
+           "ResilientEngineMixin", "is_transient"]

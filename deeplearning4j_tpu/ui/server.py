@@ -446,6 +446,52 @@ class _Handler(BaseHTTPRequestHandler):
                     })
             self._json(out)
             return
+        if parts == ["api", "slo"]:
+            # rolling-window SLO roll-up per serving worker: p50/p95/p99
+            # over the in-window successes + reason-bucketed error rate
+            # (serving.metrics.SlidingWindowStats — NOT lifetime
+            # histograms). Reasons use the same taxonomy as
+            # rejections_by_reason, which rides along for cross-checking.
+            out = []
+            for st in self._storages():
+                for sid in st.listSessionIDs():
+                    for worker in st.listWorkerIDsForSession(sid) or []:
+                        ups = st.getUpdates(sid, "ServingMetrics", worker)
+                        if not ups:
+                            continue
+                        latest = ups[-1]
+                        if isinstance(latest, dict) and "slo" in latest:
+                            out.append({
+                                "sessionId": sid, "workerId": worker,
+                                "slo": latest["slo"],
+                                "rejections_by_reason":
+                                    latest.get("rejections_by_reason"),
+                            })
+            self._json(out)
+            return
+        if parts == ["api", "traces"]:
+            # finished request traces retained by every Tracer in this
+            # process (serving/tracing.py tail sampling: errors always,
+            # successes at sample_rate). ?limit=N (default 50) bounds the
+            # payload, ?engine= filters by engine name.
+            from deeplearning4j_tpu.serving.tracing import all_tracers
+            q = parse_qs(url.query)
+            # clamp: limit<=0 would turn the [-limit:] slices into "all"
+            limit = max(1, min(int(q.get("limit", ["50"])[0]), 1000))
+            engine = q.get("engine", [None])[0]
+            traces, tracers, total = [], [], 0
+            for t in all_tracers():
+                # per-tracer limit before the merge: the newest N per
+                # tracer is a superset of the global newest N, and it
+                # avoids serializing hundreds of full event lists per poll
+                matching = t.traces(engine=engine)
+                total += len(matching)
+                traces.extend(tr.to_dict() for tr in matching[-limit:])
+                tracers.append(t.stats())
+            traces.sort(key=lambda d: d["start"])
+            self._json({"count": total, "traces": traces[-limit:],
+                        "tracers": tracers})
+            return
         if parts == ["api", "serving"]:
             # serving-engine metric snapshots (typeId ServingMetrics —
             # published by serving.metrics.ServingMetrics.publish through

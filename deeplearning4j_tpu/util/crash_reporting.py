@@ -79,6 +79,25 @@ def writeMemoryCrashDump(model, exception: BaseException,
             lines.append("---- context " + "-" * 52)
             for k in sorted(context):
                 lines.append(f"{k}: {context[k]}")
+        try:
+            # the serving flight recorder (serving/tracing.py): a bounded
+            # always-on ring of recent structured events — breaker
+            # transitions, retries, watchdog restarts, dispatch failures —
+            # so the dump carries what the serving stack did just before
+            # it died. Lazy + guarded: a dump must work even when the
+            # serving package was never imported or is itself broken.
+            import json as _json
+
+            from deeplearning4j_tpu.serving.tracing import flight_recorder
+            events = flight_recorder().snapshot()
+            if events:
+                lines.append("")
+                lines.append(f"---- flight recorder (last {len(events)} "
+                             "events) " + "-" * 20)
+                for e in events:
+                    lines.append(_json.dumps(e, default=str))
+        except Exception:
+            pass
         lines.append("")
         lines.append("---- model " + "-" * 54)
         lines.append(f"class: {type(model).__name__}")
