@@ -11,8 +11,11 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "decode",
 gate from BASELINE.json) since the reference publishes no in-tree numbers
 (SURVEY.md §6, BASELINE "published": {}). ``decode`` reports the
 GenerationEngine's steady-state numbers: decode tokens/sec across all
-slots, median time-to-first-token, slot occupancy at steady state, and
-the compiled-signature count (must stay ≤ prefill ladder + 1).
+slots, median time-to-first-token, slot occupancy at steady state, the
+compiled-signature count (must stay ≤ prefill ladder + 1), the paged
+KV-cache capacity roll-up (HBM bytes per resident stream vs the
+contiguous layout, block utilization) and the ``shared_prefix``
+scenario (N streams over one registered prefix — one prefill total).
 ``availability`` is the resilience leg: success rate and p99 latency under
 a fixed seeded FaultPlan injecting 5% transient dispatch failures.
 """
@@ -120,7 +123,15 @@ def decode_leg(on_tpu: bool) -> dict:
     and retirements interleave with decode iterations) and report the
     scheduler's sustained rate. Decode tokens/sec is summed across slots:
     one decode_step samples a token for EVERY live slot, which is exactly
-    why iteration-level scheduling wins over request-level batching."""
+    why iteration-level scheduling wins over request-level batching.
+
+    The KV roll-up is the paged-cache capacity story (vLLM SOSP'23): a
+    resident stream holds ceil((len+max_new)/block) blocks instead of a
+    worst-case max_len row, so at the contiguous layout's HBM budget the
+    pool seats `resident_streams_at_contiguous_budget` streams — the
+    chat-shaped prompt mix (lengths well under max_len) is where paging
+    earns its keep. `shared_prefix` is the CoW scenario: N streams over
+    one 256-token registered prefix, ONE prefix prefill total."""
     from deeplearning4j_tpu.models import (
         TransformerConfig, init_params)
     from deeplearning4j_tpu.serving import GenerationEngine
@@ -146,36 +157,135 @@ def decode_leg(on_tpu: bool) -> dict:
         # the engine is idle here, so the swap cannot race a live stream
         from deeplearning4j_tpu.serving import ServingMetrics
         eng.metrics = ServingMetrics()
+        eng.metrics.kv_blocks_total.set(eng._allocator.capacity)
         handles = []
         t0 = time.perf_counter()
         for i in range(n_requests):
-            n = int(rng.integers(4, max_len - max_new))
+            # chat-shaped mix: prompts well under max_len (mean seq ≈
+            # max_len/4 with the generation budget) — the regime where
+            # block-granular storage beats worst-case reservation
+            n = int(rng.integers(4, max_len // 4))
             handles.append(eng.submit(
                 rng.integers(0, cfg.vocab_size, n).astype(np.int32),
                 max_new_tokens=max_new))
-        # steady-state occupancy: poll the gauge while the backlog drains
-        # (sampling at submit time would race the scheduler's admissions)
-        occ_samples = []
-        while not handles[-1].future.done():
+        # steady-state samples: poll the gauges while the backlog drains
+        # (sampling at submit time would race the scheduler's admissions).
+        # First sample unconditionally: on a device fast enough to drain
+        # the backlog before the first 5 ms poll, the loop body would
+        # never run and the capacity metrics would be built from nothing.
+        occ_samples, blk_samples = [], []
+        while True:
             occ_samples.append(eng.metrics.slot_occupancy.value)
+            blk_samples.append(eng.metrics.kv_blocks_in_use.value)
+            if handles[-1].future.done():
+                break
             time.sleep(0.005)
         for h in handles:
             h.result(timeout=600)
         wall_s = time.perf_counter() - t0
         m = eng.metrics
+        occ = float(np.median(occ_samples))
+        blocks_in_use = float(np.median(blk_samples))
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        kv_unit = cfg.layers * 2 * cfg.heads * cfg.head_dim * itemsize
+        block_bytes = eng.block_size * kv_unit
+        contig_stream_bytes = max_len * kv_unit
+        resident = occ * slots
+        # unmeasured (all samples post-drain) reports None, not a 0-byte
+        # stream or an absurd streams-at-budget figure
+        measured = blocks_in_use > 0 and resident > 0
+        paged_stream_bytes = (blocks_in_use * block_bytes / resident
+                              if measured else None)
         return {
             "decode_tokens_per_sec": round(m.decode_tokens_per_sec(), 2),
             "end_to_end_tokens_per_sec": round(
                 n_requests * max_new / wall_s, 2),
             "ttft_ms_p50": round(m.ttft_ms.quantile(0.5), 3),
             "decode_step_ms_p50": round(m.decode_step_ms.quantile(0.5), 3),
-            "steady_state_slot_occupancy": round(
-                float(np.median(occ_samples)) if occ_samples else 1.0, 3),
+            "steady_state_slot_occupancy": round(occ, 3),
             "slots": slots,
             "requests": n_requests,
             "max_new_tokens": max_new,
             "compiled_signatures": eng.compiled_signatures(),
             "signature_bound": len(eng.buckets) + 1,
+            "block_size": eng.block_size,
+            "kv_blocks_total": eng._allocator.capacity,
+            "steady_state_blocks_in_use": round(blocks_in_use, 1),
+            "steady_state_block_utilization": round(
+                blocks_in_use / eng._allocator.capacity, 4),
+            "kv_hbm_bytes_per_resident_stream":
+                round(paged_stream_bytes) if measured else None,
+            "kv_bytes_per_stream_contiguous": contig_stream_bytes,
+            "kv_bytes_per_stream_ratio": round(
+                paged_stream_bytes / contig_stream_bytes, 4)
+                if measured else None,
+            "resident_streams_at_contiguous_budget": int(
+                slots * contig_stream_bytes // paged_stream_bytes)
+                if measured else None,
+            "shared_prefix": shared_prefix_scenario(on_tpu),
+        }
+
+
+def shared_prefix_scenario(on_tpu: bool) -> dict:
+    """Copy-on-write prefix reuse: N streams share ONE registered
+    prefix (a 256-token system prompt at TPU scale). The prefix is
+    prefilled exactly once — every stream references its pinned blocks
+    (the partial tail block via CoW) and feeds only its short suffix
+    through the decode executable, so TTFT stops paying the long-prefix
+    prefill N times and the pool stops storing it N times."""
+    from deeplearning4j_tpu.models import (
+        TransformerConfig, init_params)
+    from deeplearning4j_tpu.serving import GenerationEngine, ServingMetrics
+
+    if on_tpu:
+        cfg = TransformerConfig(causal=True, remat=False,
+                                attention_impl="flash")
+        slots, max_len, n_streams = 16, 512, 48
+        prefix_len, suffix_len, max_new = 256, 8, 32
+    else:                                   # CPU smoke (driver runs TPU)
+        cfg = TransformerConfig(vocab_size=1024, hidden=128, layers=2,
+                                heads=4, mlp_dim=512, max_seq=128,
+                                dtype=jnp.float32, causal=True, remat=False)
+        slots, max_len, n_streams = 8, 128, 32
+        prefix_len, suffix_len, max_new = 64, 4, 8
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, cfg.vocab_size, prefix_len).astype(np.int32)
+    with GenerationEngine(params, cfg, slots=slots, max_len=max_len,
+                          queue_capacity=n_streams + slots) as eng:
+        eng.warmup()
+        eng.metrics = ServingMetrics()      # exclude warmup compiles
+        t0 = time.perf_counter()
+        pid = eng.register_prefix(prefix)
+        handles = [eng.submit(
+            rng.integers(0, cfg.vocab_size, suffix_len).astype(np.int32),
+            prefix_id=pid, max_new_tokens=max_new)
+            for _ in range(n_streams)]
+        for h in handles:
+            h.result(timeout=600)
+        wall_s = time.perf_counter() - t0
+        m = eng.metrics
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        kv_unit = cfg.layers * 2 * cfg.heads * cfg.head_dim * itemsize
+        return {
+            "streams": n_streams,
+            "prefix_tokens": prefix_len,
+            "suffix_tokens": suffix_len,
+            "max_new_tokens": max_new,
+            "prefix_prefills": int(m.prefix_prefills_total.value),
+            "stream_prefills": int(m.prefills_total.value),
+            "one_prefill_for_all_streams":
+                int(m.prefix_prefills_total.value) == 1
+                and int(m.prefills_total.value) == 0,
+            "prefix_hits": int(m.prefix_hits_total.value),
+            "cow_copies": int(m.kv_cow_copies_total.value),
+            "ttft_ms_p50": round(m.ttft_ms.quantile(0.5), 3),
+            "end_to_end_tokens_per_sec": round(
+                n_streams * max_new / wall_s, 2),
+            "prefix_kv_bytes_stored_once": prefix_len * kv_unit,
+            "prefix_kv_bytes_without_sharing":
+                n_streams * prefix_len * kv_unit,
         }
 
 

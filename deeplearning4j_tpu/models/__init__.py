@@ -16,15 +16,21 @@ from deeplearning4j_tpu.models.bert import (
     BERT_BASE,
     init_kv_cache,
     kv_cache_pspecs,
+    paged_kv_cache_pspecs,
     place_kv_cache,
     make_prefill,
     make_decode_step,
+    make_paged_prefill,
+    make_paged_decode_step,
     sample_token,
+    validate_block_size,
 )
 
 __all__ = [
     "TransformerConfig", "init_params", "forward", "lm_loss",
     "make_train_step", "param_pspecs", "BERT_BASE",
-    "init_kv_cache", "kv_cache_pspecs", "place_kv_cache",
-    "make_prefill", "make_decode_step", "sample_token",
+    "init_kv_cache", "kv_cache_pspecs", "paged_kv_cache_pspecs",
+    "place_kv_cache", "make_prefill", "make_decode_step",
+    "make_paged_prefill", "make_paged_decode_step", "sample_token",
+    "validate_block_size",
 ]

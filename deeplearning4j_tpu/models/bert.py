@@ -493,23 +493,82 @@ def place_params(params, cfg: TransformerConfig, mesh: Mesh):
 # positions replicate — see kv_cache_pspecs.
 
 
+def validate_block_size(block_size, max_len: int) -> int:
+    """Validate a paged-cache block size and return it as a plain int:
+    positive power of two (the in-kernel block index math is a
+    shift/mask) no larger than ``max_len``. THE single predicate —
+    shared by :func:`init_kv_cache` and the serving engine's constructor
+    so the check and its named-value error messages cannot drift."""
+    if not isinstance(block_size, (int, np.integer)) or block_size <= 0 \
+            or (int(block_size) & (int(block_size) - 1)) != 0:
+        raise ValueError(
+            f"block_size must be a positive power of two (the in-kernel "
+            f"block index math is a shift/mask), got {block_size!r}")
+    if block_size > max_len:
+        raise ValueError(
+            f"block_size {block_size} exceeds max_len {max_len}: a block "
+            "larger than a slot's whole capacity can never be filled and "
+            "defeats paging")
+    return int(block_size)
+
+
 def init_kv_cache(cfg: TransformerConfig, slots: int, max_len: int,
-                  dtype: Any = None) -> Dict[str, Any]:
-    """Allocate the fixed-shape generation cache. ``dtype`` defaults to the
-    compute dtype (bf16 on TPU) — the cache is read every decode step, so
-    halving it halves decode's dominant HBM stream."""
+                  dtype: Any = None, block_size: Optional[int] = None,
+                  num_blocks: Optional[int] = None) -> Dict[str, Any]:
+    """Allocate the generation cache. ``dtype`` defaults to the compute
+    dtype (bf16 on TPU) — the cache is read every decode step, so halving
+    it halves decode's dominant HBM stream.
+
+    Two layouts share this constructor:
+
+    - ``block_size=None`` (legacy): the contiguous per-slot layout,
+      ``{"layers": [{"k","v"}: (slots, max_len, heads, head_dim)],
+      "lengths": (slots,) int32}`` — every slot reserves worst-case
+      ``max_len`` positions whether it uses them or not.
+    - ``block_size=B`` (paged, vLLM SOSP'23): a shared block pool
+      ``{"layers": [{"k","v"}: (num_blocks, B, heads, head_dim)]}``.
+      Block 0 is the reserved scratch block (dead-slot writes and CoW
+      no-ops land there; it is never allocated to a stream). Slot →
+      position mapping lives OUTSIDE the cache, in a host-side block
+      table the paged prefill/decode executables take as a gather index,
+      so sequence lengths only consume the blocks they touch and a
+      common prefix's blocks can be referenced by many streams.
+      ``num_blocks`` defaults to the contiguous layout's capacity
+      (``slots * ceil(max_len / B)``) plus the scratch block; pass a
+      smaller pool to trade worst-case headroom for resident streams.
+    """
     if max_len > cfg.max_seq:
         raise ValueError(
             f"max_len {max_len} exceeds the model's positional table "
             f"max_seq={cfg.max_seq}")
-    if slots <= 0 or max_len <= 0:
-        raise ValueError("slots and max_len must be positive")
+    if slots <= 0:
+        raise ValueError(f"slots must be positive, got {slots}")
+    if max_len <= 0:
+        raise ValueError(f"max_len must be positive, got {max_len}")
     dt = cfg.dtype if dtype is None else dtype
-    shape = (slots, max_len, cfg.heads, cfg.head_dim)
+    if block_size is None:
+        if num_blocks is not None:
+            raise ValueError(
+                f"num_blocks={num_blocks} requires block_size: the block "
+                "pool is a paged-layout concept")
+        shape = (slots, max_len, cfg.heads, cfg.head_dim)
+        return {
+            "layers": [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+                       for _ in range(cfg.layers)],
+            "lengths": jnp.zeros((slots,), jnp.int32),
+        }
+    block_size = validate_block_size(block_size, max_len)
+    blocks_per_slot = -(-max_len // block_size)
+    if num_blocks is None:
+        num_blocks = slots * blocks_per_slot + 1
+    if num_blocks < 2:
+        raise ValueError(
+            f"num_blocks must be >= 2 (block 0 is the reserved scratch "
+            f"block), got {num_blocks}")
+    shape = (num_blocks, block_size, cfg.heads, cfg.head_dim)
     return {
         "layers": [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
                    for _ in range(cfg.layers)],
-        "lengths": jnp.zeros((slots,), jnp.int32),
     }
 
 
@@ -526,9 +585,22 @@ def kv_cache_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
     }
 
 
+def paged_kv_cache_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs for the paged block pool: heads over 'model' (the
+    same column-parallel qkv alignment as the contiguous cache), blocks
+    and in-block positions replicated — the block table is a host-side
+    gather index over the (replicated) block axis, so paging adds zero
+    collectives under a dp/tp mesh."""
+    kv = P(None, None, MODEL_AXIS, None)
+    return {"layers": [{"k": kv, "v": kv} for _ in range(cfg.layers)]}
+
+
 def place_kv_cache(cache, cfg: TransformerConfig, mesh: Mesh):
-    """Shard a generation cache onto the mesh per kv_cache_pspecs."""
-    return jax.device_put(cache, tree_shardings(mesh, kv_cache_pspecs(cfg)))
+    """Shard a generation cache (either layout — the contiguous one
+    carries 'lengths', the paged pool does not) onto the mesh."""
+    spec = kv_cache_pspecs(cfg) if "lengths" in cache \
+        else paged_kv_cache_pspecs(cfg)
+    return jax.device_put(cache, tree_shardings(mesh, spec))
 
 
 def sample_token(logits, key, temperature, top_k):
@@ -701,4 +773,172 @@ def make_decode_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     return jax.jit(
         decode_step, donate_argnums=(1,),
         in_shardings=(param_sh, cache_sh) + (repl,) * 6,
+        out_shardings=(cache_sh, repl))
+
+
+# --------------------------------------------------------------------------
+# Paged generation: block-pool KV cache + block-table gather decode
+# --------------------------------------------------------------------------
+#
+# The contiguous cache above reserves worst-case (slots, max_len) rows, so
+# HBM — not compute — caps resident streams. The paged variants (vLLM,
+# Kwon et al. SOSP '23) store K/V in a shared pool of fixed-size blocks and
+# address it through a per-slot FIXED-SHAPE block table passed from the
+# host: decode gathers ``pool[block_table]`` back into the exact (S, L,
+# heads, head_dim) layout the contiguous attention consumed, so the math —
+# and crucially the compiled-signature story — is unchanged: ONE donated
+# decode executable for the engine's lifetime, one prefill per prompt
+# bucket. Sequence lengths host-side; copy-on-write for shared prefixes is
+# a (src, dst) block-copy argument folded INTO the decode executable (a
+# no-op self-copy of the scratch block on steps with nothing to CoW), so
+# prefix sharing mints no third executable.
+
+
+def make_paged_prefill(cfg: TransformerConfig, block_size: int,
+                       mesh: Optional[Mesh] = None):
+    """Build the jitted paged prefill: one PADDED prompt through the
+    standard forward (the same ``_block``), its per-layer K/V scattered
+    into the physical blocks named by ``block_row``, and token 0 sampled.
+
+    ``prefill(params, cache, tokens, block_row, length, key, temperature,
+    top_k) -> (cache, token0)`` with tokens (1, T_bucket) int32 and
+    ``block_row`` (ceil(T_bucket/block_size),) int32 physical block ids —
+    entries past the prompt's real blocks point at the reserved scratch
+    block 0, so padding K/V lands in scratch, never in a live block. One
+    executable per T bucket; the cache (block pool) is donated. Unlike
+    the contiguous prefill there is no ``slot`` argument: lengths live on
+    the host, and the block row alone names where this prompt's K/V go."""
+    if not cfg.causal:
+        raise ValueError("generation needs a causal LM: set "
+                         "TransformerConfig(causal=True)")
+
+    def prefill(params, cache, tokens, block_row, length, key,
+                temperature, top_k):
+        _, T = tokens.shape
+        nb = block_row.shape[0]
+        pad = nb * block_size - T
+        with jax.default_matmul_precision("default"):
+            x = params["tok_emb"][tokens].astype(cfg.dtype) \
+                + params["pos_emb"][:T][None].astype(cfg.dtype)
+            layers = []
+            for bp, lc in zip(params["blocks"], cache["layers"]):
+                x, k, v = _block(bp, x, cfg, mesh, return_kv=True)
+                kb = jnp.pad(k[0], ((0, pad), (0, 0), (0, 0))).reshape(
+                    nb, block_size, cfg.heads, cfg.head_dim)
+                vb = jnp.pad(v[0], ((0, pad), (0, 0), (0, 0))).reshape(
+                    nb, block_size, cfg.heads, cfg.head_dim)
+                layers.append({
+                    "k": lc["k"].at[block_row].set(kb.astype(lc["k"].dtype)),
+                    "v": lc["v"].at[block_row].set(vb.astype(lc["v"].dtype)),
+                })
+            x = _layernorm(x, params["ln_f"])
+            last = lax.dynamic_index_in_dim(x[0], length - 1, axis=0,
+                                            keepdims=False)
+            logits = (last @ params["lm_head"].astype(last.dtype)
+                      ).astype(jnp.float32)
+        token0 = _sample_at(logits, key, 0, temperature, top_k)
+        return {"layers": layers}, token0
+
+    if mesh is None:
+        return jax.jit(prefill, donate_argnums=(1,))
+    param_sh = _shardings(cfg, mesh)
+    cache_sh = tree_shardings(mesh, paged_kv_cache_pspecs(cfg))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        prefill, donate_argnums=(1,),
+        in_shardings=(param_sh, cache_sh) + (repl,) * 6,
+        out_shardings=(cache_sh, repl))
+
+
+def make_paged_decode_step(cfg: TransformerConfig, block_size: int,
+                           mesh: Optional[Mesh] = None):
+    """Build THE paged decode executable: one token for every slot.
+
+    ``decode_step(params, cache, tables, lengths, tokens, keys, steps,
+    temperatures, top_ks, cow_src, cow_dst) -> (cache, next_tokens)``
+    where ``tables`` is the (slots, max_blocks_per_slot) int32 block table
+    (a dead slot's row is all scratch-block 0 — its write lands in
+    scratch, its gather reads masked garbage), ``lengths`` (slots,) int32
+    the host-tracked token counts, and ``cow_src``/``cow_dst`` (slots,)
+    int32 drive the copy-on-write: each slot's dst block is overwritten
+    with its src block BEFORE this step's K/V write and gather (slots with
+    nothing to CoW pass src == dst == 0, a scratch self-copy). Every
+    argument is fixed-shape, so this compiles EXACTLY ONCE per engine
+    lifetime — the block-table gather preserves the contiguous path's
+    one-donated-executable invariant while the pool replaces the
+    per-slot worst-case reservation."""
+    if not cfg.causal:
+        raise ValueError("generation needs a causal LM: set "
+                         "TransformerConfig(causal=True)")
+
+    def decode_block(bp, x, lc, tables, pos, cow_src, cow_dst):
+        # x: (S, hidden); lc["k"]/["v"]: (NB, B, heads, D); tables:
+        # (S, max_blocks); pos: (S,) logical write position. CoW first,
+        # then the new K/V write, then the gather — data dependence
+        # orders them, so the gathered sequence sees both.
+        S, H = x.shape
+        nb = tables.shape[1]
+        L = nb * block_size
+        h = _layernorm(x, bp["ln1"])
+        qkv = h @ bp["qkv"]["kernel"].astype(h.dtype) \
+            + bp["qkv"]["bias"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(S, cfg.heads, cfg.head_dim)
+        rows = jnp.arange(S)
+        ck = lc["k"].at[cow_dst].set(lc["k"][cow_src])
+        cv = lc["v"].at[cow_dst].set(lc["v"][cow_src])
+        blk = pos // block_size
+        off = pos % block_size
+        pb = tables[rows, blk]                                 # (S,)
+        ck = ck.at[pb, off].set(
+            k.reshape(S, cfg.heads, cfg.head_dim).astype(ck.dtype))
+        cv = cv.at[pb, off].set(
+            v.reshape(S, cfg.heads, cfg.head_dim).astype(cv.dtype))
+        # block-table gather: back to the exact (S, L, heads, D) layout
+        # the contiguous attention consumed — same einsums, same mask
+        gk = ck[tables].reshape(S, L, cfg.heads, cfg.head_dim)
+        gv = cv[tables].reshape(S, L, cfg.heads, cfg.head_dim)
+        scale = 1.0 / np.sqrt(cfg.head_dim)
+        s = jnp.einsum("shd,slhd->shl", q, gk.astype(q.dtype)) * scale
+        mask = jnp.arange(L)[None, :] <= pos[:, None]          # (S, L)
+        s = jnp.where(mask[:, None, :], s, jnp.finfo(s.dtype).min)
+        p = jax.nn.softmax(s.astype(cfg.softmax_dtype), axis=-1).astype(q.dtype)
+        o = jnp.einsum("shl,slhd->shd", p, gv.astype(p.dtype)).reshape(S, H)
+        x = x + o @ bp["attn_out"]["kernel"].astype(o.dtype) \
+            + bp["attn_out"]["bias"].astype(o.dtype)
+        h = _layernorm(x, bp["ln2"])
+        h = h @ bp["mlp_in"]["kernel"].astype(h.dtype) \
+            + bp["mlp_in"]["bias"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        x = x + h @ bp["mlp_out"]["kernel"].astype(h.dtype) \
+            + bp["mlp_out"]["bias"].astype(h.dtype)
+        return x, {"k": ck, "v": cv}
+
+    def decode_step(params, cache, tables, lengths, tokens, keys, steps,
+                    temperatures, top_ks, cow_src, cow_dst):
+        L = tables.shape[1] * block_size
+        pos = jnp.clip(lengths, 0, min(L, cfg.max_seq) - 1)
+        with jax.default_matmul_precision("default"):
+            x = params["tok_emb"][tokens].astype(cfg.dtype) \
+                + params["pos_emb"][pos].astype(cfg.dtype)
+            layers = []
+            for bp, lc in zip(params["blocks"], cache["layers"]):
+                x, lc = decode_block(bp, x, lc, tables, pos, cow_src,
+                                     cow_dst)
+                layers.append(lc)
+            x = _layernorm(x, params["ln_f"])
+            logits = (x @ params["lm_head"].astype(x.dtype)
+                      ).astype(jnp.float32)
+        next_tokens = jax.vmap(_sample_at)(logits, keys, steps,
+                                           temperatures, top_ks)
+        return {"layers": layers}, next_tokens
+
+    if mesh is None:
+        return jax.jit(decode_step, donate_argnums=(1,))
+    param_sh = _shardings(cfg, mesh)
+    cache_sh = tree_shardings(mesh, paged_kv_cache_pspecs(cfg))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        decode_step, donate_argnums=(1,),
+        in_shardings=(param_sh, cache_sh) + (repl,) * 9,
         out_shardings=(cache_sh, repl))

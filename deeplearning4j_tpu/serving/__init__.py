@@ -6,7 +6,8 @@ ref: deeplearning4j-parallel-wrapper ParallelInference BATCHED mode,
 rebuilt around XLA's compile-once/dispatch-many execution model — see
 serving/engine.py and serving/generation.py for the design notes)."""
 from deeplearning4j_tpu.serving.admission import (  # noqa: F401
-    AdmissionController, DeadlineExceededError, QueueFullError, RejectedError,
+    AdmissionController, DeadlineExceededError, KVBlocksExhaustedError,
+    QueueFullError, RejectedError,
 )
 from deeplearning4j_tpu.serving.engine import InferenceEngine, bucket_ladder  # noqa: F401
 from deeplearning4j_tpu.serving.faults import (  # noqa: F401
@@ -18,6 +19,9 @@ from deeplearning4j_tpu.serving.generation import (  # noqa: F401
 from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, ReasonCounter, ServingMetrics,
     SlidingWindowStats,
+)
+from deeplearning4j_tpu.serving.paging import (  # noqa: F401
+    BlockAllocator, SharedPrefix, blocks_for_tokens,
 )
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     CausalLMAdapter, Deployment, ModelAdapter, ModelRegistry, as_adapter,
@@ -33,9 +37,11 @@ from deeplearning4j_tpu.serving.tracing import (  # noqa: F401
 from deeplearning4j_tpu.serving import tracing as tracing  # noqa: F401
 
 __all__ = [
-    "AdmissionController", "DeadlineExceededError", "QueueFullError",
-    "RejectedError", "InferenceEngine", "bucket_ladder", "Counter", "Gauge",
-    "Histogram", "ReasonCounter", "ServingMetrics", "SlidingWindowStats",
+    "AdmissionController", "DeadlineExceededError", "KVBlocksExhaustedError",
+    "QueueFullError", "RejectedError", "InferenceEngine", "bucket_ladder",
+    "Counter", "Gauge", "Histogram", "ReasonCounter", "ServingMetrics",
+    "SlidingWindowStats", "BlockAllocator", "SharedPrefix",
+    "blocks_for_tokens",
     "Deployment", "ModelAdapter", "ModelRegistry", "as_adapter",
     "GenerationEngine", "GenerationHandle", "prefill_buckets",
     "CausalLMAdapter", "FaultPlan", "FaultInjectedError", "inject",

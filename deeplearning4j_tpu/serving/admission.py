@@ -47,6 +47,25 @@ class DeadlineExceededError(RejectedError):
         super().__init__(msg, "deadline")
 
 
+class KVBlocksExhaustedError(RejectedError):
+    """The paged KV-cache block pool cannot serve this request (reason
+    'kv_blocks_exhausted'): its worst-case block reservation exceeds what
+    the pool can EVER free (capacity minus pinned shared-prefix blocks).
+    Transient pressure — enough usable blocks, just currently held by
+    live streams — is NOT this error: those requests wait in queue and
+    ride the normal deadline/queue-full backpressure. Carries ``needed``
+    / ``usable`` / ``capacity`` (in blocks) so callers and dashboards see
+    how far over budget the request was."""
+
+    def __init__(self, msg: str, needed: Optional[int] = None,
+                 usable: Optional[int] = None,
+                 capacity: Optional[int] = None):
+        super().__init__(msg, "kv_blocks_exhausted")
+        self.needed = needed
+        self.usable = usable
+        self.capacity = capacity
+
+
 @dataclass
 class Request:
     """One submitted inference request (``rows`` leading-dim rows of x)."""
@@ -198,6 +217,36 @@ class AdmissionController:
                 self._shed(req)
             if decided:
                 return out
+
+    def requeue_head(self, req: Request):
+        """Return a just-dequeued request to the queue HEAD. The paged
+        generation scheduler pops the head to inspect its block demand and
+        puts it back when the pool cannot serve it *yet* (free blocks will
+        reappear as live streams retire) — FIFO order is preserved because
+        there is exactly one consumer. If the controller closed in
+        between, the request is rejected the same way ``close()`` rejects
+        queued work (failing outside the lock, as everywhere)."""
+        rejected = False
+        with self._cv:
+            if self._closed:
+                rejected = True
+            else:
+                self._q.appendleft(req)
+                self._rows += req.rows
+                self._cv.notify()
+        if not rejected:
+            return
+        try:
+            req.future.set_exception(
+                RejectedError("engine shut down with request queued",
+                              "shutdown"))
+        except InvalidStateError:
+            self._cancelled(req)
+            return
+        if self.on_close_reject is not None:
+            self.on_close_reject(req)
+        else:
+            req.trace.finish("shutdown")
 
     def expire_queued(self) -> int:
         """Proactively shed every expired request still queued, returning

@@ -12,9 +12,19 @@ into free cache slots (prefill, padded to a prompt-length bucket ladder),
 token to its caller, and (4) retires EOS/max-token slots immediately so
 their slots are free for the next admission — a short request enters and
 leaves mid-flight of a long one. vLLM (Kwon et al., SOSP '23) showed the
-cache layout is the other half of the lever; here the fixed (slots,
-max_len) layout is chosen so XLA compiles exactly ONE decode executable
-plus one prefill per bucket for the engine's whole lifetime.
+cache layout is the other half of the lever: by default the cache is now
+PAGED — a shared pool of fixed-size blocks addressed through per-slot
+block tables (``serving/paging.py`` owns the host-side free-list
+allocator with refcounts; ``models/bert.py`` the block-table gather
+executables) — so a stream only consumes the blocks its actual length
+touches, admission is gated on free BLOCKS rather than worst-case slots,
+and a shared prefix (``submit(prefix_id=...)``) is prefilled once with
+its blocks pinned and referenced by every stream that names it,
+copy-on-write on the first write into a partially-filled shared block.
+Either layout compiles exactly ONE decode executable plus one prefill
+per bucket for the engine's whole lifetime (the block table is a
+fixed-shape gather index and the CoW copy rides the decode step's
+``cow_src``/``cow_dst`` arguments — no third executable).
 
 Determinism: sampling is gumbel-max under a per-request PRNG key folded
 with the token index, and every per-slot computation is row-wise — so a
@@ -29,22 +39,27 @@ prompts that waited too long before ever touching a slot.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, InvalidStateError
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 from deeplearning4j_tpu.profiler import OpProfiler
 from deeplearning4j_tpu.serving.admission import (
-    AdmissionController, RejectedError, Request,
+    AdmissionController, KVBlocksExhaustedError, RejectedError, Request,
 )
 from deeplearning4j_tpu.serving.engine import bucket_ladder
 from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.paging import (
+    BlockAllocator, SharedPrefix, blocks_for_tokens,
+)
 from deeplearning4j_tpu.serving.resilience import (
     CircuitBreaker, ResilientEngineMixin, RetryPolicy, WatchdogTimeoutError,
 )
@@ -74,6 +89,7 @@ class GenerationRequest:
     top_k: int
     eos_id: Optional[int]
     key: np.ndarray                 # (2,) uint32 base PRNG key
+    prefix_id: Optional[str] = None  # shared-prefix reference (paged only)
     handle: "GenerationHandle" = None
 
 
@@ -165,6 +181,18 @@ class _Slot:
     request: Request
     n_generated: int = 0
     last_token: int = 0
+    # ---- paged-cache bookkeeping (None/empty on the contiguous path) ----
+    length: int = 0                  # tokens whose K/V are in the cache
+    blocks: Optional[List[int]] = None   # every block this stream refs
+    prefix_len: int = 0              # shared-prefix tokens (block-aligned
+    #                                  part lives in shared blocks)
+    # prompt tokens still to feed through decode steps (prefix streams
+    # skip prefill: the suffix rides the decode executable one token per
+    # iteration, attending to the shared prefix's pinned blocks)
+    pending: Optional[Deque[int]] = None
+    # one-shot copy-on-write for the first write into a partially-filled
+    # shared block: (src physical block, dst physical block)
+    cow: Optional[Tuple[int, int]] = None
 
 
 class GenerationEngine(ResilientEngineMixin):
@@ -181,6 +209,17 @@ class GenerationEngine(ResilientEngineMixin):
     every decode-step participation, retries, retirement);
     ``screen_outputs`` is the cheap poisoned-result guard on sampled
     tokens (NaN/inf or out-of-vocab ids fail the iteration typed).
+
+    ``paged=True`` (the default) stores K/V in a shared block pool
+    (``block_size`` tokens per block, ``num_blocks`` total — default
+    matches the contiguous footprint) addressed through per-slot block
+    tables: admission is gated on free BLOCKS (typed
+    'kv_blocks_exhausted' shed when a request can never fit), each
+    stream reserves only ``ceil((len + max_new)/block_size)`` blocks
+    instead of ``max_len`` rows, and :meth:`register_prefix` /
+    ``submit(prefix_id=...)`` share one prefilled prefix across any
+    number of streams with copy-on-write. ``paged=False`` keeps the PR 2
+    contiguous layout (the bitwise-parity reference).
     """
 
     _COMPONENT = "serving.GenerationEngine"
@@ -190,6 +229,9 @@ class GenerationEngine(ResilientEngineMixin):
                  max_len: Optional[int] = None,
                  buckets: Optional[Sequence[int]] = None,
                  cache_dtype: Any = None,
+                 paged: bool = True,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
                  queue_capacity: int = 64,
                  default_timeout_ms: Optional[float] = None,
                  eos_id: Optional[int] = None,
@@ -201,8 +243,8 @@ class GenerationEngine(ResilientEngineMixin):
                  tracer=None, recorder=None, screen_outputs: bool = True,
                  name: str = "generation"):
         from deeplearning4j_tpu.models.bert import (
-            init_kv_cache, make_decode_step, make_prefill, place_kv_cache,
-            place_params)
+            init_kv_cache, make_decode_step, make_paged_decode_step,
+            make_paged_prefill, make_prefill, place_kv_cache, place_params)
 
         if not cfg.causal:
             raise ValueError(
@@ -226,11 +268,43 @@ class GenerationEngine(ResilientEngineMixin):
         if mesh is not None:
             params = place_params(params, cfg, mesh)
         self.params = params
-        self._prefill = make_prefill(cfg, mesh)
-        self._decode = make_decode_step(cfg, mesh)
+        self.paged = paged
+        if paged:
+            from deeplearning4j_tpu.models.bert import validate_block_size
+
+            if block_size is None:
+                # default: 16-token blocks, degrading to the largest
+                # power of two that fits a tiny max_len
+                block_size = 16
+                while block_size > self.max_len:
+                    block_size //= 2
+            self.block_size = validate_block_size(block_size, self.max_len)
+            self.max_blocks_per_slot = blocks_for_tokens(self.max_len,
+                                                         self.block_size)
+            self.num_blocks = (slots * self.max_blocks_per_slot + 1
+                               if num_blocks is None else int(num_blocks))
+            self._prefill = make_paged_prefill(cfg, self.block_size, mesh)
+            self._decode = make_paged_decode_step(cfg, self.block_size, mesh)
+        else:
+            self.block_size = None
+            self.num_blocks = None
+            self._prefill = make_prefill(cfg, mesh)
+            self._decode = make_decode_step(cfg, mesh)
         self._cache_dtype = cache_dtype
         self._place_kv_cache = place_kv_cache
         self._init_kv_cache = init_kv_cache
+        # shared-prefix registry (paged only): id -> SharedPrefix, plus a
+        # scheduler-drained prefill queue — prefix prefills must run on
+        # the scheduler thread because they donate the same cache the
+        # decode loop donates
+        self._prefixes: Dict[str, SharedPrefix] = {}
+        self._prefix_lock = threading.Lock()
+        self._pending_prefix: Deque[Tuple[str, Optional[Future]]] = deque()
+        self._prefix_ids = itertools.count()
+        self._prefix_busy = False
+        self._allocator: Optional[BlockAllocator] = None
+        self._tables: Optional[np.ndarray] = None
+        self._slots: List[Optional[_Slot]] = [None] * slots
         self._reset_cache()
         # slot-unit admission: one request == one future slot (rows=1)
         self._admission = AdmissionController(
@@ -239,7 +313,6 @@ class GenerationEngine(ResilientEngineMixin):
         self._admission.on_shed = self._count_shed
         self._admission.on_close_reject = self._count_close_reject
         self._admission.on_cancelled = self._count_cancelled
-        self._slots: List[Optional[_Slot]] = [None] * slots
         self._stop = threading.Event()
         self.screen_outputs = screen_outputs
         # resilience + observability scaffolding is the shared mixin
@@ -272,6 +345,17 @@ class GenerationEngine(ResilientEngineMixin):
         self._shutdown_resilience()   # watchdog off, breaker detached
         self._stop.set()
         self._admission.close()
+        with self._prefix_lock:
+            pending, self._pending_prefix = list(self._pending_prefix), deque()
+        for _pid, fut in pending:   # waiting register_prefix() callers
+            if fut is None:
+                continue
+            try:
+                fut.set_exception(RejectedError(
+                    "engine shut down before the prefix was prefilled",
+                    "shutdown"))
+            except InvalidStateError:
+                pass
         self._recorder.record("engine.shutdown", engine=self.name)
         if wait and self._thread.is_alive():
             self._thread.join(timeout=30.0)
@@ -281,6 +365,7 @@ class GenerationEngine(ResilientEngineMixin):
                temperature: float = 0.0, top_k: int = 0,
                eos_id: Any = _UNSET, seed: int = 0,
                timeout_ms: Optional[float] = None,
+               prefix_id: Optional[str] = None,
                on_token: Optional[Callable[[int], None]] = None
                ) -> GenerationHandle:
         """Queue one prompt. Greedy by default; ``temperature`` > 0 samples,
@@ -290,17 +375,40 @@ class GenerationEngine(ResilientEngineMixin):
         regardless of co-scheduling). ``eos_id`` defaults to the engine's;
         pass ``eos_id=None`` to disable EOS retirement for this request.
         ``timeout_ms`` bounds QUEUE time: prompts shed on deadline never
-        occupy a slot."""
+        occupy a slot. ``prefix_id`` (paged cache only) names a prefix
+        previously registered with :meth:`register_prefix`: the stream's
+        logical sequence is ``prefix + prompt``, the prefix's pinned
+        blocks are REFERENCED (not recomputed — its prefill happened
+        once), and only the prompt suffix is fed through the decode
+        executable, so thousands of concurrent streams share one
+        prefill."""
         toks = np.ascontiguousarray(np.asarray(prompt, np.int32).ravel())
         if toks.size == 0:
             raise ValueError("prompt must contain at least one token")
         if max_new_tokens <= 0:
             raise ValueError("max_new_tokens must be positive")
-        if toks.size + max_new_tokens > self.max_len:
+        prefix_len = 0
+        if prefix_id is not None:
+            if not self.paged:
+                raise ValueError(
+                    "prefix_id requires the paged KV cache "
+                    "(GenerationEngine(paged=True))")
+            with self._prefix_lock:
+                prefix = self._prefixes.get(prefix_id)
+            if prefix is None:
+                raise KeyError(
+                    f"prefix_id {prefix_id!r} is not registered — call "
+                    "register_prefix() first")
+            prefix_len = prefix.length
+        total = prefix_len + toks.size + max_new_tokens
+        if total > self.max_len:
             raise ValueError(
-                f"prompt ({toks.size}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds the cache capacity max_len={self.max_len}")
-        if toks.size > self.buckets[-1]:
+                f"prefix ({prefix_len}) + prompt ({toks.size}) + "
+                f"max_new_tokens ({max_new_tokens}) exceeds the cache "
+                f"capacity max_len={self.max_len}")
+        if prefix_id is None and toks.size > self.buckets[-1]:
+            # prefix streams skip prefill entirely (the suffix rides the
+            # decode executable), so the bucket ladder does not bound them
             raise ValueError(
                 f"prompt ({toks.size}) exceeds the top prefill bucket "
                 f"{self.buckets[-1]} — extend `buckets` up to max_len")
@@ -308,7 +416,7 @@ class GenerationEngine(ResilientEngineMixin):
             prompt=toks, max_new_tokens=max_new_tokens,
             temperature=float(temperature), top_k=int(top_k),
             eos_id=self.eos_id if eos_id is _UNSET else eos_id,
-            key=np.asarray(jax.random.PRNGKey(seed)))
+            key=np.asarray(jax.random.PRNGKey(seed)), prefix_id=prefix_id)
         trace = self._tracer.begin(self.name, "generate",
                                    prompt_len=int(toks.size),
                                    max_new_tokens=max_new_tokens)
@@ -316,6 +424,23 @@ class GenerationEngine(ResilientEngineMixin):
         greq.handle = GenerationHandle(req, toks.size, on_token=on_token)
         self.metrics.requests_total.inc()
         self._breaker_gate(trace)
+        if self.paged:
+            # structural shed: a reservation the pool can never satisfy
+            # (capacity minus prefix pins) fails typed NOW, not after a
+            # queue wait that cannot end any other way
+            needed = self._fresh_blocks_needed(prefix_len, int(toks.size),
+                                               max_new_tokens)
+            usable = self._usable_blocks()
+            if needed > usable:
+                e = KVBlocksExhaustedError(
+                    f"request needs {needed} KV blocks but the pool can "
+                    f"free at most {usable} of {self._allocator.capacity} "
+                    f"(block_size={self.block_size}; shared-prefix pins "
+                    f"excluded) — shrink the request or grow num_blocks",
+                    needed=needed, usable=usable,
+                    capacity=self._allocator.capacity)
+                self._reject_submit(trace, e)
+                raise e
         try:
             self._admission.admit(req, timeout_ms=timeout_ms)
         except RejectedError as e:
@@ -329,6 +454,111 @@ class GenerationEngine(ResilientEngineMixin):
         """Blocking submit: the full generated-token list."""
         return self.submit(prompt, **kwargs).result(timeout=timeout)
 
+    # ------------------------------------------------------ shared prefixes
+    def register_prefix(self, tokens, prefix_id: Optional[str] = None,
+                        timeout: Optional[float] = 300.0) -> str:
+        """Prefill a shared prefix ONCE and pin its blocks; returns the
+        id to pass as ``submit(prefix_id=...)``. The prefill runs on the
+        scheduler thread (it donates the same cache the decode loop
+        donates) — this call blocks until the prefix is resident. After a
+        cache rebuild (device failure / watchdog restart) the pinned K/V
+        is gone; the registration survives and the next stream naming it
+        triggers a lazy re-prefill from the retained tokens."""
+        if not self.paged:
+            raise ValueError("register_prefix requires the paged KV cache "
+                             "(GenerationEngine(paged=True))")
+        toks = np.ascontiguousarray(np.asarray(tokens, np.int32).ravel())
+        if toks.size == 0:
+            raise ValueError("prefix must contain at least one token")
+        if toks.size > self.buckets[-1]:
+            raise ValueError(
+                f"prefix ({toks.size}) exceeds the top prefill bucket "
+                f"{self.buckets[-1]} — extend `buckets` up to max_len")
+        if toks.size >= self.max_len:
+            raise ValueError(
+                f"prefix ({toks.size}) leaves no room to generate within "
+                f"max_len={self.max_len}")
+        nb = blocks_for_tokens(int(toks.size), self.block_size)
+        fut: Future = Future()
+        with self._prefix_lock:
+            if self._stop.is_set():
+                raise RejectedError("engine is shut down", "shutdown")
+            # capacity gate under the lock, counting BOTH prefilled pins
+            # and not-yet-prefilled registrations' worst cases — two
+            # concurrent registrations must not both pass and over-commit
+            # the pool (the loser would wedge the prefill queue forever)
+            reserved = sum(
+                len(p.blocks) if p.blocks
+                else blocks_for_tokens(p.length, self.block_size)
+                for p in self._prefixes.values())
+            usable = self._allocator.capacity - reserved
+            if nb > usable:
+                raise KVBlocksExhaustedError(
+                    f"prefix needs {nb} KV blocks but only {usable} of "
+                    f"{self._allocator.capacity} can ever be pinned "
+                    "(other prefixes hold the rest)",
+                    needed=nb, usable=usable,
+                    capacity=self._allocator.capacity)
+            if prefix_id is None:
+                prefix_id = f"prefix-{next(self._prefix_ids)}"
+            if prefix_id in self._prefixes:
+                raise ValueError(
+                    f"prefix_id {prefix_id!r} is already registered")
+            self._prefixes[prefix_id] = SharedPrefix(prefix_id, toks)
+            self._pending_prefix.append((prefix_id, fut))
+        try:
+            fut.result(timeout)
+        except BaseException:
+            # timeout / prefill failure / shutdown: withdraw the
+            # registration so its worst-case reservation doesn't shrink
+            # the pool (and gate stream admission) forever. A prefill
+            # already in flight copes: on finding the id unregistered it
+            # frees its blocks instead of publishing them.
+            with self._prefix_lock:
+                p = self._prefixes.get(prefix_id)
+                if p is not None and not p.ready:
+                    del self._prefixes[prefix_id]
+                    self._pending_prefix = deque(
+                        (pid, f) for pid, f in self._pending_prefix
+                        if pid != prefix_id)
+            raise
+        return prefix_id
+
+    def release_prefix(self, prefix_id: str) -> bool:
+        """Drop a shared prefix's pin. Its blocks return to the free list
+        once the last live stream referencing them retires; queued streams
+        naming the id will fail at admission. Returns False for an
+        unknown id (already released)."""
+        with self._prefix_lock:
+            prefix = self._prefixes.pop(prefix_id, None)
+            if prefix is None:
+                return False
+            blocks, prefix.blocks = prefix.blocks, None
+            if blocks:
+                # under _prefix_lock so a concurrent cache rebuild (which
+                # clears prefix.blocks and replaces the allocator, also
+                # under this lock) cannot interleave a double free
+                self._allocator.free(blocks)
+        self._recorder.record("prefix.release", engine=self.name,
+                              prefix_id=prefix_id)
+        return True
+
+    def _usable_blocks(self, excluding: Optional[str] = None) -> int:
+        """Blocks a request could EVER get: pool capacity minus
+        shared-prefix pins (live streams' blocks come back at retire;
+        pins do not). A REGISTERED prefix that has not prefilled yet
+        (queued, or awaiting lazy re-prefill after a rebuild) reserves
+        its worst case too — otherwise two concurrent registrations
+        could both pass the gate and over-commit the pool.
+        ``excluding`` names a prefix whose own reservation should not
+        count against itself (the drain's can-this-ever-fit check)."""
+        with self._prefix_lock:
+            pinned = sum(
+                len(p.blocks) if p.blocks
+                else blocks_for_tokens(p.length, self.block_size)
+                for pid, p in self._prefixes.items() if pid != excluding)
+        return self._allocator.capacity - pinned
+
     # ------------------------------------------------------------ scheduler
     def _live_count(self) -> int:
         return sum(s is not None for s in self._slots)
@@ -338,11 +568,65 @@ class GenerationEngine(ResilientEngineMixin):
         prefill/decode failure: both jitted calls DONATE the cache, so an
         exception raised after dispatch leaves ``self._cache`` bound to
         deleted buffers — without a rebuild every later call would die with
-        'Array has been deleted' while submit() kept accepting work."""
+        'Array has been deleted' while submit() kept accepting work.
+
+        On the paged path the block pool, allocator and block tables are
+        rebuilt together (one consistent empty state — a fresh allocator
+        also makes any straggling zombie free a harmless no-op against a
+        dead object), and every registered prefix is invalidated: its K/V
+        died with the pool, so ``blocks`` drops to None and the next
+        stream naming it re-prefills lazily from the retained tokens."""
         cache = self._init_kv_cache(self.cfg, self.slots, self.max_len,
-                                    dtype=self._cache_dtype)
+                                    dtype=self._cache_dtype,
+                                    block_size=self.block_size,
+                                    num_blocks=self.num_blocks)
         self._cache = self._place_kv_cache(cache, self.cfg, self.mesh) \
             if self.mesh is not None else cache
+        if self.paged:
+            with self._prefix_lock:
+                self._allocator = BlockAllocator(self.num_blocks, reserved=1)
+                self._tables = np.zeros(
+                    (self.slots, self.max_blocks_per_slot), np.int32)
+                for p in self._prefixes.values():
+                    p.blocks = None
+            self.metrics.kv_blocks_total.set(self._allocator.capacity)
+            self._update_block_gauges()
+
+    def _update_block_gauges(self):
+        """Block-pool occupancy / pin / fragmentation gauges (paged only).
+        Occupancy counts RESERVED blocks (the admission view — worst-case
+        reservations included). Fragmentation is the share of TOUCHED
+        block capacity holding no token — the tail waste of
+        partially-filled blocks, bounded by (block_size-1)/block_size per
+        stream — NOT the unwritten generation headroom, which is
+        reservation slack, not block-granularity waste (shared prefix
+        tokens counted once, via each stream's block-aligned shared
+        span)."""
+        alloc = self._allocator
+        if alloc is None:
+            return
+        in_use = alloc.in_use
+        B = self.block_size
+        with self._prefix_lock:
+            pinned = sum(len(p.blocks) for p in self._prefixes.values()
+                         if p.blocks)
+            prefix_tokens = sum(p.length for p in self._prefixes.values()
+                                if p.blocks)
+            touched = sum(blocks_for_tokens(p.length, B)
+                          for p in self._prefixes.values() if p.blocks)
+        tokens = prefix_tokens
+        for st in list(self._slots):
+            if st is not None:
+                aligned_shared = (st.prefix_len // B) * B
+                local = max(0, st.length - aligned_shared)
+                tokens += local
+                touched += blocks_for_tokens(local, B)
+        self.metrics.kv_blocks_in_use.set(in_use)
+        self.metrics.kv_blocks_pinned.set(pinned)
+        cap = alloc.capacity
+        self.metrics.kv_block_occupancy.set(in_use / cap if cap else 0.0)
+        self.metrics.kv_fragmentation.set(
+            max(0.0, 1.0 - tokens / (touched * B)) if touched else 0.0)
 
     def _loop(self, epoch: int):
         """Scheduler loop for one epoch. The watchdog bumps ``_epoch`` on
@@ -353,6 +637,8 @@ class GenerationEngine(ResilientEngineMixin):
             while not self._stop.is_set() and self._epoch == epoch:
                 if self._watchdog is not None:
                     self._watchdog.beat()
+                if self.paged:
+                    self._drain_prefix_queue(epoch)
                 self._admit(epoch)
                 if self._live_count() and self._epoch == epoch:
                     try:
@@ -366,7 +652,8 @@ class GenerationEngine(ResilientEngineMixin):
             # the replacement scheduler's live tenants
             if self._stop.is_set() and self._epoch == epoch:
                 self._fail_live(RejectedError(
-                    "engine shut down mid-generation", "shutdown"))
+                    "engine shut down mid-generation", "shutdown"),
+                    epoch=epoch)
 
     def _on_device_failure(self, exc: BaseException, epoch: int, point: str):
         """Shared failure tail for prefill/decode: the failed call may have
@@ -385,7 +672,7 @@ class GenerationEngine(ResilientEngineMixin):
         with self._wd_lock:
             current = self._epoch == epoch
         if current:
-            self._fail_live(exc)
+            self._fail_live(exc, epoch=epoch)
             self._reset_cache()
 
     def _admit(self, epoch: int):
@@ -394,7 +681,14 @@ class GenerationEngine(ResilientEngineMixin):
         so decode cadence never stalls on an empty queue. Expired prompts
         are shed even under FULL occupancy (no free slot -> no ``take()``
         -> lazy head-shedding alone would let dead prompts hold queue
-        budget and mask the queue-full backpressure signal)."""
+        budget and mask the queue-full backpressure signal).
+
+        Paged: admission is gated on free BLOCKS, not just a free slot —
+        the head request's worst-case reservation is planned first; a
+        demand the pool can never satisfy sheds typed
+        ('kv_blocks_exhausted'), a demand that merely exceeds the
+        CURRENTLY free blocks requeues at the head and waits for
+        retirements (FIFO preserved, deadline shedding still applies)."""
         self._admission.expire_queued()
         for i in range(self.slots):
             if self._stop.is_set() or self._epoch != epoch:
@@ -408,11 +702,24 @@ class GenerationEngine(ResilientEngineMixin):
                 if block:
                     return   # idle and nothing queued: back to the loop
                 continue
+            prefix = None
+            if self.paged:
+                verdict, prefix = self._plan_blocks(req)
+                if verdict == "shed":
+                    continue   # head disposed of typed; slot stays free
+                if verdict == "wait":
+                    self._admission.requeue_head(req)
+                    return     # FIFO: nothing may overtake the head
             if not req.future.set_running_or_notify_cancel():
                 self._finish_request(req.trace, "cancelled")
                 continue     # caller cancelled while queued
             qw = (time.perf_counter() - req.submit_t) * 1e3
             req.trace.event("queue.wait", queue_wait_ms=round(qw, 3))
+            if prefix is not None:
+                # shared-prefix stream: no prefill at all — reference the
+                # pinned blocks and feed the suffix through decode steps
+                self._admit_prefix_stream(i, req, prefix, epoch)
+                continue
             with self._wd_lock:  # visible to the watchdog while on-device
                 self._inflight_prefill = req
             try:
@@ -432,6 +739,278 @@ class GenerationEngine(ResilientEngineMixin):
                 with self._wd_lock:
                     if self._inflight_prefill is req:
                         self._inflight_prefill = None
+
+    # ------------------------------------------------- paged block planning
+    def _fresh_blocks_needed(self, prefix_len: int, n_prompt: int,
+                             max_new: int) -> int:
+        """THE block-demand formula — fresh blocks a stream must
+        allocate: its whole worst-case footprint minus the prefix's
+        FULLY-filled shared blocks (a partially-filled shared tail block
+        is copy-on-written into a fresh block, so it is not deducted).
+        Shared by the submit-time gate, the scheduler's plan, and the
+        seating path so the three can never disagree."""
+        total = prefix_len + n_prompt + max_new
+        return blocks_for_tokens(total, self.block_size) \
+            - prefix_len // self.block_size
+
+    def _blocks_needed(self, greq: GenerationRequest,
+                       prefix: Optional[SharedPrefix]) -> int:
+        return self._fresh_blocks_needed(
+            prefix.length if prefix is not None else 0,
+            int(greq.prompt.size), greq.max_new_tokens)
+
+    def _plan_blocks(self, req: Request):
+        """Dispose of the dequeued head: ('ok', prefix-or-None) when its
+        reservation fits the free pool, ('wait', None) when it must wait
+        for retirements (or for a lazy prefix re-prefill), ('shed', None)
+        when it was failed typed right here."""
+        greq: GenerationRequest = req.x
+        prefix = None
+        if greq.prefix_id is not None:
+            with self._prefix_lock:
+                prefix = self._prefixes.get(greq.prefix_id)
+            if prefix is None:
+                # the caller released the prefix with requests still
+                # queued against it: a client lifecycle mistake, labeled
+                # 'client_error' (not model_error — the model is fine)
+                e = RuntimeError(
+                    f"shared prefix {greq.prefix_id!r} was released while "
+                    "this request was queued")
+                if greq.handle._fail(e):
+                    self._finish_request(req.trace, "client_error")
+                return "shed", None
+            if not prefix.ready:
+                # K/V lost to a cache rebuild (or registration raced the
+                # queue): schedule the lazy re-prefill, wait our turn
+                self._queue_prefix_prefill(greq.prefix_id)
+                return "wait", None
+        needed = self._blocks_needed(greq, prefix)
+        usable = self._usable_blocks()
+        if needed > usable:
+            self._shed_typed(req, KVBlocksExhaustedError(
+                f"request needs {needed} KV blocks but the pool can free "
+                f"at most {usable} of {self._allocator.capacity} "
+                "(shared-prefix pins excluded)",
+                needed=needed, usable=usable,
+                capacity=self._allocator.capacity))
+            return "shed", None
+        # blocks a queued-but-unprefilled prefix still needs are off
+        # limits: the drain runs first each turn, but without this
+        # reservation sustained stream traffic would consume every freed
+        # block and starve the waiting prefix prefill forever
+        if needed > self._allocator.free_count \
+                - self._pending_prefix_demand():
+            return "wait", None
+        return "ok", prefix
+
+    def _pending_prefix_demand(self) -> int:
+        """Worst-case blocks the QUEUED unprefilled prefixes still need
+        (reserved ahead of stream admission so retirements accumulate
+        toward the prefill instead of being re-tenanted instantly)."""
+        with self._prefix_lock:
+            pending = {pid for pid, _ in self._pending_prefix}
+            return sum(blocks_for_tokens(p.length, self.block_size)
+                       for pid, p in self._prefixes.items()
+                       if pid in pending and not p.ready)
+
+    def _queue_prefix_prefill(self, prefix_id: str):
+        with self._prefix_lock:
+            if any(pid == prefix_id for pid, _ in self._pending_prefix):
+                return
+            self._pending_prefix.append((prefix_id, None))
+
+    def _drain_prefix_queue(self, epoch: int):
+        """Prefill pending shared prefixes (scheduler thread only — these
+        donate the same cache the decode loop donates). A prefix whose
+        blocks are not free yet stays at the head and is retried next
+        iteration: retirements free blocks, so this converges whenever
+        the pin fits ``_usable_blocks`` (which register_prefix checked)."""
+        while not self._stop.is_set() and self._epoch == epoch:
+            with self._prefix_lock:
+                if not self._pending_prefix:
+                    return
+                pid, fut = self._pending_prefix[0]
+                prefix = self._prefixes.get(pid)
+            if prefix is not None and not prefix.ready:
+                nb = blocks_for_tokens(prefix.length, self.block_size)
+                if nb > self._usable_blocks(excluding=pid):
+                    # can NEVER fit (other prefixes' pins/reservations own
+                    # the pool): unregister + fail typed instead of
+                    # wedging the queue head forever — every later
+                    # registration and lazy re-prefill sits behind it
+                    with self._prefix_lock:
+                        self._prefixes.pop(pid, None)
+                    self._pop_prefix_head(pid)
+                    if fut is not None:
+                        try:
+                            fut.set_exception(KVBlocksExhaustedError(
+                                f"prefix {pid!r} needs {nb} KV blocks the "
+                                "pool can never free (pinned by other "
+                                "prefixes)", needed=nb,
+                                usable=self._usable_blocks(),
+                                capacity=self._allocator.capacity))
+                        except InvalidStateError:
+                            pass
+                    continue
+                if nb > self._allocator.free_count:
+                    return   # wait for retirements to free blocks
+                try:
+                    if not self._prefill_prefix(prefix, epoch):
+                        return   # zombie: the new epoch owns the queue
+                except BaseException as e:
+                    self._pop_prefix_head(pid)
+                    if fut is not None:
+                        try:
+                            fut.set_exception(e)
+                        except InvalidStateError:
+                            pass
+                    self._on_device_failure(e, epoch,
+                                            point="generation.prefill")
+                    return
+            self._pop_prefix_head(pid)
+            if fut is None:
+                continue
+            try:
+                if prefix is None:
+                    fut.set_exception(RuntimeError(
+                        f"prefix {pid!r} was released before its prefill"))
+                else:
+                    fut.set_result(pid)
+            except InvalidStateError:
+                pass
+
+    def _pop_prefix_head(self, pid: str):
+        with self._prefix_lock:
+            if self._pending_prefix and self._pending_prefix[0][0] == pid:
+                self._pending_prefix.popleft()
+
+    def _prefill_prefix(self, prefix: SharedPrefix, epoch: int) -> bool:
+        """Run the ONE prefill a shared prefix ever gets (per pool
+        lifetime): allocate its blocks, write its K/V through the normal
+        bucketed prefill executable (sampled token 0 discarded), publish
+        ``prefix.blocks`` on success. Returns False when a watchdog
+        restart staled this epoch mid-call — the replacement scheduler's
+        drain re-runs it against the rebuilt pool."""
+        alloc = self._allocator
+        n = prefix.length
+        nb = blocks_for_tokens(n, self.block_size)
+        blocks = alloc.alloc(nb)
+        bucket = self._bucket_for(n)
+        row = np.zeros(blocks_for_tokens(bucket, self.block_size), np.int32)
+        row[:nb] = blocks
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prefix.tokens
+        with self._wd_lock:
+            self._prefix_busy = True
+        t0 = time.perf_counter()
+        try:
+            with self.profiler.span("serving.prefix_prefill",
+                                    engine=self.name,
+                                    prefix=prefix.prefix_id, tokens=n):
+                def call():
+                    return self._donated_call(
+                        "generation.prefill", self._prefill,
+                        self.params, self._cache, padded, row, np.int32(n),
+                        np.asarray(jax.random.PRNGKey(0)), np.float32(0.0),
+                        np.int32(0))
+
+                raw = self._retry.call(call, on_retry=self._on_retry)
+                new_cache, _tok0 = raw
+        except BaseException:
+            alloc.free(blocks)   # captured allocator: a stale one is inert
+            raise
+        finally:
+            with self._wd_lock:
+                self._prefix_busy = False
+        with self._wd_lock:
+            current = self._epoch == epoch
+            if current:
+                self._cache = new_cache
+        if not current:
+            return False
+        self._breaker.record_success()
+        with self._prefix_lock:
+            registered = self._prefixes.get(prefix.prefix_id) is prefix
+            if registered:
+                prefix.blocks = blocks
+        if not registered:      # released while we were prefilling
+            alloc.free(blocks)
+            return True
+        self.metrics.prefix_prefills_total.inc()
+        self.metrics.prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        self._recorder.record("prefix.prefill", engine=self.name,
+                              prefix_id=prefix.prefix_id, tokens=n,
+                              blocks=nb)
+        self._update_block_gauges()
+        return True
+
+    def _admit_prefix_stream(self, i: int, req: Request,
+                             prefix: SharedPrefix, epoch: int):
+        """Seat a shared-prefix stream WITHOUT a prefill: its block table
+        references the prefix's pinned blocks (refcount++), fresh blocks
+        cover the suffix + generation budget, and the prompt suffix rides
+        the decode executable one token per iteration. A partially-filled
+        shared tail block is held read-only and copy-on-written by the
+        slot's first decode step (``_Slot.cow``)."""
+        greq: GenerationRequest = req.x
+        B = self.block_size
+        P = prefix.length
+        alloc = self._allocator
+        n_shared = P // B
+        nb_total = n_shared + self._blocks_needed(greq, prefix)
+        pblocks = prefix.blocks
+        try:
+            if pblocks is None:
+                raise RuntimeError(
+                    f"shared prefix {greq.prefix_id!r} was invalidated "
+                    "while this request was being seated; resubmit")
+            fresh = alloc.alloc(nb_total - n_shared)
+            shared = list(pblocks[:n_shared])
+            # a partially-filled shared tail block is referenced too (it
+            # must stay alive until the CoW copy reads it), but never
+            # enters the table: the table entry points at the CoW dst
+            refs = shared + ([pblocks[n_shared]] if P % B else [])
+            try:
+                alloc.incref(refs)   # all-or-nothing
+            except ValueError:
+                alloc.free(fresh)
+                raise RuntimeError(
+                    f"shared prefix {greq.prefix_id!r} was released while "
+                    "this request was being seated; resubmit")
+            held = refs + fresh
+            cow = (pblocks[n_shared], fresh[0]) if P % B else None
+        except BaseException as e:
+            # release_prefix racing the seating — client lifecycle, same
+            # 'client_error' label as the queued-release shed above
+            if greq.handle._fail(e):
+                self._finish_request(req.trace, "client_error")
+            return
+        row = np.zeros(self.max_blocks_per_slot, np.int32)
+        row[:n_shared] = shared
+        row[n_shared:nb_total] = fresh
+        st = _Slot(greq=greq, request=req, n_generated=0, last_token=0,
+                   length=P, blocks=held, prefix_len=P,
+                   pending=deque(int(t) for t in greq.prompt), cow=cow)
+        with self._wd_lock:
+            seated = self._epoch == epoch and not self._stop.is_set()
+            if seated:
+                self._tables[i] = row
+                self._slots[i] = st
+        if not seated:
+            alloc.free(held)     # captured allocator: stale one is inert
+            if greq.handle._fail(WatchdogTimeoutError(
+                    f"engine[{self.name}] restarted while this prompt was "
+                    "being seated; resubmit")):
+                self._finish_request(req.trace, "watchdog")
+            return
+        prefix.hits += 1
+        self.metrics.prefix_hits_total.inc()
+        if cow is not None:
+            self.metrics.kv_cow_copies_total.inc()
+        req.trace.event("slot.assign", slot=i, prefix_id=greq.prefix_id,
+                        shared_blocks=n_shared + (1 if cow else 0),
+                        fresh_blocks=len(fresh))
+        self._update_block_gauges()
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -495,24 +1074,50 @@ class GenerationEngine(ResilientEngineMixin):
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = greq.prompt
         req.trace.event("slot.assign", slot=slot, bucket=bucket)
+        alloc = blocks = row = None
+        if self.paged:
+            # worst-case reservation, gated by _plan_blocks: every block
+            # this stream can ever touch is taken up front, so decode can
+            # never hit mid-stream exhaustion (preemption/recompute of
+            # evicted streams is the on-demand follow-up, see ROADMAP)
+            alloc = self._allocator
+            nb_total = blocks_for_tokens(n + greq.max_new_tokens,
+                                         self.block_size)
+            blocks = alloc.alloc(nb_total)
+            row = np.zeros(self.max_blocks_per_slot, np.int32)
+            row[:nb_total] = blocks
         t0 = time.perf_counter()
-        with self.profiler.span("serving.prefill", engine=self.name,
-                                slot=slot, bucket=bucket, prompt=n):
-            def call():
-                # self._cache re-read per attempt: a retryable fault raises
-                # BEFORE the donated call runs (enforced by _donated_call's
-                # consumed-stamp), so the cache is intact and the retry
-                # re-binds the same live buffers
-                return self._donated_call(
-                    "generation.prefill", self._prefill,
-                    self.params, self._cache, padded, np.int32(slot),
-                    np.int32(n), greq.key, np.float32(greq.temperature),
-                    np.int32(greq.top_k))
+        try:
+            with self.profiler.span("serving.prefill", engine=self.name,
+                                    slot=slot, bucket=bucket, prompt=n):
+                def call():
+                    # self._cache re-read per attempt: a retryable fault
+                    # raises BEFORE the donated call runs (enforced by
+                    # _donated_call's consumed-stamp), so the cache is
+                    # intact and the retry re-binds the same live buffers
+                    if self.paged:
+                        return self._donated_call(
+                            "generation.prefill", self._prefill,
+                            self.params, self._cache, padded,
+                            np.ascontiguousarray(row[:blocks_for_tokens(
+                                bucket, self.block_size)]),
+                            np.int32(n), greq.key,
+                            np.float32(greq.temperature),
+                            np.int32(greq.top_k))
+                    return self._donated_call(
+                        "generation.prefill", self._prefill,
+                        self.params, self._cache, padded, np.int32(slot),
+                        np.int32(n), greq.key, np.float32(greq.temperature),
+                        np.int32(greq.top_k))
 
-            raw = self._retry.call(call, on_retry=self._on_retry)
-            self._screen_prefill(raw)
-            new_cache, tok = raw
-            tok = int(np.asarray(tok))
+                raw = self._retry.call(call, on_retry=self._on_retry)
+                self._screen_prefill(raw)
+                new_cache, tok = raw
+                tok = int(np.asarray(tok))
+        except BaseException:
+            if blocks is not None:
+                alloc.free(blocks)   # captured allocator: stale one inert
+            raise
         with self._wd_lock:
             current = self._epoch == epoch
             if current:
@@ -521,6 +1126,8 @@ class GenerationEngine(ResilientEngineMixin):
             # the watchdog restarted the engine while this (zombie) prefill
             # was on-device: its write landed in an abandoned cache — fail
             # the request typed rather than leave its future hanging
+            if blocks is not None:
+                alloc.free(blocks)
             req.trace.event("watchdog.restart", stale=True)
             if greq.handle._fail(WatchdogTimeoutError(
                     f"engine[{self.name}] restarted while this prompt was "
@@ -537,7 +1144,8 @@ class GenerationEngine(ResilientEngineMixin):
         self.metrics.ttft_ms.observe((now - req.submit_t) * 1e3)
         self.metrics.prefills_total.inc()
         self.metrics.generated_tokens_total.inc()
-        state = _Slot(greq=greq, request=req, n_generated=1, last_token=tok)
+        state = _Slot(greq=greq, request=req, n_generated=1, last_token=tok,
+                      length=n, blocks=blocks)
         err = greq.handle._push(tok)
         if err is not None:
             # broken on_token consumer failed its own stream at token 0:
@@ -545,19 +1153,42 @@ class GenerationEngine(ResilientEngineMixin):
             # the caller's callback raised, not the model), never tenant
             req.trace.event("on_token.failed", error=type(err).__name__)
             self._finish_request(req.trace, "client_error")
+            if blocks is not None:
+                alloc.free(blocks)
+                state.blocks = None
             return
         if not self._maybe_retire(state, tok):
+            registered = False
             with self._wd_lock:
                 # re-check: a restart between the cache writeback and here
                 # reset the cache, so this tenant's K/V no longer exists —
                 # registering it would decode garbage. The watchdog already
                 # failed its handle (it was the in-flight prefill).
                 if self._epoch == epoch:
+                    if self.paged:
+                        self._tables[slot] = row
                     self._slots[slot] = state
+                    registered = True
+            if not registered and blocks is not None:
+                alloc.free(blocks)
+                state.blocks = None
+        elif blocks is not None:
+            # retired at token 0 (EOS / max_new_tokens=1): the slot was
+            # never seated, return its reservation now
+            alloc.free(blocks)
+            state.blocks = None
+        if self.paged:
+            self._update_block_gauges()
 
     def _decode_iteration(self, epoch: int):
         """One scheduler turn: a single fixed-shape decode_step over ALL
-        slots, then stream/retire per live slot."""
+        slots, then stream/retire per live slot. Paged additions: host
+        block tables + lengths ride in as the gather index, a pending CoW
+        copy runs inside the executable via cow_src/cow_dst (cleared
+        after the step lands), and shared-prefix streams still feeding
+        their prompt suffix get the NEXT suffix token embedded — their
+        mid-prompt samples are discarded until the suffix is consumed,
+        at which point the step's sample is generated token 0."""
         S = self.slots
         tokens = np.zeros(S, np.int32)
         live = np.zeros(S, bool)
@@ -565,6 +1196,9 @@ class GenerationEngine(ResilientEngineMixin):
         steps = np.zeros(S, np.int32)
         temps = np.zeros(S, np.float32)
         top_ks = np.zeros(S, np.int32)
+        lengths = np.zeros(S, np.int32)
+        cow_src = np.zeros(S, np.int32)
+        cow_dst = np.zeros(S, np.int32)
         n_live = 0
         # snapshot the slot table: after a watchdog restart the live list
         # belongs to the replacement scheduler (possibly re-tenanted), and
@@ -574,22 +1208,32 @@ class GenerationEngine(ResilientEngineMixin):
             if st is None:
                 continue
             n_live += 1
-            tokens[i] = st.last_token
+            tokens[i] = st.pending[0] if st.pending else st.last_token
             live[i] = True
             keys[i] = st.greq.key
             steps[i] = st.n_generated
             temps[i] = st.greq.temperature
             top_ks[i] = st.greq.top_k
+            lengths[i] = st.length
+            if st.cow is not None:
+                cow_src[i], cow_dst[i] = st.cow
         self.metrics.slot_occupancy.set(n_live / S)
         t0 = time.perf_counter()
         # snapshot the cache binding: if the watchdog restarts the engine
         # mid-step, this (zombie) call must keep donating the OLD cache —
         # re-reading self._cache after a restart would consume the
-        # replacement scheduler's live buffers
+        # replacement scheduler's live buffers. The block-table snapshot
+        # rides beside it for the same reason.
         cache = self._cache
+        tables = np.array(self._tables) if self.paged else None
         with self.profiler.span("serving.decode_step", engine=self.name,
                                 live=n_live, slots=S):
             def call():
+                if self.paged:
+                    return self._donated_call(
+                        "generation.decode_step", self._decode,
+                        self.params, cache, tables, lengths, tokens, keys,
+                        steps, temps, top_ks, cow_src, cow_dst)
                 return self._donated_call(
                     "generation.decode_step", self._decode,
                     self.params, cache, tokens, live, keys, steps,
@@ -611,14 +1255,17 @@ class GenerationEngine(ResilientEngineMixin):
             return   # zombie: tenants were already failed typed on restart
         self._breaker.record_success()
         dt_ms = (time.perf_counter() - t0) * 1e3
+        now = time.perf_counter()
         self.metrics.decode_step_ms.observe(dt_ms)
         self.metrics.decode_wall_ms.inc(dt_ms)
         self.metrics.decode_steps_total.inc()
-        self.metrics.generated_tokens_total.inc(n_live)
+        emitted = 0
         for i, st in enumerate(states):
             if st is None:
                 continue
             tok = int(toks[i])
+            reason = None
+            fed_only = first_token = False
             with self._wd_lock:
                 # serialize each slot-table touch with _watchdog_stall's
                 # epoch bump (taken under this lock): the instant the
@@ -626,11 +1273,29 @@ class GenerationEngine(ResilientEngineMixin):
                 # a re-tenanted slot i must not receive this step's token
                 if self._epoch != epoch:
                     return
-                st.n_generated += 1
-                st.last_token = tok
-                reason = self._retire_reason(st, tok)
-                if reason is not None:
-                    self._slots[i] = None   # freed for the NEXT admission
+                st.length += 1
+                st.cow = None          # the copy landed with this step
+                if st.pending:
+                    st.pending.popleft()
+                    if st.pending:
+                        fed_only = True   # mid-suffix: discard the sample
+                    else:
+                        first_token = True
+                if not fed_only:
+                    st.n_generated += 1
+                    st.last_token = tok
+                    reason = self._retire_reason(st, tok)
+                    if reason is not None:
+                        self._clear_slot(i, st)  # freed for NEXT admission
+            if fed_only:
+                st.request.trace.event("prompt.feed", slot=i,
+                                       remaining=len(st.pending))
+                continue
+            emitted += 1
+            if first_token:
+                # prefix streams have no prefill: token 0 lands here
+                self.metrics.ttft_ms.observe(
+                    (now - st.request.submit_t) * 1e3)
             st.request.trace.event("decode.step", step=st.n_generated - 1,
                                    dur_ms=round(dt_ms, 3), slot=i, token=tok)
             err = st.greq.handle._push(tok)
@@ -643,13 +1308,16 @@ class GenerationEngine(ResilientEngineMixin):
                 if reason is None:
                     with self._wd_lock:
                         if self._epoch == epoch and self._slots[i] is st:
-                            self._slots[i] = None
+                            self._clear_slot(i, st)
                 self._finish_request(st.request.trace, "client_error")
             elif reason is not None:
                 self._finish_stream(st, reason)
+        self.metrics.generated_tokens_total.inc(emitted)
         # re-read after retirement so an engine that drains to idle shows
         # its true occupancy instead of the pre-retire value forever
         self.metrics.slot_occupancy.set(self._live_count() / S)
+        if self.paged:
+            self._update_block_gauges()
 
     def _retire_reason(self, st: _Slot, tok: int) -> Optional[str]:
         """Pure retirement decision — EOS or the token budget — split from
@@ -690,13 +1358,56 @@ class GenerationEngine(ResilientEngineMixin):
         self._finish_stream(st, reason)
         return True
 
-    def _fail_live(self, exc: BaseException):
+    def _release_blocks(self, st: _Slot):
+        """Return a retired/failed stream's block references to the free
+        list (paged only; idempotent — ``st.blocks`` is nulled). Callers
+        on the decode/retire path hold ``_wd_lock`` with the epoch
+        verified current, so a zombie's stale retire tail can never free
+        a re-tenanted stream's blocks — it bails on the epoch check
+        before reaching here (and after a rebuild the allocator object
+        itself is fresh, so even a missed guard would hit a dead
+        allocator, not live accounting)."""
+        if not self.paged or st.blocks is None:
+            return
+        blocks, st.blocks = st.blocks, None
+        self._allocator.free(blocks)
+
+    def _clear_slot(self, i: int, st: _Slot):
+        """Vacate slot ``i``: remove its tenant, free its blocks, and —
+        critically — point its block-table row back at the scratch block.
+        A dead slot still participates in every decode step (fixed-shape
+        executable) and its write lands wherever its table row says: a
+        stale row would aim that garbage write at freed blocks, which the
+        very next admission may hand to a NEW stream. Caller holds
+        ``_wd_lock`` with the epoch verified current."""
+        self._slots[i] = None
+        if self.paged:
+            self._tables[i] = 0
+        self._release_blocks(st)
+
+    def _fail_live(self, exc: BaseException, epoch: Optional[int] = None):
+        """Fail every live tenant typed and vacate their slots. Each slot
+        is cleared under ``_wd_lock`` with the epoch re-verified: this
+        runs OUTSIDE the lock (after _on_device_failure's check), so a
+        watchdog restart can interleave — a stale walk must not evict the
+        replacement scheduler's re-tenanted slot nor free old-pool block
+        ids into the fresh allocator. Futures resolve outside the lock
+        (set_exception runs done-callbacks synchronously)."""
         reason = terminal_reason(exc)
-        for i, st in enumerate(self._slots):
-            if st is not None:
-                if st.greq.handle._fail(exc):
-                    self._finish_request(st.request.trace, reason)
-                self._slots[i] = None
+        victims: List[_Slot] = []
+        for i in range(self.slots):
+            with self._wd_lock:
+                if epoch is not None and self._epoch != epoch:
+                    break   # the restart owns the table; its stall hook
+                    #         failed these tenants already
+                st = self._slots[i]
+                if st is None:
+                    continue
+                self._clear_slot(i, st)
+            victims.append(st)
+        for st in victims:
+            if st.greq.handle._fail(exc):
+                self._finish_request(st.request.trace, reason)
 
     # ------------------------------------------- ResilientEngineMixin hooks
     def _retry_traces(self):
@@ -710,12 +1421,20 @@ class GenerationEngine(ResilientEngineMixin):
         return self.params
 
     def _crash_dump_context(self) -> dict:
-        return {"slots": self.slots, "live_slots": self._live_count()}
+        ctx = {"slots": self.slots, "live_slots": self._live_count()}
+        if self.paged and self._allocator is not None:
+            ctx.update(kv_blocks=self._allocator.num_blocks,
+                       kv_blocks_free=self._allocator.free_count,
+                       block_size=self.block_size)
+        return ctx
 
     # ------------------------------------------------------------- watchdog
     def _watchdog_busy(self) -> bool:
         with self._wd_lock:
-            if self._inflight_prefill is not None:
+            if self._inflight_prefill is not None or self._prefix_busy:
+                return True
+        with self._prefix_lock:
+            if self._pending_prefix:
                 return True
         return self._live_count() > 0 or self._admission.depth_requests > 0
 
@@ -746,6 +1465,12 @@ class GenerationEngine(ResilientEngineMixin):
                 if st.greq.handle._fail(exc):
                     self._finish_request(st.request.trace, "watchdog")
                 self._slots[i] = None
+                # blocks are not individually freed here: _reset_cache
+                # below rebuilds the whole allocator (and block tables)
+                # into one consistent empty state; nulling the refs keeps
+                # any straggling release idempotent
+                if st.blocks is not None:
+                    st.blocks = None
                 failed += 1
         if failed:
             self.metrics.failed_total.inc(failed)

@@ -248,6 +248,18 @@ class ServingMetrics:
         self.ttft_ms = Histogram("ttft_ms")               # submit->token 0
         self.prefill_ms = Histogram("prefill_ms")
         self.decode_step_ms = Histogram("decode_step_ms")
+        # ---- paged KV cache (block pool + shared-prefix reuse) -----------
+        self.prefix_prefills_total = Counter("prefix_prefills_total")
+        self.prefix_hits_total = Counter("prefix_hits_total")
+        self.kv_cow_copies_total = Counter("kv_cow_copies_total")
+        self.kv_blocks_total = Gauge("kv_blocks_total")      # pool capacity
+        self.kv_blocks_in_use = Gauge("kv_blocks_in_use")
+        self.kv_blocks_pinned = Gauge("kv_blocks_pinned")    # prefix pins
+        self.kv_block_occupancy = Gauge("kv_block_occupancy")  # in-use/total
+        # internal fragmentation: share of in-use block capacity holding no
+        # token (the partially-filled tail blocks) — the paged design's
+        # bounded waste, vs the contiguous cache's (max_len - len)/max_len
+        self.kv_fragmentation = Gauge("kv_fragmentation")
         # ---- resilience signals (retry / breaker / watchdog / fallback) --
         self.retries_total = Counter("retries_total")
         self.rejected_circuit_open = Counter("rejected_circuit_open")
@@ -322,7 +334,9 @@ class ServingMetrics:
             self.rejected_circuit_open, self.breaker_opened_total,
             self.breaker_half_open_total, self.breaker_closed_total,
             self.watchdog_restarts, self.fallback_serves,
-            self.faults_injected_total, self.poisoned_results_total)}
+            self.faults_injected_total, self.poisoned_results_total,
+            self.prefix_prefills_total, self.prefix_hits_total,
+            self.kv_cow_copies_total)}
 
     def decode_tokens_per_sec(self) -> float:
         """Steady-state decode throughput: tokens sampled by decode_step
@@ -357,6 +371,11 @@ class ServingMetrics:
             "mean_requests_per_batch": self.mean_requests_per_batch(),
             "slot_occupancy": self.slot_occupancy.value,
             "decode_tokens_per_sec": self.decode_tokens_per_sec(),
+            "kv_blocks_total": self.kv_blocks_total.value,
+            "kv_blocks_in_use": self.kv_blocks_in_use.value,
+            "kv_blocks_pinned": self.kv_blocks_pinned.value,
+            "kv_block_occupancy": self.kv_block_occupancy.value,
+            "kv_fragmentation": self.kv_fragmentation.value,
             "rejections_by_reason": self.rejections_by_reason.to_dict(),
             "slo": self.slo_snapshot(),
             "ttft_ms": self.ttft_ms.to_dict(),

@@ -1,0 +1,147 @@
+"""Host-side paging layer for the paged KV cache: the block allocator and
+the shared-prefix registry entries (models/bert.py owns the device side —
+block pool, block-table gather prefill/decode).
+
+The design point (vLLM, Kwon et al. SOSP '23 §4): KV memory, not compute,
+caps resident streams, and per-slot worst-case reservation wastes most of
+it. A fixed pool of small blocks plus a per-slot block table recovers the
+waste; REFCOUNTS on blocks make copy-on-write prefix sharing possible —
+a common system/prompt prefix is prefilled once, its blocks pinned, and
+every stream that names it references those blocks read-only (refcount++)
+until its first write into a partially-filled shared block forces a copy.
+
+Everything here is plain host bookkeeping — integers under a lock. The
+allocator is deliberately deterministic (LIFO free list): chaos/soak tests
+replay identical allocation schedules, and block-churn bugs reproduce.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.admission import KVBlocksExhaustedError
+
+
+def blocks_for_tokens(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` positions (ceil division)."""
+    return -(-tokens // block_size)
+
+
+class BlockAllocator:
+    """Refcounted free-list allocator over a fixed block pool.
+
+    Blocks ``[0, reserved)`` are never handed out — block 0 is the scratch
+    block the paged decode executable targets for dead-slot writes and
+    no-op CoW copies, so giving it to a stream would let dead slots
+    corrupt live K/V. ``alloc`` is all-or-nothing (a partial grab is
+    rolled back before raising), ``free`` decrements and returns a block
+    to the free list at refcount zero, and freeing an unallocated block
+    raises — the double-free guard that catches retire/zombie accounting
+    bugs before they silently re-tenant a stream's memory.
+    """
+
+    def __init__(self, num_blocks: int, reserved: int = 1):
+        if num_blocks <= reserved:
+            raise ValueError(
+                f"num_blocks {num_blocks} must exceed the {reserved} "
+                "reserved scratch block(s)")
+        self.num_blocks = num_blocks
+        self.reserved = reserved
+        # LIFO: pop() hands back the most recently freed block first —
+        # deterministic, and keeps the hot working set dense
+        self._free: List[int] = list(range(num_blocks - 1, reserved - 1, -1))
+        self._ref = np.zeros(num_blocks, np.int64)
+        self._lock = threading.Lock()
+
+    # --------------------------------------------------------------- sizing
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (reserved scratch excluded)."""
+        return self.num_blocks - self.reserved
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return self.capacity - len(self._free)
+
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return int(self._ref[block])
+
+    # ------------------------------------------------------------ lifecycle
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` fresh blocks (each at refcount 1), or raise
+        :class:`KVBlocksExhaustedError` leaving the allocator unchanged."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        with self._lock:
+            if n > len(self._free):
+                raise KVBlocksExhaustedError(
+                    f"KV block pool exhausted: {n} blocks requested, "
+                    f"{len(self._free)} free of {self.capacity}",
+                    needed=n, usable=len(self._free),
+                    capacity=self.capacity)
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            return out
+
+    def incref(self, blocks: Sequence[int]):
+        """Add one reference to each ALLOCATED block (prefix sharing).
+        All-or-nothing: validation happens before any increment, so a
+        failure leaves every refcount untouched."""
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise ValueError(
+                        f"incref of unallocated block {b} — a shared "
+                        "prefix referenced after its blocks were freed")
+            for b in blocks:
+                self._ref[b] += 1
+
+    def free(self, blocks: Sequence[int]):
+        """Drop one reference per block; blocks reaching zero return to
+        the free list. Freeing a block that is already free raises (the
+        double-free guard)."""
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise ValueError(
+                        f"double free of block {b}: refcount already 0")
+            for b in blocks:
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    self._free.append(b)
+
+
+@dataclasses.dataclass
+class SharedPrefix:
+    """One registered shared prefix: its tokens, and (once the scheduler
+    has prefilled it) the pinned physical blocks holding its K/V. A cache
+    rebuild (device failure, watchdog restart) sets ``blocks`` back to
+    None — the K/V is gone with the pool — and the next stream that names
+    this prefix triggers a lazy re-prefill from the retained tokens."""
+
+    prefix_id: str
+    tokens: np.ndarray                 # (n,) int32
+    blocks: Optional[List[int]] = None
+    hits: int = 0
+
+    @property
+    def length(self) -> int:
+        return int(self.tokens.size)
+
+    @property
+    def ready(self) -> bool:
+        return self.blocks is not None
+
+
+__all__ = ["BlockAllocator", "SharedPrefix", "blocks_for_tokens"]

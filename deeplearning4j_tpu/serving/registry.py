@@ -507,10 +507,17 @@ class ModelRegistry:
             eng.shutdown(wait=False)
             raise
 
-    def generation_engine(self, ref: str, **engine_kwargs):
+    def generation_engine(self, ref: str, shared_prefixes=None,
+                          **engine_kwargs):
         """Spin up a continuous-batching :class:`GenerationEngine` over a
         deployed generative model (a :class:`CausalLMAdapter` deployment).
-        Tracked for :meth:`shutdown` like batch engines."""
+        Tracked for :meth:`shutdown` like batch engines.
+
+        ``shared_prefixes`` maps prefix id -> token array: each is
+        registered (prefilled once, blocks pinned) before the engine is
+        returned, so deploy-time system prompts are resident before the
+        first request — the serving analogue of warmup-compile. Requires
+        the paged KV cache (the engine default)."""
         dep = self.get(ref)
         if not hasattr(dep.adapter, "generation_engine"):
             raise TypeError(
@@ -523,6 +530,8 @@ class ModelRegistry:
         engine_kwargs.setdefault("recorder", self._recorder)
         eng = dep.adapter.generation_engine(**engine_kwargs)
         try:
+            for pid, toks in (shared_prefixes or {}).items():
+                eng.register_prefix(toks, prefix_id=pid)
             return self._track(eng)
         except BaseException:
             eng.shutdown(wait=False)

@@ -438,6 +438,26 @@ class ResilientEngineMixin:
         self.metrics.record_rejection(exc.reason)
         self._finish_request(trace, exc.reason)
 
+    def _shed_typed(self, req, exc: RejectedError):
+        """Fail an already-DEQUEUED request with a typed serving error —
+        the scheduler-side shed path (e.g. a paged-KV request whose block
+        demand can never be satisfied). Mirrors the submit-time
+        accounting: rejection counters + SLO terminal + trace, all keyed
+        by ``exc.reason``; a future the caller cancelled first records
+        'cancelled' instead, exactly once either way."""
+        from concurrent.futures import InvalidStateError
+
+        try:
+            req.future.set_exception(exc)
+        except InvalidStateError:
+            self._finish_request(req.trace, "cancelled")
+            return
+        self.metrics.rejected_total.inc()
+        self.metrics.record_rejection(exc.reason)
+        self._recorder.record("request.shed", engine=self.name,
+                              reason=exc.reason)
+        self._finish_request(req.trace, exc.reason)
+
     # -------------------------------------------------------------- retries
     def _on_retry(self, attempt: int, exc: BaseException):
         self.metrics.retries_total.inc()

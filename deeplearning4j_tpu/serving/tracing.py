@@ -60,6 +60,7 @@ import numpy as np
 TERMINAL_REASONS = (
     "ok", "queue_full", "deadline", "shutdown", "circuit_open", "watchdog",
     "poisoned", "cancelled", "model_error", "client_error",
+    "kv_blocks_exhausted",
 )
 
 

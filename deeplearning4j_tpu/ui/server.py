@@ -511,15 +511,24 @@ class _Handler(BaseHTTPRequestHandler):
                         latest = ups[-1]
                         # gate on prefills (not decode steps): an engine
                         # serving max_new_tokens=1 retires every stream at
-                        # prefill and never runs a decode iteration
+                        # prefill and never runs a decode iteration — and
+                        # prefix prefills count (a pure shared-prefix
+                        # workload performs no per-stream prefill at all)
                         if isinstance(latest, dict) \
-                                and latest.get("prefills_total"):
+                                and (latest.get("prefills_total")
+                                     or latest.get("prefix_prefills_total")):
                             entry["generation"] = {
                                 k: latest.get(k) for k in (
                                     "decode_tokens_per_sec", "slot_occupancy",
                                     "generated_tokens_total",
                                     "generations_completed", "ttft_ms",
-                                    "prefill_ms", "decode_step_ms")}
+                                    "prefill_ms", "decode_step_ms",
+                                    "kv_blocks_total", "kv_blocks_in_use",
+                                    "kv_blocks_pinned", "kv_block_occupancy",
+                                    "kv_fragmentation",
+                                    "prefix_prefills_total",
+                                    "prefix_hits_total",
+                                    "kv_cow_copies_total")}
                         # resilience roll-up (PR 3): retry/breaker/watchdog/
                         # fallback counters + shedding causes, so "why is
                         # this engine degraded" is one GET. Gated on the
