@@ -114,6 +114,7 @@ def main():
         "decode": decode_leg(on_tpu),
         "availability": availability_leg(on_tpu),
         "observability": observability_leg(on_tpu),
+        "fairness": fairness_leg(on_tpu),
     }))
 
 
@@ -427,6 +428,171 @@ def observability_leg(on_tpu: bool) -> dict:
             / off["requests_per_sec"] * 100.0, 2),
         "traces_retained": tracer.stats()["retained"],
     }
+
+
+def fairness_leg(on_tpu: bool) -> dict:
+    """Multi-tenant QoS under contention (serving/qos.py), three scenarios:
+
+    - ``noisy_neighbor``: one flooding batch-class tenant + one
+      interactive tenant against a max_batch_size=1 engine (every
+      dispatch serves exactly one request, so QUEUE order is the whole
+      story). With QoS off the victim's requests sit behind the flood
+      (FIFO); with QoS on the interactive class strictly overtakes.
+      Reports the victim's p99 and per-tenant goodput both ways.
+    - ``weighted_share``: two batch-class tenants at weights 3:1 drain a
+      pre-loaded queue; the first-40-completions split is the measured
+      goodput ratio (the ISSUE acceptance number: ~3x +/- 20%).
+    - ``retry_storm``: a seeded FaultPlan fails 40% of dispatches
+      transiently; amplification = (dispatches incl. retries) /
+      dispatches, with and without a deployment RetryBudget — the budget
+      caps the storm near 1 + ratio while the un-budgeted run amplifies
+      toward the retry limit."""
+    import threading
+
+    from deeplearning4j_tpu.serving import (
+        FaultPlan, InferenceEngine, QosPolicy, RetryBudget, RetryPolicy,
+        TenantPolicy)
+
+    row = np.zeros((1, 16), np.float32)
+
+    # ---------------------------------------------------- noisy neighbor
+    def run_noisy(qos):
+        """One flooding batch tenant keeps a 256-request queue saturated
+        for the whole measurement; the interactive victim submits
+        blocking requests THROUGH the contention. FIFO makes each victim
+        request drain the whole backlog first; QoS lets it overtake."""
+        victim_n = 30
+        backlog = 128
+        stop = threading.Event()
+        with InferenceEngine(
+                _tiny_mlp_adapter(), max_batch_size=1, max_wait_ms=0.0,
+                queue_capacity_rows=2 * backlog, qos=qos,
+                name="fairness") as eng:
+            eng.warmup(np.zeros(16, np.float32))
+
+            def flood():
+                # keep `backlog` requests queued at all times (half the
+                # capacity, so the victim's own submit always admits and
+                # the comparison isolates QUEUE ORDER, not entry races)
+                outstanding = []
+                while not stop.is_set():
+                    outstanding = [f for f in outstanding if not f.done()]
+                    while len(outstanding) < backlog:
+                        try:
+                            outstanding.append(
+                                eng.submit(row, tenant="noisy",
+                                           priority="batch"))
+                        except Exception:
+                            break
+                    time.sleep(0.0005)
+                for f in outstanding:
+                    try:
+                        f.result(timeout=300)
+                    except Exception:
+                        pass
+
+            ft = threading.Thread(target=flood)
+            ft.start()
+            time.sleep(0.05)   # flood reaches steady saturation
+            lat = []
+            t_run = time.perf_counter()
+            for _ in range(victim_n):
+                t0 = time.perf_counter()
+                eng.submit(row, tenant="victim",
+                           priority="interactive").result(timeout=120)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            stop.set()
+            ft.join(timeout=300)
+            dt = time.perf_counter() - t_run
+            lat.sort()
+            qs = eng.metrics.qos_snapshot()
+            served = {t: d["served"] for t, d in qs["tenants"].items()}
+            return {
+                "victim_p50_ms": round(lat[len(lat) // 2], 3),
+                "victim_p99_ms": round(lat[-1], 3),
+                # run durations differ (the victim finishes ~25x sooner
+                # with QoS on), so goodput is rate-normalized
+                "goodput_per_sec": {t: round(v / dt, 1)
+                                    for t, v in served.items()},
+                "served": served,
+            }
+
+    noisy_policy = QosPolicy({
+        "noisy": TenantPolicy(weight=1.0, priority="batch"),
+        "victim": TenantPolicy(weight=1.0, priority="interactive")})
+    noisy = {"qos_off": run_noisy(None), "qos_on": run_noisy(noisy_policy)}
+
+    # ---------------------------------------------------- weighted share
+    heavy_w, light_w = 3.0, 1.0
+    pol = QosPolicy({"heavy": TenantPolicy(weight=heavy_w, priority="batch"),
+                     "light": TenantPolicy(weight=light_w, priority="batch")})
+    order = []
+    with InferenceEngine(_tiny_mlp_adapter(), max_batch_size=1,
+                         max_wait_ms=0.0, queue_capacity_rows=4096,
+                         qos=pol, name="wfq") as eng:
+        eng.warmup(np.zeros(16, np.float32))
+        plan = FaultPlan(seed=0).delay("engine.dispatch", ms=120, at=(0,))
+        with plan:
+            futs = [eng.submit(row, tenant="light")]   # wedges dispatch 0
+            time.sleep(0.03)
+            for _ in range(60):
+                for t in ("heavy", "light"):
+                    f = eng.submit(row, tenant=t)
+                    f.add_done_callback(
+                        lambda _f, t=t: order.append(t))
+                    futs.append(f)
+            for f in futs:
+                f.result(timeout=300)
+    head = order[:40]
+    n_heavy, n_light = head.count("heavy"), head.count("light")
+    weighted = {
+        "weights": {"heavy": heavy_w, "light": light_w},
+        "first_40_completions": {"heavy": n_heavy, "light": n_light},
+        "goodput_ratio": round(n_heavy / max(n_light, 1), 3),
+    }
+
+    # ------------------------------------------------------- retry storm
+    def run_storm(budget):
+        n = 120
+        plan = (FaultPlan(seed=7)
+                .fail("engine.dispatch", rate=0.4))
+        with InferenceEngine(
+                _tiny_mlp_adapter(), max_batch_size=1, max_wait_ms=0.0,
+                queue_capacity_rows=n + 8,
+                retry_policy=RetryPolicy(max_attempts=4, base_delay_ms=0.2,
+                                         max_delay_ms=2.0, seed=0),
+                retry_budget=budget, name="storm") as eng:
+            eng.warmup(np.zeros(16, np.float32))
+            ok = 0
+            with plan:
+                futs = [eng.submit(row) for _ in range(n)]
+                for f in futs:
+                    try:
+                        f.result(timeout=120)
+                        ok += 1
+                    except Exception:
+                        pass
+            m = eng.metrics
+            batches = m.batches_total.value + m.failed_total.value
+            retries = m.retries_total.value
+            return {
+                "requests": n,
+                "success_rate": round(ok / n, 4),
+                "retries": int(retries),
+                "amplification": round((batches + retries)
+                                       / max(batches, 1), 4),
+                "retry_budget_exhausted":
+                    int(m.retry_budget_exhausted_total.value),
+            }
+
+    storm = {
+        "injected_fault_rate": 0.4,
+        "budget_off": run_storm(None),
+        "budget_on": run_storm(RetryBudget(ratio=0.1, burst=5.0)),
+    }
+
+    return {"noisy_neighbor": noisy, "weighted_share": weighted,
+            "retry_storm": storm}
 
 
 if __name__ == "__main__":

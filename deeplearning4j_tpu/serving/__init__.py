@@ -7,7 +7,7 @@ rebuilt around XLA's compile-once/dispatch-many execution model — see
 serving/engine.py and serving/generation.py for the design notes)."""
 from deeplearning4j_tpu.serving.admission import (  # noqa: F401
     AdmissionController, DeadlineExceededError, KVBlocksExhaustedError,
-    QueueFullError, RejectedError,
+    QueueFullError, QuotaExceededError, RejectedError, SloShedError,
 )
 from deeplearning4j_tpu.serving.engine import InferenceEngine, bucket_ladder  # noqa: F401
 from deeplearning4j_tpu.serving.faults import (  # noqa: F401
@@ -26,9 +26,14 @@ from deeplearning4j_tpu.serving.paging import (  # noqa: F401
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     CausalLMAdapter, Deployment, ModelAdapter, ModelRegistry, as_adapter,
 )
+from deeplearning4j_tpu.serving.qos import (  # noqa: F401
+    DEFAULT_TENANT, PRIORITIES, QosPolicy, SloBurnGovernor, TenantPolicy,
+    TenantQueues, TokenBucket,
+)
 from deeplearning4j_tpu.serving.resilience import (  # noqa: F401
     CircuitBreaker, CircuitOpenError, PoisonedResultError,
-    ResilientEngineMixin, RetryPolicy, Watchdog, WatchdogTimeoutError,
+    ResilientEngineMixin, RetryBudget, RetryBudgetExhaustedError,
+    RetryPolicy, Watchdog, WatchdogTimeoutError,
 )
 from deeplearning4j_tpu.serving.tracing import (  # noqa: F401
     FlightRecorder, RequestTrace, Tracer, all_tracers, default_tracer,
@@ -49,4 +54,8 @@ __all__ = [
     "PoisonedResultError", "ResilientEngineMixin", "WatchdogTimeoutError",
     "Tracer", "RequestTrace", "FlightRecorder", "flight_recorder",
     "default_tracer", "all_tracers", "terminal_reason", "tracing",
+    "QosPolicy", "TenantPolicy", "TenantQueues", "TokenBucket",
+    "SloBurnGovernor", "DEFAULT_TENANT", "PRIORITIES",
+    "QuotaExceededError", "SloShedError", "RetryBudget",
+    "RetryBudgetExhaustedError",
 ]

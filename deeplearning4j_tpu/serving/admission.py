@@ -19,6 +19,12 @@ from typing import Optional
 
 from deeplearning4j_tpu.serving.tracing import NULL_TRACE
 
+#: The tenant every un-attributed request rides under (shared anonymous
+#: bucket; see MIGRATING.md). Defined here — next to Request, whose
+#: ``tenant`` field defaults to it — and re-exported by serving/qos.py so
+#: the literal cannot drift between the dataclass default and resolve_qos.
+DEFAULT_TENANT = "anon"
+
 
 class RejectedError(RuntimeError):
     """Request refused by admission control. ``reason`` is machine-readable:
@@ -45,6 +51,31 @@ class QueueFullError(RejectedError):
 class DeadlineExceededError(RejectedError):
     def __init__(self, msg: str):
         super().__init__(msg, "deadline")
+
+
+class QuotaExceededError(RejectedError):
+    """Per-tenant rate-quota rejection (reason 'quota_exceeded'): the
+    tenant's token bucket (serving/qos.py) is dry. Typed separately from
+    queue-full so a flooding tenant's own rejections never read as system
+    backpressure. Carries ``tenant`` and the configured ``quota``
+    (cost units/second)."""
+
+    def __init__(self, msg: str, tenant: Optional[str] = None,
+                 quota: Optional[float] = None):
+        super().__init__(msg, "quota_exceeded")
+        self.tenant = tenant
+        self.quota = quota
+
+
+class SloShedError(RejectedError):
+    """Shed by the SLO-burn governor (reason 'slo_shed'): the rolling SLO
+    window is burning past its configured threshold, so deferrable
+    (batch-class) traffic sheds at submit until the window clears.
+    ``detail`` names the signal that tripped (error rate or p99)."""
+
+    def __init__(self, msg: str, detail: str = ""):
+        super().__init__(msg, "slo_shed")
+        self.detail = detail
 
 
 class KVBlocksExhaustedError(RejectedError):
@@ -78,6 +109,17 @@ class Request:
     # request-scoped trace (serving/tracing.py). NULL_TRACE is the shared
     # no-op singleton, so un-sampled requests pay nothing here
     trace: object = NULL_TRACE
+    # ---- multi-tenant QoS identity (serving/qos.py) ----------------------
+    # every request belongs to a tenant and a priority class; without a
+    # QosPolicy these are pure accounting labels (the shared anonymous
+    # tenant, interactive class) and never affect ordering
+    tenant: str = DEFAULT_TENANT
+    priority: str = "interactive"
+    # weighted-fair-queueing tags, stamped by TenantQueues.append when a
+    # policy is active (virtual start/finish times + arrival tiebreak)
+    qos_start_tag: float = 0.0
+    qos_finish_tag: float = 0.0
+    qos_seq: int = 0
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline_t is None:
@@ -99,14 +141,30 @@ class AdmissionController:
 
     def __init__(self, capacity_rows: int = 1024,
                  default_timeout_ms: Optional[float] = None,
-                 unit: str = "rows"):
+                 unit: str = "rows", policy=None):
         if capacity_rows <= 0:
             raise ValueError("capacity_rows must be positive")
         self.capacity_rows = capacity_rows
         self.default_timeout_ms = default_timeout_ms
         self.unit = unit  # 'rows' (batch engine) | 'requests' (generation)
-        self._q: deque = deque()
+        # qos.QosPolicy swaps the single FIFO for the priority-strict
+        # weighted-fair TenantQueues (deque-shaped, so take/close/requeue
+        # below are queue-kind-agnostic) and adds per-tenant quota
+        # metering at admit. policy=None keeps the plain deque — the
+        # bitwise-identical pre-QoS path.
+        self.policy = policy
+        if policy is not None:
+            from deeplearning4j_tpu.serving.qos import TenantQueues
+
+            self._q = TenantQueues(policy, unit=unit)
+        else:
+            self._q = deque()
         self._rows = 0
+        # latched True by the first deadline-bearing admit: controllers
+        # that never see a deadline (no default_timeout_ms, no per-call
+        # timeouts) skip expire_queued()'s O(queued) scan entirely — the
+        # batch dispatcher runs that sweep every loop turn under this lock
+        self._has_deadlines = False
         self._cv = threading.Condition()
         self._closed = False
         self.shed_count = 0
@@ -133,6 +191,14 @@ class AdmissionController:
         with self._cv:
             return len(self._q)
 
+    def depth_by_tenant(self) -> dict:
+        """Queued requests per tenant (QoS multi-queue only; empty dict on
+        the FIFO path, where tenancy does not shape the queue)."""
+        with self._cv:
+            if self.policy is not None:
+                return self._q.depth_by_tenant()
+            return {}
+
     # ---------------------------------------------------------- submit side
     def admit(self, req: Request, timeout_ms: Optional[float] = None) -> Request:
         """Enqueue or raise. ``timeout_ms`` (or the controller default)
@@ -143,6 +209,14 @@ class AdmissionController:
         with self._cv:
             if self._closed:
                 raise RejectedError("engine is shut down", "shutdown")
+            if req.deadline_t is not None:
+                self._has_deadlines = True
+            if self.policy is not None:
+                # quota before capacity: a flooding tenant's excess sheds
+                # as ITS quota_exceeded, never as queue_full backpressure
+                # on everyone (tokens spent here are not refunded on a
+                # later capacity rejection — quota meters offered load)
+                self._q.charge_quota(req)
             if self._rows + req.rows > self.capacity_rows:
                 raise QueueFullError(
                     f"queue full: {self._rows} {self.unit} queued + "
@@ -197,6 +271,9 @@ class AdmissionController:
                         head = self._q[0]
                         if head.expired():
                             self._q.popleft()
+                            if self.policy is not None:
+                                # shed, not served: no WFQ service debt
+                                self._q.forget_unserved(head)
                             self._rows -= head.rows
                             shed.append(head)
                             continue
@@ -222,8 +299,12 @@ class AdmissionController:
         """Return a just-dequeued request to the queue HEAD. The paged
         generation scheduler pops the head to inspect its block demand and
         puts it back when the pool cannot serve it *yet* (free blocks will
-        reappear as live streams retire) — FIFO order is preserved because
-        there is exactly one consumer. If the controller closed in
+        reappear as live streams retire) — on the FIFO path order is
+        preserved because there is exactly one consumer; under a
+        QosPolicy a higher-priority/lower-tag request MAY be selected
+        ahead of the returned head (by design — the generation engine's
+        block-waiter reservation keeps such overtakers from starving
+        it). If the controller closed in
         between, the request is rejected the same way ``close()`` rejects
         queued work (failing outside the lock, as everywhere)."""
         rejected = False
@@ -261,7 +342,13 @@ class AdmissionController:
         now = time.perf_counter()
         shed = []
         with self._cv:
-            if any(r.expired(now) for r in self._q):
+            if not self._has_deadlines:
+                return 0   # nothing queued can ever expire: O(1) out
+            if self.policy is not None:
+                shed = self._q.remove_expired(now)
+                if shed:
+                    self._rows -= sum(r.rows for r in shed)
+            elif any(r.expired(now) for r in self._q):
                 keep: deque = deque()
                 for req in self._q:
                     (shed if req.expired(now) else keep).append(req)

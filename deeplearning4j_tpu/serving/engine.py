@@ -47,6 +47,7 @@ from deeplearning4j_tpu.serving.admission import (
 )
 from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.qos import SloBurnGovernor, resolve_qos
 from deeplearning4j_tpu.serving.resilience import (
     CircuitBreaker, CircuitOpenError, PoisonedResultError,
     ResilientEngineMixin, RetryPolicy, WatchdogTimeoutError,
@@ -88,7 +89,11 @@ class InferenceEngine(ResilientEngineMixin):
     engine into request-scoped tracing (serving/tracing.py; defaults to
     the process tracer, which is off until configured) and
     ``screen_outputs`` is the cheap NaN/inf poisoned-result guard on
-    every dispatch output."""
+    every dispatch output. ``qos`` (serving/qos.py ``QosPolicy``) swaps
+    admission's FIFO for priority-strict weighted-fair queueing with
+    per-tenant quotas + SLO-burn shedding; ``retry_budget``
+    (resilience.RetryBudget) bounds retry-storm amplification — both
+    default to off (today's behavior)."""
 
     _COMPONENT = "serving.InferenceEngine"
     _FAILURE_NOUN = "dispatch"
@@ -102,6 +107,7 @@ class InferenceEngine(ResilientEngineMixin):
                  profiler: Optional[OpProfiler] = None,
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
+                 retry_budget=None, qos=None,
                  watchdog_timeout_ms: Optional[float] = None,
                  tracer=None, recorder=None, screen_outputs: bool = True,
                  name: str = "engine"):
@@ -129,9 +135,16 @@ class InferenceEngine(ResilientEngineMixin):
         self.name = name
         self.metrics = metrics or ServingMetrics()
         self.profiler = profiler or OpProfiler.getInstance()
+        # multi-tenant QoS (serving/qos.py): a policy swaps admission's
+        # FIFO for the priority-strict weighted-fair multi-queue + quota
+        # metering, and arms the SLO-burn governor; qos=None keeps the
+        # exact pre-QoS FIFO path (bitwise-identical, guarded by test)
+        self.qos = qos
+        self._qos_governor = SloBurnGovernor(qos, self.metrics) \
+            if qos is not None else None
         self._admission = AdmissionController(
             capacity_rows=queue_capacity_rows,
-            default_timeout_ms=default_timeout_ms)
+            default_timeout_ms=default_timeout_ms, policy=qos)
         self._admission.on_shed = self._count_shed
         self._admission.on_close_reject = self._count_close_reject
         self._admission.on_cancelled = self._count_cancelled
@@ -143,6 +156,7 @@ class InferenceEngine(ResilientEngineMixin):
         # resilience + observability scaffolding is the shared mixin
         # (serving/resilience.py ResilientEngineMixin design notes)
         self._init_resilience(retry_policy=retry_policy, breaker=breaker,
+                              retry_budget=retry_budget,
                               tracer=tracer, recorder=recorder)
         self._inflight: List[Request] = []
         self._thread = threading.Thread(
@@ -169,10 +183,16 @@ class InferenceEngine(ResilientEngineMixin):
             self._thread.join(timeout=5.0)
 
     # --------------------------------------------------------------- submit
-    def submit(self, x, timeout_ms: Optional[float] = None) -> Future:
+    def submit(self, x, timeout_ms: Optional[float] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[str] = None) -> Future:
         """Enqueue a batch-major array; the Future resolves to an NDArray
         holding exactly ``x.shape[0]`` output rows, or raises
-        :class:`RejectedError` / the model's own exception."""
+        :class:`RejectedError` / the model's own exception. ``tenant``
+        attributes the request for QoS (default: the shared anonymous
+        tenant); ``priority`` ('interactive' | 'batch') defaults to the
+        tenant's configured class. Without a ``qos=`` policy both are
+        accounting labels only — ordering stays FIFO."""
         arr = np.asarray(x)
         if arr.ndim < 1 or arr.shape[0] == 0:
             raise ValueError("submit() needs a batch-major array with >=1 row")
@@ -180,23 +200,31 @@ class InferenceEngine(ResilientEngineMixin):
             raise ValueError(
                 f"request of {arr.shape[0]} rows exceeds max_batch_size "
                 f"{self.max_batch_size}; split the call")
+        tenant, priority = resolve_qos(self.qos, tenant, priority)
         self._check_row_sig(arr.shape[1:], arr.dtype)
-        self.metrics.requests_total.inc()
+        self._count_request()
         trace = self._tracer.begin(self.name, "infer",
-                                   rows=int(arr.shape[0]))
-        self._breaker_gate(trace)
-        req = Request(x=arr, rows=int(arr.shape[0]), trace=trace)
+                                   rows=int(arr.shape[0]), tenant=tenant)
+        self._breaker_gate(trace, tenant=tenant)
+        if self._qos_governor is not None:
+            e = self._qos_governor.gate(priority)
+            if e is not None:
+                self._reject_submit(trace, e, tenant=tenant)
+                raise e
+        req = Request(x=arr, rows=int(arr.shape[0]), trace=trace,
+                      tenant=tenant, priority=priority)
         try:
             self._admission.admit(req, timeout_ms=timeout_ms)
         except RejectedError as e:
-            self._reject_submit(trace, e)
+            self._reject_submit(trace, e, tenant=tenant)
             raise
         self.metrics.queue_depth.set(self._admission.depth_rows)
         return req.future
 
-    def output(self, x, timeout_ms: Optional[float] = None) -> NDArray:
+    def output(self, x, timeout_ms: Optional[float] = None,
+               **submit_kwargs) -> NDArray:
         """Blocking submit (ref: ParallelInference.output)."""
-        return self.submit(x, timeout_ms=timeout_ms).result()
+        return self.submit(x, timeout_ms=timeout_ms, **submit_kwargs).result()
 
     def _check_row_sig(self, feature_shape, dtype):
         """All requests to one engine must share feature shape and dtype:
@@ -224,8 +252,25 @@ class InferenceEngine(ResilientEngineMixin):
         while not self._stop.is_set() and self._epoch == epoch:
             if self._watchdog is not None:
                 self._watchdog.beat()
+            # proactive expiry sweep (the generation scheduler's pattern
+            # since PR 2). take() only sheds the request it SELECTS: the
+            # QoS multi-queue can starve a low-priority/low-weight
+            # tenant's queue indefinitely while other tenants have
+            # traffic, so its expired entries would hold capacity_rows
+            # budget (masking queue-full) until finally selected — sweep
+            # every turn there. The FIFO path needs no per-turn scan
+            # (lazy head-shedding covers it within one batch) and must
+            # not pay O(queued) under the admission lock, so it sweeps
+            # only on the idle tick; deadline-free controllers early-out
+            # O(1) either way. Cannot run mid-dispatch (single
+            # dispatcher thread), so in-flight delay is still bounded by
+            # one device call.
+            if self.qos is not None:
+                self._admission.expire_queued()
             first = self._admission.take(self.max_batch_size, timeout=0.05)
             if first is None:
+                if self.qos is None:
+                    self._admission.expire_queued()
                 continue
             batch = [first]
             rows = first.rows
@@ -251,7 +296,8 @@ class InferenceEngine(ResilientEngineMixin):
                     if not req.future.done():
                         try:
                             req.future.set_exception(e)
-                            self._finish_request(req.trace, reason)
+                            self._finish_request(req.trace, reason,
+                                                 tenant=req.tenant)
                         except InvalidStateError:
                             pass
             finally:
@@ -282,7 +328,8 @@ class InferenceEngine(ResilientEngineMixin):
                     self._count_cancelled(req)   # cancel won the race
                     continue
                 self.metrics.record_rejection("shutdown")
-                self._finish_request(req.trace, "shutdown")
+                self._finish_request(req.trace, "shutdown",
+                                     tenant=req.tenant)
 
     # ------------------------------------------------------------- watchdog
     def _watchdog_busy(self) -> bool:
@@ -313,7 +360,8 @@ class InferenceEngine(ResilientEngineMixin):
             try:
                 req.future.set_exception(exc)
                 failed += 1
-                self._finish_request(req.trace, "watchdog")
+                self._finish_request(req.trace, "watchdog",
+                                     tenant=req.tenant)
             except InvalidStateError:
                 pass
         if failed:
@@ -348,7 +396,7 @@ class InferenceEngine(ResilientEngineMixin):
         def call():
             return np.asarray(inject("engine.dispatch", self._run, x))
 
-        return self._retry.call(call, on_retry=self._on_retry)
+        return self._retry_call(call)
 
     # ------------------------------------------- ResilientEngineMixin hooks
     def _retry_traces(self):
@@ -366,11 +414,13 @@ class InferenceEngine(ResilientEngineMixin):
                 self._admission._shed(req)  # counts via _count_shed
             elif not req.future.set_running_or_notify_cancel():
                 # caller cancelled while queued: drop silently
-                self._finish_request(req.trace, "cancelled")
+                self._finish_request(req.trace, "cancelled",
+                                     tenant=req.tenant)
                 continue
             else:
                 qw = (now - req.submit_t) * 1e3
                 self.metrics.queue_wait_ms.observe(qw)
+                self.metrics.observe_queue_wait_class(req.priority, qw)
                 req.trace.event("queue.wait", queue_wait_ms=round(qw, 3),
                                 batch_requests=len(batch))
                 live.append(req)
@@ -414,7 +464,8 @@ class InferenceEngine(ResilientEngineMixin):
                     req.future.set_exception(e)
                     self._finish_request(
                         req.trace, reason,
-                        latency_ms=(fail_t - req.submit_t) * 1e3)
+                        latency_ms=(fail_t - req.submit_t) * 1e3,
+                        tenant=req.tenant)
                 except InvalidStateError:
                     pass  # watchdog or caller got there first
             return
@@ -442,7 +493,8 @@ class InferenceEngine(ResilientEngineMixin):
                             bucket=bucket, rows=req.rows)
             try:
                 req.future.set_result(NDArray(out))
-                self._finish_request(req.trace, "ok", latency_ms=lat)
+                self._finish_request(req.trace, "ok", latency_ms=lat,
+                                     tenant=req.tenant)
             except InvalidStateError:
                 pass  # failed by the watchdog while this zombie computed
 

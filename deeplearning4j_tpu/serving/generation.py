@@ -60,6 +60,9 @@ from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.paging import (
     BlockAllocator, SharedPrefix, blocks_for_tokens,
 )
+from deeplearning4j_tpu.serving.qos import (
+    PRIORITIES, SloBurnGovernor, resolve_qos,
+)
 from deeplearning4j_tpu.serving.resilience import (
     CircuitBreaker, ResilientEngineMixin, RetryPolicy, WatchdogTimeoutError,
 )
@@ -220,6 +223,12 @@ class GenerationEngine(ResilientEngineMixin):
     ``submit(prefix_id=...)`` share one prefilled prefix across any
     number of streams with copy-on-write. ``paged=False`` keeps the PR 2
     contiguous layout (the bitwise-parity reference).
+
+    ``qos`` (serving/qos.py ``QosPolicy``) swaps admission's FIFO for
+    priority-strict weighted-fair queueing (cost = 1 request) with
+    per-tenant quotas + SLO-burn shedding; ``retry_budget``
+    (resilience.RetryBudget) bounds retry-storm amplification. Both
+    default to off — the bitwise-identical pre-QoS path.
     """
 
     _COMPONENT = "serving.GenerationEngine"
@@ -239,6 +248,7 @@ class GenerationEngine(ResilientEngineMixin):
                  profiler: Optional[OpProfiler] = None,
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
+                 retry_budget=None, qos=None,
                  watchdog_timeout_ms: Optional[float] = None,
                  tracer=None, recorder=None, screen_outputs: bool = True,
                  name: str = "generation"):
@@ -304,12 +314,29 @@ class GenerationEngine(ResilientEngineMixin):
         self._prefix_busy = False
         self._allocator: Optional[BlockAllocator] = None
         self._tables: Optional[np.ndarray] = None
+        # block-wait reservation (scheduler thread only): the dequeued
+        # request currently waiting for KV blocks, as (request, demand,
+        # priority). Under FIFO nothing can overtake a requeued head, so
+        # freed blocks always accumulated toward it; under a QosPolicy
+        # same-class arrivals DO overtake (weighted fairness), and
+        # without this reservation their trickle could consume every
+        # freed block and starve a feasible waiter forever (the
+        # stream-side analogue of PR 6's _pending_prefix_demand). The
+        # reservation binds same-or-lower classes only — see _plan_blocks
+        self._block_waiter: Optional[Tuple[Request, int, str]] = None
         self._slots: List[Optional[_Slot]] = [None] * slots
         self._reset_cache()
+        # multi-tenant QoS (serving/qos.py): policy -> weighted-fair
+        # multi-queue + quotas + SLO-burn governor; None keeps the exact
+        # FIFO path (bitwise-identical, guarded by test)
+        self.qos = qos
+        self._qos_governor = SloBurnGovernor(qos, self.metrics) \
+            if qos is not None else None
         # slot-unit admission: one request == one future slot (rows=1)
         self._admission = AdmissionController(
             capacity_rows=queue_capacity,
-            default_timeout_ms=default_timeout_ms, unit="requests")
+            default_timeout_ms=default_timeout_ms, unit="requests",
+            policy=qos)
         self._admission.on_shed = self._count_shed
         self._admission.on_close_reject = self._count_close_reject
         self._admission.on_cancelled = self._count_cancelled
@@ -322,6 +349,7 @@ class GenerationEngine(ResilientEngineMixin):
         # retrying them re-uses the intact cache; everything else still
         # takes the fail-tenants + rebuild path from PR 2.
         self._init_resilience(retry_policy=retry_policy, breaker=breaker,
+                              retry_budget=retry_budget,
                               tracer=tracer, recorder=recorder)
         self._inflight_prefill: Optional[Request] = None
         self._thread = threading.Thread(
@@ -366,6 +394,8 @@ class GenerationEngine(ResilientEngineMixin):
                eos_id: Any = _UNSET, seed: int = 0,
                timeout_ms: Optional[float] = None,
                prefix_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[str] = None,
                on_token: Optional[Callable[[int], None]] = None
                ) -> GenerationHandle:
         """Queue one prompt. Greedy by default; ``temperature`` > 0 samples,
@@ -381,7 +411,10 @@ class GenerationEngine(ResilientEngineMixin):
         blocks are REFERENCED (not recomputed — its prefill happened
         once), and only the prompt suffix is fed through the decode
         executable, so thousands of concurrent streams share one
-        prefill."""
+        prefill. ``tenant`` / ``priority`` attribute the request for QoS
+        (serving/qos.py) — without a ``qos=`` policy they are accounting
+        labels only and the queue stays FIFO."""
+        tenant, priority = resolve_qos(self.qos, tenant, priority)
         toks = np.ascontiguousarray(np.asarray(prompt, np.int32).ravel())
         if toks.size == 0:
             raise ValueError("prompt must contain at least one token")
@@ -419,11 +452,18 @@ class GenerationEngine(ResilientEngineMixin):
             key=np.asarray(jax.random.PRNGKey(seed)), prefix_id=prefix_id)
         trace = self._tracer.begin(self.name, "generate",
                                    prompt_len=int(toks.size),
-                                   max_new_tokens=max_new_tokens)
-        req = Request(x=greq, rows=1, trace=trace)
+                                   max_new_tokens=max_new_tokens,
+                                   tenant=tenant)
+        req = Request(x=greq, rows=1, trace=trace, tenant=tenant,
+                      priority=priority)
         greq.handle = GenerationHandle(req, toks.size, on_token=on_token)
-        self.metrics.requests_total.inc()
-        self._breaker_gate(trace)
+        self._count_request()
+        self._breaker_gate(trace, tenant=tenant)
+        if self._qos_governor is not None:
+            e = self._qos_governor.gate(priority)
+            if e is not None:
+                self._reject_submit(trace, e, tenant=tenant)
+                raise e
         if self.paged:
             # structural shed: a reservation the pool can never satisfy
             # (capacity minus prefix pins) fails typed NOW, not after a
@@ -439,12 +479,12 @@ class GenerationEngine(ResilientEngineMixin):
                     f"excluded) — shrink the request or grow num_blocks",
                     needed=needed, usable=usable,
                     capacity=self._allocator.capacity)
-                self._reject_submit(trace, e)
+                self._reject_submit(trace, e, tenant=tenant)
                 raise e
         try:
             self._admission.admit(req, timeout_ms=timeout_ms)
         except RejectedError as e:
-            self._reject_submit(trace, e)
+            self._reject_submit(trace, e, tenant=tenant)
             raise
         self.metrics.queue_depth.set(self._admission.depth_requests)
         return greq.handle
@@ -583,6 +623,7 @@ class GenerationEngine(ResilientEngineMixin):
         self._cache = self._place_kv_cache(cache, self.cfg, self.mesh) \
             if self.mesh is not None else cache
         if self.paged:
+            self._block_waiter = None   # demand was against the old pool
             with self._prefix_lock:
                 self._allocator = BlockAllocator(self.num_blocks, reserved=1)
                 self._tables = np.zeros(
@@ -709,11 +750,17 @@ class GenerationEngine(ResilientEngineMixin):
                     continue   # head disposed of typed; slot stays free
                 if verdict == "wait":
                     self._admission.requeue_head(req)
-                    return     # FIFO: nothing may overtake the head
+                    # FIFO: nothing overtakes the requeued head. QoS:
+                    # higher-priority arrivals MAY overtake, but the
+                    # _block_waiter reservation keeps them from eating
+                    # the freed blocks the waiter is accumulating
+                    return
             if not req.future.set_running_or_notify_cancel():
-                self._finish_request(req.trace, "cancelled")
+                self._finish_request(req.trace, "cancelled",
+                                     tenant=req.tenant)
                 continue     # caller cancelled while queued
             qw = (time.perf_counter() - req.submit_t) * 1e3
+            self.metrics.observe_queue_wait_class(req.priority, qw)
             req.trace.event("queue.wait", queue_wait_ms=round(qw, 3))
             if prefix is not None:
                 # shared-prefix stream: no prefill at all — reference the
@@ -733,7 +780,8 @@ class GenerationEngine(ResilientEngineMixin):
                 if req.x.handle._fail(e):
                     self._finish_request(
                         req.trace, terminal_reason(e),
-                        latency_ms=(time.perf_counter() - req.submit_t) * 1e3)
+                        latency_ms=(time.perf_counter() - req.submit_t) * 1e3,
+                        tenant=req.tenant)
                 self._on_device_failure(e, epoch, point="generation.prefill")
             finally:
                 with self._wd_lock:
@@ -777,7 +825,8 @@ class GenerationEngine(ResilientEngineMixin):
                     f"shared prefix {greq.prefix_id!r} was released while "
                     "this request was queued")
                 if greq.handle._fail(e):
-                    self._finish_request(req.trace, "client_error")
+                    self._finish_request(req.trace, "client_error",
+                                         tenant=req.tenant)
                 return "shed", None
             if not prefix.ready:
                 # K/V lost to a cache rebuild (or registration raced the
@@ -786,6 +835,13 @@ class GenerationEngine(ResilientEngineMixin):
                 return "wait", None
         needed = self._blocks_needed(greq, prefix)
         usable = self._usable_blocks()
+        waiter = self._block_waiter
+        if waiter is not None and (waiter[0] is req
+                                   or waiter[0].future.done()):
+            # the waiter is being re-planned right now, or reached a
+            # terminal elsewhere (deadline shed, cancel): its
+            # reservation must not throttle anyone anymore
+            self._block_waiter = waiter = None
         if needed > usable:
             self._shed_typed(req, KVBlocksExhaustedError(
                 f"request needs {needed} KV blocks but the pool can free "
@@ -794,12 +850,31 @@ class GenerationEngine(ResilientEngineMixin):
                 needed=needed, usable=usable,
                 capacity=self._allocator.capacity))
             return "shed", None
-        # blocks a queued-but-unprefilled prefix still needs are off
-        # limits: the drain runs first each turn, but without this
-        # reservation sustained stream traffic would consume every freed
-        # block and starve the waiting prefix prefill forever
+        # two reservations are off limits: blocks a queued-but-unprefilled
+        # prefix still needs (the drain runs first each turn, but without
+        # this sustained stream traffic would consume every freed block
+        # and starve the waiting prefix prefill forever), and the current
+        # block-waiter's demand — freed blocks accumulate toward the
+        # waiter instead of being consumed by overtaking (QoS) arrivals.
+        # The waiter reservation binds SAME-OR-LOWER priority classes
+        # only: strict priority stays the top rule (interactive traffic
+        # may outrun a batch waiter indefinitely, exactly as queue
+        # selection itself allows). Any request that must wait TAKES OVER
+        # the slot: a planned "wait" head is by construction the request
+        # selection keeps picking, so the reservation always belongs to
+        # the stable head — a recorded waiter that selection no longer
+        # favors (a smaller-tag same-class arrival, a higher class)
+        # would otherwise pin a reservation nobody can clear and
+        # livelock the scheduler against an idle pool. Fairness is not
+        # lost: a displaced waiter's fixed finish tag guarantees WFQ
+        # re-selects it once the newcomers' tags grow past it.
+        rank = PRIORITIES.index(req.priority)
+        reserved = 0
+        if waiter is not None and rank >= PRIORITIES.index(waiter[2]):
+            reserved = waiter[1]
         if needed > self._allocator.free_count \
-                - self._pending_prefix_demand():
+                - self._pending_prefix_demand() - reserved:
+            self._block_waiter = (req, needed, req.priority)
             return "wait", None
         return "ok", prefix
 
@@ -914,7 +989,7 @@ class GenerationEngine(ResilientEngineMixin):
                         np.asarray(jax.random.PRNGKey(0)), np.float32(0.0),
                         np.int32(0))
 
-                raw = self._retry.call(call, on_retry=self._on_retry)
+                raw = self._retry_call(call)
                 new_cache, _tok0 = raw
         except BaseException:
             alloc.free(blocks)   # captured allocator: a stale one is inert
@@ -983,7 +1058,8 @@ class GenerationEngine(ResilientEngineMixin):
             # release_prefix racing the seating — client lifecycle, same
             # 'client_error' label as the queued-release shed above
             if greq.handle._fail(e):
-                self._finish_request(req.trace, "client_error")
+                self._finish_request(req.trace, "client_error",
+                                     tenant=req.tenant)
             return
         row = np.zeros(self.max_blocks_per_slot, np.int32)
         row[:n_shared] = shared
@@ -1001,7 +1077,8 @@ class GenerationEngine(ResilientEngineMixin):
             if greq.handle._fail(WatchdogTimeoutError(
                     f"engine[{self.name}] restarted while this prompt was "
                     "being seated; resubmit")):
-                self._finish_request(req.trace, "watchdog")
+                self._finish_request(req.trace, "watchdog",
+                                     tenant=req.tenant)
             return
         prefix.hits += 1
         self.metrics.prefix_hits_total.inc()
@@ -1110,7 +1187,7 @@ class GenerationEngine(ResilientEngineMixin):
                         np.int32(n), greq.key, np.float32(greq.temperature),
                         np.int32(greq.top_k))
 
-                raw = self._retry.call(call, on_retry=self._on_retry)
+                raw = self._retry_call(call)
                 self._screen_prefill(raw)
                 new_cache, tok = raw
                 tok = int(np.asarray(tok))
@@ -1132,7 +1209,8 @@ class GenerationEngine(ResilientEngineMixin):
             if greq.handle._fail(WatchdogTimeoutError(
                     f"engine[{self.name}] restarted while this prompt was "
                     f"in prefill; resubmit")):
-                self._finish_request(req.trace, "watchdog")
+                self._finish_request(req.trace, "watchdog",
+                                     tenant=req.tenant)
             # else: the watchdog delivered (and recorded) the terminal —
             # this zombie must not double-count the outcome
             return
@@ -1152,7 +1230,8 @@ class GenerationEngine(ResilientEngineMixin):
             # the handle delivered the terminal — record it (client_error:
             # the caller's callback raised, not the model), never tenant
             req.trace.event("on_token.failed", error=type(err).__name__)
-            self._finish_request(req.trace, "client_error")
+            self._finish_request(req.trace, "client_error",
+                                 tenant=req.tenant)
             if blocks is not None:
                 alloc.free(blocks)
                 state.blocks = None
@@ -1239,7 +1318,7 @@ class GenerationEngine(ResilientEngineMixin):
                     self.params, cache, tokens, live, keys, steps,
                     temps, top_ks)
 
-            new_cache, toks = self._retry.call(call, on_retry=self._on_retry)
+            new_cache, toks = self._retry_call(call)
             toks = np.asarray(toks)
             if self.screen_outputs:
                 # raises BEFORE the cache writeback: a poisoned iteration
@@ -1309,7 +1388,8 @@ class GenerationEngine(ResilientEngineMixin):
                     with self._wd_lock:
                         if self._epoch == epoch and self._slots[i] is st:
                             self._clear_slot(i, st)
-                self._finish_request(st.request.trace, "client_error")
+                self._finish_request(st.request.trace, "client_error",
+                                     tenant=st.request.tenant)
             elif reason is not None:
                 self._finish_stream(st, reason)
         self.metrics.generated_tokens_total.inc(emitted)
@@ -1336,7 +1416,8 @@ class GenerationEngine(ResilientEngineMixin):
         st.request.trace.event("stream.finish", finish_reason=reason,
                                tokens=st.n_generated)
         if delivered:
-            self._finish_request(st.request.trace, "ok", latency_ms=lat)
+            self._finish_request(st.request.trace, "ok", latency_ms=lat,
+                                 tenant=st.request.tenant)
         else:
             # the terminal was already delivered elsewhere (watchdog win,
             # broken on_token) and its outcome recorded there — just make
@@ -1407,7 +1488,8 @@ class GenerationEngine(ResilientEngineMixin):
             victims.append(st)
         for st in victims:
             if st.greq.handle._fail(exc):
-                self._finish_request(st.request.trace, reason)
+                self._finish_request(st.request.trace, reason,
+                                     tenant=st.request.tenant)
 
     # ------------------------------------------- ResilientEngineMixin hooks
     def _retry_traces(self):
@@ -1456,14 +1538,16 @@ class GenerationEngine(ResilientEngineMixin):
         if pre is not None:
             pre.trace.event("watchdog.restart", epoch=epoch, in_prefill=True)
             if pre.x.handle._fail(exc):
-                self._finish_request(pre.trace, "watchdog")
+                self._finish_request(pre.trace, "watchdog",
+                                     tenant=pre.tenant)
             failed += 1
         for i, st in enumerate(self._slots):
             if st is not None:
                 st.request.trace.event("watchdog.restart", epoch=epoch,
                                        slot=i)
                 if st.greq.handle._fail(exc):
-                    self._finish_request(st.request.trace, "watchdog")
+                    self._finish_request(st.request.trace, "watchdog",
+                                         tenant=st.request.tenant)
                 self._slots[i] = None
                 # blocks are not individually freed here: _reset_cache
                 # below rebuilds the whole allocator (and block tables)

@@ -23,6 +23,9 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
+# safe at module level: qos imports only admission/tracing, never metrics
+from deeplearning4j_tpu.serving.qos import PRIORITIES
+
 
 class Counter:
     """Monotone non-negative counter."""
@@ -270,6 +273,25 @@ class ServingMetrics:
         self.fallback_serves = Counter("fallback_serves")
         self.faults_injected_total = Counter("faults_injected_total")
         self.rejections_by_reason = ReasonCounter("rejections_by_reason")
+        # ---- multi-tenant QoS signals (serving/qos.py) --------------------
+        # per-tenant served/shed roll-ups (label = tenant id; "shed" here
+        # is ANY non-ok terminal — rejections, failures, cancels) plus a
+        # per-tenant reason breakdown, fed by record_tenant_outcome at
+        # every per-request terminal. queue_wait_by_class splits the
+        # queue-wait histogram by priority class, so "is interactive
+        # overtaking batch" is a direct read.
+        self.tenant_served = ReasonCounter("tenant_served")
+        self.tenant_shed = ReasonCounter("tenant_shed")
+        self._tenant_reasons: Dict[str, ReasonCounter] = {}
+        self._tenant_seen: set = set()
+        self._tenant_lock = threading.Lock()
+        self.queue_wait_by_class: Dict[str, Histogram] = {
+            p: Histogram(f"queue_wait_ms[{p}]") for p in PRIORITIES}
+        self.quota_rejections_total = Counter("quota_rejections_total")
+        self.slo_sheds_total = Counter("slo_sheds_total")
+        self.retry_budget_exhausted_total = Counter(
+            "retry_budget_exhausted_total")
+        self.slo_burn_active = Gauge("slo_burn_active")   # 0/1 governor
         # ---- observability signals (tracing / poison screen / SLO) -------
         self.poisoned_results_total = Counter("poisoned_results_total")
         self.slo_windows: Dict[str, SlidingWindowStats] = {
@@ -304,6 +326,77 @@ class ServingMetrics:
         for w in self.slo_windows.values():
             w.record(reason, latency_ms)
 
+    #: Distinct tenant labels tracked per ServingMetrics before new ones
+    #: fold into the shared overflow bucket — tenant ids are arbitrary
+    #: caller strings, so without a cap a client stamping per-request ids
+    #: would grow three counters and every snapshot() payload forever
+    #: (the same cardinality hazard qos.TenantQueues prunes against).
+    MAX_TRACKED_TENANTS = 1024
+    OVERFLOW_TENANT = "(other)"
+
+    def _tenant_label(self, tenant: str) -> str:
+        """Caller holds ``_tenant_lock``. Known tenants keep their label;
+        a novel tenant past the cap folds into ``OVERFLOW_TENANT``."""
+        if tenant in self._tenant_seen:
+            return tenant
+        if len(self._tenant_seen) >= self.MAX_TRACKED_TENANTS:
+            return self.OVERFLOW_TENANT
+        self._tenant_seen.add(tenant)
+        return tenant
+
+    def record_tenant_outcome(self, tenant: str, reason: str):
+        """Attribute one per-request terminal to its tenant: 'ok' counts
+        as served, anything else as shed (with the reason recorded in the
+        tenant's own breakdown, same taxonomy as ``rejections_by_reason``
+        / the SLO error buckets). Fed by the engines'
+        ``_finish_request(..., tenant=)`` at every terminal. Bounded
+        cardinality: at most :data:`MAX_TRACKED_TENANTS` distinct labels,
+        the rest aggregated under :data:`OVERFLOW_TENANT`."""
+        with self._tenant_lock:
+            tenant = self._tenant_label(tenant)
+            if reason != "ok":
+                rc = self._tenant_reasons.get(tenant)
+                if rc is None:
+                    rc = self._tenant_reasons[tenant] = ReasonCounter(
+                        f"tenant_rejections[{tenant}]")
+        if reason == "ok":
+            self.tenant_served.inc(tenant)
+            return
+        self.tenant_shed.inc(tenant)
+        rc.inc(reason)
+
+    def observe_queue_wait_class(self, priority: str, wait_ms: float):
+        h = self.queue_wait_by_class.get(priority)
+        if h is not None:
+            h.observe(wait_ms)
+
+    def qos_snapshot(self) -> dict:
+        """Per-tenant QoS roll-up — the /api/qos payload: served/shed and
+        reason breakdown per tenant, queue-wait histograms by priority
+        class, and the admission-governor counters (quota, SLO sheds,
+        retry-budget exhaustions, whether the burn governor is currently
+        shedding)."""
+        served = self.tenant_served.to_dict()
+        shed = self.tenant_shed.to_dict()
+        with self._tenant_lock:
+            reasons = {t: rc.to_dict()
+                       for t, rc in self._tenant_reasons.items()}
+        tenants = {t: {"served": served.get(t, 0.0),
+                       "shed": shed.get(t, 0.0),
+                       "rejections_by_reason": reasons.get(t, {})}
+                   for t in set(served) | set(shed) | set(reasons)}
+        return {
+            "tenants": tenants,
+            "queue_wait_by_class": {p: h.to_dict()
+                                    for p, h in
+                                    self.queue_wait_by_class.items()},
+            "quota_rejections_total": self.quota_rejections_total.value,
+            "slo_sheds_total": self.slo_sheds_total.value,
+            "retry_budget_exhausted_total":
+                self.retry_budget_exhausted_total.value,
+            "slo_burn_active": self.slo_burn_active.value,
+        }
+
     def slo_snapshot(self) -> Dict[str, dict]:
         """Rolling-window SLO roll-up: per window, exact p50/p95/p99 over
         in-window successes plus the reason-bucketed error rate — the
@@ -336,7 +429,8 @@ class ServingMetrics:
             self.watchdog_restarts, self.fallback_serves,
             self.faults_injected_total, self.poisoned_results_total,
             self.prefix_prefills_total, self.prefix_hits_total,
-            self.kv_cow_copies_total)}
+            self.kv_cow_copies_total, self.quota_rejections_total,
+            self.slo_sheds_total, self.retry_budget_exhausted_total)}
 
     def decode_tokens_per_sec(self) -> float:
         """Steady-state decode throughput: tokens sampled by decode_step
@@ -378,6 +472,7 @@ class ServingMetrics:
             "kv_fragmentation": self.kv_fragmentation.value,
             "rejections_by_reason": self.rejections_by_reason.to_dict(),
             "slo": self.slo_snapshot(),
+            "qos": self.qos_snapshot(),
             "ttft_ms": self.ttft_ms.to_dict(),
             "prefill_ms": self.prefill_ms.to_dict(),
             "decode_step_ms": self.decode_step_ms.to_dict(),

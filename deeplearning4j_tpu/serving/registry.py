@@ -29,7 +29,7 @@ import numpy as np
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
 from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
-from deeplearning4j_tpu.serving.resilience import CircuitBreaker
+from deeplearning4j_tpu.serving.resilience import CircuitBreaker, RetryBudget
 from deeplearning4j_tpu.serving.tracing import flight_recorder
 
 
@@ -206,6 +206,13 @@ class Deployment:
     # shares it, so failures anywhere trip it everywhere and the registry
     # can route around it (health() / previous-version fallback)
     breaker: Optional[CircuitBreaker] = None
+    # deploy-time multi-tenant QoS policy (serving/qos.py QosPolicy):
+    # every engine spun up over this deployment enforces it by default
+    qos: Optional[object] = None
+    # one retry budget per (name, version), shared by its engines like
+    # the breaker — retry storms are bounded per DEPLOYMENT (created
+    # lazily when the registry is configured with a retry_budget_ratio)
+    retry_budget: Optional[RetryBudget] = None
 
     @property
     def ref(self) -> str:
@@ -222,11 +229,19 @@ class ModelRegistry:
     def __init__(self, default_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
                  breaker_failure_threshold: int = 5,
                  breaker_cooldown_s: float = 5.0,
+                 retry_budget_ratio: Optional[float] = None,
+                 retry_budget_burst: float = 10.0,
                  metrics: Optional[ServingMetrics] = None,
                  tracer=None, recorder=None):
         self.default_buckets = tuple(default_buckets)
         self.breaker_failure_threshold = breaker_failure_threshold
         self.breaker_cooldown_s = breaker_cooldown_s
+        # retry budgets (resilience.RetryBudget — Google SRE): when a
+        # ratio is configured, every deployment gets ONE budget shared by
+        # all its engines, bounding retry amplification deployment-wide.
+        # None (the default) keeps retries unmetered, PR 3 behavior.
+        self.retry_budget_ratio = retry_budget_ratio
+        self.retry_budget_burst = retry_budget_burst
         self.metrics = metrics or ServingMetrics()
         # request tracing for every engine this registry spins up
         # (serving/tracing.py; None = the process default, off until
@@ -273,10 +288,14 @@ class ModelRegistry:
                buckets: Optional[Sequence[int]] = None,
                warmup_example=None, input_name: Optional[str] = None,
                output_name: Optional[str] = None,
-               output_index: int = 0) -> Deployment:
+               output_index: int = 0, qos=None) -> Deployment:
         """Register ``model`` under ``name``; returns the Deployment. When
         ``warmup_example`` (ONE row, no batch dim) is given, every bucket
-        size is compiled before the deployment becomes visible."""
+        size is compiled before the deployment becomes visible. ``qos``
+        (a :class:`~deeplearning4j_tpu.serving.qos.QosPolicy`) attaches a
+        deploy-time multi-tenant policy: every engine spun up over this
+        deployment enforces it unless the caller overrides ``qos=`` at
+        engine construction."""
         if ":" in name:
             raise ValueError(f"model name {name!r} may not contain ':'")
         adapter = as_adapter(model, input_name=input_name,
@@ -285,7 +304,7 @@ class ModelRegistry:
         bks = tuple(sorted(set(buckets))) if buckets else self.default_buckets
         ex = np.asarray(warmup_example) if warmup_example is not None else None
         dep = Deployment(name=name, version=0, adapter=adapter, buckets=bks,
-                         warmup_example=ex,
+                         warmup_example=ex, qos=qos,
                          state="warming" if ex is not None else "ready")
         with self._lock:
             # reserve the slot under the lock: concurrent deploys of the
@@ -422,6 +441,16 @@ class ModelRegistry:
                     self.metrics.record_breaker_transition)
             return dep.breaker
 
+    def _retry_budget_for(self, dep: Deployment) -> Optional[RetryBudget]:
+        if self.retry_budget_ratio is None:
+            return None
+        with self._lock:
+            if dep.retry_budget is None:
+                dep.retry_budget = RetryBudget(
+                    ratio=self.retry_budget_ratio,
+                    burst=self.retry_budget_burst)
+            return dep.retry_budget
+
     def health(self) -> Dict[str, dict]:
         """Per-deployment health roll-up: ``SERVING`` (ready, breaker
         CLOSED or never exercised), ``DEGRADED`` (breaker HALF_OPEN — a
@@ -495,6 +524,12 @@ class ModelRegistry:
         # share the deployment's breaker: trips observed by any engine make
         # the registry route NEW lookups to the previous healthy version
         engine_kwargs.setdefault("breaker", self._breaker_for(dep))
+        # deploy-time QoS policy + the deployment-shared retry budget
+        if dep.qos is not None:
+            engine_kwargs.setdefault("qos", dep.qos)
+        rb = self._retry_budget_for(dep)
+        if rb is not None:
+            engine_kwargs.setdefault("retry_budget", rb)
         if self._tracer is not None:
             engine_kwargs.setdefault("tracer", self._tracer)
         engine_kwargs.setdefault("recorder", self._recorder)
@@ -525,6 +560,11 @@ class ModelRegistry:
                 "CausalLMAdapter to serve autoregressive decode")
         engine_kwargs.setdefault("name", dep.ref)
         engine_kwargs.setdefault("breaker", self._breaker_for(dep))
+        if dep.qos is not None:
+            engine_kwargs.setdefault("qos", dep.qos)
+        rb = self._retry_budget_for(dep)
+        if rb is not None:
+            engine_kwargs.setdefault("retry_budget", rb)
         if self._tracer is not None:
             engine_kwargs.setdefault("tracer", self._tracer)
         engine_kwargs.setdefault("recorder", self._recorder)

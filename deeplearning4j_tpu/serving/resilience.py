@@ -69,6 +69,72 @@ class PoisonedResultError(RejectedError):
         super().__init__(msg, "poisoned")
 
 
+class RetryBudgetExhaustedError(RejectedError):
+    """A transient failure would have been retried, but the deployment's
+    retry budget (:class:`RetryBudget`) is spent — the request fails
+    typed (reason 'retry_budget_exhausted') instead, so a retry storm
+    cannot amplify a brown-out. The original transient failure rides as
+    ``__cause__``."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg, "retry_budget_exhausted")
+
+
+class RetryBudget:
+    """Google-SRE-style retry budget: a per-deployment token bucket where
+    every INCOMING request deposits ``ratio`` tokens (capped at ``burst``,
+    which it starts holding) and every retry spends one.
+
+    The invariant this buys (SRE book ch. 22, "Handling Overload"): with
+    ratio r, sustained retry traffic is at most r× the request traffic —
+    so when a deployment browns out and every call starts failing
+    transiently, total load is bounded by (1 + r)× offered load instead
+    of max_attempts×. When the bucket is dry, the retry layer fails the
+    request typed (:class:`RetryBudgetExhaustedError`) rather than
+    re-dispatching; healthy-path retries (occasional, paid for by the
+    steady deposit stream) are untouched. Shared by every engine over one
+    deployment (the registry wires this, mirroring the shared breaker),
+    so storms are bounded per deployment, not per engine."""
+
+    def __init__(self, ratio: float = 0.1, burst: float = 10.0):
+        if ratio < 0:
+            raise ValueError(f"ratio must be >= 0, got {ratio}")
+        if burst < 1:
+            raise ValueError(f"burst must be >= 1, got {burst}")
+        self.ratio = float(ratio)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._lock = threading.Lock()
+        self.spent_total = 0
+        self.exhausted_total = 0
+
+    def on_request(self):
+        """One incoming request earns the deployment ``ratio`` retries."""
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + self.ratio)
+
+    def try_spend(self) -> bool:
+        """Take one retry token; False (and counted) when dry."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.spent_total += 1
+                return True
+            self.exhausted_total += 1
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"tokens": round(self._tokens, 3), "burst": self.burst,
+                    "ratio": self.ratio, "spent_total": self.spent_total,
+                    "exhausted_total": self.exhausted_total}
+
+
 def is_transient(exc: BaseException) -> bool:
     """Default retry classifier: an exception is retry-worthy iff it says
     so (``transient=True`` attribute — FaultInjectedError and any backend
@@ -115,10 +181,15 @@ class RetryPolicy:
         return base * (1.0 + self.jitter * u)
 
     def call(self, fn: Callable[[], object],
-             on_retry: Optional[Callable[[int, BaseException], None]] = None):
+             on_retry: Optional[Callable[[int, BaseException], None]] = None,
+             budget: Optional[RetryBudget] = None):
         """Run ``fn`` with retries. ``on_retry(attempt, exc)`` fires before
         each backoff sleep (the engines count retries there). The final
-        failure — non-transient, or attempts exhausted — propagates."""
+        failure — non-transient, or attempts exhausted — propagates.
+        ``budget`` (a :class:`RetryBudget`) is consulted before EACH
+        retry: a dry budget converts the would-be retry into a typed
+        :class:`RetryBudgetExhaustedError` (original failure chained), so
+        storms stop amplifying at the deployment's configured ratio."""
         attempt = 1
         while True:
             try:
@@ -126,6 +197,12 @@ class RetryPolicy:
             except BaseException as e:
                 if attempt >= self.max_attempts or not self.classify(e):
                     raise
+                if budget is not None and not budget.try_spend():
+                    raise RetryBudgetExhaustedError(
+                        f"retry budget exhausted (ratio {budget.ratio:g}, "
+                        f"burst {budget.burst:g}): failing typed instead "
+                        f"of retrying {type(e).__name__} (attempt "
+                        f"{attempt}/{self.max_attempts})") from e
                 if on_retry is not None:
                     on_retry(attempt, e)
                 time.sleep(self.backoff_ms(attempt) / 1e3)
@@ -347,6 +424,7 @@ class ResilientEngineMixin:
 
     def _init_resilience(self, *, retry_policy: Optional[RetryPolicy] = None,
                          breaker: Optional[CircuitBreaker] = None,
+                         retry_budget: Optional[RetryBudget] = None,
                          tracer=None, recorder=None):
         from deeplearning4j_tpu.serving.tracing import (
             default_tracer, flight_recorder)
@@ -361,6 +439,10 @@ class ResilientEngineMixin:
         # to disable retries (max_attempts=1).
         self._retry = retry_policy if retry_policy is not None \
             else RetryPolicy()
+        # retry budget: None (default) = unmetered retries, today's
+        # behavior; the registry shares one per deployment so storms are
+        # bounded deployment-wide (see RetryBudget design notes)
+        self._retry_budget = retry_budget
         self._breaker = breaker if breaker is not None \
             else CircuitBreaker(name=self.name)
         self._breaker.add_listener(self.metrics.record_breaker_transition)
@@ -384,7 +466,7 @@ class ResilientEngineMixin:
         self._recorder.record("breaker.transition", engine=self.name,
                               old=old, new=new)
 
-    def _breaker_gate(self, trace):
+    def _breaker_gate(self, trace, tenant: Optional[str] = None):
         """Submit-time shed while the breaker is OPEN: typed, counted,
         traced."""
         if self._breaker.allow():
@@ -392,7 +474,7 @@ class ResilientEngineMixin:
         self.metrics.rejected_total.inc()
         self.metrics.rejected_circuit_open.inc()
         self.metrics.record_rejection("circuit_open")
-        self._finish_request(trace, "circuit_open")
+        self._finish_request(trace, "circuit_open", tenant=tenant)
         raise CircuitOpenError(
             f"circuit open for engine[{self.name}] after "
             f"{self._breaker.consecutive_failures} consecutive "
@@ -400,21 +482,35 @@ class ResilientEngineMixin:
 
     # ------------------------------------------------------------ terminals
     def _finish_request(self, trace, reason: str,
-                        latency_ms: Optional[float] = None):
+                        latency_ms: Optional[float] = None,
+                        tenant: Optional[str] = None):
         """One request reached a terminal state: close its trace (tail
         sampling decides retention) and feed the SLO windows — the same
         reason string both places, and the same string
         ``record_rejection`` used for this cause, so /api/slo error
-        buckets match ``rejections_by_reason`` keys exactly."""
+        buckets match ``rejections_by_reason`` keys exactly. ``tenant``
+        additionally attributes the outcome to the per-tenant QoS
+        counters (served/shed + per-tenant rejection reasons) — every
+        call site that holds the Request passes its tenant."""
         self.metrics.record_outcome(reason, latency_ms)
+        if tenant is not None:
+            self.metrics.record_tenant_outcome(tenant, reason)
         trace.finish(reason, latency_ms=latency_ms)
+
+    def _count_request(self):
+        """One request entered submit(): the QPS counter plus the retry
+        budget's deposit (incoming traffic is what EARNS retries — the
+        Google SRE ratio invariant)."""
+        self.metrics.requests_total.inc()
+        if self._retry_budget is not None:
+            self._retry_budget.on_request()
 
     def _count_shed(self, req):
         """AdmissionController.on_shed hook: a queued request expired."""
         self.metrics.rejected_total.inc()
         self.metrics.rejected_deadline.inc()
         self.metrics.record_rejection("deadline")
-        self._finish_request(req.trace, "deadline")
+        self._finish_request(req.trace, "deadline", tenant=req.tenant)
 
     def _count_close_reject(self, req):
         """AdmissionController.on_close_reject hook: a queued request was
@@ -422,21 +518,27 @@ class ResilientEngineMixin:
         drain, so a shutdown terminal reaches the SLO windows and
         ``rejections_by_reason`` no matter which path rejected it."""
         self.metrics.record_rejection("shutdown")
-        self._finish_request(req.trace, "shutdown")
+        self._finish_request(req.trace, "shutdown", tenant=req.tenant)
 
     def _count_cancelled(self, req):
         """AdmissionController.on_cancelled hook: a caller cancelled a
         queued future — recorded with the same 'cancelled' outcome the
         dispatch-time cancel path uses, whichever thread observes it."""
-        self._finish_request(req.trace, "cancelled")
+        self._finish_request(req.trace, "cancelled", tenant=req.tenant)
 
-    def _reject_submit(self, trace, exc: RejectedError):
+    def _reject_submit(self, trace, exc: RejectedError,
+                       tenant: Optional[str] = None):
         """Shared accounting for a submit-time admission rejection."""
         self.metrics.rejected_total.inc()
-        if getattr(exc, "reason", None) == "queue_full":
+        reason = getattr(exc, "reason", None)
+        if reason == "queue_full":
             self.metrics.rejected_queue_full.inc()
+        elif reason == "quota_exceeded":
+            self.metrics.quota_rejections_total.inc()
+        elif reason == "slo_shed":
+            self.metrics.slo_sheds_total.inc()
         self.metrics.record_rejection(exc.reason)
-        self._finish_request(trace, exc.reason)
+        self._finish_request(trace, exc.reason, tenant=tenant)
 
     def _shed_typed(self, req, exc: RejectedError):
         """Fail an already-DEQUEUED request with a typed serving error —
@@ -450,15 +552,32 @@ class ResilientEngineMixin:
         try:
             req.future.set_exception(exc)
         except InvalidStateError:
-            self._finish_request(req.trace, "cancelled")
+            self._finish_request(req.trace, "cancelled", tenant=req.tenant)
             return
         self.metrics.rejected_total.inc()
         self.metrics.record_rejection(exc.reason)
         self._recorder.record("request.shed", engine=self.name,
                               reason=exc.reason)
-        self._finish_request(req.trace, exc.reason)
+        self._finish_request(req.trace, exc.reason, tenant=req.tenant)
 
     # -------------------------------------------------------------- retries
+    def _retry_call(self, fn: Callable[[], object]):
+        """THE retry entry both engines route device calls through:
+        bounded retry (RetryPolicy) gated by the deployment's retry
+        budget. A dry budget fails the call typed — counted here so
+        'retry_budget_exhausted' lands in ``rejections_by_reason`` beside
+        every other shed cause before the engine's normal failure tail
+        (breaker, tenants failed typed, SLO terminal) takes over."""
+        try:
+            return self._retry.call(fn, on_retry=self._on_retry,
+                                    budget=self._retry_budget)
+        except RetryBudgetExhaustedError:
+            self.metrics.retry_budget_exhausted_total.inc()
+            self.metrics.record_rejection("retry_budget_exhausted")
+            self._recorder.record("retry_budget.exhausted",
+                                  engine=self.name)
+            raise
+
     def _on_retry(self, attempt: int, exc: BaseException):
         self.metrics.retries_total.inc()
         if getattr(exc, "injected", False):
@@ -559,6 +678,7 @@ class ResilientEngineMixin:
         return self._watchdog.restarts if self._watchdog is not None else 0
 
 
-__all__ = ["RetryPolicy", "CircuitBreaker", "Watchdog", "CircuitOpenError",
+__all__ = ["RetryPolicy", "RetryBudget", "RetryBudgetExhaustedError",
+           "CircuitBreaker", "Watchdog", "CircuitOpenError",
            "WatchdogTimeoutError", "PoisonedResultError",
            "ResilientEngineMixin", "is_transient"]

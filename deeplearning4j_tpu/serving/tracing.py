@@ -61,6 +61,10 @@ TERMINAL_REASONS = (
     "ok", "queue_full", "deadline", "shutdown", "circuit_open", "watchdog",
     "poisoned", "cancelled", "model_error", "client_error",
     "kv_blocks_exhausted",
+    # multi-tenant QoS sheds (serving/qos.py + resilience.RetryBudget):
+    # per-tenant quota bucket dry, SLO-burn governor shedding batch-class
+    # traffic, and the deployment retry budget refusing to amplify a storm
+    "quota_exceeded", "slo_shed", "retry_budget_exhausted",
 )
 
 

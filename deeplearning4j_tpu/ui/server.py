@@ -412,6 +412,29 @@ class _Handler(BaseHTTPRequestHandler):
     def _storages(self) -> List[StatsStorage]:
         return self.server.ui._storages  # type: ignore[attr-defined]
 
+    def _metrics_rollup(self, key: str) -> List[dict]:
+        """Latest ServingMetrics sub-payload ``key`` per serving worker
+        (the shared shape of /api/slo and /api/qos): walk every attached
+        storage's sessions/workers, pick the newest ServingMetrics
+        update carrying ``key``, and ride ``rejections_by_reason``
+        alongside for taxonomy cross-checking."""
+        out = []
+        for st in self._storages():
+            for sid in st.listSessionIDs():
+                for worker in st.listWorkerIDsForSession(sid) or []:
+                    ups = st.getUpdates(sid, "ServingMetrics", worker)
+                    if not ups:
+                        continue
+                    latest = ups[-1]
+                    if isinstance(latest, dict) and key in latest:
+                        out.append({
+                            "sessionId": sid, "workerId": worker,
+                            key: latest[key],
+                            "rejections_by_reason":
+                                latest.get("rejections_by_reason"),
+                        })
+        return out
+
     def _html(self, page: str):
         body = page.encode()
         self.send_response(200)
@@ -451,23 +474,23 @@ class _Handler(BaseHTTPRequestHandler):
             # over the in-window successes + reason-bucketed error rate
             # (serving.metrics.SlidingWindowStats — NOT lifetime
             # histograms). Reasons use the same taxonomy as
-            # rejections_by_reason, which rides along for cross-checking.
-            out = []
-            for st in self._storages():
-                for sid in st.listSessionIDs():
-                    for worker in st.listWorkerIDsForSession(sid) or []:
-                        ups = st.getUpdates(sid, "ServingMetrics", worker)
-                        if not ups:
-                            continue
-                        latest = ups[-1]
-                        if isinstance(latest, dict) and "slo" in latest:
-                            out.append({
-                                "sessionId": sid, "workerId": worker,
-                                "slo": latest["slo"],
-                                "rejections_by_reason":
-                                    latest.get("rejections_by_reason"),
-                            })
-            self._json(out)
+            # rejections_by_reason.
+            self._json(self._metrics_rollup("slo"))
+            return
+        if parts == ["api", "qos"]:
+            # multi-tenant QoS roll-up per serving worker (serving/qos.py):
+            # per-tenant served/shed + reason breakdown, queue-wait
+            # histograms by priority class, quota/SLO-shed/retry-budget
+            # counters and whether the burn governor is currently
+            # shedding. rejections_by_reason cross-check convention:
+            # admission-path reasons (quota_exceeded, slo_shed,
+            # queue_full, deadline, ...) match the per-tenant sums
+            # exactly; incident-style reasons (poisoned,
+            # retry_budget_exhausted, watchdog) count once per INCIDENT
+            # engine-wide but once per victim request per tenant, the
+            # same convention rejections_by_reason has used for
+            # 'poisoned' since PR 5.
+            self._json(self._metrics_rollup("qos"))
             return
         if parts == ["api", "traces"]:
             # finished request traces retained by every Tracer in this

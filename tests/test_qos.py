@@ -1,0 +1,1080 @@
+"""Multi-tenant QoS tests (serving/qos.py + retry budgets in
+serving/resilience.py).
+
+Acceptance criteria exercised here:
+- weighted fairness under contention: a weight-3 tenant receives ~3x the
+  goodput of a weight-1 tenant (+/- 20%) on a deterministic pre-loaded
+  queue, while interactive-class traffic strictly overtakes batch;
+- per-tenant quotas shed typed 'quota_exceeded' without burning shared
+  queue capacity; SLO-burn shedding drops batch-class traffic while the
+  rolling window burns and recovers by itself;
+- retry budgets convert would-be retries into typed
+  'retry_budget_exhausted' failures once the deployment's budget is dry
+  (storm amplification bounded), with healthy-path retries untouched;
+- QoS inertness: with no policy configured, admission keeps the exact
+  FIFO deque path, engine outputs and greedy generation streams are
+  bitwise-identical to the unlabeled path, and the compiled-signature
+  bound (len(prefill_buckets) + 1) is unchanged;
+- taxonomy drift guard: every new shed reason appears in
+  tracing.TERMINAL_REASONS exactly once (mirroring the PR 5 test).
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import TransformerConfig, init_params
+from deeplearning4j_tpu.serving import (
+    DEFAULT_TENANT, FaultPlan, GenerationEngine, InferenceEngine,
+    ModelAdapter, ModelRegistry, QosPolicy, QueueFullError,
+    QuotaExceededError, RejectedError, RetryBudget,
+    RetryBudgetExhaustedError, RetryPolicy, ServingMetrics,
+    SlidingWindowStats, SloShedError, TenantPolicy, TokenBucket, tracing,
+)
+from deeplearning4j_tpu.serving.admission import Request
+from deeplearning4j_tpu.serving.qos import (
+    BURN_REASONS, PRIORITIES, SloBurnGovernor, TenantQueues, resolve_qos,
+)
+
+CFG = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                        mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                        causal=True, attention_impl="full", remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+class EchoAdapter(ModelAdapter):
+    """Row-wise x*scale echo, optional per-dispatch sleep (to make queue
+    arbitration, not device time, the bottleneck under test)."""
+
+    def __init__(self, scale=2.0, sleep_s=0.0):
+        super().__init__(model=None)
+        self.scale = scale
+        self.sleep_s = sleep_s
+
+    def infer(self, x):
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        return np.asarray(x) * self.scale
+
+
+def row(v=1.0):
+    return np.full((1, 3), v, np.float32)
+
+
+# --------------------------------------------------------------------------
+# TokenBucket / policy units
+# --------------------------------------------------------------------------
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        clock = [0.0]
+        b = TokenBucket(rate=2.0, burst=3.0, clock=lambda: clock[0])
+        assert b.try_take() and b.try_take() and b.try_take()
+        assert not b.try_take()          # burst spent
+        clock[0] += 0.5                  # 2/s * 0.5s = 1 token
+        assert b.try_take()
+        assert not b.try_take()
+        clock[0] += 100.0                # refill caps at burst
+        assert b.tokens == pytest.approx(3.0)
+
+    def test_cost_units(self):
+        clock = [0.0]
+        b = TokenBucket(rate=0.0, burst=4.0, clock=lambda: clock[0])
+        assert b.try_take(3.0)
+        assert not b.try_take(2.0)       # only 1 left
+        assert b.try_take(1.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=-1.0, burst=1.0)
+        with pytest.raises(ValueError):
+            TokenBucket(rate=1.0, burst=0.0)
+
+
+class TestQosPolicy:
+    def test_tenant_defaults_and_dict_form(self):
+        p = QosPolicy({"a": {"weight": 2.0, "priority": "batch"}},
+                      default_weight=1.5, default_priority="batch")
+        assert p.tenant("a").weight == 2.0
+        assert p.tenant("unknown").weight == 1.5
+        assert p.tenant("unknown").priority == "batch"
+        assert p.to_dict()["tenants"]["a"]["priority"] == "batch"
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TenantPolicy(weight=0.0)
+        with pytest.raises(ValueError):
+            TenantPolicy(priority="bulk")
+        with pytest.raises(ValueError):
+            TenantPolicy(quota=-1.0)
+        with pytest.raises(ValueError):
+            QosPolicy(default_priority="nope")
+        with pytest.raises(ValueError):
+            QosPolicy(slo_shed_error_rate=1.5)
+        with pytest.raises(ValueError):
+            QosPolicy(slo_shed_classes=("mystery",))
+        with pytest.raises(ValueError):   # 0 would trip on one bad request
+            QosPolicy(slo_min_samples=0)
+        with pytest.raises(ValueError):   # negative TTL = evaluate/submit
+            QosPolicy(slo_check_interval_s=-1.0)
+
+    def test_resolve_qos(self):
+        p = QosPolicy({"b": TenantPolicy(priority="batch"),
+                       "i": TenantPolicy(priority="interactive")})
+        assert resolve_qos(None, None, None) == (DEFAULT_TENANT,
+                                                 "interactive")
+        assert resolve_qos(p, "b", None) == ("b", "batch")
+        assert resolve_qos(p, "b", "batch") == ("b", "batch")
+        with pytest.raises(ValueError):
+            resolve_qos(p, "b", "bulk")
+
+    def test_configured_tenant_cannot_escalate_priority(self):
+        """Review regression: the flooding batch tenant the policy exists
+        to contain must not escape strict-priority ordering (and the
+        burn governor, which sheds batch first) by passing
+        priority='interactive' — escalation above the configured class
+        is rejected; voluntary downgrade and unconfigured tenants are
+        untouched."""
+        p = QosPolicy({"b": TenantPolicy(priority="batch"),
+                       "i": TenantPolicy(priority="interactive")})
+        with pytest.raises(ValueError, match="escalate"):
+            resolve_qos(p, "b", "interactive")
+        assert resolve_qos(p, "i", "batch") == ("i", "batch")   # downgrade
+        assert resolve_qos(p, "stranger", "interactive") == (
+            "stranger", "interactive")                # default-trust
+        pol = QosPolicy({"b": TenantPolicy(priority="batch")})
+        with InferenceEngine(EchoAdapter(), max_batch_size=2,
+                             max_wait_ms=0, qos=pol,
+                             name="no-esc") as eng:
+            with pytest.raises(ValueError, match="escalate"):
+                eng.submit(row(), tenant="b", priority="interactive")
+
+
+# --------------------------------------------------------------------------
+# TenantQueues (the WFQ multi-queue) in isolation
+# --------------------------------------------------------------------------
+def _req(tenant, priority="interactive", rows=1):
+    return Request(x=None, rows=rows, tenant=tenant, priority=priority)
+
+
+class TestTenantQueues:
+    def test_single_tenant_is_fifo(self):
+        q = TenantQueues(QosPolicy())
+        reqs = [_req("t") for _ in range(5)]
+        for r in reqs:
+            q.append(r)
+        assert [q.popleft() for _ in range(5)] == reqs
+        assert len(q) == 0
+
+    def test_weighted_interleave_3_to_1(self):
+        pol = QosPolicy({"h": TenantPolicy(weight=3.0),
+                         "l": TenantPolicy(weight=1.0)})
+        q = TenantQueues(pol)
+        for _ in range(12):
+            q.append(_req("h"))
+        for _ in range(4):
+            q.append(_req("l"))
+        order = [q.popleft().tenant for _ in range(16)]
+        # every 4-pop window carries 3 h's and 1 l — weighted fairness
+        for i in range(0, 16, 4):
+            win = order[i:i + 4]
+            assert win.count("h") == 3 and win.count("l") == 1, order
+
+    def test_strict_priority_between_classes(self):
+        pol = QosPolicy({"b": TenantPolicy(priority="batch", weight=100.0),
+                         "i": TenantPolicy(priority="interactive",
+                                           weight=0.001)})
+        q = TenantQueues(pol)
+        q.append(_req("b", "batch"))
+        q.append(_req("b", "batch"))
+        q.append(_req("i", "interactive"))
+        # interactive overtakes regardless of weights or arrival order
+        assert q.popleft().tenant == "i"
+        assert q.popleft().tenant == "b"
+
+    def test_peek_matches_pop_and_appendleft_restores(self):
+        pol = QosPolicy({"h": TenantPolicy(weight=3.0)})
+        q = TenantQueues(pol)
+        a, b = _req("h"), _req("l")
+        q.append(a)
+        q.append(b)
+        head = q[0]
+        assert q.popleft() is head
+        q.appendleft(head)                 # requeue-head path
+        assert q[0] is head and len(q) == 2
+
+    def test_idle_tenant_reenters_at_current_vtime(self):
+        """A tenant that backs off must not bank credit and then starve
+        everyone on return — its new arrivals restart at the advanced
+        virtual time."""
+        q = TenantQueues(QosPolicy())
+        for _ in range(6):
+            q.append(_req("busy"))
+        for _ in range(3):
+            q.popleft()
+        late = _req("late")
+        q.append(late)                     # arrives after vtime advanced
+        order = [q.popleft().tenant for _ in range(4)]
+        # equal weights: late interleaves from NOW, it does not drain its
+        # "missed" share first
+        assert order.count("late") == 1
+
+    def test_remove_expired_sweeps_all_tenants(self):
+        q = TenantQueues(QosPolicy())
+        live, dead = _req("a"), _req("b")
+        dead.deadline_t = time.perf_counter() - 1.0
+        q.append(live)
+        q.append(dead)
+        shed = q.remove_expired(time.perf_counter())
+        assert shed == [dead]
+        assert len(q) == 1 and q[0] is live
+
+    def test_finish_tags_are_per_class(self):
+        """Review regression: a tenant's queued-but-unserved batch
+        backlog must not inflate its own interactive requests' start
+        tags — tags are only ever compared within a class, so the chains
+        are kept per (tenant, class)."""
+        q = TenantQueues(QosPolicy())
+        for _ in range(5):
+            q.append(_req("a", "batch"))
+        assert q._finish[("a", "batch")] == 5.0
+        fresh = _req("a", "interactive")
+        q.append(fresh)
+        assert fresh.qos_start_tag == 0.0   # not behind its batch backlog
+
+    def test_depth_by_tenant(self):
+        q = TenantQueues(QosPolicy())
+        q.append(_req("a"))
+        q.append(_req("a"))
+        q.append(_req("b", "batch"))
+        assert q.depth_by_tenant() == {"a": 2, "b": 1}
+
+    def test_drained_tenants_are_pruned(self):
+        """Review regression: rotating tenant ids must not accumulate
+        empty per-tenant deques (scanned by every dequeue under the
+        admission lock) or stale finish tags forever."""
+        q = TenantQueues(QosPolicy())
+        for i in range(600):
+            q.append(_req(f"tenant-{i}"))
+        while len(q):
+            q.popleft()
+        assert sum(len(t) for t in q._classes.values()) == 0
+        # the idle reset cleared every per-tenant finish tag
+        assert len(q._finish) == 0
+        # a drained-and-returning tenant still works
+        q.append(_req("tenant-0"))
+        assert q.popleft().tenant == "tenant-0"
+
+    def test_expiry_drain_also_resets_tenant_state(self):
+        """Review regression: an expiry-only drain (wedged dispatcher +
+        short deadlines + rotating tenant ids — popleft never runs) must
+        run the same idle reset as popleft, or _finish grows forever."""
+        q = TenantQueues(QosPolicy())
+        deadline = time.perf_counter() - 1.0
+        for i in range(50):
+            r = _req(f"rot-{i}")
+            r.deadline_t = deadline
+            q.append(r)
+        shed = q.remove_expired(time.perf_counter())
+        assert len(shed) == 50 and len(q) == 0
+        assert len(q._finish) == 0
+        assert sum(len(t) for t in q._classes.values()) == 0
+
+    def test_fully_expired_tenant_carries_no_virtual_service_debt(self):
+        """Review regression: a tenant whose queued work ALL expired
+        unserved must not be deprioritized for that phantom service —
+        its finish tag drops even while other tenants keep the queue
+        non-empty (no global idle reset)."""
+        q = TenantQueues(QosPolicy())
+        dead = []
+        for _ in range(10):
+            r = _req("victim")
+            r.deadline_t = time.perf_counter() - 1.0
+            dead.append(r)
+            q.append(r)
+        q.append(_req("busy"))            # keeps _len > 0 after the sweep
+        debt = q._finish[("victim", "interactive")]
+        assert debt > 0
+        q.remove_expired(time.perf_counter())
+        assert ("victim", "interactive") not in q._finish
+        fresh = _req("victim")
+        q.append(fresh)
+        # re-enters at the current virtual time, not behind its debt
+        assert fresh.qos_start_tag < debt
+
+    def test_take_path_expired_shed_drops_debt_too(self):
+        """Review regression: an expired head shed by take() (not the
+        sweep) must drop the tenant's finish tag the same way
+        remove_expired does — both shed paths, one rule."""
+        from deeplearning4j_tpu.serving import AdmissionController
+
+        pol = QosPolicy()
+        ctrl = AdmissionController(capacity_rows=8, policy=pol)
+        dead = Request(x=None, rows=1, tenant="victim")
+        ctrl.admit(dead, timeout_ms=1.0)
+        live = Request(x=None, rows=1, tenant="busy")
+        ctrl.admit(live)
+        # second busy request keeps the queue non-empty after the take,
+        # so the global idle reset cannot mask a banked victim tag
+        ctrl.admit(Request(x=None, rows=1, tenant="busy"))
+        debt = ctrl._q._finish[("victim", "interactive")]
+        time.sleep(0.01)
+        got = ctrl.take(8, timeout=0.0)   # sheds victim's head, pops busy
+        assert got is live
+        assert ("victim", "interactive") not in ctrl._q._finish
+        fresh = Request(x=None, rows=1, tenant="victim")
+        ctrl.admit(fresh)
+        assert fresh.qos_start_tag < debt
+        ctrl.close()
+
+    def test_no_deadline_controller_skips_expiry_scan(self):
+        """Review regression: the dispatcher sweeps every loop turn, so
+        a controller that never saw a deadline must early-out O(1)."""
+        from deeplearning4j_tpu.serving import AdmissionController
+
+        ctrl = AdmissionController(capacity_rows=8)
+        ctrl.admit(Request(x=None, rows=1))
+        assert not ctrl._has_deadlines
+        assert ctrl.expire_queued() == 0
+        ctrl.admit(Request(x=None, rows=1), timeout_ms=10.0)
+        assert ctrl._has_deadlines
+        time.sleep(0.02)
+        assert ctrl.expire_queued() == 1
+        ctrl.close()
+
+
+# --------------------------------------------------------------------------
+# Weighted fairness + priority through the batching engine (acceptance)
+# --------------------------------------------------------------------------
+def _wedge_and_enqueue(eng, submits, wedge_ms=150):
+    """Wedge dispatch #0 for ``wedge_ms`` and run ``submits`` while the
+    dispatcher is stuck — every request is queued before arbitration
+    starts, so completion order is exactly the queue's pop order
+    (max_batch_size=1: one request per dispatch)."""
+    plan = FaultPlan(seed=0).delay("engine.dispatch", ms=wedge_ms, at=(0,))
+    with plan:
+        sentinel = eng.submit(row(), tenant="sentinel", priority="batch")
+        time.sleep(0.03)                  # dispatcher takes + wedges on it
+        futs = submits()
+        for f in futs:
+            f.result(timeout=120)
+    sentinel.result(timeout=120)
+    return futs
+
+
+class TestWeightedFairEngine:
+    def test_weight3_tenant_gets_3x_goodput(self):
+        """THE fairness acceptance: under contention a weight-3 tenant
+        drains ~3x the requests of a weight-1 tenant (+/- 20%)."""
+        pol = QosPolicy({"heavy": TenantPolicy(weight=3.0, priority="batch"),
+                         "light": TenantPolicy(weight=1.0,
+                                               priority="batch")})
+        order = []
+        with InferenceEngine(EchoAdapter(), max_batch_size=1, max_wait_ms=0,
+                             queue_capacity_rows=4096, qos=pol,
+                             name="wfq") as eng:
+            def submits():
+                futs = []
+                for _ in range(40):
+                    for t in ("heavy", "light"):
+                        f = eng.submit(row(), tenant=t)
+                        f.add_done_callback(
+                            lambda _f, t=t: order.append(t))
+                        futs.append(f)
+                return futs
+
+            _wedge_and_enqueue(eng, submits)
+            head = order[:40]
+            n_h, n_l = head.count("heavy"), head.count("light")
+            assert n_l > 0
+            ratio = n_h / n_l
+            assert 2.4 <= ratio <= 3.6, (n_h, n_l, order[:40])
+            qs = eng.metrics.qos_snapshot()
+            assert qs["tenants"]["heavy"]["served"] == 40
+            assert qs["tenants"]["light"]["served"] == 40
+
+    def test_interactive_overtakes_queued_batch(self):
+        """Interactive-class p99 stays bounded under a batch flood: an
+        interactive request submitted LAST completes first."""
+        pol = QosPolicy({"flood": TenantPolicy(priority="batch"),
+                         "user": TenantPolicy(priority="interactive")})
+        order = []
+        with InferenceEngine(EchoAdapter(), max_batch_size=1, max_wait_ms=0,
+                             queue_capacity_rows=4096, qos=pol,
+                             name="prio") as eng:
+            def submits():
+                futs = []
+                for i in range(30):
+                    f = eng.submit(row(), tenant="flood")
+                    f.add_done_callback(
+                        lambda _f, i=i: order.append(f"b{i}"))
+                    futs.append(f)
+                f = eng.submit(row(), tenant="user")
+                f.add_done_callback(lambda _f: order.append("user"))
+                futs.append(f)
+                return futs
+
+            _wedge_and_enqueue(eng, submits)
+            assert order[0] == "user", order[:5]
+            # queue-wait-by-class histograms captured both classes
+            qwc = eng.metrics.queue_wait_by_class
+            assert qwc["interactive"].count == 1
+            assert qwc["batch"].count >= 30
+
+    def test_starved_tenant_expired_request_swept_mid_flood(self):
+        """Review regression: under strict priority, a batch tenant's
+        queue can be starved indefinitely by interactive traffic — its
+        deadline-expired request must be shed by the dispatcher's
+        per-iteration sweep near its deadline, not only when finally
+        selected after the flood ends."""
+        pol = QosPolicy({"flood": TenantPolicy(priority="interactive"),
+                         "starved": TenantPolicy(priority="batch")})
+        from deeplearning4j_tpu.serving import DeadlineExceededError
+
+        with InferenceEngine(EchoAdapter(sleep_s=0.002), max_batch_size=1,
+                             max_wait_ms=0, queue_capacity_rows=4096,
+                             qos=pol, name="sweep") as eng:
+            floods = [eng.submit(row(), tenant="flood")
+                      for _ in range(150)]           # ~300ms of work
+            victim = eng.submit(row(), tenant="starved", timeout_ms=40.0)
+            with pytest.raises(DeadlineExceededError):
+                victim.result(timeout=60)
+            # the flood is still in progress when the victim was shed —
+            # i.e. the sweep fired mid-starvation, not post-drain
+            assert any(not f.done() for f in floods)
+            for f in floods:
+                f.result(timeout=120)
+
+    def test_depth_by_tenant_visible_while_queued(self):
+        pol = QosPolicy({"a": TenantPolicy(), "b": TenantPolicy()})
+        with InferenceEngine(EchoAdapter(), max_batch_size=1, max_wait_ms=0,
+                             queue_capacity_rows=64, qos=pol,
+                             name="depth") as eng:
+            plan = FaultPlan(seed=0).delay("engine.dispatch", ms=200,
+                                           at=(0,))
+            with plan:
+                futs = [eng.submit(row(), tenant="a")]
+                time.sleep(0.03)
+                futs += [eng.submit(row(), tenant="a"),
+                         eng.submit(row(), tenant="b")]
+                depth = eng._admission.depth_by_tenant()
+                for f in futs:
+                    f.result(timeout=60)
+            assert depth == {"a": 1, "b": 1}
+
+
+# --------------------------------------------------------------------------
+# Per-tenant quotas
+# --------------------------------------------------------------------------
+class TestQuota:
+    def test_quota_shed_typed_and_refills(self):
+        clock = [0.0]
+        pol = QosPolicy({"q": TenantPolicy(quota=1.0, quota_burst=2.0)},
+                        clock=lambda: clock[0])
+        with InferenceEngine(EchoAdapter(), max_batch_size=4, max_wait_ms=0,
+                             qos=pol, name="quota") as eng:
+            eng.submit(row(), tenant="q").result(timeout=60)
+            eng.submit(row(), tenant="q").result(timeout=60)
+            with pytest.raises(QuotaExceededError) as ei:
+                eng.submit(row(), tenant="q")
+            assert ei.value.reason == "quota_exceeded"
+            assert ei.value.tenant == "q"
+            # typed accounting: engine totals + the tenant's own breakdown
+            assert eng.metrics.quota_rejections_total.value == 1
+            assert eng.metrics.rejections_by_reason.get(
+                "quota_exceeded") == 1
+            qs = eng.metrics.qos_snapshot()
+            assert qs["tenants"]["q"]["rejections_by_reason"][
+                "quota_exceeded"] == 1
+            # unmetered tenants are untouched by q's dry bucket
+            eng.submit(row(), tenant="other").result(timeout=60)
+            clock[0] += 1.0               # 1 token/s refill
+            eng.submit(row(), tenant="q").result(timeout=60)
+
+    def test_quota_is_policy_scoped_across_engines(self):
+        """Review regression: a deploy-time policy shared by N engines
+        must enforce ONE tenant rate across all of them (like the
+        deployment-shared RetryBudget) — not N independent buckets."""
+        clock = [0.0]
+        pol = QosPolicy({"q": TenantPolicy(quota=1.0, quota_burst=2.0)},
+                        clock=lambda: clock[0])
+        with InferenceEngine(EchoAdapter(), max_batch_size=4, max_wait_ms=0,
+                             qos=pol, name="shared-a") as e1, \
+             InferenceEngine(EchoAdapter(), max_batch_size=4, max_wait_ms=0,
+                             qos=pol, name="shared-b") as e2:
+            e1.submit(row(), tenant="q").result(timeout=60)
+            e2.submit(row(), tenant="q").result(timeout=60)
+            # burst of 2 is spent across BOTH engines
+            with pytest.raises(QuotaExceededError):
+                e1.submit(row(), tenant="q")
+            with pytest.raises(QuotaExceededError):
+                e2.submit(row(), tenant="q")
+
+    def test_quota_buckets_are_per_cost_unit(self):
+        """Review regression: one policy serving BOTH engine kinds must
+        not merge rows/s and requests/s into one bucket — same-unit
+        queues share, cross-unit queues do not."""
+        pol = QosPolicy({"q": TenantPolicy(quota=1.0, quota_burst=2.0)})
+        rows_a = TenantQueues(pol, unit="rows")
+        rows_b = TenantQueues(pol, unit="rows")
+        reqs = TenantQueues(pol, unit="requests")
+        r = _req("q")
+        rows_a.charge_quota(r)
+        rows_b.charge_quota(r)          # same unit: shared, burst spent
+        with pytest.raises(QuotaExceededError):
+            rows_a.charge_quota(r)
+        reqs.charge_quota(r)            # different unit: untouched bucket
+        reqs.charge_quota(r)
+        with pytest.raises(QuotaExceededError):
+            reqs.charge_quota(r)
+
+    def test_quota_counts_rows_for_batch_engine(self):
+        clock = [0.0]
+        pol = QosPolicy({"q": TenantPolicy(quota=1.0, quota_burst=4.0)},
+                        clock=lambda: clock[0])
+        with InferenceEngine(EchoAdapter(), max_batch_size=8, max_wait_ms=0,
+                             qos=pol, name="quota-rows") as eng:
+            eng.submit(np.ones((3, 3), np.float32),
+                       tenant="q").result(timeout=60)
+            with pytest.raises(QuotaExceededError):
+                eng.submit(np.ones((2, 3), np.float32), tenant="q")
+            eng.submit(row(), tenant="q").result(timeout=60)  # 1 left
+
+
+# --------------------------------------------------------------------------
+# SLO-burn-aware shedding
+# --------------------------------------------------------------------------
+def _burning_engine(**kw):
+    pol = QosPolicy(slo_shed_error_rate=0.5, slo_window="10s",
+                    slo_min_samples=5, slo_check_interval_s=0.0, **kw)
+    eng = InferenceEngine(EchoAdapter(), max_batch_size=4, max_wait_ms=0,
+                          qos=pol, name="slo")
+    fake = [0.0]
+    eng.metrics.slo_windows["10s"] = SlidingWindowStats(
+        window_s=10.0, clock=lambda: fake[0])
+    return eng, fake
+
+
+class TestSloShed:
+    def test_batch_sheds_while_burning_interactive_flows(self):
+        eng, fake = _burning_engine()
+        with eng:
+            for _ in range(10):
+                eng.metrics.record_outcome("model_error")
+            with pytest.raises(SloShedError) as ei:
+                eng.submit(row(), priority="batch")
+            assert ei.value.reason == "slo_shed"
+            assert "error rate" in ei.value.detail
+            # interactive keeps flowing through the same burn
+            eng.submit(row(), priority="interactive").result(timeout=60)
+            assert eng.metrics.slo_sheds_total.value == 1
+            assert eng.metrics.rejections_by_reason.get("slo_shed") == 1
+            assert eng.metrics.slo_burn_active.value == 1.0
+
+    def test_recovers_as_window_clears(self):
+        eng, fake = _burning_engine()
+        with eng:
+            for _ in range(10):
+                eng.metrics.record_outcome("model_error")
+            with pytest.raises(SloShedError):
+                eng.submit(row(), priority="batch")
+            fake[0] += 20.0               # rolling window forgets the burn
+            eng.submit(row(), priority="batch").result(timeout=60)
+            assert eng.metrics.slo_burn_active.value == 0.0
+
+    def test_own_sheds_do_not_latch_the_governor(self):
+        """The burn signal must exclude the governor's own sheds (and the
+        other admission rejections) — otherwise shedding sustains the
+        signal that triggered it and the governor never re-opens."""
+        assert "slo_shed" not in BURN_REASONS
+        assert "quota_exceeded" not in BURN_REASONS
+        assert "queue_full" not in BURN_REASONS
+        eng, fake = _burning_engine()
+        with eng:
+            for _ in range(10):
+                eng.metrics.record_outcome("model_error")
+            for _ in range(3):
+                with pytest.raises(SloShedError):
+                    eng.submit(row(), priority="batch")
+            # burn samples roll out; the recorded slo_shed terminals
+            # remain in-window but must NOT keep the governor shut
+            fake[0] += 20.0
+            eng.submit(row(), priority="batch").result(timeout=60)
+
+    def test_burn_rate_not_diluted_by_admission_sheds(self):
+        """Review regression: the burn-rate denominator mirrors the
+        numerator's shed-exclusion — a window stuffed with quota sheds
+        must not hide a 100%-failing dispatch path."""
+        eng, fake = _burning_engine()
+        with eng:
+            for _ in range(10):
+                eng.metrics.record_outcome("model_error")
+            for _ in range(990):   # flood of admission sheds
+                eng.metrics.record_outcome("quota_exceeded")
+            with pytest.raises(SloShedError):
+                eng.submit(row(), priority="batch")
+
+    def test_over_burst_request_sheds_with_structural_message(self):
+        """Review regression: a request costing more than the tenant's
+        quota_burst can NEVER pass — the typed shed must say so instead
+        of implying a back-off will help."""
+        pol = QosPolicy({"q": TenantPolicy(quota=2.0, quota_burst=2.0)})
+        with InferenceEngine(EchoAdapter(), max_batch_size=8, max_wait_ms=0,
+                             qos=pol, name="over-burst") as eng:
+            with pytest.raises(QuotaExceededError, match="never"):
+                eng.submit(np.ones((4, 3), np.float32), tenant="q")
+            # and the bucket was not drained by the refusal
+            eng.submit(np.ones((2, 3), np.float32),
+                       tenant="q").result(timeout=60)
+
+    def test_burn_gauge_refreshes_on_non_shed_class_traffic(self):
+        """Review regression: the slo_burn_active gauge must clear even
+        when batch traffic has backed off entirely — interactive submits
+        refresh the (cached) verdict."""
+        eng, fake = _burning_engine()
+        with eng:
+            for _ in range(10):
+                eng.metrics.record_outcome("model_error")
+            with pytest.raises(SloShedError):
+                eng.submit(row(), priority="batch")
+            assert eng.metrics.slo_burn_active.value == 1.0
+            fake[0] += 20.0   # burn clears; only interactive traffic now
+            eng.submit(row(), priority="interactive").result(timeout=60)
+            assert eng.metrics.slo_burn_active.value == 0.0
+
+    def test_unknown_slo_window_fails_at_construction(self):
+        """Review regression: a typo'd slo_window must fail the engine
+        constructor, not silently never shed."""
+        pol = QosPolicy(slo_shed_error_rate=0.5, slo_window="30s")
+        with pytest.raises(ValueError, match="slo_window"):
+            InferenceEngine(EchoAdapter(), max_batch_size=2, max_wait_ms=0,
+                            qos=pol, name="typo")
+
+    def test_p99_threshold_trips(self):
+        pol = QosPolicy(slo_shed_p99_ms=50.0, slo_window="10s",
+                        slo_min_samples=5, slo_check_interval_s=0.0)
+        m = ServingMetrics()
+        fake = [0.0]
+        m.slo_windows["10s"] = SlidingWindowStats(
+            window_s=10.0, clock=lambda: fake[0])
+        gov = SloBurnGovernor(pol, m)
+        for _ in range(6):
+            m.record_outcome("ok", latency_ms=100.0)
+        assert gov.gate("batch") is not None
+        assert gov.gate("interactive") is None
+
+
+# --------------------------------------------------------------------------
+# Retry budgets (Google SRE)
+# --------------------------------------------------------------------------
+class TestRetryBudget:
+    def test_budget_math(self):
+        b = RetryBudget(ratio=0.5, burst=2.0)
+        assert b.try_spend() and b.try_spend()
+        assert not b.try_spend()          # dry
+        for _ in range(2):                # 2 requests * 0.5 = 1 token
+            b.on_request()
+        assert b.try_spend()
+        assert b.exhausted_total == 1 and b.spent_total == 3
+        for _ in range(100):              # deposits cap at burst
+            b.on_request()
+        assert b.tokens == pytest.approx(2.0)
+
+    def test_storm_fails_typed_when_dry(self):
+        plan = FaultPlan(seed=0).fail("engine.dispatch", rate=1.0)
+        budget = RetryBudget(ratio=0.0, burst=2.0)
+        with InferenceEngine(
+                EchoAdapter(), max_batch_size=1, max_wait_ms=0,
+                retry_policy=RetryPolicy(max_attempts=4, base_delay_ms=0.1),
+                retry_budget=budget, name="storm") as eng:
+            with plan:
+                with pytest.raises(RetryBudgetExhaustedError) as ei:
+                    eng.submit(row()).result(timeout=60)
+            assert ei.value.reason == "retry_budget_exhausted"
+            # the original transient failure rides as the cause
+            assert ei.value.__cause__ is not None
+            assert budget.spent_total == 2 and budget.exhausted_total == 1
+            assert eng.metrics.retry_budget_exhausted_total.value == 1
+            assert eng.metrics.rejections_by_reason.get(
+                "retry_budget_exhausted") == 1
+            slo = eng.metrics.slo_windows["60s"].stats()
+            assert slo["errors_by_reason"].get(
+                "retry_budget_exhausted") == 1
+
+    def test_healthy_retries_untouched_with_budget(self):
+        """A budget with tokens behaves exactly like no budget: one
+        transient fault retries through to a bitwise-correct answer."""
+        plan = FaultPlan(seed=0).fail("engine.dispatch", at=(0,))
+        with InferenceEngine(
+                EchoAdapter(scale=1.5), max_batch_size=2, max_wait_ms=0,
+                retry_policy=RetryPolicy(max_attempts=3, base_delay_ms=0.1),
+                retry_budget=RetryBudget(ratio=0.1, burst=10.0),
+                name="healthy") as eng:
+            with plan:
+                out = eng.submit(row()).result(timeout=60)
+            assert np.array_equal(out.toNumpy(), row() * 1.5)
+            assert eng.metrics.retries_total.value == 1
+            assert eng.metrics.retry_budget_exhausted_total.value == 0
+
+    def test_registry_shares_budget_per_deployment(self, params):
+        reg = ModelRegistry(retry_budget_ratio=0.1, retry_budget_burst=5.0)
+        with reg:
+            reg.deploy("echo", EchoAdapter(), buckets=(1, 2))
+            e1 = reg.engine("echo", max_wait_ms=0)
+            e2 = reg.engine("echo", max_wait_ms=0)
+            assert e1._retry_budget is e2._retry_budget
+            assert e1._retry_budget.ratio == 0.1
+            dep = reg.get("echo")
+            assert dep.retry_budget is e1._retry_budget
+
+    def test_registry_default_is_unmetered(self):
+        reg = ModelRegistry()
+        with reg:
+            reg.deploy("echo", EchoAdapter(), buckets=(1, 2))
+            eng = reg.engine("echo", max_wait_ms=0)
+            assert eng._retry_budget is None
+
+
+# --------------------------------------------------------------------------
+# QoS through the generation engine
+# --------------------------------------------------------------------------
+def prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n).astype(np.int32)
+
+
+GEN_POLICY = QosPolicy({"user": TenantPolicy(priority="interactive"),
+                        "batcher": TenantPolicy(priority="batch")})
+
+
+def _wait_tokens(handle, n, timeout=120.0):
+    deadline = time.time() + timeout
+    while len(handle.tokens_so_far()) < n:
+        assert time.time() < deadline, "stream never started"
+        time.sleep(0.001)
+
+
+@pytest.fixture(scope="module")
+def gen_qos(params):
+    with GenerationEngine(params, CFG, slots=1, max_len=32,
+                          qos=GEN_POLICY, name="gen-qos") as eng:
+        yield eng
+
+
+class TestGenerationQos:
+    def test_interactive_prompt_overtakes_queued_batch(self, gen_qos):
+        eng = gen_qos
+        # occupy the single slot, then queue batch prompts + 1 interactive
+        long = eng.submit(prompt(5), max_new_tokens=16, tenant="batcher")
+        order = []
+        hs = [eng.submit(prompt(5), max_new_tokens=2, tenant="batcher")
+              for _ in range(3)]
+        for i, h in enumerate(hs):
+            h.future.add_done_callback(
+                lambda _f, i=i: order.append(f"b{i}"))
+        hi = eng.submit(prompt(5), max_new_tokens=2, tenant="user")
+        hi.future.add_done_callback(lambda _f: order.append("user"))
+        for h in hs + [hi, long]:
+            h.result(timeout=240)
+        assert order[0] == "user", order
+        qs = eng.metrics.qos_snapshot()
+        assert qs["tenants"]["user"]["served"] >= 1
+        assert qs["tenants"]["batcher"]["served"] >= 4
+
+    def test_tenant_label_stream_bitwise_identical(self, gen_qos):
+        ref = gen_qos.generate(prompt(5), max_new_tokens=6, timeout=240)
+        labeled = gen_qos.generate(prompt(5), max_new_tokens=6, timeout=240,
+                                   tenant="user", priority="interactive")
+        assert labeled == ref
+
+    def test_generation_quota_typed(self, params):
+        clock = [0.0]
+        pol = QosPolicy({"q": TenantPolicy(quota=1.0, quota_burst=1.0)},
+                        clock=lambda: clock[0])
+        with GenerationEngine(params, CFG, slots=1, max_len=32, qos=pol,
+                              name="gen-quota") as eng:
+            h = eng.submit(prompt(4), max_new_tokens=2, tenant="q")
+            with pytest.raises(QuotaExceededError):
+                eng.submit(prompt(4), max_new_tokens=2, tenant="q")
+            h.result(timeout=240)
+            assert eng.metrics.rejections_by_reason.get(
+                "quota_exceeded") == 1
+
+    def test_block_waiter_not_starved_under_qos(self, params):
+        """Review regression: a paged request requeued waiting for KV
+        blocks must not be starved by overtaking higher-priority
+        arrivals — the block-waiter reservation lets freed blocks
+        accumulate toward it (liveness: everything completes)."""
+        pol = QosPolicy({"big": TenantPolicy(priority="batch"),
+                         "fast": TenantPolicy(priority="interactive")})
+        with GenerationEngine(params, CFG, slots=2, max_len=32, qos=pol,
+                              block_size=8, num_blocks=7,
+                              name="blk-waiter") as eng:
+            # holder occupies 4 of the 6 usable blocks for ~26 iterations
+            holder = eng.submit(prompt(6), max_new_tokens=26,
+                                tenant="fast")
+            _wait_tokens(holder, 1)
+            # big (batch) needs 4 blocks > 2 free: requeued, waits
+            big = eng.submit(prompt(26), max_new_tokens=6, tenant="big")
+            smalls = [eng.submit(prompt(4), max_new_tokens=3,
+                                 tenant="fast") for _ in range(6)]
+            assert len(big.result(timeout=240)) == 6
+            for h in smalls:
+                assert len(h.result(timeout=240)) == 3
+            holder.result(timeout=240)
+
+    def test_two_large_waiters_do_not_livelock(self, params):
+        """Review regression: a higher-class waiting head takes OVER the
+        block-waiter slot instead of waiting behind a lower-class
+        reservation — without that, two large requests deadlock each
+        other against a pool that had room for either, and neither
+        future ever resolves."""
+        pol = QosPolicy({"big": TenantPolicy(priority="batch"),
+                         "fast": TenantPolicy(priority="interactive")})
+        with GenerationEngine(params, CFG, slots=2, max_len=32, qos=pol,
+                              block_size=8, num_blocks=7,
+                              name="no-livelock") as eng:
+            holder = eng.submit(prompt(6), max_new_tokens=26,
+                                tenant="fast")
+            _wait_tokens(holder, 1)
+            # batch waiter records its 4-block demand (2 free), then an
+            # equally-large interactive request overtakes and waits too
+            big_batch = eng.submit(prompt(26), max_new_tokens=6,
+                                   tenant="big")
+            big_inter = eng.submit(prompt(26), max_new_tokens=6,
+                                   tenant="fast")
+            # holder retires -> 6 free: each must seat in turn
+            assert len(big_inter.result(timeout=240)) == 6
+            assert len(big_batch.result(timeout=240)) == 6
+            holder.result(timeout=240)
+
+    def test_same_class_smaller_tag_waiter_does_not_livelock(self, params):
+        """Review regression: when a same-class request with a smaller
+        WFQ tag overtakes the recorded block-waiter and must wait too,
+        it takes OVER the reservation (it is the head selection keeps
+        picking) — first-waiter-wins would pin a reservation nobody can
+        clear and livelock the scheduler against an idle pool."""
+        pol = QosPolicy({"hv": TenantPolicy(weight=10.0, priority="batch"),
+                         "lw": TenantPolicy(weight=1.0, priority="batch")})
+        with GenerationEngine(params, CFG, slots=2, max_len=32, qos=pol,
+                              block_size=8, num_blocks=7,
+                              name="same-class") as eng:
+            holder = eng.submit(prompt(6), max_new_tokens=26, tenant="lw")
+            _wait_tokens(holder, 1)
+            # lw records its 4-block demand (2 free); hv's smaller tag
+            # then overtakes and must wait too
+            big_lw = eng.submit(prompt(26), max_new_tokens=6, tenant="lw")
+            big_hv = eng.submit(prompt(26), max_new_tokens=6, tenant="hv")
+            assert len(big_hv.result(timeout=240)) == 6
+            assert len(big_lw.result(timeout=240)) == 6
+            holder.result(timeout=240)
+
+    def test_registry_deploy_time_policy(self, params):
+        reg = ModelRegistry()
+        with reg:
+            from deeplearning4j_tpu.serving import CausalLMAdapter
+
+            reg.deploy("lm", CausalLMAdapter(params, CFG), qos=GEN_POLICY)
+            eng = reg.generation_engine("lm", slots=1, max_len=32)
+            assert eng.qos is GEN_POLICY
+            toks = eng.generate(prompt(4), max_new_tokens=2, timeout=240,
+                                tenant="user")
+            assert len(toks) == 2
+
+
+# --------------------------------------------------------------------------
+# QoS inertness: no policy -> the PR 6 path, bit for bit (satellite)
+# --------------------------------------------------------------------------
+class TestQosInertness:
+    def test_no_policy_keeps_plain_fifo_deque(self):
+        from collections import deque
+        with InferenceEngine(EchoAdapter(), max_batch_size=2,
+                             max_wait_ms=0, name="inert") as eng:
+            assert type(eng._admission._q) is deque
+            assert eng._admission.policy is None
+            assert eng._qos_governor is None
+
+    def test_engine_outputs_bitwise_identical_with_and_without_policy(self):
+        xs = [np.random.default_rng(i).standard_normal(
+            (2, 3)).astype(np.float32) for i in range(8)]
+
+        def run(qos, **submit_kw):
+            with InferenceEngine(EchoAdapter(scale=1.5), max_batch_size=4,
+                                 max_wait_ms=1.0, qos=qos,
+                                 name="inert-par") as eng:
+                return [eng.submit(x, **submit_kw).result(
+                    timeout=60).toNumpy() for x in xs]
+
+        plain = run(None)
+        labeled = run(None, tenant="t", priority="batch")
+        policied = run(QosPolicy({"t": TenantPolicy(weight=2.0)}))
+        for a, b, c in zip(plain, labeled, policied):
+            assert np.array_equal(a, b)
+            assert np.array_equal(a, c)
+
+    def test_generation_streams_and_signature_bound_unchanged(self, params):
+        """Satellite guard (alongside the PR 2/6 signature-bound tests):
+        greedy streams are bitwise-identical with QoS unconfigured vs
+        configured, and the compiled footprint stays at
+        len(prefill_buckets) + 1 either way."""
+        kw = dict(slots=2, max_len=32)
+        with GenerationEngine(params, CFG, name="plain", **kw) as eng:
+            ref = [eng.generate(prompt(4 + i), max_new_tokens=4,
+                                timeout=240) for i in range(3)]
+            assert eng.compiled_signatures() <= len(eng.buckets) + 1
+            plain_bound = len(eng.buckets) + 1
+        pol = QosPolicy({"a": TenantPolicy(weight=3.0, priority="batch")})
+        with GenerationEngine(params, CFG, name="qos", qos=pol, **kw) as eng:
+            got = [eng.generate(prompt(4 + i), max_new_tokens=4,
+                                timeout=240, tenant="a") for i in range(3)]
+            assert got == ref
+            assert eng.compiled_signatures() <= len(eng.buckets) + 1
+            assert len(eng.buckets) + 1 == plain_bound
+
+
+# --------------------------------------------------------------------------
+# Taxonomy drift guard (satellite, mirrors the PR 5 test)
+# --------------------------------------------------------------------------
+class TestTaxonomyGuard:
+    def test_new_shed_reasons_in_terminal_reasons_exactly_once(self):
+        for reason in ("quota_exceeded", "slo_shed",
+                       "retry_budget_exhausted"):
+            assert tracing.TERMINAL_REASONS.count(reason) == 1, reason
+
+    def test_typed_errors_map_through_terminal_reason(self):
+        assert tracing.terminal_reason(
+            QuotaExceededError("m", tenant="t")) == "quota_exceeded"
+        assert tracing.terminal_reason(SloShedError("m")) == "slo_shed"
+        assert tracing.terminal_reason(
+            RetryBudgetExhaustedError("m")) == "retry_budget_exhausted"
+
+    def test_priorities_match_metrics_histograms(self):
+        m = ServingMetrics()
+        assert set(m.queue_wait_by_class) == set(PRIORITIES)
+
+    def test_tenant_metric_cardinality_bounded(self):
+        """Review regression: rotating caller-controlled tenant ids must
+        not grow the per-tenant counters (and every snapshot payload)
+        without bound — past the cap, novel tenants fold into the shared
+        overflow bucket."""
+        m = ServingMetrics()
+        for i in range(m.MAX_TRACKED_TENANTS + 500):
+            m.record_tenant_outcome(f"user-{i}", "ok")
+            m.record_tenant_outcome(f"user-{i}", "deadline")
+        tenants = m.qos_snapshot()["tenants"]
+        assert len(tenants) == m.MAX_TRACKED_TENANTS + 1
+        other = tenants[m.OVERFLOW_TENANT]
+        assert other["served"] == 500 and other["shed"] == 500
+        assert other["rejections_by_reason"]["deadline"] == 500
+        # known tenants keep exact attribution
+        assert tenants["user-0"]["served"] == 1
+
+
+# --------------------------------------------------------------------------
+# /api/qos end-to-end
+# --------------------------------------------------------------------------
+class TestApiQos:
+    def test_api_qos_serves_tenant_rollup(self):
+        import json
+        import urllib.request
+
+        from deeplearning4j_tpu.ui import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+        pol = QosPolicy({"a": TenantPolicy(weight=2.0)})
+        with InferenceEngine(EchoAdapter(), max_batch_size=2, max_wait_ms=0,
+                             qos=pol, name="api") as eng:
+            eng.submit(row(), tenant="a").result(timeout=60)
+            storage = InMemoryStatsStorage()
+            eng.metrics.publish(storage, sessionId="s", workerId="w")
+        server = UIServer(port=0)
+        try:
+            server.attach(storage)
+            with urllib.request.urlopen(server.url + "api/qos",
+                                        timeout=5) as r:
+                body = json.loads(r.read().decode())
+            entry = [e for e in body if e["workerId"] == "w"]
+            assert entry, body
+            qos = entry[0]["qos"]
+            assert qos["tenants"]["a"]["served"] == 1
+            assert "queue_wait_by_class" in qos
+            assert "rejections_by_reason" in entry[0]
+        finally:
+            server.stop()
+
+
+# --------------------------------------------------------------------------
+# Soak: skewed weights over a starved queue (stress — out of tier-1)
+# --------------------------------------------------------------------------
+@pytest.mark.stress
+@pytest.mark.slow
+class TestTenantSoak:
+    def test_six_tenant_skewed_weight_soak(self):
+        """6 tenant threads with weights 1/1/2/2/3/3 hammer a starved
+        (64-deep) queue for ~2 s: no deadlock, every future reaches a
+        terminal, per-tenant accounting is complete, and heavier tenants
+        out-serve lighter ones."""
+        weights = {"t1": 1.0, "t2": 1.0, "t3": 2.0, "t4": 2.0,
+                   "t5": 3.0, "t6": 3.0}
+        pol = QosPolicy({t: TenantPolicy(weight=w, priority="batch")
+                         for t, w in weights.items()})
+        stop = threading.Event()
+        errors = []
+
+        with InferenceEngine(EchoAdapter(sleep_s=0.001), max_batch_size=1,
+                             max_wait_ms=0, queue_capacity_rows=64,
+                             qos=pol, name="soak") as eng:
+            def client(tenant):
+                # bounded-window client: keep ~16 requests outstanding so
+                # every tenant holds queued backlog for the WFQ to
+                # arbitrate (a raw submit-as-fast-as-possible loop would
+                # reduce to a race for free capacity at ADMISSION, which
+                # is exactly the unfairness quotas/weights exist to fix)
+                outstanding = []
+                while not stop.is_set():
+                    outstanding = [f for f in outstanding
+                                   if not f.done()]
+                    while len(outstanding) < 16:
+                        try:
+                            outstanding.append(
+                                eng.submit(row(), tenant=tenant))
+                        except QueueFullError:
+                            break   # starved queue: try again next turn
+                        except Exception as e:   # pragma: no cover
+                            errors.append(e)
+                            return
+                    time.sleep(0.0005)
+                for f in outstanding:
+                    try:
+                        f.result(timeout=120)
+                    except RejectedError:
+                        pass
+                    except Exception as e:   # pragma: no cover
+                        errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in weights]
+            for th in threads:
+                th.start()
+            time.sleep(2.0)
+            stop.set()
+            for th in threads:
+                th.join(timeout=120)
+                assert not th.is_alive(), "client thread deadlocked"
+            assert not errors, errors
+            qs = eng.metrics.qos_snapshot()
+            served = {t: qs["tenants"][t]["served"] for t in weights}
+            assert all(v > 0 for v in served.values()), served
+            # heavier tenants out-serve lighter ones (loose: aggregate by
+            # weight class to absorb scheduling noise)
+            w1 = served["t1"] + served["t2"]
+            w3 = served["t5"] + served["t6"]
+            assert w3 > w1, served
+            # engine still healthy after the storm
+            eng.submit(row(), tenant="t1").result(timeout=60)
