@@ -1,0 +1,43 @@
+"""Repo-specific static analysis for the serving stack's hand-maintained
+contracts (Engler et al., *Bugs as Deviant Behavior*, SOSP'01; Bessey et
+al., *A Few Billion Lines of Code Later*, CACM'10).
+
+Seven PRs of review hardening kept catching the same defect classes by
+hand: blocking calls under the admission lock, use-after-donate on the
+ONE donated decode executable, terminal-reason taxonomy drift, raw
+future terminals that skip SLO/trace/metrics accounting, and stray
+``jax.jit`` callsites that break the ``len(buckets)+1`` compiled-
+signature bound. This package encodes those invariants as AST checkers
+(stdlib only — no third-party deps) that run in tier-1:
+
+- :mod:`~tools.analysis.lock_discipline` — ``lock-discipline``: the
+  lock-acquisition graph over ``with self._lock:``-style sites; flags
+  lock-order inversions, same-lock re-acquisition (non-reentrant
+  ``threading.Lock``), and blocking calls under a held lock.
+- :mod:`~tools.analysis.donation` — ``donation-safety``: reads of a
+  donated cache binding after the donated call with no rebuild/epoch
+  guard between them (the zombie-decode bug class PRs 3/6 fixed).
+- :mod:`~tools.analysis.taxonomy` — ``taxonomy-drift``: every typed
+  shed's ``reason`` literal must appear exactly once in
+  ``tracing.TERMINAL_REASONS`` and be countable by
+  ``rejections_by_reason``.
+- :mod:`~tools.analysis.terminal` — ``terminal-exactly-once``: raw
+  ``future.set_result/set_exception`` / ``handle._fail/_finish`` calls
+  outside the allowlisted accounting paths.
+- :mod:`~tools.analysis.recompile` — ``recompile-risk``: ``jax.jit`` /
+  ``pjit`` callsites inside ``serving/`` (executables must come from
+  ``models/`` factories) and shape-varying array construction that
+  bypasses the bucket-ladder helpers.
+
+CLI: ``python -m tools.analysis <paths...> [--json] [--baseline FILE]
+[--write-baseline] [--rules r1,r2]``. Per-site suppressions are
+``# analysis: ok <rule> — why`` comments; bulk grandfathering lives in
+a checked-in baseline file (``tools/analysis/baseline.json``).
+"""
+from tools.analysis.core import (  # noqa: F401
+    AnalysisUnit, Baseline, Checker, Finding, Report, all_checkers,
+    analyze_paths, analyze_sources,
+)
+
+__all__ = ["AnalysisUnit", "Baseline", "Checker", "Finding", "Report",
+           "all_checkers", "analyze_paths", "analyze_sources"]

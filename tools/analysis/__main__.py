@@ -1,0 +1,121 @@
+"""CLI: ``python -m tools.analysis <paths...> [options]``.
+
+Exit codes: 0 = clean (no unsuppressed findings), 1 = findings (or file
+errors), 2 = usage error. ``--json`` emits the machine-readable report
+(bench/CI parse it); the default human output is one
+``path:line:col: rule: message`` line per finding plus a summary.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.analysis.core import (
+    Baseline, _collect_files, all_checkers, analyze_paths,
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="Repo-specific static analysis for the serving "
+                    "stack's concurrency/donation/taxonomy contracts.")
+    p.add_argument("paths", nargs="+",
+                   help="files or directories to analyze")
+    p.add_argument("--json", action="store_true",
+                   help="emit the JSON report instead of human output")
+    p.add_argument("--rules",
+                   help="comma-separated subset of rules to run "
+                        f"(default: all — "
+                        f"{','.join(c.rule for c in all_checkers())})")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file of grandfathered findings "
+                        "(default: tools/analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="grandfather every current unsuppressed finding "
+                        "into --baseline (merged with existing entries) "
+                        "and exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="with --write-baseline: drop baseline entries "
+                        "whose finding no longer fires — only safe from "
+                        "a FULL-scope run (all paths, all rules)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for c in all_checkers():
+            print(f"{c.rule}: {c.description}")
+        return 0
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        valid = {c.rule for c in all_checkers()}
+        unknown = [r for r in rules if r not in valid]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(valid: {', '.join(sorted(valid))})", file=sys.stderr)
+            return 2
+    if args.prune_baseline and not args.write_baseline:
+        print("--prune-baseline only applies with --write-baseline",
+              file=sys.stderr)
+        return 2
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+    # a path that exists but contributes no .py files is a usage error,
+    # not a clean run: a typo'd/renamed directory in a CI invocation
+    # must not turn the gate into a permanent false green
+    empty = [p for p in args.paths if not _collect_files([p])]
+    if empty:
+        print(f"no .py files under: {', '.join(empty)}", file=sys.stderr)
+        return 2
+    baseline = None if args.no_baseline else Baseline.load(args.baseline)
+    report = analyze_paths(args.paths, rules=rules, baseline=baseline)
+
+    if args.write_baseline:
+        if report.errors:
+            # refuse to regenerate from a partial view: a file that
+            # failed to parse would silently drop its waived findings
+            # from the baseline, and CI would fail once it parses again
+            for err in report.errors:
+                print(f"ERROR: {err}", file=sys.stderr)
+            print("baseline NOT written (fix the errors above first)",
+                  file=sys.stderr)
+            return 1
+        n = Baseline.write(args.baseline, report.findings,
+                           loaded=baseline, prune=args.prune_baseline)
+        print(f"baselined {n} finding(s) -> {args.baseline}")
+        return 0
+
+    if args.json:
+        json.dump(report.to_dict(), sys.stdout, indent=2)
+        print()
+        return report.exit_code
+
+    for err in report.errors:
+        print(f"ERROR: {err}")
+    for f in report.unsuppressed:
+        print(f"{f.location()}: {f.rule}: {f.message}")
+    n_un, n_sup = len(report.unsuppressed), len(report.suppressed)
+    by_rule = ", ".join(f"{r}={n}" for r, n in sorted(report.by_rule().items()))
+    print(f"\n{report.files_analyzed} file(s) analyzed in "
+          f"{report.elapsed_s * 1e3:.0f} ms: {n_un} finding(s)"
+          + (f" ({by_rule})" if by_rule else "")
+          + (f", {n_sup} suppressed" if n_sup else ""))
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,453 @@
+"""Analyzer core: source loading, suppression comments, the baseline
+file, the checker registry, and the runner.
+
+Design constraints (ISSUE 8):
+
+- **stdlib only** — ``ast`` + ``tokenize``-free comment parsing (a line
+  regex); the suite must import in any environment the repo's tests run
+  in, including ones without jax on the path (the checkers never import
+  the code they analyze — everything is syntactic).
+- **fast** — one parse per file, every checker walks the shared ASTs;
+  the tier-1 gate asserts < 10 s over ``serving/`` + ``models/``.
+- **suppressable, two ways** — an inline ``# analysis: ok <rule> — why``
+  comment on the finding line (or the line directly above it) silences
+  one site forever; the checked-in baseline file grandfathers a set of
+  known findings by content fingerprint (rule + file + enclosing
+  function + normalized line text), so findings move with their code
+  instead of pinning line numbers.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# inline suppression:  # analysis: ok rule-a rule-b — justification
+# separator before the justification is an em/en dash, "--" or ":" (a
+# single "-" would be ambiguous with the hyphens in rule names);
+# "*" suppresses every rule at the site.
+_SUPPRESS_RE = re.compile(
+    r"#\s*analysis:\s*ok\s+([\w*,\- ]+?)(?:\s*(?:—|–|--|:)\s*(.*))?\s*$")
+
+
+def _path_key(path: str) -> str:
+    """'serving/generation.py'-style key: parent dir + basename, stable
+    across absolute vs repo-relative invocations of the same tree."""
+    norm = os.path.normpath(path)
+    return os.path.join(os.path.basename(os.path.dirname(norm)),
+                        os.path.basename(norm))
+
+
+@dataclass
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str
+    path: str                      # as given to the analyzer
+    line: int
+    col: int
+    message: str
+    func: str = "<module>"         # enclosing function qualname
+    line_text: str = ""
+    suppressed: bool = False
+    suppression: str = ""          # "inline" | "baseline" | ""
+    why: str = ""                  # justification carried by the suppression
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def fingerprint(self) -> str:
+        """Content fingerprint, stable under line drift: the rule, the
+        file (parent dir + basename — a bare basename would collide
+        across same-named files like two ``engine.py``, letting one
+        file's waiver suppress a brand-new instance elsewhere; the full
+        path would break between absolute and relative invocations of
+        the same tree), the enclosing function, and the normalized
+        source line. Deliberately excludes line/col so a baseline entry
+        follows its code through unrelated edits above it."""
+        norm = " ".join(self.line_text.split())
+        key = "\x1f".join((self.rule, _path_key(self.path),
+                           self.func, norm))
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message, "func": self.func,
+                "line_text": self.line_text, "suppressed": self.suppressed,
+                "suppression": self.suppression, "why": self.why,
+                "fingerprint": self.fingerprint()}
+
+
+class SourceFile:
+    """One parsed source file: AST + raw lines + suppression map."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # line -> (set of rules or {"*"}, justification)
+        self.suppressions: Dict[int, Tuple[set, str]] = {}
+        self._parse_suppressions()
+        self._func_of_line = _function_index(self.tree)
+
+    def _parse_suppressions(self):
+        for i, raw in enumerate(self.lines, start=1):
+            if "analysis:" not in raw:
+                continue
+            m = _SUPPRESS_RE.search(raw)
+            if m is None:
+                continue
+            rules = {r.strip() for r in re.split(r"[,\s]+", m.group(1))
+                     if r.strip()}
+            why = (m.group(2) or "").strip()
+            self.suppressions[i] = (rules, why)
+
+    def suppression_for(self, line: int, rule: str) -> Optional[str]:
+        """The justification string when ``rule`` is suppressed at
+        ``line``: an inline comment on the line itself, or anywhere in
+        the contiguous comment block directly above it (multi-line
+        justifications are encouraged); None otherwise."""
+        candidates = [line]
+        ln = line - 1
+        while ln >= 1 and self.lines[ln - 1].lstrip().startswith("#"):
+            candidates.append(ln)
+            ln -= 1
+        for ln in candidates:
+            entry = self.suppressions.get(ln)
+            if entry is None:
+                continue
+            rules, why = entry
+            if rule in rules or "*" in rules:
+                return why or "(no reason given)"
+        return None
+
+    def func_at(self, line: int) -> str:
+        return self._func_of_line.get(line, "<module>")
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+def _function_index(tree: ast.AST) -> Dict[int, str]:
+    """line -> qualname of the innermost enclosing function/method."""
+    index: Dict[int, str] = {}
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = stack + [child.name]
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = ".".join(name)
+                    end = getattr(child, "end_lineno", child.lineno)
+                    for ln in range(child.lineno, end + 1):
+                        # innermost wins: later (nested) writes overwrite
+                        index[ln] = qual
+                visit(child, name)
+            else:
+                visit(child, stack)
+
+    visit(tree, [])
+    return index
+
+
+class AnalysisUnit:
+    """Every file of one analyzer run — checkers that need whole-package
+    context (taxonomy) see all files at once."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = list(files)
+        self.errors: List[str] = []
+
+    def finding(self, sf: SourceFile, rule: str, node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=sf.path, line=line, col=col,
+                       message=message, func=sf.func_at(line),
+                       line_text=sf.line_text(line))
+
+
+class Checker:
+    """Base checker: subclasses set ``rule``/``description`` and yield
+    Findings from :meth:`check`."""
+
+    rule = "base"
+    description = ""
+
+    def check(self, unit: AnalysisUnit) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+class Baseline:
+    """Checked-in set of grandfathered findings, by fingerprint. The
+    file is a JSON list of entries (rule/file/func/line_text/why +
+    fingerprint) so reviewers can read WHAT was waived and why — the
+    analyzer matches on fingerprint only, and each entry waives ONE
+    occurrence (a second identical line appearing in the same function
+    later is a NEW finding, not covered by the old waiver)."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries = entries or []
+        self._by_fp = {e["fingerprint"]: e for e in self.entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data.get("findings", []))
+
+    def matcher(self) -> "_BaselineMatcher":
+        """A fresh occurrence-counting matcher for one analyzer run."""
+        return _BaselineMatcher(self)
+
+    @staticmethod
+    def write(path: str, findings: Sequence[Finding],
+              why: str = "baselined", loaded: "Optional[Baseline]" = None,
+              prune: bool = False) -> int:
+        """Grandfather every unsuppressed finding into ``path`` —
+        MERGING with the findings the loaded baseline already waives
+        (their hand-written ``why`` justifications ride along via
+        ``Finding.why``), so re-running ``--write-baseline`` is
+        idempotent rather than destructive. ``loaded`` entries that did
+        NOT fire in this run are kept too (a run narrowed by --rules or
+        a path subset must not garbage-collect out-of-scope waivers);
+        pass ``prune=True`` from a FULL-scope run to drop stale entries
+        whose code was fixed. Returns the number written."""
+        entries = []
+        seen = set()
+        for f in findings:
+            if f.suppressed and f.suppression != "baseline":
+                continue   # inline suppressions live in the source
+            fp = f.fingerprint()
+            seen.add(fp)
+            entries.append({
+                "rule": f.rule, "file": _path_key(f.path),
+                "func": f.func, "line_text": " ".join(f.line_text.split()),
+                "why": f.why or why, "fingerprint": fp})
+        if loaded is not None and not prune:
+            entries.extend(e for e in loaded.entries
+                           if e["fingerprint"] not in seen)
+        payload = {"comment": "static-analysis baseline: grandfathered "
+                              "findings by content fingerprint; prefer "
+                              "inline '# analysis: ok <rule> -- why' "
+                              "suppressions for new waivers",
+                   "findings": entries}
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return len(entries)
+
+
+class _BaselineMatcher:
+    """Per-run matcher: N entries with one fingerprint waive exactly N
+    occurrences. Without the count, the baseline's waiver for one
+    ``fut.set_exception(e)`` line would silently suppress every future
+    duplicate of that line in the same function — the exact defect
+    class the checker exists to block, defeated at its one waived
+    site."""
+
+    def __init__(self, baseline: Baseline):
+        self._by_fp = baseline._by_fp
+        self._avail: Dict[str, int] = {}
+        for e in baseline.entries:
+            fp = e["fingerprint"]
+            self._avail[fp] = self._avail.get(fp, 0) + 1
+
+    def take(self, finding: Finding) -> Optional[dict]:
+        fp = finding.fingerprint()
+        if self._avail.get(fp, 0) > 0:
+            self._avail[fp] -= 1
+            return self._by_fp[fp]
+        return None
+
+
+@dataclass
+class Report:
+    """One analyzer run's outcome."""
+
+    findings: List[Finding] = field(default_factory=list)
+    files_analyzed: int = 0
+    elapsed_s: float = 0.0
+    errors: List[str] = field(default_factory=list)
+    rules: Tuple[str, ...] = ()
+
+    @property
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.unsuppressed or self.errors else 0
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.unsuppressed:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {"files_analyzed": self.files_analyzed,
+                "elapsed_s": round(self.elapsed_s, 4),
+                "rules": list(self.rules),
+                "counts": {"total": len(self.findings),
+                           "unsuppressed": len(self.unsuppressed),
+                           "suppressed": len(self.suppressed),
+                           "by_rule": self.by_rule()},
+                "errors": list(self.errors),
+                "findings": [f.to_dict() for f in self.findings]}
+
+
+def all_checkers() -> List[Checker]:
+    """The registered checker set, instantiated fresh (checkers are
+    stateless between runs but cheap to build)."""
+    from tools.analysis.donation import DonationSafetyChecker
+    from tools.analysis.lock_discipline import LockDisciplineChecker
+    from tools.analysis.recompile import RecompileRiskChecker
+    from tools.analysis.taxonomy import TaxonomyDriftChecker
+    from tools.analysis.terminal import TerminalExactlyOnceChecker
+
+    return [LockDisciplineChecker(), DonationSafetyChecker(),
+            TaxonomyDriftChecker(), TerminalExactlyOnceChecker(),
+            RecompileRiskChecker()]
+
+
+def _collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                out.extend(os.path.join(root, n) for n in sorted(names)
+                           if n.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def analyze_sources(sources: Dict[str, str],
+                    rules: Optional[Sequence[str]] = None,
+                    baseline: Optional[Baseline] = None) -> Report:
+    """Analyze in-memory sources ({path: text}) — the runner the CLI,
+    the tests, and the fixture snippets all share."""
+    t0 = time.perf_counter()
+    files: List[SourceFile] = []
+    errors: List[str] = []
+    for path, text in sources.items():
+        try:
+            files.append(SourceFile(path, text))
+        except SyntaxError as e:
+            errors.append(f"{path}: syntax error: {e.msg} (line {e.lineno})")
+    unit = AnalysisUnit(files)
+    checkers = [c for c in all_checkers()
+                if rules is None or c.rule in rules]
+    findings: List[Finding] = []
+    for checker in checkers:
+        findings.extend(checker.check(unit))
+    by_path = {sf.path: sf for sf in files}
+    matcher = baseline.matcher() if baseline is not None else None
+    for f in findings:
+        sf = by_path.get(f.path)
+        why = sf.suppression_for(f.line, f.rule) if sf is not None else None
+        if why is not None:
+            f.suppressed, f.suppression, f.why = True, "inline", why
+            continue
+        if matcher is not None:
+            entry = matcher.take(f)
+            if entry is not None:
+                f.suppressed = True
+                f.suppression = "baseline"
+                f.why = entry.get("why", "")
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return Report(findings=findings, files_analyzed=len(files),
+                  elapsed_s=time.perf_counter() - t0, errors=errors,
+                  rules=tuple(c.rule for c in checkers))
+
+
+def analyze_paths(paths: Sequence[str],
+                  rules: Optional[Sequence[str]] = None,
+                  baseline: Optional[Baseline] = None) -> Report:
+    """Analyze files/directories on disk."""
+    sources: Dict[str, str] = {}
+    errors: List[str] = []
+    for fp in _collect_files(paths):
+        try:
+            with open(fp, encoding="utf-8") as f:
+                sources[fp] = f.read()
+        except OSError as e:
+            errors.append(f"{fp}: {e}")
+    report = analyze_sources(sources, rules=rules, baseline=baseline)
+    report.errors = errors + report.errors
+    return report
+
+
+# ---------------------------------------------------------------- AST utils
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name for a Name/Attribute chain ('self._cache',
+    'np.zeros'), or None for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Dotted callee name of a Call, or None."""
+    return attr_chain(node.func)
+
+
+def iter_functions(tree: ast.AST):
+    """Yield (qualname, FunctionDef, enclosing ClassDef-or-None) for
+    every function/method, including nested ones."""
+    def visit(node, stack, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, stack + [child.name], child)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield ".".join(stack + [child.name]), child, cls
+                yield from visit(child, stack + [child.name], cls)
+            else:
+                yield from visit(child, stack, cls)
+
+    yield from visit(tree, [], None)
+
+
+def string_value(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def scoped_walk(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested defs — those
+    are separate scopes, yielded separately by :func:`iter_functions`,
+    and per-function checkers that used a plain ``ast.walk`` would both
+    double-report nested sites and bleed scope facts across the
+    boundary."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,201 @@
+"""``donation-safety``: use-after-donate on the donated KV cache.
+
+The generation path compiles its prefill/decode executables with
+``donate_argnums`` on the cache argument: the instant the call
+dispatches, the caller's binding may refer to CONSUMED buffers. The bug
+class this checker encodes is exactly what PR 3 and PR 6 fixed by
+review: a thread reads the donated binding *after* the donated call —
+re-dispatching it, freeing blocks against it, or re-reading
+``self._cache`` after a watchdog restart swapped it — and dies later
+with "Array has been deleted" (or worse, consumes the replacement
+scheduler's live buffers).
+
+The rule, per function scope (nested ``def``\\ s are separate scopes —
+the retry closures deliberately re-read ``self._cache`` per attempt,
+which is safe because tagged-transient faults raise BEFORE dispatch):
+
+    after a statement that passes binding X to a donated callable,
+    any later READ of X in the same scope is a finding, unless
+    (a) X was re-assigned first (the rebuild/writeback pattern:
+    ``self._cache = new_cache`` / ``self._reset_cache()``), or
+    (b) the read sits under an epoch/zombie guard (an ``if``/``while``
+    whose test mentions ``epoch`` or ``current`` — the stale-thread
+    check every writeback uses).
+
+Donated callables are recognized syntactically: ``self._prefill`` /
+``self._decode`` (the engine's two executables), anything routed
+through ``self._donated_call(point, fn, *args)``, and any callee whose
+name ends with ``_donated``. Donated bindings are the cache-like
+arguments: ``self._cache`` or any name/attribute whose final segment
+contains ``cache``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from tools.analysis.core import (
+    AnalysisUnit, Checker, attr_chain, call_name, iter_functions,
+)
+
+DONATED_CALLEES = {"_prefill", "_decode"}
+
+
+def _is_donated_call(node: ast.Call) -> bool:
+    chain = call_name(node)
+    if chain is None:
+        return False
+    last = chain.rsplit(".", 1)[-1]
+    return (last in DONATED_CALLEES or last == "_donated_call"
+            or last.endswith("_donated"))
+
+
+def _donated_args(node: ast.Call) -> List[str]:
+    """Cache-like bindings this donated call consumes."""
+    out = []
+    for arg in node.args:
+        chain = attr_chain(arg)
+        if chain is None:
+            continue
+        if "cache" in chain.rsplit(".", 1)[-1].lower():
+            out.append(chain)
+    return out
+
+
+def _reads_and_writes(node: ast.AST, scope_end: int):
+    """Every (chain, lineno, col, is_store, node) reference in this
+    scope, nested function bodies excluded."""
+    refs = []
+
+    def walk(n):
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            chain = attr_chain(child)
+            if chain is not None and isinstance(child,
+                                                (ast.Name, ast.Attribute)):
+                is_store = isinstance(getattr(child, "ctx", None),
+                                      (ast.Store, ast.Del))
+                refs.append((chain, child.lineno, child.col_offset,
+                             is_store, child))
+                # don't descend into an Attribute chain's pieces
+                continue
+            walk(child)
+
+    walk(node)
+    return refs
+
+
+def _guard_lines(fn: ast.AST) -> Set[int]:
+    """Lines covered by an epoch/zombie guard: the body of any if/while
+    whose test mentions an identifier containing 'epoch' or 'current'
+    (plus the writeback idiom ``if current: self._cache = ...``)."""
+    guarded: Set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        test_ids = {n.id for n in ast.walk(node.test)
+                    if isinstance(n, ast.Name)}
+        test_ids |= {n.attr for n in ast.walk(node.test)
+                     if isinstance(n, ast.Attribute)}
+        if any("epoch" in i or "current" in i for i in test_ids):
+            end = getattr(node, "end_lineno", node.lineno)
+            guarded.update(range(node.lineno, end + 1))
+    return guarded
+
+
+class DonationSafetyChecker(Checker):
+    rule = "donation-safety"
+    description = ("reads of a donated cache binding after the donated "
+                   "call, with no rebuild/epoch guard in between")
+
+    def check(self, unit: AnalysisUnit):
+        for sf in unit.files:
+            for qual, fn, _cls in iter_functions(sf.tree):
+                yield from self._check_function(unit, sf, fn)
+
+    def _check_function(self, unit, sf, fn):
+        # donation events in THIS scope (nested defs excluded). A donated
+        # call whose enclosing statement is a return/raise leaves the
+        # scope on that path — nothing can read the binding "after" it
+        # (the engines' retry closures end in exactly this shape).
+        donations: List[Tuple[ast.Call, List[str], Optional[ast.stmt]]] = []
+
+        def find_calls(n, stmt):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                child_stmt = child if isinstance(child, ast.stmt) else stmt
+                if isinstance(child, ast.Call) and _is_donated_call(child) \
+                        and not isinstance(child_stmt,
+                                           (ast.Return, ast.Raise)):
+                    args = _donated_args(child)
+                    if args:
+                        donations.append((child, args, child_stmt))
+                find_calls(child, child_stmt)
+
+        find_calls(fn, None)
+        if not donations:
+            return
+        refs = _reads_and_writes(fn, getattr(fn, "end_lineno", fn.lineno))
+        # within one line, Loads sort BEFORE Stores (False < True):
+        # Python evaluates an assignment's RHS before binding its
+        # target, so in ``self._cache = trim(self._cache)`` the read of
+        # the consumed buffers happens first and must be visited before
+        # the Store marks the binding rebound
+        refs.sort(key=lambda r: (r[1], r[3], r[2]))
+        guarded = _guard_lines(fn)
+        end = getattr(fn, "end_lineno", fn.lineno)
+        for call, bindings, stmt in donations:
+            call_end = getattr(call, "end_lineno", call.lineno)
+            # taint the alias AND (for a local snapshot like
+            # ``cache = self._cache``) its source attribute: after the
+            # snapshot is donated, both names refer to consumed buffers
+            tainted = set(bindings)
+            tainted |= self._alias_sources(fn, bindings, call.lineno)
+            # the donation's own assignment targets are rebinds — the
+            # canonical same-line writeback (``self._cache, toks =
+            # self._decode(..., self._cache, ...)``) leaves the binding
+            # holding the FRESH cache
+            rebound: Set[str] = set()
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    for el in ast.walk(tgt):
+                        c = attr_chain(el)
+                        if c is not None:
+                            rebound.add(c)
+            for chain, ln, col, is_store, node in refs:
+                if ln <= call_end:
+                    continue
+                if chain not in tainted or chain in rebound:
+                    continue
+                if is_store:
+                    rebound.add(chain)
+                    continue
+                if ln in guarded:
+                    continue
+                yield unit.finding(
+                    sf, self.rule, node,
+                    f"read of {chain} after it was donated to "
+                    f"{call_name(call)}() at line {call.lineno} with no "
+                    f"rebind or epoch guard between them — the buffers "
+                    f"may be consumed (use-after-donate; rebuild via "
+                    f"_reset_cache / re-assign before reading)")
+
+    @staticmethod
+    def _alias_sources(fn, bindings, before_line) -> Set[str]:
+        """For a donated local alias (``cache = self._cache`` above the
+        donation), the source attribute is tainted too."""
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) or node.lineno >= before_line:
+                continue
+            src = attr_chain(node.value)
+            if src is None or "cache" not in src.rsplit(".", 1)[-1].lower():
+                continue
+            for tgt in node.targets:
+                t = attr_chain(tgt)
+                if t in bindings:
+                    out.add(src)
+        return out

@@ -1,0 +1,263 @@
+"""``lock-discipline``: the serving stack's locking contract, checked
+from the AST.
+
+The contract (written down in PR 1/3/7 review rounds, now enforced):
+
+1. **No blocking under a held lock.** ``AdmissionController.take()``
+   fails shed futures OUTSIDE its condition lock because
+   ``Future.set_exception`` runs done-callbacks synchronously and a
+   retry-on-shed callback re-entering the controller would deadlock.
+   The same reasoning bans ``future.result()``, ``thread.join()``,
+   ``time.sleep()``, and engine dispatch (``_dispatch`` /
+   ``_guarded_run`` / ``_donated_call`` / the jitted executables /
+   ``inject``) inside any ``with self._lock:`` region. A
+   ``Condition.wait`` on the SAME lock is exempt (wait releases it);
+   a wait on a different lock while holding one is the classic
+   two-lock sleep and is flagged.
+2. **No same-lock re-acquisition.** Every lock here is a non-reentrant
+   ``threading.Lock``/``Condition`` — ``with self._lock:`` nested
+   (lexically, or via a same-class method call) inside a region already
+   holding ``self._lock`` is a guaranteed deadlock
+   (``register_prefix`` inlines the ``_usable_blocks`` sum for exactly
+   this reason).
+3. **No lock-order inversions.** Nested acquisitions define edges in a
+   per-class lock graph (A held while B is taken => A -> B); a cycle
+   means two threads can deadlock. Edges come from lexical nesting plus
+   ONE level of same-class call expansion (method f holds A and calls
+   ``self.g()``; g acquires B).
+
+Lock sites are recognized syntactically: ``with self.<attr>:`` where
+the attribute name contains ``lock`` or ``cv`` (``_lock``, ``_wd_lock``,
+``_prefix_lock``, ``_cv``, ...), plus bare local names matching the
+same pattern.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analysis.core import (
+    AnalysisUnit, Checker, Finding, attr_chain, call_name, iter_functions,
+)
+
+#: Callees that block (or can block) the calling thread. Matched on the
+#: FINAL attribute / bare name of the callee.
+BLOCKING_ATTRS = {"result", "join"}
+#: Dispatch-path callables: a device call under a lock stalls every
+#: other thread that needs it for as long as XLA runs (or forever, if
+#: the dispatch wedges — the watchdog would then deadlock against the
+#: held lock too).
+DISPATCH_CALLEES = {
+    "_dispatch", "_run", "_guarded_run", "_retry_call", "_donated_call",
+    "inject", "_prefill", "_decode", "_prefill_into", "_decode_iteration",
+    "_prefill_prefix", "_fwd", "infer",
+}
+#: Receivers whose .join() is string/path joining, not thread joining.
+SAFE_JOIN_RECEIVERS = {"os.path", "posixpath", "ntpath", "path"}
+
+
+def is_lock_expr(node: ast.AST) -> Optional[str]:
+    """The lock key when ``node`` looks like a lock object, else None.
+    Keys are the dotted chain ('self._wd_lock'); bare names count too
+    ('lock' locals in helpers)."""
+    chain = attr_chain(node)
+    if chain is None:
+        return None
+    last = chain.rsplit(".", 1)[-1].lower()
+    if "lock" in last or last == "_cv" or last == "cv":
+        return chain
+    return None
+
+
+def _with_locks(node: ast.With) -> List[str]:
+    out = []
+    for item in node.items:
+        key = is_lock_expr(item.context_expr)
+        if key is not None:
+            out.append(key)
+    return out
+
+
+class _FunctionLockInfo:
+    """Per-function lock facts: every lock the function acquires
+    anywhere, and (lock, node, held-set) for each call made while at
+    least one lock is held."""
+
+    def __init__(self):
+        self.acquires: Set[str] = set()
+        # (held locks tuple, Call node) for calls under a lock
+        self.calls_under_lock: List[Tuple[Tuple[str, ...], ast.Call]] = []
+        # lexical nesting edges: (outer, inner, With node)
+        self.nested: List[Tuple[str, str, ast.With]] = []
+        # same-lock relock sites: (lock, With node)
+        self.relocks: List[Tuple[str, ast.With]] = []
+        # self-method calls under a lock: (held, method name, Call node)
+        self.self_calls: List[Tuple[Tuple[str, ...], str, ast.Call]] = []
+
+
+def _scan_function(fn: ast.FunctionDef) -> _FunctionLockInfo:
+    info = _FunctionLockInfo()
+
+    def walk(node, held: Tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue   # nested defs run later, not under this lock
+            if isinstance(child, ast.With):
+                # multi-item ``with a, b:`` acquires left to right: each
+                # item is already held when the next acquires, so the
+                # items order-edge (and relock-check) against each other
+                # exactly like lexically nested with-statements
+                cur = held
+                for lk in _with_locks(child):
+                    info.acquires.add(lk)
+                    for outer in cur:
+                        if outer == lk:
+                            info.relocks.append((lk, child))
+                        else:
+                            info.nested.append((outer, lk, child))
+                    cur = cur + (lk,)
+                walk(child, cur)
+                continue
+            if isinstance(child, ast.Call) and held:
+                info.calls_under_lock.append((held, child))
+                chain = call_name(child)
+                if chain is not None and chain.startswith("self.") \
+                        and chain.count(".") == 1:
+                    info.self_calls.append((held, chain.split(".", 1)[1],
+                                            child))
+            walk(child, held)
+
+    walk(fn, ())
+    return info
+
+
+def _is_blocking_call(call: ast.Call, held: Tuple[str, ...]):
+    """(True, why) when this call blocks under a held lock."""
+    chain = call_name(call)
+    if chain is None:
+        return False, ""
+    parts = chain.rsplit(".", 1)
+    recv = parts[0] if len(parts) == 2 else ""
+    last = parts[-1]
+    if chain == "time.sleep" or last == "sleep":
+        return True, "time.sleep"
+    if last in BLOCKING_ATTRS:
+        if last == "join" and (recv in SAFE_JOIN_RECEIVERS
+                               or recv.endswith("path")):
+            return False, ""
+        # str.join on a literal separator: ", ".join(...)
+        if last == "join" and isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Constant):
+            return False, ""
+        return True, f".{last}()"
+    if last == "wait":
+        # Condition.wait on a HELD lock releases it while waiting — the
+        # canonical pattern; waiting on anything else under a lock is
+        # a two-lock sleep
+        if recv in held:
+            return False, ""
+        if is_lock_expr(call.func.value if isinstance(call.func,
+                                                      ast.Attribute)
+                        else call.func) is not None:
+            return True, f"wait on {recv or chain} while holding a " \
+                         f"different lock"
+        return False, ""
+    if last in DISPATCH_CALLEES:
+        return True, f"engine dispatch via {chain}()"
+    if last == "get" and recv and ("queue" in recv.lower()
+                                   or recv.endswith("_q")):
+        return True, f"queue get on {recv}"
+    return False, ""
+
+
+class LockDisciplineChecker(Checker):
+    rule = "lock-discipline"
+    description = ("lock-order inversions, same-lock re-acquisition, and "
+                   "blocking calls under a held lock")
+
+    def check(self, unit: AnalysisUnit):
+        for sf in unit.files:
+            # per-class lock graph: class name -> {(outer, inner): site}
+            class_edges: Dict[str, Dict[Tuple[str, str],
+                                        Tuple[ast.AST, str]]] = {}
+            class_fn_info: Dict[str, Dict[str, _FunctionLockInfo]] = {}
+            fn_infos: List[Tuple[str, Optional[ast.ClassDef],
+                                 _FunctionLockInfo]] = []
+            for qual, fn, cls in iter_functions(sf.tree):
+                info = _scan_function(fn)
+                fn_infos.append((qual, cls, info))
+                if cls is not None:
+                    class_fn_info.setdefault(cls.name, {})[fn.name] = info
+
+            for qual, cls, info in fn_infos:
+                # ---- blocking under lock + same-lock re-acquisition
+                for held, call in info.calls_under_lock:
+                    blocking, why = _is_blocking_call(call, held)
+                    if blocking:
+                        yield unit.finding(
+                            sf, self.rule, call,
+                            f"blocking call ({why}) while holding "
+                            f"{' + '.join(held)} — fail futures/dispatch "
+                            f"outside the lock (see "
+                            f"AdmissionController.take)")
+                for lk, site in info.relocks:
+                    yield unit.finding(
+                        sf, self.rule, site,
+                        f"re-acquisition of non-reentrant {lk} while "
+                        f"already held — guaranteed deadlock")
+                # ---- lexical nesting edges
+                if cls is not None:
+                    edges = class_edges.setdefault(cls.name, {})
+                    for outer, inner, site in info.nested:
+                        edges.setdefault((outer, inner), (site, qual))
+
+            # ---- one-level call expansion within each class
+            for cname, fns in class_fn_info.items():
+                edges = class_edges.setdefault(cname, {})
+                for fname, info in fns.items():
+                    for held, callee, call in info.self_calls:
+                        target = fns.get(callee)
+                        if target is None:
+                            continue
+                        for outer in held:
+                            for inner in target.acquires:
+                                if inner == outer:
+                                    yield unit.finding(
+                                        sf, self.rule, call,
+                                        f"{cname}.{fname} holds {outer} "
+                                        f"and calls self.{callee}(), "
+                                        f"which re-acquires {inner} — "
+                                        f"non-reentrant deadlock")
+                                else:
+                                    edges.setdefault(
+                                        (outer, inner),
+                                        (call, f"{cname}.{fname} -> "
+                                               f"self.{callee}"))
+
+            # ---- cycles in each class's lock graph
+            for cname, edges in class_edges.items():
+                adj: Dict[str, Set[str]] = {}
+                for (a, b) in edges:
+                    adj.setdefault(a, set()).add(b)
+                for (a, b), (site, where) in sorted(
+                        edges.items(), key=lambda kv: (
+                            getattr(kv[1][0], "lineno", 0), kv[0])):
+                    if self._reaches(adj, b, a):
+                        yield unit.finding(
+                            sf, self.rule, site,
+                            f"lock-order inversion in {cname}: {a} -> {b} "
+                            f"({where}) closes a cycle with the reverse "
+                            f"ordering elsewhere — pick one global order")
+
+    @staticmethod
+    def _reaches(adj: Dict[str, Set[str]], src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(adj.get(n, ()))
+        return False

@@ -1,0 +1,143 @@
+"""``recompile-risk``: protect the bounded-compiled-signature invariant
+statically.
+
+The serving stack's core perf contract (PR 1/2, asserted dynamically by
+``compiled_signatures()`` tests): ALL executables an engine dispatches
+come from the ``models/`` factories, and every shape that reaches one
+is padded to the bucket ladder — so at most ``len(buckets)`` inference
+signatures and ``len(prefill_buckets) + 1`` generation signatures ever
+compile. Two ways new code breaks it:
+
+1. **A stray ``jax.jit``/``pjit`` callsite inside ``serving/``.** An
+   executable minted in the serving layer escapes the factory
+   conventions (donation, shardings, warmup, cache-size introspection)
+   and is one ``lambda`` capture away from a per-request signature.
+   Executables belong in ``models/`` factories; serving composes them.
+2. **Shape-varying arguments that bypass the ladder.** An array built
+   with a request-derived dimension (``prompt.size``, ``len(...)``,
+   ``x.shape[...]``) fed straight to an executable compiles one
+   signature per novel size. Every such construction must route the
+   dimension through a bucket helper (``_bucket_for`` /
+   ``bucket_ladder`` / ``prefill_buckets`` / ``blocks_for_tokens`` /
+   ``tile_rows`` or the ``self.buckets`` ladder itself) first.
+
+Rule 2 is scoped to functions that actually call an executable
+(``self._prefill`` / ``self._decode`` / ``self._run`` /
+``self._guarded_run`` / ``self._fwd`` / ``.infer``): array
+constructions elsewhere can't create signatures.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Set
+
+from tools.analysis.core import (
+    AnalysisUnit, Checker, attr_chain, call_name, iter_functions,
+    scoped_walk,
+)
+
+JIT_CALLEES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+#: directories whose files may mint executables (factory homes)
+FACTORY_DIRS = {"models", "nn", "ops", "autodiff", "parallel", "train"}
+EXECUTABLE_CALLEES = {"_prefill", "_decode", "_run", "_guarded_run",
+                      "_fwd", "infer"}
+ARRAY_CTORS = {"zeros", "empty", "ones", "full"}
+BUCKET_HELPERS = {"_bucket_for", "bucket_ladder", "prefill_buckets",
+                  "blocks_for_tokens", "tile_rows"}
+
+
+def _in_factory_dir(path: str) -> bool:
+    parts = set(os.path.normpath(path).split(os.sep))
+    return bool(parts & FACTORY_DIRS)
+
+
+def _shape_is_request_derived(call: ast.Call) -> bool:
+    """True when an array constructor's shape argument embeds a
+    request-derived dimension: ``.size``, ``len(...)``, or a
+    ``.shape[...]`` subscript."""
+    shape_args = list(call.args[:1]) + [
+        kw.value for kw in call.keywords if kw.arg == "shape"]
+    for arg in shape_args:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Attribute) and node.attr == "size":
+                return True
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id == "len":
+                return True
+            if isinstance(node, ast.Subscript):
+                chain = attr_chain(node.value)
+                if chain is not None and chain.endswith(".shape"):
+                    return True
+    return False
+
+
+class RecompileRiskChecker(Checker):
+    rule = "recompile-risk"
+    description = ("jax.jit/pjit callsites outside models/ factories, and "
+                   "request-shaped arguments bypassing the bucket ladder")
+
+    def check(self, unit: AnalysisUnit):
+        for sf in unit.files:
+            factory_file = _in_factory_dir(sf.path)
+            if not factory_file:
+                for node in ast.walk(sf.tree):
+                    if isinstance(node, ast.Call) \
+                            and (call_name(node) or "") in JIT_CALLEES:
+                        yield unit.finding(
+                            sf, self.rule, node,
+                            f"{call_name(node)}() callsite outside the "
+                            f"models/ factories — serving code composes "
+                            f"executables, it does not mint them; move "
+                            f"this into a make_* factory so donation/"
+                            f"sharding/warmup conventions (and the "
+                            f"len(buckets)+1 signature bound) hold")
+            for qual, fn, _cls in iter_functions(sf.tree):
+                yield from self._check_shapes(unit, sf, qual, fn)
+
+    def _check_shapes(self, unit, sf, qual, fn):
+        # constructions are collected PER SCOPE (nested defs are their
+        # own iter_functions entries — a plain walk would double-report
+        # them), but the executable/helper flags scan the whole subtree:
+        # a retry closure dispatching the executable makes its enclosing
+        # function's raw-shaped arrays just as dangerous
+        calls_executable = False
+        calls_helper = False
+        ctor_sites = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = call_name(node)
+            if chain is None:
+                continue
+            last = chain.rsplit(".", 1)[-1]
+            if last in EXECUTABLE_CALLEES:
+                calls_executable = True
+            if last in BUCKET_HELPERS:
+                calls_helper = True
+        for node in scoped_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = call_name(node)
+            if chain is None:
+                continue
+            if chain.rsplit(".", 1)[-1] in ARRAY_CTORS \
+                    and _shape_is_request_derived(node):
+                ctor_sites.append((node, chain))
+        # reading self.buckets counts as using the ladder (warmup iterates
+        # the rungs directly)
+        if not calls_helper:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) and node.attr == "buckets":
+                    calls_helper = True
+                    break
+        if not (calls_executable and ctor_sites) or calls_helper:
+            return
+        for node, chain in ctor_sites:
+            yield unit.finding(
+                sf, self.rule, node,
+                f"{chain}() builds an array with a request-derived "
+                f"dimension in {qual}, which also dispatches an "
+                f"executable, without routing through a bucket helper "
+                f"({'/'.join(sorted(BUCKET_HELPERS))}) — every novel "
+                f"size compiles a fresh signature")

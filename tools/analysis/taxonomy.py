@@ -1,0 +1,236 @@
+"""``taxonomy-drift``: the terminal-reason taxonomy is ONE vocabulary.
+
+``tracing.terminal_reason`` is the single exception->taxonomy mapping;
+``TERMINAL_REASONS`` is the canonical list that ``/api/slo`` error
+buckets, ``rejections_by_reason`` and trace terminals all share. PR 7
+added a one-off drift-guard test for its three new reasons; this
+checker generalizes it into a whole-package pass:
+
+1. ``TERMINAL_REASONS`` itself carries no duplicates.
+2. Every ``RejectedError`` subclass (transitively, across the analyzed
+   files) that passes a literal ``reason`` to ``super().__init__`` must
+   use a reason that appears EXACTLY once in ``TERMINAL_REASONS`` —
+   a new typed shed error that forgets to register its reason fails
+   the lint, by construction.
+3. Every literal reason string at a recording site —
+   ``record_rejection("x")``, ``record_outcome("x", ...)``,
+   ``_finish_request(trace, "x", ...)``, ``trace.finish("x", ...)``,
+   and direct ``RejectedError("msg", "x")`` construction — must be in
+   ``TERMINAL_REASONS``.
+4. Every subclass reason must be COUNTABLE by ``rejections_by_reason``:
+   either a literal ``record_rejection("<reason>")`` exists somewhere,
+   or the package routes typed sheds dynamically (a
+   ``record_rejection(<non-literal>)`` call — the shared
+   ``_reject_submit``/``_shed_typed`` helpers).
+5. ``BURN_REASONS`` (the SLO-burn governor's suffered-failure set) must
+   be a subset of ``TERMINAL_REASONS``.
+
+If no ``TERMINAL_REASONS`` assignment exists in the analyzed file set
+(e.g. a run scoped to ``models/`` only), the taxonomy checks are
+skipped — there is nothing to drift from.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analysis.core import (
+    AnalysisUnit, Checker, call_name, iter_functions, string_value,
+)
+
+RECORDING_CALLEES = {"record_rejection", "record_outcome"}
+#: callees whose arg INDEX 1 is the reason (arg 0 is the trace)
+TRACE_REASON_CALLEES = {"_finish_request"}
+
+
+def _collect_terminal_reasons(unit: AnalysisUnit):
+    """(source file, assignment node, [reason literals]) for the
+    TERMINAL_REASONS tuple, or None when absent."""
+    for sf in unit.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "TERMINAL_REASONS" not in names:
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                reasons = [string_value(e) for e in node.value.elts]
+                if all(r is not None for r in reasons):
+                    return sf, node, reasons
+    return None
+
+
+def _collect_burn_reasons(unit: AnalysisUnit):
+    for sf in unit.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "BURN_REASONS" not in names:
+                continue
+            literals = [string_value(n) for n in ast.walk(node.value)
+                        if isinstance(n, ast.Constant)]
+            return sf, node, [r for r in literals if r is not None]
+    return None
+
+
+def _rejected_subclasses(unit: AnalysisUnit) -> List[Tuple[object, ast.ClassDef]]:
+    """Every class transitively subclassing RejectedError across the
+    analyzed files (matched by name — the package imports it by name
+    everywhere)."""
+    classes: Dict[str, Tuple[object, ast.ClassDef]] = {}
+    bases: Dict[str, Set[str]] = {}
+    for sf in unit.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                classes[node.name] = (sf, node)
+                bases[node.name] = {
+                    b.id if isinstance(b, ast.Name) else b.attr
+                    for b in node.bases
+                    if isinstance(b, (ast.Name, ast.Attribute))}
+    rejected = {"RejectedError"}
+    changed = True
+    while changed:
+        changed = False
+        for name, bs in bases.items():
+            if name not in rejected and bs & rejected:
+                rejected.add(name)
+                changed = True
+    return [(sf, node) for name, (sf, node) in classes.items()
+            if name in rejected and name != "RejectedError"]
+
+
+def _subclass_reason(cls: ast.ClassDef) -> Optional[Tuple[str, ast.AST]]:
+    """The literal reason a subclass stamps in its __init__ via
+    ``super().__init__(msg, "reason")`` (positional or ``reason=``), or
+    None when it forwards a parameter / has no __init__."""
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                f = call.func
+                if not (isinstance(f, ast.Attribute)
+                        and f.attr == "__init__"
+                        and isinstance(f.value, ast.Call)
+                        and isinstance(f.value.func, ast.Name)
+                        and f.value.func.id == "super"):
+                    continue
+                if len(call.args) >= 2:
+                    s = string_value(call.args[1])
+                    if s is not None:
+                        return s, call
+                for kw in call.keywords:
+                    if kw.arg == "reason":
+                        s = string_value(kw.value)
+                        if s is not None:
+                            return s, call
+    return None
+
+
+class TaxonomyDriftChecker(Checker):
+    rule = "taxonomy-drift"
+    description = ("typed shed reasons must appear exactly once in "
+                   "tracing.TERMINAL_REASONS and be countable by "
+                   "rejections_by_reason")
+
+    def check(self, unit: AnalysisUnit):
+        found = _collect_terminal_reasons(unit)
+        if found is None:
+            return
+        tr_sf, tr_node, reasons = found
+        counts: Dict[str, int] = {}
+        for r in reasons:
+            counts[r] = counts.get(r, 0) + 1
+        for r, n in counts.items():
+            if n > 1:
+                yield unit.finding(
+                    tr_sf, self.rule, tr_node,
+                    f"TERMINAL_REASONS lists {r!r} {n} times — the "
+                    f"taxonomy must carry no duplicates")
+        known = set(counts)
+
+        # literal reasons at recording sites + raw RejectedError(...)
+        literal_counts: Set[str] = set()
+        has_dynamic_count = False
+        for sf in unit.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = call_name(node)
+                last = chain.rsplit(".", 1)[-1] if chain else ""
+                if last in RECORDING_CALLEES and node.args:
+                    s = string_value(node.args[0])
+                    if s is None:
+                        if last == "record_rejection":
+                            has_dynamic_count = True
+                    elif s not in known:
+                        yield unit.finding(
+                            sf, self.rule, node,
+                            f"{last}({s!r}) uses a reason missing from "
+                            f"TERMINAL_REASONS — register it there (and "
+                            f"in the SLO/trace vocabulary) or reuse an "
+                            f"existing reason")
+                    else:
+                        literal_counts.add(s)
+                elif last in TRACE_REASON_CALLEES and len(node.args) >= 2:
+                    s = string_value(node.args[1])
+                    if s is not None and s not in known:
+                        yield unit.finding(
+                            sf, self.rule, node,
+                            f"{last}(..., {s!r}) uses a reason missing "
+                            f"from TERMINAL_REASONS")
+                elif last == "finish" and chain and "trace" in chain.lower() \
+                        and node.args:
+                    s = string_value(node.args[0])
+                    if s is not None and s not in known:
+                        yield unit.finding(
+                            sf, self.rule, node,
+                            f"trace.finish({s!r}) uses a reason missing "
+                            f"from TERMINAL_REASONS")
+                elif last == "RejectedError" and len(node.args) >= 2:
+                    s = string_value(node.args[1])
+                    if s is not None and s not in known:
+                        yield unit.finding(
+                            sf, self.rule, node,
+                            f"RejectedError(..., {s!r}) uses a reason "
+                            f"missing from TERMINAL_REASONS")
+
+        # typed subclasses: registered exactly once + countable
+        for sf, cls in _rejected_subclasses(unit):
+            got = _subclass_reason(cls)
+            if got is None:
+                continue
+            reason, site = got
+            if reason not in known:
+                yield unit.finding(
+                    sf, self.rule, cls,
+                    f"{cls.name} sheds with reason {reason!r}, which is "
+                    f"not in tracing.TERMINAL_REASONS — every typed shed "
+                    f"must register its reason (see MIGRATING.md)")
+            elif counts[reason] != 1:
+                yield unit.finding(
+                    sf, self.rule, cls,
+                    f"{cls.name}'s reason {reason!r} appears "
+                    f"{counts[reason]} times in TERMINAL_REASONS")
+            if reason in known and not has_dynamic_count \
+                    and reason not in literal_counts:
+                yield unit.finding(
+                    sf, self.rule, cls,
+                    f"{cls.name}'s reason {reason!r} is never counted: "
+                    f"no record_rejection({reason!r}) literal and no "
+                    f"dynamic record_rejection(exc.reason) routing "
+                    f"exists — sheds of this type would vanish from "
+                    f"rejections_by_reason")
+
+        # BURN_REASONS ⊆ TERMINAL_REASONS
+        burn = _collect_burn_reasons(unit)
+        if burn is not None:
+            b_sf, b_node, b_reasons = burn
+            for r in b_reasons:
+                if r not in known:
+                    yield unit.finding(
+                        b_sf, self.rule, b_node,
+                        f"BURN_REASONS entry {r!r} is not in "
+                        f"TERMINAL_REASONS — the governor would count a "
+                        f"reason no terminal can ever produce")

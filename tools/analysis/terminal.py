@@ -1,0 +1,107 @@
+"""``terminal-exactly-once``: every request terminal must carry its
+accounting.
+
+PR 5's hardest review round established the invariant: a request's
+terminal (future resolved, handle finished/failed) must be delivered
+exactly once AND recorded exactly once — trace finish, SLO window
+outcome, rejection counters, per-tenant attribution — via
+``_finish_request`` / the admission hooks / ``_shed_typed``. A raw
+``future.set_result`` / ``set_exception`` / ``handle._fail`` /
+``handle._finish`` anywhere else is how a new code path silently drops
+out of ``/api/slo`` and ``rejections_by_reason``.
+
+The rule: a raw terminal call is a finding unless
+
+- it sits inside an allowlisted class — ``GenerationHandle`` (the
+  delivery primitive itself) or ``AdmissionController`` (whose
+  shed/close/cancel paths route accounting through the engine-installed
+  ``on_shed``/``on_close_reject``/``on_cancelled`` hooks); or
+- it sits in a function named in the allowlist (``_shed_typed``); or
+- the SAME function also calls an accounting entry point
+  (``_finish_request`` / ``_count_shed`` / ``_count_close_reject`` /
+  ``_count_cancelled`` / ``_finish_stream``) — the paired-delivery
+  shape every engine terminal uses.
+
+Deliberately-unaccounted futures (e.g. the shared-prefix registration
+rendezvous, which is not a request terminal) carry per-site
+``# analysis: ok terminal-exactly-once — why`` suppressions.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from tools.analysis.core import (
+    AnalysisUnit, Checker, attr_chain, call_name, iter_functions,
+    scoped_walk,
+)
+
+TERMINAL_ATTRS = {"set_result", "set_exception"}
+HANDLE_TERMINAL_ATTRS = {"_fail", "_finish"}
+ALLOWED_CLASSES = {"GenerationHandle", "AdmissionController"}
+ALLOWED_FUNCS = {"_shed_typed"}
+ACCOUNTING_CALLEES = {"_finish_request", "_count_shed",
+                      "_count_close_reject", "_count_cancelled",
+                      "_finish_stream"}
+
+
+def _is_terminal_call(node: ast.Call) -> Optional[str]:
+    """The terminal kind when this call delivers one, else None."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    if attr in TERMINAL_ATTRS:
+        return attr
+    if attr in HANDLE_TERMINAL_ATTRS:
+        recv = attr_chain(node.func.value) or ""
+        last = recv.rsplit(".", 1)[-1].lower()
+        if "handle" in last:
+            return f"handle.{attr}"
+    return None
+
+
+def _accounting_calls(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in scoped_walk(fn):
+        if isinstance(node, ast.Call):
+            chain = call_name(node)
+            if chain is None:
+                continue
+            last = chain.rsplit(".", 1)[-1]
+            if last in ACCOUNTING_CALLEES:
+                out.add(last)
+            elif last == "finish" and "trace" in chain.lower():
+                out.add("trace.finish")
+    return out
+
+
+class TerminalExactlyOnceChecker(Checker):
+    rule = "terminal-exactly-once"
+    description = ("raw future/handle terminals outside the allowlisted "
+                   "accounting paths")
+
+    def check(self, unit: AnalysisUnit):
+        for sf in unit.files:
+            for qual, fn, cls in iter_functions(sf.tree):
+                if cls is not None and cls.name in ALLOWED_CLASSES:
+                    continue
+                if fn.name in ALLOWED_FUNCS:
+                    continue
+                accounting = None   # computed lazily per function
+                for node in scoped_walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    kind = _is_terminal_call(node)
+                    if kind is None:
+                        continue
+                    if accounting is None:
+                        accounting = _accounting_calls(fn)
+                    if accounting:
+                        continue
+                    yield unit.finding(
+                        sf, self.rule, node,
+                        f"raw terminal {kind}() in {qual} with no "
+                        f"accounting call in the same function — route "
+                        f"through _finish_request/_shed_typed (or the "
+                        f"admission hooks) so the terminal reaches the "
+                        f"SLO windows, traces and rejections_by_reason")
