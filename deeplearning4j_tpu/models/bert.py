@@ -402,6 +402,23 @@ def batch_pspec(mesh: Mesh) -> P:
     return P(d, c)
 
 
+def make_infer_last_logits(cfg: TransformerConfig,
+                           mesh: Optional[Mesh] = None):
+    """Build the batching-engine inference executable: token ids (B, T)
+    -> last-position logits (B, vocab). ``CausalLMAdapter.infer``
+    (serving/registry.py) dispatches this for InferenceEngine traffic;
+    it is minted here — not in the serving layer — so every serving
+    executable comes from a models/ factory and inherits forward()'s
+    flash/packed-attention routing (the recompile-risk lint enforces
+    the boundary). One signature per (B, T) bucket the engine's padded
+    ladder produces."""
+
+    def last_logits(params, tokens):
+        return forward(params, tokens, cfg, mesh)[:, -1, :]
+
+    return jax.jit(last_logits)
+
+
 def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                     learning_rate: float = 1e-4, weight_decay: float = 0.01):
     """Build (init_state, step). step(params, opt_state, batch) -> (params,

@@ -149,12 +149,11 @@ class CausalLMAdapter(ModelAdapter):
     def infer(self, x) -> np.ndarray:
         """Token ids (B, T) -> last-position logits (B, vocab)."""
         if self._fwd is None:
-            import jax
+            # minted by the models/ factory, not here: serving code
+            # composes executables (recompile-risk lint)
+            from deeplearning4j_tpu.models.bert import make_infer_last_logits
 
-            from deeplearning4j_tpu.models.bert import forward
-
-            self._fwd = jax.jit(
-                lambda p, t: forward(p, t, self.cfg, self.mesh)[:, -1, :])
+            self._fwd = make_infer_last_logits(self.cfg, self.mesh)
         return np.asarray(self._fwd(self.params,
                                     np.asarray(x, dtype=np.int32)))
 
