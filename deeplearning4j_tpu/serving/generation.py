@@ -379,6 +379,9 @@ class GenerationEngine(ResilientEngineMixin):
             if fut is None:
                 continue
             try:
+                # analysis: ok terminal-exactly-once — prefix rendezvous
+                # future (register_prefix blocks on it), not a request
+                # terminal: no SLO/trace/tenant accounting applies
                 fut.set_exception(RejectedError(
                     "engine shut down before the prefix was prefilled",
                     "shutdown"))
