@@ -158,6 +158,15 @@ class QosPolicy:
       ``slo_shed_classes`` traffic (default: batch only) sheds typed
       ``slo_shed`` until the window clears. ``slo_min_samples`` keeps a
       near-empty window from tripping the governor on one bad request.
+    - ``slo_clear_error_rate`` / ``slo_clear_p99_ms``: HYSTERESIS — the
+      governor trips at the shed threshold but only clears once the
+      signal falls below the (lower) clear threshold. A window hovering
+      around one shared threshold would otherwise flap the batch class
+      shed/admit every ``slo_check_interval_s`` (each admit pulse feeds
+      new outcomes that push the rate back over, each shed pulse lets
+      it decay back under). ``None`` (the default) clears at the trip
+      threshold — the pre-hysteresis behavior. A clear threshold
+      requires its trip threshold and must not exceed it.
     - ``clock`` feeds the quota buckets (fake-clock testable).
     """
 
@@ -166,6 +175,8 @@ class QosPolicy:
                  default_priority: str = "interactive",
                  slo_shed_error_rate: Optional[float] = None,
                  slo_shed_p99_ms: Optional[float] = None,
+                 slo_clear_error_rate: Optional[float] = None,
+                 slo_clear_p99_ms: Optional[float] = None,
                  slo_window: str = "10s",
                  slo_min_samples: int = 20,
                  slo_shed_classes: Tuple[str, ...] = ("batch",),
@@ -182,6 +193,29 @@ class QosPolicy:
             raise ValueError("slo_shed_error_rate must be in (0, 1]")
         if slo_shed_p99_ms is not None and slo_shed_p99_ms <= 0:
             raise ValueError("slo_shed_p99_ms must be positive")
+        if slo_clear_error_rate is not None:
+            if slo_shed_error_rate is None:
+                raise ValueError(
+                    "slo_clear_error_rate needs slo_shed_error_rate (a "
+                    "clear threshold without a trip threshold can never "
+                    "apply)")
+            if not (0.0 < slo_clear_error_rate <= slo_shed_error_rate):
+                raise ValueError(
+                    f"slo_clear_error_rate must be in (0, "
+                    f"slo_shed_error_rate={slo_shed_error_rate:g}] — a "
+                    f"clear threshold above the trip threshold would "
+                    f"un-shed while still tripping (got "
+                    f"{slo_clear_error_rate})")
+        if slo_clear_p99_ms is not None:
+            if slo_shed_p99_ms is None:
+                raise ValueError(
+                    "slo_clear_p99_ms needs slo_shed_p99_ms (a clear "
+                    "threshold without a trip threshold can never apply)")
+            if not (0.0 < slo_clear_p99_ms <= slo_shed_p99_ms):
+                raise ValueError(
+                    f"slo_clear_p99_ms must be in (0, "
+                    f"slo_shed_p99_ms={slo_shed_p99_ms:g}] (got "
+                    f"{slo_clear_p99_ms})")
         if slo_min_samples < 1:
             raise ValueError("slo_min_samples must be >= 1 (a near-empty "
                              "window must not trip batch-wide shedding)")
@@ -207,6 +241,8 @@ class QosPolicy:
         self.default_priority = default_priority
         self.slo_shed_error_rate = slo_shed_error_rate
         self.slo_shed_p99_ms = slo_shed_p99_ms
+        self.slo_clear_error_rate = slo_clear_error_rate
+        self.slo_clear_p99_ms = slo_clear_p99_ms
         self.slo_window = slo_window
         self.slo_min_samples = int(slo_min_samples)
         self.slo_shed_classes = tuple(slo_shed_classes)
@@ -259,6 +295,8 @@ class QosPolicy:
             "default_priority": self.default_priority,
             "slo_shed_error_rate": self.slo_shed_error_rate,
             "slo_shed_p99_ms": self.slo_shed_p99_ms,
+            "slo_clear_error_rate": self.slo_clear_error_rate,
+            "slo_clear_p99_ms": self.slo_clear_p99_ms,
             "slo_window": self.slo_window,
             "slo_shed_classes": list(self.slo_shed_classes),
         }
@@ -554,7 +592,19 @@ class SloBurnGovernor:
     for ``slo_check_interval_s`` (default 100 ms) — the submit hot path
     pays a clock read and a tuple compare. The cached verdict also lands
     in the ``slo_burn_active`` metrics gauge so /api/qos shows whether
-    the governor is currently shedding."""
+    the governor is currently shedding.
+
+    Hysteresis (``slo_clear_error_rate`` / ``slo_clear_p99_ms``): the
+    governor TRIPS at the shed thresholds but, once burning, only
+    CLEARS when the signal falls below its clear threshold — a window
+    hovering at the trip point holds steady instead of oscillating
+    ``slo_shed`` on/off each check interval. Hysteresis is PER SIGNAL:
+    each signal's clear threshold applies only while that signal itself
+    is holding a burn — otherwise a transient p99 trip would swap the
+    error rate onto ITS (lower) clear threshold and a steady error rate
+    the operator configured as acceptable could latch the governor
+    burning forever. Unset clear thresholds fall back to the trip
+    thresholds (no hysteresis, the pre-4c behavior)."""
 
     def __init__(self, policy: QosPolicy, metrics):
         self.policy = policy
@@ -575,6 +625,9 @@ class SloBurnGovernor:
         self._checked_at = float("-inf")
         self._burning = False
         self._detail = ""
+        # per-signal hold state (hysteresis): which signal is burning
+        self._err_burning = False
+        self._p99_burning = False
 
     def burning(self) -> Tuple[bool, str]:
         if not self.enabled:
@@ -584,16 +637,27 @@ class SloBurnGovernor:
             if now - self._checked_at < self.policy.slo_check_interval_s:
                 return self._burning, self._detail
             self._checked_at = now
-        burning, detail = self._evaluate()
+            was = (self._err_burning, self._p99_burning)
+        err_b, p99_b, detail = self._evaluate(was)
+        burning = err_b or p99_b
         with self._lock:
+            self._err_burning, self._p99_burning = err_b, p99_b
             self._burning, self._detail = burning, detail
         self.metrics.slo_burn_active.set(1.0 if burning else 0.0)
         return burning, detail
 
-    def _evaluate(self) -> Tuple[bool, str]:
+    def _evaluate(self, was: Tuple[bool, bool] = (False, False)
+                  ) -> Tuple[bool, bool, str]:
+        """(error-rate burning, p99 burning, detail). ``was`` is the
+        previous per-signal hold state and selects which threshold each
+        signal is judged against: its trip threshold when idle, its
+        clear threshold (hysteresis — defaulting to the trip value when
+        unset) while IT is holding a burn. Per-signal on purpose: one
+        signal's trip must never lower the other's bar, or a steady
+        sub-trip signal would latch the governor shut forever."""
         win = self.metrics.slo_windows.get(self.policy.slo_window)
         if win is None:
-            return False, ""
+            return False, False, ""
         s = win.stats()
         burn_errors = sum(n for r, n in s["errors_by_reason"].items()
                           if r in BURN_REASONS)
@@ -604,18 +668,36 @@ class SloBurnGovernor:
         # 950 quota sheds is a 100%-failing dispatch path, not a 5% one
         eligible = s["ok"] + burn_errors
         if eligible < self.policy.slo_min_samples:
-            return False, ""
+            return False, False, ""
+        details = []
+        err_b = p99_b = False
         rate = burn_errors / eligible
-        thr = self.policy.slo_shed_error_rate
-        if thr is not None and rate >= thr:
-            return True, (f"burn error rate {rate:.3f} >= {thr:g} over the "
-                          f"{self.policy.slo_window} window "
-                          f"({burn_errors}/{eligible} burn-eligible)")
-        p99 = self.policy.slo_shed_p99_ms
-        if p99 is not None and s["ok"] > 0 and s["p99_ms"] >= p99:
-            return True, (f"p99 {s['p99_ms']:.1f} ms >= {p99:g} ms over "
-                          f"the {self.policy.slo_window} window")
-        return False, ""
+        trip = self.policy.slo_shed_error_rate
+        if trip is not None:
+            thr = self.policy.slo_clear_error_rate \
+                if was[0] and self.policy.slo_clear_error_rate is not None \
+                else trip
+            if rate >= thr:
+                err_b = True
+                kind = "clear threshold (hysteresis)" if thr != trip \
+                    else "threshold"
+                details.append(
+                    f"burn error rate {rate:.3f} >= {kind} {thr:g} over "
+                    f"the {self.policy.slo_window} window "
+                    f"({burn_errors}/{eligible} burn-eligible)")
+        trip = self.policy.slo_shed_p99_ms
+        if trip is not None and s["ok"] > 0:
+            thr = self.policy.slo_clear_p99_ms \
+                if was[1] and self.policy.slo_clear_p99_ms is not None \
+                else trip
+            if s["p99_ms"] >= thr:
+                p99_b = True
+                kind = "clear threshold (hysteresis)" if thr != trip \
+                    else "threshold"
+                details.append(
+                    f"p99 {s['p99_ms']:.1f} ms >= {kind} {thr:g} ms over "
+                    f"the {self.policy.slo_window} window")
+        return err_b, p99_b, "; ".join(details)
 
     def gate(self, priority: str) -> Optional[SloShedError]:
         """The submit-time check: returns the typed error to shed with

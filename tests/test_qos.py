@@ -669,6 +669,152 @@ class TestSloShed:
         assert gov.gate("batch") is not None
         assert gov.gate("interactive") is None
 
+    # ---------------------------------------- hysteresis (ROADMAP 4c)
+    @staticmethod
+    def _governor(**pol_kw):
+        pol = QosPolicy(slo_window="10s", slo_min_samples=5,
+                        slo_check_interval_s=0.0, **pol_kw)
+        m = ServingMetrics()
+        fake = [0.0]
+        m.slo_windows["10s"] = SlidingWindowStats(
+            window_s=10.0, clock=lambda: fake[0])
+        return SloBurnGovernor(pol, m), m, fake
+
+    def _drive_rate(self, m, fake, errors, oks):
+        """Roll the old window out, then load exactly errors/oks."""
+        fake[0] += 20.0
+        for _ in range(errors):
+            m.record_outcome("model_error")
+        for _ in range(oks):
+            m.record_outcome("ok", latency_ms=1.0)
+
+    def test_hysteresis_holds_between_clear_and_trip(self):
+        """ROADMAP 4c: distinct trip/clear thresholds. Tripped at 0.5,
+        the governor must HOLD while the rate hovers in (clear, trip) —
+        and once cleared below 0.2, the same mid-band rate must NOT
+        re-trip."""
+        gov, m, fake = self._governor(slo_shed_error_rate=0.5,
+                                      slo_clear_error_rate=0.2)
+        self._drive_rate(m, fake, errors=6, oks=4)      # rate 0.6: trip
+        assert gov.burning()[0]
+        self._drive_rate(m, fake, errors=4, oks=6)      # rate 0.4: hold
+        burning, detail = gov.burning()
+        assert burning and "hysteresis" in detail
+        assert gov.gate("batch") is not None
+        self._drive_rate(m, fake, errors=1, oks=9)      # rate 0.1: clear
+        assert not gov.burning()[0]
+        self._drive_rate(m, fake, errors=4, oks=6)      # 0.4 < trip: stay
+        assert not gov.burning()[0]
+        assert gov.gate("batch") is None
+
+    def test_flappy_window_does_not_oscillate_slo_shed(self):
+        """The flap regression: a window oscillating around the trip
+        point must produce ONE shed episode under hysteresis — and the
+        same series flaps without it (so this test cannot pass
+        vacuously)."""
+        series = [(6, 4), (4, 6), (6, 4), (4, 6), (4, 6)]  # 0.6/0.4/...
+
+        def episodes(gov, m, fake):
+            states, prev = [], False
+            for errors, oks in series:
+                self._drive_rate(m, fake, errors, oks)
+                b = gov.burning()[0]
+                if b != prev:
+                    states.append(b)
+                prev = b
+            return states
+
+        gov, m, fake = self._governor(slo_shed_error_rate=0.5,
+                                      slo_clear_error_rate=0.2)
+        assert episodes(gov, m, fake) == [True]          # trips once, holds
+        gov2, m2, fake2 = self._governor(slo_shed_error_rate=0.5)
+        assert len(episodes(gov2, m2, fake2)) >= 3       # pre-4c: flaps
+
+    def test_hysteresis_p99(self):
+        gov, m, fake = self._governor(slo_shed_p99_ms=100.0,
+                                      slo_clear_p99_ms=50.0)
+
+        def drive_p99(ms):
+            fake[0] += 20.0
+            for _ in range(6):
+                m.record_outcome("ok", latency_ms=ms)
+
+        drive_p99(120.0)
+        assert gov.burning()[0]                          # trip at 120
+        drive_p99(70.0)
+        assert gov.burning()[0]                          # hold: 70 >= 50
+        drive_p99(40.0)
+        assert not gov.burning()[0]                      # clear below 50
+        drive_p99(70.0)
+        assert not gov.burning()[0]                      # 70 < trip: stay
+
+    def test_hysteresis_end_to_end_shed(self):
+        """Engine-level: batch submits keep shedding typed slo_shed
+        through the mid-band hold, and flow again after the clear."""
+        eng, fake = _burning_engine(slo_clear_error_rate=0.2)
+        with eng:
+            for _ in range(6):
+                eng.metrics.record_outcome("model_error")
+            for _ in range(4):
+                eng.metrics.record_outcome("ok", latency_ms=1.0)
+            with pytest.raises(SloShedError):            # 0.6: trip
+                eng.submit(row(), priority="batch")
+            fake[0] += 20.0
+            for _ in range(4):
+                eng.metrics.record_outcome("model_error")
+            for _ in range(6):
+                eng.metrics.record_outcome("ok", latency_ms=1.0)
+            with pytest.raises(SloShedError) as ei:      # 0.4: hold
+                eng.submit(row(), priority="batch")
+            assert "hysteresis" in ei.value.detail
+            fake[0] += 20.0                              # window forgets
+            eng.submit(row(), priority="batch").result(timeout=60)
+            assert eng.metrics.slo_burn_active.value == 0.0
+
+    def test_hysteresis_is_per_signal_no_cross_latch(self):
+        """Review regression: hysteresis must be PER SIGNAL. A transient
+        p99 trip must not swap the error rate onto its (lower) clear
+        threshold — a steady error rate the operator configured as
+        acceptable (below its own trip) would latch the governor
+        burning forever after the p99 fully recovered."""
+        gov, m, fake = self._governor(slo_shed_error_rate=0.5,
+                                      slo_clear_error_rate=0.2,
+                                      slo_shed_p99_ms=100.0)
+
+        def drive(err, ok_ms):
+            fake[0] += 20.0
+            for _ in range(err):
+                m.record_outcome("model_error")
+            for _ in range(10 - err):
+                m.record_outcome("ok", latency_ms=ok_ms)
+
+        drive(3, 150.0)      # err rate 0.3 < trip; p99 150 trips
+        assert gov.burning()[0]
+        drive(3, 1.0)        # p99 recovered; err rate STILL 0.3
+        burning, detail = gov.burning()
+        assert not burning, (
+            f"steady 0.3 error rate (below its 0.5 trip) latched the "
+            f"governor via the p99 trip: {detail}")
+        # and the error signal's own hysteresis still works alone
+        drive(6, 1.0)        # 0.6: err trips
+        assert gov.burning()[0]
+        drive(3, 1.0)        # 0.3 in (clear, trip): holds
+        assert gov.burning()[0]
+        drive(1, 1.0)        # 0.1 < clear: clears
+        assert not gov.burning()[0]
+
+    def test_hysteresis_validation(self):
+        with pytest.raises(ValueError, match="slo_clear_error_rate"):
+            QosPolicy(slo_shed_error_rate=0.5, slo_clear_error_rate=0.6)
+        with pytest.raises(ValueError, match="slo_clear_error_rate"):
+            QosPolicy(slo_clear_error_rate=0.2)   # clear without trip
+        with pytest.raises(ValueError, match="slo_clear_p99_ms"):
+            QosPolicy(slo_shed_p99_ms=50.0, slo_clear_p99_ms=80.0)
+        with pytest.raises(ValueError, match="slo_clear_p99_ms"):
+            QosPolicy(slo_clear_p99_ms=10.0)
+        pol = QosPolicy(slo_shed_error_rate=0.5, slo_clear_error_rate=0.5)
+        assert pol.to_dict()["slo_clear_error_rate"] == 0.5
+
 
 # --------------------------------------------------------------------------
 # Retry budgets (Google SRE)
