@@ -148,74 +148,25 @@ def decode_leg(on_tpu: bool) -> dict:
         slots, max_len, n_requests, max_new = 4, 64, 8, 12
 
     params = init_params(jax.random.PRNGKey(0), cfg)
-    rng = np.random.default_rng(0)
     with GenerationEngine(params, cfg, slots=slots, max_len=max_len,
                           queue_capacity=n_requests + slots) as eng:
-        eng.warmup()
-        # fresh metrics past this point: warmup's samples include the
-        # one-time XLA compiles (decode wall, TTFT, step histograms), which
-        # would swamp the steady-state numbers this leg exists to report —
-        # the engine is idle here, so the swap cannot race a live stream
-        from deeplearning4j_tpu.serving import ServingMetrics
-        eng.metrics = ServingMetrics()
-        eng.metrics.kv_blocks_total.set(eng._allocator.capacity)
-        handles = []
-        t0 = time.perf_counter()
-        for i in range(n_requests):
-            # chat-shaped mix: prompts well under max_len (mean seq ≈
-            # max_len/4 with the generation budget) — the regime where
-            # block-granular storage beats worst-case reservation
-            n = int(rng.integers(4, max_len // 4))
-            handles.append(eng.submit(
-                rng.integers(0, cfg.vocab_size, n).astype(np.int32),
-                max_new_tokens=max_new))
-        # steady-state samples: poll the gauges while the backlog drains
-        # (sampling at submit time would race the scheduler's admissions).
-        # First sample unconditionally: on a device fast enough to drain
-        # the backlog before the first 5 ms poll, the loop body would
-        # never run and the capacity metrics would be built from nothing.
-        occ_samples, blk_samples = [], []
-        while True:
-            occ_samples.append(eng.metrics.slot_occupancy.value)
-            blk_samples.append(eng.metrics.kv_blocks_in_use.value)
-            if handles[-1].future.done():
-                break
-            time.sleep(0.005)
-        for h in handles:
-            h.result(timeout=600)
-        wall_s = time.perf_counter() - t0
-        m = eng.metrics
-        occ = float(np.median(occ_samples))
-        blocks_in_use = float(np.median(blk_samples))
+        stats, paged_stream_bytes = _run_decode_mix(eng, cfg, n_requests,
+                                                    max_new)
+        from deeplearning4j_tpu.serving import kv_bytes_per_token
         itemsize = jnp.dtype(cfg.dtype).itemsize
-        kv_unit = cfg.layers * 2 * cfg.heads * cfg.head_dim * itemsize
-        block_bytes = eng.block_size * kv_unit
-        contig_stream_bytes = max_len * kv_unit
-        resident = occ * slots
-        # unmeasured (all samples post-drain) reports None, not a 0-byte
-        # stream or an absurd streams-at-budget figure
-        measured = blocks_in_use > 0 and resident > 0
-        paged_stream_bytes = (blocks_in_use * block_bytes / resident
-                              if measured else None)
+        contig_stream_bytes = max_len * kv_bytes_per_token(
+            cfg.layers, cfg.heads, cfg.head_dim, "float32", itemsize)
+        measured = paged_stream_bytes is not None
         return {
-            "decode_tokens_per_sec": round(m.decode_tokens_per_sec(), 2),
-            "end_to_end_tokens_per_sec": round(
-                n_requests * max_new / wall_s, 2),
-            "ttft_ms_p50": round(m.ttft_ms.quantile(0.5), 3),
-            "decode_step_ms_p50": round(m.decode_step_ms.quantile(0.5), 3),
-            "steady_state_slot_occupancy": round(occ, 3),
+            **stats,
             "slots": slots,
             "requests": n_requests,
             "max_new_tokens": max_new,
-            "compiled_signatures": eng.compiled_signatures(),
-            "signature_bound": len(eng.buckets) + 1,
             "block_size": eng.block_size,
             "kv_blocks_total": eng._allocator.capacity,
-            "steady_state_blocks_in_use": round(blocks_in_use, 1),
             "steady_state_block_utilization": round(
-                blocks_in_use / eng._allocator.capacity, 4),
-            "kv_hbm_bytes_per_resident_stream":
-                round(paged_stream_bytes) if measured else None,
+                stats["steady_state_blocks_in_use"]
+                / eng._allocator.capacity, 4),
             "kv_bytes_per_stream_contiguous": contig_stream_bytes,
             "kv_bytes_per_stream_ratio": round(
                 paged_stream_bytes / contig_stream_bytes, 4)
@@ -223,8 +174,134 @@ def decode_leg(on_tpu: bool) -> dict:
             "resident_streams_at_contiguous_budget": int(
                 slots * contig_stream_bytes // paged_stream_bytes)
                 if measured else None,
+            "paged_grid": paged_decode_grid(on_tpu),
             "shared_prefix": shared_prefix_scenario(on_tpu),
         }
+
+
+def _run_decode_mix(eng, cfg, n_requests: int, max_new: int):
+    """THE decode measurement harness, shared by :func:`decode_leg` and
+    every :func:`paged_decode_grid` cell so the two can never drift:
+    warm the engine, reset metrics (warmup's samples include the
+    one-time XLA compiles, which would swamp the steady-state numbers —
+    the engine is idle here, so the swap cannot race a live stream),
+    submit the seeded chat-shaped mix (prompts well under max_len: the
+    regime where block-granular storage beats worst-case reservation),
+    sample the occupancy/block gauges while the backlog drains (sampling
+    at submit time would race the scheduler's admissions; first sample
+    unconditional — on a device fast enough to drain before the first
+    5 ms poll the loop body would never run and the capacity numbers
+    would be built from nothing), and join every stream.
+
+    Returns ``(stats, stream_bytes)`` — the common steady-state dict
+    plus HBM bytes per resident stream, ``None`` when unmeasured (all
+    samples post-drain): better no number than a 0-byte stream or an
+    absurd streams-at-budget figure."""
+    from deeplearning4j_tpu.serving import ServingMetrics
+
+    eng.warmup()
+    eng.metrics = ServingMetrics()
+    eng.metrics.kv_blocks_total.set(eng._allocator.capacity)
+    rng = np.random.default_rng(0)       # same mix for every caller
+    t0 = time.perf_counter()
+    handles = []
+    for _ in range(n_requests):
+        n = int(rng.integers(4, max(5, eng.max_len // 4)))
+        handles.append(eng.submit(
+            rng.integers(0, cfg.vocab_size, n).astype(np.int32),
+            max_new_tokens=max_new))
+    occ_samples, blk_samples = [], []
+    while True:
+        occ_samples.append(eng.metrics.slot_occupancy.value)
+        blk_samples.append(eng.metrics.kv_blocks_in_use.value)
+        if handles[-1].future.done():
+            break
+        time.sleep(0.005)
+    for h in handles:
+        h.result(timeout=600)
+    wall_s = time.perf_counter() - t0
+    m = eng.metrics
+    occ = float(np.median(occ_samples))
+    blocks_in_use = float(np.median(blk_samples))
+    resident = occ * eng.slots
+    measured = blocks_in_use > 0 and resident > 0
+    stream_bytes = (blocks_in_use * eng.kv_block_bytes / resident
+                    if measured else None)
+    stats = {
+        "decode_tokens_per_sec": round(m.decode_tokens_per_sec(), 2),
+        "end_to_end_tokens_per_sec": round(
+            n_requests * max_new / wall_s, 2),
+        "ttft_ms_p50": round(m.ttft_ms.quantile(0.5), 3),
+        "decode_step_ms_p50": round(m.decode_step_ms.quantile(0.5), 3),
+        "steady_state_slot_occupancy": round(occ, 3),
+        "compiled_signatures": eng.compiled_signatures(),
+        "signature_bound": len(eng.buckets) + 1,
+        "steady_state_blocks_in_use": round(blocks_in_use, 1),
+        "kv_hbm_bytes_per_resident_stream":
+            round(stream_bytes) if measured else None,
+    }
+    return stats, stream_bytes
+
+
+def paged_decode_grid(on_tpu: bool) -> dict:
+    """The decode hot-path grid (ROADMAP 1b/1c + 3b/3c): the SAME
+    staggered prompt mix through {gather, fused} attention x {float32,
+    int8} KV storage. ``gather`` materializes pool[tables] in HBM every
+    step (the PR 6 route); ``fused`` streams blocks through VMEM via the
+    Pallas paged-attention kernel, never building the (slots, L) view.
+    int8 quantizes on write / dequantizes in the read, shrinking the
+    per-stream KV footprint — ``resident_streams_at_contiguous_budget``
+    is the capacity headline: how many streams fit the contiguous
+    full-precision layout's HBM budget *in the model's cache dtype*
+    (the int8 cells compound the dtype ratio — ~3.8x vs fp32 storage,
+    ~1.9x vs bf16 — with block granularity, which is how the >=2x ISSUE
+    acceptance gate clears under either storage dtype). Tokens/sec and
+    TTFT p50 are reported at the fixed occupancy the shared mix
+    produces, so the four cells are directly comparable."""
+    from deeplearning4j_tpu.models import TransformerConfig, init_params
+    from deeplearning4j_tpu.serving import (
+        GenerationEngine, kv_bytes_per_token)
+
+    if on_tpu:
+        cfg = TransformerConfig(causal=True, remat=False,
+                                attention_impl="flash")
+        slots, max_len, n_requests, max_new = 16, 512, 32, 64
+    else:                                   # CPU smoke (driver runs TPU)
+        cfg = TransformerConfig(vocab_size=1024, hidden=128, layers=2,
+                                heads=4, mlp_dim=512, max_seq=128,
+                                dtype=jnp.float32, causal=True, remat=False)
+        slots, max_len, n_requests, max_new = 2, 64, 4, 6
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    contig_stream_bytes = max_len * kv_bytes_per_token(
+        cfg.layers, cfg.heads, cfg.head_dim, "float32", itemsize)
+
+    def cell(kv_dtype: str, paged_attention: str) -> dict:
+        with GenerationEngine(params, cfg, slots=slots, max_len=max_len,
+                              kv_dtype=kv_dtype,
+                              paged_attention=paged_attention,
+                              queue_capacity=n_requests + slots) as eng:
+            stats, stream_bytes = _run_decode_mix(eng, cfg, n_requests,
+                                                  max_new)
+            return {
+                "kv_dtype": kv_dtype,
+                "paged_attention": paged_attention,
+                **stats,
+                "kv_block_bytes": eng.kv_block_bytes,
+                "resident_streams_at_contiguous_budget": int(
+                    slots * contig_stream_bytes // stream_bytes)
+                    if stream_bytes is not None else None,
+            }
+
+    grid = [cell(kv, pa) for kv in ("float32", "int8")
+            for pa in ("gather", "fused")]
+    return {
+        "slots": slots, "max_len": max_len, "requests": n_requests,
+        "max_new_tokens": max_new,
+        "kv_bytes_per_stream_contiguous_fp": contig_stream_bytes,
+        "cells": grid,
+    }
 
 
 def shared_prefix_scenario(on_tpu: bool) -> dict:

@@ -529,9 +529,45 @@ def validate_block_size(block_size, max_len: int) -> int:
     return int(block_size)
 
 
+KV_DTYPES = ("float32", "int8")
+
+
+def validate_kv_dtype(kv_dtype: str, block_size) -> str:
+    """Validate the KV storage mode. ``"float32"`` keeps full-precision
+    storage in the cache ``dtype`` (the pre-int8 behavior, bitwise);
+    ``"int8"`` stores quantized values + per-token-per-head fp32 scales
+    and requires the paged (block-pool) layout — the scales are
+    block-shaped tensors and the dequant lives in the block read. THE
+    single predicate, shared by :func:`init_kv_cache` and the serving
+    engine's constructor."""
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
+    if kv_dtype == "int8" and block_size is None:
+        raise ValueError(
+            "kv_dtype='int8' requires the paged KV cache (pass "
+            "block_size): the per-block scale tensors and on-read "
+            "dequant are block-pool concepts")
+    return kv_dtype
+
+
+def quantize_kv(x):
+    """Symmetric per-token-per-head int8 quantization of a K/V tensor
+    whose trailing axis is head_dim: returns (int8 values, fp32 scales)
+    with ``x ~= values * scales[..., None]``. Per-token scales mean a
+    decode-step write touches only its own scale entry — no block
+    requantization ever happens."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
 def init_kv_cache(cfg: TransformerConfig, slots: int, max_len: int,
                   dtype: Any = None, block_size: Optional[int] = None,
-                  num_blocks: Optional[int] = None) -> Dict[str, Any]:
+                  num_blocks: Optional[int] = None,
+                  kv_dtype: str = "float32") -> Dict[str, Any]:
     """Allocate the generation cache. ``dtype`` defaults to the compute
     dtype (bf16 on TPU) — the cache is read every decode step, so halving
     it halves decode's dominant HBM stream.
@@ -553,6 +589,17 @@ def init_kv_cache(cfg: TransformerConfig, slots: int, max_len: int,
       ``num_blocks`` defaults to the contiguous layout's capacity
       (``slots * ceil(max_len / B)``) plus the scratch block; pass a
       smaller pool to trade worst-case headroom for resident streams.
+
+    ``kv_dtype="int8"`` (paged only) stores the pool quantized —
+    ``{"k","v"}`` int8 plus ``{"k_scale","v_scale"}: (num_blocks, B,
+    heads)`` fp32 per-token-per-head scales — roughly quartering the
+    dominant HBM stream vs fp32 storage (head_dim bytes + 4 scale bytes
+    per head-token instead of 4*head_dim) and so multiplying resident
+    streams at a fixed budget. Quantization happens on write (prefill
+    scatter + decode writeback, :func:`quantize_kv`), dequantization on
+    read (the block gather, or fused into the paged-attention kernel).
+    The default ``"float32"`` keeps full-precision storage in ``dtype``
+    — the bitwise pre-int8 layout.
     """
     if max_len > cfg.max_seq:
         raise ValueError(
@@ -562,6 +609,7 @@ def init_kv_cache(cfg: TransformerConfig, slots: int, max_len: int,
         raise ValueError(f"slots must be positive, got {slots}")
     if max_len <= 0:
         raise ValueError(f"max_len must be positive, got {max_len}")
+    validate_kv_dtype(kv_dtype, block_size)
     dt = cfg.dtype if dtype is None else dtype
     if block_size is None:
         if num_blocks is not None:
@@ -583,6 +631,15 @@ def init_kv_cache(cfg: TransformerConfig, slots: int, max_len: int,
             f"num_blocks must be >= 2 (block 0 is the reserved scratch "
             f"block), got {num_blocks}")
     shape = (num_blocks, block_size, cfg.heads, cfg.head_dim)
+    if kv_dtype == "int8":
+        sshape = (num_blocks, block_size, cfg.heads)
+        return {
+            "layers": [{"k": jnp.zeros(shape, jnp.int8),
+                        "v": jnp.zeros(shape, jnp.int8),
+                        "k_scale": jnp.zeros(sshape, jnp.float32),
+                        "v_scale": jnp.zeros(sshape, jnp.float32)}
+                       for _ in range(cfg.layers)],
+        }
     return {
         "layers": [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
                    for _ in range(cfg.layers)],
@@ -602,21 +659,31 @@ def kv_cache_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
     }
 
 
-def paged_kv_cache_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
+def paged_kv_cache_pspecs(cfg: TransformerConfig,
+                          kv_dtype: str = "float32") -> Dict[str, Any]:
     """PartitionSpecs for the paged block pool: heads over 'model' (the
     same column-parallel qkv alignment as the contiguous cache), blocks
     and in-block positions replicated — the block table is a host-side
     gather index over the (replicated) block axis, so paging adds zero
-    collectives under a dp/tp mesh."""
+    collectives under a dp/tp mesh. int8 pools carry per-token-per-head
+    scale tensors whose heads axis shards identically."""
     kv = P(None, None, MODEL_AXIS, None)
-    return {"layers": [{"k": kv, "v": kv} for _ in range(cfg.layers)]}
+    layer = {"k": kv, "v": kv}
+    if kv_dtype == "int8":
+        layer = dict(layer, k_scale=P(None, None, MODEL_AXIS),
+                     v_scale=P(None, None, MODEL_AXIS))
+    return {"layers": [dict(layer) for _ in range(cfg.layers)]}
 
 
 def place_kv_cache(cache, cfg: TransformerConfig, mesh: Mesh):
-    """Shard a generation cache (either layout — the contiguous one
-    carries 'lengths', the paged pool does not) onto the mesh."""
-    spec = kv_cache_pspecs(cfg) if "lengths" in cache \
-        else paged_kv_cache_pspecs(cfg)
+    """Shard a generation cache (any layout — the contiguous one carries
+    'lengths', the paged pool does not, the int8 pool adds scales) onto
+    the mesh."""
+    if "lengths" in cache:
+        spec = kv_cache_pspecs(cfg)
+    else:
+        kv_dtype = "int8" if "k_scale" in cache["layers"][0] else "float32"
+        spec = paged_kv_cache_pspecs(cfg, kv_dtype)
     return jax.device_put(cache, tree_shardings(mesh, spec))
 
 
@@ -812,7 +879,8 @@ def make_decode_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
 
 
 def make_paged_prefill(cfg: TransformerConfig, block_size: int,
-                       mesh: Optional[Mesh] = None):
+                       mesh: Optional[Mesh] = None,
+                       kv_dtype: str = "float32"):
     """Build the jitted paged prefill: one PADDED prompt through the
     standard forward (the same ``_block``), its per-layer K/V scattered
     into the physical blocks named by ``block_row``, and token 0 sampled.
@@ -824,10 +892,15 @@ def make_paged_prefill(cfg: TransformerConfig, block_size: int,
     block 0, so padding K/V lands in scratch, never in a live block. One
     executable per T bucket; the cache (block pool) is donated. Unlike
     the contiguous prefill there is no ``slot`` argument: lengths live on
-    the host, and the block row alone names where this prompt's K/V go."""
+    the host, and the block row alone names where this prompt's K/V go.
+
+    ``kv_dtype="int8"``: quantization is FOLDED into the scatter — each
+    block's values land int8 with their per-token scales written beside
+    them, so the fp-sized prompt K/V never touches the pool."""
     if not cfg.causal:
         raise ValueError("generation needs a causal LM: set "
                          "TransformerConfig(causal=True)")
+    validate_kv_dtype(kv_dtype, block_size)
 
     def prefill(params, cache, tokens, block_row, length, key,
                 temperature, top_k):
@@ -844,6 +917,16 @@ def make_paged_prefill(cfg: TransformerConfig, block_size: int,
                     nb, block_size, cfg.heads, cfg.head_dim)
                 vb = jnp.pad(v[0], ((0, pad), (0, 0), (0, 0))).reshape(
                     nb, block_size, cfg.heads, cfg.head_dim)
+                if kv_dtype == "int8":
+                    kq, ks = quantize_kv(kb)
+                    vq, vs = quantize_kv(vb)
+                    layers.append({
+                        "k": lc["k"].at[block_row].set(kq),
+                        "v": lc["v"].at[block_row].set(vq),
+                        "k_scale": lc["k_scale"].at[block_row].set(ks),
+                        "v_scale": lc["v_scale"].at[block_row].set(vs),
+                    })
+                    continue
                 layers.append({
                     "k": lc["k"].at[block_row].set(kb.astype(lc["k"].dtype)),
                     "v": lc["v"].at[block_row].set(vb.astype(lc["v"].dtype)),
@@ -859,7 +942,7 @@ def make_paged_prefill(cfg: TransformerConfig, block_size: int,
     if mesh is None:
         return jax.jit(prefill, donate_argnums=(1,))
     param_sh = _shardings(cfg, mesh)
-    cache_sh = tree_shardings(mesh, paged_kv_cache_pspecs(cfg))
+    cache_sh = tree_shardings(mesh, paged_kv_cache_pspecs(cfg, kv_dtype))
     repl = NamedSharding(mesh, P())
     return jax.jit(
         prefill, donate_argnums=(1,),
@@ -867,8 +950,25 @@ def make_paged_prefill(cfg: TransformerConfig, block_size: int,
         out_shardings=(cache_sh, repl))
 
 
+def _paged_attention_mesh_spec(cfg: TransformerConfig, mesh: Mesh):
+    """PartitionSpecs for running the fused paged-attention kernel under
+    ``mesh`` via shard_map — heads ride the 'model' axis (matching the
+    column-parallel qkv layout), block/table/position axes replicate, so
+    the per-device kernel is embarrassingly parallel over heads: zero
+    extra collectives, exactly the packed-kernel pattern. Returns None
+    when the kernel cannot partition (heads not divisible)."""
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    if cfg.heads % tp:
+        return None
+    m = MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
+    return {"q": P(None, m, None), "pool": P(None, None, m, None),
+            "scale": P(None, None, m), "repl": P()}
+
+
 def make_paged_decode_step(cfg: TransformerConfig, block_size: int,
-                           mesh: Optional[Mesh] = None):
+                           mesh: Optional[Mesh] = None,
+                           kv_dtype: str = "float32",
+                           paged_attention: str = "gather"):
     """Build THE paged decode executable: one token for every slot.
 
     ``decode_step(params, cache, tables, lengths, tokens, keys, steps,
@@ -883,16 +983,74 @@ def make_paged_decode_step(cfg: TransformerConfig, block_size: int,
     argument is fixed-shape, so this compiles EXACTLY ONCE per engine
     lifetime — the block-table gather preserves the contiguous path's
     one-donated-executable invariant while the pool replaces the
-    per-slot worst-case reservation."""
+    per-slot worst-case reservation.
+
+    ``paged_attention`` selects how the attention read happens:
+
+    - ``"gather"`` (default): XLA materializes ``pool[tables]`` back into
+      the (S, L, heads, D) layout the contiguous attention consumed —
+      same einsums, same mask, bitwise-stable vs PR 6 at
+      ``kv_dtype="float32"``, but the single-token read pays a full
+      HBM round-trip of the gathered view every step.
+    - ``"fused"``: the Pallas :func:`~deeplearning4j_tpu.ops.
+      pallas_kernels.paged_decode_attention` kernel streams each slot's
+      blocks through VMEM behind a scalar-prefetched block table — the
+      (S, L) view never exists in HBM, and int8 dequant fuses into the
+      same pass. Numerically equivalent within fp tolerance (online
+      softmax reassociates the reduction); still the SAME single donated
+      executable and signature.
+
+    ``kv_dtype="int8"`` stores the pool quantized (see
+    :func:`init_kv_cache`): the decode writeback quantizes the new token
+    (per-token scales — no block requantization), the CoW copy moves
+    scales alongside values, and both attention routes dequantize on
+    read."""
     if not cfg.causal:
         raise ValueError("generation needs a causal LM: set "
                          "TransformerConfig(causal=True)")
+    validate_kv_dtype(kv_dtype, block_size)
+    if paged_attention not in ("gather", "fused"):
+        raise ValueError(
+            f"paged_attention must be 'gather' or 'fused', "
+            f"got {paged_attention!r}")
+    quantized = kv_dtype == "int8"
+    mesh_spec = None
+    if paged_attention == "fused" and mesh is not None:
+        mesh_spec = _paged_attention_mesh_spec(cfg, mesh)
+        if mesh_spec is None:
+            raise ValueError(
+                f"paged_attention='fused' cannot shard {cfg.heads} heads "
+                f"over the mesh's {mesh.shape.get(MODEL_AXIS, 1)}-way "
+                f"'{MODEL_AXIS}' axis; use paged_attention='gather' or a "
+                "dividing mesh")
+
+    def _fused_attention(q, ck, cv, cks, cvs, tables, pos, scale):
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            paged_decode_attention)
+        interp = jax.default_backend() != "tpu"
+
+        def _local(ql, kl, vl, tb, ps, *scales):
+            ksl, vsl = scales if quantized else (None, None)
+            return paged_decode_attention(
+                ql, kl, vl, tb, ps, block_size=block_size, scale=scale,
+                k_scale=ksl, v_scale=vsl, interpret=interp)
+
+        if mesh is None:
+            return _local(q, ck, cv, tables, pos,
+                          *((cks, cvs) if quantized else ()))
+        ms = mesh_spec
+        in_specs = (ms["q"], ms["pool"], ms["pool"], ms["repl"],
+                    ms["repl"]) + ((ms["scale"],) * 2 if quantized else ())
+        return shard_map(_local, mesh=mesh, in_specs=in_specs,
+                         out_specs=ms["q"], check_rep=False)(
+            q, ck, cv, tables, pos,
+            *((cks, cvs) if quantized else ()))
 
     def decode_block(bp, x, lc, tables, pos, cow_src, cow_dst):
         # x: (S, hidden); lc["k"]/["v"]: (NB, B, heads, D); tables:
         # (S, max_blocks); pos: (S,) logical write position. CoW first,
-        # then the new K/V write, then the gather — data dependence
-        # orders them, so the gathered sequence sees both.
+        # then the new K/V write, then the attention read — data
+        # dependence orders them, so the read sees both.
         S, H = x.shape
         nb = tables.shape[1]
         L = nb * block_size
@@ -907,20 +1065,45 @@ def make_paged_decode_step(cfg: TransformerConfig, block_size: int,
         blk = pos // block_size
         off = pos % block_size
         pb = tables[rows, blk]                                 # (S,)
-        ck = ck.at[pb, off].set(
-            k.reshape(S, cfg.heads, cfg.head_dim).astype(ck.dtype))
-        cv = cv.at[pb, off].set(
-            v.reshape(S, cfg.heads, cfg.head_dim).astype(cv.dtype))
-        # block-table gather: back to the exact (S, L, heads, D) layout
-        # the contiguous attention consumed — same einsums, same mask
-        gk = ck[tables].reshape(S, L, cfg.heads, cfg.head_dim)
-        gv = cv[tables].reshape(S, L, cfg.heads, cfg.head_dim)
+        cks = cvs = None
+        if quantized:
+            cks = lc["k_scale"].at[cow_dst].set(lc["k_scale"][cow_src])
+            cvs = lc["v_scale"].at[cow_dst].set(lc["v_scale"][cow_src])
+            kq, ks = quantize_kv(k.reshape(S, cfg.heads, cfg.head_dim))
+            vq, vs = quantize_kv(v.reshape(S, cfg.heads, cfg.head_dim))
+            ck = ck.at[pb, off].set(kq)
+            cv = cv.at[pb, off].set(vq)
+            cks = cks.at[pb, off].set(ks)
+            cvs = cvs.at[pb, off].set(vs)
+        else:
+            ck = ck.at[pb, off].set(
+                k.reshape(S, cfg.heads, cfg.head_dim).astype(ck.dtype))
+            cv = cv.at[pb, off].set(
+                v.reshape(S, cfg.heads, cfg.head_dim).astype(cv.dtype))
         scale = 1.0 / np.sqrt(cfg.head_dim)
-        s = jnp.einsum("shd,slhd->shl", q, gk.astype(q.dtype)) * scale
-        mask = jnp.arange(L)[None, :] <= pos[:, None]          # (S, L)
-        s = jnp.where(mask[:, None, :], s, jnp.finfo(s.dtype).min)
-        p = jax.nn.softmax(s.astype(cfg.softmax_dtype), axis=-1).astype(q.dtype)
-        o = jnp.einsum("shl,slhd->shd", p, gv.astype(p.dtype)).reshape(S, H)
+        if paged_attention == "fused":
+            o = _fused_attention(q, ck, cv, cks, cvs, tables, pos,
+                                 scale).reshape(S, H).astype(x.dtype)
+        else:
+            # block-table gather: back to the exact (S, L, heads, D)
+            # layout the contiguous attention consumed — same einsums,
+            # same mask (int8 dequantizes into the compute dtype first)
+            gk = ck[tables].reshape(S, L, cfg.heads, cfg.head_dim)
+            gv = cv[tables].reshape(S, L, cfg.heads, cfg.head_dim)
+            if quantized:
+                gks = cks[tables].reshape(S, L, cfg.heads)
+                gvs = cvs[tables].reshape(S, L, cfg.heads)
+                gk = (gk.astype(jnp.float32)
+                      * gks[..., None]).astype(q.dtype)
+                gv = (gv.astype(jnp.float32)
+                      * gvs[..., None]).astype(q.dtype)
+            s = jnp.einsum("shd,slhd->shl", q, gk.astype(q.dtype)) * scale
+            mask = jnp.arange(L)[None, :] <= pos[:, None]      # (S, L)
+            s = jnp.where(mask[:, None, :], s, jnp.finfo(s.dtype).min)
+            p = jax.nn.softmax(s.astype(cfg.softmax_dtype),
+                               axis=-1).astype(q.dtype)
+            o = jnp.einsum("shl,slhd->shd", p,
+                           gv.astype(p.dtype)).reshape(S, H)
         x = x + o @ bp["attn_out"]["kernel"].astype(o.dtype) \
             + bp["attn_out"]["bias"].astype(o.dtype)
         h = _layernorm(x, bp["ln2"])
@@ -929,7 +1112,10 @@ def make_paged_decode_step(cfg: TransformerConfig, block_size: int,
         h = jax.nn.gelu(h, approximate=True)
         x = x + h @ bp["mlp_out"]["kernel"].astype(h.dtype) \
             + bp["mlp_out"]["bias"].astype(h.dtype)
-        return x, {"k": ck, "v": cv}
+        out = {"k": ck, "v": cv}
+        if quantized:
+            out.update(k_scale=cks, v_scale=cvs)
+        return x, out
 
     def decode_step(params, cache, tables, lengths, tokens, keys, steps,
                     temperatures, top_ks, cow_src, cow_dst):
@@ -953,7 +1139,7 @@ def make_paged_decode_step(cfg: TransformerConfig, block_size: int,
     if mesh is None:
         return jax.jit(decode_step, donate_argnums=(1,))
     param_sh = _shardings(cfg, mesh)
-    cache_sh = tree_shardings(mesh, paged_kv_cache_pspecs(cfg))
+    cache_sh = tree_shardings(mesh, paged_kv_cache_pspecs(cfg, kv_dtype))
     repl = NamedSharding(mesh, P())
     return jax.jit(
         decode_step, donate_argnums=(1,),

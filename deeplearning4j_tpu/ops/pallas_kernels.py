@@ -753,6 +753,183 @@ def mha_attention(q, k, v, causal=False, scale=None, interpret=False,
     return o
 
 
+# ------------------------------------------- fused paged decode attention
+#
+# The serving decode hot path (models/bert.py make_paged_decode_step): one
+# query token per slot attends over that slot's block-table rows in the
+# shared KV block pool. The XLA gather route materializes pool[tables] —
+# a (slots, L, heads, head_dim) tensor — in HBM every step just to read it
+# once, which is exactly the memcpy-bound single-token read vLLM's
+# PagedAttention kernel (SOSP '23 §4.3) exists to break. This kernel
+# streams each slot's K/V blocks from the pool straight through VMEM
+# (scalar-prefetched block table drives the BlockSpec index map, so the
+# DMA engine chases the table) with the online-softmax recurrence in
+# scratch — the (slots, L) view never exists in HBM in either layout.
+# int8 pools dequantize on the fly inside the same pass (per-token,
+# per-head symmetric scales stored beside the pool), so quantized storage
+# doubles resident streams without a separate dequant materialization.
+# Forward-only by design: decode never differentiates.
+
+
+def _paged_decode_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                         block_size: int, scale: float, quantized: bool):
+    """One (slot, block) grid step. Scratch carries the running
+    max/denominator/accumulator across a slot's blocks (the grid iterates
+    blocks minor-most, so a slot's steps are consecutive); the output
+    block is written once, on the slot's last block. Fully-masked tail
+    blocks skip their compute (the DMA still lands, but dead table
+    entries point at the scratch block — one block-sized read)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    s_idx = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[s_idx]                              # attend 0..pos incl.
+
+    # block j holds global positions [j*B, (j+1)*B); skip blocks wholly
+    # past the slot's write position (their scores would all mask to
+    # -inf and contribute nothing — position 0 is always unmasked, so
+    # block 0 always runs and the running max is always real)
+    @pl.when(j * block_size <= pos)
+    def _update():
+        q = q_ref[0]                                  # (H, D)
+        qf = q.astype(jnp.float32) * scale
+        k = k_ref[0]                                  # (B, H, D)
+        v = v_ref[0]
+        kf = k.astype(jnp.float32)
+        vf = v.astype(jnp.float32)
+        if quantized:
+            kf = kf * ks_ref[0][:, :, None]           # (B, H) scales
+            vf = vf * vs_ref[0][:, :, None]
+        # s_blk[h, b] = sum_d q[h, d] * k[b, h, d] — batch over heads
+        s_blk = jax.lax.dot_general(
+            qf, kf, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)       # (H, B)
+        gpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s_blk.shape, 1)
+        s_blk = jnp.where(gpos <= pos, s_blk, _NEG_INF)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, s_blk.max(-1, keepdims=True))
+        p = jnp.exp(s_blk - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l_ref[...] * alpha + p.sum(-1, keepdims=True)
+        # acc[h, d] += sum_b p[h, b] * v[b, h, d]
+        acc_new = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, vf, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+        acc_ref[...] = acc_new
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _emit():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, pos, *,
+                           block_size: int, scale: Optional[float] = None,
+                           k_scale=None, v_scale=None, interpret=False):
+    """Fused paged decode attention: q (S, H, D) single-token queries,
+    k_pool/v_pool (NB, B, H, D) shared block pools, tables (S, nbmax)
+    int32 physical block ids, pos (S,) int32 per-slot write positions
+    (the query attends to global positions 0..pos inclusive, mirroring
+    the gather path's causal mask). Returns (S, H, D) in q's dtype.
+
+    With ``k_scale``/``v_scale`` ((NB, B, H) fp32 per-token-per-head
+    scales) the pools are int8 and dequantization fuses into the block
+    stream — the fp-sized K/V never exists anywhere, HBM or VMEM-resident
+    beyond one block. The block table is SCALAR-PREFETCHED: the BlockSpec
+    index map reads it, so each grid step's DMA fetches exactly the
+    physical block the table names — the (S, L) gathered view is never
+    materialized. Dead/short slots' tail table entries should name the
+    pool's scratch block (the serving convention), costing one redundant
+    block read but no compute (the kernel skips fully-masked blocks).
+
+    Runs in interpret mode off-TPU (the test suite's route) and compiles
+    natively on TPU. Forward-only — decode never differentiates; wrap in
+    ``jax.lax.stop_gradient`` if it ever lands under one."""
+    S, H, D = q.shape
+    NB, B, _, _ = k_pool.shape
+    if B != block_size:
+        raise ValueError(
+            f"pool block dim {B} != block_size {block_size}")
+    nbmax = tables.shape[1]
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    sc = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    def tab_map(s, j, tab, _pos):
+        return (tab[s, j], 0, 0, 0)
+
+    def stab_map(s, j, tab, _pos):
+        return (tab[s, j], 0, 0)
+
+    def q_map(s, j, tab, _pos):
+        return (s, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, H, D), q_map),
+        pl.BlockSpec((1, B, H, D), tab_map),
+        pl.BlockSpec((1, B, H, D), tab_map),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, B, H), stab_map),
+                     pl.BlockSpec((1, B, H), stab_map)]
+        operands += [k_scale, v_scale]
+
+    from jax.experimental.pallas import tpu as pltpu
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, nbmax),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, H, D), q_map),
+        scratch_shapes=[pltpu.VMEM((H, 1), jnp.float32),
+                        pltpu.VMEM((H, 1), jnp.float32),
+                        pltpu.VMEM((H, D), jnp.float32)])
+    kern = functools.partial(_paged_decode_kernel, block_size=block_size,
+                             scale=sc, quantized=quantized)
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else _tpu_params(),
+    )(tables.astype(jnp.int32), pos.astype(jnp.int32), *operands)
+
+
+def paged_decode_attention_reference(q, k_pool, v_pool, tables, pos, *,
+                                     block_size: int,
+                                     scale: Optional[float] = None,
+                                     k_scale=None, v_scale=None):
+    """Gather-based XLA reference for :func:`paged_decode_attention`:
+    materializes pool[tables] into the (S, L, H, D) view and runs plain
+    masked softmax attention in fp32 — the parity oracle the kernel tests
+    compare against, and the shape of the serving gather route."""
+    S, H, D = q.shape
+    L = tables.shape[1] * block_size
+    sc = scale if scale is not None else 1.0 / (D ** 0.5)
+    gk = k_pool[tables].reshape(S, L, H, D).astype(jnp.float32)
+    gv = v_pool[tables].reshape(S, L, H, D).astype(jnp.float32)
+    if k_scale is not None:
+        gk = gk * k_scale[tables].reshape(S, L, H)[..., None]
+        gv = gv * v_scale[tables].reshape(S, L, H)[..., None]
+    s = jnp.einsum("shd,slhd->shl", q.astype(jnp.float32), gk) * sc
+    mask = jnp.arange(L)[None, :] <= pos[:, None]          # (S, L)
+    s = jnp.where(mask[:, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("shl,slhd->shd", p, gv).astype(q.dtype)
+
+
 # --------------------------------------------------- fused softmax-xent
 
 

@@ -21,7 +21,7 @@ from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
     SlidingWindowStats,
 )
 from deeplearning4j_tpu.serving.paging import (  # noqa: F401
-    BlockAllocator, SharedPrefix, blocks_for_tokens,
+    BlockAllocator, SharedPrefix, blocks_for_tokens, kv_bytes_per_token,
 )
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     CausalLMAdapter, Deployment, ModelAdapter, ModelRegistry, as_adapter,
@@ -46,7 +46,7 @@ __all__ = [
     "QueueFullError", "RejectedError", "InferenceEngine", "bucket_ladder",
     "Counter", "Gauge", "Histogram", "ReasonCounter", "ServingMetrics",
     "SlidingWindowStats", "BlockAllocator", "SharedPrefix",
-    "blocks_for_tokens",
+    "blocks_for_tokens", "kv_bytes_per_token",
     "Deployment", "ModelAdapter", "ModelRegistry", "as_adapter",
     "GenerationEngine", "GenerationHandle", "prefill_buckets",
     "CausalLMAdapter", "FaultPlan", "FaultInjectedError", "inject",

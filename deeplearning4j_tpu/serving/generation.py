@@ -58,7 +58,7 @@ from deeplearning4j_tpu.serving.engine import bucket_ladder
 from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.paging import (
-    BlockAllocator, SharedPrefix, blocks_for_tokens,
+    BlockAllocator, SharedPrefix, blocks_for_tokens, kv_bytes_per_token,
 )
 from deeplearning4j_tpu.serving.qos import (
     PRIORITIES, SloBurnGovernor, resolve_qos,
@@ -224,6 +224,18 @@ class GenerationEngine(ResilientEngineMixin):
     number of streams with copy-on-write. ``paged=False`` keeps the PR 2
     contiguous layout (the bitwise-parity reference).
 
+    ``kv_dtype`` selects the pool's storage: ``"float32"`` (default —
+    full precision in the cache dtype, the bitwise pre-int8 behavior) or
+    ``"int8"`` (quantize-on-write / dequant-on-read with per-token
+    scales; ~4x smaller KV stream at bf16-free shapes, so >=2x resident
+    streams at a fixed HBM budget — paged only). ``paged_attention``
+    selects the decode attention read: ``"gather"`` (default; XLA
+    materializes the block gather — bitwise-stable vs PR 6) or
+    ``"fused"`` (the Pallas paged-attention kernel streams blocks
+    through VMEM, never materializing the (slots, L) view in HBM;
+    fp-tolerance-equivalent, the decode-speed route on TPU). Both knobs
+    keep the ONE-donated-executable signature bound.
+
     ``qos`` (serving/qos.py ``QosPolicy``) swaps admission's FIFO for
     priority-strict weighted-fair queueing (cost = 1 request) with
     per-tenant quotas + SLO-burn shedding; ``retry_budget``
@@ -241,6 +253,8 @@ class GenerationEngine(ResilientEngineMixin):
                  paged: bool = True,
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
+                 kv_dtype: str = "float32",
+                 paged_attention: str = "gather",
                  queue_capacity: int = 64,
                  default_timeout_ms: Optional[float] = None,
                  eos_id: Optional[int] = None,
@@ -280,7 +294,8 @@ class GenerationEngine(ResilientEngineMixin):
         self.params = params
         self.paged = paged
         if paged:
-            from deeplearning4j_tpu.models.bert import validate_block_size
+            from deeplearning4j_tpu.models.bert import (
+                validate_block_size, validate_kv_dtype)
 
             if block_size is None:
                 # default: 16-token blocks, degrading to the largest
@@ -289,13 +304,31 @@ class GenerationEngine(ResilientEngineMixin):
                 while block_size > self.max_len:
                     block_size //= 2
             self.block_size = validate_block_size(block_size, self.max_len)
+            self.kv_dtype = validate_kv_dtype(kv_dtype, self.block_size)
+            self.paged_attention = paged_attention
             self.max_blocks_per_slot = blocks_for_tokens(self.max_len,
                                                          self.block_size)
             self.num_blocks = (slots * self.max_blocks_per_slot + 1
                                if num_blocks is None else int(num_blocks))
-            self._prefill = make_paged_prefill(cfg, self.block_size, mesh)
-            self._decode = make_paged_decode_step(cfg, self.block_size, mesh)
+            self._prefill = make_paged_prefill(cfg, self.block_size, mesh,
+                                               kv_dtype=self.kv_dtype)
+            self._decode = make_paged_decode_step(
+                cfg, self.block_size, mesh, kv_dtype=self.kv_dtype,
+                paged_attention=paged_attention)
         else:
+            from deeplearning4j_tpu.models.bert import validate_kv_dtype
+
+            # int8 storage is a block-pool concept (per-block scale
+            # tensors, dequant in the block read): validate against the
+            # contiguous layout's absent block size so the error names it
+            validate_kv_dtype(kv_dtype, None)
+            if paged_attention != "gather":
+                raise ValueError(
+                    f"paged_attention={paged_attention!r} requires the "
+                    "paged KV cache (GenerationEngine(paged=True)) — the "
+                    "contiguous layout has no block table to fuse over")
+            self.kv_dtype = kv_dtype
+            self.paged_attention = "gather"
             self.block_size = None
             self.num_blocks = None
             self._prefill = make_prefill(cfg, mesh)
@@ -622,7 +655,8 @@ class GenerationEngine(ResilientEngineMixin):
         cache = self._init_kv_cache(self.cfg, self.slots, self.max_len,
                                     dtype=self._cache_dtype,
                                     block_size=self.block_size,
-                                    num_blocks=self.num_blocks)
+                                    num_blocks=self.num_blocks,
+                                    kv_dtype=self.kv_dtype)
         self._cache = self._place_kv_cache(cache, self.cfg, self.mesh) \
             if self.mesh is not None else cache
         if self.paged:
@@ -634,7 +668,30 @@ class GenerationEngine(ResilientEngineMixin):
                 for p in self._prefixes.values():
                     p.blocks = None
             self.metrics.kv_blocks_total.set(self._allocator.capacity)
+            self.metrics.kv_block_bytes.set(self.kv_block_bytes)
+            self.metrics.kv_pool_hbm_bytes.set(
+                self.num_blocks * self.kv_block_bytes)
             self._update_block_gauges()
+
+    @property
+    def kv_block_bytes(self) -> int:
+        """HBM bytes of one KV block across all layers — dtype-aware
+        (paging.kv_bytes_per_token): int8 pools count their 1-byte values
+        plus fp32 scales, fp/bf pools the cache dtype's width. Paged
+        engines only — a contiguous cache has rows, not blocks."""
+        import jax.numpy as jnp
+
+        if not self.paged:
+            raise ValueError(
+                "kv_block_bytes is a paged-layout property (this engine "
+                "runs the contiguous cache: paged=False); a contiguous "
+                "stream's footprint is max_len * "
+                "paging.kv_bytes_per_token(...)")
+        itemsize = jnp.dtype(self._cache_dtype if self._cache_dtype
+                             is not None else self.cfg.dtype).itemsize
+        return self.block_size * kv_bytes_per_token(
+            self.cfg.layers, self.cfg.heads, self.cfg.head_dim,
+            self.kv_dtype, itemsize)
 
     def _update_block_gauges(self):
         """Block-pool occupancy / pin / fragmentation gauges (paged only).
@@ -667,6 +724,7 @@ class GenerationEngine(ResilientEngineMixin):
                 touched += blocks_for_tokens(local, B)
         self.metrics.kv_blocks_in_use.set(in_use)
         self.metrics.kv_blocks_pinned.set(pinned)
+        self.metrics.kv_hbm_bytes_in_use.set(in_use * self.kv_block_bytes)
         cap = alloc.capacity
         self.metrics.kv_block_occupancy.set(in_use / cap if cap else 0.0)
         self.metrics.kv_fragmentation.set(
@@ -677,6 +735,13 @@ class GenerationEngine(ResilientEngineMixin):
         restart: this (possibly wedged) thread then exits at its next
         check, and any state it computes afterwards is dropped by the
         epoch guards instead of corrupting its replacement's cache."""
+        # decode-step staging buffers are allocated ONCE per scheduler
+        # thread and refilled in place every iteration (the old per-step
+        # np.zeros churn was ~10 allocations per decode turn). Owned by
+        # THIS epoch's thread: a watchdog replacement runs its own _loop
+        # and therefore its own buffers, so a zombie wedged in a device
+        # call can never race the replacement over shared staging memory.
+        buf = self._make_step_buffers()
         try:
             while not self._stop.is_set() and self._epoch == epoch:
                 if self._watchdog is not None:
@@ -686,7 +751,7 @@ class GenerationEngine(ResilientEngineMixin):
                 self._admit(epoch)
                 if self._live_count() and self._epoch == epoch:
                     try:
-                        self._decode_iteration(epoch)
+                        self._decode_iteration(epoch, buf)
                     except BaseException as e:   # fail tenants, keep thread
                         self._on_device_failure(e, epoch,
                                                 point="generation.decode_step")
@@ -1262,7 +1327,27 @@ class GenerationEngine(ResilientEngineMixin):
         if self.paged:
             self._update_block_gauges()
 
-    def _decode_iteration(self, epoch: int):
+    def _make_step_buffers(self) -> Dict[str, np.ndarray]:
+        """Preallocate one scheduler thread's decode-step staging arrays
+        — every per-slot argument the fixed-shape decode executable takes,
+        shaped by engine config (slots), never by any request. Refilled
+        in place each iteration by :meth:`_decode_iteration`."""
+        S = self.slots
+        buf = {"tokens": np.zeros(S, np.int32),
+               "live": np.zeros(S, bool),
+               "keys": np.zeros((S, 2), np.uint32),
+               "steps": np.zeros(S, np.int32),
+               "temps": np.zeros(S, np.float32),
+               "top_ks": np.zeros(S, np.int32),
+               "lengths": np.zeros(S, np.int32),
+               "cow_src": np.zeros(S, np.int32),
+               "cow_dst": np.zeros(S, np.int32)}
+        if self.paged:
+            buf["tables"] = np.zeros((S, self.max_blocks_per_slot),
+                                     np.int32)
+        return buf
+
+    def _decode_iteration(self, epoch: int, buf: Dict[str, np.ndarray]):
         """One scheduler turn: a single fixed-shape decode_step over ALL
         slots, then stream/retire per live slot. Paged additions: host
         block tables + lengths ride in as the gather index, a pending CoW
@@ -1270,17 +1355,20 @@ class GenerationEngine(ResilientEngineMixin):
         after the step lands), and shared-prefix streams still feeding
         their prompt suffix get the NEXT suffix token embedded — their
         mid-prompt samples are discarded until the suffix is consumed,
-        at which point the step's sample is generated token 0."""
+        at which point the step's sample is generated token 0.
+
+        ``buf`` is the calling scheduler thread's preallocated staging
+        set (:meth:`_make_step_buffers`): zeroed and refilled in place —
+        the previous step's dispatch completed when its sampled tokens
+        were read back, so the arrays are free to reuse."""
         S = self.slots
-        tokens = np.zeros(S, np.int32)
-        live = np.zeros(S, bool)
-        keys = np.zeros((S, 2), np.uint32)
-        steps = np.zeros(S, np.int32)
-        temps = np.zeros(S, np.float32)
-        top_ks = np.zeros(S, np.int32)
-        lengths = np.zeros(S, np.int32)
-        cow_src = np.zeros(S, np.int32)
-        cow_dst = np.zeros(S, np.int32)
+        tokens, live, keys = buf["tokens"], buf["live"], buf["keys"]
+        steps, temps, top_ks = buf["steps"], buf["temps"], buf["top_ks"]
+        lengths = buf["lengths"]
+        cow_src, cow_dst = buf["cow_src"], buf["cow_dst"]
+        for a in (tokens, live, keys, steps, temps, top_ks, lengths,
+                  cow_src, cow_dst):
+            a.fill(0)
         n_live = 0
         # snapshot the slot table: after a watchdog restart the live list
         # belongs to the replacement scheduler (possibly re-tenanted), and
@@ -1305,9 +1393,14 @@ class GenerationEngine(ResilientEngineMixin):
         # mid-step, this (zombie) call must keep donating the OLD cache —
         # re-reading self._cache after a restart would consume the
         # replacement scheduler's live buffers. The block-table snapshot
-        # rides beside it for the same reason.
+        # rides beside it for the same reason (copied into this thread's
+        # own staging buffer: self._tables is replaced on rebuild, and the
+        # replacement scheduler mutates only ITS buffer set).
         cache = self._cache
-        tables = np.array(self._tables) if self.paged else None
+        tables = None
+        if self.paged:
+            tables = buf["tables"]
+            np.copyto(tables, self._tables)
         with self.profiler.span("serving.decode_step", engine=self.name,
                                 live=n_live, slots=S):
             def call():

@@ -263,6 +263,14 @@ class ServingMetrics:
         # token (the partially-filled tail blocks) — the paged design's
         # bounded waste, vs the contiguous cache's (max_len - len)/max_len
         self.kv_fragmentation = Gauge("kv_fragmentation")
+        # dtype-aware HBM accounting (paging.kv_bytes_per_token is the one
+        # formula): int8 pools report their true 1-byte-values +
+        # fp32-scale footprint, so "how much HBM does the cache hold" and
+        # "how many bytes is a resident stream" read correctly whichever
+        # kv_dtype the engine stores
+        self.kv_block_bytes = Gauge("kv_block_bytes")        # bytes/block
+        self.kv_pool_hbm_bytes = Gauge("kv_pool_hbm_bytes")  # whole pool
+        self.kv_hbm_bytes_in_use = Gauge("kv_hbm_bytes_in_use")
         # ---- resilience signals (retry / breaker / watchdog / fallback) --
         self.retries_total = Counter("retries_total")
         self.rejected_circuit_open = Counter("rejected_circuit_open")
@@ -470,6 +478,9 @@ class ServingMetrics:
             "kv_blocks_pinned": self.kv_blocks_pinned.value,
             "kv_block_occupancy": self.kv_block_occupancy.value,
             "kv_fragmentation": self.kv_fragmentation.value,
+            "kv_block_bytes": self.kv_block_bytes.value,
+            "kv_pool_hbm_bytes": self.kv_pool_hbm_bytes.value,
+            "kv_hbm_bytes_in_use": self.kv_hbm_bytes_in_use.value,
             "rejections_by_reason": self.rejections_by_reason.to_dict(),
             "slo": self.slo_snapshot(),
             "qos": self.qos_snapshot(),

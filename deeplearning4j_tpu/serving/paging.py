@@ -30,6 +30,23 @@ def blocks_for_tokens(tokens: int, block_size: int) -> int:
     return -(-tokens // block_size)
 
 
+def kv_bytes_per_token(layers: int, heads: int, head_dim: int,
+                       kv_dtype: str = "float32",
+                       itemsize: int = 4) -> int:
+    """HBM bytes one token position occupies in the KV cache — THE single
+    accounting formula the engine's byte gauges and the bench capacity
+    legs share. ``kv_dtype="float32"`` stores K and V at ``itemsize``
+    bytes per element (the cache dtype's width — 2 for bf16, 4 for
+    fp32); ``"int8"`` stores 1-byte values plus one fp32 scale per
+    (token, head) per tensor, which is where the >=2x resident-stream
+    multiplier at a fixed budget comes from."""
+    if kv_dtype == "int8":
+        per_head = head_dim * 1 + 4          # int8 values + f32 scale
+    else:
+        per_head = head_dim * itemsize
+    return layers * 2 * heads * per_head     # K and V
+
+
 class BlockAllocator:
     """Refcounted free-list allocator over a fixed block pool.
 
@@ -144,4 +161,5 @@ class SharedPrefix:
         return self.blocks is not None
 
 
-__all__ = ["BlockAllocator", "SharedPrefix", "blocks_for_tokens"]
+__all__ = ["BlockAllocator", "SharedPrefix", "blocks_for_tokens",
+           "kv_bytes_per_token"]

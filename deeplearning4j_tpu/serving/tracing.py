@@ -34,7 +34,9 @@ that timeline:
 
 Export: :meth:`Tracer.chrome_events` renders retained traces in the
 Chrome-trace format ``OpProfiler`` already emits — one process lane per
-engine (pid), one thread lane per request (tid) — and
+engine (pid), one thread lane per request (tid), track names
+tenant-prefixed for QoS-attributed requests so Perfetto groups
+per-tenant timelines — and
 ``OpProfiler.export_chrome_trace(path, tracer=...)`` merges both, so
 serving request timelines and training step spans load in the same
 Perfetto view on one clock.
@@ -171,9 +173,10 @@ class RequestTrace:
     wins; later events/finishes are dropped — a watchdog and a zombie
     dispatcher may both reach the terminal)."""
 
-    __slots__ = ("trace_id", "engine", "kind", "start_t", "start_wall",
-                 "end_t", "reason", "latency_ms", "events", "dropped_events",
-                 "pid", "tid", "_tracer", "_lock", "_done")
+    __slots__ = ("trace_id", "engine", "kind", "tenant", "start_t",
+                 "start_wall", "end_t", "reason", "latency_ms", "events",
+                 "dropped_events", "pid", "tid", "_tracer", "_lock",
+                 "_done")
 
     MAX_EVENTS = 1024   # fixed memory even for a runaway stream
 
@@ -181,6 +184,12 @@ class RequestTrace:
         self.trace_id = f"{engine}-{next(_TRACE_SEQ):06d}"
         self.engine = engine
         self.kind = kind
+        # tenant identity (QoS attribution, serving/qos.py) lifted out of
+        # the submit attrs so the Chrome export can tag its track name —
+        # Perfetto sorts thread lanes lexically, so tenant-prefixed names
+        # group one tenant's request timelines together (ROADMAP 4d)
+        t = (attrs or {}).get("tenant")
+        self.tenant = str(t) if t is not None else None
         self.start_t = time.perf_counter()
         self.start_wall = time.time()
         self.end_t: Optional[float] = None
@@ -384,14 +393,22 @@ class Tracer:
                            "args": {"name": f"serving[{engine}]"}})
         for tr in traces:
             end_t = tr.end_t if tr.end_t is not None else time.perf_counter()
+            # tenant-tagged track names (ROADMAP 4d): Perfetto sorts
+            # thread lanes lexically within the engine's process lane, so
+            # the "tenant/" prefix clusters each tenant's request
+            # timelines into one contiguous per-tenant view
+            track = f"{tr.tenant}/{tr.trace_id}" if tr.tenant is not None \
+                else tr.trace_id
             events.append({"ph": "M", "name": "thread_name", "pid": tr.pid,
-                           "tid": tr.tid, "args": {"name": tr.trace_id}})
+                           "tid": tr.tid, "args": {"name": track}})
+            args = {"trace_id": tr.trace_id, "reason": tr.reason}
+            if tr.tenant is not None:
+                args["tenant"] = tr.tenant
             events.append({
                 "name": f"{tr.kind}[{tr.reason or 'live'}]", "ph": "X",
                 "ts": (tr.start_t - base) * 1e6,
                 "dur": max((end_t - tr.start_t) * 1e6, 1.0),
-                "pid": tr.pid, "tid": tr.tid,
-                "args": {"trace_id": tr.trace_id, "reason": tr.reason}})
+                "pid": tr.pid, "tid": tr.tid, "args": args})
             with tr._lock:
                 evs = list(tr.events)
             for name, t, attrs in evs:

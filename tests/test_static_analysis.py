@@ -390,6 +390,23 @@ class Adapter:
         r2 = run({"models/factory.py": src}, rules=["recompile-risk"])
         assert r2.unsuppressed == []
 
+    def test_pallas_call_is_executable_minting(self):
+        """ISSUE 9: a Pallas kernel launch mints an executable exactly
+        like jax.jit — a stray ``pl.pallas_call`` inside serving/ is
+        flagged, while the sanctioned kernel-factory home (ops/, e.g.
+        ``paged_decode_attention``) stays clean with no baseline entry."""
+        src = '''
+from jax.experimental import pallas as pl
+class Engine:
+    def attend(self, q, pool, tables):
+        return pl.pallas_call(self._kern, grid=(q.shape[0],))(q, pool)
+'''
+        r = run({"serving/generation.py": src}, rules=["recompile-risk"])
+        assert rules_hit(r) == {"recompile-risk"}
+        assert any("pallas_call" in f.message for f in r.unsuppressed)
+        r2 = run({"ops/pallas_kernels.py": src}, rules=["recompile-risk"])
+        assert r2.unsuppressed == []
+
     def test_shape_bypassing_bucket_ladder(self):
         src = '''
 import numpy as np

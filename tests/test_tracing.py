@@ -575,6 +575,37 @@ class TestChromeExport:
             if e.get("ph") == "X":
                 assert e["dur"] >= 0
 
+    def test_tenant_tagged_track_names(self, params, tmp_path):
+        """ROADMAP 4d: requests export with tenant-prefixed thread-lane
+        names (Perfetto sorts lanes lexically, so one tenant's request
+        timelines cluster together); unattributed requests group under
+        the shared DEFAULT_TENANT lane prefix — the same label their QoS
+        metrics use — and the tenant rides the slice args."""
+        from deeplearning4j_tpu.profiler import OpProfiler
+
+        prof = OpProfiler()
+        t = Tracer(sample_rate=1.0)
+        with GenerationEngine(params, CFG, slots=2, max_len=32, tracer=t,
+                              profiler=prof, name="tn") as gen:
+            gen.generate(_prompt(4, 0), max_new_tokens=2, timeout=120,
+                         tenant="acme")
+            gen.generate(_prompt(4, 1), max_new_tokens=2, timeout=120,
+                         tenant="globex")
+            gen.generate(_prompt(4, 2), max_new_tokens=2, timeout=120)
+        events = json.loads(open(prof.export_chrome_trace(
+            str(tmp_path / "tenants.json"), tracer=t)).read())["traceEvents"]
+        names = {e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e["name"] == "thread_name"}
+        from deeplearning4j_tpu.serving import DEFAULT_TENANT
+
+        assert any(n.startswith("acme/") for n in names)
+        assert any(n.startswith("globex/") for n in names)
+        assert any(n.startswith(f"{DEFAULT_TENANT}/") for n in names)
+        slice_tenants = {e["args"].get("tenant") for e in events
+                         if e.get("ph") == "X"
+                         and "trace_id" in e.get("args", {})}
+        assert {"acme", "globex", DEFAULT_TENANT} <= slice_tenants
+
     def test_plain_profiler_export_unchanged(self, tmp_path):
         """Without a tracer the export is exactly the span events — the
         pre-existing contract other tests rely on."""

@@ -8,11 +8,14 @@ is padded to the bucket ladder — so at most ``len(buckets)`` inference
 signatures and ``len(prefill_buckets) + 1`` generation signatures ever
 compile. Two ways new code breaks it:
 
-1. **A stray ``jax.jit``/``pjit`` callsite inside ``serving/``.** An
-   executable minted in the serving layer escapes the factory
-   conventions (donation, shardings, warmup, cache-size introspection)
-   and is one ``lambda`` capture away from a per-request signature.
-   Executables belong in ``models/`` factories; serving composes them.
+1. **A stray ``jax.jit``/``pjit``/``pl.pallas_call`` callsite inside
+   ``serving/``.** An executable minted in the serving layer escapes
+   the factory conventions (donation, shardings, warmup, cache-size
+   introspection) and is one ``lambda`` capture away from a
+   per-request signature. Executables belong in ``models/`` factories;
+   Pallas kernel launches belong in ``ops/`` kernel factories (e.g.
+   ``paged_decode_attention``, which the paged decode factory routes
+   through) — serving composes them.
 2. **Shape-varying arguments that bypass the ladder.** An array built
    with a request-derived dimension (``prompt.size``, ``len(...)``,
    ``x.shape[...]``) fed straight to an executable compiles one
@@ -37,7 +40,11 @@ from tools.analysis.core import (
     scoped_walk,
 )
 
-JIT_CALLEES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+JIT_CALLEES = {"jax.jit", "jit", "pjit", "jax.pjit",
+               # a pallas_call mints an executable just like jax.jit —
+               # kernel launches live in the ops/ kernel factories
+               # (FACTORY_DIRS), never inline in serving code
+               "pallas_call", "pl.pallas_call"}
 #: directories whose files may mint executables (factory homes)
 FACTORY_DIRS = {"models", "nn", "ops", "autodiff", "parallel", "train"}
 EXECUTABLE_CALLEES = {"_prefill", "_decode", "_run", "_guarded_run",
@@ -87,11 +94,12 @@ class RecompileRiskChecker(Checker):
                         yield unit.finding(
                             sf, self.rule, node,
                             f"{call_name(node)}() callsite outside the "
-                            f"models/ factories — serving code composes "
-                            f"executables, it does not mint them; move "
-                            f"this into a make_* factory so donation/"
-                            f"sharding/warmup conventions (and the "
-                            f"len(buckets)+1 signature bound) hold")
+                            f"models//ops/ factories — serving code "
+                            f"composes executables, it does not mint "
+                            f"them; move this into a make_* (or kernel) "
+                            f"factory so donation/sharding/warmup "
+                            f"conventions (and the len(buckets)+1 "
+                            f"signature bound) hold")
             for qual, fn, _cls in iter_functions(sf.tree):
                 yield from self._check_shapes(unit, sf, qual, fn)
 
