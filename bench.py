@@ -115,6 +115,7 @@ def main():
         "availability": availability_leg(on_tpu),
         "observability": observability_leg(on_tpu),
         "fairness": fairness_leg(on_tpu),
+        "cluster": cluster_leg(on_tpu),
     }))
 
 
@@ -670,6 +671,196 @@ def fairness_leg(on_tpu: bool) -> dict:
 
     return {"noisy_neighbor": noisy, "weighted_share": weighted,
             "retry_storm": storm}
+
+
+def cluster_leg(on_tpu: bool) -> dict:
+    """Pod-slice control-plane leg (serving/cluster.py): (a) 1-host vs
+    3-host loopback throughput scaling through the ClusterFrontDoor —
+    dispatch cost is a simulated per-batch device time so host
+    parallelism, not numpy, is what scales; (b) routed TTFT p50 for
+    generation streams fanned over a 3-host loopback cluster (submit ->
+    first token through the front door, routing overhead included);
+    (c) shed-reason mix under a one-host-degraded scenario: host 0's
+    deployment breaker trips and its heartbeat dies, the fleet keeps
+    serving via the survivors, and forced sheds type as
+    cluster_capacity/host_unavailable in the front door's counters."""
+    import time as _time
+
+    from deeplearning4j_tpu.serving import (
+        ClusterDirectory, ClusterFrontDoor, HeartbeatPump, InferenceEngine,
+        LoopbackHost, LoopbackTransport, ModelAdapter)
+
+    class _SimDevice(ModelAdapter):
+        """Fixed 2 ms per dispatched batch (sleep releases the GIL), so
+        N hosts serve N batches concurrently — the scaling signal."""
+
+        def __init__(self):
+            super().__init__(model=None)
+            self.w = np.linspace(-1, 1, 16, dtype=np.float32).reshape(16, 1)
+
+        def infer(self, x):
+            _time.sleep(0.002)
+            return np.asarray(x) @ self.w
+
+    def make_fleet(n, queue_capacity_rows=4096):
+        d = ClusterDirectory(heartbeat_timeout_s=5.0)
+        hosts, pumps, engines = [], [], []
+        for i in range(n):
+            eng = InferenceEngine(_SimDevice(), max_batch_size=8,
+                                  max_wait_ms=0.0,
+                                  queue_capacity_rows=queue_capacity_rows,
+                                  name=f"bench-h{i}")
+            h = LoopbackHost(i, engine=eng)
+            d.join(h)
+            pumps.append(HeartbeatPump(h, LoopbackTransport(d)))
+            hosts.append(h)
+            engines.append(eng)
+        for p in pumps:
+            p.pump_once()
+        return d, hosts, pumps, engines
+
+    def run_throughput(n_hosts, n_requests=300):
+        d, hosts, pumps, engines = make_fleet(n_hosts)
+        try:
+            fd = ClusterFrontDoor(d)
+            x = np.ones((8, 16), np.float32)   # one full bucket per req
+            fd.output(x)                        # warm the path
+            t0 = _time.perf_counter()
+            futs = [fd.submit(x) for _ in range(n_requests)]
+            for f in futs:
+                f.result(timeout=120)
+            dt = _time.perf_counter() - t0
+            return n_requests / dt
+        finally:
+            for h in hosts:
+                h.shutdown()
+
+    rps1 = run_throughput(1)
+    rps3 = run_throughput(3)
+
+    # ---- routed TTFT p50: generation streams over a 3-host fleet ------
+    from deeplearning4j_tpu.models import TransformerConfig, init_params
+    from deeplearning4j_tpu.serving import GenerationEngine
+
+    if on_tpu:
+        gcfg = TransformerConfig(causal=True, remat=False,
+                                 attention_impl="flash")
+        slots, max_len, n_streams, max_new = 8, 512, 24, 32
+    else:
+        gcfg = TransformerConfig(vocab_size=1024, hidden=128, layers=2,
+                                 heads=4, mlp_dim=512, max_seq=128,
+                                 dtype=jnp.float32, causal=True,
+                                 remat=False)
+        slots, max_len, n_streams, max_new = 2, 64, 9, 8
+
+    gparams = init_params(jax.random.PRNGKey(0), gcfg)
+    d = ClusterDirectory(heartbeat_timeout_s=5.0)
+    ghosts, gpumps = [], []
+    for i in range(3):
+        g = GenerationEngine(gparams, gcfg, slots=slots, max_len=max_len,
+                             queue_capacity=n_streams + slots,
+                             name=f"bench-g{i}")
+        h = LoopbackHost(i, generation=g)
+        d.join(h)
+        gpumps.append(HeartbeatPump(h, LoopbackTransport(d)))
+        ghosts.append(h)
+    for p in gpumps:
+        p.pump_once()
+    try:
+        fd = ClusterFrontDoor(d)
+        rng = np.random.default_rng(0)
+        # warm every host's executables out of the TTFT measurement
+        warm = [fd.submit_generate(
+            rng.integers(1, gcfg.vocab_size, 8).astype(np.int32),
+            max_new_tokens=2, host=i) for i in range(3)]
+        for h in warm:
+            h.result(timeout=600)
+        ttfts = []
+        handles = []
+        for _ in range(n_streams):
+            first = {"t": None}
+            t0 = _time.perf_counter()
+
+            def on_token(_tok, first=first, t0=t0):
+                if first["t"] is None:
+                    first["t"] = (_time.perf_counter() - t0) * 1e3
+
+            handles.append((first, fd.submit_generate(
+                rng.integers(1, gcfg.vocab_size, 12).astype(np.int32),
+                max_new_tokens=max_new, on_token=on_token)))
+        for first, h in handles:
+            h.result(timeout=600)
+            if first["t"] is not None:
+                ttfts.append(first["t"])
+        routed_ttft_p50 = float(np.median(ttfts)) if ttfts else None
+        gen_routed = fd.routed_by_host.to_dict()
+    finally:
+        for h in ghosts:
+            h.shutdown()
+
+    # ---- one-host-degraded shed mix -----------------------------------
+    clock = [0.0]
+    d = ClusterDirectory(heartbeat_timeout_s=1.0, probe_interval_s=100.0,
+                         clock=lambda: clock[0])
+    hosts, pumps, engines = [], [], []
+    for i in range(3):
+        eng = InferenceEngine(_SimDevice(), max_batch_size=8,
+                              max_wait_ms=0.0, queue_capacity_rows=1024,
+                              name=f"deg-h{i}")
+        h = LoopbackHost(i, engine=eng)
+        d.join(h)
+        pumps.append(HeartbeatPump(h, LoopbackTransport(d)))
+        hosts.append(h)
+        engines.append(eng)
+    for p in pumps:
+        p.pump_once()
+    try:
+        fd = ClusterFrontDoor(d)
+        # degrade host 0: breaker OPEN + heartbeat death
+        for _ in range(engines[0].breaker.failure_threshold):
+            engines[0].breaker.record_failure()
+        clock[0] += 2.0
+        for p in pumps[1:]:
+            p.pump_once()
+        ok = shed = 0
+        x = np.ones((8, 16), np.float32)
+        futs = []
+        for i in range(120):
+            try:
+                # a third of the burst is pinned to the dead host — the
+                # traffic that WOULD have landed there sheds typed
+                futs.append(fd.submit(x, host=0 if i % 3 == 0 else None))
+            except Exception:
+                shed += 1
+        for f in futs:
+            try:
+                f.result(timeout=120)
+                ok += 1
+            except Exception:
+                shed += 1
+        degraded = {
+            "requests": 120,
+            "served": ok,
+            "shed": shed,
+            "shed_reasons": fd.metrics.rejections_by_reason.to_dict(),
+            "routed_by_host": fd.routed_by_host.to_dict(),
+            "survivor_share": round(
+                (fd.routed_by_host.get("h1")
+                 + fd.routed_by_host.get("h2")) / max(ok, 1), 4),
+        }
+    finally:
+        for h in hosts:
+            h.shutdown()
+
+    return {
+        "throughput_rps_1host": round(rps1, 2),
+        "throughput_rps_3host": round(rps3, 2),
+        "scaling_3host": round(rps3 / rps1, 4) if rps1 else None,
+        "routed_ttft_p50_ms": round(routed_ttft_p50, 3)
+            if routed_ttft_p50 is not None else None,
+        "gen_routed_by_host": gen_routed,
+        "one_host_degraded": degraded,
+    }
 
 
 if __name__ == "__main__":

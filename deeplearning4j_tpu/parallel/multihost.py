@@ -12,6 +12,7 @@ shim plus the health/elasticity conventions (checkpoint-restart recovery, ref
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 import jax
@@ -51,16 +52,37 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+# jitted barrier executables, one per device tuple: repeated control-plane
+# syncs (checkpoint rounds, membership rendezvous) must not re-trace,
+# re-lower and re-compile a fresh executable — and re-mint a fresh Mesh —
+# every call. The device set only changes on a (re)initialize, so the
+# cache stays size ~1 in practice. The lock is module-level: lazy
+# check-then-set init of the lock itself would race two first callers
+# into concurrent compiles of the same executable.
+_BARRIER_CACHE: dict = {}
+_BARRIER_LOCK = threading.Lock()
+
+
+def _barrier_executable(devs: tuple):
+    with _BARRIER_LOCK:
+        fn = _BARRIER_CACHE.get(devs)
+        if fn is None:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+            mesh = Mesh(list(devs), ("all",))
+            fn = jax.jit(lambda a: a.sum(),
+                         in_shardings=NamedSharding(mesh, P("all")))
+            _BARRIER_CACHE[devs] = fn
+        return fn
+
+
 def barrier(name: str = "barrier"):
     """Host-level barrier via a tiny psum across all devices (control-plane
     sync; ref: parameter-server handshake/heartbeat round). Blocks until all
     hosts participate — there is no timeout plumbing in the XLA collective;
-    rely on the runtime's own liveness handling for hung peers."""
+    rely on the runtime's own liveness handling for hung peers. The jitted
+    barrier (and its Mesh) is cached per device tuple, so repeated syncs
+    dispatch the warm executable instead of recompiling."""
     import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    devs = jax.devices()
-    mesh = Mesh(devs, ("all",))
+    devs = tuple(jax.devices())
     x = jnp.ones((len(devs),))
-    y = jax.jit(lambda a: a.sum(),
-                in_shardings=NamedSharding(mesh, P("all")))(x)
-    return float(y)
+    return float(_barrier_executable(devs)(x))

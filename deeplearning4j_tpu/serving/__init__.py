@@ -6,8 +6,14 @@ ref: deeplearning4j-parallel-wrapper ParallelInference BATCHED mode,
 rebuilt around XLA's compile-once/dispatch-many execution model — see
 serving/engine.py and serving/generation.py for the design notes)."""
 from deeplearning4j_tpu.serving.admission import (  # noqa: F401
-    AdmissionController, DeadlineExceededError, KVBlocksExhaustedError,
-    QueueFullError, QuotaExceededError, RejectedError, SloShedError,
+    AdmissionController, ClusterCapacityError, DeadlineExceededError,
+    HostUnavailableError, KVBlocksExhaustedError, QueueFullError,
+    QuotaExceededError, RejectedError, SloShedError,
+)
+from deeplearning4j_tpu.serving.cluster import (  # noqa: F401
+    ClusterDirectory, ClusterFrontDoor, ClusterStatsAggregator,
+    HeartbeatPump, HostHandle, HostStatus, HttpTransport, LoopbackHost,
+    LoopbackTransport, all_directories,
 )
 from deeplearning4j_tpu.serving.engine import InferenceEngine, bucket_ladder  # noqa: F401
 from deeplearning4j_tpu.serving.faults import (  # noqa: F401
@@ -58,4 +64,8 @@ __all__ = [
     "SloBurnGovernor", "DEFAULT_TENANT", "PRIORITIES",
     "QuotaExceededError", "SloShedError", "RetryBudget",
     "RetryBudgetExhaustedError",
+    "ClusterCapacityError", "HostUnavailableError", "ClusterDirectory",
+    "ClusterFrontDoor", "ClusterStatsAggregator", "HeartbeatPump",
+    "HostHandle", "HostStatus", "HttpTransport", "LoopbackHost",
+    "LoopbackTransport", "all_directories",
 ]

@@ -78,6 +78,34 @@ class SloShedError(RejectedError):
         self.detail = detail
 
 
+class ClusterCapacityError(RejectedError):
+    """The whole FLEET is out of capacity (reason 'cluster_capacity'):
+    the pod-slice front door (serving/cluster.py) found live hosts but
+    none with admission headroom — the cross-host analogue of
+    queue-full, typed separately so dashboards distinguish "this host is
+    busy" from "the deployment is saturated". Carries the ``hosts``
+    joined and ``alive`` counts at shed time."""
+
+    def __init__(self, msg: str, hosts: Optional[int] = None,
+                 alive: Optional[int] = None):
+        super().__init__(msg, "cluster_capacity")
+        self.hosts = hosts
+        self.alive = alive
+
+
+class HostUnavailableError(RejectedError):
+    """No usable host for this request (reason 'host_unavailable'): the
+    pinned/affine host is dead or stale past its probe allowance, or
+    every candidate is — distinct from cluster_capacity because the cure
+    is different (bring hosts back vs add capacity). ``host`` names the
+    pinned host when one was, else None (fleet-wide outage/degraded
+    quorum)."""
+
+    def __init__(self, msg: str, host: Optional[int] = None):
+        super().__init__(msg, "host_unavailable")
+        self.host = host
+
+
 class KVBlocksExhaustedError(RejectedError):
     """The paged KV-cache block pool cannot serve this request (reason
     'kv_blocks_exhausted'): its worst-case block reservation exceeds what
@@ -212,10 +240,13 @@ class AdmissionController:
             if req.deadline_t is not None:
                 self._has_deadlines = True
             if self.policy is not None:
-                # quota before capacity: a flooding tenant's excess sheds
-                # as ITS quota_exceeded, never as queue_full backpressure
-                # on everyone (tokens spent here are not refunded on a
-                # later capacity rejection — quota meters offered load)
+                # backlog bound before the rate bucket (a depth shed must
+                # not also drain quota tokens), quota before capacity: a
+                # flooding tenant's excess sheds as ITS quota_exceeded,
+                # never as queue_full backpressure on everyone (tokens
+                # spent here are not refunded on a later capacity
+                # rejection — quota meters offered load)
+                self._q.check_depth(req)
                 self._q.charge_quota(req)
             if self._rows + req.rows > self.capacity_rows:
                 raise QueueFullError(

@@ -129,6 +129,15 @@ class TenantPolicy:
     priority: str = "interactive"
     quota: Optional[float] = None
     quota_burst: Optional[float] = None
+    # per-tenant queue-depth bound (ROADMAP 4a): at most this many cost
+    # units of THIS tenant's work queued at once (rows for the batch
+    # engine, requests for generation; None = unbounded). Quotas meter
+    # the tenant's RATE; max_queued bounds its standing BACKLOG — without
+    # it, capacity is global and entry to a starved queue is still a
+    # race: a slow-drained tenant can hold arbitrarily much of
+    # capacity_rows while WFQ only arbitrates what is already queued.
+    # Excess sheds typed 'quota_exceeded' at admit.
+    max_queued: Optional[int] = None
 
     def __post_init__(self):
         if self.weight <= 0:
@@ -142,6 +151,9 @@ class TenantPolicy:
         if self.quota_burst is not None and self.quota_burst <= 0:
             raise ValueError(
                 f"quota_burst must be positive, got {self.quota_burst}")
+        if self.max_queued is not None and self.max_queued <= 0:
+            raise ValueError(
+                f"max_queued must be positive, got {self.max_queued}")
 
 
 class QosPolicy:
@@ -289,7 +301,8 @@ class QosPolicy:
         roll-up, which a metrics object cannot tie back to a policy)."""
         return {
             "tenants": {n: {"weight": t.weight, "priority": t.priority,
-                            "quota": t.quota, "quota_burst": t.quota_burst}
+                            "quota": t.quota, "quota_burst": t.quota_burst,
+                            "max_queued": t.max_queued}
                         for n, t in self.tenants.items()},
             "default_weight": self.default_weight,
             "default_priority": self.default_priority,
@@ -374,6 +387,36 @@ class TenantQueues:
         self._seq = 0   # arrival tiebreak: equal finish tags pop in order
         self._prunes = 0
         self._head: Optional[Request] = None   # cached _select result
+        # queued cost units per tenant (across both classes) — the
+        # max_queued backlog bound's ledger; entries drop at zero so
+        # rotating tenant ids don't grow it
+        self._queued_cost: Dict[str, int] = {}
+
+    def _cost_delta(self, req: Request, d: int):
+        c = self._queued_cost.get(req.tenant, 0) + d * req.rows
+        if c > 0:
+            self._queued_cost[req.tenant] = c
+        else:
+            self._queued_cost.pop(req.tenant, None)
+
+    # ------------------------------------------------------- depth bound
+    def check_depth(self, req: Request):
+        """Per-tenant backlog gate (TenantPolicy.max_queued): admitting
+        ``req`` must not push its tenant's queued cost past the bound —
+        excess sheds typed 'quota_exceeded' BEFORE the rate bucket is
+        charged (a backlog shed should not also drain the tenant's
+        quota) and before global capacity, so one tenant's standing
+        backlog cannot convert into queue-full for everyone else."""
+        tp = self.policy.tenant(req.tenant)
+        if tp.max_queued is None:
+            return
+        cur = self._queued_cost.get(req.tenant, 0)
+        if cur + req.rows > tp.max_queued:
+            raise QuotaExceededError(
+                f"tenant {req.tenant!r} has {cur} {self.unit} queued; "
+                f"admitting {req.rows} more would exceed its max_queued "
+                f"bound of {tp.max_queued} — drain or back off",
+                tenant=req.tenant, quota=tp.quota)
 
     # ---------------------------------------------------------------- quota
     def charge_quota(self, req: Request):
@@ -418,6 +461,7 @@ class TenantQueues:
         self._classes[req.priority].setdefault(
             req.tenant, deque()).append(req)
         self._len += 1
+        self._cost_delta(req, +1)
         self._head = None
 
     def appendleft(self, req: Request):
@@ -427,6 +471,7 @@ class TenantQueues:
         self._classes[req.priority].setdefault(
             req.tenant, deque()).appendleft(req)
         self._len += 1
+        self._cost_delta(req, +1)
         self._head = None
 
     def _select(self) -> Optional[Request]:
@@ -475,6 +520,7 @@ class TenantQueues:
             del self._classes[head.priority][head.tenant]
         self._vtime = max(self._vtime, head.qos_start_tag)
         self._len -= 1
+        self._cost_delta(head, -1)
         if self._len == 0:
             # idle reset (standard SFQ): an empty system has no backlog
             # to be fair against — virtual time jumps past every
@@ -541,6 +587,8 @@ class TenantQueues:
                     # queue depth.
                     self._finish.pop((tenant, p), None)
         self._len -= len(shed)
+        for r in shed:
+            self._cost_delta(r, -1)
         if shed:
             self._head = None
             # mirror popleft's bookkeeping: an expiry-drain must not
@@ -567,6 +615,7 @@ class TenantQueues:
         for tenants in self._classes.values():
             tenants.clear()
         self._finish.clear()
+        self._queued_cost.clear()
         self._len = 0
         self._head = None
 

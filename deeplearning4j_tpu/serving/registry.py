@@ -231,7 +231,7 @@ class ModelRegistry:
                  retry_budget_ratio: Optional[float] = None,
                  retry_budget_burst: float = 10.0,
                  metrics: Optional[ServingMetrics] = None,
-                 tracer=None, recorder=None):
+                 tracer=None, recorder=None, cluster=None):
         self.default_buckets = tuple(default_buckets)
         self.breaker_failure_threshold = breaker_failure_threshold
         self.breaker_cooldown_s = breaker_cooldown_s
@@ -254,6 +254,15 @@ class ModelRegistry:
         self._lock = threading.RLock()
         self._engines: List[object] = []   # engines spun up via engine()
         self._closed = False
+        # pod-slice control plane (serving/cluster.py ClusterDirectory):
+        # cluster=None (the default) is the single-host stack, untouched
+        # — no host layer, no directory, identical construction path
+        # (bitwise-guarded). With a directory, every engine this registry
+        # spins up attaches to this process's LoopbackHost (host id =
+        # multihost.process_index()) which joins the directory, and
+        # front_door() serves the whole fleet.
+        self.cluster = cluster
+        self._local_host = None
 
     # --------------------------------------------------------------- teardown
     def __enter__(self) -> "ModelRegistry":
@@ -281,6 +290,44 @@ class ModelRegistry:
                 raise RuntimeError("registry is shut down")
             self._engines.append(eng)
         return eng
+
+    # ------------------------------------------------------- pod-slice tier
+    def _cluster_host(self):
+        """This process's LoopbackHost in the cluster directory (lazy:
+        minted and joined on the first engine when ``cluster=`` was
+        given; host id derives from multihost.process_index(), so a
+        real pod-slice job gets one host per process for free)."""
+        from deeplearning4j_tpu.parallel import multihost
+        from deeplearning4j_tpu.serving.cluster import LoopbackHost
+
+        created = False
+        with self._lock:
+            if self._local_host is None:
+                self._local_host = LoopbackHost(
+                    multihost.process_index(), tracer=self._tracer)
+                created = True
+            host = self._local_host
+        if created:
+            # join OUTSIDE the registry lock: the directory takes its own
+            # heartbeat lock, and membership calls must not nest under
+            # ours (lock-discipline)
+            self.cluster.join(host)
+        return host
+
+    def front_door(self, **kwargs):
+        """A :class:`~deeplearning4j_tpu.serving.cluster.ClusterFrontDoor`
+        over this registry's directory — the fleet-wide submit surface.
+        Requires ``cluster=`` at construction."""
+        from deeplearning4j_tpu.serving.cluster import ClusterFrontDoor
+
+        if self.cluster is None:
+            raise ValueError(
+                "this registry is single-host (cluster=None); pass a "
+                "ClusterDirectory at construction to serve a pod slice")
+        if self._tracer is not None:
+            kwargs.setdefault("tracer", self._tracer)
+        kwargs.setdefault("recorder", self._recorder)
+        return ClusterFrontDoor(self.cluster, **kwargs)
 
     # ------------------------------------------------------------- lifecycle
     def deploy(self, name: str, model, *, version: Optional[int] = None,
@@ -536,7 +583,10 @@ class ModelRegistry:
         try:
             if dep.warmup_example is not None:
                 eng.warmup(dep.warmup_example)
-            return self._track(eng)
+            self._track(eng)
+            if self.cluster is not None:
+                self._cluster_host().attach_engine(eng)
+            return eng
         except BaseException:
             eng.shutdown(wait=False)
             raise
@@ -571,7 +621,10 @@ class ModelRegistry:
         try:
             for pid, toks in (shared_prefixes or {}).items():
                 eng.register_prefix(toks, prefix_id=pid)
-            return self._track(eng)
+            self._track(eng)
+            if self.cluster is not None:
+                self._cluster_host().attach_generation(eng)
+            return eng
         except BaseException:
             eng.shutdown(wait=False)
             raise

@@ -67,6 +67,10 @@ TERMINAL_REASONS = (
     # per-tenant quota bucket dry, SLO-burn governor shedding batch-class
     # traffic, and the deployment retry budget refusing to amplify a storm
     "quota_exceeded", "slo_shed", "retry_budget_exhausted",
+    # pod-slice control plane (serving/cluster.py): the whole fleet is
+    # out of admission headroom, and no usable host (dead/stale past its
+    # probe allowance, or a pinned/prefix-affine host gone)
+    "cluster_capacity", "host_unavailable",
 )
 
 

@@ -492,6 +492,15 @@ class _Handler(BaseHTTPRequestHandler):
             # 'poisoned' since PR 5.
             self._json(self._metrics_rollup("qos"))
             return
+        if parts == ["api", "cluster"]:
+            # pod-slice control-plane view (serving/cluster.py): one
+            # entry per live ClusterDirectory in this process — per-host
+            # slots/blocks/breaker/SLO + heartbeat age, the fleet
+            # roll-up (alive/quorum/degraded, summed capacity), and
+            # each front door's routed/shed mix
+            from deeplearning4j_tpu.serving.cluster import all_directories
+            self._json([d.api_snapshot() for d in all_directories()])
+            return
         if parts == ["api", "traces"]:
             # finished request traces retained by every Tracer in this
             # process (serving/tracing.py tail sampling: errors always,
@@ -675,16 +684,41 @@ class RemoteStatsStorageRouter(StatsStorage):
     ``retries`` times with a short backoff, then the report is DROPPED with a
     one-time warning (the reference queues and retries asynchronously; a
     drop-after-retry keeps the same "fit() survives a UI outage" contract
-    without a background thread)."""
+    without a background thread).
+
+    ``queue_capacity > 0`` adds the reference's asynchronous mode: reports
+    enqueue into a BOUNDED queue drained by one background sender thread
+    (same retry-then-drop delivery per report), so the posting thread
+    never blocks on the network at all — the mode the serving cluster's
+    heartbeat/trace-aggregation path (serving/cluster.py HttpTransport)
+    rides. On overflow the NEWEST report is dropped and counted
+    (``dropped`` / ``dropped_overflow``): heartbeats and metrics are
+    freshness-dated, so a backlog older than the queue is worth more than
+    the report that found it full. ``flush()`` drains for tests/shutdown."""
 
     def __init__(self, url: str, timeout: float = 5.0, retries: int = 2,
-                 retry_delay: float = 0.2):
+                 retry_delay: float = 0.2, queue_capacity: int = 0):
         self.url = url.rstrip("/") + "/remote/receive"
         self.timeout = timeout
         self.retries = retries
         self.retry_delay = retry_delay
         self.dropped = 0
+        self.dropped_overflow = 0
+        self.delivered = 0
         self._warned = False
+        if queue_capacity < 0:
+            raise ValueError("queue_capacity must be >= 0 (0 = synchronous)")
+        self.queue_capacity = queue_capacity
+        self._q: Optional[list] = None
+        if queue_capacity > 0:
+            self._q = []
+            self._q_cv = threading.Condition()
+            self._sending = False
+            self._closed = False
+            self._sender = threading.Thread(
+                target=self._drain, daemon=True,
+                name="remote-stats-router-sender")
+            self._sender.start()
 
     def _post(self, payload: dict):
         data = json.dumps(payload).encode()
@@ -707,10 +741,86 @@ class RemoteStatsStorageRouter(StatsStorage):
                         f"UI server at {self.url} unreachable ({e})")
                 return None
 
+    # ------------------------------------------------------- async queue
+    def _enqueue(self, payload: dict):
+        with self._q_cv:
+            if self._closed:
+                # post-close submissions are dropped but COUNTED: every
+                # report is either delivered or accounted for in
+                # ``dropped`` — the invariant dashboards reconcile on
+                self.dropped += 1
+                return
+            if len(self._q) >= self.queue_capacity:
+                # drop-on-overflow, NEWEST report: the queued backlog is
+                # older and its delivery order matters to pollers; both
+                # counters move so dashboards separate "network down"
+                # (dropped only) from "queue undersized" (overflow too)
+                self.dropped += 1
+                self.dropped_overflow += 1
+                if not self._warned:
+                    self._warned = True
+                    warnings.warn(
+                        f"RemoteStatsStorageRouter: bounded queue "
+                        f"(capacity {self.queue_capacity}) overflowed; "
+                        f"dropping reports")
+                return
+            self._q.append(payload)
+            self._q_cv.notify()
+
+    def _drain(self):
+        while True:
+            with self._q_cv:
+                while not self._q and not self._closed:
+                    self._q_cv.wait()
+                if self._closed and not self._q:
+                    return
+                payload = self._q.pop(0)
+                self._sending = True
+            try:
+                if self._post(payload) is not None:
+                    self.delivered += 1
+            finally:
+                with self._q_cv:
+                    self._sending = False
+                    self._q_cv.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until the bounded queue is drained (async mode only;
+        a no-op synchronously). True when fully drained in time."""
+        if self._q is None:
+            return True
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._q_cv:
+            while self._q or self._sending:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._q_cv.wait(remaining)
+        return True
+
+    def close(self, timeout: float = 5.0):
+        """Stop the sender after draining what it can (async mode)."""
+        if self._q is None:
+            return
+        self.flush(timeout=timeout)
+        with self._q_cv:
+            self._closed = True
+            self._q_cv.notify_all()
+        self._sender.join(timeout=2.0)
+
     def putUpdate(self, sessionId, typeId, workerId, report):
-        self._post({"kind": "update", "sessionId": sessionId, "typeId": typeId,
-                    "workerId": workerId, "report": report})
+        payload = {"kind": "update", "sessionId": sessionId,
+                   "typeId": typeId, "workerId": workerId, "report": report}
+        if self._q is not None:
+            self._enqueue(payload)
+        else:
+            self._post(payload)
 
     def putStaticInfo(self, sessionId, typeId, workerId, info):
-        self._post({"kind": "static", "sessionId": sessionId, "typeId": typeId,
-                    "workerId": workerId, "info": info})
+        payload = {"kind": "static", "sessionId": sessionId,
+                   "typeId": typeId, "workerId": workerId, "info": info}
+        if self._q is not None:
+            self._enqueue(payload)
+        else:
+            self._post(payload)

@@ -1,0 +1,742 @@
+"""Pod-slice serving control plane tests (serving/cluster.py — ISSUE 10).
+
+The whole tier runs single-process on CPU: LoopbackHosts wrap REAL
+engines (threads as hosts), heartbeats are pumped explicitly against an
+injected fake clock (no sleeps in tier-1), and the acceptance scenarios
+from the issue run end to end:
+
+- directory membership: join/leave determinism, re-join replaces,
+  heartbeat staleness + probe-only discipline, quorum-degraded flag;
+- front-door routing: least-loaded dispatch, typed ``cluster_capacity``
+  when the fleet is full, typed ``host_unavailable`` when no usable host
+  remains, with the routing decision recorded in the trace;
+- THE fleet-health acceptance test: on a 3-host loopback cluster,
+  tripping host A's deployment breaker drains A's traffic (B/C absorb
+  it, A gets probe traffic only), and killing A's heartbeat sheds typed
+  ``host_unavailable``;
+- single-host inertness: ``cluster=None`` keeps the registry's exact
+  construction path, outputs ride the same engines bitwise, and the
+  per-host donated-executable bound ``len(buckets)+1`` holds under the
+  front door;
+- one-store observability: per-host metrics land under ``h<id>`` worker
+  ids, trace ids host-prefix (``h3/tenant/trace-id`` Chrome lanes), and
+  ``GET /api/cluster`` serves the fleet roll-up;
+- taxonomy: the two new terminal reasons appear exactly once.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import (
+    ClusterCapacityError, ClusterDirectory, ClusterFrontDoor,
+    ClusterStatsAggregator, HeartbeatPump, HostStatus, HostUnavailableError,
+    InferenceEngine, LoopbackHost, LoopbackTransport, ModelAdapter,
+    ModelRegistry, QueueFullError, Tracer,
+)
+from deeplearning4j_tpu.serving.cluster import HttpTransport
+from deeplearning4j_tpu.serving.tracing import TERMINAL_REASONS
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+
+class MlpAdapter(ModelAdapter):
+    """Pure-numpy adapter: no jit, no compile cost — the cluster tests
+    exercise the control plane, not the device path. ``gate`` (an Event)
+    wedges dispatch so tests can hold work in flight deterministically."""
+
+    kind = "tiny-mlp"
+
+    def __init__(self, gate: threading.Event = None, delay_s: float = 0.0):
+        super().__init__(model=None)
+        self.w = np.linspace(-1.0, 1.0, 6, dtype=np.float32).reshape(6, 1)
+        self.gate = gate
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def infer(self, x):
+        self.calls += 1
+        if self.gate is not None:
+            self.gate.wait(timeout=30.0)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return np.asarray(x) @ self.w
+
+
+def row(n=2):
+    return np.ones((n, 6), np.float32)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_cluster(n_hosts=3, *, clock=None, heartbeat_timeout_s=1.0,
+                 queue_capacity_rows=64, tracer=None, gates=None,
+                 delay_s=0.0, **dir_kwargs):
+    """n MLP hosts joined + heartbeated once; returns
+    (directory, hosts, pumps, engines)."""
+    d = ClusterDirectory(heartbeat_timeout_s=heartbeat_timeout_s,
+                         clock=clock if clock is not None else time.monotonic,
+                         **dir_kwargs)
+    hosts, pumps, engines = [], [], []
+    for i in range(n_hosts):
+        gate = gates[i] if gates is not None else None
+        eng = InferenceEngine(MlpAdapter(gate=gate, delay_s=delay_s),
+                              max_batch_size=8,
+                              max_wait_ms=0.0,
+                              queue_capacity_rows=queue_capacity_rows,
+                              tracer=tracer, name=f"e{i}")
+        h = LoopbackHost(i, engine=eng, tracer=tracer)
+        d.join(h)
+        pumps.append(HeartbeatPump(h, LoopbackTransport(d)))
+        hosts.append(h)
+        engines.append(eng)
+    for p in pumps:
+        p.pump_once()
+    return d, hosts, pumps, engines
+
+
+def shutdown_all(hosts):
+    for h in hosts:
+        h.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Directory: membership + health
+# --------------------------------------------------------------------------
+class TestDirectory:
+    def test_join_leave_determinism(self):
+        clock = FakeClock()
+        d = ClusterDirectory(heartbeat_timeout_s=1.0, clock=clock)
+        handles = {i: LoopbackHost(i) for i in (3, 1, 2)}
+        for i in (3, 1, 2):
+            assert d.join(handles[i]) == i
+        assert d.host_ids() == [1, 2, 3]        # sorted, insertion-free
+        assert len(d) == 3
+        # re-join with the same id REPLACES the handle (restarted host)
+        fresh = LoopbackHost(2)
+        d.join(fresh)
+        assert d.handle(2) is fresh
+        assert d.host_ids() == [1, 2, 3]
+        assert d.leave(2) is True
+        assert d.leave(2) is False               # idempotent
+        assert d.host_ids() == [1, 3]
+        assert d.handle(2) is None
+
+    def test_join_rejects_negative_id(self):
+        d = ClusterDirectory()
+        with pytest.raises(ValueError):
+            d.join(LoopbackHost(-1))
+
+    def test_heartbeat_staleness_fake_clock(self):
+        clock = FakeClock()
+        d = ClusterDirectory(heartbeat_timeout_s=1.0, clock=clock)
+        h = LoopbackHost(0, engine=None)
+        d.join(h)
+        # a joined host starts alive (fresh staleness clock)
+        assert d.alive(0)
+        clock.advance(0.9)
+        assert d.alive(0)
+        clock.advance(0.2)                       # 1.1s since join
+        assert not d.alive(0)
+        assert d.stale_ids() == [0]
+        d.heartbeat(HostStatus(host_id=0, seq=1))
+        assert d.alive(0) and d.alive_ids() == [0]
+        clock.advance(2.0)
+        assert not d.alive(0)
+
+    def test_out_of_order_heartbeat_kept_newer(self):
+        clock = FakeClock()
+        d = ClusterDirectory(heartbeat_timeout_s=10.0, clock=clock)
+        d.join(LoopbackHost(0))
+        d.heartbeat(HostStatus(host_id=0, queue_depth=5, seq=7))
+        d.heartbeat(HostStatus(host_id=0, queue_depth=0, seq=3))  # late
+        assert d.status(0).queue_depth == 5      # newer view retained
+
+    def test_restarted_host_fresh_seq_accepted(self):
+        """Review regression: a restarted host's seq counter restarts at
+        1 — its fresh beats must not be rejected as out-of-order against
+        the pre-restart counter, via EITHER recovery path: an explicit
+        re-join (clears the retained status), or heartbeats resuming
+        after staleness (lower seq accepted once the old view is
+        stale)."""
+        clock = FakeClock()
+        d = ClusterDirectory(heartbeat_timeout_s=1.0, clock=clock)
+        d.join(LoopbackHost(0))
+        d.heartbeat(HostStatus(host_id=0, queue_depth=9, seq=7200))
+        # path 1: crash + re-join, then beats from a fresh counter
+        d.join(LoopbackHost(0))
+        d.heartbeat(HostStatus(host_id=0, queue_depth=1, seq=1))
+        assert d.status(0).queue_depth == 1 and d.alive(0)
+        # path 2: no re-join — beats just resume after staleness
+        d.heartbeat(HostStatus(host_id=0, queue_depth=9, seq=7200))
+        clock.advance(2.0)                       # stale
+        assert not d.alive(0)
+        d.heartbeat(HostStatus(host_id=0, queue_depth=2, seq=1))
+        assert d.status(0).queue_depth == 2 and d.alive(0)
+
+    def test_probe_allowance_one_per_window(self):
+        clock = FakeClock()
+        d = ClusterDirectory(heartbeat_timeout_s=1.0, probe_interval_s=1.0,
+                             clock=clock)
+        d.join(LoopbackHost(0))
+        clock.advance(5.0)                       # stale
+        assert d.allow_probe(0) is True
+        assert d.allow_probe(0) is False         # window spent
+        clock.advance(1.1)
+        assert d.allow_probe(0) is True          # next window
+        # a fresh heartbeat clears the probe window entirely
+        d.heartbeat(HostStatus(host_id=0, seq=1))
+        assert d.alive(0)
+
+    def test_quorum_degraded(self):
+        clock = FakeClock()
+        d = ClusterDirectory(heartbeat_timeout_s=1.0, clock=clock)
+        for i in range(3):
+            d.join(LoopbackHost(i))
+        assert d.quorum() == 2 and not d.degraded()
+        clock.advance(2.0)                       # everyone stale
+        d.heartbeat(HostStatus(host_id=0, seq=1))
+        d.heartbeat(HostStatus(host_id=1, seq=1))
+        assert not d.degraded()                  # 2/3 alive >= quorum
+        clock.advance(2.0)
+        d.heartbeat(HostStatus(host_id=0, seq=2))
+        assert d.degraded()                      # 1/3 alive < 2
+        # explicit quorum override
+        d2 = ClusterDirectory(heartbeat_timeout_s=1.0, clock=clock,
+                              quorum=1)
+        d2.join(LoopbackHost(0))
+        assert d2.quorum() == 1
+
+    def test_ingest_http_heartbeats(self):
+        """The HTTP transport's coordinator side: heartbeats posted as
+        ClusterHeartbeat updates into a storage fold into the view,
+        incrementally (the cursor skips already-applied reports)."""
+        clock = FakeClock()
+        d = ClusterDirectory(heartbeat_timeout_s=1.0, clock=clock)
+        d.join(LoopbackHost(4))
+        store = InMemoryStatsStorage()
+        store.putUpdate("cluster", HttpTransport.TYPE_ID, "h4",
+                        HostStatus(host_id=4, queue_depth=3, seq=1).to_dict())
+        assert d.ingest(store) == 1
+        assert d.status(4).queue_depth == 3
+        assert d.ingest(store) == 0              # nothing new
+        store.putUpdate("cluster", HttpTransport.TYPE_ID, "h4",
+                        HostStatus(host_id=4, queue_depth=9, seq=2).to_dict())
+        assert d.ingest(store) == 1
+        assert d.status(4).queue_depth == 9
+        # a malformed report is skipped, not fatal
+        store.putUpdate("cluster", HttpTransport.TYPE_ID, "h4",
+                        {"garbage": True})
+        assert d.ingest(store) == 0
+
+
+# --------------------------------------------------------------------------
+# Front door: routing + typed fleet shedding
+# --------------------------------------------------------------------------
+class TestFrontDoorRouting:
+    def test_least_loaded_balances(self):
+        # 5 ms simulated device time: all 30 submits land before the
+        # first completion, so the outstanding-aware load key makes the
+        # 10/10/10 split deterministic (not a race against dispatch)
+        d, hosts, pumps, engines = make_cluster(3, delay_s=0.005)
+        try:
+            fd = ClusterFrontDoor(d)
+            futs = [fd.submit(row()) for _ in range(30)]
+            for f in futs:
+                f.result(timeout=30)
+            routed = fd.routed_by_host.to_dict()
+            assert set(routed) == {"h0", "h1", "h2"}
+            assert all(v == 10 for v in routed.values()), routed
+            # front-door SLO view saw every terminal
+            slo = fd.metrics.slo_windows["10s"].stats()
+            assert slo["ok"] == 30 and slo["errors"] == 0
+        finally:
+            shutdown_all(hosts)
+
+    def _wedge_full(self, hosts, engines, gates):
+        """Deterministically wedge every host: one request in flight
+        (dispatcher blocked on the gate) + the queue filled to exact
+        capacity via direct engine submits. Returns the held futures."""
+        held = []
+        for eng in engines:
+            held.append(eng.submit(row(2)))      # dispatcher takes this
+            deadline = time.time() + 10
+            while eng.queue_depth_rows != 0 and time.time() < deadline:
+                time.sleep(0.005)                # wait until it's in flight
+            assert eng.queue_depth_rows == 0
+            while True:                          # now fill the queue
+                try:
+                    held.append(eng.submit(row(2)))
+                except QueueFullError:
+                    break
+        return held
+
+    def test_cluster_capacity_typed_when_fleet_full(self):
+        """Every host alive but wedged with a full queue (and the
+        heartbeats say so): the front door sheds typed
+        'cluster_capacity' (counted + SLO-recorded) without bouncing."""
+        gates = [threading.Event() for _ in range(2)]
+        d, hosts, pumps, engines = make_cluster(
+            2, gates=gates, queue_capacity_rows=4)
+        tr = Tracer(sample_rate=1.0)
+        try:
+            fd = ClusterFrontDoor(d, tracer=tr)
+            held = self._wedge_full(hosts, engines, gates)
+            for p in pumps:
+                p.pump_once()         # heartbeats now report full queues
+            with pytest.raises(ClusterCapacityError) as ei:
+                fd.submit(row(2))
+            assert ei.value.reason == "cluster_capacity"
+            assert ei.value.hosts == 2 and ei.value.alive == 2
+            assert fd.metrics.rejections_by_reason.get(
+                "cluster_capacity") == 1
+            assert fd.routed_by_host.to_dict() == {}   # nothing bounced
+            shed_traces = [t for t in tr.traces()
+                           if t.reason == "cluster_capacity"]
+            assert shed_traces, [t.reason for t in tr.traces()]
+            assert "cluster.shed" in shed_traces[0].event_names()
+            for g in gates:
+                g.set()
+            for f in held:
+                f.result(timeout=30)
+        finally:
+            for g in gates:
+                g.set()
+            shutdown_all(hosts)
+
+    def test_capacity_bounces_shed_cluster_capacity(self):
+        """Heartbeat lag: the view says both hosts have room, but their
+        queues filled since the last beat. Every candidate bounces
+        queue_full — the final shed must type as cluster_capacity (the
+        cure is capacity), NOT host_unavailable (the hosts are fine)."""
+        gates = [threading.Event() for _ in range(2)]
+        d, hosts, pumps, engines = make_cluster(
+            2, gates=gates, queue_capacity_rows=4)
+        try:
+            fd = ClusterFrontDoor(d)
+            held = self._wedge_full(hosts, engines, gates)
+            # NO fresh heartbeat: the directory still believes both
+            # hosts are empty, so the front door routes, bounces on
+            # both, and converts the exhausted route into capacity
+            with pytest.raises(ClusterCapacityError) as ei:
+                fd.submit(row(2))
+            assert ei.value.reason == "cluster_capacity"
+            assert isinstance(ei.value.__cause__, QueueFullError)
+            for g in gates:
+                g.set()
+            for f in held:
+                f.result(timeout=30)
+        finally:
+            for g in gates:
+                g.set()
+            shutdown_all(hosts)
+
+    def test_bounce_reroutes_on_heartbeat_lag(self):
+        """The heartbeat view says a host has room but its queue filled
+        since the last beat: the front door retries the next candidate
+        instead of failing the caller."""
+        gates = [threading.Event(), None]
+        d, hosts, pumps, engines = make_cluster(
+            2, gates=[gates[0], None], queue_capacity_rows=2)
+        try:
+            fd = ClusterFrontDoor(d)
+            # fill host 0 (gated) behind a stale heartbeat claiming empty
+            held = []
+            while True:
+                try:
+                    held.append(hosts[0].engine.submit(row(2)))
+                except QueueFullError:
+                    break
+                if len(held) > 8:
+                    pytest.fail("queue never filled")
+            # heartbeats still say h0 is empty -> fd routes there first,
+            # bounces on its QueueFullError, lands on h1
+            fut = fd.submit(row(2))
+            assert np.asarray(fut.result(timeout=30).jax).shape == (2, 1)
+            assert fd.routed_by_host.to_dict() == {"h1": 1.0}
+            gates[0].set()
+            for f in held:
+                f.result(timeout=30)
+        finally:
+            gates[0].set()
+            shutdown_all(hosts)
+
+    def test_host_unavailable_when_all_stale(self):
+        clock = FakeClock()
+        d, hosts, pumps, engines = make_cluster(2, clock=clock)
+        tr = Tracer(sample_rate=1.0)
+        try:
+            fd = ClusterFrontDoor(d, tracer=tr)
+            clock.advance(5.0)                  # both hosts stale
+            # the two probe allowances route, then typed shed
+            assert fd.submit(row()).result(timeout=30) is not None
+            assert fd.submit(row()).result(timeout=30) is not None
+            with pytest.raises(HostUnavailableError) as ei:
+                fd.submit(row())
+            assert ei.value.reason == "host_unavailable"
+            assert "quorum-degraded" in str(ei.value)
+            assert d.degraded()
+            assert fd.metrics.rejections_by_reason.get(
+                "host_unavailable") == 1
+        finally:
+            shutdown_all(hosts)
+
+    def test_route_decision_recorded_in_trace(self):
+        d, hosts, pumps, engines = make_cluster(1)
+        tr = Tracer(sample_rate=1.0)
+        try:
+            fd = ClusterFrontDoor(d, tracer=tr)
+            fd.submit(row()).result(timeout=30)
+            # wait for the done-callback terminal to land
+            deadline = time.time() + 5
+            while not tr.traces() and time.time() < deadline:
+                time.sleep(0.01)
+            t = tr.traces()[0]
+            names = t.event_names()
+            assert "cluster.route" in names
+            route = [a for n, _, a in t.events if n == "cluster.route"][0]
+            assert route == {"host": 0, "decision": "least_loaded",
+                             "kind": "infer"}
+            assert t.reason == "ok"
+        finally:
+            shutdown_all(hosts)
+
+    def test_breaker_open_state_rides_heartbeat(self):
+        d, hosts, pumps, engines = make_cluster(1)
+        try:
+            for _ in range(engines[0].breaker.failure_threshold):
+                engines[0].breaker.record_failure()
+            pumps[0].pump_once()
+            assert d.status(0).breaker == "OPEN"
+        finally:
+            shutdown_all(hosts)
+
+
+# --------------------------------------------------------------------------
+# THE fleet-health acceptance test (issue acceptance criterion)
+# --------------------------------------------------------------------------
+class TestFleetHealthAcceptance:
+    def test_breaker_drain_then_heartbeat_death(self):
+        """3-host loopback cluster: tripping host A's deployment breaker
+        drains A's share fleet-wide (B/C absorb it; A receives at most
+        its probe allowance), and killing A's heartbeat sheds typed
+        'host_unavailable' for A-pinned work with the routing decision
+        in the trace."""
+        clock = FakeClock()
+        d, hosts, pumps, engines = make_cluster(
+            3, clock=clock, heartbeat_timeout_s=1.0,
+            probe_interval_s=10.0)
+        tr = Tracer(sample_rate=1.0, capacity=512)
+        try:
+            fd = ClusterFrontDoor(d, tracer=tr)
+            # trip A's deployment breaker; the next heartbeat carries it
+            a = engines[0].breaker
+            for _ in range(a.failure_threshold):
+                a.record_failure()
+            for p in pumps:
+                p.pump_once()
+            assert d.status(0).breaker == "OPEN"
+            a_before = engines[0].metrics.requests_total.value
+            futs = [fd.submit(row()) for _ in range(20)]
+            done = []
+            for f in futs:
+                try:
+                    done.append(f.result(timeout=30))
+                except Exception:
+                    pass
+            routed = fd.routed_by_host.to_dict()
+            # B/C absorbed A's share; A got AT MOST one probe (which its
+            # own OPEN breaker may shed — that is the probe's job)
+            assert routed.get("h1", 0) + routed.get("h2", 0) >= 19
+            a_requests = engines[0].metrics.requests_total.value - a_before
+            assert a_requests <= 1, "OPEN-breaker host must be probe-only"
+            # --- now kill A's heartbeat (B/C keep beating) -------------
+            clock.advance(2.0)
+            for p in pumps[1:]:
+                p.pump_once()
+            assert d.stale_ids() == [0]
+            # A-pinned traffic: the probe allowance was already spent on
+            # the breaker drain above (probe_interval_s=10), so the pin
+            # sheds typed host_unavailable immediately
+            with pytest.raises(HostUnavailableError) as ei:
+                fd.submit(row(), host=0)
+            assert ei.value.reason == "host_unavailable"
+            assert ei.value.host == 0
+            assert fd.metrics.rejections_by_reason.get(
+                "host_unavailable") == 1
+            shed = [t for t in tr.traces()
+                    if t.reason == "host_unavailable"]
+            assert shed and "cluster.shed" in shed[0].event_names()
+            # unpinned traffic keeps flowing to B/C
+            assert fd.submit(row()).result(timeout=30) is not None
+        finally:
+            shutdown_all(hosts)
+
+
+# --------------------------------------------------------------------------
+# Single-host inertness (issue acceptance criterion)
+# --------------------------------------------------------------------------
+class TestSingleHostInertness:
+    def test_registry_cluster_none_unchanged(self):
+        """cluster=None (the default): no host layer is minted, engines
+        construct exactly as before, and front_door() refuses."""
+        from deeplearning4j_tpu.nn import (
+            MultiLayerNetwork, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.train import Sgd
+
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(nIn=6, nOut=8, activation="TANH"))
+                .layer(OutputLayer(nIn=8, nOut=3, lossFunction="MCXENT"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        with ModelRegistry() as reg:
+            assert reg.cluster is None and reg._local_host is None
+            reg.deploy("m", net)
+            eng = reg.engine("m", max_batch_size=4, max_wait_ms=0.0)
+            assert isinstance(eng, InferenceEngine)
+            assert reg._local_host is None       # no host layer touched
+            with pytest.raises(ValueError):
+                reg.front_door()
+            direct = np.asarray(net.output(row(2)).jax)
+            served = np.asarray(eng.output(row(2)).jax)
+            np.testing.assert_array_equal(direct, served)
+
+    def test_registry_cluster_joins_local_host(self):
+        from deeplearning4j_tpu.nn import (
+            MultiLayerNetwork, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.train import Sgd
+
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(nIn=6, nOut=8, activation="TANH"))
+                .layer(OutputLayer(nIn=8, nOut=3, lossFunction="MCXENT"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        directory = ClusterDirectory(heartbeat_timeout_s=5.0)
+        with ModelRegistry(cluster=directory) as reg:
+            reg.deploy("m", net)
+            eng = reg.engine("m", max_batch_size=4, max_wait_ms=0.0)
+            # the process's host joined with multihost.process_index()=0
+            assert directory.host_ids() == [0]
+            assert directory.handle(0).engine is eng
+            fd = reg.front_door()
+            direct = eng.output(row(2))
+            routed = fd.output(row(2))
+            np.testing.assert_array_equal(np.asarray(direct.jax),
+                                          np.asarray(routed.jax))
+
+    def test_front_door_output_bitwise_equals_engine(self):
+        """Routing adds no math: the front door returns the SAME
+        engine's output, bitwise."""
+        d, hosts, pumps, engines = make_cluster(1)
+        try:
+            fd = ClusterFrontDoor(d)
+            x = np.random.default_rng(0).normal(size=(4, 6)).astype(
+                np.float32)
+            np.testing.assert_array_equal(
+                np.asarray(engines[0].output(x).jax),
+                np.asarray(fd.output(x).jax))
+        finally:
+            shutdown_all(hosts)
+
+
+# --------------------------------------------------------------------------
+# One-store observability
+# --------------------------------------------------------------------------
+class TestOneStoreObservability:
+    def test_aggregator_host_prefixed_traces_and_workers(self):
+        tr0, tr1 = Tracer(sample_rate=1.0), Tracer(sample_rate=1.0)
+        d = ClusterDirectory(heartbeat_timeout_s=5.0)
+        e0 = InferenceEngine(MlpAdapter(), max_batch_size=8,
+                             max_wait_ms=0.0, tracer=tr0, name="e0")
+        e1 = InferenceEngine(MlpAdapter(), max_batch_size=8,
+                             max_wait_ms=0.0, tracer=tr1, name="e1")
+        h0 = LoopbackHost(0, engine=e0, tracer=tr0)
+        h1 = LoopbackHost(1, engine=e1, tracer=tr1)
+        try:
+            d.join(h0)
+            d.join(h1)
+            e0.output(row(), tenant="acme")
+            e1.output(row(), tenant="zeta")
+            store = InMemoryStatsStorage()
+            agg = ClusterStatsAggregator(d, store)
+            assert agg.publish_once() == 2
+            assert store.listWorkerIDsForSession("cluster") == ["h0", "h1"]
+            traces = agg.traces(limit=10)
+            ids = [t["trace_id"] for t in traces]
+            assert any(i.startswith("h0/") for i in ids), ids
+            assert any(i.startswith("h1/") for i in ids), ids
+            assert all(t["host"] in (0, 1) for t in traces)
+            # chrome lanes: h<id>/tenant/trace-id, disjoint pids per host
+            events = agg.chrome_events()
+            tracks = [e["args"]["name"] for e in events
+                      if e.get("ph") == "M" and e["name"] == "thread_name"]
+            assert any(t.startswith("h0/acme/") for t in tracks), tracks
+            assert any(t.startswith("h1/zeta/") for t in tracks), tracks
+            procs = [e["args"]["name"] for e in events
+                     if e.get("ph") == "M" and e["name"] == "process_name"]
+            assert any(p.startswith("h0:serving[") for p in procs), procs
+            json.dumps(events)                   # JSON-safe end to end
+        finally:
+            h0.shutdown()
+            h1.shutdown()
+
+    def test_api_cluster_endpoint(self):
+        from deeplearning4j_tpu.ui import UIServer
+
+        clock = FakeClock()
+        d, hosts, pumps, engines = make_cluster(2, clock=clock)
+        server = UIServer(port=0)
+        try:
+            fd = ClusterFrontDoor(d)
+            fd.output(row())
+            with urllib.request.urlopen(server.url + "api/cluster",
+                                        timeout=10) as r:
+                payload = json.loads(r.read().decode())
+            ours = [p for p in payload
+                    if p["fleet"]["hosts"] == 2 and "0" in p["hosts"]
+                    and p["front_doors"]]
+            assert ours, payload
+            snap = ours[-1]
+            assert snap["fleet"]["state"] == "ok"
+            assert snap["fleet"]["alive"] == 2
+            h0 = snap["hosts"]["0"]
+            assert h0["alive"] is True
+            assert h0["status"]["has_infer"] is True
+            assert h0["status"]["breaker"] == "CLOSED"
+            assert "slo_p99_ms" in h0["status"]
+            fds = snap["front_doors"][0]
+            assert sum(fds["routed_by_host"].values()) == 1
+        finally:
+            server.stop()
+            shutdown_all(hosts)
+
+    def test_host_status_wire_roundtrip(self):
+        st = HostStatus(host_id=3, has_generate=True, slots=8, free_slots=2,
+                        kv_blocks_total=64, kv_blocks_free=10,
+                        kv_blocks_usable=60, block_size=16,
+                        buckets=(8, 16, 32), breaker="HALF_OPEN",
+                        slo_burn_active=True, seq=41)
+        wire = json.loads(json.dumps(st.to_dict()))
+        back = HostStatus.from_dict(wire)
+        assert back == st
+
+
+# --------------------------------------------------------------------------
+# Generation cluster: real engines, sticky streams, signature bound
+# --------------------------------------------------------------------------
+class TestGenerationCluster:
+    @pytest.fixture(scope="class")
+    def gen_cluster(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models import TransformerConfig, init_params
+        from deeplearning4j_tpu.serving import GenerationEngine
+
+        cfg = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                                mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                                causal=True, attention_impl="full",
+                                remat=False)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        hosts, pumps, engines = [], [], []
+        for i in range(2):
+            g = GenerationEngine(params, cfg, slots=2, max_len=32,
+                                 name=f"gen{i}")
+            h = LoopbackHost(i, generation=g)
+            d.join(h)
+            pumps.append(HeartbeatPump(h, LoopbackTransport(d)))
+            hosts.append(h)
+            engines.append(g)
+        for p in pumps:
+            p.pump_once()
+        fd = ClusterFrontDoor(d)
+        try:
+            yield d, fd, hosts, pumps, engines
+        finally:
+            shutdown_all(hosts)
+
+    def prompt(self, n=5, seed=3):
+        return np.random.default_rng(seed).integers(
+            1, 50, n).astype(np.int32)
+
+    def test_streams_route_block_aware_and_complete(self, gen_cluster):
+        d, fd, hosts, pumps, engines = gen_cluster
+        handles = [fd.submit_generate(self.prompt(), max_new_tokens=4,
+                                      seed=7) for _ in range(4)]
+        results = [h.result(timeout=120) for h in handles]
+        assert all(len(r) == 4 for r in results)
+        routed = fd.routed_by_host.to_dict()
+        assert routed.get("h0", 0) + routed.get("h1", 0) == 4
+        assert routed.get("h0", 0) >= 1 and routed.get("h1", 0) >= 1
+
+    def test_signature_bound_holds_under_front_door(self, gen_cluster):
+        """Acceptance guard: routing through the front door mints no new
+        executables — each host's compiled footprint stays within
+        len(buckets) prefill signatures + ONE donated decode."""
+        d, fd, hosts, pumps, engines = gen_cluster
+        for _ in range(3):
+            fd.submit_generate(self.prompt(9), max_new_tokens=3,
+                               seed=11).result(timeout=120)
+        for g in engines:
+            assert g.compiled_signatures() <= len(g.buckets) + 1
+
+    def test_greedy_stream_bitwise_identical_direct_vs_routed(
+            self, gen_cluster):
+        """Routing adds no math to the stream: a greedy generation
+        pinned through the front door is bitwise-identical to the same
+        engine's direct submit."""
+        d, fd, hosts, pumps, engines = gen_cluster
+        p = self.prompt(6, seed=9)
+        direct = engines[0].submit(p, max_new_tokens=5,
+                                   seed=123).result(timeout=120)
+        routed = fd.submit_generate(p, max_new_tokens=5, seed=123,
+                                    host=0).result(timeout=120)
+        assert direct == routed
+
+    def test_prefix_affinity_pins_streams(self, gen_cluster):
+        d, fd, hosts, pumps, engines = gen_cluster
+        pid = fd.register_prefix(self.prompt(8, seed=5), prefix_id="sys-p")
+        home = fd.prefix_host(pid)
+        assert home in (0, 1)
+        before = fd.routed_by_host.get(f"h{home}")
+        h = fd.submit_generate(self.prompt(3, seed=6), max_new_tokens=3,
+                               prefix_id=pid, seed=8)
+        assert len(h.result(timeout=120)) == 3
+        assert fd.routed_by_host.get(f"h{home}") == before + 1
+        # contradicting the affinity is a caller error
+        other = 1 - home
+        with pytest.raises(ValueError):
+            fd.submit_generate(self.prompt(3), prefix_id=pid, host=other)
+        with pytest.raises(KeyError):
+            fd.submit_generate(self.prompt(3), prefix_id="never-registered")
+
+
+# --------------------------------------------------------------------------
+# Taxonomy: the two new reasons are registered exactly once
+# --------------------------------------------------------------------------
+class TestTaxonomy:
+    @pytest.mark.parametrize("reason", ["cluster_capacity",
+                                        "host_unavailable"])
+    def test_new_terminal_reasons_exactly_once(self, reason):
+        assert TERMINAL_REASONS.count(reason) == 1
+
+    def test_typed_errors_carry_registered_reasons(self):
+        assert ClusterCapacityError("x").reason == "cluster_capacity"
+        assert HostUnavailableError("x").reason == "host_unavailable"
+        assert ClusterCapacityError("x", hosts=3, alive=1).alive == 1
+        assert HostUnavailableError("x", host=2).host == 2

@@ -96,6 +96,24 @@ class TestShardedCheckpoint:
             assert g.should_stop()
 
 
+class TestBarrierCache:
+    def test_barrier_value_and_cached_executable(self):
+        """ISSUE 10 satellite: barrier() must not mint a fresh jitted
+        executable (and Mesh) per call — the jitted barrier is cached
+        per device tuple, so repeated control-plane syncs dispatch the
+        warm executable."""
+        from deeplearning4j_tpu.parallel import multihost
+        from deeplearning4j_tpu.parallel.multihost import _barrier_executable
+
+        devs = tuple(jax.devices())
+        assert multihost.barrier() == float(len(devs))
+        fn1 = _barrier_executable(devs)
+        assert multihost.barrier() == float(len(devs))
+        fn2 = _barrier_executable(devs)
+        assert fn1 is fn2                    # same executable, no remint
+        assert multihost._BARRIER_CACHE[devs] is fn1
+
+
 _WORKER = r"""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"

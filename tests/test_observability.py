@@ -430,6 +430,110 @@ class TestUIServer:
         assert any("unreachable" in str(c.message) for c in caught)
 
 
+class TestRemoteRouterDelivery:
+    """Regression coverage for the RemoteStatsStorageRouter delivery
+    contract (ISSUE 10 satellite): the drop-after-retry path, the
+    bounded-queue (async) overflow-drop accounting, and
+    retry-then-deliver — the cluster heartbeat/trace-aggregation path
+    (serving/cluster.py HttpTransport) rides exactly this router."""
+
+    def test_bounded_queue_overflow_drops_and_counts(self):
+        """Async mode against an unreachable server: the bounded queue
+        fills (the sender is stuck retrying), overflow drops are counted
+        separately from network drops, memory stays bounded, and the
+        posting thread never blocks or raises."""
+        import warnings as _w
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter
+        router = RemoteStatsStorageRouter(
+            "http://127.0.0.1:1",            # nothing listens
+            timeout=0.2, retries=0, retry_delay=0.01, queue_capacity=2)
+        try:
+            with _w.catch_warnings(record=True) as caught:
+                _w.simplefilter("always")
+                for i in range(20):
+                    router.putUpdate("s", "T", "w", {"i": i})
+            assert router.dropped_overflow >= 1
+            assert router.dropped >= router.dropped_overflow
+            assert len(router._q) <= router.queue_capacity
+            assert any("overflow" in str(c.message) or
+                       "unreachable" in str(c.message) for c in caught)
+            assert router.flush(timeout=10)   # drains (into drops)
+            # every report was either delivered (none) or dropped
+            assert router.delivered == 0
+            assert router.dropped == 20
+        finally:
+            router.close()
+
+    def test_retry_then_deliver(self, monkeypatch):
+        """The first POST attempt fails transiently; the retry delivers
+        — the report lands in the server's storage and nothing is
+        dropped."""
+        import urllib.request as _ur
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter, UIServer
+        server = UIServer(port=0)
+        try:
+            real = _ur.urlopen
+            fails = {"n": 1}
+
+            def flaky(req, timeout=None):
+                if fails["n"] > 0:
+                    fails["n"] -= 1
+                    raise OSError("injected transient network failure")
+                return real(req, timeout=timeout)
+
+            monkeypatch.setattr(_ur, "urlopen", flaky)
+            router = RemoteStatsStorageRouter(server.url, timeout=5,
+                                              retries=2, retry_delay=0.01)
+            router.putUpdate("sess", "ServingMetrics", "w0", {"ok": 1})
+            assert router.dropped == 0
+            assert fails["n"] == 0               # the failure was consumed
+            store = server._remote_target()
+            ups = store.getUpdates("sess", "ServingMetrics", "w0")
+            assert ups and ups[-1] == {"ok": 1}
+        finally:
+            server.stop()
+
+    def test_async_queue_delivers_in_order(self):
+        """Async mode against a LIVE server: queued reports deliver in
+        submission order; flush() waits for the drain."""
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter, UIServer
+        server = UIServer(port=0)
+        try:
+            router = RemoteStatsStorageRouter(server.url,
+                                              queue_capacity=32)
+            for i in range(5):
+                router.putUpdate("sess", "T", "w0", {"i": i})
+            assert router.flush(timeout=10)
+            assert router.delivered == 5 and router.dropped == 0
+            store = server._remote_target()
+            ups = store.getUpdates("sess", "T", "w0")
+            assert [u["i"] for u in ups] == list(range(5))
+            router.close()
+        finally:
+            server.stop()
+
+    def test_post_close_submissions_counted_as_dropped(self):
+        """Review regression: a report posted after close() is dropped
+        but COUNTED — every report is delivered or accounted for."""
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter
+        router = RemoteStatsStorageRouter("http://127.0.0.1:1",
+                                          timeout=0.2, retries=0,
+                                          queue_capacity=4)
+        router.close(timeout=1.0)
+        router.putUpdate("s", "T", "w", {"late": True})
+        assert router.dropped == 1
+
+    def test_sync_mode_unchanged_default(self):
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter
+        router = RemoteStatsStorageRouter("http://127.0.0.1:1")
+        assert router.queue_capacity == 0 and router._q is None
+        assert router.flush() is True            # no-op synchronously
+        router.close()                           # no-op synchronously
+        with pytest.raises(ValueError):
+            RemoteStatsStorageRouter("http://127.0.0.1:1",
+                                     queue_capacity=-1)
+
+
 class TestTsne:
     def test_render_clusters(self, tmp_path):
         """Two well-separated gaussian clusters must stay separated in the

@@ -610,6 +610,86 @@ class TestRealPackageGate:
 
 
 # --------------------------------------------------------------------------
+# Pod-slice control plane (ISSUE 10 satellite): serving/cluster.py rides
+# the same gate, and the lock-discipline checker sees the directory's
+# heartbeat lock
+# --------------------------------------------------------------------------
+CLUSTER_HB_TP = '''
+class ClusterDirectory:
+    def heartbeat_blocking(self, status, fut):
+        with self._hb_lock:                      # the directory's lock
+            fut.result()                         # blocking under it: bug
+    def probe_then_dispatch(self, h, x):
+        with self._hb_lock:
+            h.infer(x)                           # device call under it: bug
+'''
+
+CLUSTER_HB_NEG = '''
+class ClusterDirectory:
+    def heartbeat(self, status):
+        hid = int(status.host_id)
+        with self._hb_lock:                      # bookkeeping only: fine
+            self._status[hid] = status
+            self._seen_at[hid] = self._clock()
+    def api_snapshot(self):
+        with self._hb_lock:
+            hosts = dict(self._status)
+        return hosts                             # heavy work outside
+'''
+
+
+class TestClusterGate:
+    def test_cluster_module_zero_unsuppressed(self):
+        """serving/cluster.py is inside the package gate already (it
+        lives in serving/); this pins the satellite explicitly — the new
+        control plane alone analyzes clean under every checker."""
+        target = os.path.join(SERVING, "cluster.py")
+        assert os.path.exists(target)
+        report = analyze_paths([target],
+                               baseline=Baseline.load(DEFAULT_BASELINE))
+        assert report.errors == []
+        assert report.files_analyzed == 1
+        pretty = "\n".join(f"{f.location()}: {f.rule}: {f.message}"
+                           for f in report.unsuppressed)
+        assert report.unsuppressed == [], pretty
+
+    def test_heartbeat_lock_checker_armed(self):
+        """Fixture proof: blocking calls under a directory-heartbeat
+        lock (``self._hb_lock``) are exactly what the lock-discipline
+        checker flags — the shape the control plane must never grow."""
+        r = run({"serving/cluster.py": CLUSTER_HB_TP},
+                rules=["lock-discipline"])
+        msgs = [f.message for f in r.unsuppressed]
+        assert any("_hb_lock" in m and ".result()" in m for m in msgs), msgs
+        assert any("_hb_lock" in m and "infer" in m for m in msgs), msgs
+
+    def test_heartbeat_bookkeeping_clean(self):
+        r = run({"serving/cluster.py": CLUSTER_HB_NEG},
+                rules=["lock-discipline"])
+        assert r.unsuppressed == []
+
+    def test_cluster_terminal_reasons_registered(self):
+        """Drift guard armed against the REAL tracing.py: dropping
+        either new cluster reason from TERMINAL_REASONS must fail the
+        taxonomy checker (the admission-side typed errors still carry
+        them)."""
+        sources = {}
+        for name in os.listdir(SERVING):
+            if name.endswith(".py"):
+                p = os.path.join(SERVING, name)
+                with open(p) as f:
+                    sources[p] = f.read()
+        tracing_path = os.path.join(SERVING, "tracing.py")
+        for reason in ("cluster_capacity", "host_unavailable"):
+            broken = dict(sources)
+            removed = sources[tracing_path].replace(f'"{reason}",', "")
+            assert removed != sources[tracing_path]
+            broken[tracing_path] = removed
+            r = analyze_sources(broken, rules=["taxonomy-drift"])
+            assert any(reason in f.message for f in r.unsuppressed), reason
+
+
+# --------------------------------------------------------------------------
 # CLI
 # --------------------------------------------------------------------------
 class TestCli:
