@@ -113,6 +113,12 @@ class HostStatus:
     slo_error_rate: float = 0.0
     slo_p99_ms: float = 0.0
     seq: int = 0                     # host-side monotone heartbeat counter
+    # wire-format version for rolling upgrades: receivers branch on this
+    # instead of guessing from field shapes, and from_dict's known-field
+    # filter + the defaults above mean old<->new mixes keep heartbeating
+    # (the wire-schema-drift lint enforces this shape for every wire
+    # dataclass — see tools/analysis/wire_schema.py)
+    wire_version: int = 1
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)

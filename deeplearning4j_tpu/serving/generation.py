@@ -1115,11 +1115,11 @@ class GenerationEngine(ResilientEngineMixin):
             refs = shared + ([pblocks[n_shared]] if P % B else [])
             try:
                 alloc.incref(refs)   # all-or-nothing
-            except ValueError:
+            except ValueError as e:
                 alloc.free(fresh)
                 raise RuntimeError(
                     f"shared prefix {greq.prefix_id!r} was released while "
-                    "this request was being seated; resubmit")
+                    "this request was being seated; resubmit") from e
             held = refs + fresh
             cow = (pblocks[n_shared], fresh[0]) if P % B else None
         except BaseException as e:

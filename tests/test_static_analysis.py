@@ -1,24 +1,31 @@
-"""Static-analysis suite tests (tools/analysis — ISSUE 8).
+"""Static-analysis suite tests (tools/analysis — ISSUE 8, v2 per
+ISSUE 11).
 
 Three layers, per the acceptance criteria:
 
-1. **Fixture proofs** — every one of the five checkers has at least one
-   proven true positive and one clean negative on small snippets
-   modeled on the serving stack's real shapes.
+1. **Fixture proofs** — every checker (the five ISSUE 8 rules plus the
+   ISSUE 11 cluster-era rules: wire-schema-drift, deadline-propagation,
+   metrics-drift, exception-chaining) has at least one proven true
+   positive and one clean negative on small snippets modeled on the
+   serving stack's real shapes; the transitive call expansion the v2
+   lock-discipline/donation-safety checkers grew has depth proofs.
 2. **Reintroduction gates** — deliberately re-introducing one known
    past bug per class (the blocking-under-admission-lock shape PR 1's
    review caught, the use-after-donate zombie decode PRs 3/6 fixed,
    PR 7's taxonomy drift, a raw engine ``set_exception`` skipping
-   accounting, and this PR's own serving-layer ``jax.jit``) makes the
-   corresponding checker fail.
-3. **The real-package gate** — ``python -m tools.analysis
-   deeplearning4j_tpu/serving deeplearning4j_tpu/models`` exits 0 with
-   zero unsuppressed findings, in under 10 seconds, and the
-   suppression + baseline mechanisms round-trip.
+   accounting, PR 8's serving-layer ``jax.jit``, the PR 10
+   heartbeat-seq wire asymmetry, and ISSUE 11's own
+   lost-cause-in-except from generation.py) makes the corresponding
+   checker fail.
+3. **The real-package gate** — ``python -m tools.analysis`` over
+   serving/ + models/ + ops/ + tools/ + ui/server.py exits 0 with zero
+   unsuppressed findings, in under 10 seconds, and the suppression +
+   baseline + --changed-only mechanisms round-trip.
 
 Pure stdlib: none of these tests import jax or the serving modules —
 the analyzer is syntactic by design.
 """
+import configparser
 import json
 import os
 import subprocess
@@ -36,6 +43,11 @@ pytestmark = pytest.mark.analysis
 REPO = Path(__file__).resolve().parents[1]
 SERVING = str(REPO / "deeplearning4j_tpu" / "serving")
 MODELS = str(REPO / "deeplearning4j_tpu" / "models")
+OPS = str(REPO / "deeplearning4j_tpu" / "ops")
+TOOLS = str(REPO / "tools")
+UI_SERVER = str(REPO / "deeplearning4j_tpu" / "ui" / "server.py")
+#: the ISSUE 11 whole-package gate scope
+GATE_SCOPE = [SERVING, MODELS, OPS, TOOLS, UI_SERVER]
 DEFAULT_BASELINE = str(REPO / "tools" / "analysis" / "baseline.json")
 
 RULES = {c.rule for c in all_checkers()}
@@ -121,6 +133,33 @@ class TestLockDiscipline:
     def test_clean_negative(self):
         r = run({"serving/ctl.py": LOCK_NEG}, rules=["lock-discipline"])
         assert r.unsuppressed == []
+
+    def test_same_named_classes_in_different_files_do_not_merge(self):
+        """Two unrelated classes that happen to share a name must keep
+        separate lock graphs: merging them fabricates an inversion
+        spanning classes that never share an instance (and transitive
+        expansion would walk the wrong class's methods)."""
+        a = ("class Manager:\n"
+             "    def f(self):\n"
+             "        with self._a_lock:\n"
+             "            with self._b_lock:\n"
+             "                pass\n")
+        b = ("class Manager:\n"
+             "    def g(self):\n"
+             "        with self._b_lock:\n"
+             "            with self._a_lock:\n"
+             "                pass\n")
+        r = run({"serving/a.py": a, "serving/b.py": b},
+                rules=["lock-discipline"])
+        assert r.unsuppressed == []
+        # sanity: the same two orders INSIDE one class still invert
+        r2 = run({"serving/a.py": a.replace(
+            "    def f", "    def g(self):\n"
+            "        with self._b_lock:\n"
+            "            with self._a_lock:\n"
+            "                pass\n"
+            "    def f")}, rules=["lock-discipline"])
+        assert any("inversion" in f.message for f in r2.unsuppressed)
 
     def test_multi_item_with_statement(self):
         """Review regression: ``with a, b:`` acquires left to right —
@@ -444,6 +483,606 @@ class Engine:
 
 
 # --------------------------------------------------------------------------
+# transitive expansion (ISSUE 11): lock-discipline + donation-safety
+# --------------------------------------------------------------------------
+class TestTransitiveExpansion:
+    def test_three_level_relock_chain(self):
+        """One-level expansion (the PR 8 behavior) could not see this:
+        the re-acquisition sits two calls below the held lock."""
+        src = '''
+class Engine:
+    def outer(self):
+        with self._lock:
+            self.mid()
+    def mid(self):
+        self.leaf()
+    def leaf(self):
+        with self._lock:
+            pass
+'''
+        r = run({"serving/e.py": src}, rules=["lock-discipline"])
+        msgs = [f.message for f in r.unsuppressed]
+        assert any("re-acquires self._lock" in m
+                   and "self.mid() -> self.leaf()" in m for m in msgs), msgs
+
+    def test_three_level_order_inversion(self):
+        src = '''
+class Engine:
+    def ab(self):
+        with self._a_lock:
+            self.mid()
+    def mid(self):
+        self.take_b()
+    def take_b(self):
+        with self._b_lock:
+            pass
+    def ba(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+'''
+        r = run({"serving/e.py": src}, rules=["lock-discipline"])
+        assert any("inversion" in f.message for f in r.unsuppressed)
+
+    def test_blocking_call_reached_through_chain(self):
+        src = '''
+import time
+class Engine:
+    def outer(self):
+        with self._lock:
+            self.mid()
+    def mid(self):
+        self.leaf()
+    def leaf(self):
+        time.sleep(0.1)
+'''
+        r = run({"serving/e.py": src}, rules=["lock-discipline"])
+        assert any("blocks (time.sleep)" in f.message
+                   for f in r.unsuppressed)
+
+    def test_cv_wait_through_chain_is_two_lock_sleep(self):
+        """A helper's ``with self._cv: self._cv.wait()`` is exempt in
+        ITS body (wait releases its own lock) but a caller holding a
+        DIFFERENT lock across the call keeps that lock held for the
+        whole wait — the two-lock sleep the direct form already flags
+        must survive call indirection."""
+        src = '''
+class Engine:
+    def drain(self):
+        with self._wd_lock:
+            self.await_quiesce()
+    def await_quiesce(self):
+        with self._cv:
+            self._cv.wait()
+'''
+        r = run({"serving/e.py": src}, rules=["lock-discipline"])
+        assert any("waits on self._cv" in f.message
+                   and "self._wd_lock" in f.message
+                   for f in r.unsuppressed)
+        # with no lock held at the caller, the same chain is clean
+        clean = src.replace("        with self._wd_lock:\n"
+                            "            self.await_quiesce()",
+                            "        self.await_quiesce()")
+        r2 = run({"serving/e.py": clean}, rules=["lock-discipline"])
+        assert r2.unsuppressed == []
+
+    def test_expansion_depth_is_bounded(self):
+        """A chain deeper than EXPANSION_DEPTH is (deliberately) out of
+        reach — the bound is what keeps the whole-package run inside
+        the 10 s gate."""
+        from tools.analysis.lock_discipline import LockDisciplineChecker
+        chain = "\n".join(
+            f"    def f{i}(self):\n        self.f{i + 1}()"
+            for i in range(1, 8))
+        src = ("class Engine:\n"
+               "    def outer(self):\n"
+               "        with self._lock:\n"
+               "            self.f1()\n"
+               + chain + "\n"
+               "    def f8(self):\n"
+               "        with self._lock:\n"
+               "            pass\n")
+        checker = LockDisciplineChecker()
+        from tools.analysis.core import AnalysisUnit, SourceFile
+        unit = AnalysisUnit([SourceFile("serving/e.py", src)])
+        assert list(checker.check(unit)) == []        # 8 levels: out
+        deep = LockDisciplineChecker(expansion_depth=16)
+        assert any("re-acquires" in f.message
+                   for f in deep.check(unit))          # raised bound: in
+
+    def test_expansion_follows_inherited_methods(self):
+        """The serving engines inherit their resilience scaffolding —
+        the expansion must resolve ``self._retry_call()`` into the
+        mixin even though it is another ClassDef."""
+        src = '''
+class Mixin:
+    def _retry_call(self):
+        with self._wd_lock:
+            pass
+class Engine(Mixin):
+    def dispatch(self):
+        with self._wd_lock:
+            self._retry_call()
+'''
+        r = run({"serving/e.py": src}, rules=["lock-discipline"])
+        assert any("re-acquires self._wd_lock" in f.message
+                   for f in r.unsuppressed)
+
+    def test_transitive_donation_through_helper(self):
+        """A method that donates self._cache through a retry closure
+        two calls down and never rebinds leaves the caller's read a
+        use-after-donate."""
+        src = '''
+class Engine:
+    def _fire(self):
+        def call():
+            return self._donated_call(
+                "p", self._prefill, self.params, self._cache, self.row)
+        return self._retry(call)
+    def _step(self):
+        self._fire()
+    def scheduler(self):
+        self._step()
+        return self._cache["lengths"]
+'''
+        r = run({"serving/g.py": src}, rules=["donation-safety"])
+        assert rules_hit(r) == {"donation-safety"}
+        assert any("self._step" in f.message for f in r.unsuppressed)
+
+    def test_writeback_method_does_not_propagate(self):
+        """The scheduler shape every engine actually uses: the helper
+        donates AND writes the fresh cache back — its callers see a
+        live binding."""
+        src = '''
+class Engine:
+    def _fire(self):
+        out, toks = self._decode(self.params, self._cache, self.t)
+        self._cache = out
+        return toks
+    def scheduler(self):
+        self._fire()
+        return self._cache["lengths"]
+'''
+        r = run({"serving/g.py": src}, rules=["donation-safety"])
+        assert r.unsuppressed == []
+
+    def test_epoch_guard_still_exempts_transitive_reads(self):
+        src = '''
+class Engine:
+    def _fire(self):
+        return self._decode(self.params, self._cache, self.t)
+    def scheduler(self, epoch):
+        self._fire()
+        if self._epoch == epoch:
+            return self._cache["lengths"]
+'''
+        r = run({"serving/g.py": src}, rules=["donation-safety"])
+        assert r.unsuppressed == []
+
+
+# --------------------------------------------------------------------------
+# 6. wire-schema-drift (ISSUE 11)
+# --------------------------------------------------------------------------
+WIRE_NEG = '''
+import dataclasses
+
+@dataclasses.dataclass
+class HostStatus:
+    host_id: int
+    queue_depth: int = 0
+    seq: int = 0
+    wire_version: int = 1
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in known}
+        return cls(**kw)
+'''
+
+
+class TestWireSchemaDrift:
+    def test_clean_negative(self):
+        r = run({"serving/c.py": WIRE_NEG}, rules=["wire-schema-drift"])
+        assert r.unsuppressed == []
+
+    def test_missing_version_field(self):
+        src = WIRE_NEG.replace("    wire_version: int = 1\n", "")
+        r = run({"serving/c.py": src}, rules=["wire-schema-drift"])
+        assert rules_hit(r) == {"wire-schema-drift"}
+        assert any("version field" in f.message for f in r.unsuppressed)
+
+    def test_reintroduce_heartbeat_seq_asymmetry(self):
+        """Acceptance (the PR 10 class): a to_dict that hand-builds its
+        payload and forgets ``seq`` — receivers would silently default
+        it and the out-of-order heartbeat guard goes blind."""
+        src = WIRE_NEG.replace(
+            "        return dataclasses.asdict(self)",
+            '        return {"host_id": self.host_id,\n'
+            '                "queue_depth": self.queue_depth,\n'
+            '                "wire_version": self.wire_version}')
+        r = run({"serving/c.py": src}, rules=["wire-schema-drift"])
+        assert any("never serializes field 'seq'" in f.message
+                   for f in r.unsuppressed)
+
+    def test_unknown_key_in_payload(self):
+        # hand-built dict with a key that is not a field
+        src2 = WIRE_NEG.replace(
+            "        return dataclasses.asdict(self)",
+            '        return {"host_id": self.host_id, "queue_depth": 0,\n'
+            '                "seq": self.seq, "wire_version": 1,\n'
+            '                "legacy_alias": self.host_id}')
+        r = run({"serving/c.py": src2}, rules=["wire-schema-drift"])
+        assert any("'legacy_alias'" in f.message and "not a declared"
+                   in f.message for f in r.unsuppressed)
+
+    def test_nested_payload_dicts_do_not_mask_or_fabricate(self):
+        """Keys of dicts nested INSIDE the payload are content, not
+        payload keys: a forgotten declared field must still flag even
+        when a nested sub-dict happens to use its name, and the nested
+        keys must not fire unknown-key findings."""
+        src = WIRE_NEG.replace(
+            "        return dataclasses.asdict(self)",
+            '        return {"host_id": self.host_id,\n'
+            '                "queue_depth": self.queue_depth,\n'
+            '                "wire_version": self.wire_version,\n'
+            '                "extras": {"seq": 0, "legacy": 1}}')
+        r = run({"serving/c.py": src}, rules=["wire-schema-drift"])
+        # the nested "seq" does NOT satisfy the declared seq field...
+        assert any("never serializes field 'seq'" in f.message
+                   for f in r.unsuppressed)
+        # ...and nested keys are not "unknown field" false positives
+        # ("extras" itself, a real top-level unknown, still flags)
+        msgs = [f.message for f in r.unsuppressed]
+        assert not any("'legacy'" in m for m in msgs)
+        assert any("'extras'" in m and "not a declared" in m
+                   for m in msgs)
+
+    def test_raw_splat_is_unknown_field_intolerant(self):
+        src = WIRE_NEG.replace(
+            "        known = {f.name for f in dataclasses.fields(cls)}\n"
+            "        kw = {k: v for k, v in d.items() if k in known}\n"
+            "        return cls(**kw)",
+            "        return cls(**d)")
+        r = run({"serving/c.py": src}, rules=["wire-schema-drift"])
+        assert any("splats the raw payload" in f.message
+                   for f in r.unsuppressed)
+
+    def test_explicit_ctor_must_read_required_fields(self):
+        src = '''
+import dataclasses
+
+@dataclasses.dataclass
+class Envelope:
+    wire_version: int
+    payload: str
+    seq: int = 0
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(wire_version=d["wire_version"], seq=d.get("seq", 0))
+'''
+        r = run({"serving/c.py": src}, rules=["wire-schema-drift"])
+        assert any("required field 'payload'" in f.message
+                   for f in r.unsuppressed)
+
+    def test_one_sided_report_payloads_are_skipped(self):
+        """QosPolicy.to_dict has no from_dict — report-only payloads
+        are not wire dataclasses."""
+        src = '''
+import dataclasses
+
+@dataclasses.dataclass
+class Report:
+    a: int
+
+    def to_dict(self):
+        return {"a": self.a}
+'''
+        r = run({"serving/c.py": src}, rules=["wire-schema-drift"])
+        assert r.unsuppressed == []
+
+    def test_real_hoststatus_guard_armed(self):
+        """Drift gate against the REAL cluster.py: stripping the
+        wire_version field must fail the checker."""
+        p = os.path.join(SERVING, "cluster.py")
+        with open(p) as f:
+            src = f.read()
+        broken = src.replace("    wire_version: int = 1\n", "")
+        assert broken != src
+        r = run({p: broken}, rules=["wire-schema-drift"])
+        assert any("version field" in f.message for f in r.unsuppressed)
+        # and the live file is clean
+        r2 = run({p: src}, rules=["wire-schema-drift"])
+        assert r2.unsuppressed == []
+
+
+# --------------------------------------------------------------------------
+# 7. deadline-propagation (ISSUE 11)
+# --------------------------------------------------------------------------
+DEADLINE_NEG = '''
+class ClusterFrontDoor:
+    def submit(self, x, timeout_ms=None, tenant=None):
+        h = self._pick()
+        return h.submit_infer(x, timeout_ms=timeout_ms, tenant=tenant)
+    def submit_derived(self, x, timeout_ms=None):
+        tmo = timeout_ms if timeout_ms is not None else self.default
+        return self._engine.submit(x, tmo)
+    def submit_kwargs(self, prompt, **kwargs):
+        return self._gen.submit(prompt, **kwargs)
+    def no_deadline_here(self, req):
+        return self._q.admit(req)     # deadline rides the Request object
+    def remaining_budget(self, x, deadline_t):
+        return self._h.submit(x, timeout_ms=(deadline_t - self._now()))
+'''
+
+
+class TestDeadlinePropagation:
+    def test_clean_negative(self):
+        r = run({"serving/fd.py": DEADLINE_NEG},
+                rules=["deadline-propagation"])
+        assert r.unsuppressed == []
+
+    def test_dropped_deadline_on_forward(self):
+        """Acceptance: the RPC-seam shape — a front door that accepts
+        timeout_ms and forwards the request without it."""
+        src = '''
+class ClusterFrontDoor:
+    def submit(self, x, timeout_ms=None, tenant=None):
+        h = self._pick()
+        return h.submit_infer(x, tenant=tenant)
+'''
+        r = run({"serving/fd.py": src}, rules=["deadline-propagation"])
+        assert rules_hit(r) == {"deadline-propagation"}
+        assert any("forwards without it" in f.message
+                   for f in r.unsuppressed)
+
+    def test_dropped_on_generate_and_admit(self):
+        src = '''
+class Host:
+    def submit_generate(self, prompt, deadline_t=None):
+        return self._gen.submit(prompt)
+    def enqueue(self, req, timeout_ms=None):
+        return self._q.admit(req)
+'''
+        r = run({"serving/h.py": src}, rules=["deadline-propagation"])
+        assert len(r.unsuppressed) == 2
+
+    def test_functions_without_deadline_params_are_exempt(self):
+        src = '''
+class Engine:
+    def _drain(self):
+        for req in self._backlog:
+            self._q.admit(req)
+'''
+        r = run({"serving/e.py": src}, rules=["deadline-propagation"])
+        assert r.unsuppressed == []
+
+
+# --------------------------------------------------------------------------
+# 8. metrics-drift (ISSUE 11)
+# --------------------------------------------------------------------------
+METRICS_NEG = '''
+class Counter:
+    pass
+
+class ServingMetrics:
+    def __init__(self):
+        self.requests_total = Counter("requests_total")
+        self.queue_depth = Gauge("queue_depth")
+        self._lock = object()
+
+    def record_rejection(self, reason):
+        pass
+
+    def counters(self):
+        return {c.name: c.value for c in (self.requests_total,)}
+
+    def snapshot(self):
+        return {"queue_depth": self.queue_depth.value,
+                "slo": {},
+                **self.counters()}
+
+class Engine:
+    def _dispatch(self):
+        self.metrics.requests_total.inc()
+        self.metrics.record_rejection("x")
+
+class Handler:
+    def get(self):
+        return self._metrics_rollup("slo")
+'''
+
+
+class TestMetricsDrift:
+    def test_clean_negative(self):
+        r = run({"serving/m.py": METRICS_NEG}, rules=["metrics-drift"])
+        assert r.unsuppressed == []
+
+    def test_typoed_reference(self):
+        src = METRICS_NEG.replace("self.metrics.requests_total.inc()",
+                                  "self.metrics.request_total.inc()")
+        r = run({"serving/m.py": src}, rules=["metrics-drift"])
+        assert any("request_total" in f.message and "does not exist"
+                   in f.message for f in r.unsuppressed)
+
+    def test_unexported_metric(self):
+        src = METRICS_NEG.replace(
+            'self.queue_depth = Gauge("queue_depth")',
+            'self.queue_depth = Gauge("queue_depth")\n'
+            '        self.orphan_total = Counter("orphan_total")')
+        r = run({"serving/m.py": src}, rules=["metrics-drift"])
+        assert any("orphan_total" in f.message and "never read"
+                   in f.message for f in r.unsuppressed)
+
+    def test_written_but_never_exported_metric_still_flags(self):
+        """An engine inc'ing the metric is a RECORDING site, not an
+        export — a counter that is written everywhere but surfaced by
+        neither counters() nor snapshot() is exactly the
+        recorded-cost-invisible-signal drift rule 2 exists for."""
+        src = METRICS_NEG.replace(
+            'self.queue_depth = Gauge("queue_depth")',
+            'self.queue_depth = Gauge("queue_depth")\n'
+            '        self.orphan_total = Counter("orphan_total")'
+        ).replace(
+            "self.metrics.requests_total.inc()",
+            "self.metrics.requests_total.inc()\n"
+            "        self.metrics.orphan_total.inc()")
+        r = run({"serving/m.py": src}, rules=["metrics-drift"])
+        assert any("orphan_total" in f.message and "never read"
+                   in f.message for f in r.unsuppressed)
+        # a genuine external READ (a bench sampling .value) does export
+        src2 = src.replace(
+            "        self.metrics.orphan_total.inc()",
+            "        self.metrics.orphan_total.inc()\n"
+            "        return self.metrics.orphan_total.value")
+        r2 = run({"serving/m.py": src2}, rules=["metrics-drift"])
+        assert not any("orphan_total" in f.message
+                       for f in r2.unsuppressed)
+
+    def test_declared_name_mismatch(self):
+        src = METRICS_NEG.replace('Counter("requests_total")',
+                                  'Counter("requests_totall")')
+        r = run({"serving/m.py": src}, rules=["metrics-drift"])
+        assert any("declared as" in f.message for f in r.unsuppressed)
+
+    def test_endpoint_key_must_exist(self):
+        src = METRICS_NEG.replace('self._metrics_rollup("slo")',
+                                  'self._metrics_rollup("sloo")')
+        r = run({"serving/m.py": src}, rules=["metrics-drift"])
+        assert any("sloo" in f.message and "never emits" in f.message
+                   for f in r.unsuppressed)
+
+    def test_silent_without_servingmetrics(self):
+        r = run({"models/m.py": "def f():\n    return 1\n"},
+                rules=["metrics-drift"])
+        assert r.unsuppressed == []
+
+    def test_real_package_guard_armed(self):
+        """Drift gates against the REAL tree: (a) dropping the "qos"
+        key from metrics.snapshot() strands ui/server.py's
+        _metrics_rollup("qos"); (b) typo'ing a recording site in
+        resilience.py is caught."""
+        sources = {}
+        for name in os.listdir(SERVING):
+            if name.endswith(".py"):
+                p = os.path.join(SERVING, name)
+                with open(p) as f:
+                    sources[p] = f.read()
+        with open(UI_SERVER) as f:
+            sources[UI_SERVER] = f.read()
+        metrics_path = os.path.join(SERVING, "metrics.py")
+        broken = dict(sources)
+        removed = sources[metrics_path].replace(
+            '"qos": self.qos_snapshot(),', "")
+        assert removed != sources[metrics_path]
+        broken[metrics_path] = removed
+        r = analyze_sources(broken, rules=["metrics-drift"])
+        assert any("'qos'" in f.message and "never emits" in f.message
+                   for f in r.unsuppressed)
+        broken = dict(sources)
+        resilience_path = os.path.join(SERVING, "resilience.py")
+        typoed = sources[resilience_path].replace(
+            "self.metrics.retries_total", "self.metrics.retris_total", 1)
+        assert typoed != sources[resilience_path]
+        broken[resilience_path] = typoed
+        r = analyze_sources(broken, rules=["metrics-drift"])
+        assert any("retris_total" in f.message for f in r.unsuppressed)
+        # the live tree is clean
+        r2 = analyze_sources(sources, rules=["metrics-drift"])
+        assert r2.unsuppressed == []
+
+
+# --------------------------------------------------------------------------
+# 9. exception-chaining (ISSUE 11)
+# --------------------------------------------------------------------------
+CHAINING_NEG = '''
+class Engine:
+    def seat(self, refs):
+        try:
+            self._alloc.incref(refs)
+        except ValueError as e:
+            raise RuntimeError("prefix released; resubmit") from e
+    def sever(self):
+        try:
+            self._probe()
+        except OSError:
+            raise TimeoutError("probe window closed") from None
+    def reraise(self):
+        try:
+            self._go()
+        except RuntimeError:
+            raise
+    def reraise_named(self):
+        try:
+            self._go()
+        except RuntimeError as e:
+            raise e
+    def later(self):
+        try:
+            self._go()
+        except RuntimeError:
+            def fail():
+                raise ValueError("runs outside the handler")
+            return fail
+'''
+
+
+class TestExceptionChaining:
+    def test_clean_negative(self):
+        r = run({"serving/e.py": CHAINING_NEG},
+                rules=["exception-chaining"])
+        assert r.unsuppressed == []
+
+    def test_lost_cause_flagged(self):
+        src = CHAINING_NEG.replace(
+            'raise RuntimeError("prefix released; resubmit") from e',
+            'raise RuntimeError("prefix released; resubmit")')
+        r = run({"serving/e.py": src}, rules=["exception-chaining"])
+        assert rules_hit(r) == {"exception-chaining"}
+        assert any("without 'from'" in f.message for f in r.unsuppressed)
+
+    def test_reintroduce_generation_seating_shape(self):
+        """Acceptance: the exact bug this PR fixed in generation.py —
+        the incref-failure reraise dropped the allocator's cause."""
+        p = os.path.join(SERVING, "generation.py")
+        with open(p) as f:
+            src = f.read()
+        assert '"this request was being seated; resubmit") from e' in src
+        broken = src.replace(
+            '"this request was being seated; resubmit") from e',
+            '"this request was being seated; resubmit")').replace(
+            "except ValueError as e:", "except ValueError:", 1)
+        r = run({p: broken}, rules=["exception-chaining"])
+        assert rules_hit(r) == {"exception-chaining"}
+        # and the live file is clean
+        r2 = run({p: src}, rules=["exception-chaining"])
+        assert r2.unsuppressed == []
+
+    def test_nested_handler_scopes(self):
+        src = '''
+def f():
+    try:
+        g()
+    except ValueError:
+        try:
+            h()
+        except KeyError as k:
+            raise RuntimeError("inner") from k
+        raise RuntimeError("outer, unchained")
+'''
+        r = run({"serving/e.py": src}, rules=["exception-chaining"])
+        assert len(r.unsuppressed) == 1
+        assert r.unsuppressed[0].line == 10
+
+
+# --------------------------------------------------------------------------
 # suppressions + baseline
 # --------------------------------------------------------------------------
 class TestSuppressionsAndBaseline:
@@ -563,32 +1202,52 @@ class Engine:
 # the real-package gate
 # --------------------------------------------------------------------------
 class TestRealPackageGate:
-    def test_zero_unsuppressed_findings(self):
-        """THE acceptance gate: the analyzer over serving/ + models/
-        reports zero unsuppressed findings — every true positive is
-        either fixed or carries a written justification."""
-        report = analyze_paths([SERVING, MODELS],
-                               baseline=Baseline.load(DEFAULT_BASELINE))
+    @pytest.fixture(scope="class")
+    def gate_report(self):
+        """ONE full-scope run shared by the gate assertions (the run
+        itself is what the speed gate times)."""
+        return analyze_paths(GATE_SCOPE,
+                             baseline=Baseline.load(DEFAULT_BASELINE))
+
+    def test_zero_unsuppressed_findings(self, gate_report):
+        """THE acceptance gate: the analyzer over serving/ + models/ +
+        ops/ + tools/ + ui/server.py reports zero unsuppressed findings
+        with all nine checkers and the transitive expansion on — every
+        true positive is either fixed or carries a written
+        justification."""
+        report = gate_report
         assert report.errors == []
-        assert report.files_analyzed >= 10
+        assert report.files_analyzed >= 30
         pretty = "\n".join(f"{f.location()}: {f.rule}: {f.message}"
                            for f in report.unsuppressed)
         assert report.unsuppressed == [], f"unsuppressed findings:\n{pretty}"
         # the waived sites are visible, justified, and few
-        assert 1 <= len(report.suppressed) <= 16
+        assert 1 <= len(report.suppressed) <= 24
         assert all(f.why for f in report.suppressed)
 
-    def test_fast_enough_for_tier1(self):
-        """CI satellite: the whole-package run stays under 10 s."""
-        report = analyze_paths([SERVING, MODELS],
-                               baseline=Baseline.load(DEFAULT_BASELINE))
-        assert report.elapsed_s < 10.0
+    def test_fast_enough_for_tier1(self, gate_report):
+        """CI satellite: the whole-package run stays under the existing
+        10 s speed gate WITH the ISSUE 11 checkers + transitive
+        expansion on, over the broadened scope."""
+        assert gate_report.elapsed_s < 10.0
 
     def test_every_checker_ran(self):
         report = analyze_paths([SERVING, MODELS])
         assert set(report.rules) == RULES == {
             "lock-discipline", "donation-safety", "taxonomy-drift",
-            "terminal-exactly-once", "recompile-risk"}
+            "terminal-exactly-once", "recompile-risk",
+            "wire-schema-drift", "deadline-propagation", "metrics-drift",
+            "exception-chaining"}
+
+    def test_no_new_pytest_markers(self):
+        """ISSUE 11 satellite: the lockdep/analysis tests reuse the
+        ``analysis`` marker — pytest.ini's marker set must not grow."""
+        cp = configparser.ConfigParser()
+        cp.read(REPO / "pytest.ini")
+        names = {line.strip().split(":")[0]
+                 for line in cp["pytest"]["markers"].splitlines()
+                 if line.strip()}
+        assert names == {"slow", "stress", "chaos", "analysis"}
 
     def test_taxonomy_checker_sees_real_terminal_reasons(self):
         """The generalized drift guard is actually armed: dropping a
@@ -700,15 +1359,19 @@ class TestCli:
 
     def test_json_mode_clean_exit(self):
         """bench/CI contract: --json emits a parsable report and the
-        real package exits 0."""
-        p = self._run_cli("deeplearning4j_tpu/serving",
-                          "deeplearning4j_tpu/models", "--json")
+        real package (full ISSUE 11 scope) exits 0. The v2 schema
+        carries schema_version; the v1 key set is otherwise intact."""
+        p = self._run_cli(*GATE_SCOPE, "--json")
         assert p.returncode == 0, p.stdout + p.stderr
         d = json.loads(p.stdout)
+        assert d["schema_version"] == 2
         assert d["counts"]["unsuppressed"] == 0
         assert d["counts"]["suppressed"] >= 1
-        assert d["files_analyzed"] >= 10
+        assert d["files_analyzed"] >= 30
         assert set(d["rules"]) == RULES
+        for v1_key in ("files_analyzed", "elapsed_s", "rules", "counts",
+                       "errors", "findings"):
+            assert v1_key in d
 
     def test_findings_exit_nonzero(self, tmp_path):
         bad = tmp_path / "serving"
@@ -815,6 +1478,117 @@ class TestCli:
         empty = tmp_path / "renamed_dir"
         empty.mkdir()
         p = self._run_cli(str(empty))
+        assert p.returncode == 2
+
+    def _git(self, cwd, *args):
+        p = subprocess.run(["git", *args], capture_output=True, text=True,
+                           cwd=str(cwd), timeout=60)
+        assert p.returncode == 0, p.stderr
+        return p.stdout
+
+    @pytest.fixture
+    def git_repo(self, tmp_path):
+        """A throwaway repo with one clean committed serving file."""
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "config", "user.email", "t@t")
+        self._git(tmp_path, "config", "user.name", "t")
+        serving = tmp_path / "serving"
+        serving.mkdir()
+        (serving / "clean.py").write_text(
+            "class E:\n    def f(self):\n        return 1\n")
+        (serving / "untouched.py").write_text(
+            "import jax\n"
+            "def mint():\n    return jax.jit(lambda x: x)\n")
+        self._git(tmp_path, "add", "-A")
+        # the committed tree already carries a finding in untouched.py —
+        # --changed-only must NOT see it unless the file changes
+        self._git(tmp_path, "-c", "commit.gpgsign=false", "commit",
+                  "-q", "-m", "seed")
+        return tmp_path
+
+    def _run_cli_in(self, cwd, *args):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH",
+                                                             "")
+        return subprocess.run(
+            [sys.executable, "-m", "tools.analysis", *args],
+            capture_output=True, text=True, cwd=str(cwd), env=env,
+            timeout=120)
+
+    def test_changed_only_no_py_changes_is_clean(self, git_repo):
+        """ISSUE 11 satellite: the pre-commit fast path — nothing
+        changed vs HEAD exits 0 WITHOUT the no-.py-files usage error
+        explicit paths get."""
+        p = self._run_cli_in(git_repo, "--changed-only", "--no-baseline")
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "clean" in p.stdout
+
+    def test_changed_only_sees_only_the_diff(self, git_repo):
+        """A new finding in a CHANGED file fails; the pre-existing
+        finding in the untouched file stays out of scope (that is the
+        whole-package gate's job, not the pre-commit path's)."""
+        (git_repo / "serving" / "clean.py").write_text(
+            "class E:\n    def f(self, req):\n"
+            "        with self._lock:\n"
+            "            req.future.result()\n")
+        p = self._run_cli_in(git_repo, "--changed-only", "--no-baseline",
+                             "--json")
+        assert p.returncode == 1
+        d = json.loads(p.stdout)
+        assert d["schema_version"] == 2   # schema unchanged by the mode
+        assert d["files_analyzed"] == 1
+        assert d["counts"]["by_rule"] == {"lock-discipline": 1}
+        paths = {f["path"] for f in d["findings"]}
+        assert all(p.endswith("clean.py") for p in paths)
+
+    def test_changed_only_sees_untracked_files(self, git_repo):
+        """A brand-new un-added file is exactly the pre-commit surface
+        most likely to carry fresh findings — ``git diff`` alone never
+        lists it, which would make the mode a false green."""
+        (git_repo / "serving" / "brand_new.py").write_text(
+            "class E:\n    def f(self, req):\n"
+            "        with self._lock:\n"
+            "            req.future.result()\n")
+        p = self._run_cli_in(git_repo, "--changed-only", "--no-baseline",
+                             "--json")
+        assert p.returncode == 1, p.stdout + p.stderr
+        d = json.loads(p.stdout)
+        assert d["files_analyzed"] == 1
+        assert all(f["path"].endswith("brand_new.py")
+                   for f in d["findings"])
+
+    def test_changed_only_respects_path_narrowing(self, git_repo):
+        (git_repo / "serving" / "clean.py").write_text(
+            "class E:\n    def f(self, req):\n"
+            "        with self._lock:\n"
+            "            req.future.result()\n")
+        other = git_repo / "other"
+        other.mkdir()
+        p = self._run_cli_in(git_repo, str(other), "--changed-only",
+                             "--no-baseline")
+        assert p.returncode == 0   # the diff is outside the given path
+
+    def test_changed_only_base_ref(self, git_repo):
+        """--base-ref pins the diff base: vs HEAD~1 the seed commit's
+        files count as changed."""
+        (git_repo / "serving" / "extra.py").write_text("x = 1\n")
+        self._git(git_repo, "add", "-A")
+        self._git(git_repo, "-c", "commit.gpgsign=false", "commit",
+                  "-q", "-m", "second")
+        p = self._run_cli_in(git_repo, "--changed-only",
+                             "--base-ref", "HEAD~1", "--no-baseline",
+                             "--json")
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert json.loads(p.stdout)["files_analyzed"] == 1
+
+    def test_changed_only_usage_errors(self, git_repo, tmp_path):
+        p = self._run_cli_in(git_repo, "--changed-only",
+                             "--base-ref", "no-such-ref")
+        assert p.returncode == 2
+        p = self._run_cli_in(git_repo, "--changed-only",
+                             "--write-baseline")
+        assert p.returncode == 2   # partial-view baseline refused
+        p = self._run_cli()        # no paths, no --changed-only
         assert p.returncode == 2
 
     def test_write_baseline_refuses_partial_view(self, tmp_path):

@@ -29,10 +29,35 @@ signature bound. This package encodes those invariants as AST checkers
   ``models/`` factories) and shape-varying array construction that
   bypasses the bucket-ladder helpers.
 
+The v2 suite (ISSUE 11) adds the cluster-era contracts the RPC tier
+multiplies, upgrades lock-discipline/donation-safety to bounded
+TRANSITIVE same-class call expansion, and pairs the static lock graph
+with a runtime lockdep:
+
+- :mod:`~tools.analysis.wire_schema` — ``wire-schema-drift``: wire
+  dataclasses (paired ``to_dict``/``from_dict`` like ``HostStatus``)
+  must carry a version field, serialize every declared field, and
+  tolerate unknown fields on receive (rolling upgrades).
+- :mod:`~tools.analysis.deadline` — ``deadline-propagation``: a
+  function accepting a ``timeout``/``deadline`` parameter must thread
+  it through submit-shaped forwarding calls.
+- :mod:`~tools.analysis.metrics_drift` — ``metrics-drift``:
+  ``ServingMetrics`` attribute references, declared names, exports,
+  and ``ui/server.py`` endpoint keys must agree.
+- :mod:`~tools.analysis.exception_chaining` — ``exception-chaining``:
+  ``raise X(...)`` inside ``except`` without ``from`` loses the cause
+  the taxonomy and crash dumps depend on.
+- :mod:`~tools.analysis.lockdep` — RUNTIME lock-order validation
+  (Eraser/Linux-lockdep style): instrumented ``threading`` primitives
+  record the dynamic acquisition graph while the chaos suite runs;
+  the differential against ``lock_discipline.static_lock_graph`` is
+  drift-gated via the checked-in ``tools/analysis/lockgraph.json``.
+
 CLI: ``python -m tools.analysis <paths...> [--json] [--baseline FILE]
-[--write-baseline] [--rules r1,r2]``. Per-site suppressions are
-``# analysis: ok <rule> — why`` comments; bulk grandfathering lives in
-a checked-in baseline file (``tools/analysis/baseline.json``).
+[--write-baseline] [--rules r1,r2] [--changed-only [--base-ref REF]]``.
+Per-site suppressions are ``# analysis: ok <rule> — why`` comments;
+bulk grandfathering lives in a checked-in baseline file
+(``tools/analysis/baseline.json``).
 """
 from tools.analysis.core import (  # noqa: F401
     AnalysisUnit, Baseline, Checker, Finding, Report, all_checkers,

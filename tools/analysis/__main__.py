@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from tools.analysis.core import (
@@ -19,15 +20,81 @@ from tools.analysis.core import (
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 
 
+def changed_files(base_ref: str, paths=None, cwd=None):
+    """.py files changed vs ``base_ref`` (committed, working-tree, AND
+    untracked changes — the pre-commit view; ``git diff`` alone never
+    lists a brand-new un-added file, which would make the mode a false
+    green on exactly the files most likely to carry fresh findings),
+    optionally intersected with ``paths``. Raises RuntimeError when git
+    can't answer (not a repo, unknown ref) so the CLI can exit 2
+    instead of a false green."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", base_ref, "--"],
+            capture_output=True, text=True, cwd=cwd, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard",
+             "--full-name"],
+            capture_output=True, text=True, cwd=cwd, timeout=30)
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, cwd=cwd, timeout=30)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(f"git diff failed: {e}") from e
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"git diff --name-only {base_ref} failed: "
+            f"{out.stderr.strip() or out.stdout.strip()}")
+    if untracked.returncode != 0:
+        raise RuntimeError(
+            f"git ls-files --others failed: {untracked.stderr.strip()}")
+    if top.returncode != 0:
+        raise RuntimeError(
+            f"git rev-parse --show-toplevel failed: {top.stderr.strip()}")
+    # git prints repo-root-relative paths; resolving them against the
+    # CWD would silently drop every file when run from a subdirectory
+    # (a pre-commit gate that exits 0 on a typo'd invocation)
+    root = top.stdout.strip()
+    files = []
+    for rel in dict.fromkeys(out.stdout.splitlines()
+                             + untracked.stdout.splitlines()):
+        rel = rel.strip()
+        if not rel.endswith(".py"):
+            continue
+        fp = os.path.join(root, rel)
+        if not os.path.exists(fp):
+            continue   # deleted files have nothing to analyze
+        if paths:
+            norm = os.path.normpath(os.path.abspath(fp))
+            keep = False
+            for p in paths:
+                pn = os.path.normpath(os.path.abspath(p))
+                if norm == pn or norm.startswith(pn + os.sep):
+                    keep = True
+                    break
+            if not keep:
+                continue
+        files.append(fp)
+    return sorted(files)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.analysis",
         description="Repo-specific static analysis for the serving "
                     "stack's concurrency/donation/taxonomy contracts.")
-    p.add_argument("paths", nargs="+",
-                   help="files or directories to analyze")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to analyze (optional with "
+                        "--changed-only, where they narrow the diff)")
     p.add_argument("--json", action="store_true",
                    help="emit the JSON report instead of human output")
+    p.add_argument("--changed-only", action="store_true",
+                   help="analyze only .py files in the git diff vs "
+                        "--base-ref (fast pre-commit mode; no changed "
+                        "files = clean exit 0)")
+    p.add_argument("--base-ref", default="HEAD",
+                   help="base ref for --changed-only (default: HEAD — "
+                        "staged + unstaged changes)")
     p.add_argument("--rules",
                    help="comma-separated subset of rules to run "
                         f"(default: all — "
@@ -70,19 +137,45 @@ def main(argv=None) -> int:
         print("--prune-baseline only applies with --write-baseline",
               file=sys.stderr)
         return 2
+    if not args.paths and not args.changed_only:
+        print("paths are required (or pass --changed-only)",
+              file=sys.stderr)
+        return 2
     missing = [p for p in args.paths if not os.path.exists(p)]
     if missing:
         print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
         return 2
-    # a path that exists but contributes no .py files is a usage error,
-    # not a clean run: a typo'd/renamed directory in a CI invocation
-    # must not turn the gate into a permanent false green
-    empty = [p for p in args.paths if not _collect_files([p])]
-    if empty:
-        print(f"no .py files under: {', '.join(empty)}", file=sys.stderr)
-        return 2
+    if args.changed_only:
+        if args.write_baseline:
+            # a baseline regenerated from a diff-narrowed view would be
+            # exactly the partial-view hazard the parse-error guard
+            # blocks — refuse outright
+            print("--write-baseline needs the full view; drop "
+                  "--changed-only", file=sys.stderr)
+            return 2
+        try:
+            targets = changed_files(args.base_ref, args.paths)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if not targets:
+            # the pre-commit fast path: a diff with no .py changes is a
+            # clean run, not the no-.py-files usage error explicit
+            # paths get — there was nothing to drift
+            print(f"no .py files changed vs {args.base_ref}: clean")
+            return 0
+    else:
+        # a path that exists but contributes no .py files is a usage
+        # error, not a clean run: a typo'd/renamed directory in a CI
+        # invocation must not turn the gate into a permanent false green
+        empty = [p for p in args.paths if not _collect_files([p])]
+        if empty:
+            print(f"no .py files under: {', '.join(empty)}",
+                  file=sys.stderr)
+            return 2
+        targets = args.paths
     baseline = None if args.no_baseline else Baseline.load(args.baseline)
-    report = analyze_paths(args.paths, rules=rules, baseline=baseline)
+    report = analyze_paths(targets, rules=rules, baseline=baseline)
 
     if args.write_baseline:
         if report.errors:

@@ -300,8 +300,14 @@ class Report:
             out[f.rule] = out.get(f.rule, 0) + 1
         return out
 
+    #: --json report schema version. v2 (ISSUE 11): added this field
+    #: itself plus the four cluster-era rules; consumers that pinned the
+    #: v1 key set keep working — the schema only grows.
+    SCHEMA_VERSION = 2
+
     def to_dict(self) -> dict:
-        return {"files_analyzed": self.files_analyzed,
+        return {"schema_version": self.SCHEMA_VERSION,
+                "files_analyzed": self.files_analyzed,
                 "elapsed_s": round(self.elapsed_s, 4),
                 "rules": list(self.rules),
                 "counts": {"total": len(self.findings),
@@ -315,15 +321,21 @@ class Report:
 def all_checkers() -> List[Checker]:
     """The registered checker set, instantiated fresh (checkers are
     stateless between runs but cheap to build)."""
+    from tools.analysis.deadline import DeadlinePropagationChecker
     from tools.analysis.donation import DonationSafetyChecker
+    from tools.analysis.exception_chaining import ExceptionChainingChecker
     from tools.analysis.lock_discipline import LockDisciplineChecker
+    from tools.analysis.metrics_drift import MetricsDriftChecker
     from tools.analysis.recompile import RecompileRiskChecker
     from tools.analysis.taxonomy import TaxonomyDriftChecker
     from tools.analysis.terminal import TerminalExactlyOnceChecker
+    from tools.analysis.wire_schema import WireSchemaDriftChecker
 
     return [LockDisciplineChecker(), DonationSafetyChecker(),
             TaxonomyDriftChecker(), TerminalExactlyOnceChecker(),
-            RecompileRiskChecker()]
+            RecompileRiskChecker(), WireSchemaDriftChecker(),
+            DeadlinePropagationChecker(), MetricsDriftChecker(),
+            ExceptionChainingChecker()]
 
 
 def _collect_files(paths: Sequence[str]) -> List[str]:

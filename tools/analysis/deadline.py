@@ -1,0 +1,129 @@
+"""``deadline-propagation``: a forwarded request keeps its deadline.
+
+The single-host stack threads ``timeout_ms`` end to end: submit stamps
+``Request.deadline_t``, admission sheds expired heads, the SLO windows
+bucket ``deadline`` terminals. The cluster tier multiplies the hops —
+front door -> host handle -> remote engine (ROADMAP item 1 adds the RPC
+leg) — and EVERY hop that drops the parameter turns a caller's 50 ms
+budget into an unbounded wait on a remote queue: the shed happens (if
+at all) at the wrong tier, with the wrong taxonomy, after the client
+gave up.
+
+The rule: a function that accepts a deadline-ish parameter (name
+containing ``timeout`` or ``deadline``) and makes a submit-shaped
+forwarding call (final callee name in :data:`FORWARD_CALLEES`) must
+reference one of those parameters somewhere in that call's arguments —
+positionally, by keyword, through a derived local (``tmo = timeout_ms
+or default`` still references it at the derivation site and usually at
+the call), or by splatting ``**kwargs`` it arrived in. A submit-shaped
+call with no deadline reference while one was available to forward is
+a finding.
+
+Functions WITHOUT a deadline-ish parameter are not findings: the
+engines' internal dispatch helpers deliberately work on already-
+stamped ``Request`` objects (the deadline rides the object, not the
+signature).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from tools.analysis.core import (
+    AnalysisUnit, Checker, call_name, iter_functions, scoped_walk,
+)
+
+#: Final callee names that forward a request/dispatch to another
+#: component. ``submit`` covers both engines and the front door;
+#: ``submit_infer``/``submit_generate`` are the HostHandle RPC seam;
+#: ``admit`` is the admission hop that stamps the deadline.
+FORWARD_CALLEES = {"submit", "submit_infer", "submit_generate", "admit"}
+
+DEADLINE_MARKERS = ("timeout", "deadline")
+
+
+def _deadline_params(fn: ast.FunctionDef) -> Set[str]:
+    args = list(fn.args.posonlyargs) + list(fn.args.args) \
+        + list(fn.args.kwonlyargs)
+    out = {a.arg for a in args
+           if any(m in a.arg.lower() for m in DEADLINE_MARKERS)}
+    return out
+
+
+def _kwargs_param(fn: ast.FunctionDef) -> str:
+    return fn.args.kwarg.arg if fn.args.kwarg is not None else ""
+
+
+def _derived_names(fn: ast.FunctionDef, seeds: Set[str]) -> Set[str]:
+    """Locals assigned FROM a deadline param (``tmo = timeout_ms or
+    self.default``) carry the deadline onward — one level is enough for
+    the stack's idioms."""
+    out = set(seeds)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        rhs_names = {n.id for n in ast.walk(node.value)
+                     if isinstance(n, ast.Name)}
+        if rhs_names & seeds:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+class DeadlinePropagationChecker(Checker):
+    rule = "deadline-propagation"
+    description = ("submit-shaped forwarding calls must thread the "
+                   "caller's deadline/timeout parameter instead of "
+                   "dropping it")
+
+    def check(self, unit: AnalysisUnit):
+        for sf in unit.files:
+            for qual, fn, _cls in iter_functions(sf.tree):
+                params = _deadline_params(fn)
+                if not params:
+                    continue
+                carriers = _derived_names(fn, params)
+                kwargs_name = _kwargs_param(fn)
+                for node in scoped_walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    chain = call_name(node)
+                    if chain is None:
+                        continue
+                    last = chain.rsplit(".", 1)[-1]
+                    if last not in FORWARD_CALLEES:
+                        continue
+                    if self._call_threads_deadline(node, carriers,
+                                                   kwargs_name):
+                        continue
+                    yield unit.finding(
+                        sf, self.rule, node,
+                        f"{qual} accepts {'/'.join(sorted(params))} but "
+                        f"this {last}() forwards without it — the "
+                        f"callee waits unbounded while the caller's "
+                        f"deadline expires unenforced; thread the "
+                        f"parameter (or shed before forwarding)")
+
+    @staticmethod
+    def _call_threads_deadline(call: ast.Call, carriers: Set[str],
+                               kwargs_name: str) -> bool:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in ast.walk(arg):
+                if isinstance(n, ast.Name) and n.id in carriers:
+                    return True
+                if isinstance(n, ast.Attribute) and any(
+                        m in n.attr.lower() for m in DEADLINE_MARKERS):
+                    # req.deadline_t / self.default_timeout_ms style:
+                    # the deadline rides an attribute through the call
+                    return True
+        # a deadline-named keyword fed from anything (e.g. a recomputed
+        # remaining-budget expression) counts as threading
+        for kw in call.keywords:
+            if kw.arg is not None and any(m in kw.arg.lower()
+                                          for m in DEADLINE_MARKERS):
+                return True
+            if kw.arg is None and isinstance(kw.value, ast.Name) \
+                    and kw.value.id == kwargs_name:
+                return True
+        return False

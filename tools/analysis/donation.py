@@ -28,17 +28,32 @@ through ``self._donated_call(point, fn, *args)``, and any callee whose
 name ends with ``_donated``. Donated bindings are the cache-like
 arguments: ``self._cache`` or any name/attribute whose final segment
 contains ``cache``.
+
+ISSUE 11 added BOUNDED TRANSITIVE same-class call expansion: a method
+that donates ``self._cache`` (directly, through a retry closure, or
+through further same-class calls up to :data:`EXPANSION_DEPTH` levels)
+and never rebinds it afterwards leaves the binding consumed for its
+CALLER — so ``self._step_once()`` acts as a donation event in the
+calling scope, and a read of ``self._cache`` after it (with no rebind
+or epoch guard) is the same use-after-donate the direct form is. A
+method that writes ``self._cache`` back anywhere (the epoch-guarded
+writeback every scheduler path uses) does NOT propagate — its callers
+see a live binding.
 """
 from __future__ import annotations
 
 import ast
-from typing import List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from tools.analysis.core import (
     AnalysisUnit, Checker, attr_chain, call_name, iter_functions,
 )
 
 DONATED_CALLEES = {"_prefill", "_decode"}
+
+#: Same-class call levels the consumed-binding summary propagates
+#: through (mirrors lock_discipline.EXPANSION_DEPTH).
+EXPANSION_DEPTH = 4
 
 
 def _is_donated_call(node: ast.Call) -> bool:
@@ -104,17 +119,107 @@ def _guard_lines(fn: ast.AST) -> Set[int]:
     return guarded
 
 
+def _method_summary(fn: ast.FunctionDef) -> Tuple[Set[str], Set[str]]:
+    """(self-attr bindings this method donates ANYWHERE — including
+    inside its retry closures, which run before the method returns —
+    and self-attr bindings it stores). A method whose donated set minus
+    its stored set is non-empty leaves those bindings consumed for its
+    caller."""
+    donated: Set[str] = set()
+    stored: Set[str] = set()
+    aliases: Dict[str, str] = {}   # local name -> self.* source
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            src = attr_chain(node.value)
+            if src is not None and src.startswith("self.") \
+                    and "cache" in src.rsplit(".", 1)[-1].lower():
+                for tgt in node.targets:
+                    t = attr_chain(tgt)
+                    if t is not None and not t.startswith("self."):
+                        aliases[t] = src
+        if isinstance(node, ast.Call) and _is_donated_call(node):
+            for b in _donated_args(node):
+                if b.startswith("self."):
+                    donated.add(b)
+                elif b in aliases:
+                    donated.add(aliases[b])
+        chain = attr_chain(node)
+        if chain is not None and chain.startswith("self.") \
+                and isinstance(getattr(node, "ctx", None), ast.Store):
+            stored.add(chain)
+    return donated, stored
+
+
+def _class_consumers(methods: Dict[str, ast.FunctionDef],
+                     depth: int = EXPANSION_DEPTH) -> Dict[str, Set[str]]:
+    """Per method: the self-attr bindings a call to it leaves consumed,
+    propagated through same-class calls up to ``depth`` levels."""
+    direct: Dict[str, Set[str]] = {}
+    stores: Dict[str, Set[str]] = {}
+    callees: Dict[str, Set[str]] = {}
+    for name, fn in methods.items():
+        donated, stored = _method_summary(fn)
+        direct[name] = donated
+        stores[name] = stored
+        calls = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                chain = call_name(node)
+                if chain is not None and chain.startswith("self.") \
+                        and chain.count(".") == 1:
+                    calls.add(chain.split(".", 1)[1])
+        callees[name] = calls & set(methods)
+    summary = {name: direct[name] - stores[name] for name in methods}
+    for _ in range(max(0, depth - 1)):
+        changed = False
+        for name in methods:
+            inherited: Set[str] = set()
+            for callee in callees[name]:
+                inherited |= summary.get(callee, set())
+            new = (direct[name] | inherited) - stores[name]
+            if new != summary[name]:
+                summary[name] = new
+                changed = True
+        if not changed:
+            break
+    return {name: s for name, s in summary.items() if s}
+
+
 class DonationSafetyChecker(Checker):
     rule = "donation-safety"
     description = ("reads of a donated cache binding after the donated "
-                   "call, with no rebuild/epoch guard in between")
+                   "call (direct, or through a same-class method that "
+                   "leaves the binding consumed), with no rebuild/epoch "
+                   "guard in between")
+
+    def __init__(self, expansion_depth: int = EXPANSION_DEPTH):
+        self.expansion_depth = expansion_depth
 
     def check(self, unit: AnalysisUnit):
         for sf in unit.files:
-            for qual, fn, _cls in iter_functions(sf.tree):
-                yield from self._check_function(unit, sf, fn)
+            # per-class consumed-binding summaries for the transitive
+            # expansion (same-file classes only: the donation chains all
+            # live inside one engine module)
+            consumers_by_class: Dict[str, Dict[str, Set[str]]] = {}
+            methods_by_class: Dict[str, Dict[str, ast.FunctionDef]] = {}
+            for qual, fn, cls in iter_functions(sf.tree):
+                if cls is not None and "." not in qual[:-len(fn.name) - 1]:
+                    methods_by_class.setdefault(cls.name, {})
+                    if fn.name not in methods_by_class[cls.name]:
+                        methods_by_class[cls.name][fn.name] = fn
+            for cname, methods in methods_by_class.items():
+                consumers_by_class[cname] = _class_consumers(
+                    methods, self.expansion_depth)
+            for qual, fn, cls in iter_functions(sf.tree):
+                consumers = consumers_by_class.get(
+                    cls.name, {}) if cls is not None else {}
+                # a method must not treat its OWN call chain as a
+                # donation event for itself (recursion)
+                consumers = {k: v for k, v in consumers.items()
+                             if k != fn.name}
+                yield from self._check_function(unit, sf, fn, consumers)
 
-    def _check_function(self, unit, sf, fn):
+    def _check_function(self, unit, sf, fn, consumers=None):
         # donation events in THIS scope (nested defs excluded). A donated
         # call whose enclosing statement is a return/raise leaves the
         # scope on that path — nothing can read the binding "after" it
@@ -127,12 +232,25 @@ class DonationSafetyChecker(Checker):
                                       ast.AsyncFunctionDef)):
                     continue
                 child_stmt = child if isinstance(child, ast.stmt) else stmt
-                if isinstance(child, ast.Call) and _is_donated_call(child) \
+                if isinstance(child, ast.Call) \
                         and not isinstance(child_stmt,
                                            (ast.Return, ast.Raise)):
-                    args = _donated_args(child)
-                    if args:
-                        donations.append((child, args, child_stmt))
+                    if _is_donated_call(child):
+                        args = _donated_args(child)
+                        if args:
+                            donations.append((child, args, child_stmt))
+                    elif consumers:
+                        # transitive: a same-class method that leaves
+                        # self-attr bindings consumed is a donation
+                        # event in this scope too
+                        chain = call_name(child)
+                        if chain is not None and chain.startswith("self.") \
+                                and chain.count(".") == 1:
+                            m = chain.split(".", 1)[1]
+                            if m in consumers:
+                                donations.append(
+                                    (child, sorted(consumers[m]),
+                                     child_stmt))
                 find_calls(child, child_stmt)
 
         find_calls(fn, None)

@@ -1,0 +1,63 @@
+"""``exception-chaining``: don't lose the cause inside ``except``.
+
+The taxonomy (``tracing.terminal_reason``) and the crash dumps
+(``util/crash_reporting``) both walk ``__cause__`` chains to answer
+"WHY did this request fail" — a ``raise NewError(...)`` inside an
+``except`` block without ``from`` replaces the explicit cause chain
+with implicit ``__context__``, which ``raise ... from None``-style
+sanitizing, future refactors, and the dump renderer all treat
+differently. PR 10's bounce-retry conversion
+(``ClusterCapacityError(...) from host_rejection``) is the idiom: the
+fleet-level shed CARRIES the host's typed rejection.
+
+The rule: a ``raise <Constructor>(...)`` lexically inside an ``except``
+handler must carry an explicit ``from`` clause — ``from e`` to chain,
+``from None`` to deliberately sever. Bare ``raise`` (re-raise) and
+``raise e`` (the caught object itself) keep their tracebacks and are
+exempt, as are raises inside nested ``def``\\ s (those run later,
+outside the handler's context).
+"""
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.core import AnalysisUnit, Checker
+
+
+def _handler_raises(handler: ast.ExceptHandler):
+    """Raise nodes lexically inside this handler's body, nested
+    defs/handlers excluded (inner handlers are visited on their own)."""
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ExceptHandler)):
+            continue
+        if isinstance(node, ast.Raise):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class ExceptionChainingChecker(Checker):
+    rule = "exception-chaining"
+    description = ("raise <NewError>(...) inside an except block without "
+                   "'from' loses the cause the taxonomy and crash dumps "
+                   "depend on")
+
+    def check(self, unit: AnalysisUnit):
+        for sf in unit.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                for r in _handler_raises(node):
+                    if r.exc is None or r.cause is not None:
+                        continue   # bare re-raise / explicit from
+                    if not isinstance(r.exc, ast.Call):
+                        continue   # `raise e` keeps its traceback
+                    yield unit.finding(
+                        sf, self.rule, r,
+                        f"raise inside an except block without 'from' — "
+                        f"the cause chain the taxonomy and crash dumps "
+                        f"walk is lost; write 'raise ... from "
+                        f"{node.name or 'e'}' (or 'from None' to sever "
+                        f"deliberately)")

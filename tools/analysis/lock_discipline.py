@@ -23,13 +23,28 @@ The contract (written down in PR 1/3/7 review rounds, now enforced):
 3. **No lock-order inversions.** Nested acquisitions define edges in a
    per-class lock graph (A held while B is taken => A -> B); a cycle
    means two threads can deadlock. Edges come from lexical nesting plus
-   ONE level of same-class call expansion (method f holds A and calls
-   ``self.g()``; g acquires B).
+   BOUNDED TRANSITIVE same-class call expansion (method f holds A and
+   calls ``self.g()``; g calls ``self.h()``; h acquires B — the A -> B
+   edge is found through the whole chain, up to
+   :data:`EXPANSION_DEPTH` call levels). ISSUE 11 upgraded this from
+   one level: the serving stack's real deadlock risks live two and
+   three calls deep (``_decode_iteration -> _clear_slot ->
+   allocator``-shaped chains), which the one-level expansion was blind
+   to. Blocking calls propagate through the same chains: f holding A
+   and calling ``self.g()`` where g (or anything g reaches, same
+   class) sleeps/joins/dispatches is flagged at f's call site.
 
 Lock sites are recognized syntactically: ``with self.<attr>:`` where
 the attribute name contains ``lock`` or ``cv`` (``_lock``, ``_wd_lock``,
 ``_prefix_lock``, ``_cv``, ...), plus bare local names matching the
 same pattern.
+
+:func:`static_lock_graph` exports the same per-class edge set (with
+mixin/base-class edges projected onto their subclasses) as a plain
+``{"edges": [[outer, inner], ...]}`` graph over ``Class.attr`` nodes —
+the static half of the runtime-lockdep differential
+(:mod:`tools.analysis.lockdep` records the dynamic half from
+instrumented locks while the chaos suite runs).
 """
 from __future__ import annotations
 
@@ -37,8 +52,15 @@ import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from tools.analysis.core import (
-    AnalysisUnit, Checker, Finding, attr_chain, call_name, iter_functions,
+    AnalysisUnit, Checker, attr_chain, call_name, iter_functions,
 )
+
+#: How many same-class call levels the transitive expansion follows.
+#: Depth 1 is the pre-ISSUE-11 behavior (direct callees only); the
+#: serving stack's deepest real chain today is 3 calls, so 4 leaves one
+#: level of headroom without risking pathological blowup on cyclic call
+#: graphs (the walker is visited-set bounded anyway).
+EXPANSION_DEPTH = 4
 
 #: Callees that block (or can block) the calling thread. Matched on the
 #: FINAL attribute / bare name of the callee.
@@ -93,6 +115,19 @@ class _FunctionLockInfo:
         self.relocks: List[Tuple[str, ast.With]] = []
         # self-method calls under a lock: (held, method name, Call node)
         self.self_calls: List[Tuple[Tuple[str, ...], str, ast.Call]] = []
+        # every self-method call, held or not — the transitive expansion
+        # follows these to find acquires/blocking calls further down
+        self.all_self_calls: Set[str] = set()
+        # blocking calls ANYWHERE in the function (held or not) as
+        # (why, Call node): when a caller holds a lock and reaches this
+        # function through same-class calls, these block under that lock
+        self.blocking_calls: List[Tuple[str, ast.Call]] = []
+        # Condition.wait sites that are exempt LOCALLY (wait on a lock
+        # this function itself holds, or a lone wait with nothing held)
+        # as (waited-on lock key, Call node): a caller holding a
+        # DIFFERENT lock through a same-class call chain turns these
+        # into the two-lock sleep — wait releases only its own lock
+        self.lock_waits: List[Tuple[str, ast.Call]] = []
 
 
 def _scan_function(fn: ast.FunctionDef) -> _FunctionLockInfo:
@@ -118,13 +153,25 @@ def _scan_function(fn: ast.FunctionDef) -> _FunctionLockInfo:
                     cur = cur + (lk,)
                 walk(child, cur)
                 continue
-            if isinstance(child, ast.Call) and held:
-                info.calls_under_lock.append((held, child))
+            if isinstance(child, ast.Call):
                 chain = call_name(child)
+                if held:
+                    info.calls_under_lock.append((held, child))
                 if chain is not None and chain.startswith("self.") \
                         and chain.count(".") == 1:
-                    info.self_calls.append((held, chain.split(".", 1)[1],
-                                            child))
+                    info.all_self_calls.add(chain.split(".", 1)[1])
+                    if held:
+                        info.self_calls.append((held, chain.split(".", 1)[1],
+                                                child))
+                blocking, why = _is_blocking_call(child, held)
+                if blocking:
+                    info.blocking_calls.append((why, child))
+                elif chain is not None and \
+                        chain.rsplit(".", 1)[-1] in ("wait", "wait_for") \
+                        and isinstance(child.func, ast.Attribute) \
+                        and is_lock_expr(child.func.value) is not None:
+                    info.lock_waits.append(
+                        (attr_chain(child.func.value), child))
             walk(child, held)
 
     walk(fn, ())
@@ -150,15 +197,16 @@ def _is_blocking_call(call: ast.Call, held: Tuple[str, ...]):
                 and isinstance(call.func.value, ast.Constant):
             return False, ""
         return True, f".{last}()"
-    if last == "wait":
+    if last in ("wait", "wait_for"):
         # Condition.wait on a HELD lock releases it while waiting — the
         # canonical pattern; waiting on anything else under a lock is
-        # a two-lock sleep
+        # a two-lock sleep (with nothing held, a lone wait is not this
+        # checker's business)
         if recv in held:
             return False, ""
-        if is_lock_expr(call.func.value if isinstance(call.func,
-                                                      ast.Attribute)
-                        else call.func) is not None:
+        if held and is_lock_expr(call.func.value if isinstance(call.func,
+                                                               ast.Attribute)
+                                 else call.func) is not None:
             return True, f"wait on {recv or chain} while holding a " \
                          f"different lock"
         return False, ""
@@ -170,84 +218,231 @@ def _is_blocking_call(call: ast.Call, held: Tuple[str, ...]):
     return False, ""
 
 
+def _reachable_facts(fns: Dict[str, _FunctionLockInfo], root: str,
+                     depth: int):
+    """Locks acquired, blocking calls, and locally-exempt Condition
+    waits reachable from same-class method ``root`` within ``depth``
+    call levels (``root``'s own body is level 1). Returns
+    ``({lock: call path}, [(why, call path)], {waited lock: call path})``
+    or None when ``root`` is not a method of this class; paths are
+    tuples of method names starting at ``root``. Visited-set bounded,
+    so a recursive call graph terminates regardless of depth."""
+    if root not in fns:
+        return None
+    acquires: Dict[str, Tuple[str, ...]] = {}
+    blocking: List[Tuple[str, Tuple[str, ...]]] = []
+    blocked_seen: Set[Tuple[str, Tuple[str, ...]]] = set()
+    waits: Dict[str, Tuple[str, ...]] = {}
+    seen = {root}
+    frontier: List[Tuple[str, Tuple[str, ...]]] = [(root, (root,))]
+    level = 0
+    while frontier and level < depth:
+        level += 1
+        nxt: List[Tuple[str, Tuple[str, ...]]] = []
+        for fname, path in frontier:
+            info = fns[fname]
+            for lk in sorted(info.acquires):
+                acquires.setdefault(lk, path)
+            for why, _node in info.blocking_calls:
+                if (why, path) not in blocked_seen:
+                    blocked_seen.add((why, path))
+                    blocking.append((why, path))
+            for wlk, _node in info.lock_waits:
+                waits.setdefault(wlk, path)
+            for callee in sorted(info.all_self_calls):
+                if callee in fns and callee not in seen:
+                    seen.add(callee)
+                    nxt.append((callee, path + (callee,)))
+        frontier = nxt
+    return acquires, blocking, waits
+
+
+class _ClassIndex:
+    """Unit-wide class resolution: per-class function infos with
+    ancestor methods folded in (subclass methods shadow), so the
+    transitive expansion follows ``self._retry_call()`` from an engine
+    method into the mixin that defines it — class hierarchies span
+    files in the serving stack (ResilientEngineMixin lives in
+    resilience.py, its subclasses in engine.py/generation.py)."""
+
+    def __init__(self, unit: AnalysisUnit):
+        # classes are keyed (file path, class name): two unrelated
+        # same-named classes in different files must NOT merge into one
+        # lock graph — merged edges fabricate inversions spanning
+        # classes that never share an instance, and transitive
+        # expansion would follow the wrong class's methods. Base-name
+        # resolution (the deliberate cross-file mixin case) goes
+        # through _resolve below.
+        self.fns_raw: Dict[Tuple[str, str],
+                           Dict[str, _FunctionLockInfo]] = {}
+        self.bases: Dict[Tuple[str, str], List[str]] = {}
+        self.by_name: Dict[str, List[Tuple[str, str]]] = {}
+        # (sf, qual, cls, info) for every function, for per-site checks
+        self.all_fns: List[Tuple[object, str, Optional[ast.ClassDef],
+                                 ast.FunctionDef, _FunctionLockInfo]] = []
+        for sf in unit.files:
+            for qual, fn, cls in iter_functions(sf.tree):
+                info = _scan_function(fn)
+                self.all_fns.append((sf, qual, cls, fn, info))
+                if cls is None:
+                    continue
+                key = (sf.path, cls.name)
+                if key not in self.fns_raw:
+                    self.fns_raw[key] = {}
+                    self.by_name.setdefault(cls.name, []).append(key)
+                    self.bases[key] = [
+                        b.id if isinstance(b, ast.Name) else b.attr
+                        for b in cls.bases
+                        if isinstance(b, (ast.Name, ast.Attribute))]
+                # first definition wins within a class (rare; keeps
+                # results deterministic)
+                self.fns_raw[key].setdefault(fn.name, info)
+        self._eff: Dict[Tuple[str, str],
+                        Dict[str, _FunctionLockInfo]] = {}
+
+    def _resolve(self, name: str,
+                 from_path: str) -> Optional[Tuple[str, str]]:
+        """The class key a base NAME refers to: same-file definition
+        wins, else the first in path order (deterministic; cross-file
+        mixins like ResilientEngineMixin are single-definition in
+        practice)."""
+        cands = self.by_name.get(name, [])
+        if not cands:
+            return None
+        for k in cands:
+            if k[0] == from_path:
+                return k
+        return min(cands)
+
+    def ancestors(self, key: Tuple[str, str]) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        stack = [(b, key[0]) for b in self.bases.get(key, [])]
+        while stack:
+            bname, frm = stack.pop(0)
+            k = self._resolve(bname, frm)
+            if k is not None and k not in out and k != key:
+                out.append(k)
+                stack.extend((b, k[0]) for b in self.bases.get(k, []))
+        return out
+
+    def effective_fns(self, key: Tuple[str, str]
+                      ) -> Dict[str, _FunctionLockInfo]:
+        got = self._eff.get(key)
+        if got is None:
+            got = {}
+            for anc in reversed(self.ancestors(key)):
+                got.update(self.fns_raw.get(anc, {}))
+            got.update(self.fns_raw.get(key, {}))
+            self._eff[key] = got
+        return got
+
+
 class LockDisciplineChecker(Checker):
     rule = "lock-discipline"
     description = ("lock-order inversions, same-lock re-acquisition, and "
-                   "blocking calls under a held lock")
+                   "blocking calls under a held lock (direct or through "
+                   "bounded transitive same-class calls)")
+
+    def __init__(self, expansion_depth: int = EXPANSION_DEPTH):
+        self.expansion_depth = expansion_depth
 
     def check(self, unit: AnalysisUnit):
-        for sf in unit.files:
-            # per-class lock graph: class name -> {(outer, inner): site}
-            class_edges: Dict[str, Dict[Tuple[str, str],
-                                        Tuple[ast.AST, str]]] = {}
-            class_fn_info: Dict[str, Dict[str, _FunctionLockInfo]] = {}
-            fn_infos: List[Tuple[str, Optional[ast.ClassDef],
-                                 _FunctionLockInfo]] = []
-            for qual, fn, cls in iter_functions(sf.tree):
-                info = _scan_function(fn)
-                fn_infos.append((qual, cls, info))
-                if cls is not None:
-                    class_fn_info.setdefault(cls.name, {})[fn.name] = info
+        index = _ClassIndex(unit)
+        # per-class lock graph: (file, class name) -> {(outer, inner):
+        # site} — keyed like _ClassIndex so same-named classes in
+        # different files keep separate graphs
+        class_edges: Dict[Tuple[str, str],
+                          Dict[Tuple[str, str],
+                               Tuple[object, ast.AST, str]]] = {}
 
-            for qual, cls, info in fn_infos:
-                # ---- blocking under lock + same-lock re-acquisition
-                for held, call in info.calls_under_lock:
-                    blocking, why = _is_blocking_call(call, held)
-                    if blocking:
+        for sf, qual, cls, fn, info in index.all_fns:
+            # ---- blocking under lock + same-lock re-acquisition
+            for held, call in info.calls_under_lock:
+                blocking, why = _is_blocking_call(call, held)
+                if blocking:
+                    yield unit.finding(
+                        sf, self.rule, call,
+                        f"blocking call ({why}) while holding "
+                        f"{' + '.join(held)} — fail futures/dispatch "
+                        f"outside the lock (see "
+                        f"AdmissionController.take)")
+            for lk, site in info.relocks:
+                yield unit.finding(
+                    sf, self.rule, site,
+                    f"re-acquisition of non-reentrant {lk} while "
+                    f"already held — guaranteed deadlock")
+            # ---- lexical nesting edges
+            if cls is not None:
+                edges = class_edges.setdefault((sf.path, cls.name), {})
+                for outer, inner, site in info.nested:
+                    edges.setdefault((outer, inner), (sf, site, qual))
+
+            # ---- bounded transitive call expansion (same class,
+            # ancestor methods included)
+            if cls is None or not info.self_calls:
+                continue
+            ckey = (sf.path, cls.name)
+            fns = index.effective_fns(ckey)
+            edges = class_edges.setdefault(ckey, {})
+            for held, callee, call in info.self_calls:
+                reach = _reachable_facts(fns, callee, self.expansion_depth)
+                if reach is None:
+                    continue
+                acquires, blocking, waits = reach
+                for outer in held:
+                    for inner, path in acquires.items():
+                        via = " -> ".join(f"self.{p}()" for p in path)
+                        if inner == outer:
+                            yield unit.finding(
+                                sf, self.rule, call,
+                                f"{cls.name}.{fn.name} holds {outer} "
+                                f"and calls {via}, which re-acquires "
+                                f"{inner} — non-reentrant deadlock")
+                        else:
+                            edges.setdefault(
+                                (outer, inner),
+                                (sf, call,
+                                 f"{cls.name}.{fn.name} -> {via}"))
+                for why, path in blocking:
+                    via = " -> ".join(f"self.{p}()" for p in path)
+                    yield unit.finding(
+                        sf, self.rule, call,
+                        f"{cls.name}.{fn.name} holds "
+                        f"{' + '.join(held)} and calls {via}, which "
+                        f"blocks ({why}) — the lock is held for the "
+                        f"whole wait (move the blocking call outside, "
+                        f"or drop the lock first)")
+                # a callee's Condition.wait is exempt in ITS body (wait
+                # releases its own lock) but becomes the two-lock sleep
+                # when this caller holds a DIFFERENT lock across the
+                # chain — the held lock stays held for the whole wait
+                for waitlock, path in waits.items():
+                    for outer in held:
+                        if outer == waitlock:
+                            continue
+                        via = " -> ".join(f"self.{p}()" for p in path)
                         yield unit.finding(
                             sf, self.rule, call,
-                            f"blocking call ({why}) while holding "
-                            f"{' + '.join(held)} — fail futures/dispatch "
-                            f"outside the lock (see "
-                            f"AdmissionController.take)")
-                for lk, site in info.relocks:
+                            f"{cls.name}.{fn.name} holds {outer} and "
+                            f"calls {via}, which waits on {waitlock} — "
+                            f"{outer} is held for the whole wait "
+                            f"(two-lock sleep through the call chain)")
+
+        # ---- cycles in each class's lock graph
+        for (_path, cname), edges in class_edges.items():
+            adj: Dict[str, Set[str]] = {}
+            for (a, b) in edges:
+                adj.setdefault(a, set()).add(b)
+            for (a, b), (sf, site, where) in sorted(
+                    edges.items(), key=lambda kv: (
+                        kv[1][0].path, getattr(kv[1][1], "lineno", 0),
+                        kv[0])):
+                if self._reaches(adj, b, a):
                     yield unit.finding(
                         sf, self.rule, site,
-                        f"re-acquisition of non-reentrant {lk} while "
-                        f"already held — guaranteed deadlock")
-                # ---- lexical nesting edges
-                if cls is not None:
-                    edges = class_edges.setdefault(cls.name, {})
-                    for outer, inner, site in info.nested:
-                        edges.setdefault((outer, inner), (site, qual))
-
-            # ---- one-level call expansion within each class
-            for cname, fns in class_fn_info.items():
-                edges = class_edges.setdefault(cname, {})
-                for fname, info in fns.items():
-                    for held, callee, call in info.self_calls:
-                        target = fns.get(callee)
-                        if target is None:
-                            continue
-                        for outer in held:
-                            for inner in target.acquires:
-                                if inner == outer:
-                                    yield unit.finding(
-                                        sf, self.rule, call,
-                                        f"{cname}.{fname} holds {outer} "
-                                        f"and calls self.{callee}(), "
-                                        f"which re-acquires {inner} — "
-                                        f"non-reentrant deadlock")
-                                else:
-                                    edges.setdefault(
-                                        (outer, inner),
-                                        (call, f"{cname}.{fname} -> "
-                                               f"self.{callee}"))
-
-            # ---- cycles in each class's lock graph
-            for cname, edges in class_edges.items():
-                adj: Dict[str, Set[str]] = {}
-                for (a, b) in edges:
-                    adj.setdefault(a, set()).add(b)
-                for (a, b), (site, where) in sorted(
-                        edges.items(), key=lambda kv: (
-                            getattr(kv[1][0], "lineno", 0), kv[0])):
-                    if self._reaches(adj, b, a):
-                        yield unit.finding(
-                            sf, self.rule, site,
-                            f"lock-order inversion in {cname}: {a} -> {b} "
-                            f"({where}) closes a cycle with the reverse "
-                            f"ordering elsewhere — pick one global order")
+                        f"lock-order inversion in {cname}: {a} -> {b} "
+                        f"({where}) closes a cycle with the reverse "
+                        f"ordering elsewhere — pick one global order")
 
     @staticmethod
     def _reaches(adj: Dict[str, Set[str]], src: str, dst: str) -> bool:
@@ -261,3 +456,63 @@ class LockDisciplineChecker(Checker):
             seen.add(n)
             stack.extend(adj.get(n, ()))
         return False
+
+
+# --------------------------------------------------------- static graph
+def _normalize_node(cname: str, key: str) -> str:
+    """'self._wd_lock' within class C -> 'C._wd_lock' — the node naming
+    runtime lockdep also produces (instance class + attribute name), so
+    the two graphs diff directly."""
+    return f"{cname}.{key[5:] if key.startswith('self.') else key}"
+
+
+def static_lock_graph(paths: List[str],
+                      depth: int = EXPANSION_DEPTH) -> dict:
+    """The static half of the lockdep differential: every lock-order
+    edge the :class:`LockDisciplineChecker` derives (lexical nesting +
+    bounded transitive same-class expansion), flattened to one edge set
+    over ``Class.attr`` nodes. Base/mixin-class edges are projected
+    onto every subclass in the unit as well — at runtime the locks
+    belong to INSTANCES, and :mod:`tools.analysis.lockdep` names nodes
+    by the instance's class, so ``ResilientEngineMixin``'s
+    ``self._wd_lock`` nesting shows up dynamically as
+    ``GenerationEngine._wd_lock``."""
+    from tools.analysis.core import SourceFile, _collect_files
+
+    files = []
+    for fp in _collect_files(paths):
+        try:
+            with open(fp, encoding="utf-8") as f:
+                files.append(SourceFile(fp, f.read()))
+        except (OSError, SyntaxError):
+            continue
+    unit = AnalysisUnit(files)
+    index = _ClassIndex(unit)
+    raw_edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+    for ckey in index.fns_raw:
+        es = raw_edges.setdefault(ckey, set())
+        fns = index.effective_fns(ckey)
+        for fname, info in index.fns_raw[ckey].items():
+            for outer, inner, _site in info.nested:
+                es.add((outer, inner))
+            for held, callee, _call in info.self_calls:
+                reach = _reachable_facts(fns, callee, depth)
+                if reach is None:
+                    continue
+                acquires, _blocking, _waits = reach
+                for outer in held:
+                    for inner in acquires:
+                        if inner != outer:
+                            es.add((outer, inner))
+    edges: Set[Tuple[str, str]] = set()
+    for ckey, es in raw_edges.items():
+        # project base/mixin edges onto subclasses: runtime lockdep
+        # names nodes by the INSTANCE's class
+        holders = [ckey[1]] + [c[1] for c in index.fns_raw
+                               if ckey in index.ancestors(c)]
+        for holder in holders:
+            for outer, inner in es:
+                edges.add((_normalize_node(holder, outer),
+                           _normalize_node(holder, inner)))
+    return {"depth": depth,
+            "edges": sorted([a, b] for a, b in edges)}

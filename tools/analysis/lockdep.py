@@ -1,0 +1,636 @@
+"""Runtime lockdep: dynamic lock-order validation for the serving stack.
+
+The static ``lock-discipline`` checker sees lexical nesting plus
+bounded same-class call expansion — by construction it is blind to
+acquisition orders that only exist DYNAMICALLY: an engine thread
+holding ``GenerationEngine._wd_lock`` while the admission controller's
+``_cv`` fires a callback, a metrics counter lock taken under a
+scheduler lock three objects away. This module is the other half, in
+the style of Eraser (Savage et al., SOSP'97) and Linux lockdep:
+instrumented wrappers for ``threading.Lock`` / ``RLock`` /
+``Condition`` record, while the real tier-1 chaos/stress tests run,
+
+- the per-thread **acquisition-order graph** over lock CLASSES (locks
+  are classed by creation site, lockdep-style: instance class +
+  attribute name, ``GenerationEngine._wd_lock``, so every engine
+  instance maps to one node),
+- **held-lock blocking calls** — ``Condition.wait`` entered while
+  OTHER locks are held (the dynamic analogue of the static two-lock
+  sleep rule), and
+- **hold times** (max + total per class, acquire-contention wait max).
+
+:func:`differential` then cross-checks the dynamic graph against
+``lock_discipline.static_lock_graph``: dynamic-only edges expose
+call-indirection blind spots in the static checker (each must be
+waived-with-why in ``tools/analysis/lockgraph.json`` or fixed), and a
+cycle in the MERGED graph is a potential deadlock neither side can
+prove safe alone.
+
+Opt-in and bitwise-inert when off: nothing is patched at import time;
+``install()`` swaps the ``threading`` factories and ``uninstall()``
+restores them. Locks created from NON-repo code (pytest, stdlib
+internals) get real primitives — zero overhead outside the
+``deeplearning4j_tpu`` package.
+
+Pytest plugin (THE intended entry point)::
+
+    LOCKDEP_REPORT=/tmp/lockdep.json \\
+        python -m pytest tests/test_resilience.py -q -m 'not slow' \\
+        -p tools.analysis.lockdep
+
+``pytest_configure`` installs the wrappers before test modules import,
+``pytest_unconfigure`` writes the JSON report and restores threading.
+
+CLI::
+
+    python -m tools.analysis.lockdep --report /tmp/lockdep.json          # diff
+    python -m tools.analysis.lockdep --report /tmp/lockdep.json --update # regen
+
+``--update`` folds newly-observed dynamic edges into
+``lockgraph.json`` (waivers and their whys are preserved); the plain
+run prints the differential and exits 1 on unwaived dynamic-only edges
+or merged-graph cycles.
+"""
+from __future__ import annotations
+
+import json
+import linecache
+import os
+import re
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+#: Only locks created from files under these path fragments are
+#: tracked — everything else passes through as a real primitive.
+REPO_MARKERS = (os.sep + "deeplearning4j_tpu" + os.sep,)
+
+DEFAULT_GRAPH = os.path.join(os.path.dirname(__file__), "lockgraph.json")
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+_ASSIGN_RE = re.compile(r"\s*(self\.)?([A-Za-z_]\w*)\s*[:=]")
+
+
+class _State:
+    """Global lockdep state. Mutations ride a REAL lock (the
+    instrumented factories are never active inside this module)."""
+
+    def __init__(self):
+        self.enabled = False
+        self._mu = _REAL_LOCK()
+        self._tls = threading.local()
+        # (src class, dst class) -> count
+        self.edges: Dict[Tuple[str, str], int] = {}
+        # same-class nesting (two INSTANCES of one class held together):
+        # not an order edge — a self-loop would fail every cycle check —
+        # but worth surfacing in the report
+        self.same_class: Dict[str, int] = {}
+        # class -> [n_acquires, max_hold_s, total_hold_s, max_wait_s]
+        self.holds: Dict[str, List[float]] = {}
+        # Condition.wait entered while holding other locks:
+        # (waited-on class, tuple of held classes) -> count
+        self.waits_under_lock: Dict[Tuple[str, Tuple[str, ...]], int] = {}
+
+    # ---------------------------------------------------------- per-thread
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def on_acquire(self, key: str, obj_id: int, waited_s: float):
+        st = self._stack()
+        nested = any(e[1] == obj_id for e in st)
+        if not nested:
+            held = []
+            for e in st:
+                if e[0] not in held:
+                    held.append(e[0])
+            with self._mu:
+                for h in held:
+                    if h == key:
+                        self.same_class[key] = \
+                            self.same_class.get(key, 0) + 1
+                    else:
+                        self.edges[(h, key)] = \
+                            self.edges.get((h, key), 0) + 1
+                rec = self.holds.setdefault(key, [0, 0.0, 0.0, 0.0])
+                rec[0] += 1
+                if waited_s > rec[3]:
+                    rec[3] = waited_s
+        st.append((key, obj_id, time.perf_counter(), nested))
+
+    def on_release(self, key: str, obj_id: int):
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][1] == obj_id:
+                _k, _oid, t0, nested = st.pop(i)
+                if not nested:
+                    held_s = time.perf_counter() - t0
+                    with self._mu:
+                        rec = self.holds.setdefault(key, [0, 0.0, 0.0, 0.0])
+                        if held_s > rec[1]:
+                            rec[1] = held_s
+                        rec[2] += held_s
+                return
+
+    def on_wait(self, key: str, obj_id: int):
+        """Condition.wait entry: the condition's lock is released for
+        the wait — pop it; record the held-lock blocking call if other
+        locks stay held (st entries for OTHER objects)."""
+        st = self._stack()
+        others = tuple(sorted({e[0] for e in st if e[1] != obj_id}))
+        if others:
+            with self._mu:
+                k = (key, others)
+                self.waits_under_lock[k] = \
+                    self.waits_under_lock.get(k, 0) + 1
+        self.on_release(key, obj_id)
+
+    def on_wait_done(self, key: str, obj_id: int):
+        # re-acquisition after the wait: same edge semantics as a fresh
+        # acquire — the re-take happens while the OTHER held locks are
+        # still held, so order edges are recorded again (idempotent)
+        self.on_acquire(key, obj_id, 0.0)
+
+    # ------------------------------------------------------------- reading
+    def reset(self):
+        with self._mu:
+            self.edges.clear()
+            self.same_class.clear()
+            self.holds.clear()
+            self.waits_under_lock.clear()
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "schema_version": 1,
+                "edges": [{"src": a, "dst": b, "count": n}
+                          for (a, b), n in sorted(self.edges.items())],
+                "same_class_nesting": dict(sorted(
+                    self.same_class.items())),
+                "holds": {k: {"acquires": int(v[0]),
+                              "max_hold_ms": round(v[1] * 1e3, 3),
+                              "total_hold_ms": round(v[2] * 1e3, 3),
+                              "max_acquire_wait_ms": round(v[3] * 1e3, 3)}
+                          for k, v in sorted(self.holds.items())},
+                "waits_under_lock": [
+                    {"wait_on": k, "holding": list(held), "count": n}
+                    for (k, held), n in sorted(
+                        self.waits_under_lock.items())],
+            }
+
+
+_STATE = _State()
+
+
+# --------------------------------------------------------------------------
+# Lock classing: creation-site naming
+# --------------------------------------------------------------------------
+def _creation_key() -> Optional[str]:
+    """The lock-class key for a primitive being created RIGHT NOW, from
+    the first repo frame up the stack: ``InstanceClass._attr`` when the
+    creation line is a ``self._attr = threading.Lock()`` assignment
+    inside a method, ``module.py:NAME`` for module-level locks, None
+    (-> untracked real primitive) when no repo frame exists."""
+    f = sys._getframe(2)
+    while f is not None:
+        fname = f.f_code.co_filename
+        if any(m in fname for m in REPO_MARKERS):
+            break
+        f = f.f_back
+    if f is None:
+        return None
+    line = linecache.getline(f.f_code.co_filename, f.f_lineno)
+    m = _ASSIGN_RE.match(line)
+    attr = m.group(2) if m else None
+    if m and m.group(1):   # self._attr = ...
+        slf = f.f_locals.get("self")
+        if slf is not None:
+            return f"{type(slf).__name__}.{attr}"
+    base = os.path.basename(f.f_code.co_filename)
+    if attr:
+        return f"{base}:{attr}"
+    return f"{base}:{f.f_code.co_name}:{f.f_lineno}"
+
+
+# --------------------------------------------------------------------------
+# Instrumented primitives
+# --------------------------------------------------------------------------
+class _TrackedBase:
+    _ld_key: str
+
+    def __repr__(self):
+        return f"<lockdep {type(self).__name__} {self._ld_key} " \
+               f"wrapping {self._ld_inner!r}>"
+
+
+class _TrackedLock(_TrackedBase):
+    def __init__(self, inner, key: str):
+        self._ld_inner = inner
+        self._ld_key = key
+
+    def acquire(self, blocking=True, timeout=-1):
+        t0 = time.perf_counter()
+        got = self._ld_inner.acquire(blocking, timeout)
+        if got:
+            _STATE.on_acquire(self._ld_key, id(self),
+                              time.perf_counter() - t0)
+        return got
+
+    def release(self):
+        _STATE.on_release(self._ld_key, id(self))
+        self._ld_inner.release()
+
+    def locked(self):
+        return self._ld_inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _TrackedRLock(_TrackedLock):
+    # reentrancy rides _State's nested-detection (same obj id already on
+    # the thread's stack -> no edges, symmetric push/pop)
+    def locked(self):   # RLock has no .locked() before 3.12; mirror it
+        inner = self._ld_inner
+        return inner.locked() if hasattr(inner, "locked") else False
+
+
+class _TrackedCondition(_TrackedBase):
+    """A real Condition over the REAL underlying lock, with acquisition
+    tracking keyed to the lock's class. ``threading.Condition(lock)``
+    over an instrumented lock shares that lock's identity — acquiring
+    the condition IS acquiring the lock, so the graph sees one node."""
+
+    def __init__(self, inner_cond, key: str, obj_id: Optional[int] = None):
+        self._ld_inner = inner_cond
+        self._ld_key = key
+        self._ld_obj = obj_id if obj_id is not None else id(self)
+
+    def acquire(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        got = self._ld_inner.acquire(*args, **kwargs)
+        if got:
+            _STATE.on_acquire(self._ld_key, self._ld_obj,
+                              time.perf_counter() - t0)
+        return got
+
+    def release(self):
+        _STATE.on_release(self._ld_key, self._ld_obj)
+        self._ld_inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout=None):
+        _STATE.on_wait(self._ld_key, self._ld_obj)
+        try:
+            return self._ld_inner.wait(timeout)
+        finally:
+            _STATE.on_wait_done(self._ld_key, self._ld_obj)
+
+    def wait_for(self, predicate, timeout=None):
+        _STATE.on_wait(self._ld_key, self._ld_obj)
+        try:
+            return self._ld_inner.wait_for(predicate, timeout)
+        finally:
+            _STATE.on_wait_done(self._ld_key, self._ld_obj)
+
+    def notify(self, n=1):
+        self._ld_inner.notify(n)
+
+    def notify_all(self):
+        self._ld_inner.notify_all()
+
+
+# --------------------------------------------------------------------------
+# Factories + install/uninstall
+# --------------------------------------------------------------------------
+def _lock_factory():
+    key = _creation_key()
+    if key is None:
+        return _REAL_LOCK()
+    return _TrackedLock(_REAL_LOCK(), key)
+
+
+def _rlock_factory():
+    key = _creation_key()
+    if key is None:
+        return _REAL_RLOCK()
+    return _TrackedRLock(_REAL_RLOCK(), key)
+
+
+def _condition_factory(lock=None):
+    if isinstance(lock, _TrackedLock):
+        # share the wrapped lock's identity: Condition(self._lock)
+        inner = _REAL_CONDITION(lock._ld_inner)
+        return _TrackedCondition(inner, lock._ld_key, id(lock))
+    if lock is not None:
+        return _REAL_CONDITION(lock)
+    key = _creation_key()
+    if key is None:
+        return _REAL_CONDITION()
+    return _TrackedCondition(_REAL_CONDITION(_REAL_RLOCK()), key)
+
+
+def install():
+    """Patch the ``threading`` factories. Idempotent. Locks created
+    BEFORE install stay real (uninstrumented) — install early (the
+    pytest plugin installs at configure time, before test imports)."""
+    if _STATE.enabled:
+        return
+    _STATE.enabled = True
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = _condition_factory
+
+
+def uninstall():
+    if not _STATE.enabled:
+        return
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+    _STATE.enabled = False
+
+
+def reset():
+    _STATE.reset()
+
+
+def snapshot() -> dict:
+    return _STATE.snapshot()
+
+
+def write_report(path: str):
+    with open(path, "w") as f:
+        json.dump(_STATE.snapshot(), f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+class capture:
+    """Context manager for in-process use::
+
+        with lockdep.capture() as state:
+            ...build engines, run traffic...
+        graph = state.snapshot()
+
+    Construct the objects under test INSIDE the block — locks created
+    before it are not instrumented.
+    """
+
+    def __enter__(self):
+        install()
+        reset()
+        return _STATE
+
+    def __exit__(self, *exc):
+        uninstall()
+        return False
+
+
+# --------------------------------------------------------------------------
+# Differential vs the static graph
+# --------------------------------------------------------------------------
+def load_graph(path: str = DEFAULT_GRAPH) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _edge_waived(edge: Tuple[str, str], waivers: List[dict]) -> Optional[str]:
+    """The why when ``edge`` matches a waiver (entries support ``*``
+    wildcards per endpoint — metrics leaf locks would otherwise need
+    one entry per holder class), else None."""
+    for w in waivers:
+        src, dst = w.get("edge", (None, None))
+        if (src == "*" or src == edge[0]) and (dst == "*" or dst == edge[1]):
+            return w.get("why", "(no reason given)")
+    return None
+
+
+def find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Elementary cycles in the merged graph (Tarjan SCCs; any SCC with
+    more than one node, or a self-loop, is reported as its sorted node
+    list — enough to name the deadlock suspects)."""
+    adj: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v):
+        # iterative Tarjan (the graph is small, but recursion depth
+        # should not depend on it)
+        work = [(v, iter(sorted(adj[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj[w]))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1 or node in adj.get(node, ()):
+                    out.append(sorted(scc))
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def differential(dynamic: dict, graph: dict) -> dict:
+    """Cross-check one dynamic report against the checked-in graph.
+
+    ``dynamic`` is a :func:`snapshot` / ``LOCKDEP_REPORT`` payload;
+    ``graph`` is ``lockgraph.json`` (static edges + recorded dynamic
+    edges + dynamic-only waivers). Returns::
+
+        {"dynamic_only": [...],          # observed, absent statically
+         "same_class_nesting": [...],    # two instances of K nested
+         "unwaived": [...],              # dynamic-only/nesting, NO waiver
+         "static_only": [...],           # static edges this run missed
+         "cycles": [[node, ...], ...],   # merged-graph cycles
+         "ok": bool}
+
+    Same-class nesting gates as a waivable ``[K, K]`` pseudo-edge: the
+    class-level graph cannot distinguish a consistent instance order
+    (A1 before A2, always) from a two-instance ABBA deadlock, so a
+    human must certify the instance-level order — the lockdep
+    nest-annotation analogue. It is NOT merged into the cycle check
+    (a self-loop would condemn every consistent nesting).
+
+    ``static_only`` is informational (a run that skips a test simply
+    does not exercise every edge); ``unwaived`` and ``cycles`` are the
+    failures the drift gate asserts empty.
+    """
+    dyn_edges = {(e["src"], e["dst"]) for e in dynamic.get("edges", [])}
+    static_edges = {tuple(e) for e in
+                    graph.get("static", {}).get("edges", [])}
+    recorded = {tuple(e["edge"]) for e in
+                graph.get("dynamic", {}).get("edges", [])}
+    waivers = graph.get("dynamic_only_waivers", [])
+    dynamic_only = sorted(dyn_edges - static_edges)
+    same_class = sorted(dynamic.get("same_class_nesting", {}))
+    unwaived = [e for e in dynamic_only + [(k, k) for k in same_class]
+                if _edge_waived(e, waivers) is None]
+    merged = static_edges | dyn_edges | recorded
+    cycles = find_cycles(merged)
+    return {
+        "dynamic_only": [list(e) for e in dynamic_only],
+        "same_class_nesting": same_class,
+        "unwaived": [list(e) for e in unwaived],
+        "static_only": sorted(list(e)
+                              for e in static_edges - dyn_edges),
+        "cycles": cycles,
+        "ok": not unwaived and not cycles,
+    }
+
+
+# --------------------------------------------------------------------------
+# Pytest plugin: ``pytest -p tools.analysis.lockdep``
+# --------------------------------------------------------------------------
+def pytest_configure(config):
+    install()
+
+
+def pytest_unconfigure(config):
+    path = os.environ.get("LOCKDEP_REPORT", "")
+    if path:
+        write_report(path)
+    uninstall()
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+#: Repo-root-anchored (this file lives at tools/analysis/lockdep.py) so
+#: --update run from any CWD regenerates against the real tree instead
+#: of silently writing an empty static graph.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+STATIC_SCOPE = tuple(os.path.join(_REPO_ROOT, p) for p in (
+    "deeplearning4j_tpu/serving", "deeplearning4j_tpu/models",
+    "deeplearning4j_tpu/ops", "tools",
+    "deeplearning4j_tpu/ui/server.py"))
+
+
+def regenerate_static(graph_path: str = DEFAULT_GRAPH,
+                      scope=STATIC_SCOPE) -> dict:
+    """Recompute the static half in-place (waivers + recorded dynamic
+    edges preserved); returns the updated graph dict."""
+    from tools.analysis.lock_discipline import static_lock_graph
+
+    live = [p for p in scope if os.path.exists(p)]
+    if not live:
+        raise RuntimeError(f"no static-scope paths exist under "
+                           f"{_REPO_ROOT} — refusing to write an empty "
+                           f"static graph")
+    graph = load_graph(graph_path) if os.path.exists(graph_path) else {
+        "schema_version": 1, "static": {}, "dynamic": {"edges": []},
+        "dynamic_only_waivers": []}
+    graph["static"] = static_lock_graph(live)
+    with open(graph_path, "w") as f:
+        json.dump(graph, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return graph
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m tools.analysis.lockdep",
+        description="Differential of a runtime lockdep report against "
+                    "the checked-in lock graph.")
+    p.add_argument("--report", help="LOCKDEP_REPORT JSON from a "
+                                    "-p tools.analysis.lockdep test run")
+    p.add_argument("--graph", default=DEFAULT_GRAPH,
+                   help="lockgraph.json (default: tools/analysis/)")
+    p.add_argument("--update", action="store_true",
+                   help="fold the report's observed edges into the "
+                        "graph's dynamic section and regenerate the "
+                        "static section (waivers preserved)")
+    args = p.parse_args(argv)
+    if args.update:
+        graph = regenerate_static(args.graph)
+        if args.report:
+            with open(args.report) as f:
+                dyn = json.load(f)
+            known = {tuple(e["edge"]): e
+                     for e in graph.get("dynamic", {}).get("edges", [])}
+            for e in dyn.get("edges", []):
+                key = (e["src"], e["dst"])
+                if key in known:
+                    known[key]["count"] = max(known[key].get("count", 0),
+                                              e.get("count", 0))
+                else:
+                    known[key] = {"edge": list(key),
+                                  "count": e.get("count", 0)}
+            nesting = dict(graph.get("dynamic", {}).get(
+                "same_class_nesting", {}))
+            for k, n in dyn.get("same_class_nesting", {}).items():
+                nesting[k] = max(nesting.get(k, 0), n)
+            graph["dynamic"] = {"edges": sorted(
+                known.values(), key=lambda d: d["edge"]),
+                "same_class_nesting": dict(sorted(nesting.items()))}
+            with open(args.graph, "w") as f:
+                json.dump(graph, f, indent=2, sort_keys=True)
+                f.write("\n")
+        print(f"updated {args.graph}")
+        return 0
+    if not args.report:
+        p.error("--report is required (or --update)")
+    with open(args.report) as f:
+        dyn = json.load(f)
+    diff = differential(dyn, load_graph(args.graph))
+    print(json.dumps(diff, indent=2))
+    return 0 if diff["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

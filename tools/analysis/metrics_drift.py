@@ -1,0 +1,232 @@
+"""``metrics-drift``: ``ServingMetrics`` and its consumers agree.
+
+Every signal the stack emits hangs off ONE class
+(``serving/metrics.py ServingMetrics``); every consumer — the engines'
+``self.metrics.<attr>`` recording sites, the ``snapshot()`` roll-up,
+``ui/server.py``'s ``/api/*`` endpoints — names those attributes or
+the snapshot keys by string. Nothing ties the two sides together until
+a dashboard quietly reads zeros. The checker closes the loop:
+
+1. **References resolve.** Any ``<recv>.metrics.X`` / ``<recv>._metrics.X``
+   attribute access in the analyzed files must name a real
+   ``ServingMetrics`` attribute (metric object, method, or constant) —
+   a typo'd ``metrics.request_total.inc()`` is a finding, not a
+   silently-zero counter.
+2. **Metrics are exported.** Every Counter/Gauge/Histogram/ReasonCounter
+   the constructor defines must be READ somewhere outside ``__init__``
+   (the ``snapshot()``/``counters()`` roll-ups count) — a metric nobody
+   exports is drift in the other direction: recorded cost, invisible
+   signal.
+3. **Declared names match attributes.** ``self.X = Counter("Y")`` with
+   ``X != Y`` splits the attribute vocabulary from the exported-name
+   vocabulary (``snapshot()`` spreads ``counters()`` by DECLARED name;
+   dashboards then chart a key no recording site mentions).
+4. **Endpoint keys exist.** ``_metrics_rollup("<key>")`` calls (the
+   ``/api/slo`` + ``/api/qos`` shape in ``ui/server.py``) must name a
+   key ``snapshot()`` actually emits.
+
+When no ``ServingMetrics`` class is in the analyzed file set (a run
+scoped to ``models/``), the checker is silent — nothing to drift from.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set, Tuple
+
+from tools.analysis.core import (
+    AnalysisUnit, Checker, attr_chain, call_name, string_value,
+)
+
+METRIC_CTORS = {"Counter", "Gauge", "Histogram", "ReasonCounter",
+                "SlidingWindowStats"}
+METRICS_RECEIVERS = {"metrics", "_metrics"}
+#: Recording methods on the metric primitives (Counter.inc, Gauge.set/
+#: add, Histogram.observe, ReasonCounter.inc, SlidingWindowStats.record).
+#: A reference consumed ONLY by these is a write site — it must not
+#: satisfy rule 2's "metric is exported" check, or a counter that is
+#: inc'd everywhere but never surfaced by counters()/snapshot() passes
+#: silently (recorded cost, invisible signal).
+WRITE_METHODS = {"inc", "set", "add", "observe", "record"}
+
+
+def _find_class(unit: AnalysisUnit, name: str):
+    for sf in unit.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and node.name == name:
+                return sf, node
+    return None
+
+
+class _MetricsInfo:
+    """The ServingMetrics surface, from its ClassDef."""
+
+    def __init__(self, sf, cls: ast.ClassDef):
+        self.sf = sf
+        self.cls = cls
+        # attr -> (declared name or None, Assign node) for metric objects
+        self.metric_attrs: Dict[str, Tuple[Optional[str], ast.AST]] = {}
+        self.other_attrs: Set[str] = set()   # non-metric self.* + consts
+        self.methods: Set[str] = set()
+        self.snapshot_keys: Set[str] = set()
+        for node in cls.body:
+            if isinstance(node, ast.FunctionDef):
+                self.methods.add(node.name)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.other_attrs.add(t.id)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                self.other_attrs.add(node.target.id)
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        if init is not None:
+            for node in ast.walk(init):
+                # plain AND annotated assignments (``self.slo_windows:
+                # Dict[...] = {...}`` is an AnnAssign)
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                for t in targets:
+                    chain = attr_chain(t)
+                    if chain is None or not chain.startswith("self.") \
+                            or chain.count(".") != 1:
+                        continue
+                    attr = chain.split(".", 1)[1]
+                    declared = self._metric_ctor(value)
+                    if declared is not None:
+                        self.metric_attrs[attr] = (declared, node)
+                    else:
+                        self.other_attrs.add(attr)
+        # snapshot keys: literal dict keys in snapshot() + every counter
+        # name (snapshot() spreads **self.counters() by declared name —
+        # declared == attr is enforced by check 3)
+        snap = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "snapshot"), None)
+        if snap is not None:
+            for node in ast.walk(snap):
+                if isinstance(node, ast.Dict):
+                    for k in node.keys:
+                        s = string_value(k) if k is not None else None
+                        if s is not None:
+                            self.snapshot_keys.add(s)
+        self.snapshot_keys |= set(self.metric_attrs)
+
+    @staticmethod
+    def _metric_ctor(value: ast.AST) -> Optional[str]:
+        """The declared metric NAME when ``value`` is a
+        ``Counter("name")``-style construction, "" when the ctor takes
+        a non-constant name, None when not a metric ctor."""
+        if not isinstance(value, ast.Call):
+            return None
+        chain = call_name(value) or ""
+        if chain.rsplit(".", 1)[-1] not in METRIC_CTORS:
+            return None
+        if value.args:
+            s = string_value(value.args[0])
+            return s if s is not None else ""
+        return ""
+
+
+class MetricsDriftChecker(Checker):
+    rule = "metrics-drift"
+    description = ("ServingMetrics attribute references, declared metric "
+                   "names, exports, and UI endpoint keys must agree")
+
+    def check(self, unit: AnalysisUnit):
+        found = _find_class(unit, "ServingMetrics")
+        if found is None:
+            return
+        info = _MetricsInfo(*found)
+        known = set(info.metric_attrs) | info.other_attrs | info.methods
+
+        # 3. declared name matches the attribute
+        for attr, (declared, node) in sorted(info.metric_attrs.items()):
+            if declared and declared != attr:
+                yield unit.finding(
+                    info.sf, self.rule, node,
+                    f"ServingMetrics.{attr} is declared as "
+                    f"{declared!r} — snapshot()/dashboards export the "
+                    f"declared name while recording sites use the "
+                    f"attribute; keep them identical")
+
+        # 1. references resolve  +  2. every metric is EXPORTED (read by
+        # something other than a recording call). Two-pass: references
+        # count per metric, write-consumptions count per metric —
+        # ``self.metrics.X.inc()`` contributes one reference (the ``X``
+        # attribute) AND one write (the ``inc`` attribute whose receiver
+        # chain ends in ``.X``), so refs > writes iff some site reads
+        # the metric (``.value``, ``to_dict()``, counters()' bare
+        # enumeration, snapshot roll-ups).
+        refs: Dict[str, int] = {}
+        writes: Dict[str, int] = {}
+        for sf in unit.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Attribute) \
+                        or not isinstance(node.ctx, ast.Load):
+                    continue
+                recv = attr_chain(node.value)
+                if recv is None:
+                    continue
+                parts = recv.rsplit(".", 2)
+                recv_last = parts[-1]
+                if recv_last in METRICS_RECEIVERS:
+                    if node.attr not in known:
+                        yield unit.finding(
+                            sf, self.rule, node,
+                            f"{recv}.{node.attr} references a "
+                            f"ServingMetrics attribute that does not "
+                            f"exist — the recording silently vanishes "
+                            f"(typo, or a metric that was removed "
+                            f"without its call sites)")
+                    elif node.attr in info.metric_attrs:
+                        refs[node.attr] = refs.get(node.attr, 0) + 1
+                elif recv == "self" and sf is info.sf:
+                    if node.attr in info.metric_attrs and \
+                            info.sf.func_at(node.lineno) != \
+                            "ServingMetrics.__init__":
+                        refs[node.attr] = refs.get(node.attr, 0) + 1
+                elif node.attr in WRITE_METHODS and len(parts) >= 2 \
+                        and recv_last in info.metric_attrs:
+                    # ``<...>.metrics.X.inc`` / (in metrics.py)
+                    # ``self.X.inc`` — the receiver whose last component
+                    # is a metric attr and whose previous component is a
+                    # metrics receiver (or bare self in metrics.py)
+                    prev = parts[-2]
+                    if prev in METRICS_RECEIVERS or (
+                            prev == "self" and len(parts) == 2
+                            and sf is info.sf):
+                        writes[recv_last] = writes.get(recv_last, 0) + 1
+        for attr in sorted(set(info.metric_attrs)):
+            if refs.get(attr, 0) > writes.get(attr, 0):
+                continue
+            _, node = info.metric_attrs[attr]
+            yield unit.finding(
+                info.sf, self.rule, node,
+                f"ServingMetrics.{attr} is only ever recorded "
+                f"(inc/set/observe/...), never read outside __init__ — "
+                f"it records cost nobody exports; wire it into "
+                f"counters()/snapshot() or delete it")
+
+        # 4. endpoint keys exist in the snapshot payload
+        for sf in unit.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = call_name(node) or ""
+                if chain.rsplit(".", 1)[-1] != "_metrics_rollup" \
+                        or not node.args:
+                    continue
+                s = string_value(node.args[0])
+                if s is not None and s not in info.snapshot_keys:
+                    yield unit.finding(
+                        sf, self.rule, node,
+                        f"_metrics_rollup({s!r}) asks for a key "
+                        f"ServingMetrics.snapshot() never emits — the "
+                        f"endpoint would serve nulls for every worker")

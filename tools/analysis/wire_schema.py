@@ -1,0 +1,253 @@
+"""``wire-schema-drift``: wire dataclasses must survive rolling
+upgrades.
+
+The cluster tier ships dataclasses over HTTP (``HostStatus`` today, the
+RPC envelope next — ROADMAP item 1), and PR 10's review rounds already
+caught one wire asymmetry by hand (the heartbeat ``seq`` field). The
+contract, encoded here before the fleet goes cross-host:
+
+A **wire dataclass** — any ``@dataclass`` that defines BOTH a
+serializer (``to_dict``/``to_json``) and a deserializer classmethod
+(``from_dict``/``from_json``) — must satisfy:
+
+1. **Version field.** A field whose name contains ``version``
+   (``wire_version``, ``schema_version``) so a receiver can branch on
+   format changes during a rolling upgrade instead of guessing from
+   field shapes.
+2. **Symmetric field sets.** A serializer that builds a dict literal
+   must emit every declared field and no unknown keys
+   (``dataclasses.asdict(self)`` covers all fields by construction).
+   A deserializer that constructs explicitly (``cls(a=d["a"], ...)``)
+   must read every field that has NO default — defaulted fields may be
+   absent from old senders' payloads, which is exactly how new fields
+   roll out.
+3. **Unknown-field tolerance.** The deserializer must not splat the
+   raw payload (``cls(**d)``) — a NEWER sender's extra field would
+   crash an older receiver mid-upgrade. The sanctioned idiom filters
+   to declared fields first (``{k: v for k, v in d.items() if k in
+   known}``, ``known`` from ``dataclasses.fields``).
+
+Classes with only one side of the pair (e.g. ``QosPolicy.to_dict``,
+a report-only payload) are not wire dataclasses and are skipped.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analysis.core import (
+    AnalysisUnit, Checker, attr_chain, call_name, string_value,
+)
+
+SERIALIZERS = {"to_dict", "to_json"}
+DESERIALIZERS = {"from_dict", "from_json"}
+
+
+def _is_dataclass_decorated(cls: ast.ClassDef) -> bool:
+    for dec in cls.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        chain = attr_chain(target)
+        if chain is not None and chain.rsplit(".", 1)[-1] == "dataclass":
+            return True
+    return False
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> List[Tuple[str, bool]]:
+    """[(field name, has_default)] from annotated class-body targets
+    (ClassVar / init=False subtleties are out of scope for wire types,
+    which keep to plain fields)."""
+    out = []
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                          ast.Name):
+            ann = ast.unparse(node.annotation) if hasattr(ast, "unparse") \
+                else ""
+            if "ClassVar" in ann:
+                continue
+            out.append((node.target.id, node.value is not None))
+    return out
+
+
+def _find_method(cls: ast.ClassDef, names: Set[str]) -> Optional[ast.FunctionDef]:
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name in names:
+            return node
+    return None
+
+
+def _uses_asdict(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = call_name(node) or ""
+            if chain.rsplit(".", 1)[-1] == "asdict":
+                return True
+    return False
+
+
+def _literal_dict_keys(fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """Constant keys when the serializer's payload is built as
+    TOP-LEVEL dict literals (the build-then-patch idiom
+    ``d = {...}; d["x"] = ...`` counts both); None when no literal dict
+    exists. Dicts nested as VALUES inside another dict are payload
+    content, not payload keys — counting them would both fabricate
+    unknown-key findings and mask a genuinely unserialized field whose
+    name happens to appear in a nested sub-dict (the exact asymmetry
+    this rule exists to catch)."""
+    nested: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for v in node.values:
+                for sub in ast.walk(v):
+                    if isinstance(sub, ast.Dict):
+                        nested.add(id(sub))
+    keys: Optional[Set[str]] = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict) and id(node) not in nested:
+            if keys is None:
+                keys = set()
+            for k in node.keys:
+                s = string_value(k) if k is not None else None
+                if s is not None:
+                    keys.add(s)
+    if keys is None:
+        return None
+    # second pass: d["extra"] = ... patches after the literal (walk
+    # order visits the outer Assign statements before the Dict child,
+    # so this cannot fold into the loop above); only simple
+    # ``name["key"]`` targets — ``d["a"]["b"]`` writes into a nested
+    # payload, not a top-level key
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Name):
+                    s = string_value(tgt.slice)
+                    if s is not None:
+                        keys.add(s)
+    return keys
+
+
+def _splats_raw_param(fn: ast.FunctionDef) -> Optional[ast.Call]:
+    """The ``cls(**d)`` call when the deserializer splats a raw
+    parameter into the constructor, else None. A ``**`` operand that is
+    a locally-built dict (filtered/transformed) is fine."""
+    params = {a.arg for a in fn.args.args} | {a.arg for a in
+                                              fn.args.kwonlyargs}
+    # locals assigned in the body are transformed values, not the raw
+    # payload — ``kw = {k: v ... if k in known}; return cls(**kw)``
+    assigned = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    assigned.add(tgt.id)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg is None and isinstance(kw.value, ast.Name) \
+                    and kw.value.id in params and kw.value.id not in assigned:
+                return node
+    return None
+
+
+def _read_keys(fn: ast.FunctionDef) -> Set[str]:
+    """Constant keys the deserializer reads: ``d["x"]``, ``d.get("x")``,
+    ``kw["x"] = ...`` and keyword names in an explicit ``cls(x=...)``
+    construction."""
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Subscript):
+            s = string_value(node.slice)
+            if s is not None:
+                keys.add(s)
+        elif isinstance(node, ast.Call):
+            chain = call_name(node) or ""
+            if chain.rsplit(".", 1)[-1] == "get" and node.args:
+                s = string_value(node.args[0])
+                if s is not None:
+                    keys.add(s)
+            elif chain in ("cls", ""):
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        keys.add(kw.arg)
+    return keys
+
+
+class WireSchemaDriftChecker(Checker):
+    rule = "wire-schema-drift"
+    description = ("wire dataclasses (paired to_dict/from_dict) must "
+                   "carry a version field, serialize every declared "
+                   "field, and tolerate unknown fields on receive")
+
+    def check(self, unit: AnalysisUnit):
+        for sf in unit.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                if not _is_dataclass_decorated(node):
+                    continue
+                ser = _find_method(node, SERIALIZERS)
+                deser = _find_method(node, DESERIALIZERS)
+                if ser is None or deser is None:
+                    continue
+                yield from self._check_wire_class(unit, sf, node, ser,
+                                                  deser)
+
+    def _check_wire_class(self, unit, sf, cls, ser, deser):
+        fields = _dataclass_fields(cls)
+        names = {n for n, _ in fields}
+
+        # 1. version field for rolling upgrades
+        if not any("version" in n for n in names):
+            yield unit.finding(
+                sf, self.rule, cls,
+                f"wire dataclass {cls.name} has no version field — add "
+                f"a defaulted ``wire_version: int = 1`` so receivers "
+                f"can branch on format changes during a rolling upgrade "
+                f"(see HostStatus)")
+
+        # 2. serializer symmetry
+        if not _uses_asdict(ser):
+            keys = _literal_dict_keys(ser)
+            if keys is not None:
+                for n in sorted(names - keys):
+                    yield unit.finding(
+                        sf, self.rule, ser,
+                        f"{cls.name}.{ser.name} never serializes field "
+                        f"{n!r} — the receiver's {deser.name} would "
+                        f"silently default it (the PR 10 heartbeat-seq "
+                        f"asymmetry class)")
+                for k in sorted(keys - names):
+                    yield unit.finding(
+                        sf, self.rule, ser,
+                        f"{cls.name}.{ser.name} emits key {k!r} which is "
+                        f"not a declared field — receivers filtering to "
+                        f"dataclasses.fields() drop it on the floor")
+
+        # 3. deserializer: unknown-field tolerance + required coverage
+        splat = _splats_raw_param(deser)
+        if splat is not None:
+            yield unit.finding(
+                sf, self.rule, splat,
+                f"{cls.name}.{deser.name} splats the raw payload into "
+                f"the constructor — a newer sender's extra field crashes "
+                f"this receiver mid-rolling-upgrade; filter to known "
+                f"fields first ({{k: v for k, v in d.items() if k in "
+                f"known}})")
+        else:
+            read = _read_keys(deser)
+            # a fields()-driven filter covers everything by construction
+            covers_all = any(
+                isinstance(n, ast.Call)
+                and (call_name(n) or "").rsplit(".", 1)[-1] == "fields"
+                for n in ast.walk(deser))
+            if not covers_all:
+                for n, has_default in fields:
+                    if not has_default and n not in read:
+                        yield unit.finding(
+                            sf, self.rule, deser,
+                            f"{cls.name}.{deser.name} never reads "
+                            f"required field {n!r} — construction "
+                            f"cannot succeed / the field silently "
+                            f"drops off the wire")

@@ -105,6 +105,7 @@ def _build(variant: str):
             p = _o.apply_updates(p, updates)
             return p, s, loss
 
+    # analysis: ok recompile-risk — standalone bench/profiling harness: mints its own executables by design, never on a serving dispatch path
     jstep = jax.jit(step, donate_argnums=(0, 1))
 
     rng = np.random.default_rng(0)
