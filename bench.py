@@ -860,6 +860,152 @@ def cluster_leg(on_tpu: bool) -> dict:
             if routed_ttft_p50 is not None else None,
         "gen_routed_by_host": gen_routed,
         "one_host_degraded": degraded,
+        "rpc": rpc_subleg(on_tpu, gcfg, gparams, slots, max_len),
+    }
+
+
+def rpc_subleg(on_tpu: bool, gcfg, gparams, slots: int,
+               max_len: int) -> dict:
+    """RPC data-plane sub-leg (serving/rpc.py — ISSUE 12): (a) per-
+    dispatch overhead of the HTTP HostHandle vs the loopback direct
+    call (same engine, same rows — the wire's round-trip tax); (b)
+    routed TTFT p50 for generation streams fanned over a 3-host HTTP
+    fleet (every hop crosses a real socket); (c) hedged vs unhedged
+    stream-latency p99 under a seeded 5% ``rpc.dispatch`` latency-spike
+    plan — the Tail-at-Scale claim measured: with hedging off a spiked
+    dispatch stalls its whole stream for the spike, with hedging on the
+    stall monitor opens a backup attempt and the tail collapses."""
+    import time as _time
+
+    from deeplearning4j_tpu.serving import (
+        ClusterDirectory, ClusterFrontDoor, FaultPlan, GenerationEngine,
+        HeartbeatPump, HedgePolicy, HostRpcServer, InferenceEngine,
+        LoopbackHost, LoopbackTransport, ModelAdapter, RemoteHost)
+
+    class _Mlp(ModelAdapter):
+        def __init__(self):
+            super().__init__(model=None)
+            self.w = np.linspace(-1, 1, 16, dtype=np.float32).reshape(16, 1)
+
+        def infer(self, x):
+            return np.asarray(x) @ self.w
+
+    # ---- (a) loopback vs HTTP dispatch overhead -----------------------
+    eng = InferenceEngine(_Mlp(), max_batch_size=8, max_wait_ms=0.0,
+                          name="rpc-bench-e")
+    local = LoopbackHost(0, engine=eng)
+    srv = HostRpcServer(local)
+    remote = RemoteHost(0, srv.url)
+    x = np.ones((8, 16), np.float32)
+    try:
+        def p50_dispatch(host, n=80, warm=10):
+            for _ in range(warm):
+                host.submit_infer(x).result(timeout=60)
+            lats = []
+            for _ in range(n):
+                t0 = _time.perf_counter()
+                host.submit_infer(x).result(timeout=60)
+                lats.append((_time.perf_counter() - t0) * 1e3)
+            return float(np.median(lats))
+
+        loop_p50 = p50_dispatch(local)
+        http_p50 = p50_dispatch(remote)
+    finally:
+        srv.stop()
+        local.shutdown()
+
+    # ---- (b) + (c): a 3-host HTTP generation fleet --------------------
+    n_streams, max_new = (24, 16) if on_tpu else (30, 4)
+    d = ClusterDirectory(heartbeat_timeout_s=30.0)
+    servers, locals_, remotes = [], [], []
+    for i in range(3):
+        g = GenerationEngine(gparams, gcfg, slots=slots, max_len=max_len,
+                             queue_capacity=n_streams + slots,
+                             name=f"rpc-bench-g{i}")
+        lh = LoopbackHost(i, generation=g)
+        sv = HostRpcServer(lh)
+        rm = RemoteHost(i, sv.url, poll_wait_ms=25.0)
+        d.join(rm)
+        HeartbeatPump(rm, LoopbackTransport(d)).pump_once()
+        servers.append(sv)
+        locals_.append(lh)
+        remotes.append(rm)
+    rng = np.random.default_rng(0)
+
+    def run_streams(fd, n, plan=None):
+        """Sequential streams (isolates per-stream latency from slot
+        contention); returns (ttfts_ms, latencies_ms)."""
+        from contextlib import nullcontext
+
+        ttfts, lats = [], []
+        ctx = plan if plan is not None else nullcontext()
+        with ctx:
+            for _ in range(n):
+                first = {"t": None}
+                t0 = _time.perf_counter()
+
+                def on_token(_tok, first=first, t0=t0):
+                    if first["t"] is None:
+                        first["t"] = (_time.perf_counter() - t0) * 1e3
+
+                h = fd.submit_generate(
+                    rng.integers(1, gcfg.vocab_size, 12).astype(np.int32),
+                    max_new_tokens=max_new, on_token=on_token)
+                h.result(timeout=600)
+                lats.append((_time.perf_counter() - t0) * 1e3)
+                if first["t"] is not None:
+                    ttfts.append(first["t"])
+        return ttfts, lats
+
+    spike_ms = 400.0
+
+    def spike_plan():
+        return FaultPlan(seed=7).delay("rpc.dispatch", spike_ms, rate=0.05)
+
+    try:
+        # warm every host's executables out of the measurements
+        for i in range(3):
+            ClusterFrontDoor(d, name=f"warm{i}").submit_generate(
+                rng.integers(1, gcfg.vocab_size, 8).astype(np.int32),
+                max_new_tokens=2, host=i).result(timeout=600)
+
+        fd_clean = ClusterFrontDoor(d, name="rpc-clean",
+                                    hedge=HedgePolicy(hedge_after_ms=None))
+        ttfts, _ = run_streams(fd_clean, n_streams)
+        routed = fd_clean.routed_by_host.to_dict()
+
+        fd_unhedged = ClusterFrontDoor(
+            d, name="rpc-unhedged", hedge=HedgePolicy(hedge_after_ms=None))
+        _, lats_unhedged = run_streams(fd_unhedged, n_streams,
+                                       plan=spike_plan())
+
+        fd_hedged = ClusterFrontDoor(
+            d, name="rpc-hedged",
+            hedge=HedgePolicy(hedge_after_ms=80.0, max_attempts=3,
+                              poll_wait_ms=25.0))
+        _, lats_hedged = run_streams(fd_hedged, n_streams,
+                                     plan=spike_plan())
+        hedge_mix = fd_hedged.hedges.to_dict()
+    finally:
+        for sv in servers:
+            sv.stop()
+        for lh in locals_:
+            lh.shutdown()
+
+    return {
+        "loopback_dispatch_p50_ms": round(loop_p50, 3),
+        "http_dispatch_p50_ms": round(http_p50, 3),
+        "http_overhead_p50_ms": round(http_p50 - loop_p50, 3),
+        "routed_ttft_p50_ms_http": round(float(np.median(ttfts)), 3)
+            if ttfts else None,
+        "gen_routed_by_host": routed,
+        "hedge_spike_plan": {"point": "rpc.dispatch", "rate": 0.05,
+                             "delay_ms": spike_ms, "seed": 7},
+        "stream_p99_ms_unhedged": round(
+            float(np.percentile(lats_unhedged, 99)), 3),
+        "stream_p99_ms_hedged": round(
+            float(np.percentile(lats_hedged, 99)), 3),
+        "hedges": hedge_mix,
     }
 
 

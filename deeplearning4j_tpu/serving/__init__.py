@@ -7,20 +7,28 @@ rebuilt around XLA's compile-once/dispatch-many execution model — see
 serving/engine.py and serving/generation.py for the design notes)."""
 from deeplearning4j_tpu.serving.admission import (  # noqa: F401
     AdmissionController, ClusterCapacityError, DeadlineExceededError,
-    HostUnavailableError, KVBlocksExhaustedError, QueueFullError,
-    QuotaExceededError, RejectedError, SloShedError,
+    HostDrainingError, HostUnavailableError, KVBlocksExhaustedError,
+    QueueFullError, QuotaExceededError, RejectedError, RpcError,
+    SloShedError,
 )
 from deeplearning4j_tpu.serving.cluster import (  # noqa: F401
     ClusterDirectory, ClusterFrontDoor, ClusterStatsAggregator,
-    HeartbeatPump, HostHandle, HostStatus, HttpTransport, LoopbackHost,
-    LoopbackTransport, all_directories,
+    ElasticityLoop, ElasticityPlanner, ElasticityPolicy, HeartbeatPump,
+    HedgePolicy, HostHandle, HostStatus, HttpTransport, LoopbackHost,
+    LoopbackTransport, all_directories, all_elasticity_loops, drain_host,
+    http_snapshot_source,
+)
+from deeplearning4j_tpu.serving.rpc import (  # noqa: F401
+    HostRpcServer, RemoteHost, RemoteStream, RpcRequest, RpcResponse,
+    RpcStreamChunk, rejected_from_wire,
 )
 from deeplearning4j_tpu.serving.engine import InferenceEngine, bucket_ladder  # noqa: F401
 from deeplearning4j_tpu.serving.faults import (  # noqa: F401
     FaultInjectedError, FaultPlan, inject,
 )
 from deeplearning4j_tpu.serving.generation import (  # noqa: F401
-    GenerationEngine, GenerationHandle, prefill_buckets,
+    GenerationEngine, GenerationHandle, client_stream_handle,
+    prefill_buckets,
 )
 from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, ReasonCounter, ServingMetrics,
@@ -68,4 +76,9 @@ __all__ = [
     "ClusterFrontDoor", "ClusterStatsAggregator", "HeartbeatPump",
     "HostHandle", "HostStatus", "HttpTransport", "LoopbackHost",
     "LoopbackTransport", "all_directories",
+    "HostDrainingError", "RpcError", "HedgePolicy", "ElasticityPolicy",
+    "ElasticityPlanner", "ElasticityLoop", "all_elasticity_loops",
+    "drain_host", "http_snapshot_source", "HostRpcServer", "RemoteHost",
+    "RemoteStream", "RpcRequest", "RpcResponse", "RpcStreamChunk",
+    "rejected_from_wire", "client_stream_handle",
 ]

@@ -106,6 +106,35 @@ class HostUnavailableError(RejectedError):
         self.host = host
 
 
+class HostDrainingError(RejectedError):
+    """The host is draining (reason 'host_draining'): admission is
+    closed ahead of a graceful leave — resident streams finish, queued
+    work drains, but nothing new is accepted. Typed separately from
+    'shutdown' because the cure differs: a draining host is healthy and
+    the router simply places the request elsewhere (the cluster front
+    door excludes draining hosts from candidates, so this reason only
+    reaches callers who submit to the host directly). ``host`` names
+    the draining host when known."""
+
+    def __init__(self, msg: str, host: Optional[int] = None):
+        super().__init__(msg, "host_draining")
+        self.host = host
+
+
+class RpcError(RejectedError):
+    """The RPC data plane could not interpret a peer's wire payload
+    (reason 'rpc_error'): malformed JSON, a response missing required
+    fields, or a mid-upgrade schema the receiver cannot branch on.
+    Distinct from 'host_unavailable' (the host answered — with garbage)
+    so dashboards separate wire-schema incidents from dead hosts; the
+    front door still treats it as a host bounce and re-dispatches.
+    ``host`` names the peer whose payload failed to parse."""
+
+    def __init__(self, msg: str, host: Optional[int] = None):
+        super().__init__(msg, "rpc_error")
+        self.host = host
+
+
 class KVBlocksExhaustedError(RejectedError):
     """The paged KV-cache block pool cannot serve this request (reason
     'kv_blocks_exhausted'): its worst-case block reservation exceeds what

@@ -67,8 +67,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.serving.admission import (
-    DEFAULT_TENANT, ClusterCapacityError, HostUnavailableError,
-    RejectedError,
+    DEFAULT_TENANT, ClusterCapacityError, HostDrainingError,
+    HostUnavailableError, RejectedError,
 )
 from deeplearning4j_tpu.serving.metrics import ReasonCounter, ServingMetrics
 from deeplearning4j_tpu.serving.paging import blocks_for_tokens
@@ -112,6 +112,12 @@ class HostStatus:
     slo_burn_active: bool = False
     slo_error_rate: float = 0.0
     slo_p99_ms: float = 0.0
+    # graceful-leave protocol (serving/rpc.py + MIGRATING.md): a
+    # draining host finishes its resident streams but admits nothing
+    # new — the router excludes it from candidates (no probe, no shed)
+    # until it leaves the directory. Defaulted, so pre-drain senders'
+    # heartbeats keep parsing mid-rolling-upgrade.
+    draining: bool = False
     seq: int = 0                     # host-side monotone heartbeat counter
     # wire-format version for rolling upgrades: receivers branch on this
     # instead of guessing from field shapes, and from_dict's known-field
@@ -181,6 +187,7 @@ class LoopbackHost(HostHandle):
         self._engine = engine
         self._generation = generation
         self._tracer = tracer
+        self._draining = False
         self._seq = 0
 
     # ------------------------------------------------------------ wiring
@@ -218,7 +225,8 @@ class LoopbackHost(HostHandle):
         with self._lock:
             self._seq += 1
             seq = self._seq
-        st = HostStatus(host_id=self.host_id, seq=seq)
+        st = HostStatus(host_id=self.host_id, seq=seq,
+                        draining=self._draining)
         breaker = None
         metrics = None
         if eng is not None:
@@ -255,8 +263,15 @@ class LoopbackHost(HostHandle):
         return st
 
     # ----------------------------------------------------------- submits
+    def _drain_gate(self):
+        if self._draining:
+            raise HostDrainingError(
+                f"host {self.host_id} is draining — admission closed "
+                "ahead of a graceful leave", host=self.host_id)
+
     def submit_infer(self, x, *, timeout_ms=None, tenant=None,
                      priority=None):
+        self._drain_gate()
         eng = self.engine
         if eng is None:
             raise HostUnavailableError(
@@ -266,6 +281,7 @@ class LoopbackHost(HostHandle):
                           priority=priority)
 
     def submit_generate(self, prompt, **kwargs):
+        self._drain_gate()
         gen = self.generation
         if gen is None:
             raise HostUnavailableError(
@@ -274,6 +290,7 @@ class LoopbackHost(HostHandle):
         return gen.submit(prompt, **kwargs)
 
     def register_prefix(self, tokens, prefix_id=None, timeout=None) -> str:
+        self._drain_gate()
         gen = self.generation
         if gen is None:
             raise HostUnavailableError(
@@ -281,6 +298,35 @@ class LoopbackHost(HostHandle):
                 host=self.host_id)
         kw = {} if timeout is None else {"timeout": timeout}
         return gen.register_prefix(tokens, prefix_id=prefix_id, **kw)
+
+    # --------------------------------------------------------------- drain
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful host drain — the host half of the leave protocol:
+        flip :attr:`HostStatus.draining` (the next heartbeat tells the
+        fleet; this host's own submits shed typed ``host_draining``
+        immediately), then drain each engine — admission closed, queued
+        and RESIDENT streams finish, shared-prefix pins released.
+        Returns True when fully drained within ``timeout``. Leaving the
+        directory is the COORDINATOR's half (``drain_host`` pairs the
+        two: mark → drain → leave), because the directory lives there."""
+        self._draining = True
+        eng, gen = self.engine, self.generation
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def remaining():
+            return None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+
+        ok = True
+        if eng is not None:
+            ok = eng.drain(timeout=remaining()) and ok
+        if gen is not None:
+            ok = gen.drain(timeout=remaining()) and ok
+        return ok
 
     # ----------------------------------------------- one-store observability
     def publish_stats(self, storage, session_id: str = "cluster",
@@ -371,19 +417,51 @@ class HttpTransport(ClusterTransport):
                               f"h{status.host_id}", status.to_dict())
 
 
+def _validate_jitter(interval_s: float, jitter: float):
+    """Shared guard for the jittered daemon loops (HeartbeatPump,
+    ElasticityLoop)."""
+    if interval_s <= 0:
+        raise ValueError("interval_s must be positive")
+    if not (0.0 <= jitter < 1.0):
+        raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+
+
+def _jittered_interval_s(interval_s: float, jitter: float, rng) -> float:
+    """``interval_s`` scaled by a seeded draw in
+    ``[1 - jitter, 1 + jitter)``. Pure-PRNG (no clock), so schedule
+    tests assert the whole sequence without sleeping — and a restarted
+    fleet's loops decorrelate instead of thundering-herding the
+    coordinator forever (fixed intervals never decorrelate)."""
+    if jitter == 0.0:
+        return interval_s
+    u = float(rng.random())
+    return interval_s * (1.0 + jitter * (2.0 * u - 1.0))
+
+
 class HeartbeatPump:
     """Per-host heartbeat driver: periodically publishes
     ``host.status()`` through the transport. ``pump_once()`` is the
     whole beat — tests call it directly (no sleeps in tier-1);
-    :meth:`start` runs it on a daemon thread for real deployments."""
+    :meth:`start` runs it on a daemon thread for real deployments.
+
+    ``jitter`` spreads the beat interval by a seeded ±fraction (default
+    ±10%): a fleet restarted by one rollout would otherwise beat in
+    lockstep and thundering-herd the coordinator every interval forever
+    (fixed intervals never decorrelate — the classic synchronized-
+    clients failure). The jitter PRNG is seeded per host (``seed``
+    defaults to the host id), so the schedule is deterministic for
+    tests yet distinct across hosts."""
 
     def __init__(self, host: HostHandle, transport: ClusterTransport,
-                 interval_s: float = 0.5):
-        if interval_s <= 0:
-            raise ValueError("interval_s must be positive")
+                 interval_s: float = 0.5, jitter: float = 0.1,
+                 seed: Optional[int] = None):
+        _validate_jitter(interval_s, jitter)
         self.host = host
         self.transport = transport
         self.interval_s = interval_s
+        self.jitter = float(jitter)
+        self._rng = np.random.default_rng(
+            seed if seed is not None else int(host.host_id))
         self.beats = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -391,6 +469,11 @@ class HeartbeatPump:
     def pump_once(self):
         self.transport.publish(self.host.status())
         self.beats += 1
+
+    def next_interval_s(self) -> float:
+        """The next beat's wait — see :func:`_jittered_interval_s`."""
+        return _jittered_interval_s(self.interval_s, self.jitter,
+                                    self._rng)
 
     def start(self) -> "HeartbeatPump":
         if self._thread is None:
@@ -401,7 +484,7 @@ class HeartbeatPump:
         return self
 
     def _loop(self):
-        while not self._stop.wait(self.interval_s):
+        while not self._stop.wait(self.next_interval_s()):
             try:
                 self.pump_once()
             except Exception:
@@ -467,6 +550,12 @@ class ClusterDirectory:
         self._status: Dict[int, HostStatus] = {}
         self._seen_at: Dict[int, float] = {}
         self._probe_at: Dict[int, float] = {}
+        # coordinator-side drain marks: set the INSTANT a drain is
+        # initiated (before the host's next heartbeat can carry its own
+        # draining flag), so the routing window between drain start and
+        # the next beat sheds nothing — the drain protocol's zero-shed
+        # guarantee
+        self._draining_ids: set = set()
         self._ingest_cursor: Dict[str, int] = {}
         self._front_doors: "weakref.WeakSet" = weakref.WeakSet()
         self._recorder = recorder if recorder is not None \
@@ -490,6 +579,7 @@ class ClusterDirectory:
             self._seen_at[hid] = self._clock()
             self._status.pop(hid, None)
             self._probe_at.pop(hid, None)
+            self._draining_ids.discard(hid)   # a re-join un-drains
         self._recorder.record("cluster.join", host=hid,
                               replaced=replacing)
         return hid
@@ -500,7 +590,18 @@ class ClusterDirectory:
             self._status.pop(host_id, None)
             self._seen_at.pop(host_id, None)
             self._probe_at.pop(host_id, None)
+            self._draining_ids.discard(host_id)
+            fds = list(self._front_doors)
         if gone is not None:
+            # prefix affinity must not outlive the host: a drained
+            # host's pins are released, so a stale _prefix_hosts entry
+            # would pin every future submit naming that prefix at a
+            # host that no longer exists — a permanent typed shed after
+            # a zero-shed scale-down. Dropped entries surface as the
+            # explicit KeyError ("not registered — call
+            # register_prefix()"), telling the caller to re-register.
+            for fd in fds:
+                fd._forget_host_prefixes(host_id)
             self._recorder.record("cluster.leave", host=host_id)
         return gone is not None
 
@@ -605,6 +706,28 @@ class ClusterDirectory:
             alive = sum(1 for h in self._handles if self._alive_locked(h))
         return alive < self.quorum()
 
+    def mark_draining(self, host_id: int) -> bool:
+        """Coordinator-side drain mark: routing excludes this host from
+        the instant the drain is INITIATED (a heartbeat-only flag would
+        leave a shed window until the host's next beat). Cleared by
+        :meth:`leave` / a re-:meth:`join`. Returns False for unknown
+        ids."""
+        with self._hb_lock:
+            if host_id not in self._handles:
+                return False
+            self._draining_ids.add(host_id)
+        self._recorder.record("cluster.drain", host=host_id)
+        return True
+
+    def is_draining(self, host_id: int) -> bool:
+        """True when the coordinator marked the host draining OR its own
+        heartbeat says so (either side may learn first)."""
+        with self._hb_lock:
+            if host_id in self._draining_ids:
+                return True
+            st = self._status.get(host_id)
+        return st is not None and st.draining
+
     def allow_probe(self, host_id: int) -> bool:
         """One probe per ``probe_interval_s`` per non-alive host — the
         fleet-scope HALF_OPEN. Returns True exactly once per window (the
@@ -640,6 +763,8 @@ class ClusterDirectory:
                 seen = self._seen_at.get(hid)
                 hosts[hid] = {
                     "alive": self._alive_locked(hid),
+                    "draining": hid in self._draining_ids
+                                or (st is not None and st.draining),
                     "heartbeat_age_s": (round(now - seen, 3)
                                         if seen is not None else None),
                     "status": st.to_dict() if st is not None else None,
@@ -662,6 +787,8 @@ class ClusterDirectory:
             "hosts": len([h for h in hosts.values()
                           if not h.get("unbound")]),
             "alive": len(alive),
+            "draining": len([h for h in hosts.values()
+                             if h.get("draining")]),
             "quorum": self.quorum(),
             "state": "degraded" if self.degraded() else "ok",
             "slots": sum(s["slots"] for s in statuses),
@@ -679,6 +806,10 @@ class ClusterDirectory:
                 "routed_by_host": fd.routed_by_host.to_dict(),
                 "rejections_by_reason":
                     fd.metrics.rejections_by_reason.to_dict(),
+                # 'timeout' (stall-triggered backup) vs 'redispatch'
+                # (attempt lost to a retriable host failure) — the
+                # elasticity planner reads the shed mix next to these
+                "hedges": fd.hedges.to_dict(),
             } for fd in fds],
         }
 
@@ -692,6 +823,477 @@ _DIRECTORIES_LOCK = threading.Lock()
 def all_directories() -> List[ClusterDirectory]:
     with _DIRECTORIES_LOCK:
         return list(_DIRECTORIES)
+
+
+# --------------------------------------------------------------------------
+# Hedged re-dispatch: terminal-exactly-once streams over the RPC plane
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class HedgePolicy:
+    """Tail-tolerance policy for generation streams over the RPC data
+    plane (Dean & Barroso, "The Tail at Scale"): when a stream makes no
+    progress for ``hedge_after_ms``, the front door opens a BACKUP
+    attempt on another candidate host — both race, the first terminal
+    wins, the loser is cancelled server-side (its slot and KV blocks
+    come back instead of decoding for nobody). ``hedge_after_ms=None``
+    disables timeout hedging but keeps re-dispatch on host loss.
+    ``max_attempts`` bounds TOTAL attempts per logical stream (first
+    dispatch + hedges + re-dispatches), so a request that kills every
+    host it lands on cannot walk the whole fleet. ``poll_wait_ms`` is
+    the long-poll window per chunk fetch (also the cancellation-notice
+    latency bound for loser attempts)."""
+
+    hedge_after_ms: Optional[float] = 250.0
+    max_attempts: int = 3
+    poll_wait_ms: float = 50.0
+
+    def __post_init__(self):
+        if self.hedge_after_ms is not None and self.hedge_after_ms <= 0:
+            raise ValueError("hedge_after_ms must be positive (or None)")
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if self.poll_wait_ms <= 0:
+            raise ValueError("poll_wait_ms must be positive")
+
+
+class _Attempt:
+    """One live attempt of a hedged stream on one host. ``tokens``
+    accumulates the FULL prefix this attempt has received (streams are
+    bitwise-deterministic per seed, so every attempt's prefix agrees) —
+    the supervisor's leader pushes ``tokens[delivered:]`` to the client
+    handle, which is what makes leadership transfer gap-free and
+    duplicate-free by construction."""
+
+    __slots__ = ("stream", "host_id", "idx", "tokens", "cursor")
+
+    def __init__(self, stream, host_id: int, idx: int):
+        self.stream = stream
+        self.host_id = host_id
+        self.idx = idx
+        self.tokens: List[int] = []
+        self.cursor = 0
+
+
+class _HedgedStream:
+    """Supervisor for ONE logical generation stream dispatched over the
+    RPC data plane, with hedged re-dispatch and terminal-exactly-once
+    semantics. The caller holds a single client
+    :class:`~deeplearning4j_tpu.serving.generation.GenerationHandle`;
+    underneath it, attempts come and go:
+
+    - each attempt runs in its own thread (route → ``open_stream`` →
+      chunk long-poll loop), so a latency spike in one attempt's
+      dispatch or stream never blocks another attempt's progress;
+    - an attempt lost to the HEDGE_RETRIABLE class (host died, wire
+      garbage, remote engine shutdown/watchdog) is folded out
+      (``cluster.bounce`` in the trace) and replaced — re-dispatch
+      excludes every host already tried, recomputes the REMAINING
+      deadline budget, and replays the same seeded request, so the
+      stream's tokens are bitwise those the first host would have
+      produced;
+    - a monitor thread opens a backup attempt when no token progress is
+      made for ``hedge_after_ms`` (the classic tail hedge) — first
+      terminal wins, losers are cancelled server-side;
+    - token delivery is deduplicated by a single ``delivered``
+      watermark: the LEADER attempt pushes ``tokens[delivered:]``, and
+      leadership transfers only at loss/terminal, so no token is
+      delivered twice and none is skipped;
+    - exactly ONE terminal reaches the handle (first ``finished`` flip
+      wins under the lock), and the front door records exactly one SLO
+      outcome for the whole hedged ensemble."""
+
+    HEDGE_RETRIABLE = ("host_unavailable", "rpc_error", "shutdown",
+                       "watchdog")
+
+    def __init__(self, fd: "ClusterFrontDoor", toks: np.ndarray, *,
+                 gen_kwargs: dict, pinned: Optional[int],
+                 blocks_hint_max_new: int, timeout_ms: Optional[float],
+                 trace, tenant_label: str, t0: float):
+        from deeplearning4j_tpu.serving.generation import (
+            client_stream_handle)
+
+        self.fd = fd
+        self.toks = toks
+        self.gen_kwargs = gen_kwargs       # forwarded to open_stream
+        self.pinned = pinned
+        self.max_new = blocks_hint_max_new
+        self.trace = trace
+        self.tenant = tenant_label
+        self.t0 = t0
+        self.deadline_t = None if timeout_ms is None \
+            else t0 + timeout_ms / 1e3
+        on_token = gen_kwargs.pop("on_token", None)
+        self.handle = client_stream_handle(int(toks.size),
+                                           on_token=on_token,
+                                           tenant=tenant_label)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.delivered = 0
+        self.finished = False
+        self.attempts: List[_Attempt] = []
+        #: hosts with a dispatch POST currently in flight — an attempt
+        #: is invisible to `attempts` until open_stream returns, so
+        #: routing and the no-route shed must read this too: a backup
+        #: must not re-pick the very host whose dispatch is stalling,
+        #: and a failed backup route must not shed a terminal while the
+        #: original dispatch may still succeed
+        self.inflight: List[int] = []
+        self._leader: Optional[_Attempt] = None
+        self.tried: List[int] = []
+        self.bounced_full = 0
+        self.attempt_seq = 0
+        self.last_error: Optional[BaseException] = None
+        self.last_progress = time.perf_counter()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, first_route):
+        """Launch the first attempt (on the route the front door already
+        picked) plus the hedge monitor; returns the client handle."""
+        idx = self._claim_attempt()
+        t = threading.Thread(
+            target=self._run_attempt, args=(idx, first_route),
+            daemon=True, name=f"fd-stream[{self.fd.name}]#a{idx}")
+        t.start()
+        threading.Thread(
+            target=self._monitor, daemon=True,
+            name=f"fd-stream-monitor[{self.fd.name}]").start()
+        return self.handle
+
+    def _claim_attempt(self) -> Optional[int]:
+        with self._lock:
+            if self.attempt_seq >= self.fd.hedge.max_attempts:
+                return None
+            self.attempt_seq += 1
+            return self.attempt_seq
+
+    def _is_finished(self) -> bool:
+        with self._lock:
+            return self.finished
+
+    def _remaining_ms(self) -> Optional[float]:
+        return None if self.deadline_t is None \
+            else (self.deadline_t - time.perf_counter()) * 1e3
+
+    # -------------------------------------------------------------- attempts
+    def _run_attempt(self, idx: Optional[int], route=None):
+        """One attempt thread: route (unless handed one) → open →
+        poll-and-deliver. On a retriable loss, the SAME thread
+        re-dispatches when it was the last live attempt (claiming a
+        fresh attempt slot); otherwise it exits and the survivors carry
+        the stream."""
+        while idx is not None and not self._is_finished():
+            if route is None:
+                with self._lock:
+                    exclude = tuple(self.tried) + tuple(
+                        a.host_id for a in self.attempts) \
+                        + tuple(self.inflight)
+                    bounced = self.bounced_full
+                try:
+                    route = self.fd._route(
+                        "generate", rows=1,
+                        blocks_needed=self.fd._blocks_needed(
+                            int(self.toks.size), self.max_new,
+                            self.pinned),
+                        pinned=self.pinned, exclude=exclude,
+                        bounced_full=bounced)
+                except RejectedError as e:
+                    if self.last_error is not None \
+                            and e.__cause__ is None:
+                        e.__cause__ = self.last_error
+                    self._no_route(e)
+                    return
+            h, hid, how = route
+            route = None
+            if not hasattr(h, "open_stream"):
+                # mixed fleet: a re-dispatch can route to a LOOPBACK
+                # host, which has no attempt-scoped stream surface (the
+                # supervisor already owns the caller's handle and
+                # cannot adopt an engine-owned one) — fold it out like
+                # a bounced candidate and try the next; an
+                # AttributeError here would kill the attempt thread and
+                # hang the caller forever
+                with self._lock:
+                    self.tried.append(hid)
+                self.trace.event("cluster.bounce", host=hid,
+                                 reason="host_unavailable", attempt=idx,
+                                 detail="no rpc stream surface")
+                continue
+            self.trace.event("cluster.route", host=hid, decision=how,
+                             kind="generate", attempt=idx)
+            with self._lock:
+                self.inflight.append(hid)
+            try:
+                stream = h.open_stream(
+                    self.toks, timeout_ms=self._remaining_ms(),
+                    hedge_attempt=idx, **self.gen_kwargs)
+            except RejectedError as e:
+                with self._lock:
+                    self.inflight.remove(hid)
+                    self.tried.append(hid)
+                    if e.reason in ClusterFrontDoor.CAPACITY_BOUNCE_REASONS:
+                        self.bounced_full += 1
+                    self.last_error = e
+                self.trace.event("cluster.bounce", host=hid,
+                                 reason=e.reason, attempt=idx)
+                continue     # next candidate, same attempt slot
+            a = _Attempt(stream, hid, idx)
+            late = False
+            with self._lock:
+                self.inflight.remove(hid)
+                if self.finished:
+                    late = True
+                else:
+                    self.attempts.append(a)
+                    self.last_progress = time.perf_counter()
+                    self._cv.notify_all()
+            if late:
+                stream.cancel()   # raced the terminal: free the slot
+                return
+            self.fd.routed_by_host.inc(f"h{hid}")
+            self.fd._out_add("generate", hid, 1)
+            self.trace.event("rpc.dispatch", host=hid,
+                             stream_id=stream.stream_id, attempt=idx)
+            loss = self._poll_attempt(a)
+            self.fd._out_add("generate", hid, -1)
+            if loss is None:
+                return           # terminal delivered (by someone)
+            with self._lock:
+                if a in self.attempts:
+                    self.attempts.remove(a)
+                if self._leader is a:
+                    self._leader = None
+                self.tried.append(hid)
+                self.last_error = loss
+                others = bool(self.attempts)
+                done = self.finished
+            self.trace.event("cluster.bounce", host=hid,
+                             reason=getattr(loss, "reason", "model_error"),
+                             attempt=idx)
+            a.stream.cancel()
+            if done or others:
+                return           # survivors own the stream (a loss
+                #                  racing the winner's terminal is NOT
+                #                  a re-dispatch — don't count one)
+            idx = self._claim_attempt()
+            if idx is not None:
+                self.fd.hedges.inc("redispatch")
+            if idx is None:
+                exc = HostUnavailableError(
+                    f"stream lost after "
+                    f"{self.fd.hedge.max_attempts} attempt(s); hedge "
+                    f"budget exhausted", host=self.pinned)
+                exc.__cause__ = loss
+                self._shed_once(exc)
+                return
+        # claim failed before the first dispatch of this thread: the
+        # monitor raced the budget away — survivors own the stream
+
+    def _no_route(self, exc: RejectedError):
+        """Routing found no candidate for a (re)dispatch: terminal shed
+        only when no live attempt remains AND no dispatch is still in
+        flight — otherwise the survivors (or the pending dispatch) may
+        still finish and this was just a failed hedge."""
+        with self._lock:
+            live = bool(self.attempts) or bool(self.inflight)
+        if not live:
+            self._shed_once(exc)
+
+    def _poll_attempt(self, a: _Attempt) -> Optional[BaseException]:
+        """Drive one attempt's chunk loop. Returns the loss exception
+        when the attempt should be folded out and possibly replaced;
+        None when a terminal was delivered (any attempt's) or the
+        supervisor finished."""
+        while True:
+            if self._is_finished():
+                return None
+            try:
+                chunk = a.stream.poll(a.cursor, self.fd.hedge.poll_wait_ms)
+            except RejectedError as e:
+                if getattr(e, "reason", None) in self.HEDGE_RETRIABLE:
+                    return e
+                self._finish_failed(e)
+                return None
+            if not self._deliver(a, chunk, promote=chunk.done
+                                 and not chunk.error_reason):
+                return None      # broken local consumer: terminal done
+            if chunk.done:
+                if chunk.error_reason in self.HEDGE_RETRIABLE:
+                    from deeplearning4j_tpu.serving.rpc import (
+                        rejected_from_wire)
+                    return rejected_from_wire(
+                        chunk.error_reason, chunk.error_message,
+                        host=a.host_id)
+                if chunk.error_reason is not None:
+                    from deeplearning4j_tpu.serving.rpc import (
+                        rejected_from_wire)
+                    self._finish_failed(rejected_from_wire(
+                        chunk.error_reason, chunk.error_message,
+                        host=a.host_id))
+                else:
+                    self._finish_ok(a, chunk.finish_reason or "max_tokens")
+                return None
+
+    # ------------------------------------------------- delivery + terminals
+    def _deliver(self, a: _Attempt, chunk, promote: bool = False) -> bool:
+        """Fold one chunk into the attempt's accumulated prefix and, for
+        the LEADER, push the undelivered tail to the client handle.
+        ``promote`` forces leadership (a successful terminal's attempt
+        must flush its full prefix before finishing). Returns False
+        when the client's own on_token consumer broke the stream.
+
+        The pushes happen UNDER the supervisor lock, atomically with
+        the watermark advance: claiming ``delivered`` first and pushing
+        after would open a window where another attempt's terminal
+        (``_take_terminal`` needs this lock) finishes the handle while
+        the claimed tokens are still un-pushed — ``result()`` would
+        snapshot a truncated stream. A slow ``on_token`` consumer
+        therefore stalls only its own stream's supervisor, exactly like
+        the local engine path, where the callback runs on the scheduler
+        thread."""
+        toks = [int(t) for t in chunk.tokens]
+        broken: Optional[BaseException] = None
+        with self._lock:
+            if self.finished or a not in self.attempts:
+                return True
+            a.tokens.extend(toks)
+            a.cursor = len(a.tokens)
+            if promote or self._leader is None \
+                    or self._leader not in self.attempts \
+                    or len(a.tokens) > self.delivered:
+                # the last arm is the stalled-leader handoff: attempts
+                # share a bitwise-identical prefix, so whichever one is
+                # PAST the delivered watermark may lead — a backup that
+                # out-runs a stalled-but-alive leader starts streaming
+                # to the client immediately instead of withholding its
+                # tokens until its terminal flush (the TTFT tail the
+                # hedge exists to collapse); ping-ponging is harmless,
+                # the watermark dedups
+                self._leader = a
+            if self._leader is a:
+                while self.delivered < len(a.tokens):
+                    err = self.handle._push(a.tokens[self.delivered])
+                    if err is not None:
+                        broken = err
+                        break
+                    self.delivered += 1
+            if toks:
+                self.last_progress = time.perf_counter()
+                self._cv.notify_all()
+        if broken is not None:
+            # the handle already delivered its own terminal (_fail
+            # inside _push): record the one outcome + stop the fleet
+            self.trace.event("on_token.failed",
+                             error=type(broken).__name__)
+            self._finish_client_error()
+            return False
+        return True
+
+    def _take_terminal(self) -> Optional[List[_Attempt]]:
+        """First caller wins the terminal: returns the loser attempts to
+        cancel (None for everyone after the first)."""
+        with self._lock:
+            if self.finished:
+                return None
+            self.finished = True
+            losers = list(self.attempts)
+            self.attempts = []
+            self._cv.notify_all()
+        return losers
+
+    def _cancel_losers(self, losers: List[_Attempt]):
+        for a in losers:
+            a.stream.cancel()
+
+    def _finish_ok(self, winner: _Attempt, finish_reason: str):
+        losers = self._take_terminal()
+        if losers is None:
+            return
+        delivered = self.handle._finish(finish_reason)
+        lat = (time.perf_counter() - self.t0) * 1e3
+        if delivered:
+            self.fd._finish_request(self.trace, "ok", lat, self.tenant)
+        else:   # the caller cancelled first: that terminal stands
+            self.fd._finish_request(self.trace, "cancelled", lat, self.tenant)
+        self._cancel_losers([a for a in losers if a is not winner])
+
+    def _finish_failed(self, exc: BaseException):
+        losers = self._take_terminal()
+        if losers is None:
+            return
+        reason = terminal_reason(exc)
+        delivered = self.handle._fail(exc)
+        lat = (time.perf_counter() - self.t0) * 1e3
+        self.fd._finish_request(self.trace, reason if delivered else "cancelled",
+                        lat, self.tenant)
+        self._cancel_losers(losers)
+
+    def _finish_client_error(self):
+        losers = self._take_terminal()
+        if losers is None:
+            return
+        lat = (time.perf_counter() - self.t0) * 1e3
+        self.fd._finish_request(self.trace, "client_error", lat, self.tenant)
+        self._cancel_losers(losers)
+
+    def _shed_once(self, exc: RejectedError):
+        """Typed fleet shed, exactly once — the hedged analogue of the
+        front door's synchronous ``_shed`` (same counters, same trace
+        shape), delivered through the client handle because dispatch
+        already went asynchronous."""
+        losers = self._take_terminal()
+        if losers is None:
+            return
+        self.fd.metrics.rejected_total.inc()
+        self.fd.metrics.record_rejection(exc.reason)
+        self.fd._recorder.record("cluster.shed", reason=exc.reason,
+                                 front_door=self.fd.name)
+        self.trace.event("cluster.shed", reason=exc.reason)
+        delivered = self.handle._fail(exc)
+        self.fd._finish_request(self.trace,
+                        exc.reason if delivered else "cancelled",
+                        None, self.tenant)
+        self._cancel_losers(losers)
+
+    # --------------------------------------------------------------- hedging
+    def _monitor(self):
+        """Open a backup attempt when the stream stalls (no token
+        progress for ``hedge_after_ms``). Decisions are made under the
+        cv; the spawn itself (routing + thread start) runs outside it."""
+        hed = self.fd.hedge
+        if hed.hedge_after_ms is None or self.pinned is not None:
+            return    # timeout hedging off (or nowhere else to go)
+        wait_s = hed.hedge_after_ms / 1e3
+        while True:
+            spawn_idx = None
+            with self._cv:
+                if self.finished:
+                    return
+                elapsed = time.perf_counter() - self.last_progress
+                if elapsed < wait_s:
+                    self._cv.wait(wait_s - elapsed)
+                    continue
+                if len(self.attempts) <= 1 \
+                        and self.attempt_seq < hed.max_attempts:
+                    # <= 1: a stalled DISPATCH (attempt thread stuck in
+                    # routing/open_stream, so nothing is live yet) is
+                    # hedged exactly like a stalled stream — the spiked
+                    # POST and the backup race, first terminal wins
+                    self.attempt_seq += 1
+                    spawn_idx = self.attempt_seq
+                    self.last_progress = time.perf_counter()
+                else:
+                    # nothing to hedge right now (two attempts already
+                    # racing, or the attempt budget is spent): check
+                    # again next window
+                    self._cv.wait(wait_s)
+                    continue
+            self.fd.hedges.inc("timeout")
+            self.trace.event("cluster.hedge", attempt=spawn_idx,
+                             stalled_ms=round(elapsed * 1e3, 1))
+            threading.Thread(
+                target=self._run_attempt, args=(spawn_idx, None),
+                daemon=True,
+                name=f"fd-stream[{self.fd.name}]#a{spawn_idx}").start()
 
 
 # --------------------------------------------------------------------------
@@ -736,14 +1338,20 @@ class ClusterFrontDoor:
 
     def __init__(self, directory: ClusterDirectory, *,
                  metrics: Optional[ServingMetrics] = None,
-                 tracer=None, recorder=None, name: str = "cluster"):
+                 tracer=None, recorder=None, name: str = "cluster",
+                 hedge: Optional[HedgePolicy] = None):
         self.directory = directory
         self.name = name
         self.metrics = metrics or ServingMetrics()
         self._tracer = tracer if tracer is not None else default_tracer()
         self._recorder = recorder if recorder is not None \
             else flight_recorder()
+        # tail-tolerance policy for streams over the RPC data plane
+        # (hosts with an open_stream surface — RemoteHost); loopback
+        # streams keep the PR 10 sticky direct path untouched
+        self.hedge = hedge if hedge is not None else HedgePolicy()
         self.routed_by_host = ReasonCounter("routed_by_host")
+        self.hedges = ReasonCounter("hedges")   # 'timeout' | 'redispatch'
         self._affinity_lock = threading.Lock()
         self._prefix_hosts: Dict[str, int] = {}
         # this front door's own in-flight work per (kind, host), in the
@@ -826,6 +1434,12 @@ class ClusterFrontDoor:
             h = d.handle(hid)
             if h is None or not h.serves(kind):
                 continue
+            if d.is_draining(hid):
+                # graceful drain: resident streams finish, nothing new
+                # routes here — NOT a probe candidate (the host is
+                # healthy, it is leaving) and NOT a "full" host (its
+                # absence must not convert sheds to cluster_capacity)
+                continue
             st = d.status(hid)
             if st is None or not d.alive(hid):
                 probe_set.append((hid, h))       # never/stale heartbeat
@@ -874,9 +1488,9 @@ class ClusterFrontDoor:
         self._recorder.record("cluster.shed", reason=exc.reason,
                               front_door=self.name)
         trace.event("cluster.shed", reason=exc.reason)
-        self._finish(trace, exc.reason, None, tenant)
+        self._finish_request(trace, exc.reason, None, tenant)
 
-    def _finish(self, trace, reason: str, latency_ms: Optional[float],
+    def _finish_request(self, trace, reason: str, latency_ms: Optional[float],
                 tenant: str):
         self.metrics.record_outcome(reason, latency_ms)
         self.metrics.record_tenant_outcome(tenant, reason)
@@ -888,7 +1502,7 @@ class ClusterFrontDoor:
             self._out_add(kind, host_id, -cost)
             exc = f.exception()
             reason = "ok" if exc is None else terminal_reason(exc)
-            self._finish(trace, reason,
+            self._finish_request(trace, reason,
                          (time.perf_counter() - t0) * 1e3, tenant)
         fut.add_done_callback(done)
 
@@ -962,10 +1576,20 @@ class ClusterFrontDoor:
                         tenant: Optional[str] = None,
                         priority: Optional[str] = None,
                         host: Optional[int] = None, **kwargs):
-        """Route one generation stream; returns the host engine's
-        :class:`GenerationHandle`. The stream is STICKY to the routed
-        host (its KV blocks live there); ``prefix_id`` pins routing to
-        the host holding the registered prefix."""
+        """Route one generation stream; returns a
+        :class:`GenerationHandle`. On a LOOPBACK host this is the host
+        engine's own handle and the stream is sticky (PR 10 semantics,
+        bitwise-inert). On an RPC host (``open_stream`` surface) the
+        returned handle is front-door-owned and the stream is HEDGED:
+        dispatch goes asynchronous (admission sheds surface through the
+        handle, exactly once), host loss mid-stream re-dispatches to
+        the next candidate with the remaining deadline budget, a stall
+        past ``hedge.hedge_after_ms`` races a backup attempt, the first
+        terminal wins, and no token is delivered twice (delivery is
+        watermarked; streams are seed-deterministic so every attempt's
+        prefix agrees). ``prefix_id`` pins routing to the host holding
+        the registered prefix — pinned streams never hedge across
+        hosts (their KV blocks cannot migrate)."""
         toks = np.asarray(prompt).ravel()
         label = self._label(tenant, priority)
         if prefix_id is not None:
@@ -1001,6 +1625,30 @@ class ClusterFrontDoor:
                     e.__cause__ = last_reject
                 self._shed(trace, e, label)
                 raise
+            if hasattr(h, "open_stream"):
+                # RPC host: hand the stream to the hedging supervisor.
+                # Dispatch goes asynchronous from here — admission sheds,
+                # re-dispatches and the terminal all surface through the
+                # returned handle, and the supervisor emits this route's
+                # cluster.route/rpc.dispatch trace events itself. Any
+                # loopback bounces this loop already collected seed the
+                # supervisor's exclude/bounce state so a mixed fleet
+                # keeps each-candidate-once semantics.
+                gen_kwargs = dict(kwargs)
+                timeout_ms = gen_kwargs.pop("timeout_ms", None)
+                gen_kwargs.update(max_new_tokens=max_new_tokens,
+                                  prefix_id=prefix_id, tenant=tenant,
+                                  priority=priority)
+                sup = _HedgedStream(
+                    self, np.asarray(toks, np.int32),
+                    gen_kwargs=gen_kwargs, pinned=host,
+                    blocks_hint_max_new=max_new_tokens,
+                    timeout_ms=timeout_ms, trace=trace,
+                    tenant_label=label, t0=t0)
+                sup.tried = list(tried)
+                sup.bounced_full = bounced_full
+                sup.last_error = last_reject
+                return sup.start((h, hid, how))
             trace.event("cluster.route", host=hid, decision=how,
                         kind="generate", blocks_needed=needed)
             try:
@@ -1059,6 +1707,15 @@ class ClusterFrontDoor:
     def prefix_host(self, prefix_id: str) -> Optional[int]:
         with self._affinity_lock:
             return self._prefix_hosts.get(prefix_id)
+
+    def _forget_host_prefixes(self, host_id: int):
+        """Directory hook on host leave: drop every prefix affinity
+        pointing at the departed host (its pins are gone with it)."""
+        with self._affinity_lock:
+            stale = [p for p, h in self._prefix_hosts.items()
+                     if h == host_id]
+            for p in stale:
+                del self._prefix_hosts[p]
 
 
 # --------------------------------------------------------------------------
@@ -1132,7 +1789,313 @@ class ClusterStatsAggregator:
         return events
 
 
+# --------------------------------------------------------------------------
+# Graceful leave + the elasticity decision loop
+# --------------------------------------------------------------------------
+def drain_host(directory: ClusterDirectory, host_id: int,
+               timeout: Optional[float] = None) -> bool:
+    """The coordinator half of the graceful-leave protocol, pairing the
+    host's :meth:`HostHandle.drain`:
+
+    1. **mark** — :meth:`ClusterDirectory.mark_draining` excludes the
+       host from routing the INSTANT the drain is initiated (waiting for
+       the host's next heartbeat to carry ``draining`` would leave a
+       window where the front door routes into a closing door and sheds
+       — the protocol's zero-shed guarantee lives here);
+    2. **drain** — the host stops admission, finishes every queued and
+       resident stream, and releases its shared-prefix pins;
+    3. **leave** — only once fully drained does the host leave the
+       directory (its heartbeats stop mattering; a later re-join
+       un-drains it).
+
+    Returns True when the host drained within ``timeout``. On timeout
+    the host STAYS marked draining with its directory entry intact —
+    admission is still closed and resident streams are still finishing,
+    so the caller can retry the drain or force ``shutdown()``; it must
+    not rejoin routing half-drained."""
+    h = directory.handle(host_id)
+    if h is None:
+        raise KeyError(f"host {host_id} has no bound handle in this "
+                       f"directory — cannot drain an unbound "
+                       f"(heartbeat-only) member")
+    directory.mark_draining(host_id)
+    ok = h.drain(timeout=timeout)
+    if ok:
+        directory.leave(host_id)
+    return ok
+
+
+@dataclasses.dataclass
+class ElasticityPolicy:
+    """Thresholds for the join/drain decision loop. The loop watches two
+    TRENDS from the ``GET /api/cluster`` payload — the fleet's free-slot
+    fraction and the front doors' shed mix — and recommends scaling:
+
+    - **join** when capacity pressure persists: ``cluster_capacity``
+      sheds appeared since the last look, or the free-slot fraction sat
+      below ``low_free_slot_frac`` for ``trend_windows`` consecutive
+      observations (a single busy tick never scales the fleet);
+    - **drain** when slack persists: free-slot fraction above
+      ``high_free_slot_frac`` with zero capacity sheds for
+      ``trend_windows`` consecutive observations, and more than
+      ``min_hosts`` routable hosts remain — the least-loaded host drains
+      (fewest resident streams leave, so scale-down finishes fastest);
+    - **hold** otherwise, and always while any host is mid-drain (one
+      elasticity action at a time keeps the trend readable)."""
+
+    low_free_slot_frac: float = 0.15
+    high_free_slot_frac: float = 0.60
+    trend_windows: int = 3
+    min_hosts: int = 1
+
+    def __post_init__(self):
+        if not (0.0 <= self.low_free_slot_frac
+                < self.high_free_slot_frac <= 1.0):
+            raise ValueError(
+                f"need 0 <= low_free_slot_frac < high_free_slot_frac <= 1, "
+                f"got {self.low_free_slot_frac}/{self.high_free_slot_frac}")
+        if self.trend_windows < 1:
+            raise ValueError("trend_windows must be >= 1")
+        if self.min_hosts < 1:
+            raise ValueError("min_hosts must be >= 1")
+
+
+class ElasticityPlanner:
+    """Pure decision half of the elasticity loop: feed it successive
+    ``GET /api/cluster`` payloads (:meth:`ClusterDirectory.api_snapshot`
+    locally, or fetched over HTTP — the shape is the same), get back a
+    decision dict. Holds only trend state (previous shed totals,
+    consecutive pressure/slack streaks); it never touches the fleet —
+    :class:`ElasticityLoop` applies decisions."""
+
+    #: front-door rejection reasons that mean "the fleet was full", the
+    #: signal that adding a host would have absorbed the request
+    CAPACITY_SHED_REASONS = ("cluster_capacity",)
+
+    def __init__(self, policy: Optional[ElasticityPolicy] = None):
+        self.policy = policy if policy is not None else ElasticityPolicy()
+        self._last_shed_total: Optional[int] = None
+        self._pressure_streak = 0
+        self._slack_streak = 0
+        self.last_decision: Optional[dict] = None
+
+    # ------------------------------------------------------------- signals
+    def _capacity_sheds(self, snapshot: dict) -> int:
+        total = 0
+        for fd in snapshot.get("front_doors", ()):
+            by_reason = fd.get("rejections_by_reason") or {}
+            for r in self.CAPACITY_SHED_REASONS:
+                total += int(by_reason.get(r, 0))
+        return total
+
+    @staticmethod
+    def _free_slot_frac(snapshot: dict) -> Optional[float]:
+        fleet = snapshot.get("fleet") or {}
+        slots = fleet.get("slots") or 0
+        if not slots:
+            return None
+        return float(fleet.get("free_slots", 0)) / float(slots)
+
+    @staticmethod
+    def _drain_candidate(snapshot: dict) -> Optional[int]:
+        """Least-loaded alive host: most free slots (ties: most free KV
+        blocks, then the highest id — newest joiner leaves first)."""
+        best = None
+        for hid_s, h in (snapshot.get("hosts") or {}).items():
+            st = h.get("status")
+            if (st is None or h.get("unbound") or not h.get("alive")
+                    or h.get("draining")):
+                continue
+            key = (st.get("free_slots", 0), st.get("kv_blocks_free", 0),
+                   int(hid_s))
+            if best is None or key > best[0]:
+                best = (key, int(hid_s))
+        return None if best is None else best[1]
+
+    # ------------------------------------------------------------ decision
+    def observe(self, snapshot: dict) -> dict:
+        """Fold one ``/api/cluster`` payload into the trends and decide.
+        The first observation never acts (no delta to read yet)."""
+        pol = self.policy
+        shed_total = self._capacity_sheds(snapshot)
+        shed_delta = (0 if self._last_shed_total is None
+                      else max(0, shed_total - self._last_shed_total))
+        first = self._last_shed_total is None
+        self._last_shed_total = shed_total
+        free_frac = self._free_slot_frac(snapshot)
+        fleet = snapshot.get("fleet") or {}
+        alive = int(fleet.get("alive", 0))
+        draining = int(fleet.get("draining", 0))
+
+        pressure = shed_delta > 0 or (
+            free_frac is not None and free_frac < pol.low_free_slot_frac)
+        slack = (shed_delta == 0 and free_frac is not None
+                 and free_frac > pol.high_free_slot_frac)
+        if first:
+            pressure = slack = False
+        self._pressure_streak = self._pressure_streak + 1 if pressure else 0
+        self._slack_streak = self._slack_streak + 1 if slack else 0
+
+        action, reason, target = "hold", "within watermarks", None
+        draining_host = None
+        if draining > 0:
+            action, reason = "hold", "a drain is already in progress"
+            # name the host mid-drain so the loop can keep DRIVING the
+            # drain to completion: a resident stream outliving one
+            # drain_timeout_s leaves the host marked draining, and a
+            # hold-forever here would wedge the whole loop (no retry,
+            # no join) on a single stuck drain
+            for hid_s, h in (snapshot.get("hosts") or {}).items():
+                if h.get("draining") and not h.get("unbound"):
+                    draining_host = int(hid_s)
+                    break
+        elif self._pressure_streak >= pol.trend_windows:
+            action = "join"
+            ff = "n/a" if free_frac is None else round(free_frac, 3)
+            reason = (f"capacity pressure for {self._pressure_streak} "
+                      f"window(s): +{shed_delta} capacity shed(s), "
+                      f"free-slot fraction {ff}")
+            self._pressure_streak = 0
+        elif (self._slack_streak >= pol.trend_windows
+                and alive - draining > pol.min_hosts):
+            target = self._drain_candidate(snapshot)
+            if target is not None:
+                action = "drain"
+                reason = (f"sustained slack for {self._slack_streak} "
+                          f"window(s): free-slot fraction "
+                          f"{round(free_frac, 3)} > "
+                          f"{pol.high_free_slot_frac}, no capacity sheds")
+                self._slack_streak = 0
+        self.last_decision = {
+            "action": action, "reason": reason, "host": target,
+            "draining_host": draining_host,
+            "free_slot_frac": (None if free_frac is None
+                               else round(free_frac, 4)),
+            "capacity_sheds_delta": shed_delta,
+            "pressure_streak": self._pressure_streak,
+            "slack_streak": self._slack_streak,
+        }
+        return self.last_decision
+
+
+def http_snapshot_source(url: str, index: int = 0, timeout_s: float = 5.0):
+    """A snapshot source reading ``GET /api/cluster`` off a coordinator
+    UI server — the over-the-wire way to feed :class:`ElasticityLoop`
+    (the endpoint returns one payload per live directory; ``index``
+    picks which)."""
+    import json as _json
+    import urllib.request as _req
+
+    base = url.rstrip("/")
+
+    def fetch() -> dict:
+        with _req.urlopen(f"{base}/api/cluster", timeout=timeout_s) as r:
+            payload = _json.loads(r.read().decode())
+        return payload[index]
+    return fetch
+
+
+class ElasticityLoop:
+    """The acting half of the join/drain loop: each :meth:`step` pulls
+    one snapshot from ``source`` (default: the directory's own
+    ``api_snapshot``; pass :func:`http_snapshot_source` to drive it off
+    a remote coordinator's ``GET /api/cluster``), asks the planner, and
+    applies the decision — ``join`` invokes the caller's ``on_join``
+    hook (only the deployer can mint hosts; the loop just says when),
+    ``drain`` runs :func:`drain_host` on the chosen host. ``start()``
+    runs steps on a daemon thread with the same seeded-jitter discipline
+    as :class:`HeartbeatPump`; tests call :meth:`step` directly."""
+
+    def __init__(self, directory: ClusterDirectory, *,
+                 planner: Optional[ElasticityPlanner] = None,
+                 source: Optional[Callable[[], dict]] = None,
+                 on_join: Optional[Callable[[dict], None]] = None,
+                 drain_timeout_s: Optional[float] = 30.0,
+                 interval_s: float = 5.0, jitter: float = 0.1,
+                 seed: int = 0):
+        _validate_jitter(interval_s, jitter)
+        self.directory = directory
+        self.planner = planner if planner is not None else ElasticityPlanner()
+        self._source = source if source is not None \
+            else directory.api_snapshot
+        self.on_join = on_join
+        self.drain_timeout_s = drain_timeout_s
+        self.interval_s = interval_s
+        self.jitter = float(jitter)
+        self._rng = np.random.default_rng(seed)
+        self.steps = 0
+        self.decisions: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        with _ELASTICITY_LOCK:
+            _ELASTICITY_LOOPS.add(self)
+
+    def step(self) -> dict:
+        decision = self.planner.observe(self._source())
+        self.steps += 1
+        self.decisions.append(decision)
+        if decision["action"] == "join":
+            if self.on_join is not None:
+                self.on_join(decision)
+        elif decision["action"] == "drain":
+            # the snapshot may be seconds stale (http_snapshot_source):
+            # the chosen host can have left between observe and apply —
+            # skip rather than KeyError out of the caller's step()
+            if self.directory.handle(decision["host"]) is not None:
+                drain_host(self.directory, decision["host"],
+                           timeout=self.drain_timeout_s)
+        elif decision.get("draining_host") is not None:
+            # a prior drain timed out mid-flight (the host stays marked
+            # draining, admission closed, residents still finishing):
+            # keep driving it to completion instead of holding forever
+            # — drain_host is idempotent and leaves on success
+            hid = decision["draining_host"]
+            if self.directory.handle(hid) is not None:
+                drain_host(self.directory, hid,
+                           timeout=self.drain_timeout_s)
+        return decision
+
+    def next_interval_s(self) -> float:
+        return _jittered_interval_s(self.interval_s, self.jitter,
+                                    self._rng)
+
+    def start(self) -> "ElasticityLoop":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="elasticity-loop")
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.next_interval_s()):
+            try:
+                self.step()
+            except Exception:
+                pass   # a failed fetch/drain must not kill the loop
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# weak registry: the UI's /api/cluster decorates each directory's
+# payload with its loop's latest decision (same pattern as
+# all_directories)
+_ELASTICITY_LOOPS: "weakref.WeakSet[ElasticityLoop]" = weakref.WeakSet()
+_ELASTICITY_LOCK = threading.Lock()
+
+
+def all_elasticity_loops() -> List["ElasticityLoop"]:
+    with _ELASTICITY_LOCK:
+        return list(_ELASTICITY_LOOPS)
+
+
 __all__ = ["HostStatus", "HostHandle", "LoopbackHost", "ClusterTransport",
            "LoopbackTransport", "HttpTransport", "HeartbeatPump",
            "ClusterDirectory", "ClusterFrontDoor", "ClusterStatsAggregator",
-           "all_directories"]
+           "HedgePolicy", "ElasticityPolicy", "ElasticityPlanner",
+           "ElasticityLoop", "all_elasticity_loops", "drain_host",
+           "http_snapshot_source", "all_directories"]

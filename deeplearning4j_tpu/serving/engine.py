@@ -42,8 +42,8 @@ from deeplearning4j_tpu.ndarray.array import NDArray
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, batch_sharding
 from deeplearning4j_tpu.profiler import OpProfiler
 from deeplearning4j_tpu.serving.admission import (
-    AdmissionController, DeadlineExceededError, QueueFullError, RejectedError,
-    Request,
+    AdmissionController, DeadlineExceededError, HostDrainingError,
+    QueueFullError, RejectedError, Request,
 )
 from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
@@ -151,6 +151,7 @@ class InferenceEngine(ResilientEngineMixin):
         self._seen_buckets: set = set()
         self._row_sig = None  # (feature shape, dtype) pinned by first request
         self._seen_lock = threading.Lock()
+        self._draining = False
         self._stop = threading.Event()
         self.screen_outputs = screen_outputs
         # resilience + observability scaffolding is the shared mixin
@@ -182,6 +183,17 @@ class InferenceEngine(ResilientEngineMixin):
         if wait and self._thread.is_alive():
             self._thread.join(timeout=5.0)
 
+    # ----------------------------------------------------------------- drain
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain (the host-leave protocol's engine half): stop
+        admitting — new submits shed typed ``host_draining`` — then wait
+        for every queued and in-flight request to finish (the shared
+        mixin ``_drain_wait``). Returns True when fully drained within
+        ``timeout`` (None = wait forever). The dispatcher keeps running
+        either way; ``shutdown()`` is the usual next step once the host
+        has left the directory."""
+        return self._drain_wait(timeout)
+
     # --------------------------------------------------------------- submit
     def submit(self, x, timeout_ms: Optional[float] = None,
                tenant: Optional[str] = None,
@@ -205,6 +217,14 @@ class InferenceEngine(ResilientEngineMixin):
         self._count_request()
         trace = self._tracer.begin(self.name, "infer",
                                    rows=int(arr.shape[0]), tenant=tenant)
+        if self._draining:
+            # drain outranks every other gate: the host is leaving and
+            # the router should place this elsewhere
+            e = HostDrainingError(
+                f"engine[{self.name}] is draining — admission closed "
+                "ahead of a graceful leave; route to another host")
+            self._reject_submit(trace, e, tenant=tenant)
+            raise e
         self._breaker_gate(trace, tenant=tenant)
         if self._qos_governor is not None:
             e = self._qos_governor.gate(priority)

@@ -25,7 +25,21 @@ Design constraints, in priority order:
 Injection points are plain strings named after the call they wrap —
 ``engine.dispatch``, ``engine.warmup``, ``generation.prefill``,
 ``generation.decode_step``, ``registry.warmup`` — so a plan composed for
-one engine works against any other.
+one engine works against any other. The RPC data plane
+(serving/rpc.py) adds the seeded NETWORK fault points, wrapped
+client-side so cross-host chaos replays bit-for-bit in one process just
+like engine chaos does:
+
+- ``rpc.dispatch`` — the submit POST (drop via :meth:`FaultPlan.fail`,
+  latency spike via :meth:`FaultPlan.delay`, both fire BEFORE the
+  request leaves the client, so a dropped dispatch never half-commits
+  server state);
+- ``rpc.stream``   — each streamed-chunk long-poll (a drop here models
+  the host dying mid-stream — the hedging supervisor's re-dispatch
+  trigger);
+- ``rpc.response`` — response decode (a :meth:`FaultPlan.poison` rule
+  mutating the decoded payload models a malformed/mid-upgrade wire
+  schema; the client sheds typed ``rpc_error``).
 
 Usage::
 

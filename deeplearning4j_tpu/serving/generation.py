@@ -52,7 +52,8 @@ import numpy as np
 
 from deeplearning4j_tpu.profiler import OpProfiler
 from deeplearning4j_tpu.serving.admission import (
-    AdmissionController, KVBlocksExhaustedError, RejectedError, Request,
+    AdmissionController, HostDrainingError, KVBlocksExhaustedError,
+    RejectedError, Request,
 )
 from deeplearning4j_tpu.serving.engine import bucket_ladder
 from deeplearning4j_tpu.serving.faults import inject
@@ -373,6 +374,7 @@ class GenerationEngine(ResilientEngineMixin):
         self._admission.on_shed = self._count_shed
         self._admission.on_close_reject = self._count_close_reject
         self._admission.on_cancelled = self._count_cancelled
+        self._draining = False
         self._stop = threading.Event()
         self.screen_outputs = screen_outputs
         # resilience + observability scaffolding is the shared mixin
@@ -423,6 +425,28 @@ class GenerationEngine(ResilientEngineMixin):
         self._recorder.record("engine.shutdown", engine=self.name)
         if wait and self._thread.is_alive():
             self._thread.join(timeout=30.0)
+
+    # ----------------------------------------------------------------- drain
+    def drain(self, timeout: Optional[float] = None,
+              release_prefixes: bool = True) -> bool:
+        """Graceful drain (the host-leave protocol's engine half): stop
+        admitting — new submits shed typed ``host_draining`` — finish
+        every queued and RESIDENT stream (the scheduler keeps running:
+        queued prompts still seat and decode to completion; the shared
+        mixin ``_drain_wait``), then release every shared-prefix pin so
+        the pool's blocks return to the free list. Returns True when
+        fully drained within ``timeout`` (None = wait forever); on
+        timeout the engine stays draining (admission stays closed) but
+        pins are kept — the caller decides whether to force
+        ``shutdown()``."""
+        if not self._drain_wait(timeout):
+            return False
+        if release_prefixes:
+            with self._prefix_lock:
+                pids = list(self._prefixes)
+            for pid in pids:
+                self.release_prefix(pid)
+        return True
 
     # --------------------------------------------------------------- submit
     def submit(self, prompt, *, max_new_tokens: int = 16,
@@ -494,6 +518,14 @@ class GenerationEngine(ResilientEngineMixin):
                       priority=priority)
         greq.handle = GenerationHandle(req, toks.size, on_token=on_token)
         self._count_request()
+        if self._draining:
+            # drain outranks every other gate: the host is leaving and
+            # the router should place this stream elsewhere
+            e = HostDrainingError(
+                f"engine[{self.name}] is draining — admission closed "
+                "ahead of a graceful leave; route to another host")
+            self._reject_submit(trace, e, tenant=tenant)
+            raise e
         self._breaker_gate(trace, tenant=tenant)
         if self._qos_governor is not None:
             e = self._qos_governor.gate(priority)
@@ -543,6 +575,10 @@ class GenerationEngine(ResilientEngineMixin):
         if not self.paged:
             raise ValueError("register_prefix requires the paged KV cache "
                              "(GenerationEngine(paged=True))")
+        if self._draining:
+            raise HostDrainingError(
+                f"engine[{self.name}] is draining — it releases its "
+                "prefix pins and takes no new ones; register elsewhere")
         toks = np.ascontiguousarray(np.asarray(tokens, np.int32).ravel())
         if toks.size == 0:
             raise ValueError("prefix must contain at least one token")
@@ -1706,5 +1742,25 @@ class GenerationEngine(ResilientEngineMixin):
         return self
 
 
+def client_stream_handle(prompt_len: int,
+                         on_token: Optional[Callable[[int], None]] = None,
+                         tenant: str = None) -> GenerationHandle:
+    """A :class:`GenerationHandle` backed by NO local scheduler — the
+    client half of a cross-host stream bridge (serving/rpc.py and the
+    front door's hedging supervisor in serving/cluster.py). The bridge
+    delivers through the same scheduler-side hooks the engine uses —
+    ``_push`` per token, ``_finish``/``_fail`` exactly-once at the
+    terminal — so ``result()``/``stream()``/``tokens_so_far()``/
+    ``on_token`` behave identically whether the tokens were decoded in
+    this process or long-polled off a remote host. The underlying
+    admission Request exists only to carry the future and tenant label;
+    it never enters a queue."""
+    from deeplearning4j_tpu.serving.admission import DEFAULT_TENANT
+
+    req = Request(x=None, rows=1,
+                  tenant=tenant if tenant is not None else DEFAULT_TENANT)
+    return GenerationHandle(req, prompt_len, on_token=on_token)
+
+
 __all__ = ["GenerationEngine", "GenerationHandle", "GenerationRequest",
-           "prefill_buckets"]
+           "client_stream_handle", "prefill_buckets"]

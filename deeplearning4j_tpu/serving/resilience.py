@@ -669,6 +669,36 @@ class ResilientEngineMixin:
             name=self.name).start()
         return self
 
+    # ----------------------------------------------------------------- drain
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """Nothing queued and nothing in flight — the drain's exit
+        condition (exactly the watchdog's not-busy predicate)."""
+        return not self._watchdog_busy()
+
+    def _drain_wait(self, timeout: Optional[float]) -> bool:
+        """The shared host-leave drain protocol (serving/rpc.py): flip
+        the draining flag — new submits shed typed ``host_draining`` —
+        then wait for every queued and in-flight request to finish.
+        Returns True when fully drained within ``timeout`` (None = wait
+        forever); on timeout the engine STAYS draining (admission stays
+        closed) so the caller can retry or force ``shutdown()``. One
+        copy for both engines — only the post-drain tail (generation's
+        prefix-pin release) differs."""
+        self._draining = True
+        self._recorder.record("engine.drain", engine=self.name)
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while not self.drained:
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
     @property
     def breaker(self) -> CircuitBreaker:
         return self._breaker

@@ -71,6 +71,11 @@ TERMINAL_REASONS = (
     # out of admission headroom, and no usable host (dead/stale past its
     # probe allowance, or a pinned/prefix-affine host gone)
     "cluster_capacity", "host_unavailable",
+    # RPC data plane (serving/rpc.py): a gracefully-draining host
+    # refusing new admission ahead of leaving the directory, and a peer
+    # whose wire payload could not be interpreted (malformed/mid-upgrade
+    # schema) — distinct from host_unavailable because the host answered
+    "host_draining", "rpc_error",
 )
 
 

@@ -495,11 +495,25 @@ class _Handler(BaseHTTPRequestHandler):
         if parts == ["api", "cluster"]:
             # pod-slice control-plane view (serving/cluster.py): one
             # entry per live ClusterDirectory in this process — per-host
-            # slots/blocks/breaker/SLO + heartbeat age, the fleet
-            # roll-up (alive/quorum/degraded, summed capacity), and
-            # each front door's routed/shed mix
-            from deeplearning4j_tpu.serving.cluster import all_directories
-            self._json([d.api_snapshot() for d in all_directories()])
+            # slots/blocks/breaker/SLO + drain state + heartbeat age,
+            # the fleet roll-up (alive/draining/quorum/degraded, summed
+            # capacity), each front door's routed/shed/hedge mix, and —
+            # when an ElasticityLoop watches the directory — its latest
+            # join/drain decision (the loop itself may be feeding off
+            # THIS endpoint via http_snapshot_source; the decision block
+            # is additive, so the payload stays a valid planner input)
+            from deeplearning4j_tpu.serving.cluster import (
+                all_directories, all_elasticity_loops,
+            )
+            loops = {id(lp.directory): lp for lp in all_elasticity_loops()}
+            payload = []
+            for d in all_directories():
+                snap = d.api_snapshot()
+                lp = loops.get(id(d))
+                if lp is not None and lp.planner.last_decision is not None:
+                    snap["elasticity"] = lp.planner.last_decision
+                payload.append(snap)
+            self._json(payload)
             return
         if parts == ["api", "traces"]:
             # finished request traces retained by every Tracer in this

@@ -309,7 +309,14 @@ class TestDifferentialOverChaosSuite:
     SUBSET = ["tests/test_qos.py::TestQuota",
               "tests/test_resilience.py::TestRetryPolicy",
               "tests/test_resilience.py::TestRegistryResilience",
-              "tests/test_paged_kv.py::TestSharedPrefix"]
+              "tests/test_paged_kv.py::TestSharedPrefix",
+              # ISSUE 12: the RPC data plane's server/stream-bridge
+              # threads (serving/rpc.py) and the hedging supervisor's
+              # under-lock delivery — the chaos subset must observe the
+              # _OpState.cv long-poll edges and the _HedgedStream push
+              # edge so the lockgraph waivers stay armed against drift
+              "tests/test_rpc.py::TestRpcChaos",
+              "tests/test_rpc.py::TestDeliveryRaces"]
 
     def test_dynamic_graph_diffs_green(self, tmp_path):
         report = tmp_path / "lockdep.json"
@@ -329,6 +336,8 @@ class TestDifferentialOverChaosSuite:
         assert ("GenerationEngine._wd_lock",
                 "BlockAllocator._lock") in observed
         assert ("ModelRegistry._lock", "CircuitBreaker._lock") in observed
+        # the RPC server's stream long-poll really ran under the plugin
+        assert ("_OpState.cv", "GenerationHandle._lock") in observed
         diff = differential(dyn, load_graph(DEFAULT_GRAPH))
         pretty = json.dumps(diff, indent=2)
         assert diff["unwaived"] == [], (

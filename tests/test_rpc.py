@@ -1,0 +1,1619 @@
+"""Fault-tolerant RPC data plane tests (serving/rpc.py + the hedging /
+drain / elasticity layers in serving/cluster.py — ISSUE 12).
+
+Everything runs single-process on CPU: real ``HostRpcServer``s bind
+loopback TCP ports in front of real engines, ``RemoteHost``s drive them
+over actual HTTP, and the seeded ``rpc.*`` fault points make the
+network-failure scenarios deterministic (no socket ever needs to
+actually fail to replay an incident). The acceptance scenarios from the
+issue run end to end:
+
+- wire schema: versioned round-trips, v1 peer <-> v2 coordinator in both
+  directions with unknown fields ignored;
+- deadline propagation: a request with 50 ms of budget arrives at the
+  remote host with <= 50 ms (exactly 50 under a frozen injected clock),
+  hedged re-dispatches ship only what remains, and a spent budget sheds
+  typed ``deadline`` server-side before touching the engine;
+- THE chaos acceptance test: a generation stream routed over HTTP
+  survives its host being killed mid-stream — hedged re-dispatch lands
+  it on the survivor, the client handle sees exactly one terminal, no
+  token is delivered twice, the result is bitwise the unkilled stream,
+  and the trace carries cluster.route -> rpc.dispatch -> cluster.bounce
+  -> terminal in monotonic order;
+- graceful drain: ``drain_host`` admits nothing new, finishes resident
+  streams, releases prefix pins, leaves the directory, and the front
+  door sheds ZERO requests during the drain window;
+- heartbeat jitter: seeded +-10% beat schedules decorrelate a restarted
+  fleet, asserted schedule-level without sleeping;
+- elasticity: the join/drain planner reads ``/api/cluster`` payloads,
+  trends (never single ticks) drive decisions, and the loop's drain
+  action really shrinks a live fleet.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import (
+    ClusterDirectory, ClusterFrontDoor, ElasticityLoop, ElasticityPlanner,
+    ElasticityPolicy, FaultPlan, HeartbeatPump, HedgePolicy, HostDrainingError,
+    HostRpcServer, HostStatus, HostUnavailableError, InferenceEngine,
+    LoopbackHost, LoopbackTransport, ModelAdapter, RejectedError, RemoteHost,
+    RpcError, RpcRequest, RpcResponse, RpcStreamChunk, Tracer, drain_host,
+    rejected_from_wire,
+)
+from deeplearning4j_tpu.serving.faults import FaultInjectedError
+from deeplearning4j_tpu.serving.rpc import RPC_PREFIX
+from deeplearning4j_tpu.serving.tracing import TERMINAL_REASONS
+
+
+class MlpAdapter(ModelAdapter):
+    """Pure-numpy adapter — RPC tests exercise the wire, not the math."""
+
+    kind = "tiny-mlp"
+
+    def __init__(self, delay_s: float = 0.0):
+        super().__init__(model=None)
+        self.w = np.linspace(-1.0, 1.0, 6, dtype=np.float32).reshape(6, 1)
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def infer(self, x):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return np.asarray(x) @ self.w
+
+
+def row(n=2):
+    return np.ones((n, 6), np.float32)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_rpc_infer_host(host_id=0, *, clock=None, delay_s=0.0, **rh_kwargs):
+    """One MLP engine behind a real HTTP endpoint + its remote handle.
+    Returns (remote, server, local, engine, adapter)."""
+    adapter = MlpAdapter(delay_s=delay_s)
+    eng = InferenceEngine(adapter, max_batch_size=8, max_wait_ms=0.0,
+                          name=f"rpc-e{host_id}")
+    local = LoopbackHost(host_id, engine=eng)
+    kw = {} if clock is None else {"clock": clock}
+    srv = HostRpcServer(local, **kw)
+    remote = RemoteHost(host_id, srv.url, **kw, **rh_kwargs)
+    return remote, srv, local, eng, adapter
+
+
+def stop_rpc_host(srv, local):
+    srv.stop()
+    local.shutdown()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                            mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                            causal=True, attention_impl="full", remat=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def make_rpc_gen_fleet(tiny_model, n_hosts=2, *, slots=2, max_len=48,
+                       tracer=None, hedge=None, heartbeat_timeout_s=30.0):
+    """n generation hosts each behind a real HTTP endpoint, joined to a
+    directory via their RemoteHost handles (the data plane IS the wire).
+    Returns (directory, front_door, remotes, servers, locals, engines)."""
+    from deeplearning4j_tpu.serving import GenerationEngine
+
+    cfg, params = tiny_model
+    d = ClusterDirectory(heartbeat_timeout_s=heartbeat_timeout_s)
+    remotes, servers, locals_, engines = [], [], [], []
+    for i in range(n_hosts):
+        g = GenerationEngine(params, cfg, slots=slots, max_len=max_len,
+                             name=f"rpc-g{i}")
+        local = LoopbackHost(i, generation=g)
+        srv = HostRpcServer(local)
+        rem = RemoteHost(i, srv.url)
+        d.join(rem)
+        HeartbeatPump(rem, LoopbackTransport(d)).pump_once()
+        remotes.append(rem)
+        servers.append(srv)
+        locals_.append(local)
+        engines.append(g)
+    fd = ClusterFrontDoor(d, tracer=tracer, hedge=hedge)
+    return d, fd, remotes, servers, locals_, engines
+
+
+def stop_fleet(servers, locals_):
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    for h in locals_:
+        try:
+            h.shutdown()
+        except Exception:
+            pass
+
+
+def prompt(n=5, seed=3, vocab=50):
+    return np.random.default_rng(seed).integers(1, vocab, n).astype(np.int32)
+
+
+# --------------------------------------------------------------------------
+# Wire schema: versioned round-trips, rolling-upgrade tolerance
+# --------------------------------------------------------------------------
+class TestWireSchema:
+    CASES = [
+        RpcRequest(request_id="r1", kind="generate", prompt=[1, 2, 3],
+                   max_new_tokens=4, temperature=0.5, top_k=3, seed=9,
+                   tenant="acme", priority="interactive", timeout_ms=80.0,
+                   hedge_attempt=2),
+        RpcResponse(request_id="r1", ok=True, done=True, stream_id="op-7",
+                    result=[[1.0], [2.0]], result_dtype="float32"),
+        RpcStreamChunk(stream_id="op-7", cursor=3, tokens=[5, 6], done=True,
+                       finish_reason="eos"),
+    ]
+
+    @pytest.mark.parametrize("msg", CASES, ids=lambda m: type(m).__name__)
+    def test_round_trip_through_json(self, msg):
+        wire = json.loads(json.dumps(msg.to_dict()))
+        assert type(msg).from_dict(wire) == msg
+        assert wire["wire_version"] == 1
+
+    @pytest.mark.parametrize("msg", CASES, ids=lambda m: type(m).__name__)
+    def test_v2_sender_to_v1_receiver_ignores_unknown_fields(self, msg):
+        """Direction 1 of the rolling upgrade: a NEWER peer adds fields
+        this receiver has never heard of — from_dict's known-field
+        filter drops them instead of raising TypeError."""
+        wire = msg.to_dict()
+        wire["wire_version"] = 2
+        wire["a_v2_only_field"] = {"nested": [1, 2, 3]}
+        back = type(msg).from_dict(wire)
+        assert back.wire_version == 2
+        base = msg.to_dict()
+        got = back.to_dict()
+        for k, v in base.items():
+            if k != "wire_version":
+                assert got[k] == v
+
+    @pytest.mark.parametrize("msg", CASES, ids=lambda m: type(m).__name__)
+    def test_v1_sender_to_v2_receiver_defaults_missing_fields(self, msg):
+        """Direction 2: an OLDER peer omits fields this receiver grew
+        after v1 — every non-identity field is defaulted, so the payload
+        still parses (the receiver branches on wire_version instead of
+        crashing on shape)."""
+        wire = msg.to_dict()
+        # simulate the old sender: drop every defaulted field it never had
+        for drop in ("hedge_attempt", "finish_reason", "result_dtype",
+                     "error_reason", "error_message"):
+            wire.pop(drop, None)
+        back = type(msg).from_dict(wire)
+        assert back.wire_version == 1
+
+    def test_host_status_draining_defaults_for_old_senders(self):
+        """The PR 10 heartbeat schema grew ``draining`` this PR: a
+        pre-drain sender's payload (no such key) must keep parsing —
+        the MIGRATING.md contract."""
+        st = HostStatus(host_id=4, has_infer=True, slots=8, seq=3)
+        wire = st.to_dict()
+        del wire["draining"]
+        back = HostStatus.from_dict(wire)
+        assert back.draining is False
+        assert back.host_id == 4
+
+    def test_rejected_from_wire_maps_the_one_taxonomy(self):
+        e = rejected_from_wire("queue_full", "full", host=2)
+        assert isinstance(e, RejectedError) and e.reason == "queue_full"
+        e = rejected_from_wire("host_unavailable", "gone", host=2)
+        assert isinstance(e, HostUnavailableError) and e.host == 2
+        e = rejected_from_wire("host_draining", "leaving", host=1)
+        assert isinstance(e, HostDrainingError)
+        assert e.reason == "host_draining"
+        # unknown / absent / 'ok' reasons are wire-schema incidents
+        for bad in ("not_a_reason", None, "ok"):
+            e = rejected_from_wire(bad, "?", host=3)
+            assert isinstance(e, RpcError) and e.reason == "rpc_error"
+
+
+# --------------------------------------------------------------------------
+# Infer over the wire: results, typed rejections, cancel
+# --------------------------------------------------------------------------
+class TestRpcInfer:
+    def test_infer_round_trip_matches_local(self):
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            x = row(3)
+            want = np.asarray(eng.output(x).jax)
+            got = np.asarray(
+                remote.submit_infer(x, timeout_ms=10_000).result(timeout=30))
+            np.testing.assert_array_equal(got, want)
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_status_rides_the_wire(self):
+        remote, srv, local, eng, adapter = make_rpc_infer_host(host_id=7)
+        try:
+            st = remote.status()
+            assert st.host_id == 7 and st.has_infer and not st.draining
+            assert st.wire_version == 1
+            assert remote.serves("infer") and not remote.serves("generate")
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_bfloat16_result_round_trips_wire_safe(self):
+        """A bfloat16 result (normal on TPU) must resolve on the
+        client: either faithfully (ml_dtypes registers the name with
+        numpy, as here) or via the server's float32 fallback for
+        names the peer cannot reconstruct — never a dead result
+        poller hanging the caller's Future forever."""
+        import jax.numpy as jnp
+
+        class Bf16Adapter(ModelAdapter):
+            kind = "bf16-mlp"
+
+            def __init__(self):
+                super().__init__(model=None)
+
+            def infer(self, x):
+                return jnp.asarray(np.asarray(x).sum(axis=1,
+                                                     keepdims=True),
+                                   jnp.bfloat16)
+
+        eng = InferenceEngine(Bf16Adapter(), max_batch_size=8,
+                              max_wait_ms=0.0, name="bf16-e")
+        local = LoopbackHost(0, engine=eng)
+        srv = HostRpcServer(local)
+        remote = RemoteHost(0, srv.url)
+        try:
+            got = np.asarray(remote.submit_infer(
+                row(2), timeout_ms=10_000).result(timeout=30))
+            # whatever dtype crossed the wire, the client could build it
+            assert got.dtype == np.dtype(str(got.dtype))
+            np.testing.assert_allclose(
+                got.astype(np.float32).ravel(), [6.0, 6.0])
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_typed_rejection_crosses_the_wire(self):
+        """A host's own shed re-raises client-side with the host's
+        reason — admission looks local either side of the wire."""
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            local.drain(timeout=10)
+            with pytest.raises(HostDrainingError) as ei:
+                remote.submit_infer(row())
+            assert ei.value.reason == "host_draining"
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_unknown_kind_is_rpc_error(self):
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            resp = RpcResponse.from_dict(remote._rpc(
+                f"{RPC_PREFIX}/submit",
+                RpcRequest(kind="teleport").to_dict(), point=None))
+            assert not resp.ok and resp.error_reason == "rpc_error"
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_terminal_survives_a_lost_response(self):
+        """Idempotence over a lossy wire: a resolved op's terminal must
+        be re-pollable — popping it on first fetch made a lost HTTP
+        response unrecoverable (retry got 'unknown op' and the client
+        failed a request that succeeded server-side)."""
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            resp = RpcResponse.from_dict(remote._rpc(
+                f"{RPC_PREFIX}/submit",
+                RpcRequest(kind="infer", x=row().tolist(),
+                           x_dtype="float32").to_dict(), point=None))
+            assert resp.ok
+            polls = [RpcResponse.from_dict(remote._rpc(
+                f"{RPC_PREFIX}/result",
+                {"stream_id": resp.stream_id, "wait_ms": 5_000},
+                point=None)) for _ in range(2)]
+            for p in polls:      # the re-poll sees the SAME terminal
+                assert p.ok and p.done and p.result == polls[0].result
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_malformed_payload_types_client_error_not_rpc_error(self):
+        """A TypeError out of np.asarray/np.dtype on a malformed
+        payload must come back typed 'client_error' — an escaped HTTP
+        500 reads as hedge-retriable rpc_error and the fleet replays
+        the same bad request against every host."""
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            for req in (RpcRequest(kind="generate", prompt=None),
+                        RpcRequest(kind="infer", x=[[1.0]],
+                                   x_dtype="bogus")):
+                resp = RpcResponse.from_dict(remote._rpc(
+                    f"{RPC_PREFIX}/submit", req.to_dict(), point=None))
+                assert not resp.ok
+                assert resp.error_reason == "client_error", resp
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_op_ttl_measured_from_terminal_not_creation(self):
+        """A stream/infer op whose total RUNTIME exceeds OP_TTL_S must
+        still get its full post-terminal retention window — sweeping on
+        created_t garbage-collected a long op the instant it resolved,
+        so the client's final poll found 'unknown op' and failed (or
+        fully re-decoded) a request that succeeded."""
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            resp = RpcResponse.from_dict(remote._rpc(
+                f"{RPC_PREFIX}/submit",
+                RpcRequest(kind="infer", x=row().tolist(),
+                           x_dtype="float32").to_dict(), point=None))
+            state = srv._op(resp.stream_id)
+            from concurrent.futures import wait as fwait
+            fwait([state.future], timeout=30)
+            state.created_t -= 10 * srv.OP_TTL_S   # "ran for 20 min"
+            srv._gc()                              # must NOT sweep it
+            poll = RpcResponse.from_dict(remote._rpc(
+                f"{RPC_PREFIX}/result",
+                {"stream_id": resp.stream_id, "wait_ms": 1_000},
+                point=None))
+            assert poll.ok and poll.done
+            # once the TTL elapses past RESOLUTION, it is swept
+            state.resolved_t -= 10 * srv.OP_TTL_S
+            srv._gc()
+            assert srv._op(resp.stream_id) is None
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_unknown_op_long_poll_is_rpc_error(self):
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            resp = RpcResponse.from_dict(remote._rpc(
+                f"{RPC_PREFIX}/result",
+                {"stream_id": "op-999", "wait_ms": 1}, point=None))
+            assert not resp.ok and resp.error_reason == "rpc_error"
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_dead_host_is_typed_host_unavailable(self):
+        remote, srv, local, eng, adapter = make_rpc_infer_host(
+            timeout_s=2.0)
+        stop_rpc_host(srv, local)
+        with pytest.raises(HostUnavailableError) as ei:
+            remote.submit_infer(row())
+        assert ei.value.reason == "host_unavailable"
+        assert ei.value.__cause__ is not None   # chains the socket error
+
+
+# --------------------------------------------------------------------------
+# Deadline propagation (acceptance): budgets only ever shrink
+# --------------------------------------------------------------------------
+class TestDeadlinePropagation:
+    def test_50ms_budget_arrives_with_exactly_50ms_under_frozen_clock(self):
+        fc = FakeClock()
+        remote, srv, local, eng, adapter = make_rpc_infer_host(clock=fc)
+        try:
+            remote.submit_infer(row(), timeout_ms=50.0).result(timeout=30)
+            assert srv.last_arrival_budget_ms == pytest.approx(50.0)
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_real_clock_budget_arrives_no_larger_than_sent(self):
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            remote.submit_infer(row(), timeout_ms=50.0).result(timeout=30)
+            assert srv.last_arrival_budget_ms <= 50.0
+            assert srv.last_arrival_budget_ms > 0.0
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_redispatch_ships_only_the_remaining_budget(self, tiny_model):
+        """Hedged re-dispatches share ONE deadline: advancing the
+        injected clock 30 ms between attempts shrinks the second
+        attempt's wire budget from 50 ms to 20 ms."""
+        from deeplearning4j_tpu.serving import GenerationEngine
+
+        cfg, params = tiny_model
+        fc = FakeClock()
+        g = GenerationEngine(params, cfg, slots=2, max_len=48, name="ddl-g")
+        local = LoopbackHost(0, generation=g)
+        srv = HostRpcServer(local)
+        remote = RemoteHost(0, srv.url, clock=fc)
+        try:
+            deadline_t = remote._deadline_t(50.0)
+            remote.open_stream(prompt(4), max_new_tokens=1,
+                               deadline_t=deadline_t)
+            assert srv.last_arrival_budget_ms == pytest.approx(50.0)
+            fc.advance(0.030)
+            remote.open_stream(prompt(4), max_new_tokens=1,
+                               deadline_t=deadline_t, hedge_attempt=1)
+            assert srv.last_arrival_budget_ms == pytest.approx(20.0)
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_result_poller_backstops_a_wedged_remote(self):
+        """The infer result poller must enforce its deadline locally
+        (server-side shedding owns the budget, but a WEDGED remote
+        engine never resolves the op) — otherwise the daemon poller
+        thread and its socket leak forever, one per such request."""
+        fc = FakeClock()
+        remote, srv, local, eng, adapter = make_rpc_infer_host(
+            clock=fc, delay_s=5.0, poll_wait_ms=20.0)
+        try:
+            fut = remote.submit_infer(row(), timeout_ms=50.0)
+            fc.advance(60.0)    # budget + grace long gone
+            with pytest.raises(RejectedError) as ei:
+                fut.result(timeout=10)
+            assert ei.value.reason == "deadline"
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_spent_budget_sheds_typed_deadline_before_the_engine(self):
+        fc = FakeClock()
+        remote, srv, local, eng, adapter = make_rpc_infer_host(clock=fc)
+        try:
+            calls_before = adapter.calls
+            deadline_t = remote._deadline_t(50.0)
+            fc.advance(0.060)             # budget is now -10 ms
+            with pytest.raises(RejectedError) as ei:
+                remote.submit_infer(row(), timeout_ms=remote._budget_ms(
+                    deadline_t))
+            assert ei.value.reason == "deadline"
+            assert adapter.calls == calls_before   # shed at the door
+        finally:
+            stop_rpc_host(srv, local)
+
+
+# --------------------------------------------------------------------------
+# Generation stream bridging: remote handles behave like local ones
+# --------------------------------------------------------------------------
+class TestGenerationBridge:
+    @pytest.fixture(scope="class")
+    def bridge(self, tiny_model):
+        from deeplearning4j_tpu.serving import GenerationEngine
+
+        cfg, params = tiny_model
+        g = GenerationEngine(params, cfg, slots=2, max_len=48, name="br-g")
+        local = LoopbackHost(0, generation=g)
+        srv = HostRpcServer(local)
+        remote = RemoteHost(0, srv.url, poll_wait_ms=50.0)
+        try:
+            yield remote, srv, local, g
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_bridged_stream_bitwise_equals_direct(self, bridge):
+        remote, srv, local, g = bridge
+        p = prompt(6, seed=9)
+        want = g.submit(p, max_new_tokens=8, seed=123).result(timeout=120)
+        got = remote.submit_generate(p, max_new_tokens=8,
+                                     seed=123).result(timeout=120)
+        assert got == want
+
+    def test_on_token_streams_in_order_no_duplicates(self, bridge):
+        remote, srv, local, g = bridge
+        seen = []
+        h = remote.submit_generate(prompt(5, seed=4), max_new_tokens=6,
+                                   seed=5, on_token=seen.append)
+        res = h.result(timeout=120)
+        assert seen == res and len(res) == 6
+        assert h.finish_reason in ("max_tokens", "eos")
+
+    def test_broken_consumer_cancels_server_side(self, bridge):
+        """A broken local on_token consumer must stop the REMOTE slot —
+        the bridge cancels the op instead of letting the host decode
+        the whole budget for nobody."""
+        remote, srv, local, g = bridge
+        cancels_before = srv.cancels
+
+        def bomb(_tok):
+            raise RuntimeError("consumer broke")
+
+        h = remote.submit_generate(prompt(5, seed=6), max_new_tokens=16,
+                                   seed=6, on_token=bomb)
+        with pytest.raises(Exception):
+            h.result(timeout=120)
+        deadline = time.monotonic() + 30
+        while srv.cancels == cancels_before and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert srv.cancels > cancels_before
+
+
+# --------------------------------------------------------------------------
+# Delivery-race regressions: no token lost at a terminal, ever
+# --------------------------------------------------------------------------
+class TestDeliveryRaces:
+    def test_terminal_chunk_never_drops_trailing_tokens(self):
+        """Server-side read order regression: the engine may push its
+        last token(s) and resolve the future BETWEEN the long-poll's
+        two reads. Reading done-then-tokens guarantees a done=True
+        chunk carries the complete stream; the reverse order silently
+        dropped the tail."""
+        from concurrent.futures import Future
+
+        from deeplearning4j_tpu.serving.rpc import _OpState
+
+        class RacyHandle:
+            """tokens_so_far() finishes the stream AFTER computing its
+            snapshot — exactly the interleaving where the engine
+            resolves the future between the server's two reads."""
+
+            def __init__(self):
+                self.future = Future()
+                self.future.set_running_or_notify_cancel()
+                self._toks = [1, 2]
+                self.finish_reason = None
+                self._fired = False
+
+            def tokens_so_far(self):
+                snap = list(self._toks)
+                if not self._fired:
+                    self._fired = True
+                    self._toks.append(3)
+                    self.finish_reason = "max_tokens"
+                    self.future.set_result(list(self._toks))
+                return snap
+
+        local = LoopbackHost(0)
+        srv = HostRpcServer(local)
+        try:
+            srv._register(_OpState("op-racy", "generate",
+                                   handle=RacyHandle()))
+            got, done = [], False
+            for _ in range(4):
+                chunk = RpcStreamChunk.from_dict(srv._handle_stream(
+                    {"stream_id": "op-racy", "cursor": len(got),
+                     "wait_ms": 50}))
+                got.extend(chunk.tokens)
+                if chunk.done:
+                    done = True
+                    break
+            assert done
+            assert got == [1, 2, 3]      # the tail survived the race
+        finally:
+            srv.stop()
+
+    def test_hedge_terminal_cannot_outrun_inflight_leader_pushes(self):
+        """Supervisor delivery-atomicity regression: attempt A (leader)
+        is mid-push — stuck in a slow on_token — when attempt B's
+        successful terminal arrives. B's _finish must wait for A's
+        claimed tokens to actually reach the handle: claiming the
+        watermark first and pushing outside the lock let B snapshot a
+        truncated result()."""
+        from deeplearning4j_tpu.serving.cluster import (
+            _Attempt, _HedgedStream)
+        from deeplearning4j_tpu.serving.tracing import NULL_TRACE
+
+        class DummyStream:
+            cancelled = False
+
+            def cancel(self):
+                self.cancelled = True
+
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        fd = ClusterFrontDoor(d)
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_consumer(tok):
+            if tok == 2:
+                entered.set()
+                assert gate.wait(timeout=30)
+
+        sup = _HedgedStream(fd, np.asarray([7], np.int32),
+                            gen_kwargs={"on_token": slow_consumer},
+                            pinned=None, blocks_hint_max_new=4,
+                            timeout_ms=None, trace=NULL_TRACE,
+                            tenant_label="anon",
+                            t0=time.perf_counter())
+        a = _Attempt(DummyStream(), 0, 1)
+        b = _Attempt(DummyStream(), 1, 2)
+        sup.attempts = [a, b]
+
+        t_a = threading.Thread(
+            target=sup._deliver, args=(a, RpcStreamChunk(tokens=[1, 2, 3])),
+            daemon=True)
+        t_a.start()
+        assert entered.wait(timeout=30)   # A holds the lock, mid-push
+
+        done_b = threading.Event()
+
+        def b_finishes():
+            sup._deliver(b, RpcStreamChunk(tokens=[1, 2, 3], done=True,
+                                           finish_reason="max_tokens"),
+                         promote=True)
+            sup._finish_ok(b, "max_tokens")
+            done_b.set()
+
+        threading.Thread(target=b_finishes, daemon=True).start()
+        time.sleep(0.05)
+        # B must NOT have finished the handle while A's claimed tokens
+        # are still un-pushed
+        assert not sup.handle.future.done()
+        gate.set()
+        assert done_b.wait(timeout=30)
+        t_a.join(timeout=30)
+        assert sup.handle.result(timeout=30) == [1, 2, 3]
+
+    def test_backup_past_watermark_takes_leadership_mid_stream(self):
+        """Stalled-leader handoff: a backup attempt whose prefix is
+        PAST the delivered watermark starts streaming to the client
+        immediately — leadership must not stay pinned to a
+        stalled-but-alive attempt until the backup's terminal."""
+        from deeplearning4j_tpu.serving.cluster import (
+            _Attempt, _HedgedStream)
+        from deeplearning4j_tpu.serving.tracing import NULL_TRACE
+
+        class DummyStream:
+            def cancel(self):
+                pass
+
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        fd = ClusterFrontDoor(d)
+        sup = _HedgedStream(fd, np.asarray([7], np.int32),
+                            gen_kwargs={}, pinned=None,
+                            blocks_hint_max_new=4, timeout_ms=None,
+                            trace=NULL_TRACE, tenant_label="anon",
+                            t0=time.perf_counter())
+        stalled = _Attempt(DummyStream(), 0, 1)
+        backup = _Attempt(DummyStream(), 1, 2)
+        sup.attempts = [stalled, backup]
+        sup._deliver(stalled, RpcStreamChunk(tokens=[]))   # leader, stuck
+        assert sup._leader is stalled
+        sup._deliver(backup, RpcStreamChunk(tokens=[1, 2]))
+        # the backup out-ran the stalled leader: it leads and its
+        # tokens reached the client BEFORE any terminal
+        assert sup._leader is backup
+        assert sup.handle.tokens_so_far() == [1, 2]
+        assert not sup.handle.future.done()
+
+    def test_serves_never_blocks_on_the_network(self):
+        """serves() is called for every candidate on every route — it
+        must answer from the cached status (optimistically True before
+        any heartbeat) instead of fetching over a socket that may hang
+        for the whole timeout."""
+        remote = RemoteHost(9, "http://127.0.0.1:9", timeout_s=30.0)
+        t0 = time.perf_counter()
+        assert remote.serves("generate") is True
+        assert remote.serves("infer") is True
+        assert (time.perf_counter() - t0) < 0.5
+        with pytest.raises(ValueError):
+            remote.serves("teleport")
+
+
+# --------------------------------------------------------------------------
+# Seeded network chaos: the rpc.* fault points replay bit-for-bit
+# --------------------------------------------------------------------------
+@pytest.mark.chaos
+class TestRpcChaos:
+    def test_dispatch_drop_types_host_unavailable_and_chains(self):
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            with FaultPlan(seed=0).fail("rpc.dispatch", at=(0,)):
+                with pytest.raises(HostUnavailableError) as ei:
+                    remote.submit_infer(row())
+            assert isinstance(ei.value.__cause__, FaultInjectedError)
+            # the drop fired BEFORE the request left the client: the
+            # server never saw a submit, so no half-committed op state
+            assert srv.submits == 0
+            # the plan gone, the same request sails through
+            remote.submit_infer(row()).result(timeout=30)
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_dispatch_latency_spike_delays_but_delivers(self):
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            with FaultPlan(seed=0).delay("rpc.dispatch", 60.0, at=(0,)) as p:
+                t0 = time.perf_counter()
+                fut = remote.submit_infer(row())
+                took_ms = (time.perf_counter() - t0) * 1e3
+                fut.result(timeout=30)
+            assert took_ms >= 55.0
+            assert [e["kind"] for e in p.fired("rpc.dispatch")] == ["delay"]
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_response_poison_types_rpc_error(self):
+        """A malformed/mid-upgrade payload (poisoned AFTER decode) is an
+        rpc_error — the host answered, with garbage — not a dead host."""
+        remote, srv, local, eng, adapter = make_rpc_infer_host()
+        try:
+            with FaultPlan(seed=0).poison(
+                    "rpc.response", lambda raw: {"wat": 1}, at=(0,)):
+                with pytest.raises(RpcError) as ei:
+                    remote.submit_infer(row())
+            assert ei.value.reason == "rpc_error"
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_poisoned_null_tokens_chunk_fails_typed_not_hangs(
+            self, tiny_model):
+        """A poison rule nulling a chunk's tokens (the advertised
+        malformed/mid-upgrade model) must surface as typed rpc_error on
+        the handle — iterating None in the bridge thread would kill it
+        and hang the caller forever."""
+        from deeplearning4j_tpu.serving import GenerationEngine
+
+        cfg, params = tiny_model
+        g = GenerationEngine(params, cfg, slots=2, max_len=48, name="po-g")
+        local = LoopbackHost(0, generation=g)
+        srv = HostRpcServer(local)
+        remote = RemoteHost(0, srv.url, poll_wait_ms=25.0)
+        try:
+            remote.submit_generate(prompt(4), max_new_tokens=1,
+                                   seed=1).result(timeout=120)
+            # rpc.response index 0 of this plan = the submit POST's
+            # payload; index 1 = the first stream long-poll's chunk
+            with FaultPlan(seed=0).poison(
+                    "rpc.response",
+                    lambda raw: dict(raw, tokens=None), at=(1,)):
+                h = remote.submit_generate(prompt(4), max_new_tokens=4,
+                                           seed=2)
+                with pytest.raises(RpcError) as ei:
+                    h.result(timeout=120)
+            assert ei.value.reason == "rpc_error"
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_stream_drop_fails_bridged_handle_typed(self, tiny_model):
+        from deeplearning4j_tpu.serving import GenerationEngine
+
+        cfg, params = tiny_model
+        g = GenerationEngine(params, cfg, slots=2, max_len=48, name="ch-g")
+        local = LoopbackHost(0, generation=g)
+        srv = HostRpcServer(local)
+        remote = RemoteHost(0, srv.url, poll_wait_ms=25.0)
+        try:
+            # warm the executables OUTSIDE the plan so poll indices are
+            # stable, then drop the stream's first long-poll
+            remote.submit_generate(prompt(4), max_new_tokens=1,
+                                   seed=1).result(timeout=120)
+            with FaultPlan(seed=0).fail("rpc.stream", at=(0,)):
+                h = remote.submit_generate(prompt(4), max_new_tokens=4,
+                                           seed=2)
+                with pytest.raises(HostUnavailableError):
+                    h.result(timeout=120)
+        finally:
+            stop_rpc_host(srv, local)
+
+    def test_seeded_plan_replays_bit_for_bit(self):
+        """The reproducibility contract extended to the network tier:
+        two identical runs of one seeded rate-based plan over identical
+        RPC traffic fire on identical call indices."""
+        def run_once():
+            remote, srv, local, eng, adapter = make_rpc_infer_host()
+            try:
+                plan = FaultPlan(seed=42).fail("rpc.dispatch", rate=0.4)
+                fired = []
+                with plan:
+                    for _ in range(12):
+                        try:
+                            remote.submit_infer(row()).result(timeout=30)
+                        except HostUnavailableError:
+                            pass
+                    fired = [(e["point"], e["index"], e["kind"])
+                             for e in plan.fired()]
+                return fired
+            finally:
+                stop_rpc_host(srv, local)
+
+        first, second = run_once(), run_once()
+        assert first == second
+        assert any(kind == "fail" for _, _, kind in first)
+
+
+# --------------------------------------------------------------------------
+# THE chaos acceptance test: hedged re-dispatch survives a host kill
+# --------------------------------------------------------------------------
+@pytest.mark.chaos
+class TestHedgedRedispatch:
+    def _kill(self, servers, locals_, victim):
+        servers[victim].stop()
+        locals_[victim].shutdown(wait=False)
+
+    def test_stream_survives_host_kill_mid_stream(self, tiny_model):
+        """ISSUE 12 acceptance: a generation stream routed over HTTP to
+        host A survives A being KILLED mid-stream. The hedged
+        re-dispatch lands it on host B with the same seeded request,
+        the client handle observes exactly one terminal, no token is
+        delivered twice (the result is bitwise the stream an unkilled
+        host produces), and the trace carries cluster.route ->
+        rpc.dispatch -> cluster.bounce -> terminal in monotonic order."""
+        tracer = Tracer(sample_rate=1.0)
+        d, fd, remotes, servers, locals_, engines = make_rpc_gen_fleet(
+            tiny_model, 2, tracer=tracer,
+            hedge=HedgePolicy(hedge_after_ms=None, max_attempts=3,
+                              poll_wait_ms=25.0))
+        try:
+            p = prompt(5, seed=3)
+            # ground truth: the same seeded stream on an unkilled engine
+            want = engines[1].submit(p, max_new_tokens=24,
+                                     seed=7).result(timeout=120)
+
+            seen, killed = [], threading.Event()
+
+            def on_token(t):
+                seen.append(int(t))
+                if len(seen) == 4:
+                    killed.set()
+
+            h = fd.submit_generate(p, max_new_tokens=24, seed=7,
+                                   on_token=on_token)
+            assert killed.wait(timeout=120), "stream never produced tokens"
+            victim = 0 if fd.routed_by_host.get("h0") else 1
+            self._kill(servers, locals_, victim)
+
+            res = h.result(timeout=120)
+            # no token delivered twice, none skipped, bitwise the
+            # unkilled stream — and exactly one terminal on the handle
+            assert res == want and len(res) == 24
+            assert seen == res
+            assert h.future.done() and h.finish_reason is not None
+            assert fd.hedges.get("redispatch") >= 1
+            routed = fd.routed_by_host.to_dict()
+            assert routed.get(f"h{victim}") >= 1
+            assert routed.get(f"h{1 - victim}") >= 1
+            # fleet SLO saw ONE outcome for the whole hedged ensemble
+            assert sum(fd.metrics.tenant_served.to_dict().values()) == 1
+            assert fd.metrics.rejections_by_reason.to_dict() == {}
+
+            # the trace: route -> dispatch -> bounce -> re-route ->
+            # re-dispatch -> retire, timestamps monotonic
+            traces = [t for t in tracer.traces()
+                      if t.kind == "cluster.generate" and t.reason == "ok"]
+            assert traces, [t.reason for t in tracer.traces()]
+            tr = traces[-1]
+            names = tr.event_names()
+            for needed in ("cluster.route", "rpc.dispatch",
+                           "cluster.bounce", "retire"):
+                assert needed in names, names
+            assert (names.index("cluster.route")
+                    < names.index("rpc.dispatch")
+                    < names.index("cluster.bounce")
+                    < len(names) - 1 == names.index("retire"))
+            stamps = [t for _, t, _ in tr.events]
+            assert stamps == sorted(stamps)
+            # the bounce names the victim and its loss class
+            bounce = [a for n, _, a in tr.events
+                      if n == "cluster.bounce"][0]
+            assert bounce["host"] == victim
+            assert bounce["reason"] == "host_unavailable"
+        finally:
+            stop_fleet(servers, locals_)
+
+    def test_no_candidate_sheds_typed_host_unavailable(self, tiny_model):
+        """The other acceptance arm: when no candidate fits the
+        re-dispatch, the stream sheds typed ``host_unavailable`` —
+        exactly one terminal, chained to the loss that killed the last
+        attempt, counted once in the front door's SLO."""
+        tracer = Tracer(sample_rate=1.0)
+        d, fd, remotes, servers, locals_, engines = make_rpc_gen_fleet(
+            tiny_model, 1, tracer=tracer,
+            hedge=HedgePolicy(hedge_after_ms=None, max_attempts=3,
+                              poll_wait_ms=25.0))
+        try:
+            seen, killed = [], threading.Event()
+
+            def on_token(t):
+                seen.append(int(t))
+                if len(seen) == 2:
+                    killed.set()
+
+            h = fd.submit_generate(prompt(5, seed=3), max_new_tokens=24,
+                                   seed=7, on_token=on_token)
+            assert killed.wait(timeout=120)
+            self._kill(servers, locals_, 0)
+            with pytest.raises(HostUnavailableError) as ei:
+                h.result(timeout=120)
+            assert ei.value.reason == "host_unavailable"
+            assert fd.metrics.rejections_by_reason.get(
+                "host_unavailable") == 1
+            shed = [t for t in tracer.traces()
+                    if t.reason == "host_unavailable"]
+            assert shed and "cluster.shed" in shed[0].event_names()
+        finally:
+            stop_fleet(servers, locals_)
+
+
+# --------------------------------------------------------------------------
+# Timeout hedging: stalled streams race a backup, first terminal wins
+# --------------------------------------------------------------------------
+class _StubStream:
+    def __init__(self, host, sid):
+        self.host = host
+        self.stream_id = sid
+        self.cancelled = False
+
+    def poll(self, cursor, wait_ms):
+        return self.host._poll(self, cursor, wait_ms)
+
+    def cancel(self):
+        self.cancelled = True
+        self.host.cancels += 1
+
+
+class _StubHost:
+    """HostHandle-shaped stub with an ``open_stream`` surface: ``plan``
+    maps poll index -> chunk so tests script exact stream behavior
+    (stall forever / deliver-and-finish) without real engines."""
+
+    def __init__(self, host_id, tokens=None, stall=False, free_slots=4,
+                 first_dispatch_delay_s=0.0):
+        self.host_id = host_id
+        self.name = f"stub{host_id}"
+        self.tokens = tokens or []
+        self.stall = stall
+        self.free_slots = free_slots
+        self.first_dispatch_delay_s = first_dispatch_delay_s
+        self.opened = 0
+        self.cancels = 0
+        self.streams = []
+
+    def serves(self, kind):
+        return kind == "generate"
+
+    def status(self):
+        return HostStatus(host_id=self.host_id, has_generate=True,
+                          slots=8, free_slots=self.free_slots,
+                          kv_blocks_total=1024, kv_blocks_free=1024,
+                          kv_blocks_usable=1024, block_size=16,
+                          queue_depth=0, seq=1)
+
+    def open_stream(self, prompt, **kw):
+        self.opened += 1
+        if self.opened == 1 and self.first_dispatch_delay_s:
+            time.sleep(self.first_dispatch_delay_s)
+        s = _StubStream(self, f"s{self.host_id}-{self.opened}")
+        self.streams.append(s)
+        return s
+
+    def _poll(self, stream, cursor, wait_ms):
+        if self.stall:
+            time.sleep(wait_ms / 1e3)
+            return RpcStreamChunk(stream_id=stream.stream_id, cursor=cursor,
+                                  tokens=[], done=False)
+        toks = self.tokens[cursor:]
+        return RpcStreamChunk(stream_id=stream.stream_id, cursor=cursor,
+                              tokens=toks, done=True,
+                              finish_reason="max_tokens")
+
+    def shutdown(self, wait=True):
+        pass
+
+
+class TestTimeoutHedge:
+    def _fleet(self, hosts):
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        tr = LoopbackTransport(d)
+        for h in hosts:
+            d.join(h)
+            tr.publish(h.status())
+        return d
+
+    def test_stalled_stream_races_a_backup_first_terminal_wins(self):
+        """Tail hedge: host A accepts the stream then never produces a
+        token; after ``hedge_after_ms`` the monitor opens a backup on
+        host B, B's terminal wins, A's attempt is cancelled server-side,
+        and every token reaches the client exactly once."""
+        stall = _StubHost(0, stall=True, free_slots=8)   # routed first
+        good = _StubHost(1, tokens=[11, 12, 13], free_slots=2)
+        d = self._fleet([stall, good])
+        fd = ClusterFrontDoor(d, hedge=HedgePolicy(
+            hedge_after_ms=60.0, max_attempts=2, poll_wait_ms=20.0))
+        seen = []
+        h = fd.submit_generate(np.asarray([1, 2, 3], np.int32),
+                               max_new_tokens=3, on_token=seen.append)
+        res = h.result(timeout=30)
+        assert res == [11, 12, 13] and seen == res
+        assert stall.opened == 1 and good.opened == 1
+        assert fd.hedges.get("timeout") == 1
+        # the loser was cancelled server-side (slot + KV blocks back)
+        deadline = time.monotonic() + 10
+        while not stall.streams[0].cancelled \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert stall.streams[0].cancelled
+        # ONE SLO outcome for the whole hedged ensemble
+        assert sum(fd.metrics.tenant_served.to_dict().values()) == 1
+
+    def test_stalled_dispatch_is_hedged_onto_another_host(self):
+        """A latency-spiked DISPATCH (the open_stream POST itself hangs,
+        so no attempt is live yet) must hedge exactly like a stalled
+        stream — and the backup must route to a DIFFERENT host: the
+        stalling dispatch's host rides the supervisor's in-flight set,
+        so a genuinely slow host cannot eat the whole attempt budget.
+        This is the bench's 5% rpc.dispatch spike scenario."""
+        slow = _StubHost(0, tokens=[21, 22], free_slots=8,
+                         first_dispatch_delay_s=2.0)   # routed first
+        good = _StubHost(1, tokens=[21, 22], free_slots=2)
+        d = self._fleet([slow, good])
+        fd = ClusterFrontDoor(d, hedge=HedgePolicy(
+            hedge_after_ms=60.0, max_attempts=2, poll_wait_ms=20.0))
+        t0 = time.perf_counter()
+        h = fd.submit_generate(np.asarray([1, 2], np.int32),
+                               max_new_tokens=2)
+        res = h.result(timeout=30)
+        elapsed = time.perf_counter() - t0
+        assert res == [21, 22]
+        assert fd.hedges.get("timeout") == 1
+        # the backup went to the healthy host and won long before the
+        # spiked dispatch returned
+        assert good.opened == 1
+        assert elapsed < 1.5
+        assert sum(fd.metrics.tenant_served.to_dict().values()) == 1
+
+    def test_failed_backup_route_never_sheds_while_dispatch_pending(self):
+        """Single-host fleet with a stalled dispatch: the backup's
+        route finds no candidate (the stalling host is in-flight), but
+        that must NOT shed a terminal — the pending dispatch can still
+        succeed, and the stream completes when it lands."""
+        slow = _StubHost(0, tokens=[31, 32], first_dispatch_delay_s=0.4)
+        d = self._fleet([slow])
+        fd = ClusterFrontDoor(d, hedge=HedgePolicy(
+            hedge_after_ms=60.0, max_attempts=3, poll_wait_ms=20.0))
+        h = fd.submit_generate(np.asarray([1, 2], np.int32),
+                               max_new_tokens=2)
+        assert h.result(timeout=30) == [31, 32]
+        assert fd.metrics.rejections_by_reason.to_dict() == {}
+
+    def test_no_hedge_before_stall_window(self):
+        fast = _StubHost(0, tokens=[5], free_slots=8)
+        spare = _StubHost(1, tokens=[5], free_slots=2)
+        d = self._fleet([fast, spare])
+        fd = ClusterFrontDoor(d, hedge=HedgePolicy(
+            hedge_after_ms=5_000.0, max_attempts=2, poll_wait_ms=20.0))
+        assert fd.submit_generate(np.asarray([1, 2], np.int32),
+                                  max_new_tokens=1).result(timeout=30) == [5]
+        assert spare.opened == 0 and fd.hedges.to_dict() == {}
+
+    def test_redispatch_to_loopback_host_folds_out_instead_of_hanging(self):
+        """Mixed fleet: a re-dispatch routed to a host WITHOUT an
+        open_stream surface (a LoopbackHost) must fold that candidate
+        out and continue — an AttributeError would kill the attempt
+        thread and leave the caller's handle hanging forever."""
+        class DyingHost(_StubHost):
+            def _poll(self, stream, cursor, wait_ms):
+                raise HostUnavailableError("host died", host=self.host_id)
+
+        class LoopbackishHost:
+            """Serves generate but has no attempt-scoped RPC surface."""
+
+            host_id = 1
+            name = "lb1"
+
+            def serves(self, kind):
+                return kind == "generate"
+
+            def status(self):
+                return HostStatus(host_id=1, has_generate=True, slots=8,
+                                  free_slots=2, kv_blocks_total=1024,
+                                  kv_blocks_free=1024,
+                                  kv_blocks_usable=1024, block_size=16,
+                                  seq=1)
+
+            def shutdown(self, wait=True):
+                pass
+
+        dying = DyingHost(0, free_slots=8)      # routed first
+        d = self._fleet([dying, LoopbackishHost()])
+        fd = ClusterFrontDoor(d, hedge=HedgePolicy(
+            hedge_after_ms=None, max_attempts=3, poll_wait_ms=20.0))
+        h = fd.submit_generate(np.asarray([1, 2], np.int32),
+                               max_new_tokens=2)
+        with pytest.raises(HostUnavailableError):   # typed, not a hang
+            h.result(timeout=30)
+        assert fd.metrics.rejections_by_reason.get(
+            "host_unavailable") == 1
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            HedgePolicy(hedge_after_ms=0.0)
+        with pytest.raises(ValueError):
+            HedgePolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            HedgePolicy(poll_wait_ms=0.0)
+
+
+# --------------------------------------------------------------------------
+# Graceful drain (acceptance): zero sheds, pins released, clean leave
+# --------------------------------------------------------------------------
+class TestGracefulDrain:
+    def test_drain_host_with_resident_streams_sheds_nothing(
+            self, tiny_model):
+        """ISSUE 12 acceptance: drain() on a host with RESIDENT streams
+        admits nothing new, finishes every resident stream, releases
+        its prefix pins, leaves the directory — and the front door
+        sheds ZERO requests during the drain window."""
+        d, fd, remotes, servers, locals_, engines = make_rpc_gen_fleet(
+            tiny_model, 2, hedge=HedgePolicy(hedge_after_ms=None))
+        try:
+            victim, survivor = 0, 1
+            # a pinned prefix + two resident streams on the victim
+            remotes[victim].register_prefix(prompt(8, seed=5),
+                                            prefix_id="sys", timeout=120)
+            assert "sys" in engines[victim]._prefixes
+            seated = [threading.Event() for _ in range(2)]
+            residents = [fd.submit_generate(prompt(4, seed=i),
+                                            max_new_tokens=12, seed=i,
+                                            host=victim,
+                                            on_token=lambda _t, e=seated[i]:
+                                            e.set())
+                         for i in range(2)]
+            # RESIDENT means resident: both streams must be decoding on
+            # the victim before the drain starts (dispatch through the
+            # hedging supervisor is asynchronous)
+            for e in seated:
+                assert e.wait(timeout=120)
+
+            done = threading.Event()
+            drained = []
+
+            def run_drain():
+                drained.append(drain_host(d, victim, timeout=120))
+                done.set()
+
+            threading.Thread(target=run_drain, daemon=True).start()
+            # the drain window: new traffic keeps landing, all on the
+            # survivor, none shed
+            during = [fd.submit_generate(prompt(4, seed=10 + i),
+                                         max_new_tokens=4, seed=i)
+                      for i in range(3)]
+            assert done.wait(timeout=120) and drained == [True]
+
+            for i, h in enumerate(residents):   # residents finished
+                assert len(h.result(timeout=120)) == 12
+            for h in during:                    # drain-window traffic ok
+                assert len(h.result(timeout=120)) == 4
+            # ZERO sheds of any kind during the window
+            assert fd.metrics.rejections_by_reason.to_dict() == {}
+            # pins released, directory left
+            assert engines[victim]._prefixes == {}
+            assert d.handle(victim) is None
+            assert str(victim) not in d.api_snapshot()["hosts"]
+            # every during-stream routed to the survivor
+            assert fd.routed_by_host.get(f"h{survivor}") >= 3
+            # the drained host itself now refuses direct submits, typed
+            with pytest.raises(HostDrainingError):
+                remotes[victim].submit_generate(prompt(3),
+                                                max_new_tokens=1)
+        finally:
+            stop_fleet(servers, locals_)
+
+    def test_mark_draining_excludes_instantly_before_any_heartbeat(self):
+        """The zero-shed guarantee's load-bearing half: the coordinator
+        mark excludes the host from routing the INSTANT the drain is
+        initiated — no wait for the host's next beat."""
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        e0, e1 = MlpAdapter(), MlpAdapter()
+        engines = [InferenceEngine(e0, max_batch_size=8, max_wait_ms=0.0,
+                                   name="dr-e0"),
+                   InferenceEngine(e1, max_batch_size=8, max_wait_ms=0.0,
+                                   name="dr-e1")]
+        hosts = [LoopbackHost(i, engine=engines[i]) for i in range(2)]
+        try:
+            tr = LoopbackTransport(d)
+            for h in hosts:
+                d.join(h)
+                tr.publish(h.status())
+            fd = ClusterFrontDoor(d)
+            assert d.mark_draining(0) is True
+            assert d.is_draining(0) and not d.is_draining(1)
+            for _ in range(4):
+                fd.output(row())
+            assert fd.routed_by_host.to_dict() == {"h1": 4.0}
+            assert fd.metrics.rejections_by_reason.to_dict() == {}
+            assert d.mark_draining(99) is False
+        finally:
+            for h in hosts:
+                h.shutdown()
+
+    def test_draining_flag_rides_the_heartbeat(self):
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        eng = InferenceEngine(MlpAdapter(), max_batch_size=8,
+                              max_wait_ms=0.0, name="hb-e0")
+        h = LoopbackHost(0, engine=eng)
+        try:
+            d.join(h)
+            pump = HeartbeatPump(h, LoopbackTransport(d), jitter=0.0)
+            pump.pump_once()
+            assert not d.is_draining(0)
+            h.drain(timeout=30)       # host learns first, no mark
+            pump.pump_once()
+            assert d.is_draining(0)   # the beat carried the flag
+            snap = d.api_snapshot()
+            assert snap["hosts"]["0"]["draining"] is True
+            assert snap["fleet"]["draining"] == 1
+        finally:
+            h.shutdown()
+
+    def test_drain_timeout_returns_false_and_stays_draining(self):
+        eng = InferenceEngine(MlpAdapter(delay_s=0.2), max_batch_size=8,
+                              max_wait_ms=0.0, name="to-e0")
+        h = LoopbackHost(0, engine=eng)
+        try:
+            futs = [eng.submit(row()) for _ in range(8)]
+            assert h.drain(timeout=0.01) is False
+            assert h.draining      # admission stays closed
+            with pytest.raises(HostDrainingError):
+                h.submit_infer(row())
+            for f in futs:
+                f.result(timeout=30)
+            assert h.drain(timeout=30) is True     # retry succeeds
+        finally:
+            h.shutdown()
+
+    def test_leave_forgets_prefix_affinity(self):
+        """A departed host's prefix-affinity entries must die with it:
+        a stale entry would pin every future submit naming that prefix
+        at a host that no longer exists — a permanent typed shed after
+        a zero-shed scale-down. The caller gets the explicit
+        re-register KeyError instead."""
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        engines = [InferenceEngine(MlpAdapter(), max_batch_size=8,
+                                   max_wait_ms=0.0, name=f"pa-e{i}")
+                   for i in range(2)]
+        hosts = [LoopbackHost(i, engine=engines[i]) for i in range(2)]
+        try:
+            tr = LoopbackTransport(d)
+            for h in hosts:
+                d.join(h)
+                tr.publish(h.status())
+            fd = ClusterFrontDoor(d)
+            with fd._affinity_lock:        # a prefix homed on host 1
+                fd._prefix_hosts["sys"] = 1
+                fd._prefix_hosts["other"] = 0
+            d.leave(1)
+            assert fd.prefix_host("sys") is None
+            assert fd.prefix_host("other") == 0    # untouched
+            with pytest.raises(KeyError):          # re-register, not shed
+                fd.submit_generate(np.asarray([1, 2], np.int32),
+                                   prefix_id="sys")
+        finally:
+            for h in hosts:
+                h.shutdown()
+
+    def test_rejoin_undrains(self):
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        eng = InferenceEngine(MlpAdapter(), max_batch_size=8,
+                              max_wait_ms=0.0, name="rj-e0")
+        h = LoopbackHost(0, engine=eng)
+        try:
+            d.join(h)
+            d.mark_draining(0)
+            assert d.is_draining(0)
+            d.join(h)                  # a re-join un-drains
+            assert not d.is_draining(0)
+            d.mark_draining(0)
+            d.leave(0)                 # so does leaving
+            d.join(h)
+            assert not d.is_draining(0)
+        finally:
+            h.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Heartbeat jitter: seeded +-10% decorrelates a restarted fleet
+# --------------------------------------------------------------------------
+class TestHeartbeatJitter:
+    def _pump(self, host_id=0, **kw):
+        eng = InferenceEngine(MlpAdapter(), max_batch_size=8,
+                              max_wait_ms=0.0, name=f"jit-e{host_id}")
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        h = LoopbackHost(host_id, engine=eng)
+        d.join(h)
+        return h, HeartbeatPump(h, LoopbackTransport(d), interval_s=0.5,
+                                **kw)
+
+    def test_schedule_is_seeded_and_deterministic(self):
+        """Fake-clock style: the whole beat schedule is derived without
+        sleeping — two pumps with one seed produce the identical
+        schedule, so a chaos replay's heartbeat timing is bit-for-bit."""
+        h1, p1 = self._pump(0, seed=7)
+        h2, p2 = self._pump(0, seed=7)
+        try:
+            s1 = [p1.next_interval_s() for _ in range(64)]
+            s2 = [p2.next_interval_s() for _ in range(64)]
+            assert s1 == s2
+            assert all(0.45 <= x <= 0.55 for x in s1)      # +-10% of 0.5
+            assert len(set(round(x, 9) for x in s1)) > 32  # actually jitters
+        finally:
+            h1.shutdown()
+            h2.shutdown()
+
+    def test_restarted_fleet_decorrelates(self):
+        """The thundering-herd fix: hosts restarted at t=0 with the
+        default per-host seed drift apart — cumulative beat times
+        diverge instead of hitting the coordinator in lockstep forever."""
+        hosts, pumps = zip(*[self._pump(i) for i in range(4)])
+        try:
+            horizons = []
+            for p in pumps:
+                t, sched = 0.0, []
+                for _ in range(32):
+                    t += p.next_interval_s()
+                    sched.append(round(t, 9))
+                horizons.append(tuple(sched))
+            assert len(set(horizons)) == 4        # no two hosts in lockstep
+            # and by beat 32 no pair is within one pump's own spread
+            finals = sorted(h[-1] for h in horizons)
+            assert finals[-1] - finals[0] > 0.05
+        finally:
+            for h in hosts:
+                h.shutdown()
+
+    def test_zero_jitter_is_exact_and_validation_guards(self):
+        h, p = self._pump(0, jitter=0.0)
+        try:
+            assert [p.next_interval_s() for _ in range(4)] == [0.5] * 4
+            eng = InferenceEngine(MlpAdapter(), max_batch_size=8,
+                                  max_wait_ms=0.0, name="jv-e")
+            d = ClusterDirectory(heartbeat_timeout_s=30.0)
+            hh = LoopbackHost(1, engine=eng)
+            try:
+                with pytest.raises(ValueError):
+                    HeartbeatPump(hh, LoopbackTransport(d), jitter=1.0)
+                with pytest.raises(ValueError):
+                    HeartbeatPump(hh, LoopbackTransport(d), jitter=-0.1)
+            finally:
+                hh.shutdown()
+        finally:
+            h.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Elasticity: the join/drain decision loop over /api/cluster payloads
+# --------------------------------------------------------------------------
+def snap(free=10, slots=20, alive=3, draining=0, sheds=0, hosts=None):
+    """A minimal /api/cluster-shaped payload for the planner."""
+    return {
+        "fleet": {"slots": slots, "free_slots": free, "alive": alive,
+                  "draining": draining, "hosts": alive},
+        "hosts": hosts or {},
+        "front_doors": [{"rejections_by_reason":
+                         {"cluster_capacity": sheds} if sheds else {}}],
+    }
+
+
+class TestElasticityPlanner:
+    def test_first_observation_never_acts(self):
+        pl = ElasticityPlanner(ElasticityPolicy(trend_windows=1))
+        assert pl.observe(snap(free=0))["action"] == "hold"
+
+    def test_sustained_pressure_joins_single_tick_does_not(self):
+        pl = ElasticityPlanner(ElasticityPolicy(trend_windows=3))
+        pl.observe(snap())
+        assert pl.observe(snap(free=1))["action"] == "hold"
+        assert pl.observe(snap(free=1))["action"] == "hold"
+        d = pl.observe(snap(free=1))
+        assert d["action"] == "join" and "pressure" in d["reason"]
+        # streak resets after acting
+        assert pl.observe(snap(free=1))["action"] == "hold"
+
+    def test_capacity_sheds_count_as_pressure(self):
+        pl = ElasticityPlanner(ElasticityPolicy(trend_windows=2))
+        pl.observe(snap(sheds=0))
+        assert pl.observe(snap(sheds=3))["capacity_sheds_delta"] == 3
+        assert pl.observe(snap(sheds=6))["action"] == "join"
+
+    def test_sustained_slack_drains_least_loaded(self):
+        hosts = {
+            "0": {"alive": True, "draining": False,
+                  "status": {"free_slots": 2, "kv_blocks_free": 0}},
+            "1": {"alive": True, "draining": False,
+                  "status": {"free_slots": 9, "kv_blocks_free": 5}},
+            "2": {"alive": True, "draining": False,
+                  "status": {"free_slots": 9, "kv_blocks_free": 3}},
+        }
+        pl = ElasticityPlanner(ElasticityPolicy(trend_windows=2,
+                                                min_hosts=1))
+        pl.observe(snap(free=18, hosts=hosts))
+        pl.observe(snap(free=18, hosts=hosts))
+        d = pl.observe(snap(free=18, hosts=hosts))
+        assert d["action"] == "drain"
+        assert d["host"] == 1     # most free slots, then most free blocks
+        # a draining host is never the candidate
+        hosts["1"]["draining"] = True
+
+    def test_holds_at_min_hosts_and_while_draining(self):
+        pl = ElasticityPlanner(ElasticityPolicy(trend_windows=1,
+                                                min_hosts=3))
+        pl.observe(snap(free=20, alive=3))
+        assert pl.observe(snap(free=20, alive=3))["action"] == "hold"
+        pl2 = ElasticityPlanner(ElasticityPolicy(trend_windows=1))
+        pl2.observe(snap())
+        d = pl2.observe(snap(free=20, draining=1))
+        assert d["action"] == "hold" and "in progress" in d["reason"]
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            ElasticityPolicy(low_free_slot_frac=0.7,
+                             high_free_slot_frac=0.6)
+        with pytest.raises(ValueError):
+            ElasticityPolicy(trend_windows=0)
+        with pytest.raises(ValueError):
+            ElasticityPolicy(min_hosts=0)
+
+
+class TestElasticityLoop:
+    def test_drain_decision_shrinks_a_live_fleet(self):
+        """End to end: sustained slack -> the loop drains the
+        least-loaded host of a REAL 2-host fleet, which leaves the
+        directory; the survivor keeps serving. (The slack snapshots are
+        scripted — infer-only hosts report no slot gauge — but the
+        drain action runs against the live directory.)"""
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        engines = [InferenceEngine(MlpAdapter(), max_batch_size=8,
+                                   max_wait_ms=0.0, name=f"el-e{i}")
+                   for i in range(2)]
+        hosts = [LoopbackHost(i, engine=engines[i]) for i in range(2)]
+        try:
+            tr = LoopbackTransport(d)
+            for h in hosts:
+                d.join(h)
+                tr.publish(h.status())
+            slack = snap(free=18, slots=20, alive=2, hosts={
+                "0": {"alive": True, "draining": False,
+                      "status": {"free_slots": 2, "kv_blocks_free": 0}},
+                "1": {"alive": True, "draining": False,
+                      "status": {"free_slots": 9, "kv_blocks_free": 5}},
+            })
+            loop = ElasticityLoop(
+                d, planner=ElasticityPlanner(
+                    ElasticityPolicy(trend_windows=1, min_hosts=1)),
+                source=lambda: slack, drain_timeout_s=30.0)
+            assert loop.step()["action"] == "hold"   # first never acts
+            decision = loop.step()
+            assert decision["action"] == "drain"
+            gone = decision["host"]
+            assert gone == 1                    # the least-loaded host
+            assert d.handle(gone) is None       # really left the fleet
+            assert hosts[gone].draining
+            fd = ClusterFrontDoor(d)
+            fd.output(row())        # survivor still serves
+            assert fd.routed_by_host.get(f"h{1 - gone}") == 1
+        finally:
+            for h in hosts:
+                h.shutdown()
+
+    def test_join_decision_invokes_the_deployer_hook(self):
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        feed = [snap(), snap(free=0), snap(free=0)]
+        joined = []
+        loop = ElasticityLoop(
+            d, planner=ElasticityPlanner(ElasticityPolicy(trend_windows=2)),
+            source=lambda: feed.pop(0), on_join=joined.append)
+        loop.step()
+        loop.step()
+        assert joined == []
+        loop.step()
+        assert len(joined) == 1 and joined[0]["action"] == "join"
+
+    def test_stuck_drain_is_retried_not_held_forever(self):
+        """A drain that timed out mid-flight (host still marked
+        draining, admission closed) must not wedge the loop: the hold
+        decision names the draining host and step() keeps driving the
+        drain to completion instead of holding forever."""
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        engines = [InferenceEngine(MlpAdapter(), max_batch_size=8,
+                                   max_wait_ms=0.0, name=f"sd-e{i}")
+                   for i in range(2)]
+        hosts = [LoopbackHost(i, engine=engines[i]) for i in range(2)]
+        try:
+            tr = LoopbackTransport(d)
+            for h in hosts:
+                d.join(h)
+                tr.publish(h.status())
+            d.mark_draining(1)     # a prior drain attempt timed out here
+            loop = ElasticityLoop(d, drain_timeout_s=30.0)
+            decision = loop.step()
+            assert decision["action"] == "hold"
+            assert decision["draining_host"] == 1
+            # the retry completed the drain: the host left the fleet
+            assert d.handle(1) is None
+            assert hosts[1].draining
+        finally:
+            for h in hosts:
+                h.shutdown()
+
+    def test_drain_decision_for_vanished_host_is_skipped(self):
+        """A stale snapshot can name a drain candidate that left the
+        fleet between observe and apply — step() must skip it, not
+        KeyError out of the caller."""
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        slack = snap(free=18, slots=20, alive=2, hosts={
+            "5": {"alive": True, "draining": False,
+                  "status": {"free_slots": 9, "kv_blocks_free": 5}},
+        })
+        loop = ElasticityLoop(
+            d, planner=ElasticityPlanner(
+                ElasticityPolicy(trend_windows=1, min_hosts=1)),
+            source=lambda: slack)
+        loop.step()
+        decision = loop.step()     # picks host 5 — which never joined
+        assert decision["action"] == "drain" and decision["host"] == 5
+
+    def test_jittered_schedule_and_validation(self):
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        loop = ElasticityLoop(d, interval_s=5.0, jitter=0.1, seed=3)
+        sched = [loop.next_interval_s() for _ in range(16)]
+        assert all(4.5 <= s <= 5.5 for s in sched)
+        assert ElasticityLoop(d, interval_s=5.0, jitter=0.1,
+                              seed=3).next_interval_s() == sched[0]
+        with pytest.raises(ValueError):
+            ElasticityLoop(d, interval_s=0.0)
+        with pytest.raises(ValueError):
+            ElasticityLoop(d, jitter=2.0)
+
+    def test_api_cluster_carries_drain_states_and_decision(self):
+        """/api/cluster end to end: per-host drain flags, the fleet
+        draining count, the front door's hedge mix, and the watching
+        loop's latest decision all ride the one payload."""
+        from deeplearning4j_tpu.ui import UIServer
+
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        engines = [InferenceEngine(MlpAdapter(), max_batch_size=8,
+                                   max_wait_ms=0.0, name=f"api-e{i}")
+                   for i in range(2)]
+        hosts = [LoopbackHost(i, engine=engines[i]) for i in range(2)]
+        server = UIServer(port=0)
+        try:
+            tr = LoopbackTransport(d)
+            for h in hosts:
+                d.join(h)
+                tr.publish(h.status())
+            fd = ClusterFrontDoor(d)
+            fd.output(row())
+            loop = ElasticityLoop(d)
+            loop.step()          # decision recorded while nothing drains
+            d.mark_draining(1)   # (stepping after the mark would RETRY
+            #                      the drain and complete it — see
+            #                      test_stuck_drain_is_retried)
+            with urllib.request.urlopen(server.url + "api/cluster",
+                                        timeout=10) as r:
+                payload = json.loads(r.read().decode())
+            ours = [p for p in payload if p["fleet"]["hosts"] == 2
+                    and p["fleet"].get("draining") == 1
+                    and p.get("elasticity")]
+            assert ours, payload
+            got = ours[-1]
+            assert got["hosts"]["1"]["draining"] is True
+            assert got["hosts"]["0"]["draining"] is False
+            assert "hedges" in got["front_doors"][0]
+            assert got["elasticity"]["action"] in ("hold", "join", "drain")
+        finally:
+            server.stop()
+            for h in hosts:
+                h.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Taxonomy: the two new reasons are registered exactly once
+# --------------------------------------------------------------------------
+class TestTaxonomy:
+    @pytest.mark.parametrize("reason", ["host_draining", "rpc_error"])
+    def test_new_terminal_reasons_exactly_once(self, reason):
+        assert TERMINAL_REASONS.count(reason) == 1
+
+    def test_typed_errors_carry_registered_reasons(self):
+        assert HostDrainingError("x").reason == "host_draining"
+        assert RpcError("x").reason == "rpc_error"
+        assert HostDrainingError("x", host=3).host == 3
+        assert RpcError("x", host=4).host == 4

@@ -1349,6 +1349,92 @@ class TestClusterGate:
 
 
 # --------------------------------------------------------------------------
+# ISSUE 12 gate: the RPC data plane's wire + deadline contracts
+# --------------------------------------------------------------------------
+class TestRpcGate:
+    def _rpc_source(self):
+        p = os.path.join(SERVING, "rpc.py")
+        with open(p) as f:
+            return p, f.read()
+
+    def test_rpc_module_zero_unsuppressed(self):
+        """serving/rpc.py is inside the package gate already; this pins
+        the satellite explicitly — the data plane alone analyzes clean
+        under every checker (the deadline-propagation rule covering the
+        new submit surface included)."""
+        p, _ = self._rpc_source()
+        report = analyze_paths([p], baseline=Baseline.load(DEFAULT_BASELINE))
+        assert report.errors == []
+        assert report.files_analyzed == 1
+        pretty = "\n".join(f"{f.location()}: {f.rule}: {f.message}"
+                           for f in report.unsuppressed)
+        assert report.unsuppressed == [], pretty
+
+    def test_wire_version_guard_armed_for_rpc_request(self):
+        """Reintroduction gate against the REAL rpc.py: stripping the
+        RPC request schema's wire_version field must fail the
+        wire-schema-drift checker (exactly the HostStatus gate's shape,
+        extended to the data plane)."""
+        p, src = self._rpc_source()
+        broken = src.replace(
+            "    hedge_attempt: int = 0\n    wire_version: int = 1\n",
+            "    hedge_attempt: int = 0\n")
+        assert broken != src
+        r = run({p: broken}, rules=["wire-schema-drift"])
+        assert any("RpcRequest" in f.message and "version field"
+                   in f.message for f in r.unsuppressed)
+
+    def test_raw_splat_guard_armed_for_rpc_request(self):
+        """A from_dict that splats the raw payload (``cls(**d)``) would
+        crash on a newer peer's unknown field mid-rolling-upgrade —
+        reintroducing it in the real rpc.py must fail the checker."""
+        p, src = self._rpc_source()
+        broken = src.replace(
+            "        known = {f.name for f in dataclasses.fields(cls)}\n"
+            "        return cls(**{k: v for k, v in d.items() "
+            "if k in known})",
+            "        return cls(**d)", 1)
+        assert broken != src
+        r = run({p: broken}, rules=["wire-schema-drift"])
+        assert any("splats the raw payload" in f.message
+                   for f in r.unsuppressed)
+
+    def test_deadline_guard_armed_for_rpc_submit_surface(self):
+        """Acceptance: the deadline-propagation checker covers the RPC
+        submit surface — the server-side ``_submit`` dropping the
+        arrived budget on its engine forward must flag."""
+        p, src = self._rpc_source()
+        broken = src.replace(
+            "                fut = self.host.submit_infer(\n"
+            "                    arr, timeout_ms=timeout_ms, "
+            "tenant=req.tenant,\n",
+            "                fut = self.host.submit_infer(\n"
+            "                    arr, tenant=req.tenant,\n")
+        assert broken != src
+        r = run({p: broken}, rules=["deadline-propagation"])
+        assert any("forwards without it" in f.message
+                   for f in r.unsuppressed)
+
+    def test_rpc_terminal_reasons_registered(self):
+        """Drift guard armed against the REAL tracing.py for the two
+        new data-plane reasons."""
+        sources = {}
+        for name in os.listdir(SERVING):
+            if name.endswith(".py"):
+                q = os.path.join(SERVING, name)
+                with open(q) as f:
+                    sources[q] = f.read()
+        tracing_path = os.path.join(SERVING, "tracing.py")
+        for reason in ("host_draining", "rpc_error"):
+            broken = dict(sources)
+            removed = sources[tracing_path].replace(f'"{reason}",', "")
+            assert removed != sources[tracing_path]
+            broken[tracing_path] = removed
+            r = analyze_sources(broken, rules=["taxonomy-drift"])
+            assert any(reason in f.message for f in r.unsuppressed), reason
+
+
+# --------------------------------------------------------------------------
 # CLI
 # --------------------------------------------------------------------------
 class TestCli:
