@@ -21,6 +21,7 @@ from deeplearning4j_tpu.models.bert import (
     make_prefill,
     make_decode_step,
     make_paged_prefill,
+    grow_block_table,
     make_paged_decode_step,
     sample_token,
     validate_block_size,
@@ -35,6 +36,7 @@ __all__ = [
     "init_kv_cache", "kv_cache_pspecs", "paged_kv_cache_pspecs",
     "place_kv_cache", "make_prefill", "make_decode_step",
     "make_paged_prefill", "make_paged_decode_step", "sample_token",
+    "grow_block_table",
     "validate_block_size", "validate_kv_dtype", "quantize_kv",
     "KV_DTYPES",
 ]

@@ -675,6 +675,26 @@ def paged_kv_cache_pspecs(cfg: TransformerConfig,
     return {"layers": [dict(layer) for _ in range(cfg.layers)]}
 
 
+def grow_block_table(tables: np.ndarray, slot: int, n_entries: int,
+                     block: int) -> int:
+    """Append one physical block to a slot's row of the HOST-side block
+    table — the on-demand allocator's whole device story. The table is
+    FIXED-WIDTH (``(slots, ceil(max_len/block_size))``, zero-padded to
+    the scratch block), so growing a stream's footprint is writing the
+    next entry of its row: the donated paged decode executable's
+    signature never changes, only the gather index it is handed each
+    step. Returns the new entry count; raises when the row is already
+    full (the stream's ``max_len`` worth of blocks are all mapped —
+    admission bounds total length, so hitting this is a bookkeeping
+    bug, not load)."""
+    if not 0 <= n_entries < tables.shape[1]:
+        raise ValueError(
+            f"slot {slot} block-table row is full ({n_entries} of "
+            f"{tables.shape[1]} entries) — cannot map block {block}")
+    tables[slot, n_entries] = block
+    return n_entries + 1
+
+
 def place_kv_cache(cache, cfg: TransformerConfig, mesh: Mesh):
     """Shard a generation cache (any layout — the contiguous one carries
     'lengths', the paged pool does not, the int8 pool adds scales) onto
@@ -886,13 +906,21 @@ def make_paged_prefill(cfg: TransformerConfig, block_size: int,
     into the physical blocks named by ``block_row``, and token 0 sampled.
 
     ``prefill(params, cache, tokens, block_row, length, key, temperature,
-    top_k) -> (cache, token0)`` with tokens (1, T_bucket) int32 and
+    top_k, step) -> (cache, token0)`` with tokens (1, T_bucket) int32 and
     ``block_row`` (ceil(T_bucket/block_size),) int32 physical block ids —
     entries past the prompt's real blocks point at the reserved scratch
     block 0, so padding K/V lands in scratch, never in a live block. One
     executable per T bucket; the cache (block pool) is donated. Unlike
     the contiguous prefill there is no ``slot`` argument: lengths live on
     the host, and the block row alone names where this prompt's K/V go.
+
+    ``step`` is the SAMPLE index the trailing token draw folds into the
+    request key (``_sample_at``): 0 for a fresh prompt (the pre-existing
+    behavior, bitwise-unchanged), and the victim's next token index when
+    a preempted stream recomputes through prefill with its
+    generated-so-far tokens appended to the prompt — per-request keys
+    fold the token index, so the resumed draw is position-stable and the
+    resumed stream bitwise-matches its unpreempted run.
 
     ``kv_dtype="int8"``: quantization is FOLDED into the scatter — each
     block's values land int8 with their per-token scales written beside
@@ -903,7 +931,7 @@ def make_paged_prefill(cfg: TransformerConfig, block_size: int,
     validate_kv_dtype(kv_dtype, block_size)
 
     def prefill(params, cache, tokens, block_row, length, key,
-                temperature, top_k):
+                temperature, top_k, step):
         _, T = tokens.shape
         nb = block_row.shape[0]
         pad = nb * block_size - T
@@ -936,7 +964,7 @@ def make_paged_prefill(cfg: TransformerConfig, block_size: int,
                                             keepdims=False)
             logits = (last @ params["lm_head"].astype(last.dtype)
                       ).astype(jnp.float32)
-        token0 = _sample_at(logits, key, 0, temperature, top_k)
+        token0 = _sample_at(logits, key, step, temperature, top_k)
         return {"layers": layers}, token0
 
     if mesh is None:
@@ -946,7 +974,7 @@ def make_paged_prefill(cfg: TransformerConfig, block_size: int,
     repl = NamedSharding(mesh, P())
     return jax.jit(
         prefill, donate_argnums=(1,),
-        in_shardings=(param_sh, cache_sh) + (repl,) * 6,
+        in_shardings=(param_sh, cache_sh) + (repl,) * 7,
         out_shardings=(cache_sh, repl))
 
 

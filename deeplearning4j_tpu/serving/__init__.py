@@ -8,8 +8,8 @@ serving/engine.py and serving/generation.py for the design notes)."""
 from deeplearning4j_tpu.serving.admission import (  # noqa: F401
     AdmissionController, ClusterCapacityError, DeadlineExceededError,
     HostDrainingError, HostUnavailableError, KVBlocksExhaustedError,
-    QueueFullError, QuotaExceededError, RejectedError, RpcError,
-    SloShedError,
+    PreemptedError, QueueFullError, QuotaExceededError, RejectedError,
+    RpcError, SloShedError,
 )
 from deeplearning4j_tpu.serving.cluster import (  # noqa: F401
     ClusterDirectory, ClusterFrontDoor, ClusterStatsAggregator,
@@ -35,7 +35,8 @@ from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
     SlidingWindowStats,
 )
 from deeplearning4j_tpu.serving.paging import (  # noqa: F401
-    BlockAllocator, SharedPrefix, blocks_for_tokens, kv_bytes_per_token,
+    BlockAllocator, PrefixCache, SharedPrefix, blocks_for_tokens,
+    kv_bytes_per_token,
 )
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     CausalLMAdapter, Deployment, ModelAdapter, ModelRegistry, as_adapter,
@@ -59,8 +60,8 @@ __all__ = [
     "AdmissionController", "DeadlineExceededError", "KVBlocksExhaustedError",
     "QueueFullError", "RejectedError", "InferenceEngine", "bucket_ladder",
     "Counter", "Gauge", "Histogram", "ReasonCounter", "ServingMetrics",
-    "SlidingWindowStats", "BlockAllocator", "SharedPrefix",
-    "blocks_for_tokens", "kv_bytes_per_token",
+    "SlidingWindowStats", "BlockAllocator", "PrefixCache", "SharedPrefix",
+    "blocks_for_tokens", "kv_bytes_per_token", "PreemptedError",
     "Deployment", "ModelAdapter", "ModelRegistry", "as_adapter",
     "GenerationEngine", "GenerationHandle", "prefill_buckets",
     "CausalLMAdapter", "FaultPlan", "FaultInjectedError", "inject",

@@ -154,6 +154,25 @@ class KVBlocksExhaustedError(RejectedError):
         self.capacity = capacity
 
 
+class PreemptedError(RejectedError):
+    """A resident generation stream was evicted to reclaim KV blocks
+    (reason 'preempted') and could NOT be resumed: either admission
+    closed before the recompute requeue landed, or the stream's resume
+    footprint can no longer ever fit the pool (its blocks were freed;
+    shared-prefix pins grew underneath it). Ordinarily preemption is
+    invisible to the caller — the victim requeues through the prefill
+    path with its generated-so-far tokens appended to the prompt and the
+    resumed stream is bitwise-identical to an unpreempted run — so this
+    terminal only surfaces when the resume is impossible. Distinct from
+    'kv_blocks_exhausted': tokens were already delivered, and the cure
+    is resubmitting the whole request (elsewhere), not shrinking it.
+    Carries the count of ``tokens_generated`` before eviction."""
+
+    def __init__(self, msg: str, tokens_generated: Optional[int] = None):
+        super().__init__(msg, "preempted")
+        self.tokens_generated = tokens_generated
+
+
 @dataclass
 class Request:
     """One submitted inference request (``rows`` leading-dim rows of x)."""

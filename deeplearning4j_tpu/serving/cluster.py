@@ -106,6 +106,17 @@ class HostStatus:
     kv_blocks_free: int = 0
     kv_blocks_usable: int = 0
     block_size: int = 0
+    # the host engine's block-allocation discipline ("reserve" |
+    # "on_demand"): an on-demand host seats a stream on its PROMPT's
+    # blocks only, so the router gates its free-block headroom on the
+    # admit demand, not the worst case. Defaulted — pre-upgrade
+    # heartbeats parse as reserve, the conservative read.
+    allocate: str = "reserve"
+    # lifetime preemption count (allocate="on_demand" evictions): the
+    # elasticity planner reads the fleet-wide DELTA as a capacity-
+    # pressure signal — a fleet that preempts steadily needs hosts
+    # before it starts shedding
+    preemptions_total: int = 0
     buckets: Tuple[int, ...] = ()
     # health
     breaker: str = "CLOSED"
@@ -249,6 +260,9 @@ class LoopbackHost(HostHandle):
                 st.kv_blocks_free = gen._allocator.free_count
                 st.kv_blocks_usable = gen._usable_blocks()
                 st.block_size = gen.block_size
+                st.allocate = gen.allocate
+                st.preemptions_total = int(
+                    gen.metrics.preemptions_total.value)
             breaker, metrics = gen.breaker, gen.metrics
         if breaker is not None:
             st.breaker = breaker.state
@@ -795,6 +809,10 @@ class ClusterDirectory:
             "free_slots": sum(s["free_slots"] for s in statuses),
             "kv_blocks_total": sum(s["kv_blocks_total"] for s in statuses),
             "kv_blocks_free": sum(s["kv_blocks_free"] for s in statuses),
+            # pre-upgrade heartbeats carry no preemption counter: .get
+            # keeps a mixed-version fleet's snapshot parsing
+            "preemptions_total": sum(int(s.get("preemptions_total", 0))
+                                     for s in statuses),
             "breakers_open": sum(1 for s in statuses
                                  if s["breaker"] == "OPEN"),
         }
@@ -994,6 +1012,8 @@ class _HedgedStream:
                         blocks_needed=self.fd._blocks_needed(
                             int(self.toks.size), self.max_new,
                             self.pinned),
+                        blocks_admit=self.fd._blocks_needed(
+                            int(self.toks.size), 1, self.pinned),
                         pinned=self.pinned, exclude=exclude,
                         bounced_full=bounced)
                 except RejectedError as e:
@@ -1379,13 +1399,22 @@ class ClusterFrontDoor:
 
     # ------------------------------------------------------------ routing
     def _headroom(self, st: HostStatus, kind: str, rows: int,
-                  blocks_needed: int) -> bool:
+                  blocks_needed: int,
+                  blocks_admit: Optional[int] = None) -> bool:
         if kind == "infer":
             return st.queue_depth + rows <= st.queue_capacity
         if st.kv_blocks_total and blocks_needed > st.kv_blocks_usable:
-            return False   # this host can NEVER hold the stream
+            return False   # this host can NEVER hold the stream (the
+            #                 worst case bounds every allocate mode)
+        # the demand SEATING pays: an on-demand host takes only the
+        # prompt's blocks up front (the generation tail allocates per
+        # boundary crossing, preempting when dry), so its free-block
+        # headroom is judged on the admit demand
+        demand = blocks_admit if (blocks_admit is not None
+                                  and st.allocate == "on_demand") \
+            else blocks_needed
         if st.free_slots > 0 and (not st.kv_blocks_total
-                                  or blocks_needed <= st.kv_blocks_free):
+                                  or demand <= st.kv_blocks_free):
             return True    # seats immediately
         # no free seat (or blocks currently held by live streams): the
         # request can still queue — retirements free both
@@ -1417,13 +1446,16 @@ class ClusterFrontDoor:
     CAPACITY_BOUNCE_REASONS = ("queue_full", "kv_blocks_exhausted")
 
     def _route(self, kind: str, *, rows: int = 1, blocks_needed: int = 0,
+               blocks_admit: Optional[int] = None,
                pinned: Optional[int] = None,
                exclude: Tuple[int, ...] = (), bounced_full: int = 0):
         """Pick (handle, host_id, decision) or raise typed. Pure reader
         of the directory view except for the probe grant. ``exclude``
         names hosts that already bounced this request, ``bounced_full``
         how many of those bounced for capacity (heartbeat lag: the view
-        said headroom, the host's own admission said full)."""
+        said headroom, the host's own admission said full).
+        ``blocks_admit`` is the prompt-only seat demand an on-demand
+        host gates on (None: judge every host on ``blocks_needed``)."""
         d = self.directory
         ranked: List[Tuple[tuple, int, HostHandle]] = []
         probe_set: List[Tuple[int, HostHandle]] = []
@@ -1447,7 +1479,8 @@ class ClusterFrontDoor:
             if st.breaker == "OPEN":
                 probe_set.append((hid, h))       # drained fleet-wide
                 continue
-            if not self._headroom(st, kind, rows, blocks_needed):
+            if not self._headroom(st, kind, rows, blocks_needed,
+                                  blocks_admit):
                 full += 1
                 continue
             ranked.append((self._load_key(st, kind, rows, blocks_needed),
@@ -1615,9 +1648,13 @@ class ClusterFrontDoor:
         while True:
             needed = self._blocks_needed(int(toks.size), max_new_tokens,
                                          host)
+            # the prompt-only seat demand (+1, the first write target):
+            # what an allocate="on_demand" host actually takes at seating
+            needed_admit = self._blocks_needed(int(toks.size), 1, host)
             try:
                 h, hid, how = self._route(
                     "generate", rows=1, blocks_needed=needed,
+                    blocks_admit=needed_admit,
                     pinned=host, exclude=tuple(tried),
                     bounced_full=bounced_full)
             except RejectedError as e:
@@ -1832,9 +1869,14 @@ class ElasticityPolicy:
     fraction and the front doors' shed mix — and recommends scaling:
 
     - **join** when capacity pressure persists: ``cluster_capacity``
-      sheds appeared since the last look, or the free-slot fraction sat
+      sheds appeared since the last look, the free-slot fraction sat
       below ``low_free_slot_frac`` for ``trend_windows`` consecutive
-      observations (a single busy tick never scales the fleet);
+      observations (a single busy tick never scales the fleet), or the
+      fleet preempted at least ``preemption_pressure_min`` resident
+      streams since the last look — a fleet that preempts steadily is
+      serving on borrowed KV blocks and needs hosts BEFORE it starts
+      shedding (preemption is the leading indicator, sheds the
+      trailing one);
     - **drain** when slack persists: free-slot fraction above
       ``high_free_slot_frac`` with zero capacity sheds for
       ``trend_windows`` consecutive observations, and more than
@@ -1847,6 +1889,11 @@ class ElasticityPolicy:
     high_free_slot_frac: float = 0.60
     trend_windows: int = 3
     min_hosts: int = 1
+    # fleet-wide preemptions per observation that count as capacity
+    # pressure (allocate="on_demand" hosts evicting residents to serve
+    # boundary crossings). 1 = any sustained preemption is pressure;
+    # raise it to tolerate occasional churn on small pools
+    preemption_pressure_min: int = 1
 
     def __post_init__(self):
         if not (0.0 <= self.low_free_slot_frac
@@ -1858,6 +1905,8 @@ class ElasticityPolicy:
             raise ValueError("trend_windows must be >= 1")
         if self.min_hosts < 1:
             raise ValueError("min_hosts must be >= 1")
+        if self.preemption_pressure_min < 1:
+            raise ValueError("preemption_pressure_min must be >= 1")
 
 
 class ElasticityPlanner:
@@ -1875,6 +1924,7 @@ class ElasticityPlanner:
     def __init__(self, policy: Optional[ElasticityPolicy] = None):
         self.policy = policy if policy is not None else ElasticityPolicy()
         self._last_shed_total: Optional[int] = None
+        self._last_preempt_total: Optional[int] = None
         self._pressure_streak = 0
         self._slack_streak = 0
         self.last_decision: Optional[dict] = None
@@ -1926,10 +1976,22 @@ class ElasticityPlanner:
         fleet = snapshot.get("fleet") or {}
         alive = int(fleet.get("alive", 0))
         draining = int(fleet.get("draining", 0))
+        # preemption rate — the join signal BESIDE the shed mix: an
+        # on-demand fleet evicting residents for KV blocks is out of
+        # memory headroom even while nothing sheds yet (missing on
+        # pre-upgrade snapshots: delta stays 0)
+        preempt_total = int(fleet.get("preemptions_total", 0) or 0)
+        preempt_delta = (0 if self._last_preempt_total is None
+                         else max(0, preempt_total
+                                  - self._last_preempt_total))
+        self._last_preempt_total = preempt_total
 
-        pressure = shed_delta > 0 or (
-            free_frac is not None and free_frac < pol.low_free_slot_frac)
-        slack = (shed_delta == 0 and free_frac is not None
+        pressure = (shed_delta > 0
+                    or preempt_delta >= pol.preemption_pressure_min
+                    or (free_frac is not None
+                        and free_frac < pol.low_free_slot_frac))
+        slack = (shed_delta == 0 and preempt_delta == 0
+                 and free_frac is not None
                  and free_frac > pol.high_free_slot_frac)
         if first:
             pressure = slack = False
@@ -1954,6 +2016,7 @@ class ElasticityPlanner:
             ff = "n/a" if free_frac is None else round(free_frac, 3)
             reason = (f"capacity pressure for {self._pressure_streak} "
                       f"window(s): +{shed_delta} capacity shed(s), "
+                      f"+{preempt_delta} preemption(s), "
                       f"free-slot fraction {ff}")
             self._pressure_streak = 0
         elif (self._slack_streak >= pol.trend_windows
@@ -1972,6 +2035,7 @@ class ElasticityPlanner:
             "free_slot_frac": (None if free_frac is None
                                else round(free_frac, 4)),
             "capacity_sheds_delta": shed_delta,
+            "preemptions_delta": preempt_delta,
             "pressure_streak": self._pressure_streak,
             "slack_streak": self._slack_streak,
         }

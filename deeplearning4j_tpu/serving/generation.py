@@ -53,13 +53,14 @@ import numpy as np
 from deeplearning4j_tpu.profiler import OpProfiler
 from deeplearning4j_tpu.serving.admission import (
     AdmissionController, HostDrainingError, KVBlocksExhaustedError,
-    RejectedError, Request,
+    PreemptedError, RejectedError, Request,
 )
 from deeplearning4j_tpu.serving.engine import bucket_ladder
 from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.paging import (
-    BlockAllocator, SharedPrefix, blocks_for_tokens, kv_bytes_per_token,
+    BlockAllocator, PrefixCache, SharedPrefix, blocks_for_tokens,
+    kv_bytes_per_token,
 )
 from deeplearning4j_tpu.serving.qos import (
     PRIORITIES, SloBurnGovernor, resolve_qos,
@@ -95,6 +96,15 @@ class GenerationRequest:
     key: np.ndarray                 # (2,) uint32 base PRNG key
     prefix_id: Optional[str] = None  # shared-prefix reference (paged only)
     handle: "GenerationHandle" = None
+    # ---- preemption / recompute-on-resume (allocate="on_demand") --------
+    # set when this stream was evicted to reclaim KV blocks: the tokens
+    # it had generated (appended to the prompt on the recompute prefill)
+    # and the index its next sample resumes at — per-request keys fold
+    # the token index, so the resumed draws are position-stable and the
+    # resumed stream is bitwise the unpreempted one
+    resume_tokens: Optional[np.ndarray] = None
+    resume_step: int = 0
+    preemptions: int = 0
 
 
 class GenerationHandle:
@@ -197,6 +207,13 @@ class _Slot:
     # one-shot copy-on-write for the first write into a partially-filled
     # shared block: (src physical block, dst physical block)
     cow: Optional[Tuple[int, int]] = None
+    # table-row entries mapped so far (shared + fresh). Under
+    # allocate="reserve" this covers the worst case at seating; under
+    # "on_demand" it grows one block per boundary crossing
+    n_entries: int = 0
+    # recompute-on-resume seating: TTFT/prefix-hit accounting already
+    # happened on the first seating and must not double-count
+    resumed: bool = False
 
 
 class GenerationEngine(ResilientEngineMixin):
@@ -242,6 +259,33 @@ class GenerationEngine(ResilientEngineMixin):
     per-tenant quotas + SLO-burn shedding; ``retry_budget``
     (resilience.RetryBudget) bounds retry-storm amplification. Both
     default to off — the bitwise-identical pre-QoS path.
+
+    ``allocate`` selects the block allocator's discipline (paged only):
+
+    - ``"reserve"`` (default): a stream's whole worst-case
+      ``ceil((len + max_new)/block_size)`` footprint is taken at seating
+      — the pre-existing behavior, bitwise-inert, zero mid-stream
+      surprises, but every unwritten generation tail sits idle in the
+      pool (the ``kv_reservation_slack`` gauge).
+    - ``"on_demand"`` (vLLM SOSP'23 §4.5): seating takes only the
+      PROMPT's blocks; the decode loop allocates one block per
+      block-boundary crossing, and when the pool is dry it preempts the
+      lowest-QoS-class resident streams (largest footprint, latest
+      arrival first; ``TenantPolicy.preemptible=False`` exempts a
+      tenant) and requeues them for recompute-on-resume through the
+      prefill path — the resumed stream is bitwise the unpreempted one
+      (per-request keys fold the token index). ``kv_blocks_exhausted``
+      becomes a mid-stream condition too; a victim that can no longer
+      ever be resumed sheds typed ``'preempted'``.
+
+    ``prefix_cache_blocks`` > 0 (paged only) enables the AUTOMATIC
+    prefix cache (SGLang RadixAttention's policy): retired streams'
+    full blocks are retained in a bounded LRU (at most this many
+    blocks) and a later prompt sharing a block-aligned token prefix
+    references them directly — shared system prompts hit with no API
+    opt-in (``register_prefix`` remains the pinned, never-evicted
+    route). Cached blocks are reclaimed on demand, so they never gate
+    admission. Default 0 — off, bitwise-inert.
     """
 
     _COMPONENT = "serving.GenerationEngine"
@@ -256,6 +300,8 @@ class GenerationEngine(ResilientEngineMixin):
                  num_blocks: Optional[int] = None,
                  kv_dtype: str = "float32",
                  paged_attention: str = "gather",
+                 allocate: str = "reserve",
+                 prefix_cache_blocks: int = 0,
                  queue_capacity: int = 64,
                  default_timeout_ms: Optional[float] = None,
                  eos_id: Optional[int] = None,
@@ -268,8 +314,11 @@ class GenerationEngine(ResilientEngineMixin):
                  tracer=None, recorder=None, screen_outputs: bool = True,
                  name: str = "generation"):
         from deeplearning4j_tpu.models.bert import (
-            init_kv_cache, make_decode_step, make_paged_decode_step,
-            make_paged_prefill, make_prefill, place_kv_cache, place_params)
+            grow_block_table, init_kv_cache, make_decode_step,
+            make_paged_decode_step, make_paged_prefill, make_prefill,
+            place_kv_cache, place_params)
+
+        self._grow_table = grow_block_table
 
         if not cfg.causal:
             raise ValueError(
@@ -307,6 +356,16 @@ class GenerationEngine(ResilientEngineMixin):
             self.block_size = validate_block_size(block_size, self.max_len)
             self.kv_dtype = validate_kv_dtype(kv_dtype, self.block_size)
             self.paged_attention = paged_attention
+            if allocate not in ("reserve", "on_demand"):
+                raise ValueError(
+                    f"allocate must be 'reserve' or 'on_demand', got "
+                    f"{allocate!r}")
+            if prefix_cache_blocks < 0:
+                raise ValueError(
+                    f"prefix_cache_blocks must be >= 0, got "
+                    f"{prefix_cache_blocks}")
+            self.allocate = allocate
+            self.prefix_cache_blocks = int(prefix_cache_blocks)
             self.max_blocks_per_slot = blocks_for_tokens(self.max_len,
                                                          self.block_size)
             self.num_blocks = (slots * self.max_blocks_per_slot + 1
@@ -323,6 +382,19 @@ class GenerationEngine(ResilientEngineMixin):
             # tensors, dequant in the block read): validate against the
             # contiguous layout's absent block size so the error names it
             validate_kv_dtype(kv_dtype, None)
+            if allocate != "reserve":
+                raise ValueError(
+                    f"allocate={allocate!r} requires the paged KV cache "
+                    "(GenerationEngine(paged=True)) — the contiguous "
+                    "layout reserves whole rows, there is nothing to "
+                    "allocate on demand")
+            if prefix_cache_blocks:
+                raise ValueError(
+                    "prefix_cache_blocks requires the paged KV cache "
+                    "(GenerationEngine(paged=True)) — the automatic "
+                    "prefix cache holds retired streams' blocks")
+            self.allocate = "reserve"
+            self.prefix_cache_blocks = 0
             if paged_attention != "gather":
                 raise ValueError(
                     f"paged_attention={paged_attention!r} requires the "
@@ -348,6 +420,14 @@ class GenerationEngine(ResilientEngineMixin):
         self._prefix_busy = False
         self._allocator: Optional[BlockAllocator] = None
         self._tables: Optional[np.ndarray] = None
+        # automatic prefix cache (paging.PrefixCache; scheduler-thread
+        # single-writer) — rebuilt with the pool in _reset_cache.
+        # _cache_bypass suspends MATCHING (warmup: a rung probe hitting
+        # an earlier rung's retired blocks would ride the feed path and
+        # skip its prefill compile — live traffic would then pay XLA
+        # inline, the exact thing warmup exists to prevent)
+        self._prefix_cache: Optional[PrefixCache] = None
+        self._cache_bypass = False
         # block-wait reservation (scheduler thread only): the dequeued
         # request currently waiting for KV blocks, as (request, demand,
         # priority). Under FIFO nothing can overtake a requeued head, so
@@ -446,6 +526,15 @@ class GenerationEngine(ResilientEngineMixin):
                 pids = list(self._prefixes)
             for pid in pids:
                 self.release_prefix(pid)
+            # the automatic prefix cache's entries go with the pins:
+            # every block returns to the free list so the departing
+            # host's last heartbeats show full capacity (the cache is
+            # internally locked; any in-flight match holds its own refs)
+            if self._prefix_cache is not None:
+                before = len(self._prefix_cache)
+                self._prefix_cache.release_all()
+                if before:
+                    self.metrics.prefix_cache_evictions_total.inc(before)
         return True
 
     # --------------------------------------------------------------- submit
@@ -697,12 +786,22 @@ class GenerationEngine(ResilientEngineMixin):
             if self.mesh is not None else cache
         if self.paged:
             self._block_waiter = None   # demand was against the old pool
+            if self._prefix_cache is not None:
+                # the old pool's K/V died with its allocator: the cached
+                # references are void and must NOT be freed into the
+                # fresh allocator (the PR 6 _clear_slot discipline,
+                # extended to cache entries)
+                self._prefix_cache.invalidate()
             with self._prefix_lock:
                 self._allocator = BlockAllocator(self.num_blocks, reserved=1)
                 self._tables = np.zeros(
                     (self.slots, self.max_blocks_per_slot), np.int32)
                 for p in self._prefixes.values():
                     p.blocks = None
+            self._prefix_cache = (
+                PrefixCache(self._allocator, self.block_size,
+                            self.prefix_cache_blocks)
+                if self.prefix_cache_blocks else None)
             self.metrics.kv_blocks_total.set(self._allocator.capacity)
             self.metrics.kv_block_bytes.set(self.kv_block_bytes)
             self.metrics.kv_pool_hbm_bytes.set(
@@ -752,12 +851,19 @@ class GenerationEngine(ResilientEngineMixin):
             touched = sum(blocks_for_tokens(p.length, B)
                           for p in self._prefixes.values() if p.blocks)
         tokens = prefix_tokens
+        slack = 0
         for st in list(self._slots):
             if st is not None:
                 aligned_shared = (st.prefix_len // B) * B
                 local = max(0, st.length - aligned_shared)
                 tokens += local
                 touched += blocks_for_tokens(local, B)
+                # reserved-but-unwritten blocks: row entries past the
+                # stream's written positions — the worst-case generation
+                # tail allocate="reserve" holds idle (on_demand keeps at
+                # most ~1 slack block per stream, the next write target)
+                slack += max(0, (st.n_entries - st.prefix_len // B)
+                             - blocks_for_tokens(local, B))
         self.metrics.kv_blocks_in_use.set(in_use)
         self.metrics.kv_blocks_pinned.set(pinned)
         self.metrics.kv_hbm_bytes_in_use.set(in_use * self.kv_block_bytes)
@@ -765,6 +871,10 @@ class GenerationEngine(ResilientEngineMixin):
         self.metrics.kv_block_occupancy.set(in_use / cap if cap else 0.0)
         self.metrics.kv_fragmentation.set(
             max(0.0, 1.0 - tokens / (touched * B)) if touched else 0.0)
+        self.metrics.kv_reservation_slack.set(slack)
+        self.metrics.prefix_cache_blocks.set(
+            self._prefix_cache.total_blocks
+            if self._prefix_cache is not None else 0)
 
     def _loop(self, epoch: int):
         """Scheduler loop for one epoch. The watchdog bumps ``_epoch`` on
@@ -847,9 +957,9 @@ class GenerationEngine(ResilientEngineMixin):
                 if block:
                     return   # idle and nothing queued: back to the loop
                 continue
-            prefix = None
+            prefix = cached = None
             if self.paged:
-                verdict, prefix = self._plan_blocks(req)
+                verdict, prefix, cached = self._plan_blocks(req)
                 if verdict == "shed":
                     continue   # head disposed of typed; slot stays free
                 if verdict == "wait":
@@ -859,17 +969,34 @@ class GenerationEngine(ResilientEngineMixin):
                     # _block_waiter reservation keeps them from eating
                     # the freed blocks the waiter is accumulating
                     return
-            if not req.future.set_running_or_notify_cancel():
-                self._finish_request(req.trace, "cancelled",
-                                     tenant=req.tenant)
-                continue     # caller cancelled while queued
-            qw = (time.perf_counter() - req.submit_t) * 1e3
-            self.metrics.observe_queue_wait_class(req.priority, qw)
-            req.trace.event("queue.wait", queue_wait_ms=round(qw, 3))
-            if prefix is not None:
-                # shared-prefix stream: no prefill at all — reference the
-                # pinned blocks and feed the suffix through decode steps
-                self._admit_prefix_stream(i, req, prefix, epoch)
+            greq: GenerationRequest = req.x
+            resumed = greq.resume_tokens is not None
+            if not req.future.running():
+                if not req.future.set_running_or_notify_cancel():
+                    if cached is not None:
+                        # the plan's match refs must not outlive the
+                        # request: leaked refcounts would keep evicted
+                        # cache blocks off the free list forever
+                        self._allocator.free(cached[2])
+                    self._finish_request(req.trace, "cancelled",
+                                         tenant=req.tenant)
+                    continue     # caller cancelled while queued
+            if not resumed:
+                qw = (time.perf_counter() - req.submit_t) * 1e3
+                self.metrics.observe_queue_wait_class(req.priority, qw)
+                req.trace.event("queue.wait", queue_wait_ms=round(qw, 3))
+            if prefix is not None or cached is not None:
+                # shared-prefix / automatic-cache-hit stream: no prefill
+                # at all — reference the shared blocks and feed the
+                # remaining prompt through decode steps
+                self._seat_stream(i, req, prefix, cached, epoch)
+                continue
+            if resumed and int(greq.prompt.size) \
+                    + int(greq.resume_tokens.size) > self.buckets[-1]:
+                # the recompute prompt outgrew the prefill ladder (custom
+                # short buckets): rebuild the K/V through the decode-feed
+                # path instead — slower, but always available
+                self._seat_stream(i, req, None, None, epoch)
                 continue
             with self._wd_lock:  # visible to the watchdog while on-device
                 self._inflight_prefill = req
@@ -894,28 +1021,58 @@ class GenerationEngine(ResilientEngineMixin):
 
     # ------------------------------------------------- paged block planning
     def _fresh_blocks_needed(self, prefix_len: int, n_prompt: int,
-                             max_new: int) -> int:
+                             max_new: int, admit: bool = False) -> int:
         """THE block-demand formula — fresh blocks a stream must
-        allocate: its whole worst-case footprint minus the prefix's
-        FULLY-filled shared blocks (a partially-filled shared tail block
-        is copy-on-written into a fresh block, so it is not deducted).
-        Shared by the submit-time gate, the scheduler's plan, and the
-        seating path so the three can never disagree."""
+        allocate: its footprint minus the prefix's FULLY-filled shared
+        blocks (a partially-filled shared tail block is copy-on-written
+        into a fresh block, so it is not deducted). Shared by the
+        submit-time gate, the scheduler's plan, and the seating path so
+        the three can never disagree.
+
+        ``admit=False`` is the WORST CASE (prompt + every token the
+        stream may ever generate) — the structural can-this-ever-fit
+        bound, and the reservation ``allocate="reserve"`` takes at
+        seating. ``admit=True`` is the demand seating actually pays:
+        identical under "reserve", but under "on_demand" only the
+        PROMPT's positions (plus one, the first generated token's write
+        target — a seated stream can always emit at least one token);
+        the generation tail allocates one block per boundary crossing
+        in the decode loop instead of sitting idle in the pool."""
         total = prefix_len + n_prompt + max_new
+        if admit and self.allocate == "on_demand":
+            total = prefix_len + n_prompt + 1
         return blocks_for_tokens(total, self.block_size) \
             - prefix_len // self.block_size
 
     def _blocks_needed(self, greq: GenerationRequest,
-                       prefix: Optional[SharedPrefix]) -> int:
+                       prefix: Optional[SharedPrefix],
+                       admit: bool = False) -> int:
+        """A request's fresh-block demand. A preemption-resumed request
+        recomputes its generated-so-far tokens through the prompt, so
+        they count as prompt positions and its remaining budget shrinks
+        by the same amount — the worst case is unchanged from the
+        original admission."""
+        n = int(greq.prompt.size)
+        if greq.resume_tokens is not None:
+            n += int(greq.resume_tokens.size)
         return self._fresh_blocks_needed(
             prefix.length if prefix is not None else 0,
-            int(greq.prompt.size), greq.max_new_tokens)
+            n, greq.max_new_tokens - greq.resume_step, admit=admit)
 
     def _plan_blocks(self, req: Request):
-        """Dispose of the dequeued head: ('ok', prefix-or-None) when its
-        reservation fits the free pool, ('wait', None) when it must wait
-        for retirements (or for a lazy prefix re-prefill), ('shed', None)
-        when it was failed typed right here."""
+        """Dispose of the dequeued head: ('ok', prefix-or-None,
+        cache-hit-or-None) when its seat demand fits the free pool,
+        ('wait', None, None) when it must wait for retirements (or for a
+        lazy prefix re-prefill), ('shed', None, None) when it was failed
+        typed right here. The cache hit is ``(entry, m)`` — the
+        automatic prefix cache's longest block-aligned match, consumed
+        by the seating path (:meth:`_seat_stream`).
+
+        Two demands: the WORST CASE gates structurally (a stream whose
+        whole footprint exceeds what the pool can ever free can never
+        complete, whichever allocator runs), the SEAT demand (prompt
+        blocks only under ``allocate="on_demand"``) gates against the
+        currently-free pool — the on-demand win is exactly this gap."""
         greq: GenerationRequest = req.x
         prefix = None
         if greq.prefix_id is not None:
@@ -931,13 +1088,13 @@ class GenerationEngine(ResilientEngineMixin):
                 if greq.handle._fail(e):
                     self._finish_request(req.trace, "client_error",
                                          tenant=req.tenant)
-                return "shed", None
+                return "shed", None, None
             if not prefix.ready:
                 # K/V lost to a cache rebuild (or registration raced the
                 # queue): schedule the lazy re-prefill, wait our turn
                 self._queue_prefix_prefill(greq.prefix_id)
-                return "wait", None
-        needed = self._blocks_needed(greq, prefix)
+                return "wait", None, None
+        needed_worst = self._blocks_needed(greq, prefix)
         usable = self._usable_blocks()
         waiter = self._block_waiter
         if waiter is not None and (waiter[0] is req
@@ -946,14 +1103,48 @@ class GenerationEngine(ResilientEngineMixin):
             # terminal elsewhere (deadline shed, cancel): its
             # reservation must not throttle anyone anymore
             self._block_waiter = waiter = None
-        if needed > usable:
+        if needed_worst > usable:
+            if greq.resume_tokens is not None:
+                # a preemption victim whose footprint can no longer ever
+                # fit (shared-prefix pins grew under it after its blocks
+                # were freed): the resume is impossible — typed
+                # 'preempted', the caller resubmits the whole request
+                self._shed_typed(req, PreemptedError(
+                    f"stream was preempted after {greq.resume_step} "
+                    f"token(s) and its resume needs {needed_worst} KV "
+                    f"blocks but the pool can free at most {usable} of "
+                    f"{self._allocator.capacity} — resubmit",
+                    tokens_generated=greq.resume_step))
+                return "shed", None, None
             self._shed_typed(req, KVBlocksExhaustedError(
-                f"request needs {needed} KV blocks but the pool can free "
-                f"at most {usable} of {self._allocator.capacity} "
-                "(shared-prefix pins excluded)",
-                needed=needed, usable=usable,
+                f"request needs {needed_worst} KV blocks but the pool "
+                f"can free at most {usable} of "
+                f"{self._allocator.capacity} (shared-prefix pins "
+                "excluded)",
+                needed=needed_worst, usable=usable,
                 capacity=self._allocator.capacity))
-            return "shed", None
+            return "shed", None, None
+        # automatic prefix cache: longest block-aligned token-prefix
+        # match over retired streams' full blocks — a hit seats like a
+        # (block-aligned) shared prefix, no API opt-in. match_and_ref
+        # takes this planner's OWN allocator refs atomically with the
+        # match, so a concurrent release (warmup/drain) or eviction
+        # cannot free the matched blocks before seating; every non-seat
+        # exit below must free them. Resumed streams skip the match:
+        # their recompute must rebuild the exact state the unpreempted
+        # run had, through the same prefill route.
+        cached = None
+        if (self._prefix_cache is not None and prefix is None
+                and greq.resume_tokens is None and not self._cache_bypass):
+            cached = self._prefix_cache.match_and_ref(greq.prompt)
+        if cached is not None:
+            m = cached[1]
+            needed = self._fresh_blocks_needed(
+                m * self.block_size,
+                int(greq.prompt.size) - m * self.block_size,
+                greq.max_new_tokens, admit=True)
+        else:
+            needed = self._blocks_needed(greq, prefix, admit=True)
         # two reservations are off limits: blocks a queued-but-unprefilled
         # prefix still needs (the drain runs first each turn, but without
         # this sustained stream traffic would consume every freed block
@@ -976,11 +1167,26 @@ class GenerationEngine(ResilientEngineMixin):
         reserved = 0
         if waiter is not None and rank >= PRIORITIES.index(waiter[2]):
             reserved = waiter[1]
-        if needed > self._allocator.free_count \
-                - self._pending_prefix_demand() - reserved:
+        avail = self._allocator.free_count \
+            - self._pending_prefix_demand() - reserved
+        if needed > avail and self._prefix_cache is not None \
+                and len(self._prefix_cache):
+            # the automatic prefix cache is reclaimable-on-demand by
+            # design: evict LRU entries (never the one just matched)
+            # before making anyone wait
+            self._cache_evict(needed - avail,
+                              protect=cached[0] if cached else None)
+            avail = self._allocator.free_count \
+                - self._pending_prefix_demand() - reserved
+        if needed > avail:
+            if cached is not None:
+                # not seating this turn: return the planner's match refs
+                # (the cache entry keeps its own; the next plan
+                # re-matches against whatever still exists)
+                self._allocator.free(cached[2])
             self._block_waiter = (req, needed, req.priority)
-            return "wait", None
-        return "ok", prefix
+            return "wait", None, None
+        return "ok", prefix, cached
 
     def _pending_prefix_demand(self) -> int:
         """Worst-case blocks the QUEUED unprefilled prefixes still need
@@ -1091,7 +1297,7 @@ class GenerationEngine(ResilientEngineMixin):
                         "generation.prefill", self._prefill,
                         self.params, self._cache, padded, row, np.int32(n),
                         np.asarray(jax.random.PRNGKey(0)), np.float32(0.0),
-                        np.int32(0))
+                        np.int32(0), np.int32(0))
 
                 raw = self._retry_call(call)
                 new_cache, _tok0 = raw
@@ -1123,32 +1329,75 @@ class GenerationEngine(ResilientEngineMixin):
         self._update_block_gauges()
         return True
 
-    def _admit_prefix_stream(self, i: int, req: Request,
-                             prefix: SharedPrefix, epoch: int):
-        """Seat a shared-prefix stream WITHOUT a prefill: its block table
-        references the prefix's pinned blocks (refcount++), fresh blocks
-        cover the suffix + generation budget, and the prompt suffix rides
-        the decode executable one token per iteration. A partially-filled
-        shared tail block is held read-only and copy-on-written by the
-        slot's first decode step (``_Slot.cow``)."""
+    def _seat_stream(self, i: int, req: Request,
+                     prefix: Optional[SharedPrefix], cached, epoch: int):
+        """Seat a stream WITHOUT a prefill — the decode-feed path. Three
+        flavors share it:
+
+        - **explicit shared prefix**: the table references the prefix's
+          pinned blocks (refcount++), a partially-filled shared tail
+          block is held read-only and copy-on-written by the slot's
+          first decode step (``_Slot.cow``);
+        - **automatic prefix-cache hit** (``cached=(entry, m)``): the
+          table references the entry's first ``m`` blocks (refcount++) —
+          entries hold FULL blocks only, so there is never a CoW tail;
+        - **bare feed** (no shared blocks): a preemption-resumed stream
+          whose recompute prompt outgrew the prefill ladder rebuilds its
+          K/V one token per decode iteration from position 0.
+
+        Fresh blocks cover the rest of the seat demand (worst case under
+        ``allocate="reserve"``, prompt-only under ``"on_demand"``), and
+        the un-prefilled tokens — prompt suffix plus, for a resumed
+        stream, its generated-so-far tokens — ride the decode executable
+        one token per iteration with mid-feed samples discarded; the
+        final feed's sample is token ``resume_step`` (0 for a fresh
+        stream), exactly the index the request key folds."""
         greq: GenerationRequest = req.x
         B = self.block_size
-        P = prefix.length
         alloc = self._allocator
-        n_shared = P // B
-        nb_total = n_shared + self._blocks_needed(greq, prefix)
-        pblocks = prefix.blocks
+        resumed = greq.resume_tokens is not None
+        feed = [int(t) for t in greq.prompt]
+        if resumed:
+            feed += [int(t) for t in greq.resume_tokens]
+        cow = None
+        cow_src = None
+        part = None            # partially-filled shared tail ref (prefix)
+        owned = []             # refs this planner ALREADY holds (a cache
+        #                        hit's match_and_ref took them atomically)
         try:
-            if pblocks is None:
-                raise RuntimeError(
-                    f"shared prefix {greq.prefix_id!r} was invalidated "
-                    "while this request was being seated; resubmit")
-            fresh = alloc.alloc(nb_total - n_shared)
-            shared = list(pblocks[:n_shared])
-            # a partially-filled shared tail block is referenced too (it
-            # must stay alive until the CoW copy reads it), but never
-            # enters the table: the table entry points at the CoW dst
-            refs = shared + ([pblocks[n_shared]] if P % B else [])
+            if prefix is not None:
+                P = prefix.length
+                pblocks = prefix.blocks
+                if pblocks is None:
+                    raise RuntimeError(
+                        f"shared prefix {greq.prefix_id!r} was "
+                        "invalidated while this request was being "
+                        "seated; resubmit")
+                shared = list(pblocks[:P // B])
+                # a partially-filled shared tail block is referenced too
+                # (it must stay alive until the CoW copy reads it), but
+                # never enters the table: the table entry points at the
+                # CoW dst
+                part = [pblocks[P // B]] if P % B else []
+                cow_src = pblocks[P // B] if P % B else None
+                nfresh = self._blocks_needed(greq, prefix, admit=True)
+            elif cached is not None:
+                _entry, m, owned = cached
+                P = m * B
+                shared = list(owned)
+                part = []
+                nfresh = self._fresh_blocks_needed(
+                    P, len(feed) - P, greq.max_new_tokens
+                    - greq.resume_step, admit=True)
+                feed = feed[m * B:]
+            else:
+                P = 0
+                shared, part = [], []
+                nfresh = self._fresh_blocks_needed(
+                    0, len(feed), greq.max_new_tokens - greq.resume_step,
+                    admit=True)
+            fresh = alloc.alloc(nfresh)
+            refs = part if owned else shared + part
             try:
                 alloc.incref(refs)   # all-or-nothing
             except ValueError as e:
@@ -1156,21 +1405,28 @@ class GenerationEngine(ResilientEngineMixin):
                 raise RuntimeError(
                     f"shared prefix {greq.prefix_id!r} was released while "
                     "this request was being seated; resubmit") from e
-            held = refs + fresh
-            cow = (pblocks[n_shared], fresh[0]) if P % B else None
+            held = shared + part + fresh
+            if cow_src is not None:
+                cow = (cow_src, fresh[0])
         except BaseException as e:
+            if owned:
+                alloc.free(owned)   # the match refs must not leak
             # release_prefix racing the seating — client lifecycle, same
             # 'client_error' label as the queued-release shed above
             if greq.handle._fail(e):
                 self._finish_request(req.trace, "client_error",
                                      tenant=req.tenant)
             return
+        n_shared = len(shared)
+        n_entries = n_shared + len(fresh)
         row = np.zeros(self.max_blocks_per_slot, np.int32)
         row[:n_shared] = shared
-        row[n_shared:nb_total] = fresh
-        st = _Slot(greq=greq, request=req, n_generated=0, last_token=0,
+        row[n_shared:n_entries] = fresh
+        st = _Slot(greq=greq, request=req,
+                   n_generated=greq.resume_step, last_token=0,
                    length=P, blocks=held, prefix_len=P,
-                   pending=deque(int(t) for t in greq.prompt), cow=cow)
+                   pending=deque(feed), cow=cow, n_entries=n_entries,
+                   resumed=resumed)
         with self._wd_lock:
             seated = self._epoch == epoch and not self._stop.is_set()
             if seated:
@@ -1184,14 +1440,205 @@ class GenerationEngine(ResilientEngineMixin):
                 self._finish_request(req.trace, "watchdog",
                                      tenant=req.tenant)
             return
-        prefix.hits += 1
-        self.metrics.prefix_hits_total.inc()
+        if prefix is not None and not resumed:
+            prefix.hits += 1
+            self.metrics.prefix_hits_total.inc()
+        if cached is not None:
+            self.metrics.prefix_cache_hits_total.inc()
         if cow is not None:
             self.metrics.kv_cow_copies_total.inc()
         req.trace.event("slot.assign", slot=i, prefix_id=greq.prefix_id,
                         shared_blocks=n_shared + (1 if cow else 0),
-                        fresh_blocks=len(fresh))
+                        fresh_blocks=len(fresh),
+                        cached_tokens=P if cached is not None else 0,
+                        resumed=resumed)
         self._update_block_gauges()
+
+    # --------------------------------- on-demand growth + QoS preemption
+    def _grow_block_tables(self, epoch: int) -> bool:
+        """Map a fresh block into every live slot whose NEXT write (at
+        position ``st.length``) falls past its mapped entries — the
+        on-demand allocator's per-iteration work, host-side only: the
+        fixed-width table row grows an entry, the donated decode
+        signature is untouched. Returns False when a watchdog restart
+        staled this epoch (the caller abandons the iteration)."""
+        B = self.block_size
+        while True:
+            needy = None
+            with self._wd_lock:
+                if self._epoch != epoch or self._stop.is_set():
+                    return False
+                for i, st in enumerate(self._slots):
+                    if st is None:
+                        continue
+                    if blocks_for_tokens(st.length + 1, B) > st.n_entries:
+                        needy = (i, st)
+                        break
+            if needy is None:
+                return True
+            if not self._grow_slot(needy[0], needy[1], epoch):
+                return False
+
+    def _grow_slot(self, i: int, st: _Slot, epoch: int) -> bool:
+        """Allocate ONE block for slot ``i``'s boundary crossing,
+        reclaiming — automatic-prefix-cache eviction first, then
+        QoS-aware preemption — when the pool is dry. Returns False only
+        when the epoch staled; a self-preempted slot returns True and
+        the caller's re-scan finds it gone."""
+        while True:
+            alloc = self._allocator
+            try:
+                blocks = alloc.alloc(1)
+            except KVBlocksExhaustedError:
+                blocks = None
+            if blocks is not None:
+                with self._wd_lock:
+                    current = self._epoch == epoch
+                    seated = current and self._slots[i] is st
+                    if seated:
+                        st.n_entries = self._grow_table(
+                            self._tables, i, st.n_entries, blocks[0])
+                        st.blocks.append(blocks[0])
+                if not seated:
+                    # slot re-tenanted (restart) or stream gone: return
+                    # the block — captured allocator, stale one is inert
+                    alloc.free(blocks)
+                return current if not seated else True
+            # pool dry: unpinned cache entries are the cheap reclaim
+            if self._prefix_cache is not None and len(self._prefix_cache):
+                if self._cache_evict(1):
+                    continue
+            outcome = self._preempt_for(i, st, epoch)
+            if outcome == "stale":
+                return False
+            if outcome == "self":
+                return True      # slot i was evicted; caller re-scans
+            # outcome == "freed": retry the allocation
+
+    def _preempt_for(self, needy_i: int, needy_st: _Slot,
+                     epoch: int) -> str:
+        """The pool cannot serve slot ``needy_i``'s next block: evict ONE
+        resident stream and requeue it for recompute-on-resume (vLLM
+        §4.5). Victim policy — QoS-aware, strict priority first: only
+        same-or-LOWER classes than the needy stream are eligible (a
+        batch stream never evicts interactive work), non-``preemptible``
+        tenants are exempt, and within the eligible set the lowest
+        class, then the largest block footprint, then the latest arrival
+        goes first (one eviction frees the most for the least recompute
+        debt). With no eligible victim the needy stream preempts ITSELF
+        and waits in queue as the block-waiter. Returns 'freed' (a
+        victim's blocks are back), 'self' (the needy slot was evicted),
+        or 'stale' (watchdog restart owns the table)."""
+        needy_rank = PRIORITIES.index(needy_st.request.priority)
+        victim = None
+        with self._wd_lock:
+            if self._epoch != epoch:
+                return "stale"
+            best = None
+            for j, st in enumerate(self._slots):
+                if st is None or st is needy_st:
+                    continue
+                if st.request.future.done():
+                    continue   # terminal delivered; retire tail owns it
+                rank = PRIORITIES.index(st.request.priority)
+                if rank < needy_rank:
+                    continue   # never evict a higher class
+                if self.qos is not None and not self.qos.tenant(
+                        st.request.tenant).preemptible:
+                    continue
+                key = (rank, len(st.blocks or ()), st.request.submit_t)
+                if best is None or key > best[0]:
+                    best = (key, j, st)
+            if best is not None:
+                victim = (best[1], best[2])
+            else:
+                victim = (needy_i, needy_st)
+            j, vst = victim
+            # evict under the lock with the epoch verified: the blocks
+            # are freed exactly once — a zombie cannot reach here (the
+            # epoch check above), and _reset_cache replaces the
+            # allocator wholesale on restart (PR 6 _clear_slot
+            # discipline, extended to eviction)
+            self._slots[j] = None
+            self._tables[j] = 0
+            blocks, vst.blocks = vst.blocks, None
+            if blocks:
+                self._allocator.free_batch([blocks])
+        greq = vst.greq
+        req = vst.request
+        greq.resume_tokens = np.asarray(greq.handle.tokens_so_far(),
+                                        np.int32)
+        greq.resume_step = vst.n_generated
+        greq.preemptions += 1
+        self.metrics.preemptions_total.inc()
+        req.trace.event("preempt", slot=j,
+                        tokens_generated=vst.n_generated,
+                        blocks_freed=len(blocks or ()),
+                        self_preempted=vst is needy_st)
+        self._recorder.record("stream.preempt", engine=self.name,
+                              slot=j, tenant=req.tenant,
+                              tokens_generated=vst.n_generated,
+                              blocks=len(blocks or ()))
+        # deadline bounded QUEUE time and this stream already served it:
+        # the recompute requeue must not convert a long generation into
+        # a 'deadline' shed (see MIGRATING.md)
+        req.deadline_t = None
+        if self._stop.is_set():
+            self._shed_typed(req, PreemptedError(
+                f"stream preempted after {vst.n_generated} token(s) "
+                "while the engine was shutting down — resubmit",
+                tokens_generated=vst.n_generated))
+        else:
+            self._admission.requeue_head(req)
+            self.metrics.queue_depth.set(self._admission.depth_requests)
+        return "self" if vst is needy_st else "freed"
+
+    def _maybe_cache_retired(self, i: int, st: _Slot):
+        """Offer a normally-retired stream's FULL blocks to the
+        automatic prefix cache instead of freeing them (caller holds
+        ``_wd_lock`` with the epoch verified — the decode retire tail).
+        Only the block-aligned span whose K/V the table actually holds
+        is kept (``st.length`` positions: the retiring token's own K/V
+        was never written), covered by the stream's prompt + generated
+        tokens; explicit-prefix streams are skipped (their shared span
+        is already pinned and the pin owns its lifecycle)."""
+        cache = self._prefix_cache
+        if cache is None or st.greq.prefix_id is not None \
+                or st.blocks is None:
+            return
+        B = self.block_size
+        m = st.length // B
+        if m <= 0 or st.n_entries < m:
+            return
+        gen = st.greq.handle.tokens_so_far()
+        seq = np.concatenate([np.asarray(st.greq.prompt, np.int32),
+                              np.asarray(gen, np.int32)])
+        if seq.size < m * B:
+            return   # bookkeeping mismatch: freeing normally is safe
+        row = [int(b) for b in self._tables[i][:m]]
+        try:
+            self._allocator.incref(row)   # the cache's own reference
+        except ValueError:
+            return   # shouldn't happen (stream holds refs); stay safe
+        before = len(cache)
+        kept = cache.insert(seq[:m * B], row)
+        if kept:
+            self.metrics.prefix_cache_inserts_total.inc()
+        evicted = before + (1 if kept else 0) - len(cache)
+        if evicted > 0:
+            self.metrics.prefix_cache_evictions_total.inc(evicted)
+
+    def _cache_evict(self, need_blocks: int, protect=None) -> int:
+        """Evict LRU automatic-prefix-cache entries (scheduler thread
+        only), counting evictions into metrics. Returns the references
+        released."""
+        cache = self._prefix_cache
+        before = len(cache)
+        released = cache.evict(need_blocks, protect=protect)
+        evicted = before - len(cache)
+        if evicted > 0:
+            self.metrics.prefix_cache_evictions_total.inc(evicted)
+        return released
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -1250,20 +1697,33 @@ class GenerationEngine(ResilientEngineMixin):
 
     def _prefill_into(self, slot: int, req: Request, epoch: int):
         greq: GenerationRequest = req.x
-        n = int(greq.prompt.size)
+        resumed = greq.resume_tokens is not None
+        toks = greq.prompt
+        if resumed:
+            # recompute-on-resume (the vLLM §4.5 policy): the victim's
+            # generated-so-far tokens ride the prompt through ONE
+            # prefill, and the trailing sample is drawn at its next
+            # token index (the `step` argument) — position-stable keys
+            # make the resumed stream bitwise the unpreempted one
+            toks = np.concatenate(
+                [greq.prompt, np.asarray(greq.resume_tokens, np.int32)])
+        n = int(toks.size)
         bucket = self._bucket_for(n)
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = greq.prompt
-        req.trace.event("slot.assign", slot=slot, bucket=bucket)
+        padded[0, :n] = toks
+        req.trace.event("slot.assign", slot=slot, bucket=bucket,
+                        resumed=resumed)
         alloc = blocks = row = None
+        nb_total = 0
         if self.paged:
-            # worst-case reservation, gated by _plan_blocks: every block
-            # this stream can ever touch is taken up front, so decode can
-            # never hit mid-stream exhaustion (preemption/recompute of
-            # evicted streams is the on-demand follow-up, see ROADMAP)
+            # reservation gated by _plan_blocks: under "reserve" every
+            # block this stream can ever touch is taken up front (decode
+            # never hits mid-stream exhaustion); under "on_demand" only
+            # the prompt's blocks (plus the first write target) — the
+            # decode loop allocates one block per boundary crossing and
+            # preempts when the pool is dry
             alloc = self._allocator
-            nb_total = blocks_for_tokens(n + greq.max_new_tokens,
-                                         self.block_size)
+            nb_total = self._blocks_needed(greq, None, admit=True)
             blocks = alloc.alloc(nb_total)
             row = np.zeros(self.max_blocks_per_slot, np.int32)
             row[:nb_total] = blocks
@@ -1284,7 +1744,8 @@ class GenerationEngine(ResilientEngineMixin):
                                 bucket, self.block_size)]),
                             np.int32(n), greq.key,
                             np.float32(greq.temperature),
-                            np.int32(greq.top_k))
+                            np.int32(greq.top_k),
+                            np.int32(greq.resume_step))
                     return self._donated_call(
                         "generation.prefill", self._prefill,
                         self.params, self._cache, padded, np.int32(slot),
@@ -1323,11 +1784,19 @@ class GenerationEngine(ResilientEngineMixin):
         req.trace.event("prefill", dur_ms=round((now - t0) * 1e3, 3),
                         slot=slot, bucket=bucket, prompt=n)
         self.metrics.prefill_ms.observe((now - t0) * 1e3)
-        self.metrics.ttft_ms.observe((now - req.submit_t) * 1e3)
+        if greq.resume_step == 0:
+            # this IS the stream's first token — including a victim
+            # preempted before it ever emitted one (resume_step 0):
+            # its preemption-inflated TTFT is exactly what the
+            # histogram must see. A resume_step > 0 stream's TTFT was
+            # recorded at its original first token; never re-count.
+            self.metrics.ttft_ms.observe((now - req.submit_t) * 1e3)
         self.metrics.prefills_total.inc()
         self.metrics.generated_tokens_total.inc()
-        state = _Slot(greq=greq, request=req, n_generated=1, last_token=tok,
-                      length=n, blocks=blocks)
+        state = _Slot(greq=greq, request=req,
+                      n_generated=greq.resume_step + 1, last_token=tok,
+                      length=n, blocks=blocks, n_entries=nb_total,
+                      resumed=resumed)
         err = greq.handle._push(tok)
         if err is not None:
             # broken on_token consumer failed its own stream at token 0:
@@ -1398,6 +1867,14 @@ class GenerationEngine(ResilientEngineMixin):
         the previous step's dispatch completed when its sampled tokens
         were read back, so the arrays are free to reuse."""
         S = self.slots
+        if self.paged and self.allocate == "on_demand":
+            # on-demand block growth: every live slot whose next write
+            # crosses a block boundary gets one fresh block mapped into
+            # its (fixed-width) table row — preempting residents when
+            # the pool is dry. Runs BEFORE the slot snapshot: a stream
+            # preempted here must not be staged into this step.
+            if not self._grow_block_tables(epoch):
+                return   # epoch staled mid-growth: the restart owns it
         tokens, live, keys = buf["tokens"], buf["live"], buf["keys"]
         steps, temps, top_ks = buf["steps"], buf["temps"], buf["top_ks"]
         lengths = buf["lengths"]
@@ -1497,14 +1974,20 @@ class GenerationEngine(ResilientEngineMixin):
                     st.last_token = tok
                     reason = self._retire_reason(st, tok)
                     if reason is not None:
+                        self._maybe_cache_retired(i, st)
                         self._clear_slot(i, st)  # freed for NEXT admission
             if fed_only:
                 st.request.trace.event("prompt.feed", slot=i,
                                        remaining=len(st.pending))
                 continue
             emitted += 1
-            if first_token:
-                # prefix streams have no prefill: token 0 lands here
+            if first_token and st.greq.resume_step == 0:
+                # prefix/feed streams have no prefill: token 0 lands
+                # here — including a victim preempted mid-feed before
+                # any token (resume_step 0), whose preemption-inflated
+                # TTFT must still be observed exactly once. A
+                # resume_step > 0 feed's "first" token is mid-stream;
+                # its TTFT was recorded at the original first token.
                 self.metrics.ttft_ms.observe(
                     (now - st.request.submit_t) * 1e3)
             st.request.trace.event("decode.step", step=st.n_generated - 1,
@@ -1729,16 +2212,29 @@ class GenerationEngine(ResilientEngineMixin):
         room for 2 generated tokens) still compiles, via a 1-token
         stream."""
         prev = 0
-        for b in self.buckets:
-            n, prev = prev + 1, b
-            new = min(2, self.max_len - n)
-            if new < 1:
-                continue   # rung admits no prompt at all (n == max_len)
-            # eos_id=None: an engine-level eos_id matching the warmup
-            # continuation would retire every stream at prefill and leave
-            # the decode executable uncompiled
-            self.generate(np.zeros(n, np.int32), max_new_tokens=new,
-                          eos_id=None, timeout=300.0)
+        self._cache_bypass = True   # every rung must actually PREFILL —
+        #   an automatic-prefix-cache hit on an earlier rung's retired
+        #   blocks would route the probe through the decode-feed path
+        #   and leave that rung's prefill uncompiled
+        try:
+            for b in self.buckets:
+                n, prev = prev + 1, b
+                new = min(2, self.max_len - n)
+                if new < 1:
+                    continue   # rung admits no prompt at all (n == max_len)
+                # eos_id=None: an engine-level eos_id matching the warmup
+                # continuation would retire every stream at prefill and
+                # leave the decode executable uncompiled
+                self.generate(np.zeros(n, np.int32), max_new_tokens=new,
+                              eos_id=None, timeout=300.0)
+        finally:
+            self._cache_bypass = False
+            if self._prefix_cache is not None:
+                # drop the probes' retired blocks: zero-token warmup
+                # prompts must not squat the bounded LRU (or match real
+                # traffic). The cache locks internally, and a racing
+                # match_and_ref holds its own block refs — no torn state
+                self._prefix_cache.release_all()
         return self
 
 

@@ -263,6 +263,22 @@ class ServingMetrics:
         # token (the partially-filled tail blocks) — the paged design's
         # bounded waste, vs the contiguous cache's (max_len - len)/max_len
         self.kv_fragmentation = Gauge("kv_fragmentation")
+        # reservation slack: blocks RESERVED by resident streams but not
+        # yet holding any written token — the worst-case-generation tail
+        # allocate="reserve" pays up front and allocate="on_demand"
+        # recovers (at most ~1 block/stream stays slack there). Split
+        # from kv_fragmentation on purpose: fragmentation is tail waste
+        # WITHIN touched blocks, slack is whole untouched blocks
+        self.kv_reservation_slack = Gauge("kv_reservation_slack")
+        # ---- automatic prefix cache (paging.PrefixCache) ------------------
+        self.prefix_cache_hits_total = Counter("prefix_cache_hits_total")
+        self.prefix_cache_inserts_total = Counter(
+            "prefix_cache_inserts_total")
+        self.prefix_cache_evictions_total = Counter(
+            "prefix_cache_evictions_total")
+        self.prefix_cache_blocks = Gauge("prefix_cache_blocks")
+        # ---- preemption (allocate="on_demand" recompute-on-resume) --------
+        self.preemptions_total = Counter("preemptions_total")
         # dtype-aware HBM accounting (paging.kv_bytes_per_token is the one
         # formula): int8 pools report their true 1-byte-values +
         # fp32-scale footprint, so "how much HBM does the cache hold" and
@@ -438,7 +454,10 @@ class ServingMetrics:
             self.faults_injected_total, self.poisoned_results_total,
             self.prefix_prefills_total, self.prefix_hits_total,
             self.kv_cow_copies_total, self.quota_rejections_total,
-            self.slo_sheds_total, self.retry_budget_exhausted_total)}
+            self.slo_sheds_total, self.retry_budget_exhausted_total,
+            self.preemptions_total, self.prefix_cache_hits_total,
+            self.prefix_cache_inserts_total,
+            self.prefix_cache_evictions_total)}
 
     def decode_tokens_per_sec(self) -> float:
         """Steady-state decode throughput: tokens sampled by decode_step
@@ -478,6 +497,8 @@ class ServingMetrics:
             "kv_blocks_pinned": self.kv_blocks_pinned.value,
             "kv_block_occupancy": self.kv_block_occupancy.value,
             "kv_fragmentation": self.kv_fragmentation.value,
+            "kv_reservation_slack": self.kv_reservation_slack.value,
+            "prefix_cache_blocks": self.prefix_cache_blocks.value,
             "kv_block_bytes": self.kv_block_bytes.value,
             "kv_pool_hbm_bytes": self.kv_pool_hbm_bytes.value,
             "kv_hbm_bytes_in_use": self.kv_hbm_bytes_in_use.value,

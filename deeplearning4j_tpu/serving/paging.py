@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -129,14 +129,43 @@ class BlockAllocator:
         the free list. Freeing a block that is already free raises (the
         double-free guard)."""
         with self._lock:
+            self._free_locked(blocks)
+
+    def free_batch(self, block_lists: Sequence[Sequence[int]]):
+        """Free SEVERAL block lists under ONE lock acquisition — the
+        preemption path's shape: evicting a victim (or several) returns
+        dozens of blocks at once, and taking the lock per list would
+        interleave a concurrent ``alloc`` between them, handing a later
+        admission part of a victim's footprint while the rest is still
+        nominally held. Validation runs across every list before any
+        mutation, so a double free leaves the allocator untouched."""
+        counts: dict = {}
+        for blocks in block_lists:
+            for b in blocks:
+                counts[b] = counts.get(b, 0) + 1
+        with self._lock:
+            for b, n in counts.items():
+                # a block may legitimately appear in several lists (two
+                # victims sharing a prefix block hold one ref each) — the
+                # batch must not drop more refs than the block holds
+                if self._ref[b] < n:
+                    raise ValueError(
+                        f"double free of block {b}: {n} refs dropped in "
+                        f"one batch but refcount is {int(self._ref[b])}")
+            for blocks in block_lists:
+                self._free_locked(blocks, validated=True)
+
+    def _free_locked(self, blocks: Sequence[int], validated: bool = False):
+        """Caller holds ``_lock``."""
+        if not validated:
             for b in blocks:
                 if self._ref[b] <= 0:
                     raise ValueError(
                         f"double free of block {b}: refcount already 0")
-            for b in blocks:
-                self._ref[b] -= 1
-                if self._ref[b] == 0:
-                    self._free.append(b)
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
 
 
 @dataclasses.dataclass
@@ -161,5 +190,218 @@ class SharedPrefix:
         return self.blocks is not None
 
 
-__all__ = ["BlockAllocator", "SharedPrefix", "blocks_for_tokens",
-           "kv_bytes_per_token"]
+@dataclasses.dataclass
+class _CacheEntry:
+    """One retired stream's reusable prefix: its FULL blocks (length a
+    multiple of the block size) and the tokens whose K/V they hold. The
+    entry owns one allocator reference per block."""
+
+    tokens: np.ndarray                 # (m * block_size,) int32
+    blocks: List[int]                  # m physical block ids, in order
+
+
+class PrefixCache:
+    """Automatic longest-token-prefix cache over retired streams' FULL
+    KV blocks (SGLang RadixAttention's policy on PR 6's block pool): when
+    a stream retires, its fully-written blocks — prompt and generated
+    tokens alike — are kept instead of freed, and a later admission whose
+    prompt starts with the same tokens references them directly, skipping
+    that much prefill compute. No API opt-in: chat traffic with a shared
+    system prompt hits automatically.
+
+    Matching is block-granular: only whole blocks are reusable (a partial
+    tail block's remaining positions would be written by the new stream,
+    corrupting the retired copy — the explicit ``register_prefix`` path
+    copy-on-writes exactly that tail, and entries here are truncated to
+    full blocks so no CoW is ever needed). Entries are a bounded LRU by
+    total blocks held (``capacity_blocks``); eviction — LRU first, and
+    on-demand when the engine needs blocks back — drops the entry's
+    references through the SAME :class:`BlockAllocator` refcounts every
+    other holder uses, so an entry sharing blocks with a live stream (or
+    another entry) frees only its own reference. Unpinned by
+    construction: nothing here blocks reclamation, which is why cached
+    blocks do NOT count against ``kv_blocks_usable``.
+
+    Thread safety: all entry-list operations run under the cache's own
+    lock (the scheduler thread matches/inserts/evicts; ``warmup``/
+    ``drain`` release from the caller's thread). The match→seat handoff
+    is made safe by :meth:`match_and_ref`, which takes the caller's
+    allocator references ATOMICALLY with the match — an entry released
+    or evicted a microsecond later cannot pull the matched blocks out
+    from under the seat (the caller's refs keep them alive).
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int,
+                 capacity_blocks: int):
+        if capacity_blocks <= 0:
+            raise ValueError(
+                f"capacity_blocks must be positive, got {capacity_blocks}")
+        self.allocator = allocator
+        self.block_size = block_size
+        self.capacity_blocks = capacity_blocks
+        self._entries: List[_CacheEntry] = []   # LRU order: [0] is oldest
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.inserts = 0
+        self.evictions = 0
+
+    # -------------------------------------------------------------- sizing
+    @property
+    def total_blocks(self) -> int:
+        with self._lock:
+            return sum(len(e.blocks) for e in self._entries)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # ------------------------------------------------------------ lifecycle
+    def insert(self, tokens: np.ndarray, blocks: Sequence[int]) -> bool:
+        """Offer a retired stream's leading blocks. ``tokens`` are the
+        positions those blocks hold (len == len(blocks) * block_size, the
+        caller truncates to full blocks); the caller transfers ONE
+        allocator reference per block — on rejection (duplicate coverage,
+        or an entry larger than the whole cache) the refs are freed here.
+        Returns True when the entry was retained."""
+        B = self.block_size
+        blocks = list(blocks)
+        if not blocks or len(tokens) != len(blocks) * B:
+            if blocks:
+                self.allocator.free(blocks)
+            return False
+        if len(blocks) > self.capacity_blocks:
+            self.allocator.free(blocks)
+            return False
+        with self._lock:
+            for e in self._entries:
+                if len(e.tokens) >= len(tokens) and np.array_equal(
+                        e.tokens[:len(tokens)], tokens):
+                    # an existing entry already covers this prefix
+                    # (>= length): keep the older, longer one — rejecting
+                    # the duplicate keeps hot system prompts from
+                    # crowding the LRU with identical copies
+                    self.allocator.free(blocks)
+                    return False
+            self._entries.append(_CacheEntry(
+                tokens=np.ascontiguousarray(tokens, dtype=np.int32),
+                blocks=blocks))
+            self.inserts += 1
+            over = sum(len(e.blocks) for e in self._entries) \
+                - self.capacity_blocks
+            if over > 0:
+                self._evict_locked(over, protect=self._entries[-1])
+        return True
+
+    def match(self, tokens: np.ndarray
+              ) -> Optional[Tuple[_CacheEntry, int]]:
+        """Longest block-aligned prefix of ``tokens`` held by any entry:
+        ``(entry, m)`` with m >= 1 matched blocks (``entry.blocks[:m]``,
+        covering ``tokens[:m * B]``) — or None. At most
+        ``(len(tokens) - 1) // B`` blocks match: the stream must keep at
+        least one token to feed through the decode executable (the
+        position whose logits seed its first sample). The matched entry
+        moves to MRU; the caller increfs the matched blocks before
+        touching them (the cache keeps its own reference either way) and
+        may pass the entry to :meth:`evict` as ``protect``."""
+        with self._lock:
+            hit = self._match_locked(tokens)
+            return None if hit is None else (hit[0], hit[1])
+
+    def match_and_ref(self, tokens: np.ndarray
+                      ) -> Optional[Tuple[_CacheEntry, int, List[int]]]:
+        """:meth:`match`, plus one allocator reference per matched block
+        taken ATOMICALLY under the cache lock — the handoff the seating
+        path needs: once this returns, a concurrent ``release_all`` /
+        ``evict`` of the entry only drops the CACHE's reference; the
+        caller's refs keep the matched blocks (and their K/V) alive
+        until it frees or seats them. Returns ``(entry, m, blocks)``
+        where ``blocks`` is the caller-owned ref'd list."""
+        with self._lock:
+            hit = self._match_locked(tokens)
+            if hit is None:
+                return None
+            e, m = hit
+            blocks = list(e.blocks[:m])
+            self.allocator.incref(blocks)
+            return e, m, blocks
+
+    def _match_locked(self, tokens: np.ndarray):
+        toks = np.asarray(tokens)
+        max_m = (int(toks.size) - 1) // self.block_size
+        if max_m <= 0:
+            return None
+        best_i, best_m = -1, 0
+        for i, e in enumerate(self._entries):
+            m = self._common_blocks(e.tokens, toks, max_m)
+            if m > best_m:
+                best_i, best_m = i, m
+        if best_m <= 0:
+            return None
+        e = self._entries.pop(best_i)
+        self._entries.append(e)        # MRU
+        self.hits += 1
+        return e, best_m
+
+    def _common_blocks(self, a: np.ndarray, b: np.ndarray,
+                       cap: int) -> int:
+        """Whole blocks of common prefix between two token arrays —
+        forward block-by-block scan, stopping at the first mismatching
+        block (linear in the match length, not quadratic in the prompt:
+        this runs per entry on every paged admission)."""
+        B = self.block_size
+        n = min(int(a.size), int(b.size), cap * B) // B
+        m = 0
+        for k in range(n):
+            if not np.array_equal(a[k * B:(k + 1) * B],
+                                  b[k * B:(k + 1) * B]):
+                break
+            m += 1
+        return m
+
+    def evict(self, need_blocks: int,
+              protect: Optional[_CacheEntry] = None) -> int:
+        """Drop LRU entries (never ``protect``) until ``need_blocks``
+        block references have been released or the cache is empty.
+        Returns the references released — blocks also referenced by live
+        streams or sibling entries return to the free list only when
+        their LAST holder lets go, so the caller re-checks the
+        allocator's ``free_count`` rather than trusting this figure."""
+        with self._lock:
+            return self._evict_locked(need_blocks, protect)
+
+    def _evict_locked(self, need_blocks: int,
+                      protect: Optional[_CacheEntry] = None) -> int:
+        released = 0
+        i = 0
+        while released < need_blocks and i < len(self._entries):
+            e = self._entries[i]
+            if e is protect:
+                i += 1
+                continue
+            self._entries.pop(i)
+            self.allocator.free(e.blocks)
+            released += len(e.blocks)
+            self.evictions += 1
+        return released
+
+    def release_all(self):
+        """Free every entry's references (graceful drain, or warmup
+        dropping its probe entries: cached blocks return to the pool so
+        the heartbeat's free-block view goes back to capacity). Safe
+        against a concurrent match_and_ref: that caller's own refs keep
+        its matched blocks alive."""
+        with self._lock:
+            for e in self._entries:
+                self.allocator.free(e.blocks)
+            self._entries = []
+
+    def invalidate(self):
+        """Drop every entry WITHOUT freeing — the pool (and allocator)
+        died under a cache rebuild; the old references are void and the
+        fresh allocator must never see them."""
+        with self._lock:
+            self._entries = []
+
+
+__all__ = ["BlockAllocator", "PrefixCache", "SharedPrefix",
+           "blocks_for_tokens", "kv_bytes_per_token"]

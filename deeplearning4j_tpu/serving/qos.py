@@ -138,6 +138,16 @@ class TenantPolicy:
     # capacity_rows while WFQ only arbitrates what is already queued.
     # Excess sheds typed 'quota_exceeded' at admit.
     max_queued: Optional[int] = None
+    # whether this tenant's RESIDENT generation streams may be evicted by
+    # the on-demand KV allocator's preemption (allocate="on_demand",
+    # serving/generation.py): preemption already respects strict priority
+    # (a stream never evicts a higher class), and within that order
+    # preemptible=False exempts a tenant entirely — for workloads whose
+    # recompute-on-resume cost is unacceptable (very long generations,
+    # per-token billing). A stream may still preempt ITSELF when the pool
+    # cannot serve its next block any other way; this flag only shields
+    # the tenant from being chosen as someone else's victim.
+    preemptible: bool = True
 
     def __post_init__(self):
         if self.weight <= 0:
@@ -302,7 +312,8 @@ class QosPolicy:
         return {
             "tenants": {n: {"weight": t.weight, "priority": t.priority,
                             "quota": t.quota, "quota_burst": t.quota_burst,
-                            "max_queued": t.max_queued}
+                            "max_queued": t.max_queued,
+                            "preemptible": t.preemptible}
                         for n, t in self.tenants.items()},
             "default_weight": self.default_weight,
             "default_priority": self.default_priority,

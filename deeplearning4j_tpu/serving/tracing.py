@@ -76,6 +76,12 @@ TERMINAL_REASONS = (
     # whose wire payload could not be interpreted (malformed/mid-upgrade
     # schema) — distinct from host_unavailable because the host answered
     "host_draining", "rpc_error",
+    # on-demand KV allocation (serving/generation.py allocate="on_demand"):
+    # a preempted stream that could not be requeued for recompute-on-resume
+    # (admission closed mid-preemption, or the resume demand can never fit
+    # the pool again) — distinct from kv_blocks_exhausted because the
+    # caller already received tokens and should resubmit the WHOLE request
+    "preempted",
 )
 
 

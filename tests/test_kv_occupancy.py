@@ -1,0 +1,662 @@
+"""KV occupancy -> 1.0 (ISSUE 13): on-demand block allocation, QoS-aware
+preemption with recompute-on-resume, and the automatic prefix cache
+(serving/paging.py + serving/generation.py + serving/cluster.py).
+
+Acceptance criteria exercised here:
+- ``allocate="reserve"`` (the default) stays bitwise-identical to the
+  pre-on-demand engine, and ``allocate="on_demand"`` greedy/sampled
+  streams equal their reserve-mode twins token for token;
+- a preempted-then-resumed stream — evicted mid-generation to reclaim KV
+  blocks, requeued through the prefill path with its generated-so-far
+  tokens appended to the prompt — is bitwise-equal to its unpreempted
+  run (per-request keys fold the token index, so sampling is
+  position-stable), and the ONE-donated-executable bound
+  ``len(buckets) + 1`` holds throughout;
+- preemption respects QoS: victims are chosen lowest-class-first, a
+  stream never evicts a higher class, ``TenantPolicy.preemptible=False``
+  exempts a tenant, and an unresumable victim sheds typed 'preempted';
+- the automatic prefix cache reuses retired streams' full blocks on a
+  longest block-aligned token-prefix match with NO API opt-in, bounded
+  by an LRU, bitwise-inert on stream content;
+- preemption racing the watchdog/cache-rebuild path frees a victim's
+  epoch-staled blocks exactly once (the PR 6 _clear_slot discipline).
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import TransformerConfig, init_params
+from deeplearning4j_tpu.serving import (
+    BlockAllocator, GenerationEngine, KVBlocksExhaustedError,
+    PreemptedError, PrefixCache, QosPolicy, blocks_for_tokens,
+)
+
+CFG = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                        mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                        causal=True, attention_impl="full", remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# BlockAllocator.free_batch: a victim's whole footprint under one lock
+# ---------------------------------------------------------------------------
+class TestFreeBatch:
+    def test_frees_multiple_lists_atomically(self):
+        a = BlockAllocator(9)
+        x, y = a.alloc(3), a.alloc(2)
+        a.free_batch([x, y])
+        assert a.free_count == a.capacity
+        assert all(a.refcount(b) == 0 for b in x + y)
+
+    def test_double_free_across_batch_is_rejected_untouched(self):
+        a = BlockAllocator(9)
+        x = a.alloc(2)
+        with pytest.raises(ValueError, match="double free"):
+            a.free_batch([x, x])          # refcount 1, two drops
+        # validation ran BEFORE any mutation: nothing was freed
+        assert all(a.refcount(b) == 1 for b in x)
+        assert a.in_use == 2
+
+    def test_shared_block_with_enough_refs_frees_per_holder(self):
+        a = BlockAllocator(9)
+        x = a.alloc(2)
+        a.incref(x)                        # two holders
+        a.free_batch([x, x])               # both drop in one batch
+        assert a.free_count == a.capacity
+
+
+# ---------------------------------------------------------------------------
+# On-demand allocation: prompt-blocks-only seating, lazy growth
+# ---------------------------------------------------------------------------
+class TestOnDemandAllocation:
+    def test_on_demand_greedy_equals_reserve(self, params):
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8) as eng:
+            want = eng.generate(prompt(5), max_new_tokens=12,
+                                eos_id=None, timeout=120)
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8,
+                              allocate="on_demand") as eng:
+            got = eng.generate(prompt(5), max_new_tokens=12,
+                               eos_id=None, timeout=120)
+            assert eng.compiled_signatures() <= len(eng.buckets) + 1
+        assert got == want
+
+    def test_seat_demand_is_prompt_blocks_only(self, params):
+        # worst case: ceil((4+24)/8) = 4 blocks; pool capacity 4 — reserve
+        # can hold ONE such stream, on_demand seats BOTH (1 block each)
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, num_blocks=5,
+                              allocate="on_demand",
+                              queue_capacity=8) as eng:
+            assert eng._fresh_blocks_needed(0, 4, 24) == 4
+            assert eng._fresh_blocks_needed(0, 4, 24, admit=True) == 1
+
+    def test_structural_gate_keeps_worst_case(self, params):
+        # a request whose WHOLE footprint exceeds the pool can never
+        # complete under any allocator: still sheds typed at submit
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, num_blocks=3,
+                              allocate="on_demand") as eng:
+            with pytest.raises(KVBlocksExhaustedError) as ei:
+                eng.submit(prompt(4), max_new_tokens=24)
+            assert ei.value.reason == "kv_blocks_exhausted"
+
+    def test_on_demand_rejects_contiguous_cache(self, params):
+        with pytest.raises(ValueError, match="on_demand.*paged"):
+            GenerationEngine(params, CFG, slots=2, max_len=32,
+                             paged=False, allocate="on_demand")
+        with pytest.raises(ValueError, match="allocate must be"):
+            GenerationEngine(params, CFG, slots=2, max_len=32,
+                             block_size=8, allocate="lazy")
+
+    def test_reservation_slack_gauge_splits_from_fragmentation(
+            self, params):
+        """reserve holds worst-case tail blocks idle (slack > 0);
+        on_demand keeps at most the next write target (slack 0 at
+        seating). Sampled deterministically: a blocking on_token wedges
+        the scheduler right after the post-prefill gauge update."""
+        import threading
+
+        for allocate, want_slack in (("reserve", 2), ("on_demand", 0)):
+            seen = threading.Event()
+            release = threading.Event()
+            slack = []
+
+            def hold(tok, _n=[0]):
+                _n[0] += 1
+                if _n[0] == 2:     # token 2: post-prefill gauges landed
+                    seen.set()
+                    release.wait(30)
+
+            with GenerationEngine(params, CFG, slots=2, max_len=32,
+                                  block_size=8,
+                                  allocate=allocate) as eng:
+                h = eng.submit(prompt(4), max_new_tokens=20, eos_id=None,
+                               on_token=hold)
+                assert seen.wait(60)
+                slack.append(eng.metrics.kv_reservation_slack.value)
+                release.set()
+                h.result(timeout=120)
+            # prompt 4 -> 1 touched block; reserve maps ceil(24/8)=3
+            assert slack[-1] == want_slack, (allocate, slack)
+
+
+# ---------------------------------------------------------------------------
+# Preemption with recompute-on-resume
+# ---------------------------------------------------------------------------
+class TestPreemption:
+    def test_preempted_stream_resumes_bitwise_equal(self, params):
+        """THE acceptance test: a tight pool forces eviction mid-stream;
+        both streams complete, the victim's tokens equal its unpreempted
+        (solo) run, and the signature bound holds."""
+        solo = []
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8) as eng:
+            for s in (0, 1):
+                solo.append(eng.generate(prompt(4, s), max_new_tokens=20,
+                                         eos_id=None, timeout=120))
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, num_blocks=5,
+                              allocate="on_demand",
+                              queue_capacity=8) as eng:
+            hs = [eng.submit(prompt(4, s), max_new_tokens=20, eos_id=None)
+                  for s in (0, 1)]
+            got = [h.result(timeout=120) for h in hs]
+            assert eng.metrics.preemptions_total.value >= 1
+            assert eng.compiled_signatures() <= len(eng.buckets) + 1
+            # TTFT exactly once per stream, preemptions notwithstanding
+            # (review find: the resume gate must key on resume_step, not
+            # the resumed flag, or victims could double- or zero-count)
+            assert eng.metrics.ttft_ms.count == 2
+        assert got == solo
+
+    def test_sampled_preempted_stream_is_position_stable(self, params):
+        solo = []
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8) as eng:
+            for s in (3, 4):
+                solo.append(eng.generate(
+                    prompt(4, s), max_new_tokens=20, temperature=1.0,
+                    top_k=8, seed=s, eos_id=None, timeout=120))
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, num_blocks=5,
+                              allocate="on_demand",
+                              queue_capacity=8) as eng:
+            hs = [eng.submit(prompt(4, s), max_new_tokens=20,
+                             temperature=1.0, top_k=8, seed=s,
+                             eos_id=None) for s in (3, 4)]
+            got = [h.result(timeout=120) for h in hs]
+            assert eng.metrics.preemptions_total.value >= 1
+        assert got == solo
+
+    def test_resume_through_feed_path_when_prompt_outgrows_ladder(
+            self, params):
+        """A custom short bucket ladder: the recompute prompt (original
+        prompt + generated tokens) exceeds the top prefill bucket, so
+        the victim rebuilds through the decode-feed path — slower, but
+        bitwise the same stream."""
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, buckets=(8,)) as eng:
+            solo = [eng.generate(prompt(4, s), max_new_tokens=20,
+                                 eos_id=None, timeout=120)
+                    for s in (0, 1)]
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, buckets=(8,), num_blocks=5,
+                              allocate="on_demand",
+                              queue_capacity=8) as eng:
+            hs = [eng.submit(prompt(4, s), max_new_tokens=20, eos_id=None)
+                  for s in (0, 1)]
+            got = [h.result(timeout=120) for h in hs]
+            assert eng.metrics.preemptions_total.value >= 1
+        assert got == solo
+
+    def test_victims_by_tenant_class_batch_first(self, params):
+        """QoS: the batch-class resident is evicted for the interactive
+        stream's boundary crossing, never the other way around."""
+        qos = QosPolicy(tenants={
+            "fast": {"priority": "interactive"},
+            "slow": {"priority": "batch"}})
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, num_blocks=5,
+                              allocate="on_demand", qos=qos,
+                              queue_capacity=8) as eng:
+            hb = eng.submit(prompt(4, 1), max_new_tokens=20, eos_id=None,
+                            tenant="slow")
+            ha = eng.submit(prompt(4, 0), max_new_tokens=20, eos_id=None,
+                            tenant="fast")
+            ra, rb = ha.result(timeout=120), hb.result(timeout=120)
+            assert eng.metrics.preemptions_total.value >= 1
+            # the interactive stream was never evicted
+            assert ha._req.x.preemptions == 0
+            assert hb._req.x.preemptions >= 1
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8) as eng:
+            assert ra == eng.generate(prompt(4, 0), max_new_tokens=20,
+                                      eos_id=None, timeout=120)
+            assert rb == eng.generate(prompt(4, 1), max_new_tokens=20,
+                                      eos_id=None, timeout=120)
+
+    def test_non_preemptible_tenant_is_exempt(self, params):
+        """preemptible=False shields a tenant from being chosen as
+        someone ELSE's victim: any eviction it suffers is a
+        self-preemption at its own boundary crossing (always legal —
+        the pool cannot serve it any other way)."""
+        from deeplearning4j_tpu.serving import Tracer
+
+        qos = QosPolicy(tenants={
+            "pinned": {"priority": "batch", "preemptible": False}})
+        tracer = Tracer(enabled=True, sample_rate=1.0)
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, num_blocks=5,
+                              allocate="on_demand", qos=qos,
+                              tracer=tracer, queue_capacity=8) as eng:
+            hp = eng.submit(prompt(4, 1), max_new_tokens=20, eos_id=None,
+                            tenant="pinned")
+            ho = eng.submit(prompt(4, 0), max_new_tokens=20, eos_id=None)
+            ho.result(timeout=120)
+            hp.result(timeout=120)
+            assert eng.metrics.preemptions_total.value >= 1
+            evictions = [a for name, _t, a in hp._req.trace.events
+                         if name == "preempt"]
+            # every eviction the pinned tenant suffered was BY ITSELF
+            assert all(a.get("self_preempted") for a in evictions)
+
+    def test_batch_never_evicts_interactive(self, params):
+        qos = QosPolicy(tenants={
+            "fast": {"priority": "interactive"},
+            "slow": {"priority": "batch"}})
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, num_blocks=5,
+                              allocate="on_demand", qos=qos,
+                              queue_capacity=8) as eng:
+            ha = eng.submit(prompt(4, 0), max_new_tokens=20, eos_id=None,
+                            tenant="fast")
+            hb = eng.submit(prompt(4, 1), max_new_tokens=20, eos_id=None,
+                            tenant="slow")
+            ha.result(timeout=120)
+            hb.result(timeout=120)
+            assert ha._req.x.preemptions == 0
+
+    def test_unresumable_victim_sheds_typed_preempted(self, params):
+        """Shared-prefix pins grow under a running stream; when its
+        blocks are gone and its footprint can never fit again, the
+        terminal is typed 'preempted' (tokens were already delivered —
+        the caller resubmits the whole request)."""
+        with GenerationEngine(params, CFG, slots=2, max_len=64,
+                              block_size=8, num_blocks=9,
+                              allocate="on_demand",
+                              queue_capacity=8) as eng:
+            # worst case: ceil((4+28)/8) = 4 of 8 usable blocks
+            h = eng.submit(prompt(4), max_new_tokens=28, eos_id=None)
+            while len(h.tokens_so_far()) < 2:
+                time.sleep(0.001)
+            # pin 5 blocks: usable drops to 3 < the stream's worst case
+            eng.register_prefix(prompt(40, seed=9), timeout=60.0)
+            with pytest.raises(PreemptedError) as ei:
+                h.result(timeout=120)
+            assert ei.value.reason == "preempted"
+            assert ei.value.tokens_generated >= 1
+            assert len(h.tokens_so_far()) >= 1
+            assert eng.metrics.rejections_by_reason.get("preempted") == 1
+            slo = eng.metrics.slo_snapshot()["60s"]["errors_by_reason"]
+            assert slo.get("preempted") == 1
+
+
+# ---------------------------------------------------------------------------
+# Automatic prefix cache (no API opt-in)
+# ---------------------------------------------------------------------------
+class TestAutomaticPrefixCache:
+    def test_shared_system_prompt_hits_without_opt_in(self, params):
+        sysp = prompt(17, seed=7)          # 2 full blocks + partial tail
+        p1 = np.concatenate([sysp, prompt(3, 1)]).astype(np.int32)
+        p2 = np.concatenate([sysp, prompt(3, 2)]).astype(np.int32)
+        with GenerationEngine(params, CFG, slots=2, max_len=48,
+                              block_size=8) as eng:
+            want = [eng.generate(p, max_new_tokens=5, timeout=120)
+                    for p in (p1, p2)]
+        with GenerationEngine(params, CFG, slots=2, max_len=48,
+                              block_size=8,
+                              prefix_cache_blocks=16) as eng:
+            a = eng.generate(p1, max_new_tokens=5, timeout=120)
+            b = eng.generate(p2, max_new_tokens=5, timeout=120)
+            m = eng.metrics
+            assert m.prefix_cache_hits_total.value == 1
+            assert m.prefix_cache_inserts_total.value >= 1
+            # the hit stream skipped its prefill entirely
+            assert m.prefills_total.value == 1
+        assert [a, b] == want
+
+    def test_sampled_streams_bitwise_inert(self, params):
+        sysp = prompt(16, seed=7)
+        ps = [np.concatenate([sysp, prompt(4, s)]).astype(np.int32)
+              for s in (1, 2, 3)]
+        kw = dict(max_new_tokens=6, temperature=1.0, top_k=6, timeout=120)
+        with GenerationEngine(params, CFG, slots=2, max_len=48,
+                              block_size=8) as eng:
+            want = [eng.generate(p, seed=s, **kw)
+                    for s, p in enumerate(ps)]
+        with GenerationEngine(params, CFG, slots=2, max_len=48,
+                              block_size=8,
+                              prefix_cache_blocks=16) as eng:
+            got = [eng.generate(p, seed=s, **kw)
+                   for s, p in enumerate(ps)]
+            assert eng.metrics.prefix_cache_hits_total.value >= 2
+        assert got == want
+
+    def test_lru_bound_and_eviction(self, params):
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8,
+                              prefix_cache_blocks=3) as eng:
+            for s in range(6):             # distinct prompts, no reuse
+                eng.generate(prompt(9, seed=s + 20), max_new_tokens=4,
+                             timeout=120)
+            assert eng._prefix_cache.total_blocks <= 3
+            assert eng.metrics.prefix_cache_evictions_total.value >= 1
+            assert eng.metrics.prefix_cache_blocks.value <= 3
+
+    def test_cached_blocks_reclaimed_on_demand_not_gating(self, params):
+        """A full cache never blocks admission: its entries evict the
+        moment a seat demand needs the blocks (reclaimable capacity,
+        which is also why kv_blocks_usable ignores it)."""
+        with GenerationEngine(params, CFG, slots=1, max_len=32,
+                              block_size=8, num_blocks=5,
+                              prefix_cache_blocks=4,
+                              queue_capacity=8) as eng:
+            eng.generate(prompt(9, seed=1), max_new_tokens=4, timeout=120)
+            assert eng._prefix_cache.total_blocks >= 1
+            # worst case 4 blocks == whole pool: forces cache eviction
+            assert eng.generate(prompt(4, seed=2), max_new_tokens=26,
+                                eos_id=None, timeout=120)
+
+    def test_drain_releases_cache_blocks(self, params):
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8,
+                              prefix_cache_blocks=8) as eng:
+            eng.generate(prompt(9, seed=1), max_new_tokens=4, timeout=120)
+            assert eng._prefix_cache.total_blocks >= 1
+            assert eng.drain(timeout=60.0)
+            assert eng._allocator.free_count == eng._allocator.capacity
+
+    def test_cache_survives_bookkeeping_on_rebuild(self, params):
+        """A cache rebuild voids every entry WITHOUT freeing into the
+        fresh allocator (the stale references belong to the dead pool)."""
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8,
+                              prefix_cache_blocks=8) as eng:
+            eng.generate(prompt(9, seed=1), max_new_tokens=4, timeout=120)
+            assert eng._prefix_cache.total_blocks >= 1
+            eng._reset_cache()
+            assert eng._prefix_cache.total_blocks == 0
+            assert eng._allocator.free_count == eng._allocator.capacity
+            # and the engine still serves
+            assert eng.generate(prompt(5), max_new_tokens=4, timeout=120)
+
+    def test_prefix_cache_requires_paged(self, params):
+        with pytest.raises(ValueError, match="prefix_cache_blocks"):
+            GenerationEngine(params, CFG, slots=2, max_len=32,
+                             paged=False, prefix_cache_blocks=8)
+
+    def test_unit_match_is_block_granular_and_lru(self):
+        a = BlockAllocator(17)
+        c = PrefixCache(a, 4, capacity_blocks=8)
+        t1 = np.arange(8, dtype=np.int32)
+        b1 = a.alloc(2)
+        assert c.insert(t1, b1)
+        # full match capped at (len-1)//B blocks: identical prompt still
+        # leaves one token to feed
+        hit = c.match(np.arange(8, dtype=np.int32))
+        assert hit is not None and hit[1] == 1
+        hit = c.match(np.arange(12, dtype=np.int32))
+        assert hit is not None and hit[1] == 2
+        assert c.match(np.arange(3, dtype=np.int32)) is None   # < 1 block
+        miss = np.concatenate([[9, 9, 9, 9],
+                               np.arange(4)]).astype(np.int32)
+        assert c.match(miss) is None      # prefix, not substring
+        # duplicate coverage rejected, and the offered refs come back
+        b2 = a.alloc(1)
+        free_before = a.free_count
+        assert not c.insert(t1[:4], b2)   # an entry already covers these
+        assert a.free_count == free_before + 1
+
+    def test_cancelled_cache_hit_request_frees_match_refs(self, params):
+        """Review find: a queued request cancelled before seating whose
+        prompt matched the cache must free the planner's match refs —
+        a leak would keep evicted cache blocks off the free list
+        forever, silently shrinking the pool."""
+        import threading
+
+        sysp = prompt(17, seed=7)
+        p1 = np.concatenate([sysp, prompt(3, 1)]).astype(np.int32)
+        p2 = np.concatenate([sysp, prompt(3, 2)]).astype(np.int32)
+        seen, release = threading.Event(), threading.Event()
+
+        def hold(tok):
+            seen.set()
+            release.wait(30)
+
+        with GenerationEngine(params, CFG, slots=1, max_len=48,
+                              block_size=8, prefix_cache_blocks=16,
+                              queue_capacity=8) as eng:
+            eng.generate(p1, max_new_tokens=4, timeout=120)  # seeds cache
+            blocker = eng.submit(prompt(5, 9), max_new_tokens=12,
+                                 eos_id=None, on_token=hold)
+            assert seen.wait(60)          # slot wedged: queue backs up
+            victim = eng.submit(p2, max_new_tokens=4)
+            assert victim.future.cancel()  # cancelled while queued
+            release.set()
+            blocker.result(timeout=120)
+            # a clean follow-up stream drains everything; afterwards the
+            # only refs left are the cache's own — dropping them must
+            # return the WHOLE pool (a leaked match ref would not)
+            eng.generate(prompt(6, 11), max_new_tokens=4, timeout=120)
+            deadline = time.time() + 30
+            while eng.live_slots:
+                assert time.time() < deadline
+                time.sleep(0.01)
+            eng._prefix_cache.release_all()
+            assert eng._allocator.free_count == eng._allocator.capacity
+
+    def test_match_and_ref_survives_concurrent_release(self):
+        """Review find: the match→seat handoff must own its refs — a
+        release_all (warmup finishing, drain) between match and seating
+        could otherwise free the matched blocks and hand them back to
+        the very stream as 'fresh', corrupting its own shared prefix."""
+        a = BlockAllocator(17)
+        c = PrefixCache(a, 4, capacity_blocks=8)
+        toks = np.arange(8, dtype=np.int32)
+        blocks = a.alloc(2)
+        assert c.insert(toks, blocks)
+        hit = c.match_and_ref(np.arange(12, dtype=np.int32))
+        assert hit is not None
+        _e, m, owned = hit
+        assert m == 2 and all(a.refcount(b) == 2 for b in owned)
+        c.release_all()                    # the cache's refs drop...
+        assert all(a.refcount(b) == 1 for b in owned)   # ...ours hold
+        # the blocks are NOT on the free list while the seat holds them
+        grabbed = a.alloc(a.free_count)
+        assert not set(owned) & set(grabbed)
+        a.free(grabbed)
+        a.free(owned)                      # seat path releases at retire
+        assert a.free_count == a.capacity
+
+
+# ---------------------------------------------------------------------------
+# Chaos: preemption racing the watchdog / cache-rebuild path
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+class TestPreemptionWatchdogRace:
+    def test_stale_epoch_preemption_frees_nothing(self, params):
+        """The epoch guard: a zombie scheduler's preemption attempt
+        against a bumped epoch must not touch the table or free a single
+        block (they belong to the replacement's pool now)."""
+        import threading
+
+        seen, release = threading.Event(), threading.Event()
+
+        def hold(tok, _n=[0]):
+            _n[0] += 1
+            if _n[0] == 2:
+                seen.set()
+                release.wait(30)
+
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, allocate="on_demand") as eng:
+            h = eng.submit(prompt(4), max_new_tokens=10, eos_id=None,
+                           on_token=hold)
+            assert seen.wait(60)
+            st = eng._slots[0]
+            assert st is not None
+            held = list(st.blocks)
+            free_before = eng._allocator.free_count
+            out = eng._preempt_for(0, st, eng._epoch + 1)   # stale epoch
+            assert out == "stale"
+            assert st.blocks == held
+            assert eng._allocator.free_count == free_before
+            release.set()
+            h.result(timeout=120)
+
+    def test_watchdog_restart_mid_preemption_workload_no_double_free(
+            self, params):
+        """Seeded chaos: a decode hang trips the watchdog while an
+        on-demand engine is actively preempting on a starved pool. The
+        victims' epoch-staled blocks are freed exactly once — a double
+        free into the FRESH allocator would raise inside the scheduler
+        and poison every later stream — and the rebuilt engine's
+        accounting drains back to a full free list."""
+        from deeplearning4j_tpu.serving import FaultPlan
+
+        plan = FaultPlan(seed=0).delay("generation.decode_step", ms=900,
+                                       at=(6,))
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, num_blocks=5,
+                              allocate="on_demand",
+                              queue_capacity=8) as eng:
+            eng.generate(prompt(5), max_new_tokens=2, timeout=120)
+            eng.arm_watchdog(200)
+            with plan:
+                hs = [eng.submit(prompt(4, s), max_new_tokens=20,
+                                 eos_id=None) for s in (0, 1)]
+                for h in hs:
+                    with pytest.raises(Exception):
+                        h.result(timeout=60)
+            time.sleep(1.0)    # zombie wakes against its abandoned cache
+            # fresh pool serves clean bitwise streams, zero leaked blocks
+            got = [eng.generate(prompt(4, s), max_new_tokens=20,
+                                eos_id=None, timeout=120) for s in (0, 1)]
+            deadline = time.time() + 30
+            while eng._allocator.free_count != eng._allocator.capacity:
+                assert time.time() < deadline, "leaked blocks"
+                time.sleep(0.01)
+            assert eng.compiled_signatures() <= len(eng.buckets) + 1
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8) as eng:
+            solo = [eng.generate(prompt(4, s), max_new_tokens=20,
+                                 eos_id=None, timeout=120)
+                    for s in (0, 1)]
+        assert got == solo
+
+
+# ---------------------------------------------------------------------------
+# Cluster integration: heartbeat fields, routing, elasticity signal
+# ---------------------------------------------------------------------------
+class TestClusterIntegration:
+    def test_host_status_wire_carries_allocate_and_preemptions(self):
+        import json
+
+        from deeplearning4j_tpu.serving import HostStatus
+
+        st = HostStatus(host_id=3, allocate="on_demand",
+                        preemptions_total=7, seq=1)
+        back = HostStatus.from_dict(json.loads(json.dumps(st.to_dict())))
+        assert back.allocate == "on_demand"
+        assert back.preemptions_total == 7
+        # pre-upgrade payloads default to the conservative read
+        old = st.to_dict()
+        del old["allocate"], old["preemptions_total"]
+        back = HostStatus.from_dict(old)
+        assert back.allocate == "reserve"
+        assert back.preemptions_total == 0
+
+    def test_headroom_gates_on_demand_hosts_on_admit_demand(self):
+        from deeplearning4j_tpu.serving import HostStatus
+        from deeplearning4j_tpu.serving.cluster import ClusterFrontDoor
+
+        st = HostStatus(host_id=0, has_generate=True, slots=4,
+                        free_slots=1, kv_blocks_total=20,
+                        kv_blocks_usable=16, kv_blocks_free=3,
+                        gen_queue_depth=10, gen_queue_capacity=10)
+        hr = ClusterFrontDoor._headroom
+        # worst case 8 > 3 free: a reserve host cannot seat immediately
+        # and its queue is full -> no headroom
+        assert not hr(None, st, "generate", 1, 8, 2)
+        # the same host on_demand seats on the 2-block admit demand
+        st.allocate = "on_demand"
+        assert hr(None, st, "generate", 1, 8, 2)
+        # the structural bound still applies to every mode
+        assert not hr(None, st, "generate", 1, 17, 2)
+
+    def test_loopback_status_reports_allocate_mode(self, params):
+        from deeplearning4j_tpu.serving import LoopbackHost
+
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8,
+                              allocate="on_demand") as eng:
+            st = LoopbackHost(0, generation=eng).status()
+            assert st.allocate == "on_demand"
+            assert st.preemptions_total == 0
+
+    def test_planner_preemption_rate_is_a_join_signal(self):
+        from deeplearning4j_tpu.serving import (
+            ElasticityPlanner, ElasticityPolicy)
+
+        def snap(preempt):
+            return {"fleet": {"alive": 3, "draining": 0, "slots": 12,
+                              "free_slots": 4,
+                              "preemptions_total": preempt},
+                    "front_doors": [], "hosts": {}}
+
+        p = ElasticityPlanner(ElasticityPolicy(trend_windows=2))
+        assert p.observe(snap(0))["action"] == "hold"   # first never acts
+        d = p.observe(snap(3))
+        assert d["action"] == "hold" and d["preemptions_delta"] == 3
+        d = p.observe(snap(6))
+        assert d["action"] == "join"
+        assert "preemption" in d["reason"]
+        # steady counter (no new preemptions): pressure streak resets
+        p2 = ElasticityPlanner(ElasticityPolicy(trend_windows=2))
+        for i, s in enumerate((0, 0, 0, 0)):
+            d = p2.observe(snap(s))
+        assert d["action"] == "hold" and d["preemptions_delta"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Observability: the new metrics ride /api/serving
+# ---------------------------------------------------------------------------
+class TestMetricsSurface:
+    def test_snapshot_carries_occupancy_metrics(self):
+        from deeplearning4j_tpu.serving import ServingMetrics
+
+        snap = ServingMetrics().snapshot()
+        for key in ("kv_reservation_slack", "prefix_cache_blocks",
+                    "preemptions_total", "prefix_cache_hits_total",
+                    "prefix_cache_inserts_total",
+                    "prefix_cache_evictions_total"):
+            assert key in snap, key
+
+    def test_preempted_reason_registered_exactly_once(self):
+        from deeplearning4j_tpu.serving.tracing import TERMINAL_REASONS
+
+        assert TERMINAL_REASONS.count("preempted") == 1

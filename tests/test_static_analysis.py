@@ -1435,6 +1435,36 @@ class TestRpcGate:
 
 
 # --------------------------------------------------------------------------
+# KV occupancy gate (ISSUE 13): the 'preempted' terminal rides the same
+# taxonomy discipline as every other typed shed
+# --------------------------------------------------------------------------
+class TestKvOccupancyGate:
+    def test_preempted_reason_drift_guard_armed(self):
+        """Reintroduction gate against the REAL tracing.py: dropping
+        'preempted' from TERMINAL_REASONS (in memory) while
+        admission.PreemptedError still sheds it must produce taxonomy
+        findings — the preemption path's typed terminal cannot silently
+        leave the one vocabulary."""
+        sources = {}
+        for name in os.listdir(SERVING):
+            if name.endswith(".py"):
+                q = os.path.join(SERVING, name)
+                with open(q) as f:
+                    sources[q] = f.read()
+        tracing_path = os.path.join(SERVING, "tracing.py")
+        removed = sources[tracing_path].replace('"preempted",', "")
+        assert removed != sources[tracing_path]
+        broken = dict(sources)
+        broken[tracing_path] = removed
+        r = analyze_sources(broken, rules=["taxonomy-drift"])
+        assert any("preempted" in f.message for f in r.unsuppressed)
+        # and the live tree is clean
+        clean = analyze_sources(sources, rules=["taxonomy-drift"])
+        assert [f for f in clean.unsuppressed
+                if "preempted" in f.message] == []
+
+
+# --------------------------------------------------------------------------
 # CLI
 # --------------------------------------------------------------------------
 class TestCli:
