@@ -35,8 +35,8 @@ from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
     SlidingWindowStats,
 )
 from deeplearning4j_tpu.serving.paging import (  # noqa: F401
-    BlockAllocator, PrefixCache, SharedPrefix, blocks_for_tokens,
-    kv_bytes_per_token,
+    BlockAllocator, BlockSwapStore, PrefixCache, SharedPrefix, SwapEntry,
+    blocks_for_tokens, kv_bytes_per_token,
 )
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     CausalLMAdapter, Deployment, ModelAdapter, ModelRegistry, as_adapter,
@@ -60,7 +60,8 @@ __all__ = [
     "AdmissionController", "DeadlineExceededError", "KVBlocksExhaustedError",
     "QueueFullError", "RejectedError", "InferenceEngine", "bucket_ladder",
     "Counter", "Gauge", "Histogram", "ReasonCounter", "ServingMetrics",
-    "SlidingWindowStats", "BlockAllocator", "PrefixCache", "SharedPrefix",
+    "SlidingWindowStats", "BlockAllocator", "BlockSwapStore", "PrefixCache",
+    "SharedPrefix", "SwapEntry",
     "blocks_for_tokens", "kv_bytes_per_token", "PreemptedError",
     "Deployment", "ModelAdapter", "ModelRegistry", "as_adapter",
     "GenerationEngine", "GenerationHandle", "prefill_buckets",

@@ -161,9 +161,12 @@ class PreemptedError(RejectedError):
     footprint can no longer ever fit the pool (its blocks were freed;
     shared-prefix pins grew underneath it). Ordinarily preemption is
     invisible to the caller — the victim requeues through the prefill
-    path with its generated-so-far tokens appended to the prompt and the
-    resumed stream is bitwise-identical to an unpreempted run — so this
-    terminal only surfaces when the resume is impossible. Distinct from
+    path with its generated-so-far tokens appended to the prompt (or,
+    above the engine's ``swap_threshold_blocks`` crossover, its KV
+    blocks ride host RAM and are copied straight back in, skipping the
+    recompute prefill entirely) and the resumed stream is
+    bitwise-identical to an unpreempted run — so this terminal only
+    surfaces when the resume is impossible. Distinct from
     'kv_blocks_exhausted': tokens were already delivered, and the cure
     is resubmitting the whole request (elsewhere), not shrinking it.
     Carries the count of ``tokens_generated`` before eviction."""

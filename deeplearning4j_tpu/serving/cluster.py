@@ -62,6 +62,7 @@ import dataclasses
 import threading
 import time
 import weakref
+from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -117,6 +118,12 @@ class HostStatus:
     # pressure signal — a fleet that preempts steadily needs hosts
     # before it starts shedding
     preemptions_total: int = 0
+    # swap-to-host occupancy (PR 15): blocks a preemption victim parked
+    # in host RAM awaiting copy-back, and the store's bound. Defaulted
+    # so a pre-swap sender's heartbeat parses (mixed fleet reads 0) and
+    # a pre-swap RECEIVER's known-field filter drops them harmlessly.
+    kv_swapped_blocks: int = 0
+    kv_swap_capacity_blocks: int = 0
     buckets: Tuple[int, ...] = ()
     # health
     breaker: str = "CLOSED"
@@ -263,6 +270,10 @@ class LoopbackHost(HostHandle):
                 st.allocate = gen.allocate
                 st.preemptions_total = int(
                     gen.metrics.preemptions_total.value)
+                if gen._swap_store is not None:
+                    st.kv_swapped_blocks = gen._swap_store.blocks_held
+                    st.kv_swap_capacity_blocks = \
+                        gen._swap_store.capacity_blocks
             breaker, metrics = gen.breaker, gen.metrics
         if breaker is not None:
             st.breaker = breaker.state
@@ -813,6 +824,13 @@ class ClusterDirectory:
             # keeps a mixed-version fleet's snapshot parsing
             "preemptions_total": sum(int(s.get("preemptions_total", 0))
                                      for s in statuses),
+            # swap-to-host occupancy roll-up: pre-upgrade heartbeats
+            # (and hosts with no swap store) report 0 via the defaults
+            "kv_swapped_blocks": sum(int(s.get("kv_swapped_blocks", 0))
+                                     for s in statuses),
+            "kv_swap_capacity_blocks": sum(
+                int(s.get("kv_swap_capacity_blocks", 0))
+                for s in statuses),
             "breakers_open": sum(1 for s in statuses
                                  if s["breaker"] == "OPEN"),
         }
@@ -859,11 +877,21 @@ class HedgePolicy:
     dispatch + hedges + re-dispatches), so a request that kills every
     host it lands on cannot walk the whole fleet. ``poll_wait_ms`` is
     the long-poll window per chunk fetch (also the cancellation-notice
-    latency bound for loser attempts)."""
+    latency bound for loser attempts).
+
+    ``infer_hedge_after_ms`` extends the same stall hedge to BATCH
+    INFERENCE submits (``ClusterFrontDoor.submit``): an unresolved
+    result after this long opens ONE backup POST on another candidate —
+    first result wins, the loser is cancelled server-side, and exactly
+    one SLO outcome is recorded for the pair. Default None keeps the
+    pre-hedge infer path bitwise untouched (streams hedge by default;
+    infer results, unlike token streams, have no progress watermark to
+    distinguish slow from stuck, so hedging them is opt-in)."""
 
     hedge_after_ms: Optional[float] = 250.0
     max_attempts: int = 3
     poll_wait_ms: float = 50.0
+    infer_hedge_after_ms: Optional[float] = None
 
     def __post_init__(self):
         if self.hedge_after_ms is not None and self.hedge_after_ms <= 0:
@@ -872,6 +900,10 @@ class HedgePolicy:
             raise ValueError("max_attempts must be >= 1")
         if self.poll_wait_ms <= 0:
             raise ValueError("poll_wait_ms must be positive")
+        if self.infer_hedge_after_ms is not None \
+                and self.infer_hedge_after_ms <= 0:
+            raise ValueError(
+                "infer_hedge_after_ms must be positive (or None)")
 
 
 class _Attempt:
@@ -880,16 +912,25 @@ class _Attempt:
     bitwise-deterministic per seed, so every attempt's prefix agrees) —
     the supervisor's leader pushes ``tokens[delivered:]`` to the client
     handle, which is what makes leadership transfer gap-free and
-    duplicate-free by construction."""
+    duplicate-free by construction.
 
-    __slots__ = ("stream", "host_id", "idx", "tokens", "cursor")
+    A resumed attempt (wire v2: the replacement host seated the stream
+    at the delivery watermark) is PRE-SEEDED with the delivered prefix:
+    ``base`` is the honored resume point, ``tokens``/``cursor`` start
+    there, and the wire cursor the remote long-poll sees is ``cursor -
+    base`` (the replacement host's handle holds only tokens past the
+    watermark — it recomputed, it did not re-decode)."""
 
-    def __init__(self, stream, host_id: int, idx: int):
+    __slots__ = ("stream", "host_id", "idx", "tokens", "cursor", "base")
+
+    def __init__(self, stream, host_id: int, idx: int,
+                 prefix: Optional[List[int]] = None):
         self.stream = stream
         self.host_id = host_id
         self.idx = idx
-        self.tokens: List[int] = []
-        self.cursor = 0
+        self.tokens: List[int] = list(prefix) if prefix else []
+        self.cursor = len(self.tokens)
+        self.base = len(self.tokens)
 
 
 class _HedgedStream:
@@ -1042,10 +1083,24 @@ class _HedgedStream:
                              kind="generate", attempt=idx)
             with self._lock:
                 self.inflight.append(hid)
+                # resume-from-watermark (wire v2): ship the delivered
+                # prefix so the replacement host runs ONE recompute
+                # prefill and continues from the exact next token
+                # instead of re-decoding the whole stream. The handle
+                # holds exactly the delivered tokens (pushed under this
+                # lock), so the snapshot IS the watermark. A finished
+                # budget (every token delivered, terminal chunk lost)
+                # replays instead — resume_step == max_new would be
+                # nothing-to-resume.
+                resume = list(self.handle.tokens_so_far())
+            if not resume or len(resume) >= self.max_new:
+                resume = None
+            rkw = {} if resume is None else {
+                "resume_tokens": resume, "resume_step": len(resume)}
             try:
                 stream = h.open_stream(
                     self.toks, timeout_ms=self._remaining_ms(),
-                    hedge_attempt=idx, **self.gen_kwargs)
+                    hedge_attempt=idx, **rkw, **self.gen_kwargs)
             except RejectedError as e:
                 with self._lock:
                     self.inflight.remove(hid)
@@ -1056,7 +1111,20 @@ class _HedgedStream:
                 self.trace.event("cluster.bounce", host=hid,
                                  reason=e.reason, attempt=idx)
                 continue     # next candidate, same attempt slot
-            a = _Attempt(stream, hid, idx)
+            honored = int(getattr(stream, "resume_step", 0) or 0)
+            if resume is not None and honored == len(resume):
+                # v2 peer seated the stream at the watermark: pre-seed
+                # the attempt so its cursor space starts there and zero
+                # already-delivered tokens cross the wire again
+                a = _Attempt(stream, hid, idx, prefix=resume)
+                self.fd.metrics.stream_resumes_total.inc()
+                self.trace.event("stream.resume", host=hid, attempt=idx,
+                                 resume_step=honored)
+            else:
+                # v1 peer (echo 0) or partial honor: full replay from
+                # token 0 — the delivered watermark dedups the replayed
+                # prefix exactly as before wire v2
+                a = _Attempt(stream, hid, idx)
             late = False
             with self._lock:
                 self.inflight.remove(hid)
@@ -1127,7 +1195,10 @@ class _HedgedStream:
             if self._is_finished():
                 return None
             try:
-                chunk = a.stream.poll(a.cursor, self.fd.hedge.poll_wait_ms)
+                # wire cursor is attempt-local: a resumed attempt's
+                # server never held the pre-watermark tokens
+                chunk = a.stream.poll(a.cursor - a.base,
+                                      self.fd.hedge.poll_wait_ms)
             except RejectedError as e:
                 if getattr(e, "reason", None) in self.HEDGE_RETRIABLE:
                     return e
@@ -1314,6 +1385,195 @@ class _HedgedStream:
                 target=self._run_attempt, args=(spawn_idx, None),
                 daemon=True,
                 name=f"fd-stream[{self.fd.name}]#a{spawn_idx}").start()
+
+
+class _HedgedInfer:
+    """Supervisor for ONE hedged batch-inference request — the infer
+    analogue of :class:`_HedgedStream`, deliberately smaller (a result
+    has no token watermark, so there is no leadership or resume: just
+    first-result-wins over at most one primary + one backup). The
+    caller holds a PROXY Future; underneath it:
+
+    - the primary attempt is the synchronous dispatch ``submit`` already
+      made; a monitor opens one backup POST on another candidate when
+      the result is still unresolved after ``infer_hedge_after_ms``
+      (budget-aware: no backup once the deadline is spent);
+    - the first SUCCESS claims the terminal under the supervisor lock
+      (the ``_take_terminal`` discipline), resolves the proxy, records
+      exactly ONE front-door SLO outcome, and cancels the loser
+      server-side (``Future.cancel_remote`` — the RPC ``/cancel``
+      endpoint — plus the local ``Future.cancel`` for a still-queued
+      loopback op);
+    - a FAILURE is adopted only when it is the last outstanding attempt
+      and no dispatch is in flight — a failed primary does not mask a
+      backup that may still win, and vice versa."""
+
+    def __init__(self, fd: "ClusterFrontDoor", arr, rows: int, *,
+                 timeout_ms: Optional[float], tenant, priority,
+                 label: str, trace, t0: float, tried: List[int]):
+        self.fd = fd
+        self.arr = arr
+        self.rows = rows
+        self.timeout_ms = timeout_ms
+        self.tenant = tenant
+        self.priority = priority
+        self.label = label
+        self.trace = trace
+        self.t0 = t0
+        self.proxy: Future = Future()
+        self.proxy.set_running_or_notify_cancel()
+        self._lock = threading.Lock()
+        self._done_evt = threading.Event()
+        self.finished = False
+        self.outstanding: Dict[int, Future] = {}
+        self.inflight = 0          # backup dispatch POST in progress
+        self.tried: List[int] = list(tried)
+        self.last_error: Optional[BaseException] = None
+
+    def start(self, hid: int, fut: Future) -> Future:
+        """Adopt the already-dispatched primary, arm the stall monitor,
+        return the proxy the caller resolves against."""
+        self._adopt(hid, fut)
+        threading.Thread(
+            target=self._monitor, daemon=True,
+            name=f"fd-infer-hedge[{self.fd.name}]").start()
+        return self.proxy
+
+    def _adopt(self, hid: int, fut: Future):
+        with self._lock:
+            self.outstanding[hid] = fut
+            if hid not in self.tried:
+                self.tried.append(hid)
+        fut.add_done_callback(lambda f, h=hid: self._attempt_done(h, f))
+
+    def _remaining_ms(self) -> Optional[float]:
+        return None if self.timeout_ms is None else \
+            self.timeout_ms - (time.perf_counter() - self.t0) * 1e3
+
+    # ------------------------------------------------------------ terminal
+    def _claim(self) -> Optional[List[Future]]:
+        """First caller wins; returns the loser futures to cancel."""
+        with self._lock:
+            if self.finished:
+                return None
+            self.finished = True
+            losers = list(self.outstanding.values())
+            self.outstanding.clear()
+        self._done_evt.set()
+        return losers
+
+    def _cancel_losers(self, losers: List[Future]):
+        for f in losers:
+            f.cancel()
+            cancel_remote = getattr(f, "cancel_remote", None)
+            if cancel_remote is not None:
+                cancel_remote()   # best-effort: the op (queued or
+                #                   running) is dropped server-side
+
+    def _attempt_done(self, hid: int, fut: Future):
+        self.fd._out_add("infer", hid, -self.rows)
+        try:
+            exc = fut.exception()
+        except BaseException as e:   # cancelled loser: nothing to adopt
+            exc = e
+        if exc is None:
+            losers = self._claim()
+            if losers is None:
+                return               # late loser: terminal already out
+            # analysis: ok terminal-exactly-once — the claim above is
+            # the hedged ensemble's single winner gate
+            self.proxy.set_result(fut.result())
+            self.fd._finish_request(
+                self.trace, "ok", (time.perf_counter() - self.t0) * 1e3,
+                self.label)
+            self._cancel_losers([f for f in losers if f is not fut])
+            return
+        with self._lock:
+            self.outstanding.pop(hid, None)
+            if not fut.cancelled():
+                self.last_error = exc
+            survivors = bool(self.outstanding) or self.inflight > 0
+            if fut.cancelled():
+                # our own loser cleanup resolving: never a terminal
+                return
+        if survivors:
+            self.trace.event("cluster.bounce", host=hid,
+                             reason=terminal_reason(exc), kind="infer")
+            return
+        losers = self._claim()
+        if losers is None:
+            return
+        # analysis: ok terminal-exactly-once — single loser-less
+        # failure terminal for the whole ensemble
+        self.proxy.set_exception(exc)
+        self.fd._finish_request(
+            self.trace, terminal_reason(exc),
+            (time.perf_counter() - self.t0) * 1e3, self.label)
+
+    # ------------------------------------------------------------- hedging
+    def _monitor(self):
+        hed = self.fd.hedge
+        wait_s = hed.infer_hedge_after_ms / 1e3
+        self._done_evt.wait(wait_s)
+        with self._lock:
+            if self.finished or not self.outstanding:
+                return      # resolved (or failed) before the stall bar
+            if len(self.tried) >= min(2, hed.max_attempts):
+                return
+            self.inflight += 1
+            exclude = tuple(self.tried)
+        remaining = self._remaining_ms()
+        if remaining is not None and remaining <= 0:
+            with self._lock:
+                self.inflight -= 1
+            return          # no budget left to hedge with
+        backup = None
+        try:
+            h, hid, how = self.fd._route("infer", rows=self.rows,
+                                         exclude=exclude)
+            self.trace.event("cluster.route", host=hid, decision=how,
+                             kind="infer", hedged=True)
+            backup = (hid, h.submit_infer(
+                self.arr, timeout_ms=remaining, tenant=self.tenant,
+                priority=self.priority))
+        except RejectedError as e:
+            self.trace.event("cluster.hedge", kind="infer",
+                             failed=getattr(e, "reason", "rpc_error"))
+        finally:
+            with self._lock:
+                self.inflight -= 1
+                dead = not self.outstanding and self.last_error is not None
+        if backup is None:
+            if dead:
+                # the primary failed while this dispatch was deciding:
+                # adopt its error now that no backup is coming
+                losers = self._claim()
+                if losers is not None:
+                    exc = self.last_error
+                    # analysis: ok terminal-exactly-once — same single
+                    # failure gate as _attempt_done's loser-less arm
+                    self.proxy.set_exception(exc)
+                    self.fd._finish_request(
+                        self.trace, terminal_reason(exc),
+                        (time.perf_counter() - self.t0) * 1e3, self.label)
+            return
+        hid, fut = backup
+        self.fd.hedges.inc("timeout")
+        self.fd.routed_by_host.inc(f"h{hid}")
+        self.fd._out_add("infer", hid, self.rows)
+        self.trace.event("cluster.hedge", kind="infer", host=hid)
+        late = False
+        with self._lock:
+            if self.finished:
+                late = True
+        if late:
+            fut.cancel()
+            cancel_remote = getattr(fut, "cancel_remote", None)
+            if cancel_remote is not None:
+                cancel_remote()
+            self.fd._out_add("infer", hid, -self.rows)
+            return
+        self._adopt(hid, fut)
 
 
 # --------------------------------------------------------------------------
@@ -1596,6 +1856,16 @@ class ClusterFrontDoor:
                 continue
             self.routed_by_host.inc(f"h{hid}")
             self._out_add("infer", hid, rows)
+            if (self.hedge.infer_hedge_after_ms is not None
+                    and host is None and self.hedge.max_attempts >= 2):
+                # stall-hedged: a monitor races ONE backup POST when the
+                # result is slow; first success wins, loser cancelled
+                # server-side, exactly-once SLO terminal via the proxy
+                sup = _HedgedInfer(
+                    self, arr, rows, timeout_ms=timeout_ms,
+                    tenant=tenant, priority=priority, label=label,
+                    trace=trace, t0=t0, tried=tried)
+                return sup.start(hid, fut)
             self._watch_future(fut, trace, t0, label, "infer", hid, rows)
             return fut
 

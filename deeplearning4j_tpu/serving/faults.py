@@ -41,6 +41,18 @@ like engine chaos does:
   mutating the decoded payload models a malformed/mid-upgrade wire
   schema; the client sheds typed ``rpc_error``).
 
+The KV swap-to-host path (serving/paging.py ``BlockSwapStore`` driven
+from the generation engine's preemption policy) adds two seeded points
+with an explicit DEGRADE contract — a fired fault falls back to
+recompute-on-resume, it never sheds the request:
+
+- ``kv.swap_out`` — the device→host block copy when a preemption victim
+  is above the swap threshold (fail → the victim is preempted the
+  pre-swap way and re-prefills on resume);
+- ``kv.swap_in``  — the host→device copy re-seating a swapped victim
+  (fail → the blocks are freed back and the stream re-prefills; either
+  way the resumed stream is bitwise the uninterrupted one).
+
 Usage::
 
     plan = (FaultPlan(seed=7)

@@ -59,8 +59,8 @@ from deeplearning4j_tpu.serving.engine import bucket_ladder
 from deeplearning4j_tpu.serving.faults import inject
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.paging import (
-    BlockAllocator, PrefixCache, SharedPrefix, blocks_for_tokens,
-    kv_bytes_per_token,
+    BlockAllocator, BlockSwapStore, PrefixCache, SharedPrefix, SwapEntry,
+    blocks_for_tokens, kv_bytes_per_token,
 )
 from deeplearning4j_tpu.serving.qos import (
     PRIORITIES, SloBurnGovernor, resolve_qos,
@@ -105,6 +105,12 @@ class GenerationRequest:
     resume_tokens: Optional[np.ndarray] = None
     resume_step: int = 0
     preemptions: int = 0
+    # swap-to-host (paging.BlockSwapStore): the key of this stream's
+    # parked KV entry when its preemption swapped out instead of
+    # discarding — a valid key re-seats via device_put with NO prefill;
+    # a miss (LRU-evicted, invalidated, or swap-in failure) falls back
+    # to the recompute path above
+    swap_key: Optional[int] = None
 
 
 class GenerationHandle:
@@ -302,6 +308,8 @@ class GenerationEngine(ResilientEngineMixin):
                  paged_attention: str = "gather",
                  allocate: str = "reserve",
                  prefix_cache_blocks: int = 0,
+                 swap_threshold_blocks: Optional[int] = None,
+                 swap_capacity_blocks: Optional[int] = None,
                  queue_capacity: int = 64,
                  default_timeout_ms: Optional[float] = None,
                  eos_id: Optional[int] = None,
@@ -370,6 +378,25 @@ class GenerationEngine(ResilientEngineMixin):
                                                          self.block_size)
             self.num_blocks = (slots * self.max_blocks_per_slot + 1
                                if num_blocks is None else int(num_blocks))
+            if swap_threshold_blocks is not None \
+                    and swap_threshold_blocks < 0:
+                raise ValueError(
+                    f"swap_threshold_blocks must be >= 0 (a victim whose "
+                    f"footprint EXCEEDS it swaps to host RAM), got "
+                    f"{swap_threshold_blocks}")
+            if swap_capacity_blocks is not None \
+                    and swap_threshold_blocks is None:
+                raise ValueError(
+                    "swap_capacity_blocks requires swap_threshold_blocks "
+                    "— the store only fills from preemption swap-outs")
+            self.swap_threshold_blocks = swap_threshold_blocks
+            # bounded host-RAM parking lot for preempted streams' KV
+            # (vLLM §4.5 swap-vs-recompute): default None keeps the
+            # recompute-only PR 13 behavior, bitwise-inert
+            self._swap_store = BlockSwapStore(
+                int(swap_capacity_blocks) if swap_capacity_blocks
+                is not None else self.num_blocks) \
+                if swap_threshold_blocks is not None else None
             self._prefill = make_paged_prefill(cfg, self.block_size, mesh,
                                                kv_dtype=self.kv_dtype)
             self._decode = make_paged_decode_step(
@@ -393,8 +420,16 @@ class GenerationEngine(ResilientEngineMixin):
                     "prefix_cache_blocks requires the paged KV cache "
                     "(GenerationEngine(paged=True)) — the automatic "
                     "prefix cache holds retired streams' blocks")
+            if swap_threshold_blocks is not None \
+                    or swap_capacity_blocks is not None:
+                raise ValueError(
+                    "swap_threshold_blocks requires the paged KV cache "
+                    "(GenerationEngine(paged=True)) — swap-to-host parks "
+                    "block K/V, and the contiguous layout has no blocks")
             self.allocate = "reserve"
             self.prefix_cache_blocks = 0
+            self.swap_threshold_blocks = None
+            self._swap_store = None
             if paged_attention != "gather":
                 raise ValueError(
                     f"paged_attention={paged_attention!r} requires the "
@@ -517,24 +552,27 @@ class GenerationEngine(ResilientEngineMixin):
         the pool's blocks return to the free list. Returns True when
         fully drained within ``timeout`` (None = wait forever); on
         timeout the engine stays draining (admission stays closed) but
-        pins are kept — the caller decides whether to force
-        ``shutdown()``."""
-        if not self._drain_wait(timeout):
+        explicit pins are kept — the caller decides whether to force
+        ``shutdown()``. The AUTOMATIC prefix cache is released on BOTH
+        exits: admission is closed, so no future stream can match it —
+        a timed-out drain that parked those reclaimable blocks until
+        shutdown would advertise less free capacity than the host
+        actually has (any in-flight match holds its own refs, so the
+        release is safe against still-resident streams)."""
+        ok = self._drain_wait(timeout)
+        if release_prefixes and self._prefix_cache is not None:
+            before = len(self._prefix_cache)
+            self._prefix_cache.release_all()
+            if before:
+                self.metrics.prefix_cache_evictions_total.inc(before)
+                self._update_block_gauges()
+        if not ok:
             return False
         if release_prefixes:
             with self._prefix_lock:
                 pids = list(self._prefixes)
             for pid in pids:
                 self.release_prefix(pid)
-            # the automatic prefix cache's entries go with the pins:
-            # every block returns to the free list so the departing
-            # host's last heartbeats show full capacity (the cache is
-            # internally locked; any in-flight match holds its own refs)
-            if self._prefix_cache is not None:
-                before = len(self._prefix_cache)
-                self._prefix_cache.release_all()
-                if before:
-                    self.metrics.prefix_cache_evictions_total.inc(before)
         return True
 
     # --------------------------------------------------------------- submit
@@ -545,7 +583,8 @@ class GenerationEngine(ResilientEngineMixin):
                prefix_id: Optional[str] = None,
                tenant: Optional[str] = None,
                priority: Optional[str] = None,
-               on_token: Optional[Callable[[int], None]] = None
+               on_token: Optional[Callable[[int], None]] = None,
+               resume_tokens=None, resume_step: int = 0
                ) -> GenerationHandle:
         """Queue one prompt. Greedy by default; ``temperature`` > 0 samples,
         ``top_k`` > 0 restricts sampling to the k highest-probability
@@ -562,13 +601,41 @@ class GenerationEngine(ResilientEngineMixin):
         executable, so thousands of concurrent streams share one
         prefill. ``tenant`` / ``priority`` attribute the request for QoS
         (serving/qos.py) — without a ``qos=`` policy they are accounting
-        labels only and the queue stays FIFO."""
+        labels only and the queue stays FIFO.
+
+        ``resume_tokens``/``resume_step`` seat this stream at a RESUME
+        point instead of token 0 — the cross-host half of PR 13's
+        recompute-on-resume (serving/rpc.py forwards them off the wire
+        when a front door re-dispatches a lost stream): the already-
+        delivered tokens ride the prompt through ONE recompute prefill
+        and the next sample is drawn at index ``resume_step``, so the
+        recovered stream is bitwise the uninterrupted one and re-decodes
+        nothing it already delivered. ``resume_step`` must equal
+        ``len(resume_tokens)`` — the resume point IS the delivery
+        watermark."""
         tenant, priority = resolve_qos(self.qos, tenant, priority)
         toks = np.ascontiguousarray(np.asarray(prompt, np.int32).ravel())
         if toks.size == 0:
             raise ValueError("prompt must contain at least one token")
         if max_new_tokens <= 0:
             raise ValueError("max_new_tokens must be positive")
+        if resume_tokens is not None:
+            resume_tokens = np.ascontiguousarray(
+                np.asarray(resume_tokens, np.int32).ravel())
+            if int(resume_step) != int(resume_tokens.size):
+                raise ValueError(
+                    f"resume_step ({resume_step}) must equal "
+                    f"len(resume_tokens) ({resume_tokens.size}) — the "
+                    "resume point is the delivery watermark")
+            if resume_step >= max_new_tokens:
+                raise ValueError(
+                    f"resume_step ({resume_step}) must be < "
+                    f"max_new_tokens ({max_new_tokens}) — a finished "
+                    "stream has nothing to resume")
+        elif resume_step:
+            raise ValueError(
+                f"resume_step ({resume_step}) requires resume_tokens — "
+                "the delivered prefix the recompute prefill replays")
         prefix_len = 0
         if prefix_id is not None:
             if not self.paged:
@@ -598,11 +665,18 @@ class GenerationEngine(ResilientEngineMixin):
             prompt=toks, max_new_tokens=max_new_tokens,
             temperature=float(temperature), top_k=int(top_k),
             eos_id=self.eos_id if eos_id is _UNSET else eos_id,
-            key=np.asarray(jax.random.PRNGKey(seed)), prefix_id=prefix_id)
+            key=np.asarray(jax.random.PRNGKey(seed)), prefix_id=prefix_id,
+            resume_tokens=resume_tokens, resume_step=int(resume_step))
         trace = self._tracer.begin(self.name, "generate",
                                    prompt_len=int(toks.size),
                                    max_new_tokens=max_new_tokens,
                                    tenant=tenant)
+        if resume_tokens is not None:
+            # a wire-resume landed here instead of a full replay: count
+            # it and mark the trace — the kill-mid-stream acceptance
+            # test asserts exactly one of these per recovery
+            self.metrics.stream_resumes_total.inc()
+            trace.event("stream.resume", resume_step=int(resume_step))
         req = Request(x=greq, rows=1, trace=trace, tenant=tenant,
                       priority=priority)
         greq.handle = GenerationHandle(req, toks.size, on_token=on_token)
@@ -792,6 +866,12 @@ class GenerationEngine(ResilientEngineMixin):
                 # fresh allocator (the PR 6 _clear_slot discipline,
                 # extended to cache entries)
                 self._prefix_cache.invalidate()
+            if self._swap_store is not None:
+                # swapped-out entries carry the epoch they were captured
+                # under and would be rejected at swap-in anyway; dropping
+                # them here returns the host RAM immediately
+                self._swap_store.invalidate()
+                self.metrics.kv_swapped_blocks_held.set(0)
             with self._prefix_lock:
                 self._allocator = BlockAllocator(self.num_blocks, reserved=1)
                 self._tables = np.zeros(
@@ -875,6 +955,9 @@ class GenerationEngine(ResilientEngineMixin):
         self.metrics.prefix_cache_blocks.set(
             self._prefix_cache.total_blocks
             if self._prefix_cache is not None else 0)
+        self.metrics.kv_swapped_blocks_held.set(
+            self._swap_store.blocks_held
+            if self._swap_store is not None else 0)
 
     def _loop(self, epoch: int):
         """Scheduler loop for one epoch. The watchdog bumps ``_epoch`` on
@@ -978,6 +1061,7 @@ class GenerationEngine(ResilientEngineMixin):
                         # request: leaked refcounts would keep evicted
                         # cache blocks off the free list forever
                         self._allocator.free(cached[2])
+                    self._discard_swap(greq)
                     self._finish_request(req.trace, "cancelled",
                                          tenant=req.tenant)
                     continue     # caller cancelled while queued
@@ -985,6 +1069,12 @@ class GenerationEngine(ResilientEngineMixin):
                 qw = (time.perf_counter() - req.submit_t) * 1e3
                 self.metrics.observe_queue_wait_class(req.priority, qw)
                 req.trace.event("queue.wait", queue_wait_ms=round(qw, 3))
+            if greq.swap_key is not None and self.paged:
+                # swap-to-host victim: try the block copy-back first —
+                # cheaper than recompute above the crossover. Any miss
+                # falls through to the ordinary resume paths below.
+                if self._swap_in_seat(i, req, epoch):
+                    continue
             if prefix is not None or cached is not None:
                 # shared-prefix / automatic-cache-hit stream: no prefill
                 # at all — reference the shared blocks and feed the
@@ -1109,6 +1199,7 @@ class GenerationEngine(ResilientEngineMixin):
                 # fit (shared-prefix pins grew under it after its blocks
                 # were freed): the resume is impossible — typed
                 # 'preempted', the caller resubmits the whole request
+                self._discard_swap(greq)
                 self._shed_typed(req, PreemptedError(
                     f"stream was preempted after {greq.resume_step} "
                     f"token(s) and its resume needs {needed_worst} KV "
@@ -1454,6 +1545,83 @@ class GenerationEngine(ResilientEngineMixin):
                         resumed=resumed)
         self._update_block_gauges()
 
+    def _swap_in_seat(self, i: int, req: Request, epoch: int) -> bool:
+        """Re-seat a swap-to-host preemption victim by copying its
+        captured KV blocks back into freshly-allocated pool blocks
+        (device_put scatter + table rebuild) — NO prefill, no decode
+        feed: the slot resumes exactly where the eviction froze it
+        (``n_generated``/``last_token``/``length`` from the snapshot)
+        and the next decode step continues the stream bitwise. Returns
+        False on ANY miss — key already dropped (LRU eviction, watchdog
+        invalidation), epoch mismatch, pool refusal, or a seeded
+        ``kv.swap_in`` fault — and the caller falls through to the
+        recompute-on-resume path; a swap failure never sheds."""
+        greq: GenerationRequest = req.x
+        key, greq.swap_key = greq.swap_key, None   # one shot either way
+        store = self._swap_store
+        entry = store.take(key) if store is not None \
+            and key is not None else None
+        if entry is None:
+            return False
+        self.metrics.kv_swapped_blocks_held.set(store.blocks_held)
+        if entry.epoch != epoch:
+            return False   # captured against a pre-restart pool
+        alloc = self._allocator
+        # same demand formula _plan_blocks just verified (swapped
+        # victims are prefix-less by the swap-out gate, so prefix=None
+        # is exact, and it covers the snapshot's blocks: used =
+        # ceil(length/B) <= ceil((prompt+resume+1)/B) <= nfresh)
+        nfresh = self._blocks_needed(greq, None, admit=True)
+        try:
+            blocks = alloc.alloc(nfresh)
+        except KVBlocksExhaustedError:
+            return False
+        used = entry.used_blocks
+        rows = np.asarray(blocks[:used], np.int32)
+        try:
+            def copy_in():
+                # scatter the host snapshot into the allocated rows of
+                # every leaf (values and int8 scales alike); .at[].set
+                # builds a NEW pytree, assigned only under the epoch
+                # check below — a watchdog restart in between drops it
+                layers = [
+                    {k: leaf.at[rows].set(data[k])
+                     for k, leaf in layer.items()}
+                    for layer, data in zip(self._cache["layers"],
+                                           entry.payload)]
+                out = dict(self._cache)
+                out["layers"] = layers
+                return out
+            new_cache = inject("kv.swap_in", copy_in)
+        except Exception as e:
+            alloc.free(blocks)
+            req.trace.event("kv.swap", direction="in", slot=i,
+                            failed=type(e).__name__)
+            return False
+        row = np.zeros(self.max_blocks_per_slot, np.int32)
+        row[:nfresh] = blocks
+        st = _Slot(greq=greq, request=req,
+                   n_generated=entry.n_generated,
+                   last_token=entry.last_token, length=entry.length,
+                   blocks=blocks, prefix_len=0, n_entries=nfresh,
+                   resumed=True)
+        with self._wd_lock:
+            seated = self._epoch == epoch and not self._stop.is_set()
+            if seated:
+                self._cache = new_cache
+                self._tables[i] = row
+                self._slots[i] = st
+        if not seated:
+            alloc.free(blocks)   # captured allocator: stale one is inert
+            return False         # the recompute path owns the terminal
+        self.metrics.kv_swap_bytes_in.inc(entry.nbytes)
+        req.trace.event("kv.swap", direction="in", slot=i,
+                        blocks=used, bytes=entry.nbytes)
+        req.trace.event("slot.assign", slot=i, swapped_in=True,
+                        resumed=True)
+        self._update_block_gauges()
+        return True
+
     # --------------------------------- on-demand growth + QoS preemption
     def _grow_block_tables(self, epoch: int) -> bool:
         """Map a fresh block into every live slot whose NEXT write (at
@@ -1515,10 +1683,76 @@ class GenerationEngine(ResilientEngineMixin):
                 return True      # slot i was evicted; caller re-scans
             # outcome == "freed": retry the allocation
 
+    def _try_swap_out(self, j: int, vst: _Slot, epoch: int):
+        """Copy victim slot ``j``'s written KV blocks (values AND int8
+        scales) to the host swap store. Caller holds ``_wd_lock`` with
+        the epoch verified and has NOT yet freed the victim's blocks —
+        the device_get must finish before ``free_batch`` can recycle
+        them under another stream. Returns ``(key, blocks, bytes)`` on
+        success, ``(None, 0, 0)`` when the victim is below the
+        crossover, structurally ineligible (pending CoW destination or
+        mid-feed rows whose K/V is not yet complete), the bounded store
+        cannot fit it, or the copy fails (seeded ``kv.swap_out`` fault
+        point) — every miss degrades to the recompute path."""
+        store = self._swap_store
+        if store is None or vst.blocks is None:
+            return None, 0, 0
+        if len(vst.blocks) <= self.swap_threshold_blocks:
+            return None, 0, 0
+        if vst.cow is not None or vst.pending:
+            # a pending copy-on-write destination still holds garbage
+            # rows, and a mid-feed slot's cache is not yet complete:
+            # neither snapshot would reproduce the stream
+            return None, 0, 0
+        if vst.prefix_len != 0 or vst.greq.prefix_id is not None:
+            # shared-span victims (explicit prefix / automatic cache
+            # hit) take the recompute path: their shared blocks outlive
+            # the eviction anyway, so the swap win is the private tail
+            # only — not worth duplicating pinned K/V into host RAM and
+            # re-deriving the plan's shared-block discount at re-seat
+            return None, 0, 0
+        used = blocks_for_tokens(vst.length, self.block_size)
+        if used <= 0 or used > vst.n_entries:
+            return None, 0, 0
+        rows = np.asarray(self._tables[j][:used], np.int32)
+        try:
+            # gather the used rows ON DEVICE, then one host transfer of
+            # just those blocks (not the whole pool)
+            payload = inject(
+                "kv.swap_out",
+                lambda: jax.device_get(
+                    [{k: leaf[rows] for k, leaf in layer.items()}
+                     for layer in self._cache["layers"]]))
+        except Exception:
+            return None, 0, 0
+        nbytes = sum(int(a.nbytes) for layer in payload
+                     for a in layer.values())
+        entry = SwapEntry(payload=payload, used_blocks=used,
+                          length=vst.length, n_generated=vst.n_generated,
+                          last_token=int(vst.last_token),
+                          prefix_len=vst.prefix_len, epoch=epoch,
+                          nbytes=nbytes)
+        key = store.put(entry)
+        if key is None:
+            return None, 0, 0
+        return key, used, nbytes
+
+    def _discard_swap(self, greq: "GenerationRequest"):
+        """Drop a requeued stream's swapped-out entry (terminal shed or
+        capacity refusal: the blocks will never be swapped back in)."""
+        if greq.swap_key is not None:
+            if self._swap_store is not None:
+                self._swap_store.discard(greq.swap_key)
+                self.metrics.kv_swapped_blocks_held.set(
+                    self._swap_store.blocks_held)
+            greq.swap_key = None
+
     def _preempt_for(self, needy_i: int, needy_st: _Slot,
                      epoch: int) -> str:
         """The pool cannot serve slot ``needy_i``'s next block: evict ONE
-        resident stream and requeue it for recompute-on-resume (vLLM
+        resident stream and requeue it — swapping its written blocks to
+        host RAM when it sits above the recompute-vs-copy crossover
+        (``swap_threshold_blocks``), else for recompute-on-resume (vLLM
         §4.5). Victim policy — QoS-aware, strict priority first: only
         same-or-LOWER classes than the needy stream are eligible (a
         batch stream never evicts interactive work), non-``preemptible``
@@ -1554,11 +1788,21 @@ class GenerationEngine(ResilientEngineMixin):
             else:
                 victim = (needy_i, needy_st)
             j, vst = victim
-            # evict under the lock with the epoch verified: the blocks
-            # are freed exactly once — a zombie cannot reach here (the
-            # epoch check above), and _reset_cache replaces the
-            # allocator wholesale on restart (PR 6 _clear_slot
-            # discipline, extended to eviction)
+            # swap-to-host (vLLM §4.5): a victim above the
+            # recompute-vs-copy crossover copies its written blocks to
+            # host RAM BEFORE they are freed — once free_batch runs the
+            # pool can hand those blocks to another stream, so the
+            # device_get must complete under the same lock that frees
+            # them. Any failure degrades to the recompute path (the
+            # entry simply isn't stored); it never sheds the stream.
+            # analysis: ok lock-discipline — the device_get must finish
+            # before free_batch hands these blocks to another stream;
+            # the copy is bounded (a victim's few KV blocks) and atomic
+            # with the table teardown under the same epoch lock every
+            # slot mutation takes. Moving it outside would race the
+            # pool reusing (and overwriting) the blocks mid-copy.
+            swap_key, swap_blocks, swap_bytes = self._try_swap_out(
+                j, vst, epoch)
             self._slots[j] = None
             self._tables[j] = 0
             blocks, vst.blocks = vst.blocks, None
@@ -1569,11 +1813,20 @@ class GenerationEngine(ResilientEngineMixin):
         greq.resume_tokens = np.asarray(greq.handle.tokens_so_far(),
                                         np.int32)
         greq.resume_step = vst.n_generated
+        greq.swap_key = swap_key
         greq.preemptions += 1
         self.metrics.preemptions_total.inc()
+        if swap_key is not None:
+            self.metrics.kv_swapped_blocks.inc(swap_blocks)
+            self.metrics.kv_swap_bytes_out.inc(swap_bytes)
+            self.metrics.kv_swapped_blocks_held.set(
+                self._swap_store.blocks_held)
+            req.trace.event("kv.swap", direction="out", slot=j,
+                            blocks=swap_blocks, bytes=swap_bytes)
         req.trace.event("preempt", slot=j,
                         tokens_generated=vst.n_generated,
                         blocks_freed=len(blocks or ()),
+                        swapped=swap_key is not None,
                         self_preempted=vst is needy_st)
         self._recorder.record("stream.preempt", engine=self.name,
                               slot=j, tenant=req.tenant,
@@ -1584,6 +1837,7 @@ class GenerationEngine(ResilientEngineMixin):
         # a 'deadline' shed (see MIGRATING.md)
         req.deadline_t = None
         if self._stop.is_set():
+            self._discard_swap(greq)
             self._shed_typed(req, PreemptedError(
                 f"stream preempted after {vst.n_generated} token(s) "
                 "while the engine was shutting down — resubmit",

@@ -279,6 +279,20 @@ class ServingMetrics:
         self.prefix_cache_blocks = Gauge("prefix_cache_blocks")
         # ---- preemption (allocate="on_demand" recompute-on-resume) --------
         self.preemptions_total = Counter("preemptions_total")
+        # ---- stream resume + KV swap-to-host (PR 15) ----------------------
+        # streams seated from a resume point instead of replayed from
+        # token 0: engine-side, a submit carrying resume_tokens (the
+        # wire-resume path) or a swap-in re-seat; front-door-side, a
+        # re-dispatch the remote host honored at the delivery watermark
+        self.stream_resumes_total = Counter("stream_resumes_total")
+        # cumulative blocks/bytes copied device->host on preemption
+        # swap-out and host->device on swap-in re-seating; the gauge is
+        # the store's CURRENT occupancy (bounded by the engine's
+        # swap_capacity_blocks)
+        self.kv_swapped_blocks = Counter("kv_swapped_blocks")
+        self.kv_swap_bytes_out = Counter("kv_swap_bytes_out")
+        self.kv_swap_bytes_in = Counter("kv_swap_bytes_in")
+        self.kv_swapped_blocks_held = Gauge("kv_swapped_blocks_held")
         # dtype-aware HBM accounting (paging.kv_bytes_per_token is the one
         # formula): int8 pools report their true 1-byte-values +
         # fp32-scale footprint, so "how much HBM does the cache hold" and
@@ -457,7 +471,9 @@ class ServingMetrics:
             self.slo_sheds_total, self.retry_budget_exhausted_total,
             self.preemptions_total, self.prefix_cache_hits_total,
             self.prefix_cache_inserts_total,
-            self.prefix_cache_evictions_total)}
+            self.prefix_cache_evictions_total,
+            self.stream_resumes_total, self.kv_swapped_blocks,
+            self.kv_swap_bytes_out, self.kv_swap_bytes_in)}
 
     def decode_tokens_per_sec(self) -> float:
         """Steady-state decode throughput: tokens sampled by decode_step
@@ -498,6 +514,7 @@ class ServingMetrics:
             "kv_block_occupancy": self.kv_block_occupancy.value,
             "kv_fragmentation": self.kv_fragmentation.value,
             "kv_reservation_slack": self.kv_reservation_slack.value,
+            "kv_swapped_blocks_held": self.kv_swapped_blocks_held.value,
             "prefix_cache_blocks": self.prefix_cache_blocks.value,
             "kv_block_bytes": self.kv_block_bytes.value,
             "kv_pool_hbm_bytes": self.kv_pool_hbm_bytes.value,

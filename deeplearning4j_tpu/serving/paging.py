@@ -17,8 +17,10 @@ replay identical allocation schedules, and block-churn bugs reproduce.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import threading
-from typing import List, Optional, Sequence, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -403,5 +405,129 @@ class PrefixCache:
             self._entries = []
 
 
-__all__ = ["BlockAllocator", "PrefixCache", "SharedPrefix",
-           "blocks_for_tokens", "kv_bytes_per_token"]
+@dataclasses.dataclass
+class SwapEntry:
+    """One preempted stream's parked KV state (host RAM).
+
+    ``payload`` mirrors the pool's per-layer leaf layout — a list of
+    ``{"k", "v"[, "k_scale", "v_scale"]}`` dicts whose arrays carry the
+    victim's USED blocks as their leading axis (``(used, block_size,
+    heads, head_dim)`` values, ``(used, block_size, heads)`` int8
+    scales) — so swap-in is a straight row scatter back into whatever
+    physical blocks the re-seating allocates. The scheduler-side seat
+    state (``length``/``n_generated``/``last_token``/``prefix_len``)
+    rides along so the re-seated slot resumes mid-decode with no
+    prefill at all. ``epoch`` stamps the engine epoch the K/V was
+    captured under: a watchdog restart rebuilds the pool, making every
+    parked entry's data void — the engine invalidates the store AND
+    checks the stamp before swapping in."""
+
+    payload: List[Dict[str, np.ndarray]]
+    used_blocks: int
+    length: int
+    n_generated: int
+    last_token: int
+    prefix_len: int
+    epoch: int
+    nbytes: int
+
+
+class BlockSwapStore:
+    """Bounded host-RAM parking lot for preempted streams' KV blocks —
+    the swap half of vLLM SOSP'23 §4.5's swap-vs-recompute tradeoff.
+
+    On preemption a victim whose footprint sits above the
+    recompute-vs-copy crossover (``GenerationEngine(swap_threshold_
+    blocks=...)``) has its used blocks ``device_get`` into an entry
+    here instead of being discarded; re-seating ``device_put``s them
+    back and rebuilds the block-table row, so resume costs one block
+    copy instead of a full prefix recompute. The store is strictly an
+    OPTIMIZATION layer: every entry's stream also carries the PR 13
+    ``resume_tokens``/``resume_step`` recompute state, so an entry
+    evicted under capacity pressure (LRU), dropped by a failed swap-in,
+    or invalidated by a pool rebuild degrades that stream to the
+    recompute path — never to a shed.
+
+    Capacity is bounded in BLOCKS (``capacity_blocks``); inserting past
+    it evicts least-recently-parked entries first (their streams
+    recompute). ``take`` pops an entry for re-seating; ``discard``
+    drops one that can no longer be used; ``invalidate`` empties the
+    store wholesale on a cache rebuild. All methods lock internally;
+    the lock is a leaf (pure host bookkeeping, no outcalls)."""
+
+    def __init__(self, capacity_blocks: int):
+        if capacity_blocks <= 0:
+            raise ValueError(
+                f"capacity_blocks must be positive, got {capacity_blocks}")
+        self.capacity_blocks = int(capacity_blocks)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[int, SwapEntry]" = OrderedDict()
+        self._keys = itertools.count(1)
+        self.swap_outs = 0
+        self.swap_ins = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def blocks_held(self) -> int:
+        with self._lock:
+            return sum(e.used_blocks for e in self._entries.values())
+
+    @property
+    def bytes_held(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def put(self, entry: SwapEntry) -> Optional[int]:
+        """Park one entry; returns its key, or None when the entry alone
+        exceeds the store's whole capacity (the caller recomputes).
+        Evicts LRU entries until the new total fits — evicted streams
+        silently degrade to recompute when their ``take`` misses."""
+        if entry.used_blocks > self.capacity_blocks:
+            return None
+        with self._lock:
+            held = sum(e.used_blocks for e in self._entries.values())
+            while held + entry.used_blocks > self.capacity_blocks \
+                    and self._entries:
+                _, old = self._entries.popitem(last=False)
+                held -= old.used_blocks
+                self.evictions += 1
+            key = next(self._keys)
+            self._entries[key] = entry
+            self.swap_outs += 1
+            return key
+
+    def take(self, key: Optional[int]) -> Optional[SwapEntry]:
+        """Pop the entry parked under ``key`` (None for a miss — the
+        entry was LRU-evicted or the store invalidated; the stream
+        recomputes)."""
+        if key is None:
+            return None
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self.swap_ins += 1
+            return e
+
+    def discard(self, key: Optional[int]) -> None:
+        """Drop one entry without counting a swap-in (its stream shed or
+        its resume became impossible)."""
+        if key is None:
+            return
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def invalidate(self):
+        """Drop every entry — the pool the data was captured from died
+        under a cache rebuild; parked K/V no longer matches any
+        allocator the engine will ever hand out."""
+        with self._lock:
+            self._entries.clear()
+
+
+__all__ = ["BlockAllocator", "BlockSwapStore", "PrefixCache",
+           "SharedPrefix", "SwapEntry", "blocks_for_tokens",
+           "kv_bytes_per_token"]

@@ -93,7 +93,17 @@ class RpcRequest:
     clocks are not comparable; the receiver re-anchors the budget on its
     own clock, so network transit only ever SHRINKS the deadline).
     ``hedge_attempt`` numbers re-dispatches of the same logical request
-    so server logs can correlate a hedge's loser and winner."""
+    so server logs can correlate a hedge's loser and winner.
+
+    ``resume_tokens``/``resume_step`` (wire v2, PR 13's recompute-on-
+    resume crossing the RPC boundary) re-dispatch a lost stream from its
+    delivery watermark: the already-delivered tokens ride along, the
+    replacement host runs ONE recompute prefill, and decoding continues
+    at index ``resume_step`` — bitwise the uninterrupted stream, zero
+    re-decoded tokens. Both fields are DEFAULTED so a v1 receiver's
+    known-field filter silently drops them and replays from token 0
+    (the client's watermark dedup absorbs the duplicates — see the
+    ``RpcResponse.resume_step`` echo)."""
 
     request_id: str = ""
     kind: str = "infer"                  # 'infer' | 'generate'
@@ -109,12 +119,15 @@ class RpcRequest:
     eos_default: bool = True             # True: use the host engine's eos
     seed: int = 0
     prefix_id: Optional[str] = None
+    # ---- resume-from-watermark (wire v2) ---------------------------------
+    resume_tokens: Optional[list] = None  # delivered-so-far token ids
+    resume_step: int = 0                  # == len(resume_tokens)
     # ---- identity + budget ----------------------------------------------
     tenant: Optional[str] = None
     priority: Optional[str] = None
     timeout_ms: Optional[float] = None   # remaining budget at send time
     hedge_attempt: int = 0
-    wire_version: int = 1
+    wire_version: int = 2
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -130,7 +143,14 @@ class RpcResponse:
     """Submit/result envelope. ``ok=False`` carries the host's own typed
     rejection (``error_reason`` from the one taxonomy) so the client
     re-raises it as if admission had run locally; ``done=False`` is the
-    long-poll "nothing yet" answer for infer results."""
+    long-poll "nothing yet" answer for infer results.
+
+    ``resume_step`` (wire v2) ECHOES the honored resume point of a
+    generate admit: a v2 server that seated the stream at the request's
+    watermark answers with it, a v1 server (whose ``from_dict`` dropped
+    the resume fields) leaves the default 0 — so the client knows
+    whether the attempt resumes or replays, and only pre-seeds its
+    delivered prefix in the former case."""
 
     request_id: str = ""
     ok: bool = False
@@ -140,7 +160,8 @@ class RpcResponse:
     result_dtype: Optional[str] = None
     error_reason: Optional[str] = None
     error_message: Optional[str] = None
-    wire_version: int = 1
+    resume_step: int = 0
+    wire_version: int = 2
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -407,6 +428,13 @@ class HostRpcServer:
             elif req.kind == "generate":
                 state = _OpState(op_id, "generate")
                 kw = {} if req.eos_default else {"eos_id": req.eos_id}
+                if req.resume_tokens is not None:
+                    # wire v2 resume: seat through the engine's
+                    # recompute-on-resume path (one recompute prefill,
+                    # next sample at index resume_step)
+                    kw["resume_tokens"] = np.asarray(req.resume_tokens,
+                                                     np.int32)
+                    kw["resume_step"] = int(req.resume_step)
                 handle = self.host.submit_generate(
                     np.asarray(req.prompt, np.int32),
                     max_new_tokens=req.max_new_tokens,
@@ -439,7 +467,10 @@ class HostRpcServer:
                                error_message=str(e)).to_dict()
         self._register(state)
         return RpcResponse(request_id=req.request_id, ok=True,
-                           stream_id=op_id).to_dict()
+                           stream_id=op_id,
+                           resume_step=int(req.resume_step)
+                           if req.resume_tokens is not None else 0
+                           ).to_dict()
 
     def _make_on_token(self, state: _OpState):
         def on_token(_tok: int):
@@ -589,12 +620,20 @@ class RemoteStream:
     cursor-addressed chunk protocol the bridge and the front door's
     hedging supervisor drive. Deliberately not a GenerationHandle — the
     handle the caller holds outlives attempts (hedged re-dispatch swaps
-    the attempt underneath it)."""
+    the attempt underneath it).
 
-    def __init__(self, host: "RemoteHost", stream_id: str):
+    ``resume_step`` is the HONORED resume point echoed by the server's
+    admit (0 when the attempt replays from the first token — a fresh
+    dispatch, or a v1 peer that dropped the resume fields): the hedging
+    supervisor pre-seeds its delivered prefix only when it is > 0, and
+    this attempt's cursor space starts there."""
+
+    def __init__(self, host: "RemoteHost", stream_id: str,
+                 resume_step: int = 0):
         self.host = host
         self.host_id = host.host_id
         self.stream_id = stream_id
+        self.resume_step = int(resume_step)
 
     def poll(self, cursor: int, wait_ms: float) -> RpcStreamChunk:
         """The next chunk past ``cursor`` (long-polls up to ``wait_ms``
@@ -784,6 +823,18 @@ class RemoteHost(HostHandle):
         resp = self._submit_wire(req)
         fut: Future = Future()
         fut.set_running_or_notify_cancel()
+
+        def cancel_remote(op_id=resp.stream_id):
+            # best-effort server-side drop (the hedge loser's cleanup);
+            # the host may already be gone — that IS the cancel
+            try:
+                self._rpc(f"{RPC_PREFIX}/cancel",
+                          {"stream_id": op_id, "wire_version": 1},
+                          point=None)
+            except Exception:
+                pass
+
+        fut.cancel_remote = cancel_remote  # type: ignore[attr-defined]
         t = threading.Thread(
             target=self._poll_result, args=(resp.stream_id, fut, deadline_t),
             daemon=True, name=f"rpc-result[h{self.host_id}]")
@@ -876,12 +927,21 @@ class RemoteHost(HostHandle):
                     tenant: Optional[str] = None,
                     priority: Optional[str] = None,
                     hedge_attempt: int = 0,
-                    deadline_t: Optional[float] = None) -> RemoteStream:
+                    deadline_t: Optional[float] = None,
+                    resume_tokens=None,
+                    resume_step: int = 0) -> RemoteStream:
         """Admit one generation attempt remotely and return the
         attempt-scoped :class:`RemoteStream`. ``deadline_t`` (this
         client's clock) takes precedence over ``timeout_ms`` so hedged
         re-dispatches of one logical request share ONE deadline — each
-        attempt ships only the budget that remains."""
+        attempt ships only the budget that remains.
+
+        ``resume_tokens``/``resume_step`` ask the host to seat this
+        attempt at the delivery watermark instead of replaying (wire v2;
+        ``max_new_tokens`` stays the ORIGINAL total budget). The
+        returned stream's ``resume_step`` is what the server actually
+        honored — 0 from a v1 peer, whose replay-from-0 the caller's
+        watermark dedup must absorb."""
         toks = np.asarray(prompt, np.int32).ravel()
         if deadline_t is None:
             deadline_t = self._deadline_t(timeout_ms)
@@ -893,11 +953,15 @@ class RemoteHost(HostHandle):
             temperature=float(temperature), top_k=int(top_k),
             eos_id=None if eos_default else eos_id,
             eos_default=eos_default, seed=int(seed), prefix_id=prefix_id,
+            resume_tokens=None if resume_tokens is None
+            else [int(t) for t in resume_tokens],
+            resume_step=int(resume_step),
             tenant=tenant, priority=priority,
             timeout_ms=self._budget_ms(deadline_t),
             hedge_attempt=int(hedge_attempt))
         resp = self._submit_wire(req)
-        return RemoteStream(self, resp.stream_id)
+        return RemoteStream(self, resp.stream_id,
+                            resume_step=int(resp.resume_step or 0))
 
     def submit_generate(self, prompt, **kwargs):
         """HostHandle surface: admit remotely and bridge the stream into

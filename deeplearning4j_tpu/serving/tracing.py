@@ -14,7 +14,12 @@ that timeline:
   span events with monotonic timestamps over the request's whole life
   (``submit -> queue.admit -> queue.wait -> prefill/dispatch ->
   decode.step* -> retire``), including resilience events (``retry.attempt``,
-  ``watchdog.restart``, breaker sheds as terminal reasons).
+  ``watchdog.restart``, breaker sheds as terminal reasons). Recovery
+  events ride the same timeline: ``stream.resume`` (a submit carrying a
+  delivered-so-far watermark — engine-side on the resumed host, front-
+  door-side on the re-dispatching hedge supervisor) and ``kv.swap``
+  (``direction="out"|"in"`` — a preemption victim's blocks moving to or
+  from the host-RAM swap store instead of being recomputed).
 - :class:`Tracer` — per-process (or per-engine) trace collector with
   **tail sampling**: every in-flight request of an enabled tracer is
   recorded live, and the retention decision happens at ``finish()`` —

@@ -1,0 +1,354 @@
+"""KV swap-to-host preemption (ISSUE 15 — serving/paging.py
+``BlockSwapStore`` + the generation engine's swap-out/swap-in hooks).
+
+Acceptance criteria exercised here:
+- a preemption victim above ``swap_threshold_blocks`` parks its used
+  blocks in bounded host RAM and re-seats by copying them back —
+  preempt -> swap -> resume is bitwise the unpreempted stream (greedy
+  AND sampled) with NO second prefill;
+- the defaults (``swap_threshold_blocks=None``) build no store and stay
+  bitwise-inert; a threshold above every victim's footprint degrades to
+  the PR 13 recompute path;
+- seeded ``kv.swap_out`` / ``kv.swap_in`` fault points degrade a failed
+  swap to recompute — never to a shed — and the stream stays bitwise;
+- shared-span victims (explicit prefix) never swap (their block demand
+  is computed WITH the shared discount; a private swap-in could need
+  more blocks than admission verified);
+- swap occupancy rides the heartbeat (``HostStatus``, mixed-fleet
+  defaulted) and rolls up in ``/api/cluster``; the engine counters flow
+  through ``snapshot()``;
+- a timed-out drain releases the AUTOMATIC prefix cache (admission is
+  closed — nothing can ever match it again) while keeping explicit
+  pins for the caller's force-shutdown decision.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import TransformerConfig, init_params
+from deeplearning4j_tpu.serving import (
+    BlockSwapStore, ClusterDirectory, FaultPlan, GenerationEngine,
+    HeartbeatPump, LoopbackHost, LoopbackTransport, QosPolicy, SwapEntry,
+    Tracer,
+)
+
+CFG = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                        mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                        causal=True, attention_impl="full", remat=False)
+
+QOS = QosPolicy(tenants={"fast": {"priority": "interactive"},
+                         "slow": {"priority": "batch"}})
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n).astype(np.int32)
+
+
+def entry(used=2, nbytes=64, epoch=0):
+    return SwapEntry(payload=[], used_blocks=used, length=10,
+                     n_generated=3, last_token=7, prefix_len=0,
+                     epoch=epoch, nbytes=nbytes)
+
+
+# ---------------------------------------------------------------------------
+# BlockSwapStore: bounded LRU parking lot, miss == recompute
+# ---------------------------------------------------------------------------
+class TestBlockSwapStore:
+    def test_capacity_must_be_positive(self):
+        for bad in (0, -3):
+            with pytest.raises(ValueError, match="positive"):
+                BlockSwapStore(bad)
+
+    def test_put_take_round_trip_counts(self):
+        s = BlockSwapStore(8)
+        e = entry(used=3, nbytes=96)
+        k = s.put(e)
+        assert k is not None
+        assert len(s) == 1 and s.blocks_held == 3 and s.bytes_held == 96
+        assert s.take(k) is e
+        assert len(s) == 0 and s.blocks_held == 0
+        assert s.swap_outs == 1 and s.swap_ins == 1
+        # a second take of the same key is a MISS (recompute), not an
+        # error — and a None key short-circuits
+        assert s.take(k) is None and s.take(None) is None
+        assert s.swap_ins == 1
+
+    def test_oversized_entry_refused_untouched(self):
+        s = BlockSwapStore(4)
+        k1 = s.put(entry(used=2))
+        assert s.put(entry(used=5)) is None   # alone exceeds capacity
+        assert len(s) == 1 and s.take(k1) is not None
+        assert s.evictions == 0
+
+    def test_lru_eviction_under_pressure(self):
+        s = BlockSwapStore(4)
+        k1 = s.put(entry(used=2))
+        k2 = s.put(entry(used=2))
+        k3 = s.put(entry(used=2))        # evicts k1 (oldest parked)
+        assert s.evictions == 1
+        assert s.take(k1) is None        # its stream recomputes
+        assert s.take(k2) is not None and s.take(k3) is not None
+
+    def test_discard_does_not_count_a_swap_in(self):
+        s = BlockSwapStore(8)
+        k = s.put(entry())
+        s.discard(k)
+        s.discard(None)
+        assert len(s) == 0 and s.swap_ins == 0
+        assert s.take(k) is None
+
+    def test_invalidate_empties_wholesale(self):
+        s = BlockSwapStore(8)
+        keys = [s.put(entry()) for _ in range(3)]
+        s.invalidate()
+        assert len(s) == 0 and s.blocks_held == 0
+        assert all(s.take(k) is None for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# Preempt -> swap -> resume: bitwise, no second prefill
+# ---------------------------------------------------------------------------
+def preempt_scenario(params, sample_kw=None, victim_kw=None, tracer=None,
+                     **engine_kw):
+    """QoS preemption: the batch victim is evicted for the interactive
+    aggressor's block demand. Returns (victim_tokens, aggressor_tokens,
+    engine-metrics closure results)."""
+    sample_kw = sample_kw or {}
+    victim_kw = victim_kw or {}
+    with GenerationEngine(params, CFG, slots=2, max_len=32, block_size=8,
+                          num_blocks=5, allocate="on_demand", qos=QOS,
+                          queue_capacity=8, tracer=tracer,
+                          **engine_kw) as eng:
+        hv = eng.submit(prompt(4, 1), max_new_tokens=20, eos_id=None,
+                        tenant="slow", **sample_kw, **victim_kw)
+        ha = eng.submit(prompt(4, 0), max_new_tokens=20, eos_id=None,
+                        tenant="fast", **sample_kw)
+        victim = hv.result(timeout=120)
+        aggressor = ha.result(timeout=120)
+        stats = {
+            "preemptions": int(eng.metrics.preemptions_total.value),
+            "swapped_blocks": int(eng.metrics.kv_swapped_blocks.value),
+            "bytes_out": int(eng.metrics.kv_swap_bytes_out.value),
+            "bytes_in": int(eng.metrics.kv_swap_bytes_in.value),
+            "prefills": int(eng.metrics.prefills_total.value),
+            "held": int(eng.metrics.kv_swapped_blocks_held.value),
+            "snapshot": eng.metrics.snapshot(),
+        }
+    return victim, aggressor, stats
+
+
+def oracle(params, sample_kw=None, victim_kw=None):
+    """The same two streams on an unconstrained engine: no preemption."""
+    sample_kw = sample_kw or {}
+    victim_kw = victim_kw or {}
+    with GenerationEngine(params, CFG, slots=2, max_len=32,
+                          block_size=8) as eng:
+        v = eng.submit(prompt(4, 1), max_new_tokens=20, eos_id=None,
+                       **sample_kw, **victim_kw).result(timeout=120)
+        a = eng.submit(prompt(4, 0), max_new_tokens=20, eos_id=None,
+                       **sample_kw).result(timeout=120)
+    return v, a
+
+
+class TestSwapPreemptResume:
+    SWAP = dict(swap_threshold_blocks=0, swap_capacity_blocks=64)
+
+    def test_greedy_bitwise_no_reprefill(self, params):
+        tracer = Tracer(enabled=True, sample_rate=1.0)
+        v, a, st = preempt_scenario(params, tracer=tracer, **self.SWAP)
+        vo, ao = oracle(params)
+        assert (v, a) == (vo, ao)
+        assert st["preemptions"] >= 1
+        assert st["swapped_blocks"] >= 1 and st["bytes_out"] > 0
+        assert st["bytes_in"] == st["bytes_out"]
+        # the victim's resume copied blocks back in — NO second
+        # prefill: one per stream, exactly
+        assert st["prefills"] == 2
+        assert st["held"] == 0          # every parked entry re-seated
+        # the victim's own trace carries the swap round trip
+        swap_events = [a_ for t in tracer.traces()
+                       for n, _, a_ in t.events if n == "kv.swap"]
+        assert {e["direction"] for e in swap_events} == {"out", "in"}
+
+    def test_sampled_bitwise_no_reprefill(self, params):
+        kw = dict(temperature=0.8, top_k=5)
+        v, a, st = preempt_scenario(
+            params, sample_kw=kw, victim_kw={"seed": 11}, **self.SWAP)
+        vo, ao = oracle(params, sample_kw=kw, victim_kw={"seed": 11})
+        # per-request keys fold the token index: the swapped-in stream's
+        # draws are position-stable, bitwise the unpreempted run
+        assert (v, a) == (vo, ao)
+        assert st["preemptions"] >= 1 and st["swapped_blocks"] >= 1
+        assert st["prefills"] == 2
+
+    def test_threshold_none_builds_no_store_and_is_inert(self, params):
+        v, a, st = preempt_scenario(params)     # defaults: swap off
+        vo, ao = oracle(params)
+        assert (v, a) == (vo, ao)
+        assert st["preemptions"] >= 1
+        assert st["swapped_blocks"] == 0 and st["bytes_out"] == 0
+        assert st["prefills"] == 3              # recompute resume
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8) as eng:
+            assert eng._swap_store is None
+
+    def test_threshold_above_footprint_degrades_to_recompute(self, params):
+        v, a, st = preempt_scenario(params, swap_threshold_blocks=16,
+                                    swap_capacity_blocks=64)
+        vo, ao = oracle(params)
+        assert (v, a) == (vo, ao)
+        assert st["preemptions"] >= 1 and st["swapped_blocks"] == 0
+
+    def test_swap_kwargs_require_paged_pool(self, params):
+        with pytest.raises(ValueError):
+            GenerationEngine(params, CFG, slots=2, max_len=32,
+                             paged=False, swap_threshold_blocks=0)
+
+    def test_shared_prefix_victim_never_swaps(self, params):
+        """Explicit-prefix victims carry shared-span block discounts in
+        their verified admission demand — swapping them would duplicate
+        pinned K/V and break the plan-vs-seat accounting, so they take
+        the recompute path."""
+        sysp = prompt(8, seed=9)
+
+        def run(**engine_kw):
+            with GenerationEngine(params, CFG, slots=2, max_len=48,
+                                  block_size=8, num_blocks=7,
+                                  allocate="on_demand", qos=QOS,
+                                  queue_capacity=8, **engine_kw) as eng:
+                eng.register_prefix(sysp, prefix_id="sys", timeout=60.0)
+                hv = eng.submit(prompt(4, 1), max_new_tokens=20,
+                                eos_id=None, tenant="slow",
+                                prefix_id="sys")
+                ha = eng.submit(prompt(4, 0), max_new_tokens=20,
+                                eos_id=None, tenant="fast")
+                v = hv.result(timeout=120)
+                ha.result(timeout=120)
+                return v, (int(eng.metrics.preemptions_total.value),
+                           int(eng.metrics.kv_swapped_blocks.value))
+
+        v_swap, (npre, nswap) = run(**self.SWAP)
+        v_plain, _ = run()
+        assert v_swap == v_plain
+        assert npre >= 1
+        assert nswap == 0       # the prefix victim degraded to recompute
+
+
+# ---------------------------------------------------------------------------
+# Seeded swap chaos: a failed swap degrades to recompute, never sheds
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+class TestSwapChaos:
+    SWAP = dict(swap_threshold_blocks=0, swap_capacity_blocks=64)
+
+    def test_swap_out_fault_degrades_to_recompute(self, params):
+        plan = FaultPlan(seed=3).fail("kv.swap_out", at=(0,))
+        with plan:
+            v, a, st = preempt_scenario(params, **self.SWAP)
+        vo, ao = oracle(params)
+        assert (v, a) == (vo, ao)       # bitwise despite the fault
+        assert st["preemptions"] >= 1
+        assert any(f["point"] == "kv.swap_out" for f in plan.fired())
+
+    def test_swap_in_fault_frees_blocks_and_recomputes(self, params):
+        plan = FaultPlan(seed=5).fail("kv.swap_in", at=(0,))
+        with plan:
+            v, a, st = preempt_scenario(params, **self.SWAP)
+        vo, ao = oracle(params)
+        assert (v, a) == (vo, ao)
+        assert st["preemptions"] >= 1
+        assert any(f["point"] == "kv.swap_in" for f in plan.fired())
+        assert st["held"] == 0          # nothing left parked
+
+    def test_seeded_plan_replays_bitwise(self, params):
+        runs = []
+        for _ in range(2):
+            with FaultPlan(seed=7).fail("kv.swap_out", rate=1.0):
+                v, a, _ = preempt_scenario(params, **self.SWAP)
+            runs.append((v, a))
+        assert runs[0] == runs[1]
+
+
+# ---------------------------------------------------------------------------
+# Observability: heartbeat occupancy, fleet roll-up, metric flow
+# ---------------------------------------------------------------------------
+class TestSwapObservability:
+    def test_status_and_api_snapshot_carry_occupancy(self, params):
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, num_blocks=5,
+                              allocate="on_demand",
+                              swap_threshold_blocks=0,
+                              swap_capacity_blocks=16) as eng:
+            # park one entry directly: the heartbeat reads occupancy,
+            # not provenance
+            eng._swap_store.put(entry(used=3, nbytes=96))
+            h = LoopbackHost(0, generation=eng)
+            d = ClusterDirectory(heartbeat_timeout_s=30.0)
+            d.join(h)
+            HeartbeatPump(h, LoopbackTransport(d)).pump_once()
+            st = h.status()
+            assert st.kv_swapped_blocks == 3
+            assert st.kv_swap_capacity_blocks == 16
+            fleet = d.api_snapshot()["fleet"]
+            assert fleet["kv_swapped_blocks"] == 3
+            assert fleet["kv_swap_capacity_blocks"] == 16
+
+    def test_pre_upgrade_heartbeat_defaults_swap_fields(self):
+        from deeplearning4j_tpu.serving import HostStatus
+
+        st = HostStatus(host_id=1, has_generate=True, slots=2, seq=1)
+        wire = st.to_dict()
+        del wire["kv_swapped_blocks"]
+        del wire["kv_swap_capacity_blocks"]
+        back = HostStatus.from_dict(wire)
+        assert back.kv_swapped_blocks == 0
+        assert back.kv_swap_capacity_blocks == 0
+
+    def test_swap_counters_flow_through_snapshot(self, params):
+        _, _, st = preempt_scenario(
+            params, swap_threshold_blocks=0, swap_capacity_blocks=64)
+        snap = st["snapshot"]
+        for key in ("stream_resumes_total", "kv_swapped_blocks",
+                    "kv_swap_bytes_out", "kv_swap_bytes_in",
+                    "kv_swapped_blocks_held"):
+            assert key in snap, key
+        assert snap["kv_swapped_blocks"] >= 1
+        assert snap["kv_swap_bytes_in"] == snap["kv_swap_bytes_out"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Drain releases the automatic cache on BOTH exits (ISSUE 15 bugfix)
+# ---------------------------------------------------------------------------
+class TestDrainReleasesAutomaticCache:
+    def test_timed_out_drain_releases_auto_cache_keeps_pins(self, params):
+        sysp = prompt(17, seed=7)
+        p1 = np.concatenate([sysp, prompt(3, 1)]).astype(np.int32)
+        with GenerationEngine(params, CFG, slots=2, max_len=64,
+                              block_size=8, prefix_cache_blocks=6,
+                              queue_capacity=8) as eng:
+            eng.generate(p1, max_new_tokens=4, timeout=120)   # seeds cache
+            assert eng.metrics.prefix_cache_blocks.value > 0
+            eng.register_prefix(prompt(8, seed=5), prefix_id="pin",
+                                timeout=60.0)
+            # a stream that outlives the drain window
+            h = eng.submit(prompt(4, 2), max_new_tokens=40, eos_id=None)
+            while not h.tokens_so_far():
+                time.sleep(0.001)
+            assert eng.drain(timeout=0.01) is False
+            # automatic cache: released — admission is closed, nothing
+            # can ever match it again
+            assert eng.metrics.prefix_cache_blocks.value == 0
+            assert eng.metrics.prefix_cache_evictions_total.value >= 1
+            # explicit pin: KEPT on the timeout exit (documented
+            # contract — the caller decides whether to force shutdown)
+            with eng._prefix_lock:
+                assert "pin" in eng._prefixes

@@ -177,7 +177,10 @@ class TestWireSchema:
     def test_round_trip_through_json(self, msg):
         wire = json.loads(json.dumps(msg.to_dict()))
         assert type(msg).from_dict(wire) == msg
-        assert wire["wire_version"] == 1
+        # RpcRequest/RpcResponse grew resume-from-watermark fields (v2);
+        # chunks are unchanged since v1
+        want = 1 if isinstance(msg, RpcStreamChunk) else 2
+        assert wire["wire_version"] == want
 
     @pytest.mark.parametrize("msg", CASES, ids=lambda m: type(m).__name__)
     def test_v2_sender_to_v1_receiver_ignores_unknown_fields(self, msg):
@@ -202,12 +205,19 @@ class TestWireSchema:
         still parses (the receiver branches on wire_version instead of
         crashing on shape)."""
         wire = msg.to_dict()
-        # simulate the old sender: drop every defaulted field it never had
+        # simulate the old sender: drop every defaulted field it never
+        # had, and stamp ITS wire version
         for drop in ("hedge_attempt", "finish_reason", "result_dtype",
-                     "error_reason", "error_message"):
+                     "error_reason", "error_message",
+                     "resume_tokens", "resume_step"):
             wire.pop(drop, None)
+        wire["wire_version"] = 1
         back = type(msg).from_dict(wire)
         assert back.wire_version == 1
+        if isinstance(back, RpcRequest):
+            assert back.resume_tokens is None and back.resume_step == 0
+        if isinstance(back, RpcResponse):
+            assert back.resume_step == 0
 
     def test_host_status_draining_defaults_for_old_senders(self):
         """The PR 10 heartbeat schema grew ``draining`` this PR: a
@@ -847,6 +857,9 @@ class TestHedgedRedispatch:
             # ground truth: the same seeded stream on an unkilled engine
             want = engines[1].submit(p, max_new_tokens=24,
                                      seed=7).result(timeout=120)
+            g_base = [int(e.metrics.generated_tokens_total.value)
+                      for e in engines]
+            p_base = [int(e.metrics.prefills_total.value) for e in engines]
 
             seen, killed = [], threading.Event()
 
@@ -896,6 +909,21 @@ class TestHedgedRedispatch:
                       if n == "cluster.bounce"][0]
             assert bounce["host"] == victim
             assert bounce["reason"] == "host_unavailable"
+
+            # ISSUE 15: the re-dispatch RESUMED from the delivery
+            # watermark instead of replaying — the survivor ran ONE
+            # recompute prefill and re-decoded ZERO delivered tokens
+            survivor = engines[1 - victim]
+            assert survivor.metrics.stream_resumes_total.value == 1
+            resumes = [a for n, _, a in tr.events
+                       if n == "stream.resume"]
+            assert resumes, names
+            r = int(resumes[-1]["resume_step"])
+            assert r >= 4          # killed after the 4th delivered token
+            assert int(survivor.metrics.generated_tokens_total.value) \
+                == g_base[1 - victim] + (24 - r)
+            assert int(survivor.metrics.prefills_total.value) \
+                == p_base[1 - victim] + 1
         finally:
             stop_fleet(servers, locals_)
 
@@ -1130,6 +1158,260 @@ class TestTimeoutHedge:
             HedgePolicy(max_attempts=0)
         with pytest.raises(ValueError):
             HedgePolicy(poll_wait_ms=0.0)
+        with pytest.raises(ValueError):
+            HedgePolicy(infer_hedge_after_ms=0.0)
+
+
+# --------------------------------------------------------------------------
+# Resume-from-watermark re-dispatch (ISSUE 15): v2 honors, v1 replays
+# --------------------------------------------------------------------------
+class _DyingAfterHost(_StubHost):
+    """Delivers the first ``k`` tokens, then dies retriably on the next
+    poll — the re-dispatch trigger with a non-zero delivery watermark."""
+
+    def __init__(self, host_id, tokens, k, **kw):
+        super().__init__(host_id, tokens=tokens, **kw)
+        self.k = k
+
+    def _poll(self, stream, cursor, wait_ms):
+        if cursor < self.k:
+            return RpcStreamChunk(stream_id=stream.stream_id,
+                                  cursor=cursor,
+                                  tokens=self.tokens[cursor:self.k],
+                                  done=False)
+        raise HostUnavailableError("host died mid-stream",
+                                   host=self.host_id)
+
+
+class _ResumeRecordingHost(_StubHost):
+    """Records the resume kwargs every ``open_stream`` carried. With
+    ``honor=True`` it behaves like a v2 server: echoes ``resume_step``
+    on the stream and serves ONLY the remaining tokens. With
+    ``honor=False`` it is a v1 server mid-rolling-upgrade: the resume
+    fields fall off its known-field filter, it replays from token 0 and
+    echoes nothing."""
+
+    def __init__(self, host_id, tokens, honor=True, **kw):
+        super().__init__(host_id, tokens=tokens, **kw)
+        self.honor = honor
+        self.saw_resume = []
+
+    def open_stream(self, prompt, resume_tokens=None, resume_step=0,
+                    **kw):
+        self.opened += 1
+        self.saw_resume.append(
+            (None if resume_tokens is None else
+             [int(t) for t in resume_tokens], int(resume_step)))
+        s = _StubStream(self, f"s{self.host_id}-{self.opened}")
+        if self.honor and resume_tokens is not None:
+            s.resume_step = int(resume_step)
+            s.base = len(resume_tokens)
+        else:
+            s.base = 0
+        self.streams.append(s)
+        return s
+
+    def _poll(self, stream, cursor, wait_ms):
+        toks = self.tokens[stream.base + cursor:]
+        return RpcStreamChunk(stream_id=stream.stream_id, cursor=cursor,
+                              tokens=toks, done=True,
+                              finish_reason="max_tokens")
+
+
+class TestResumeRedispatch:
+    TOKENS = [100 + i for i in range(8)]
+
+    def _fleet(self, hosts):
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        tr = LoopbackTransport(d)
+        for h in hosts:
+            d.join(h)
+            tr.publish(h.status())
+        return d
+
+    def _run(self, replacement):
+        dying = _DyingAfterHost(0, self.TOKENS, k=3, free_slots=8)
+        d = self._fleet([dying, replacement])
+        fd = ClusterFrontDoor(d, hedge=HedgePolicy(
+            hedge_after_ms=None, max_attempts=3, poll_wait_ms=20.0))
+        seen = []
+        h = fd.submit_generate(np.asarray([1, 2, 3], np.int32),
+                               max_new_tokens=len(self.TOKENS),
+                               on_token=seen.append)
+        res = h.result(timeout=30)
+        return fd, seen, res
+
+    def test_v2_replacement_resumes_zero_tokens_redecoded(self):
+        """The re-dispatch ships the delivered-so-far watermark; a v2
+        replacement honors it, serves only the remainder, and the
+        client pre-seeds — no token crosses the wire twice."""
+        good = _ResumeRecordingHost(1, self.TOKENS, honor=True,
+                                    free_slots=2)
+        fd, seen, res = self._run(good)
+        assert res == self.TOKENS and seen == res
+        assert fd.hedges.get("redispatch") == 1
+        # the replacement saw EXACTLY the delivered watermark
+        [(rtoks, rstep)] = good.saw_resume
+        assert rtoks == self.TOKENS[:3] and rstep == 3
+        assert fd.metrics.stream_resumes_total.value == 1
+        assert sum(fd.metrics.tenant_served.to_dict().values()) == 1
+
+    def test_v1_replacement_replays_and_watermark_dedups(self):
+        """Rolling upgrade, other direction: the replacement is a v1
+        server — the resume fields fall off its known-field filter and
+        it replays from token 0. The un-echoed resume_step tells the
+        client NOT to pre-seed, and the delivery watermark absorbs the
+        replayed prefix: the caller still sees every token exactly
+        once."""
+        old = _ResumeRecordingHost(1, self.TOKENS, honor=False,
+                                   free_slots=2)
+        fd, seen, res = self._run(old)
+        assert res == self.TOKENS and seen == res
+        # the client DID offer the resume point; the v1 host ignored it
+        [(rtoks, rstep)] = old.saw_resume
+        assert rtoks == self.TOKENS[:3] and rstep == 3
+        # no pre-seed happened (nothing was honored), so no resume
+        # counted — the replay path is the PR 12 dedup, unchanged
+        assert fd.metrics.stream_resumes_total.value == 0
+        assert sum(fd.metrics.tenant_served.to_dict().values()) == 1
+
+
+# --------------------------------------------------------------------------
+# Batch-infer hedging (ISSUE 15 satellite): stall races a backup POST
+# --------------------------------------------------------------------------
+class _InferStubHost:
+    """HostHandle-shaped infer stub: scripted latency/failure, records
+    remote cancels (the ``cancel_remote`` loser-cleanup surface)."""
+
+    def __init__(self, host_id, value, delay_s=0.0, fail=None,
+                 free_slots=8):
+        self.host_id = host_id
+        self.name = f"istub{host_id}"
+        self.value = value
+        self.delay_s = delay_s
+        self.fail = fail
+        self.free_slots = free_slots
+        self.submits = 0
+        self.remote_cancels = 0
+
+    def serves(self, kind):
+        return kind == "infer"
+
+    def status(self):
+        return HostStatus(host_id=self.host_id, has_infer=True, slots=8,
+                          free_slots=self.free_slots, queue_depth=0,
+                          queue_capacity=4096, seq=1)
+
+    def submit_infer(self, x, timeout_ms=None, tenant=None,
+                     priority=None):
+        from concurrent.futures import Future
+
+        self.submits += 1
+        fut = Future()
+        fut.set_running_or_notify_cancel()
+        fut.cancel_remote = lambda: setattr(
+            self, "remote_cancels", self.remote_cancels + 1)
+
+        def run():
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            if self.fail is not None:
+                if not fut.cancelled():
+                    fut.set_exception(self.fail)
+            elif not fut.cancelled():
+                fut.set_result(self.value)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def shutdown(self, wait=True):
+        pass
+
+
+class TestInferHedge:
+    def _fleet(self, hosts):
+        d = ClusterDirectory(heartbeat_timeout_s=30.0)
+        tr = LoopbackTransport(d)
+        for h in hosts:
+            d.join(h)
+            tr.publish(h.status())
+        return d
+
+    def test_stalled_infer_races_backup_first_result_wins(self):
+        slow = _InferStubHost(0, value="slow", delay_s=2.0, free_slots=8)
+        fast = _InferStubHost(1, value="fast", delay_s=0.0, free_slots=2)
+        d = self._fleet([slow, fast])
+        fd = ClusterFrontDoor(d, hedge=HedgePolicy(
+            infer_hedge_after_ms=50.0, max_attempts=2))
+        t0 = time.perf_counter()
+        assert fd.submit(row(2)).result(timeout=30) == "fast"
+        assert time.perf_counter() - t0 < 1.5   # did not wait out slow
+        assert slow.submits == 1 and fast.submits == 1
+        assert fd.hedges.get("timeout") == 1
+        # exactly ONE SLO outcome for the whole hedged ensemble
+        assert sum(fd.metrics.tenant_served.to_dict().values()) == 1
+        # the loser is cancelled server-side
+        deadline = time.monotonic() + 10
+        while slow.remote_cancels == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert slow.remote_cancels == 1
+        # outstanding-row accounting drains back to zero
+        deadline = time.monotonic() + 10
+        while any(fd._out("infer", h) for h in (0, 1)) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fd._out("infer", 0) == 0 and fd._out("infer", 1) == 0
+
+    def test_default_off_never_hedges(self):
+        slow = _InferStubHost(0, value="slow", delay_s=0.3, free_slots=8)
+        spare = _InferStubHost(1, value="spare", free_slots=2)
+        d = self._fleet([slow, spare])
+        fd = ClusterFrontDoor(d)     # HedgePolicy default: infer off
+        assert fd.submit(row(2)).result(timeout=30) == "slow"
+        assert spare.submits == 0 and fd.hedges.to_dict() == {}
+
+    def test_pinned_infer_never_hedges(self):
+        slow = _InferStubHost(0, value="slow", delay_s=0.3, free_slots=8)
+        spare = _InferStubHost(1, value="spare", free_slots=8)
+        d = self._fleet([slow, spare])
+        fd = ClusterFrontDoor(d, hedge=HedgePolicy(
+            infer_hedge_after_ms=30.0, max_attempts=2))
+        assert fd.submit(row(2), host=0).result(timeout=30) == "slow"
+        assert spare.submits == 0 and fd.hedges.to_dict() == {}
+
+    def test_both_attempts_fail_one_typed_terminal(self):
+        boom = RejectedError("queue filled mid-flight", "queue_full")
+        a = _InferStubHost(0, value=None, delay_s=0.05, fail=boom,
+                           free_slots=8)
+        b = _InferStubHost(1, value=None, delay_s=0.05, fail=boom,
+                           free_slots=2)
+        d = self._fleet([a, b])
+        fd = ClusterFrontDoor(d, hedge=HedgePolicy(
+            infer_hedge_after_ms=20.0, max_attempts=2))
+        fut = fd.submit(row(2))
+        with pytest.raises(RejectedError):
+            fut.result(timeout=30)
+
+        def errs():
+            return fd.metrics.slo_snapshot()["60s"]["errors_by_reason"]
+
+        deadline = time.monotonic() + 10
+        while not errs().get("queue_full") and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # the ensemble's failure is ONE terminal, not one per attempt
+        assert errs().get("queue_full") == 1
+
+    def test_backup_failure_adopts_primary_result(self):
+        """The backup bounces but the primary still lands: no shed."""
+        slow = _InferStubHost(0, value="slow", delay_s=0.3, free_slots=8)
+        bad = _InferStubHost(1, value=None, delay_s=0.0, free_slots=2,
+                             fail=RejectedError("full", "queue_full"))
+        d = self._fleet([slow, bad])
+        fd = ClusterFrontDoor(d, hedge=HedgePolicy(
+            infer_hedge_after_ms=30.0, max_attempts=2))
+        assert fd.submit(row(2)).result(timeout=30) == "slow"
+        assert fd.metrics.rejections_by_reason.to_dict() == {}
+        assert sum(fd.metrics.tenant_served.to_dict().values()) == 1
 
 
 # --------------------------------------------------------------------------

@@ -1377,7 +1377,7 @@ class TestRpcGate:
         extended to the data plane)."""
         p, src = self._rpc_source()
         broken = src.replace(
-            "    hedge_attempt: int = 0\n    wire_version: int = 1\n",
+            "    hedge_attempt: int = 0\n    wire_version: int = 2\n",
             "    hedge_attempt: int = 0\n")
         assert broken != src
         r = run({p: broken}, rules=["wire-schema-drift"])
@@ -1462,6 +1462,87 @@ class TestKvOccupancyGate:
         clean = analyze_sources(sources, rules=["taxonomy-drift"])
         assert [f for f in clean.unsuppressed
                 if "preempted" in f.message] == []
+
+
+# --------------------------------------------------------------------------
+# ISSUE 15 gate: resume-from-watermark wire fields + the swap path's
+# no-new-terminal discipline
+# --------------------------------------------------------------------------
+class TestStreamRecoveryGate:
+    def _rpc_source(self):
+        p = os.path.join(SERVING, "rpc.py")
+        with open(p) as f:
+            return p, f.read()
+
+    def test_resume_fields_ride_wire_v2(self):
+        """Source pin: the resume fields and the v2 bump live on BOTH
+        envelopes — the request carries ``resume_tokens``/``resume_step``
+        and the response echoes the honored ``resume_step`` — while the
+        chunk schema stays v1 (untouched by the resume change). A revert
+        to v1 defaults would silently turn every re-dispatch back into a
+        full replay."""
+        _, src = self._rpc_source()
+        assert "resume_tokens: Optional[list] = None" in src
+        assert src.count("\n    resume_step: int = 0") == 2
+        assert src.count("    wire_version: int = 2\n") == 2
+        assert src.count("    wire_version: int = 1\n") == 1   # the chunk
+
+    def test_resume_serialization_guard_armed(self):
+        """Reintroduction gate (the PR 10 asymmetry class extended to
+        the resume fields): a hand-built RpcRequest.to_dict that forgets
+        them must fail wire-schema-drift — the receiving host would
+        default resume_step to 0 and the 'resumed' stream would
+        re-prefill and re-decode from scratch."""
+        p, src = self._rpc_source()
+        broken = src.replace(
+            "    def to_dict(self) -> dict:\n"
+            "        return dataclasses.asdict(self)",
+            '    def to_dict(self) -> dict:\n'
+            '        return {"request_id": self.request_id,\n'
+            '                "kind": self.kind,\n'
+            '                "prompt": self.prompt,\n'
+            '                "wire_version": self.wire_version}',
+            1)
+        assert broken != src
+        r = run({p: broken}, rules=["wire-schema-drift"])
+        msgs = [f.message for f in r.unsuppressed]
+        assert any("RpcRequest" in m and "'resume_tokens'" in m
+                   and "never serializes" in m for m in msgs), msgs
+        assert any("RpcRequest" in m and "'resume_step'" in m
+                   for m in msgs)
+
+    def test_swap_path_adds_no_terminal_reason(self):
+        """The swap contract: ``kv.swap_out``/``kv.swap_in`` failures
+        DEGRADE to the recompute path — they never shed a stream, so
+        the one taxonomy must not have grown a swap reason. And the
+        tempting-but-wrong design (a typed swap shed) stays gated: an
+        unregistered KvSwapFailedError must fail the taxonomy checker."""
+        tracing_path = os.path.join(SERVING, "tracing.py")
+        with open(tracing_path) as f:
+            tsrc = f.read()
+        taxonomy = tsrc.split("TERMINAL_REASONS")[1].split(")")[0]
+        assert "swap" not in taxonomy
+        sources = {}
+        for name in os.listdir(SERVING):
+            if name.endswith(".py"):
+                q = os.path.join(SERVING, name)
+                with open(q) as f:
+                    sources[q] = f.read()
+        adm = os.path.join(SERVING, "admission.py")
+        broken = dict(sources)
+        broken[adm] = sources[adm] + '''
+
+class KvSwapFailedError(RejectedError):
+    def __init__(self, msg):
+        super().__init__(msg, "kv_swap_failed")
+'''
+        r = analyze_sources(broken, rules=["taxonomy-drift"])
+        assert any("KvSwapFailedError" in f.message
+                   for f in r.unsuppressed)
+        # and the live tree is clean of any swap-flavored drift
+        clean = analyze_sources(sources, rules=["taxonomy-drift"])
+        assert [f for f in clean.unsuppressed
+                if "swap" in f.message.lower()] == []
 
 
 # --------------------------------------------------------------------------

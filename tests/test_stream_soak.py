@@ -1,0 +1,216 @@
+"""Stream-recovery soak (ISSUE 15): a REAL host process SIGKILLed
+mid-stream, repeatedly.
+
+The in-process kill test (test_rpc.py::TestHedgedGeneration) severs a
+server thread; this soak raises the stakes to a separate OS process —
+the child builds the same seeded tiny model behind a real
+``HostRpcServer``, the parent routes a generation stream to it over
+HTTP, and ``SIGKILL`` (no grace, no close(), the kernel just reaps the
+sockets) lands mid-stream. Each iteration asserts the full recovery
+contract end to end:
+
+- the hedged re-dispatch RESUMES from the delivery watermark on the
+  in-process survivor (one recompute prefill, zero re-decoded tokens),
+- the recovered stream is bitwise the unkilled ground truth — no token
+  delivered twice, none skipped, exactly one terminal.
+
+Multi-process and minutes-long: ``slow`` + ``stress`` (deselected from
+tier-1; run explicitly with ``-m stress``).
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.stress]
+
+REPO = str(Path(__file__).resolve().parents[1])
+
+_WORKER = """
+import time
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.models import TransformerConfig, init_params
+from deeplearning4j_tpu.serving import (
+    GenerationEngine, HostRpcServer, LoopbackHost,
+)
+
+# the SAME seeded tiny model the parent's survivor runs — determinism
+# across processes is what makes the bitwise assertion meaningful
+cfg = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                        mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                        causal=True, attention_impl="full", remat=False)
+params = init_params(jax.random.PRNGKey(0), cfg)
+g = GenerationEngine(params, cfg, slots=2, max_len=48,
+                     name="soak-victim")
+local = LoopbackHost(0, generation=g)
+srv = HostRpcServer(local)
+print("URL " + srv.url, flush=True)
+while True:          # serve until SIGKILLed — no graceful exit path
+    time.sleep(1.0)
+"""
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                            mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                            causal=True, attention_impl="full", remat=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _spawn_victim(tmp_path):
+    script = tmp_path / "victim_host.py"
+    if not script.exists():
+        script.write_text(_WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, str(script)], cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+def _read_url(child, deadline_s=300.0):
+    """First 'URL ...' line from the child (jax may warn first)."""
+    out = []
+
+    def reader():
+        for line in child.stdout:
+            out.append(line.rstrip("\n"))
+            if line.startswith("URL "):
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout=deadline_s)
+    for line in out:
+        if line.startswith("URL "):
+            return line[4:].strip()
+    raise AssertionError(
+        "victim host never published its URL (rc=%s):\n%s"
+        % (child.poll(), "\n".join(out)))
+
+
+class TestSigkillSoak:
+    ITERATIONS = 3
+
+    def test_sigkill_mid_stream_resumes_bitwise(self, tiny_model,
+                                                tmp_path):
+        from deeplearning4j_tpu.serving import (
+            ClusterDirectory, ClusterFrontDoor, GenerationEngine,
+            HeartbeatPump, HedgePolicy, HostRpcServer, LoopbackHost,
+            LoopbackTransport, RemoteHost, Tracer,
+        )
+
+        cfg, params = tiny_model
+        survivor = GenerationEngine(params, cfg, slots=2, max_len=48,
+                                    name="soak-survivor")
+        surv_local = LoopbackHost(1, generation=survivor)
+        surv_srv = HostRpcServer(surv_local)
+        children = []
+        try:
+            for it in range(self.ITERATIONS):
+                child = _spawn_victim(tmp_path)
+                children.append(child)
+                url = _read_url(child)
+
+                tracer = Tracer(sample_rate=1.0)
+                d = ClusterDirectory(heartbeat_timeout_s=300.0)
+                fd = ClusterFrontDoor(
+                    d, tracer=tracer,
+                    hedge=HedgePolicy(hedge_after_ms=None,
+                                      max_attempts=3,
+                                      poll_wait_ms=25.0))
+                victim_rem = RemoteHost(0, url)
+                d.join(victim_rem)
+                HeartbeatPump(victim_rem,
+                              LoopbackTransport(d)).pump_once()
+
+                p = np.random.default_rng(11 + it).integers(
+                    1, 50, 5).astype(np.int32)
+                want = survivor.submit(
+                    p, max_new_tokens=24, seed=7 + it).result(timeout=180)
+                g_base = int(
+                    survivor.metrics.generated_tokens_total.value)
+                p_base = int(survivor.metrics.prefills_total.value)
+                r_base = int(survivor.metrics.stream_resumes_total.value)
+
+                seen, watermark = [], threading.Event()
+
+                def on_token(t):
+                    seen.append(int(t))
+                    if len(seen) == 4:
+                        watermark.set()
+
+                # the victim is the only generate host at submit time —
+                # the stream deterministically routes to the child
+                h = fd.submit_generate(p, max_new_tokens=24, seed=7 + it,
+                                       on_token=on_token)
+                assert watermark.wait(timeout=180), \
+                    "iteration %d: stream never produced tokens" % it
+
+                surv_rem = RemoteHost(1, surv_srv.url)
+                d.join(surv_rem)
+                HeartbeatPump(surv_rem,
+                              LoopbackTransport(d)).pump_once()
+                os.kill(child.pid, signal.SIGKILL)
+                child.wait(timeout=30)
+
+                res = h.result(timeout=180)
+                # bitwise the unkilled stream: nothing doubled, nothing
+                # skipped, one terminal
+                assert res == want and len(res) == 24, (it, res, want)
+                assert seen == res
+                assert h.future.done() and h.finish_reason is not None
+                assert fd.hedges.get("redispatch") >= 1
+                assert sum(
+                    fd.metrics.tenant_served.to_dict().values()) == 1
+
+                # resumed, not replayed: one recompute prefill on the
+                # survivor and ZERO re-decoded delivered tokens
+                assert int(survivor.metrics.stream_resumes_total.value) \
+                    == r_base + 1
+                traces = [t for t in tracer.traces()
+                          if t.kind == "cluster.generate"
+                          and t.reason == "ok"]
+                assert traces
+                resumes = [a for n, _, a in traces[-1].events
+                           if n == "stream.resume"]
+                assert resumes, traces[-1].event_names()
+                r = int(resumes[-1]["resume_step"])
+                assert r >= 4
+                assert int(
+                    survivor.metrics.generated_tokens_total.value) \
+                    == g_base + (24 - r)
+                assert int(survivor.metrics.prefills_total.value) \
+                    == p_base + 1
+        finally:
+            for child in children:
+                if child.poll() is None:
+                    child.kill()
+                try:
+                    child.wait(timeout=30)
+                except Exception:
+                    pass
+                if child.stdout is not None:
+                    child.stdout.close()
+            try:
+                surv_srv.stop()
+            except Exception:
+                pass
+            surv_local.shutdown()
