@@ -1009,6 +1009,7 @@ def cluster_leg(on_tpu: bool) -> dict:
         "one_host_degraded": degraded,
         "rpc": rpc_subleg(on_tpu, gcfg, gparams, slots, max_len),
         "recovery": recovery_subleg(on_tpu, gcfg, gparams),
+        "disagg": disagg_subleg(on_tpu, gcfg, gparams, slots, max_len),
     }
 
 
@@ -1248,6 +1249,114 @@ def rpc_subleg(on_tpu: bool, gcfg, gparams, slots: int,
         "stream_p99_ms_hedged": round(
             float(np.percentile(lats_hedged, 99)), 3),
         "hedges": hedge_mix,
+    }
+
+
+def disagg_subleg(on_tpu: bool, gcfg, gparams, slots: int,
+                  max_len: int) -> dict:
+    """Disaggregated serving sub-leg (ISSUE 16 — serving/disagg.py):
+    the same fixed 2-host fleet run mixed (both hosts ``host_class=
+    "mixed"``, no policy) and disaggregated (1 prefill + 1 decode
+    behind :class:`DisaggPolicy`), same prompt schedule. Reports TTFT
+    p50 and ITL p99 for both placements, plus the migration-path
+    numbers only the disaggregated run has: migrations vs degrade
+    fallbacks, KV bytes migrated per stream, and the fleet prefix hit
+    rate (wave 2 repeats wave 1's prompts, so the radix-routed decode
+    host already holds their cached prefixes)."""
+    import time as _time
+
+    from deeplearning4j_tpu.serving import (
+        ClusterDirectory, ClusterFrontDoor, DisaggPolicy, GenerationEngine,
+        HeartbeatPump, LoopbackHost, LoopbackTransport)
+
+    n_prompts, prompt_len, max_new = 4, 12, 16
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, gcfg.vocab_size, prompt_len).astype(np.int32)
+               for _ in range(n_prompts)]
+
+    def run_fleet(disaggregated: bool) -> dict:
+        classes = ("prefill", "decode") if disaggregated \
+            else ("mixed", "mixed")
+        d = ClusterDirectory(heartbeat_timeout_s=5.0)
+        engines, hosts, pumps = [], [], []
+        for i, cls in enumerate(classes):
+            g = GenerationEngine(gparams, gcfg, slots=slots,
+                                 max_len=max_len, prefix_cache_blocks=8,
+                                 name=f"disagg-{cls}{i}")
+            h = LoopbackHost(i, generation=g, host_class=cls)
+            d.join(h)
+            pumps.append(HeartbeatPump(h, LoopbackTransport(d)))
+            engines.append(g)
+            hosts.append(h)
+        for p in pumps:
+            p.pump_once()
+        fd = ClusterFrontDoor(
+            d, disagg=DisaggPolicy() if disaggregated else None)
+        try:
+            # warm both hosts' executables out of the measurement
+            for i in range(len(hosts)):
+                fd.submit_generate(prompts[0], max_new_tokens=2,
+                                   host=i).result(timeout=600)
+            ttfts, itls = [], []
+
+            def run_wave():
+                handles = []
+                for toks in prompts:
+                    stamps = []
+                    t0 = _time.perf_counter()
+                    handles.append((stamps, t0, fd.submit_generate(
+                        toks, max_new_tokens=max_new,
+                        on_token=lambda _t, s=stamps:
+                            s.append(_time.perf_counter()))))
+                for stamps, t0, h in handles:
+                    h.result(timeout=600)
+                    if stamps:
+                        ttfts.append((stamps[0] - t0) * 1e3)
+                    itls.extend((b - a) * 1e3
+                                for a, b in zip(stamps, stamps[1:]))
+
+            run_wave()
+            # wave 1's retired streams fill the decode-side prefix
+            # cache; the next heartbeats advertise it, so wave 2's
+            # repeat prompts can radix-route to the host holding them
+            deadline = _time.time() + 10
+            while (disaggregated and _time.time() < deadline
+                   and len(engines[1]._prefix_cache or ()) == 0):
+                _time.sleep(0.02)
+            for p in pumps:
+                p.pump_once()
+            run_wave()
+
+            out = {
+                "ttft_p50_ms": round(float(np.median(ttfts)), 3),
+                "itl_p99_ms": round(float(np.percentile(itls, 99)), 3),
+            }
+            if disaggregated:
+                streams = 2 * n_prompts
+                out.update({
+                    "migrations": int(
+                        fd.metrics.kv_migrations_total.value),
+                    "migrate_fallbacks": int(
+                        fd.metrics.kv_migrate_fallbacks_total.value),
+                    "migrated_bytes_per_stream": round(
+                        engines[1].metrics.kv_migrate_bytes_in.value
+                        / streams, 1),
+                    "prefix_route_hits": int(
+                        fd.metrics.prefix_route_hits_total.value),
+                    "fleet_prefix_hit_rate": round(
+                        fd.metrics.prefix_route_hits_total.value
+                        / n_prompts, 4),
+                })
+            return out
+        finally:
+            for h in hosts:
+                h.shutdown()
+
+    return {
+        "fleet": {"hosts": 2, "slots_per_host": slots,
+                  "prompts": 2 * n_prompts, "max_new_tokens": max_new},
+        "mixed": run_fleet(False),
+        "disaggregated": run_fleet(True),
     }
 
 

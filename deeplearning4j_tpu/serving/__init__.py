@@ -19,8 +19,12 @@ from deeplearning4j_tpu.serving.cluster import (  # noqa: F401
     http_snapshot_source,
 )
 from deeplearning4j_tpu.serving.rpc import (  # noqa: F401
-    HostRpcServer, RemoteHost, RemoteStream, RpcRequest, RpcResponse,
-    RpcStreamChunk, rejected_from_wire,
+    HostRpcServer, KvMigrateRequest, KvMigrateResponse, RemoteHost,
+    RemoteStream, RpcRequest, RpcResponse, RpcStreamChunk,
+    rejected_from_wire,
+)
+from deeplearning4j_tpu.serving.disagg import (  # noqa: F401
+    DisaggPolicy, FleetPrefixIndex,
 )
 from deeplearning4j_tpu.serving.engine import InferenceEngine, bucket_ladder  # noqa: F401
 from deeplearning4j_tpu.serving.faults import (  # noqa: F401
@@ -83,4 +87,6 @@ __all__ = [
     "drain_host", "http_snapshot_source", "HostRpcServer", "RemoteHost",
     "RemoteStream", "RpcRequest", "RpcResponse", "RpcStreamChunk",
     "rejected_from_wire", "client_stream_handle",
+    "DisaggPolicy", "FleetPrefixIndex", "KvMigrateRequest",
+    "KvMigrateResponse",
 ]

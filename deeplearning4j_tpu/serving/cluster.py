@@ -125,6 +125,21 @@ class HostStatus:
     kv_swapped_blocks: int = 0
     kv_swap_capacity_blocks: int = 0
     buckets: Tuple[int, ...] = ()
+    # disaggregated serving (PR 16): the host's placement class. A
+    # "prefill" host takes prompt processing only, a "decode" host owns
+    # decode-phase streams (and receives migrated KV pages), "mixed"
+    # does both — the pre-disaggregation behavior, and the DEFAULT, so
+    # a pre-upgrade sender's heartbeat parses as mixed and routes
+    # exactly as before (bitwise-inert).
+    host_class: str = "mixed"
+    # fleet-wide cache-aware routing: the host's advertised prefix-cache
+    # contents (leading tokens of each cached entry, MRU-first,
+    # truncated by the cache's advertisement cap) plus summary counters.
+    # Defaulted so pre-upgrade heartbeats parse with an empty
+    # advertisement (the router simply never prefers such a host).
+    prefix_tokens: Tuple[Tuple[int, ...], ...] = ()
+    prefix_cache_entries: int = 0
+    prefix_cache_hits: int = 0
     # health
     breaker: str = "CLOSED"
     slo_burn_active: bool = False
@@ -147,6 +162,7 @@ class HostStatus:
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["buckets"] = list(self.buckets)
+        d["prefix_tokens"] = [list(p) for p in self.prefix_tokens]
         return d
 
     @classmethod
@@ -154,6 +170,9 @@ class HostStatus:
         known = {f.name for f in dataclasses.fields(cls)}
         kw = {k: v for k, v in d.items() if k in known}
         kw["buckets"] = tuple(kw.get("buckets") or ())
+        kw["prefix_tokens"] = tuple(
+            tuple(int(t) for t in p)
+            for p in kw.get("prefix_tokens") or ())
         return cls(**kw)
 
 
@@ -198,9 +217,15 @@ class LoopbackHost(HostHandle):
     into so the aggregator can host-prefix its traces."""
 
     def __init__(self, host_id: int, *, engine=None, generation=None,
-                 tracer=None, name: Optional[str] = None):
+                 tracer=None, name: Optional[str] = None,
+                 host_class: str = "mixed"):
+        if host_class not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"host_class must be 'prefill', 'decode' or 'mixed', "
+                f"got {host_class!r}")
         self.host_id = int(host_id)
         self.name = name if name is not None else f"h{host_id}"
+        self.host_class = host_class
         self._lock = threading.Lock()
         self._engine = engine
         self._generation = generation
@@ -244,7 +269,8 @@ class LoopbackHost(HostHandle):
             self._seq += 1
             seq = self._seq
         st = HostStatus(host_id=self.host_id, seq=seq,
-                        draining=self._draining)
+                        draining=self._draining,
+                        host_class=self.host_class)
         breaker = None
         metrics = None
         if eng is not None:
@@ -274,6 +300,14 @@ class LoopbackHost(HostHandle):
                     st.kv_swapped_blocks = gen._swap_store.blocks_held
                     st.kv_swap_capacity_blocks = \
                         gen._swap_store.capacity_blocks
+            cache = getattr(gen, "_prefix_cache", None)
+            if cache is not None:
+                # cache-aware routing advertisement: entry count, hit
+                # count, and the leading tokens of each cached entry
+                # (MRU-first, capped) for the fleet prefix index
+                st.prefix_cache_entries = len(cache)
+                st.prefix_cache_hits = int(cache.hits)
+                st.prefix_tokens = cache.advertised_prefixes()
             breaker, metrics = gen.breaker, gen.metrics
         if breaker is not None:
             st.breaker = breaker.state
@@ -833,6 +867,18 @@ class ClusterDirectory:
                 for s in statuses),
             "breakers_open": sum(1 for s in statuses
                                  if s["breaker"] == "OPEN"),
+            # disaggregated serving (PR 16): per-class host counts —
+            # pre-upgrade heartbeats carry no host_class and read as
+            # mixed, the class that routes exactly as before
+            "host_classes": {
+                c: sum(1 for s in statuses
+                       if s.get("host_class", "mixed") == c)
+                for c in ("prefill", "decode", "mixed")},
+            # fleet prefix-cache roll-up for cache-aware routing
+            "prefix_cache_entries": sum(
+                int(s.get("prefix_cache_entries", 0)) for s in statuses),
+            "prefix_cache_hits": sum(
+                int(s.get("prefix_cache_hits", 0)) for s in statuses),
         }
         return {
             "hosts": {str(h): d for h, d in sorted(hosts.items())},
@@ -1619,10 +1665,16 @@ class ClusterFrontDoor:
     def __init__(self, directory: ClusterDirectory, *,
                  metrics: Optional[ServingMetrics] = None,
                  tracer=None, recorder=None, name: str = "cluster",
-                 hedge: Optional[HedgePolicy] = None):
+                 hedge: Optional[HedgePolicy] = None, disagg=None):
         self.directory = directory
         self.name = name
         self.metrics = metrics or ServingMetrics()
+        # disaggregated prefill/decode placement (serving/disagg.py's
+        # DisaggPolicy). None — the default — is bitwise-inert: every
+        # request takes the single-host path below, exactly PR 15's
+        # behavior. A configured policy only engages when the fleet
+        # actually has prefill- AND decode-class hosts.
+        self.disagg = disagg
         self._tracer = tracer if tracer is not None else default_tracer()
         self._recorder = recorder if recorder is not None \
             else flight_recorder()
@@ -1660,10 +1712,18 @@ class ClusterFrontDoor:
     # ------------------------------------------------------------ routing
     def _headroom(self, st: HostStatus, kind: str, rows: int,
                   blocks_needed: int,
-                  blocks_admit: Optional[int] = None) -> bool:
+                  blocks_admit: Optional[int] = None,
+                  blocks_migrate: Optional[int] = None) -> bool:
         if kind == "infer":
             return st.queue_depth + rows <= st.queue_capacity
-        if st.kv_blocks_total and blocks_needed > st.kv_blocks_usable:
+        # a migration-capable decode host seats the stream on its
+        # POST-MIGRATION block count (the prefill host already paid the
+        # prompt; the resume token rides inside the generation budget),
+        # not the full re-prefill count — judging it on the larger bound
+        # would bounce a host that can perfectly well take the stream
+        bound = blocks_needed if blocks_migrate is None \
+            else min(blocks_needed, blocks_migrate)
+        if st.kv_blocks_total and bound > st.kv_blocks_usable:
             return False   # this host can NEVER hold the stream (the
             #                 worst case bounds every allocate mode)
         # the demand SEATING pays: an on-demand host takes only the
@@ -1672,7 +1732,7 @@ class ClusterFrontDoor:
         # headroom is judged on the admit demand
         demand = blocks_admit if (blocks_admit is not None
                                   and st.allocate == "on_demand") \
-            else blocks_needed
+            else bound
         if st.free_slots > 0 and (not st.kv_blocks_total
                                   or demand <= st.kv_blocks_free):
             return True    # seats immediately
@@ -1707,6 +1767,7 @@ class ClusterFrontDoor:
 
     def _route(self, kind: str, *, rows: int = 1, blocks_needed: int = 0,
                blocks_admit: Optional[int] = None,
+               blocks_migrate: Optional[int] = None,
                pinned: Optional[int] = None,
                exclude: Tuple[int, ...] = (), bounced_full: int = 0):
         """Pick (handle, host_id, decision) or raise typed. Pure reader
@@ -1715,7 +1776,10 @@ class ClusterFrontDoor:
         how many of those bounced for capacity (heartbeat lag: the view
         said headroom, the host's own admission said full).
         ``blocks_admit`` is the prompt-only seat demand an on-demand
-        host gates on (None: judge every host on ``blocks_needed``)."""
+        host gates on (None: judge every host on ``blocks_needed``).
+        ``blocks_migrate`` is the post-migration seat demand when the
+        stream arrives as migrated KV pages rather than a raw prompt —
+        feasibility is judged on the smaller of the two bounds."""
         d = self.directory
         ranked: List[Tuple[tuple, int, HostHandle]] = []
         probe_set: List[Tuple[int, HostHandle]] = []
@@ -1740,7 +1804,7 @@ class ClusterFrontDoor:
                 probe_set.append((hid, h))       # drained fleet-wide
                 continue
             if not self._headroom(st, kind, rows, blocks_needed,
-                                  blocks_admit):
+                                  blocks_admit, blocks_migrate):
                 full += 1
                 continue
             ranked.append((self._load_key(st, kind, rows, blocks_needed),
@@ -1895,6 +1959,17 @@ class ClusterFrontDoor:
         hosts (their KV blocks cannot migrate)."""
         toks = np.asarray(prompt).ravel()
         label = self._label(tenant, priority)
+        if (self.disagg is not None and host is None and prefix_id is None
+                and self.disagg.enabled(self.directory)):
+            # disaggregated placement: prefill-class host runs the
+            # prompt, its KV pages migrate to a decode-class host. The
+            # policy does its own request/trace/terminal accounting
+            # (it spans two routed submits); pinned and prefix-affine
+            # streams stay on the single-host path — their blocks
+            # cannot migrate.
+            return self.disagg.submit(
+                self, toks, max_new_tokens=max_new_tokens, tenant=tenant,
+                priority=priority, **kwargs)
         if prefix_id is not None:
             with self._affinity_lock:
                 ph = self._prefix_hosts.get(prefix_id)

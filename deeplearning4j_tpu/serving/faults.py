@@ -53,6 +53,19 @@ recompute-on-resume, it never sheds the request:
   (fail → the blocks are freed back and the stream re-prefills; either
   way the resumed stream is bitwise the uninterrupted one).
 
+Cross-host KV page migration (serving/disagg.py + the ``kv.migrate``
+RPC endpoint) extends the same DEGRADE contract across hosts — a fired
+fault falls back to recompute on the DECODE host, it never sheds:
+
+- ``kv.migrate``        — the migrate RPC round-trip itself (fail → the
+  front door runs the stream the pre-disaggregation way, one host,
+  full prefill there);
+- ``kv.migrate.export`` — the prefill host's device→host page read
+  (fail → no pages ship; the decode host re-prefills);
+- ``kv.migrate.import`` — seating shipped pages in the decode host's
+  swap store (fail → the pages are dropped and the decode host
+  re-prefills; the stream is bitwise identical on every path).
+
 Usage::
 
     plan = (FaultPlan(seed=7)

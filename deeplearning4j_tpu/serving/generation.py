@@ -111,6 +111,16 @@ class GenerationRequest:
     # a miss (LRU-evicted, invalidated, or swap-in failure) falls back
     # to the recompute path above
     swap_key: Optional[int] = None
+    # ---- cross-host KV page migration (serving/disagg.py) ---------------
+    # capture_pages asks the retire tail to export this stream's written
+    # KV block pages (values + int8 scales + lengths + stream state) as
+    # a SwapEntry stashed on captured_entry BEFORE the terminal is
+    # delivered — the disaggregation orchestrator ships it to the
+    # decode-class host, which re-seats via the swap-in device_put path.
+    # A failed export leaves captured_entry None: the orchestrator
+    # degrades to recompute on the decode host, never sheds.
+    capture_pages: bool = False
+    captured_entry: Optional[SwapEntry] = None
 
 
 class GenerationHandle:
@@ -584,8 +594,9 @@ class GenerationEngine(ResilientEngineMixin):
                tenant: Optional[str] = None,
                priority: Optional[str] = None,
                on_token: Optional[Callable[[int], None]] = None,
-               resume_tokens=None, resume_step: int = 0
-               ) -> GenerationHandle:
+               resume_tokens=None, resume_step: int = 0,
+               capture_pages: bool = False,
+               swap_key: Optional[int] = None) -> GenerationHandle:
         """Queue one prompt. Greedy by default; ``temperature`` > 0 samples,
         ``top_k`` > 0 restricts sampling to the k highest-probability
         tokens, ``seed`` fixes the stream's
@@ -612,7 +623,19 @@ class GenerationEngine(ResilientEngineMixin):
         recovered stream is bitwise the uninterrupted one and re-decodes
         nothing it already delivered. ``resume_step`` must equal
         ``len(resume_tokens)`` — the resume point IS the delivery
-        watermark."""
+        watermark.
+
+        ``capture_pages`` (paged only) marks this stream for KV page
+        export at retirement: its written block pages are stashed as a
+        :class:`SwapEntry` retrievable via :meth:`take_captured_pages`
+        — the prefill half of cross-host disaggregation
+        (serving/disagg.py runs such a stream with
+        ``max_new_tokens=1``). ``swap_key`` names an entry previously
+        seated by :meth:`import_pages`: admission re-seats the stream
+        from those pages with NO prefill, falling back to the ordinary
+        resume recompute on any miss — the decode half of the same
+        migration (requires ``resume_tokens``, the degrade path's
+        delivery watermark)."""
         tenant, priority = resolve_qos(self.qos, tenant, priority)
         toks = np.ascontiguousarray(np.asarray(prompt, np.int32).ravel())
         if toks.size == 0:
@@ -636,6 +659,17 @@ class GenerationEngine(ResilientEngineMixin):
             raise ValueError(
                 f"resume_step ({resume_step}) requires resume_tokens — "
                 "the delivered prefix the recompute prefill replays")
+        if capture_pages and not self.paged:
+            raise ValueError(
+                "capture_pages requires the paged KV cache "
+                "(GenerationEngine(paged=True)) — page export gathers "
+                "block rows, and the contiguous layout has no blocks")
+        if swap_key is not None and resume_tokens is None:
+            raise ValueError(
+                "swap_key requires resume_tokens — an imported stream "
+                "needs its delivery watermark so a swap-in miss can "
+                "degrade to the recompute path without re-decoding "
+                "delivered tokens")
         prefix_len = 0
         if prefix_id is not None:
             if not self.paged:
@@ -666,7 +700,8 @@ class GenerationEngine(ResilientEngineMixin):
             temperature=float(temperature), top_k=int(top_k),
             eos_id=self.eos_id if eos_id is _UNSET else eos_id,
             key=np.asarray(jax.random.PRNGKey(seed)), prefix_id=prefix_id,
-            resume_tokens=resume_tokens, resume_step=int(resume_step))
+            resume_tokens=resume_tokens, resume_step=int(resume_step),
+            capture_pages=bool(capture_pages), swap_key=swap_key)
         trace = self._tracer.begin(self.name, "generate",
                                    prompt_len=int(toks.size),
                                    max_new_tokens=max_new_tokens,
@@ -1695,7 +1730,12 @@ class GenerationEngine(ResilientEngineMixin):
         cannot fit it, or the copy fails (seeded ``kv.swap_out`` fault
         point) — every miss degrades to the recompute path."""
         store = self._swap_store
-        if store is None or vst.blocks is None:
+        if store is None or vst.blocks is None \
+                or self.swap_threshold_blocks is None:
+            # threshold None with a live store: the store was created
+            # lazily by import_pages (cross-host migration) — migration
+            # must not change preemption behavior, so victims keep the
+            # recompute-only path
             return None, 0, 0
         if len(vst.blocks) <= self.swap_threshold_blocks:
             return None, 0, 0
@@ -1746,6 +1786,100 @@ class GenerationEngine(ResilientEngineMixin):
                 self.metrics.kv_swapped_blocks_held.set(
                     self._swap_store.blocks_held)
             greq.swap_key = None
+
+    # ------------------------------- cross-host KV page migration (disagg)
+    def _capture_pages(self, req: Request, rows: np.ndarray, length: int,
+                       n_generated: int, last_token: int, epoch: int):
+        """Export a retiring ``capture_pages`` stream's written KV block
+        pages (values AND int8 scales, every leaf) as a
+        :class:`SwapEntry` on ``greq.captured_entry`` — the prefill half
+        of cross-host migration. Caller holds ``_wd_lock`` with the
+        epoch verified and the blocks still referenced, the same
+        discipline as :meth:`_try_swap_out` (the device_get must finish
+        before the rows can be recycled under another stream). Any
+        failure — including the seeded ``kv.migrate.export`` fault
+        point — leaves ``captured_entry`` None: the orchestrator
+        degrades to recompute on the decode host, never sheds."""
+        greq: GenerationRequest = req.x
+        try:
+            payload = inject(
+                "kv.migrate.export",
+                lambda: jax.device_get(
+                    [{k: leaf[rows] for k, leaf in layer.items()}
+                     for layer in self._cache["layers"]]))
+        except Exception as e:
+            req.trace.event("kv.migrate", direction="export",
+                            failed=type(e).__name__)
+            return
+        nbytes = sum(int(a.nbytes) for layer in payload
+                     for a in layer.values())
+        greq.captured_entry = SwapEntry(
+            payload=payload, used_blocks=int(rows.size),
+            length=int(length), n_generated=int(n_generated),
+            last_token=int(last_token), prefix_len=0, epoch=epoch,
+            nbytes=nbytes)
+        self.metrics.kv_migrate_bytes_out.inc(nbytes)
+        req.trace.event("kv.migrate", direction="export",
+                        blocks=int(rows.size), bytes=nbytes)
+
+    def take_captured_pages(self, handle: GenerationHandle
+                            ) -> Optional[SwapEntry]:
+        """One-shot retrieval of a ``capture_pages`` stream's exported
+        pages (None when the export failed or never ran — the caller
+        degrades to recompute). Call after the handle's future resolved:
+        the capture happens before the terminal is delivered, so a
+        resolved future means the entry is either set or never will
+        be."""
+        greq = handle._req.x
+        if greq is None:
+            return None
+        entry, greq.captured_entry = greq.captured_entry, None
+        return entry
+
+    def import_pages(self, entry: SwapEntry) -> Optional[int]:
+        """Seat migrated KV pages in this engine's swap store and return
+        the key to pass as ``submit(swap_key=...)`` — the decode half of
+        cross-host migration rides PR 15's swap-in device_put path
+        unchanged. The entry is re-stamped with THIS engine's current
+        epoch (it crossed hosts; the exporter's epoch is meaningless
+        here) under ``_wd_lock``, so a restart between import and
+        admission invalidates it exactly like a native swap entry.
+        Returns None when the store refuses it or the seeded
+        ``kv.migrate.import`` fault point fires — the caller submits
+        without ``swap_key`` and the decode host recomputes."""
+        if not self.paged:
+            raise ValueError(
+                "import_pages requires the paged KV cache "
+                "(GenerationEngine(paged=True)) — migrated pages re-seat "
+                "through the block pool")
+        with self._wd_lock:
+            if self._swap_store is None:
+                # lazy store for migration-only engines (no
+                # swap_threshold_blocks): preemption behavior is
+                # unchanged — _try_swap_out gates on the threshold, not
+                # the store
+                self._swap_store = BlockSwapStore(self.num_blocks)
+            store = self._swap_store
+            entry = dataclasses.replace(entry, epoch=self._epoch)
+        try:
+            key = inject("kv.migrate.import", store.put, entry)
+        except Exception:
+            return None
+        if key is not None:
+            self.metrics.kv_migrate_bytes_in.inc(entry.nbytes)
+            self.metrics.kv_swapped_blocks_held.set(store.blocks_held)
+        return key
+
+    def discard_imported(self, key: int):
+        """Drop an :meth:`import_pages` entry whose stream never reached
+        admission (the migrate endpoint's follow-up submit was rejected):
+        the key is one-shot and nothing will ever take it, so the parked
+        bytes must come back now, not at shutdown."""
+        with self._wd_lock:
+            store = self._swap_store
+        if store is not None:
+            store.discard(key)
+            self.metrics.kv_swapped_blocks_held.set(store.blocks_held)
 
     def _preempt_for(self, needy_i: int, needy_st: _Slot,
                      epoch: int) -> str:
@@ -2051,6 +2185,25 @@ class GenerationEngine(ResilientEngineMixin):
                       n_generated=greq.resume_step + 1, last_token=tok,
                       length=n, blocks=blocks, n_entries=nb_total,
                       resumed=resumed)
+        if greq.capture_pages and blocks is not None \
+                and self._retire_reason(state, tok) is not None:
+            # page export for a stream retiring AT its first token (the
+            # disaggregation prefill stage runs max_new_tokens=1): must
+            # happen BEFORE _push/_maybe_retire resolve the future — the
+            # orchestrator reads captured_entry the moment result()
+            # returns
+            used = blocks_for_tokens(n, self.block_size)
+            if 0 < used <= nb_total:
+                with self._wd_lock:
+                    if self._epoch == epoch:
+                        # analysis: ok lock-discipline — the device_get
+                        # must finish before the retire tail frees these
+                        # blocks to another stream (same contract as the
+                        # swap-out copy); the read is bounded (one
+                        # stream's used blocks) and epoch-atomic
+                        self._capture_pages(
+                            req, np.asarray(blocks[:used], np.int32),
+                            n, state.n_generated, tok, epoch)
         err = greq.handle._push(tok)
         if err is not None:
             # broken on_token consumer failed its own stream at token 0:
@@ -2228,6 +2381,27 @@ class GenerationEngine(ResilientEngineMixin):
                     st.last_token = tok
                     reason = self._retire_reason(st, tok)
                     if reason is not None:
+                        if st.greq.capture_pages and st.blocks is not None:
+                            # decode-feed retirement (prefix/cache-hit
+                            # seat, EOS at token 0): export the written
+                            # pages while the blocks are still
+                            # referenced, under the same epoch lock that
+                            # frees them (st.length counts written
+                            # positions; the retiring token's K/V was
+                            # never written — swap-out semantics)
+                            used = blocks_for_tokens(st.length,
+                                                     self.block_size)
+                            if 0 < used <= st.n_entries:
+                                # analysis: ok lock-discipline — the
+                                # device_get must finish before
+                                # _clear_slot frees these blocks to
+                                # another stream (swap-out's contract);
+                                # bounded read, epoch-atomic
+                                self._capture_pages(
+                                    st.request,
+                                    np.asarray(self._tables[i][:used],
+                                               np.int32),
+                                    st.length, st.n_generated, tok, epoch)
                         self._maybe_cache_retired(i, st)
                         self._clear_slot(i, st)  # freed for NEXT admission
             if fed_only:

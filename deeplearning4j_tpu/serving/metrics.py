@@ -293,6 +293,22 @@ class ServingMetrics:
         self.kv_swap_bytes_out = Counter("kv_swap_bytes_out")
         self.kv_swap_bytes_in = Counter("kv_swap_bytes_in")
         self.kv_swapped_blocks_held = Gauge("kv_swapped_blocks_held")
+        # ---- disaggregated prefill/decode (serving/disagg.py, PR 16) ------
+        # kv_migrations_total counts streams whose KV pages moved from a
+        # prefill-class host to a decode-class host; bytes_out is stamped
+        # on the exporting engine, bytes_in on the importing one (the two
+        # only match fleet-wide when every export lands). fallbacks are
+        # migrations that degraded to recompute-on-decode-host — the
+        # DEGRADE contract means they NEVER surface as sheds, so this
+        # counter is the only place a lost migration is visible.
+        # prefix_route_hits counts front-door placements steered by the
+        # fleet-wide radix prefix index (cache-aware routing).
+        self.kv_migrations_total = Counter("kv_migrations_total")
+        self.kv_migrate_bytes_out = Counter("kv_migrate_bytes_out")
+        self.kv_migrate_bytes_in = Counter("kv_migrate_bytes_in")
+        self.kv_migrate_fallbacks_total = Counter(
+            "kv_migrate_fallbacks_total")
+        self.prefix_route_hits_total = Counter("prefix_route_hits_total")
         # dtype-aware HBM accounting (paging.kv_bytes_per_token is the one
         # formula): int8 pools report their true 1-byte-values +
         # fp32-scale footprint, so "how much HBM does the cache hold" and
@@ -473,7 +489,10 @@ class ServingMetrics:
             self.prefix_cache_inserts_total,
             self.prefix_cache_evictions_total,
             self.stream_resumes_total, self.kv_swapped_blocks,
-            self.kv_swap_bytes_out, self.kv_swap_bytes_in)}
+            self.kv_swap_bytes_out, self.kv_swap_bytes_in,
+            self.kv_migrations_total, self.kv_migrate_bytes_out,
+            self.kv_migrate_bytes_in, self.kv_migrate_fallbacks_total,
+            self.prefix_route_hits_total)}
 
     def decode_tokens_per_sec(self) -> float:
         """Steady-state decode throughput: tokens sampled by decode_step

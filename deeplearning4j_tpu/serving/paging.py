@@ -192,14 +192,152 @@ class SharedPrefix:
         return self.blocks is not None
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class _CacheEntry:
     """One retired stream's reusable prefix: its FULL blocks (length a
     multiple of the block size) and the tokens whose K/V they hold. The
-    entry owns one allocator reference per block."""
+    entry owns one allocator reference per block. ``tick`` is the LRU
+    stamp (bumped on insert and on every match hit) — the radix index
+    returns every entry achieving the longest match, and the smallest
+    tick reproduces the pre-radix linear scan's first-in-LRU-order
+    tie-break exactly."""
 
     tokens: np.ndarray                 # (m * block_size,) int32
     blocks: List[int]                  # m physical block ids, in order
+    tick: int = 0
+
+
+class _RadixNode:
+    """One node of :class:`RadixPrefixIndex`. ``label`` is the
+    compressed edge INTO this node (a run of block keys no inserted
+    path diverges within); ``values`` is every value registered at or
+    below this node — by construction each of them extends through the
+    node's entire label."""
+
+    __slots__ = ("label", "children", "values")
+
+    def __init__(self, label: Tuple = ()):
+        self.label: Tuple = tuple(label)
+        self.children: Dict[object, "_RadixNode"] = {}
+        self.values: set = set()
+
+
+class RadixPrefixIndex:
+    """Compressed radix tree (SGLang RadixAttention's lookup structure)
+    over BLOCK-granular key paths: each path element is one block's
+    worth of tokens reduced to a hashable key (:class:`PrefixCache`
+    uses the block's ``int32`` bytes; the fleet-wide index in
+    serving/disagg.py uses token tuples). A lookup walks the tree once
+    — O(match length) key comparisons — instead of scanning every
+    entry, and :meth:`match` returns BOTH the longest-prefix depth and
+    the complete set of values achieving it, so callers keep their own
+    tie-break (the prefix cache's LRU order, the fleet index's host
+    load ranking).
+
+    Edges are compressed: inserting a path that diverges inside an
+    existing edge SPLITS that edge at the divergence point (the classic
+    radix split, unit-tested directly). Removing the last value below a
+    node prunes its whole subtree. Values are registered on every node
+    along their path, so any node's ``values`` set is exactly the
+    values whose paths extend through that node's full label — which is
+    what makes a mid-label divergence still return the right candidate
+    set without walking the subtree.
+
+    Not thread-safe on its own: every owner (``PrefixCache``, the fleet
+    index) already serializes access under its existing lock.
+    """
+
+    def __init__(self):
+        self._root = _RadixNode()
+
+    @staticmethod
+    def _common_len(a: Sequence, b: Sequence) -> int:
+        n = min(len(a), len(b))
+        m = 0
+        while m < n and a[m] == b[m]:
+            m += 1
+        return m
+
+    def insert(self, path: Sequence, value) -> None:
+        """Register ``value`` along ``path`` (a non-empty sequence of
+        hashable block keys), splitting edges at any divergence."""
+        path = tuple(path)
+        node = self._root
+        i = 0
+        while i < len(path):
+            child = node.children.get(path[i])
+            if child is None:
+                child = _RadixNode(path[i:])
+                node.children[path[i]] = child
+                child.values.add(value)
+                return
+            common = self._common_len(child.label, path[i:])
+            if common < len(child.label):
+                # split: a mid-edge divergence (or a path ending inside
+                # the edge) carves the shared run into its own node
+                mid = _RadixNode(child.label[:common])
+                mid.children[child.label[common]] = child
+                mid.values = set(child.values)
+                child.label = child.label[common:]
+                node.children[path[i]] = mid
+                child = mid
+            child.values.add(value)
+            node = child
+            i += common
+
+    def remove(self, path: Sequence, value) -> None:
+        """Drop ``value`` from every node along ``path``, pruning any
+        node left with no values (its subtree holds none either — a
+        node's set is the union of its subtree's). Unknown paths and
+        absent values are tolerated (idempotent)."""
+        path = tuple(path)
+        node = self._root
+        walk = []
+        i = 0
+        while i < len(path):
+            child = node.children.get(path[i])
+            if child is None or i + len(child.label) > len(path):
+                return
+            walk.append((node, path[i], child))
+            i += len(child.label)
+            node = child
+        for parent, head, child in reversed(walk):
+            child.values.discard(value)
+            if not child.values:
+                del parent.children[head]
+
+    def match(self, path: Sequence) -> Tuple[int, set]:
+        """Longest prefix of ``path`` any registered value shares:
+        ``(depth, values)`` where every value in the set matches exactly
+        ``depth`` leading keys of the query (the maximum any value
+        achieves), or ``(0, set())``. Cap the lookup by truncating
+        ``path`` before the call."""
+        path = tuple(path)
+        node = self._root
+        best_depth, best_values = 0, set()
+        i = 0
+        while i < len(path):
+            child = node.children.get(path[i])
+            if child is None:
+                break
+            common = self._common_len(child.label, path[i:])
+            if common > 0:
+                best_depth, best_values = i + common, child.values
+            if common < len(child.label):
+                break
+            node = child
+            i += common
+        return best_depth, set(best_values)
+
+    def node_count(self) -> int:
+        """Nodes below the root — the split/prune unit tests' probe."""
+        n = 0
+        stack = [self._root]
+        while stack:
+            nd = stack.pop()
+            n += len(nd.children)
+            stack.extend(nd.children.values())
+        return n
 
 
 class PrefixCache:
@@ -242,6 +380,12 @@ class PrefixCache:
         self.block_size = block_size
         self.capacity_blocks = capacity_blocks
         self._entries: List[_CacheEntry] = []   # LRU order: [0] is oldest
+        # block-granular radix tree over every entry's token path — the
+        # lookup is one tree walk instead of a scan over all entries;
+        # the LRU list above stays the eviction order (and, via entry
+        # ticks, the match tie-break), bitwise-inert vs the linear scan
+        self._index = RadixPrefixIndex()
+        self._ticks = itertools.count(1)
         self._lock = threading.Lock()
         self.hits = 0
         self.inserts = 0
@@ -275,23 +419,27 @@ class PrefixCache:
             self.allocator.free(blocks)
             return False
         with self._lock:
-            for e in self._entries:
-                if len(e.tokens) >= len(tokens) and np.array_equal(
-                        e.tokens[:len(tokens)], tokens):
-                    # an existing entry already covers this prefix
-                    # (>= length): keep the older, longer one — rejecting
-                    # the duplicate keeps hot system prompts from
-                    # crowding the LRU with identical copies
-                    self.allocator.free(blocks)
-                    return False
-            self._entries.append(_CacheEntry(
+            path = self._block_path(tokens, len(blocks))
+            depth, covering = self._index.match(path)
+            if depth == len(blocks) and covering:
+                # an existing entry already covers this prefix — any
+                # value at the full-path node holds >= len(blocks)
+                # matching blocks, i.e. len(e.tokens) >= len(tokens)
+                # with an equal prefix: keep the older, longer one —
+                # rejecting the duplicate keeps hot system prompts from
+                # crowding the LRU with identical copies
+                self.allocator.free(blocks)
+                return False
+            entry = _CacheEntry(
                 tokens=np.ascontiguousarray(tokens, dtype=np.int32),
-                blocks=blocks))
+                blocks=blocks, tick=next(self._ticks))
+            self._entries.append(entry)
+            self._index.insert(path, entry)
             self.inserts += 1
             over = sum(len(e.blocks) for e in self._entries) \
                 - self.capacity_blocks
             if over > 0:
-                self._evict_locked(over, protect=self._entries[-1])
+                self._evict_locked(over, protect=entry)
         return True
 
     def match(self, tokens: np.ndarray
@@ -332,33 +480,26 @@ class PrefixCache:
         max_m = (int(toks.size) - 1) // self.block_size
         if max_m <= 0:
             return None
-        best_i, best_m = -1, 0
-        for i, e in enumerate(self._entries):
-            m = self._common_blocks(e.tokens, toks, max_m)
-            if m > best_m:
-                best_i, best_m = i, m
-        if best_m <= 0:
+        # one radix walk — O(match length) block comparisons, not
+        # O(entries x match length). Among the entries achieving the
+        # longest match, the smallest LRU tick wins: exactly the entry
+        # the pre-radix linear scan (first in LRU order) returned
+        m, cands = self._index.match(self._block_path(toks, max_m))
+        if m <= 0 or not cands:
             return None
-        e = self._entries.pop(best_i)
+        e = min(cands, key=lambda c: c.tick)
+        self._entries.remove(e)
         self._entries.append(e)        # MRU
+        e.tick = next(self._ticks)
         self.hits += 1
-        return e, best_m
+        return e, m
 
-    def _common_blocks(self, a: np.ndarray, b: np.ndarray,
-                       cap: int) -> int:
-        """Whole blocks of common prefix between two token arrays —
-        forward block-by-block scan, stopping at the first mismatching
-        block (linear in the match length, not quadratic in the prompt:
-        this runs per entry on every paged admission)."""
+    def _block_path(self, tokens: np.ndarray, m: int) -> Tuple[bytes, ...]:
+        """``tokens``' first ``m`` blocks as hashable radix keys (the
+        raw int32 bytes of each block-sized chunk)."""
+        toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
         B = self.block_size
-        n = min(int(a.size), int(b.size), cap * B) // B
-        m = 0
-        for k in range(n):
-            if not np.array_equal(a[k * B:(k + 1) * B],
-                                  b[k * B:(k + 1) * B]):
-                break
-            m += 1
-        return m
+        return tuple(toks[k * B:(k + 1) * B].tobytes() for k in range(m))
 
     def evict(self, need_blocks: int,
               protect: Optional[_CacheEntry] = None) -> int:
@@ -381,6 +522,8 @@ class PrefixCache:
                 i += 1
                 continue
             self._entries.pop(i)
+            self._index.remove(self._block_path(e.tokens, len(e.blocks)),
+                               e)
             self.allocator.free(e.blocks)
             released += len(e.blocks)
             self.evictions += 1
@@ -396,6 +539,7 @@ class PrefixCache:
             for e in self._entries:
                 self.allocator.free(e.blocks)
             self._entries = []
+            self._index = RadixPrefixIndex()
 
     def invalidate(self):
         """Drop every entry WITHOUT freeing — the pool (and allocator)
@@ -403,6 +547,20 @@ class PrefixCache:
         fresh allocator must never see them."""
         with self._lock:
             self._entries = []
+            self._index = RadixPrefixIndex()
+
+    def advertised_prefixes(self, max_entries: int = 32
+                            ) -> Tuple[Tuple[int, ...], ...]:
+        """The MRU-most entries' token sequences, as plain int tuples —
+        what a host advertises in its heartbeat so the cluster front
+        door's fleet-wide prefix index (serving/disagg.py) can route a
+        prompt to the host already holding its longest prefix. Bounded
+        by ``max_entries`` to keep heartbeat payloads small; the hottest
+        (most recently matched) entries advertise first."""
+        with self._lock:
+            ents = self._entries[-max_entries:] if max_entries else []
+            return tuple(tuple(int(t) for t in e.tokens)
+                         for e in reversed(ents))
 
 
 @dataclasses.dataclass
@@ -529,5 +687,5 @@ class BlockSwapStore:
 
 
 __all__ = ["BlockAllocator", "BlockSwapStore", "PrefixCache",
-           "SharedPrefix", "SwapEntry", "blocks_for_tokens",
-           "kv_bytes_per_token"]
+           "RadixPrefixIndex", "SharedPrefix", "SwapEntry",
+           "blocks_for_tokens", "kv_bytes_per_token"]

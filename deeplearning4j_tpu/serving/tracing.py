@@ -87,6 +87,12 @@ TERMINAL_REASONS = (
     # the pool again) — distinct from kv_blocks_exhausted because the
     # caller already received tokens and should resubmit the WHOLE request
     "preempted",
+    # Deliberately ABSENT: "migrate_failed". Cross-host KV page
+    # migration (serving/disagg.py + the kv.migrate endpoint) degrades
+    # every failure to recompute on the decode host — the request's
+    # terminal is whatever the recomputed stream earns, so a migration
+    # failure is a trace event + kv_migrate_fallbacks_total increment,
+    # never a terminal reason (the taxonomy lint enforces this stays so).
 )
 
 

@@ -1,0 +1,389 @@
+"""Disaggregated prefill/decode serving with cross-host KV page
+migration (ISSUE 16 — serving/disagg.py + the ``kv.migrate`` RPC
+endpoint in serving/rpc.py).
+
+Acceptance criteria exercised here:
+- a stream placed prefill-host -> migrate -> decode-host is BITWISE
+  identical to the single-host run (greedy AND sampled, fp32 AND int8
+  KV), over loopback hand-off and over the real HTTP ``kv.migrate``
+  endpoint alike;
+- seeded ``kv.migrate`` / ``kv.migrate.export`` / ``kv.migrate.import``
+  faults DEGRADE to recompute on the decode host — zero sheds, stream
+  still bitwise;
+- mixed-fleet class routing: a prefill-class host never holds a
+  decode-phase stream (including every fallback path);
+- ``HostStatus`` rolling-upgrade tolerance: a v-old payload (no
+  host_class / prefix advertisement) parses clean and reads as mixed;
+- ``/api/cluster`` rolls up per-class fleet counts and fleet prefix
+  stats;
+- the defaults (``disagg=None``, ``host_class="mixed"``) are bitwise
+  inert; the decode-stage feasibility check judges a migration-capable
+  host on its post-migration block count, not the re-prefill count;
+- the fleet-wide radix prefix index routes a repeat prompt to the
+  decode host advertising its longest cached prefix.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import TransformerConfig, init_params
+from deeplearning4j_tpu.serving import (
+    ClusterDirectory, ClusterFrontDoor, DisaggPolicy, FaultPlan,
+    FleetPrefixIndex, GenerationEngine, HeartbeatPump, HostStatus,
+    LoopbackHost, LoopbackTransport,
+)
+from deeplearning4j_tpu.serving.rpc import HostRpcServer, RemoteHost
+
+CFG = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                        mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                        causal=True, attention_impl="full", remat=False)
+
+PROMPT = np.array([5, 9, 3, 7, 11, 2], np.int32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def engine(params, name, kv_dtype="float32", **kw):
+    return GenerationEngine(params, CFG, slots=2, max_len=64,
+                            kv_dtype=kv_dtype, name=name, **kw)
+
+
+def disagg_fleet(params, kv_dtype="float32", **engine_kw):
+    """1 prefill-class + 1 decode-class loopback host behind a front
+    door with the DisaggPolicy installed; heartbeats pre-pumped."""
+    g_p = engine(params, "pf", kv_dtype, **engine_kw)
+    g_d = engine(params, "dec", kv_dtype, **engine_kw)
+    hp = LoopbackHost(0, generation=g_p, host_class="prefill")
+    hd = LoopbackHost(1, generation=g_d, host_class="decode")
+    d = ClusterDirectory()
+    d.join(hp)
+    d.join(hd)
+    d.heartbeat(hp.status())
+    d.heartbeat(hd.status())
+    fd = ClusterFrontDoor(d, disagg=DisaggPolicy())
+    return g_p, g_d, hp, hd, d, fd
+
+
+def reference(params, max_new=10, kv_dtype="float32", temp=0.0, seed=0):
+    g = engine(params, "ref", kv_dtype)
+    try:
+        return list(g.submit(PROMPT, max_new_tokens=max_new,
+                             temperature=temp, seed=seed).result())
+    finally:
+        g.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity: migrated stream == single-host stream
+# ---------------------------------------------------------------------------
+class TestMigratedParity:
+    @pytest.mark.parametrize("kv_dtype", ["float32", "int8"])
+    @pytest.mark.parametrize("temp,seed", [(0.0, 0), (0.9, 7)])
+    def test_loopback_migration_bitwise(self, params, kv_dtype, temp,
+                                        seed):
+        ref = reference(params, 10, kv_dtype, temp, seed)
+        g_p, g_d, hp, hd, d, fd = disagg_fleet(params, kv_dtype)
+        try:
+            h = fd.submit_generate(PROMPT, max_new_tokens=10,
+                                   temperature=temp, seed=seed)
+            got = [int(t) for t in h.result(timeout=120)]
+            assert got == ref
+            # a REAL migration happened: pages crossed, swap-in seated
+            assert fd.metrics.kv_migrations_total.value == 1
+            assert g_p.metrics.kv_migrate_bytes_out.value > 0
+            assert g_d.metrics.kv_migrate_bytes_in.value > 0
+            assert g_d.metrics.kv_swap_bytes_in.value > 0
+        finally:
+            g_p.shutdown()
+            g_d.shutdown()
+
+    def test_rpc_migration_bitwise(self, params):
+        """Same parity over the real HTTP ``kv.migrate`` endpoint: both
+        hosts behind HostRpcServer, the front door sees RemoteHosts."""
+        ref = reference(params, 10)
+        g_p = engine(params, "rpf")
+        g_d = engine(params, "rdec")
+        lp = LoopbackHost(0, generation=g_p, host_class="prefill")
+        ld = LoopbackHost(1, generation=g_d, host_class="decode")
+        sp, sd = HostRpcServer(lp), HostRpcServer(ld)
+        rp = RemoteHost(0, sp.url)
+        rd = RemoteHost(1, sd.url)
+        d = ClusterDirectory()
+        d.join(rp)
+        d.join(rd)
+        t = LoopbackTransport(d)
+        HeartbeatPump(rp, t).pump_once()
+        HeartbeatPump(rd, t).pump_once()
+        fd = ClusterFrontDoor(d, disagg=DisaggPolicy())
+        try:
+            h = fd.submit_generate(PROMPT, max_new_tokens=10)
+            got = [int(t) for t in h.result(timeout=120)]
+            assert got == ref
+            assert fd.metrics.kv_migrations_total.value == 1
+            assert g_d.metrics.kv_migrate_bytes_in.value > 0
+        finally:
+            sp.stop()
+            sd.stop()
+            g_p.shutdown()
+            g_d.shutdown()
+
+    def test_on_token_sees_full_stream_once(self, params):
+        ref = reference(params, 8)
+        g_p, g_d, hp, hd, d, fd = disagg_fleet(params)
+        seen = []
+        try:
+            h = fd.submit_generate(PROMPT, max_new_tokens=8,
+                                   on_token=seen.append)
+            got = [int(t) for t in h.result(timeout=120)]
+            assert got == ref
+            assert [int(t) for t in seen] == ref
+        finally:
+            g_p.shutdown()
+            g_d.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# seeded kv.migrate faults: recompute on the decode host, never shed
+# ---------------------------------------------------------------------------
+class TestMigrateFaultsDegrade:
+    @pytest.mark.parametrize("point", ["kv.migrate", "kv.migrate.export",
+                                       "kv.migrate.import"])
+    def test_fault_degrades_to_recompute_never_sheds(self, params, point):
+        ref = reference(params, 8)
+        g_p, g_d, hp, hd, d, fd = disagg_fleet(params)
+        try:
+            plan = FaultPlan(seed=11).fail(point, at=[0])
+            with plan:
+                h = fd.submit_generate(PROMPT, max_new_tokens=8)
+                got = [int(t) for t in h.result(timeout=120)]
+            assert [e["point"] for e in plan.fired()] == [point]
+            assert got == ref                       # bitwise, still
+            # ZERO sheds: the stream degraded, nothing was rejected
+            assert fd.metrics.rejected_total.value == 0
+            assert fd.metrics.rejections_by_reason.to_dict() == {}
+            assert fd.metrics.kv_migrate_fallbacks_total.value >= 1
+            # no migration was counted for the degraded stream
+            assert fd.metrics.kv_migrations_total.value == 0
+        finally:
+            g_p.shutdown()
+            g_d.shutdown()
+
+    def test_migrate_failed_is_not_a_terminal_reason(self):
+        from deeplearning4j_tpu.serving.tracing import TERMINAL_REASONS
+        assert "migrate_failed" not in TERMINAL_REASONS
+
+
+# ---------------------------------------------------------------------------
+# class routing: a prefill host never holds a decode-phase stream
+# ---------------------------------------------------------------------------
+class TestClassRouting:
+    def test_prefill_host_never_decodes(self, params):
+        g_p, g_d, hp, hd, d, fd = disagg_fleet(params)
+        try:
+            h = fd.submit_generate(PROMPT, max_new_tokens=10)
+            h.result(timeout=120)
+            # the prefill host produced exactly the watermark token;
+            # every decode-phase token came off the decode host
+            assert g_p.metrics.generated_tokens_total.value == 1
+            assert g_d.metrics.generated_tokens_total.value == 9
+        finally:
+            g_p.shutdown()
+            g_d.shutdown()
+
+    def test_fallback_recompute_stays_off_prefill_host(self, params):
+        """Even the full-recompute degrade path routes the decode-phase
+        stream to a non-prefill host."""
+        g_p, g_d, hp, hd, d, fd = disagg_fleet(params)
+        try:
+            with FaultPlan(seed=5).fail("kv.migrate", at=[0]):
+                h = fd.submit_generate(PROMPT, max_new_tokens=8)
+                h.result(timeout=120)
+            # prefill host ran only its 1-token prefill attempt; the
+            # recomputed stream (prefill + 8 tokens) ran on the decode
+            # host
+            assert g_p.metrics.generated_tokens_total.value == 1
+            assert g_d.metrics.generated_tokens_total.value == 8
+        finally:
+            g_p.shutdown()
+            g_d.shutdown()
+
+    def test_host_class_validation(self):
+        with pytest.raises(ValueError, match="host_class"):
+            LoopbackHost(0, host_class="gpu")
+
+    def test_mixed_only_fleet_keeps_policy_inert(self, params):
+        ref = reference(params, 8)
+        g_a = engine(params, "ma")
+        g_b = engine(params, "mb")
+        d = ClusterDirectory()
+        ha = LoopbackHost(0, generation=g_a)
+        hb = LoopbackHost(1, generation=g_b)
+        d.join(ha)
+        d.join(hb)
+        d.heartbeat(ha.status())
+        d.heartbeat(hb.status())
+        fd = ClusterFrontDoor(d, disagg=DisaggPolicy())
+        try:
+            assert not fd.disagg.enabled(d)
+            h = fd.submit_generate(PROMPT, max_new_tokens=8)
+            got = [int(t) for t in h.result(timeout=120)]
+            assert got == ref
+            assert fd.metrics.kv_migrations_total.value == 0
+            assert fd.metrics.kv_migrate_fallbacks_total.value == 0
+        finally:
+            g_a.shutdown()
+            g_b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# rolling-upgrade wire tolerance + /api/cluster roll-up
+# ---------------------------------------------------------------------------
+class TestWireAndSnapshot:
+    def test_v_old_heartbeat_payload_ingests_clean(self):
+        """A pre-upgrade sender's payload carries neither host_class nor
+        the prefix advertisement — it must parse, read as mixed, and
+        fold into the directory without error."""
+        old_payload = {
+            "host_id": 3, "has_generate": True, "queue_depth": 0,
+            "queue_capacity": 8, "gen_queue_depth": 1,
+            "gen_queue_capacity": 64, "slots": 4, "free_slots": 2,
+            "kv_blocks_total": 32, "kv_blocks_free": 16,
+            "kv_blocks_usable": 30, "block_size": 16,
+            "buckets": [8, 16], "breaker": "CLOSED", "seq": 7,
+            "wire_version": 1,
+        }
+        st = HostStatus.from_dict(old_payload)
+        assert st.host_class == "mixed"
+        assert st.prefix_tokens == ()
+        assert st.prefix_cache_entries == 0
+        assert st.prefix_cache_hits == 0
+        d = ClusterDirectory()
+        d.heartbeat(st)
+        assert d.status(3).host_class == "mixed"
+
+    def test_round_trip_preserves_class_and_prefixes(self):
+        st = HostStatus(host_id=1, host_class="decode",
+                        prefix_tokens=((1, 2, 3), (4, 5)),
+                        prefix_cache_entries=2, prefix_cache_hits=9)
+        st2 = HostStatus.from_dict(st.to_dict())
+        assert st2.host_class == "decode"
+        assert st2.prefix_tokens == ((1, 2, 3), (4, 5))
+        assert st2.prefix_cache_hits == 9
+
+    def test_api_snapshot_rolls_up_host_classes(self, params):
+        g_p, g_d, hp, hd, d, fd = disagg_fleet(params)
+        try:
+            snap = d.api_snapshot()
+            assert snap["fleet"]["host_classes"] == {
+                "prefill": 1, "decode": 1, "mixed": 0}
+            assert "prefix_cache_entries" in snap["fleet"]
+            assert "prefix_cache_hits" in snap["fleet"]
+            hs = snap["hosts"]["0"]["status"]
+            assert hs["host_class"] == "prefill"
+        finally:
+            g_p.shutdown()
+            g_d.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# decode-stage feasibility: post-migration block count, not re-prefill
+# ---------------------------------------------------------------------------
+class TestMigrateFeasibility:
+    def _fd(self):
+        return ClusterFrontDoor(ClusterDirectory())
+
+    def test_headroom_uses_post_migration_bound(self):
+        fd = self._fd()
+        st = HostStatus(host_id=0, has_generate=True, slots=2,
+                        free_slots=1, gen_queue_capacity=8,
+                        kv_blocks_total=8, kv_blocks_free=8,
+                        kv_blocks_usable=6, block_size=16)
+        # re-prefill bound exceeds usable blocks, post-migration bound
+        # fits: a migration-capable host is feasible
+        assert not fd._headroom(st, "generate", 1, 7)
+        assert fd._headroom(st, "generate", 1, 7, None, 6)
+        # the migrate bound never RAISES the demand
+        assert fd._headroom(st, "generate", 1, 4, None, 9)
+
+    def test_headroom_default_unchanged(self):
+        fd = self._fd()
+        st = HostStatus(host_id=0, has_generate=True, slots=2,
+                        free_slots=1, gen_queue_capacity=8,
+                        kv_blocks_total=8, kv_blocks_free=8,
+                        kv_blocks_usable=6, block_size=16)
+        assert fd._headroom(st, "generate", 1, 6)
+        assert not fd._headroom(st, "generate", 1, 7)
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide prefix index + cache-aware decode routing
+# ---------------------------------------------------------------------------
+class TestFleetPrefixIndex:
+    def test_refresh_and_match(self):
+        idx = FleetPrefixIndex()
+
+        class FakeDir:
+            def __init__(self):
+                self._st = {
+                    0: HostStatus(host_id=0, seq=1,
+                                  prefix_tokens=((1, 2, 3),)),
+                    1: HostStatus(host_id=1, seq=1,
+                                  prefix_tokens=((1, 2), (9, 9))),
+                }
+
+            def host_ids(self):
+                return sorted(self._st)
+
+            def status(self, hid):
+                return self._st.get(hid)
+
+        d = FakeDir()
+        idx.refresh(d)
+        assert idx.best_hosts((1, 2, 3, 4)) == (3, {0})
+        assert idx.best_hosts((1, 2, 7)) == (2, {0, 1})
+        assert idx.best_hosts((9, 9)) == (2, {1})
+        # seq unchanged: refresh is a no-op; seq moved: re-indexed
+        d._st[1] = HostStatus(host_id=1, seq=2, prefix_tokens=((9, 9),))
+        idx.refresh(d)
+        assert idx.best_hosts((1, 2, 7)) == (2, {0})  # host 1's (1,2) gone
+        # a departed host drops out entirely
+        del d._st[0]
+        idx.refresh(d)
+        assert idx.best_hosts((1, 2, 3)) == (0, set())
+        assert idx.best_hosts((9, 9)) == (2, {1})
+
+    def test_cache_aware_decode_routing_hits(self, params):
+        """A repeat prompt routes to the decode host advertising its
+        prefix — the fleet-level RadixAttention payoff."""
+        g_p, g_d, hp, hd, d, fd = disagg_fleet(
+            params, prefix_cache_blocks=8)
+        try:
+            # one full 16-token block must be WRITTEN for the retired
+            # stream to enter the cache (the retiring token's own K/V
+            # never is): 6 prompt + 16 generated covers it
+            h = fd.submit_generate(PROMPT, max_new_tokens=16)
+            h.result(timeout=120)
+            # wait for the retired stream's blocks to land in the cache
+            deadline = time.time() + 10
+            while (g_d._prefix_cache is None
+                   or len(g_d._prefix_cache) == 0):
+                assert time.time() < deadline, "prefix cache never filled"
+                time.sleep(0.02)
+            # fresh heartbeats advertise the cached prefix (and keep
+            # BOTH hosts inside the liveness window — a stale prefill
+            # host would turn the policy inert, which is its own test)
+            d.heartbeat(hp.status())
+            d.heartbeat(hd.status())
+            assert d.status(1).prefix_cache_entries >= 1
+            h2 = fd.submit_generate(PROMPT, max_new_tokens=10)
+            h2.result(timeout=120)
+            assert fd.metrics.prefix_route_hits_total.value >= 1
+        finally:
+            g_p.shutdown()
+            g_d.shutdown()

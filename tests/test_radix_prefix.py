@@ -1,0 +1,179 @@
+"""Radix-tree longest-prefix index (ISSUE 16 satellite — serving/paging.py
+``RadixPrefixIndex``, the SGLang RadixAttention lookup structure that
+replaced ``PrefixCache``'s linear scan and powers the fleet-wide prefix
+index in serving/disagg.py).
+
+Exercised here:
+- compressed-edge insert/match/remove semantics, including the classic
+  mid-edge SPLIT and subtree pruning, with exact node counts;
+- ``match`` returns the longest depth AND every value achieving it (the
+  caller keeps its own tie-break);
+- the ``PrefixCache`` rewiring is behavior-preserving: block-granular
+  matching, LRU tie-break, eviction — and ``advertised_prefixes`` lists
+  MRU-first for the heartbeat advertisement.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving.paging import (
+    BlockAllocator, PrefixCache, RadixPrefixIndex,
+)
+
+
+class TestRadixPrefixIndex:
+    def test_empty_index_matches_nothing(self):
+        idx = RadixPrefixIndex()
+        assert idx.match((1, 2, 3)) == (0, set())
+        assert idx.node_count() == 0
+
+    def test_single_path_is_one_compressed_node(self):
+        idx = RadixPrefixIndex()
+        idx.insert((1, 2, 3), "a")
+        assert idx.node_count() == 1          # one edge, label (1,2,3)
+        assert idx.match((1, 2, 3, 4)) == (3, {"a"})
+        assert idx.match((1, 2)) == (2, {"a"})
+        assert idx.match((9,)) == (0, set())
+
+    def test_mid_edge_divergence_splits(self):
+        idx = RadixPrefixIndex()
+        idx.insert((1, 2, 3), "a")
+        idx.insert((1, 2, 4), "b")
+        # split: mid(1,2) -> {(3): a, (4): b}
+        assert idx.node_count() == 3
+        assert idx.match((1, 2)) == (2, {"a", "b"})
+        assert idx.match((1, 2, 3)) == (3, {"a"})
+        assert idx.match((1, 2, 4, 7)) == (3, {"b"})
+
+    def test_path_ending_inside_edge_splits(self):
+        idx = RadixPrefixIndex()
+        idx.insert((1, 2, 3, 4), "long")
+        idx.insert((1, 2), "short")
+        # mid(1,2) gains value "short"; child (3,4) keeps "long"
+        assert idx.node_count() == 2
+        assert idx.match((1, 2)) == (2, {"long", "short"})
+        assert idx.match((1, 2, 3, 4)) == (4, {"long"})
+
+    def test_longest_match_wins_over_shallower_values(self):
+        idx = RadixPrefixIndex()
+        idx.insert((1,), "one")
+        idx.insert((1, 2), "two")
+        idx.insert((1, 2, 3), "three")
+        assert idx.match((1, 2, 3, 9)) == (3, {"three"})
+        assert idx.match((1, 2, 9)) == (2, {"two", "three"})
+        assert idx.match((1, 9)) == (1, {"one", "two", "three"})
+
+    def test_remove_prunes_empty_subtrees(self):
+        idx = RadixPrefixIndex()
+        idx.insert((1, 2, 3), "a")
+        idx.insert((1, 2, 4), "b")
+        assert idx.node_count() == 3
+        idx.remove((1, 2, 3), "a")
+        assert idx.match((1, 2, 3)) == (2, {"b"})
+        assert idx.node_count() == 2          # the (3) child pruned
+        idx.remove((1, 2, 4), "b")
+        assert idx.node_count() == 0
+        assert idx.match((1, 2, 4)) == (0, set())
+
+    def test_remove_is_idempotent_and_tolerates_unknown(self):
+        idx = RadixPrefixIndex()
+        idx.insert((1, 2), "a")
+        idx.remove((9, 9), "nope")            # unknown path: no-op
+        idx.remove((1, 2), "nope")            # absent value: no-op
+        idx.remove((1, 2), "a")
+        idx.remove((1, 2), "a")               # second remove: no-op
+        assert idx.node_count() == 0
+
+    def test_same_path_many_values(self):
+        idx = RadixPrefixIndex()
+        for v in range(5):
+            idx.insert((7, 8), v)
+        assert idx.match((7, 8)) == (2, {0, 1, 2, 3, 4})
+        idx.remove((7, 8), 2)
+        assert idx.match((7, 8)) == (2, {0, 1, 3, 4})
+        assert idx.node_count() == 1          # node lives while valued
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache over the radix index: behavior-preserving rewiring
+# ---------------------------------------------------------------------------
+def toks(*vals):
+    return np.asarray(vals, np.int32)
+
+
+class TestPrefixCacheRadix:
+    def _cache(self, capacity_blocks=8, block_size=2, num_blocks=32):
+        alloc = BlockAllocator(num_blocks)
+        return alloc, PrefixCache(alloc, block_size, capacity_blocks)
+
+    def test_block_granular_longest_match(self):
+        alloc, c = self._cache()
+        b2 = alloc.alloc(1)
+        assert c.insert(toks(1, 2), b2)
+        b1 = alloc.alloc(2)
+        assert c.insert(toks(1, 2, 3, 4), b1)  # extends, not a duplicate
+        hit = c.match_and_ref(toks(1, 2, 3, 4, 5, 6))
+        assert hit is not None
+        entry, m, blocks = hit
+        # m counts BLOCKS: both of b1's blocks match (the longer entry
+        # wins over the 1-block (1,2) entry)
+        assert m == 2 and blocks == b1
+        alloc.free(blocks)
+
+    def test_covered_duplicate_is_rejected(self):
+        alloc, c = self._cache()
+        b1 = alloc.alloc(2)
+        assert c.insert(toks(1, 2, 3, 4), b1)
+        free_before = alloc.free_count
+        b2 = alloc.alloc(1)
+        # an existing entry already covers this whole prefix: rejected,
+        # and the transferred refs come back to the pool
+        assert not c.insert(toks(1, 2), b2)
+        assert alloc.free_count == free_before
+
+    def test_lru_tie_break_is_oldest_entry(self):
+        alloc, c = self._cache()
+        b1 = alloc.alloc(1)
+        assert c.insert(toks(5, 6), b1)
+        b2 = alloc.alloc(2)
+        # same leading block (5,6): both entries achieve depth-1 matches
+        assert c.insert(toks(5, 6, 7, 8), b2)
+        hit = c.match_and_ref(toks(5, 6, 9, 9))
+        assert hit is not None
+        _, m, blocks = hit
+        # both match exactly one block; the OLDER entry wins (the
+        # pre-radix linear scan's first-in-LRU-order tie-break)
+        assert m == 1 and blocks == [b1[0]]
+        alloc.free(blocks)
+
+    def test_advertised_prefixes_mru_first_and_bounded(self):
+        alloc, c = self._cache(capacity_blocks=16)
+        for i in range(4):
+            b = alloc.alloc(1)
+            assert c.insert(toks(10 + i, 20 + i), b)
+        adv = c.advertised_prefixes()
+        assert adv[0] == (13, 23)             # most recent insert first
+        assert adv[-1] == (10, 20)
+        assert c.advertised_prefixes(max_entries=2) == ((13, 23), (12, 22))
+        assert c.advertised_prefixes(max_entries=0) == ()
+
+    def test_eviction_keeps_index_consistent(self):
+        alloc, c = self._cache(capacity_blocks=2)
+        b1 = alloc.alloc(1)
+        assert c.insert(toks(1, 2), b1)
+        b2 = alloc.alloc(2)
+        assert c.insert(toks(3, 4, 5, 6), b2)  # evicts (1,2) for room
+        assert c.evictions >= 1
+        assert c.match_and_ref(toks(1, 2)) is None
+        hit = c.match_and_ref(toks(3, 4, 5, 6))
+        assert hit is not None
+        alloc.free(hit[2])
+
+    def test_release_all_empties_index(self):
+        alloc, c = self._cache()
+        b = alloc.alloc(2)
+        assert c.insert(toks(1, 2, 3, 4), b)
+        free_before = alloc.free_count
+        c.release_all()
+        assert alloc.free_count == free_before + 2
+        assert c.match_and_ref(toks(1, 2, 3, 4)) is None
+        assert len(c) == 0

@@ -1485,7 +1485,10 @@ class TestStreamRecoveryGate:
         assert "resume_tokens: Optional[list] = None" in src
         assert src.count("\n    resume_step: int = 0") == 2
         assert src.count("    wire_version: int = 2\n") == 2
-        assert src.count("    wire_version: int = 1\n") == 1   # the chunk
+        # the chunk plus the two kv.migrate envelopes (ISSUE 16) stay v1
+        assert src.count("    wire_version: int = 1\n") == 3
+        assert "class KvMigrateRequest" in src
+        assert "class KvMigrateResponse" in src
 
     def test_resume_serialization_guard_armed(self):
         """Reintroduction gate (the PR 10 asymmetry class extended to
@@ -1543,6 +1546,126 @@ class KvSwapFailedError(RejectedError):
         clean = analyze_sources(sources, rules=["taxonomy-drift"])
         assert [f for f in clean.unsuppressed
                 if "swap" in f.message.lower()] == []
+
+
+# --------------------------------------------------------------------------
+# ISSUE 16 gate: the kv.migrate wire schema, deadline flow through the
+# two-stage disaggregated dispatch, and the migrate path's
+# no-new-terminal discipline
+# --------------------------------------------------------------------------
+class TestDisaggGate:
+    def _source(self, name):
+        p = os.path.join(SERVING, name)
+        with open(p) as f:
+            return p, f.read()
+
+    def _serving_sources(self):
+        sources = {}
+        for name in os.listdir(SERVING):
+            if name.endswith(".py"):
+                q = os.path.join(SERVING, name)
+                with open(q) as f:
+                    sources[q] = f.read()
+        return sources
+
+    def test_disagg_module_zero_unsuppressed(self):
+        """serving/disagg.py analyzes clean under every checker — the
+        whole two-stage placement path, no baseline entries."""
+        p, _ = self._source("disagg.py")
+        report = analyze_paths([p], baseline=Baseline.load(DEFAULT_BASELINE))
+        assert report.errors == []
+        pretty = "\n".join(f"{f.location()}: {f.rule}: {f.message}"
+                           for f in report.unsuppressed)
+        assert report.unsuppressed == [], pretty
+
+    def test_migrate_schema_guard_armed(self):
+        """wire-schema-drift covers the kv.migrate dataclasses: a
+        hand-built KvMigrateRequest.to_dict that forgets the page
+        payload must flag — the decode host would seat zero pages and
+        silently recompute every migrated stream."""
+        p, src = self._source("rpc.py")
+        anchor = (
+            "    wire_version: int = 1\n"
+            "\n"
+            "    def to_dict(self) -> dict:\n"
+            "        return dataclasses.asdict(self)\n"
+            "\n"
+            "    @classmethod\n"
+            "    def from_dict(cls, d: dict) -> \"KvMigrateRequest\":")
+        broken = src.replace(
+            anchor,
+            anchor.replace(
+                "        return dataclasses.asdict(self)",
+                '        return {"request_id": self.request_id,\n'
+                '                "kind": self.kind,\n'
+                '                "prompt": self.prompt,\n'
+                '                "wire_version": self.wire_version}'),
+            1)
+        assert broken != src
+        r = run({p: broken}, rules=["wire-schema-drift"])
+        msgs = [f.message for f in r.unsuppressed]
+        assert any("KvMigrateRequest" in m and "'pages'" in m
+                   and "never serializes" in m for m in msgs), msgs
+        assert any("KvMigrateRequest" in m and "'block_size'" in m
+                   for m in msgs)
+
+    def test_deadline_guard_armed_for_two_stage_dispatch(self):
+        """deadline-propagation covers BOTH dispatch stages: dropping
+        the shrinking budget from the stage-B decode forward must flag
+        — a migrated stream would decode against an unbounded wait
+        while the caller's 50 ms budget expired at stage A."""
+        p, src = self._source("disagg.py")
+        broken = src.replace(
+            "            h2 = hb.submit_generate(\n"
+            "                toks, max_new_tokens=max_new_tokens,\n"
+            "                timeout_ms=deadline_budget(), tenant=tenant,\n",
+            "            h2 = hb.submit_generate(\n"
+            "                toks, max_new_tokens=max_new_tokens,\n"
+            "                tenant=tenant,\n", 1)
+        assert broken != src
+        r = run({p: broken}, rules=["deadline-propagation"])
+        assert any("forwards without it" in f.message
+                   for f in r.unsuppressed)
+        # ... and the stage-A migrate hop rides the same rule
+        broken_a = src.replace(
+            "                pf = ha.migrate_prefill(\n"
+            "                    toks, max_new_tokens=max_new_tokens,\n"
+            "                    timeout_ms=deadline_budget(), "
+            "tenant=tenant,\n",
+            "                pf = ha.migrate_prefill(\n"
+            "                    toks, max_new_tokens=max_new_tokens,\n"
+            "                    tenant=tenant,\n", 1)
+        assert broken_a != src
+        r2 = run({p: broken_a}, rules=["deadline-propagation"])
+        assert any("forwards without it" in f.message
+                   for f in r2.unsuppressed)
+
+    def test_migrate_path_adds_no_terminal_reason(self):
+        """The migrate contract mirrors the swap contract: kv.migrate
+        failures DEGRADE to recompute on the decode host, never shed —
+        the one taxonomy must not grow a migrate reason, and the
+        tempting-but-wrong typed shed stays gated."""
+        tracing_path = os.path.join(SERVING, "tracing.py")
+        with open(tracing_path) as f:
+            tsrc = f.read()
+        taxonomy = tsrc.split("TERMINAL_REASONS")[1].split(")")[0]
+        assert "migrate" not in taxonomy
+        sources = self._serving_sources()
+        adm = os.path.join(SERVING, "admission.py")
+        broken = dict(sources)
+        broken[adm] = sources[adm] + '''
+
+class KvMigrateFailedError(RejectedError):
+    def __init__(self, msg):
+        super().__init__(msg, "migrate_failed")
+'''
+        r = analyze_sources(broken, rules=["taxonomy-drift"])
+        assert any("KvMigrateFailedError" in f.message
+                   for f in r.unsuppressed)
+        # and the live tree carries no migrate-flavored drift
+        clean = analyze_sources(sources, rules=["taxonomy-drift"])
+        assert [f for f in clean.unsuppressed
+                if "migrate" in f.message.lower()] == []
 
 
 # --------------------------------------------------------------------------

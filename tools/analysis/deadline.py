@@ -36,8 +36,12 @@ from tools.analysis.core import (
 #: Final callee names that forward a request/dispatch to another
 #: component. ``submit`` covers both engines and the front door;
 #: ``submit_infer``/``submit_generate`` are the HostHandle RPC seam;
-#: ``admit`` is the admission hop that stamps the deadline.
-FORWARD_CALLEES = {"submit", "submit_infer", "submit_generate", "admit"}
+#: ``admit`` is the admission hop that stamps the deadline;
+#: ``migrate_prefill``/``submit_migrated`` are the two-stage
+#: disaggregated dispatch (serving/disagg.py over the kv.migrate
+#: endpoint) — the budget must shrink across BOTH stages, never reset.
+FORWARD_CALLEES = {"submit", "submit_infer", "submit_generate", "admit",
+                   "migrate_prefill", "submit_migrated"}
 
 DEADLINE_MARKERS = ("timeout", "deadline")
 
