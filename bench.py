@@ -176,6 +176,7 @@ def decode_leg(on_tpu: bool) -> dict:
                 slots * contig_stream_bytes // paged_stream_bytes)
                 if measured else None,
             "paged_grid": paged_decode_grid(on_tpu),
+            "speculative": speculative_grid(on_tpu),
             "shared_prefix": shared_prefix_scenario(on_tpu),
             "occupancy": occupancy_leg(on_tpu),
         }
@@ -303,6 +304,93 @@ def paged_decode_grid(on_tpu: bool) -> dict:
         "max_new_tokens": max_new,
         "kv_bytes_per_stream_contiguous_fp": contig_stream_bytes,
         "cells": grid,
+    }
+
+
+def speculative_grid(on_tpu: bool) -> dict:
+    """Speculative decoding tokens/sec vs k (ISSUE 17): the SAME staggered
+    mix as :func:`paged_decode_grid`, through k in {0, 2, 4, 8} x {gather,
+    fused} x {float32, int8}. k=0 is the plain engine (``speculative=
+    None``) — the per-(route, dtype) baseline the k>0 cells must beat.
+
+    The draft is a 1-layer model at half the target's width, so its
+    per-proposal cost is a fraction of a target decode step — the real
+    deployment economics. To pin the acceptance regime the grid zeroes
+    ``lm_head`` in BOTH models: logits are identically 0, greedy sampling
+    picks the same argmax on both sides, and acceptance is deterministically
+    1.0 — the ceiling cells show the pure scheduling win (one verify
+    commits k tokens), while ``acceptance_rate`` in each cell keeps the
+    headline honest about the regime it was measured in. Determinism means
+    the grid needs no warm-up repetitions to be reproducible."""
+    from deeplearning4j_tpu.models import TransformerConfig, init_params
+    from deeplearning4j_tpu.serving import GenerationEngine, SpecConfig
+
+    if on_tpu:
+        cfg = TransformerConfig(causal=True, remat=False,
+                                attention_impl="flash")
+        dcfg = TransformerConfig(hidden=cfg.hidden // 2, layers=1,
+                                 heads=cfg.heads, mlp_dim=cfg.mlp_dim // 2,
+                                 vocab_size=cfg.vocab_size,
+                                 max_seq=cfg.max_seq, causal=True,
+                                 remat=False, attention_impl="flash")
+        slots, max_len, n_requests, max_new = 16, 512, 32, 64
+    else:                                   # CPU smoke (driver runs TPU)
+        # the draft/target cost gap is the whole economics: a 1-layer
+        # thin draft against a deep target, so k cheap proposals replace
+        # k expensive decode dispatches with ONE (k+1)-position verify
+        cfg = TransformerConfig(vocab_size=1024, hidden=256, layers=4,
+                                heads=4, mlp_dim=1024, max_seq=128,
+                                dtype=jnp.float32, causal=True, remat=False)
+        dcfg = TransformerConfig(vocab_size=1024, hidden=32, layers=1,
+                                 heads=2, mlp_dim=64, max_seq=128,
+                                 dtype=jnp.float32, causal=True,
+                                 remat=False)
+        slots, max_len, n_requests, max_new = 2, 64, 4, 24
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dparams = init_params(jax.random.PRNGKey(1), dcfg)
+    # acceptance-1.0 regime: identical (zero) logits on both sides
+    params = {**params, "lm_head": jnp.zeros_like(params["lm_head"])}
+    dparams = {**dparams, "lm_head": jnp.zeros_like(dparams["lm_head"])}
+
+    def cell(k: int, kv_dtype: str, paged_attention: str) -> dict:
+        spec = SpecConfig(dparams, dcfg, k=k) if k > 0 else None
+        with GenerationEngine(params, cfg, slots=slots, max_len=max_len,
+                              kv_dtype=kv_dtype,
+                              paged_attention=paged_attention,
+                              queue_capacity=n_requests + slots,
+                              speculative=spec) as eng:
+            stats, _ = _run_decode_mix(eng, cfg, n_requests, max_new)
+            m = eng.metrics
+            return {
+                "k": k, "kv_dtype": kv_dtype,
+                "paged_attention": paged_attention,
+                "tokens_per_sec": stats["end_to_end_tokens_per_sec"],
+                "decode_steps_total": m.decode_steps_total.value,
+                "acceptance_rate": round(m.spec_acceptance_rate.value, 4)
+                    if k > 0 else None,
+                "compiled_signatures": stats["compiled_signatures"],
+                "signature_bound": len(eng.buckets) + (2 if k > 0 else 1),
+                "draft_compiled_signatures":
+                    eng.draft_compiled_signatures(),
+            }
+
+    grid = [cell(k, kv, pa) for kv in ("float32", "int8")
+            for pa in ("gather", "fused") for k in (0, 2, 4, 8)]
+    # the ISSUE acceptance gate: at least one k>0 cell beats its own
+    # (route, dtype) k=0 baseline on tokens/sec at high acceptance
+    base = {(c["kv_dtype"], c["paged_attention"]): c["tokens_per_sec"]
+            for c in grid if c["k"] == 0}
+    speedups = [round(c["tokens_per_sec"]
+                      / base[(c["kv_dtype"], c["paged_attention"])], 3)
+                for c in grid if c["k"] > 0]
+    return {
+        "slots": slots, "max_len": max_len, "requests": n_requests,
+        "max_new_tokens": max_new,
+        "draft": {"hidden": dcfg.hidden, "layers": dcfg.layers,
+                  "mlp_dim": dcfg.mlp_dim},
+        "cells": grid,
+        "best_speedup_vs_k0": max(speedups) if speedups else None,
     }
 
 

@@ -1173,3 +1173,397 @@ def make_paged_decode_step(cfg: TransformerConfig, block_size: int,
         decode_step, donate_argnums=(1,),
         in_shardings=(param_sh, cache_sh) + (repl,) * 9,
         out_shardings=(cache_sh, repl))
+
+
+# --------------------------------------------------------------------------
+# Speculative decoding: draft-model executables + k-token verify step
+# --------------------------------------------------------------------------
+#
+# Speculative decoding (Leviathan et al., ICML'23) amortizes decode's
+# memory-bandwidth cost: a small DRAFT model proposes k tokens one at a
+# time (cheap — its whole KV stream is tiny), then the target model scores
+# all k+1 positions in ONE fixed-shape verify step and commits the longest
+# proposal prefix its own sampling agrees with. The adaptation here is
+# exact-match verification against the target's OWN deterministic samples:
+# every token of a stream is already a pure function of (request key, token
+# index) via ``_sample_at``, so the verify step computes the target's
+# samples g_0..g_k at the k+1 positions and acceptance only decides HOW
+# MANY of them commit this turn — the emitted values are ALWAYS the
+# target's, so a speculative stream is bitwise the non-speculative one at
+# ANY temperature, not just greedy. Speedup comes from acceptance, never
+# from changed sampling.
+#
+# The draft model keeps a CONTIGUOUS (slots, max_len) cache with NO
+# device-side lengths — the scheduler passes lengths per call, so
+# rewinding a rejected tail after verify is host arithmetic, not a device
+# op. Both factories preserve the one-donated-executable discipline: one
+# draft step, one verify step, for the engine's lifetime.
+
+
+def init_draft_kv_cache(cfg: TransformerConfig, slots: int, max_len: int,
+                        dtype: Any = None) -> Dict[str, Any]:
+    """Allocate the draft model's contiguous KV cache: the legacy
+    (slots, max_len, heads, head_dim) layout WITHOUT the device-side
+    ``lengths`` leaf — draft positions are host-tracked so the serving
+    scheduler can rewind a rejected speculation tail for free (the next
+    turn simply passes a smaller length and overwrites)."""
+    if max_len > cfg.max_seq:
+        raise ValueError(
+            f"max_len {max_len} exceeds the draft model's positional "
+            f"table max_seq={cfg.max_seq}")
+    dt = cfg.dtype if dtype is None else dtype
+    shape = (slots, max_len, cfg.heads, cfg.head_dim)
+    return {"layers": [{"k": jnp.zeros(shape, dt),
+                        "v": jnp.zeros(shape, dt)}
+                       for _ in range(cfg.layers)]}
+
+
+def draft_kv_cache_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs for the draft cache: identical head-over-'model'
+    layout as :func:`kv_cache_pspecs`, minus the lengths leaf."""
+    kv = P(None, None, MODEL_AXIS, None)
+    return {"layers": [{"k": kv, "v": kv} for _ in range(cfg.layers)]}
+
+
+def place_draft_kv_cache(cache, cfg: TransformerConfig, mesh: Mesh):
+    """Shard a draft KV cache onto ``mesh`` per
+    :func:`draft_kv_cache_pspecs` (heads over the 'model' axis)."""
+    return jax.device_put(cache,
+                          tree_shardings(mesh, draft_kv_cache_pspecs(cfg)))
+
+
+def make_draft_prefill(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+    """Build the jitted draft prefill: one PADDED prompt through the draft
+    model's standard forward, its per-layer K/V written into cache slot
+    ``slot``. ``draft_prefill(params, cache, tokens, slot) -> cache`` with
+    tokens (1, T_bucket) int32. No sampling — the draft's first proposal
+    is drawn by :func:`make_draft_step` feeding the target's last sampled
+    token. One executable per T bucket (the engine reuses its prompt
+    ladder); the cache is donated. Padding K/V past the real prompt lands
+    in the slot row but is masked by every later draft step's causal mask,
+    exactly the contiguous target layout's convention."""
+    if not cfg.causal:
+        raise ValueError("speculative drafting needs a causal LM: set "
+                         "TransformerConfig(causal=True)")
+
+    def draft_prefill(params, cache, tokens, slot):
+        _, T = tokens.shape
+        slot = jnp.asarray(slot, jnp.int32)
+        z = jnp.zeros((), jnp.int32)
+        with jax.default_matmul_precision("default"):
+            x = params["tok_emb"][tokens].astype(cfg.dtype) \
+                + params["pos_emb"][:T][None].astype(cfg.dtype)
+            layers = []
+            for bp, lc in zip(params["blocks"], cache["layers"]):
+                x, k, v = _block(bp, x, cfg, mesh, return_kv=True)
+                layers.append({
+                    "k": lax.dynamic_update_slice(
+                        lc["k"], k.astype(lc["k"].dtype), (slot, z, z, z)),
+                    "v": lax.dynamic_update_slice(
+                        lc["v"], v.astype(lc["v"].dtype), (slot, z, z, z)),
+                })
+        return {"layers": layers}
+
+    if mesh is None:
+        return jax.jit(draft_prefill, donate_argnums=(1,))
+    param_sh = _shardings(cfg, mesh)
+    cache_sh = tree_shardings(mesh, draft_kv_cache_pspecs(cfg))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        draft_prefill, donate_argnums=(1,),
+        in_shardings=(param_sh, cache_sh, repl, repl),
+        out_shardings=cache_sh)
+
+
+def make_draft_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+    """Build THE draft decode executable: one proposed token per slot.
+
+    ``draft_step(params, cache, tokens, lengths, keys, steps,
+    temperatures, top_ks) -> (cache, proposals)`` — the contiguous
+    :func:`make_decode_step` math with ``lengths`` passed from the HOST
+    (the draft cache has no device lengths and no ``live`` mask: dead or
+    draft-cold slots compute masked garbage the scheduler ignores). The
+    scheduler invokes this executable k times per speculative turn, each
+    call feeding the previous proposal at the next position; ``steps``
+    carries the TARGET token index each proposal predicts, so the gumbel
+    draw folds the exact key/step the verify step will fold — a draft
+    whose logits track the target's proposes the target's own sample with
+    high probability even at temperature > 0. Shape is (slots,) always,
+    so this compiles EXACTLY ONCE; the cache is donated."""
+    if not cfg.causal:
+        raise ValueError("speculative drafting needs a causal LM: set "
+                         "TransformerConfig(causal=True)")
+
+    def draft_block(bp, x, lc, pos):
+        S, H = x.shape
+        L = lc["k"].shape[1]
+        h = _layernorm(x, bp["ln1"])
+        qkv = h @ bp["qkv"]["kernel"].astype(h.dtype) \
+            + bp["qkv"]["bias"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(S, cfg.heads, cfg.head_dim)
+        rows = jnp.arange(S)
+        ck = lc["k"].at[rows, pos].set(
+            k.reshape(S, cfg.heads, cfg.head_dim).astype(lc["k"].dtype))
+        cv = lc["v"].at[rows, pos].set(
+            v.reshape(S, cfg.heads, cfg.head_dim).astype(lc["v"].dtype))
+        scale = 1.0 / np.sqrt(cfg.head_dim)
+        s = jnp.einsum("shd,slhd->shl", q, ck.astype(q.dtype)) * scale
+        mask = jnp.arange(L)[None, :] <= pos[:, None]
+        s = jnp.where(mask[:, None, :], s, jnp.finfo(s.dtype).min)
+        p = jax.nn.softmax(s.astype(cfg.softmax_dtype),
+                           axis=-1).astype(q.dtype)
+        o = jnp.einsum("shl,slhd->shd", p, cv.astype(p.dtype)).reshape(S, H)
+        x = x + o @ bp["attn_out"]["kernel"].astype(o.dtype) \
+            + bp["attn_out"]["bias"].astype(o.dtype)
+        h = _layernorm(x, bp["ln2"])
+        h = h @ bp["mlp_in"]["kernel"].astype(h.dtype) \
+            + bp["mlp_in"]["bias"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        x = x + h @ bp["mlp_out"]["kernel"].astype(h.dtype) \
+            + bp["mlp_out"]["bias"].astype(h.dtype)
+        return x, {"k": ck, "v": cv}
+
+    def draft_step(params, cache, tokens, lengths, keys, steps,
+                   temperatures, top_ks):
+        max_len = cache["layers"][0]["k"].shape[1]
+        pos = jnp.clip(lengths, 0, min(max_len, cfg.max_seq) - 1)
+        with jax.default_matmul_precision("default"):
+            x = params["tok_emb"][tokens].astype(cfg.dtype) \
+                + params["pos_emb"][pos].astype(cfg.dtype)
+            layers = []
+            for bp, lc in zip(params["blocks"], cache["layers"]):
+                x, lc = draft_block(bp, x, lc, pos)
+                layers.append(lc)
+            x = _layernorm(x, params["ln_f"])
+            logits = (x @ params["lm_head"].astype(x.dtype)
+                      ).astype(jnp.float32)
+        proposals = jax.vmap(_sample_at)(logits, keys, steps,
+                                         temperatures, top_ks)
+        return {"layers": layers}, proposals
+
+    if mesh is None:
+        return jax.jit(draft_step, donate_argnums=(1,))
+    param_sh = _shardings(cfg, mesh)
+    cache_sh = tree_shardings(mesh, draft_kv_cache_pspecs(cfg))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        draft_step, donate_argnums=(1,),
+        in_shardings=(param_sh, cache_sh) + (repl,) * 6,
+        out_shardings=(cache_sh, repl))
+
+
+def make_verify_step(cfg: TransformerConfig, block_size: int, k: int,
+                     mesh: Optional[Mesh] = None,
+                     kv_dtype: str = "float32",
+                     paged_attention: str = "gather"):
+    """Build THE speculative verify executable: score k+1 positions per
+    slot in one step and count the accepted proposal prefix on device.
+
+    ``verify_step(params, cache, tables, lengths, tokens, keys, steps,
+    temperatures, top_ks, cow_src, cow_dst) -> (cache, samples,
+    accepted)`` — :func:`make_paged_decode_step` extended from one query
+    per slot to ``k + 1``: ``tokens`` is (slots, k+1) int32 with column 0
+    the slot's last committed token and columns 1..k the draft proposals
+    d_1..d_k; K/V for ALL k+1 tokens are written at positions length..
+    length+k, each query position length+j attends its own causal prefix
+    (positions <= length+j), and ``samples[:, j]`` is the TARGET's own
+    deterministic sample for token index ``steps + j`` — per-position
+    attention reuses the single-query decode math exactly, so
+    ``samples[:, j]`` is bitwise what ``decode_step`` would have sampled
+    at that point given the same history. ``accepted[:, ]`` counts the
+    longest prefix with ``tokens[:, j+1] == samples[:, j]`` — the
+    rejection-sampling acceptance under deterministic gumbel-max
+    (exact-match, temperature-independent). The scheduler commits
+    ``min(accepted+1, k)`` of the samples; position length+accepted+1's
+    K/V (a rejected proposal's) is overwritten by the next turn's write
+    at the new length, the same convention a dead slot's garbage follows.
+
+    Writes that would land past the pool capacity or ``cfg.max_seq`` are
+    routed to the reserved scratch block 0 instead of clamping — a
+    clamped scatter near the boundary would collide multiple positions
+    onto a LIVE block entry and corrupt committed K/V; scratch-routing
+    keeps dead/overflow garbage where dead-slot garbage already lives.
+    Dead slots compute masked garbage across all k+1 positions exactly as
+    they do in decode_step. Both attention routes (``"gather"`` and the
+    fused Pallas kernel — invoked once per query position inside the SAME
+    executable) and both ``kv_dtype`` modes are supported; every argument
+    is fixed-shape, so this compiles EXACTLY ONCE per engine lifetime and
+    the engine's executable bound grows to buckets + 2 (prefill ladder +
+    decode + verify)."""
+    if not cfg.causal:
+        raise ValueError("generation needs a causal LM: set "
+                         "TransformerConfig(causal=True)")
+    if k < 1:
+        raise ValueError(
+            f"verify needs k >= 1 proposed tokens per turn, got {k} — "
+            "k == 0 IS the plain decode_step; the engine falls back to "
+            "it rather than minting a degenerate verify executable")
+    validate_kv_dtype(kv_dtype, block_size)
+    if paged_attention not in ("gather", "fused"):
+        raise ValueError(
+            f"paged_attention must be 'gather' or 'fused', "
+            f"got {paged_attention!r}")
+    T = k + 1
+    quantized = kv_dtype == "int8"
+    mesh_spec = None
+    if paged_attention == "fused" and mesh is not None:
+        mesh_spec = _paged_attention_mesh_spec(cfg, mesh)
+        if mesh_spec is None:
+            raise ValueError(
+                f"paged_attention='fused' cannot shard {cfg.heads} heads "
+                f"over the mesh's {mesh.shape.get(MODEL_AXIS, 1)}-way "
+                f"'{MODEL_AXIS}' axis; use paged_attention='gather' or a "
+                "dividing mesh")
+
+    def _fused_attention(q, ck, cv, cks, cvs, tables, pos, scale):
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            paged_decode_attention)
+        interp = jax.default_backend() != "tpu"
+
+        def _local(ql, kl, vl, tb, ps, *scales):
+            ksl, vsl = scales if quantized else (None, None)
+            return paged_decode_attention(
+                ql, kl, vl, tb, ps, block_size=block_size, scale=scale,
+                k_scale=ksl, v_scale=vsl, interpret=interp)
+
+        if mesh is None:
+            return _local(q, ck, cv, tables, pos,
+                          *((cks, cvs) if quantized else ()))
+        ms = mesh_spec
+        in_specs = (ms["q"], ms["pool"], ms["pool"], ms["repl"],
+                    ms["repl"]) + ((ms["scale"],) * 2 if quantized else ())
+        return shard_map(_local, mesh=mesh, in_specs=in_specs,
+                         out_specs=ms["q"], check_rep=False)(
+            q, ck, cv, tables, pos,
+            *((cks, cvs) if quantized else ()))
+
+    def verify_block(bp, x, lc, tables, pos, cow_src, cow_dst):
+        # x: (S, T, hidden); lc pool tensors: (NB, B, heads, D); pos:
+        # (S,) the FIRST write position (== current length, clamped).
+        # CoW first, then all T K/V writes, then the per-position
+        # attention reads — data dependence orders them.
+        S, _T, H = x.shape
+        nb = tables.shape[1]
+        L = nb * block_size
+        Lcap = min(L, cfg.max_seq)
+        h = _layernorm(x, bp["ln1"])
+        qkv = h @ bp["qkv"]["kernel"].astype(h.dtype) \
+            + bp["qkv"]["bias"].astype(h.dtype)
+        q, kx, vx = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(S, T, cfg.heads, cfg.head_dim)
+        rows = jnp.arange(S)
+        ck = lc["k"].at[cow_dst].set(lc["k"][cow_src])
+        cv = lc["v"].at[cow_dst].set(lc["v"][cow_src])
+        # (S, T) write positions; overflow routes to the scratch block —
+        # NOT a clamp: a clamped position would scatter-collide onto a
+        # live block entry and corrupt committed K/V near the boundary
+        posm = pos[:, None] + jnp.arange(T, dtype=pos.dtype)[None, :]
+        valid = posm < Lcap
+        blk = jnp.minimum(posm, L - 1) // block_size
+        off = posm % block_size
+        pb = jnp.where(valid, tables[rows[:, None], blk], 0)
+        cks = cvs = None
+        if quantized:
+            cks = lc["k_scale"].at[cow_dst].set(lc["k_scale"][cow_src])
+            cvs = lc["v_scale"].at[cow_dst].set(lc["v_scale"][cow_src])
+            kq, ks = quantize_kv(
+                kx.reshape(S, T, cfg.heads, cfg.head_dim))
+            vq, vs = quantize_kv(
+                vx.reshape(S, T, cfg.heads, cfg.head_dim))
+            ck = ck.at[pb, off].set(kq)
+            cv = cv.at[pb, off].set(vq)
+            cks = cks.at[pb, off].set(ks)
+            cvs = cvs.at[pb, off].set(vs)
+        else:
+            ck = ck.at[pb, off].set(
+                kx.reshape(S, T, cfg.heads, cfg.head_dim).astype(ck.dtype))
+            cv = cv.at[pb, off].set(
+                vx.reshape(S, T, cfg.heads, cfg.head_dim).astype(cv.dtype))
+        scale = 1.0 / np.sqrt(cfg.head_dim)
+        if paged_attention == "fused":
+            outs = [
+                _fused_attention(
+                    q[:, j], ck, cv, cks, cvs, tables,
+                    jnp.minimum(pos + j, Lcap - 1), scale)
+                for j in range(T)]
+            o = jnp.stack(outs, axis=1).reshape(S, T, H).astype(x.dtype)
+        else:
+            gk = ck[tables].reshape(S, L, cfg.heads, cfg.head_dim)
+            gv = cv[tables].reshape(S, L, cfg.heads, cfg.head_dim)
+            if quantized:
+                gks = cks[tables].reshape(S, L, cfg.heads)
+                gvs = cvs[tables].reshape(S, L, cfg.heads)
+                gk = (gk.astype(jnp.float32)
+                      * gks[..., None]).astype(q.dtype)
+                gv = (gv.astype(jnp.float32)
+                      * gvs[..., None]).astype(q.dtype)
+            # one single-query attention per position — the EXACT einsum
+            # shapes decode_step compiles, so each position's output (and
+            # therefore its sample) is bitwise the sequential decode's
+            outs = []
+            for j in range(T):
+                pj = jnp.minimum(pos + j, Lcap - 1)
+                s = jnp.einsum("shd,slhd->shl", q[:, j],
+                               gk.astype(q.dtype)) * scale
+                mask = jnp.arange(L)[None, :] <= pj[:, None]
+                s = jnp.where(mask[:, None, :], s, jnp.finfo(s.dtype).min)
+                p = jax.nn.softmax(s.astype(cfg.softmax_dtype),
+                                   axis=-1).astype(q.dtype)
+                outs.append(jnp.einsum("shl,slhd->shd", p,
+                                       gv.astype(p.dtype)))
+            o = jnp.stack(outs, axis=1).reshape(S, T, H)
+        x = x + o @ bp["attn_out"]["kernel"].astype(o.dtype) \
+            + bp["attn_out"]["bias"].astype(o.dtype)
+        h = _layernorm(x, bp["ln2"])
+        h = h @ bp["mlp_in"]["kernel"].astype(h.dtype) \
+            + bp["mlp_in"]["bias"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        x = x + h @ bp["mlp_out"]["kernel"].astype(h.dtype) \
+            + bp["mlp_out"]["bias"].astype(h.dtype)
+        out = {"k": ck, "v": cv}
+        if quantized:
+            out.update(k_scale=cks, v_scale=cvs)
+        return x, out
+
+    def verify_step(params, cache, tables, lengths, tokens, keys, steps,
+                    temperatures, top_ks, cow_src, cow_dst):
+        L = tables.shape[1] * block_size
+        Lcap = min(L, cfg.max_seq)
+        pos = jnp.clip(lengths, 0, Lcap - 1)
+        posm = jnp.minimum(
+            pos[:, None] + jnp.arange(T, dtype=pos.dtype)[None, :],
+            Lcap - 1)
+        with jax.default_matmul_precision("default"):
+            x = params["tok_emb"][tokens].astype(cfg.dtype) \
+                + params["pos_emb"][posm].astype(cfg.dtype)
+            layers = []
+            for bp, lc in zip(params["blocks"], cache["layers"]):
+                x, lc = verify_block(bp, x, lc, tables, pos, cow_src,
+                                     cow_dst)
+                layers.append(lc)
+            x = _layernorm(x, params["ln_f"])
+            logits = (x @ params["lm_head"].astype(x.dtype)
+                      ).astype(jnp.float32)
+
+        def _sample_row(lg, key, step0, temperature, top_k):
+            st = step0 + jnp.arange(T, dtype=jnp.int32)
+            return jax.vmap(
+                lambda l, s: _sample_at(l, key, s, temperature, top_k)
+            )(lg, st)
+
+        samples = jax.vmap(_sample_row)(logits, keys, steps,
+                                        temperatures, top_ks)
+        matches = (tokens[:, 1:] == samples[:, :k]).astype(jnp.int32)
+        accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+        return {"layers": layers}, samples, accepted.astype(jnp.int32)
+
+    if mesh is None:
+        return jax.jit(verify_step, donate_argnums=(1,))
+    param_sh = _shardings(cfg, mesh)
+    cache_sh = tree_shardings(mesh, paged_kv_cache_pspecs(cfg, kv_dtype))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        verify_step, donate_argnums=(1,),
+        in_shardings=(param_sh, cache_sh) + (repl,) * 9,
+        out_shardings=(cache_sh, repl, repl))

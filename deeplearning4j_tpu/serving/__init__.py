@@ -31,7 +31,7 @@ from deeplearning4j_tpu.serving.faults import (  # noqa: F401
     FaultInjectedError, FaultPlan, inject,
 )
 from deeplearning4j_tpu.serving.generation import (  # noqa: F401
-    GenerationEngine, GenerationHandle, client_stream_handle,
+    GenerationEngine, GenerationHandle, SpecConfig, client_stream_handle,
     prefill_buckets,
 )
 from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
@@ -46,8 +46,8 @@ from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     CausalLMAdapter, Deployment, ModelAdapter, ModelRegistry, as_adapter,
 )
 from deeplearning4j_tpu.serving.qos import (  # noqa: F401
-    DEFAULT_TENANT, PRIORITIES, QosPolicy, SloBurnGovernor, TenantPolicy,
-    TenantQueues, TokenBucket,
+    DEFAULT_TENANT, PRIORITIES, QosPolicy, SloBurnGovernor,
+    SpecAcceptanceGovernor, TenantPolicy, TenantQueues, TokenBucket,
 )
 from deeplearning4j_tpu.serving.resilience import (  # noqa: F401
     CircuitBreaker, CircuitOpenError, PoisonedResultError,
@@ -68,14 +68,15 @@ __all__ = [
     "SharedPrefix", "SwapEntry",
     "blocks_for_tokens", "kv_bytes_per_token", "PreemptedError",
     "Deployment", "ModelAdapter", "ModelRegistry", "as_adapter",
-    "GenerationEngine", "GenerationHandle", "prefill_buckets",
+    "GenerationEngine", "GenerationHandle", "SpecConfig", "prefill_buckets",
     "CausalLMAdapter", "FaultPlan", "FaultInjectedError", "inject",
     "RetryPolicy", "CircuitBreaker", "Watchdog", "CircuitOpenError",
     "PoisonedResultError", "ResilientEngineMixin", "WatchdogTimeoutError",
     "Tracer", "RequestTrace", "FlightRecorder", "flight_recorder",
     "default_tracer", "all_tracers", "terminal_reason", "tracing",
     "QosPolicy", "TenantPolicy", "TenantQueues", "TokenBucket",
-    "SloBurnGovernor", "DEFAULT_TENANT", "PRIORITIES",
+    "SloBurnGovernor", "SpecAcceptanceGovernor", "DEFAULT_TENANT",
+    "PRIORITIES",
     "QuotaExceededError", "SloShedError", "RetryBudget",
     "RetryBudgetExhaustedError",
     "ClusterCapacityError", "HostUnavailableError", "ClusterDirectory",

@@ -53,6 +53,26 @@ recompute-on-resume, it never sheds the request:
   (fail → the blocks are freed back and the stream re-prefills; either
   way the resumed stream is bitwise the uninterrupted one).
 
+Speculative decoding (serving/generation.py ``speculative=SpecConfig``)
+adds three seeded points on the draft+verify turn. The DRAFT-side two
+carry the DEGRADE contract — the draft model is optional work, so a
+fired fault degrades the stream to plain decode (acceptance-zero /
+fallback turns), counts ``spec_fallbacks_total``, feeds the draft
+breaker, and NEVER sheds or stalls the stream; the verify step is the
+target model itself, so its faults keep decode_step's retry-then-
+fail-tenants semantics:
+
+- ``generation.draft_prefill`` — seating a fresh stream's prompt in the
+  draft cache (fail → the slot stays draft-cold: it still rides verify
+  turns, its garbage proposals simply never match);
+- ``generation.draft_step``    — each of the k per-turn draft proposals
+  (fail → this turn and the slots' warmth degrade to plain decode; the
+  draft breaker opening stops further attempts until cooldown);
+- ``generation.verify_step``   — the k+1-position target verify (typed
+  transient faults raise BEFORE the donated call and retry like
+  decode_step; real failures take the fail-tenants + rebuild path,
+  stamped with this point in the crash dump).
+
 Cross-host KV page migration (serving/disagg.py + the ``kv.migrate``
 RPC endpoint) extends the same DEGRADE contract across hosts — a fired
 fault falls back to recompute on the DECODE host, it never sheds:

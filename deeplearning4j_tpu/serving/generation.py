@@ -63,7 +63,7 @@ from deeplearning4j_tpu.serving.paging import (
     blocks_for_tokens, kv_bytes_per_token,
 )
 from deeplearning4j_tpu.serving.qos import (
-    PRIORITIES, SloBurnGovernor, resolve_qos,
+    PRIORITIES, SloBurnGovernor, SpecAcceptanceGovernor, resolve_qos,
 )
 from deeplearning4j_tpu.serving.resilience import (
     CircuitBreaker, ResilientEngineMixin, RetryPolicy, WatchdogTimeoutError,
@@ -203,6 +203,44 @@ class GenerationHandle:
             return False
 
 
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decoding engine mode (Leviathan et al., ICML'23):
+    a small DRAFT model proposes ``k`` tokens per scheduler turn and the
+    target model verifies all of them in ONE fixed-shape
+    ``make_verify_step`` executable, committing the longest proposal
+    prefix that matches the target's own deterministic samples.
+
+    Because every token of a stream is already a pure function of
+    (request key, token index), the verify step computes the TARGET's
+    samples at the k+1 scored positions and acceptance only decides how
+    MANY commit per turn — a speculative stream is bitwise the
+    non-speculative one at any temperature (``speculative=None`` and any
+    ``SpecConfig`` emit identical tokens; only throughput differs).
+
+    ``draft_params``/``draft_cfg`` are the draft model (must share the
+    target's vocab and cover the engine's ``max_len`` positions);
+    ``k`` is the proposals per turn (the verify executable scores k+1
+    positions). ``min_acceptance`` > 0 arms the per-tenant
+    :class:`~deeplearning4j_tpu.serving.qos.SpecAcceptanceGovernor`:
+    a tenant whose observed draft-acceptance rate stays below it after
+    ``min_proposed`` proposals is demoted to k=0 (plain per-turn
+    advancement) instead of paying verify overhead its traffic keeps
+    rejecting."""
+
+    draft_params: Any
+    draft_cfg: Any
+    k: int = 4
+    min_acceptance: float = 0.0
+    min_proposed: int = 256
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(
+                f"SpecConfig.k must be >= 1 (k == 0 IS plain decode — "
+                f"pass speculative=None), got {self.k}")
+
+
 @dataclasses.dataclass
 class _Slot:
     """Scheduler-side state of one occupied cache slot."""
@@ -230,6 +268,13 @@ class _Slot:
     # recompute-on-resume seating: TTFT/prefix-hit accounting already
     # happened on the first seating and must not double-count
     resumed: bool = False
+    # speculative decoding: count of stream positions whose K/V the
+    # DRAFT cache holds valid. The slot is draft-WARM (eligible to
+    # speculate) iff draft_len == length at turn start; -1 marks
+    # draft-cold (never draft-seated, draft crashed, or the stream
+    # advanced through a plain turn) — cold slots still ride spec turns
+    # correctly, their garbage proposals just never match
+    draft_len: int = -1
 
 
 class GenerationEngine(ResilientEngineMixin):
@@ -294,6 +339,18 @@ class GenerationEngine(ResilientEngineMixin):
       becomes a mid-stream condition too; a victim that can no longer
       ever be resumed sheds typed ``'preempted'``.
 
+    ``speculative`` (a :class:`SpecConfig`; paged only) turns each
+    scheduler turn into draft×k + ONE k+1-position verify: the draft
+    model proposes, the target commits the prefix matching its own
+    deterministic samples, and per-slot lengths advance by the accepted
+    count — bitwise identical streams at any k and temperature, faster
+    exactly when drafts are accepted. The draft has its own breaker:
+    draft faults DEGRADE the turn to plain decode (never shed, never
+    stall), and ``min_acceptance`` > 0 demotes low-acceptance tenants to
+    k=0 via the qos acceptance governor. Executable bound grows to
+    ``len(self.buckets) + 2`` target-side plus ``len(self.buckets) + 1``
+    draft-side. Default None — the exact plain path.
+
     ``prefix_cache_blocks`` > 0 (paged only) enables the AUTOMATIC
     prefix cache (SGLang RadixAttention's policy): retired streams'
     full blocks are retained in a bounded LRU (at most this many
@@ -328,6 +385,7 @@ class GenerationEngine(ResilientEngineMixin):
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  retry_budget=None, qos=None,
+                 speculative: Optional[SpecConfig] = None,
                  watchdog_timeout_ms: Optional[float] = None,
                  tracer=None, recorder=None, screen_outputs: bool = True,
                  name: str = "generation"):
@@ -483,6 +541,60 @@ class GenerationEngine(ResilientEngineMixin):
         # stream-side analogue of PR 6's _pending_prefix_demand). The
         # reservation binds same-or-lower classes only — see _plan_blocks
         self._block_waiter: Optional[Tuple[Request, int, str]] = None
+        # speculative decoding (SpecConfig): draft executables + THE
+        # verify step, a draft-only breaker (degrade-to-plain, never
+        # shed), and the per-tenant acceptance governor. speculative=None
+        # keeps the exact plain path — bitwise-inert by construction
+        # (verify commits only the target's own samples), guarded by the
+        # parity suite
+        self._spec = speculative
+        self._spec_force_plain = False   # warmup: compile the fallback
+        if speculative is not None:
+            from deeplearning4j_tpu.models.bert import (
+                init_draft_kv_cache, make_draft_prefill, make_draft_step,
+                make_verify_step, place_draft_kv_cache)
+
+            if not self.paged:
+                raise ValueError(
+                    "speculative decoding requires the paged KV cache "
+                    "(GenerationEngine(paged=True)) — the verify step is "
+                    "a paged executable")
+            dcfg = speculative.draft_cfg
+            if not dcfg.causal:
+                raise ValueError(
+                    "the draft model must be causal: TransformerConfig("
+                    "causal=True)")
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size} — proposals are fed to the target "
+                    "as token ids, the vocabularies must be shared")
+            if dcfg.max_seq < self.max_len:
+                raise ValueError(
+                    f"draft max_seq {dcfg.max_seq} < engine max_len "
+                    f"{self.max_len} — the draft must cover every prompt "
+                    "bucket position")
+            dparams = speculative.draft_params
+            if mesh is not None:
+                from deeplearning4j_tpu.models.bert import place_params
+                dparams = place_params(dparams, dcfg, mesh)
+            self._draft_params = dparams
+            self._draft_cfg = dcfg
+            # the draft writes K/V up to position length + k - 1; clamp
+            # to its positional table (near-the-end proposals degrade to
+            # garbage → acceptance 0, never wrong tokens)
+            self._draft_max_len = min(self.max_len + speculative.k,
+                                      dcfg.max_seq)
+            self._init_draft_cache = init_draft_kv_cache
+            self._place_draft_cache = place_draft_kv_cache
+            self._draft_prefill = make_draft_prefill(dcfg, mesh)
+            self._draft_step = make_draft_step(dcfg, mesh)
+            self._verify = make_verify_step(
+                cfg, self.block_size, speculative.k, mesh,
+                kv_dtype=self.kv_dtype, paged_attention=paged_attention)
+            self._draft_breaker = CircuitBreaker(name=f"{name}.draft")
+            self._spec_governor = SpecAcceptanceGovernor(
+                speculative.min_acceptance, speculative.min_proposed)
         self._slots: List[Optional[_Slot]] = [None] * slots
         self._reset_cache()
         # multi-tenant QoS (serving/qos.py): policy -> weighted-fair
@@ -922,6 +1034,22 @@ class GenerationEngine(ResilientEngineMixin):
             self.metrics.kv_pool_hbm_bytes.set(
                 self.num_blocks * self.kv_block_bytes)
             self._update_block_gauges()
+        if self._spec is not None:
+            # the draft cache rides the same rebuild: its contents only
+            # described the (now failed) tenants, and a fresh empty cache
+            # is one consistent state for the replacement scheduler
+            self._reset_draft_cache()
+
+    def _reset_draft_cache(self):
+        """(Re)allocate the speculative DRAFT model's contiguous KV cache
+        — called at construction, with every target-cache rebuild, and
+        after any draft-leg failure (draft calls donate this cache too).
+        Existing slots become draft-cold; the caller marks them."""
+        cache = self._init_draft_cache(self._draft_cfg, self.slots,
+                                       self._draft_max_len)
+        self._draft_cache = self._place_draft_cache(
+            cache, self._draft_cfg, self.mesh) \
+            if self.mesh is not None else cache
 
     @property
     def kv_block_bytes(self) -> int:
@@ -1017,8 +1145,13 @@ class GenerationEngine(ResilientEngineMixin):
                     try:
                         self._decode_iteration(epoch, buf)
                     except BaseException as e:   # fail tenants, keep thread
-                        self._on_device_failure(e, epoch,
-                                                point="generation.decode_step")
+                        # a speculative verify failure stamps its own
+                        # fault point — the crash dump must name the
+                        # executable that actually died
+                        self._on_device_failure(
+                            e, epoch,
+                            point=getattr(e, "fault_point",
+                                          "generation.decode_step"))
         finally:
             # queued requests are failed by _admission.close() itself;
             # current-epoch thread only — a staled zombie must not fail
@@ -2231,6 +2364,13 @@ class GenerationEngine(ResilientEngineMixin):
             if not registered and blocks is not None:
                 alloc.free(blocks)
                 state.blocks = None
+            if registered and self._spec is not None:
+                # warm the DRAFT cache for the freshly seated stream
+                # (scheduler thread — the draft prefill donates the draft
+                # cache like the decode loop donates the target's).
+                # DEGRADE contract: failure leaves the slot draft-cold
+                # (acceptance-zero speculation), never fails the stream
+                self._draft_seat(slot, state, padded, epoch)
         elif blocks is not None:
             # retired at token 0 (EOS / max_new_tokens=1): the slot was
             # never seated, return its reservation now
@@ -2238,6 +2378,39 @@ class GenerationEngine(ResilientEngineMixin):
             state.blocks = None
         if self.paged:
             self._update_block_gauges()
+
+    def _draft_seat(self, slot: int, state: _Slot, padded: np.ndarray,
+                    epoch: int):
+        """Draft-prefill a just-seated stream's prompt into the draft
+        cache (speculative engines only). Any failure takes the DEGRADE
+        path: the draft cache is rebuilt (the donated call may have
+        consumed it), every live slot goes draft-cold, and the stream
+        itself proceeds at plain speed — a dead draft never sheds."""
+        if padded.shape[1] > self._draft_cfg.max_seq:
+            return   # bucket exceeds the draft's positional table: cold
+        dcache = self._draft_cache
+        try:
+            new = self._donated_call(
+                "generation.draft_prefill", self._draft_prefill,
+                self._draft_params, dcache, padded, np.int32(slot))
+        except BaseException as e:
+            self._draft_breaker.record_failure()
+            self.metrics.spec_fallbacks_total.inc()
+            self._recorder.record("spec.draft_failure", engine=self.name,
+                                  point="generation.draft_prefill",
+                                  error=type(e).__name__)
+            with self._wd_lock:
+                if self._epoch == epoch:
+                    self._reset_draft_cache()
+                    for st in self._slots:
+                        if st is not None:
+                            st.draft_len = -1
+            return
+        with self._wd_lock:
+            if self._epoch != epoch:
+                return   # zombie: the replacement rebuilt its own cache
+            self._draft_cache = new
+            state.draft_len = state.length
 
     def _make_step_buffers(self) -> Dict[str, np.ndarray]:
         """Preallocate one scheduler thread's decode-step staging arrays
@@ -2257,6 +2430,9 @@ class GenerationEngine(ResilientEngineMixin):
         if self.paged:
             buf["tables"] = np.zeros((S, self.max_blocks_per_slot),
                                      np.int32)
+        if self._spec is not None:
+            buf["spec_tokens"] = np.zeros((S, self._spec.k + 1), np.int32)
+            buf["draft_feed"] = np.zeros(S, np.int32)
         return buf
 
     def _decode_iteration(self, epoch: int, buf: Dict[str, np.ndarray]):
@@ -2308,6 +2484,9 @@ class GenerationEngine(ResilientEngineMixin):
             if st.cow is not None:
                 cow_src[i], cow_dst[i] = st.cow
         self.metrics.slot_occupancy.set(n_live / S)
+        if self._spec is not None and not self._spec_force_plain \
+                and self._spec_turn(epoch, buf, states, n_live):
+            return
         t0 = time.perf_counter()
         # snapshot the cache binding: if the watchdog restarts the engine
         # mid-step, this (zombie) call must keep donating the OLD cache —
@@ -2358,89 +2537,275 @@ class GenerationEngine(ResilientEngineMixin):
         for i, st in enumerate(states):
             if st is None:
                 continue
-            tok = int(toks[i])
-            reason = None
-            fed_only = first_token = False
-            with self._wd_lock:
-                # serialize each slot-table touch with _watchdog_stall's
-                # epoch bump (taken under this lock): the instant the
-                # epoch moves, the replacement scheduler owns the table —
-                # a re-tenanted slot i must not receive this step's token
-                if self._epoch != epoch:
-                    return
-                st.length += 1
-                st.cow = None          # the copy landed with this step
-                if st.pending:
-                    st.pending.popleft()
-                    if st.pending:
-                        fed_only = True   # mid-suffix: discard the sample
-                    else:
-                        first_token = True
-                if not fed_only:
-                    st.n_generated += 1
-                    st.last_token = tok
-                    reason = self._retire_reason(st, tok)
-                    if reason is not None:
-                        if st.greq.capture_pages and st.blocks is not None:
-                            # decode-feed retirement (prefix/cache-hit
-                            # seat, EOS at token 0): export the written
-                            # pages while the blocks are still
-                            # referenced, under the same epoch lock that
-                            # frees them (st.length counts written
-                            # positions; the retiring token's K/V was
-                            # never written — swap-out semantics)
-                            used = blocks_for_tokens(st.length,
-                                                     self.block_size)
-                            if 0 < used <= st.n_entries:
-                                # analysis: ok lock-discipline — the
-                                # device_get must finish before
-                                # _clear_slot frees these blocks to
-                                # another stream (swap-out's contract);
-                                # bounded read, epoch-atomic
-                                self._capture_pages(
-                                    st.request,
-                                    np.asarray(self._tables[i][:used],
-                                               np.int32),
-                                    st.length, st.n_generated, tok, epoch)
-                        self._maybe_cache_retired(i, st)
-                        self._clear_slot(i, st)  # freed for NEXT admission
-            if fed_only:
-                st.request.trace.event("prompt.feed", slot=i,
-                                       remaining=len(st.pending))
-                continue
-            emitted += 1
-            if first_token and st.greq.resume_step == 0:
-                # prefix/feed streams have no prefill: token 0 lands
-                # here — including a victim preempted mid-feed before
-                # any token (resume_step 0), whose preemption-inflated
-                # TTFT must still be observed exactly once. A
-                # resume_step > 0 feed's "first" token is mid-stream;
-                # its TTFT was recorded at the original first token.
-                self.metrics.ttft_ms.observe(
-                    (now - st.request.submit_t) * 1e3)
-            st.request.trace.event("decode.step", step=st.n_generated - 1,
-                                   dur_ms=round(dt_ms, 3), slot=i, token=tok)
-            err = st.greq.handle._push(tok)
-            if err is not None:
-                # broken on_token consumer: the handle delivered the
-                # terminal — retire the slot now (no point decoding a dead
-                # stream) and record the one outcome
-                st.request.trace.event("on_token.failed",
-                                       error=type(err).__name__)
-                if reason is None:
-                    with self._wd_lock:
-                        if self._epoch == epoch and self._slots[i] is st:
-                            self._clear_slot(i, st)
-                self._finish_request(st.request.trace, "client_error",
-                                     tenant=st.request.tenant)
-            elif reason is not None:
-                self._finish_stream(st, reason)
+            res = self._commit_sampled(i, st, int(toks[i]), epoch, dt_ms,
+                                       now)
+            if res == "stale":
+                return
+            if res != "fed":
+                emitted += 1
         self.metrics.generated_tokens_total.inc(emitted)
         # re-read after retirement so an engine that drains to idle shows
         # its true occupancy instead of the pre-retire value forever
         self.metrics.slot_occupancy.set(self._live_count() / S)
         if self.paged:
             self._update_block_gauges()
+
+    def _commit_sampled(self, i: int, st: _Slot, tok: int, epoch: int,
+                        dt_ms: float, now: float) -> str:
+        """Commit ONE sampled token to slot ``i`` — the per-slot tail of
+        :meth:`_decode_iteration`, split out so the speculative commit
+        walk can apply it once per ACCEPTED token with identical
+        semantics (length/pending/retire accounting, page capture,
+        tracing, stream push). Returns ``"stale"`` (epoch moved — the
+        caller must abandon the whole iteration), ``"fed"`` (mid-suffix
+        prompt feed, sample discarded), ``"ok"``, ``"retired"``, or
+        ``"client_error"`` (the last three all emitted the token; the
+        last two vacated the slot — a speculative walk must stop)."""
+        reason = None
+        fed_only = first_token = False
+        with self._wd_lock:
+            # serialize each slot-table touch with _watchdog_stall's
+            # epoch bump (taken under this lock): the instant the
+            # epoch moves, the replacement scheduler owns the table —
+            # a re-tenanted slot i must not receive this step's token
+            if self._epoch != epoch:
+                return "stale"
+            st.length += 1
+            st.cow = None          # the copy landed with this step
+            if st.pending:
+                st.pending.popleft()
+                if st.pending:
+                    fed_only = True   # mid-suffix: discard the sample
+                else:
+                    first_token = True
+            if not fed_only:
+                st.n_generated += 1
+                st.last_token = tok
+                reason = self._retire_reason(st, tok)
+                if reason is not None:
+                    if st.greq.capture_pages and st.blocks is not None:
+                        # decode-feed retirement (prefix/cache-hit
+                        # seat, EOS at token 0): export the written
+                        # pages while the blocks are still
+                        # referenced, under the same epoch lock that
+                        # frees them (st.length counts written
+                        # positions; the retiring token's K/V was
+                        # never written — swap-out semantics)
+                        used = blocks_for_tokens(st.length,
+                                                 self.block_size)
+                        if 0 < used <= st.n_entries:
+                            # analysis: ok lock-discipline — the
+                            # device_get must finish before
+                            # _clear_slot frees these blocks to
+                            # another stream (swap-out's contract);
+                            # bounded read, epoch-atomic
+                            self._capture_pages(
+                                st.request,
+                                np.asarray(self._tables[i][:used],
+                                           np.int32),
+                                st.length, st.n_generated, tok, epoch)
+                    self._maybe_cache_retired(i, st)
+                    self._clear_slot(i, st)  # freed for NEXT admission
+        if fed_only:
+            st.request.trace.event("prompt.feed", slot=i,
+                                   remaining=len(st.pending))
+            return "fed"
+        if first_token and st.greq.resume_step == 0:
+            # prefix/feed streams have no prefill: token 0 lands
+            # here — including a victim preempted mid-feed before
+            # any token (resume_step 0), whose preemption-inflated
+            # TTFT must still be observed exactly once. A
+            # resume_step > 0 feed's "first" token is mid-stream;
+            # its TTFT was recorded at the original first token.
+            self.metrics.ttft_ms.observe(
+                (now - st.request.submit_t) * 1e3)
+        st.request.trace.event("decode.step", step=st.n_generated - 1,
+                               dur_ms=round(dt_ms, 3), slot=i, token=tok)
+        err = st.greq.handle._push(tok)
+        if err is not None:
+            # broken on_token consumer: the handle delivered the
+            # terminal — retire the slot now (no point decoding a dead
+            # stream) and record the one outcome
+            st.request.trace.event("on_token.failed",
+                                   error=type(err).__name__)
+            if reason is None:
+                with self._wd_lock:
+                    if self._epoch == epoch and self._slots[i] is st:
+                        self._clear_slot(i, st)
+            self._finish_request(st.request.trace, "client_error",
+                                 tenant=st.request.tenant)
+            return "client_error"
+        if reason is not None:
+            self._finish_stream(st, reason)
+            return "retired"
+        return "ok"
+
+    # ------------------------------------------------- speculative decoding
+    def _spec_turn(self, epoch: int, buf: Dict[str, np.ndarray],
+                   states: List[Optional[_Slot]], n_live: int) -> bool:
+        """One speculative scheduler turn: draft×k then ONE verify over
+        all slots, committing each slot's accepted prefix. Returns True
+        when this turn was handled (the caller skips the plain step);
+        False degrades the turn to plain decode — draft breaker open, no
+        draft-warm eligible slot, or the draft leg failed (the DEGRADE
+        contract: a dead draft costs throughput, never correctness, and
+        never sheds or stalls a stream).
+
+        Eligibility is per slot: draft-WARM (``draft_len == length``), no
+        pending prompt feed, and the tenant not k=0-demoted by the
+        acceptance governor. Ineligible live slots still ride the
+        fixed-shape verify — their proposal columns are garbage the
+        exact-match acceptance never commits, so they advance exactly one
+        token, like a plain turn. The commit walk reuses
+        :meth:`_commit_sampled` per accepted token, so every stream is
+        bitwise the plain-decode stream regardless of k.
+
+        The verify dispatch is retried like decode (injected faults raise
+        before the donated call); a real verify failure propagates to the
+        loop stamped ``fault_point='generation.verify_step'`` and takes
+        the fail-tenants + rebuild path."""
+        spec = self._spec
+        k = spec.k
+        elig = [st is not None and not st.pending
+                and st.draft_len == st.length
+                and not self._spec_governor.demoted(st.request.tenant)
+                for st in states]
+        if not any(elig):
+            return False
+        if not self._draft_breaker.allow():
+            self.metrics.spec_fallbacks_total.inc()
+            return False
+        # ---- draft leg: k proposals per slot, one executable call each.
+        # NOT retried — the draft is optional work, and the degrade path
+        # is strictly cheaper than a retry storm on a sick draft
+        dtoks = buf["spec_tokens"]
+        dtoks[:, 0] = buf["tokens"]
+        feed = buf["draft_feed"]
+        np.copyto(feed, buf["tokens"])
+        dcache = self._draft_cache
+        try:
+            with self.profiler.span("serving.draft_step",
+                                    engine=self.name, live=n_live, k=k):
+                for j in range(k):
+                    dcache, props = self._donated_call(
+                        "generation.draft_step", self._draft_step,
+                        self._draft_params, dcache, feed,
+                        buf["lengths"] + np.int32(j), buf["keys"],
+                        buf["steps"] + np.int32(j), buf["temps"],
+                        buf["top_ks"])
+                    props = np.asarray(props)
+                    if self.screen_outputs:
+                        self._screen_token_ids(
+                            props, "generation.draft_step",
+                            live=np.asarray(elig))
+                    dtoks[:, j + 1] = props
+                    np.copyto(feed, props)
+        except BaseException as e:
+            self._draft_breaker.record_failure()
+            self.metrics.spec_fallbacks_total.inc()
+            self._recorder.record("spec.draft_failure", engine=self.name,
+                                  point="generation.draft_step",
+                                  error=type(e).__name__)
+            with self._wd_lock:
+                if self._epoch == epoch:
+                    # the failed call may have consumed the donated draft
+                    # cache; rebuild it and mark every stream cold — they
+                    # keep decoding at plain speed
+                    self._reset_draft_cache()
+                    for st in states:
+                        if st is not None:
+                            st.draft_len = -1
+            return False
+        with self._wd_lock:
+            if self._epoch != epoch:
+                return True   # zombie: replacement owns its own caches
+            self._draft_cache = dcache
+        self._draft_breaker.record_success()
+        # ---- verify leg: ONE fixed-shape executable scores k+1
+        # positions per slot and counts each accepted prefix on device
+        t0 = time.perf_counter()
+        cache = self._cache
+        tables = buf["tables"]
+        np.copyto(tables, self._tables)
+        try:
+            with self.profiler.span("serving.verify_step",
+                                    engine=self.name, live=n_live,
+                                    slots=self.slots, k=k):
+                def call():
+                    return self._donated_call(
+                        "generation.verify_step", self._verify,
+                        self.params, cache, tables, buf["lengths"], dtoks,
+                        buf["keys"], buf["steps"], buf["temps"],
+                        buf["top_ks"], buf["cow_src"], buf["cow_dst"])
+
+                new_cache, samples, accepted = self._retry_call(call)
+                samples = np.asarray(samples)
+                accepted = np.asarray(accepted)
+                if self.screen_outputs:
+                    self._screen_token_ids(samples,
+                                           "generation.verify_step",
+                                           live=buf["live"])
+        except BaseException as e:
+            try:
+                e.fault_point = "generation.verify_step"
+            except Exception:
+                pass   # exotic __slots__ exception: generic dump label
+            raise
+        with self._wd_lock:
+            current = self._epoch == epoch
+            if current:
+                self._cache = new_cache
+        if not current:
+            return True   # zombie: tenants already failed on restart
+        self._breaker.record_success()
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        now = time.perf_counter()
+        self.metrics.decode_step_ms.observe(dt_ms)
+        self.metrics.decode_wall_ms.inc(dt_ms)
+        self.metrics.decode_steps_total.inc()
+        # ---- commit walk: per slot, apply the plain-decode tail once
+        # per accepted token. The commit count is capped by (a) the
+        # device acceptance + 1 (the target's own next sample), (b) k
+        # (sample k+1's K/V was never drafted — recomputed identically
+        # next turn), and (c) the slot's VALIDLY WRITTEN positions
+        # (writes past the mapped block entries or max_seq were
+        # scratch-routed; committing them would stand on garbage)
+        B = self.block_size
+        emitted = 0
+        for i, st in enumerate(states):
+            if st is None:
+                continue
+            if elig[i]:
+                cap = max(1, min(st.n_entries * B, self.cfg.max_seq)
+                          - st.length)
+                c = min(int(accepted[i]) + 1, k, cap)
+                self.metrics.record_spec_outcome(
+                    st.request.tenant, k, int(accepted[i]))
+                self._spec_governor.record(
+                    st.request.tenant, k, int(accepted[i]))
+            else:
+                c = 1   # cold/demoted/pending: exactly a plain turn
+            res = "ok"
+            for j in range(c):
+                res = self._commit_sampled(i, st, int(samples[i, j]),
+                                           epoch, dt_ms, now)
+                if res == "stale":
+                    return True
+                if res != "fed":
+                    emitted += 1
+                if res in ("retired", "client_error"):
+                    break
+            if elig[i] and res == "ok":
+                # the draft wrote positions length..length+k-1 this turn
+                # and we committed c <= k of them: its cache is exactly
+                # as long as the stream again — still warm
+                with self._wd_lock:
+                    if self._epoch == epoch and self._slots[i] is st:
+                        st.draft_len = st.length
+        self.metrics.generated_tokens_total.inc(emitted)
+        self.metrics.slot_occupancy.set(self._live_count() / self.slots)
+        self._update_block_gauges()
+        return True
 
     def _retire_reason(self, st: _Slot, tok: int) -> Optional[str]:
         """Pure retirement decision — EOS or the token budget — split from
@@ -2617,11 +2982,28 @@ class GenerationEngine(ResilientEngineMixin):
     def compiled_signatures(self) -> int:
         """Live compiled-executable count across the whole generation path:
         bounded by ``len(self.buckets) + 1`` (prefill ladder + the single
-        decode step) for the engine's lifetime."""
+        decode step) for the engine's lifetime — ``+ 2`` when
+        ``speculative`` is set (the single verify step rides beside the
+        decode fallback; the draft model's own executables are counted
+        separately by :meth:`draft_compiled_signatures`)."""
         from deeplearning4j_tpu.serving.registry import _jit_cache_size
 
         return (_jit_cache_size(self._prefill) or 0) + \
-            (_jit_cache_size(self._decode) or 0)
+            (_jit_cache_size(self._decode) or 0) + \
+            ((_jit_cache_size(self._verify) or 0)
+             if self._spec is not None else 0)
+
+    def draft_compiled_signatures(self) -> int:
+        """DRAFT-side compiled-executable count (0 for non-speculative
+        engines): bounded by ``len(self.buckets) + 1`` — the draft
+        prefill ladder (compiled lazily per bucket as streams seat) plus
+        THE single draft step, mirroring the target's own bound."""
+        if self._spec is None:
+            return 0
+        from deeplearning4j_tpu.serving.registry import _jit_cache_size
+
+        return (_jit_cache_size(self._draft_prefill) or 0) + \
+            (_jit_cache_size(self._draft_step) or 0)
 
     @property
     def queue_depth(self) -> int:
@@ -2663,6 +3045,19 @@ class GenerationEngine(ResilientEngineMixin):
                 # traffic). The cache locks internally, and a racing
                 # match_and_ref holds its own block refs — no torn state
                 self._prefix_cache.release_all()
+        if self._spec is not None and self.max_len >= 2:
+            # speculative engines compiled draft prefill/step + verify
+            # through the rungs above, but never the PLAIN decode
+            # fallback — and a draft breaker opening under live load must
+            # not pay XLA inline at the worst possible moment. One
+            # forced-plain probe compiles it now.
+            self._spec_force_plain = True
+            try:
+                self.generate(np.zeros(1, np.int32),
+                              max_new_tokens=min(2, self.max_len - 1),
+                              eos_id=None, timeout=300.0)
+            finally:
+                self._spec_force_plain = False
         return self
 
 
@@ -2687,4 +3082,4 @@ def client_stream_handle(prompt_len: int,
 
 
 __all__ = ["GenerationEngine", "GenerationHandle", "GenerationRequest",
-           "client_stream_handle", "prefill_buckets"]
+           "SpecConfig", "client_stream_handle", "prefill_buckets"]

@@ -346,6 +346,23 @@ class ServingMetrics:
         self.retry_budget_exhausted_total = Counter(
             "retry_budget_exhausted_total")
         self.slo_burn_active = Gauge("slo_burn_active")   # 0/1 governor
+        # ---- speculative decoding signals (draft + k-token verify) -------
+        # proposed counts draft tokens the verify step scored, accepted
+        # the prefix the target model kept — accepted/proposed IS the
+        # fleet acceptance rate, and spec_acceptance_rate publishes it as
+        # a gauge so /api/serving exposes it directly. fallbacks are
+        # scheduler turns that degraded to plain decode (draft breaker
+        # open, draft fault, or governor demotion) — the DEGRADE contract
+        # means a dead draft NEVER sheds a stream, so this counter is the
+        # only place a lost draft is visible. Per-tenant acceptance rides
+        # the same bounded-cardinality label scheme as the tenant
+        # served/shed counters.
+        self.spec_tokens_proposed = Counter("spec_tokens_proposed")
+        self.spec_tokens_accepted = Counter("spec_tokens_accepted")
+        self.spec_fallbacks_total = Counter("spec_fallbacks_total")
+        self.spec_acceptance_rate = Gauge("spec_acceptance_rate")
+        self._spec_proposed: Dict[str, int] = {}
+        self._spec_accepted: Dict[str, int] = {}
         # ---- observability signals (tracing / poison screen / SLO) -------
         self.poisoned_results_total = Counter("poisoned_results_total")
         self.slo_windows: Dict[str, SlidingWindowStats] = {
@@ -418,6 +435,42 @@ class ServingMetrics:
             return
         self.tenant_shed.inc(tenant)
         rc.inc(reason)
+
+    def record_spec_outcome(self, tenant: str, proposed: int, accepted: int):
+        """One speculative verify turn's outcome for one stream: the draft
+        proposed ``proposed`` tokens and the target accepted ``accepted``
+        of them (a prefix — rejection sampling). Feeds the fleet counters,
+        refreshes the acceptance-rate gauge, and accumulates the tenant's
+        own rate for :meth:`spec_snapshot` (bounded cardinality, same
+        scheme as :meth:`record_tenant_outcome`)."""
+        if proposed <= 0:
+            return
+        self.spec_tokens_proposed.inc(proposed)
+        self.spec_tokens_accepted.inc(accepted)
+        p = self.spec_tokens_proposed.value
+        self.spec_acceptance_rate.set(
+            self.spec_tokens_accepted.value / p if p else 0.0)
+        with self._tenant_lock:
+            t = self._tenant_label(tenant)
+            self._spec_proposed[t] = self._spec_proposed.get(t, 0) + proposed
+            self._spec_accepted[t] = self._spec_accepted.get(t, 0) + accepted
+
+    def spec_snapshot(self) -> dict:
+        """Speculative-decoding roll-up — rides ``snapshot()`` (the
+        /api/serving payload) under the ``"spec"`` key: fleet acceptance
+        rate plus the per-tenant acceptance-rate gauge."""
+        with self._tenant_lock:
+            tenants = {
+                t: {"proposed": p,
+                    "accepted": self._spec_accepted.get(t, 0),
+                    "acceptance_rate": self._spec_accepted.get(t, 0) / p
+                    if p else 0.0}
+                for t, p in self._spec_proposed.items()}
+        return {
+            "acceptance_rate": self.spec_acceptance_rate.value,
+            "fallbacks_total": self.spec_fallbacks_total.value,
+            "tenants": tenants,
+        }
 
     def observe_queue_wait_class(self, priority: str, wait_ms: float):
         h = self.queue_wait_by_class.get(priority)
@@ -492,7 +545,8 @@ class ServingMetrics:
             self.kv_swap_bytes_out, self.kv_swap_bytes_in,
             self.kv_migrations_total, self.kv_migrate_bytes_out,
             self.kv_migrate_bytes_in, self.kv_migrate_fallbacks_total,
-            self.prefix_route_hits_total)}
+            self.prefix_route_hits_total, self.spec_tokens_proposed,
+            self.spec_tokens_accepted, self.spec_fallbacks_total)}
 
     def decode_tokens_per_sec(self) -> float:
         """Steady-state decode throughput: tokens sampled by decode_step
@@ -541,6 +595,8 @@ class ServingMetrics:
             "rejections_by_reason": self.rejections_by_reason.to_dict(),
             "slo": self.slo_snapshot(),
             "qos": self.qos_snapshot(),
+            "spec_acceptance_rate": self.spec_acceptance_rate.value,
+            "spec": self.spec_snapshot(),
             "ttft_ms": self.ttft_ms.to_dict(),
             "prefill_ms": self.prefill_ms.to_dict(),
             "decode_step_ms": self.decode_step_ms.to_dict(),

@@ -776,6 +776,90 @@ class SloBurnGovernor:
             f"until the window clears", detail=detail)
 
 
+class SpecAcceptanceGovernor:
+    """Per-tenant draft-acceptance feedback for speculative decoding
+    (serving/generation.py ``speculative=SpecConfig(...)``): the verify
+    step reports each tenant's (proposed, accepted) draft-token counts
+    after every speculative turn, and a tenant whose observed acceptance
+    rate falls below ``min_acceptance`` — judged only after
+    ``min_proposed`` proposals, so a cold stream's first turns cannot
+    demote it — is DEMOTED to k=0: its traffic stops paying the
+    draft+verify overhead that its rejections were wasting, and the
+    scheduler runs plain decode turns for it instead. Demotion is per
+    tenant and sticky (acceptance is a property of the tenant's traffic
+    distribution vs the draft model, not a transient), and it is a pure
+    SCHEDULING decision: emitted tokens are always the target model's
+    own samples, so demotion — like speculation itself — is
+    bitwise-inert.
+
+    ``min_acceptance <= 0`` disables demotion (every record is still
+    tracked for the acceptance-rate snapshot). Cardinality is bounded
+    like the metrics tenant counters: at most ``max_tenants`` distinct
+    labels, the rest folded into the shared overflow label."""
+
+    OVERFLOW_TENANT = "(other)"
+
+    def __init__(self, min_acceptance: float = 0.0,
+                 min_proposed: int = 256, max_tenants: int = 1024):
+        if min_proposed <= 0:
+            raise ValueError(
+                f"min_proposed must be positive (a zero observation "
+                f"floor would demote tenants on no evidence), got "
+                f"{min_proposed}")
+        self.min_acceptance = float(min_acceptance)
+        self.min_proposed = int(min_proposed)
+        self.max_tenants = int(max_tenants)
+        self._lock = threading.Lock()
+        self._proposed: Dict[str, int] = {}
+        self._accepted: Dict[str, int] = {}
+        self._demoted: set = set()
+
+    def _label(self, tenant: Optional[str]) -> str:
+        t = tenant if tenant is not None else DEFAULT_TENANT
+        if t in self._proposed or len(self._proposed) < self.max_tenants:
+            return t
+        return self.OVERFLOW_TENANT
+
+    def record(self, tenant: Optional[str], proposed: int, accepted: int):
+        """One tenant's draft outcome for one speculative turn."""
+        if proposed <= 0:
+            return
+        with self._lock:
+            t = self._label(tenant)
+            p = self._proposed[t] = self._proposed.get(t, 0) + int(proposed)
+            a = self._accepted[t] = self._accepted.get(t, 0) + int(accepted)
+            if self.min_acceptance > 0.0 and t not in self._demoted \
+                    and p >= self.min_proposed \
+                    and a / p < self.min_acceptance:
+                self._demoted.add(t)
+
+    def demoted(self, tenant: Optional[str]) -> bool:
+        """True when ``tenant``'s traffic should run k=0 (plain decode)."""
+        if self.min_acceptance <= 0.0:
+            return False
+        with self._lock:
+            t = self._label(tenant)
+            return t in self._demoted
+
+    def acceptance_rate(self, tenant: Optional[str]) -> Optional[float]:
+        with self._lock:
+            t = self._label(tenant)
+            p = self._proposed.get(t, 0)
+            return self._accepted.get(t, 0) / p if p else None
+
+    def snapshot(self) -> dict:
+        """Per-tenant acceptance roll-up (rides the engine's /api/serving
+        payload beside the metrics counters)."""
+        with self._lock:
+            return {
+                t: {"proposed": p,
+                    "accepted": self._accepted.get(t, 0),
+                    "acceptance_rate": self._accepted.get(t, 0) / p
+                    if p else 0.0,
+                    "demoted": t in self._demoted}
+                for t, p in self._proposed.items()}
+
+
 __all__ = ["QosPolicy", "TenantPolicy", "TenantQueues", "TokenBucket",
-           "SloBurnGovernor", "resolve_qos", "DEFAULT_TENANT", "PRIORITIES",
-           "BURN_REASONS"]
+           "SloBurnGovernor", "SpecAcceptanceGovernor", "resolve_qos",
+           "DEFAULT_TENANT", "PRIORITIES", "BURN_REASONS"]

@@ -212,6 +212,16 @@ class Deployment:
     # the breaker — retry storms are bounded per DEPLOYMENT (created
     # lazily when the registry is configured with a retry_budget_ratio)
     retry_budget: Optional[RetryBudget] = None
+    # speculative decoding: the DRAFT model rides the TARGET's deployment
+    # (one name:version, one breaker, one retry budget, one /api/serving
+    # roll-up — the draft is an implementation detail of serving the
+    # target faster, not a separately routable model). When set, every
+    # generation engine over this deployment defaults to
+    # speculative=SpecConfig(draft..., k=spec_k, ...)
+    draft: Optional[ModelAdapter] = None
+    spec_k: int = 4
+    spec_min_acceptance: float = 0.0
+    spec_min_proposed: int = 256
 
     @property
     def ref(self) -> str:
@@ -334,23 +344,47 @@ class ModelRegistry:
                buckets: Optional[Sequence[int]] = None,
                warmup_example=None, input_name: Optional[str] = None,
                output_name: Optional[str] = None,
-               output_index: int = 0, qos=None) -> Deployment:
+               output_index: int = 0, qos=None,
+               draft_model=None, spec_k: int = 4,
+               spec_min_acceptance: float = 0.0,
+               spec_min_proposed: int = 256) -> Deployment:
         """Register ``model`` under ``name``; returns the Deployment. When
         ``warmup_example`` (ONE row, no batch dim) is given, every bucket
         size is compiled before the deployment becomes visible. ``qos``
         (a :class:`~deeplearning4j_tpu.serving.qos.QosPolicy`) attaches a
         deploy-time multi-tenant policy: every engine spun up over this
         deployment enforces it unless the caller overrides ``qos=`` at
-        engine construction."""
+        engine construction.
+
+        ``draft_model`` (a :class:`CausalLMAdapter` over a smaller LM)
+        deploys draft + target as ONE deployment for speculative
+        decoding: same name:version, same breaker and retry budget, one
+        /api/serving roll-up. Engines from :meth:`generation_engine`
+        then default to ``speculative=SpecConfig(draft..., k=spec_k,
+        min_acceptance=spec_min_acceptance)`` — and their warmup
+        compiles BOTH models' executables (the engine's rung probes
+        draft-seat each prompt bucket). Target-only deploys are
+        untouched."""
         if ":" in name:
             raise ValueError(f"model name {name!r} may not contain ':'")
         adapter = as_adapter(model, input_name=input_name,
                              output_name=output_name,
                              output_index=output_index)
+        if draft_model is not None:
+            draft_model = as_adapter(draft_model)
+            if not (hasattr(draft_model, "params")
+                    and hasattr(draft_model, "cfg")):
+                raise TypeError(
+                    f"draft_model must be a CausalLMAdapter (got "
+                    f"{draft_model.kind}) — the draft proposes token ids "
+                    "for the target's verify step")
         bks = tuple(sorted(set(buckets))) if buckets else self.default_buckets
         ex = np.asarray(warmup_example) if warmup_example is not None else None
         dep = Deployment(name=name, version=0, adapter=adapter, buckets=bks,
-                         warmup_example=ex, qos=qos,
+                         warmup_example=ex, qos=qos, draft=draft_model,
+                         spec_k=spec_k,
+                         spec_min_acceptance=spec_min_acceptance,
+                         spec_min_proposed=spec_min_proposed,
                          state="warming" if ex is not None else "ready")
         with self._lock:
             # reserve the slot under the lock: concurrent deploys of the
@@ -611,6 +645,15 @@ class ModelRegistry:
         engine_kwargs.setdefault("breaker", self._breaker_for(dep))
         if dep.qos is not None:
             engine_kwargs.setdefault("qos", dep.qos)
+        if dep.draft is not None:
+            # draft + target deployed as ONE unit: the engine defaults to
+            # speculative decode over the deployment's draft (pass
+            # speculative=None explicitly to opt a single engine out)
+            from deeplearning4j_tpu.serving.generation import SpecConfig
+            engine_kwargs.setdefault("speculative", SpecConfig(
+                dep.draft.params, dep.draft.cfg, k=dep.spec_k,
+                min_acceptance=dep.spec_min_acceptance,
+                min_proposed=dep.spec_min_proposed))
         rb = self._retry_budget_for(dep)
         if rb is not None:
             engine_kwargs.setdefault("retry_budget", rb)

@@ -492,6 +492,16 @@ class _Handler(BaseHTTPRequestHandler):
             # 'poisoned' since PR 5.
             self._json(self._metrics_rollup("qos"))
             return
+        if parts == ["api", "serving", "spec"]:
+            # speculative-decoding roll-up per serving worker
+            # (serving/generation.py speculative=SpecConfig): fleet
+            # acceptance rate (spec_tokens_accepted / proposed), the
+            # fallbacks counter (turns degraded to plain decode — a
+            # dead draft NEVER sheds, so this is its only footprint),
+            # and per-tenant proposed/accepted/acceptance_rate on the
+            # same bounded-cardinality label scheme as /api/qos.
+            self._json(self._metrics_rollup("spec"))
+            return
         if parts == ["api", "cluster"]:
             # pod-slice control-plane view (serving/cluster.py): one
             # entry per live ClusterDirectory in this process — per-host

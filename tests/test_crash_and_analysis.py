@@ -1,5 +1,9 @@
 """CrashReportingUtil (ref: o.d.util.CrashReportingUtil tests) and DataVec
-HtmlAnalysis (ref: org.datavec.api.transform.ui.HtmlAnalysis)."""
+HtmlAnalysis (ref: org.datavec.api.transform.ui.HtmlAnalysis) — plus the
+speculative-decoding DEGRADE contract under injected draft faults
+(serving/faults.py ``generation.draft_prefill`` / ``generation.draft_step``
+/ ``generation.verify_step``): a dead draft model degrades streams to
+plain decode bitwise-correctly, it NEVER sheds or stalls them."""
 import os
 
 import numpy as np
@@ -103,3 +107,88 @@ class TestHtmlAnalysis:
                         if f.startswith("dl4jtpu-crash")]
         finally:
             crash_reporting.crashDumpOutputDirectory(None)
+
+
+@pytest.mark.chaos
+class TestSpeculativeDegrade:
+    """Injected faults on the speculative turn (ISSUE 17 satellite): the
+    draft model is OPTIONAL work, so draft-side faults degrade streams to
+    plain decode — bitwise-correct output, ``spec_fallbacks_total``
+    counted, the draft breaker fed, and the stream never shed or stalled.
+    The verify step is the target model itself: its transient faults ride
+    decode_step's retry path, invisibly to the client."""
+
+    def _cfgs(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models import TransformerConfig
+        cfg = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                                mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                                causal=True, attention_impl="full",
+                                remat=False)
+        dcfg = TransformerConfig(vocab_size=50, hidden=16, layers=1, heads=2,
+                                 mlp_dim=32, max_seq=64, dtype=jnp.float32,
+                                 causal=True, attention_impl="full",
+                                 remat=False)
+        return cfg, dcfg
+
+    def _run(self, plan, n_streams=2, max_new=10):
+        """Drive ``n_streams`` under an optional FaultPlan on a spec
+        engine; return (results, fallbacks, plain-engine baseline)."""
+        import contextlib
+
+        import jax
+
+        from deeplearning4j_tpu.models import init_params
+        from deeplearning4j_tpu.serving import GenerationEngine, SpecConfig
+        cfg, dcfg = self._cfgs()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        dparams = init_params(jax.random.PRNGKey(1), dcfg)
+        prompts = [np.random.default_rng(s).integers(1, 50, 5)
+                   .astype(np.int32) for s in range(n_streams)]
+        with GenerationEngine(params, cfg, slots=2, max_len=32) as eng:
+            base = [eng.generate(p, max_new_tokens=max_new, eos_id=None,
+                                 timeout=120) for p in prompts]
+        with GenerationEngine(params, cfg, slots=2, max_len=32,
+                              speculative=SpecConfig(dparams, dcfg,
+                                                     k=4)) as eng:
+            with plan if plan is not None else contextlib.nullcontext():
+                hs = [eng.submit(p, max_new_tokens=max_new, eos_id=None)
+                      for p in prompts]
+                got = [h.result(timeout=120) for h in hs]
+            snap = eng.metrics.snapshot()
+        return got, base, snap
+
+    def test_draft_step_faults_degrade_to_plain(self):
+        """Every draft_step call fails: all turns fall back to plain
+        decode. Streams complete bitwise-correct, nothing is shed."""
+        from deeplearning4j_tpu.serving import FaultPlan
+        plan = FaultPlan(seed=3).fail("generation.draft_step", rate=1.0)
+        got, base, snap = self._run(plan)
+        assert got == base
+        assert snap["spec_fallbacks_total"] >= 1
+        assert snap["failed_total"] == 0
+        assert snap["generations_completed"] == len(got)
+        assert plan.fired("generation.draft_step")
+
+    def test_draft_prefill_faults_leave_slot_cold(self):
+        """A failed draft seat leaves the slot draft-cold — it decodes
+        plain and still finishes bitwise-correct."""
+        from deeplearning4j_tpu.serving import FaultPlan
+        plan = FaultPlan(seed=5).fail("generation.draft_prefill", rate=1.0)
+        got, base, snap = self._run(plan)
+        assert got == base
+        assert snap["failed_total"] == 0
+        assert snap["generations_completed"] == len(got)
+        assert plan.fired("generation.draft_prefill")
+
+    def test_verify_step_fault_is_retried_transparently(self):
+        """One transient verify fault rides decode_step's retry path: the
+        turn replays against the pre-call snapshot and the client never
+        sees it."""
+        from deeplearning4j_tpu.serving import FaultPlan
+        plan = FaultPlan(seed=7).fail("generation.verify_step", at=(0,))
+        got, base, snap = self._run(plan)
+        assert got == base
+        assert snap["retries_total"] >= 1
+        assert plan.fired("generation.verify_step")

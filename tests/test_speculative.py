@@ -1,0 +1,267 @@
+"""Speculative decoding (ISSUE 17): draft + k-token verify as ONE
+deployment (models/bert.py make_draft_step/make_verify_step +
+serving/generation.py ``speculative=SpecConfig`` + serving/registry.py
+``deploy(draft_model=...)``).
+
+The correctness bar exercised here:
+- greedy speculative streams are BITWISE identical to non-speculative
+  runs at every tested k, both KV dtypes, both paged-attention routes,
+  at temperature > 0, and under preemption/resume — the verify step
+  commits only the TARGET's own deterministic samples, so acceptance
+  decides throughput, never content;
+- the executable bound grows to ``len(buckets) + 2`` target-side
+  (prefill ladder + plain decode + THE verify step) and
+  ``len(buckets) + 1`` draft-side, for the engine's lifetime;
+- a draft that agrees with the target (here: the target itself) hits
+  acceptance 1.0 and multi-token turns; per-tenant acceptance flows
+  through ``/api/serving`` (ServingMetrics.snapshot()["spec"]) and the
+  qos SpecAcceptanceGovernor demotes low-acceptance tenants to k=0;
+- ``speculative=None`` (the default) is the exact plain path, and the
+  registry deploys draft+target as one name:version.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import TransformerConfig, init_params
+from deeplearning4j_tpu.serving import (
+    CausalLMAdapter, GenerationEngine, ModelRegistry, SpecAcceptanceGovernor,
+    SpecConfig,
+)
+
+CFG = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                        mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                        causal=True, attention_impl="full", remat=False)
+# the draft is a genuinely different (smaller) model: its proposals
+# rarely match the target's samples, which is exactly the hard case for
+# the parity bar — acceptance ~0 must still be bitwise-correct
+DCFG = TransformerConfig(vocab_size=50, hidden=16, layers=1, heads=2,
+                         mlp_dim=32, max_seq=64, dtype=jnp.float32,
+                         causal=True, attention_impl="full", remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def dparams():
+    return init_params(jax.random.PRNGKey(1), DCFG)
+
+
+def prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n).astype(np.int32)
+
+
+PROMPTS = ((5, 0), (11, 1), (3, 2))   # (length, seed): co-scheduled mix
+
+
+def run_streams(params, engine_kwargs, temperature=0.0, top_k=0,
+                max_new=10):
+    with GenerationEngine(params, CFG, slots=2, max_len=32,
+                          **engine_kwargs) as eng:
+        hs = [eng.submit(prompt(n, s), max_new_tokens=max_new,
+                         temperature=temperature, top_k=top_k,
+                         eos_id=None, seed=s)
+              for n, s in PROMPTS]
+        return [h.result(timeout=120) for h in hs]
+
+
+class TestBitwiseParity:
+    def test_greedy_parity_every_k(self, params, dparams):
+        base = run_streams(params, {})
+        for k in (1, 2, 4, 8):
+            got = run_streams(params, {
+                "speculative": SpecConfig(dparams, DCFG, k=k)})
+            assert got == base, f"k={k} diverged"
+
+    def test_greedy_parity_int8_kv(self, params, dparams):
+        base = run_streams(params, {"kv_dtype": "int8"})
+        for k in (1, 4):
+            got = run_streams(params, {
+                "kv_dtype": "int8",
+                "speculative": SpecConfig(dparams, DCFG, k=k)})
+            assert got == base, f"int8 k={k} diverged"
+
+    def test_greedy_parity_fused_attention(self, params, dparams):
+        base = run_streams(params, {"block_size": 8,
+                                    "paged_attention": "fused"})
+        got = run_streams(params, {
+            "block_size": 8, "paged_attention": "fused",
+            "speculative": SpecConfig(dparams, DCFG, k=2)})
+        assert got == base
+
+    def test_sampled_parity(self, params, dparams):
+        """The exact-match acceptance scheme is temperature-independent:
+        the verify step emits the target's own gumbel-max draws, so even
+        sampled streams are bitwise-stable under speculation."""
+        base = run_streams(params, {}, temperature=1.0, top_k=8)
+        got = run_streams(params,
+                          {"speculative": SpecConfig(dparams, DCFG, k=3)},
+                          temperature=1.0, top_k=8)
+        assert got == base
+
+    def test_parity_under_preemption_resume(self, params, dparams):
+        """A tight on-demand pool forces mid-stream eviction while
+        speculating; the resumed streams stay bitwise their solo runs
+        (recompute-on-resume re-seats via prefill, which re-warms the
+        draft cache)."""
+        solo = []
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8) as eng:
+            for s in (0, 1):
+                solo.append(eng.generate(prompt(4, s), max_new_tokens=20,
+                                         eos_id=None, timeout=120))
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              block_size=8, num_blocks=5,
+                              allocate="on_demand", queue_capacity=8,
+                              speculative=SpecConfig(dparams, DCFG,
+                                                     k=4)) as eng:
+            hs = [eng.submit(prompt(4, s), max_new_tokens=20, eos_id=None)
+                  for s in (0, 1)]
+            got = [h.result(timeout=120) for h in hs]
+            assert eng.metrics.preemptions_total.value >= 1
+        assert got == solo
+
+
+class TestExecutableBound:
+    def test_signature_bound_buckets_plus_two(self, params, dparams):
+        """Warmup drives every prefill rung, the verify step, AND the
+        plain-decode fallback; the target-side executable count stays
+        <= buckets + 2 and the draft side <= buckets + 1."""
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              speculative=SpecConfig(dparams, DCFG,
+                                                     k=4)) as eng:
+            eng.warmup()
+            for n, s in PROMPTS:
+                eng.generate(prompt(n, s), max_new_tokens=6, eos_id=None,
+                             timeout=120)
+            assert eng.compiled_signatures() <= len(eng.buckets) + 2
+            assert eng.draft_compiled_signatures() <= len(eng.buckets) + 1
+
+    def test_plain_engine_bound_unchanged(self, params):
+        with GenerationEngine(params, CFG, slots=2, max_len=32) as eng:
+            eng.warmup()
+            assert eng.compiled_signatures() <= len(eng.buckets) + 1
+            assert eng.draft_compiled_signatures() == 0
+
+    def test_spec_config_validation(self, params, dparams):
+        with pytest.raises(ValueError, match="k must be >= 1"):
+            SpecConfig(dparams, DCFG, k=0)
+        with pytest.raises(ValueError, match="paged"):
+            GenerationEngine(params, CFG, slots=2, max_len=32, paged=False,
+                             speculative=SpecConfig(dparams, DCFG))
+        small = TransformerConfig(vocab_size=50, hidden=16, layers=1,
+                                  heads=2, mlp_dim=32, max_seq=16,
+                                  dtype=jnp.float32, causal=True,
+                                  attention_impl="full", remat=False)
+        with pytest.raises(ValueError, match="max_seq"):
+            GenerationEngine(params, CFG, slots=2, max_len=32,
+                             speculative=SpecConfig(dparams, small))
+
+
+class TestAcceptance:
+    def test_self_draft_accepts_everything(self, params):
+        """Draft == target: proposals are the target's own samples, so
+        every turn commits k tokens and acceptance is 1.0 — the speedup
+        regime the bench grid measures."""
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              speculative=SpecConfig(params, CFG,
+                                                     k=4)) as eng:
+            base = eng.generate(prompt(5, 0), max_new_tokens=12,
+                                eos_id=None, timeout=120)
+            snap = eng.metrics.snapshot()
+            assert len(base) == 12
+            assert snap["spec_tokens_proposed"] > 0
+            assert snap["spec_acceptance_rate"] == pytest.approx(1.0)
+            # multi-token turns: far fewer scheduler steps than tokens
+            assert snap["decode_steps_total"] < 12
+
+    def test_acceptance_surfaces_per_tenant(self, params, dparams):
+        """/api/serving (= ServingMetrics.snapshot()) carries the spec
+        roll-up: fleet counters + per-tenant acceptance-rate gauge."""
+        with GenerationEngine(params, CFG, slots=2, max_len=32,
+                              speculative=SpecConfig(dparams, DCFG,
+                                                     k=4)) as eng:
+            eng.generate(prompt(5, 0), max_new_tokens=8, eos_id=None,
+                         timeout=120)
+            snap = eng.metrics.snapshot()
+            assert snap["spec_tokens_proposed"] >= 4
+            spec = snap["spec"]
+            assert spec["tenants"], "per-tenant acceptance missing"
+            for t, row in spec["tenants"].items():
+                assert 0.0 <= row["acceptance_rate"] <= 1.0
+                assert row["proposed"] >= row["accepted"]
+
+    def test_governor_demotes_low_acceptance_tenant(self):
+        gov = SpecAcceptanceGovernor(min_acceptance=0.5, min_proposed=8)
+        assert not gov.demoted("t")
+        gov.record("t", 4, 4)          # below the observation floor
+        assert not gov.demoted("t")
+        gov.record("t", 8, 0)          # 12 proposed, 4 accepted: 0.33
+        assert gov.demoted("t")
+        assert gov.snapshot()["t"]["demoted"]
+        # a healthy tenant is untouched; disabled governor never demotes
+        gov.record("ok", 100, 90)
+        assert not gov.demoted("ok")
+        off = SpecAcceptanceGovernor(min_acceptance=0.0)
+        off.record("t", 1000, 0)
+        assert not off.demoted("t")
+
+    def test_engine_demotes_to_plain_turns(self, params, dparams):
+        """min_acceptance over a hopeless draft: once the tenant crosses
+        the observation floor it stops speculating (k=0 semantics) —
+        and its streams stay bitwise-correct throughout."""
+        base = run_streams(params, {}, max_new=16)
+        got = run_streams(params, {
+            "speculative": SpecConfig(dparams, DCFG, k=4,
+                                      min_acceptance=0.99,
+                                      min_proposed=8)}, max_new=16)
+        assert got == base
+
+
+class TestRegistryDeployment:
+    def test_draft_rides_target_deployment(self, params, dparams):
+        reg = ModelRegistry()
+        dep = reg.deploy(
+            "lm", CausalLMAdapter(params, CFG),
+            draft_model=CausalLMAdapter(dparams, DCFG), spec_k=3)
+        assert dep.draft is not None and dep.ref == "lm:1"
+        eng = reg.generation_engine("lm", slots=2, max_len=32)
+        try:
+            assert eng._spec is not None and eng._spec.k == 3
+            base = run_streams(params, {})
+            hs = [eng.submit(prompt(n, s), max_new_tokens=10,
+                             eos_id=None, seed=s) for n, s in PROMPTS]
+            assert [h.result(timeout=120) for h in hs] == base
+        finally:
+            reg.shutdown()
+
+    def test_engine_can_opt_out(self, params, dparams):
+        reg = ModelRegistry()
+        reg.deploy("lm", CausalLMAdapter(params, CFG),
+                   draft_model=CausalLMAdapter(dparams, DCFG))
+        eng = reg.generation_engine("lm", slots=2, max_len=32,
+                                    speculative=None)
+        try:
+            assert eng._spec is None
+        finally:
+            reg.shutdown()
+
+    def test_non_causal_draft_rejected(self, params):
+        from deeplearning4j_tpu.nn import (
+            MultiLayerNetwork, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.Builder().seed(7).list()
+            .layer(OutputLayer(nIn=4, nOut=2, activation="SOFTMAX",
+                               lossFunction="MCXENT"))
+            .build()).init()
+        reg = ModelRegistry()
+        with pytest.raises(TypeError, match="CausalLMAdapter"):
+            reg.deploy("lm", CausalLMAdapter(params, CFG),
+                       draft_model=net)

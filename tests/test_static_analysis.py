@@ -802,6 +802,26 @@ class Report:
         r2 = run({p: src}, rules=["wire-schema-drift"])
         assert r2.unsuppressed == []
 
+    def test_speculative_stays_off_the_wire(self):
+        """ISSUE 17 decision: speculative decoding is deployment-local
+        config (registry.deploy(draft_model=...)), NOT a per-request
+        knob — RpcRequest grows NO spec field, so v1 receivers need no
+        defaulting story and the wire-schema-drift gate stays armed on
+        an unchanged schema."""
+        import dataclasses
+
+        from deeplearning4j_tpu.serving import RpcRequest
+        names = {f.name for f in dataclasses.fields(RpcRequest)}
+        assert not any("spec" in n or "draft" in n for n in names), (
+            "speculative config leaked into the wire schema — it is "
+            "deployment-local by design (ISSUE 17 satellite)")
+        # and the live rpc.py is clean under the drift rule
+        p = os.path.join(SERVING, "rpc.py")
+        with open(p) as f:
+            src = f.read()
+        r = run({p: src}, rules=["wire-schema-drift"])
+        assert r.unsuppressed == []
+
 
 # --------------------------------------------------------------------------
 # 7. deadline-propagation (ISSUE 11)
@@ -863,6 +883,27 @@ class Engine:
 '''
         r = run({"serving/e.py": src}, rules=["deadline-propagation"])
         assert r.unsuppressed == []
+
+    def test_speculative_turn_covered(self):
+        """ISSUE 17: the rule reaches the draft/verify turn shape — a
+        host that accepts a deadline and dispatches the speculative leg
+        without forwarding it must flag, and the REAL generation.py
+        (where the spec turn lives inside the deadline-carrying decode
+        scheduler) stays clean."""
+        src = '''
+class Host:
+    def submit_speculative(self, prompt, timeout_ms=None):
+        self._draft.submit(prompt)
+        return self._verify.submit(prompt)
+'''
+        r = run({"serving/h.py": src}, rules=["deadline-propagation"])
+        assert rules_hit(r) == {"deadline-propagation"}
+        p = os.path.join(SERVING, "generation.py")
+        with open(p) as f:
+            live = f.read()
+        assert "_spec_turn" in live      # the turn this test covers
+        r2 = run({p: live}, rules=["deadline-propagation"])
+        assert r2.unsuppressed == []
 
 
 # --------------------------------------------------------------------------
@@ -997,6 +1038,39 @@ class TestMetricsDrift:
         # the live tree is clean
         r2 = analyze_sources(sources, rules=["metrics-drift"])
         assert r2.unsuppressed == []
+
+    def test_spec_counters_under_drift_gate(self):
+        """ISSUE 17: the speculative counters ride the same drift gate —
+        typo'ing the generation.py recording site of
+        ``spec_fallbacks_total`` (the ONLY visibility a dead draft has
+        under the DEGRADE contract) must flag, and stranding the
+        snapshot's "spec" roll-up read by ui/server.py must flag."""
+        sources = {}
+        for name in os.listdir(SERVING):
+            if name.endswith(".py"):
+                p = os.path.join(SERVING, name)
+                with open(p) as f:
+                    sources[p] = f.read()
+        with open(UI_SERVER) as f:
+            sources[UI_SERVER] = f.read()
+        gen_path = os.path.join(SERVING, "generation.py")
+        broken = dict(sources)
+        typoed = sources[gen_path].replace(
+            "self.metrics.spec_fallbacks_total",
+            "self.metrics.spec_fallback_total", 1)
+        assert typoed != sources[gen_path]
+        broken[gen_path] = typoed
+        r = analyze_sources(broken, rules=["metrics-drift"])
+        assert any("spec_fallback_total" in f.message
+                   for f in r.unsuppressed)
+        metrics_path = os.path.join(SERVING, "metrics.py")
+        broken = dict(sources)
+        removed = sources[metrics_path].replace(
+            '"spec": self.spec_snapshot(),', "")
+        assert removed != sources[metrics_path]
+        broken[metrics_path] = removed
+        r = analyze_sources(broken, rules=["metrics-drift"])
+        assert r.unsuppressed != []
 
 
 # --------------------------------------------------------------------------
