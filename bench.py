@@ -116,6 +116,7 @@ def main():
         "observability": observability_leg(on_tpu),
         "fairness": fairness_leg(on_tpu),
         "cluster": cluster_leg(on_tpu),
+        "soak": soak_leg(on_tpu),
     }))
 
 
@@ -1445,6 +1446,54 @@ def disagg_subleg(on_tpu: bool, gcfg, gparams, slots: int,
                   "prompts": 2 * n_prompts, "max_new_tokens": max_new},
         "mixed": run_fleet(False),
         "disaggregated": run_fleet(True),
+    }
+
+
+def soak_leg(on_tpu: bool) -> dict:
+    """Fleet chaos soak (ISSUE 18): three real HTTP hosts over the RPC
+    plane take the seeded trace mix (chat/rag/batch over an on/off
+    arrival process) while the seeded episode schedule fires kill,
+    drain, preemption-storm, swap-pressure and rpc-fault episodes.
+
+    The headline numbers: sustained tokens/sec over the whole soak,
+    p99 latency DURING chaos-episode windows vs BETWEEN them (the tail
+    price of chaos), worst recovery-time-to-SLO after a kill/drain, and
+    the ledger verdict — True means every block, swap entry, op and
+    thread returned to its post-warmup baseline. Seeded end to end:
+    same seed, same episodes, same trace, so a drift here is a
+    robustness regression, not noise."""
+    from tools.soak import run_soak
+
+    seed = 3
+    duration_s = 16.0 if on_tpu else 14.0
+    report = run_soak(seed=seed, duration_s=duration_s, n_hosts=3,
+                      rate_rps=3.0, mean_gap_s=3.0)
+    d = report.to_dict()
+    load = d["load"]
+    rec = d["recovery_to_slo_s"]
+    return {
+        "seed": seed,
+        "duration_s": duration_s,
+        "episodes_fired": d["episodes_fired"],
+        "episode_kinds": sorted({r.episode.kind
+                                 for r in report.episodes}),
+        "requests": load["requests"],
+        "ok": load["ok"],
+        "stuck_streams": load["stuck_streams"],
+        "tokens_per_sec": load["tokens_per_sec"],
+        "watermark_clean": load["watermark_clean"],
+        "latency_p99_during_episodes_ms":
+            round(load["latency_p99_during_episodes_ms"], 3)
+            if load["latency_p99_during_episodes_ms"] is not None
+            else None,
+        "latency_p99_between_episodes_ms":
+            round(load["latency_p99_between_episodes_ms"], 3)
+            if load["latency_p99_between_episodes_ms"] is not None
+            else None,
+        "recovery_to_slo_s": rec,
+        "max_recovery_to_slo_s": d["max_recovery_to_slo_s"],
+        "ledger_clean": d["ledger_clean"],
+        "ledger_violations": d["ledger_violations"],
     }
 
 

@@ -34,6 +34,14 @@ from deeplearning4j_tpu.serving.generation import (  # noqa: F401
     GenerationEngine, GenerationHandle, SpecConfig, client_stream_handle,
     prefill_buckets,
 )
+from deeplearning4j_tpu.serving.ledger import (  # noqa: F401
+    LeakWatch, LedgerSnapshot, ResourceLedger, check_shutdown,
+    tracked_engines, tracked_rpc_servers,
+)
+from deeplearning4j_tpu.serving.loadgen import (  # noqa: F401
+    ArrivalProcess, LoadGenerator, LoadReport, TraceRequest, TraceSpec,
+    engine_submitter, front_door_submitter,
+)
 from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, ReasonCounter, ServingMetrics,
     SlidingWindowStats,
@@ -90,4 +98,8 @@ __all__ = [
     "rejected_from_wire", "client_stream_handle",
     "DisaggPolicy", "FleetPrefixIndex", "KvMigrateRequest",
     "KvMigrateResponse",
+    "LeakWatch", "LedgerSnapshot", "ResourceLedger", "check_shutdown",
+    "tracked_engines", "tracked_rpc_servers",
+    "ArrivalProcess", "LoadGenerator", "LoadReport", "TraceRequest",
+    "TraceSpec", "engine_submitter", "front_door_submitter",
 ]

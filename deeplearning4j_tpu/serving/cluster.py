@@ -1709,6 +1709,15 @@ class ClusterFrontDoor:
         with self._affinity_lock:
             return self._outstanding.get((kind, host_id), 0)
 
+    def outstanding_total(self) -> int:
+        """This front door's own in-flight dispatches across every
+        (kind, host) — the zero-leak ledger's stuck-dispatch dimension
+        (serving/ledger.py): a chaos episode that strands a hedged
+        attempt shows up here as a count that never returns to its
+        baseline."""
+        with self._affinity_lock:
+            return sum(self._outstanding.values())
+
     # ------------------------------------------------------------ routing
     def _headroom(self, st: HostStatus, kind: str, rows: int,
                   blocks_needed: int,

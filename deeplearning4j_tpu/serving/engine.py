@@ -46,6 +46,7 @@ from deeplearning4j_tpu.serving.admission import (
     QueueFullError, RejectedError, Request,
 )
 from deeplearning4j_tpu.serving.faults import inject
+from deeplearning4j_tpu.serving.ledger import track_engine
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.qos import SloBurnGovernor, resolve_qos
 from deeplearning4j_tpu.serving.resilience import (
@@ -166,6 +167,7 @@ class InferenceEngine(ResilientEngineMixin):
         self._thread.start()
         if watchdog_timeout_ms is not None:
             self.arm_watchdog(watchdog_timeout_ms)
+        track_engine(self)   # weak: the zero-leak ledger's registry
 
     # ------------------------------------------------------------ lifecycle
     def __enter__(self) -> "InferenceEngine":
@@ -554,6 +556,16 @@ class InferenceEngine(ResilientEngineMixin):
     @property
     def queue_depth_rows(self) -> int:
         return self._admission.depth_rows
+
+    def ledger_stats(self) -> dict:
+        """Point-in-time resource accounting for the zero-leak ledger
+        (serving/ledger.py): queued rows and the dispatcher's in-flight
+        batch — both must read zero once the engine is shut down."""
+        with self._wd_lock:
+            inflight = sum(r.rows for r in self._inflight)
+        return {"name": self.name,
+                "queue_depth": self._admission.depth_rows,
+                "inflight_rows": inflight}
 
 
 __all__ = ["InferenceEngine", "bucket_ladder", "RejectedError",

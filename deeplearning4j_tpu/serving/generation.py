@@ -57,6 +57,7 @@ from deeplearning4j_tpu.serving.admission import (
 )
 from deeplearning4j_tpu.serving.engine import bucket_ladder
 from deeplearning4j_tpu.serving.faults import inject
+from deeplearning4j_tpu.serving.ledger import track_engine
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.paging import (
     BlockAllocator, BlockSwapStore, PrefixCache, SharedPrefix, SwapEntry,
@@ -630,6 +631,7 @@ class GenerationEngine(ResilientEngineMixin):
         self._thread.start()
         if watchdog_timeout_ms is not None:
             self.arm_watchdog(watchdog_timeout_ms)
+        track_engine(self)   # weak: the zero-leak ledger's registry
 
     # ------------------------------------------------------------ lifecycle
     def __enter__(self) -> "GenerationEngine":
@@ -1920,6 +1922,25 @@ class GenerationEngine(ResilientEngineMixin):
                     self._swap_store.blocks_held)
             greq.swap_key = None
 
+    # queued-request disposal hooks (AdmissionController callbacks): a
+    # preemption victim requeued WITH a swap entry can die in the queue
+    # too — shutdown's close(), a caller cancel, a deadline shed. The
+    # shared-mixin accounting alone leaked the parked SwapEntry on all
+    # three paths (host RAM held until engine GC; the ISSUE 18 ledger's
+    # swap-store-empty-at-shutdown law caught it), so the generation
+    # engine layers the discard on before counting the terminal.
+    def _count_close_reject(self, req):
+        self._discard_swap(req.x)
+        super()._count_close_reject(req)
+
+    def _count_cancelled(self, req):
+        self._discard_swap(req.x)
+        super()._count_cancelled(req)
+
+    def _count_shed(self, req):
+        self._discard_swap(req.x)
+        super()._count_shed(req)
+
     # ------------------------------- cross-host KV page migration (disagg)
     def _capture_pages(self, req: Request, rows: np.ndarray, length: int,
                        n_generated: int, last_token: int, epoch: int):
@@ -3012,6 +3033,35 @@ class GenerationEngine(ResilientEngineMixin):
     @property
     def live_slots(self) -> int:
         return self._live_count()
+
+    def ledger_stats(self) -> dict:
+        """Point-in-time resource accounting for the zero-leak ledger
+        (serving/ledger.py): every countable thing this engine can hold
+        — resident slots, queued requests, KV blocks by attribution
+        (free / explicit pins / automatic cache), swap-store residency.
+        Reads only; each lock is taken briefly on its own (leaf-lock
+        hygiene), so the soak orchestrator can poll this under load."""
+        stats = {"name": self.name,
+                 "live_slots": self._live_count(),
+                 "queue_depth": self._admission.depth_requests}
+        with self._wd_lock:
+            alloc = self._allocator
+            store = self._swap_store
+            cache = self._prefix_cache
+        if alloc is not None:
+            stats["kv_capacity_blocks"] = alloc.capacity
+            stats["kv_free_blocks"] = alloc.free_count
+            stats["kv_blocks_in_use"] = alloc.in_use
+        if store is not None:
+            stats["swap_entries"] = len(store)
+            stats["swap_blocks_held"] = store.blocks_held
+        if cache is not None:
+            stats["kv_prefix_cache_blocks"] = cache.total_blocks
+        with self._prefix_lock:
+            stats["pinned_prefixes"] = len(self._prefixes)
+            stats["kv_pinned_blocks"] = sum(
+                len(p.blocks) for p in self._prefixes.values() if p.blocks)
+        return stats
 
     def warmup(self) -> "GenerationEngine":
         """Compile every prefill bucket + the decode executable up front by

@@ -23,7 +23,12 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
-# safe at module level: qos imports only admission/tracing, never metrics
+# safe at module level: qos imports only admission/tracing, never metrics;
+# ledger is stdlib-only (the /proc RSS + thread readers live there so the
+# zero-leak ledger and these gauges argue about the SAME numbers)
+from deeplearning4j_tpu.serving.ledger import (
+    process_rss_bytes as _read_rss, process_thread_counts as _read_threads,
+)
 from deeplearning4j_tpu.serving.qos import PRIORITIES
 
 
@@ -317,6 +322,15 @@ class ServingMetrics:
         self.kv_block_bytes = Gauge("kv_block_bytes")        # bytes/block
         self.kv_pool_hbm_bytes = Gauge("kv_pool_hbm_bytes")  # whole pool
         self.kv_hbm_bytes_in_use = Gauge("kv_hbm_bytes_in_use")
+        # ---- process self-observation (ISSUE 18 zero-leak ledger) --------
+        # the flat-memory / no-orphan soak gates assert on the SAME
+        # numbers operators see: current RSS and thread count refresh at
+        # snapshot() time from the ledger's /proc readers; open_ops is
+        # mirrored in by HostRpcServer's registry sweep (unresolved ops
+        # only — TTL-retained resolved ops are contract, not leak)
+        self.process_rss_bytes = Gauge("process_rss_bytes")
+        self.live_threads = Gauge("live_threads")
+        self.open_ops = Gauge("open_ops")
         # ---- resilience signals (retry / breaker / watchdog / fallback) --
         self.retries_total = Counter("retries_total")
         self.rejected_circuit_open = Counter("rejected_circuit_open")
@@ -571,6 +585,13 @@ class ServingMetrics:
     def snapshot(self) -> dict:
         with self._lock:
             per_bucket = {str(k): dict(v) for k, v in self._per_bucket.items()}
+        # live process self-observation: refreshed at read time so every
+        # consumer (/api/serving, bench, the soak ledger) sees current
+        # RSS/threads without a background sampler thread to leak
+        rss = _read_rss()
+        if rss is not None:
+            self.process_rss_bytes.set(rss)
+        self.live_threads.set(_read_threads()[0])
         return {
             "timestamp": time.time(),
             **self.counters(),
@@ -592,6 +613,9 @@ class ServingMetrics:
             "kv_block_bytes": self.kv_block_bytes.value,
             "kv_pool_hbm_bytes": self.kv_pool_hbm_bytes.value,
             "kv_hbm_bytes_in_use": self.kv_hbm_bytes_in_use.value,
+            "process_rss_bytes": self.process_rss_bytes.value,
+            "live_threads": self.live_threads.value,
+            "open_ops": self.open_ops.value,
             "rejections_by_reason": self.rejections_by_reason.to_dict(),
             "slo": self.slo_snapshot(),
             "qos": self.qos_snapshot(),

@@ -72,6 +72,7 @@ from deeplearning4j_tpu.serving.admission import (
 from deeplearning4j_tpu.serving.cluster import HostHandle, HostStatus
 from deeplearning4j_tpu.serving.faults import FaultInjectedError, inject
 from deeplearning4j_tpu.serving.generation import client_stream_handle
+from deeplearning4j_tpu.serving.ledger import track_rpc_server
 from deeplearning4j_tpu.serving.paging import SwapEntry
 from deeplearning4j_tpu.serving.tracing import (
     TERMINAL_REASONS, terminal_reason,
@@ -447,6 +448,7 @@ class HostRpcServer:
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
             daemon=True, name=f"rpc-server[h{getattr(host, 'host_id', '?')}]")
         self._thread.start()
+        track_rpc_server(self)   # weak: the zero-leak ledger's registry
 
     @property
     def port(self) -> int:
@@ -493,6 +495,29 @@ class HostRpcServer:
                     s.resolved_t = now
                 elif now - s.resolved_t > self.OP_TTL_S:
                     self._ops.pop(k, None)
+        self._publish_open_ops(len(items) - len(resolved))
+
+    def open_ops(self) -> int:
+        """Registered ops whose terminal has NOT resolved — the zero-
+        leak ledger's stuck-client dimension. TTL-retained RESOLVED ops
+        don't count: retention is the watermark-replay contract, not a
+        leak. (serving/ledger.py check_shutdown holds this to zero once
+        the host's engines are down.)"""
+        with self._lock:
+            items = list(self._ops.values())
+        # future/handle internals read outside the registry lock, same
+        # leaf-lock hygiene as _gc
+        return sum(1 for s in items if not self._op_done(s))
+
+    def _publish_open_ops(self, n: int):
+        """Mirror the op registry's unresolved count onto the host
+        engines' ``open_ops`` gauge so /api/serving shows the same
+        number the ledger asserts on (ISSUE 18 self-observation)."""
+        for eng in (getattr(self.host, "engine", None),
+                    getattr(self.host, "generation", None)):
+            m = getattr(eng, "metrics", None)
+            if m is not None:
+                m.open_ops.set(n)
 
     @staticmethod
     def _op_done(state: _OpState) -> bool:

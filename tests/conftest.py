@@ -37,6 +37,28 @@ def rtol():
     return 1e-5
 
 
+@pytest.fixture(autouse=True)
+def _leak_watch(request):
+    """Zero-leak gate for the suites that stress shutdown paths (ISSUE
+    18): after any test marked chaos/stress/soak tears down, every
+    engine/RPC server it shut down must satisfy the ledger's shutdown
+    law — allocator free list fully attributable, swap store empty,
+    zero unresolved ops, no resident slot. See serving/ledger.py."""
+    marked = any(request.node.get_closest_marker(m)
+                 for m in ("chaos", "stress", "soak"))
+    if not marked:
+        yield
+        return
+    from deeplearning4j_tpu.serving.ledger import LeakWatch
+
+    watch = LeakWatch()
+    yield
+    bad = watch.finish()
+    assert not bad, (
+        "leaked resources at engine/server shutdown:\n  "
+        + "\n  ".join(bad))
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Cap in-process compiled-executable accumulation. Running the whole

@@ -1,0 +1,297 @@
+"""Zero-leak resource ledger (serving/ledger.py, ISSUE 18): snapshot
+diffing, slack semantics, the absolute shutdown law, the settle window,
+and a live engine lifecycle through the ledger."""
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving.ledger import (
+    LeakWatch, LedgerSnapshot, ResourceLedger, check_shutdown,
+    process_rss_bytes, process_thread_counts,
+)
+
+
+def _fake_engine(name="fake", **overrides):
+    """An object with the ledger_stats surface and a mutable dict."""
+    stats = {"name": name, "live_slots": 0, "queue_depth": 0,
+             "kv_capacity_blocks": 16, "kv_free_blocks": 16,
+             "kv_blocks_in_use": 0, "swap_entries": 0,
+             "swap_blocks_held": 0, "kv_prefix_cache_blocks": 0,
+             "pinned_prefixes": 0, "kv_pinned_blocks": 0}
+    stats.update(overrides)
+    eng = types.SimpleNamespace(name=name, stats=stats)
+    eng.ledger_stats = lambda: dict(eng.stats)
+    return eng
+
+
+class TestProcessProbes:
+    def test_thread_counts(self):
+        threads, non_daemon = process_thread_counts()
+        assert threads >= 1
+        assert 0 <= non_daemon <= threads
+        ev = threading.Event()
+        t = threading.Thread(target=ev.wait, daemon=False)
+        t.start()
+        try:
+            assert process_thread_counts()[1] >= non_daemon + 1
+        finally:
+            ev.set()
+            t.join()
+
+    def test_rss_readable_on_linux(self):
+        rss = process_rss_bytes()
+        assert rss is None or rss > 1024 * 1024
+
+
+class TestSnapshotDiff:
+    def test_diff_names_moved_dimensions(self):
+        a = LedgerSnapshot(0.0, {"x": 1, "y": 2})
+        b = LedgerSnapshot(1.0, {"x": 1, "y": 5, "z": 3})
+        d = a.diff(b)
+        assert d == {"y": (2, 5), "z": (0, 3)}
+
+
+class TestResourceLedger:
+    def test_clean_when_nothing_moves(self):
+        eng = _fake_engine()
+        ledger = ResourceLedger(engines=[eng], rpc_servers=[],
+                                rss_slack_bytes=1 << 34,
+                                thread_slack=64)
+        ledger.baseline()
+        assert ledger.check() == []
+
+    def test_leak_named_exactly(self):
+        eng = _fake_engine()
+        ledger = ResourceLedger(engines=[eng], rpc_servers=[],
+                                rss_slack_bytes=1 << 34,
+                                thread_slack=64)
+        ledger.baseline()
+        eng.stats["swap_entries"] = 2
+        eng.stats["kv_free_blocks"] = 13
+        bad = ledger.check()
+        assert any("engine[fake].swap_entries" in v for v in bad)
+        assert any("engine[fake].kv_free_blocks" in v for v in bad)
+        with pytest.raises(AssertionError, match="swap_entries"):
+            ledger.assert_clean(timeout_s=0.0)
+
+    def test_settle_window_waits_for_cleanup(self):
+        eng = _fake_engine()
+        ledger = ResourceLedger(engines=[eng], rpc_servers=[],
+                                rss_slack_bytes=1 << 34,
+                                thread_slack=64)
+        ledger.baseline()
+        eng.stats["live_slots"] = 1
+
+        def release():
+            time.sleep(0.3)
+            eng.stats["live_slots"] = 0
+        threading.Thread(target=release, daemon=True).start()
+        assert ledger.check(timeout_s=5.0) == []
+
+    def test_capacity_may_shrink_but_not_grow(self):
+        # a killed host's threads leaving is not a leak; thread growth is
+        eng = _fake_engine()
+        ledger = ResourceLedger(engines=[eng], rpc_servers=[],
+                                rss_slack_bytes=1 << 34, thread_slack=0)
+        base = ledger.baseline()
+        ev = threading.Event()
+        t = threading.Thread(target=ev.wait, daemon=True)
+        t.start()
+        try:
+            bad = ledger.check()
+            assert any("process.threads" in v for v in bad)
+        finally:
+            ev.set()
+            t.join()
+        assert base.get("process.threads") >= 1
+
+    def test_front_door_outstanding_tracked(self):
+        fd = types.SimpleNamespace(outstanding_total=lambda: 0)
+        ledger = ResourceLedger(engines=[], rpc_servers=[],
+                                front_doors=[fd],
+                                rss_slack_bytes=1 << 34,
+                                thread_slack=64)
+        ledger.baseline()
+        fd.outstanding_total = lambda: 3
+        bad = ledger.check()
+        assert any("front_door[0].outstanding" in v for v in bad)
+
+    def test_tracer_retention_bounded_absolutely(self):
+        tr = types.SimpleNamespace(
+            stats=lambda: {"retained": 9, "capacity": 4})
+        ledger = ResourceLedger(engines=[], rpc_servers=[], tracers=[tr],
+                                rss_slack_bytes=1 << 34,
+                                thread_slack=64)
+        ledger.baseline()
+        bad = ledger.check()
+        assert any("exceeds ring capacity" in v for v in bad)
+
+    def test_check_requires_baseline(self):
+        with pytest.raises(RuntimeError):
+            ResourceLedger(engines=[], rpc_servers=[]).check()
+
+
+class TestShutdownLaw:
+    def test_clean_engine_passes(self):
+        assert check_shutdown(_fake_engine()) == []
+
+    def test_orphaned_blocks_detected(self):
+        # 16 capacity, 13 free, nothing pinned/cached: 3 blocks orphaned
+        eng = _fake_engine(kv_free_blocks=13, kv_blocks_in_use=3)
+        bad = check_shutdown(eng)
+        assert any("3 orphaned KV block(s)" in v for v in bad)
+
+    def test_prefix_retention_is_not_a_leak(self):
+        # pins and cache survive shutdown by design; attribution holds
+        eng = _fake_engine(kv_free_blocks=10, kv_pinned_blocks=4,
+                           kv_prefix_cache_blocks=2)
+        assert check_shutdown(eng) == []
+
+    def test_stranded_swap_entry_detected(self):
+        eng = _fake_engine(swap_entries=1, swap_blocks_held=2)
+        bad = check_shutdown(eng)
+        assert any("swap_entries" in v for v in bad)
+        assert any("swap_blocks_held" in v for v in bad)
+
+    def test_unresolved_rpc_op_detected(self):
+        srv = types.SimpleNamespace(open_ops=lambda: 2, name="srv0")
+        bad = check_shutdown(srv)
+        assert bad and "2 unresolved op(s)" in bad[0]
+        srv.open_ops = lambda: 0
+        assert check_shutdown(srv) == []
+
+
+class TestLeakWatchAccountability:
+    def test_preexisting_wreckage_excluded(self):
+        """A deliberately wrecked engine left behind by an EARLIER test
+        (shut down dirty, lingering un-GC'd in the weak registry) must
+        not fail a later test's watch — but a watch armed before the
+        shutdown still catches the same wreck."""
+        from deeplearning4j_tpu.serving.ledger import track_engine
+
+        class _Wreck:                      # weakref-able, unlike
+            def __init__(self, name):      # SimpleNamespace
+                self.name = name
+                self.stats = dict(_fake_engine(name).stats,
+                                  live_slots=1)
+                self._stop = threading.Event()
+
+            def ledger_stats(self):
+                return dict(self.stats)
+
+        wreck = _Wreck("wreck-old")
+        wreck._stop.set()                 # reads as already shut down
+        track_engine(wreck)
+        late_watch = LeakWatch()          # armed AFTER the wreckage
+        assert [v for v in late_watch.finish(settle_s=0.0)
+                if "wreck-old" in v] == []
+
+        fresh = _Wreck("wreck-new")       # still running at arm time
+        track_engine(fresh)
+        early_watch = LeakWatch()
+        fresh._stop.set()                 # shut down DURING the test
+        bad = early_watch.finish(settle_s=0.0)
+        assert any("wreck-new" in v for v in bad)
+        fresh.stats["live_slots"] = 0     # tidy the registry entry
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                            mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                            causal=True, attention_impl="full",
+                            remat=False)
+    return cfg, init_params(jax.random.PRNGKey(0), cfg)
+
+
+class TestLiveLedger:
+    def test_engine_lifecycle_through_ledger(self, tiny_model):
+        from deeplearning4j_tpu.serving import GenerationEngine
+        from deeplearning4j_tpu.serving.ledger import tracked_engines
+
+        cfg, params = tiny_model
+        g = GenerationEngine(params, cfg, slots=2, max_len=48,
+                             allocate="on_demand",
+                             swap_threshold_blocks=1,
+                             name="ledger-live")
+        assert g in tracked_engines()     # __init__ registers weakly
+        ledger = ResourceLedger(engines=[g], rpc_servers=[],
+                                rss_slack_bytes=1 << 34,
+                                thread_slack=64)
+        prompt = np.arange(1, 7, dtype=np.int32)
+        g.submit(prompt, max_new_tokens=2, seed=1).result(timeout=300)
+        ledger.baseline()
+        hs = [g.submit(prompt, max_new_tokens=4, seed=i)
+              for i in range(4)]
+        for h in hs:
+            h.result(timeout=300)
+        assert ledger.check(timeout_s=20.0) == []
+        g.shutdown()
+        assert check_shutdown(g) == []
+
+    def test_leak_watch_sweeps_shut_down_engines(self, tiny_model):
+        from deeplearning4j_tpu.serving import GenerationEngine
+
+        cfg, params = tiny_model
+        watch = LeakWatch()
+        g = GenerationEngine(params, cfg, slots=2, max_len=48,
+                             allocate="on_demand",
+                             swap_threshold_blocks=1,
+                             name="ledger-watch")
+        g.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=2,
+                 seed=1).result(timeout=300)
+        g.shutdown()
+        assert watch.finish(settle_s=10.0) == []
+
+    def test_close_reject_discards_swap_entries(self, tiny_model):
+        """The leak this PR fixed: a queued request whose KV pages were
+        swapped out (a requeued preemption victim) must have its swap
+        entry discarded when shutdown's close-reject fails it — not
+        stranded in the host-RAM store forever."""
+        from deeplearning4j_tpu.serving import GenerationEngine
+
+        cfg, params = tiny_model
+        g = GenerationEngine(params, cfg, slots=2, max_len=48,
+                             allocate="on_demand",
+                             swap_threshold_blocks=1,
+                             queue_capacity=8, name="ledger-closerej")
+        try:
+            prompt = np.arange(1, 20, dtype=np.int32)
+            # saturate both slots with long decodes, then pile
+            # interactive arrivals on top to force batch preemption
+            # (swap-out), leaving swapped victims queued at shutdown
+            slow = [g.submit(prompt, max_new_tokens=24, seed=i,
+                             priority="batch") for i in range(2)]
+            time.sleep(0.2)
+            burst = [g.submit(prompt, max_new_tokens=24, seed=10 + i,
+                              priority="interactive") for i in range(4)]
+        finally:
+            g.shutdown()
+        for h in slow + burst:     # resolve every stream either way —
+            try:                   # raced completions are fine, what
+                h.result(timeout=60)   # matters is the ledger below
+            except Exception:
+                pass
+        bad = check_shutdown(g)
+        assert bad == [], f"shutdown stranded resources: {bad}"
+
+
+class TestMetricsGauges:
+    def test_snapshot_exports_process_gauges(self):
+        from deeplearning4j_tpu.serving import ServingMetrics
+
+        m = ServingMetrics()
+        snap = m.snapshot()
+        for key in ("process_rss_bytes", "live_threads", "open_ops"):
+            assert key in snap, f"{key} missing from snapshot"
+        assert snap["live_threads"] >= 1
+        if process_rss_bytes() is not None:
+            assert snap["process_rss_bytes"] > 0

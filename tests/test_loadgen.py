@@ -1,0 +1,236 @@
+"""Trace-driven load generation (serving/loadgen.py, ISSUE 18): seeded
+trace determinism, arrival-process shapes, workload-family geometry,
+report windowing, and a live replay against a real GenerationEngine."""
+import concurrent.futures
+import dataclasses
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving.loadgen import (
+    WORKLOAD_KINDS, ArrivalProcess, LoadGenerator, LoadReport,
+    RequestRecord, TraceSpec, engine_submitter, front_door_submitter,
+)
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+class TestArrivalProcess:
+    def test_poisson_sorted_within_horizon(self):
+        arr = ArrivalProcess(kind="poisson", rate_rps=20.0)
+        times = arr.arrivals(5.0, _rng())
+        assert times == sorted(times)
+        assert all(0.0 < t < 5.0 for t in times)
+        # 20 rps over 5 s: ~100 expected, loose 3-sigma-ish band
+        assert 60 <= len(times) <= 150
+
+    def test_poisson_seed_determinism(self):
+        arr = ArrivalProcess(kind="poisson", rate_rps=8.0)
+        assert arr.arrivals(10.0, _rng(3)) == arr.arrivals(10.0, _rng(3))
+        assert arr.arrivals(10.0, _rng(3)) != arr.arrivals(10.0, _rng(4))
+
+    def test_onoff_silent_off_windows(self):
+        # off_rate 0: every arrival must land inside an on-window
+        arr = ArrivalProcess(kind="onoff", rate_rps=30.0, on_s=1.0,
+                             off_s=1.0, off_rate_rps=0.0)
+        times = arr.arrivals(10.0, _rng(7))
+        assert times, "on/off process produced no arrivals"
+        for t in times:
+            assert (t % 2.0) < 1.0, f"arrival {t} inside an off window"
+
+    def test_ramp_density_increases(self):
+        arr = ArrivalProcess(kind="ramp", rate_rps=40.0,
+                             start_rate_rps=1.0)
+        times = arr.arrivals(10.0, _rng(11))
+        first = sum(1 for t in times if t < 5.0)
+        second = sum(1 for t in times if t >= 5.0)
+        assert second > first * 1.5
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            ArrivalProcess(kind="lognormal")
+        with pytest.raises(ValueError):
+            ArrivalProcess(rate_rps=0.0)
+
+
+class TestTraceSpec:
+    def test_same_seed_bit_identical_trace(self):
+        spec = TraceSpec(seed=42, duration_s=8.0)
+        assert spec.generate() == spec.generate()
+
+    def test_different_seed_different_trace(self):
+        a = TraceSpec(seed=1, duration_s=8.0).generate()
+        b = TraceSpec(seed=2, duration_s=8.0).generate()
+        assert a != b
+
+    def test_shapes_fit_engine_capacity(self):
+        spec = TraceSpec(seed=5, duration_s=20.0, max_len=48)
+        trace = spec.generate()
+        assert trace, "empty trace"
+        for tr in trace:
+            assert len(tr.prompt) + tr.max_new_tokens <= spec.max_len
+            assert tr.max_new_tokens >= 1
+            assert all(0 < t < spec.vocab_size for t in tr.prompt)
+
+    def test_family_geometry(self):
+        spec = TraceSpec(seed=5, duration_s=30.0)
+        trace = spec.generate()
+        by_kind = {k: [t for t in trace if t.kind == k]
+                   for k in WORKLOAD_KINDS}
+        for k in WORKLOAD_KINDS:
+            assert by_kind[k], f"no {k} requests in 30 s trace"
+        prefix = spec.system_prefix()
+        for tr in by_kind["chat"]:
+            assert tr.prompt[:len(prefix)] == prefix
+            assert tr.priority == "interactive"
+        for tr in by_kind["rag"]:
+            # rag: huge prompt, short decode
+            assert tr.max_new_tokens <= 6
+            assert len(tr.prompt) > spec.max_len // 2
+            assert tr.tenant == "rag"
+        for tr in by_kind["batch"]:
+            assert tr.priority == "batch"
+
+    def test_batch_arrives_in_clumps(self):
+        spec = TraceSpec(seed=9, duration_s=30.0,
+                         mix={"batch": 1.0})
+        trace = spec.generate()
+        assert all(t.kind == "batch" for t in trace)
+        # at least one clump: two batch requests within the 50 ms fan
+        gaps = [b.arrival_s - a.arrival_s
+                for a, b in zip(trace, trace[1:])]
+        assert any(g <= 0.05 for g in gaps)
+
+    def test_mix_can_zero_a_family(self):
+        spec = TraceSpec(seed=3, duration_s=20.0,
+                         mix={"chat": 1.0, "rag": 0.0, "batch": 0.0})
+        assert all(t.kind == "chat" for t in spec.generate())
+        with pytest.raises(ValueError):
+            TraceSpec(mix={"chat": 0.0}).generate()
+
+    def test_indices_sorted_and_dense(self):
+        trace = TraceSpec(seed=4, duration_s=15.0).generate()
+        assert [t.index for t in trace] == list(range(len(trace)))
+        arrivals = [t.arrival_s for t in trace]
+        assert arrivals == sorted(arrivals)
+
+
+class TestLoadReport:
+    def _rec(self, i, submit, done, ok=True, tokens=3):
+        return RequestRecord(index=i, kind="chat", tenant="t",
+                             submit_t=submit, done_t=done, ok=ok,
+                             reason="ok" if ok else "shed",
+                             tokens=tokens)
+
+    def test_windowed_percentiles_split_episodes(self):
+        # two fast completions outside the window, one slow inside
+        recs = [self._rec(0, 0.0, 0.1), self._rec(1, 0.0, 0.2),
+                self._rec(2, 9.0, 12.0)]
+        rep = LoadReport(recs, 0.0, 13.0)
+        windows = [(10.0, 12.5)]
+        inside = rep.latency_percentile(99, windows, inside=True)
+        outside = rep.latency_percentile(99, windows, inside=False)
+        assert inside == pytest.approx(3000.0)
+        assert outside == pytest.approx(200.0, rel=0.01)
+
+    def test_stuck_and_tokens(self):
+        recs = [self._rec(0, 0.0, 1.0, tokens=10),
+                RequestRecord(index=1, kind="rag", tenant="t",
+                              submit_t=0.0)]      # never resolved
+        rep = LoadReport(recs, 0.0, 2.0)
+        assert rep.stuck_streams == 1
+        assert rep.total_tokens == 10
+        assert rep.tokens_per_sec == pytest.approx(5.0)
+        d = rep.to_dict()
+        assert d["stuck_streams"] == 1
+        assert d["latency_p99_during_episodes_ms"] is None
+
+
+class TestLoadGenerator:
+    def _handle(self, future):
+        return types.SimpleNamespace(future=future)
+
+    def test_submit_time_shed_recorded_not_raised(self):
+        from deeplearning4j_tpu.serving import QueueFullError
+
+        def submit(tr, on_token):
+            raise QueueFullError("full")
+
+        trace = TraceSpec(seed=1, duration_s=2.0).generate()
+        rep = LoadGenerator(trace, submit, speed=100.0,
+                            drain_timeout_s=1.0).run()
+        assert len(rep.records) == len(trace)
+        assert rep.reasons() == {"queue_full": len(trace)}
+        assert rep.stuck_streams == 0     # resolved-at-submit, not stuck
+
+    def test_unresolved_stream_becomes_stuck(self):
+        def submit(tr, on_token):
+            return self._handle(concurrent.futures.Future())
+
+        trace = TraceSpec(seed=1, duration_s=0.5).generate()[:3]
+        t0 = time.monotonic()
+        rep = LoadGenerator(trace, submit, speed=100.0,
+                            drain_timeout_s=0.5).run()
+        assert time.monotonic() - t0 < 5.0
+        assert rep.stuck_streams == len(trace)
+        assert all(r.reason == "stuck" for r in rep.records)
+
+    def test_watermark_violation_detected(self):
+        # stream one token, resolve with two: delivery lost a token
+        def submit(tr, on_token):
+            fut = concurrent.futures.Future()
+
+            def later():
+                on_token(7)
+                fut.set_result([7, 8])
+            threading.Thread(target=later, daemon=True).start()
+            return self._handle(fut)
+
+        trace = TraceSpec(seed=1, duration_s=0.5).generate()[:2]
+        rep = LoadGenerator(trace, submit, speed=100.0,
+                            drain_timeout_s=5.0).run()
+        assert rep.stuck_streams == 0
+        assert not rep.watermark_clean
+
+
+@pytest.fixture(scope="module")
+def tiny_gen():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import TransformerConfig, init_params
+    from deeplearning4j_tpu.serving import GenerationEngine
+
+    cfg = TransformerConfig(vocab_size=50, hidden=32, layers=2, heads=2,
+                            mlp_dim=64, max_seq=64, dtype=jnp.float32,
+                            causal=True, attention_impl="full",
+                            remat=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    g = GenerationEngine(params, cfg, slots=4, max_len=48,
+                         allocate="on_demand", swap_threshold_blocks=1,
+                         name="loadgen-test")
+    yield g
+    g.shutdown()
+
+
+class TestLiveReplay:
+    def test_replay_against_engine(self, tiny_gen):
+        spec = TraceSpec(seed=6, duration_s=3.0,
+                         arrival=ArrivalProcess(rate_rps=6.0))
+        gen = LoadGenerator(spec.generate(), engine_submitter(tiny_gen),
+                            speed=4.0, drain_timeout_s=60.0)
+        rep = gen.run()
+        assert rep.records, "trace generated no requests"
+        assert rep.stuck_streams == 0
+        assert rep.watermark_clean
+        assert rep.total_tokens > 0
+        ok = [r for r in rep.records if r.ok]
+        assert ok
+        for r in ok:
+            assert r.ttft_ms is not None and r.ttft_ms >= 0
+            assert r.latency_ms >= r.ttft_ms

@@ -1314,14 +1314,15 @@ class TestRealPackageGate:
             "exception-chaining"}
 
     def test_no_new_pytest_markers(self):
-        """ISSUE 11 satellite: the lockdep/analysis tests reuse the
-        ``analysis`` marker — pytest.ini's marker set must not grow."""
+        """ISSUE 11 satellite (amended by ISSUE 18's ``soak`` marker for
+        the fleet chaos soak tier): pytest.ini's marker set must not
+        grow past this explicit list."""
         cp = configparser.ConfigParser()
         cp.read(REPO / "pytest.ini")
         names = {line.strip().split(":")[0]
                  for line in cp["pytest"]["markers"].splitlines()
                  if line.strip()}
-        assert names == {"slow", "stress", "chaos", "analysis"}
+        assert names == {"slow", "stress", "chaos", "analysis", "soak"}
 
     def test_taxonomy_checker_sees_real_terminal_reasons(self):
         """The generalized drift guard is actually armed: dropping a
@@ -1740,6 +1741,51 @@ class KvMigrateFailedError(RejectedError):
         clean = analyze_sources(sources, rules=["taxonomy-drift"])
         assert [f for f in clean.unsuppressed
                 if "migrate" in f.message.lower()] == []
+
+
+# --------------------------------------------------------------------------
+# Fleet chaos soak (ISSUE 18 satellite): the load/chaos/ledger modules
+# ride the same gate, and the ledger adds no terminal vocabulary
+# --------------------------------------------------------------------------
+class TestSoakGate:
+    SOAK_FILES = (
+        os.path.join(SERVING, "loadgen.py"),
+        os.path.join(SERVING, "ledger.py"),
+        os.path.join(TOOLS, "soak.py"),
+    )
+
+    def test_soak_modules_zero_unsuppressed(self):
+        """serving/loadgen.py, serving/ledger.py and tools/soak.py
+        analyze clean under every checker — the whole harness, no new
+        baseline entries."""
+        for p in self.SOAK_FILES:
+            assert os.path.exists(p), p
+        report = analyze_paths(list(self.SOAK_FILES),
+                               baseline=Baseline.load(DEFAULT_BASELINE))
+        assert report.errors == []
+        pretty = "\n".join(f"{f.location()}: {f.rule}: {f.message}"
+                           for f in report.unsuppressed)
+        assert report.unsuppressed == [], pretty
+
+    def test_ledger_adds_no_terminal_reasons(self):
+        """The ledger reports leaks as dimension strings, never as
+        typed request terminals: neither ledger.py nor loadgen.py may
+        add entries to tracing.TERMINAL_REASONS, and the taxonomy
+        checker over serving/ stays clean with them in scope (loadgen's
+        'stuck' / 'pending' are report labels, not shed reasons)."""
+        from deeplearning4j_tpu.serving.tracing import TERMINAL_REASONS
+
+        assert "stuck" not in TERMINAL_REASONS
+        assert "pending" not in TERMINAL_REASONS
+        sources = {}
+        for name in os.listdir(SERVING):
+            if name.endswith(".py"):
+                q = os.path.join(SERVING, name)
+                with open(q) as f:
+                    sources[q] = f.read()
+        r = analyze_sources(sources, rules=["taxonomy-drift"])
+        assert [f for f in r.unsuppressed
+                if "ledger" in f.path or "loadgen" in f.path] == []
 
 
 # --------------------------------------------------------------------------
