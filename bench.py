@@ -741,6 +741,123 @@ def observability_leg(on_tpu: bool) -> dict:
             (off["requests_per_sec"] - on["requests_per_sec"])
             / off["requests_per_sec"] * 100.0, 2),
         "traces_retained": tracer.stats()["retained"],
+        "cross_host": _cross_host_tracing_cell(n_requests),
+        "planner_cost_model": _planner_cost_model_cell(),
+    }
+
+
+def _cross_host_tracing_cell(n_requests: int) -> dict:
+    """Cross-host stitched tracing overhead (ISSUE 19): the same seeded
+    traffic through a 2-host loopback cluster front door with tracing
+    OFF (the default — no trace context even built) and at 100%
+    sampling with per-host tracers, wire-v3 context propagation, and
+    the aggregator's stitched view. ``overhead_us_per_request`` should
+    hold the single-host ~10 us/request envelope plus the one
+    dict-kwarg hop per dispatch; the off condition must sit within
+    noise of the plain engine path (it IS the plain path: NULL_TRACE
+    means zero extra kwargs touch the wire)."""
+    from deeplearning4j_tpu.serving import (
+        ClusterDirectory, ClusterFrontDoor, ClusterStatsAggregator,
+        HeartbeatPump, InferenceEngine, LoopbackHost, LoopbackTransport,
+        Tracer)
+
+    def run(traced):
+        cap = 3 * n_requests
+        fd_tracer = Tracer(sample_rate=1.0, capacity=cap) if traced \
+            else None
+        d = ClusterDirectory(heartbeat_timeout_s=60.0)
+        engines, hosts = [], []
+        for i in range(2):
+            ekw = ({"tracer": Tracer(sample_rate=1.0, capacity=cap)}
+                   if traced else {})
+            eng = InferenceEngine(
+                _tiny_mlp_adapter(), max_batch_size=8, max_wait_ms=0.0,
+                queue_capacity_rows=n_requests + 8,
+                name=f"xhost-{'on' if traced else 'off'}{i}", **ekw)
+            eng.warmup(np.zeros(16, np.float32))
+            h = LoopbackHost(i, engine=eng, **ekw)
+            d.join(h)
+            HeartbeatPump(h, LoopbackTransport(d)).pump_once()
+            engines.append(eng)
+            hosts.append(h)
+        fd = ClusterFrontDoor(d, tracer=fd_tracer)
+        try:
+            rng = np.random.default_rng(0)
+            xs = [rng.standard_normal((1, 16)).astype(np.float32)
+                  for _ in range(n_requests)]
+            dts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for f in [fd.submit(x) for x in xs]:
+                    f.result(timeout=120)
+                dts.append(time.perf_counter() - t0)
+            dt = sorted(dts)[1]
+            out = {"requests_per_sec": round(n_requests / dt, 2)}
+            if traced:
+                agg = ClusterStatsAggregator(d, hosts=hosts)
+                agg.estimate_clock_offsets()
+                stitched = agg.stitched_traces()
+                out["stitched_traces"] = len(stitched)
+                out["multi_span"] = sum(
+                    1 for s in stitched if s["span_count"] >= 2)
+            return out, dt
+        finally:
+            for h in hosts:
+                h.shutdown()
+
+    (off, dt_off), (on, dt_on) = run(False), run(True)
+    return {
+        "requests": n_requests,
+        "hosts": 2,
+        "sampling_off": off,
+        "sampling_100_stitched": on,
+        "overhead_us_per_request": round(
+            (dt_on - dt_off) / n_requests * 1e6, 2),
+        "single_host_envelope_us": 10.0,
+    }
+
+
+def _planner_cost_model_cell() -> dict:
+    """Cost-model fit quality (ISSUE 19 / ROADMAP 4b): seeded synthetic
+    fleet telemetry with a KNOWN tokens/sec curve plus noise, fitted by
+    ``fit_cost_models`` exactly the way the elasticity planner does —
+    headline numbers are the recovered full-occupancy rate vs ground
+    truth and whether the planner's decision log cites the fitted
+    cost-per-token (the join/drain unit-economics citation)."""
+    from deeplearning4j_tpu.serving import (
+        ElasticityPlanner, TimeSeriesStore, config_key)
+
+    true_at_full = 80.0    # rate = 100 - 20*occ
+    rng = np.random.default_rng(0)
+    ts = TimeSeriesStore()
+    for i in range(64):
+        occ = float(rng.uniform(0.05, 1.0))
+        ts.record(0, {
+            "t": float(i),
+            "slot_occupancy": occ,
+            "tokens_per_sec": 100.0 - 20.0 * occ
+            + float(rng.normal(0.0, 2.0)),
+            "host_class": "decode",
+        })
+    planner = ElasticityPlanner(timeseries=ts)
+    dec = planner.observe({
+        "fleet": {"hosts": 1, "alive": 1, "draining": 0,
+                  "slots": 8, "free_slots": 4},
+        "hosts": {}, "front_doors": []})
+    key = config_key("decode", None)
+    m = dec["cost_model"]["models"][key]
+    return {
+        "samples": 64,
+        "true_tokens_per_sec_at_full": true_at_full,
+        "fitted_tokens_per_sec_at_full": round(
+            m["tokens_per_sec_at_full"], 2),
+        "fit_error_pct": round(
+            abs(m["tokens_per_sec_at_full"] - true_at_full)
+            / true_at_full * 100.0, 2),
+        "r2": round(m["r2"], 4),
+        "cost_per_token_host_s": m["cost_per_token"],
+        "decision_cites_cost_per_token":
+            "fitted cost/token" in dec["reason"],
     }
 
 

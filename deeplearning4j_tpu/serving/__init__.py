@@ -67,6 +67,9 @@ from deeplearning4j_tpu.serving.tracing import (  # noqa: F401
     flight_recorder, terminal_reason,
 )
 from deeplearning4j_tpu.serving import tracing as tracing  # noqa: F401
+from deeplearning4j_tpu.serving.timeseries import (  # noqa: F401
+    TimeSeriesStore, cheapest_cell, config_key, fit_cost_models,
+)
 
 __all__ = [
     "AdmissionController", "DeadlineExceededError", "KVBlocksExhaustedError",
@@ -102,4 +105,5 @@ __all__ = [
     "tracked_engines", "tracked_rpc_servers",
     "ArrivalProcess", "LoadGenerator", "LoadReport", "TraceRequest",
     "TraceSpec", "engine_submitter", "front_door_submitter",
+    "TimeSeriesStore", "cheapest_cell", "config_key", "fit_cost_models",
 ]

@@ -152,12 +152,21 @@ class HostStatus:
     # heartbeats keep parsing mid-rolling-upgrade.
     draining: bool = False
     seq: int = 0                     # host-side monotone heartbeat counter
+    # fleet time-series telemetry (ISSUE 19): the host's wall clock at
+    # status time (the aggregator's NTP-style skew estimate reads it
+    # against its own probe round-trip) and one compact
+    # timeseries.SAMPLE_FIELDS dict, shipped only when the host has a
+    # TimeSeriesStore attached. Defaulted — a wire-v1 sender's heartbeat
+    # parses with no sample (the fleet ring simply never sees that
+    # host), a wire-v1 receiver's known-field filter drops both.
+    wall_t: float = 0.0
+    sample: Optional[dict] = None
     # wire-format version for rolling upgrades: receivers branch on this
     # instead of guessing from field shapes, and from_dict's known-field
     # filter + the defaults above mean old<->new mixes keep heartbeating
     # (the wire-schema-drift lint enforces this shape for every wire
     # dataclass — see tools/analysis/wire_schema.py)
-    wire_version: int = 1
+    wire_version: int = 2
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -196,7 +205,7 @@ class HostHandle:
         raise NotImplementedError
 
     def submit_infer(self, x, *, timeout_ms=None, tenant=None,
-                     priority=None):
+                     priority=None, trace_link=None, trace_parent=None):
         raise NotImplementedError
 
     def submit_generate(self, prompt, **kwargs):
@@ -218,7 +227,7 @@ class LoopbackHost(HostHandle):
 
     def __init__(self, host_id: int, *, engine=None, generation=None,
                  tracer=None, name: Optional[str] = None,
-                 host_class: str = "mixed"):
+                 host_class: str = "mixed", timeseries=None):
         if host_class not in ("prefill", "decode", "mixed"):
             raise ValueError(
                 f"host_class must be 'prefill', 'decode' or 'mixed', "
@@ -230,18 +239,40 @@ class LoopbackHost(HostHandle):
         self._engine = engine
         self._generation = generation
         self._tracer = tracer
+        # fleet time-series telemetry (ISSUE 19): an optional
+        # timeseries.TimeSeriesStore — when attached, every status()
+        # call (heartbeat cadence by construction: the pump publishes
+        # status) builds one compact sample, folds it into this host's
+        # own ring, and ships it on the heartbeat for the fleet-side
+        # ring. None (default) is bitwise-inert: no sample is built and
+        # HostStatus.sample stays None, the wire-v1 shape.
+        self._timeseries = timeseries
         self._draining = False
         self._seq = 0
+        self._stamp_recorders()
 
     # ------------------------------------------------------------ wiring
+    def _stamp_recorders(self):
+        """Make this host's engines' flight-recorder events attributable
+        at RECORD time: a merged incident ring (check_shutdown, crash
+        dumps) then needs no worker-prefix cross-referencing. One host
+        per process in production; a single-process multi-host test that
+        inspects stamps gives each engine its own recorder."""
+        for eng in (self._engine, self._generation):
+            rec = getattr(eng, "_recorder", None)
+            if rec is not None:
+                rec.set_host(self.host_id)
+
     def attach_engine(self, engine) -> "LoopbackHost":
         with self._lock:
             self._engine = engine
+        self._stamp_recorders()
         return self
 
     def attach_generation(self, generation) -> "LoopbackHost":
         with self._lock:
             self._generation = generation
+        self._stamp_recorders()
         return self
 
     @property
@@ -319,6 +350,28 @@ class LoopbackHost(HostHandle):
                 s = windows[0][1].stats()
                 st.slo_error_rate = s["error_rate"]
                 st.slo_p99_ms = s["p99_ms"]
+        # the host's wall clock at status time: the aggregator's skew
+        # estimate reads it against its own probe round-trip midpoint
+        st.wall_t = time.time()
+        if self._timeseries is not None and metrics is not None:
+            # heartbeat-cadence sampling: status() IS the beat (the
+            # pump publishes it), so one sample per beat, decorated
+            # with the host identity the cost models cell on
+            sample = metrics.timeseries_sample()
+            sample["t"] = st.wall_t
+            sample["host_class"] = self.host_class
+            sample["slots"] = st.slots
+            sample["free_slots"] = st.free_slots
+            sample["gen_queue_depth"] = st.gen_queue_depth
+            if gen is not None:
+                sample["config"] = {
+                    "kv_dtype": getattr(gen, "kv_dtype", "float32"),
+                    "allocate": getattr(gen, "allocate", "reserve"),
+                    "paged_attention":
+                        (getattr(gen, "paged_attention", "none")
+                         if getattr(gen, "paged", False) else "none"),
+                }
+            st.sample = self._timeseries.record(self.host_id, sample)
         return st
 
     # ----------------------------------------------------------- submits
@@ -329,7 +382,7 @@ class LoopbackHost(HostHandle):
                 "ahead of a graceful leave", host=self.host_id)
 
     def submit_infer(self, x, *, timeout_ms=None, tenant=None,
-                     priority=None):
+                     priority=None, trace_link=None, trace_parent=None):
         self._drain_gate()
         eng = self.engine
         if eng is None:
@@ -337,7 +390,8 @@ class LoopbackHost(HostHandle):
                 f"host {self.host_id} serves no batch-inference engine",
                 host=self.host_id)
         return eng.submit(x, timeout_ms=timeout_ms, tenant=tenant,
-                          priority=priority)
+                          priority=priority, trace_link=trace_link,
+                          trace_parent=trace_parent)
 
     def submit_generate(self, prompt, **kwargs):
         self._drain_gate()
@@ -591,7 +645,7 @@ class ClusterDirectory:
                  probe_interval_s: Optional[float] = None,
                  quorum: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 recorder=None):
+                 recorder=None, timeseries=None):
         if heartbeat_timeout_s <= 0:
             raise ValueError("heartbeat_timeout_s must be positive")
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
@@ -617,6 +671,10 @@ class ClusterDirectory:
         self._draining_ids: set = set()
         self._ingest_cursor: Dict[str, int] = {}
         self._front_doors: "weakref.WeakSet" = weakref.WeakSet()
+        # fleet-side time-series ring (ISSUE 19): every heartbeat whose
+        # HostStatus carries a sample folds it here — None (default) is
+        # bitwise-inert, heartbeats are handled exactly as before
+        self.timeseries = timeseries
         self._recorder = recorder if recorder is not None \
             else flight_recorder()
         with _DIRECTORIES_LOCK:
@@ -698,6 +756,10 @@ class ClusterDirectory:
             self._status[hid] = status
             self._seen_at[hid] = self._clock()
             self._probe_at.pop(hid, None)
+        if self.timeseries is not None and status.sample is not None:
+            # fleet-side fold: the heartbeat carried one sample (wire
+            # v2, defaulted — v1 senders never reach here)
+            self.timeseries.record(hid, status.sample)
         if was_stale:
             self._recorder.record("cluster.heartbeat_recovered", host=hid)
 
@@ -1143,6 +1205,15 @@ class _HedgedStream:
                 resume = None
             rkw = {} if resume is None else {
                 "resume_tokens": resume, "resume_step": len(resume)}
+            # wire-v3 trace context: each attempt (first dispatch,
+            # hedge, re-dispatch) is a labeled child span of the
+            # front-door root — attempt index in the parent-span label
+            # so the stitched view tells a resume leg from a hedge leg
+            if self.trace.trace_id is not None:
+                rkw["trace_link"] = self.trace.trace_id
+                rkw["trace_parent"] = (
+                    f"attempt{idx}" if resume is None
+                    else f"attempt{idx}:resume@{len(resume)}")
             try:
                 stream = h.open_stream(
                     self.toks, timeout_ms=self._remaining_ms(),
@@ -1579,9 +1650,12 @@ class _HedgedInfer:
                                          exclude=exclude)
             self.trace.event("cluster.route", host=hid, decision=how,
                              kind="infer", hedged=True)
+            tkw = {} if self.trace.trace_id is None else {
+                "trace_link": self.trace.trace_id,
+                "trace_parent": "hedge"}
             backup = (hid, h.submit_infer(
                 self.arr, timeout_ms=remaining, tenant=self.tenant,
-                priority=self.priority))
+                priority=self.priority, **tkw))
         except RejectedError as e:
             self.trace.event("cluster.hedge", kind="infer",
                              failed=getattr(e, "reason", "rpc_error"))
@@ -1899,6 +1973,13 @@ class ClusterFrontDoor:
         self.metrics.requests_total.inc()
         trace = self._tracer.begin(self.name, "cluster.infer", rows=rows,
                                    tenant=label)
+        # wire-v3 trace context (ISSUE 19): the routed host's engine
+        # trace becomes a child leg of this front-door root. A disabled
+        # tracer's NULL_TRACE has trace_id None → no kwargs → the
+        # dispatch is bitwise the pre-v3 call (and a v2 receiver would
+        # ignore the fields anyway).
+        tkw = {} if trace.trace_id is None else {
+            "trace_link": trace.trace_id, "trace_parent": "attempt1"}
         t0 = time.perf_counter()
         tried: List[int] = []
         bounced_full = 0
@@ -1917,7 +1998,8 @@ class ClusterFrontDoor:
                         kind="infer")
             try:
                 fut = h.submit_infer(arr, timeout_ms=timeout_ms,
-                                     tenant=tenant, priority=priority)
+                                     tenant=tenant, priority=priority,
+                                     **tkw)
             except RejectedError as e:
                 # heartbeat lag: the host filled (or shut down) since
                 # its last beat — fold it out and try the next candidate
@@ -2042,11 +2124,13 @@ class ClusterFrontDoor:
                 return sup.start((h, hid, how))
             trace.event("cluster.route", host=hid, decision=how,
                         kind="generate", blocks_needed=needed)
+            tkw = {} if trace.trace_id is None else {
+                "trace_link": trace.trace_id, "trace_parent": "route"}
             try:
                 handle = h.submit_generate(
                     toks, max_new_tokens=max_new_tokens,
                     prefix_id=prefix_id, tenant=tenant, priority=priority,
-                    **kwargs)
+                    **tkw, **kwargs)
             except RejectedError as e:
                 tried.append(hid)
                 if e.reason in self.CAPACITY_BOUNCE_REASONS:
@@ -2118,21 +2202,99 @@ class ClusterStatsAggregator:
     ``h<id>``), tail-sampled traces with host-prefixed trace ids, and
     merged Chrome lanes where every track is ``h<id>/tenant/trace-id``
     (Perfetto sorts lexically, so each host's tenants cluster under
-    that host's lanes)."""
+    that host's lanes).
+
+    With wire-v3 trace context (ISSUE 19) the per-host traces carry
+    ``link``/``parent_span`` back to their front-door root, and the
+    aggregator STITCHES them: :meth:`stitched_traces` groups every
+    host's child legs under the logical stream's root trace, and
+    :meth:`stitched_chrome_events` renders root + legs on ONE timeline
+    with each host's events shifted by its estimated clock-skew offset
+    (:meth:`estimate_clock_offsets` — NTP's classic midpoint estimate
+    over a status round-trip: ``offset = host_wall_t - (t_before +
+    t_after) / 2``). ``hosts`` optionally names LoopbackHosts whose
+    traces should aggregate even though the directory routes to them
+    through another handle (an RPC fleet's server-side hosts — the
+    observability side-channel in single-process tests)."""
 
     def __init__(self, directory: ClusterDirectory, storage=None,
-                 session_id: str = "cluster"):
+                 session_id: str = "cluster", hosts=None):
         self.directory = directory
         self.storage = storage
         self.session_id = session_id
+        self._extra_hosts: List[LoopbackHost] = list(hosts or ())
+        self._offsets: Dict[int, float] = {}
 
     def _loopback_hosts(self) -> List[LoopbackHost]:
         out = []
+        seen = set()
         for hid in self.directory.host_ids():
             h = self.directory.handle(hid)
             if isinstance(h, LoopbackHost):
                 out.append(h)
+                seen.add(id(h))
+        for h in self._extra_hosts:
+            if id(h) not in seen:
+                out.append(h)
         return out
+
+    def _front_door_tracers(self) -> list:
+        """Each front door's tracer, deduped (front doors may share
+        one) — the stitched view's root-trace source."""
+        with self.directory._hb_lock:
+            fds = list(self.directory._front_doors)
+        tracers, seen = [], set()
+        for fd in fds:
+            tr = fd._tracer
+            if tr is not None and id(tr) not in seen:
+                seen.add(id(tr))
+                tracers.append(tr)
+        return tracers
+
+    # ------------------------------------------------------- clock skew
+    def estimate_clock_offsets(self) -> Dict[int, float]:
+        """Per-host clock-skew offsets (seconds a host's wall clock runs
+        AHEAD of the coordinator's), NTP midpoint estimate: probe the
+        host's status round-trip and read its ``wall_t`` stamp against
+        the probe midpoint. Accuracy is bounded by half the RTT — the
+        heartbeat-grade bound the stitched timeline needs (spans are
+        hundreds of µs and up), not a time-sync service. Cached for the
+        stitched exports; re-estimate whenever drift matters."""
+        offsets: Dict[int, float] = {}
+        probed = set()
+        for hid in self.directory.host_ids():
+            h = self.directory.handle(hid)
+            if h is None:
+                continue
+            off = self._probe_offset(h)
+            if off is not None:
+                offsets[hid] = off
+            probed.add(hid)
+        for h in self._extra_hosts:
+            if h.host_id in probed:
+                continue
+            off = self._probe_offset(h)
+            if off is not None:
+                offsets[h.host_id] = off
+        self._offsets = offsets
+        return offsets
+
+    @staticmethod
+    def _probe_offset(h: HostHandle) -> Optional[float]:
+        t_before = time.time()
+        try:
+            st = h.status()
+        except Exception:
+            return None   # a dead host stitches uncorrected, not at all
+        t_after = time.time()
+        wall = float(getattr(st, "wall_t", 0.0) or 0.0)
+        if not wall:
+            return None   # wire-v1 peer: no stamp, assume no skew
+        return wall - (t_before + t_after) / 2.0
+
+    @property
+    def clock_offsets(self) -> Dict[int, float]:
+        return dict(self._offsets)
 
     def publish_once(self) -> int:
         """Publish every loopback host's metrics snapshot into the
@@ -2178,6 +2340,111 @@ class ClusterStatsAggregator:
                     e["args"] = args
                 events.append(e)
         return events
+
+    # ------------------------------------------------ cross-host stitching
+    def stitched_traces(self, limit: Optional[int] = None) -> List[dict]:
+        """ONE trace per logical stream: every front-door root trace
+        with its cross-host child legs folded under it (host traces
+        whose wire-v3 ``link`` names the root's trace id). Legs carry
+        their host id, parent-span label, and the skew-corrected wall
+        start (``start_corrected = start - offset``, on the
+        coordinator's clock) so the causal chain reads monotonic on one
+        timeline; legs sort by corrected start. Roots with no linked
+        leg still stitch (span_count 1 — a purely local request)."""
+        offsets = self._offsets
+        stitched: Dict[str, dict] = {}
+        order: List[str] = []
+        for tracer in self._front_door_tracers():
+            for tr in tracer.snapshot():
+                rid = tr["trace_id"]
+                if rid in stitched:
+                    continue
+                stitched[rid] = {
+                    "trace_id": rid, "root": tr, "legs": [],
+                    "hosts": [], "span_count": 1,
+                    "error": tr.get("reason") not in (None, "ok"),
+                }
+                order.append(rid)
+        for h in self._loopback_hosts():
+            off = float(offsets.get(h.host_id, 0.0))
+            for tr in h.trace_snapshots():
+                link = tr.get("link")
+                if link is None or link not in stitched:
+                    continue
+                leg = dict(tr)
+                leg["host"] = h.host_id
+                leg["skew_offset_s"] = off
+                leg["start_corrected"] = tr["start"] - off
+                s = stitched[link]
+                s["legs"].append(leg)
+                if tr.get("reason") not in (None, "ok"):
+                    s["error"] = True
+        out = []
+        for rid in order:
+            s = stitched[rid]
+            s["legs"].sort(key=lambda d: d["start_corrected"])
+            s["hosts"] = sorted({g["host"] for g in s["legs"]})
+            s["span_count"] = 1 + len(s["legs"])
+            out.append(s)
+        out.sort(key=lambda d: d["root"]["start"])
+        return out[-limit:] if limit is not None else out
+
+    def stitched_chrome_events(self, t0: Optional[float] = None
+                               ) -> List[dict]:
+        """The whole fleet's causal chain on ONE Chrome timeline: the
+        front doors' root lanes (their native pids — small ints,
+        disjoint from the host blocks) plus every host's lanes
+        (per-host pid blocks, as :meth:`chrome_events`) with each
+        host's event timestamps shifted by its estimated clock-skew
+        offset, so a leg's spans land where they truly ran relative to
+        the root. ``t0`` is the shared perf_counter origin (defaults to
+        the earliest tracer's)."""
+        tracers = self._front_door_tracers()
+        hosts = self._loopback_hosts()
+        if t0 is None:
+            bases = [tr._t0 for tr in tracers]
+            bases += [h._tracer._t0 for h in hosts
+                      if h._tracer is not None]
+            t0 = min(bases) if bases else 0.0
+        events: List[dict] = []
+        for tracer in tracers:
+            for e in tracer.chrome_events(t0=t0):
+                e = dict(e)
+                if e.get("ph") == "M":
+                    args = dict(e.get("args") or {})
+                    if "name" in args:
+                        sep = ":" if e["name"] == "process_name" else "/"
+                        args["name"] = f"fd{sep}{args['name']}"
+                    e["args"] = args
+                events.append(e)
+        offsets = self._offsets
+        for h in hosts:
+            base = (h.host_id + 1) * 1000
+            shift_us = float(offsets.get(h.host_id, 0.0)) * 1e6
+            for e in h.chrome_events(t0=t0):
+                e = dict(e)
+                if "pid" in e:
+                    e["pid"] = base + e["pid"]
+                if e.get("ph") == "M":
+                    args = dict(e.get("args") or {})
+                    if "name" in args:
+                        sep = ":" if e["name"] == "process_name" else "/"
+                        args["name"] = f"h{h.host_id}{sep}{args['name']}"
+                    e["args"] = args
+                elif "ts" in e:
+                    e["ts"] = e["ts"] - shift_us
+                events.append(e)
+        return events
+
+    def export_stitched_chrome(self, path: str) -> str:
+        """One-file Chrome/Perfetto export of the stitched fleet view
+        (chrome://tracing or ui.perfetto.dev)."""
+        import json
+
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.stitched_chrome_events(),
+                       "displayTimeUnit": "ms"}, f)
+        return path
 
 
 # --------------------------------------------------------------------------
@@ -2275,8 +2542,20 @@ class ElasticityPlanner:
     #: signal that adding a host would have absorbed the request
     CAPACITY_SHED_REASONS = ("cluster_capacity",)
 
-    def __init__(self, policy: Optional[ElasticityPolicy] = None):
+    def __init__(self, policy: Optional[ElasticityPolicy] = None, *,
+                 timeseries=None, host_cost_per_s: float = 1.0,
+                 min_fit_samples: int = 4):
         self.policy = policy if policy is not None else ElasticityPolicy()
+        # cost-model substrate (ISSUE 19, ROADMAP 4b): a
+        # timeseries.TimeSeriesStore (usually the directory's fleet-side
+        # ring — the same data /api/timeseries serves). When attached,
+        # every decision fits tokens/sec cost curves per host class ×
+        # config cell and cites the cheapest fitted cost-per-token in
+        # its reason; None (default) keeps decisions bitwise identical
+        # to the pre-cost-model planner.
+        self.timeseries = timeseries
+        self.host_cost_per_s = float(host_cost_per_s)
+        self.min_fit_samples = int(min_fit_samples)
         self._last_shed_total: Optional[int] = None
         self._last_preempt_total: Optional[int] = None
         self._pressure_streak = 0
@@ -2383,6 +2662,17 @@ class ElasticityPlanner:
                           f"{round(free_frac, 3)} > "
                           f"{pol.high_free_slot_frac}, no capacity sheds")
                 self._slack_streak = 0
+        cost_model = self._fit_cost_model()
+        if cost_model is not None and cost_model.get("cheapest"):
+            # the decision log cites the fitted figure (the SRE
+            # capacity-planning loop's unit economics next to the
+            # trend that triggered the action)
+            key = cost_model["cheapest"]
+            m = cost_model["models"][key]
+            reason += (f"; fitted cost/token "
+                       f"{m['cost_per_token']:.3e} host-s at full "
+                       f"occupancy ({key}, n={m['n']}, "
+                       f"r2={m['r2']:.3f})")
         self.last_decision = {
             "action": action, "reason": reason, "host": target,
             "draining_host": draining_host,
@@ -2393,7 +2683,23 @@ class ElasticityPlanner:
             "pressure_streak": self._pressure_streak,
             "slack_streak": self._slack_streak,
         }
+        if cost_model is not None:
+            self.last_decision["cost_model"] = cost_model
         return self.last_decision
+
+    def _fit_cost_model(self) -> Optional[dict]:
+        """Fit the per-(host class × config) cost curves off the
+        attached time-series ring; None without one (bitwise-inert
+        default)."""
+        if self.timeseries is None:
+            return None
+        from deeplearning4j_tpu.serving.timeseries import (
+            cheapest_cell, fit_cost_models)
+        models = fit_cost_models(self.timeseries,
+                                 min_samples=self.min_fit_samples,
+                                 host_cost_per_s=self.host_cost_per_s)
+        return {"models": models, "cheapest": cheapest_cell(models),
+                "host_cost_per_s": self.host_cost_per_s}
 
 
 def http_snapshot_source(url: str, index: int = 0, timeout_s: float = 5.0):

@@ -268,6 +268,11 @@ class DisaggPolicy:
         trace.event("cluster.route", host=hid_a, decision=how_a,
                     kind="generate", stage="prefill")
         fd.routed_by_host.inc(f"h{hid_a}")
+        # wire-v3 trace context: the prefill leg is a labeled child of
+        # the front-door root (NULL_TRACE → no kwargs, bitwise-inert)
+        tkw = {} if trace.trace_id is None else {
+            "trace_link": trace.trace_id,
+            "trace_parent": "migrate:prefill"}
         try:
             if hasattr(ha, "migrate_prefill"):
                 # RPC host: one round-trip runs prefill + capture and
@@ -276,7 +281,8 @@ class DisaggPolicy:
                 pf = ha.migrate_prefill(
                     toks, max_new_tokens=max_new_tokens,
                     timeout_ms=deadline_budget(), tenant=tenant,
-                    priority=priority, **self._sampling_kwargs(kwargs))
+                    priority=priority, **tkw,
+                    **self._sampling_kwargs(kwargs))
                 entry = None
                 if pf.mode == "captured" and pf.pages is not None:
                     entry = SwapEntry(
@@ -294,7 +300,7 @@ class DisaggPolicy:
             h1 = ha.submit_generate(
                 toks, max_new_tokens=1, capture_pages=True,
                 timeout_ms=deadline_budget(), tenant=tenant,
-                priority=priority, **kwargs)
+                priority=priority, **tkw, **kwargs)
             b = deadline_budget()
             wait_s = self.DEFAULT_WAIT_S if b is None \
                 else b / 1e3 + self.WAIT_SLACK_S
@@ -422,6 +428,12 @@ class DisaggPolicy:
         kw = dict(kwargs)
         kw.pop("capture_pages", None)
         gen_b = getattr(hb, "generation", None)
+        # wire-v3 trace context: the context crosses BOTH migration
+        # stages — the decode leg links to the same front-door root as
+        # the prefill leg, never dropped between the two hops
+        tkw = {} if trace.trace_id is None else {
+            "trace_link": trace.trace_id,
+            "trace_parent": "migrate:decode"}
 
         if hasattr(hb, "submit_migrated") and first is not None:
             # RPC decode host: ship pages (when captured) or just the
@@ -443,7 +455,7 @@ class DisaggPolicy:
             _, mode = hb.submit_migrated(
                 toks, pf, max_new_tokens=max_new_tokens,
                 timeout_ms=deadline_budget(), tenant=tenant,
-                priority=priority, handle=client,
+                priority=priority, handle=client, **tkw,
                 **self._sampling_kwargs(kwargs))
             if mode == "migrated":
                 fd.metrics.kv_migrations_total.inc()
@@ -477,7 +489,7 @@ class DisaggPolicy:
             h2 = hb.submit_generate(
                 toks, max_new_tokens=max_new_tokens,
                 timeout_ms=deadline_budget(), tenant=tenant,
-                priority=priority, on_token=relay, **kw)
+                priority=priority, on_token=relay, **tkw, **kw)
         except RejectedError:
             if key is not None and gen_b is not None:
                 # the one-shot key will never be taken — reclaim the

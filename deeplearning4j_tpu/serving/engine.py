@@ -199,14 +199,19 @@ class InferenceEngine(ResilientEngineMixin):
     # --------------------------------------------------------------- submit
     def submit(self, x, timeout_ms: Optional[float] = None,
                tenant: Optional[str] = None,
-               priority: Optional[str] = None) -> Future:
+               priority: Optional[str] = None,
+               trace_link: Optional[str] = None,
+               trace_parent: Optional[str] = None) -> Future:
         """Enqueue a batch-major array; the Future resolves to an NDArray
         holding exactly ``x.shape[0]`` output rows, or raises
         :class:`RejectedError` / the model's own exception. ``tenant``
         attributes the request for QoS (default: the shared anonymous
         tenant); ``priority`` ('interactive' | 'batch') defaults to the
         tenant's configured class. Without a ``qos=`` policy both are
-        accounting labels only — ordering stays FIFO."""
+        accounting labels only — ordering stays FIFO. ``trace_link`` /
+        ``trace_parent`` attach the request's trace to a cross-host
+        parent (wire-v3 trace context — see serving/rpc.py); default
+        None keeps the trace a local root."""
         arr = np.asarray(x)
         if arr.ndim < 1 or arr.shape[0] == 0:
             raise ValueError("submit() needs a batch-major array with >=1 row")
@@ -217,7 +222,8 @@ class InferenceEngine(ResilientEngineMixin):
         tenant, priority = resolve_qos(self.qos, tenant, priority)
         self._check_row_sig(arr.shape[1:], arr.dtype)
         self._count_request()
-        trace = self._tracer.begin(self.name, "infer",
+        trace = self._tracer.begin(self.name, "infer", link=trace_link,
+                                   parent_span=trace_parent,
                                    rows=int(arr.shape[0]), tenant=tenant)
         if self._draining:
             # drain outranks every other gate: the host is leaving and

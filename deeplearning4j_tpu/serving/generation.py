@@ -710,7 +710,9 @@ class GenerationEngine(ResilientEngineMixin):
                on_token: Optional[Callable[[int], None]] = None,
                resume_tokens=None, resume_step: int = 0,
                capture_pages: bool = False,
-               swap_key: Optional[int] = None) -> GenerationHandle:
+               swap_key: Optional[int] = None,
+               trace_link: Optional[str] = None,
+               trace_parent: Optional[str] = None) -> GenerationHandle:
         """Queue one prompt. Greedy by default; ``temperature`` > 0 samples,
         ``top_k`` > 0 restricts sampling to the k highest-probability
         tokens, ``seed`` fixes the stream's
@@ -749,7 +751,14 @@ class GenerationEngine(ResilientEngineMixin):
         from those pages with NO prefill, falling back to the ordinary
         resume recompute on any miss — the decode half of the same
         migration (requires ``resume_tokens``, the degrade path's
-        delivery watermark)."""
+        delivery watermark).
+
+        ``trace_link``/``trace_parent`` attach this stream's trace to a
+        cross-host parent (the wire-v3 trace context serving/rpc.py
+        forwards): the engine's RequestTrace stays a full local timeline
+        but records which front-door trace it is a child leg of, so the
+        cluster aggregator can stitch the legs. Default None — a local
+        root, bitwise the pre-v3 behavior."""
         tenant, priority = resolve_qos(self.qos, tenant, priority)
         toks = np.ascontiguousarray(np.asarray(prompt, np.int32).ravel())
         if toks.size == 0:
@@ -817,6 +826,8 @@ class GenerationEngine(ResilientEngineMixin):
             resume_tokens=resume_tokens, resume_step=int(resume_step),
             capture_pages=bool(capture_pages), swap_key=swap_key)
         trace = self._tracer.begin(self.name, "generate",
+                                   link=trace_link,
+                                   parent_span=trace_parent,
                                    prompt_len=int(toks.size),
                                    max_new_tokens=max_new_tokens,
                                    tenant=tenant)

@@ -582,6 +582,31 @@ class ServingMetrics:
         dt = time.time() - self._t0
         return self.requests_total.value / dt if dt > 0 else 0.0
 
+    def timeseries_sample(self) -> dict:
+        """One compact per-heartbeat time-series sample
+        (serving/timeseries.py's SAMPLE_FIELDS core): throughput,
+        occupancy, pressure and self-observation gauges — deliberately
+        a small flat dict, not :meth:`snapshot` (a heartbeat ships one
+        of these per beat; the full snapshot is an on-demand payload).
+        Reads existing counters/gauges only — no new Counter, so the
+        metrics-drift parity list in :meth:`counters` is untouched."""
+        rss = _read_rss()
+        if rss is not None:
+            self.process_rss_bytes.set(rss)
+        return {
+            "t": time.time(),
+            "tokens_per_sec": self.decode_tokens_per_sec(),
+            "generated_tokens_total": self.generated_tokens_total.value,
+            "slot_occupancy": self.slot_occupancy.value,
+            "kv_block_occupancy": self.kv_block_occupancy.value,
+            "preemptions_total": self.preemptions_total.value,
+            "spec_acceptance_rate": self.spec_acceptance_rate.value,
+            "queue_depth": self.queue_depth.value,
+            "queue_by_class": {p: h.count for p, h in
+                               self.queue_wait_by_class.items()},
+            "rss_bytes": self.process_rss_bytes.value,
+        }
+
     def snapshot(self) -> dict:
         with self._lock:
             per_bucket = {str(k): dict(v) for k, v in self._per_bucket.items()}

@@ -106,7 +106,17 @@ class RpcRequest:
     re-decoded tokens. Both fields are DEFAULTED so a v1 receiver's
     known-field filter silently drops them and replays from token 0
     (the client's watermark dedup absorbs the duplicates — see the
-    ``RpcResponse.resume_step`` echo)."""
+    ``RpcResponse.resume_step`` echo).
+
+    ``trace_id``/``parent_span`` (wire v3, Dapper-style cross-host trace
+    context) name the front-door trace this dispatch is a child leg of
+    and the labeled span that sent it ("attempt0", "hedge:timeout", ...).
+    The receiving engine begins its own RequestTrace LINKED to that id,
+    so the aggregator can stitch the legs into one logical stream.
+    Rolling-upgrade tolerant both directions: a v2 receiver's
+    known-field filter drops the fields (its trace stays a local root,
+    exactly today's behavior), and a v2 SENDER's request leaves the
+    defaults None so a v3 receiver mints a local root as today."""
 
     request_id: str = ""
     kind: str = "infer"                  # 'infer' | 'generate'
@@ -125,12 +135,15 @@ class RpcRequest:
     # ---- resume-from-watermark (wire v2) ---------------------------------
     resume_tokens: Optional[list] = None  # delivered-so-far token ids
     resume_step: int = 0                  # == len(resume_tokens)
+    # ---- cross-host trace context (wire v3) ------------------------------
+    trace_id: Optional[str] = None       # the logical stream's root trace
+    parent_span: Optional[str] = None    # label of the dispatching span
     # ---- identity + budget ----------------------------------------------
     tenant: Optional[str] = None
     priority: Optional[str] = None
     timeout_ms: Optional[float] = None   # remaining budget at send time
     hedge_attempt: int = 0
-    wire_version: int = 2
+    wire_version: int = 3
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -229,6 +242,11 @@ class KvMigrateRequest:
     tenant: Optional[str] = None
     priority: Optional[str] = None
     timeout_ms: Optional[float] = None   # remaining budget at send time
+    # ---- cross-host trace context (wire v2, same contract as
+    # RpcRequest's v3 fields: defaulted None both directions, so the
+    # context survives BOTH migration legs or degrades to local roots) --
+    trace_id: Optional[str] = None
+    parent_span: Optional[str] = None
     # ---- import payload (stage B) ----------------------------------------
     first_token: int = 0                 # the delivery watermark token
     resume_step: int = 1
@@ -240,7 +258,7 @@ class KvMigrateRequest:
     nbytes: int = 0
     block_size: int = 0                  # sender's block size (a
     #                                      mismatch degrades to recompute)
-    wire_version: int = 1
+    wire_version: int = 2
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -552,16 +570,23 @@ class HostRpcServer:
                                f"({timeout_ms:.1f} ms remaining on "
                                f"arrival)")).to_dict()
         op_id = f"op-{next(self._op_ids)}"
+        # wire v3 trace context: honor it by threading the sender's
+        # logical trace id into the local engine submit, so the engine's
+        # own RequestTrace becomes a LINKED child leg of the front-door
+        # trace (a v2 sender leaves both None — local root, as today)
+        trace_kw = {} if req.trace_id is None else {
+            "trace_link": req.trace_id, "trace_parent": req.parent_span}
         try:
             if req.kind == "infer":
                 arr = np.asarray(req.x, dtype=np.dtype(req.x_dtype))
                 fut = self.host.submit_infer(
                     arr, timeout_ms=timeout_ms, tenant=req.tenant,
-                    priority=req.priority)
+                    priority=req.priority, **trace_kw)
                 state = _OpState(op_id, "infer", future=fut)
             elif req.kind == "generate":
                 state = _OpState(op_id, "generate")
                 kw = {} if req.eos_default else {"eos_id": req.eos_id}
+                kw.update(trace_kw)
                 if req.resume_tokens is not None:
                     # wire v2 resume: seat through the engine's
                     # recompute-on-resume path (one recompute prefill,
@@ -788,6 +813,11 @@ class HostRpcServer:
     def _migrate_prefill(self, req: KvMigrateRequest,
                          timeout_ms: Optional[float]) -> dict:
         kw = {} if req.eos_default else {"eos_id": req.eos_id}
+        if req.trace_id is not None:
+            # wire v2 migrate trace context: the prefill leg links to
+            # the front-door trace exactly like a /submit dispatch does
+            kw["trace_link"] = req.trace_id
+            kw["trace_parent"] = req.parent_span
         try:
             handle = self.host.submit_generate(
                 np.asarray(req.prompt, np.int32), max_new_tokens=1,
@@ -867,6 +897,12 @@ class HostRpcServer:
         op_id = f"op-{next(self._op_ids)}"
         state = _OpState(op_id, "generate")
         kw = {} if req.eos_default else {"eos_id": req.eos_id}
+        if req.trace_id is not None:
+            # the import/decode leg carries the SAME logical trace the
+            # prefill leg did — the context is never dropped between the
+            # two migration stages (deadline-propagation-style contract)
+            kw["trace_link"] = req.trace_id
+            kw["trace_parent"] = req.parent_span
         if key is not None:
             kw["swap_key"] = key
         try:
@@ -1097,16 +1133,20 @@ class RemoteHost(HostHandle):
         return resp
 
     def submit_infer(self, x, *, timeout_ms=None, tenant=None,
-                     priority=None) -> Future:
+                     priority=None, trace_link=None,
+                     trace_parent=None) -> Future:
         """Dispatch one batch-inference request; admission outcome is
         synchronous (a typed rejection raises HERE, so the front door's
         bounce loop works unchanged), the result rides a background
-        long-poll into the returned Future."""
+        long-poll into the returned Future. ``trace_link`` /
+        ``trace_parent`` stamp the wire-v3 trace context (default None:
+        the v2-sender shape — the remote trace stays a local root)."""
         arr = np.asarray(x)
         deadline_t = self._deadline_t(timeout_ms)
         req = RpcRequest(
             request_id=f"h{self.host_id}-r{next(self._req_ids)}",
             kind="infer", x=arr.tolist(), x_dtype=str(arr.dtype),
+            trace_id=trace_link, parent_span=trace_parent,
             tenant=tenant, priority=priority,
             timeout_ms=self._budget_ms(deadline_t))
         resp = self._submit_wire(req)
@@ -1218,7 +1258,9 @@ class RemoteHost(HostHandle):
                     hedge_attempt: int = 0,
                     deadline_t: Optional[float] = None,
                     resume_tokens=None,
-                    resume_step: int = 0) -> RemoteStream:
+                    resume_step: int = 0,
+                    trace_link: Optional[str] = None,
+                    trace_parent: Optional[str] = None) -> RemoteStream:
         """Admit one generation attempt remotely and return the
         attempt-scoped :class:`RemoteStream`. ``deadline_t`` (this
         client's clock) takes precedence over ``timeout_ms`` so hedged
@@ -1245,6 +1287,7 @@ class RemoteHost(HostHandle):
             resume_tokens=None if resume_tokens is None
             else [int(t) for t in resume_tokens],
             resume_step=int(resume_step),
+            trace_id=trace_link, parent_span=trace_parent,
             tenant=tenant, priority=priority,
             timeout_ms=self._budget_ms(deadline_t),
             hedge_attempt=int(hedge_attempt))
@@ -1328,7 +1371,9 @@ class RemoteHost(HostHandle):
                         timeout_ms: Optional[float] = None,
                         deadline_t: Optional[float] = None,
                         tenant: Optional[str] = None,
-                        priority: Optional[str] = None
+                        priority: Optional[str] = None,
+                        trace_link: Optional[str] = None,
+                        trace_parent: Optional[str] = None
                         ) -> KvMigrateResponse:
         """Stage A of disaggregated serving (serving/disagg.py): run
         the prompt's prefill HERE with page capture, returning the
@@ -1347,7 +1392,8 @@ class RemoteHost(HostHandle):
             temperature=float(temperature), top_k=int(top_k),
             eos_id=None if eos_default else eos_id,
             eos_default=eos_default, seed=int(seed), tenant=tenant,
-            priority=priority, timeout_ms=self._budget_ms(deadline_t))
+            priority=priority, timeout_ms=self._budget_ms(deadline_t),
+            trace_id=trace_link, parent_span=trace_parent)
         return self._migrate_rpc(req)
 
     def submit_migrated(self, prompt, prefill: KvMigrateResponse, *,
@@ -1358,6 +1404,8 @@ class RemoteHost(HostHandle):
                         deadline_t: Optional[float] = None,
                         tenant: Optional[str] = None,
                         priority: Optional[str] = None,
+                        trace_link: Optional[str] = None,
+                        trace_parent: Optional[str] = None,
                         handle=None):
         """Stage B: seat stage A's pages on THIS host and continue the
         stream from its watermark. Returns ``(handle, mode)`` — the
@@ -1378,6 +1426,7 @@ class RemoteHost(HostHandle):
             eos_id=None if eos_default else eos_id,
             eos_default=eos_default, seed=int(seed), tenant=tenant,
             priority=priority, timeout_ms=self._budget_ms(deadline_t),
+            trace_id=trace_link, parent_span=trace_parent,
             first_token=int(prefill.first_token), resume_step=1,
             pages=prefill.pages, used_blocks=int(prefill.used_blocks),
             length=int(prefill.length),

@@ -52,7 +52,7 @@ import itertools
 import threading
 import time
 import weakref
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -119,18 +119,32 @@ class FlightRecorder:
     ``snapshot()`` is what ``util/crash_reporting`` appends to every
     serving crash dump."""
 
-    def __init__(self, capacity: int = 512):
+    def __init__(self, capacity: int = 512, host: Optional[int] = None):
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
         self._ring: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._seq = 0
+        self._host = host
+
+    def set_host(self, host: Optional[int]) -> "FlightRecorder":
+        """Stamp every FUTURE event with this host id (``"host"`` field).
+        Events are attributable at record time, so a merged incident ring
+        from several hosts' crash dumps needs no worker-prefix
+        cross-referencing; already-recorded events keep whatever stamp
+        they got. ``None`` stops stamping (the single-process default —
+        the event shape is unchanged until a host id exists)."""
+        with self._lock:
+            self._host = host
+        return self
 
     def record(self, kind: str, **fields):
         e = {"kind": kind, "t": time.time(),
              "mono_ms": time.perf_counter() * 1e3, **fields}
         with self._lock:
+            if self._host is not None and "host" not in e:
+                e["host"] = self._host
             self._seq += 1
             e["seq"] = self._seq
             self._ring.append(e)
@@ -192,6 +206,84 @@ NULL_TRACE = _NullTrace()
 _TRACE_SEQ = itertools.count(1)
 
 
+class _LinkRegistry:
+    """Process-wide tail-sampling coordination for LINKED traces (one
+    logical stream whose legs finish in different Tracers — the front
+    door's root plus each host engine's child).
+
+    The per-tracer retention coin is leg-local, so without coordination a
+    success-sampled front-door trace can survive while its FAILED remote
+    leg is dropped (or vice versa) and the stitched view lies. The fix
+    keeps tail-sampling semantics per *logical* stream: an error on any
+    leg marks the logical id errored (bounded FIFO of recent ids), which
+    (a) force-retains every LATER leg of that stream and (b) resurrects
+    every EARLIER leg that the coin had sampled out — sampled-out legs
+    park here (bounded, oldest streams evicted for real) instead of
+    vanishing immediately, precisely so a late error can still claim
+    them. Unlinked traces pass through with identical observable
+    behavior: nothing else ever shares their logical id, so a parked
+    unlinked trace is just a deferred drop.
+
+    Lock order: this registry's lock never nests with a Tracer's —
+    callers do registry lookups and tracer mutations in separate
+    critical sections (the runtime lockdep suite would flag a cycle
+    between two tracers bridged through here)."""
+
+    MAX_ERROR_IDS = 1024     # recent errored logical ids remembered
+    MAX_PARKED = 512         # sampled-out traces held for resurrection
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._errors: "OrderedDict[str, bool]" = OrderedDict()
+        self._parked: "OrderedDict[str, list]" = OrderedDict()
+        self._n_parked = 0
+
+    def errored(self, logical_id: str) -> bool:
+        with self._lock:
+            return logical_id in self._errors
+
+    def mark_error(self, logical_id: str) -> list:
+        """Record one leg's error terminal; returns the (trace, tracer
+        weakref) pairs previously parked under this logical id so the
+        caller can re-admit them into their own tracers' rings."""
+        with self._lock:
+            if logical_id not in self._errors:
+                self._errors[logical_id] = True
+                while len(self._errors) > self.MAX_ERROR_IDS:
+                    self._errors.popitem(last=False)
+            entries = self._parked.pop(logical_id, [])
+            self._n_parked -= len(entries)
+            return entries
+
+    def park(self, logical_id: str, trace, tracer):
+        """Hold a sampled-out finished trace for possible resurrection.
+        The trace's strong tracer edge is cut (a weakref rides along
+        instead) so parking never pins a tracer past its engine."""
+        trace._tracer = None
+        with self._lock:
+            self._parked.setdefault(logical_id, []).append(
+                (trace, weakref.ref(tracer)))
+            self._n_parked += 1
+            while self._n_parked > self.MAX_PARKED and self._parked:
+                _, evicted = self._parked.popitem(last=False)
+                self._n_parked -= len(evicted)
+
+    def clear(self):
+        with self._lock:
+            self._errors.clear()
+            self._parked.clear()
+            self._n_parked = 0
+
+
+_LINKS = _LinkRegistry()
+
+
+def link_registry() -> _LinkRegistry:
+    """The process-global linked-trace retention registry (tests reset it
+    via ``clear()`` for isolation)."""
+    return _LINKS
+
+
 class RequestTrace:
     """One request's causal timeline: typed events with monotonic
     timestamps. Created by :meth:`Tracer.begin`, carried on
@@ -202,14 +294,23 @@ class RequestTrace:
     __slots__ = ("trace_id", "engine", "kind", "tenant", "start_t",
                  "start_wall", "end_t", "reason", "latency_ms", "events",
                  "dropped_events", "pid", "tid", "_tracer", "_lock",
-                 "_done")
+                 "_done", "link", "parent_span")
 
     MAX_EVENTS = 1024   # fixed memory even for a runaway stream
 
-    def __init__(self, tracer: "Tracer", engine: str, kind: str, **attrs):
+    def __init__(self, tracer: "Tracer", engine: str, kind: str,
+                 link: Optional[str] = None,
+                 parent_span: Optional[str] = None, **attrs):
         self.trace_id = f"{engine}-{next(_TRACE_SEQ):06d}"
         self.engine = engine
         self.kind = kind
+        # cross-host trace context (Dapper, over our own wire — ISSUE 19):
+        # ``link`` is the LOGICAL stream's root trace id (the front-door
+        # trace this one is a child leg of), ``parent_span`` the label of
+        # the parent span that dispatched it ("attempt1", "migrate:prefill",
+        # ...). Both default None — a local root, exactly the pre-v3 shape.
+        self.link = link
+        self.parent_span = parent_span
         # tenant identity (QoS attribution, serving/qos.py) lifted out of
         # the submit attrs so the Chrome export can tag its track name —
         # Perfetto sorts thread lanes lexically, so tenant-prefixed names
@@ -288,7 +389,7 @@ class RequestTrace:
             events = [{"name": name, "t_ms": round((t - self.start_t) * 1e3, 3),
                        **({"attrs": attrs} if attrs else {})}
                       for name, t, attrs in self.events]
-            return {
+            out = {
                 "trace_id": self.trace_id, "engine": self.engine,
                 "kind": self.kind, "reason": self.reason,
                 "start": self.start_wall,
@@ -296,6 +397,11 @@ class RequestTrace:
                 "dropped_events": self.dropped_events,
                 "events": events,
             }
+            if self.link is not None:
+                out["link"] = self.link
+            if self.parent_span is not None:
+                out["parent_span"] = self.parent_span
+            return out
 
 
 class Tracer:
@@ -332,33 +438,34 @@ class Tracer:
         self.started = 0
         self.retained_total = 0
         self.sampled_out = 0
+        self.link_retained = 0
         self._t0 = time.perf_counter()
         with _TRACERS_LOCK:
             _TRACERS.add(self)
 
     # ------------------------------------------------------------ recording
-    def begin(self, engine: str, kind: str, **attrs):
+    def begin(self, engine: str, kind: str, link: Optional[str] = None,
+              parent_span: Optional[str] = None, **attrs):
         """A new RequestTrace — or NULL_TRACE when this tracer cannot
         possibly retain it (disabled, or sample_rate=0 with errors not
-        kept), which keeps the off path allocation-free."""
+        kept), which keeps the off path allocation-free. ``link`` /
+        ``parent_span`` attach the trace to a cross-host parent (the
+        wire-v3 trace context a remote front door stamped on the RPC):
+        the new trace stays a full local RequestTrace but records whose
+        child leg it is, and tail sampling treats the whole linked
+        stream as one retention unit."""
         if not self.enabled or (self.sample_rate <= 0.0
                                 and not self.keep_errors):
             return NULL_TRACE
         with self._lock:
             self.started += 1
-        return RequestTrace(self, engine, kind, **attrs)
+        return RequestTrace(self, engine, kind, link=link,
+                            parent_span=parent_span, **attrs)
 
-    def _retain(self, trace: RequestTrace):
-        """Tail-sampling decision at finish time: errors always kept when
-        keep_errors, successes kept at sample_rate (seeded draw)."""
+    def _admit(self, trace: RequestTrace):
+        """Append one finished trace to the retention ring (caller has
+        already decided retention): assign its Chrome lanes and count it."""
         with self._lock:
-            # errors bypass the coin only when keep_errors; everything
-            # else flips the seeded sample_rate coin
-            always_keep = trace.reason != "ok" and self.keep_errors
-            if not always_keep and self.sample_rate < 1.0 \
-                    and float(self._rng.random()) >= self.sample_rate:
-                self.sampled_out += 1
-                return
             pid = self._pids.get(trace.engine)
             if pid is None:
                 pid = self._pids[trace.engine] = 2 + len(self._pids)
@@ -368,6 +475,48 @@ class Tracer:
             trace.tid = tid
             self.retained_total += 1
             self._retained.append(trace)
+
+    def _retain(self, trace: RequestTrace):
+        """Tail-sampling decision at finish time: errors always kept when
+        keep_errors, successes kept at sample_rate (seeded draw) — and
+        the decision is coordinated per LOGICAL stream through the link
+        registry, so an error on any linked leg force-retains every other
+        leg of the same stream, whichever tracer holds it (registry and
+        tracer locks never nest — see :class:`_LinkRegistry`)."""
+        logical = trace.link if trace.link is not None else trace.trace_id
+        if trace.reason != "ok" and self.keep_errors:
+            # the error leg itself is always kept; claim back any legs
+            # of the same stream the coin already sampled out elsewhere
+            resurrect = _LINKS.mark_error(logical)
+            self._admit(trace)
+            for parked, tracer_ref in resurrect:
+                owner = tracer_ref()
+                if owner is None:
+                    continue
+                with owner._lock:
+                    owner.sampled_out -= 1
+                    owner.link_retained += 1
+                owner._admit(parked)
+            return
+        with self._lock:
+            # the seeded coin draw is unchanged (same draw order as
+            # before link-aware retention: no draw for kept errors or
+            # at sample_rate=1.0), so seeded tests stay reproducible
+            drop = self.sample_rate < 1.0 \
+                and float(self._rng.random()) >= self.sample_rate
+        if drop and _LINKS.errored(logical):
+            with self._lock:
+                self.link_retained += 1
+            drop = False
+        if not drop:
+            self._admit(trace)
+            return
+        with self._lock:
+            self.sampled_out += 1
+        # park instead of dropping: a LATER error on a linked leg can
+        # still resurrect this one (unlinked ids are never claimed, so
+        # parking is just a deferred drop for them)
+        _LINKS.park(logical, trace, self)
 
     # -------------------------------------------------------------- reading
     def traces(self, engine: Optional[str] = None) -> List[RequestTrace]:
@@ -395,6 +544,7 @@ class Tracer:
                     "retained": len(self._retained),
                     "retained_total": self.retained_total,
                     "sampled_out": self.sampled_out,
+                    "link_retained": self.link_retained,
                     "evicted": self.retained_total - len(self._retained)}
 
     def clear(self):
@@ -516,5 +666,6 @@ def all_tracers() -> List[Tracer]:
 
 
 __all__ = ["RequestTrace", "Tracer", "FlightRecorder", "NULL_TRACE",
-           "flight_recorder", "default_tracer", "configure", "all_tracers",
-           "terminal_reason", "TERMINAL_REASONS"]
+           "flight_recorder", "link_registry", "default_tracer",
+           "configure", "all_tracers", "terminal_reason",
+           "TERMINAL_REASONS"]

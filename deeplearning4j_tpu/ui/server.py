@@ -525,6 +525,33 @@ class _Handler(BaseHTTPRequestHandler):
                 payload.append(snap)
             self._json(payload)
             return
+        if parts == ["api", "timeseries"]:
+            # fleet time-series telemetry (serving/timeseries.py, fed at
+            # heartbeat cadence through HostStatus.sample): one entry
+            # per live ClusterDirectory carrying a fleet-side
+            # TimeSeriesStore — per-host sample rings plus the fitted
+            # cost models the elasticity planner's decisions cite.
+            # ?limit=N bounds samples per host (default 100);
+            # directories without a store are skipped (timeseries=None
+            # is the bitwise-inert default).
+            from deeplearning4j_tpu.serving.cluster import all_directories
+            from deeplearning4j_tpu.serving.timeseries import (
+                cheapest_cell, fit_cost_models,
+            )
+            q = parse_qs(url.query)
+            limit = max(1, min(int(q.get("limit", ["100"])[0]), 1000))
+            payload = []
+            for d in all_directories():
+                ts = getattr(d, "timeseries", None)
+                if ts is None:
+                    continue
+                snap = ts.api_snapshot(limit=limit)
+                models = fit_cost_models(ts)
+                snap["cost_models"] = models
+                snap["cheapest_cell"] = cheapest_cell(models)
+                payload.append(snap)
+            self._json(payload)
+            return
         if parts == ["api", "traces"]:
             # finished request traces retained by every Tracer in this
             # process (serving/tracing.py tail sampling: errors always,

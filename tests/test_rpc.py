@@ -116,9 +116,12 @@ def tiny_model():
 
 
 def make_rpc_gen_fleet(tiny_model, n_hosts=2, *, slots=2, max_len=48,
-                       tracer=None, hedge=None, heartbeat_timeout_s=30.0):
+                       tracer=None, hedge=None, heartbeat_timeout_s=30.0,
+                       engine_tracers=None):
     """n generation hosts each behind a real HTTP endpoint, joined to a
     directory via their RemoteHost handles (the data plane IS the wire).
+    ``engine_tracers`` optionally gives host i's engine its own Tracer —
+    the server-side legs of a cross-host stitched trace (ISSUE 19).
     Returns (directory, front_door, remotes, servers, locals, engines)."""
     from deeplearning4j_tpu.serving import GenerationEngine
 
@@ -126,9 +129,10 @@ def make_rpc_gen_fleet(tiny_model, n_hosts=2, *, slots=2, max_len=48,
     d = ClusterDirectory(heartbeat_timeout_s=heartbeat_timeout_s)
     remotes, servers, locals_, engines = [], [], [], []
     for i in range(n_hosts):
+        ekw = {} if engine_tracers is None else {"tracer": engine_tracers[i]}
         g = GenerationEngine(params, cfg, slots=slots, max_len=max_len,
-                             name=f"rpc-g{i}")
-        local = LoopbackHost(i, generation=g)
+                             name=f"rpc-g{i}", **ekw)
+        local = LoopbackHost(i, generation=g, **ekw)
         srv = HostRpcServer(local)
         rem = RemoteHost(i, srv.url)
         d.join(rem)
@@ -177,9 +181,11 @@ class TestWireSchema:
     def test_round_trip_through_json(self, msg):
         wire = json.loads(json.dumps(msg.to_dict()))
         assert type(msg).from_dict(wire) == msg
-        # RpcRequest/RpcResponse grew resume-from-watermark fields (v2);
+        # RpcRequest grew trace-context fields (v3) on top of the
+        # resume-from-watermark fields (v2); RpcResponse is still v2;
         # chunks are unchanged since v1
-        want = 1 if isinstance(msg, RpcStreamChunk) else 2
+        want = (1 if isinstance(msg, RpcStreamChunk)
+                else 3 if isinstance(msg, RpcRequest) else 2)
         assert wire["wire_version"] == want
 
     @pytest.mark.parametrize("msg", CASES, ids=lambda m: type(m).__name__)
@@ -230,6 +236,63 @@ class TestWireSchema:
         assert back.draining is False
         assert back.host_id == 4
 
+    def test_v3_trace_context_rides_the_wire_and_v2_interops(self):
+        """ISSUE 19 wire v3: ``trace_id``/``parent_span`` round-trip on
+        RpcRequest (and KvMigrateRequest's v2), and a v2 peer that never
+        heard of them interops both directions — the rolling-upgrade
+        contract that lets a mixed fleet trace what it can."""
+        from deeplearning4j_tpu.serving import KvMigrateRequest
+
+        msg = RpcRequest(request_id="r9", kind="generate", prompt=[1, 2],
+                         trace_id="cluster-000042", parent_span="attempt1")
+        wire = json.loads(json.dumps(msg.to_dict()))
+        assert wire["wire_version"] == 3
+        back = RpcRequest.from_dict(wire)
+        assert back.trace_id == "cluster-000042"
+        assert back.parent_span == "attempt1"
+        # v2 sender -> v3 receiver: the fields are simply absent and
+        # default to no-context (a local root server-side)
+        old = {k: v for k, v in wire.items()
+               if k not in ("trace_id", "parent_span")}
+        old["wire_version"] = 2
+        back = RpcRequest.from_dict(old)
+        assert back.trace_id is None and back.parent_span is None
+        # v3 sender -> v2 receiver: the known-field filter drops them
+        # (same mechanism test_v2_sender_to_v1_receiver exercises) —
+        # the stream still parses and runs, just untraced remotely
+        mig = KvMigrateRequest(request_id="m1", kind="prefill",
+                               prompt=[1, 2, 3], trace_id="cluster-7",
+                               parent_span="migrate:prefill")
+        mwire = json.loads(json.dumps(mig.to_dict()))
+        assert mwire["wire_version"] == 2
+        mback = KvMigrateRequest.from_dict(mwire)
+        assert mback.trace_id == "cluster-7"
+        assert mback.parent_span == "migrate:prefill"
+        mold = {k: v for k, v in mwire.items()
+                if k not in ("trace_id", "parent_span")}
+        mold["wire_version"] = 1
+        mback = KvMigrateRequest.from_dict(mold)
+        assert mback.trace_id is None and mback.parent_span is None
+
+    def test_host_status_v2_sample_fields_default_both_ways(self):
+        """HostStatus grew ``wall_t`` + ``sample`` (wire v2, ISSUE 19):
+        a v1 sender's payload parses with both defaulted, and a v2
+        payload's sample dict survives the round trip."""
+        st = HostStatus(host_id=3, has_generate=True, slots=4, seq=9)
+        st.wall_t = 1234.5
+        st.sample = {"t": 1234.5, "tokens_per_sec": 10.0}
+        wire = json.loads(json.dumps(st.to_dict()))
+        assert wire["wire_version"] == 2
+        back = HostStatus.from_dict(wire)
+        assert back.wall_t == 1234.5
+        assert back.sample == {"t": 1234.5, "tokens_per_sec": 10.0}
+        old = dict(wire)
+        for drop in ("wall_t", "sample"):
+            del old[drop]
+        old["wire_version"] = 1
+        back = HostStatus.from_dict(old)
+        assert back.wall_t == 0.0 and back.sample is None
+
     def test_rejected_from_wire_maps_the_one_taxonomy(self):
         e = rejected_from_wire("queue_full", "full", host=2)
         assert isinstance(e, RejectedError) and e.reason == "queue_full"
@@ -264,7 +327,9 @@ class TestRpcInfer:
         try:
             st = remote.status()
             assert st.host_id == 7 and st.has_infer and not st.draining
-            assert st.wire_version == 1
+            # v2: wall_t + the defaulted timeseries sample field
+            assert st.wire_version == 2
+            assert st.wall_t > 0 and st.sample is None
             assert remote.serves("infer") and not remote.serves("generate")
         finally:
             stop_rpc_host(srv, local)
@@ -846,10 +911,18 @@ class TestHedgedRedispatch:
         the client handle observes exactly one terminal, no token is
         delivered twice (the result is bitwise the stream an unkilled
         host produces), and the trace carries cluster.route ->
-        rpc.dispatch -> cluster.bounce -> terminal in monotonic order."""
+        rpc.dispatch -> cluster.bounce -> terminal in monotonic order.
+
+        ISSUE 19 extends the acceptance: each host engine traces into
+        its own Tracer, the wire-v3 trace context links those legs back
+        to the front-door root, and the aggregator stitches the whole
+        recovery into ONE cross-host trace — root + a leg from BOTH
+        hosts, monotonic on one skew-corrected clock, exportable as a
+        single Chrome timeline."""
         tracer = Tracer(sample_rate=1.0)
+        engine_tracers = [Tracer(sample_rate=1.0), Tracer(sample_rate=1.0)]
         d, fd, remotes, servers, locals_, engines = make_rpc_gen_fleet(
-            tiny_model, 2, tracer=tracer,
+            tiny_model, 2, tracer=tracer, engine_tracers=engine_tracers,
             hedge=HedgePolicy(hedge_after_ms=None, max_attempts=3,
                               poll_wait_ms=25.0))
         try:
@@ -924,6 +997,52 @@ class TestHedgedRedispatch:
                 == g_base[1 - victim] + (24 - r)
             assert int(survivor.metrics.prefills_total.value) \
                 == p_base[1 - victim] + 1
+
+            # ISSUE 19 acceptance: the aggregator stitches the hedged,
+            # killed-and-resumed stream into ONE trace. The victim's
+            # leg closes with 'shutdown' from its scheduler thread's
+            # unwind — give it a beat to land in the host tracer.
+            from deeplearning4j_tpu.serving import ClusterStatsAggregator
+            agg = ClusterStatsAggregator(d, hosts=locals_)
+            agg.estimate_clock_offsets()
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                ours = [s for s in agg.stitched_traces()
+                        if s["trace_id"] == tr.trace_id]
+                if ours and len(ours[0]["hosts"]) >= 2:
+                    break
+                time.sleep(0.05)
+            assert len(ours) == 1, "stream must stitch into ONE trace"
+            s = ours[0]
+            # spans from BOTH hosts under the one front-door root
+            assert s["hosts"] == [0, 1]
+            assert s["span_count"] == 1 + len(s["legs"]) >= 3
+            # the victim's killed leg errored ('shutdown'); linked
+            # tail-sampling keeps the whole stream, flagged
+            assert s["error"] is True
+            # parent-span labels name the dispatch sites: the primary
+            # attempt on the victim, the watermark resume on the survivor
+            parents = [leg["parent_span"] for leg in s["legs"]]
+            assert any(p == "attempt1" for p in parents), parents
+            assert any(":resume@" in p for p in parents), parents
+            by_host = {leg["host"]: leg for leg in s["legs"]}
+            assert ":resume@" in by_host[1 - victim]["parent_span"]
+            assert all(leg["link"] == tr.trace_id for leg in s["legs"])
+            # monotonic on ONE clock: legs sort by skew-corrected start,
+            # and the survivor's resume leg follows the victim's attempt
+            starts = [leg["start_corrected"] for leg in s["legs"]]
+            assert starts == sorted(starts)
+            assert (by_host[victim]["start_corrected"]
+                    <= by_host[1 - victim]["start_corrected"])
+            # Chrome export renders root + both hosts on one timeline:
+            # host lanes live in disjoint pid blocks, every span carries
+            # a shared-origin timestamp
+            ev = agg.stitched_chrome_events()
+            pids = {e["pid"] for e in ev if e.get("ph") == "X"}
+            assert any(p < 1000 for p in pids)           # front door
+            assert any(1000 <= p < 2000 for p in pids)   # host 0
+            assert any(2000 <= p < 3000 for p in pids)   # host 1
+            assert all(e["ts"] >= 0 for e in ev if e.get("ph") == "X")
         finally:
             stop_fleet(servers, locals_)
 
@@ -959,6 +1078,41 @@ class TestHedgedRedispatch:
             assert shed and "cluster.shed" in shed[0].event_names()
         finally:
             stop_fleet(servers, locals_)
+
+
+# --------------------------------------------------------------------------
+# ISSUE 19: trace context is bitwise-inert when off, linked when on
+# --------------------------------------------------------------------------
+class TestTraceContextInert:
+    def _run(self, tiny_model, traced):
+        kw = (dict(tracer=Tracer(sample_rate=1.0),
+                   engine_tracers=[Tracer(sample_rate=1.0)])
+              if traced else {})
+        d, fd, remotes, servers, locals_, engines = make_rpc_gen_fleet(
+            tiny_model, 1, **kw)
+        try:
+            res = fd.submit_generate(prompt(5, seed=3), max_new_tokens=16,
+                                     seed=11).result(timeout=120)
+            return res, kw
+        finally:
+            stop_fleet(servers, locals_)
+
+    def test_tracing_off_vs_full_sampling_bitwise_identical(self, tiny_model):
+        """The acceptance's inertness guard: the SAME seeded stream with
+        tracing disabled (the default — no trace kwargs even touch the
+        wire) and at 100% sampling produces bitwise-identical tokens;
+        the traced run's server-side leg links to the front-door root
+        (proof the context actually crossed the HTTP hop)."""
+        res_off, _ = self._run(tiny_model, traced=False)
+        res_on, kw = self._run(tiny_model, traced=True)
+        assert res_off == res_on and len(res_on) == 16
+        roots = [t for t in kw["tracer"].traces()
+                 if t.kind == "cluster.generate"]
+        assert roots and roots[-1].reason == "ok"
+        legs = [t for t in kw["engine_tracers"][0].traces()
+                if t.link == roots[-1].trace_id]
+        assert legs, "server leg never linked to the front-door root"
+        assert legs[-1].parent_span.startswith("attempt")
 
 
 # --------------------------------------------------------------------------

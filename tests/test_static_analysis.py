@@ -794,7 +794,7 @@ class Report:
         p = os.path.join(SERVING, "cluster.py")
         with open(p) as f:
             src = f.read()
-        broken = src.replace("    wire_version: int = 1\n", "")
+        broken = src.replace("    wire_version: int = 2\n", "")
         assert broken != src
         r = run({p: broken}, rules=["wire-schema-drift"])
         assert any("version field" in f.message for f in r.unsuppressed)
@@ -1452,7 +1452,7 @@ class TestRpcGate:
         extended to the data plane)."""
         p, src = self._rpc_source()
         broken = src.replace(
-            "    hedge_attempt: int = 0\n    wire_version: int = 2\n",
+            "    hedge_attempt: int = 0\n    wire_version: int = 3\n",
             "    hedge_attempt: int = 0\n")
         assert broken != src
         r = run({p: broken}, rules=["wire-schema-drift"])
@@ -1555,13 +1555,18 @@ class TestStreamRecoveryGate:
         and the response echoes the honored ``resume_step`` — while the
         chunk schema stays v1 (untouched by the resume change). A revert
         to v1 defaults would silently turn every re-dispatch back into a
-        full replay."""
+        full replay. ISSUE 19 bumped the request to v3 (trace context)
+        and the kv.migrate request to v2 — the resume fields ride along
+        unchanged."""
         _, src = self._rpc_source()
         assert "resume_tokens: Optional[list] = None" in src
         assert src.count("\n    resume_step: int = 0") == 2
+        # request @ v3 (trace context), response @ v2 (resume echo) +
+        # kv.migrate request @ v2 (trace context)
+        assert src.count("    wire_version: int = 3\n") == 1
         assert src.count("    wire_version: int = 2\n") == 2
-        # the chunk plus the two kv.migrate envelopes (ISSUE 16) stay v1
-        assert src.count("    wire_version: int = 1\n") == 3
+        # the chunk plus the kv.migrate response stay v1
+        assert src.count("    wire_version: int = 1\n") == 2
         assert "class KvMigrateRequest" in src
         assert "class KvMigrateResponse" in src
 
@@ -1660,7 +1665,7 @@ class TestDisaggGate:
         silently recompute every migrated stream."""
         p, src = self._source("rpc.py")
         anchor = (
-            "    wire_version: int = 1\n"
+            "    wire_version: int = 2\n"
             "\n"
             "    def to_dict(self) -> dict:\n"
             "        return dataclasses.asdict(self)\n"

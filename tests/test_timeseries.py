@@ -1,0 +1,343 @@
+"""Fleet time-series telemetry tests (serving/timeseries.py + its
+ISSUE 19 wiring): the bounded per-host sample rings, heartbeat-cadence
+sampling on LoopbackHost, the directory's fleet-side fold off
+``HostStatus.sample``, ``GET /api/timeseries``, and the least-squares
+cost models whose cost-per-token figure the elasticity planner's
+join/drain decisions cite (ROADMAP 4b).
+
+The inertness contract rides every layer: ``timeseries=None`` (the
+default everywhere) builds no sample, ships ``HostStatus.sample=None``
+(the pre-v2 wire shape), and keeps planner decisions bitwise identical
+to the pre-cost-model planner."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import (
+    ClusterDirectory, ElasticityLoop, ElasticityPlanner, ElasticityPolicy,
+    HeartbeatPump, InferenceEngine, LoopbackHost, LoopbackTransport,
+    ModelAdapter, ServingMetrics, TimeSeriesStore, cheapest_cell,
+    config_key, fit_cost_models,
+)
+
+
+class MlpAdapter(ModelAdapter):
+    def __init__(self):
+        super().__init__(model=None)
+        self.w = np.ones((6, 1), np.float32)
+
+    def infer(self, x):
+        return np.asarray(x) @ self.w
+
+
+def sample(occ, rate, host_class="decode", config=None, t=None):
+    s = {"slot_occupancy": occ, "tokens_per_sec": rate,
+         "host_class": host_class}
+    if config is not None:
+        s["config"] = config
+    if t is not None:
+        s["t"] = t
+    return s
+
+
+# --------------------------------------------------------------------------
+# The store: bounded rings, fixed memory, JSON-safe snapshots
+# --------------------------------------------------------------------------
+class TestTimeSeriesStore:
+    def test_record_stamps_t_and_rings_are_bounded(self):
+        ts = TimeSeriesStore(capacity=4)
+        got = ts.record(0, {"tokens_per_sec": 1.0})
+        assert got["t"] > 0   # stamped at record time
+        for i in range(9):
+            ts.record(0, sample(0.5, float(i), t=float(i)))
+        assert len(ts) == 4                      # ring evicted for real
+        assert ts.recorded_total == 10           # ...but the count didn't
+        assert [s["tokens_per_sec"] for s in ts.series(0)] \
+            == [5.0, 6.0, 7.0, 8.0]
+        assert ts.latest(0)["tokens_per_sec"] == 8.0
+
+    def test_per_host_isolation_and_flattening(self):
+        ts = TimeSeriesStore(capacity=8)
+        ts.record(2, sample(0.1, 10.0, t=1.0))
+        ts.record(0, sample(0.2, 20.0, t=2.0))
+        ts.record(2, sample(0.3, 30.0, t=3.0))
+        assert ts.host_ids() == [0, 2]
+        assert len(ts.series(2)) == 2 and len(ts.series(0)) == 1
+        assert ts.series(1) == [] and ts.latest(1) is None
+        assert len(ts.all_samples()) == 3
+
+    def test_readers_return_copies(self):
+        ts = TimeSeriesStore()
+        ts.record(0, sample(0.5, 9.0, t=1.0))
+        ts.series(0)[0]["tokens_per_sec"] = -1.0
+        ts.latest(0)["tokens_per_sec"] = -1.0
+        assert ts.latest(0)["tokens_per_sec"] == 9.0
+
+    def test_api_snapshot_shape_and_limit(self):
+        ts = TimeSeriesStore(capacity=16)
+        for i in range(6):
+            ts.record(3, sample(0.5, float(i), t=float(i)))
+        snap = json.loads(json.dumps(ts.api_snapshot(limit=2)))
+        assert snap["capacity"] == 16 and snap["recorded_total"] == 6
+        h = snap["hosts"]["3"]
+        assert h["n"] == 6 and len(h["series"]) == 2
+        assert h["latest"]["tokens_per_sec"] == 5.0
+        assert [s["tokens_per_sec"] for s in h["series"]] == [4.0, 5.0]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TimeSeriesStore(capacity=0)
+
+
+# --------------------------------------------------------------------------
+# Cost models: least squares over (host class x config) cells
+# --------------------------------------------------------------------------
+class TestCostModels:
+    CFG_BF16 = {"kv_dtype": "bfloat16", "allocate": "on_demand",
+                "paged_attention": "pallas"}
+
+    def test_config_key_defaults_and_axes(self):
+        assert config_key("decode", None) \
+            == "decode|kv=float32|alloc=reserve|paged=none"
+        assert config_key(None, self.CFG_BF16) \
+            == "mixed|kv=bfloat16|alloc=on_demand|paged=pallas"
+
+    def test_perfect_linear_fit_recovers_the_curve(self):
+        # rate = 100 - 20*occ exactly: at full occupancy 80 tok/s
+        rows = [sample(o, 100.0 - 20.0 * o) for o in
+                (0.1, 0.25, 0.5, 0.75, 1.0)]
+        models = fit_cost_models(rows)
+        [key] = models
+        m = models[key]
+        assert m["n"] == 5
+        assert m["intercept"] == pytest.approx(100.0)
+        assert m["slope"] == pytest.approx(-20.0)
+        assert m["r2"] == pytest.approx(1.0)
+        assert m["tokens_per_sec_at_full"] == pytest.approx(80.0)
+        assert m["cost_per_token"] == pytest.approx(1.0 / 80.0)
+
+    def test_host_cost_per_s_prices_the_rate(self):
+        rows = [sample(o, 50.0) for o in (0.2, 0.4, 0.6, 0.8)]
+        m = fit_cost_models(rows, host_cost_per_s=3600.0)
+        assert m[config_key("decode", None)]["cost_per_token"] \
+            == pytest.approx(3600.0 / 50.0)
+        with pytest.raises(ValueError):
+            fit_cost_models(rows, host_cost_per_s=0.0)
+
+    def test_min_samples_gates_the_fit(self):
+        rows = [sample(o, 10.0) for o in (0.1, 0.9)]
+        m = fit_cost_models(rows, min_samples=4)
+        model = m[config_key("decode", None)]
+        assert model["n"] == 2 and model["cost_per_token"] is None
+        assert model["mean_tokens_per_sec"] == pytest.approx(10.0)
+
+    def test_nonpositive_predicted_rate_reports_unusable(self):
+        # rate collapses with occupancy: at occ=1 the fit predicts <= 0
+        rows = [sample(o, max(0.0, 10.0 - 20.0 * o)) for o in
+                (0.1, 0.3, 0.5, 0.7, 0.9)]
+        m = fit_cost_models(rows)
+        assert m[config_key("decode", None)]["cost_per_token"] is None
+
+    def test_cells_split_by_host_class_and_config(self):
+        rows = ([sample(o, 40.0, config=self.CFG_BF16) for o in
+                 (0.2, 0.4, 0.6, 0.8)]
+                + [sample(o, 20.0, host_class="prefill") for o in
+                   (0.2, 0.4, 0.6, 0.8)])
+        # samples missing either axis are skipped, not crashed on
+        rows.append({"host_class": "decode"})
+        models = fit_cost_models(rows)
+        assert set(models) == {config_key("decode", self.CFG_BF16),
+                               config_key("prefill", None)}
+        assert cheapest_cell(models) == config_key("decode", self.CFG_BF16)
+
+    def test_cheapest_cell_none_without_a_usable_fit(self):
+        assert cheapest_cell({}) is None
+        models = fit_cost_models([sample(0.5, 10.0)], min_samples=4)
+        assert cheapest_cell(models) is None
+
+    def test_fit_accepts_a_store_directly(self):
+        ts = TimeSeriesStore()
+        for o in (0.2, 0.4, 0.6, 0.8):
+            ts.record(0, sample(o, 100.0 - 10.0 * o, t=o))
+        models = fit_cost_models(ts)
+        m = models[config_key("decode", None)]
+        assert m["tokens_per_sec_at_full"] == pytest.approx(90.0)
+
+
+# --------------------------------------------------------------------------
+# Heartbeat-cadence sampling: host ring -> HostStatus.sample -> fleet ring
+# --------------------------------------------------------------------------
+class TestHeartbeatSampling:
+    def test_status_without_store_ships_no_sample(self):
+        eng = InferenceEngine(MlpAdapter(), max_batch_size=4,
+                              max_wait_ms=0.0, name="ts-off")
+        try:
+            st = LoopbackHost(0, engine=eng).status()
+            assert st.sample is None         # bitwise-inert default
+            assert st.wall_t > 0             # the skew stamp always rides
+        finally:
+            eng.shutdown()
+
+    def test_status_folds_one_sample_per_beat_and_ships_it(self):
+        ts = TimeSeriesStore(capacity=8)
+        eng = InferenceEngine(MlpAdapter(), max_batch_size=4,
+                              max_wait_ms=0.0, name="ts-on")
+        try:
+            h = LoopbackHost(4, engine=eng, timeseries=ts)
+            st = h.status()
+            assert st.sample is not None
+            assert st.sample["t"] == st.wall_t
+            assert st.sample["host_class"] == "mixed"
+            assert "tokens_per_sec" in st.sample
+            assert "rss_bytes" in st.sample
+            assert ts.latest(4) == st.sample  # the host's own ring
+            h.status()
+            assert len(ts.series(4)) == 2     # one per beat, no more
+        finally:
+            eng.shutdown()
+
+    def test_directory_folds_heartbeat_samples_fleet_side(self):
+        host_ts = TimeSeriesStore()
+        fleet_ts = TimeSeriesStore()
+        d = ClusterDirectory(heartbeat_timeout_s=30.0,
+                             timeseries=fleet_ts)
+        eng = InferenceEngine(MlpAdapter(), max_batch_size=4,
+                              max_wait_ms=0.0, name="ts-fleet")
+        try:
+            h = LoopbackHost(2, engine=eng, timeseries=host_ts)
+            d.join(h)
+            pump = HeartbeatPump(h, LoopbackTransport(d))
+            pump.pump_once()
+            pump.pump_once()
+            assert len(fleet_ts.series(2)) == 2
+            assert fleet_ts.latest(2)["tokens_per_sec"] \
+                == host_ts.latest(2)["tokens_per_sec"]
+            # a sample-less host (pre-upgrade, or sampling off) folds
+            # nothing and breaks nothing
+            eng2 = InferenceEngine(MlpAdapter(), max_batch_size=4,
+                                   max_wait_ms=0.0, name="ts-fleet2")
+            try:
+                h2 = LoopbackHost(3, engine=eng2)
+                d.join(h2)
+                HeartbeatPump(h2, LoopbackTransport(d)).pump_once()
+                assert fleet_ts.series(3) == []
+            finally:
+                eng2.shutdown()
+        finally:
+            eng.shutdown()
+
+
+# --------------------------------------------------------------------------
+# The planner cites fitted cost-per-token (ROADMAP 4b)
+# --------------------------------------------------------------------------
+def _snap(free=4, slots=8, alive=2):
+    return {"fleet": {"hosts": alive, "alive": alive, "draining": 0,
+                      "slots": slots, "free_slots": free},
+            "hosts": {}, "front_doors": []}
+
+
+class TestPlannerCostModel:
+    def _seeded_store(self):
+        ts = TimeSeriesStore()
+        for i, o in enumerate((0.2, 0.4, 0.6, 0.8, 1.0)):
+            ts.record(0, sample(o, 100.0 - 20.0 * o, t=float(i)))
+        return ts
+
+    def test_default_planner_is_bitwise_identical(self):
+        with_ts = ElasticityPlanner(timeseries=None)
+        without = ElasticityPlanner()
+        for _ in range(3):
+            a = with_ts.observe(_snap())
+            b = without.observe(_snap())
+            assert a == b and "cost_model" not in a
+            assert "fitted cost/token" not in a["reason"]
+
+    def test_decision_cites_fitted_cost_per_token(self):
+        ts = self._seeded_store()
+        p = ElasticityPlanner(timeseries=ts)
+        dec = p.observe(_snap())
+        key = config_key("decode", None)
+        assert f"({key}, n=5" in dec["reason"]
+        assert "fitted cost/token 1.250e-02 host-s" in dec["reason"]
+        cm = dec["cost_model"]
+        assert cm["cheapest"] == key
+        assert cm["models"][key]["cost_per_token"] \
+            == pytest.approx(1.0 / 80.0)
+        assert cm["host_cost_per_s"] == 1.0
+
+    def test_unusable_fit_cites_nothing(self):
+        ts = TimeSeriesStore()
+        ts.record(0, sample(0.5, 10.0, t=1.0))   # below min_fit_samples
+        p = ElasticityPlanner(timeseries=ts)
+        dec = p.observe(_snap())
+        assert "fitted cost/token" not in dec["reason"]
+        assert dec["cost_model"]["cheapest"] is None
+
+    def test_loop_step_decision_carries_the_citation(self):
+        """The acceptance wording end to end: ``ElasticityLoop.step()``
+        over a live directory produces a decision citing the fitted
+        cost-per-token from the directory's own fleet-side ring — the
+        same data ``/api/timeseries`` serves."""
+        fleet_ts = TimeSeriesStore()
+        d = ClusterDirectory(heartbeat_timeout_s=30.0,
+                             timeseries=fleet_ts)
+        eng = InferenceEngine(MlpAdapter(), max_batch_size=4,
+                              max_wait_ms=0.0, name="ts-loop")
+        try:
+            h = LoopbackHost(0, engine=eng,
+                             timeseries=TimeSeriesStore())
+            d.join(h)
+            pump = HeartbeatPump(h, LoopbackTransport(d))
+            pump.pump_once()
+            # the live host's heartbeat sample (idle: occupancy 0, rate
+            # 0, under min_fit_samples) lands in the 'mixed' cell; a
+            # usable curve needs spread, so densify a decode-class cell
+            # the way a busy fleet would
+            for i, o in enumerate((0.25, 0.5, 0.75, 1.0)):
+                fleet_ts.record(0, sample(o, 50.0, t=float(i)))
+            loop = ElasticityLoop(
+                d, planner=ElasticityPlanner(
+                    ElasticityPolicy(min_hosts=1),
+                    timeseries=fleet_ts, host_cost_per_s=2.0))
+            dec = loop.step()
+            assert "fitted cost/token" in dec["reason"]
+            m = dec["cost_model"]["models"][dec["cost_model"]["cheapest"]]
+            assert m["cost_per_token"] == pytest.approx(2.0 / 50.0)
+        finally:
+            eng.shutdown()
+
+
+# --------------------------------------------------------------------------
+# GET /api/timeseries
+# --------------------------------------------------------------------------
+class TestApiTimeseries:
+    def test_endpoint_serves_rings_and_cost_models(self):
+        from deeplearning4j_tpu.ui import UIServer
+
+        fleet_ts = TimeSeriesStore()
+        d = ClusterDirectory(heartbeat_timeout_s=30.0,
+                             timeseries=fleet_ts)
+        for i, o in enumerate((0.2, 0.4, 0.6, 0.8)):
+            fleet_ts.record(1, sample(o, 30.0, t=float(i)))
+        server = UIServer(port=0)
+        try:
+            with urllib.request.urlopen(
+                    server.url + "api/timeseries?limit=2",
+                    timeout=10) as r:
+                payload = json.loads(r.read().decode())
+            ours = [p for p in payload
+                    if "1" in p.get("hosts", {})
+                    and p["hosts"]["1"]["n"] == 4]
+            assert ours, payload
+            got = ours[-1]
+            assert len(got["hosts"]["1"]["series"]) == 2   # ?limit=
+            key = config_key("decode", None)
+            assert got["cheapest_cell"] == key
+            assert got["cost_models"][key]["cost_per_token"] \
+                == pytest.approx(1.0 / 30.0)
+        finally:
+            server.stop()
+            # keep the directory's store out of later tests' payloads
+            fleet_ts.clear()

@@ -24,7 +24,7 @@ from deeplearning4j_tpu.serving import (
 )
 from deeplearning4j_tpu.serving import faults as faults_mod
 from deeplearning4j_tpu.serving.tracing import (
-    NULL_TRACE, FlightRecorder, all_tracers, default_tracer,
+    NULL_TRACE, FlightRecorder, all_tracers, default_tracer, link_registry,
 )
 from deeplearning4j_tpu.util import crash_reporting
 
@@ -88,6 +88,113 @@ class TestFlightRecorder:
             fr.record("e")
         seqs = [e["seq"] for e in fr.snapshot()]
         assert seqs == [4, 5]
+
+    def test_host_id_stamped_at_record_time(self):
+        """ISSUE 19 satellite: events are attributable the moment they
+        are recorded — a merged incident ring needs no worker-prefix
+        cross-referencing. Earlier events keep their (un)stamp, an
+        explicit ``host=`` field always wins, None stops stamping."""
+        fr = FlightRecorder(capacity=8)
+        fr.record("before")
+        fr.set_host(3)
+        fr.record("after")
+        fr.record("explicit", host=9)
+        fr.set_host(None)
+        fr.record("stopped")
+        snap = {e["kind"]: e for e in fr.snapshot()}
+        assert "host" not in snap["before"]
+        assert snap["after"]["host"] == 3
+        assert snap["explicit"]["host"] == 9
+        assert "host" not in snap["stopped"]
+
+    def test_loopback_host_stamps_its_engines_recorder(self):
+        """The cluster wiring half: wrapping an engine in a LoopbackHost
+        stamps that engine's recorder with the host id, so every future
+        incident event (device failures, breaker trips, shutdown) lands
+        pre-attributed in crash dumps."""
+        from deeplearning4j_tpu.serving import LoopbackHost
+
+        rec = FlightRecorder(capacity=8)
+        eng = InferenceEngine(EchoAdapter(), max_batch_size=2,
+                              max_wait_ms=0.0, recorder=rec,
+                              name="fr-host")
+        try:
+            LoopbackHost(5, engine=eng)
+            rec.record("incident")
+            assert rec.snapshot()[-1]["host"] == 5
+        finally:
+            eng.shutdown()
+        assert rec.snapshot()[-1]["kind"] == "engine.shutdown"
+        assert rec.snapshot()[-1]["host"] == 5
+
+
+# --------------------------------------------------------------------------
+# ISSUE 19 satellite: tail-sampling retention is per LOGICAL stream
+# --------------------------------------------------------------------------
+class TestLinkedTailSampling:
+    """An error on ANY leg of a linked cross-host trace retains EVERY
+    leg of that logical stream, whichever tracer holds it — without
+    coordination the stitched view lies (a retained root whose failed
+    remote leg was sampled out, or vice versa)."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_registry(self):
+        link_registry().clear()
+        yield
+        link_registry().clear()
+
+    def test_late_error_resurrects_sampled_out_linked_leg(self):
+        fd = Tracer(sample_rate=1.0)
+        host = Tracer(sample_rate=0.0, keep_errors=True)
+        root = fd.begin("cluster", "cluster.generate")
+        leg = host.begin("rpc-g0", "generate", link=root.trace_id,
+                         parent_span="attempt1")
+        leg.finish("ok")
+        # the coin dropped the success leg — parked, not yet visible
+        assert host.stats()["retained"] == 0
+        assert host.stats()["sampled_out"] == 1
+        # ... until the ROOT errors: the whole stream is one retention
+        # unit, so the parked leg is resurrected into ITS OWN tracer
+        root.finish("host_unavailable")
+        assert fd.stats()["retained"] == 1
+        st = host.stats()
+        assert st["retained"] == 1 and st["link_retained"] == 1
+        assert st["sampled_out"] == 0
+        assert host.traces()[-1].trace_id == leg.trace_id
+
+    def test_earlier_error_force_retains_later_legs(self):
+        fd = Tracer(sample_rate=1.0)
+        host = Tracer(sample_rate=0.0, keep_errors=True)
+        root = fd.begin("cluster", "cluster.generate")
+        root.finish("deadline")
+        leg = host.begin("rpc-g1", "generate", link=root.trace_id,
+                         parent_span="hedge")
+        leg.finish("ok")   # success, but its stream already errored
+        st = host.stats()
+        assert st["retained"] == 1 and st["link_retained"] == 1
+
+    def test_unlinked_traces_keep_plain_tail_sampling(self):
+        t = Tracer(sample_rate=0.0, keep_errors=True)
+        t.begin("e", "infer").finish("ok")
+        assert t.stats()["retained"] == 0
+        t.begin("e", "infer").finish("queue_full")
+        assert t.stats()["retained"] == 1   # errors always kept
+
+    def test_error_leg_on_host_retains_sampled_out_root(self):
+        """The symmetric direction: the front door's success root was
+        sampled out; the remote leg's error claims it back — the
+        stitched trace keeps its root."""
+        fd = Tracer(sample_rate=0.0, keep_errors=True)
+        host = Tracer(sample_rate=1.0)
+        root = fd.begin("cluster", "cluster.generate")
+        rid = root.trace_id
+        leg = host.begin("rpc-g0", "generate", link=rid,
+                         parent_span="attempt1")
+        root.finish("ok")
+        assert fd.stats()["retained"] == 0
+        leg.finish("host_unavailable")
+        assert fd.stats()["retained"] == 1
+        assert fd.traces()[-1].trace_id == rid
 
 
 # --------------------------------------------------------------------------
